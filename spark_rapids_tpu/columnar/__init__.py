@@ -1,0 +1,5 @@
+from .column import Column, bucket_strlen
+from .batch import ColumnarBatch, bucket_rows, concat_batches
+
+__all__ = ["Column", "ColumnarBatch", "bucket_rows", "bucket_strlen",
+           "concat_batches"]

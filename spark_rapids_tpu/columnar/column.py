@@ -1,0 +1,181 @@
+"""Device column vectors.
+
+The TPU analogue of the reference's GpuColumnVector
+(reference: sql-plugin/src/main/java/.../GpuColumnVector.java) — but instead of
+wrapping a cuDF buffer, a column IS a small pytree of jnp arrays so whole
+operator pipelines can be traced into one XLA program:
+
+  * data  : jnp array [capacity]           (numeric/bool/date/timestamp)
+            or uint8 [capacity, max_len]   (strings, padded UTF-8 bytes)
+  * valid : bool [capacity]                (null bitmap; True = non-null)
+  * lengths : int32 [capacity]             (strings only)
+
+`capacity` is a STATIC bucketed size (see batch.py); the actual row count of a
+batch is tracked by the batch's row mask.  Null slots hold zeros so reductions
+can mask without NaN poisoning.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (BooleanType, DataType, DoubleType, StringType)
+
+
+@jax.tree_util.register_pytree_node_class
+class Column:
+    """One device column. Registered as a pytree: `data`/`valid`/`lengths`
+    are traced leaves, `dtype` is static."""
+
+    __slots__ = ("data", "valid", "lengths", "dtype")
+
+    def __init__(self, data, valid, dtype: DataType, lengths=None):
+        self.data = data
+        self.valid = valid
+        self.dtype = dtype
+        self.lengths = lengths
+
+    def tree_flatten(self):
+        if self.dtype.is_string:
+            return (self.data, self.valid, self.lengths), self.dtype
+        return (self.data, self.valid), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, children):
+        if dtype.is_string:
+            data, valid, lengths = children
+            return cls(data, valid, dtype, lengths)
+        data, valid = children
+        return cls(data, valid, dtype)
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        assert self.dtype.is_string
+        return self.data.shape[1]
+
+    # ---- constructors ------------------------------------------------------
+
+    @staticmethod
+    def from_numpy(values: np.ndarray, valid: Optional[np.ndarray],
+                   dtype: DataType, capacity: Optional[int] = None) -> "Column":
+        """Build a (host-side) column from numpy, padding to `capacity`."""
+        n = len(values)
+        cap = capacity if capacity is not None else n
+        assert cap >= n, (cap, n)
+        if valid is None:
+            valid = np.ones(n, dtype=np.bool_)
+        vfull = np.zeros(cap, dtype=np.bool_)
+        vfull[:n] = valid
+        if dtype.is_string:
+            raise ValueError("use Column.from_strings for string data")
+        dfull = np.zeros(cap, dtype=dtype.np_dtype)
+        arr = np.asarray(values, dtype=dtype.np_dtype)
+        # zero out nulls so masked reductions are safe
+        arr = np.where(valid, arr, np.zeros((), dtype=dtype.np_dtype))
+        dfull[:n] = arr
+        return Column(jnp.asarray(dfull), jnp.asarray(vfull), dtype)
+
+    @staticmethod
+    def from_strings(values, capacity: Optional[int] = None,
+                     max_len: Optional[int] = None) -> "Column":
+        """values: sequence of str | None."""
+        n = len(values)
+        cap = capacity if capacity is not None else n
+        enc = [v.encode("utf-8") if v is not None else b"" for v in values]
+        need = max((len(b) for b in enc), default=0)
+        ml = max_len if max_len is not None else bucket_strlen(need)
+        assert ml >= need, (ml, need)
+        data = np.zeros((cap, ml), dtype=np.uint8)
+        lengths = np.zeros(cap, dtype=np.int32)
+        valid = np.zeros(cap, dtype=np.bool_)
+        for i, (v, b) in enumerate(zip(values, enc)):
+            if v is None:
+                continue
+            valid[i] = True
+            lengths[i] = len(b)
+            if b:
+                data[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        return Column(jnp.asarray(data), jnp.asarray(valid), StringType,
+                      jnp.asarray(lengths))
+
+    @staticmethod
+    def all_null(dtype: DataType, capacity: int, max_len: int = 8) -> "Column":
+        valid = jnp.zeros(capacity, dtype=jnp.bool_)
+        if dtype.is_string:
+            return Column(jnp.zeros((capacity, max_len), dtype=jnp.uint8),
+                          valid, dtype, jnp.zeros(capacity, dtype=jnp.int32))
+        return Column(jnp.zeros(capacity, dtype=dtype.jnp_dtype), valid, dtype)
+
+    # ---- host materialization ---------------------------------------------
+
+    def to_pylist(self, num_rows: int):
+        """Materialize the first `num_rows` rows as Python values (None=null)."""
+        valid = np.asarray(self.valid)[:num_rows]
+        if self.dtype.is_string:
+            data = np.asarray(self.data)[:num_rows]
+            lens = np.asarray(self.lengths)[:num_rows]
+            return [bytes(data[i, :lens[i]]).decode("utf-8", "replace")
+                    if valid[i] else None for i in range(num_rows)]
+        data = np.asarray(self.data)[:num_rows]
+        out = []
+        for i in range(num_rows):
+            out.append(data[i].item() if valid[i] else None)
+        return out
+
+    # ---- structural ops (all static-shape, jit-safe) -----------------------
+
+    def take(self, indices) -> "Column":
+        """Gather rows; indices out of range produce garbage rows the caller
+        must mask."""
+        if self.dtype.is_string:
+            return Column(jnp.take(self.data, indices, axis=0,
+                                   mode="clip"),
+                          jnp.take(self.valid, indices, mode="clip"),
+                          self.dtype,
+                          jnp.take(self.lengths, indices, mode="clip"))
+        return Column(jnp.take(self.data, indices, mode="clip"),
+                      jnp.take(self.valid, indices, mode="clip"),
+                      self.dtype)
+
+    def with_valid(self, valid) -> "Column":
+        return Column(self.data, valid, self.dtype, self.lengths)
+
+    def mask_invalid(self) -> "Column":
+        """Zero data in null slots (keeps reductions clean after ops that may
+        have written garbage there)."""
+        if self.dtype.is_string:
+            lens = jnp.where(self.valid, self.lengths, 0)
+            data = jnp.where(self.valid[:, None], self.data, 0)
+            return Column(data, self.valid, self.dtype, lens)
+        zero = jnp.zeros((), dtype=self.data.dtype)
+        return Column(jnp.where(self.valid, self.data, zero), self.valid,
+                      self.dtype)
+
+    def pad_strings_to(self, max_len: int) -> "Column":
+        assert self.dtype.is_string
+        cur = self.max_len
+        if cur == max_len:
+            return self
+        if cur < max_len:
+            pad = jnp.zeros((self.capacity, max_len - cur), dtype=jnp.uint8)
+            return Column(jnp.concatenate([self.data, pad], axis=1),
+                          self.valid, self.dtype, self.lengths)
+        raise ValueError(f"cannot shrink string column {cur} -> {max_len}")
+
+    def __repr__(self):  # pragma: no cover
+        return f"Column({self.dtype.name}, cap={self.capacity})"
+
+
+def bucket_strlen(n: int, minimum: int = 8) -> int:
+    """Round a string max-length up to a power-of-two bucket (static shapes)."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b

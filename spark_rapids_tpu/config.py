@@ -1,0 +1,287 @@
+"""Typed configuration registry.
+
+Mirrors the reference's conf system (reference: sql-plugin/.../rapids/
+RapidsConf.scala:96-220 for the builder machinery, :221-590 for the key list,
+:600-689 for doc generation): every entry has a key, a typed default, a doc
+string, and an `internal` flag; docs/configs.md is *generated* from this
+registry; every operator/expression additionally gets an auto-derived
+kill-switch key (see plan/overrides.py).
+
+Key namespace keeps the reference's `spark.rapids.` prefix so users of the
+reference find the same knobs, with `tpu` substituted where the reference says
+`gpu`.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+_REGISTRY: "Dict[str, ConfEntry]" = {}
+
+
+def _to_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes"):
+        return True
+    if s in ("false", "0", "no"):
+        return False
+    raise ValueError(f"not a boolean: {v!r}")
+
+
+_BYTE_SUFFIXES = {
+    "b": 1, "k": 1 << 10, "kb": 1 << 10, "m": 1 << 20, "mb": 1 << 20,
+    "g": 1 << 30, "gb": 1 << 30, "t": 1 << 40, "tb": 1 << 40,
+}
+
+
+def to_bytes(v) -> int:
+    """Parse '2g', '512m', '1024' -> bytes (reference: byte converters in
+    TypedConfBuilder, RapidsConf.scala:141-150)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]*)\s*", str(v))
+    if not m:
+        raise ValueError(f"not a byte size: {v!r}")
+    num, suf = float(m.group(1)), m.group(2).lower()
+    if suf == "":
+        return int(num)
+    if suf not in _BYTE_SUFFIXES:
+        raise ValueError(f"unknown byte suffix {suf!r} in {v!r}")
+    return int(num * _BYTE_SUFFIXES[suf])
+
+
+class ConfEntry:
+    def __init__(self, key: str, default: Any, doc: str,
+                 converter: Callable[[Any], Any],
+                 internal: bool = False):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.converter = converter
+        self.internal = internal
+        if key in _REGISTRY:
+            raise ValueError(f"duplicate conf key {key}")
+        _REGISTRY[key] = self
+
+    def get(self, conf: "TpuConf"):
+        raw = conf._settings.get(self.key)
+        if raw is None:
+            return self.default
+        return self.converter(raw)
+
+
+def _conf(key, default, doc, converter, internal=False) -> ConfEntry:
+    return ConfEntry(key, default, doc, converter, internal)
+
+
+# --- core enables -----------------------------------------------------------
+SQL_ENABLED = _conf("spark.rapids.sql.enabled", True,
+                    "Enable (true) or disable (false) TPU acceleration of SQL "
+                    "plans.", _to_bool)
+TEST_CONF = _conf("spark.rapids.sql.test.enabled", False,
+                  "Intended for internal testing only: fail if an operation "
+                  "falls back to CPU instead of running on the TPU.", _to_bool,
+                  internal=True)
+TEST_ALLOWED_NONTPU = _conf(
+    "spark.rapids.sql.test.allowedNonTpu", "",
+    "Comma separated exec class names allowed to stay on CPU in test mode.",
+    str, internal=True)
+INCOMPATIBLE_OPS = _conf(
+    "spark.rapids.sql.incompatibleOps.enabled", False,
+    "Enable operations that produce results that differ from Spark in corner "
+    "cases (e.g. float aggregation ordering).", _to_bool)
+EXPLAIN = _conf(
+    "spark.rapids.sql.explain", "NONE",
+    "Explain why parts of a query were or were not placed on the TPU. "
+    "NONE|ALL|NOT_ON_TPU.", str)
+HAS_NANS = _conf(
+    "spark.rapids.sql.hasNans", True,
+    "Assume floating point data may contain NaNs (affects eligibility of some "
+    "ops, matching the reference's hasNans gate).", _to_bool)
+VARIABLE_FLOAT_AGG = _conf(
+    "spark.rapids.sql.variableFloatAgg.enabled", False,
+    "Allow float/double aggregations whose result may differ in last-bit "
+    "rounding from CPU due to reduction order.", _to_bool)
+ENABLE_CAST_STRING_TO_FLOAT = _conf(
+    "spark.rapids.sql.castStringToFloat.enabled", False,
+    "Enable string->float casts on device; off by default because corner-case "
+    "formats differ from the CPU.", _to_bool)
+ENABLE_CAST_FLOAT_TO_STRING = _conf(
+    "spark.rapids.sql.castFloatToString.enabled", False,
+    "Enable float->string casts on device; formatting differs in corner cases.",
+    _to_bool)
+ENABLE_CAST_STRING_TO_TIMESTAMP = _conf(
+    "spark.rapids.sql.castStringToTimestamp.enabled", False,
+    "Enable string->timestamp casts on device.", _to_bool)
+IMPROVED_FLOAT_OPS = _conf(
+    "spark.rapids.sql.improvedFloatOps.enabled", False,
+    "Use device float ops that are faster but not bit-identical to the JVM.",
+    _to_bool)
+
+# --- batching ---------------------------------------------------------------
+BATCH_SIZE_BYTES = _conf(
+    "spark.rapids.sql.batchSizeBytes", 2 << 30,
+    "Target size in bytes for TPU columnar batches; operators coalesce "
+    "smaller batches up to this goal (reference default 2GiB).", to_bytes)
+MAX_READER_BATCH_SIZE_ROWS = _conf(
+    "spark.rapids.sql.reader.batchSizeRows", 2 ** 31 - 1,
+    "Soft cap on rows per batch produced by file readers.", int)
+MAX_READER_BATCH_SIZE_BYTES = _conf(
+    "spark.rapids.sql.reader.batchSizeBytes", 2 << 30,
+    "Soft cap on bytes per batch produced by file readers.", to_bytes)
+MIN_BUCKET_ROWS = _conf(
+    "spark.rapids.sql.tpu.minBucketRows", 1024,
+    "Smallest row-capacity bucket; batch capacities are rounded up to "
+    "power-of-two buckets so XLA recompiles are bounded (TPU-specific: XLA "
+    "traces once per static shape).", int)
+
+# --- memory -----------------------------------------------------------------
+TPU_ALLOC_FRACTION = _conf(
+    "spark.rapids.memory.tpu.allocFraction", 0.9,
+    "Fraction of usable HBM to reserve for the columnar batch pool.", float)
+HOST_SPILL_STORAGE_SIZE = _conf(
+    "spark.rapids.memory.host.spillStorageSize", 1 << 30,
+    "Bytes of host memory to use for spilled device buffers before spilling "
+    "to disk.", to_bytes)
+TPU_OOM_SPILL_ENABLED = _conf(
+    "spark.rapids.memory.tpu.oomSpill.enabled", True,
+    "Synchronously spill device buffers when an HBM allocation fails.",
+    _to_bool)
+TPU_DEBUG = _conf(
+    "spark.rapids.memory.tpu.debug", "NONE",
+    "Log device allocations/frees: NONE|STDOUT|STDERR.", str)
+CONCURRENT_TPU_TASKS = _conf(
+    "spark.rapids.sql.concurrentTpuTasks", 1,
+    "Number of tasks that may use the TPU concurrently (device semaphore).",
+    int)
+PINNED_POOL_SIZE = _conf(
+    "spark.rapids.memory.pinnedPool.size", 0,
+    "Size of the pinned host staging pool used for H2D/D2H transfer.",
+    to_bytes)
+
+# --- formats ----------------------------------------------------------------
+CSV_ENABLED = _conf("spark.rapids.sql.format.csv.enabled", True,
+                    "Enable CSV read acceleration.", _to_bool)
+CSV_READ_ENABLED = _conf("spark.rapids.sql.format.csv.read.enabled", True,
+                         "Enable CSV reads.", _to_bool)
+PARQUET_ENABLED = _conf("spark.rapids.sql.format.parquet.enabled", True,
+                        "Enable Parquet acceleration.", _to_bool)
+PARQUET_READ_ENABLED = _conf("spark.rapids.sql.format.parquet.read.enabled",
+                             True, "Enable Parquet reads.", _to_bool)
+PARQUET_WRITE_ENABLED = _conf("spark.rapids.sql.format.parquet.write.enabled",
+                              True, "Enable Parquet writes.", _to_bool)
+ORC_ENABLED = _conf("spark.rapids.sql.format.orc.enabled", True,
+                    "Enable ORC acceleration.", _to_bool)
+ORC_READ_ENABLED = _conf("spark.rapids.sql.format.orc.read.enabled", True,
+                         "Enable ORC reads.", _to_bool)
+ORC_WRITE_ENABLED = _conf("spark.rapids.sql.format.orc.write.enabled", True,
+                          "Enable ORC writes.", _to_bool)
+PARQUET_DEBUG_DUMP_PREFIX = _conf(
+    "spark.rapids.sql.parquet.debug.dumpPrefix", "",
+    "If set, dump the clipped host parquet buffer to this path prefix for "
+    "offline repro.", str)
+
+# --- shuffle ----------------------------------------------------------------
+SHUFFLE_TRANSPORT_CLASS = _conf(
+    "spark.rapids.shuffle.transport.class",
+    "spark_rapids_tpu.shuffle.ici.IciShuffleTransport",
+    "Implementation of the device shuffle transport "
+    "(ICI all-to-all on-slice; loopback transport for tests).", str)
+SHUFFLE_MAX_RECV_INFLIGHT = _conf(
+    "spark.rapids.shuffle.maxReceiveInflightBytes", 1 << 30,
+    "Cap on bytes of shuffle data in flight to a receiving task.", to_bytes)
+SHUFFLE_DEVICE_RESIDENT = _conf(
+    "spark.rapids.shuffle.deviceResident.enabled", True,
+    "Keep shuffle partitions resident in HBM (spillable) instead of "
+    "serializing to host between stages.", _to_bool)
+
+# --- export -----------------------------------------------------------------
+EXPORT_COLUMNAR_RDD = _conf(
+    "spark.rapids.sql.exportColumnarRdd", False,
+    "Allow exporting device columnar data for ML integration "
+    "(ColumnarRdd equivalent).", _to_bool)
+
+
+class TpuConf:
+    """A view over string settings, like RapidsConf over SparkConf."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None,
+                 use_env: bool = True):
+        self._settings: Dict[str, Any] = {}
+        if use_env:
+            for k, v in os.environ.items():
+                if k.startswith("SPARK_RAPIDS_"):
+                    key = k.lower().replace("_", ".").replace(
+                        "spark.rapids.", "spark.rapids.", 1)
+                    self._settings[key] = v
+        if settings:
+            self._settings.update(settings)
+
+    def get(self, entry_or_key):
+        if isinstance(entry_or_key, ConfEntry):
+            return entry_or_key.get(self)
+        entry = _REGISTRY.get(entry_or_key)
+        if entry is not None:
+            return entry.get(self)
+        return self._settings.get(entry_or_key)
+
+    def set(self, key: str, value) -> "TpuConf":
+        self._settings[key] = value
+        return self
+
+    def is_op_enabled(self, conf_key: str, default: bool = True) -> bool:
+        raw = self._settings.get(conf_key)
+        if raw is None:
+            return default
+        return _to_bool(raw)
+
+    # convenience properties (subset; prefer .get(ENTRY))
+    @property
+    def sql_enabled(self):
+        return self.get(SQL_ENABLED)
+
+    @property
+    def is_test_enabled(self):
+        return self.get(TEST_CONF)
+
+    @property
+    def explain(self):
+        return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def batch_size_bytes(self):
+        return self.get(BATCH_SIZE_BYTES)
+
+
+def registered_entries() -> List[ConfEntry]:
+    return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+
+def help_doc(include_internal: bool = False) -> str:
+    """Generate docs/configs.md, like RapidsConf.help (RapidsConf.scala:600-689)."""
+    lines = [
+        "# TPU Accelerator for Apache Spark — Configuration",
+        "",
+        "The following configs are generated from the registry in "
+        "`spark_rapids_tpu/config.py`; do not edit by hand.",
+        "",
+        "Name | Description | Default Value",
+        "-----|-------------|--------------",
+    ]
+    for e in registered_entries():
+        if e.internal and not include_internal:
+            continue
+        lines.append(f"{e.key}|{e.doc}|{e.default}")
+    lines += [
+        "",
+        "## Fine-tuning: per-operator enables",
+        "",
+        "Every accelerated expression, exec, scan and partitioning also gets an "
+        "auto-derived boolean config `spark.rapids.sql.<kind>.<Name>` that can "
+        "force it back to the CPU (see `spark_rapids_tpu/plan/overrides.py`).",
+        "",
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,189 @@
+"""Data type system for the TPU columnar engine.
+
+Mirrors the supported-type gate of the reference plugin
+(reference: sql-plugin/.../rapids/GpuOverrides.scala:375-387 — bool/byte/short/int/
+long/float/double/date/timestamp-UTC/string), mapped onto JAX device dtypes.
+
+Device representation decisions (TPU-first, not a cuDF port):
+  * numeric/bool/date/timestamp columns -> a single jnp array [capacity]
+  * DateType   -> int32 days since epoch
+  * TimestampType -> int64 microseconds since epoch, UTC only
+  * StringType -> fixed-width padded UTF-8 byte matrix uint8[capacity, max_len]
+    plus an int32 length column.  XLA wants static shapes; a byte matrix keeps
+    string kernels vectorizable on the VPU (8x128 lanes) instead of the
+    offset+heap layout cuDF uses, which needs scatter/gather per row.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    """A SQL-level column type."""
+
+    name: str
+    # dtype of the device data buffer (None for types with special layout)
+    np_dtype: Optional[np.dtype]
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return self.name
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (ByteType, ShortType, IntegerType, LongType,
+                        FloatType, DoubleType)
+
+    @property
+    def is_integral(self) -> bool:
+        return self in (ByteType, ShortType, IntegerType, LongType)
+
+    @property
+    def is_floating(self) -> bool:
+        return self in (FloatType, DoubleType)
+
+    @property
+    def is_string(self) -> bool:
+        return self is StringType
+
+    @property
+    def is_datetime(self) -> bool:
+        return self in (DateType, TimestampType)
+
+    @property
+    def jnp_dtype(self):
+        if self.np_dtype is None:
+            raise TypeError(f"{self.name} has no single-buffer device dtype")
+        return jnp.dtype(self.np_dtype)
+
+
+BooleanType = DataType("boolean", np.dtype(np.bool_))
+ByteType = DataType("byte", np.dtype(np.int8))
+ShortType = DataType("short", np.dtype(np.int16))
+IntegerType = DataType("int", np.dtype(np.int32))
+LongType = DataType("long", np.dtype(np.int64))
+FloatType = DataType("float", np.dtype(np.float32))
+DoubleType = DataType("double", np.dtype(np.float64))
+DateType = DataType("date", np.dtype(np.int32))          # days since 1970-01-01
+TimestampType = DataType("timestamp", np.dtype(np.int64))  # micros since epoch, UTC
+StringType = DataType("string", None)
+NullType = DataType("null", None)
+
+ALL_TYPES = (BooleanType, ByteType, ShortType, IntegerType, LongType, FloatType,
+             DoubleType, DateType, TimestampType, StringType)
+
+# The type gate: what the engine supports on device at all
+# (reference: GpuOverrides.isSupportedType).
+SUPPORTED_TYPES = frozenset(ALL_TYPES)
+
+_NUMERIC_ORDER = [ByteType, ShortType, IntegerType, LongType, FloatType, DoubleType]
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    """Numeric type promotion for binary arithmetic (Spark semantics-ish)."""
+    if a is b:
+        return a
+    if a.is_numeric and b.is_numeric:
+        ia, ib = _NUMERIC_ORDER.index(a), _NUMERIC_ORDER.index(b)
+        winner = _NUMERIC_ORDER[max(ia, ib)]
+        # int64 + float32 -> float64 like Spark (avoid precision cliff)
+        if winner.is_floating and (a is LongType or b is LongType):
+            return DoubleType
+        return winner
+    raise TypeError(f"cannot promote {a} and {b}")
+
+
+_ARROW_NAME = {
+    "boolean": "bool", "byte": "int8", "short": "int16", "int": "int32",
+    "long": "int64", "float": "float32", "double": "float64",
+    "date": "date32", "timestamp": "timestamp[us, tz=UTC]", "string": "string",
+}
+
+
+def to_arrow(dt: DataType):
+    import pyarrow as pa
+    return {
+        "boolean": pa.bool_(), "byte": pa.int8(), "short": pa.int16(),
+        "int": pa.int32(), "long": pa.int64(), "float": pa.float32(),
+        "double": pa.float64(), "date": pa.date32(),
+        "timestamp": pa.timestamp("us", tz="UTC"), "string": pa.string(),
+    }[dt.name]
+
+
+def from_arrow(at) -> DataType:
+    import pyarrow as pa
+    if pa.types.is_boolean(at):
+        return BooleanType
+    if pa.types.is_int8(at):
+        return ByteType
+    if pa.types.is_int16(at):
+        return ShortType
+    if pa.types.is_int32(at):
+        return IntegerType
+    if pa.types.is_int64(at):
+        return LongType
+    if pa.types.is_float32(at):
+        return FloatType
+    if pa.types.is_float64(at):
+        return DoubleType
+    if pa.types.is_date32(at):
+        return DateType
+    if pa.types.is_timestamp(at):
+        return TimestampType
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return StringType
+    if pa.types.is_dictionary(at):
+        return from_arrow(at.value_type)
+    if pa.types.is_decimal(at):
+        # decimals are not in the supported-type gate; scans cast to double
+        return DoubleType
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+@dataclasses.dataclass(frozen=True)
+class StructField:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    fields: tuple[StructField, ...]
+
+    def __init__(self, fields):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i):
+        return self.fields[i]
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def field(self, name: str) -> StructField:
+        return self.fields[self.index_of(name)]
+
+    def __repr__(self):
+        inner = ", ".join(f"{f.name}:{f.dtype.name}" for f in self.fields)
+        return f"Schema({inner})"
+
+
+def schema_of(**kwargs: DataType) -> Schema:
+    return Schema([StructField(k, v) for k, v in kwargs.items()])

@@ -1,0 +1,16 @@
+"""Test harness setup: run everything on a virtual 8-device CPU mesh so
+multi-chip sharding is exercised without TPU hardware (the driver separately
+dry-runs the multichip path)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# float64 columns are part of the supported type surface
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,37 @@
+from spark_rapids_tpu import config as C
+
+
+def test_defaults():
+    conf = C.TpuConf(use_env=False)
+    assert conf.sql_enabled is True
+    assert conf.batch_size_bytes == 2 << 30
+    assert conf.get(C.CONCURRENT_TPU_TASKS) == 1
+
+
+def test_overrides_and_converters():
+    conf = C.TpuConf({"spark.rapids.sql.enabled": "false",
+                      "spark.rapids.sql.batchSizeBytes": "512m"},
+                     use_env=False)
+    assert conf.sql_enabled is False
+    assert conf.batch_size_bytes == 512 << 20
+
+
+def test_byte_parser():
+    assert C.to_bytes("2g") == 2 << 30
+    assert C.to_bytes("1.5k") == 1536
+    assert C.to_bytes(100) == 100
+
+
+def test_doc_generation_covers_registry():
+    doc = C.help_doc()
+    assert "spark.rapids.sql.batchSizeBytes" in doc
+    assert "spark.rapids.memory.host.spillStorageSize" in doc
+    # internal confs hidden by default
+    assert "spark.rapids.sql.test.enabled" not in doc
+    assert "spark.rapids.sql.test.enabled" in C.help_doc(include_internal=True)
+
+
+def test_op_kill_switch():
+    conf = C.TpuConf({"spark.rapids.sql.expr.Add": "false"}, use_env=False)
+    assert conf.is_op_enabled("spark.rapids.sql.expr.Add") is False
+    assert conf.is_op_enabled("spark.rapids.sql.expr.Subtract") is True
