@@ -1,0 +1,323 @@
+"""TpuSession + DataFrame: the user-facing entry points.
+
+Standalone equivalent of the reference's plugin bootstrap + Spark session
+surface (reference: com/nvidia/spark/SQLPlugin.scala, rapids/Plugin.scala):
+a session owns the conf and the device runtime; DataFrames build logical
+plans; collect() runs the overrides pass (tag -> explain -> convert ->
+transitions) and executes the physical plan.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from . import config as C
+from .config import TpuConf
+from .exec.base import CpuExec, ExecContext, ExecNode, TpuExec
+from .exec import basic as B
+from .plan import logical as L
+from .plan.logical import ColumnExpr, SortOrder, col, functions, lit
+from .plan.overrides import PlanMeta, plan_schema
+from .plan.physical import convert
+from .plan import transitions as T
+from .types import Schema, StructField, from_arrow
+
+
+class TpuSession:
+    def __init__(self, conf: Optional[Dict] = None):
+        self.conf = TpuConf(conf)
+        self._runtime = None
+
+    # -- data sources -------------------------------------------------------
+    def from_arrow(self, table) -> "DataFrame":
+        fields = [StructField(n, from_arrow(t))
+                  for n, t in zip(table.column_names, table.schema.types)]
+        return DataFrame(self, L.LogicalScan(table, Schema(fields), "memory"))
+
+    def from_pydict(self, data: Dict, schema: Optional[Schema] = None
+                    ) -> "DataFrame":
+        import pyarrow as pa
+        if schema is None:
+            table = pa.table(data)
+        else:
+            from .types import to_arrow
+            table = pa.table(
+                {k: pa.array(v, type=to_arrow(schema.field(k).dtype))
+                 for k, v in data.items()})
+        return self.from_arrow(table)
+
+    def from_pandas(self, df) -> "DataFrame":
+        import pyarrow as pa
+        return self.from_arrow(pa.Table.from_pandas(df, preserve_index=False))
+
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+    # -- runtime ------------------------------------------------------------
+    @property
+    def runtime(self):
+        if self._runtime is None:
+            from .mem.runtime import TpuRuntime
+            self._runtime = TpuRuntime(self.conf)
+        return self._runtime
+
+    def set(self, key: str, value) -> "TpuSession":
+        self.conf.set(key, value)
+        return self
+
+    # -- planning -----------------------------------------------------------
+    def plan(self, logical: L.LogicalPlan) -> ExecNode:
+        meta = PlanMeta(logical, self.conf)
+        meta.tag_tree()
+        explain_mode = self.conf.explain
+        if explain_mode in ("ALL", "NOT_ON_TPU", "NOT_ON_GPU"):
+            text = meta.explain(verbose=explain_mode == "ALL")
+            if explain_mode == "ALL" or "!" in text:
+                print(text, file=sys.stderr)
+        physical = convert(meta)
+        return T.finalize(physical, self.conf)
+
+    def explain_str(self, logical: L.LogicalPlan) -> str:
+        meta = PlanMeta(logical, self.conf)
+        meta.tag_tree()
+        return meta.explain()
+
+
+class DataFrameReader:
+    def __init__(self, session: TpuSession):
+        self.session = session
+        self._options: Dict = {}
+
+    def option(self, k, v) -> "DataFrameReader":
+        self._options[k] = v
+        return self
+
+    def options(self, **kw) -> "DataFrameReader":
+        self._options.update(kw)
+        return self
+
+    def parquet(self, *paths: str) -> "DataFrame":
+        from .io.scan import parquet_schema, expand_paths
+        files = expand_paths(paths)
+        schema = parquet_schema(files)
+        return DataFrame(self.session, L.LogicalScan(
+            files, schema, "parquet", dict(self._options)))
+
+    def csv(self, *paths: str, schema: Optional[Schema] = None,
+            header: bool = False) -> "DataFrame":
+        from .io.scan import csv_schema, expand_paths
+        files = expand_paths(paths)
+        opts = dict(self._options)
+        opts.setdefault("header", header)
+        if schema is None:
+            schema = csv_schema(files, opts)
+        return DataFrame(self.session,
+                         L.LogicalScan(files, schema, "csv", opts))
+
+    def orc(self, *paths: str) -> "DataFrame":
+        from .io.scan import orc_schema, expand_paths
+        files = expand_paths(paths)
+        schema = orc_schema(files)
+        return DataFrame(self.session, L.LogicalScan(
+            files, schema, "orc", dict(self._options)))
+
+
+class DataFrame:
+    def __init__(self, session: TpuSession, plan: L.LogicalPlan):
+        self.session = session
+        self.plan = plan
+
+    # -- transformations ----------------------------------------------------
+    def _wrap_cols(self, cols):
+        out = []
+        for c in cols:
+            if isinstance(c, str):
+                out.append(col(c))
+            elif isinstance(c, ColumnExpr):
+                out.append(c)
+            else:
+                out.append(lit(c))
+        return out
+
+    def select(self, *cols) -> "DataFrame":
+        return DataFrame(self.session,
+                         L.LogicalProject(self._wrap_cols(cols), self.plan))
+
+    def with_column(self, name: str, expr: ColumnExpr) -> "DataFrame":
+        exprs = [col(n) for n in self.schema.names if n != name]
+        exprs.append(expr.alias(name))
+        return DataFrame(self.session, L.LogicalProject(exprs, self.plan))
+
+    withColumn = with_column
+
+    def filter(self, condition: ColumnExpr) -> "DataFrame":
+        return DataFrame(self.session,
+                         L.LogicalFilter(condition, self.plan))
+
+    where = filter
+
+    def group_by(self, *cols) -> "GroupedData":
+        return GroupedData(self, self._wrap_cols(cols))
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = how.replace("outer", "").rstrip("_") or how
+        how = {"leftsemi": "left_semi", "leftanti": "left_anti",
+               "left_semi": "left_semi", "left_anti": "left_anti",
+               "inner": "inner", "left": "left", "cross": "cross",
+               "full": "full", "right": "right"}.get(how, how)
+        if isinstance(on, (list, tuple)) and on \
+                and all(isinstance(x, str) for x in on):
+            return DataFrame(self.session, L.LogicalJoin(
+                self.plan, other.plan, how, using=list(on)))
+        if isinstance(on, str):
+            return DataFrame(self.session, L.LogicalJoin(
+                self.plan, other.plan, how, using=[on]))
+        return DataFrame(self.session, L.LogicalJoin(
+            self.plan, other.plan, how, condition=on))
+
+    def order_by(self, *orders) -> "DataFrame":
+        os = []
+        for o in orders:
+            if isinstance(o, SortOrder):
+                os.append(o)
+            elif isinstance(o, str):
+                os.append(SortOrder(col(o)))
+            else:
+                os.append(SortOrder(o))
+        return DataFrame(self.session, L.LogicalSort(os, self.plan))
+
+    orderBy = sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, L.LogicalLimit(n, self.plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.session,
+                         L.LogicalUnion([self.plan, other.plan]))
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(self.session, L.LogicalDistinct(self.plan))
+
+    def repartition(self, n: int, *cols) -> "DataFrame":
+        keys = self._wrap_cols(cols)
+        mode = "hash" if keys else "round_robin"
+        return DataFrame(self.session, L.LogicalRepartition(
+            n, keys, self.plan, mode))
+
+    # -- actions ------------------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        return plan_schema(self.plan, self.session.conf)
+
+    def explain(self) -> str:
+        return self.session.explain_str(self.plan)
+
+    def physical_plan(self) -> ExecNode:
+        return self.session.plan(self.plan)
+
+    def to_arrow(self):
+        import pyarrow as pa
+        physical = self.session.plan(self.plan)
+        if isinstance(physical, TpuExec):
+            physical = B.DeviceToHostExec(physical)
+        ctx = ExecContext(self.session.conf)
+        tables = list(physical.execute_cpu(ctx))
+        if not tables:
+            from .types import to_arrow
+            return pa.table({f.name: pa.array([], type=to_arrow(f.dtype))
+                             for f in self.schema})
+        return pa.concat_tables(tables)
+
+    def collect(self) -> List[tuple]:
+        table = self.to_arrow()
+        return [tuple(r.values()) for r in table.to_pylist()]
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def count(self) -> int:
+        return self.to_arrow().num_rows
+
+    def show(self, n: int = 20):
+        print(self.limit(n).to_arrow().to_pandas())
+
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+    # ML integration: ColumnarRdd equivalent (reference: ColumnarRdd.scala)
+    def to_device_batches(self):
+        """Export device ColumnarBatches for ML handoff (requires
+        spark.rapids.sql.exportColumnarRdd=true, like the reference)."""
+        if not self.session.conf.get(C.EXPORT_COLUMNAR_RDD):
+            raise RuntimeError(
+                f"set {C.EXPORT_COLUMNAR_RDD.key}=true to export device "
+                "columnar data")
+        physical = self.session.plan(self.plan)
+        ctx = ExecContext(self.session.conf)
+        if isinstance(physical, TpuExec):
+            yield from physical.execute(ctx)
+        else:
+            for table in physical.execute_cpu(ctx):
+                from .columnar import ColumnarBatch
+                yield ColumnarBatch.from_arrow(table)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: List[ColumnExpr]):
+        self.df = df
+        self.keys = keys
+
+    def agg(self, *aggs) -> "DataFrame":
+        return DataFrame(self.df.session, L.LogicalAggregate(
+            self.keys, list(aggs), self.df.plan))
+
+    def count(self) -> "DataFrame":
+        return self.agg(functions.count(lit(1)).alias("count"))
+
+
+class DataFrameWriter:
+    def __init__(self, df: DataFrame):
+        self.df = df
+        self._options: Dict = {}
+        self._partition_by: List[str] = []
+
+    def option(self, k, v) -> "DataFrameWriter":
+        self._options[k] = v
+        return self
+
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    partitionBy = partition_by
+
+    def parquet(self, path: str):
+        self._write(path, "parquet")
+
+    def csv(self, path: str):
+        self._write(path, "csv")
+
+    def orc(self, path: str):
+        self._write(path, "orc")
+
+    def _write(self, path: str, fmt: str):
+        plan = L.LogicalWrite(path, fmt, self.df.plan, self._options,
+                              self._partition_by)
+        physical = self.df.session.plan(plan)
+        ctx = ExecContext(self.df.session.conf)
+        if isinstance(physical, TpuExec):
+            for _ in physical.execute(ctx):
+                pass
+        else:
+            for _ in physical.execute_cpu(ctx):
+                pass

@@ -1,0 +1,113 @@
+"""Physical execution operators.
+
+Reference: sql-plugin/.../rapids/GpuExec.scala — every device operator is a
+`TpuExec` producing an iterator of ColumnarBatch with standard metrics
+(numOutputRows/numOutputBatches/totalTime).  The CPU fallback side
+(`CpuExec`) runs on pyarrow Tables, playing the role CPU Spark plays for the
+reference: anything the planner can't put on the device still executes, and
+the pair gives the CPU-vs-TPU comparison oracle the test suite uses.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from ..columnar import ColumnarBatch
+from ..config import TpuConf
+from ..types import Schema
+
+
+class Metrics:
+    """SQLMetric equivalent (reference: GpuExec.scala:24-41)."""
+
+    def __init__(self):
+        self.values: Dict[str, float] = {}
+
+    def add(self, name: str, v: float):
+        self.values[name] = self.values.get(name, 0) + v
+
+    def timer(self, name: str):
+        return _Timer(self, name)
+
+    def __repr__(self):
+        return repr(self.values)
+
+
+class _Timer:
+    def __init__(self, m: Metrics, name: str):
+        self.m, self.name = m, name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        self.m.add(self.name, time.perf_counter() - self.t0)
+
+
+class ExecContext:
+    """Per-query execution context: conf, partition id, runtime services."""
+
+    def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
+                 num_partitions: int = 1, runtime=None):
+        self.conf = conf or TpuConf()
+        self.partition_id = partition_id
+        self.num_partitions = num_partitions
+        self.runtime = runtime  # mem.runtime.TpuRuntime when active
+
+    def with_partition(self, pid: int, nparts: int) -> "ExecContext":
+        return ExecContext(self.conf, pid, nparts, self.runtime)
+
+
+class ExecNode:
+    """Base physical operator."""
+
+    def __init__(self, *children: "ExecNode"):
+        self.children: List[ExecNode] = list(children)
+        self.metrics = Metrics()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    # columnar device path
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError(f"{self.name} has no device execution")
+
+    # host path (pyarrow Tables)
+    def execute_cpu(self, ctx: ExecContext):
+        raise NotImplementedError(f"{self.name} has no CPU execution")
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = [" " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 2))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.name
+
+
+class TpuExec(ExecNode):
+    """Device columnar operator (GpuExec equivalent)."""
+
+    # hint to the transition pass (reference: CoalesceGoal lattice)
+    coalesce_after: bool = False
+    # None | "single" | int target bytes — requirement on children batches
+    child_coalesce_goal = None
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+
+class CpuExec(ExecNode):
+    """Host operator running on pyarrow Tables."""
+
+    @property
+    def is_device(self) -> bool:
+        return False

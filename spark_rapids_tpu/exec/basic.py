@@ -1,0 +1,476 @@
+"""Basic physical operators: scan (memory), project, filter, coalesce,
+limit, union, expand, and the host<->device transitions.
+
+Reference: rapids/basicPhysicalOperators.scala (project/filter/union),
+GpuCoalesceBatches.scala, limit.scala, GpuExpandExec.scala,
+GpuRowToColumnarExec/GpuColumnarToRowExec (transitions).
+
+TPU-first difference from the reference: project/filter don't move data at
+all — filter ANDs into the batch's selection mask and the transition pass
+fuses maximal chains of row-local operators into ONE jitted per-batch
+function (FusedPipelineExec), so XLA emits a single fused program where cuDF
+would launch one kernel per operator.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import ColumnarBatch, Column, bucket_rows, concat_batches
+from ..config import MAX_READER_BATCH_SIZE_ROWS
+from ..ops import expressions as E
+from ..ops.cpu_eval import (cpu_cols_to_table, cpu_eval, table_to_cpu_cols)
+from ..types import BooleanType, Schema, StructField
+from .base import CpuExec, ExecContext, ExecNode, TpuExec
+
+
+def _pred_keep(col: Column):
+    """null predicate result filters the row out (SQL WHERE semantics)."""
+    return jnp.logical_and(col.valid, col.data)
+
+
+class TpuScanMemoryExec(TpuExec):
+    """In-memory arrow table scan -> device batches (the H2D edge)."""
+
+    def __init__(self, table, schema: Schema, conf=None):
+        super().__init__()
+        self.table = table
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows = self.table.num_rows
+        limit = min(ctx.conf.get(MAX_READER_BATCH_SIZE_ROWS), 1 << 20)
+        off = 0
+        while off < rows or (rows == 0 and off == 0):
+            chunk = self.table.slice(off, limit)
+            with self.metrics.timer("scanTime"):
+                batch = ColumnarBatch.from_arrow(chunk)
+            self.metrics.add("numOutputRows", chunk.num_rows)
+            self.metrics.add("numOutputBatches", 1)
+            yield batch
+            off += limit
+            if rows == 0:
+                break
+
+    def describe(self):
+        return f"TpuScanMemoryExec[rows={self.table.num_rows}]"
+
+
+class RowLocalExec(TpuExec):
+    """A device op whose per-batch work is a pure batch->batch function —
+    the fusion unit for FusedPipelineExec."""
+
+    def batch_fn(self):
+        raise NotImplementedError
+
+    def expressions(self) -> List[E.Expression]:
+        return []
+
+    def _needs_row_offset(self) -> bool:
+        return any(E.tree_needs_row_offset(e) for e in self.expressions())
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        base = self.batch_fn()
+        if self._needs_row_offset():
+            # stateful exprs (mono id / rand): thread the partition row
+            # offset through as a traced argument; costs one host sync per
+            # batch, paid only when such an expression is present
+            fn = jax.jit(functools.partial(E.eval_with_row_offset, base))
+            offset = 0
+            for batch in self.children[0].execute(ctx):
+                with self.metrics.timer("totalTime"):
+                    out = fn(batch, jnp.int64(offset))
+                offset += batch.num_rows_host()
+                self.metrics.add("numOutputBatches", 1)
+                yield out
+            return
+        fn = jax.jit(base)
+        for batch in self.children[0].execute(ctx):
+            with self.metrics.timer("totalTime"):
+                out = fn(batch)
+            self.metrics.add("numOutputBatches", 1)
+            yield out
+
+
+class TpuProjectExec(RowLocalExec):
+    def __init__(self, exprs: Sequence[E.Expression], names: Sequence[str],
+                 child: ExecNode):
+        super().__init__(child)
+        self.exprs = list(exprs)
+        self._schema = Schema([StructField(n, e.dtype)
+                               for n, e in zip(names, exprs)])
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def batch_fn(self):
+        exprs, schema = self.exprs, self._schema
+
+        def fn(batch: ColumnarBatch) -> ColumnarBatch:
+            cols = [e.eval(batch) for e in exprs]
+            return ColumnarBatch(cols, batch.sel, schema)
+        return fn
+
+    def expressions(self):
+        return list(self.exprs)
+
+    def describe(self):
+        return f"TpuProjectExec[{', '.join(map(repr, self.exprs))}]"
+
+
+class TpuFilterExec(RowLocalExec):
+    def __init__(self, condition: E.Expression, child: ExecNode):
+        super().__init__(child)
+        self.condition = condition
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def batch_fn(self):
+        cond = self.condition
+
+        def fn(batch: ColumnarBatch) -> ColumnarBatch:
+            keep = _pred_keep(cond.eval(batch))
+            return batch.filter(keep)
+        return fn
+
+    def expressions(self):
+        return [self.condition]
+
+    def describe(self):
+        return f"TpuFilterExec[{self.condition!r}]"
+
+
+class FusedPipelineExec(RowLocalExec):
+    """Maximal chain of row-local ops compiled as ONE jitted function.
+    Created by the transition pass; this is where XLA fusion pays."""
+
+    def __init__(self, stages: List[RowLocalExec], child: ExecNode):
+        super().__init__(child)
+        self.stages = stages
+
+    @property
+    def schema(self):
+        return self.stages[-1].schema
+
+    def batch_fn(self):
+        fns = [s.batch_fn() for s in self.stages]
+
+        def fn(batch):
+            for f in fns:
+                batch = f(batch)
+            return batch
+        return fn
+
+    def expressions(self):
+        out = []
+        for s in self.stages:
+            out.extend(s.expressions())
+        return out
+
+    def describe(self):
+        inner = " -> ".join(s.name for s in self.stages)
+        return f"FusedPipelineExec[{inner}]"
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Concatenate small batches up to a goal (reference:
+    GpuCoalesceBatches.scala; goals RequireSingleBatch / TargetSize)."""
+
+    def __init__(self, child: ExecNode, goal="target", target_bytes=None):
+        super().__init__(child)
+        self.goal = goal
+        self.target_bytes = target_bytes
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        target = self.target_bytes or ctx.conf.batch_size_bytes
+        pending: List[ColumnarBatch] = []
+        pending_bytes = 0
+        for batch in self.children[0].execute(ctx):
+            sz = batch.device_size_bytes()
+            if self.goal != "single" and pending \
+                    and pending_bytes + sz > target:
+                yield self._flush(pending)
+                pending, pending_bytes = [], 0
+            pending.append(batch)
+            pending_bytes += sz
+        if pending:
+            yield self._flush(pending)
+
+    def _flush(self, pending):
+        with self.metrics.timer("concatTime"):
+            if len(pending) == 1:
+                out = pending[0].compact()
+            else:
+                out = concat_batches(pending)
+        self.metrics.add("numOutputBatches", 1)
+        return out
+
+    def describe(self):
+        return f"TpuCoalesceBatchesExec[{self.goal}]"
+
+
+class TpuUnionExec(TpuExec):
+    def __init__(self, children: Sequence[ExecNode]):
+        super().__init__(*children)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        for child in self.children:
+            yield from child.execute(ctx)
+
+
+class TpuLocalLimitExec(TpuExec):
+    """Slice batches to the first n live rows (per partition)."""
+
+    def __init__(self, n: int, child: ExecNode):
+        super().__init__(child)
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        remaining = self.n
+        for batch in self.children[0].execute(ctx):
+            if remaining <= 0:
+                return
+            batch = batch.compact()
+            count = batch.num_rows_host()
+            if count > remaining:
+                sel = jnp.arange(batch.capacity, dtype=jnp.int32) < remaining
+                batch = batch.with_sel(sel)
+                count = remaining
+            remaining -= count
+            yield batch
+
+    def describe(self):
+        return f"TpuLocalLimitExec[{self.n}]"
+
+
+class TpuGlobalLimitExec(TpuLocalLimitExec):
+    """Same slice on the single merged stream (single partition upstream)."""
+
+    def describe(self):
+        return f"TpuGlobalLimitExec[{self.n}]"
+
+
+class TpuExpandExec(RowLocalExec):
+    """Projection-list fan-out (ROLLUP/CUBE).  Reference: GpuExpandExec.
+
+    TPU shape discipline: output capacity = capacity * n_projections
+    (static), built by interleaved concat, no scatter."""
+
+    def __init__(self, projections: List[List[E.Expression]],
+                 names: Sequence[str], child: ExecNode):
+        super().__init__(child)
+        self.projections = projections
+        self._schema = Schema([StructField(n, e.dtype)
+                               for n, e in zip(names, projections[0])])
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def batch_fn(self):
+        projections, schema = self.projections, self._schema
+
+        def fn(batch: ColumnarBatch) -> ColumnarBatch:
+            parts = []
+            for proj in projections:
+                cols = [e.eval(batch) for e in proj]
+                parts.append(ColumnarBatch(cols, batch.sel, schema))
+            ncols = []
+            for ci in range(len(schema)):
+                f = schema[ci]
+                cs = [p.columns[ci] for p in parts]
+                if f.dtype.is_string:
+                    ml = max(c.max_len for c in cs)
+                    cs = [c.pad_strings_to(ml) for c in cs]
+                    ncols.append(Column(
+                        jnp.concatenate([c.data for c in cs], axis=0),
+                        jnp.concatenate([c.valid for c in cs]),
+                        f.dtype,
+                        jnp.concatenate([c.lengths for c in cs])))
+                else:
+                    ncols.append(Column(
+                        jnp.concatenate([c.data for c in cs]),
+                        jnp.concatenate([c.valid for c in cs]), f.dtype))
+            sel = jnp.concatenate([batch.sel] * len(projections))
+            return ColumnarBatch(ncols, sel, schema)
+        return fn
+
+    def expressions(self):
+        return [e for proj in self.projections for e in proj]
+
+    def describe(self):
+        return f"TpuExpandExec[{len(self.projections)} projections]"
+
+
+# --------------------------------------------------------------------------
+# transitions (reference: GpuRowToColumnarExec / GpuColumnarToRowExec /
+# HostColumnarToGpu — ours are arrow<->device batch edges)
+# --------------------------------------------------------------------------
+
+class HostToDeviceExec(TpuExec):
+    """Adopt host arrow tables from a CPU subtree into device batches."""
+
+    def __init__(self, child: ExecNode):
+        super().__init__(child)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        for table in self.children[0].execute_cpu(ctx):
+            with self.metrics.timer("h2dTime"):
+                yield ColumnarBatch.from_arrow(table)
+
+
+class DeviceToHostExec(CpuExec):
+    """Materialize device batches to host arrow tables."""
+
+    def __init__(self, child: ExecNode):
+        super().__init__(child)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute_cpu(self, ctx):
+        for batch in self.children[0].execute(ctx):
+            with self.metrics.timer("d2hTime"):
+                yield batch.to_arrow()
+
+
+# --------------------------------------------------------------------------
+# CPU fallback operators (the "CPU Spark" side of the oracle)
+# --------------------------------------------------------------------------
+
+class CpuScanMemoryExec(CpuExec):
+    def __init__(self, table, schema: Schema):
+        super().__init__()
+        self.table = table
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute_cpu(self, ctx):
+        yield self.table
+
+
+class CpuProjectExec(CpuExec):
+    def __init__(self, exprs, names, child):
+        super().__init__(child)
+        self.exprs = list(exprs)
+        self._schema = Schema([StructField(n, e.dtype)
+                               for n, e in zip(names, exprs)])
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute_cpu(self, ctx):
+        for table in self.children[0].execute_cpu(ctx):
+            cols = table_to_cpu_cols(table)
+            n = table.num_rows
+            out = [cpu_eval(e, cols, n) for e in self.exprs]
+            yield cpu_cols_to_table(out, self._schema)
+
+    def describe(self):
+        return f"CpuProjectExec[{', '.join(map(repr, self.exprs))}]"
+
+
+class CpuFilterExec(CpuExec):
+    def __init__(self, condition, child):
+        super().__init__(child)
+        self.condition = condition
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute_cpu(self, ctx):
+        for table in self.children[0].execute_cpu(ctx):
+            cols = table_to_cpu_cols(table)
+            n = table.num_rows
+            v, m = cpu_eval(self.condition, cols, n)
+            keep = m & v.astype(bool)
+            yield table.filter(keep)
+
+    def describe(self):
+        return f"CpuFilterExec[{self.condition!r}]"
+
+
+class CpuUnionExec(CpuExec):
+    def __init__(self, children):
+        super().__init__(*children)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute_cpu(self, ctx):
+        for child in self.children:
+            yield from child.execute_cpu(ctx)
+
+
+class CpuLimitExec(CpuExec):
+    def __init__(self, n, child):
+        super().__init__(child)
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute_cpu(self, ctx):
+        remaining = self.n
+        for table in self.children[0].execute_cpu(ctx):
+            if remaining <= 0:
+                return
+            if table.num_rows > remaining:
+                table = table.slice(0, remaining)
+            remaining -= table.num_rows
+            yield table
+
+
+class CpuExpandExec(CpuExec):
+    def __init__(self, projections, names, child):
+        super().__init__(child)
+        self.projections = projections
+        self._schema = Schema([StructField(n, e.dtype)
+                               for n, e in zip(names, projections[0])])
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute_cpu(self, ctx):
+        import pyarrow as pa
+        for table in self.children[0].execute_cpu(ctx):
+            cols = table_to_cpu_cols(table)
+            n = table.num_rows
+            for proj in self.projections:
+                out = [cpu_eval(e, cols, n) for e in proj]
+                yield cpu_cols_to_table(out, self._schema)

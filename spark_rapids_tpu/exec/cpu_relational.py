@@ -1,0 +1,378 @@
+"""CPU relational operators (aggregate/join/sort/distinct) over pandas.
+
+These are the fallback executors (the role CPU Spark plays for the
+reference) and the oracle side of every CPU-vs-TPU comparison test.
+Implemented with pandas groupby/merge/sort_values with explicit handling of
+Spark semantics: null grouping keys form a group, NaN equality in keys,
+nulls-first/last ordering, count ignoring nulls.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..ops import expressions as E
+from ..ops.aggregates import AggregateExpression
+from ..ops.cpu_eval import cpu_cols_to_table, cpu_eval, table_to_cpu_cols
+from ..types import (DoubleType, LongType, Schema, StructField)
+from .base import CpuExec, ExecContext
+
+
+class _NanKey:
+    """Hashable stand-in for NaN grouping/join keys (NaN == NaN in Spark)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "NaN"
+
+
+_NAN_KEY = _NanKey()
+
+
+def _concat_tables(tables):
+    import pyarrow as pa
+    tables = list(tables)
+    if not tables:
+        return None
+    return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+
+class CpuHashAggregateExec(CpuExec):
+    def __init__(self, grouping, group_names, aggregates: Sequence[AggregateExpression],
+                 child):
+        super().__init__(child)
+        self.grouping = list(grouping)
+        self.group_names = list(group_names)
+        self.aggregates = list(aggregates)
+        fields = [StructField(n, g.dtype)
+                  for n, g in zip(group_names, grouping)]
+        fields += [StructField(a.output_name or a.func.lower(), a.dtype)
+                   for a in self.aggregates]
+        self._schema = Schema(fields)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        gs = ", ".join(map(repr, self.grouping))
+        ags = ", ".join(map(repr, self.aggregates))
+        return f"CpuHashAggregateExec[keys=[{gs}] aggs=[{ags}]]"
+
+    def execute_cpu(self, ctx: ExecContext):
+        table = _concat_tables(self.children[0].execute_cpu(ctx))
+        cols = table_to_cpu_cols(table)
+        n = table.num_rows
+        keys = [cpu_eval(g, cols, n) for g in self.grouping]
+        ins = []
+        for a in self.aggregates:
+            if a.child is None:
+                ins.append((np.ones(n, dtype=np.int64), np.ones(n, bool)))
+            else:
+                ins.append(cpu_eval(a.child, cols, n))
+
+        # group rows: build hashable key tuples (None for null, NaN folded
+        # to a sentinel distinct from any real value)
+        groups = {}
+        order = []
+        for i in range(n):
+            kt = []
+            for kv, km in keys:
+                if not km[i]:
+                    kt.append(None)
+                else:
+                    v = kv[i]
+                    if isinstance(v, (float, np.floating)) and np.isnan(v):
+                        v = _NAN_KEY  # NaN == NaN for grouping in Spark
+                    elif isinstance(v, np.floating) and v == 0.0:
+                        v = 0.0  # fold -0.0
+                    kt.append(v if not isinstance(v, np.generic)
+                              else v.item())
+            kt = tuple(kt)
+            if kt not in groups:
+                groups[kt] = []
+                order.append(kt)
+            groups[kt].append(i)
+
+        if not self.grouping and not groups:
+            groups[()] = []
+            order.append(())
+
+        out_rows_keys = []
+        out_aggs = [[] for _ in self.aggregates]
+        for kt in order:
+            idx = groups[kt]
+            out_rows_keys.append(kt)
+            for ai, a in enumerate(self.aggregates):
+                vals, valid = ins[ai]
+                sel = [i for i in idx if valid[i]]
+                out_aggs[ai].append(self._agg_value(a, vals, sel))
+
+        import pyarrow as pa
+        from ..types import to_arrow
+        arrays = []
+        for ki in range(len(self.grouping)):
+            vals = [float("nan") if kt[ki] is _NAN_KEY else kt[ki]
+                    for kt in out_rows_keys]
+            arrays.append(pa.array(vals,
+                                   type=to_arrow(self._schema[ki].dtype)))
+        for ai, a in enumerate(self.aggregates):
+            ft = self._schema[len(self.grouping) + ai].dtype
+            arrays.append(pa.array(out_aggs[ai], type=to_arrow(ft)))
+        yield pa.table(arrays, names=self._schema.names)
+
+    def _agg_value(self, a: AggregateExpression, vals, sel: List[int]):
+        if a.func == "Count":
+            return len(sel)
+        if not sel:
+            return None
+        data = [vals[i] for i in sel]
+        data = [d.item() if isinstance(d, np.generic) else d for d in data]
+        if a.func == "Sum":
+            return sum(data)
+        if a.func == "Min":
+            clean = [d for d in data if not (isinstance(d, float)
+                                             and np.isnan(d))]
+            return min(clean) if clean else float("nan")
+        if a.func == "Max":
+            has_nan = any(isinstance(d, float) and np.isnan(d) for d in data)
+            if has_nan:
+                return float("nan")  # NaN is greatest
+            return max(data)
+        if a.func == "Average":
+            return sum(data) / len(data)
+        if a.func == "First":
+            return data[0]
+        if a.func == "Last":
+            return data[-1]
+        raise NotImplementedError(a.func)
+
+
+class CpuSortExec(CpuExec):
+    def __init__(self, sort_exprs, ascending: List[bool],
+                 nulls_first: List[bool], child):
+        super().__init__(child)
+        self.sort_exprs = list(sort_exprs)
+        self.ascending = ascending
+        self.nulls_first = nulls_first
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute_cpu(self, ctx):
+        table = _concat_tables(self.children[0].execute_cpu(ctx))
+        cols = table_to_cpu_cols(table)
+        n = table.num_rows
+        keycols = [cpu_eval(e, cols, n) for e in self.sort_exprs]
+
+        def sort_key(i):
+            parts = []
+            for (kv, km), asc, nf in zip(keycols, self.ascending,
+                                         self.nulls_first):
+                # nulls_first already holds the EFFECTIVE placement for this
+                # direction (SortOrder.effective_nulls_first), so it is not
+                # negated for descending
+                if not km[i]:
+                    null_rank = 0 if nf else 2
+                    val = 0
+                else:
+                    null_rank = 1
+                    v = kv[i]
+                    if isinstance(v, (float, np.floating)) and np.isnan(v):
+                        v = float("inf")  # NaN greatest
+                        nan_bump = 1
+                    else:
+                        nan_bump = 0
+                    val = (v, nan_bump)
+                    if not asc:
+                        val = _Neg(val)
+                parts.append((null_rank, val))
+            return tuple(parts)
+
+        idx = sorted(range(n), key=sort_key)
+        yield table.take(idx)
+
+    def describe(self):
+        return f"CpuSortExec[{', '.join(map(repr, self.sort_exprs))}]"
+
+
+class _Neg:
+    """Reverse-order wrapper for descending sort of arbitrary comparables."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        if isinstance(other, _Neg):
+            return other.v < self.v
+        return NotImplemented
+
+    def __eq__(self, other):
+        return isinstance(other, _Neg) and other.v == self.v
+
+
+class CpuJoinExec(CpuExec):
+    """Hash join on equi-keys with optional residual condition."""
+
+    def __init__(self, left, right, join_type: str,
+                 left_keys, right_keys, condition, out_schema: Schema,
+                 using_drop: Optional[List[int]] = None):
+        super().__init__(left, right)
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+        self._schema = out_schema
+        self.using_drop = using_drop or []
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"CpuJoinExec[{self.join_type}, "
+                f"keys={len(self.left_keys)}]")
+
+    def execute_cpu(self, ctx):
+        import pyarrow as pa
+        lt = _concat_tables(self.children[0].execute_cpu(ctx))
+        rt = _concat_tables(self.children[1].execute_cpu(ctx))
+        ln, rn = lt.num_rows, rt.num_rows
+        lcols = table_to_cpu_cols(lt)
+        rcols = table_to_cpu_cols(rt)
+        lkeys = [cpu_eval(e, lcols, ln) for e in self.left_keys]
+        rkeys = [cpu_eval(e, rcols, rn) for e in self.right_keys]
+
+        def key_tuple(keys, i):
+            kt = []
+            for kv, km in keys:
+                if not km[i]:
+                    return None  # null keys never match
+                v = kv[i]
+                if isinstance(v, (float, np.floating)):
+                    if np.isnan(v):
+                        v = _NAN_KEY
+                    elif v == 0.0:
+                        v = 0.0
+                kt.append(v.item() if isinstance(v, np.generic) else v)
+            return tuple(kt)
+
+        build = {}
+        for j in range(rn):
+            kt = key_tuple(rkeys, j)
+            if kt is not None:
+                build.setdefault(kt, []).append(j)
+
+        li, ri = [], []
+        matched_left = np.zeros(ln, bool)
+        for i in range(ln):
+            kt = key_tuple(lkeys, i)
+            matches = build.get(kt, []) if kt is not None else []
+            for j in matches:
+                li.append(i)
+                ri.append(j)
+            if matches:
+                matched_left[i] = True
+
+        # residual condition on matched pairs
+        if self.condition is not None and li:
+            joined = self._take_pairs(lt, rt, li, ri)
+            cols = table_to_cpu_cols(joined)
+            v, m = cpu_eval(self.condition, cols, len(li))
+            keep = m & v.astype(bool)
+            li = [x for x, k in zip(li, keep) if k]
+            ri = [x for x, k in zip(ri, keep) if k]
+            matched_left = np.zeros(ln, bool)
+            for x in li:
+                matched_left[x] = True
+
+        jt = self.join_type
+        if jt == "inner":
+            yield self._project(self._take_pairs(lt, rt, li, ri))
+            return
+        if jt == "left_semi":
+            yield self._project(lt.take([i for i in range(ln)
+                                         if matched_left[i]]))
+            return
+        if jt == "left_anti":
+            yield self._project(lt.take([i for i in range(ln)
+                                         if not matched_left[i]]))
+            return
+        if jt in ("left", "left_outer"):
+            un = [i for i in range(ln) if not matched_left[i]]
+            matched = self._take_pairs(lt, rt, li, ri)
+            if un:
+                left_part = lt.take(un)
+                unmatched = pa.table(
+                    [left_part.column(c) for c in left_part.column_names] +
+                    [pa.nulls(len(un), type=f.type) for f in rt.schema],
+                    names=matched.column_names)
+                out = pa.concat_tables([matched, unmatched])
+            else:
+                out = matched
+            yield self._project(out)
+            return
+        raise NotImplementedError(f"join type {jt}")
+
+    def _take_pairs(self, lt, rt, li, ri):
+        import pyarrow as pa
+        lpart = lt.take(li)
+        rpart = rt.take(ri)
+        names = list(lt.column_names)
+        rnames = []
+        for c in rt.column_names:
+            rnames.append(c if c not in names else c + "_r")
+        return pa.table([lpart.column(c) for c in lt.column_names] +
+                        [rpart.column(c) for c in rt.column_names],
+                        names=names + rnames)
+
+    def _project(self, table):
+        if self.using_drop:
+            keep = [i for i in range(table.num_columns)
+                    if i not in self.using_drop]
+            table = table.select(keep)
+        return table.rename_columns(self._schema.names)
+
+
+class CpuRepartitionExec(CpuExec):
+    """CPU fallback repartition: the host executor is single-process, so
+    repartitioning is a pass-through (partition counts only matter to the
+    device/parallel engine in exec/exchange.py)."""
+
+    def __init__(self, num_partitions: int, child):
+        super().__init__(child)
+        self.num_partitions = num_partitions
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute_cpu(self, ctx):
+        yield from self.children[0].execute_cpu(ctx)
+
+
+class CpuDistinctExec(CpuExec):
+    def __init__(self, child):
+        super().__init__(child)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute_cpu(self, ctx):
+        table = _concat_tables(self.children[0].execute_cpu(ctx))
+        seen = set()
+        keep = []
+        pylist = [tuple(r.values()) for r in table.to_pylist()]
+        for i, row in enumerate(pylist):
+            k = tuple("NaN" if isinstance(v, float) and np.isnan(v) else v
+                      for v in row)
+            if k not in seen:
+                seen.add(k)
+                keep.append(i)
+        yield table.take(keep)

@@ -1,0 +1,16 @@
+from .expressions import (EXPR_REGISTRY, Add, Alias, And, BinaryExpression,
+                          BitwiseAnd, BitwiseNot, BitwiseOr, BitwiseXor,
+                          BoundReference, CaseWhen, Coalesce, Divide,
+                          EqualNullSafe, EqualTo, Expression, GreaterThan,
+                          GreaterThanOrEqual, If, In, InSet, IntegralDivide,
+                          IsNaN, IsNotNull, IsNull, LessThan, LessThanOrEqual,
+                          Literal, MonotonicallyIncreasingID, NaNvl, Not, Or,
+                          Pmod, Rand, Remainder, ShiftLeft, ShiftRight,
+                          ShiftRightUnsigned, SparkPartitionID, Subtract,
+                          Multiply, UnaryExpression, UnaryMinus, UnaryPositive,
+                          Abs, lit)
+from .cast import AnsiCast, Cast, supported_cast
+from . import math  # noqa: F401  (registers math exprs)
+
+__all__ = ["Expression", "BoundReference", "Literal", "lit", "Cast",
+           "AnsiCast", "EXPR_REGISTRY", "supported_cast"]

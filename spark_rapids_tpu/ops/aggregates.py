@@ -1,0 +1,53 @@
+"""Declarative aggregate functions.
+
+Reference: org/.../rapids/AggregateFunctions.scala:29-533 — each aggregate is
+a (update, merge, finalize) triple so the exec can run Partial on each batch,
+merge running state across batches/partitions, then finalize.  On TPU the
+update/merge steps are masked segment reductions (see exec/aggregate.py);
+this module only declares semantics.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..types import (DataType, DoubleType, LongType)
+from .expressions import Expression
+
+
+@dataclasses.dataclass
+class AggregateExpression(Expression):
+    """A resolved aggregate call appearing in an agg list."""
+
+    func: str                 # Sum|Min|Max|Count|Average|First|Last
+    child: Optional[Expression]  # None for count(*)
+    distinct: bool = False
+    output_name: str = ""
+
+    def __post_init__(self):
+        self.children = (self.child,) if self.child is not None else ()
+
+    @property
+    def dtype(self) -> DataType:
+        if self.func == "Count":
+            return LongType
+        if self.func == "Average":
+            return DoubleType
+        if self.func == "Sum":
+            ct = self.child.dtype
+            if ct.is_integral:
+                return LongType
+            return DoubleType
+        return self.child.dtype
+
+    def eval(self, batch):
+        raise RuntimeError("AggregateExpression is evaluated by the "
+                           "aggregate exec, not columnar eval")
+
+    def __repr__(self):
+        inner = repr(self.child) if self.child is not None else "*"
+        d = "DISTINCT " if self.distinct else ""
+        return f"{self.func}({d}{inner})"
+
+
+AGG_FUNCS = ("Sum", "Min", "Max", "Count", "Average", "First", "Last")

@@ -1,0 +1,710 @@
+"""CPU (host) evaluation of expression trees over numpy/pyarrow data.
+
+This plays the role CPU Spark plays for the reference plugin: the fallback
+executor for anything the planner keeps off the device, and the independent
+oracle the test suite compares device results against.  Implemented with
+numpy object-level semantics (NOT by re-running the jax code on CPU), so a
+bug in a device kernel cannot hide in a shared implementation.
+
+Columns are (values: np.ndarray, valid: np.ndarray[bool]); strings use
+object arrays holding str|None.
+"""
+from __future__ import annotations
+
+import datetime
+import math
+from typing import Tuple
+
+import numpy as np
+
+from ..types import (BooleanType, DataType, DateType, DoubleType, FloatType,
+                     IntegerType, LongType, StringType, TimestampType)
+from . import expressions as E
+from . import math as M
+from . import strings as S
+from . import datetime_exprs as D
+from .aggregates import AggregateExpression
+from .cast import Cast, _INT_RANGE
+
+CpuCol = Tuple[np.ndarray, np.ndarray]  # (values, valid)
+
+
+def table_to_cpu_cols(table):
+    """pyarrow Table -> list of CpuCol following our device type mapping."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    from ..types import from_arrow
+    cols = []
+    for col in table.columns:
+        arr = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+        if pa.types.is_dictionary(arr.type):
+            arr = arr.dictionary_decode()
+        if pa.types.is_decimal(arr.type):
+            arr = pc.cast(arr, pa.float64())
+        dt = from_arrow(arr.type)
+        valid = np.asarray(arr.is_valid()) if arr.null_count \
+            else np.ones(len(arr), dtype=bool)
+        if dt.is_string:
+            vals = np.array(arr.to_pylist(), dtype=object)
+        elif pa.types.is_date32(arr.type):
+            vals = np.asarray(arr.view(pa.int32()).fill_null(0)
+                              .to_numpy(zero_copy_only=False))
+        elif pa.types.is_timestamp(arr.type):
+            vals = np.asarray(pc.cast(arr, pa.timestamp("us", tz="UTC"))
+                              .view(pa.int64()).fill_null(0)
+                              .to_numpy(zero_copy_only=False))
+        else:
+            fill = False if pa.types.is_boolean(arr.type) else 0
+            vals = np.asarray(arr.fill_null(fill)
+                              .to_numpy(zero_copy_only=False)
+                              .astype(dt.np_dtype))
+        vals = _zero_invalid(vals, valid, dt)
+        cols.append((vals, valid))
+    return cols
+
+
+def cpu_cols_to_table(cols, schema):
+    import pyarrow as pa
+    from ..types import to_arrow
+    arrays = []
+    for (vals, valid), f in zip(cols, schema):
+        pylist = [None if not v else _to_py(x, f.dtype)
+                  for x, v in zip(vals.tolist(), valid.tolist())]
+        arrays.append(pa.array(pylist, type=to_arrow(f.dtype)))
+    return pa.table(arrays, names=schema.names)
+
+
+def _to_py(x, dt: DataType):
+    if dt is DateType:
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=int(x))
+    if dt is TimestampType:
+        return datetime.datetime(1970, 1, 1,
+                                 tzinfo=datetime.timezone.utc) + \
+            datetime.timedelta(microseconds=int(x))
+    return x
+
+
+def _zero_invalid(vals, valid, dt: DataType):
+    if dt.is_string:
+        out = vals.copy()
+        out[~valid] = None
+        return out
+    out = vals.copy()
+    out[~valid] = 0
+    return out
+
+
+def _const(n, value, dtype: DataType) -> CpuCol:
+    if value is None:
+        if dtype.is_string:
+            return np.full(n, None, dtype=object), np.zeros(n, bool)
+        return (np.zeros(n, dtype=dtype.np_dtype if dtype.np_dtype is not None
+                         else np.int64), np.zeros(n, bool))
+    if dtype.is_string:
+        return np.full(n, value, dtype=object), np.ones(n, bool)
+    return (np.full(n, value, dtype=dtype.np_dtype), np.ones(n, bool))
+
+
+def cpu_eval(expr: E.Expression, cols, n: int) -> CpuCol:
+    """Evaluate `expr` against input columns (list of CpuCol)."""
+
+    def rec(e):
+        return cpu_eval(e, cols, n)
+
+    if isinstance(expr, E.BoundReference):
+        return cols[expr.index]
+    if isinstance(expr, E.Literal):
+        return _const(n, expr.value, expr.dtype)
+    if isinstance(expr, E.Alias):
+        return rec(expr.child)
+    if isinstance(expr, Cast):
+        return _cpu_cast(rec(expr.child), expr.child.dtype, expr.to, n)
+    if isinstance(expr, AggregateExpression):
+        raise RuntimeError("aggregates evaluated by agg exec")
+
+    t = type(expr).__name__
+
+    # ---- arithmetic / comparison / logic ------------------------------
+    if t in _BINOPS:
+        lv, lm = rec(expr.left)
+        rv, rm = rec(expr.right)
+        return _BINOPS[t](expr, lv, lm, rv, rm)
+    if t == "UnaryMinus":
+        v, m = rec(expr.child)
+        return -v, m
+    if t == "UnaryPositive":
+        return rec(expr.child)
+    if t == "Abs":
+        v, m = rec(expr.child)
+        return np.abs(v), m
+    if t == "BitwiseNot":
+        v, m = rec(expr.child)
+        return ~v, m
+    if t == "Not":
+        v, m = rec(expr.child)
+        return ~v.astype(bool), m
+    if t == "IsNull":
+        v, m = rec(expr.child)
+        return ~m, np.ones(n, bool)
+    if t == "IsNotNull":
+        v, m = rec(expr.child)
+        return m.copy(), np.ones(n, bool)
+    if t == "IsNaN":
+        v, m = rec(expr.child)
+        vals = np.zeros(n, bool)
+        vals[m] = np.isnan(v[m].astype(np.float64))
+        return vals, np.ones(n, bool)
+    if t == "Coalesce":
+        dt = expr.dtype
+        out_v, out_m = rec(expr.children[0])
+        if not dt.is_string:
+            out_v = out_v.astype(dt.np_dtype)
+        out_v = out_v.copy()
+        out_m = out_m.copy()
+        for ch in expr.children[1:]:
+            v, m = rec(ch)
+            if not dt.is_string:
+                v = v.astype(dt.np_dtype)
+            fill = ~out_m & m
+            out_v[fill] = v[fill]
+            out_m |= m
+        return out_v, out_m
+    if t == "NaNvl":
+        lv, lm = rec(expr.left)
+        rv, rm = rec(expr.right)
+        use_r = np.isnan(lv.astype(np.float64))
+        v = np.where(use_r, rv.astype(lv.dtype), lv)
+        m = np.where(use_r, rm, lm)
+        return v, m
+    if t == "If":
+        pv, pm = rec(expr.pred)
+        tv, tm = rec(expr.then)
+        ov, om = rec(expr.other)
+        cond = pm & pv.astype(bool)
+        if expr.dtype.is_string:
+            v = np.where(cond, tv, ov)
+        else:
+            tt = expr.dtype.np_dtype
+            v = np.where(cond, tv.astype(tt), ov.astype(tt))
+        return v, np.where(cond, tm, om)
+    if t == "CaseWhen":
+        e = expr.else_value if expr.else_value is not None \
+            else E.Literal(None, expr.dtype)
+        out = e
+        for p, val in reversed(expr.branches):
+            out = E.If(p, val, out)
+        return rec(out)
+    if t in ("In", "InSet"):
+        v, m = rec(expr.value)
+        items = [i for i in expr.items if i is not None]
+        has_null = len(items) != len(expr.items)
+        hit = np.zeros(n, bool)
+        for it in items:
+            if expr.value.dtype.is_string:
+                hit |= np.array([x == it for x in v], dtype=bool)
+            elif expr.value.dtype.is_floating:
+                hit |= v == it
+            else:
+                hit |= v == it
+        valid = m & (hit | ~has_null) if has_null else m
+        return hit, valid
+
+    # ---- math ---------------------------------------------------------
+    if t in _MATH_UNARY:
+        v, m = rec(expr.child)
+        x = v.astype(np.float64)
+        with np.errstate(all="ignore"):
+            if t in ("Log", "Log2", "Log10"):
+                ok = x > 0
+                fn = {"Log": np.log, "Log2": np.log2, "Log10": np.log10}[t]
+                return fn(np.where(ok, x, 1.0)), m & ok
+            if t == "Log1p":
+                ok = x > -1
+                return np.log1p(np.where(ok, x, 0.0)), m & ok
+            return _MATH_UNARY[t](x), m
+    if t == "Floor":
+        v, m = rec(expr.child)
+        if expr.child.dtype.is_floating:
+            return np.floor(v).astype(np.int64), m
+        return v, m
+    if t == "Ceil":
+        v, m = rec(expr.child)
+        if expr.child.dtype.is_floating:
+            return np.ceil(v).astype(np.int64), m
+        return v, m
+    if t == "Pow":
+        lv, lm = rec(expr.left)
+        rv, rm = rec(expr.right)
+        with np.errstate(all="ignore"):
+            return np.power(lv.astype(np.float64), rv.astype(np.float64)), \
+                lm & rm
+    if t == "Atan2":
+        lv, lm = rec(expr.left)
+        rv, rm = rec(expr.right)
+        return np.arctan2(lv.astype(np.float64), rv.astype(np.float64)), \
+            lm & rm
+
+    # ---- strings ------------------------------------------------------
+    if isinstance(expr, (S._StringUnary, S.Substring, S.Concat,
+                         S.StartsWith, S.EndsWith, S.Contains, S.Like,
+                         S.StringLocate, S.StringReplace)):
+        return _cpu_string(expr, rec, n)
+
+    # ---- datetime -----------------------------------------------------
+    if isinstance(expr, (D._DatePart, D._DateArith, D.UnixTimestamp,
+                         D.FromUnixTime, D.TimeAdd)):
+        return _cpu_datetime(expr, rec, n)
+
+    if t == "SparkPartitionID":
+        return np.full(n, expr.partition_id, dtype=np.int32), np.ones(n, bool)
+    if t == "MonotonicallyIncreasingID":
+        base = expr.partition_id << 33
+        return base + np.arange(n, dtype=np.int64), np.ones(n, bool)
+
+    raise NotImplementedError(f"cpu_eval: {t}")
+
+
+def _jvm_mod(l, r):
+    return l - r * (np.sign(l) * np.sign(r) * (np.abs(l) // np.abs(r)))
+
+
+def _promote_np(expr, lv, rv):
+    from ..types import promote
+    t = promote(expr.left.dtype, expr.right.dtype)
+    return lv.astype(t.np_dtype), rv.astype(t.np_dtype)
+
+
+def _arith(fn):
+    def run(expr, lv, lm, rv, rm):
+        lv, rv = _promote_np(expr, lv, rv)
+        with np.errstate(all="ignore"):
+            return fn(lv, rv), lm & rm
+    return run
+
+
+def _cpu_divide(expr, lv, lm, rv, rm):
+    l = lv.astype(np.float64)
+    r = rv.astype(np.float64)
+    nz = r != 0.0
+    with np.errstate(all="ignore"):
+        return np.where(nz, l, 1.0) / np.where(nz, r, 1.0), lm & rm & nz
+
+
+def _cpu_intdiv(expr, lv, lm, rv, rm):
+    l = lv.astype(np.int64)
+    r = rv.astype(np.int64)
+    nz = r != 0
+    rs = np.where(nz, r, 1)
+    q = np.sign(l) * np.sign(rs) * (np.abs(l) // np.abs(rs))
+    return q, lm & rm & nz
+
+
+def _cpu_rem(expr, lv, lm, rv, rm):
+    lv, rv = _promote_np(expr, lv, rv)
+    if np.issubdtype(lv.dtype, np.floating):
+        nz = rv != 0.0
+        return np.fmod(lv, np.where(nz, rv, 1.0)), lm & rm & nz
+    nz = rv != 0
+    return _jvm_mod(lv, np.where(nz, rv, 1)), lm & rm & nz
+
+
+def _cpu_pmod(expr, lv, lm, rv, rm):
+    v, m = _cpu_rem(expr, lv, lm, rv, rm)
+    lv2, rv2 = _promote_np(expr, lv, rv)
+    safe = np.where(rv2 != 0, rv2, 1)
+    if np.issubdtype(v.dtype, np.floating):
+        v = np.where(v < 0, np.fmod(v + safe, safe), v)
+    else:
+        v = np.where(v < 0, _jvm_mod(v + safe, safe), v)
+    return v, m
+
+
+def _cmp_vals(expr, lv, rv):
+    if expr.left.dtype.is_string:
+        return lv, rv
+    if expr.left.dtype.is_numeric and expr.right.dtype.is_numeric:
+        return _promote_np(expr, lv, rv)
+    return lv, rv
+
+
+def _cpu_eq(lv, rv, str_side):
+    if str_side:
+        return np.array([a == b for a, b in zip(lv, rv)], dtype=bool)
+    if np.issubdtype(lv.dtype, np.floating):
+        return (lv == rv) | (np.isnan(lv) & np.isnan(rv))
+    return lv == rv
+
+
+def _cpu_lt(lv, rv, str_side):
+    if str_side:
+        return np.array([(a is not None and b is not None and a < b)
+                         for a, b in zip(lv, rv)], dtype=bool)
+    if np.issubdtype(lv.dtype, np.floating):
+        return np.where(np.isnan(lv), False, np.where(np.isnan(rv), True,
+                                                      lv < rv))
+    return lv < rv
+
+
+def _comparison(kind):
+    def run(expr, lv, lm, rv, rm):
+        s = expr.left.dtype.is_string
+        lv2, rv2 = _cmp_vals(expr, lv, rv)
+        if kind == "eq":
+            out = _cpu_eq(lv2, rv2, s)
+        elif kind == "lt":
+            out = _cpu_lt(lv2, rv2, s)
+        elif kind == "gt":
+            out = _cpu_lt(rv2, lv2, s)
+        elif kind == "le":
+            out = ~_cpu_lt(rv2, lv2, s)
+        else:
+            out = ~_cpu_lt(lv2, rv2, s)
+        return out, lm & rm
+    return run
+
+
+def _cpu_eqns(expr, lv, lm, rv, rm):
+    s = expr.left.dtype.is_string
+    lv2, rv2 = _cmp_vals(expr, lv, rv)
+    eq = _cpu_eq(lv2, rv2, s)
+    return (lm & rm & eq) | (~lm & ~rm), np.ones(len(lm), bool)
+
+
+def _cpu_and(expr, lv, lm, rv, rm):
+    lt = lm & lv.astype(bool)
+    rt = rm & rv.astype(bool)
+    fl = lm & ~lv.astype(bool)
+    fr = rm & ~rv.astype(bool)
+    return lt & rt, (lm & rm) | fl | fr
+
+
+def _cpu_or(expr, lv, lm, rv, rm):
+    lt = lm & lv.astype(bool)
+    rt = rm & rv.astype(bool)
+    return lt | rt, (lm & rm) | lt | rt
+
+
+_BINOPS = {
+    "Add": _arith(lambda a, b: a + b),
+    "Subtract": _arith(lambda a, b: a - b),
+    "Multiply": _arith(lambda a, b: a * b),
+    "Divide": _cpu_divide,
+    "IntegralDivide": _cpu_intdiv,
+    "Remainder": _cpu_rem,
+    "Pmod": _cpu_pmod,
+    "EqualTo": _comparison("eq"),
+    "LessThan": _comparison("lt"),
+    "GreaterThan": _comparison("gt"),
+    "LessThanOrEqual": _comparison("le"),
+    "GreaterThanOrEqual": _comparison("ge"),
+    "EqualNullSafe": _cpu_eqns,
+    "And": _cpu_and,
+    "Or": _cpu_or,
+    "BitwiseAnd": _arith(lambda a, b: a & b),
+    "BitwiseOr": _arith(lambda a, b: a | b),
+    "BitwiseXor": _arith(lambda a, b: a ^ b),
+    "ShiftLeft": lambda e, lv, lm, rv, rm: (
+        lv << (rv.astype(lv.dtype) % (lv.dtype.itemsize * 8)), lm & rm),
+    "ShiftRight": lambda e, lv, lm, rv, rm: (
+        lv >> (rv.astype(lv.dtype) % (lv.dtype.itemsize * 8)), lm & rm),
+    "ShiftRightUnsigned": lambda e, lv, lm, rv, rm: (
+        _srun(lv, rv), lm & rm),
+}
+
+
+def _srun(lv, rv):
+    bits = lv.dtype.itemsize * 8
+    u = lv.astype(np.uint64 if bits == 64 else np.uint32)
+    return (u >> (rv % bits).astype(u.dtype)).astype(lv.dtype)
+
+
+_MATH_UNARY = {
+    "Sqrt": np.sqrt, "Cbrt": np.cbrt, "Exp": np.exp, "Expm1": np.expm1,
+    "Sin": np.sin, "Cos": np.cos, "Tan": np.tan, "Asin": np.arcsin,
+    "Acos": np.arccos, "Atan": np.arctan, "Sinh": np.sinh, "Cosh": np.cosh,
+    "Tanh": np.tanh, "ToDegrees": np.degrees, "ToRadians": np.radians,
+    "Signum": np.sign, "Rint": np.round,
+    "Log": np.log, "Log2": np.log2, "Log10": np.log10, "Log1p": np.log1p,
+}
+
+
+# ---- cast -----------------------------------------------------------------
+
+def _cpu_cast(col: CpuCol, src: DataType, dst: DataType, n: int) -> CpuCol:
+    v, m = col
+    if src is dst:
+        return col
+    if dst.is_string:
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if not m[i]:
+                out[i] = None
+            elif src is BooleanType:
+                out[i] = "true" if v[i] else "false"
+            elif src is DateType:
+                out[i] = str(datetime.date(1970, 1, 1) +
+                             datetime.timedelta(days=int(v[i])))
+            elif src is TimestampType:
+                dt = (datetime.datetime(1970, 1, 1) +
+                      datetime.timedelta(microseconds=int(v[i])))
+                out[i] = dt.strftime("%Y-%m-%d %H:%M:%S")
+            else:
+                out[i] = str(v[i])
+        return out, m.copy()
+    if src.is_string:
+        vals = np.zeros(n, dtype=dst.np_dtype if dst.np_dtype is not None
+                        else np.int64)
+        valid = np.zeros(n, bool)
+        for i in range(n):
+            if not m[i] or v[i] is None:
+                continue
+            s = v[i].strip()
+            try:
+                if dst is BooleanType:
+                    sl = s.lower()
+                    if sl in ("true", "t", "yes", "y", "1"):
+                        vals[i], valid[i] = True, True
+                    elif sl in ("false", "f", "no", "n", "0"):
+                        vals[i], valid[i] = False, True
+                elif dst.is_integral:
+                    x = int(s)
+                    lo, hi = _INT_RANGE[dst.name]
+                    if lo <= x <= hi:
+                        vals[i], valid[i] = x, True
+                elif dst.is_floating:
+                    vals[i], valid[i] = float(s), True
+                elif dst is DateType:
+                    d = datetime.date.fromisoformat(s)
+                    vals[i] = (d - datetime.date(1970, 1, 1)).days
+                    valid[i] = True
+                elif dst is TimestampType:
+                    if " " in s:
+                        dt = datetime.datetime.strptime(s,
+                                                        "%Y-%m-%d %H:%M:%S")
+                    else:
+                        dt = datetime.datetime.combine(
+                            datetime.date.fromisoformat(s),
+                            datetime.time())
+                    vals[i] = int((dt - datetime.datetime(1970, 1, 1))
+                                  .total_seconds() * 1_000_000)
+                    valid[i] = True
+            except (ValueError, OverflowError):
+                pass
+        return vals, valid
+    if dst is BooleanType:
+        return v != 0, m
+    if src is BooleanType:
+        return v.astype(dst.np_dtype), m
+    if src is DateType and dst is TimestampType:
+        return v.astype(np.int64) * 86_400_000_000, m
+    if src is TimestampType and dst is DateType:
+        return (v.astype(np.int64) // 86_400_000_000).astype(np.int32), m
+    if src is TimestampType and dst.is_numeric:
+        if dst.is_floating:
+            return v.astype(np.float64) / 1e6, m
+        return (v // 1_000_000).astype(dst.np_dtype), m
+    if dst is TimestampType:
+        if src.is_floating:
+            return (v.astype(np.float64) * 1e6).astype(np.int64), m
+        return v.astype(np.int64) * 1_000_000, m
+    if dst.is_floating:
+        return v.astype(dst.np_dtype), m
+    if src.is_floating:
+        lo, hi = _INT_RANGE[dst.name]
+        x = np.nan_to_num(v.astype(np.float64), nan=0.0)
+        x = np.trunc(x)
+        out = np.clip(x, float(lo), float(hi))
+        res = np.zeros(n, dtype=np.int64)
+        inb = (out > lo) & (out < hi)
+        res[inb] = out[inb].astype(np.int64)
+        res[out >= hi] = hi
+        res[out <= lo] = lo
+        return res.astype(dst.np_dtype), m
+    return v.astype(dst.np_dtype), m
+
+
+# ---- strings --------------------------------------------------------------
+
+def _str_lit(e):
+    return S._literal_bytes(e).decode("utf-8")
+
+
+def _cpu_string(expr, rec, n: int) -> CpuCol:
+    t = type(expr).__name__
+    if t in ("Upper", "Lower", "StringTrim", "StringTrimLeft",
+             "StringTrimRight", "Length"):
+        v, m = rec(expr.child)
+        if t == "Length":
+            out = np.array([len(x) if x is not None else 0 for x in v],
+                           dtype=np.int32)
+            return out, m
+        fn = {"Upper": lambda s: s.upper(), "Lower": lambda s: s.lower(),
+              "StringTrim": lambda s: s.strip(),
+              "StringTrimLeft": lambda s: s.lstrip(),
+              "StringTrimRight": lambda s: s.rstrip()}[t]
+        out = np.array([fn(x) if x is not None else None for x in v],
+                       dtype=object)
+        return out, m
+    if t == "Substring":
+        v, m = rec(expr.child)
+        p, pm = rec(expr.pos)
+        ln, lm = rec(expr.length)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            s = v[i]
+            if s is None:
+                out[i] = None
+                continue
+            pos = int(p[i])
+            length = max(int(ln[i]), 0)
+            start = pos - 1 if pos > 0 else (len(s) + pos if pos < 0 else 0)
+            start = max(start, 0)
+            out[i] = s[start:start + length]
+        return out, m & pm & lm
+    if t == "Concat":
+        parts = [rec(c) for c in expr.children]
+        out = np.empty(n, dtype=object)
+        valid = np.ones(n, bool)
+        for pv, pm in parts:
+            valid &= pm
+        for i in range(n):
+            if valid[i]:
+                out[i] = "".join(pv[i] for pv, _ in parts)
+        return out, valid
+    if t in ("StartsWith", "EndsWith", "Contains"):
+        v, m = rec(expr.child)
+        pat = _str_lit(expr.pattern)
+        fn = {"StartsWith": str.startswith, "EndsWith": str.endswith,
+              "Contains": str.__contains__}[t]
+        out = np.array([fn(x, pat) if x is not None else False for x in v],
+                       dtype=bool)
+        return out, m
+    if t == "Like":
+        import re
+        v, m = rec(expr.child)
+        pat = _str_lit(expr.pattern)
+        rx = _like_to_regex(pat, expr.escape)
+        out = np.array([bool(rx.fullmatch(x)) if x is not None else False
+                        for x in v], dtype=bool)
+        return out, m
+    if t == "StringLocate":
+        v, m = rec(expr.child)
+        sub = _str_lit(expr.substr)
+        st, sm = rec(expr.start)
+        out = np.zeros(n, dtype=np.int32)
+        for i in range(n):
+            if v[i] is None:
+                continue
+            start = max(int(st[i]) - 1, 0) if int(st[i]) > 0 else None
+            if int(st[i]) <= 0:
+                out[i] = 0
+                continue
+            idx = v[i].find(sub, start)
+            out[i] = idx + 1 if idx >= 0 else 0
+        return out, m & sm
+    if t == "StringReplace":
+        v, m = rec(expr.child)
+        search = _str_lit(expr.search)
+        repl = _str_lit(expr.replace)
+        out = np.array([x.replace(search, repl) if x is not None else None
+                        for x in v], dtype=object)
+        return out, m
+    raise NotImplementedError(f"cpu string {t}")
+
+
+def _like_to_regex(pat: str, escape: str):
+    import re
+    out = []
+    i = 0
+    while i < len(pat):
+        ch = pat[i]
+        if escape and ch == escape and i + 1 < len(pat):
+            out.append(re.escape(pat[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return re.compile("".join(out), re.DOTALL)
+
+
+# ---- datetime -------------------------------------------------------------
+
+def _cpu_datetime(expr, rec, n: int) -> CpuCol:
+    t = type(expr).__name__
+    if isinstance(expr, D._DatePart):
+        v, m = rec(expr.child)
+        if expr.child.dtype is TimestampType:
+            days = v.astype(np.int64) // 86_400_000_000
+            micros = v
+        else:
+            days = v.astype(np.int64)
+            micros = days * 86_400_000_000
+        out = np.zeros(n, dtype=np.int32)
+        for i in range(n):
+            d = datetime.date(1970, 1, 1) + datetime.timedelta(
+                days=int(days[i]))
+            if t == "Year":
+                out[i] = d.year
+            elif t == "Month":
+                out[i] = d.month
+            elif t == "DayOfMonth":
+                out[i] = d.day
+            elif t == "DayOfWeek":
+                out[i] = d.isoweekday() % 7 + 1
+            elif t == "WeekDay":
+                out[i] = d.weekday()
+            elif t == "DayOfYear":
+                out[i] = d.timetuple().tm_yday
+            elif t == "Quarter":
+                out[i] = (d.month - 1) // 3 + 1
+            elif t == "LastDay":
+                nxt = (d.replace(day=28) + datetime.timedelta(days=4))
+                last = nxt - datetime.timedelta(days=nxt.day)
+                out[i] = (last - datetime.date(1970, 1, 1)).days
+            elif t in ("Hour", "Minute", "Second"):
+                tod = int(micros[i]) % 86_400_000_000
+                sec = tod // 1_000_000
+                out[i] = {"Hour": sec // 3600, "Minute": (sec % 3600) // 60,
+                          "Second": sec % 60}[t]
+        return out, m
+    if t in ("DateAdd", "DateSub"):
+        lv, lm = rec(expr.left)
+        rv, rm = rec(expr.right)
+        sign = 1 if t == "DateAdd" else -1
+        return (lv.astype(np.int32) + sign * rv.astype(np.int32)), lm & rm
+    if t == "DateDiff":
+        lv, lm = rec(expr.left)
+        rv, rm = rec(expr.right)
+        l = lv.astype(np.int64) if expr.left.dtype is DateType \
+            else lv // 86_400_000_000
+        r = rv.astype(np.int64) if expr.right.dtype is DateType \
+            else rv // 86_400_000_000
+        return (l - r).astype(np.int32), lm & rm
+    if isinstance(expr, D.UnixTimestamp):
+        v, m = rec(expr.child)
+        src = expr.child.dtype
+        if src is TimestampType:
+            return v // 1_000_000, m
+        if src is DateType:
+            return v.astype(np.int64) * 86_400, m
+        # string
+        col = _cpu_cast((v, m), StringType, TimestampType, n)
+        return col[0] // 1_000_000, col[1]
+    if t == "FromUnixTime":
+        v, m = rec(expr.child)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            dt = datetime.datetime(1970, 1, 1) + datetime.timedelta(
+                seconds=int(v[i]))
+            out[i] = dt.strftime("%Y-%m-%d %H:%M:%S")
+        return out, m
+    if t == "TimeAdd":
+        lv, lm = rec(expr.child)
+        rv, rm = rec(expr.interval)
+        return lv + rv.astype(np.int64), lm & rm
+    raise NotImplementedError(f"cpu datetime {t}")

@@ -1,0 +1,230 @@
+"""Date/time expression library.
+
+Reference: org/.../rapids/datetimeExpressions.scala (+DateUtils.scala) —
+year/month/day/hour/minute/second extraction, date add/sub/diff,
+unix_timestamp family.  All pure integer arithmetic on days/micros via
+datetime_utils, UTC only (the reference likewise requires UTC sessions).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import Column
+from ..types import (DateType, IntegerType, LongType, StringType,
+                     TimestampType)
+from . import datetime_utils as dtu
+from .expressions import Expression, Literal, UnaryExpression
+
+
+class _DatePart(Expression):
+    """Extract an int field from a date or timestamp column."""
+
+    out_dtype = IntegerType
+
+    def __init__(self, child):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.out_dtype
+
+    def _days(self, c: Column):
+        if self.child.dtype is TimestampType:
+            return dtu.micros_to_days(c.data)
+        return c.data
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        return Column(self.compute(c), c.valid, self.out_dtype)
+
+
+class Year(_DatePart):
+    def compute(self, c):
+        y, _, _ = dtu.civil_from_days(self._days(c))
+        return y
+
+
+class Month(_DatePart):
+    def compute(self, c):
+        _, m, _ = dtu.civil_from_days(self._days(c))
+        return m
+
+
+class DayOfMonth(_DatePart):
+    def compute(self, c):
+        _, _, d = dtu.civil_from_days(self._days(c))
+        return d
+
+
+class DayOfWeek(_DatePart):
+    """Spark: 1 = Sunday ... 7 = Saturday."""
+
+    def compute(self, c):
+        days = self._days(c).astype(jnp.int64)
+        # 1970-01-01 was a Thursday (=> dayofweek 5)
+        return ((days + 4) % 7 + 1).astype(jnp.int32)
+
+
+class DayOfYear(_DatePart):
+    def compute(self, c):
+        days = self._days(c)
+        y, _, _ = dtu.civil_from_days(days)
+        jan1 = dtu.days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+        return (days - jan1 + 1).astype(jnp.int32)
+
+
+class Quarter(_DatePart):
+    def compute(self, c):
+        _, m, _ = dtu.civil_from_days(self._days(c))
+        return ((m - 1) // 3 + 1).astype(jnp.int32)
+
+
+class LastDay(_DatePart):
+    out_dtype = DateType
+
+    def compute(self, c):
+        days = self._days(c)
+        y, m, _ = dtu.civil_from_days(days)
+        return dtu.days_from_civil(y, m, dtu.last_day_of_month(y, m))
+
+
+class Hour(_DatePart):
+    def compute(self, c):
+        h, _, _, _ = dtu.micros_time_of_day(c.data)
+        return h
+
+
+class Minute(_DatePart):
+    def compute(self, c):
+        _, m, _, _ = dtu.micros_time_of_day(c.data)
+        return m
+
+
+class Second(_DatePart):
+    def compute(self, c):
+        _, _, s, _ = dtu.micros_time_of_day(c.data)
+        return s
+
+
+class WeekDay(_DatePart):
+    """Spark weekday: 0 = Monday ... 6 = Sunday."""
+
+    def compute(self, c):
+        days = self._days(c).astype(jnp.int64)
+        return ((days + 3) % 7).astype(jnp.int32)
+
+
+class _DateArith(Expression):
+    def __init__(self, left, right):
+        self.left, self.right = left, right
+        self.children = (left, right)
+
+    @property
+    def dtype(self):
+        return DateType
+
+
+class DateAdd(_DateArith):
+    def eval(self, batch):
+        l = self.left.eval(batch)
+        r = self.right.eval(batch)
+        data = (l.data.astype(jnp.int32) + r.data.astype(jnp.int32))
+        return Column(data, l.valid & r.valid, DateType).mask_invalid()
+
+
+class DateSub(_DateArith):
+    def eval(self, batch):
+        l = self.left.eval(batch)
+        r = self.right.eval(batch)
+        data = (l.data.astype(jnp.int32) - r.data.astype(jnp.int32))
+        return Column(data, l.valid & r.valid, DateType).mask_invalid()
+
+
+class DateDiff(_DateArith):
+    @property
+    def dtype(self):
+        return IntegerType
+
+    def eval(self, batch):
+        end = self.left.eval(batch)
+        start = self.right.eval(batch)
+        e = end.data if self.left.dtype is DateType \
+            else dtu.micros_to_days(end.data)
+        s = start.data if self.right.dtype is DateType \
+            else dtu.micros_to_days(start.data)
+        return Column((e - s).astype(jnp.int32), end.valid & start.valid,
+                      IntegerType).mask_invalid()
+
+
+class UnixTimestamp(Expression):
+    """unix_timestamp(ts|date|string[, fmt]) -> long seconds.  String input
+    supports the default 'yyyy-MM-dd HH:mm:ss' format (conf-gated parse)."""
+
+    def __init__(self, child, fmt: Expression = None):
+        self.child = child
+        self.fmt = fmt
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return LongType
+
+    def eval(self, batch):
+        from .cast import Cast
+        src = self.child.dtype
+        if src is TimestampType:
+            c = self.child.eval(batch)
+            return Column(c.data // dtu.MICROS_PER_SECOND, c.valid, LongType)
+        if src is DateType:
+            c = self.child.eval(batch)
+            return Column(c.data.astype(jnp.int64) * dtu.SECONDS_PER_DAY,
+                          c.valid, LongType)
+        if src is StringType:
+            ts = Cast(self.child, TimestampType).eval(batch)
+            return Column(ts.data // dtu.MICROS_PER_SECOND, ts.valid,
+                          LongType).mask_invalid()
+        raise NotImplementedError(f"unix_timestamp({src.name})")
+
+
+class ToUnixTimestamp(UnixTimestamp):
+    pass
+
+
+class FromUnixTime(Expression):
+    """from_unixtime(long) -> 'yyyy-MM-dd HH:mm:ss' string (default format)."""
+
+    def __init__(self, child, fmt: Expression = None):
+        self.child = child
+        self.fmt = fmt
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return StringType
+
+    def eval(self, batch):
+        from .cast import _format_timestamp
+        c = self.child.eval(batch)
+        micros = Column(c.data.astype(jnp.int64) * dtu.MICROS_PER_SECOND,
+                        c.valid, TimestampType)
+        return _format_timestamp(micros, StringType)
+
+
+class TimeAdd(Expression):
+    """timestamp + interval literal (micros)."""
+
+    def __init__(self, child, interval_micros: Expression):
+        self.child = child
+        self.interval = interval_micros
+        self.children = (child, interval_micros)
+
+    @property
+    def dtype(self):
+        return TimestampType
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        i = self.interval.eval(batch)
+        return Column(c.data + i.data.astype(jnp.int64), c.valid & i.valid,
+                      TimestampType).mask_invalid()

@@ -1,0 +1,74 @@
+"""Vectorized civil-calendar conversions (days since epoch <-> y/m/d and
+micros since epoch <-> time-of-day), used by cast and datetime expressions.
+
+Pure jnp integer arithmetic (Howard Hinnant's civil_from_days / days_from_civil
+algorithms), so they trace into the same XLA program as the rest of a pipeline.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MICROS_PER_SECOND = 1_000_000
+SECONDS_PER_DAY = 86_400
+MICROS_PER_DAY = MICROS_PER_SECOND * SECONDS_PER_DAY
+
+
+def civil_from_days(days):
+    """int32/64 days since 1970-01-01 -> (year, month, day) int32 arrays."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097                                   # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)          # [0, 365]
+    mp = (5 * doy + 2) // 153                                # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                        # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)                   # [1, 12]
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y, m, d):
+    """(year, month, day) -> int32 days since 1970-01-01."""
+    y = y.astype(jnp.int64)
+    m = m.astype(jnp.int64)
+    d = d.astype(jnp.int64)
+    y = jnp.where(m <= 2, y - 1, y)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400                                       # [0, 399]
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1                         # [0, 365]
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy             # [0, 146096]
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def floordiv(a, b):
+    """Floor division toward -inf on int64 (jnp // already floors)."""
+    return a // b
+
+
+def micros_to_days(micros):
+    return (micros.astype(jnp.int64) // MICROS_PER_DAY).astype(jnp.int32)
+
+
+def micros_time_of_day(micros):
+    """-> (hour, minute, second, microsecond) int32 arrays."""
+    tod = micros.astype(jnp.int64) % MICROS_PER_DAY
+    sec = tod // MICROS_PER_SECOND
+    us = tod % MICROS_PER_SECOND
+    h = sec // 3600
+    mi = (sec % 3600) // 60
+    s = sec % 60
+    return (h.astype(jnp.int32), mi.astype(jnp.int32), s.astype(jnp.int32),
+            us.astype(jnp.int32))
+
+
+def is_leap_year(y):
+    return ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+
+
+def last_day_of_month(y, m):
+    base = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                       dtype=jnp.int32)
+    d = base[m - 1]
+    return jnp.where((m == 2) & is_leap_year(y), 29, d)

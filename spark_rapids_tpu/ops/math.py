@@ -1,0 +1,186 @@
+"""Math expression library (reference: org/.../rapids/mathExpressions.scala).
+
+Spark semantics: math functions take/return DoubleType (the analyzer casts
+inputs); Log-family returns NULL for non-positive inputs (unlike cuDF's -inf,
+which the reference flags as incompat)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import Column
+from ..types import DoubleType, LongType, IntegerType
+from .expressions import (BinaryExpression, Expression, UnaryExpression)
+
+
+class _DoubleUnary(UnaryExpression):
+    @property
+    def dtype(self):
+        return DoubleType
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        x = c.data.astype(jnp.float64)
+        data = self.do_op(x)
+        return Column(data, c.valid, DoubleType)
+
+
+class Sqrt(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.sqrt(x)
+
+
+class Cbrt(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.cbrt(x)
+
+
+class Exp(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.exp(x)
+
+
+class Expm1(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.expm1(x)
+
+
+class _LogBase(_DoubleUnary):
+    """null for x <= lower bound, matching Spark's nullSafeEval."""
+
+    lower = 0.0
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        x = c.data.astype(jnp.float64)
+        ok = x > self.lower
+        data = self.do_op(jnp.where(ok, x, 1.0))
+        return Column(data, jnp.logical_and(c.valid, ok), DoubleType)
+
+
+class Log(_LogBase):
+    def do_op(self, x):
+        return jnp.log(x)
+
+
+class Log2(_LogBase):
+    def do_op(self, x):
+        return jnp.log2(x)
+
+
+class Log10(_LogBase):
+    def do_op(self, x):
+        return jnp.log10(x)
+
+
+class Log1p(_LogBase):
+    lower = -1.0
+
+    def do_op(self, x):
+        return jnp.log1p(x)
+
+
+class Sin(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.sin(x)
+
+
+class Cos(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.cos(x)
+
+
+class Tan(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.tan(x)
+
+
+class Asin(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.arcsin(x)
+
+
+class Acos(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.arccos(x)
+
+
+class Atan(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.arctan(x)
+
+
+class Sinh(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.sinh(x)
+
+
+class Cosh(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.cosh(x)
+
+
+class Tanh(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.tanh(x)
+
+
+class ToDegrees(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.degrees(x)
+
+
+class ToRadians(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.radians(x)
+
+
+class Signum(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.sign(x)
+
+
+class Floor(UnaryExpression):
+    @property
+    def dtype(self):
+        return LongType if self.child.dtype.is_floating else self.child.dtype
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        if not self.child.dtype.is_floating:
+            return c
+        return Column(jnp.floor(c.data).astype(jnp.int64), c.valid, LongType)
+
+
+class Ceil(UnaryExpression):
+    @property
+    def dtype(self):
+        return LongType if self.child.dtype.is_floating else self.child.dtype
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        if not self.child.dtype.is_floating:
+            return c
+        return Column(jnp.ceil(c.data).astype(jnp.int64), c.valid, LongType)
+
+
+class Rint(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.round(x)  # banker's rounding, matches Math.rint
+
+
+class Pow(BinaryExpression):
+    @property
+    def dtype(self):
+        return DoubleType
+
+    def do_op(self, l, r, valid):
+        return jnp.power(l.astype(jnp.float64), r.astype(jnp.float64)), valid
+
+
+class Atan2(BinaryExpression):
+    @property
+    def dtype(self):
+        return DoubleType
+
+    def do_op(self, l, r, valid):
+        return jnp.arctan2(l.astype(jnp.float64), r.astype(jnp.float64)), valid

@@ -1,0 +1,393 @@
+"""String expression library over byte-matrix columns.
+
+Reference: org/.../rapids/stringFunctions.scala (upper/lower/substring/
+locate/replace/trim/startsWith/endsWith/concat/contains/Like/Length).
+
+Device representation is uint8[rows, max_len] + int32 lengths (see
+columnar/column.py).  Everything here is plain vectorized VPU arithmetic —
+no scatter, no per-row loops — so XLA fuses string predicates into the same
+program as the rest of the pipeline.  Multi-byte UTF-8: Length counts
+characters; case-mapping and substring positions are ASCII-exact (documented
+incompat, like the reference's unicode carve-outs).
+
+Pattern-matching ops (StartsWith/EndsWith/Contains/Like/Locate/Replace)
+require a LITERAL pattern, as in the reference (tagged otherwise).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, bucket_strlen
+from ..types import (BooleanType, IntegerType, StringType)
+from .expressions import Expression, Literal
+
+
+def _literal_bytes(e: Expression) -> bytes:
+    if isinstance(e, Literal) and isinstance(e.value, str):
+        return e.value.encode("utf-8")
+    raise ValueError("pattern must be a string literal")
+
+
+def _is_cont(b):
+    """UTF-8 continuation byte?"""
+    return (b & 0xC0) == 0x80
+
+
+class _StringUnary(Expression):
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return StringType
+
+
+class Upper(_StringUnary):
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        lower = (c.data >= ord("a")) & (c.data <= ord("z"))
+        return Column(jnp.where(lower, c.data - 32, c.data), c.valid,
+                      StringType, c.lengths)
+
+
+class Lower(_StringUnary):
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        upper = (c.data >= ord("A")) & (c.data <= ord("Z"))
+        return Column(jnp.where(upper, c.data + 32, c.data), c.valid,
+                      StringType, c.lengths)
+
+
+class Length(_StringUnary):
+    """Character count (UTF-8 aware: skip continuation bytes)."""
+
+    @property
+    def dtype(self):
+        return IntegerType
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        pos = jnp.arange(c.max_len, dtype=jnp.int32)[None, :]
+        in_range = pos < c.lengths[:, None]
+        starts = in_range & ~_is_cont(c.data)
+        return Column(jnp.sum(starts, axis=1).astype(jnp.int32), c.valid,
+                      IntegerType)
+
+
+class StringTrim(_StringUnary):
+    def eval(self, batch):
+        from .cast import _trim_ws
+        return _trim_ws(self.child.eval(batch))
+
+
+class StringTrimLeft(_StringUnary):
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        data, lens = c.data, c.lengths
+        L = c.max_len
+        pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+        in_range = pos < lens[:, None]
+        nonws = (data > 0x20) & in_range
+        start = jnp.min(jnp.where(nonws, pos, L), axis=1)
+        new_lens = jnp.maximum(lens - start, 0).astype(jnp.int32)
+        idx = jnp.clip(pos + start[:, None], 0, L - 1)
+        shifted = jnp.take_along_axis(data, idx, axis=1)
+        shifted = jnp.where(pos < new_lens[:, None], shifted, 0)
+        return Column(shifted, c.valid, StringType, new_lens)
+
+
+class StringTrimRight(_StringUnary):
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        pos = jnp.arange(c.max_len, dtype=jnp.int32)[None, :]
+        in_range = pos < c.lengths[:, None]
+        nonws = (c.data > 0x20) & in_range
+        end = jnp.max(jnp.where(nonws, pos + 1, 0), axis=1).astype(jnp.int32)
+        data = jnp.where(pos < end[:, None], c.data, 0)
+        return Column(data, c.valid, StringType, end)
+
+
+class Substring(Expression):
+    """Spark substring(str, pos, len): 1-based, negative pos counts from the
+    end, pos=0 treated as 1.  Byte-positioned (ASCII-exact)."""
+
+    def __init__(self, child, pos, length):
+        self.child, self.pos, self.length = child, pos, length
+        self.children = (child, pos, length)
+
+    @property
+    def dtype(self):
+        return StringType
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        p = self.pos.eval(batch).data.astype(jnp.int32)
+        n = self.length.eval(batch).data.astype(jnp.int32)
+        L = c.max_len
+        lens = c.lengths
+        # resolve 1-based/negative start to 0-based
+        start = jnp.where(p > 0, p - 1, jnp.where(p < 0, lens + p, 0))
+        start = jnp.clip(start, 0, lens)
+        stop = jnp.clip(start + jnp.maximum(n, 0), start, lens)
+        new_lens = (stop - start).astype(jnp.int32)
+        pos_m = jnp.arange(L, dtype=jnp.int32)[None, :]
+        idx = jnp.clip(pos_m + start[:, None], 0, L - 1)
+        shifted = jnp.take_along_axis(c.data, idx, axis=1)
+        shifted = jnp.where(pos_m < new_lens[:, None], shifted, 0)
+        return Column(shifted, c.valid, StringType, new_lens)
+
+
+class Concat(Expression):
+    def __init__(self, *children):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self):
+        return StringType
+
+    def eval(self, batch):
+        cols = [ch.eval(batch) for ch in self.children]
+        out = cols[0]
+        for nxt in cols[1:]:
+            out = _concat2(out, nxt)
+        return out
+
+
+def _concat2(a: Column, b: Column) -> Column:
+    L = bucket_strlen(a.max_len + b.max_len)
+    a = a.pad_strings_to(L)
+    b = b.pad_strings_to(L)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    bidx = jnp.clip(pos - a.lengths[:, None], 0, L - 1)
+    b_shifted = jnp.take_along_axis(b.data, bidx, axis=1)
+    data = jnp.where(pos < a.lengths[:, None], a.data, b_shifted)
+    lens = a.lengths + b.lengths
+    data = jnp.where(pos < lens[:, None], data, 0)
+    valid = a.valid & b.valid
+    return Column(data, valid, StringType, lens.astype(jnp.int32))
+
+
+class _PatternPredicate(Expression):
+    def __init__(self, child, pattern):
+        self.child, self.pattern = child, pattern
+        self.children = (child, pattern)
+
+    @property
+    def dtype(self):
+        return BooleanType
+
+    def _pat(self) -> bytes:
+        return _literal_bytes(self.pattern)
+
+
+class StartsWith(_PatternPredicate):
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        pat = np.frombuffer(self._pat(), dtype=np.uint8)
+        m = len(pat)
+        if m == 0:
+            return Column(jnp.ones(c.capacity, jnp.bool_), c.valid,
+                          BooleanType)
+        if m > c.max_len:
+            return Column(jnp.zeros(c.capacity, jnp.bool_), c.valid,
+                          BooleanType)
+        hit = jnp.all(c.data[:, :m] == jnp.asarray(pat)[None, :], axis=1) \
+            & (c.lengths >= m)
+        return Column(hit, c.valid, BooleanType)
+
+
+class EndsWith(_PatternPredicate):
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        pat = np.frombuffer(self._pat(), dtype=np.uint8)
+        m = len(pat)
+        if m == 0:
+            return Column(jnp.ones(c.capacity, jnp.bool_), c.valid,
+                          BooleanType)
+        if m > c.max_len:
+            return Column(jnp.zeros(c.capacity, jnp.bool_), c.valid,
+                          BooleanType)
+        L = c.max_len
+        pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+        start = c.lengths[:, None] - m
+        idx = jnp.clip(pos + start, 0, L - 1)
+        tail = jnp.take_along_axis(c.data, idx, axis=1)[:, :m]
+        hit = jnp.all(tail == jnp.asarray(pat)[None, :], axis=1) \
+            & (c.lengths >= m)
+        return Column(hit, c.valid, BooleanType)
+
+
+def _contains_at(c: Column, pat: np.ndarray):
+    """bool[rows, L]: does pat occur starting at each position?"""
+    L = c.max_len
+    m = len(pat)
+    acc = jnp.ones((c.capacity, L), dtype=jnp.bool_)
+    for j in range(m):
+        shifted = jnp.roll(c.data, -j, axis=1)
+        # positions beyond L-j invalid; rely on length check below
+        acc = acc & (shifted == int(pat[j]))
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    acc = acc & (pos + m <= c.lengths[:, None])
+    return acc
+
+
+class Contains(_PatternPredicate):
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        pat = np.frombuffer(self._pat(), dtype=np.uint8)
+        if len(pat) == 0:
+            return Column(jnp.ones(c.capacity, jnp.bool_), c.valid,
+                          BooleanType)
+        if len(pat) > c.max_len:
+            return Column(jnp.zeros(c.capacity, jnp.bool_), c.valid,
+                          BooleanType)
+        hit = jnp.any(_contains_at(c, pat), axis=1)
+        return Column(hit, c.valid, BooleanType)
+
+
+class StringLocate(Expression):
+    """locate(substr, str, start): 1-based position of first occurrence at or
+    after `start`; 0 if absent."""
+
+    def __init__(self, substr, child, start=None):
+        self.substr, self.child = substr, child
+        self.start = start if start is not None else Literal(1)
+        self.children = (substr, child, self.start)
+
+    @property
+    def dtype(self):
+        return IntegerType
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        pat = np.frombuffer(_literal_bytes(self.substr), dtype=np.uint8)
+        st = self.start.eval(batch).data.astype(jnp.int32)
+        L = c.max_len
+        if len(pat) == 0:
+            res = jnp.where(st <= 1, 1, jnp.where(st - 1 <= c.lengths, st, 0))
+            return Column(res.astype(jnp.int32), c.valid, IntegerType)
+        if len(pat) > L:
+            return Column(jnp.zeros(c.capacity, jnp.int32), c.valid,
+                          IntegerType)
+        occ = _contains_at(c, pat)
+        pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+        occ = occ & (pos >= st[:, None] - 1)
+        found = jnp.any(occ, axis=1)
+        first = jnp.argmax(occ, axis=1).astype(jnp.int32) + 1
+        return Column(jnp.where(found, first, 0), c.valid, IntegerType)
+
+
+class Like(_PatternPredicate):
+    r"""SQL LIKE: % any run, _ any char, \ escapes.  Compiled into a
+    position-set DP unrolled over the (literal) pattern — each pattern token
+    is one vector op over the batch, no regex engine on device."""
+
+    def __init__(self, child, pattern, escape: str = "\\"):
+        super().__init__(child, pattern)
+        self.escape = escape
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        pat = self._pat()
+        esc = self.escape.encode()[0] if self.escape else None
+        # tokenize
+        tokens = []  # ("char", b) | ("any1",) | ("many",)
+        i = 0
+        while i < len(pat):
+            b = pat[i]
+            if esc is not None and b == esc and i + 1 < len(pat):
+                tokens.append(("char", pat[i + 1]))
+                i += 2
+                continue
+            if b == ord("%"):
+                tokens.append(("many",))
+            elif b == ord("_"):
+                tokens.append(("any1",))
+            else:
+                tokens.append(("char", b))
+            i += 1
+        L = c.max_len
+        cap = c.capacity
+        pos = jnp.arange(L + 1, dtype=jnp.int32)[None, :]
+        # reach[i, p] : pattern prefix consumed matches string prefix length p
+        reach = pos == 0
+        reach = jnp.broadcast_to(reach, (cap, L + 1))
+        in_str = (pos[:, 1:] <= c.lengths[:, None]) if L else None
+        for tok in tokens:
+            if tok[0] == "many":
+                reach = jnp.cumsum(reach, axis=1) > 0
+            elif tok[0] == "any1":
+                nxt = jnp.zeros_like(reach)
+                nxt = nxt.at[:, 1:].set(reach[:, :-1] & in_str)
+                reach = nxt
+            else:
+                hit = (c.data == tok[1]) & (
+                    jnp.arange(L, dtype=jnp.int32)[None, :]
+                    < c.lengths[:, None])
+                nxt = jnp.zeros_like(reach)
+                nxt = nxt.at[:, 1:].set(reach[:, :-1] & hit)
+                reach = nxt
+        final = jnp.take_along_axis(reach, c.lengths[:, None].astype(jnp.int32),
+                                    axis=1)[:, 0]
+        return Column(final, c.valid, BooleanType)
+
+
+class StringReplace(Expression):
+    """replace(str, search, replace) with literal search/replace.
+
+    General replace changes row lengths arbitrarily; the device kernel
+    supports same-length search/replace (the common fixed-width cleanup
+    case); other shapes are planner-tagged to the CPU executor."""
+
+    def __init__(self, child, search, replace):
+        self.child, self.search, self.replace = child, search, replace
+        self.children = (child, search, replace)
+
+    @property
+    def dtype(self):
+        return StringType
+
+    def device_supported(self) -> bool:
+        try:
+            s = _literal_bytes(self.search)
+            r = _literal_bytes(self.replace)
+            return len(s) == len(r) and len(s) > 0
+        except ValueError:
+            return False
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        s = np.frombuffer(_literal_bytes(self.search), dtype=np.uint8)
+        r = np.frombuffer(_literal_bytes(self.replace), dtype=np.uint8)
+        if len(s) != len(r) or len(s) == 0:
+            raise NotImplementedError(
+                "device StringReplace requires equal-length literals")
+        if len(s) > c.max_len:
+            return c
+        occ = _contains_at(c, s)
+        # suppress overlapping matches left-to-right: greedy scan
+        m = len(s)
+        L = c.max_len
+
+        def step(carry, col_occ):
+            # carry: remaining suppress count per row
+            active = col_occ & (carry == 0)
+            new_carry = jnp.where(active, m - 1,
+                                  jnp.maximum(carry - 1, 0))
+            return new_carry, active
+
+        import jax
+        carry0 = jnp.zeros(c.capacity, dtype=jnp.int32)
+        _, starts = jax.lax.scan(step, carry0, occ.T)
+        starts = starts.T  # [rows, L] non-overlapping match starts
+        data = c.data
+        for j in range(m):
+            mask = jnp.roll(starts, j, axis=1)
+            if j > 0:
+                mask = mask.at[:, :j].set(False)
+            data = jnp.where(mask, int(r[j]), data)
+        return Column(data, c.valid, StringType, c.lengths)

@@ -1,0 +1,151 @@
+"""Analysis: resolve the unresolved DSL against child schemas.
+
+Produces typed, bound Expression trees (ops/expressions.py) and computes
+output schemas for every logical node.  Inserts Casts for type coercion the
+way Spark's analyzer would (string literal vs date column -> cast literal,
+numeric promotion, etc.), so the device expression engine only ever sees
+well-typed trees.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..ops import expressions as E
+from ..ops import math as M
+from ..ops.aggregates import AGG_FUNCS, AggregateExpression
+from ..ops.cast import Cast, supported_cast
+from ..types import (BooleanType, DataType, DateType, DoubleType, IntegerType,
+                     LongType, NullType, Schema, StringType, StructField,
+                     TimestampType, promote)
+from .logical import ColumnExpr, SortOrder, WhenBuilder
+
+# ops resolved via simple constructor lookup: ColumnExpr op name -> class
+_SIMPLE = {}
+for _n in ("Add Subtract Multiply Divide IntegralDivide Remainder Pmod "
+           "UnaryMinus Abs EqualTo LessThan GreaterThan LessThanOrEqual "
+           "GreaterThanOrEqual EqualNullSafe And Or Not IsNull IsNotNull "
+           "IsNaN Coalesce NaNvl BitwiseAnd BitwiseOr BitwiseXor BitwiseNot "
+           "ShiftLeft ShiftRight ShiftRightUnsigned").split():
+    _SIMPLE[_n] = getattr(E, _n)
+for _n in ("Sqrt Cbrt Exp Expm1 Log Log2 Log10 Log1p Sin Cos Tan Asin Acos "
+           "Atan Sinh Cosh Tanh ToDegrees ToRadians Signum Floor Ceil Rint "
+           "Pow Atan2").split():
+    _SIMPLE[_n] = getattr(M, _n)
+
+_COMPARISONS = {"EqualTo", "LessThan", "GreaterThan", "LessThanOrEqual",
+                "GreaterThanOrEqual", "EqualNullSafe"}
+_ARITH = {"Add", "Subtract", "Multiply", "Divide", "IntegralDivide",
+          "Remainder", "Pmod"}
+
+
+class AnalysisError(Exception):
+    pass
+
+
+def coerce_pair(l: E.Expression, r: E.Expression, op: str
+                ) -> Tuple[E.Expression, E.Expression]:
+    """Insert casts so a binary op sees compatible types."""
+    lt, rt = l.dtype, r.dtype
+    if lt is rt:
+        return l, r
+    if lt is NullType:
+        return E.Literal(None, rt), r
+    if rt is NullType:
+        return l, E.Literal(None, lt)
+    if lt.is_numeric and rt.is_numeric:
+        return l, r  # BinaryExpression promotes internally
+    # string vs date/timestamp/numeric: cast the string side (Spark coerces
+    # string literals to the other operand's type)
+    if lt.is_string and supported_cast(lt, rt):
+        return Cast(l, rt), r
+    if rt.is_string and supported_cast(rt, lt):
+        return l, Cast(r, lt)
+    # date vs timestamp: widen date
+    if lt is DateType and rt is TimestampType:
+        return Cast(l, TimestampType), r
+    if lt is TimestampType and rt is DateType:
+        return l, Cast(r, TimestampType)
+    if op in _COMPARISONS and lt.name == rt.name:
+        return l, r
+    raise AnalysisError(f"cannot apply {op} to {lt.name} and {rt.name}")
+
+
+def resolve(ce, schema: Schema, partition_id: int = 0) -> E.Expression:
+    """ColumnExpr -> typed bound Expression."""
+    if not isinstance(ce, ColumnExpr):
+        return E.lit(ce)
+    op = ce.op
+    if op == "col":
+        name = ce.args[0]
+        try:
+            idx = schema.index_of(name)
+        except KeyError:
+            raise AnalysisError(
+                f"column {name!r} not found in {schema.names}")
+        return E.BoundReference(idx, schema[idx].dtype, name)
+    if op == "lit":
+        return E.Literal(ce.args[0])
+    if op == "Cast":
+        child = resolve(ce.args[0], schema, partition_id)
+        to = ce.args[1]
+        if child.dtype is NullType:
+            return E.Literal(None, to)
+        if not supported_cast(child.dtype, to):
+            raise AnalysisError(f"cast {child.dtype.name}->{to.name} "
+                                "not supported")
+        return Cast(child, to)
+    if op == "In":
+        child = resolve(ce.args[0], schema, partition_id)
+        return E.In(child, list(ce.args[1]))
+    if op == "CaseWhen":
+        branches, otherwise = ce.args
+        rb = [(resolve(p, schema, partition_id),
+               resolve(v, schema, partition_id)) for p, v in branches]
+        ro = resolve(otherwise, schema, partition_id) \
+            if otherwise is not None else None
+        return E.CaseWhen(rb, ro)
+    if op in AGG_FUNCS:
+        child_ce, distinct = ce.args
+        child = None
+        if not (child_ce.op == "lit" and child_ce.args[0] in (1, "*")):
+            child = resolve(child_ce, schema, partition_id)
+        return AggregateExpression(op, child, distinct,
+                                   output_name=ce.output_name)
+    if op == "Rand":
+        return E.Rand(ce.args[0], partition_id)
+    if op == "SparkPartitionID":
+        return E.SparkPartitionID(partition_id)
+    if op == "MonotonicallyIncreasingID":
+        return E.MonotonicallyIncreasingID(partition_id)
+    # string/date ops resolved lazily to keep import cycles away
+    from ..ops import strings as S
+    from ..ops import datetime_exprs as D
+    _STRING = {"Upper": S.Upper, "Lower": S.Lower, "Length": S.Length,
+               "Substring": S.Substring, "Concat": S.Concat,
+               "StartsWith": S.StartsWith, "EndsWith": S.EndsWith,
+               "Contains": S.Contains, "Like": S.Like, "Trim": S.StringTrim,
+               "LTrim": S.StringTrimLeft, "RTrim": S.StringTrimRight,
+               "StringReplace": S.StringReplace, "Locate": S.StringLocate}
+    _DATE = {"Year": D.Year, "Month": D.Month, "DayOfMonth": D.DayOfMonth,
+             "Hour": D.Hour, "Minute": D.Minute, "Second": D.Second,
+             "DayOfWeek": D.DayOfWeek, "DayOfYear": D.DayOfYear,
+             "Quarter": D.Quarter, "LastDay": D.LastDay,
+             "DateAdd": D.DateAdd, "DateSub": D.DateSub,
+             "DateDiff": D.DateDiff, "UnixTimestamp": D.UnixTimestamp,
+             "FromUnixTime": D.FromUnixTime}
+    if op in _STRING:
+        args = [resolve(a, schema, partition_id) for a in ce.args]
+        return _STRING[op](*args)
+    if op in _DATE:
+        args = [resolve(a, schema, partition_id) for a in ce.args]
+        return _DATE[op](*args)
+    if op in _SIMPLE:
+        args = [resolve(a, schema, partition_id) for a in ce.args]
+        if len(args) == 2 and (op in _COMPARISONS or op in _ARITH):
+            args = list(coerce_pair(args[0], args[1], op))
+        return _SIMPLE[op](*args)
+    raise AnalysisError(f"unknown expression op {op!r}")
+
+
+def output_field(ce: ColumnExpr, expr: E.Expression) -> StructField:
+    return StructField(ce.output_name, expr.dtype)

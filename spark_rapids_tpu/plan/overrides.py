@@ -1,0 +1,336 @@
+"""The overrides pass: tag -> explain -> convert.
+
+Reference behavior being reproduced (structure, not code):
+  * GpuOverrides rule tables keyed by operator class, each rule deriving a
+    kill-switch conf `spark.rapids.sql.<kind>.<Name>`
+    (reference: rapids/GpuOverrides.scala:66-258 rule framework,
+     453-1705 rule tables)
+  * RapidsMeta tagging tree: every plan/expression node gets a meta wrapper;
+    tagging marks `willNotWorkOnTpu(reason)` bottom-up; `explain` prints the
+    reasons; conversion swaps supported subtrees to device operators
+    (reference: rapids/RapidsMeta.scala:173-196)
+  * type gate (reference: GpuOverrides.isSupportedType:375-387)
+
+The planner here goes logical plan -> physical ExecNode tree where each node
+is either the Tpu* or Cpu* implementation; transitions.py then inserts
+host<->device edges, coalesce nodes and fuses row-local chains.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .. import config as C
+from ..config import TpuConf
+from ..ops import expressions as E
+from ..ops import math as M
+from ..ops import strings as S
+from ..ops import datetime_exprs as D
+from ..ops.aggregates import AggregateExpression
+from ..ops.cast import Cast, supported_cast
+from ..types import (DataType, NullType, Schema, StructField, StringType,
+                     SUPPORTED_TYPES, DoubleType, FloatType)
+from . import logical as L
+from .analysis import AnalysisError, resolve
+
+# --------------------------------------------------------------------------
+# expression rule table — class name -> optional extra tagger
+# (the device implementation is the Expression.eval itself)
+# --------------------------------------------------------------------------
+
+def _tag_cast(meta: "ExprMeta", conf: TpuConf):
+    e: Cast = meta.expr
+    src, dst = e.child.dtype, e.to
+    if not supported_cast(src, dst):
+        meta.will_not_work(f"cast {src.name} to {dst.name} is not supported "
+                           "on TPU")
+        return
+    if src.is_string and dst.is_floating \
+            and not conf.get(C.ENABLE_CAST_STRING_TO_FLOAT):
+        meta.will_not_work(
+            "string to float casts can produce results different from Spark "
+            "in corner cases; set "
+            f"{C.ENABLE_CAST_STRING_TO_FLOAT.key}=true to enable")
+    if src.is_floating and dst.is_string \
+            and not conf.get(C.ENABLE_CAST_FLOAT_TO_STRING):
+        meta.will_not_work(
+            "float to string casts are formatted differently than Spark; set "
+            f"{C.ENABLE_CAST_FLOAT_TO_STRING.key}=true to enable")
+    if src.is_string and dst.name == "timestamp" \
+            and not conf.get(C.ENABLE_CAST_STRING_TO_TIMESTAMP):
+        meta.will_not_work(
+            "string to timestamp casts only support a subset of formats; set "
+            f"{C.ENABLE_CAST_STRING_TO_TIMESTAMP.key}=true to enable")
+
+
+def _tag_literal_pattern(meta: "ExprMeta", conf: TpuConf):
+    e = meta.expr
+    pat = getattr(e, "pattern", None) or getattr(e, "search", None)
+    if not (isinstance(pat, E.Literal) and isinstance(pat.value, str)):
+        meta.will_not_work("only literal patterns are supported on TPU")
+
+
+def _tag_replace(meta: "ExprMeta", conf: TpuConf):
+    e: S.StringReplace = meta.expr
+    if not e.device_supported():
+        meta.will_not_work("device StringReplace requires equal-length "
+                           "literal search/replace strings")
+
+
+def _tag_agg(meta: "ExprMeta", conf: TpuConf):
+    e: AggregateExpression = meta.expr
+    if e.distinct:
+        meta.will_not_work("distinct aggregates are not supported on TPU yet")
+    if e.func in ("Sum", "Average") and e.child is not None \
+            and e.child.dtype.is_floating \
+            and not (conf.get(C.VARIABLE_FLOAT_AGG)
+                     or conf.get(C.INCOMPATIBLE_OPS)):
+        meta.will_not_work(
+            "floating point aggregation reduces in a different order than "
+            f"Spark; set {C.VARIABLE_FLOAT_AGG.key}=true to enable")
+
+
+_EXPR_RULES: Dict[str, Optional[Callable]] = {}
+for _n in ("BoundReference Literal Alias Add Subtract Multiply Divide "
+           "IntegralDivide Remainder Pmod UnaryMinus UnaryPositive Abs "
+           "EqualTo LessThan GreaterThan LessThanOrEqual GreaterThanOrEqual "
+           "EqualNullSafe And Or Not IsNull IsNotNull IsNaN Coalesce NaNvl "
+           "If CaseWhen In InSet BitwiseAnd BitwiseOr BitwiseXor BitwiseNot "
+           "ShiftLeft ShiftRight ShiftRightUnsigned SparkPartitionID "
+           "MonotonicallyIncreasingID Rand "
+           "Sqrt Cbrt Exp Expm1 Log Log2 Log10 Log1p Sin Cos Tan Asin Acos "
+           "Atan Sinh Cosh Tanh ToDegrees ToRadians Signum Floor Ceil Rint "
+           "Pow Atan2 "
+           "Upper Lower Length StringTrim StringTrimLeft StringTrimRight "
+           "Substring Concat "
+           "Year Month DayOfMonth DayOfWeek WeekDay DayOfYear Quarter "
+           "LastDay Hour Minute Second DateAdd DateSub DateDiff "
+           "UnixTimestamp ToUnixTimestamp FromUnixTime TimeAdd").split():
+    _EXPR_RULES[_n] = None
+_EXPR_RULES["Cast"] = _tag_cast
+_EXPR_RULES["AnsiCast"] = _tag_cast
+_EXPR_RULES["StartsWith"] = _tag_literal_pattern
+_EXPR_RULES["EndsWith"] = _tag_literal_pattern
+_EXPR_RULES["Contains"] = _tag_literal_pattern
+_EXPR_RULES["Like"] = _tag_literal_pattern
+_EXPR_RULES["StringLocate"] = None
+_EXPR_RULES["StringReplace"] = _tag_replace
+_EXPR_RULES["AggregateExpression"] = _tag_agg
+
+
+def expr_conf_key(name: str) -> str:
+    return f"spark.rapids.sql.expr.{name}"
+
+
+def exec_conf_key(name: str) -> str:
+    return f"spark.rapids.sql.exec.{name}"
+
+
+# --------------------------------------------------------------------------
+# meta tree
+# --------------------------------------------------------------------------
+
+class MetaBase:
+    def __init__(self):
+        self._reasons: List[str] = []
+
+    def will_not_work(self, reason: str):
+        if reason not in self._reasons:
+            self._reasons.append(reason)
+
+    @property
+    def can_this_run(self) -> bool:
+        return not self._reasons
+
+    @property
+    def reasons(self):
+        return list(self._reasons)
+
+
+class ExprMeta(MetaBase):
+    def __init__(self, expr: E.Expression, conf: TpuConf):
+        super().__init__()
+        self.expr = expr
+        self.conf = conf
+        self.children = [ExprMeta(c, conf) for c in expr.children]
+
+    @property
+    def name(self) -> str:
+        return type(self.expr).__name__
+
+    def tag(self):
+        for c in self.children:
+            c.tag()
+        name = self.name
+        rule = _EXPR_RULES.get(name, "missing")
+        if rule == "missing":
+            self.will_not_work(f"expression {name} is not supported on TPU")
+        else:
+            dt = self.expr.dtype
+            if dt is not NullType and dt not in SUPPORTED_TYPES:
+                self.will_not_work(f"expression {name} produces an "
+                                   f"unsupported type {dt.name}")
+            if not self.conf.is_op_enabled(expr_conf_key(name)):
+                self.will_not_work(
+                    f"expression {name} has been disabled; set "
+                    f"{expr_conf_key(name)}=true to enable")
+            if rule is not None:
+                rule(self, self.conf)
+
+    @property
+    def can_run_deep(self) -> bool:
+        return self.can_this_run and all(c.can_run_deep
+                                         for c in self.children)
+
+    def all_reasons(self) -> List[str]:
+        out = list(self._reasons)
+        for c in self.children:
+            out.extend(c.all_reasons())
+        return out
+
+
+class PlanMeta(MetaBase):
+    """Meta wrapper for one logical node."""
+
+    def __init__(self, plan: L.LogicalPlan, conf: TpuConf,
+                 session=None):
+        super().__init__()
+        self.plan = plan
+        self.conf = conf
+        self.session = session
+        self.children = [PlanMeta(c, conf, session) for c in plan.children]
+        self.expr_metas: List[ExprMeta] = []
+        self.resolved = {}     # stashed resolved expressions for conversion
+        self.on_tpu = False
+
+    @property
+    def name(self) -> str:
+        return _exec_name(self.plan)
+
+    def input_schema(self, i=0) -> Schema:
+        return plan_schema(self.children[i].plan, self.conf)
+
+    def tag_tree(self):
+        for c in self.children:
+            c.tag_tree()
+        if not self.conf.sql_enabled:
+            self.will_not_work("TPU acceleration is disabled "
+                               f"({C.SQL_ENABLED.key}=false)")
+        if not self.conf.is_op_enabled(exec_conf_key(self.name)):
+            self.will_not_work(f"exec {self.name} has been disabled; set "
+                               f"{exec_conf_key(self.name)}=true to enable")
+        try:
+            self._tag_self()
+        except AnalysisError as ex:
+            raise
+        except NotImplementedError as ex:
+            self.will_not_work(str(ex))
+        for em in self.expr_metas:
+            em.tag()
+            if not em.can_run_deep:
+                for r in em.all_reasons():
+                    self.will_not_work(r)
+        self.on_tpu = self.can_this_run
+
+    # -- per-node tagging+resolution --------------------------------------
+    def _tag_self(self):
+        from . import tagging
+        tagging.tag_node(self)
+
+    def explain(self, verbose: bool = False, indent: int = 0) -> str:
+        mark = "*" if self.on_tpu else "!"
+        line = " " * indent + f"{mark}{self.name}"
+        if not self.on_tpu:
+            why = "; ".join(self._reasons) or "child not on TPU"
+            line += f" cannot run on TPU because {why}"
+        lines = [line]
+        for c in self.children:
+            lines.append(c.explain(verbose, indent + 2))
+        return "\n".join(lines)
+
+
+def _exec_name(plan: L.LogicalPlan) -> str:
+    """Logical node -> reference exec-rule name (so conf keys match the
+    reference's per-exec kill-switches)."""
+    mapping = {
+        L.LogicalProject: "ProjectExec",
+        L.LogicalFilter: "FilterExec",
+        L.LogicalAggregate: "HashAggregateExec",
+        L.LogicalSort: "SortExec",
+        L.LogicalLimit: "CollectLimitExec",
+        L.LogicalUnion: "UnionExec",
+        L.LogicalExpand: "ExpandExec",
+        L.LogicalWindow: "WindowExec",
+        L.LogicalRepartition: "ShuffleExchangeExec",
+        L.LogicalWrite: "DataWritingCommandExec",
+        L.LogicalDistinct: "HashAggregateExec",
+    }
+    if isinstance(plan, L.LogicalScan):
+        return {"memory": "LocalTableScanExec",
+                "parquet": "FileSourceScanExec",
+                "csv": "BatchScanExec",
+                "orc": "FileSourceScanExec"}.get(plan.fmt,
+                                                 "FileSourceScanExec")
+    if isinstance(plan, L.LogicalJoin):
+        return "SortMergeJoinExec"  # pre-conversion name; see tagging
+    return mapping.get(type(plan), type(plan).__name__)
+
+
+# schema computation --------------------------------------------------------
+
+def plan_schema(plan: L.LogicalPlan, conf: TpuConf) -> Schema:
+    s = getattr(plan, "_cached_schema", None)
+    if s is None:
+        s = _compute_schema(plan, conf)
+        plan._cached_schema = s
+    return s
+
+
+def _compute_schema(plan: L.LogicalPlan, conf: TpuConf) -> Schema:
+    if isinstance(plan, L.LogicalScan):
+        return plan.schema
+    if isinstance(plan, L.LogicalProject):
+        child = plan_schema(plan.children[0], conf)
+        fields = []
+        for ce in plan.exprs:
+            ex = resolve(ce, child)
+            fields.append(StructField(ce.output_name, ex.dtype))
+        return Schema(fields)
+    if isinstance(plan, L.LogicalAggregate):
+        child = plan_schema(plan.children[0], conf)
+        fields = []
+        for ce in plan.grouping:
+            ex = resolve(ce, child)
+            fields.append(StructField(ce.output_name, ex.dtype))
+        for ce in plan.aggregates:
+            ex = resolve(ce, child)
+            fields.append(StructField(ce.output_name, ex.dtype))
+        return Schema(fields)
+    if isinstance(plan, L.LogicalJoin):
+        ls = plan_schema(plan.children[0], conf)
+        rs = plan_schema(plan.children[1], conf)
+        if plan.join_type in ("left_semi", "left_anti"):
+            return ls
+        if plan.using:
+            rfields = [f for f in rs if f.name not in plan.using]
+            return Schema(list(ls.fields) + rfields)
+        return Schema(list(ls.fields) + list(rs.fields))
+    if isinstance(plan, (L.LogicalFilter, L.LogicalSort, L.LogicalLimit,
+                         L.LogicalDistinct, L.LogicalRepartition,
+                         L.LogicalWrite)):
+        return plan_schema(plan.children[0], conf)
+    if isinstance(plan, L.LogicalUnion):
+        return plan_schema(plan.children[0], conf)
+    if isinstance(plan, L.LogicalExpand):
+        child = plan_schema(plan.children[0], conf)
+        fields = []
+        for ce in plan.projections[0]:
+            ex = resolve(ce, child)
+            fields.append(StructField(ce.output_name, ex.dtype))
+        return Schema(fields)
+    if isinstance(plan, L.LogicalWindow):
+        child = plan_schema(plan.children[0], conf)
+        fields = list(child.fields)
+        for ce in plan.window_exprs:
+            fields.append(StructField(ce.output_name, DoubleType))
+        return Schema(fields)
+    raise NotImplementedError(f"schema of {type(plan).__name__}")

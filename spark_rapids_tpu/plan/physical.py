@@ -1,0 +1,93 @@
+"""Conversion: tagged meta tree -> physical ExecNode tree.
+
+The reference's convertIfNeeded (RapidsMeta.scala) swaps supported subtrees
+to Gpu* operators; here each node independently becomes Tpu* (if tagged ok)
+or Cpu* (fallback), and transitions.py stitches the boundaries.
+"""
+from __future__ import annotations
+
+from ..exec import basic as B
+from ..exec import cpu_relational as CR
+from ..exec.base import ExecNode
+from . import logical as L
+from .overrides import PlanMeta, plan_schema
+
+
+def convert(meta: PlanMeta) -> ExecNode:
+    children = [convert(c) for c in meta.children]
+    plan = meta.plan
+    on_tpu = meta.on_tpu
+    r = meta.resolved
+
+    if isinstance(plan, L.LogicalScan):
+        return _convert_scan(meta, on_tpu)
+    if isinstance(plan, L.LogicalProject):
+        cls = B.TpuProjectExec if on_tpu else B.CpuProjectExec
+        return cls(r["exprs"], r["names"], children[0])
+    if isinstance(plan, L.LogicalFilter):
+        cls = B.TpuFilterExec if on_tpu else B.CpuFilterExec
+        return cls(r["condition"], children[0])
+    if isinstance(plan, L.LogicalAggregate):
+        if on_tpu:
+            from ..exec.aggregate import TpuHashAggregateExec
+            return TpuHashAggregateExec(r["grouping"], r["group_names"],
+                                        r["aggregates"], children[0])
+        return CR.CpuHashAggregateExec(r["grouping"], r["group_names"],
+                                       r["aggregates"], children[0])
+    if isinstance(plan, L.LogicalJoin):
+        out_schema = plan_schema(plan, meta.conf)
+        using_drop = []
+        if plan.using:
+            lw = len(plan_schema(plan.children[0], meta.conf))
+            rs = plan_schema(plan.children[1], meta.conf)
+            for name in plan.using:
+                using_drop.append(lw + rs.index_of(name))
+        if on_tpu:
+            from ..exec.join import TpuHashJoinExec
+            return TpuHashJoinExec(children[0], children[1], plan.join_type,
+                                   r["left_keys"], r["right_keys"],
+                                   r["condition"], out_schema, using_drop)
+        return CR.CpuJoinExec(children[0], children[1], plan.join_type,
+                              r["left_keys"], r["right_keys"],
+                              r["condition"], out_schema, using_drop)
+    if isinstance(plan, L.LogicalSort):
+        if on_tpu:
+            from ..exec.sort import TpuSortExec
+            return TpuSortExec(r["sort_exprs"], r["ascending"],
+                               r["nulls_first"], children[0])
+        return CR.CpuSortExec(r["sort_exprs"], r["ascending"],
+                              r["nulls_first"], children[0])
+    if isinstance(plan, L.LogicalLimit):
+        cls = B.TpuGlobalLimitExec if on_tpu else B.CpuLimitExec
+        return cls(plan.n, children[0])
+    if isinstance(plan, L.LogicalUnion):
+        all_tpu = on_tpu
+        cls = B.TpuUnionExec if all_tpu else B.CpuUnionExec
+        return cls(children)
+    if isinstance(plan, L.LogicalDistinct):
+        return CR.CpuDistinctExec(children[0])
+    if isinstance(plan, L.LogicalExpand):
+        cls = B.TpuExpandExec if on_tpu else B.CpuExpandExec
+        return cls(r["projections"], r["names"], children[0])
+    if isinstance(plan, L.LogicalRepartition):
+        if on_tpu:
+            from ..exec.exchange import make_repartition_exec
+            return make_repartition_exec(plan, r.get("keys", []), children[0],
+                                         on_tpu)
+        return CR.CpuRepartitionExec(plan.num_partitions, children[0])
+    if isinstance(plan, L.LogicalWrite):
+        from ..io.writer import make_write_exec
+        return make_write_exec(plan, children[0], on_tpu)
+    if isinstance(plan, L.LogicalWindow):
+        from ..exec.window import make_window_exec
+        return make_window_exec(meta, children[0], on_tpu)
+    raise NotImplementedError(f"convert {type(plan).__name__}")
+
+
+def _convert_scan(meta: PlanMeta, on_tpu: bool) -> ExecNode:
+    plan: L.LogicalScan = meta.plan
+    if plan.fmt == "memory":
+        cls = B.TpuScanMemoryExec if on_tpu else B.CpuScanMemoryExec
+        return cls(plan.source, plan.schema)
+    from ..io.scan import make_scan_exec
+    return make_scan_exec(plan, on_tpu, meta.conf)

@@ -1,0 +1,219 @@
+"""Per-node tagging + expression resolution (called from PlanMeta.tag_tree).
+
+Each handler resolves the node's expressions against child schemas (stashing
+results on the meta for conversion) and applies node-specific constraints,
+mirroring the reference's per-meta tagPlanForGpu methods (e.g.
+GpuHashAggregateMeta.tagPlanForGpu aggregate.scala:64-111,
+GpuHashJoin.tagJoin GpuHashJoin.scala:27-45, sort nulls-ordering checks in
+GpuSortExec.scala).
+"""
+from __future__ import annotations
+
+from .. import config as C
+from ..ops.aggregates import AggregateExpression
+from ..types import StringType
+from . import logical as L
+from .analysis import resolve
+from .overrides import ExprMeta, PlanMeta, plan_schema
+
+_TPU_JOIN_TYPES = {"inner", "left", "left_outer", "left_semi", "left_anti"}
+
+
+
+
+def _require_exec(meta: PlanMeta, module: str):
+    """Feature-gate: tag off-TPU until the device exec module lands."""
+    import importlib.util
+    if importlib.util.find_spec(f"spark_rapids_tpu.exec.{module}") is None \
+            and importlib.util.find_spec(
+                f"spark_rapids_tpu.io.{module}") is None:
+        meta.will_not_work(f"TPU {module} exec is not implemented yet")
+
+
+def tag_node(meta: PlanMeta):
+    plan = meta.plan
+    conf = meta.conf
+
+    if isinstance(plan, L.LogicalScan):
+        _tag_scan(meta)
+    elif isinstance(plan, L.LogicalProject):
+        schema = meta.input_schema()
+        exprs = [resolve(ce, schema) for ce in plan.exprs]
+        meta.resolved["exprs"] = exprs
+        meta.resolved["names"] = [ce.output_name for ce in plan.exprs]
+        meta.expr_metas = [ExprMeta(e, conf) for e in exprs]
+    elif isinstance(plan, L.LogicalFilter):
+        schema = meta.input_schema()
+        cond = resolve(plan.condition, schema)
+        meta.resolved["condition"] = cond
+        meta.expr_metas = [ExprMeta(cond, conf)]
+    elif isinstance(plan, L.LogicalAggregate):
+        _tag_aggregate(meta)
+    elif isinstance(plan, L.LogicalJoin):
+        _tag_join(meta)
+    elif isinstance(plan, L.LogicalSort):
+        _tag_sort(meta)
+    elif isinstance(plan, L.LogicalLimit):
+        pass
+    elif isinstance(plan, L.LogicalUnion):
+        pass
+    elif isinstance(plan, L.LogicalDistinct):
+        meta.will_not_work("distinct is executed as CPU fallback until the "
+                           "TPU dedup kernel lands")
+    elif isinstance(plan, L.LogicalExpand):
+        schema = meta.input_schema()
+        projections = [[resolve(ce, schema) for ce in proj]
+                       for proj in plan.projections]
+        meta.resolved["projections"] = projections
+        meta.resolved["names"] = [ce.output_name
+                                  for ce in plan.projections[0]]
+        meta.expr_metas = [ExprMeta(e, conf)
+                           for proj in projections for e in proj]
+    elif isinstance(plan, L.LogicalRepartition):
+        _require_exec(meta, "exchange")
+        schema = meta.input_schema()
+        keys = [resolve(ce, schema) for ce in plan.keys]
+        meta.resolved["keys"] = keys
+        meta.expr_metas = [ExprMeta(e, conf) for e in keys]
+    elif isinstance(plan, L.LogicalWindow):
+        meta.will_not_work("window execution is CPU fallback until the TPU "
+                           "window kernels land")
+    elif isinstance(plan, L.LogicalWrite):
+        _require_exec(meta, "writer")
+        if plan.fmt == "parquet" and not (
+                conf.get(C.PARQUET_ENABLED)
+                and conf.get(C.PARQUET_WRITE_ENABLED)):
+            meta.will_not_work("parquet writes disabled by conf")
+    else:
+        meta.will_not_work(
+            f"{type(plan).__name__} is not supported on TPU")
+
+
+def _tag_scan(meta: PlanMeta):
+    plan: L.LogicalScan = meta.plan
+    conf = meta.conf
+    if plan.fmt == "parquet":
+        if not (conf.get(C.PARQUET_ENABLED)
+                and conf.get(C.PARQUET_READ_ENABLED)):
+            meta.will_not_work(
+                f"parquet reads disabled; set {C.PARQUET_ENABLED.key}=true "
+                f"and {C.PARQUET_READ_ENABLED.key}=true")
+    elif plan.fmt == "csv":
+        if not (conf.get(C.CSV_ENABLED) and conf.get(C.CSV_READ_ENABLED)):
+            meta.will_not_work("csv reads disabled by conf")
+    elif plan.fmt == "orc":
+        if not (conf.get(C.ORC_ENABLED) and conf.get(C.ORC_READ_ENABLED)):
+            meta.will_not_work("orc reads disabled by conf")
+    for f in plan.schema:
+        from ..types import SUPPORTED_TYPES
+        if f.dtype not in SUPPORTED_TYPES:
+            meta.will_not_work(f"scan column {f.name} has unsupported type "
+                               f"{f.dtype.name}")
+
+
+def _tag_aggregate(meta: PlanMeta):
+    _require_exec(meta, "aggregate")
+    plan: L.LogicalAggregate = meta.plan
+    conf = meta.conf
+    schema = meta.input_schema()
+    grouping = [resolve(ce, schema) for ce in plan.grouping]
+    aggs = []
+    for ce in plan.aggregates:
+        ex = resolve(ce, schema)
+        if not isinstance(ex, AggregateExpression):
+            raise NotImplementedError(
+                "non-aggregate expression in agg list; wrap in first()")
+        aggs.append(ex)
+    meta.resolved["grouping"] = grouping
+    meta.resolved["group_names"] = [ce.output_name for ce in plan.grouping]
+    meta.resolved["aggregates"] = aggs
+    meta.expr_metas = [ExprMeta(e, conf) for e in grouping]
+    meta.expr_metas += [ExprMeta(e, conf) for e in aggs]
+    if conf.get(C.HAS_NANS):
+        # like the reference's hasNans gate on float agg keys
+        for g in grouping:
+            if g.dtype.is_floating and not conf.get(C.INCOMPATIBLE_OPS):
+                # we implement Spark NaN-equal grouping; allowed
+                pass
+
+
+def _tag_join(meta: PlanMeta):
+    _require_exec(meta, "join")
+    plan: L.LogicalJoin = meta.plan
+    if plan.join_type not in _TPU_JOIN_TYPES:
+        meta.will_not_work(
+            f"{plan.join_type} joins are not supported on TPU "
+            "(Inner/Left/LeftSemi/LeftAnti only, like the reference)")
+    ls = plan_schema(plan.children[0], meta.conf)
+    rs = plan_schema(plan.children[1], meta.conf)
+    lkeys, rkeys, cond = [], [], None
+    if plan.using:
+        for name in plan.using:
+            lkeys.append(resolve(L.col(name), ls))
+            rkeys.append(resolve(L.col(name), rs))
+    elif plan.condition is not None:
+        eqs, residual = _split_equi(plan.condition)
+        for lc, rc in eqs:
+            try:
+                lk = resolve(lc, ls)
+                rk = resolve(rc, rs)
+            except Exception:
+                lk = resolve(rc, ls)
+                rk = resolve(lc, rs)
+            lkeys.append(lk)
+            rkeys.append(rk)
+        if residual is not None:
+            joined = _joined_schema(ls, rs)
+            cond = resolve(residual, joined)
+            meta.expr_metas.append(ExprMeta(cond, meta.conf))
+    if not lkeys:
+        meta.will_not_work("join without equi-join keys is not supported "
+                           "on TPU (no cross/theta join)")
+    meta.resolved["left_keys"] = lkeys
+    meta.resolved["right_keys"] = rkeys
+    meta.resolved["condition"] = cond
+    meta.expr_metas += [ExprMeta(e, meta.conf) for e in lkeys + rkeys]
+
+
+def _joined_schema(ls, rs):
+    from ..types import Schema, StructField
+    names = [f.name for f in ls]
+    rfields = []
+    for f in rs:
+        nm = f.name if f.name not in names else f.name + "_r"
+        rfields.append(StructField(nm, f.dtype))
+    return Schema(list(ls.fields) + rfields)
+
+
+def _split_equi(cond):
+    """Split a join condition into equi key pairs + residual."""
+    eqs = []
+    residual = []
+
+    def walk(ce):
+        if ce.op == "And":
+            walk(ce.args[0])
+            walk(ce.args[1])
+        elif ce.op == "EqualTo":
+            eqs.append((ce.args[0], ce.args[1]))
+        else:
+            residual.append(ce)
+    walk(cond)
+    res = None
+    for r in residual:
+        res = r if res is None else (res & r)
+    return eqs, res
+
+
+def _tag_sort(meta: PlanMeta):
+    _require_exec(meta, "sort")
+    plan: L.LogicalSort = meta.plan
+    schema = meta.input_schema()
+    exprs = [resolve(o.child, schema) for o in plan.orders]
+    meta.resolved["sort_exprs"] = exprs
+    meta.resolved["ascending"] = [o.ascending for o in plan.orders]
+    meta.resolved["nulls_first"] = [o.effective_nulls_first
+                                    for o in plan.orders]
+    meta.expr_metas = [ExprMeta(e, meta.conf) for e in exprs]
+    # reference restriction: nulls ordering must match cudf defaults
+    # (GpuSortExec.scala); our lexsort handles both, no restriction needed

@@ -1,0 +1,116 @@
+"""Transition pass over the physical tree.
+
+Reference: rapids/GpuTransitionOverrides.scala — inserts host<->device
+transitions at CPU/TPU boundaries, inserts coalesce nodes per child goal,
+optimizes adjacent transitions away, and in test mode asserts the whole plan
+is on the device except an allowlist (assertIsOnTheGpu :211-254).
+
+TPU-specific extra pass: maximal chains of row-local device ops are fused
+into a single FusedPipelineExec so the per-batch work compiles to ONE XLA
+program.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .. import config as C
+from ..config import TpuConf
+from ..exec import basic as B
+from ..exec.base import CpuExec, ExecNode, TpuExec
+
+
+class PlanOnCpuError(AssertionError):
+    """Raised in test mode when something silently fell back to CPU."""
+
+
+def insert_transitions(node: ExecNode) -> ExecNode:
+    node.children = [insert_transitions(c) for c in node.children]
+    new_children = []
+    for child in node.children:
+        if isinstance(node, TpuExec) and isinstance(child, CpuExec):
+            new_children.append(B.HostToDeviceExec(child))
+        elif isinstance(node, CpuExec) and isinstance(child, TpuExec):
+            new_children.append(B.DeviceToHostExec(child))
+        else:
+            new_children.append(child)
+    node.children = new_children
+    return node
+
+
+def optimize_transitions(node: ExecNode) -> ExecNode:
+    """Remove D2H->H2D and H2D->D2H pairs (reference: optimizeGpuPlanTransitions)."""
+    node.children = [optimize_transitions(c) for c in node.children]
+    if isinstance(node, B.HostToDeviceExec) \
+            and isinstance(node.children[0], B.DeviceToHostExec):
+        return node.children[0].children[0]
+    if isinstance(node, B.DeviceToHostExec) \
+            and isinstance(node.children[0], B.HostToDeviceExec):
+        return node.children[0].children[0]
+    return node
+
+
+def insert_coalesce(node: ExecNode, conf: TpuConf) -> ExecNode:
+    """Insert TpuCoalesceBatchesExec under device ops that declare a child
+    coalesce goal (reference: insertCoalesce per childrenCoalesceGoal)."""
+    node.children = [insert_coalesce(c, conf) for c in node.children]
+    goal = getattr(node, "child_coalesce_goal", None)
+    if goal is not None and isinstance(node, TpuExec):
+        node.children = [
+            B.TpuCoalesceBatchesExec(c, goal="single"
+                                     if goal == "single" else "target")
+            if isinstance(c, TpuExec)
+            and not isinstance(c, B.TpuCoalesceBatchesExec) else c
+            for c in node.children]
+    return node
+
+
+def fuse_row_local(node: ExecNode) -> ExecNode:
+    """Collapse maximal chains of RowLocalExec into one FusedPipelineExec
+    (flattening through already-fused children so a 3+ op chain still
+    compiles to a single program)."""
+    node.children = [fuse_row_local(c) for c in node.children]
+    if isinstance(node, B.RowLocalExec):
+        chain: List[B.RowLocalExec] = []  # outermost first
+        cur: ExecNode = node
+        while isinstance(cur, B.RowLocalExec):
+            chain.append(cur)
+            cur = cur.children[0]
+        if len(chain) > 1 or any(isinstance(c, B.FusedPipelineExec)
+                                 for c in chain):
+            stages: List[B.RowLocalExec] = []  # execution order
+            for n in reversed(chain):
+                if isinstance(n, B.FusedPipelineExec):
+                    stages.extend(n.stages)
+                else:
+                    stages.append(n)
+            if len(stages) == 1:
+                return node
+            return B.FusedPipelineExec(stages, cur)
+    return node
+
+
+def assert_on_tpu(node: ExecNode, conf: TpuConf):
+    """Test-mode check (reference: GpuTransitionOverrides.assertIsOnTheGpu)."""
+    allowed = {s.strip() for s in
+               str(conf.get(C.TEST_ALLOWED_NONTPU)).split(",") if s.strip()}
+    always_ok = {"DeviceToHostExec", "HostToDeviceExec"}
+
+    def walk(n: ExecNode):
+        if isinstance(n, CpuExec) and n.name not in allowed \
+                and n.name not in always_ok:
+            raise PlanOnCpuError(
+                f"plan is not on the TPU: {n.describe()} "
+                f"(allow with {C.TEST_ALLOWED_NONTPU.key})")
+        for c in n.children:
+            walk(c)
+    walk(node)
+
+
+def finalize(node: ExecNode, conf: TpuConf) -> ExecNode:
+    node = insert_transitions(node)
+    node = optimize_transitions(node)
+    node = insert_coalesce(node, conf)
+    node = fuse_row_local(node)
+    if conf.is_test_enabled:
+        assert_on_tpu(node, conf)
+    return node

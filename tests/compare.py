@@ -1,0 +1,85 @@
+"""CPU-vs-TPU comparison harness.
+
+Mirrors the reference's universal oracle (tests/.../SparkQueryCompareTestSuite
+.scala:132-300): run the same query once with TPU acceleration enabled and
+once with spark.rapids.sql.enabled=false (pure CPU executors), then deep-
+compare row sets with float tolerance and optional sort-insensitivity.
+"""
+import math
+
+from spark_rapids_tpu.engine import TpuSession
+
+
+def run_both(build_query, conf=None, cpu_conf_extra=None):
+    tpu_conf = dict(conf or {})
+    cpu_conf = dict(conf or {})
+    cpu_conf.update(cpu_conf_extra or {})
+    cpu_conf["spark.rapids.sql.enabled"] = "false"
+    tpu = build_query(TpuSession(tpu_conf)).collect()
+    cpu = build_query(TpuSession(cpu_conf)).collect()
+    return cpu, tpu
+
+
+def normalize_row(row, approx):
+    out = []
+    for v in row:
+        if isinstance(v, float):
+            if math.isnan(v):
+                out.append("NaN")
+            elif approx:
+                out.append(round(v, 9) if abs(v) < 1e12 else v)
+            else:
+                out.append(v)
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def _sort_key(row):
+    out = []
+    for v in row:
+        if v is None:
+            out.append((0, 0, ""))
+        elif isinstance(v, float) and math.isnan(v):
+            out.append((2, 0, ""))
+        elif isinstance(v, str):
+            out.append((1, 0, v))
+        elif isinstance(v, bool):
+            out.append((1, int(v), ""))
+        elif isinstance(v, (int, float)):
+            out.append((1, v, ""))
+        else:
+            out.append((1, 0, str(v)))
+    return tuple(out)
+
+
+def assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=True):
+    assert len(cpu) == len(tpu), \
+        f"row count differs: cpu={len(cpu)} tpu={len(tpu)}\n" \
+        f"cpu={cpu[:10]}\ntpu={tpu[:10]}"
+    c = [normalize_row(r, approx_float) for r in cpu]
+    t = [normalize_row(r, approx_float) for r in tpu]
+    if ignore_order:
+        c = sorted(c, key=_sort_key)
+        t = sorted(t, key=_sort_key)
+    for i, (cr, tr) in enumerate(zip(c, t)):
+        if cr != tr:
+            ok = len(cr) == len(tr)
+            if ok:
+                for cv, tv in zip(cr, tr):
+                    if isinstance(cv, float) and isinstance(tv, float):
+                        if not math.isclose(cv, tv, rel_tol=1e-9,
+                                            abs_tol=1e-9):
+                            ok = False
+                            break
+                    elif cv != tv:
+                        ok = False
+                        break
+            assert ok, f"row {i} differs:\n  cpu={cr}\n  tpu={tr}"
+
+
+def assert_tpu_and_cpu_are_equal(build_query, conf=None, ignore_order=True,
+                                 approx_float=True):
+    cpu, tpu = run_both(build_query, conf)
+    assert_rows_equal(cpu, tpu, ignore_order, approx_float)
+    return cpu

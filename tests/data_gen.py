@@ -1,0 +1,82 @@
+"""Typed random data generators with special-case injection.
+
+Mirrors integration_tests/src/main/python/data_gen.py from the reference:
+every generator seeds deterministically and injects the nasty corner values
+(None, NaN, +-0.0, min/max, empty strings) at a fixed ratio.
+"""
+import random
+import string
+
+from spark_rapids_tpu import types as T
+
+SPECIALS = {
+    T.IntegerType: [None, 0, 1, -1, 2**31 - 1, -(2**31)],
+    T.LongType: [None, 0, 1, -1, 2**63 - 1, -(2**63)],
+    T.ShortType: [None, 0, -1, 2**15 - 1, -(2**15)],
+    T.ByteType: [None, 0, -1, 127, -128],
+    T.DoubleType: [None, 0.0, -0.0, 1.0, -1.0, float("nan"), float("inf"),
+                   float("-inf"), 1e300, -1e300, 5e-324],
+    T.FloatType: [None, 0.0, -0.0, float("nan"), float("inf"), 3.4e38],
+    T.BooleanType: [None, True, False],
+    T.StringType: [None, "", " ", "a", "A", "0", "nan", "null",
+                   "\tx ", "longer string value"],
+    # keep |days| within python datetime range with slack for date arithmetic
+    T.DateType: [None, 0, -1, 18262, -719000, 2932800],
+    T.TimestampType: [None, 0, -1, 1_600_000_000_000_000,
+                      -62_135_596_800_000_000],
+}
+
+
+def gen_value(rng: random.Random, dtype, nullable=True):
+    specials = SPECIALS[dtype]
+    if rng.random() < 0.15:
+        v = rng.choice(specials)
+        if v is None and not nullable:
+            return _random_value(rng, dtype)
+        return v
+    return _random_value(rng, dtype)
+
+
+def _random_value(rng, dtype):
+    if dtype is T.IntegerType:
+        return rng.randint(-(2**31), 2**31 - 1)
+    if dtype is T.LongType:
+        return rng.randint(-(2**63), 2**63 - 1)
+    if dtype is T.ShortType:
+        return rng.randint(-(2**15), 2**15 - 1)
+    if dtype is T.ByteType:
+        return rng.randint(-128, 127)
+    if dtype is T.DoubleType:
+        return rng.uniform(-1e6, 1e6)
+    if dtype is T.FloatType:
+        import struct
+        return struct.unpack("f", struct.pack("f",
+                                              rng.uniform(-1e6, 1e6)))[0]
+    if dtype is T.BooleanType:
+        return rng.random() < 0.5
+    if dtype is T.StringType:
+        n = rng.randint(0, 20)
+        return "".join(rng.choice(string.ascii_letters + string.digits + " _")
+                       for _ in range(n))
+    if dtype is T.DateType:
+        return rng.randint(-100_000, 100_000)
+    if dtype is T.TimestampType:
+        return rng.randint(-10**15, 4 * 10**15)
+    raise TypeError(dtype)
+
+
+def gen_table(seed: int, n: int, **cols):
+    """cols: name=dtype (or name=(dtype, nullable)).  Returns dict + Schema."""
+    rng = random.Random(seed)
+    data = {}
+    fields = []
+    for name, spec in cols.items():
+        dtype, nullable = spec if isinstance(spec, tuple) else (spec, True)
+        data[name] = [gen_value(rng, dtype, nullable) for _ in range(n)]
+        fields.append(T.StructField(name, dtype, nullable))
+    return data, T.Schema(fields)
+
+
+def gen_df(session, seed: int, n: int, **cols):
+    data, schema = gen_table(seed, n, **cols)
+    return session.from_pydict(data, schema)

@@ -1,0 +1,267 @@
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import ColumnarBatch
+from spark_rapids_tpu.ops import expressions as E
+from spark_rapids_tpu.ops import math as M
+from spark_rapids_tpu.ops.cast import Cast
+
+
+def make_batch(**cols):
+    """Infer schema from kwargs: name=(values, dtype)."""
+    schema = T.Schema([T.StructField(k, dt) for k, (_, dt) in cols.items()])
+    return ColumnarBatch.from_pydict({k: v for k, (v, _) in cols.items()},
+                                     schema)
+
+
+def evaluate(expr, batch):
+    col = expr.eval(batch)
+    n = batch.num_rows_host()
+    return col.to_pylist(n)
+
+
+def ref(i, batch, name):
+    idx = batch.schema.index_of(name)
+    return E.BoundReference(idx, batch.schema[idx].dtype, name)
+
+
+def test_add_null_propagation():
+    b = make_batch(a=([1, None, 3], T.IntegerType), c=([10, 20, None],
+                                                       T.IntegerType))
+    out = evaluate(E.Add(ref(0, b, "a"), ref(1, b, "c")), b)
+    assert out == [11, None, None]
+
+
+def test_promotion_int_float():
+    b = make_batch(a=([1, 2], T.IntegerType), f=([0.5, 1.5], T.FloatType))
+    e = E.Add(ref(0, b, "a"), ref(1, b, "f"))
+    assert e.dtype is T.FloatType
+    assert evaluate(e, b) == [1.5, 3.5]
+    # long + float -> double like Spark
+    b2 = make_batch(a=([1], T.LongType), f=([0.5], T.FloatType))
+    assert E.Add(ref(0, b2, "a"), ref(1, b2, "f")).dtype is T.DoubleType
+
+
+def test_divide_by_zero_is_null():
+    b = make_batch(a=([10, 10, None], T.IntegerType),
+                   d=([2, 0, 2], T.IntegerType))
+    assert evaluate(E.Divide(ref(0, b, "a"), ref(1, b, "d")), b) == \
+        [5.0, None, None]
+    assert evaluate(E.IntegralDivide(ref(0, b, "a"), ref(1, b, "d")), b) == \
+        [5, None, None]
+    assert evaluate(E.Remainder(ref(0, b, "a"), ref(1, b, "d")), b) == \
+        [0, None, None]
+
+
+def test_remainder_sign_follows_dividend():
+    b = make_batch(a=([-7, 7, -7], T.IntegerType), d=([3, -3, -3],
+                                                      T.IntegerType))
+    assert evaluate(E.Remainder(ref(0, b, "a"), ref(1, b, "d")), b) == \
+        [-1, 1, -1]
+    # Spark pmod: r = a % n (sign of dividend); if r < 0 then (r + n) % n
+    assert evaluate(E.Pmod(ref(0, b, "a"), ref(1, b, "d")), b) == [2, 1, -1]
+
+
+def test_kleene_and_or():
+    b = make_batch(x=([True, True, True, False, False, None, None],
+                      T.BooleanType),
+                   y=([True, False, None, False, None, False, None],
+                      T.BooleanType))
+    x, y = ref(0, b, "x"), ref(1, b, "y")
+    assert evaluate(E.And(x, y), b) == [True, False, None, False, False,
+                                        False, None]
+    assert evaluate(E.Or(x, y), b) == [True, True, True, False, None,
+                                       None, None]
+
+
+def test_comparisons_nan_and_negzero():
+    b = make_batch(x=([float("nan"), 0.0, 1.0], T.DoubleType),
+                   y=([float("nan"), -0.0, float("nan")], T.DoubleType))
+    x, y = ref(0, b, "x"), ref(1, b, "y")
+    # Spark: NaN == NaN, -0.0 == 0.0, NaN is greatest
+    assert evaluate(E.EqualTo(x, y), b) == [True, True, False]
+    assert evaluate(E.LessThan(x, y), b) == [False, False, True]
+    assert evaluate(E.GreaterThanOrEqual(x, y), b) == [True, True, False]
+
+
+def test_equal_null_safe():
+    b = make_batch(x=([1, None, None], T.IntegerType),
+                   y=([1, 1, None], T.IntegerType))
+    assert evaluate(E.EqualNullSafe(ref(0, b, "x"), ref(1, b, "y")), b) == \
+        [True, False, True]
+
+
+def test_null_predicates_and_coalesce():
+    b = make_batch(x=([1, None, 3], T.IntegerType),
+                   y=([None, 20, 30], T.IntegerType))
+    x, y = ref(0, b, "x"), ref(1, b, "y")
+    assert evaluate(E.IsNull(x), b) == [False, True, False]
+    assert evaluate(E.IsNotNull(x), b) == [True, False, True]
+    assert evaluate(E.Coalesce(x, y), b) == [1, 20, 3]
+    assert evaluate(E.Coalesce(x, E.Literal(99)), b) == [1, 99, 3]
+
+
+def test_if_and_case_when():
+    b = make_batch(x=([1, 5, None], T.IntegerType))
+    x = ref(0, b, "x")
+    pred = E.GreaterThan(x, E.Literal(2))
+    out = evaluate(E.If(pred, E.Literal(100), x), b)
+    assert out == [1, 100, None]
+    cw = E.CaseWhen([(E.EqualTo(x, E.Literal(1)), E.Literal(10)),
+                     (E.EqualTo(x, E.Literal(5)), E.Literal(50))],
+                    E.Literal(0))
+    assert evaluate(cw, b) == [10, 50, 0]
+
+
+def test_in():
+    b = make_batch(x=([1, 2, 3, None], T.IntegerType))
+    assert evaluate(E.In(ref(0, b, "x"), [1, 3]), b) == \
+        [True, False, True, None]
+    # null in list: non-matches become null
+    assert evaluate(E.In(ref(0, b, "x"), [1, None]), b) == \
+        [True, None, None, None]
+
+
+def test_in_strings():
+    b = make_batch(s=(["a", "bb", None], T.StringType))
+    assert evaluate(E.In(ref(0, b, "s"), ["bb", "c"]), b) == \
+        [False, True, None]
+
+
+def test_math_log_null_for_nonpositive():
+    b = make_batch(x=([math.e, 0.0, -1.0], T.DoubleType))
+    out = evaluate(M.Log(ref(0, b, "x")), b)
+    assert out[0] == pytest.approx(1.0)
+    assert out[1] is None and out[2] is None
+
+
+def test_math_funcs():
+    b = make_batch(x=([4.0, 9.0], T.DoubleType))
+    x = ref(0, b, "x")
+    assert evaluate(M.Sqrt(x), b) == [2.0, 3.0]
+    assert evaluate(M.Pow(x, E.Literal(2.0)), b) == pytest.approx([16.0, 81.0])
+    assert evaluate(M.Floor(E.Divide(x, E.Literal(2.0))), b) == [2, 4]
+    assert evaluate(M.Ceil(E.Divide(x, E.Literal(2.0))), b) == [2, 5]
+
+
+def test_bitwise_and_shifts():
+    b = make_batch(x=([0b1100, -8], T.IntegerType), y=([0b1010, 2],
+                                                       T.IntegerType))
+    x, y = ref(0, b, "x"), ref(1, b, "y")
+    assert evaluate(E.BitwiseAnd(x, y), b) == [0b1000, -8 & 2]
+    assert evaluate(E.BitwiseOr(x, y), b) == [0b1110, -8 | 2]
+    assert evaluate(E.ShiftLeft(x, E.Literal(1)), b) == [0b11000, -16]
+    assert evaluate(E.ShiftRight(x, E.Literal(1)), b) == [0b110, -4]
+    assert evaluate(E.ShiftRightUnsigned(x, E.Literal(1)), b) == \
+        [0b110, (-8 & 0xFFFFFFFF) >> 1]
+
+
+# ---- casts ----------------------------------------------------------------
+
+def test_cast_numeric():
+    b = make_batch(x=([1.9, -1.9, float("nan"), 1e300], T.DoubleType))
+    x = ref(0, b, "x")
+    assert evaluate(Cast(x, T.IntegerType), b) == [1, -1, 0, 2**31 - 1]
+    assert evaluate(Cast(x, T.LongType), b) == [1, -1, 0, 2**63 - 1]
+    b2 = make_batch(x=([300], T.IntegerType))
+    assert evaluate(Cast(ref(0, b2, "x"), T.ByteType), b2) == [300 - 256]
+
+
+def test_cast_bool():
+    b = make_batch(x=([0, 1, 5], T.IntegerType))
+    assert evaluate(Cast(ref(0, b, "x"), T.BooleanType), b) == \
+        [False, True, True]
+
+
+def test_cast_string_to_int():
+    b = make_batch(s=(["123", "-45", "+7", "9x", "", None,
+                       "99999999999999999999"], T.StringType))
+    out = evaluate(Cast(ref(0, b, "s"), T.IntegerType), b)
+    assert out == [123, -45, 7, None, None, None, None]
+
+
+def test_cast_string_to_long_boundaries():
+    b = make_batch(s=(["9223372036854775807", "-9223372036854775808"],
+                      T.StringType))
+    assert evaluate(Cast(ref(0, b, "s"), T.LongType), b) == \
+        [2**63 - 1, -(2**63)]
+
+
+def test_cast_string_to_double():
+    b = make_batch(s=(["1.5", "-2.25e2", "1e-2", ".5", "3.", "abc", "1e",
+                       None], T.StringType))
+    out = evaluate(Cast(ref(0, b, "s"), T.DoubleType), b)
+    assert out[0] == 1.5
+    assert out[1] == -225.0
+    assert out[2] == pytest.approx(0.01)
+    assert out[3] == 0.5
+    assert out[4] == 3.0
+    assert out[5] is None and out[6] is None and out[7] is None
+
+
+def test_cast_int_to_string():
+    b = make_batch(x=([0, 7, -123, 2**62], T.LongType))
+    assert evaluate(Cast(ref(0, b, "x"), T.StringType), b) == \
+        ["0", "7", "-123", str(2**62)]
+
+
+def test_cast_bool_string_roundtrip():
+    b = make_batch(s=(["true", "FALSE", "y", "0", "zz"], T.StringType))
+    assert evaluate(Cast(ref(0, b, "s"), T.BooleanType), b) == \
+        [True, False, True, False, None]
+    b2 = make_batch(x=([True, False], T.BooleanType))
+    assert evaluate(Cast(ref(0, b2, "x"), T.StringType), b2) == \
+        ["true", "false"]
+
+
+def test_cast_date_string_roundtrip():
+    import datetime
+    b = make_batch(s=(["2020-02-29", "1969-12-31", "2020-13-01", "2019-02-29",
+                       "20-1-1", None], T.StringType))
+    out = evaluate(Cast(ref(0, b, "s"), T.DateType), b)
+    epoch = datetime.date(1970, 1, 1)
+    assert out[0] == (datetime.date(2020, 2, 29) - epoch).days
+    assert out[1] == -1
+    assert out[2] is None and out[3] is None and out[5] is None
+    # format back
+    b2 = make_batch(d=([out[0], out[1]], T.DateType))
+    assert evaluate(Cast(ref(0, b2, "d"), T.StringType), b2) == \
+        ["2020-02-29", "1969-12-31"]
+
+
+def test_cast_timestamp():
+    b = make_batch(t=([1_600_000_000_000_000], T.TimestampType))
+    t = ref(0, b, "t")
+    assert evaluate(Cast(t, T.LongType), b) == [1_600_000_000]
+    assert evaluate(Cast(t, T.StringType), b) == ["2020-09-13 12:26:40"]
+    assert evaluate(Cast(t, T.DateType), b) == [1_600_000_000 // 86400]
+    b2 = make_batch(s=(["2020-09-13 12:26:40", "2020-09-13", "bogus"],
+                       T.StringType))
+    out = evaluate(Cast(ref(0, b2, "s"), T.TimestampType), b2)
+    assert out[0] == 1_600_000_000_000_000
+    assert out[1] == (1_600_000_000 // 86400) * 86400 * 1_000_000
+    assert out[2] is None
+
+
+def test_date_to_timestamp():
+    b = make_batch(d=([18519], T.DateType))
+    assert evaluate(Cast(ref(0, b, "d"), T.TimestampType), b) == \
+        [18519 * 86400 * 1_000_000]
+
+
+def test_literals_and_alias():
+    b = make_batch(x=([1, 2], T.IntegerType))
+    assert evaluate(E.Literal(5), b) == [5, 5]
+    assert evaluate(E.Literal(None, T.IntegerType), b) == [None, None]
+    assert evaluate(E.Literal("hi"), b) == ["hi", "hi"]
+    assert evaluate(E.Alias(E.Literal(1), "one"), b) == [1, 1]
+
+
+def test_monotonic_id_and_partition_id():
+    b = make_batch(x=([1, 2, 3], T.IntegerType))
+    assert evaluate(E.SparkPartitionID(2), b) == [2, 2, 2]
+    out = evaluate(E.MonotonicallyIncreasingID(1), b)
+    assert out == [(1 << 33), (1 << 33) + 1, (1 << 33) + 2]
