@@ -1,0 +1,498 @@
+"""TPU hash aggregate.
+
+Reference behavior: rapids/aggregate.scala — streaming per-partition loop
+(per batch: update-aggregate; across batches: concat running state and
+merge-aggregate; finally: finalize projection), Partial/Final phases bound
+separately (setupReferences :585).
+
+TPU-first implementation: no hash table.  Scatter is slow on TPU, so
+grouping is SORT-based with static shapes:
+
+  1. hash keys twice (64-bit each), stable-sort rows by (h1, h2) — dead
+     rows get max hash and fall to the back;
+  2. group boundary = hash changed OR any key column differs from the
+     previous sorted row (hash collisions cannot create wrong groups unless
+     BOTH 64-bit hashes collide AND rows interleave);
+  3. group id = prefix-sum of boundaries; segment reductions with
+     indices_are_sorted=True (XLA lowers these without scatter);
+  4. output keys gathered from each group's first row; output capacity =
+     input capacity, live rows = number of groups.
+
+Multi-batch streams fold through the same kernel: the running state batch is
+concatenated with each new partial result and re-grouped (merge aggregates),
+exactly the reference's concatenateBatches + merge pass.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, ColumnarBatch, concat_batches
+from ..ops import expressions as E
+from ..ops.aggregates import AggregateExpression
+from ..ops.hashing import hash_columns_double
+from ..types import (DoubleType, LongType, Schema, StructField)
+from .base import ExecContext, ExecNode, TpuExec
+
+_I64_MAX = np.int64(2**63 - 1)
+_I64_MIN = np.int64(-(2**63))
+
+
+def group_rows(key_cols: Sequence[Column], live):
+    """-> (order, gid_sorted, boundary_sorted, num_groups).
+
+    order: stable permutation putting equal keys adjacent, dead rows last.
+    gid_sorted[i]: group id of sorted position i (garbage for dead rows).
+    """
+    cap = live.shape[0]
+    if not key_cols:
+        order = jnp.arange(cap, dtype=jnp.int32)
+        gid = jnp.zeros(cap, dtype=jnp.int32)
+        boundary = jnp.zeros(cap, dtype=jnp.bool_)
+        return order, gid, boundary, jnp.minimum(jnp.sum(live), 1)
+    h1, h2 = hash_columns_double(key_cols, live)
+    # stable lexsort: primary h1, secondary h2, tertiary original index
+    order = jnp.lexsort((h2, h1)).astype(jnp.int32)
+    live_s = jnp.take(live, order)
+    h1s = jnp.take(h1, order)
+    h2s = jnp.take(h2, order)
+    differs = (h1s != _shift1(h1s)) | (h2s != _shift1(h2s))
+    for c in key_cols:
+        cs = c.take(order)
+        differs = differs | _col_differs_from_prev(cs)
+    boundary = live_s & differs
+    boundary = boundary.at[0].set(live_s[0])
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    return order, gid, boundary, num_groups
+
+
+def _shift1(x):
+    """x shifted down by one position (x[i-1]); position 0 gets x[0]."""
+    return jnp.roll(x, 1)
+
+
+def _col_differs_from_prev(c: Column):
+    """Row i differs from row i-1 (null-aware, Spark key equality: nulls
+    equal, NaN equal, -0.0 == 0.0 — the hash normalizes floats, and direct
+    bit compare after the same normalization keeps it consistent)."""
+    from ..ops.hashing import _normalize_bits
+    vprev = _shift1(c.valid)
+    both_null = (~c.valid) & (~vprev)
+    valid_mismatch = c.valid != vprev
+    if c.dtype.is_string:
+        data_diff = jnp.any(c.data != _shift1_rows(c.data), axis=1) \
+            | (c.lengths != _shift1(c.lengths))
+    else:
+        bits = _normalize_bits(c)
+        data_diff = bits != _shift1(bits)
+    return jnp.where(both_null, False,
+                     jnp.where(valid_mismatch, True,
+                               jnp.where(c.valid, data_diff, False)))
+
+
+def _shift1_rows(m):
+    return jnp.roll(m, 1, axis=0)
+
+
+# --------------------------------------------------------------------------
+# segment reducers (sorted ids, masked)
+# --------------------------------------------------------------------------
+
+def _seg_sum(vals, gid, contribute, cap):
+    v = jnp.where(contribute, vals, jnp.zeros((), vals.dtype))
+    return jax.ops.segment_sum(v, gid, num_segments=cap,
+                               indices_are_sorted=True)
+
+
+def _seg_min(vals, gid, contribute, cap, fill):
+    v = jnp.where(contribute, vals, fill)
+    return jax.ops.segment_min(v, gid, num_segments=cap,
+                               indices_are_sorted=True)
+
+
+def _seg_max(vals, gid, contribute, cap, fill):
+    v = jnp.where(contribute, vals, fill)
+    return jax.ops.segment_max(v, gid, num_segments=cap,
+                               indices_are_sorted=True)
+
+
+class _AggState:
+    """Internal state layout per aggregate: list of (field_suffix, dtype)."""
+
+    @staticmethod
+    def fields(agg: AggregateExpression):
+        f = agg.func
+        if f == "Count":
+            return [("count", LongType)]
+        if f == "Average":
+            return [("sum", DoubleType), ("count", LongType)]
+        if f == "Sum":
+            return [("sum", agg.dtype)]
+        if f in ("Min", "Max"):
+            return [(f.lower(), agg.child.dtype)]
+        if f in ("First", "Last"):
+            return [("val", agg.child.dtype), ("pos", LongType)]
+        raise NotImplementedError(f)
+
+
+def _update_one(agg: AggregateExpression, col, gid, live_s, cap):
+    """Compute state columns for one aggregate from sorted input values."""
+    f = agg.func
+    if f == "Count":
+        if col is None:  # count(*)
+            contribute = live_s
+        else:
+            contribute = live_s & col.valid
+        cnt = _seg_sum(contribute.astype(jnp.int64), gid, live_s, cap)
+        return [Column(cnt, jnp.ones(cap, jnp.bool_), LongType)]
+    vals, valid = col.data, col.valid
+    contribute = live_s & valid
+    if f in ("Sum", "Average"):
+        out_t = DoubleType if f == "Average" else agg.dtype
+        v = vals.astype(out_t.jnp_dtype)
+        s = _seg_sum(v, gid, contribute, cap)
+        nvalid = _seg_sum(contribute.astype(jnp.int64), gid, live_s, cap)
+        sum_col = Column(s, nvalid > 0, out_t).mask_invalid()
+        if f == "Sum":
+            return [sum_col]
+        return [sum_col, Column(nvalid, jnp.ones(cap, jnp.bool_), LongType)]
+    if f in ("Min", "Max"):
+        return [_minmax(f, agg.child.dtype, vals, gid, contribute, cap)]
+    raise NotImplementedError(f)
+
+
+def _minmax(f, dtype, vals, gid, contribute, cap):
+    if dtype.is_floating:
+        v = vals.astype(jnp.float64)
+        isnan = jnp.isnan(v)
+        has_nan = _seg_max((contribute & isnan).astype(jnp.int32), gid,
+                           jnp.ones_like(contribute), cap,
+                           jnp.int32(0)) > 0
+        nvalid = _seg_sum(contribute.astype(jnp.int64), gid,
+                          jnp.ones_like(contribute), cap)
+        if f == "Min":
+            r = _seg_min(jnp.where(isnan, jnp.inf, v), gid, contribute, cap,
+                         jnp.float64(np.inf))
+            # NaN only wins min when the group has NO non-NaN values
+            # (min(+inf, NaN) is +inf: NaN is greatest)
+            n_non_nan = _seg_sum((contribute & ~isnan).astype(jnp.int32),
+                                 gid, jnp.ones_like(contribute), cap)
+            only_nan = has_nan & (n_non_nan == 0)
+            r = jnp.where(only_nan, jnp.nan, r)
+        else:
+            r = _seg_max(jnp.where(isnan, -jnp.inf, v), gid, contribute, cap,
+                         jnp.float64(-np.inf))
+            r = jnp.where(has_nan, jnp.nan, r)  # NaN is greatest
+        out = r.astype(dtype.jnp_dtype)
+        return Column(out, nvalid > 0, dtype).mask_invalid()
+    v = vals.astype(jnp.int64)
+    nvalid = _seg_sum(contribute.astype(jnp.int64), gid,
+                      jnp.ones_like(contribute), cap)
+    if f == "Min":
+        r = _seg_min(v, gid, contribute, cap, jnp.int64(_I64_MAX))
+    else:
+        r = _seg_max(v, gid, contribute, cap, jnp.int64(_I64_MIN))
+    return Column(r.astype(dtype.jnp_dtype), nvalid > 0, dtype) \
+        .mask_invalid()
+
+
+class TpuHashAggregateExec(TpuExec):
+    coalesce_after = True
+
+    def __init__(self, grouping: Sequence[E.Expression],
+                 group_names: Sequence[str],
+                 aggregates: Sequence[AggregateExpression], child: ExecNode):
+        super().__init__(child)
+        self.grouping = list(grouping)
+        self.group_names = list(group_names)
+        self.aggregates = list(aggregates)
+        fields = [StructField(n, g.dtype)
+                  for n, g in zip(group_names, grouping)]
+        fields += [StructField(a.output_name or a.func.lower(), a.dtype)
+                   for a in self.aggregates]
+        self._schema = Schema(fields)
+        self._state_schema = self._make_state_schema()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        gs = ", ".join(map(repr, self.grouping))
+        ags = ", ".join(map(repr, self.aggregates))
+        return f"TpuHashAggregateExec[keys=[{gs}] aggs=[{ags}]]"
+
+    def _make_state_schema(self) -> Schema:
+        fields = [StructField(f"_k{i}", g.dtype)
+                  for i, g in enumerate(self.grouping)]
+        for ai, a in enumerate(self.aggregates):
+            for suffix, dt in _AggState.fields(a):
+                fields.append(StructField(f"_a{ai}_{suffix}", dt))
+        return Schema(fields)
+
+    # ---- per-batch kernels (jitted) ---------------------------------------
+
+    def _update_kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """input batch -> state batch (update aggregation)."""
+        cap = batch.capacity
+        keys = [g.eval(batch) for g in self.grouping]
+        live = batch.sel
+        order, gid, boundary, ngroups = group_rows(keys, live)
+        live_s = jnp.take(live, order)
+        gid = jnp.where(live_s, gid, cap - 1)
+
+        state_cols: List[Column] = []
+        # group keys: first row of each group (the boundary rows, compacted)
+        first_pos = _seg_min(jnp.arange(cap, dtype=jnp.int64), gid,
+                             live_s, cap, jnp.int64(_I64_MAX))
+        first_idx = jnp.take(order,
+                             jnp.clip(first_pos, 0, cap - 1).astype(jnp.int32))
+        for k in keys:
+            state_cols.append(k.take(first_idx))
+        for a in self.aggregates:
+            col = a.child.eval(batch) if a.child is not None else None
+            scol = col.take(order) if col is not None else None
+            f = a.func
+            if f in ("First", "Last"):
+                # first/last over live rows INCLUDING null values (Spark
+                # ignoreNulls=false default).  Position = rank among LIVE
+                # rows in original order (the driver advances the offset by
+                # live-row count, so raw indices of non-compacted batches
+                # would break cross-batch ordering) + partition row offset.
+                rank_orig = jnp.cumsum(live.astype(jnp.int64)) - 1
+                pos = jnp.take(rank_orig, order)
+                if f == "First":
+                    best = _seg_min(pos, gid, live_s, cap,
+                                    jnp.int64(_I64_MAX))
+                else:
+                    best = _seg_max(pos, gid, live_s, cap, jnp.int64(-1))
+                # original index of the winning row: sorted position whose
+                # pos equals the group's best
+                is_best = live_s & (pos == jnp.take(best,
+                                                    jnp.clip(gid, 0,
+                                                             cap - 1)))
+                rowpos = jnp.arange(cap, dtype=jnp.int64)
+                win_sorted = _seg_min(jnp.where(is_best, rowpos, _I64_MAX),
+                                      gid, live_s, cap, jnp.int64(_I64_MAX))
+                widx = jnp.take(
+                    order, jnp.clip(win_sorted, 0, cap - 1).astype(jnp.int32))
+                state_cols.append(col.take(widx))
+                gpos = best + E.current_row_offset()
+                state_cols.append(Column(gpos, jnp.ones(cap, jnp.bool_),
+                                         LongType))
+            else:
+                state_cols.extend(_update_one(a, scol, gid, live_s, cap))
+        sel = jnp.arange(cap, dtype=jnp.int32) < ngroups
+        # zero out dead state rows
+        state_cols = [c.with_valid(c.valid & sel).mask_invalid()
+                      if not c.dtype.is_string else c for c in state_cols]
+        return ColumnarBatch(state_cols, sel, self._state_schema)
+
+    def _merge_kernel(self, state: ColumnarBatch) -> ColumnarBatch:
+        """state batch (concat of partials) -> merged state batch."""
+        cap = state.capacity
+        nkeys = len(self.grouping)
+        keys = list(state.columns[:nkeys])
+        live = state.sel
+        order, gid, boundary, ngroups = group_rows(keys, live)
+        live_s = jnp.take(live, order)
+        gid = jnp.where(live_s, gid, cap - 1)
+        out_cols: List[Column] = []
+        first_pos = _seg_min(jnp.arange(cap, dtype=jnp.int64), gid,
+                             live_s, cap, jnp.int64(_I64_MAX))
+        first_idx = jnp.take(order,
+                             jnp.clip(first_pos, 0, cap - 1).astype(jnp.int32))
+        for k in keys:
+            out_cols.append(k.take(first_idx))
+        ci = nkeys
+        for a in self.aggregates:
+            f = a.func
+            nfields = len(_AggState.fields(a))
+            cols = state.columns[ci:ci + nfields]
+            ci += nfields
+            if f == "Count":
+                scol = cols[0].take(order)
+                s = _seg_sum(scol.data, gid, live_s & scol.valid, cap)
+                out_cols.append(Column(s, jnp.ones(cap, jnp.bool_),
+                                       LongType))
+            elif f == "Sum":
+                scol = cols[0].take(order)
+                contribute = live_s & scol.valid
+                s = _seg_sum(scol.data, gid, contribute, cap)
+                nvalid = _seg_sum(contribute.astype(jnp.int64), gid, live_s,
+                                  cap)
+                out_cols.append(Column(s, nvalid > 0, cols[0].dtype)
+                                .mask_invalid())
+            elif f == "Average":
+                scol = cols[0].take(order)
+                ccol = cols[1].take(order)
+                contribute = live_s & scol.valid
+                s = _seg_sum(scol.data, gid, contribute, cap)
+                n = _seg_sum(ccol.data, gid, live_s & ccol.valid, cap)
+                out_cols.append(Column(s, n > 0, DoubleType).mask_invalid())
+                out_cols.append(Column(n, jnp.ones(cap, jnp.bool_),
+                                       LongType))
+            elif f in ("Min", "Max"):
+                scol = cols[0].take(order)
+                contribute = live_s & scol.valid
+                out_cols.append(_minmax(f, scol.dtype, scol.data, gid,
+                                        contribute, cap))
+            elif f in ("First", "Last"):
+                vcol = cols[0].take(order)
+                pcol = cols[1].take(order)
+                if f == "First":
+                    best = _seg_min(pcol.data, gid, live_s, cap,
+                                    jnp.int64(_I64_MAX))
+                else:
+                    best = _seg_max(pcol.data, gid, live_s, cap,
+                                    jnp.int64(-1))
+                is_best = live_s & (pcol.data == jnp.take(best, gid))
+                # position of the winning row in sorted order
+                rowpos = jnp.arange(cap, dtype=jnp.int64)
+                win = _seg_min(jnp.where(is_best, rowpos, _I64_MAX), gid,
+                               live_s, cap, jnp.int64(_I64_MAX))
+                widx = jnp.clip(win, 0, cap - 1).astype(jnp.int32)
+                out_cols.append(vcol.take(widx))
+                out_cols.append(Column(best, jnp.ones(cap, jnp.bool_),
+                                       LongType))
+            else:
+                raise NotImplementedError(f)
+        sel = jnp.arange(cap, dtype=jnp.int32) < ngroups
+        out_cols = [c.with_valid(c.valid & sel).mask_invalid()
+                    if not c.dtype.is_string else c for c in out_cols]
+        return ColumnarBatch(out_cols, sel, self._state_schema)
+
+    def _finalize_kernel(self, state: ColumnarBatch) -> ColumnarBatch:
+        nkeys = len(self.grouping)
+        out_cols = list(state.columns[:nkeys])
+        ci = nkeys
+        for a in self.aggregates:
+            nfields = len(_AggState.fields(a))
+            cols = state.columns[ci:ci + nfields]
+            ci += nfields
+            if a.func == "Average":
+                s, n = cols[0], cols[1]
+                nz = n.data > 0
+                avg = s.data / jnp.where(nz, n.data, 1).astype(jnp.float64)
+                out_cols.append(Column(avg, s.valid & nz, DoubleType)
+                                .mask_invalid())
+            elif a.func in ("First", "Last"):
+                out_cols.append(cols[0])
+            else:
+                c = cols[0]
+                if c.dtype is not a.dtype and not c.dtype.is_string:
+                    c = Column(c.data.astype(a.dtype.jnp_dtype), c.valid,
+                               a.dtype)
+                out_cols.append(c)
+        return ColumnarBatch(out_cols, state.sel, self._schema)
+
+    # ---- ungrouped fast path ----------------------------------------------
+
+    def _global_kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """No grouping keys: masked whole-batch reductions to a 1-row state."""
+        live = batch.sel
+        cap = 8  # tiny static output
+        cols: List[Column] = []
+        for a in self.aggregates:
+            col = a.child.eval(batch) if a.child is not None else None
+            f = a.func
+            if f == "Count":
+                contribute = live if col is None else live & col.valid
+                v = jnp.sum(contribute.astype(jnp.int64))
+                cols.append(_scalar_col(v, True, LongType, cap))
+                continue
+            contribute = live & col.valid
+            nvalid = jnp.sum(contribute.astype(jnp.int64))
+            if f in ("Sum", "Average"):
+                out_t = DoubleType if f == "Average" else a.dtype
+                v = jnp.sum(jnp.where(contribute,
+                                      col.data.astype(out_t.jnp_dtype),
+                                      jnp.zeros((), out_t.jnp_dtype)))
+                cols.append(_scalar_col(v, nvalid > 0, out_t, cap))
+                if f == "Average":
+                    cols.append(_scalar_col(nvalid, True, LongType, cap))
+            elif f in ("Min", "Max"):
+                mm = _minmax(f, col.dtype, col.data,
+                             jnp.zeros(batch.capacity, jnp.int32),
+                             contribute, 1)
+                cols.append(_scalar_col(mm.data[0], mm.valid[0], col.dtype,
+                                        cap))
+            elif f in ("First", "Last"):
+                pos = jnp.arange(batch.capacity, dtype=jnp.int64)
+                if f == "First":
+                    raw = jnp.min(jnp.where(live, pos, _I64_MAX))
+                else:
+                    raw = jnp.max(jnp.where(live, pos, -1))
+                idx = jnp.clip(raw, 0, batch.capacity - 1).astype(jnp.int32)
+                # rank among live rows, for cross-batch ordering
+                rank = jnp.cumsum(live.astype(jnp.int64)) - 1
+                best = rank[idx]
+                # strings need a take-based path (no scalar buffer dtype)
+                taken = col.take(jnp.full((cap,), idx, dtype=jnp.int32))
+                row0 = jnp.arange(cap, dtype=jnp.int32) < 1
+                cols.append(taken.with_valid(taken.valid & row0))
+                cols.append(_scalar_col(best + E.current_row_offset(), True,
+                                        LongType, cap))
+            else:
+                raise NotImplementedError(f)
+        sel = jnp.arange(cap, dtype=jnp.int32) < 1
+        return ColumnarBatch(cols, sel, self._state_schema)
+
+    # ---- driver -----------------------------------------------------------
+
+    def _needs_offset(self) -> bool:
+        if any(a.func in ("First", "Last") for a in self.aggregates):
+            return True
+        exprs = list(self.grouping)
+        exprs += [a.child for a in self.aggregates if a.child is not None]
+        return any(E.tree_needs_row_offset(e) for e in exprs)
+
+    def execute(self, ctx: ExecContext):
+        grouped = bool(self.grouping)
+        base_update = (self._update_kernel if grouped
+                       else self._global_kernel)
+        needs_off = self._needs_offset()
+        if needs_off:
+            update = jax.jit(lambda b, off: E.eval_with_row_offset(
+                base_update, b, off))
+        else:
+            update = jax.jit(base_update)
+        merge = jax.jit(self._merge_kernel)
+        finalize = jax.jit(self._finalize_kernel)
+        state = None
+        offset = 0
+        for batch in self.children[0].execute(ctx):
+            with self.metrics.timer("computeAggTime"):
+                partial = update(batch, jnp.int64(offset)) if needs_off \
+                    else update(batch)
+            if needs_off:
+                offset += batch.num_rows_host()
+            if state is None:
+                state = partial
+            else:
+                with self.metrics.timer("concatTime"):
+                    both = concat_batches([state, partial])
+                with self.metrics.timer("mergeAggTime"):
+                    state = merge(both)
+        if state is None:
+            if grouped:
+                return
+            # global agg over empty input still yields one row: run the
+            # kernel on an all-dead batch of the child schema
+            child_schema = self.children[0].schema
+            data = {f.name: [] for f in child_schema}
+            dead = ColumnarBatch.from_pydict(data, child_schema)
+            state = update(dead, jnp.int64(0)) if needs_off else update(dead)
+        self.metrics.add("numOutputBatches", 1)
+        yield finalize(state)
+
+
+def _scalar_col(value, valid, dtype, cap):
+    data = jnp.zeros(cap, dtype=dtype.jnp_dtype).at[0].set(
+        value.astype(dtype.jnp_dtype) if hasattr(value, "astype") else value)
+    v = jnp.zeros(cap, dtype=jnp.bool_).at[0].set(valid)
+    return Column(data, v, dtype).mask_invalid()

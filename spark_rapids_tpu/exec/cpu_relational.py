@@ -107,8 +107,7 @@ class CpuHashAggregateExec(CpuExec):
             out_rows_keys.append(kt)
             for ai, a in enumerate(self.aggregates):
                 vals, valid = ins[ai]
-                sel = [i for i in idx if valid[i]]
-                out_aggs[ai].append(self._agg_value(a, vals, sel))
+                out_aggs[ai].append(self._agg_value(a, vals, valid, idx))
 
         import pyarrow as pa
         from ..types import to_arrow
@@ -123,15 +122,28 @@ class CpuHashAggregateExec(CpuExec):
             arrays.append(pa.array(out_aggs[ai], type=to_arrow(ft)))
         yield pa.table(arrays, names=self._schema.names)
 
-    def _agg_value(self, a: AggregateExpression, vals, sel: List[int]):
+    def _agg_value(self, a: AggregateExpression, vals, valid, idx):
+        sel = [i for i in idx if valid[i]]
         if a.func == "Count":
             return len(sel)
+        if a.func in ("First", "Last"):
+            # Spark default ignoreNulls=false: nulls count as values
+            if not idx:
+                return None
+            i0 = idx[0] if a.func == "First" else idx[-1]
+            if not valid[i0]:
+                return None
+            v = vals[i0]
+            return v.item() if isinstance(v, np.generic) else v
         if not sel:
             return None
         data = [vals[i] for i in sel]
         data = [d.item() if isinstance(d, np.generic) else d for d in data]
         if a.func == "Sum":
-            return sum(data)
+            s = sum(data)
+            if a.dtype is LongType:
+                s = ((s + 2**63) % 2**64) - 2**63  # java long wraparound
+            return s
         if a.func == "Min":
             clean = [d for d in data if not (isinstance(d, float)
                                              and np.isnan(d))]
@@ -143,10 +155,6 @@ class CpuHashAggregateExec(CpuExec):
             return max(data)
         if a.func == "Average":
             return sum(data) / len(data)
-        if a.func == "First":
-            return data[0]
-        if a.func == "Last":
-            return data[-1]
         raise NotImplementedError(a.func)
 
 

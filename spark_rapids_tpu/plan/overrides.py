@@ -80,6 +80,10 @@ def _tag_agg(meta: "ExprMeta", conf: TpuConf):
     e: AggregateExpression = meta.expr
     if e.distinct:
         meta.will_not_work("distinct aggregates are not supported on TPU yet")
+    if e.func in ("Min", "Max") and e.child is not None \
+            and e.child.dtype.is_string:
+        meta.will_not_work("min/max over strings is not supported on TPU "
+                           "yet (byte-matrix segment reduction pending)")
     if e.func in ("Sum", "Average") and e.child is not None \
             and e.child.dtype.is_floating \
             and not (conf.get(C.VARIABLE_FLOAT_AGG)

@@ -1,0 +1,195 @@
+"""TPU hash aggregate vs CPU oracle."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.plan.logical import col, functions as f, lit
+
+from compare import assert_tpu_and_cpu_are_equal, run_both, assert_rows_equal
+from data_gen import gen_df
+
+FLOAT_AGG = {"spark.rapids.sql.variableFloatAgg.enabled": "true"}
+
+
+def _assert_on_tpu(build, conf=None):
+    """The TPU side must actually plan the agg on device."""
+    from spark_rapids_tpu.engine import TpuSession
+    c = dict(conf or {})
+    s = TpuSession(c)
+    text = build(s).explain()
+    assert "!HashAggregateExec" not in text, text
+
+
+def test_groupby_sum_count_long():
+    def q(s):
+        df = gen_df(s, seed=20, n=800, k=T.IntegerType, v=T.LongType)
+        return df.group_by("k").agg(f.sum(col("v")).alias("sv"),
+                                    f.count(col("v")).alias("cv"),
+                                    f.count(lit(1)).alias("cstar"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_groupby_min_max():
+    def q(s):
+        df = gen_df(s, seed=21, n=600, k=T.IntegerType, v=T.IntegerType,
+                    d=T.DoubleType)
+        return df.group_by("k").agg(f.min(col("v")).alias("mnv"),
+                                    f.max(col("v")).alias("mxv"),
+                                    f.min(col("d")).alias("mnd"),
+                                    f.max(col("d")).alias("mxd"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_groupby_avg_float_conf_gated():
+    def q(s):
+        df = gen_df(s, seed=22, n=500, k=T.IntegerType, v=T.IntegerType)
+        return df.group_by("k").agg(f.avg(col("v")).alias("av"),
+                                    f.sum(col("v")).alias("sv"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_float_agg_requires_conf():
+    from spark_rapids_tpu.engine import TpuSession
+
+    def q(s):
+        df = gen_df(s, seed=23, n=100, k=T.IntegerType, v=T.DoubleType)
+        return df.group_by("k").agg(f.sum(col("v")).alias("sv"))
+    # without the conf, falls back (explain shows reason)
+    text = q(TpuSession()).explain()
+    assert "variableFloatAgg" in text
+    # with the conf, runs on TPU and matches
+    _assert_on_tpu(q, FLOAT_AGG)
+    assert_tpu_and_cpu_are_equal(q, conf=FLOAT_AGG)
+
+
+def test_groupby_string_keys():
+    def q(s):
+        df = gen_df(s, seed=24, n=600, k=T.StringType, v=T.LongType)
+        return df.group_by("k").agg(f.sum(col("v")).alias("sv"),
+                                    f.count(col("v")).alias("cv"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_groupby_multi_keys_with_nulls_nans():
+    def q(s):
+        df = gen_df(s, seed=25, n=700, k1=T.IntegerType, k2=T.DoubleType,
+                    v=T.LongType)
+        return df.group_by("k1", "k2").agg(f.count(lit(1)).alias("c"),
+                                           f.sum(col("v")).alias("sv"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_groupby_first_last():
+    # first/last depend on row order; use a key-sorted deterministic frame
+    def q(s):
+        df = s.from_pydict({"k": [1, 1, 2, 2, 2, 3],
+                            "v": [10, None, 30, 40, None, 60]},
+                           T.schema_of(k=T.IntegerType, v=T.IntegerType))
+        return df.group_by("k").agg(f.first(col("v")).alias("fv"),
+                                    f.last(col("v")).alias("lv"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_global_agg():
+    def q(s):
+        df = gen_df(s, seed=26, n=500, v=T.LongType, d=T.DoubleType)
+        return df.agg(f.sum(col("v")).alias("sv"),
+                      f.count(col("v")).alias("cv"),
+                      f.min(col("d")).alias("mnd"),
+                      f.max(col("d")).alias("mxd"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_global_agg_empty_input():
+    def q(s):
+        df = s.from_pydict({"v": []}, T.schema_of(v=T.LongType))
+        return df.agg(f.sum(col("v")).alias("sv"),
+                      f.count(col("v")).alias("cv"))
+    cpu, tpu = run_both(q)
+    assert tpu == [(None, 0)]
+    assert_rows_equal(cpu, tpu)
+
+
+def test_groupby_empty_input():
+    def q(s):
+        df = s.from_pydict({"k": [], "v": []},
+                           T.schema_of(k=T.IntegerType, v=T.LongType))
+        return df.group_by("k").agg(f.sum(col("v")).alias("sv"))
+    cpu, tpu = run_both(q)
+    assert cpu == tpu == []
+
+
+def test_agg_over_multiple_batches():
+    # force multiple scan batches so the merge path runs
+    conf = {"spark.rapids.sql.reader.batchSizeRows": "100"}
+
+    def q(s):
+        df = gen_df(s, seed=27, n=950, k=T.IntegerType, v=T.LongType)
+        return df.group_by("k").agg(f.sum(col("v")).alias("sv"),
+                                    f.count(lit(1)).alias("c"))
+    assert_tpu_and_cpu_are_equal(q, conf=conf)
+
+
+def test_agg_expression_keys_and_values():
+    def q(s):
+        df = gen_df(s, seed=28, n=400, a=T.IntegerType, b=T.IntegerType)
+        return df.group_by((col("a") % 10).alias("bucket")) \
+            .agg(f.sum(col("a") + col("b")).alias("sab"),
+                 f.max(col("b") * 2).alias("mb2"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_distinct_agg_falls_back():
+    from spark_rapids_tpu.engine import TpuSession
+
+    def q(s):
+        df = gen_df(s, seed=29, n=300, k=T.IntegerType, v=T.IntegerType)
+        return df.group_by("k").agg(f.count_distinct(col("v")).alias("cd"))
+    text = q(TpuSession()).explain()
+    assert "distinct" in text
+
+
+def test_min_with_inf_and_nan_group():
+    def q(s):
+        df = s.from_pydict(
+            {"k": [1, 1, 2, 2, 3],
+             "v": [float("inf"), float("nan"), float("nan"), float("nan"),
+                   1.5]},
+            T.schema_of(k=T.IntegerType, v=T.DoubleType))
+        return df.group_by("k").agg(f.min(col("v")).alias("mn"),
+                                    f.max(col("v")).alias("mx"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_first_last_across_filtered_batches():
+    conf = {"spark.rapids.sql.reader.batchSizeRows": "64"}
+
+    def q(s):
+        n = 300
+        df = s.from_pydict({"k": [i % 3 for i in range(n)],
+                            "v": list(range(n))},
+                           T.schema_of(k=T.IntegerType, v=T.IntegerType))
+        # filter leaves non-compacted batches; last() must still pick the
+        # globally latest surviving row per key
+        return df.filter(col("v") % 7 != 0) \
+                 .group_by("k").agg(f.first(col("v")).alias("fv"),
+                                    f.last(col("v")).alias("lv"))
+    assert_tpu_and_cpu_are_equal(q, conf=conf)
+
+
+def test_global_first_last_strings():
+    def q(s):
+        df = s.from_pydict({"s": ["aa", None, "cc"]},
+                           T.schema_of(s=T.StringType))
+        return df.agg(f.first(col("s")).alias("fs"),
+                      f.last(col("s")).alias("ls"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
