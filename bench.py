@@ -1,0 +1,83 @@
+"""Flagship benchmark: TPC-H Q6 shape on the device engine vs the CPU path.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+  value       = device-engine throughput (million rows/sec through the
+                filter->project->aggregate pipeline, steady-state)
+  vs_baseline = speedup over this framework's own CPU (pyarrow) executors,
+                the stand-in for the reference's CPU-Spark-vs-GPU oracle
+                (reference headline: TPCxBB-like Q5 19.8x, README.md:7-15).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_ROWS = 6_000_000  # ~SF1 lineitem row count
+
+
+def make_lineitem(n: int):
+    import pyarrow as pa
+    rng = np.random.RandomState(42)
+    price = rng.uniform(900.0, 105000.0, n)
+    discount = rng.choice(np.arange(0.0, 0.11, 0.01), n)
+    quantity = rng.randint(1, 51, n).astype(np.int64)
+    # days since epoch across 1992-1998 (TPC-H date range)
+    shipdate = rng.randint(8035, 10592, n).astype(np.int64)
+    return pa.table({
+        "l_extendedprice": price,
+        "l_discount": discount,
+        "l_quantity": quantity,
+        "l_shipdate": shipdate,
+    })
+
+
+def q6(session, table):
+    from spark_rapids_tpu.plan.logical import col, functions as F
+    df = session.from_arrow(table)
+    # 1994-01-01 = day 8766, 1995-01-01 = day 9131
+    return (df.filter((col("l_shipdate") >= 8766)
+                      & (col("l_shipdate") < 9131)
+                      & (col("l_discount") >= 0.05)
+                      & (col("l_discount") <= 0.07)
+                      & (col("l_quantity") < 24))
+            .agg(F.sum(col("l_extendedprice") * col("l_discount"))
+                 .alias("revenue")))
+
+
+def timed_run(session, table):
+    """One full run: plan + execute + materialize.  Kernels compiled on a
+    previous run are reused via the process-wide kernel cache."""
+    t0 = time.perf_counter()
+    rows = q6(session, table).collect()
+    return time.perf_counter() - t0, rows
+
+
+def main():
+    from spark_rapids_tpu.engine import TpuSession
+    table = make_lineitem(N_ROWS)
+
+    tpu = TpuSession()
+    timed_run(tpu, table)  # warmup: compile + caches
+    tpu_runs = [timed_run(tpu, table) for _ in range(3)]
+    tpu_t = min(t for t, _ in tpu_runs)
+    tpu_rows = tpu_runs[-1][1]
+
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    cpu_t, cpu_rows = timed_run(cpu, table)
+
+    assert abs(tpu_rows[0][0] - cpu_rows[0][0]) < 1e-4 * abs(cpu_rows[0][0]), \
+        (tpu_rows, cpu_rows)
+
+    mrows_s = N_ROWS / tpu_t / 1e6
+    print(json.dumps({
+        "metric": "tpch_q6_like_6M_rows_device_throughput",
+        "value": round(mrows_s, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(cpu_t / tpu_t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

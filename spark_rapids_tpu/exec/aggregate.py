@@ -451,18 +451,33 @@ class TpuHashAggregateExec(TpuExec):
         exprs += [a.child for a in self.aggregates if a.child is not None]
         return any(E.tree_needs_row_offset(e) for e in exprs)
 
+    def kernel_key(self) -> tuple:
+        from ..utils.kernel_cache import expr_key, schema_key
+        return ("TpuHashAggregateExec",
+                tuple(expr_key(g) for g in self.grouping),
+                tuple(self.group_names),
+                tuple(expr_key(a) for a in self.aggregates),
+                tuple(a.output_name for a in self.aggregates),
+                schema_key(self._schema))
+
     def execute(self, ctx: ExecContext):
+        from ..utils.kernel_cache import cached_kernel
         grouped = bool(self.grouping)
         base_update = (self._update_kernel if grouped
                        else self._global_kernel)
         needs_off = self._needs_offset()
+        key = self.kernel_key()
         if needs_off:
-            update = jax.jit(lambda b, off: E.eval_with_row_offset(
-                base_update, b, off))
+            update = cached_kernel(
+                key + ("update_off",),
+                lambda: lambda b, off: E.eval_with_row_offset(
+                    base_update, b, off))
         else:
-            update = jax.jit(base_update)
-        merge = jax.jit(self._merge_kernel)
-        finalize = jax.jit(self._finalize_kernel)
+            update = cached_kernel(key + ("update",), lambda: base_update)
+        merge = cached_kernel(key + ("merge",),
+                              lambda: self._merge_kernel)
+        finalize = cached_kernel(key + ("finalize",),
+                                 lambda: self._finalize_kernel)
         state = None
         offset = 0
         for batch in self.children[0].execute(ctx):

@@ -73,16 +73,26 @@ class RowLocalExec(TpuExec):
     def expressions(self) -> List[E.Expression]:
         return []
 
+    def kernel_key(self) -> tuple:
+        """Structural cache key; must fully determine batch_fn's closure."""
+        from ..utils.kernel_cache import expr_key
+        return (type(self).__name__,
+                tuple(expr_key(e) for e in self.expressions()))
+
     def _needs_row_offset(self) -> bool:
         return any(E.tree_needs_row_offset(e) for e in self.expressions())
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
-        base = self.batch_fn()
+        from ..utils.kernel_cache import cached_kernel
+        key = self.kernel_key()
         if self._needs_row_offset():
             # stateful exprs (mono id / rand): thread the partition row
             # offset through as a traced argument; costs one host sync per
             # batch, paid only when such an expression is present
-            fn = jax.jit(functools.partial(E.eval_with_row_offset, base))
+            fn = cached_kernel(
+                key + ("row_offset",),
+                lambda: functools.partial(E.eval_with_row_offset,
+                                          self.batch_fn()))
             offset = 0
             for batch in self.children[0].execute(ctx):
                 with self.metrics.timer("totalTime"):
@@ -91,7 +101,7 @@ class RowLocalExec(TpuExec):
                 self.metrics.add("numOutputBatches", 1)
                 yield out
             return
-        fn = jax.jit(base)
+        fn = cached_kernel(key, self.batch_fn)
         for batch in self.children[0].execute(ctx):
             with self.metrics.timer("totalTime"):
                 out = fn(batch)
@@ -121,6 +131,10 @@ class TpuProjectExec(RowLocalExec):
 
     def expressions(self):
         return list(self.exprs)
+
+    def kernel_key(self):
+        from ..utils.kernel_cache import schema_key
+        return super().kernel_key() + (schema_key(self._schema),)
 
     def describe(self):
         return f"TpuProjectExec[{', '.join(map(repr, self.exprs))}]"
@@ -176,6 +190,10 @@ class FusedPipelineExec(RowLocalExec):
         for s in self.stages:
             out.extend(s.expressions())
         return out
+
+    def kernel_key(self):
+        return ("FusedPipelineExec",
+                tuple(s.kernel_key() for s in self.stages))
 
     def describe(self):
         inner = " -> ".join(s.name for s in self.stages)
@@ -319,6 +337,12 @@ class TpuExpandExec(RowLocalExec):
 
     def expressions(self):
         return [e for proj in self.projections for e in proj]
+
+    def kernel_key(self):
+        from ..utils.kernel_cache import schema_key
+        return super().kernel_key() + (
+            tuple(len(p) for p in self.projections),
+            schema_key(self._schema))
 
     def describe(self):
         return f"TpuExpandExec[{len(self.projections)} projections]"

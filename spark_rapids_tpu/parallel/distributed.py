@@ -1,0 +1,109 @@
+"""Distributed query steps: SPMD operators over a device mesh.
+
+The TPU-native replacement for the reference's accelerated shuffle path
+(reference: rapids/shuffle/RapidsShuffleClient.scala, RapidsShuffleServer.scala,
+shuffle-plugin/.../ucx/): where the reference moves device buffers peer-to-peer
+over UCX/RDMA with a flatbuffers control plane and bounce-buffer pools, here a
+repartition-by-key is ONE XLA collective (`all_to_all` over ICI) inside a
+`shard_map`-traced program — no control plane, no staging copies, and the
+compiler overlaps it with compute.
+
+Key trick that makes this static-shape friendly: batches carry a selection
+mask, so "send rows with bucket==d to device d" does not compact anything —
+every device sends its full (identical) column data tiled n ways with n
+different selection masks.  Sel-mask shuffles trade bandwidth for zero
+dynamic shapes; the coalesce pass compacts after the exchange.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax>=0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..columnar import Column, ColumnarBatch
+from ..ops.hashing import hash_columns_double
+from .mesh import DATA_AXIS
+
+
+def _all_to_all(x, axis: str):
+    """Tiled all-to-all on the leading (row) axis: the array is split into
+    `n` equal row blocks, block d goes to device d, received blocks are
+    re-concatenated in peer order."""
+    return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+
+
+def exchange_by_bucket(batch: ColumnarBatch, bucket, axis: str = DATA_AXIS
+                       ) -> ColumnarBatch:
+    """Inside shard_map: route each live row to device `bucket[row] % n`.
+
+    Returns a batch of capacity n*cap whose selection mask keeps exactly the
+    rows this device owns.  Since every destination receives the SAME column
+    data (only the selection mask differs per destination), the data movement
+    is an all_gather; only the mask needs a true all_to_all.
+    """
+    n = jax.lax.psum(1, axis)
+    cap = batch.capacity
+    dest = jnp.arange(n, dtype=jnp.int32)[:, None]            # [n, 1]
+    sel_nd = batch.sel[None, :] & (bucket[None, :] == dest)    # [n, cap]
+    recv_sel = _all_to_all(sel_nd.reshape(n * cap), axis)
+
+    def gather(x):
+        return jax.lax.all_gather(x, axis, tiled=True)
+
+    def exchange_col(c: Column) -> Column:
+        if c.dtype.is_string:
+            return Column(gather(c.data), gather(c.valid), c.dtype,
+                          gather(c.lengths))
+        return Column(gather(c.data), gather(c.valid), c.dtype)
+
+    cols = [exchange_col(c) for c in batch.columns]
+    return ColumnarBatch(cols, recv_sel, batch.schema)
+
+
+def key_buckets(key_cols: Sequence[Column], live, n: int):
+    """Owner device of each row: h1(keys) % n (dead rows -> garbage, masked
+    by sel downstream)."""
+    if not key_cols:
+        return jnp.zeros(live.shape, dtype=jnp.int32)
+    h1, _ = hash_columns_double(key_cols, live)
+    return (h1 % jnp.uint64(n)).astype(jnp.int32)
+
+
+def distributed_aggregate_step(agg, mesh: Mesh, axis: str = DATA_AXIS,
+                               pre=None):
+    """Build the full SPMD aggregation step over a mesh.
+
+    Per device: [optional fused filter/project `pre`] -> update-aggregate
+    local rows -> all_to_all partial states by key hash -> merge-aggregate
+    owned groups -> finalize.  This is the TPU equivalent of the reference's
+    partial-agg -> shuffle -> final-agg stage pair (reference:
+    rapids/aggregate.scala Partial/Final modes + GpuShuffleExchangeExec), as
+    one compiled XLA program.
+
+    `agg` is a TpuHashAggregateExec (provides the three kernels).
+    Returns a function: globally row-sharded batch -> row-sharded result
+    batch whose live rows are each device's owned groups.
+    """
+    n = mesh.shape[axis]
+    nkeys = len(agg.grouping)
+
+    def step(local: ColumnarBatch) -> ColumnarBatch:
+        if pre is not None:
+            local = pre(local)
+        state = agg._update_kernel(local)
+        bucket = key_buckets(list(state.columns[:nkeys]), state.sel, n)
+        gathered = exchange_by_bucket(state, bucket, axis)
+        merged = agg._merge_kernel(gathered)
+        return agg._finalize_kernel(merged)
+
+    return shard_map(step, mesh=mesh, in_specs=(P(axis),),
+                     out_specs=P(axis))

@@ -1,0 +1,52 @@
+"""Device mesh management for multi-chip execution.
+
+The reference scales by "one GPU per Spark executor" plus UCX peer-to-peer
+shuffle (reference: rapids/GpuDeviceManager.scala:98-112, shuffle-plugin/).
+The TPU-native model is different and better matched to the hardware: all
+chips of a slice form one `jax.sharding.Mesh`, columnar batches are sharded
+over the row axis, and repartitioning rides ICI as an XLA all-to-all instead
+of an RDMA transport (SURVEY.md §2.9, §5).
+
+Axis convention:
+  * "data"  — row-sharded batch parallelism (the SQL engine's only
+    first-class axis; rows are this domain's "big dimension").
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis: str = DATA_AXIS,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over the first `n_devices` local devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = n_devices if n_devices is not None else len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def row_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (row) axis of every leaf of a ColumnarBatch."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = DATA_AXIS):
+    """Place a host/single-device ColumnarBatch row-sharded onto the mesh.
+
+    The batch capacity must divide evenly by the mesh size (callers pick
+    power-of-two capacities via bucket_rows, so any power-of-two mesh fits).
+    """
+    n = mesh.shape[axis]
+    if batch.capacity % n != 0:
+        raise ValueError(
+            f"batch capacity {batch.capacity} not divisible by mesh size {n}")
+    return jax.device_put(batch, row_sharding(mesh, axis))

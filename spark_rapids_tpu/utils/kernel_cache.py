@@ -1,0 +1,75 @@
+"""Process-wide compiled-kernel cache.
+
+jax.jit's own cache is keyed by function identity, but the execs build fresh
+closures every plan/execute, so without this layer each collect() re-traces
+and re-compiles every kernel (the reference has no analogue — cuDF kernels
+are precompiled; for us compilation IS the kernel-build step, so caching it
+is what makes repeated/streaming queries cheap).
+
+Keys are structural: (kernel kind, expression-tree signature, schema
+signature).  Shape/dtype differences of the incoming batches are handled by
+jit itself underneath one cache entry.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+
+
+_CACHE: Dict[tuple, Callable] = {}
+
+
+def expr_key(e) -> tuple:
+    """Structural signature of an expression tree: class + every non-child
+    constructor attribute + children, recursively.  Safer than repr (an
+    expression whose repr omits a parameter would under-key the cache)."""
+    from ..ops.expressions import Expression
+    attrs = []
+    d = getattr(e, "__dict__", None)
+    items = sorted(d.items()) if d else \
+        [(s, getattr(e, s)) for s in getattr(e, "__slots__", ())]
+    for k, v in items:
+        if k == "children" or isinstance(v, Expression):
+            continue
+        if isinstance(v, (list, tuple)) and any(
+                isinstance(x, Expression) for x in v):
+            continue
+        attrs.append((k, _val_key(v)))
+    kids = tuple(expr_key(c) for c in e.children)
+    return (type(e).__name__, tuple(attrs), kids)
+
+
+def _val_key(v):
+    from ..types import DataType
+    if isinstance(v, DataType):
+        return v.name
+    if isinstance(v, (list, tuple)):
+        return tuple(_val_key(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return tuple(sorted(map(repr, v)))
+    if isinstance(v, dict):
+        return tuple(sorted((k, _val_key(x)) for k, x in v.items()))
+    return repr(v)
+
+
+def schema_key(schema) -> tuple:
+    return tuple((f.name, f.dtype.name) for f in schema)
+
+
+def cached_kernel(key: tuple, builder: Callable[[], Callable],
+                  **jit_kw) -> Callable:
+    """Return the jitted kernel for `key`, building it on first use."""
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(builder(), **jit_kw)
+        _CACHE[key] = fn
+    return fn
+
+
+def cache_info() -> Tuple[int, list]:
+    return len(_CACHE), [k[0] for k in _CACHE]
+
+
+def clear():
+    _CACHE.clear()
