@@ -1,0 +1,131 @@
+"""TPU sort.
+
+Reference behavior: rapids/GpuSortExec.scala — per-batch cuDF Table.orderBy
+with null-ordering support; global sorts rely on upstream range
+partitioning.  TPU-first implementation: every sort column is encoded into
+order-preserving integer keys and ONE `jnp.lexsort` (stable, XLA sort HLO)
+orders the whole batch — no comparator kernels:
+
+  * numerics/dates/timestamps -> int64 (floats via the IEEE monotone bit
+    transform; NaN canonicalized above +inf, Spark's "NaN greatest");
+  * strings -> big-endian uint64 words over the padded byte matrix (UTF-8
+    byte order == code-point order) + length tiebreak;
+  * null placement -> a per-column rank key (before/after non-nulls);
+  * dead rows -> a most-major key pushing them to the back.
+
+Descending columns invert their key bits (~k), which reverses order without
+overflow.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, ColumnarBatch, concat_batches
+from ..ops import expressions as E
+from .base import ExecContext, ExecNode, TpuExec
+
+_I64_MIN = np.int64(-(2**63))
+_NAN_BITS = np.int64(0x7FF8000000000000)
+
+
+def float_sort_key(data) -> jnp.ndarray:
+    """Monotone int64 encoding of float64 values; NaN > +inf and
+    -0.0 == 0.0 (Spark ordering semantics)."""
+    d = data.astype(jnp.float64)
+    bits = jax.lax.bitcast_convert_type(d, jnp.int64)
+    # -0.0 -> 0.0 by bit pattern (a float compare would also catch
+    # subnormals under XLA's flush-to-zero); NaN above +inf
+    bits = jnp.where(bits == _I64_MIN, jnp.int64(0), bits)
+    bits = jnp.where(jnp.isnan(d), _NAN_BITS, bits)
+    return jnp.where(bits >= 0, bits, ~bits + _I64_MIN)
+
+
+def column_sort_keys(c: Column, ascending: bool) -> List[jnp.ndarray]:
+    """Order-preserving integer keys for one column, most-significant first.
+    Null rows are zeroed (a separate null-rank key places them)."""
+    if c.dtype.is_string:
+        cap, L = c.data.shape
+        assert L % 8 == 0, L  # bucket_strlen yields power-of-two >= 8
+        w = c.data.reshape(cap, L // 8, 8).astype(jnp.uint64)
+        shifts = jnp.arange(56, -8, -8, dtype=jnp.uint64)
+        words = jnp.sum(w << shifts, axis=2, dtype=jnp.uint64)
+        keys = [words[:, j] for j in range(L // 8)]
+        keys.append(c.lengths.astype(jnp.int64))
+    elif c.dtype.is_floating:
+        keys = [float_sort_key(c.data)]
+    else:
+        keys = [c.data.astype(jnp.int64)]
+    keys = [jnp.where(c.valid, k, jnp.zeros((), k.dtype)) for k in keys]
+    if not ascending:
+        keys = [~k for k in keys]
+    return keys
+
+
+def sort_order(batch: ColumnarBatch, exprs: Sequence[E.Expression],
+               ascending: Sequence[bool], nulls_first: Sequence[bool]):
+    """Stable permutation ordering live rows by the sort spec, dead rows
+    last.  `nulls_first` is the EFFECTIVE placement (already accounts for
+    direction, like SortOrder.effective_nulls_first)."""
+    live = batch.sel
+    major: List[jnp.ndarray] = [(~live).astype(jnp.int32)]
+    for e, asc, nf in zip(exprs, ascending, nulls_first):
+        c = e.eval(batch)
+        null_rank = jnp.where(c.valid, jnp.int32(1),
+                              jnp.int32(0) if nf else jnp.int32(2))
+        major.append(null_rank)
+        major.extend(column_sort_keys(c, asc))
+    # lexsort: LAST key is primary -> pass minor-to-major
+    return jnp.lexsort(tuple(reversed(major))).astype(jnp.int32)
+
+
+class TpuSortExec(TpuExec):
+    """Global sort: coalesce to a single batch, one lexsort kernel."""
+
+    child_coalesce_goal = "single"
+
+    def __init__(self, sort_exprs: Sequence[E.Expression],
+                 ascending: Sequence[bool], nulls_first: Sequence[bool],
+                 child: ExecNode):
+        super().__init__(child)
+        self.sort_exprs = list(sort_exprs)
+        self.ascending = list(ascending)
+        self.nulls_first = list(nulls_first)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def kernel_key(self):
+        from ..utils.kernel_cache import expr_key
+        return ("TpuSortExec",
+                tuple(expr_key(e) for e in self.sort_exprs),
+                tuple(self.ascending), tuple(self.nulls_first))
+
+    def _sort_kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
+        order = sort_order(batch, self.sort_exprs, self.ascending,
+                           self.nulls_first)
+        return batch.take(order)
+
+    def execute(self, ctx: ExecContext):
+        from ..utils.kernel_cache import cached_kernel
+        fn = cached_kernel(self.kernel_key(), lambda: self._sort_kernel)
+        batches = list(self.children[0].execute(ctx))
+        if not batches:
+            return
+        batch = batches[0] if len(batches) == 1 else concat_batches(batches)
+        with self.metrics.timer("sortTime"):
+            out = fn(batch)
+        self.metrics.add("numOutputBatches", 1)
+        yield out
+
+    def describe(self):
+        parts = []
+        for e, a, nf in zip(self.sort_exprs, self.ascending,
+                            self.nulls_first):
+            parts.append(f"{e!r} {'ASC' if a else 'DESC'} "
+                         f"NULLS {'FIRST' if nf else 'LAST'}")
+        return f"TpuSortExec[{', '.join(parts)}]"
