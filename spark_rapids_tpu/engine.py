@@ -140,6 +140,11 @@ class DataFrame:
                 out.append(lit(c))
         return out
 
+    def __getitem__(self, name: str) -> ColumnExpr:
+        if name not in self.schema.names:
+            raise KeyError(name)
+        return col(name)
+
     def select(self, *cols) -> "DataFrame":
         return DataFrame(self.session,
                          L.LogicalProject(self._wrap_cols(cols), self.plan))

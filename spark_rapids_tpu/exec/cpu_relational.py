@@ -278,12 +278,14 @@ class CpuJoinExec(CpuExec):
 
         li, ri = [], []
         matched_left = np.zeros(ln, bool)
+        matched_right = np.zeros(rn, bool)
         for i in range(ln):
             kt = key_tuple(lkeys, i)
             matches = build.get(kt, []) if kt is not None else []
             for j in matches:
                 li.append(i)
                 ri.append(j)
+                matched_right[j] = True
             if matches:
                 matched_left[i] = True
 
@@ -298,39 +300,65 @@ class CpuJoinExec(CpuExec):
             matched_left = np.zeros(ln, bool)
             for x in li:
                 matched_left[x] = True
+            matched_right = np.zeros(rn, bool)
+            for x in ri:
+                matched_right[x] = True
 
         jt = self.join_type
         if jt == "inner":
             yield self._project(self._take_pairs(lt, rt, li, ri))
             return
         if jt == "left_semi":
-            yield self._project(lt.take([i for i in range(ln)
-                                         if matched_left[i]]))
+            yield self._project(lt.take(_idx_array(
+                [i for i in range(ln) if matched_left[i]])))
             return
         if jt == "left_anti":
-            yield self._project(lt.take([i for i in range(ln)
-                                         if not matched_left[i]]))
+            yield self._project(lt.take(_idx_array(
+                [i for i in range(ln) if not matched_left[i]])))
             return
-        if jt in ("left", "left_outer"):
-            un = [i for i in range(ln) if not matched_left[i]]
+        if jt in ("left", "left_outer", "right", "right_outer", "full",
+                  "full_outer"):
             matched = self._take_pairs(lt, rt, li, ri)
-            if un:
-                left_part = lt.take(un)
-                unmatched = pa.table(
-                    [left_part.column(c) for c in left_part.column_names] +
-                    [pa.nulls(len(un), type=f.type) for f in rt.schema],
-                    names=matched.column_names)
-                out = pa.concat_tables([matched, unmatched])
-            else:
-                out = matched
-            yield self._project(out)
+            parts = [matched]
+            if jt not in ("right", "right_outer"):
+                un = [i for i in range(ln) if not matched_left[i]]
+                if un:
+                    left_part = lt.take(_idx_array(un))
+                    parts.append(pa.table(
+                        [left_part.column(c)
+                         for c in left_part.column_names] +
+                        [pa.nulls(len(un), type=f.type) for f in rt.schema],
+                        names=matched.column_names))
+            if jt not in ("left", "left_outer"):
+                un = [j for j in range(rn) if not matched_right[j]]
+                if un:
+                    right_part = rt.take(_idx_array(un))
+                    # USING joins keep the LEFT key column; unmatched right
+                    # rows must surface their key there (Spark coalesces the
+                    # two key columns), not NULL
+                    lw = len(lt.column_names)
+                    key_src = {}  # left col position -> right col name
+                    for d in self.using_drop:
+                        rname = rt.column_names[d - lw]
+                        if rname in lt.column_names:
+                            key_src[lt.column_names.index(rname)] = rname
+                    left_arrays = [
+                        right_part.column(key_src[i]) if i in key_src
+                        else pa.nulls(len(un), type=f.type)
+                        for i, f in enumerate(lt.schema)]
+                    parts.append(pa.table(
+                        left_arrays +
+                        [right_part.column(c)
+                         for c in right_part.column_names],
+                        names=matched.column_names))
+            yield self._project(pa.concat_tables(parts))
             return
         raise NotImplementedError(f"join type {jt}")
 
     def _take_pairs(self, lt, rt, li, ri):
         import pyarrow as pa
-        lpart = lt.take(li)
-        rpart = rt.take(ri)
+        lpart = lt.take(_idx_array(li))
+        rpart = rt.take(_idx_array(ri))
         names = list(lt.column_names)
         rnames = []
         for c in rt.column_names:
@@ -384,3 +412,10 @@ class CpuDistinctExec(CpuExec):
                 seen.add(k)
                 keep.append(i)
         yield table.take(keep)
+
+
+def _idx_array(indices):
+    """Typed take-indices (pa.array([]) infers null type, which take
+    rejects)."""
+    import pyarrow as pa
+    return pa.array(indices, type=pa.int64())

@@ -1,0 +1,273 @@
+"""TPU hash join.
+
+Reference behavior: rapids/GpuHashJoin.scala:26-139 — build side becomes a
+table, each stream batch projects its keys and runs
+innerJoin/leftJoin/leftSemiJoin/leftAntiJoin, with residual conditions
+applied as a post-filter (inner only); GpuShuffledHashJoinExec.scala:83-87
+requires a single build batch.
+
+TPU-first implementation: no hash table (scatter-heavy probing is slow on
+TPU).  The join is sort + binary search with static shapes, shaped like
+cuDF's own count-then-gather join API:
+
+  1. BUILD: hash the build keys (64-bit), stable-sort the build batch by
+     hash — dead rows hash to uint64-max and fall to the back.  Done once,
+     then reused for every stream batch.
+  2. WINDOW: per stream row, `searchsorted(left/right)` on the sorted build
+     hashes yields a candidate window [lo, hi).  One host sync reads the
+     max window width, which becomes the static `max_dup` of the probe
+     kernels (hash collisions inside a window are rejected by comparing the
+     actual key bytes, so a wide window is a cost, never a wrongness).
+  3. COUNT: `fori_loop` over d < max_dup counts verified key-equal matches
+     per stream row; prefix sums give each row's output start and the total
+     (second host sync picks the power-of-two output capacity bucket).
+  4. GATHER: the same loop scatters (left_row, build_row) index pairs into
+     their output slots; left/semi/anti never reach this phase (they are a
+     mask over the stream batch: counts>0 / counts==0).
+
+Equality uses Spark key semantics (nulls never match, NaN == NaN,
+-0.0 == 0.0), matching the CPU oracle in cpu_relational.py.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, ColumnarBatch, concat_batches
+from ..columnar.batch import bucket_rows
+from ..ops import expressions as E
+from ..ops.hashing import _normalize_bits, hash_columns_double
+from ..types import Schema, StructField
+from .base import ExecContext, ExecNode, TpuExec
+
+
+def _row_equal(lcol: Column, bcol: Column, bidx):
+    """Per-stream-row key equality between lcol[i] and bcol[bidx[i]]
+    (Spark join-key semantics: null keys never match anything)."""
+    bvalid = jnp.take(bcol.valid, bidx, mode="clip")
+    ok = lcol.valid & bvalid
+    if lcol.dtype.is_string:
+        blens = jnp.take(bcol.lengths, bidx, mode="clip")
+        ok &= lcol.lengths == blens
+        bdata = jnp.take(bcol.data, bidx, axis=0, mode="clip")
+        L = min(lcol.max_len, bcol.max_len)
+        pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+        in_str = pos < lcol.lengths[:, None]
+        same = jnp.where(in_str, lcol.data[:, :L] == bdata[:, :L], True)
+        ok &= jnp.all(same, axis=1)
+    else:
+        lbits = _normalize_bits(lcol)
+        bbits = jnp.take(_normalize_bits(bcol), bidx, mode="clip")
+        ok &= lbits == bbits
+    return ok
+
+
+class TpuHashJoinExec(TpuExec):
+    """Equi hash join: inner / left / left_semi / left_anti.
+
+    Streams the LEFT side against a single sorted build batch of the RIGHT
+    side (reference builds right for these join types too,
+    GpuHashJoin.scala:46-70)."""
+
+    coalesce_after = True
+
+    def __init__(self, left: ExecNode, right: ExecNode, join_type: str,
+                 left_keys: Sequence[E.Expression],
+                 right_keys: Sequence[E.Expression],
+                 condition: Optional[E.Expression], out_schema: Schema,
+                 using_drop: Optional[List[int]] = None):
+        super().__init__(left, right)
+        # canonical names so kernels only ever see "left"
+        self.join_type = {"left_outer": "left"}.get(join_type, join_type)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+        self._schema = out_schema
+        self.using_drop = using_drop or []
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"TpuHashJoinExec[{self.join_type}, "
+                f"keys={len(self.left_keys)}]")
+
+    def kernel_key(self) -> tuple:
+        from ..utils.kernel_cache import expr_key, schema_key
+        # schemas matter: the gather kernel closes over self._schema, and
+        # two joins with identical key exprs can differ in payload columns
+        return ("TpuHashJoinExec", self.join_type,
+                tuple(expr_key(e) for e in self.left_keys),
+                tuple(expr_key(e) for e in self.right_keys),
+                expr_key(self.condition) if self.condition is not None
+                else None,
+                tuple(self.using_drop),
+                schema_key(self.children[0].schema),
+                schema_key(self.children[1].schema),
+                schema_key(self._schema))
+
+    # ---- kernels ----------------------------------------------------------
+
+    def _build_kernel(self, rbatch: ColumnarBatch):
+        """Sort the build batch by key hash; dead rows last."""
+        keys = [e.eval(rbatch) for e in self.right_keys]
+        h1, _h2 = hash_columns_double(keys, rbatch.sel)
+        order = jnp.argsort(h1, stable=True).astype(jnp.int32)
+        sorted_batch = rbatch.take(order)
+        skeys = [k.take(order) for k in keys]
+        return sorted_batch, skeys, jnp.take(h1, order)
+
+    def _window_kernel(self, lbatch: ColumnarBatch, h1s):
+        """-> (lo, hi, max_dup) candidate windows per stream row."""
+        keys = [e.eval(lbatch) for e in self.left_keys]
+        h1, _h2 = hash_columns_double(keys, lbatch.sel)
+        lo = jnp.searchsorted(h1s, h1, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(h1s, h1, side="right").astype(jnp.int32)
+        width = jnp.where(lbatch.sel, hi - lo, 0)
+        return lo, hi, jnp.max(width)
+
+    def _count_kernel(self, max_dup: int, lbatch: ColumnarBatch,
+                      build: ColumnarBatch, bkeys, lo, hi):
+        """Verified match count per stream row + prefix starts + total."""
+        lkeys = [e.eval(lbatch) for e in self.left_keys]
+        cap_b = build.capacity
+        live = lbatch.sel
+        blive = build.sel
+
+        def body(d, cnt):
+            bidx = jnp.clip(lo + d, 0, cap_b - 1)
+            ok = live & ((lo + d) < hi) & jnp.take(blive, bidx, mode="clip")
+            for lk, bk in zip(lkeys, bkeys):
+                ok &= _row_equal(lk, bk, bidx)
+            return cnt + ok.astype(jnp.int32)
+
+        counts = jax.lax.fori_loop(
+            0, max_dup, body, jnp.zeros(lbatch.capacity, jnp.int32))
+        if self.join_type == "left":
+            counts = jnp.where(live & (counts == 0), 1, counts)
+        starts = jnp.cumsum(counts) - counts
+        return counts, starts, jnp.sum(counts)
+
+    def _gather_kernel(self, max_dup: int, out_cap: int,
+                       lbatch: ColumnarBatch, build: ColumnarBatch, bkeys,
+                       lo, hi, counts, starts, total):
+        """Scatter (left_row, build_row) pairs into output slots, then
+        gather the joined columns."""
+        lkeys = [e.eval(lbatch) for e in self.left_keys]
+        cap_b = build.capacity
+        live = lbatch.sel
+        blive = build.sel
+
+        l_idx = jnp.zeros(out_cap, jnp.int32)
+        b_idx = jnp.zeros(out_cap, jnp.int32)
+        matched = jnp.zeros(out_cap, jnp.bool_)
+        rows = jnp.arange(lbatch.capacity, dtype=jnp.int32)
+
+        def body(d, carry):
+            l_out, b_out, m_out, rank = carry
+            bidx = jnp.clip(lo + d, 0, cap_b - 1)
+            ok = live & ((lo + d) < hi) & jnp.take(blive, bidx, mode="clip")
+            for lk, bk in zip(lkeys, bkeys):
+                ok &= _row_equal(lk, bk, bidx)
+            slot = jnp.where(ok, starts + rank, out_cap)  # out_cap = dropped
+            l_out = l_out.at[slot].set(rows, mode="drop")
+            b_out = b_out.at[slot].set(bidx, mode="drop")
+            m_out = m_out.at[slot].set(True, mode="drop")
+            return l_out, b_out, m_out, rank + ok.astype(jnp.int32)
+
+        zero_rank = jnp.zeros(lbatch.capacity, jnp.int32)
+        l_idx, b_idx, matched, _ = jax.lax.fori_loop(
+            0, max_dup, body, (l_idx, b_idx, matched, zero_rank))
+        if self.join_type == "left":
+            # unmatched live rows were forced to counts==1; their slot
+            # (starts[i]) was never written by the match loop, so fill it
+            # with the left row and leave `matched` False (right side null)
+            slot = jnp.where(live, starts, out_cap)
+            already = jnp.take(matched, jnp.clip(slot, 0, out_cap - 1),
+                               mode="clip")
+            slot = jnp.where(already, out_cap, slot)
+            l_idx = l_idx.at[slot].set(rows, mode="drop")
+
+        sel = jnp.arange(out_cap, dtype=jnp.int32) < total
+        lcols = [c.take(l_idx) for c in lbatch.columns]
+        rcols = []
+        for c in build.columns:
+            taken = c.take(b_idx)
+            rcols.append(taken.with_valid(taken.valid & matched)
+                         .mask_invalid())
+        lfields = list(lbatch.schema.fields)
+        rfields = [StructField(f.name + "_r"
+                               if f.name in lbatch.schema.names else f.name,
+                               f.dtype) for f in build.schema]
+        joined = ColumnarBatch(lcols + rcols, sel,
+                               Schema(lfields + rfields))
+        if self.condition is not None:
+            cond = self.condition.eval(joined)
+            keep = cond.valid & cond.data.astype(jnp.bool_)
+            joined = joined.filter(keep)
+        if self.using_drop:
+            keep_idx = [i for i in range(joined.num_cols)
+                        if i not in self.using_drop]
+            joined = joined.select_columns(keep_idx)
+        return ColumnarBatch(joined.columns, joined.sel, self._schema)
+
+    def _semi_kernel(self, lbatch: ColumnarBatch, counts):
+        if self.join_type == "left_semi":
+            return lbatch.filter(counts > 0)
+        return lbatch.filter(counts == 0)  # left_anti
+
+    # ---- driver -----------------------------------------------------------
+
+    def execute(self, ctx: ExecContext):
+        from ..utils.kernel_cache import cached_kernel
+        key = self.kernel_key()
+        build_fn = cached_kernel(key + ("build",),
+                                 lambda: self._build_kernel)
+        window_fn = cached_kernel(key + ("window",),
+                                  lambda: self._window_kernel)
+
+        rbatches = list(self.children[1].execute(ctx))
+        if rbatches:
+            rbatch = rbatches[0] if len(rbatches) == 1 \
+                else concat_batches(rbatches)
+        else:
+            rbatch = _empty_batch(self.children[1].schema)
+        with self.metrics.timer("buildTime"):
+            build, bkeys, h1s = build_fn(rbatch)
+
+        for lbatch in self.children[0].execute(ctx):
+            with self.metrics.timer("joinTime"):
+                lo, hi, max_dup_t = window_fn(lbatch, h1s)
+                max_dup = int(max_dup_t)  # host sync #1
+                count_fn = cached_kernel(
+                    key + ("count", max_dup),
+                    lambda: functools.partial(self._count_kernel, max_dup))
+                counts, starts, total_t = count_fn(lbatch, build, bkeys,
+                                                   lo, hi)
+                if self.join_type in ("left_semi", "left_anti"):
+                    semi_fn = cached_kernel(key + ("semi",),
+                                            lambda: self._semi_kernel)
+                    out = semi_fn(lbatch, counts)
+                    out = ColumnarBatch(out.columns, out.sel, self._schema)
+                else:
+                    total = int(total_t)  # host sync #2
+                    out_cap = bucket_rows(max(total, 1))
+                    gather_fn = cached_kernel(
+                        key + ("gather", max_dup, out_cap),
+                        lambda: functools.partial(self._gather_kernel,
+                                                  max_dup, out_cap))
+                    out = gather_fn(lbatch, build, bkeys, lo, hi,
+                                    counts, starts, total_t)
+            self.metrics.add("numOutputBatches", 1)
+            self.metrics.add("numOutputRows", out.num_rows_host())
+            yield out
+
+
+def _empty_batch(schema: Schema) -> ColumnarBatch:
+    data = {f.name: [] for f in schema}
+    return ColumnarBatch.from_pydict(data, schema)

@@ -37,7 +37,9 @@ def _normalize_bits(col: Column):
     data = col.data
     if col.dtype.is_floating:
         d = data.astype(jnp.float64)
-        d = d + jnp.zeros((), jnp.float64)      # -0.0 -> 0.0
+        # -0.0 -> 0.0 via select, NOT `d + 0.0`: XLA's algebraic
+        # simplifier folds x+0 away under jit, skipping the normalization
+        d = jnp.where(d == 0.0, jnp.float64(0.0), d)
         canonical_nan = jnp.float64(np.nan)
         d = jnp.where(jnp.isnan(d), canonical_nan, d)
         return jax_bitcast_i64(d)
@@ -165,13 +167,13 @@ def spark_hash_column(col: Column, seed):
     elif dt.name == "float":
         f = col.data.astype(jnp.float32)
         f = jnp.where(jnp.isnan(f), jnp.float32(np.nan), f)
-        f = f + jnp.zeros((), jnp.float32)
+        f = jnp.where(f == 0.0, jnp.float32(0.0), f)  # fold-proof -0.0 fix
         bits = jax_bitcast_i32(f)
         h = murmur3_int(bits, seed)
     elif dt.name == "double":
         d = col.data.astype(jnp.float64)
         d = jnp.where(jnp.isnan(d), jnp.float64(np.nan), d)
-        d = d + jnp.zeros((), jnp.float64)
+        d = jnp.where(d == 0.0, jnp.float64(0.0), d)  # fold-proof -0.0 fix
         bits = jax_bitcast_i64(d)
         h = murmur3_long(bits, seed)
     else:

@@ -163,6 +163,13 @@ def _tag_join(meta: PlanMeta):
             lkeys.append(lk)
             rkeys.append(rk)
         if residual is not None:
+            if plan.join_type != "inner":
+                # post-filtering is only equivalent to a join condition for
+                # inner joins (reference: GpuHashJoin tagJoin restricts
+                # conditional joins the same way)
+                meta.will_not_work(
+                    f"conditional {plan.join_type} joins are not supported "
+                    "on TPU (inner only)")
             joined = _joined_schema(ls, rs)
             cond = resolve(residual, joined)
             meta.expr_metas.append(ExprMeta(cond, meta.conf))

@@ -1,0 +1,247 @@
+"""TPU hash join vs CPU oracle.
+
+Mirrors integration_tests/src/main/python/join_test.py from the reference:
+every join type crossed with nasty key data (nulls, NaN, -0.0, duplicate
+keys, empty sides), all checked CPU-vs-TPU.
+"""
+import random
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.plan.logical import col
+
+from compare import assert_tpu_and_cpu_are_equal
+from data_gen import gen_value
+
+
+def keyed_df(session, seed, n, key_range=15, key_type=T.IntegerType,
+             null_ratio=0.1, extra=None):
+    """A table whose key column collides often (join selectivity)."""
+    rng = random.Random(seed)
+    keys = []
+    for _ in range(n):
+        if rng.random() < null_ratio:
+            keys.append(None)
+        elif key_type is T.StringType:
+            keys.append(f"k{rng.randint(0, key_range)}")
+        elif key_type is T.DoubleType:
+            r = rng.random()
+            if r < 0.1:
+                keys.append(float("nan"))
+            elif r < 0.2:
+                keys.append(rng.choice([0.0, -0.0]))
+            else:
+                keys.append(float(rng.randint(0, key_range)))
+        else:
+            keys.append(rng.randint(0, key_range))
+    data = {"k": keys}
+    fields = [T.StructField("k", key_type)]
+    for name, dt in (extra or {}).items():
+        data[name] = [gen_value(rng, dt) for _ in range(n)]
+        fields.append(T.StructField(name, dt))
+    return session.from_pydict(data, T.Schema(fields))
+
+
+def _assert_join_on_tpu(build, conf=None):
+    from spark_rapids_tpu.engine import TpuSession
+    s = TpuSession(dict(conf or {}))
+    text = build(s).explain()
+    assert "!SortMergeJoinExec" not in text, text
+
+
+def _check(build, conf=None):
+    _assert_join_on_tpu(build, conf)
+    assert_tpu_and_cpu_are_equal(build, conf)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+@pytest.mark.parametrize("key_type", [T.IntegerType, T.LongType,
+                                      T.StringType, T.DoubleType])
+def test_join_types(how, key_type):
+    def q(s):
+        left = keyed_df(s, 100, 300, key_type=key_type,
+                        extra={"a": T.LongType})
+        right = keyed_df(s, 200, 200, key_type=key_type,
+                         extra={"b": T.DoubleType})
+        return left.join(right, "k", how)
+    _check(q)
+
+
+def test_inner_join_then_filter():
+    def q(s):
+        left = keyed_df(s, 101, 250, extra={"a": T.LongType})
+        right = keyed_df(s, 201, 250, extra={"b": T.LongType})
+        return left.join(right, on="k", how="inner") \
+            .filter(col("a").is_not_null())
+    _check(q)
+
+
+def test_join_duplicate_heavy():
+    """Many duplicates on both sides (fan-out join)."""
+    def q(s):
+        left = keyed_df(s, 102, 400, key_range=3, extra={"a": T.IntegerType})
+        right = keyed_df(s, 202, 300, key_range=3, extra={"b": T.IntegerType})
+        return left.join(right, "k", "inner")
+    _check(q)
+
+
+def test_join_no_matches():
+    def q(s):
+        left = keyed_df(s, 103, 100, key_range=5, extra={"a": T.LongType})
+        rng = random.Random(203)
+        right = s.from_pydict(
+            {"k": [rng.randint(100, 200) for _ in range(80)],
+             "b": [rng.random() for _ in range(80)]},
+            T.Schema([T.StructField("k", T.IntegerType),
+                      T.StructField("b", T.DoubleType)]))
+        return left.join(right, "k", "left")
+    _check(q)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_join_empty_build_side(how):
+    def q(s):
+        left = keyed_df(s, 104, 120, extra={"a": T.LongType})
+        right = s.from_pydict(
+            {"k": [], "b": []},
+            T.Schema([T.StructField("k", T.IntegerType),
+                      T.StructField("b", T.DoubleType)]))
+        return left.join(right, "k", how)
+    _check(q)
+
+
+def test_join_empty_stream_side():
+    def q(s):
+        left = s.from_pydict(
+            {"k": [], "a": []},
+            T.Schema([T.StructField("k", T.IntegerType),
+                      T.StructField("a", T.LongType)]))
+        right = keyed_df(s, 205, 120, extra={"b": T.DoubleType})
+        return left.join(right, "k", "inner")
+    _check(q)
+
+
+def test_join_multi_key():
+    def q(s):
+        rng = random.Random(106)
+        n = 300
+
+        def mk(seed):
+            r = random.Random(seed)
+            return {
+                "k1": [r.randint(0, 8) if r.random() > 0.1 else None
+                       for _ in range(n)],
+                "k2": [f"s{r.randint(0, 5)}" if r.random() > 0.1 else None
+                       for _ in range(n)],
+                "v": [r.random() for _ in range(n)],
+            }
+        schema = T.Schema([T.StructField("k1", T.IntegerType),
+                           T.StructField("k2", T.StringType),
+                           T.StructField("v", T.DoubleType)])
+        left = s.from_pydict(mk(1061), schema)
+        right = s.from_pydict(mk(1062), schema)
+        return left.join(right, ["k1", "k2"], "inner")
+    _check(q)
+
+
+def test_join_with_residual_condition():
+    """Equi keys + non-equi residual: inner joins post-filter on TPU."""
+    def q(s):
+        left = keyed_df(s, 107, 200, extra={"a": T.IntegerType})
+        right = keyed_df(s, 207, 200, extra={"b": T.IntegerType}) \
+            .select(col("k").alias("kr"), col("b"))
+        return left.join(right,
+                         (col("k") == col("kr")) & (col("a") > col("b")),
+                         "inner")
+
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_conditional_left_join_falls_back():
+    """Conditional non-inner joins must fall back to CPU (and be right)."""
+    from spark_rapids_tpu.engine import TpuSession
+
+    def q(s):
+        left = keyed_df(s, 108, 150, extra={"a": T.IntegerType})
+        right = keyed_df(s, 208, 150, extra={"b": T.IntegerType}) \
+            .select(col("k").alias("kr"), col("b"))
+        return left.join(right,
+                         (col("k") == col("kr")) & (col("a") > col("b")),
+                         "left")
+
+    s = TpuSession({})
+    text = q(s).explain()
+    assert "!SortMergeJoinExec" in text
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_full_join_falls_back():
+    from spark_rapids_tpu.engine import TpuSession
+
+    def q(s):
+        left = keyed_df(s, 109, 100, extra={"a": T.IntegerType})
+        right = keyed_df(s, 209, 100, extra={"b": T.IntegerType})
+        return left.join(right, "k", "full")
+
+    s = TpuSession({})
+    text = q(s).explain()
+    assert "!SortMergeJoinExec" in text
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_join_then_aggregate():
+    """Join feeding an aggregation (the TPC-H shape)."""
+    def q(s):
+        from spark_rapids_tpu.plan.logical import functions as F
+        left = keyed_df(s, 110, 400, key_range=10,
+                        extra={"qty": T.LongType})
+        right = keyed_df(s, 210, 50, key_range=10,
+                         extra={"price": T.DoubleType})
+        j = left.join(right, "k", "inner")
+        return j.group_by("k").agg(
+            F.count(col("qty")).alias("n"),
+            F.max(col("price")).alias("mx"))
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_self_join_disambiguation():
+    def q(s):
+        df = keyed_df(s, 111, 150, extra={"a": T.LongType})
+        other = keyed_df(s, 111, 150, extra={"a": T.LongType})
+        return df.join(other, "k", "left_semi")
+    _check(q)
+
+
+@pytest.mark.parametrize("how", ["right", "full"])
+def test_outer_using_join_key_coalesce(how):
+    """Unmatched build rows must surface their key in the kept key column
+    (CPU fallback path; Spark coalesces USING keys)."""
+    from spark_rapids_tpu.engine import TpuSession
+    s = TpuSession({"spark.rapids.sql.enabled": "false"})
+    left = s.from_pydict(
+        {"k": [1], "a": [10]},
+        T.Schema([T.StructField("k", T.IntegerType),
+                  T.StructField("a", T.LongType)]))
+    right = s.from_pydict(
+        {"k": [1, 2], "b": [1.0, 2.0]},
+        T.Schema([T.StructField("k", T.IntegerType),
+                  T.StructField("b", T.DoubleType)]))
+    rows = sorted(left.join(right, "k", how).collect(), key=str)
+    assert (2, None, 2.0) in rows, rows
+
+
+def test_left_outer_alias_matches_left():
+    """'left_outer' must behave exactly like 'left' on the TPU path."""
+    import spark_rapids_tpu.plan.logical as L
+    from spark_rapids_tpu.engine import TpuSession, DataFrame
+
+    def q(how):
+        s = TpuSession({})
+        left = keyed_df(s, 113, 120, extra={"a": T.LongType})
+        right = keyed_df(s, 213, 80, extra={"b": T.DoubleType})
+        return DataFrame(s, L.LogicalJoin(
+            left.plan, right.plan, how, using=["k"])).collect()
+
+    from compare import assert_rows_equal
+    assert_rows_equal(q("left"), q("left_outer"))
