@@ -98,29 +98,25 @@ class DataFrameReader:
         return self
 
     def parquet(self, *paths: str) -> "DataFrame":
-        from .io.scan import parquet_schema, expand_paths
-        files = expand_paths(paths)
-        schema = parquet_schema(files)
-        return DataFrame(self.session, L.LogicalScan(
-            files, schema, "parquet", dict(self._options)))
+        from .io.scan import scan_info
+        files, schema, opts = scan_info(paths, "parquet", self._options)
+        return DataFrame(self.session,
+                         L.LogicalScan(files, schema, "parquet", opts))
 
     def csv(self, *paths: str, schema: Optional[Schema] = None,
             header: bool = False) -> "DataFrame":
-        from .io.scan import csv_schema, expand_paths
-        files = expand_paths(paths)
+        from .io.scan import scan_info
         opts = dict(self._options)
         opts.setdefault("header", header)
-        if schema is None:
-            schema = csv_schema(files, opts)
+        files, schema, opts = scan_info(paths, "csv", opts, schema)
         return DataFrame(self.session,
                          L.LogicalScan(files, schema, "csv", opts))
 
     def orc(self, *paths: str) -> "DataFrame":
-        from .io.scan import orc_schema, expand_paths
-        files = expand_paths(paths)
-        schema = orc_schema(files)
-        return DataFrame(self.session, L.LogicalScan(
-            files, schema, "orc", dict(self._options)))
+        from .io.scan import scan_info
+        files, schema, opts = scan_info(paths, "orc", self._options)
+        return DataFrame(self.session,
+                         L.LogicalScan(files, schema, "orc", opts))
 
 
 class DataFrame:

@@ -342,8 +342,10 @@ class CpuJoinExec(CpuExec):
                         rname = rt.column_names[d - lw]
                         if rname in lt.column_names:
                             key_src[lt.column_names.index(rname)] = rname
+                    import pyarrow.compute as pc
                     left_arrays = [
-                        right_part.column(key_src[i]) if i in key_src
+                        pc.cast(right_part.column(key_src[i]), f.type)
+                        if i in key_src
                         else pa.nulls(len(un), type=f.type)
                         for i, f in enumerate(lt.schema)]
                     parts.append(pa.table(
