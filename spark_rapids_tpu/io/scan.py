@@ -33,6 +33,13 @@ from ..plan import logical as L
 # path + schema discovery (driver side)
 # --------------------------------------------------------------------------
 
+def _opt_bool(v) -> bool:
+    """Spark-style option parsing: the string \"false\" is False."""
+    if isinstance(v, str):
+        return v.strip().lower() in ("true", "1", "yes")
+    return bool(v)
+
+
 def expand_paths(paths) -> List[str]:
     """Expand files/dirs/globs into a sorted file list."""
     out: List[str] = []
@@ -161,7 +168,20 @@ def scan_info(paths, fmt: str, options: dict,
     files = expand_paths(paths)
     part_fields, typed = discover_partitions(paths, files)
     if user_schema is not None:
-        file_schema = user_schema
+        # a user schema may name discovered partition columns: they stay
+        # partition columns (sourced from the directory names, with the
+        # user-declared dtype), and must not be read from the data files
+        part_names = {f.name for f in part_fields}
+        file_schema = Schema([f for f in user_schema.fields
+                              if f.name not in part_names])
+        by_name = {f.name: f for f in user_schema.fields}
+        part_fields = [by_name.get(f.name, f) for f in part_fields]
+        if typed and part_fields:
+            typed = {fl: {k: _parse_partition_value(
+                              None if v is None else str(v),
+                              by_name[k].dtype) if k in by_name else v
+                          for k, v in vals.items()}
+                     for fl, vals in typed.items()}
     elif fmt == "parquet":
         file_schema = parquet_schema(files)
     elif fmt == "orc":
@@ -185,7 +205,7 @@ def scan_info(paths, fmt: str, options: dict,
 def _read_csv_arrow(path: str, schema: Optional[Schema], options: dict):
     import pyarrow as pa
     import pyarrow.csv as pacsv
-    header = bool(options.get("header", False))
+    header = _opt_bool(options.get("header", False))
     sep = options.get("sep", options.get("delimiter", ","))
     read_opts = pacsv.ReadOptions(autogenerate_column_names=not header)
     # ignore_empty_lines=False: a single-string-column table's null row is

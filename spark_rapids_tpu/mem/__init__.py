@@ -1,0 +1,19 @@
+"""Device memory runtime: spillable buffers, 3-tier stores, task semaphore.
+
+The TPU analogue of the reference's L1 device runtime (SURVEY.md §2.4):
+GpuDeviceManager / RapidsBufferCatalog / RapidsBufferStore tiers /
+DeviceMemoryEventHandler / GpuSemaphore.
+"""
+from .buffer import BatchMeta, SpillPriorities, StorageTier
+from .priority_queue import HashedPriorityQueue
+from .runtime import DeviceMemoryEventHandler, TpuRuntime
+from .semaphore import TpuSemaphore
+from .stores import (BufferCatalog, DeviceMemoryStore, DiskStore,
+                     HostMemoryStore, SpillableBuffer)
+
+__all__ = [
+    "BatchMeta", "SpillPriorities", "StorageTier", "HashedPriorityQueue",
+    "DeviceMemoryEventHandler", "TpuRuntime", "TpuSemaphore",
+    "BufferCatalog", "DeviceMemoryStore", "DiskStore", "HostMemoryStore",
+    "SpillableBuffer",
+]

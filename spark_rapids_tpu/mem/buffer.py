@@ -1,0 +1,146 @@
+"""Spillable buffer identities, tiers, priorities and host/disk forms.
+
+TPU-native analogue of the reference's spillable-buffer framework data model
+(sql-plugin/.../rapids/RapidsBuffer.scala:52-58 tier enum,
+SpillPriorities.scala priority constants, MetaUtils.scala TableMeta).  A
+"buffer" here is a whole ColumnarBatch (struct-of-arrays pytree) rather than
+one contiguous device allocation: XLA owns device memory, so the unit we can
+account for and release is the batch's set of jnp arrays.
+
+Host form: numpy arrays (one per leaf).  Disk form: a single file holding the
+raw little-endian bytes of every leaf back to back, with the layout kept in
+the in-memory meta (BatchMeta) — the analogue of the flatbuffers TableMeta
+that lets the shuffle server re-serve a spilled buffer from any tier.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import Column, ColumnarBatch
+from ..types import Schema
+
+
+class StorageTier(enum.IntEnum):
+    """Where a buffer currently lives (RapidsBuffer.scala:52-58)."""
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+class SpillPriorities:
+    """Ordering constants (reference: SpillPriorities.scala).  Lower spills
+    first."""
+    # Buffers actively being used as task input: spill dead last.
+    ACTIVE_ON_DECK_PRIORITY = float(2 ** 60)
+    # Output buffers waiting to be shuffled: spill first, oldest first.
+    OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY = float(-(2 ** 60))
+    # Everything else defaults in between.
+    DEFAULT_PRIORITY = 0.0
+
+
+_next_id_lock = threading.Lock()
+_next_id = [0]
+
+
+def fresh_buffer_id() -> int:
+    with _next_id_lock:
+        _next_id[0] += 1
+        return _next_id[0]
+
+
+@dataclass
+class ColumnLeafMeta:
+    """Layout of one column's leaves inside the flat disk image."""
+    dtype_name: str
+    shapes: List[Tuple[int, ...]]   # data, valid [, lengths]
+    np_dtypes: List[str]
+
+
+@dataclass
+class BatchMeta:
+    """Reconstruction recipe for a batch (TableMeta analogue,
+    MetaUtils.scala:41-137).  Enough to rebuild the ColumnarBatch from a flat
+    byte image, and to describe degenerate (rows-only) batches."""
+    schema: Schema
+    capacity: int
+    leaf_meta: List[ColumnLeafMeta]
+    sel_shape: Tuple[int, ...]
+    size_bytes: int
+
+
+def batch_to_host(batch: ColumnarBatch) -> Tuple[List[np.ndarray], BatchMeta]:
+    """D2H: pull every leaf down as numpy (the spill copy)."""
+    import jax
+    leaves: List[np.ndarray] = []
+    leaf_meta: List[ColumnLeafMeta] = []
+    for c in batch.columns:
+        arrs = [np.asarray(jax.device_get(c.data)),
+                np.asarray(jax.device_get(c.valid))]
+        if c.lengths is not None:
+            arrs.append(np.asarray(jax.device_get(c.lengths)))
+        leaves.extend(arrs)
+        leaf_meta.append(ColumnLeafMeta(
+            c.dtype.name,
+            [a.shape for a in arrs],
+            [a.dtype.str for a in arrs]))
+    sel = np.asarray(jax.device_get(batch.sel))
+    leaves.append(sel)
+    meta = BatchMeta(batch.schema, batch.capacity, leaf_meta, sel.shape,
+                     sum(a.nbytes for a in leaves))
+    return leaves, meta
+
+
+def host_to_batch(leaves: List[np.ndarray], meta: BatchMeta) -> ColumnarBatch:
+    """H2D: rebuild the device batch from its host copy."""
+    import jax.numpy as jnp
+    cols = []
+    i = 0
+    for f, lm in zip(meta.schema, meta.leaf_meta):
+        n_leaves = len(lm.shapes)
+        arrs = leaves[i:i + n_leaves]
+        i += n_leaves
+        data = jnp.asarray(arrs[0])
+        valid = jnp.asarray(arrs[1])
+        lengths = jnp.asarray(arrs[2]) if n_leaves == 3 else None
+        cols.append(Column(data, valid, f.dtype, lengths))
+    sel = jnp.asarray(leaves[i])
+    return ColumnarBatch(cols, sel, meta.schema)
+
+
+def host_leaves_nbytes(leaves: List[np.ndarray]) -> int:
+    return sum(a.nbytes for a in leaves)
+
+
+def write_leaves(path: str, leaves: List[np.ndarray]) -> int:
+    """Flat byte image of all leaves, back to back (disk tier)."""
+    with open(path, "wb") as f:
+        for a in leaves:
+            f.write(np.ascontiguousarray(a).tobytes())
+    return os.path.getsize(path)
+
+
+def read_leaves(path: str, meta: BatchMeta) -> List[np.ndarray]:
+    leaves: List[np.ndarray] = []
+    with open(path, "rb") as f:
+        raw = f.read()
+    off = 0
+    for lm in meta.leaf_meta:
+        for shape, ds in zip(lm.shapes, lm.np_dtypes):
+            dt = np.dtype(ds)
+            n = int(np.prod(shape)) if shape else 1
+            nb = n * dt.itemsize
+            leaves.append(np.frombuffer(raw, dtype=dt, count=n,
+                                        offset=off).reshape(shape))
+            off += nb
+    # sel leaf
+    dt = np.dtype(np.bool_)
+    n = int(np.prod(meta.sel_shape))
+    leaves.append(np.frombuffer(raw, dtype=dt, count=n,
+                                offset=off).reshape(meta.sel_shape))
+    return leaves

@@ -1,0 +1,106 @@
+"""Updatable priority queue ordering spillable buffers.
+
+TPU-native analogue of the reference's HashedPriorityQueue
+(sql-plugin/src/main/java/.../HashedPriorityQueue.java): O(log n) offer/poll
+plus O(log n) priority *update* of an element already in the queue, which the
+buffer stores use to re-prioritize a buffer when it becomes the active input
+of a task.  Implemented as a binary heap + position map (the same structure
+the reference uses), in Python.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class HashedPriorityQueue(Generic[T]):
+    """Min-heap by `priority_of(element)`; elements must be hashable."""
+
+    def __init__(self, priority_of: Callable[[T], float]):
+        self._prio = priority_of
+        self._heap: List[T] = []
+        self._pos: Dict[T, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __contains__(self, item: T) -> bool:
+        return item in self._pos
+
+    def offer(self, item: T) -> None:
+        if item in self._pos:
+            raise ValueError(f"{item!r} already queued")
+        self._heap.append(item)
+        self._pos[item] = len(self._heap) - 1
+        self._sift_up(len(self._heap) - 1)
+
+    def peek(self) -> Optional[T]:
+        return self._heap[0] if self._heap else None
+
+    def poll(self) -> Optional[T]:
+        if not self._heap:
+            return None
+        return self._remove_at(0)
+
+    def remove(self, item: T) -> bool:
+        i = self._pos.get(item)
+        if i is None:
+            return False
+        self._remove_at(i)
+        return True
+
+    def update_priority(self, item: T) -> None:
+        """Re-heapify `item` after its priority changed externally."""
+        i = self._pos.get(item)
+        if i is None:
+            raise KeyError(item)
+        if not self._sift_up(i):
+            self._sift_down(i)
+
+    # ---- heap plumbing -----------------------------------------------------
+
+    def _remove_at(self, i: int) -> T:
+        item = self._heap[i]
+        last = self._heap.pop()
+        del self._pos[item]
+        if i < len(self._heap):
+            self._heap[i] = last
+            self._pos[last] = i
+            if not self._sift_up(i):
+                self._sift_down(i)
+        return item
+
+    def _swap(self, i: int, j: int) -> None:
+        h = self._heap
+        h[i], h[j] = h[j], h[i]
+        self._pos[h[i]] = i
+        self._pos[h[j]] = j
+
+    def _sift_up(self, i: int) -> bool:
+        moved = False
+        while i > 0:
+            parent = (i - 1) >> 1
+            if self._prio(self._heap[i]) < self._prio(self._heap[parent]):
+                self._swap(i, parent)
+                i = parent
+                moved = True
+            else:
+                break
+        return moved
+
+    def _sift_down(self, i: int) -> None:
+        n = len(self._heap)
+        while True:
+            left, right = 2 * i + 1, 2 * i + 2
+            smallest = i
+            if left < n and (self._prio(self._heap[left])
+                             < self._prio(self._heap[smallest])):
+                smallest = left
+            if right < n and (self._prio(self._heap[right])
+                              < self._prio(self._heap[smallest])):
+                smallest = right
+            if smallest == i:
+                return
+            self._swap(i, smallest)
+            i = smallest

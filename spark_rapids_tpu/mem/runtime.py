@@ -1,0 +1,184 @@
+"""Per-process device runtime: HBM pool accounting + spill stores + semaphore.
+
+TPU-native analogue of GpuDeviceManager + GpuShuffleEnv wiring
+(sql-plugin/.../rapids/GpuDeviceManager.scala:120-243 — RMM pool init with
+allocFraction of device memory, pinned pool; GpuShuffleEnv.scala:57-107 —
+store construction + OOM event handler install;
+DeviceMemoryEventHandler.scala:38-90 — on alloc failure, synchronously spill
+the device store and retry).
+
+XLA owns the real HBM allocator, so the pool here is an *accounting* pool:
+every registered batch counts its static footprint against
+allocFraction * hbm_total, and `reserve()` is the allocation boundary where
+the OOM->spill hook runs.  This is the same contract the reference gets from
+RMM's onAllocFailure callback, enforced one level up.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Optional
+
+from ..columnar import ColumnarBatch
+from ..config import (CONCURRENT_TPU_TASKS, HOST_SPILL_STORAGE_SIZE,
+                      TPU_ALLOC_FRACTION, TPU_DEBUG, TPU_OOM_SPILL_ENABLED,
+                      TpuConf)
+from .buffer import SpillPriorities, StorageTier, host_to_batch, read_leaves
+from .semaphore import TpuSemaphore
+from .stores import (BufferCatalog, DeviceMemoryStore, DiskStore,
+                     HostMemoryStore, SpillableBuffer)
+
+
+def _detect_hbm_bytes() -> int:
+    """Total device memory of the first accelerator, if discoverable."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+        if stats:
+            for key in ("bytes_limit", "bytes_reservable_limit"):
+                if key in stats and stats[key]:
+                    return int(stats[key])
+    except Exception:
+        pass
+    return 16 << 30  # v5e-class default when stats are unavailable
+
+
+class DeviceMemoryEventHandler:
+    """OOM->spill hook (DeviceMemoryEventHandler.scala:38-90)."""
+
+    def __init__(self, device_store: DeviceMemoryStore, debug: str = "NONE"):
+        self.device_store = device_store
+        self.debug = debug
+        self.retry_count = 0
+
+    def on_alloc_failure(self, alloc_size: int) -> bool:
+        """Spill the device store down by `alloc_size`; True = retry the
+        allocation."""
+        store_size = self.device_store.current_size
+        target = max(0, store_size - alloc_size)
+        spilled = self.device_store.synchronous_spill(target)
+        if self.debug in ("STDOUT", "STDERR"):
+            out = sys.stdout if self.debug == "STDOUT" else sys.stderr
+            print(f"[tpu-mem] alloc failure of {alloc_size}B: spilled "
+                  f"{spilled}B from device store", file=out)
+        self.retry_count += 1
+        return spilled > 0
+
+
+class TpuRuntime:
+    """Executor-singleton services (one per TpuSession/process)."""
+
+    def __init__(self, conf: Optional[TpuConf] = None,
+                 pool_limit_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self.conf = conf or TpuConf()
+        frac = float(self.conf.get(TPU_ALLOC_FRACTION))
+        self.pool_limit = (pool_limit_bytes if pool_limit_bytes is not None
+                           else int(_detect_hbm_bytes() * frac))
+        self.catalog = BufferCatalog()
+        self.device_store = DeviceMemoryStore(self.catalog)
+        self.host_store = HostMemoryStore(
+            self.catalog, int(self.conf.get(HOST_SPILL_STORAGE_SIZE)))
+        self.disk_store = DiskStore(self.catalog, spill_dir)
+        self.device_store.spill_store = self.host_store
+        self.host_store.spill_store = self.disk_store
+        self.event_handler = DeviceMemoryEventHandler(
+            self.device_store, str(self.conf.get(TPU_DEBUG)).upper())
+        self.oom_spill = bool(self.conf.get(TPU_OOM_SPILL_ENABLED))
+        self.semaphore = TpuSemaphore(
+            int(self.conf.get(CONCURRENT_TPU_TASKS)))
+        self._lock = threading.Lock()
+
+    # ---- allocation boundary ----------------------------------------------
+
+    def reserve(self, nbytes: int) -> None:
+        """Account for an upcoming device allocation; spill if over budget.
+
+        Raises MemoryError when the pool cannot be brought under budget
+        (mirrors RMM throwing after the event handler declines to retry)."""
+        for _ in range(8):  # bounded retry loop
+            used = self.device_store.current_size
+            if used + nbytes <= self.pool_limit:
+                return
+            if not (self.oom_spill
+                    and self.event_handler.on_alloc_failure(nbytes)):
+                break
+        used = self.device_store.current_size
+        if used + nbytes > self.pool_limit:
+            raise MemoryError(
+                f"HBM pool exhausted: need {nbytes}B, used {used}B of "
+                f"{self.pool_limit}B and nothing left to spill")
+
+    # ---- spillable batch registry ------------------------------------------
+
+    def add_batch(self, batch: ColumnarBatch,
+                  spill_priority: float = SpillPriorities.DEFAULT_PRIORITY
+                  ) -> int:
+        """Register a device batch as spillable; returns its buffer id."""
+        self.reserve(batch.device_size_bytes())
+        return self.device_store.add_batch(batch, spill_priority).id
+
+    def get_batch(self, buffer_id: int) -> ColumnarBatch:
+        """Materialize a registered batch on device, from whatever tier it
+        currently occupies (the read path of RapidsBuffer.getColumnarBatch)."""
+        buf = self.catalog.acquire(buffer_id)
+        try:
+            return self._materialize(buf)
+        finally:
+            self.catalog.release(buf)
+
+    def _materialize(self, buf: SpillableBuffer) -> ColumnarBatch:
+        """Return the batch on device, *promoting* the buffer back to the
+        device tier so the HBM pool keeps accounting for exactly one copy
+        (unlike the reference, which hands out an untracked transient device
+        copy — RMM tracks that copy for it; our accounting pool must)."""
+        with buf.lock:
+            if buf.tier == StorageTier.DEVICE:
+                return buf.device_batch
+            if buf.tier == StorageTier.HOST:
+                leaves, src = buf.host_leaves, self.host_store
+            else:
+                leaves, src = read_leaves(buf.disk_path, buf.meta), \
+                    self.disk_store
+            self.reserve(buf.size_bytes)
+            batch = host_to_batch(leaves, buf.meta)
+            src.untrack(buf)
+            if buf.disk_path:
+                self.disk_store.delete_file(buf)
+            buf.host_leaves = None
+            buf.device_batch = batch
+            self.device_store.track(buf)
+            return batch
+
+    def free_batch(self, buffer_id: int) -> None:
+        buf = self.catalog.remove(buffer_id)
+        if buf is None:
+            return
+        for store in (self.device_store, self.host_store, self.disk_store):
+            store.untrack(buf)
+        if buf.disk_path:
+            self.disk_store.delete_file(buf)
+        buf.device_batch = None
+        buf.host_leaves = None
+
+    def update_priority(self, buffer_id: int, priority: float) -> None:
+        buf = self.catalog.acquire(buffer_id)
+        try:
+            for store in (self.device_store, self.host_store,
+                          self.disk_store):
+                if buf.tier == store.tier:
+                    store.update_priority(buf, priority)
+                    return
+        finally:
+            self.catalog.release(buf)
+
+    # ---- stats -------------------------------------------------------------
+
+    def pool_stats(self) -> dict:
+        return {
+            "pool_limit": self.pool_limit,
+            "device_used": self.device_store.current_size,
+            "host_used": self.host_store.current_size,
+            "disk_used": self.disk_store.current_size,
+        }

@@ -1,0 +1,78 @@
+"""Device task semaphore.
+
+TPU-native analogue of GpuSemaphore (sql-plugin/.../rapids/GpuSemaphore.scala:
+27-161): caps how many tasks may hold the device at once
+(spark.rapids.sql.concurrentTpuTasks, default 1).  Acquired on first device
+use in a task, re-entrant per task, releasable around host-side work, and
+fully released on task completion.
+
+One condition variable guards both the holder map and admission, so the
+"does this task already hold a slot" check and the slot grab are atomic —
+two threads sharing a task id cannot double-consume a slot.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class TpuSemaphore:
+    def __init__(self, max_concurrent: int):
+        assert max_concurrent > 0
+        self.max_concurrent = max_concurrent
+        self._cond = threading.Condition()
+        self._holders: Dict[int, int] = {}   # task id -> acquire depth
+
+    def _key(self, task_id=None) -> int:
+        return task_id if task_id is not None else threading.get_ident()
+
+    def acquire_if_necessary(self, task_id=None) -> None:
+        """Block until this task holds a device slot; re-entrant per task
+        (GpuSemaphore.acquireIfNecessary)."""
+        key = self._key(task_id)
+        with self._cond:
+            while True:
+                depth = self._holders.get(key, 0)
+                if depth > 0 or len(self._holders) < self.max_concurrent:
+                    self._holders[key] = depth + 1
+                    return
+                self._cond.wait()
+
+    def release_if_necessary(self, task_id=None) -> None:
+        """Give the slot back (e.g. while the task does host-side I/O)."""
+        key = self._key(task_id)
+        with self._cond:
+            depth = self._holders.get(key, 0)
+            if depth == 0:
+                return
+            if depth == 1:
+                del self._holders[key]
+                self._cond.notify_all()
+            else:
+                self._holders[key] = depth - 1
+
+    def task_done(self, task_id=None) -> None:
+        """Drop every reference the task holds (the task-completion listener
+        path, GpuSemaphore.scala:97-120)."""
+        key = self._key(task_id)
+        with self._cond:
+            if self._holders.pop(key, 0) > 0:
+                self._cond.notify_all()
+
+    def active_tasks(self) -> int:
+        with self._cond:
+            return len(self._holders)
+
+    class _Held:
+        def __init__(self, sem, task_id):
+            self.sem, self.task_id = sem, task_id
+
+        def __enter__(self):
+            self.sem.acquire_if_necessary(self.task_id)
+            return self
+
+        def __exit__(self, *a):
+            self.sem.task_done(self.task_id)
+
+    def held(self, task_id=None) -> "_Held":
+        return TpuSemaphore._Held(self, task_id)

@@ -1,0 +1,292 @@
+"""Three-tier spillable buffer stores + catalog.
+
+TPU-native analogue of the reference's spill framework
+(sql-plugin/.../rapids/RapidsBufferStore.scala:40-307 — per-store
+BufferTracker ordered by spill priority, synchronousSpill at 141-241;
+RapidsBufferCatalog.scala:30-52 — id->buffer lookup with ref-count acquire;
+RapidsDeviceMemoryStore.scala / RapidsHostMemoryStore.scala /
+RapidsDiskStore.scala).
+
+Differences from the reference, deliberate for TPU:
+  * XLA owns HBM, so the device tier holds jnp-array batches and accounts
+    for their static footprint instead of sub-allocating an RMM pool;
+    "freeing" device memory = dropping the last Python reference so XLA's
+    allocator can reuse the pages.
+  * One SpillableBuffer object migrates between tiers (the reference copies
+    into a new RapidsBuffer per tier); the catalog maps id -> that object.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+from ..columnar import ColumnarBatch
+from .buffer import (BatchMeta, SpillPriorities, StorageTier, batch_to_host,
+                     fresh_buffer_id, host_leaves_nbytes, host_to_batch,
+                     read_leaves, write_leaves)
+from .priority_queue import HashedPriorityQueue
+
+
+class SpillableBuffer:
+    """A registered, spillable columnar batch.
+
+    Ref-counting discipline mirrors RapidsBuffer.addReference/free
+    (RapidsBuffer.scala): a buffer with live references cannot be spilled;
+    `close()` drops one reference; `free()` removes it from its store."""
+
+    def __init__(self, buffer_id: int, meta: BatchMeta,
+                 spill_priority: float):
+        self.id = buffer_id
+        self.meta = meta
+        self.spill_priority = spill_priority
+        self.tier = StorageTier.DEVICE
+        self.ref_count = 0
+        self.freed = False
+        # guards ref_count and tier migration: spilling re-checks ref_count
+        # under this lock, acquire increments under it, so a reader can
+        # never observe a half-migrated buffer
+        self.lock = threading.RLock()
+        # tier payloads (exactly one is set, per current tier)
+        self.device_batch: Optional[ColumnarBatch] = None
+        self.host_leaves = None
+        self.disk_path: Optional[str] = None
+
+    @property
+    def size_bytes(self) -> int:
+        return self.meta.size_bytes
+
+    def __repr__(self):  # pragma: no cover
+        return (f"SpillableBuffer(id={self.id}, tier={self.tier.name}, "
+                f"size={self.size_bytes}, refs={self.ref_count})")
+
+
+class BufferStore:
+    """One tier's tracker: insertion-ordered within equal priority, spillable
+    candidates ordered by (priority, id) — lower spills first
+    (RapidsBufferStore.scala BufferTracker)."""
+
+    tier: StorageTier
+
+    def __init__(self, catalog: "BufferCatalog"):
+        self.catalog = catalog
+        self.spill_store: Optional["BufferStore"] = None
+        self._buffers: Dict[int, SpillableBuffer] = {}
+        self._queue: HashedPriorityQueue[int] = HashedPriorityQueue(
+            self._priority_of)
+        self._size = 0
+        self._lock = threading.RLock()
+
+    def _priority_of(self, buffer_id: int) -> float:
+        b = self._buffers[buffer_id]
+        return b.spill_priority
+
+    @property
+    def current_size(self) -> int:
+        with self._lock:
+            return self._size
+
+    def track(self, buf: SpillableBuffer) -> None:
+        with self._lock:
+            self._buffers[buf.id] = buf
+            self._queue.offer(buf.id)
+            self._size += buf.size_bytes
+            buf.tier = self.tier
+
+    def untrack(self, buf: SpillableBuffer) -> None:
+        with self._lock:
+            if buf.id in self._buffers:
+                del self._buffers[buf.id]
+                self._queue.remove(buf.id)
+                self._size -= buf.size_bytes
+
+    def update_priority(self, buf: SpillableBuffer, priority: float) -> None:
+        with self._lock:
+            buf.spill_priority = priority
+            if buf.id in self._buffers:
+                self._queue.update_priority(buf.id)
+
+    def synchronous_spill(self, target_size: int) -> int:
+        """Migrate lowest-priority unreferenced buffers to the next tier
+        until this store holds <= target_size bytes.  Returns bytes spilled
+        (RapidsBufferStore.synchronousSpill, RapidsBufferStore.scala:141-241).
+        """
+        spilled = 0
+        while True:
+            with self._lock:
+                if self._size <= target_size:
+                    return spilled
+                victim = self._pick_victim()
+                if victim is None:
+                    return spilled  # nothing spillable (all referenced)
+                self._buffers.pop(victim.id)
+                self._queue.remove(victim.id)
+                self._size -= victim.size_bytes
+            # migrate outside the store lock, pinned by the buffer lock; the
+            # timeout bounds any cross-wait with a concurrent reader
+            if not victim.lock.acquire(timeout=1.0):
+                self.track(victim)
+                return spilled
+            try:
+                if victim.freed:
+                    continue
+                if victim.ref_count > 0:  # acquired since we picked it
+                    self.track(victim)
+                    continue
+                self._spill_one(victim)
+                spilled += victim.size_bytes
+            finally:
+                victim.lock.release()
+
+    def _pick_victim(self) -> Optional[SpillableBuffer]:
+        # scan from the head of the priority queue for an unreferenced buffer
+        skipped: List[int] = []
+        victim = None
+        while True:
+            bid = self._queue.poll()
+            if bid is None:
+                break
+            b = self._buffers[bid]
+            if b.ref_count == 0:
+                victim = b
+                break
+            skipped.append(bid)
+        for bid in skipped:
+            self._queue.offer(bid)
+        if victim is not None:
+            self._queue.offer(victim.id)  # restored; caller removes
+        return victim
+
+    def _spill_one(self, buf: SpillableBuffer) -> None:
+        assert self.spill_store is not None, \
+            f"{type(self).__name__} has no spill target"
+        self._release_payload_to(buf, self.spill_store)
+        self.spill_store.track(buf)
+
+    def _release_payload_to(self, buf: SpillableBuffer,
+                            dest: "BufferStore") -> None:
+        raise NotImplementedError
+
+
+class DeviceMemoryStore(BufferStore):
+    """HBM tier (RapidsDeviceMemoryStore.scala; addTable at :40)."""
+
+    tier = StorageTier.DEVICE
+
+    def add_batch(self, batch: ColumnarBatch,
+                  spill_priority: float = SpillPriorities.DEFAULT_PRIORITY,
+                  buffer_id: Optional[int] = None) -> SpillableBuffer:
+        leaves_size = batch.device_size_bytes()
+        bid = buffer_id if buffer_id is not None else fresh_buffer_id()
+        meta = BatchMeta(batch.schema, batch.capacity, [], (batch.capacity,),
+                         leaves_size)
+        buf = SpillableBuffer(bid, meta, spill_priority)
+        buf.device_batch = batch
+        self.track(buf)
+        self.catalog.register(buf)
+        return buf
+
+    def _release_payload_to(self, buf: SpillableBuffer,
+                            dest: BufferStore) -> None:
+        leaves, meta = batch_to_host(buf.device_batch)
+        meta.size_bytes = host_leaves_nbytes(leaves)
+        buf.meta = meta
+        buf.host_leaves = leaves
+        buf.device_batch = None  # drop the jnp refs -> XLA can reuse HBM
+
+
+class HostMemoryStore(BufferStore):
+    """Bounded host tier (RapidsHostMemoryStore.scala;
+    spark.rapids.memory.host.spillStorageSize)."""
+
+    tier = StorageTier.HOST
+
+    def __init__(self, catalog: "BufferCatalog", max_size: int):
+        super().__init__(catalog)
+        self.max_size = max_size
+
+    def track(self, buf: SpillableBuffer) -> None:
+        # make room first: host tier is bounded, overflow goes to disk
+        if self.spill_store is not None \
+                and self.current_size + buf.size_bytes > self.max_size:
+            self.synchronous_spill(max(0, self.max_size - buf.size_bytes))
+        super().track(buf)
+
+    def _release_payload_to(self, buf: SpillableBuffer,
+                            dest: BufferStore) -> None:
+        assert isinstance(dest, DiskStore)
+        path = dest.path_for(buf.id)
+        write_leaves(path, buf.host_leaves)
+        buf.disk_path = path
+        buf.host_leaves = None
+
+
+class DiskStore(BufferStore):
+    """Disk tier (RapidsDiskStore.scala + RapidsDiskBlockManager.scala):
+    buffer id -> local spill file."""
+
+    tier = StorageTier.DISK
+
+    def __init__(self, catalog: "BufferCatalog",
+                 spill_dir: Optional[str] = None):
+        super().__init__(catalog)
+        self._dir = spill_dir or tempfile.mkdtemp(prefix="tpu_spill_")
+
+    def path_for(self, buffer_id: int) -> str:
+        return os.path.join(self._dir, f"tpu_buffer_{buffer_id}.bin")
+
+    def _release_payload_to(self, buf, dest):  # pragma: no cover
+        raise RuntimeError("disk is the last tier")
+
+    def delete_file(self, buf: SpillableBuffer) -> None:
+        if buf.disk_path and os.path.exists(buf.disk_path):
+            os.unlink(buf.disk_path)
+        buf.disk_path = None
+
+
+class BufferCatalog:
+    """id -> buffer registry with ref-counted acquire
+    (RapidsBufferCatalog.scala:30-52)."""
+
+    def __init__(self):
+        self._buffers: Dict[int, SpillableBuffer] = {}
+        self._lock = threading.RLock()
+
+    def register(self, buf: SpillableBuffer) -> None:
+        with self._lock:
+            if buf.id in self._buffers:
+                raise ValueError(f"duplicate buffer id {buf.id}")
+            self._buffers[buf.id] = buf
+
+    def acquire(self, buffer_id: int) -> SpillableBuffer:
+        """Pin the buffer against spilling; caller must `release`."""
+        with self._lock:
+            buf = self._buffers.get(buffer_id)
+        if buf is None:
+            raise KeyError(f"unknown buffer {buffer_id}")
+        with buf.lock:  # waits out any in-flight migration
+            if buf.freed:
+                raise KeyError(f"unknown buffer {buffer_id}")
+            buf.ref_count += 1
+            return buf
+
+    def release(self, buf: SpillableBuffer) -> None:
+        with buf.lock:
+            assert buf.ref_count > 0, f"over-release of {buf!r}"
+            buf.ref_count -= 1
+
+    def lookup_tier(self, buffer_id: int) -> StorageTier:
+        with self._lock:
+            return self._buffers[buffer_id].tier
+
+    def remove(self, buffer_id: int) -> Optional[SpillableBuffer]:
+        with self._lock:
+            buf = self._buffers.pop(buffer_id, None)
+            if buf is not None:
+                buf.freed = True
+            return buf
+
+    def ids(self):
+        with self._lock:
+            return list(self._buffers)

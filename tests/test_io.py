@@ -256,3 +256,30 @@ def test_csv_single_string_column_null_row(tmp_path):
     assert len(rows) == 4, rows
     assert sorted(rows, key=str) == sorted([(v,) for v in data["s"]],
                                            key=str), rows
+
+
+def test_csv_header_option_string_false(tmp_path):
+    """Spark-style string options: header="false" must mean False."""
+    p = tmp_path / "h.csv"
+    p.write_text("1,x\n2,y\n")
+    schema = T.Schema([T.StructField("a", T.LongType),
+                       T.StructField("b", T.StringType)])
+    from spark_rapids_tpu.engine import TpuSession
+    s = TpuSession({})
+    rows = s.read.option("header", "false").csv(str(p), schema=schema) \
+        .collect()
+    assert rows == [(1, "x"), (2, "y")], rows
+
+
+def test_partitioned_read_user_schema_includes_partition_col(tmp_path):
+    """A user schema naming the Hive partition column must work: the column
+    comes from the directory names, not the data files."""
+    from spark_rapids_tpu.engine import TpuSession
+    out = str(tmp_path / "byp")
+    s = TpuSession({})
+    data = {"p": [1, 2, 1], "v": [10.0, 20.0, 30.0]}
+    s.from_pydict(data).write.partition_by("p").csv(out)
+    full = T.Schema([T.StructField("v", T.DoubleType),
+                     T.StructField("p", T.LongType)])
+    rows = sorted(s.read.csv(out, schema=full, header=True).collect())
+    assert rows == [(10.0, 1), (20.0, 2), (30.0, 1)], rows

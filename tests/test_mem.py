@@ -1,0 +1,227 @@
+"""Memory runtime tests (SURVEY.md §2.4 / §4 tier 1 memory-subsystem suites:
+RapidsBufferCatalogSuite, RapidsDeviceMemoryStoreSuite, GpuSemaphoreSuite,
+TestHashedPriorityQueue)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import Column, ColumnarBatch
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.mem import (HashedPriorityQueue, SpillPriorities,
+                                  StorageTier, TpuRuntime, TpuSemaphore)
+from spark_rapids_tpu.types import DoubleType, LongType, Schema, StructField
+
+
+def make_batch(n=100, cap=1024, seed=0):
+    rng = np.random.RandomState(seed)
+    schema = Schema([StructField("a", LongType), StructField("b", DoubleType)])
+    return ColumnarBatch.from_pydict(
+        {"a": rng.randint(0, 50, n).tolist(),
+         "b": rng.uniform(-5, 5, n).tolist()}, schema, capacity=cap)
+
+
+def batch_rows(b):
+    return b.to_pylist()
+
+
+# ---- HashedPriorityQueue ----------------------------------------------------
+
+class TestHashedPriorityQueue:
+    def test_offer_poll_order(self):
+        prios = {"a": 3.0, "b": 1.0, "c": 2.0}
+        q = HashedPriorityQueue(lambda k: prios[k])
+        for k in prios:
+            q.offer(k)
+        assert [q.poll(), q.poll(), q.poll()] == ["b", "c", "a"]
+        assert q.poll() is None
+
+    def test_update_priority(self):
+        prios = {"a": 1.0, "b": 2.0, "c": 3.0}
+        q = HashedPriorityQueue(lambda k: prios[k])
+        for k in prios:
+            q.offer(k)
+        prios["a"] = 10.0
+        q.update_priority("a")
+        assert q.poll() == "b"
+        prios["c"] = 0.0
+        q.update_priority("c")
+        assert q.poll() == "c"
+        assert q.poll() == "a"
+
+    def test_remove(self):
+        prios = {"a": 1.0, "b": 2.0}
+        q = HashedPriorityQueue(lambda k: prios[k])
+        q.offer("a")
+        q.offer("b")
+        assert q.remove("a")
+        assert not q.remove("a")
+        assert q.poll() == "b"
+
+    def test_many_random(self):
+        rng = np.random.RandomState(7)
+        prios = {i: float(rng.uniform(0, 1)) for i in range(200)}
+        q = HashedPriorityQueue(lambda k: prios[k])
+        for k in prios:
+            q.offer(k)
+        # random priority updates
+        for k in rng.choice(200, 50, replace=False):
+            prios[int(k)] = float(rng.uniform(0, 1))
+            q.update_priority(int(k))
+        out = []
+        while len(q):
+            out.append(q.poll())
+        assert out == sorted(prios, key=lambda k: prios[k])
+
+
+# ---- catalog + spill --------------------------------------------------------
+
+class TestSpillFramework:
+    def runtime(self, pool=1 << 20, host=1 << 20, tmpdir=None):
+        conf = TpuConf({"spark.rapids.memory.host.spillStorageSize": host})
+        return TpuRuntime(conf, pool_limit_bytes=pool, spill_dir=tmpdir)
+
+    def test_add_get_roundtrip(self):
+        rt = self.runtime()
+        b = make_batch()
+        want = batch_rows(b)
+        bid = rt.add_batch(b)
+        got = rt.get_batch(bid)
+        assert batch_rows(got) == want
+
+    def test_spill_device_to_host_roundtrip(self):
+        rt = self.runtime()
+        b = make_batch(seed=1)
+        want = batch_rows(b)
+        bid = rt.add_batch(b)
+        spilled = rt.device_store.synchronous_spill(0)
+        assert spilled > 0
+        assert rt.catalog.lookup_tier(bid) == StorageTier.HOST
+        assert rt.device_store.current_size == 0
+        got = rt.get_batch(bid)
+        assert batch_rows(got) == want
+
+    def test_spill_through_to_disk(self, tmp_path):
+        rt = self.runtime(host=1, tmpdir=str(tmp_path))  # host tier ~disabled
+        b = make_batch(seed=2)
+        want = batch_rows(b)
+        bid = rt.add_batch(b)
+        rt.device_store.synchronous_spill(0)
+        # host store is bounded at 1 byte: buffer lands on disk next track
+        rt.host_store.synchronous_spill(0)
+        assert rt.catalog.lookup_tier(bid) == StorageTier.DISK
+        got = rt.get_batch(bid)
+        assert batch_rows(got) == want
+
+    def test_oom_triggers_spill(self):
+        b1, b2 = make_batch(seed=3), make_batch(seed=4)
+        size = b1.device_size_bytes()
+        rt = self.runtime(pool=int(size * 1.5))
+        id1 = rt.add_batch(b1)
+        id2 = rt.add_batch(b2)  # must force b1 to spill
+        assert rt.catalog.lookup_tier(id1) == StorageTier.HOST
+        assert rt.catalog.lookup_tier(id2) == StorageTier.DEVICE
+
+    def test_pool_exhausted_raises(self):
+        b = make_batch()
+        rt = self.runtime(pool=10)  # tiny pool, nothing to spill
+        with pytest.raises(MemoryError):
+            rt.add_batch(b)
+
+    def test_acquired_buffer_not_spilled(self):
+        rt = self.runtime()
+        b = make_batch(seed=5)
+        bid = rt.add_batch(b)
+        buf = rt.catalog.acquire(bid)
+        try:
+            spilled = rt.device_store.synchronous_spill(0)
+            assert spilled == 0
+            assert rt.catalog.lookup_tier(bid) == StorageTier.DEVICE
+        finally:
+            rt.catalog.release(buf)
+        assert rt.device_store.synchronous_spill(0) > 0
+
+    def test_spill_priority_order(self):
+        rt = self.runtime()
+        b1, b2 = make_batch(seed=6), make_batch(seed=7)
+        id1 = rt.add_batch(b1, SpillPriorities.ACTIVE_ON_DECK_PRIORITY)
+        id2 = rt.add_batch(
+            b2, SpillPriorities.OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
+        # spill one buffer's worth: the shuffle-output one must go first
+        rt.device_store.synchronous_spill(rt.device_store.current_size - 1)
+        assert rt.catalog.lookup_tier(id2) == StorageTier.HOST
+        assert rt.catalog.lookup_tier(id1) == StorageTier.DEVICE
+
+    def test_update_priority_changes_victim(self):
+        rt = self.runtime()
+        id1 = rt.add_batch(make_batch(seed=8), 1.0)
+        id2 = rt.add_batch(make_batch(seed=9), 2.0)
+        rt.update_priority(id1, 100.0)
+        rt.device_store.synchronous_spill(rt.device_store.current_size - 1)
+        assert rt.catalog.lookup_tier(id2) == StorageTier.HOST
+        assert rt.catalog.lookup_tier(id1) == StorageTier.DEVICE
+
+    def test_free_removes_everywhere(self, tmp_path):
+        rt = self.runtime(tmpdir=str(tmp_path))
+        bid = rt.add_batch(make_batch(seed=10))
+        rt.device_store.synchronous_spill(0)
+        rt.host_store.synchronous_spill(0)
+        buf = rt.catalog.acquire(bid)
+        path = buf.disk_path
+        rt.catalog.release(buf)
+        assert path is not None
+        rt.free_batch(bid)
+        import os
+        assert not os.path.exists(path)
+        with pytest.raises(KeyError):
+            rt.get_batch(bid)
+
+    def test_unknown_buffer_raises(self):
+        rt = self.runtime()
+        with pytest.raises(KeyError):
+            rt.get_batch(999999)
+
+
+# ---- semaphore --------------------------------------------------------------
+
+class TestSemaphore:
+    def test_reentrant(self):
+        s = TpuSemaphore(1)
+        s.acquire_if_necessary("t1")
+        s.acquire_if_necessary("t1")  # must not deadlock
+        assert s.active_tasks() == 1
+        s.release_if_necessary("t1")
+        assert s.active_tasks() == 1
+        s.release_if_necessary("t1")
+        assert s.active_tasks() == 0
+
+    def test_caps_concurrency(self):
+        s = TpuSemaphore(2)
+        running = []
+        peak = [0]
+        lock = threading.Lock()
+
+        def task(tid):
+            s.acquire_if_necessary(tid)
+            with lock:
+                running.append(tid)
+                peak[0] = max(peak[0], len(running))
+            time.sleep(0.02)
+            with lock:
+                running.remove(tid)
+            s.task_done(tid)
+
+        threads = [threading.Thread(target=task, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert peak[0] <= 2
+        assert s.active_tasks() == 0
+
+    def test_held_context(self):
+        s = TpuSemaphore(1)
+        with s.held("a"):
+            assert s.active_tasks() == 1
+        assert s.active_tasks() == 0
