@@ -214,6 +214,21 @@ class DataFrame:
         return DataFrame(self.session, L.LogicalRepartition(
             n, keys, self.plan, mode))
 
+    def repartition_by_range(self, n: int, *orders) -> "DataFrame":
+        keys, asc, nf = [], [], []
+        for o in orders:
+            if isinstance(o, str):
+                o = SortOrder(col(o))
+            elif not isinstance(o, SortOrder):
+                o = SortOrder(o)
+            keys.append(o.child)
+            asc.append(o.ascending)
+            nf.append(o.effective_nulls_first)
+        return DataFrame(self.session, L.LogicalRepartition(
+            n, keys, self.plan, "range", asc, nf))
+
+    repartitionByRange = repartition_by_range
+
     # -- actions ------------------------------------------------------------
     @property
     def schema(self) -> Schema:
@@ -230,7 +245,7 @@ class DataFrame:
         physical = self.session.plan(self.plan)
         if isinstance(physical, TpuExec):
             physical = B.DeviceToHostExec(physical)
-        ctx = ExecContext(self.session.conf)
+        ctx = ExecContext(self.session.conf, runtime=self.session.runtime)
         tables = list(physical.execute_cpu(ctx))
         if not tables:
             from .types import to_arrow
@@ -264,7 +279,7 @@ class DataFrame:
                 f"set {C.EXPORT_COLUMNAR_RDD.key}=true to export device "
                 "columnar data")
         physical = self.session.plan(self.plan)
-        ctx = ExecContext(self.session.conf)
+        ctx = ExecContext(self.session.conf, runtime=self.session.runtime)
         if isinstance(physical, TpuExec):
             yield from physical.execute(ctx)
         else:

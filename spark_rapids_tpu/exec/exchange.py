@@ -1,0 +1,116 @@
+"""Shuffle exchange exec: partition -> split -> shuffle manager round trip.
+
+TPU-native analogue of GpuShuffleExchangeExec
+(rapids/GpuShuffleExchangeExec.scala:60-155 + Plugin.scala:54-130): partition
+indexes are computed ON DEVICE (murmur3 hash / range bounds / round robin /
+single), the batch is contiguous-split on device (one sort + one counts
+sync), and each partition slice is cached in the device-resident shuffle
+store (spillable) until the read side drains it.
+
+The CPU fallback half lives in exec/cpu_relational.CpuRepartitionExec.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+from ..columnar import ColumnarBatch, concat_batches
+from ..ops import expressions as E
+from ..shuffle.manager import get_shuffle_env
+from ..shuffle.partition import (hash_partition_ids, range_partition_ids,
+                                 round_robin_partition_ids,
+                                 sample_range_bounds, single_partition_ids,
+                                 split_by_partition)
+from .base import ExecContext, ExecNode, TpuExec
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    """mode: hash | round_robin | range | single."""
+
+    coalesce_after = True
+
+    def __init__(self, mode: str, keys: Sequence[E.Expression],
+                 num_partitions: int, child: ExecNode,
+                 ascending: Optional[List[bool]] = None,
+                 nulls_first: Optional[List[bool]] = None):
+        super().__init__(child)
+        assert mode in ("hash", "round_robin", "range", "single"), mode
+        self.mode = mode
+        self.keys = list(keys)
+        self.num_partitions = max(1, int(num_partitions))
+        self.ascending = ascending or [True] * len(self.keys)
+        self.nulls_first = nulls_first or [True] * len(self.keys)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return (f"TpuShuffleExchangeExec[{self.mode}, "
+                f"n={self.num_partitions}]")
+
+    def _partition_ids(self, batch: ColumnarBatch, map_id: int, bounds):
+        n = self.num_partitions
+        if n == 1 or self.mode == "single":
+            return single_partition_ids(batch.capacity)
+        if self.mode == "hash":
+            key_cols = [e.eval(batch) for e in self.keys]
+            return hash_partition_ids(key_cols, n)
+        if self.mode == "round_robin":
+            return round_robin_partition_ids(batch.capacity, n, map_id)
+        if self.mode == "range":
+            if bounds is None:
+                return single_partition_ids(batch.capacity)
+            return range_partition_ids(batch, self.keys, self.ascending,
+                                       self.nulls_first, bounds)
+        raise AssertionError(self.mode)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        env = get_shuffle_env(ctx.runtime, ctx.conf) if ctx.runtime else None
+        if env is None:
+            from ..mem.runtime import TpuRuntime
+            ctx.runtime = TpuRuntime(ctx.conf)
+            env = get_shuffle_env(ctx.runtime, ctx.conf)
+        sid = env.new_shuffle_id()
+        n = self.num_partitions
+
+        child_batches = self.children[0].execute(ctx)
+        bounds = None
+        if self.mode == "range" and n > 1:
+            # range bounds need a pass over the data (reference reservoir-
+            # samples on the host: GpuRangePartitioner.scala:42-216)
+            child_batches = list(child_batches)
+            bounds = sample_range_bounds(child_batches, self.keys,
+                                         self.ascending, self.nulls_first, n)
+
+        num_writes = 0
+        with self.metrics.timer("shuffleWriteTime"):
+            for map_id, batch in enumerate(child_batches):
+                pids = self._partition_ids(batch, map_id, bounds)
+                for p, sub in split_by_partition(batch, pids, n):
+                    env.write_partition(sid, map_id, p, sub)
+                    num_writes += 1
+        self.metrics.add("numPartitionsWritten", num_writes)
+
+        try:
+            with self.metrics.timer("shuffleReadTime"):
+                for p in range(n):
+                    parts = list(env.fetch_partition(sid, p))
+                    if not parts:
+                        continue
+                    out = parts[0] if len(parts) == 1 \
+                        else concat_batches(parts)
+                    self.metrics.add("numOutputBatches", 1)
+                    yield out
+        finally:
+            env.remove_shuffle(sid)
+
+
+def make_repartition_exec(plan, keys, child: ExecNode,
+                          on_tpu: bool) -> ExecNode:
+    """Planner hook (plan/physical.py) for LogicalRepartition."""
+    mode = plan.mode
+    if mode == "hash" and not keys:
+        mode = "round_robin"
+    return TpuShuffleExchangeExec(mode, keys, plan.num_partitions, child,
+                                  getattr(plan, "ascending", None),
+                                  getattr(plan, "nulls_first", None))

@@ -1,0 +1,75 @@
+"""Best-fit address-space sub-allocator.
+
+TPU-native analogue of the reference's AddressSpaceAllocator
+(sql-plugin/.../rapids/AddressSpaceAllocator.scala:22-150): carves variable
+sized blocks out of one fixed address range.  Used by the shuffle transport's
+bounce-buffer pool to hand out staging slices from one pre-allocated host
+buffer without fragmentation surprises.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class AddressSpaceAllocator:
+    """Best-fit allocator over [0, size).  Thread-safe."""
+
+    def __init__(self, size: int):
+        assert size > 0
+        self.size = size
+        # free blocks: start -> length (kept coalesced)
+        self._free: Dict[int, int] = {0: size}
+        self._allocated: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def allocate(self, length: int) -> Optional[int]:
+        """Returns the start address, or None if no block fits."""
+        if length <= 0:
+            return None
+        with self._lock:
+            best: Optional[int] = None
+            best_len = None
+            for start, flen in self._free.items():
+                if flen >= length and (best_len is None or flen < best_len):
+                    best, best_len = start, flen
+            if best is None:
+                return None
+            del self._free[best]
+            if best_len > length:
+                self._free[best + length] = best_len - length
+            self._allocated[best] = length
+            return best
+
+    def free(self, address: int) -> int:
+        """Release a block; returns its length.  Coalesces neighbours."""
+        with self._lock:
+            length = self._allocated.pop(address, None)
+            if length is None:
+                raise ValueError(f"free of unallocated address {address}")
+            start, flen = address, length
+            # merge with following free block
+            nxt = start + flen
+            if nxt in self._free:
+                flen += self._free.pop(nxt)
+            # merge with preceding free block
+            for fs in list(self._free):
+                if fs + self._free[fs] == start:
+                    start, flen = fs, self._free.pop(fs) + flen
+                    break
+            self._free[start] = flen
+            return length
+
+    @property
+    def allocated_bytes(self) -> int:
+        with self._lock:
+            return sum(self._allocated.values())
+
+    @property
+    def available_bytes(self) -> int:
+        with self._lock:
+            return sum(self._free.values())
+
+    def largest_free_block(self) -> int:
+        with self._lock:
+            return max(self._free.values(), default=0)

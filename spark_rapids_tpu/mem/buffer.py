@@ -35,11 +35,13 @@ class StorageTier(enum.IntEnum):
 
 class SpillPriorities:
     """Ordering constants (reference: SpillPriorities.scala).  Lower spills
-    first."""
+    first.  Magnitudes are 1e15, not 2^63 like the reference's Longs: these
+    are float64 priorities, and the ulp at 1e15 is 0.125, so +sequence-number
+    increments (oldest-first ordering among shuffle outputs) stay exact."""
     # Buffers actively being used as task input: spill dead last.
-    ACTIVE_ON_DECK_PRIORITY = float(2 ** 60)
+    ACTIVE_ON_DECK_PRIORITY = 1e15
     # Output buffers waiting to be shuffled: spill first, oldest first.
-    OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY = float(-(2 ** 60))
+    OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY = -1e15
     # Everything else defaults in between.
     DEFAULT_PRIORITY = 0.0
 

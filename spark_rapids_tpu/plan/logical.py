@@ -435,10 +435,16 @@ class LogicalDistinct(LogicalPlan):
 
 class LogicalRepartition(LogicalPlan):
     def __init__(self, num_partitions: int, keys: Sequence[ColumnExpr],
-                 child: LogicalPlan, mode: str = "hash"):
+                 child: LogicalPlan, mode: str = "hash",
+                 ascending: Optional[Sequence[bool]] = None,
+                 nulls_first: Optional[Sequence[bool]] = None):
         self.num_partitions = num_partitions
         self.keys = list(keys)
         self.mode = mode  # hash | round_robin | range | single
+        self.ascending = list(ascending) if ascending is not None \
+            else [True] * len(self.keys)
+        self.nulls_first = list(nulls_first) if nulls_first is not None \
+            else list(self.ascending)
         self.children = (child,)
 
 

@@ -1,0 +1,27 @@
+"""Shuffle layer: device partitioners, device-resident shuffle manager,
+transport SPI (loopback + ICI collectives).
+
+The TPU analogue of the reference's L2 shuffle (SURVEY.md §2.8): baseline
+columnar shuffle + RapidsShuffleManager with UCX transport become a
+spillable device-resident block store with a loopback wire for host-driven
+mode and XLA all_to_all over ICI for SPMD mesh mode.
+"""
+from .catalog import (ShuffleBlockId, ShuffleBufferCatalog,
+                      ShuffleReceivedBufferCatalog)
+from .manager import ShuffleEnv, ShuffleServer, get_shuffle_env
+from .partition import (hash_partition_ids, range_partition_ids,
+                        round_robin_partition_ids, sample_range_bounds,
+                        single_partition_ids, split_by_partition)
+from .transport import (BounceBufferPool, InflightThrottle, LoopbackTransport,
+                        MetadataRequest, MetadataResponse, ShuffleTransport,
+                        Transaction, TransactionStatus)
+
+__all__ = [
+    "ShuffleBlockId", "ShuffleBufferCatalog", "ShuffleReceivedBufferCatalog",
+    "ShuffleEnv", "ShuffleServer", "get_shuffle_env",
+    "hash_partition_ids", "range_partition_ids", "round_robin_partition_ids",
+    "sample_range_bounds", "single_partition_ids", "split_by_partition",
+    "BounceBufferPool", "InflightThrottle", "LoopbackTransport",
+    "MetadataRequest", "MetadataResponse", "ShuffleTransport",
+    "Transaction", "TransactionStatus",
+]

@@ -1,0 +1,78 @@
+"""Shuffle block catalogs: block id -> spillable buffer ids + metadata.
+
+TPU-native analogue of ShuffleBufferCatalog / ShuffleReceivedBufferCatalog
+(sql-plugin/.../rapids/ShuffleBufferCatalog.scala:1-211,
+ShuffleReceivedBufferCatalog.scala): the writer side maps each
+(shuffle, map, reduce) block to the list of spillable buffers holding its
+batches; the reader side registers buffers received from peers.  Both sit on
+top of the mem.BufferCatalog, so shuffle data participates in
+device->host->disk spill like everything else.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True, order=True)
+class ShuffleBlockId:
+    shuffle_id: int
+    map_id: int
+    reduce_id: int
+
+
+class ShuffleBufferCatalog:
+    """Writer-side registry (one per executor/ShuffleEnv)."""
+
+    def __init__(self):
+        self._blocks: Dict[ShuffleBlockId, List[int]] = {}
+        self._by_shuffle: Dict[int, List[ShuffleBlockId]] = {}
+        self._lock = threading.Lock()
+
+    def add_buffer(self, block: ShuffleBlockId, buffer_id: int) -> None:
+        with self._lock:
+            if block not in self._blocks:
+                self._blocks[block] = []
+                self._by_shuffle.setdefault(block.shuffle_id, []).append(block)
+            self._blocks[block].append(buffer_id)
+
+    def buffers_for(self, block: ShuffleBlockId) -> List[int]:
+        with self._lock:
+            return list(self._blocks.get(block, []))
+
+    def blocks_for_reduce(self, shuffle_id: int,
+                          reduce_id: int) -> List[ShuffleBlockId]:
+        with self._lock:
+            return sorted(b for b in self._by_shuffle.get(shuffle_id, [])
+                          if b.reduce_id == reduce_id)
+
+    def remove_shuffle(self, shuffle_id: int) -> List[int]:
+        """Unregister every block of a shuffle; returns the buffer ids to
+        free."""
+        with self._lock:
+            blocks = self._by_shuffle.pop(shuffle_id, [])
+            freed: List[int] = []
+            for blk in blocks:
+                freed.extend(self._blocks.pop(blk, []))
+            return freed
+
+    def has_shuffle(self, shuffle_id: int) -> bool:
+        with self._lock:
+            return shuffle_id in self._by_shuffle
+
+
+class ShuffleReceivedBufferCatalog:
+    """Reader-side registry for buffers fetched from remote executors."""
+
+    def __init__(self):
+        self._received: Dict[int, List[int]] = {}   # shuffle_id -> buffer ids
+        self._lock = threading.Lock()
+
+    def add(self, shuffle_id: int, buffer_id: int) -> None:
+        with self._lock:
+            self._received.setdefault(shuffle_id, []).append(buffer_id)
+
+    def remove_shuffle(self, shuffle_id: int) -> List[int]:
+        with self._lock:
+            return self._received.pop(shuffle_id, [])

@@ -1,0 +1,227 @@
+"""Device-resident shuffle manager: writer, reader, server.
+
+TPU-native analogue of RapidsShuffleInternalManager + RapidsCachingWriter /
+RapidsCachingReader (org/.../rapids/RapidsShuffleInternalManager.scala:73-337,
+RapidsCachingReader.scala:49-170) and GpuShuffleEnv (GpuShuffleEnv.scala:
+57-107):
+
+  * write side caches each partition's batch as a SPILLABLE buffer in the
+    device store (shuffle data never leaves HBM unless memory pressure
+    spills it) and registers it in the ShuffleBufferCatalog;
+  * read side serves local blocks straight from the catalog (zero copy when
+    still in HBM) and fetches remote blocks through the transport, which
+    re-serves spilled buffers from whatever tier they occupy;
+  * a baseline host-serialized path mirrors the reference's always-available
+    non-UCX shuffle (GpuColumnarBatchSerializer.scala).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import ColumnarBatch
+from ..config import (SHUFFLE_DEVICE_RESIDENT, SHUFFLE_MAX_RECV_INFLIGHT,
+                      TpuConf)
+from ..mem.buffer import (SpillPriorities, StorageTier, batch_to_host,
+                          host_to_batch, read_leaves)
+from ..mem.runtime import TpuRuntime
+from .catalog import (ShuffleBlockId, ShuffleBufferCatalog,
+                      ShuffleReceivedBufferCatalog)
+from .transport import (LoopbackTransport, MetadataRequest, MetadataResponse,
+                        BlockMeta, ShuffleTransport)
+
+
+class ShuffleServer:
+    """Serves this executor's shuffle buffers to peers, from ANY storage
+    tier (RapidsShuffleServer.scala:67-671: BufferSendState acquires
+    possibly-spilled buffers and streams them through bounce buffers)."""
+
+    def __init__(self, env: "ShuffleEnv"):
+        self.env = env
+        self._cache: Dict[int, Tuple[list, object]] = {}
+        self._lock = threading.Lock()
+
+    def handle_metadata_request(self, request: MetadataRequest
+                                ) -> MetadataResponse:
+        blocks = request.blocks
+        if blocks is None:  # wildcard discovery for one reduce partition
+            blocks = self.env.catalog.blocks_for_reduce(
+                request.shuffle_id, request.reduce_id)
+        out: List[BlockMeta] = []
+        for block in blocks:
+            buffer_ids = self.env.catalog.buffers_for(block)
+            metas, sizes = [], []
+            for bid in buffer_ids:
+                baseline = self.env.baseline_leaves(bid)
+                if baseline is not None:
+                    metas.append(baseline[1])
+                    sizes.append(baseline[1].size_bytes)
+                    continue
+                buf = self.env.runtime.catalog.acquire(bid)
+                try:
+                    metas.append(buf.meta)
+                    sizes.append(buf.size_bytes)
+                finally:
+                    self.env.runtime.catalog.release(buf)
+            out.append(BlockMeta(block, buffer_ids, metas, sizes))
+        return MetadataResponse(out)
+
+    def _leaves(self, buffer_id: int):
+        """Host-side leaves of a buffer, whatever its tier (no promotion —
+        serving a spilled buffer must not re-inflate HBM)."""
+        with self._lock:
+            hit = self._cache.get(buffer_id)
+            if hit is not None:
+                return hit
+        baseline = self.env.baseline_leaves(buffer_id)
+        if baseline is not None:
+            leaves, meta = baseline
+        else:
+            buf = self.env.runtime.catalog.acquire(buffer_id)
+            try:
+                with buf.lock:
+                    if buf.tier == StorageTier.DEVICE:
+                        leaves, meta = batch_to_host(buf.device_batch)
+                    elif buf.tier == StorageTier.HOST:
+                        leaves, meta = buf.host_leaves, buf.meta
+                    else:
+                        leaves, meta = read_leaves(buf.disk_path, buf.meta), \
+                            buf.meta
+            finally:
+                self.env.runtime.catalog.release(buf)
+        with self._lock:
+            if len(self._cache) >= 4:  # bounded serving cache
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[buffer_id] = (leaves, meta)
+        return leaves, meta
+
+    def buffer_layout(self, buffer_id: int):
+        leaves, meta = self._leaves(buffer_id)
+        layout = [(a.shape, a.dtype.str, a.nbytes) for a in leaves]
+        return layout, meta
+
+    def copy_leaf_chunk(self, buffer_id: int, leaf_idx: int, offset: int,
+                        length: int, dest: np.ndarray) -> None:
+        leaves, _ = self._leaves(buffer_id)
+        flat = np.ascontiguousarray(leaves[leaf_idx]).view(np.uint8).reshape(-1)
+        dest[:length] = flat[offset:offset + length]
+
+    def done_serving(self, buffer_id: int) -> None:
+        with self._lock:
+            self._cache.pop(buffer_id, None)
+
+
+class ShuffleEnv:
+    """Per-executor shuffle wiring (GpuShuffleEnv equivalent)."""
+
+    def __init__(self, runtime: TpuRuntime, conf: Optional[TpuConf] = None,
+                 executor_id: str = "exec-0",
+                 transport: Optional[ShuffleTransport] = None):
+        self.runtime = runtime
+        self.conf = conf or TpuConf()
+        self.executor_id = executor_id
+        self.device_resident = bool(self.conf.get(SHUFFLE_DEVICE_RESIDENT))
+        self.catalog = ShuffleBufferCatalog()
+        self.received = ShuffleReceivedBufferCatalog()
+        if transport is None:
+            transport = LoopbackTransport(
+                max_inflight_bytes=int(
+                    self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)))
+        self.transport = transport
+        self.server = ShuffleServer(self)
+        transport.register_server(executor_id, self.server)
+        # baseline (host-serialized) buffers share the buffer-id space with
+        # spillable ones so the catalog + server treat both uniformly
+        self._baseline_buffers: Dict[int, Tuple[list, object]] = {}
+        self._shuffle_counter = [0]
+        self._write_seq = [0]
+        self._lock = threading.Lock()
+
+    def baseline_leaves(self, buffer_id: int):
+        with self._lock:
+            return self._baseline_buffers.get(buffer_id)
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def new_shuffle_id(self) -> int:
+        with self._lock:
+            self._shuffle_counter[0] += 1
+            return self._shuffle_counter[0]
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        for bid in self.catalog.remove_shuffle(shuffle_id):
+            with self._lock:
+                if self._baseline_buffers.pop(bid, None) is not None:
+                    continue
+            self.runtime.free_batch(bid)
+        for bid in self.received.remove_shuffle(shuffle_id):
+            self.runtime.free_batch(bid)
+
+    # ---- write path (RapidsCachingWriter.write) ----------------------------
+
+    def write_partition(self, shuffle_id: int, map_id: int, reduce_id: int,
+                        batch: ColumnarBatch) -> None:
+        block = ShuffleBlockId(shuffle_id, map_id, reduce_id)
+        if self.device_resident:
+            with self._lock:
+                self._write_seq[0] += 1
+                seq = self._write_seq[0]
+            # oldest shuffle output spills first (SpillPriorities.scala)
+            prio = (SpillPriorities.OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY
+                    + float(seq))
+            bid = self.runtime.add_batch(batch, prio)
+            self.catalog.add_buffer(block, bid)
+        else:
+            from ..mem.buffer import fresh_buffer_id
+            leaves, meta = batch_to_host(batch)
+            bid = fresh_buffer_id()
+            with self._lock:
+                self._baseline_buffers[bid] = (leaves, meta)
+            self.catalog.add_buffer(block, bid)
+
+    # ---- read path (RapidsCachingReader.read) ------------------------------
+
+    def fetch_partition(self, shuffle_id: int, reduce_id: int,
+                        remote_peers: Optional[List[str]] = None
+                        ) -> Iterator[ColumnarBatch]:
+        """Local blocks from the catalog; remote blocks via transport."""
+        for block in self.catalog.blocks_for_reduce(shuffle_id, reduce_id):
+            for bid in self.catalog.buffers_for(block):
+                baseline = self.baseline_leaves(bid)
+                if baseline is not None:
+                    leaves, meta = baseline
+                    self.runtime.reserve(meta.size_bytes)
+                    yield host_to_batch(leaves, meta)
+                else:
+                    yield self.runtime.get_batch(bid)
+        for peer in remote_peers or []:
+            yield from self._fetch_remote(peer, shuffle_id, reduce_id)
+
+    def _fetch_remote(self, peer: str, shuffle_id: int, reduce_id: int
+                      ) -> Iterator[ColumnarBatch]:
+        """doFetch (RapidsShuffleClient.scala:350-770): wildcard metadata
+        request discovers the peer's blocks for this reduce partition, then
+        per-buffer receives register spillable buffers locally.  Everything
+        goes through the transport SPI — no peer-object introspection."""
+        client = self.transport.make_client(peer)
+        resp = client.fetch_metadata(MetadataRequest(
+            shuffle_id=shuffle_id, reduce_id=reduce_id))
+        for bm in resp.block_metas:
+            for bid in bm.buffer_ids:
+                leaves, meta = client.fetch_buffer(bid)
+                client.release_buffer(bid)
+                batch = host_to_batch(leaves, meta)
+                rid = self.runtime.add_batch(batch)
+                self.received.add(shuffle_id, rid)
+                yield self.runtime.get_batch(rid)
+
+
+def get_shuffle_env(runtime: TpuRuntime, conf: TpuConf) -> ShuffleEnv:
+    """Lazily attach one ShuffleEnv to a runtime (executor singleton)."""
+    env = getattr(runtime, "_shuffle_env", None)
+    if env is None:
+        env = ShuffleEnv(runtime, conf)
+        runtime._shuffle_env = env
+    return env

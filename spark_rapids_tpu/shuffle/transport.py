@@ -1,0 +1,271 @@
+"""Shuffle transport SPI: control plane, staging buffers, loopback fake.
+
+TPU-native analogue of the reference's transport stack
+(rapids/shuffle/RapidsShuffleTransport.scala:38-500 — client/server SPI,
+bounce-buffer pools, inflight-bytes throttle, Transaction lifecycle;
+RapidsShuffleClient.scala:350-770 — metadata request -> throttled buffer
+receives; RapidsShuffleServer.scala:67-671 — serve buffers from any tier
+through send bounce buffers).  The flatbuffers control messages become plain
+dataclasses; UCX tag-matched RDMA becomes: LOOPBACK (in-memory, for tests —
+the unit-testable fake the reference snapshot lacks, SURVEY.md §4) and the
+ICI all-to-all path in ici.py for mesh-resident SPMD plans.
+
+Data still moves through a bounded staging (bounce-buffer) pool with an
+inflight-bytes throttle, so the flow control logic is real even when the
+wire is memcpy.
+"""
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..mem.address_space import AddressSpaceAllocator
+from ..mem.buffer import BatchMeta
+from .catalog import ShuffleBlockId
+
+
+# ---- transaction lifecycle (RapidsShuffleTransport.scala:311-376) ----------
+
+class TransactionStatus(enum.Enum):
+    NOT_STARTED = 0
+    IN_PROGRESS = 1
+    SUCCESS = 2
+    ERROR = 3
+    CANCELLED = 4
+
+
+@dataclass
+class Transaction:
+    txn_id: int
+    status: TransactionStatus = TransactionStatus.NOT_STARTED
+    bytes_transferred: int = 0
+    error_message: Optional[str] = None
+
+    def complete(self, nbytes: int) -> None:
+        self.bytes_transferred += nbytes
+        self.status = TransactionStatus.SUCCESS
+
+    def fail(self, msg: str) -> None:
+        self.status = TransactionStatus.ERROR
+        self.error_message = msg
+
+
+# ---- control messages (the .fbs schemas, as dataclasses) -------------------
+
+@dataclass
+class MetadataRequest:
+    """Either an explicit block list, or a (shuffle_id, reduce_id) wildcard
+    asking the peer to enumerate every block it holds for that reduce
+    partition (the discovery the reference gets from MapStatus)."""
+    blocks: Optional[List[ShuffleBlockId]] = None
+    shuffle_id: Optional[int] = None
+    reduce_id: Optional[int] = None
+
+
+@dataclass
+class BlockMeta:
+    block: ShuffleBlockId
+    buffer_ids: List[int]
+    metas: List[BatchMeta]
+    sizes: List[int]
+
+
+@dataclass
+class MetadataResponse:
+    block_metas: List[BlockMeta]
+
+
+@dataclass
+class TransferRequest:
+    buffer_ids: List[int]
+
+
+# ---- bounce buffers (BounceBufferManager.scala + AddressSpaceAllocator) ----
+
+class BounceBufferPool:
+    """One pre-allocated host staging area sub-allocated into per-transfer
+    slices; acquire blocks until space frees (backpressure)."""
+
+    def __init__(self, pool_size: int, buffer_size: int = 1 << 20):
+        self.buffer_size = buffer_size
+        self._backing = np.zeros(pool_size, dtype=np.uint8)
+        self._alloc = AddressSpaceAllocator(pool_size)
+        self._cond = threading.Condition()
+
+    def acquire(self, length: int, timeout: float = 30.0) -> int:
+        """Returns the slice start address.  Blocks until available."""
+        assert length <= self._alloc.size, \
+            f"transfer slice {length} exceeds pool {self._alloc.size}"
+        with self._cond:
+            deadline = None
+            while True:
+                addr = self._alloc.allocate(length)
+                if addr is not None:
+                    return addr
+                import time
+                if deadline is None:
+                    deadline = time.monotonic() + timeout
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("bounce buffer pool exhausted")
+                self._cond.wait(remaining)
+
+    def release(self, addr: int) -> None:
+        with self._cond:
+            self._alloc.free(addr)
+            self._cond.notify_all()
+
+    def view(self, addr: int, length: int) -> np.ndarray:
+        return self._backing[addr:addr + length]
+
+
+class InflightThrottle:
+    """Caps bytes of shuffle data in flight to a receiving task
+    (spark.rapids.shuffle.maxReceiveInflightBytes;
+    UCXShuffleTransport.scala:363-471 queuePending)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._inflight = 0
+        self.peak = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, nbytes: int) -> None:
+        take = min(nbytes, self.max_bytes)  # a single huge buffer still flows
+        with self._cond:
+            while self._inflight + take > self.max_bytes:
+                self._cond.wait()
+            self._inflight += take
+            self.peak = max(self.peak, self._inflight)
+
+    def release(self, nbytes: int) -> None:
+        take = min(nbytes, self.max_bytes)
+        with self._cond:
+            self._inflight -= take
+            self._cond.notify_all()
+
+
+# ---- SPI -------------------------------------------------------------------
+
+class ShuffleTransportClient:
+    """Fetch path to one peer (RapidsShuffleClient equivalent)."""
+
+    def fetch_metadata(self, request: MetadataRequest) -> MetadataResponse:
+        raise NotImplementedError
+
+    def fetch_buffer(self, buffer_id: int
+                     ) -> Tuple[List[np.ndarray], BatchMeta]:
+        raise NotImplementedError
+
+    def release_buffer(self, buffer_id: int) -> None:
+        """Tell the peer it may drop serving state for this buffer."""
+
+
+class ShuffleTransport:
+    """Client/server factory (RapidsShuffleTransport SPI,
+    RapidsShuffleTransport.scala:378-396)."""
+
+    def make_client(self, peer_executor_id: str) -> ShuffleTransportClient:
+        raise NotImplementedError
+
+    def register_server(self, executor_id: str, server) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+# ---- loopback implementation ----------------------------------------------
+
+class LoopbackTransport(ShuffleTransport):
+    """In-process transport: peers are ShuffleServer objects in a registry.
+
+    Every byte still flows through the bounce-buffer pool in bounded chunks
+    under the inflight throttle, so flow control and reassembly are
+    exercised exactly as a wire transport would."""
+
+    def __init__(self, pool_size: int = 8 << 20, chunk_size: int = 1 << 20,
+                 max_inflight_bytes: int = 4 << 20):
+        self._servers: Dict[str, object] = {}
+        self.pool = BounceBufferPool(pool_size, chunk_size)
+        self.chunk_size = chunk_size
+        self.throttle = InflightThrottle(max_inflight_bytes)
+        self._txn_counter = [0]
+        self._lock = threading.Lock()
+
+    def register_server(self, executor_id: str, server) -> None:
+        with self._lock:
+            self._servers[executor_id] = server
+
+    def make_client(self, peer_executor_id: str) -> "LoopbackClient":
+        with self._lock:
+            server = self._servers.get(peer_executor_id)
+        if server is None:
+            raise KeyError(f"no shuffle server for peer {peer_executor_id}")
+        return LoopbackClient(self, server)
+
+    def next_txn(self) -> Transaction:
+        with self._lock:
+            self._txn_counter[0] += 1
+            return Transaction(self._txn_counter[0],
+                               TransactionStatus.IN_PROGRESS)
+
+
+class LoopbackClient(ShuffleTransportClient):
+    def __init__(self, transport: LoopbackTransport, server):
+        self.transport = transport
+        self.server = server
+
+    def fetch_metadata(self, request: MetadataRequest) -> MetadataResponse:
+        txn = self.transport.next_txn()
+        try:
+            resp = self.server.handle_metadata_request(request)
+            txn.complete(0)
+            return resp
+        except Exception as e:  # noqa: BLE001 — transaction records it
+            txn.fail(str(e))
+            raise
+
+    def release_buffer(self, buffer_id: int) -> None:
+        self.server.done_serving(buffer_id)
+
+    def fetch_buffer(self, buffer_id: int
+                     ) -> Tuple[List[np.ndarray], BatchMeta]:
+        """Pull one buffer's leaves through bounce-buffer chunks."""
+        txn = self.transport.next_txn()
+        pool = self.transport.pool
+        chunk = self.transport.chunk_size
+        leaves_meta = self.server.buffer_layout(buffer_id)
+        total = sum(nb for _, _, nb in leaves_meta[0])
+        self.transport.throttle.acquire(total)
+        try:
+            out: List[np.ndarray] = []
+            for (shape, dtype_str, nbytes) in leaves_meta[0]:
+                dest = np.empty(nbytes, dtype=np.uint8)
+                off = 0
+                while off < nbytes:
+                    length = min(chunk, nbytes - off)
+                    addr = pool.acquire(length)
+                    try:
+                        # "send": server copies into the bounce slice
+                        self.server.copy_leaf_chunk(
+                            buffer_id, len(out), off, length,
+                            pool.view(addr, length))
+                        # "recv": copy out of the bounce slice
+                        dest[off:off + length] = pool.view(addr, length)
+                    finally:
+                        pool.release(addr)
+                    off += length
+                    txn.bytes_transferred += length
+                out.append(dest.view(np.dtype(dtype_str)).reshape(shape))
+            txn.status = TransactionStatus.SUCCESS
+            return out, leaves_meta[1]
+        except Exception as e:  # noqa: BLE001
+            txn.fail(str(e))
+            raise
+        finally:
+            self.transport.throttle.release(total)
