@@ -18,3 +18,4 @@ _jax.config.update("jax_enable_x64", True)
 from . import types  # noqa: F401
 from .config import TpuConf  # noqa: F401
 from .columnar import Column, ColumnarBatch  # noqa: F401
+from .plan.logical import Window, WindowSpec  # noqa: F401

@@ -141,14 +141,51 @@ class DataFrame:
             raise KeyError(name)
         return col(name)
 
+    def _project(self, exprs) -> "DataFrame":
+        """Build a projection, splitting out window expressions (including
+        ones nested inside arithmetic, like sum(v).over(w) + 1) into
+        LogicalWindow nodes beneath the project (Spark's
+        ExtractWindowExpressions analyzer rule, in spirit)."""
+        win: list = []
+
+        def extract(e):
+            if not isinstance(e, ColumnExpr):
+                return e
+            if e.op == "WindowExpr":
+                if e._alias is None:
+                    e = e.alias(f"_w{len(win)}")
+                win.append(e)
+                return col(e.output_name)
+
+            def walk(a):
+                if isinstance(a, ColumnExpr):
+                    return extract(a)
+                if isinstance(a, (list, tuple)):
+                    return type(a)(walk(x) for x in a)
+                return a
+            new_args = tuple(walk(a) for a in e.args)
+            return ColumnExpr(e.op, new_args, alias=e._alias)
+
+        rewritten = [extract(e) for e in exprs]
+        if not win:
+            return DataFrame(self.session,
+                             L.LogicalProject(exprs, self.plan))
+        groups: dict = {}
+        for e in win:
+            spec = e.args[1]
+            groups.setdefault(spec._group_key(), (spec, []))[1].append(e)
+        child = self.plan
+        for _k, (spec, es) in groups.items():
+            child = L.LogicalWindow(es, spec.parts, spec.orders, child)
+        return DataFrame(self.session, L.LogicalProject(rewritten, child))
+
     def select(self, *cols) -> "DataFrame":
-        return DataFrame(self.session,
-                         L.LogicalProject(self._wrap_cols(cols), self.plan))
+        return self._project(self._wrap_cols(cols))
 
     def with_column(self, name: str, expr: ColumnExpr) -> "DataFrame":
         exprs = [col(n) for n in self.schema.names if n != name]
         exprs.append(expr.alias(name))
-        return DataFrame(self.session, L.LogicalProject(exprs, self.plan))
+        return self._project(exprs)
 
     withColumn = with_column
 

@@ -32,20 +32,33 @@ _I64_MIN = np.int64(-(2**63))
 _NAN_BITS = np.int64(0x7FF8000000000000)
 
 
-def float_sort_key(data) -> jnp.ndarray:
-    """Monotone int64 encoding of float64 values; NaN > +inf and
-    -0.0 == 0.0 (Spark ordering semantics)."""
+def float_sort_keys(data) -> List[jnp.ndarray]:
+    """Order keys for float64 values with Spark semantics (NaN above +inf,
+    all NaN equal, -0.0 == 0.0).
+
+    CPU backend: ONE monotone int64 bit-pattern key — exact, including
+    subnormals (XLA's flush-to-zero would make a float compare call
+    5e-324 == 0.0).
+
+    TPU (axon) backend: f64<->int bitcasts are unimplemented (f64 is an
+    emulated f32-pair), so the keys are [nan_flag, native f64 value] and
+    the comparator runs in float.  Subnormals underflow the f32-pair
+    representation to zero on this device anyway, so the float compare is
+    exact over the device's representable values."""
     d = data.astype(jnp.float64)
-    bits = jax.lax.bitcast_convert_type(d, jnp.int64)
-    # -0.0 -> 0.0 by bit pattern (a float compare would also catch
-    # subnormals under XLA's flush-to-zero); NaN above +inf
-    bits = jnp.where(bits == _I64_MIN, jnp.int64(0), bits)
-    bits = jnp.where(jnp.isnan(d), _NAN_BITS, bits)
-    return jnp.where(bits >= 0, bits, ~bits + _I64_MIN)
+    nan = jnp.isnan(d)
+    if jax.default_backend() == "cpu":
+        bits = jax.lax.bitcast_convert_type(d, jnp.int64)
+        bits = jnp.where(bits == _I64_MIN, jnp.int64(0), bits)  # -0.0 -> 0.0
+        bits = jnp.where(nan, _NAN_BITS, bits)
+        return [jnp.where(bits >= 0, bits, ~bits + _I64_MIN)]
+    v = jnp.where(nan | (d == 0.0), jnp.float64(0.0), d)
+    return [nan.astype(jnp.int32), v]
 
 
 def column_sort_keys(c: Column, ascending: bool) -> List[jnp.ndarray]:
-    """Order-preserving integer keys for one column, most-significant first.
+    """Order-preserving keys for one column, most-significant first
+    (integer keys, except a native-f64 value key for float columns).
     Null rows are zeroed (a separate null-rank key places them)."""
     if c.dtype.is_string:
         cap, L = c.data.shape
@@ -56,12 +69,14 @@ def column_sort_keys(c: Column, ascending: bool) -> List[jnp.ndarray]:
         keys = [words[:, j] for j in range(L // 8)]
         keys.append(c.lengths.astype(jnp.int64))
     elif c.dtype.is_floating:
-        keys = [float_sort_key(c.data)]
+        keys = float_sort_keys(c.data)
     else:
         keys = [c.data.astype(jnp.int64)]
     keys = [jnp.where(c.valid, k, jnp.zeros((), k.dtype)) for k in keys]
     if not ascending:
-        keys = [~k for k in keys]
+        # integers invert bitwise; float value keys invert by negation
+        keys = [(-k if jnp.issubdtype(k.dtype, jnp.floating) else ~k)
+                for k in keys]
     return keys
 
 

@@ -32,6 +32,25 @@ def mix64(x):
     return x
 
 
+def f64_bits(d):
+    """Injective int64 encoding of a float64 array's values.
+
+    On CPU this is the exact IEEE bit pattern.  On the TPU (axon) backend,
+    f64<->int bitcasts are unimplemented (f64 itself is emulated as an
+    f32-pair), so the encoding is (bits(hi_f32) << 32) | bits(lo_f32) where
+    hi = round-to-f32(d), lo = d - hi — exactly the pair the emulation
+    stores, hence injective on every value the device can represent."""
+    import jax
+    d = d.astype(jnp.float64)
+    if jax.default_backend() == "cpu":
+        return jax.lax.bitcast_convert_type(d, jnp.int64)
+    hi = d.astype(jnp.float32)
+    lo = (d - hi.astype(jnp.float64)).astype(jnp.float32)
+    hb = jax_bitcast_i32(hi).astype(jnp.int64)
+    lb = jax_bitcast_i32(lo).astype(jnp.int64)
+    return (hb << jnp.int64(32)) | (lb & jnp.int64(0xFFFFFFFF))
+
+
 def _normalize_bits(col: Column):
     """Value bits with Spark key semantics: -0.0 == 0.0, all NaN equal."""
     data = col.data
@@ -42,17 +61,12 @@ def _normalize_bits(col: Column):
         d = jnp.where(d == 0.0, jnp.float64(0.0), d)
         canonical_nan = jnp.float64(np.nan)
         d = jnp.where(jnp.isnan(d), canonical_nan, d)
-        return jax_bitcast_i64(d)
+        return f64_bits(d)
     if col.dtype.is_string:
         raise AssertionError("use string path")
     if data.dtype == jnp.bool_:
         return data.astype(jnp.int64)
     return data.astype(jnp.int64)
-
-
-def jax_bitcast_i64(x):
-    import jax
-    return jax.lax.bitcast_convert_type(x, jnp.int64)
 
 
 def hash_column64(col: Column, seed: int):
@@ -174,7 +188,9 @@ def spark_hash_column(col: Column, seed):
         d = col.data.astype(jnp.float64)
         d = jnp.where(jnp.isnan(d), jnp.float64(np.nan), d)
         d = jnp.where(d == 0.0, jnp.float64(0.0), d)  # fold-proof -0.0 fix
-        bits = jax_bitcast_i64(d)
+        # exact Spark bit parity on CPU; injective pair encoding on TPU
+        # (documented incompat: emulated f64 has no true IEEE bits)
+        bits = f64_bits(d)
         h = murmur3_long(bits, seed)
     else:
         raise NotImplementedError(f"spark hash of {dt.name}")

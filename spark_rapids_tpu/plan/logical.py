@@ -119,6 +119,11 @@ class ColumnExpr:
     def desc(self) -> "SortOrder":
         return SortOrder(self, ascending=False)
 
+    def over(self, spec: "WindowSpec") -> "ColumnExpr":
+        """Turn an aggregate/ranking expression into a window expression
+        (pyspark's Column.over)."""
+        return ColumnExpr("WindowExpr", (self, spec), alias=self._alias)
+
     def substr(self, pos, length) -> "ColumnExpr":
         return ColumnExpr("Substring", (self, _wrap(pos), _wrap(length)))
 
@@ -343,6 +348,84 @@ class functions:
     @staticmethod
     def row_number():
         return ColumnExpr("RowNumber", ())
+
+    @staticmethod
+    def rank():
+        return ColumnExpr("Rank", ())
+
+    @staticmethod
+    def dense_rank():
+        return ColumnExpr("DenseRank", ())
+
+    @staticmethod
+    def lag(e, offset: int = 1, default=None):
+        return ColumnExpr("Lag", (_wrap(e), offset, default))
+
+    @staticmethod
+    def lead(e, offset: int = 1, default=None):
+        return ColumnExpr("Lead", (_wrap(e), offset, default))
+
+
+class WindowSpec:
+    """partition/order/frame spec (pyspark WindowSpec equivalent; reference:
+    rapids/GpuWindowExpression.scala window spec mapping)."""
+
+    def __init__(self, parts=(), orders=(), frame=None):
+        self.parts = list(parts)        # partition-by ColumnExprs
+        self.orders = list(orders)      # SortOrders
+        # frame: None (Spark default) | ("rows", start, end)
+        self.frame = frame
+
+    def partition_by(self, *cols) -> "WindowSpec":
+        return WindowSpec([c if isinstance(c, ColumnExpr) else col(c)
+                           for c in cols], self.orders, self.frame)
+
+    partitionBy = partition_by
+
+    def order_by(self, *orders) -> "WindowSpec":
+        os = []
+        for o in orders:
+            if isinstance(o, SortOrder):
+                os.append(o)
+            elif isinstance(o, str):
+                os.append(SortOrder(col(o)))
+            else:
+                os.append(SortOrder(o))
+        return WindowSpec(self.parts, os, self.frame)
+
+    orderBy = order_by
+
+    def rows_between(self, start: int, end: int) -> "WindowSpec":
+        return WindowSpec(self.parts, self.orders,
+                          ("rows", int(start), int(end)))
+
+    rowsBetween = rows_between
+
+    def _group_key(self):
+        """Specs with the same partition/order can share one window node."""
+        return (tuple(repr(c) for c in self.parts),
+                tuple((repr(o.child), o.ascending, o.effective_nulls_first)
+                      for o in self.orders))
+
+
+class Window:
+    """pyspark.sql.Window-compatible namespace."""
+
+    unboundedPreceding = unbounded_preceding = -(1 << 62)
+    unboundedFollowing = unbounded_following = (1 << 62)
+    currentRow = current_row = 0
+
+    @staticmethod
+    def partition_by(*cols) -> WindowSpec:
+        return WindowSpec().partition_by(*cols)
+
+    partitionBy = partition_by
+
+    @staticmethod
+    def order_by(*orders) -> WindowSpec:
+        return WindowSpec().order_by(*orders)
+
+    orderBy = order_by
 
 
 class WhenBuilder(ColumnExpr):

@@ -332,9 +332,13 @@ def _compute_schema(plan: L.LogicalPlan, conf: TpuConf) -> Schema:
             fields.append(StructField(ce.output_name, ex.dtype))
         return Schema(fields)
     if isinstance(plan, L.LogicalWindow):
+        from ..ops.windows import resolve_window_func
         child = plan_schema(plan.children[0], conf)
         fields = list(child.fields)
         for ce in plan.window_exprs:
-            fields.append(StructField(ce.output_name, DoubleType))
+            func_ce, spec = ce.args
+            wf = resolve_window_func(func_ce, spec, child, resolve,
+                                     device=False)
+            fields.append(StructField(ce.output_name, wf.dtype))
         return Schema(fields)
     raise NotImplementedError(f"schema of {type(plan).__name__}")

@@ -76,8 +76,7 @@ def tag_node(meta: PlanMeta):
         meta.resolved["keys"] = keys
         meta.expr_metas = [ExprMeta(e, conf) for e in keys]
     elif isinstance(plan, L.LogicalWindow):
-        meta.will_not_work("window execution is CPU fallback until the TPU "
-                           "window kernels land")
+        _tag_window(meta)
     elif isinstance(plan, L.LogicalWrite):
         _require_exec(meta, "writer")
         if plan.fmt == "parquet" and not (
@@ -224,3 +223,40 @@ def _tag_sort(meta: PlanMeta):
     meta.expr_metas = [ExprMeta(e, meta.conf) for e in exprs]
     # reference restriction: nulls ordering must match cudf defaults
     # (GpuSortExec.scala); our lexsort handles both, no restriction needed
+
+
+def _tag_window(meta: PlanMeta):
+    """Resolve a LogicalWindow: partition/order keys + every window function
+    (reference: GpuWindowExpression tagging, GpuWindowExpression.scala:87-233).
+    Device-capability limits fall back to the CPU window exec; semantic
+    errors surface as analysis errors."""
+    from ..ops.windows import WindowUnsupported, resolve_window_func
+    plan: L.LogicalWindow = meta.plan
+    schema = meta.input_schema()
+    part_exprs = [resolve(ce, schema) for ce in plan.partition_by]
+    order_exprs = [resolve(o.child, schema) for o in plan.order_by]
+    meta.resolved["part_exprs"] = part_exprs
+    meta.resolved["order_exprs"] = order_exprs
+    meta.resolved["ascending"] = [o.ascending for o in plan.order_by]
+    meta.resolved["nulls_first"] = [o.effective_nulls_first
+                                    for o in plan.order_by]
+
+    def _resolve_funcs(device: bool):
+        funcs = []
+        for ce in plan.window_exprs:
+            func_ce, spec = ce.args
+            wf = resolve_window_func(func_ce, spec, schema, resolve,
+                                     device=device)
+            wf.name = ce.output_name
+            funcs.append(wf)
+        return funcs
+
+    try:
+        meta.resolved["funcs"] = _resolve_funcs(device=True)
+    except WindowUnsupported as e:
+        meta.will_not_work(f"window: {e}")
+        meta.resolved["funcs"] = _resolve_funcs(device=False)
+    meta.expr_metas = [ExprMeta(e, meta.conf)
+                       for e in part_exprs + order_exprs] + \
+        [ExprMeta(f.child, meta.conf)
+         for f in meta.resolved["funcs"] if f.child is not None]

@@ -1,0 +1,305 @@
+"""Window exec tests: CPU-vs-TPU oracle over ranking, offset and frame
+aggregate functions (reference coverage model: GpuWindowExpression.scala +
+integration_tests window tests)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Window
+from spark_rapids_tpu.plan.logical import col, functions as F
+
+from compare import assert_tpu_and_cpu_are_equal
+
+
+def base_data(seed=0, n=300, nulls=True):
+    rng = np.random.RandomState(seed)
+    k = rng.randint(0, 8, n)
+    v = rng.uniform(-100, 100, n).round(3)
+    o = rng.randint(0, 1000, n)
+    vals = [None if nulls and i % 11 == 0 else float(v[i]) for i in range(n)]
+    return {"k": k.tolist(), "o": o.tolist(), "v": vals}
+
+
+def _check(build, conf=None):
+    assert_tpu_and_cpu_are_equal(build, conf=conf)
+
+
+def test_row_number():
+    data = base_data(1)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("k"), col("o"), F.row_number().over(w).alias("rn"))
+    _check(q)
+
+
+def test_rank_dense_rank_with_ties():
+    rng = np.random.RandomState(2)
+    data = {"k": rng.randint(0, 5, 200).tolist(),
+            "o": rng.randint(0, 10, 200).tolist()}  # many ties
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("k"), col("o"),
+            F.rank().over(w).alias("r"),
+            F.dense_rank().over(w).alias("dr"))
+    _check(q)
+
+
+def test_desc_order_and_nulls():
+    data = base_data(3)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("v").desc())
+        return s.from_pydict(data).select(
+            col("k"), col("v"), F.row_number().over(w).alias("rn"))
+    _check(q)
+
+
+def test_sum_default_frame_running():
+    data = base_data(4, nulls=False)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("k"), col("o"), F.sum(col("v")).over(w).alias("rsum"))
+    _check(q)
+
+
+def test_default_frame_ties_range_semantics():
+    """Default frame with ORDER BY is RANGE-to-current: peers share the
+    running value."""
+    data = {"k": [1] * 6, "o": [1, 1, 2, 2, 3, 3],
+            "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]}
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("o"), col("v"), F.sum(col("v")).over(w).alias("rs"))
+    _check(q)
+
+
+def test_whole_partition_agg_no_order():
+    data = base_data(5)
+
+    def q(s):
+        w = Window.partitionBy(col("k"))
+        return s.from_pydict(data).select(
+            col("k"), col("v"),
+            F.sum(col("v")).over(w).alias("total"),
+            F.count(col("v")).over(w).alias("cnt"),
+            F.avg(col("v")).over(w).alias("mean"))
+    _check(q)
+
+
+def test_min_max_unbounded_running():
+    data = base_data(6)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("k"), col("o"),
+            F.min(col("v")).over(w).alias("rmin"),
+            F.max(col("v")).over(w).alias("rmax"))
+    _check(q)
+
+
+def test_rows_between_bounded_sum():
+    data = base_data(7, nulls=False)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o")) \
+            .rowsBetween(-2, 2)
+        return s.from_pydict(data).select(
+            col("k"), col("o"),
+            F.sum(col("v")).over(w).alias("ms"),
+            F.count(col("v")).over(w).alias("mc"),
+            F.avg(col("v")).over(w).alias("ma"))
+    _check(q)
+
+
+def test_rows_between_bounded_min_max():
+    data = base_data(8)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o")) \
+            .rowsBetween(-3, 1)
+        return s.from_pydict(data).select(
+            col("k"), col("o"),
+            F.min(col("v")).over(w).alias("mn"),
+            F.max(col("v")).over(w).alias("mx"))
+    _check(q)
+
+
+def test_rows_unbounded_following():
+    data = base_data(9, nulls=False)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o")) \
+            .rowsBetween(Window.currentRow, Window.unboundedFollowing)
+        return s.from_pydict(data).select(
+            col("k"), col("o"),
+            F.sum(col("v")).over(w).alias("suffix_sum"),
+            F.min(col("v")).over(w).alias("suffix_min"))
+    _check(q)
+
+
+def test_lag_lead():
+    data = base_data(10)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("k"), col("o"),
+            F.lag(col("v"), 1).over(w).alias("l1"),
+            F.lead(col("v"), 2).over(w).alias("ld2"),
+            F.lag(col("v"), 1, -999.0).over(w).alias("l1d"))
+    _check(q)
+
+
+def test_first_last_values():
+    data = base_data(11)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("k"), col("o"),
+            F.first(col("v")).over(w).alias("fv"))
+    _check(q)
+
+
+def test_window_over_strings_min_max():
+    rng = np.random.RandomState(12)
+    words = ["apple", "pear", None, "zebra", "kiwi", "fig"]
+    data = {"k": rng.randint(0, 4, 120).tolist(),
+            "s": [words[i % len(words)] for i in range(120)],
+            "o": rng.randint(0, 50, 120).tolist()}
+
+    def q(s):
+        w = Window.partitionBy(col("k"))
+        return s.from_pydict(data).select(
+            col("k"), col("s"),
+            F.min(col("s")).over(w).alias("smin"),
+            F.max(col("s")).over(w).alias("smax"))
+    _check(q)
+
+
+def test_multiple_specs_in_one_select():
+    data = base_data(13, nulls=False)
+
+    def q(s):
+        w1 = Window.partitionBy(col("k")).orderBy(col("o"))
+        w2 = Window.partitionBy(col("o"))
+        return s.from_pydict(data).select(
+            col("k"), col("o"),
+            F.row_number().over(w1).alias("rn"),
+            F.count(col("v")).over(w2).alias("c_by_o"))
+    _check(q)
+
+
+def test_no_partition_by():
+    data = base_data(14, n=100)
+
+    def q(s):
+        w = Window.orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("o"), F.row_number().over(w).alias("rn"))
+    _check(q)
+
+
+def test_window_on_tpu_not_fallback():
+    """Default conf must place the window exec on the device."""
+    from spark_rapids_tpu.engine import TpuSession
+    s = TpuSession({})
+    w = Window.partitionBy(col("k")).orderBy(col("o"))
+    df = s.from_pydict(base_data(15)).select(
+        col("k"), F.row_number().over(w).alias("rn"))
+    text = df.explain()
+    assert "WindowExec" in text
+    assert "!" not in text.split("WindowExec")[0].splitlines()[-1], text
+
+
+def test_wide_bounded_minmax_falls_back():
+    """Device caps bounded min/max width; planner must fall back, result
+    must still be correct."""
+    data = base_data(16)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o")) \
+            .rowsBetween(-5000, 5000)
+        return s.from_pydict(data).select(
+            col("k"), F.min(col("v")).over(w).alias("mn"))
+    _check(q)
+
+
+def test_window_then_filter():
+    data = base_data(17)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        df = s.from_pydict(data).select(
+            col("k"), col("o"), F.row_number().over(w).alias("rn"))
+        return df.filter(col("rn") <= 3)
+    _check(q)
+
+
+def test_nested_window_expression():
+    """sum(v).over(w) + 1 nested in arithmetic (Spark extracts these)."""
+    data = base_data(18, nulls=False)
+
+    def q(s):
+        w = Window.partitionBy(col("k"))
+        return s.from_pydict(data).select(
+            col("k"), (F.sum(col("v")).over(w) + 1.0).alias("x"))
+    _check(q)
+
+
+def test_min_max_with_nan_values():
+    """Spark: NaN is greatest — max prefers NaN, min avoids it."""
+    data = {"k": [1, 1, 1, 2, 2],
+            "v": [float("nan"), 1.0, 3.0, float("nan"), float("nan")]}
+
+    def q(s):
+        w = Window.partitionBy(col("k"))
+        return s.from_pydict(data).select(
+            col("k"), col("v"),
+            F.min(col("v")).over(w).alias("mn"),
+            F.max(col("v")).over(w).alias("mx"))
+    _check(q)
+
+
+def test_desc_string_prefix_ordering():
+    """DESC strings: 'abc' ranks before its prefix 'ab'."""
+    data = {"s": ["ab", "abc", "b", "a"], "k": [1, 1, 1, 1]}
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("s").desc())
+        return s.from_pydict(data).select(
+            col("s"), F.row_number().over(w).alias("rn"))
+    _check(q)
+
+
+def test_lag_with_wide_string_default():
+    data = {"k": [1, 1, 1], "o": [1, 2, 3], "s": ["aa", "bb", "cc"]}
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("o"),
+            F.lag(col("s"), 1, "averylongdefaultstringvalue").over(w)
+            .alias("lg"))
+    _check(q)
+
+
+def test_string_min_suffix_frame_falls_back():
+    """Bounded-start string min must fall back to CPU and stay correct."""
+    data = {"g": [1, 1, 1, 1], "o": [1, 2, 3, 4],
+            "s": ["a", "d", "c", "b"]}
+
+    def q(s):
+        w = Window.partitionBy(col("g")).orderBy(col("o")) \
+            .rowsBetween(0, Window.unboundedFollowing)
+        return s.from_pydict(data).select(
+            col("o"), F.min(col("s")).over(w).alias("mn"))
+    _check(q)
