@@ -1,0 +1,33 @@
+import time, numpy as np
+t0 = time.time()
+def log(m): print(f"[{time.time()-t0:6.1f}s] {m}", flush=True)
+import jax
+log(f"devices: {jax.devices()}")
+from spark_rapids_tpu.engine import TpuSession
+from spark_rapids_tpu.plan.logical import col, functions as F
+s = TpuSession({})
+df = s.from_pydict({"a": [1, 2]}).select(col("a"),
+                                         F.explode([1.5, 2.5]).alias("x"))
+assert sorted(df.collect()) == [(1, 1.5), (1, 2.5), (2, 1.5), (2, 2.5)]
+log("explode OK")
+rng = np.random.RandomState(0)
+n, m = 20000, 64
+left = {"k": rng.randint(0, m, n).tolist(), "v": rng.uniform(0, 1, n).tolist()}
+right = {"k": list(range(m)), "w": [float(i) * 2 for i in range(m)]}
+j = s.from_pydict(left).join(s.from_pydict(right).hint("broadcast"), on="k")
+assert "TpuBroadcastHashJoinExec" in j.physical_plan().tree_string()
+out = dict(j.group_by(col("k")).agg(F.sum(col("w")).alias("sw")).collect())
+ka = np.array(left["k"])
+for kk in range(0, m, 7):
+    want = (ka == kk).sum() * kk * 2.0
+    assert abs(out[kk] - want) < 1e-6, (kk, out[kk], want)
+log("broadcast join OK")
+# TPC-H Q1 and Q6 on the chip
+import sys; sys.path.insert(0, "/root/repo")
+from benchmarks.tpch import QUERIES, load_tables
+tables = load_tables(s, sf=0.002)
+r6 = QUERIES[6](tables).collect()
+log(f"tpch q6 on TPU OK: revenue={r6[0][0]:.2f}")
+r1 = QUERIES[1](tables).collect()
+assert len(r1) == 6, r1
+log(f"tpch q1 on TPU OK: {len(r1)} groups")

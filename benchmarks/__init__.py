@@ -1,0 +1,8 @@
+"""Benchmark / workload applications (SURVEY.md §4 tier 3).
+
+Mirrors the reference's integration_tests benchmark apps: a TPC-H-like
+suite (reference: integration_tests/src/main/scala/.../tpch/
+TpchLikeSpark.scala:49-290+) with schema, data generator and all 22
+queries, runnable against the TPU engine or the CPU fallback engine for
+comparison.
+"""

@@ -1,0 +1,6 @@
+"""TPC-H-like benchmark suite (reference: integration_tests/.../tpch/)."""
+from .datagen import days, generate, load_tables
+from .queries import QUERIES
+from .schema import SCHEMAS
+
+__all__ = ["days", "generate", "load_tables", "QUERIES", "SCHEMAS"]

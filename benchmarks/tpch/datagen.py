@@ -1,0 +1,208 @@
+"""TPC-H-like data generator (structure-faithful, not dbgen-exact).
+
+Row counts scale with `sf` like the spec (lineitem ~ 6M * sf); key
+relationships (orders->customer, lineitem->orders/part/supplier,
+partsupp->part/supplier, nested region/nation) and the value domains the
+22 queries filter on (segments, brands, types like "%BRASS", date ranges,
+priorities, ship modes, phone country codes) are all generated so every
+query selects a meaningful subset.  Reference counterpart: the .tbl
+fixtures + converters in integration_tests (TpchLikeSpark.scala:49-290).
+"""
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def days(s: str) -> int:
+    """'1994-01-01' -> days since epoch (our DateType representation)."""
+    y, m, d = map(int, s.split("-"))
+    return (datetime.date(y, m, d) - _EPOCH).days
+
+
+START = days("1992-01-01")
+END = days("1998-08-02")
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+           "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ",
+           "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU",
+           "CHINA", "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA",
+           "UNITED KINGDOM", "UNITED STATES"]
+NATION_REGION = [0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0, 0, 1, 2, 3,
+                 4, 2, 3, 3, 1]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+TYPES_1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPES_2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPES_3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINERS_1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINERS_2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+          "black", "blanched", "blue", "blush", "brown", "burlywood",
+          "chartreuse", "chiffon", "chocolate", "coral", "cornflower",
+          "cream", "cyan", "dark", "deep", "dim", "dodger", "drab", "firebrick",
+          "floral", "forest", "frosted", "gainsboro", "ghost", "gold",
+          "goldenrod", "green", "grey", "honeydew", "hot", "indian", "ivory",
+          "khaki", "lace", "lavender", "lawn", "lemon", "light", "lime",
+          "linen", "magenta", "maroon", "medium", "metallic", "midnight",
+          "mint", "misty", "moccasin", "navajo", "navy", "olive", "orange",
+          "orchid", "pale", "papaya", "peach", "peru", "pink", "plum",
+          "powder", "puff", "purple", "red", "rose", "rosy", "royal",
+          "saddle", "salmon", "sandy", "seashell", "sienna", "sky", "slate",
+          "smoke", "snow", "spring", "steel", "tan", "thistle", "tomato",
+          "turquoise", "violet", "wheat", "white", "yellow"]
+WORDS = ["express", "special", "pending", "deposits", "packages", "regular",
+         "requests", "accounts", "ironic", "final", "unusual", "Customer",
+         "Complaints", "carefully", "quickly", "furiously", "slyly"]
+
+
+def _comment(rng, n):
+    k = rng.randint(2, 6, n)
+    w = np.array(WORDS)
+    return [" ".join(w[rng.randint(0, len(w), kk)]) for kk in k]
+
+
+def generate(sf: float = 0.001, seed: int = 42):
+    """Returns {table_name: dict of column -> python list}."""
+    rng = np.random.RandomState(seed)
+    out = {}
+
+    out["region"] = {
+        "r_regionkey": list(range(5)),
+        "r_name": REGIONS,
+        "r_comment": _comment(rng, 5),
+    }
+    nn = len(NATIONS)
+    out["nation"] = {
+        "n_nationkey": list(range(nn)),
+        "n_name": NATIONS,
+        "n_regionkey": NATION_REGION,
+        "n_comment": _comment(rng, nn),
+    }
+
+    n_supp = max(10, int(10_000 * sf))
+    supp_nation = rng.randint(0, nn, n_supp)
+    out["supplier"] = {
+        "s_suppkey": list(range(1, n_supp + 1)),
+        "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+        "s_address": [f"addr {i}" for i in range(n_supp)],
+        "s_nationkey": supp_nation.tolist(),
+        "s_phone": [f"{nk + 10}-{rng.randint(100, 999)}-"
+                    f"{rng.randint(100, 999)}-{rng.randint(1000, 9999)}"
+                    for nk in supp_nation],
+        "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_supp),
+                              2).tolist(),
+        "s_comment": _comment(rng, n_supp),
+    }
+
+    n_cust = max(30, int(150_000 * sf))
+    cust_nation = rng.randint(0, nn, n_cust)
+    out["customer"] = {
+        "c_custkey": list(range(1, n_cust + 1)),
+        "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
+        "c_address": [f"caddr {i}" for i in range(n_cust)],
+        "c_nationkey": cust_nation.tolist(),
+        "c_phone": [f"{nk + 10}-{rng.randint(100, 999)}-"
+                    f"{rng.randint(100, 999)}-{rng.randint(1000, 9999)}"
+                    for nk in cust_nation],
+        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust),
+                              2).tolist(),
+        "c_mktsegment": [SEGMENTS[i] for i in rng.randint(0, 5, n_cust)],
+        "c_comment": _comment(rng, n_cust),
+    }
+
+    n_part = max(20, int(200_000 * sf))
+    out["part"] = {
+        "p_partkey": list(range(1, n_part + 1)),
+        "p_name": [" ".join(np.array(COLORS)[rng.choice(len(COLORS), 5,
+                                                        replace=False)])
+                   for _ in range(n_part)],
+        "p_mfgr": [f"Manufacturer#{rng.randint(1, 6)}"
+                   for _ in range(n_part)],
+        "p_brand": [f"Brand#{rng.randint(1, 6)}{rng.randint(1, 6)}"
+                    for _ in range(n_part)],
+        "p_type": [f"{TYPES_1[rng.randint(0, 6)]} "
+                   f"{TYPES_2[rng.randint(0, 5)]} "
+                   f"{TYPES_3[rng.randint(0, 5)]}" for _ in range(n_part)],
+        "p_size": rng.randint(1, 51, n_part).tolist(),
+        "p_container": [f"{CONTAINERS_1[rng.randint(0, 5)]} "
+                        f"{CONTAINERS_2[rng.randint(0, 8)]}"
+                        for _ in range(n_part)],
+        "p_retailprice": np.round(900 + rng.uniform(0, 200, n_part),
+                                  2).tolist(),
+        "p_comment": _comment(rng, n_part),
+    }
+
+    n_ps = n_part * 4
+    ps_part = np.repeat(np.arange(1, n_part + 1), 4)
+    ps_supp = rng.randint(1, n_supp + 1, n_ps)
+    out["partsupp"] = {
+        "ps_partkey": ps_part.tolist(),
+        "ps_suppkey": ps_supp.tolist(),
+        "ps_availqty": rng.randint(1, 10_000, n_ps).tolist(),
+        "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, n_ps),
+                                  2).tolist(),
+        "ps_comment": _comment(rng, n_ps),
+    }
+
+    n_ord = max(100, int(1_500_000 * sf))
+    o_date = rng.randint(START, END - 151, n_ord)
+    out["orders"] = {
+        "o_orderkey": list(range(1, n_ord + 1)),
+        "o_custkey": rng.randint(1, n_cust + 1, n_ord).tolist(),
+        "o_orderstatus": [["F", "O", "P"][i]
+                          for i in rng.randint(0, 3, n_ord)],
+        "o_totalprice": np.round(rng.uniform(900, 500_000, n_ord),
+                                 2).tolist(),
+        "o_orderdate": o_date.tolist(),
+        "o_orderpriority": [PRIORITIES[i] for i in rng.randint(0, 5, n_ord)],
+        "o_clerk": [f"Clerk#{rng.randint(1, 1000):09d}"
+                    for _ in range(n_ord)],
+        "o_shippriority": [0] * n_ord,
+        "o_comment": _comment(rng, n_ord),
+    }
+
+    nl_per = rng.randint(1, 8, n_ord)
+    l_ord = np.repeat(np.arange(1, n_ord + 1), nl_per)
+    n_li = len(l_ord)
+    l_odate = np.repeat(o_date, nl_per)
+    ship = l_odate + rng.randint(1, 122, n_li)
+    commit = l_odate + rng.randint(30, 91, n_li)
+    receipt = ship + rng.randint(1, 31, n_li)
+    qty = rng.randint(1, 51, n_li).astype(np.float64)
+    price = np.round(qty * (900 + rng.uniform(0, 200, n_li)), 2)
+    linenumber = np.concatenate([np.arange(1, k + 1) for k in nl_per])
+    out["lineitem"] = {
+        "l_orderkey": l_ord.tolist(),
+        "l_partkey": rng.randint(1, n_part + 1, n_li).tolist(),
+        "l_suppkey": rng.randint(1, n_supp + 1, n_li).tolist(),
+        "l_linenumber": linenumber.tolist(),
+        "l_quantity": qty.tolist(),
+        "l_extendedprice": price.tolist(),
+        "l_discount": np.round(rng.uniform(0.0, 0.10, n_li), 2).tolist(),
+        "l_tax": np.round(rng.uniform(0.0, 0.08, n_li), 2).tolist(),
+        "l_returnflag": [["A", "N", "R"][i] for i in
+                         rng.randint(0, 3, n_li)],
+        "l_linestatus": [["F", "O"][i] for i in rng.randint(0, 2, n_li)],
+        "l_shipdate": ship.tolist(),
+        "l_commitdate": commit.tolist(),
+        "l_receiptdate": receipt.tolist(),
+        "l_shipinstruct": [INSTRUCTS[i] for i in rng.randint(0, 4, n_li)],
+        "l_shipmode": [SHIPMODES[i] for i in rng.randint(0, 7, n_li)],
+        "l_comment": _comment(rng, n_li),
+    }
+    return out
+
+
+def load_tables(session, sf: float = 0.001, seed: int = 42):
+    """{name: DataFrame} on the given session."""
+    from .schema import SCHEMAS
+    data = generate(sf, seed)
+    return {name: session.from_pydict(data[name], SCHEMAS[name])
+            for name in SCHEMAS}

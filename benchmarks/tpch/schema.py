@@ -1,0 +1,51 @@
+"""TPC-H table schemas (dates as DateType day numbers)."""
+from spark_rapids_tpu.types import (DateType, DoubleType, LongType, Schema,
+                                    StringType, StructField as F)
+
+REGION = Schema([F("r_regionkey", LongType), F("r_name", StringType),
+                 F("r_comment", StringType)])
+
+NATION = Schema([F("n_nationkey", LongType), F("n_name", StringType),
+                 F("n_regionkey", LongType), F("n_comment", StringType)])
+
+SUPPLIER = Schema([F("s_suppkey", LongType), F("s_name", StringType),
+                   F("s_address", StringType), F("s_nationkey", LongType),
+                   F("s_phone", StringType), F("s_acctbal", DoubleType),
+                   F("s_comment", StringType)])
+
+CUSTOMER = Schema([F("c_custkey", LongType), F("c_name", StringType),
+                   F("c_address", StringType), F("c_nationkey", LongType),
+                   F("c_phone", StringType), F("c_acctbal", DoubleType),
+                   F("c_mktsegment", StringType), F("c_comment", StringType)])
+
+PART = Schema([F("p_partkey", LongType), F("p_name", StringType),
+               F("p_mfgr", StringType), F("p_brand", StringType),
+               F("p_type", StringType), F("p_size", LongType),
+               F("p_container", StringType), F("p_retailprice", DoubleType),
+               F("p_comment", StringType)])
+
+PARTSUPP = Schema([F("ps_partkey", LongType), F("ps_suppkey", LongType),
+                   F("ps_availqty", LongType), F("ps_supplycost", DoubleType),
+                   F("ps_comment", StringType)])
+
+ORDERS = Schema([F("o_orderkey", LongType), F("o_custkey", LongType),
+                 F("o_orderstatus", StringType),
+                 F("o_totalprice", DoubleType), F("o_orderdate", DateType),
+                 F("o_orderpriority", StringType), F("o_clerk", StringType),
+                 F("o_shippriority", LongType), F("o_comment", StringType)])
+
+LINEITEM = Schema([F("l_orderkey", LongType), F("l_partkey", LongType),
+                   F("l_suppkey", LongType), F("l_linenumber", LongType),
+                   F("l_quantity", DoubleType),
+                   F("l_extendedprice", DoubleType),
+                   F("l_discount", DoubleType), F("l_tax", DoubleType),
+                   F("l_returnflag", StringType), F("l_linestatus", StringType),
+                   F("l_shipdate", DateType), F("l_commitdate", DateType),
+                   F("l_receiptdate", DateType), F("l_shipinstruct", StringType),
+                   F("l_shipmode", StringType), F("l_comment", StringType)])
+
+SCHEMAS = {
+    "region": REGION, "nation": NATION, "supplier": SUPPLIER,
+    "customer": CUSTOMER, "part": PART, "partsupp": PARTSUPP,
+    "orders": ORDERS, "lineitem": LINEITEM,
+}

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build the native host runtime (called automatically from
+# spark_rapids_tpu/native.py on first import; safe to run by hand).
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -std=c++17 -shared -fPIC -pthread \
+    -o libtpu_host_runtime.so src/host_runtime.cpp
+echo "built $(pwd)/libtpu_host_runtime.so"

@@ -1,0 +1,225 @@
+// Native host runtime for spark_rapids_tpu.
+//
+// The reference delegates its performance-critical host paths to native
+// libraries (RMM's C++ allocator, libcudf's host scaffolding, UCX).  The
+// TPU build keeps the same split: JAX/XLA owns device compute, and this
+// C++ library owns the host runtime hot paths, exposed over a plain C ABI
+// consumed via ctypes (no pybind11 in the image):
+//
+//   * best-fit address-space sub-allocator (AddressSpaceAllocator.scala
+//     equivalent) for bounce-buffer pools
+//   * spill file I/O: O_DIRECT-friendly whole-buffer pwrite/pread with
+//     full-write loops (RapidsDiskStore equivalent)
+//   * multi-threaded gather/scatter memcpy for host columnar compaction
+//     (the serialize path of shuffle spill: contiguous per-partition
+//     reassembly)
+//   * murmur3-32 (Spark variant) batch hashing for host-side fallbacks
+//
+// Build: g++ -O3 -shared -fPIC (see native/build.sh).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Best-fit address-space allocator
+// ---------------------------------------------------------------------------
+
+struct AsAllocator {
+  std::mutex mu;
+  std::map<int64_t, int64_t> free_blocks;  // start -> len (coalesced)
+  std::map<int64_t, int64_t> allocated;    // start -> len
+  int64_t size;
+};
+
+void* asalloc_create(int64_t size) {
+  auto* a = new AsAllocator();
+  a->size = size;
+  a->free_blocks[0] = size;
+  return a;
+}
+
+void asalloc_destroy(void* h) { delete static_cast<AsAllocator*>(h); }
+
+int64_t asalloc_allocate(void* h, int64_t length) {
+  auto* a = static_cast<AsAllocator*>(h);
+  if (length <= 0) return -1;
+  std::lock_guard<std::mutex> lock(a->mu);
+  int64_t best = -1, best_len = 0;
+  for (auto& kv : a->free_blocks) {
+    if (kv.second >= length && (best < 0 || kv.second < best_len)) {
+      best = kv.first;
+      best_len = kv.second;
+    }
+  }
+  if (best < 0) return -1;
+  a->free_blocks.erase(best);
+  if (best_len > length) a->free_blocks[best + length] = best_len - length;
+  a->allocated[best] = length;
+  return best;
+}
+
+int64_t asalloc_free(void* h, int64_t address) {
+  auto* a = static_cast<AsAllocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->allocated.find(address);
+  if (it == a->allocated.end()) return -1;
+  int64_t start = address, len = it->second, freed = len;
+  a->allocated.erase(it);
+  auto next = a->free_blocks.find(start + len);
+  if (next != a->free_blocks.end()) {
+    len += next->second;
+    a->free_blocks.erase(next);
+  }
+  auto prev = a->free_blocks.lower_bound(start);
+  if (prev != a->free_blocks.begin()) {
+    --prev;
+    if (prev->first + prev->second == start) {
+      start = prev->first;
+      len += prev->second;
+      a->free_blocks.erase(prev);
+    }
+  }
+  a->free_blocks[start] = len;
+  return freed;
+}
+
+int64_t asalloc_allocated_bytes(void* h) {
+  auto* a = static_cast<AsAllocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  int64_t total = 0;
+  for (auto& kv : a->allocated) total += kv.second;
+  return total;
+}
+
+int64_t asalloc_largest_free(void* h) {
+  auto* a = static_cast<AsAllocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  int64_t best = 0;
+  for (auto& kv : a->free_blocks) best = std::max(best, kv.second);
+  return best;
+}
+
+// ---------------------------------------------------------------------------
+// Spill file I/O (RapidsDiskStore equivalent)
+// ---------------------------------------------------------------------------
+
+// Write the full buffer to `path`; returns bytes written or -errno.
+int64_t spill_write(const char* path, const uint8_t* data, int64_t nbytes) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  int64_t off = 0;
+  while (off < nbytes) {
+    ssize_t w = ::pwrite(fd, data + off, nbytes - off, off);
+    if (w <= 0) {
+      ::close(fd);
+      return -2;
+    }
+    off += w;
+  }
+  ::close(fd);
+  return off;
+}
+
+// Read exactly nbytes from `path` at `offset` into data.
+int64_t spill_read(const char* path, uint8_t* data, int64_t nbytes,
+                   int64_t offset) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  int64_t off = 0;
+  while (off < nbytes) {
+    ssize_t r = ::pread(fd, data + off, nbytes - off, offset + off);
+    if (r <= 0) {
+      ::close(fd);
+      return -2;
+    }
+    off += r;
+  }
+  ::close(fd);
+  return off;
+}
+
+// ---------------------------------------------------------------------------
+// Multi-threaded row gather (host columnar compaction)
+// ---------------------------------------------------------------------------
+
+// out[i, :] = src[idx[i], :] for fixed-width rows of `row_bytes` each.
+void gather_rows(const uint8_t* src, uint8_t* out, const int32_t* idx,
+                 int64_t n_out, int64_t row_bytes, int32_t n_threads) {
+  if (n_threads <= 1 || n_out < 4096) {
+    for (int64_t i = 0; i < n_out; ++i)
+      std::memcpy(out + i * row_bytes, src + (int64_t)idx[i] * row_bytes,
+                  row_bytes);
+    return;
+  }
+  std::vector<std::thread> workers;
+  int64_t chunk = (n_out + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n_out, lo + chunk);
+    if (lo >= hi) break;
+    workers.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i)
+        std::memcpy(out + i * row_bytes, src + (int64_t)idx[i] * row_bytes,
+                    row_bytes);
+    });
+  }
+  for (auto& w : workers) w.join();
+}
+
+// ---------------------------------------------------------------------------
+// Spark murmur3-32 over int64 values (host-side hash partition fallback)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_k(uint32_t k) {
+  k *= 0xcc9e2d51u;
+  k = rotl32(k, 15);
+  return k * 0x1b873593u;
+}
+
+static inline uint32_t mix_h(uint32_t h, uint32_t k) {
+  h ^= mix_k(k);
+  h = rotl32(h, 13);
+  return h * 5u + 0xe6546b64u;
+}
+
+static inline uint32_t fmix(uint32_t h, uint32_t len) {
+  h ^= len;
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+// Spark hashLong per element (low word then high word), seed 42 chainable.
+void murmur3_long_batch(const int64_t* vals, const uint8_t* valid,
+                        int32_t* out, int64_t n, int32_t seed) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid && !valid[i]) {
+      out[i] = seed;
+      continue;
+    }
+    uint64_t u = (uint64_t)vals[i];
+    uint32_t h = (uint32_t)seed;
+    h = mix_h(h, (uint32_t)(u & 0xffffffffu));
+    h = mix_h(h, (uint32_t)(u >> 32));
+    out[i] = (int32_t)fmix(h, 8);
+  }
+}
+
+}  // extern "C"

@@ -198,6 +198,13 @@ SHUFFLE_DEVICE_RESIDENT = _conf(
     "Keep shuffle partitions resident in HBM (spillable) instead of "
     "serializing to host between stages.", _to_bool)
 
+# --- joins ------------------------------------------------------------------
+AUTO_BROADCAST_JOIN_THRESHOLD = _conf(
+    "spark.sql.autoBroadcastJoinThreshold", 10 << 20,
+    "Maximum estimated size in bytes of a join build side that will be "
+    "broadcast to every consumer instead of shuffled (Spark's conf key; "
+    "-1 disables broadcast joins).", to_bytes)
+
 # --- export -----------------------------------------------------------------
 EXPORT_COLUMNAR_RDD = _conf(
     "spark.rapids.sql.exportColumnarRdd", False,

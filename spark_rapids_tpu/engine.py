@@ -166,6 +166,25 @@ class DataFrame:
             new_args = tuple(walk(a) for a in e.args)
             return ColumnExpr(e.op, new_args, alias=e._alias)
 
+        # generators (explode/posexplode) first: they change the row count
+        gens = [e for e in exprs if e.op in ("Explode", "PosExplode")]
+        if len(gens) > 1:
+            raise ValueError("only one generator (explode/posexplode) is "
+                             "allowed per select, like Spark")
+        if gens:
+            g = gens[0]
+            pos = g.op == "PosExplode"
+            names = (["pos"] if pos else []) + [g._alias or "col"]
+            base = DataFrame(self.session,
+                             L.LogicalGenerate(g, names, self.plan))
+            out = []
+            for e in exprs:
+                if e is g:
+                    out.extend(col(n) for n in names)
+                else:
+                    out.append(e)
+            return base._project(out)
+
         rewritten = [extract(e) for e in exprs]
         if not win:
             return DataFrame(self.session,
@@ -235,6 +254,13 @@ class DataFrame:
 
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self.session, L.LogicalLimit(n, self.plan))
+
+    def hint(self, name: str, *args) -> "DataFrame":
+        """Spark-style plan hints; \"broadcast\" marks this side for a
+        broadcast hash join."""
+        hints = set(getattr(self.plan, "_hints", ())) | {name.lower()}
+        self.plan._hints = hints
+        return self
 
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self.session,
@@ -331,8 +357,52 @@ class GroupedData:
         self.keys = keys
 
     def agg(self, *aggs) -> "DataFrame":
-        return DataFrame(self.df.session, L.LogicalAggregate(
-            self.keys, list(aggs), self.df.plan))
+        """Aggregate; compound expressions over aggregates (e.g.
+        sum(a)/sum(b)) are split into leaf aggregates + a result projection,
+        the way Spark's analyzer plans them (and the reference's
+        resultProjection phase executes them, aggregate.scala:403-510)."""
+        from .ops.aggregates import AGG_FUNCS
+        leaf_aggs: List[ColumnExpr] = []
+        projections: List[ColumnExpr] = []
+        compound = False
+
+        def walk(e):
+            if not isinstance(e, ColumnExpr):
+                return e
+            if e.op in AGG_FUNCS:
+                name = f"_agg{len(leaf_aggs)}"
+                leaf_aggs.append(e.alias(name))
+                return col(name)
+
+            def sub(a):
+                if isinstance(a, ColumnExpr):
+                    return walk(a)
+                if isinstance(a, (list, tuple)):
+                    return type(a)(sub(x) for x in a)
+                return a
+            return ColumnExpr(e.op, tuple(sub(a) for a in e.args),
+                              alias=e._alias)
+
+        for e in aggs:
+            if isinstance(e, ColumnExpr) and e.op in AGG_FUNCS:
+                leaf_aggs.append(e)
+                projections.append(col(e.output_name))
+            else:
+                before = len(leaf_aggs)
+                rewritten = walk(e)
+                if len(leaf_aggs) == before:
+                    raise ValueError(
+                        f"aggregate expression {e!r} contains no aggregate "
+                        "function")
+                compound = True
+                projections.append(rewritten.alias(e.output_name))
+
+        agg_plan = L.LogicalAggregate(self.keys, leaf_aggs, self.df.plan)
+        if not compound:
+            return DataFrame(self.df.session, agg_plan)
+        key_cols = [col(k.output_name) for k in self.keys]
+        return DataFrame(self.df.session, L.LogicalProject(
+            key_cols + projections, agg_plan))
 
     def count(self) -> "DataFrame":
         return self.agg(functions.count(lit(1)).alias("count"))

@@ -201,7 +201,7 @@ class CpuSortExec(CpuExec):
             return tuple(parts)
 
         idx = sorted(range(n), key=sort_key)
-        yield table.take(idx)
+        yield table.take(_idx_array(idx))
 
     def describe(self):
         return f"CpuSortExec[{', '.join(map(repr, self.sort_exprs))}]"
@@ -413,7 +413,7 @@ class CpuDistinctExec(CpuExec):
             if k not in seen:
                 seen.add(k)
                 keep.append(i)
-        yield table.take(keep)
+        yield table.take(_idx_array(keep))
 
 
 def _idx_array(indices):

@@ -1,0 +1,129 @@
+"""Generate exec: explode/posexplode of array literals.
+
+TPU-native analogue of GpuGenerateExec (rapids/GpuGenerateExec.scala:101+ —
+this reference snapshot supports exploding array LITERALS only; per-row
+array columns are a later feature there too).  Device shape: a fan-out is a
+single static gather — row i of the child appears at output rows
+[i*n, (i+1)*n) with the tiled literal value column appended — so the whole
+operator is one reshape-free `take`.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, ColumnarBatch, bucket_rows
+from ..types import DataType, IntegerType, Schema, StructField
+from .base import CpuExec, ExecContext, ExecNode, TpuExec
+
+
+class TpuGenerateExec(TpuExec):
+    def __init__(self, values: List, value_dtype: DataType, pos: bool,
+                 names: List[str], child: ExecNode):
+        super().__init__(child)
+        self.values = list(values)
+        self.value_dtype = value_dtype
+        self.pos = pos
+        self.names = list(names)
+
+    @property
+    def schema(self):
+        child = self.children[0].schema
+        fields = list(child.fields)
+        gen = [StructField(self.names[-1], self.value_dtype)]
+        if self.pos:
+            gen.insert(0, StructField(self.names[0], IntegerType))
+        return Schema(fields + gen)
+
+    def describe(self):
+        kind = "posexplode" if self.pos else "explode"
+        return f"TpuGenerateExec[{kind}, n={len(self.values)}]"
+
+    def kernel_key(self):
+        from ..utils.kernel_cache import schema_key
+        return ("TpuGenerateExec", tuple(map(repr, self.values)),
+                self.value_dtype.name, self.pos, tuple(self.names),
+                schema_key(self.children[0].schema))
+
+    def _kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
+        n = len(self.values)
+        cap = batch.capacity
+        out_cap = bucket_rows(max(cap * n, 1))
+        out_i = jnp.arange(out_cap, dtype=jnp.int32)
+        src = jnp.clip(out_i // n, 0, cap - 1)
+        in_range = out_i < cap * n
+        sel = jnp.take(batch.sel, src, mode="clip") & in_range
+        cols = [c.take(src) for c in batch.columns]
+        # tiled literal value column
+        if self.value_dtype.is_string:
+            vc = Column.from_strings(self.values)
+            data = jnp.take(vc.data, out_i % n, axis=0, mode="clip")
+            lens = jnp.take(vc.lengths, out_i % n, mode="clip")
+            valid = jnp.take(vc.valid, out_i % n, mode="clip") & in_range
+            gen_cols = [Column(data, valid, self.value_dtype, lens)]
+        else:
+            arr = np.array([0 if v is None else v for v in self.values],
+                           dtype=self.value_dtype.np_dtype)
+            vmask = np.array([v is not None for v in self.values], bool)
+            data = jnp.take(jnp.asarray(arr), out_i % n, mode="clip")
+            valid = jnp.take(jnp.asarray(vmask), out_i % n,
+                             mode="clip") & in_range
+            gen_cols = [Column(data, valid, self.value_dtype)]
+        if self.pos:
+            gen_cols.insert(0, Column(
+                (out_i % n).astype(jnp.int32),
+                jnp.ones(out_cap, dtype=jnp.bool_), IntegerType))
+        return ColumnarBatch(cols + gen_cols, sel, self.schema)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..utils.kernel_cache import cached_kernel
+        fn = cached_kernel(self.kernel_key(), lambda: self._kernel)
+        for batch in self.children[0].execute(ctx):
+            with self.metrics.timer("generateTime"):
+                out = fn(batch)
+            self.metrics.add("numOutputBatches", 1)
+            yield out
+
+
+class CpuGenerateExec(CpuExec):
+    def __init__(self, values: List, value_dtype: DataType, pos: bool,
+                 names: List[str], child: ExecNode):
+        super().__init__(child)
+        self.values = list(values)
+        self.value_dtype = value_dtype
+        self.pos = pos
+        self.names = list(names)
+
+    @property
+    def schema(self):
+        child = self.children[0].schema
+        fields = list(child.fields)
+        gen = [StructField(self.names[-1], self.value_dtype)]
+        if self.pos:
+            gen.insert(0, StructField(self.names[0], IntegerType))
+        return Schema(fields + gen)
+
+    def execute_cpu(self, ctx: ExecContext):
+        import pyarrow as pa
+        from ..types import to_arrow
+        n = len(self.values)
+        for table in self.children[0].execute_cpu(ctx):
+            m = table.num_rows
+            idx = pa.array([i for i in range(m) for _ in range(n)],
+                           type=pa.int64())
+            out = table.take(idx)
+            vals = pa.array(self.values * m, type=to_arrow(self.value_dtype))
+            if self.pos:
+                out = out.append_column(
+                    self.names[0],
+                    pa.array(list(range(n)) * m, type=pa.int32()))
+            out = out.append_column(self.names[-1], vals)
+            yield out
+
+
+def make_generate_exec(meta, child: ExecNode, on_tpu: bool) -> ExecNode:
+    r = meta.resolved
+    cls = TpuGenerateExec if on_tpu else CpuGenerateExec
+    return cls(r["values"], r["value_dtype"], r["pos"], r["names"], child)

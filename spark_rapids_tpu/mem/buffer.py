@@ -120,17 +120,24 @@ def host_leaves_nbytes(leaves: List[np.ndarray]) -> int:
 
 
 def write_leaves(path: str, leaves: List[np.ndarray]) -> int:
-    """Flat byte image of all leaves, back to back (disk tier)."""
-    with open(path, "wb") as f:
-        for a in leaves:
-            f.write(np.ascontiguousarray(a).tobytes())
-    return os.path.getsize(path)
+    """Flat byte image of all leaves, back to back (disk tier).  One
+    contiguous native pwrite (native/src/host_runtime.cpp spill_write;
+    python fallback without a toolchain)."""
+    from ..native import spill_write
+    total = sum(a.nbytes for a in leaves)
+    flat = np.empty(total, dtype=np.uint8)
+    off = 0
+    for a in leaves:
+        b = np.ascontiguousarray(a).view(np.uint8).reshape(-1)
+        flat[off:off + b.nbytes] = b
+        off += b.nbytes
+    return spill_write(path, flat)
 
 
 def read_leaves(path: str, meta: BatchMeta) -> List[np.ndarray]:
+    from ..native import spill_read
     leaves: List[np.ndarray] = []
-    with open(path, "rb") as f:
-        raw = f.read()
+    raw = spill_read(path, meta.size_bytes)
     off = 0
     for lm in meta.leaf_meta:
         for shape, ds in zip(lm.shapes, lm.np_dtypes):

@@ -1,0 +1,217 @@
+"""ctypes bindings for the native host runtime (native/src/host_runtime.cpp).
+
+The reference's host hot paths live in C++ (RMM allocator, libcudf host
+scaffolding, UCX); ours live in libtpu_host_runtime.so: best-fit
+address-space allocator, spill file I/O, multi-threaded row gather, Spark
+murmur3 batch hashing.  The library is compiled on first use with the
+image's g++ and cached next to its source; every caller has a pure-Python
+fallback, so a missing toolchain degrades performance, never correctness.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_ROOT, "libtpu_host_runtime.so")
+_SRC_PATH = os.path.join(_ROOT, "src", "host_runtime.cpp")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             "-o", _LIB_PATH, _SRC_PATH],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """The loaded CDLL, or None when unavailable (fallback mode)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC_PATH)
+                and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)):
+            if not os.path.exists(_SRC_PATH) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.asalloc_create.restype = ctypes.c_void_p
+        lib.asalloc_create.argtypes = [ctypes.c_int64]
+        lib.asalloc_destroy.argtypes = [ctypes.c_void_p]
+        lib.asalloc_allocate.restype = ctypes.c_int64
+        lib.asalloc_allocate.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.asalloc_free.restype = ctypes.c_int64
+        lib.asalloc_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.asalloc_allocated_bytes.restype = ctypes.c_int64
+        lib.asalloc_allocated_bytes.argtypes = [ctypes.c_void_p]
+        lib.asalloc_largest_free.restype = ctypes.c_int64
+        lib.asalloc_largest_free.argtypes = [ctypes.c_void_p]
+        lib.spill_write.restype = ctypes.c_int64
+        lib.spill_write.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                    ctypes.c_int64]
+        lib.spill_read.restype = ctypes.c_int64
+        lib.spill_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                   ctypes.c_int64, ctypes.c_int64]
+        lib.gather_rows.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.c_int64, ctypes.c_int32]
+        lib.murmur3_long_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_void_p, ctypes.c_int64,
+                                           ctypes.c_int32]
+        _lib = lib
+        return _lib
+
+
+# ---------------------------------------------------------------------------
+# typed wrappers (None-safe: callers check availability via native_available)
+# ---------------------------------------------------------------------------
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+class NativeAddressSpaceAllocator:
+    """C++ best-fit allocator with the same interface as
+    mem.address_space.AddressSpaceAllocator."""
+
+    def __init__(self, size: int):
+        lib = get_lib()
+        assert lib is not None
+        self._lib = lib
+        self._h = lib.asalloc_create(size)
+        self.size = size
+
+    def allocate(self, length: int):
+        addr = self._lib.asalloc_allocate(self._h, length)
+        return None if addr < 0 else addr
+
+    def free(self, address: int) -> int:
+        n = self._lib.asalloc_free(self._h, address)
+        if n < 0:
+            raise ValueError(f"free of unallocated address {address}")
+        return n
+
+    @property
+    def allocated_bytes(self) -> int:
+        return self._lib.asalloc_allocated_bytes(self._h)
+
+    @property
+    def available_bytes(self) -> int:
+        return self.size - self.allocated_bytes
+
+    def largest_free_block(self) -> int:
+        return self._lib.asalloc_largest_free(self._h)
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self._lib.asalloc_destroy(self._h)
+        except Exception:
+            pass
+
+
+def spill_write(path: str, data: np.ndarray) -> int:
+    """Whole-buffer native write; returns bytes written."""
+    lib = get_lib()
+    buf = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+    if lib is None:
+        with open(path, "wb") as f:
+            f.write(buf.tobytes())
+        return buf.nbytes
+    n = lib.spill_write(path.encode(), buf.ctypes.data, buf.nbytes)
+    if n != buf.nbytes:
+        raise OSError(f"native spill write failed ({n}) for {path}")
+    return n
+
+
+def spill_read(path: str, nbytes: int, offset: int = 0) -> np.ndarray:
+    """Native read of nbytes at offset; returns a uint8 array."""
+    lib = get_lib()
+    if lib is None:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return np.frombuffer(f.read(nbytes), dtype=np.uint8)
+    out = np.empty(nbytes, dtype=np.uint8)
+    n = lib.spill_read(path.encode(), out.ctypes.data, nbytes, offset)
+    if n != nbytes:
+        raise OSError(f"native spill read failed ({n}) for {path}")
+    return out
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                n_threads: int = 0) -> np.ndarray:
+    """out[i] = src[idx[i]] for 1-D/2-D fixed-width arrays, multithreaded."""
+    lib = get_lib()
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    if lib is None:
+        return np.ascontiguousarray(src[idx])
+    src_c = np.ascontiguousarray(src)
+    row_bytes = src_c.dtype.itemsize * int(
+        np.prod(src_c.shape[1:], dtype=np.int64))
+    out = np.empty((len(idx),) + src_c.shape[1:], dtype=src_c.dtype)
+    if n_threads <= 0:
+        n_threads = min(8, os.cpu_count() or 1)
+    lib.gather_rows(src_c.ctypes.data, out.ctypes.data, idx.ctypes.data,
+                    len(idx), row_bytes, n_threads)
+    return out
+
+
+def murmur3_long(vals: np.ndarray, valid=None, seed: int = 42) -> np.ndarray:
+    """Spark hashLong over an int64 batch (nulls pass the seed through)."""
+    lib = get_lib()
+    v = np.ascontiguousarray(vals, dtype=np.int64)
+    out = np.empty(len(v), dtype=np.int32)
+    if lib is None:  # pure-python fallback (slow; used only w/o toolchain)
+        def one(x, s):
+            def rotl(a, r):
+                return ((a << r) | (a >> (32 - r))) & 0xffffffff
+
+            def mixk(k):
+                k = (k * 0xcc9e2d51) & 0xffffffff
+                k = rotl(k, 15)
+                return (k * 0x1b873593) & 0xffffffff
+
+            def mixh(h, k):
+                h ^= mixk(k)
+                h = rotl(h, 13)
+                return (h * 5 + 0xe6546b64) & 0xffffffff
+            u = x & 0xffffffffffffffff
+            h = mixh(s & 0xffffffff, u & 0xffffffff)
+            h = mixh(h, u >> 32)
+            h ^= 8
+            h ^= h >> 16
+            h = (h * 0x85ebca6b) & 0xffffffff
+            h ^= h >> 13
+            h = (h * 0xc2b2ae35) & 0xffffffff
+            h ^= h >> 16
+            return h - 0x100000000 if h >= 0x80000000 else h
+        for i, x in enumerate(v.tolist()):
+            if valid is not None and not valid[i]:
+                out[i] = seed
+            else:
+                out[i] = one(x, seed)
+        return out
+    vmask = None
+    if valid is not None:
+        vmask = np.ascontiguousarray(valid, dtype=np.uint8)
+    lib.murmur3_long_batch(v.ctypes.data,
+                           vmask.ctypes.data if vmask is not None else None,
+                           out.ctypes.data, len(v), seed)
+    return out

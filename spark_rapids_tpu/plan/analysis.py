@@ -149,3 +149,21 @@ def resolve(ce, schema: Schema, partition_id: int = 0) -> E.Expression:
 
 def output_field(ce: ColumnExpr, expr: E.Expression) -> StructField:
     return StructField(ce.output_name, expr.dtype)
+
+
+def _infer_value_dtype(values) -> Optional[DataType]:
+    """Common type of an array literal's elements (numeric promotion; None
+    when elements are mixed beyond promotion)."""
+    from ..ops.expressions import _infer_literal_type
+    dt: Optional[DataType] = None
+    for v in values:
+        if v is None:
+            continue
+        t = _infer_literal_type(v)
+        if dt is None or dt is t:
+            dt = t
+        elif dt.is_numeric and t.is_numeric:
+            dt = promote(dt, t)
+        else:
+            return None
+    return dt

@@ -365,6 +365,18 @@ class functions:
     def lead(e, offset: int = 1, default=None):
         return ColumnExpr("Lead", (_wrap(e), offset, default))
 
+    @staticmethod
+    def explode(values):
+        """Explode an array literal: one output row per element per input
+        row (reference scope: GpuGenerateExec.scala:101+ supports
+        explode/posexplode of array literals)."""
+        return ColumnExpr("Explode", (list(values),))
+
+    @staticmethod
+    def posexplode(values):
+        """Like explode, plus a 0-based position column."""
+        return ColumnExpr("PosExplode", (list(values),))
+
 
 class WindowSpec:
     """partition/order/frame spec (pyspark WindowSpec equivalent; reference:
@@ -537,6 +549,17 @@ class LogicalExpand(LogicalPlan):
     def __init__(self, projections: Sequence[Sequence[ColumnExpr]],
                  child: LogicalPlan):
         self.projections = [list(p) for p in projections]
+        self.children = (child,)
+
+
+class LogicalGenerate(LogicalPlan):
+    """Generator (explode/posexplode of an array literal) appended to the
+    child's columns (Spark GenerateExec shape; reference:
+    rapids/GpuGenerateExec.scala)."""
+
+    def __init__(self, generator: ColumnExpr, names, child: LogicalPlan):
+        self.generator = generator          # Explode | PosExplode ColumnExpr
+        self.names = list(names)            # output column names (1 or 2)
         self.children = (child,)
 
 

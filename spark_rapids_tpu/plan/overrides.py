@@ -252,22 +252,28 @@ class PlanMeta(MetaBase):
         return "\n".join(lines)
 
 
+_DISPLAY_NAMES = {
+    L.LogicalProject: "ProjectExec",
+    L.LogicalFilter: "FilterExec",
+    L.LogicalAggregate: "HashAggregateExec",
+    L.LogicalSort: "SortExec",
+    L.LogicalLimit: "CollectLimitExec",
+    L.LogicalUnion: "UnionExec",
+    L.LogicalExpand: "ExpandExec",
+    L.LogicalWindow: "WindowExec",
+    L.LogicalGenerate: "GenerateExec",
+    L.LogicalRepartition: "ShuffleExchangeExec",
+    L.LogicalWrite: "DataWritingCommandExec",
+    L.LogicalDistinct: "HashAggregateExec",
+    L.LogicalScan: "FileSourceScanExec",
+    L.LogicalJoin: "SortMergeJoinExec",
+}
+
+
 def _exec_name(plan: L.LogicalPlan) -> str:
     """Logical node -> reference exec-rule name (so conf keys match the
     reference's per-exec kill-switches)."""
-    mapping = {
-        L.LogicalProject: "ProjectExec",
-        L.LogicalFilter: "FilterExec",
-        L.LogicalAggregate: "HashAggregateExec",
-        L.LogicalSort: "SortExec",
-        L.LogicalLimit: "CollectLimitExec",
-        L.LogicalUnion: "UnionExec",
-        L.LogicalExpand: "ExpandExec",
-        L.LogicalWindow: "WindowExec",
-        L.LogicalRepartition: "ShuffleExchangeExec",
-        L.LogicalWrite: "DataWritingCommandExec",
-        L.LogicalDistinct: "HashAggregateExec",
-    }
+    mapping = _DISPLAY_NAMES
     if isinstance(plan, L.LogicalScan):
         return {"memory": "LocalTableScanExec",
                 "parquet": "FileSourceScanExec",
@@ -330,6 +336,16 @@ def _compute_schema(plan: L.LogicalPlan, conf: TpuConf) -> Schema:
         for ce in plan.projections[0]:
             ex = resolve(ce, child)
             fields.append(StructField(ce.output_name, ex.dtype))
+        return Schema(fields)
+    if isinstance(plan, L.LogicalGenerate):
+        from ..types import IntegerType
+        from .analysis import _infer_value_dtype
+        child = plan_schema(plan.children[0], conf)
+        fields = list(child.fields)
+        dtype = _infer_value_dtype(plan.generator.args[0]) or StringType
+        if plan.generator.op == "PosExplode":
+            fields.append(StructField(plan.names[0], IntegerType))
+        fields.append(StructField(plan.names[-1], dtype))
         return Schema(fields)
     if isinstance(plan, L.LogicalWindow):
         from ..ops.windows import resolve_window_func

@@ -44,12 +44,22 @@ def convert(meta: PlanMeta) -> ExecNode:
                 using_drop.append(lw + rs.index_of(name))
         if on_tpu:
             from ..exec.join import TpuHashJoinExec
+            if _should_broadcast_build(plan, meta.conf):
+                from ..exec.broadcast import (TpuBroadcastExchangeExec,
+                                              TpuBroadcastHashJoinExec)
+                return TpuBroadcastHashJoinExec(
+                    children[0], TpuBroadcastExchangeExec(children[1]),
+                    plan.join_type, r["left_keys"], r["right_keys"],
+                    r["condition"], out_schema, using_drop)
             return TpuHashJoinExec(children[0], children[1], plan.join_type,
                                    r["left_keys"], r["right_keys"],
                                    r["condition"], out_schema, using_drop)
         return CR.CpuJoinExec(children[0], children[1], plan.join_type,
                               r["left_keys"], r["right_keys"],
                               r["condition"], out_schema, using_drop)
+    if isinstance(plan, L.LogicalGenerate):
+        from ..exec.generate import make_generate_exec
+        return make_generate_exec(meta, children[0], on_tpu)
     if isinstance(plan, L.LogicalSort):
         if on_tpu:
             from ..exec.sort import TpuSortExec
@@ -91,3 +101,40 @@ def _convert_scan(meta: PlanMeta, on_tpu: bool) -> ExecNode:
         return cls(plan.source, plan.schema)
     from ..io.scan import make_scan_exec
     return make_scan_exec(plan, on_tpu, meta.conf)
+
+
+def _estimate_plan_bytes(plan: L.LogicalPlan):
+    """Rough byte-size estimate of a subtree's output (Spark's stats
+    sizeInBytes, simplified).  None = unknown."""
+    import os
+    if isinstance(plan, L.LogicalScan):
+        if plan.fmt == "memory":
+            src = plan.source
+            nbytes = getattr(src, "nbytes", None)
+            if nbytes is not None:
+                return int(nbytes)
+            return None
+        try:
+            return sum(os.path.getsize(f) for f in plan.files)
+        except OSError:
+            return None
+    if isinstance(plan, (L.LogicalProject, L.LogicalFilter, L.LogicalSort,
+                         L.LogicalLimit, L.LogicalRepartition)):
+        return _estimate_plan_bytes(plan.children[0])
+    return None
+
+
+def _should_broadcast_build(plan: "L.LogicalJoin", conf) -> bool:
+    """Broadcast the build (right) side when hinted or when its estimated
+    size is under spark.sql.autoBroadcastJoinThreshold (Spark planning
+    behavior; reference: GpuBroadcastHashJoinExec replaces Spark's
+    BroadcastHashJoinExec when Spark already chose broadcast)."""
+    from .. import config as C
+    right = plan.children[1]
+    if "broadcast" in getattr(right, "_hints", ()):
+        return True
+    threshold = conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
+    if threshold is None or int(threshold) < 0:
+        return False
+    est = _estimate_plan_bytes(right)
+    return est is not None and est <= int(threshold)

@@ -75,6 +75,8 @@ def tag_node(meta: PlanMeta):
         keys = [resolve(ce, schema) for ce in plan.keys]
         meta.resolved["keys"] = keys
         meta.expr_metas = [ExprMeta(e, conf) for e in keys]
+    elif isinstance(plan, L.LogicalGenerate):
+        _tag_generate(meta)
     elif isinstance(plan, L.LogicalWindow):
         _tag_window(meta)
     elif isinstance(plan, L.LogicalWrite):
@@ -260,3 +262,24 @@ def _tag_window(meta: PlanMeta):
                        for e in part_exprs + order_exprs] + \
         [ExprMeta(f.child, meta.conf)
          for f in meta.resolved["funcs"] if f.child is not None]
+
+
+def _tag_generate(meta: PlanMeta):
+    """explode/posexplode of an array literal (the reference's supported
+    generator surface, GpuGenerateExec.scala:101+)."""
+    plan: L.LogicalGenerate = meta.plan
+    values = list(plan.generator.args[0])
+    if not values:
+        meta.will_not_work("explode of an empty array literal")
+        values = [None]
+    from .analysis import _infer_value_dtype
+    dtype = _infer_value_dtype(values)
+    if dtype is None:
+        meta.will_not_work("explode values must share one supported type")
+        from ..types import StringType as _S
+        dtype = _S
+        values = [None if v is None else str(v) for v in values]
+    meta.resolved["values"] = values
+    meta.resolved["value_dtype"] = dtype
+    meta.resolved["pos"] = plan.generator.op == "PosExplode"
+    meta.resolved["names"] = list(plan.names)

@@ -93,7 +93,11 @@ class BounceBufferPool:
     def __init__(self, pool_size: int, buffer_size: int = 1 << 20):
         self.buffer_size = buffer_size
         self._backing = np.zeros(pool_size, dtype=np.uint8)
-        self._alloc = AddressSpaceAllocator(pool_size)
+        from ..native import NativeAddressSpaceAllocator, native_available
+        if native_available():
+            self._alloc = NativeAddressSpaceAllocator(pool_size)
+        else:
+            self._alloc = AddressSpaceAllocator(pool_size)
         self._cond = threading.Condition()
 
     def acquire(self, length: int, timeout: float = 30.0) -> int:

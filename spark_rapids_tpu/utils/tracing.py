@@ -1,0 +1,39 @@
+"""Tracing/profiling ranges.
+
+TPU-native analogue of the reference's NVTX integration
+(rapids/NvtxWithMetrics.scala:44 — a profiler range that also accumulates a
+SQLMetric; docs/dev/nvtx_profiling.md): ranges show up in the XLA/JAX trace
+viewer instead of Nsight.  `profile_trace` wraps jax.profiler for capturing
+a trace directory viewable in TensorBoard/XProf.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def named_range(name: str, metrics=None, metric_name: str = None):
+    """A profiler range; optionally accumulates elapsed seconds into a
+    Metrics object (NvtxWithMetrics equivalent)."""
+    import jax
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            with jax.named_scope(name):
+                yield
+    finally:
+        if metrics is not None:
+            metrics.add(metric_name or name, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a device trace for the enclosed block (the Nsight-capture
+    equivalent; open with TensorBoard's profile plugin)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

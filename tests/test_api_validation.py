@@ -1,0 +1,85 @@
+"""API validation (reference: api_validation/.../ApiValidation.scala:16-40 —
+reflection over CPU exec signatures vs Gpu exec signatures to catch drift).
+
+Here: every logical node must be convertible, every Tpu exec must have a CPU
+counterpart with a compatible constructor, and the conf registry must stay
+documented.
+"""
+import inspect
+
+import spark_rapids_tpu.plan.logical as L
+from spark_rapids_tpu import config as C
+
+
+def _logical_nodes():
+    return [cls for name, cls in vars(L).items()
+            if isinstance(cls, type) and issubclass(cls, L.LogicalPlan)
+            and cls is not L.LogicalPlan]
+
+
+def test_every_logical_node_has_display_name():
+    from spark_rapids_tpu.plan.overrides import _DISPLAY_NAMES
+    missing = [cls.__name__ for cls in _logical_nodes()
+               if cls not in _DISPLAY_NAMES]
+    assert not missing, f"logical nodes without display names: {missing}"
+
+
+def test_tpu_cpu_exec_pairs_signature_compatible():
+    """Each Tpu*Exec/Cpu*Exec pair must accept the same leading
+    constructor parameters (the ApiValidation check, adapted)."""
+    pairs = [
+        ("spark_rapids_tpu.exec.basic", "TpuProjectExec", "CpuProjectExec"),
+        ("spark_rapids_tpu.exec.basic", "TpuFilterExec", "CpuFilterExec"),
+        ("spark_rapids_tpu.exec.basic", "TpuUnionExec", "CpuUnionExec"),
+        ("spark_rapids_tpu.exec.basic", "TpuExpandExec", "CpuExpandExec"),
+        ("spark_rapids_tpu.exec.generate", "TpuGenerateExec",
+         "CpuGenerateExec"),
+        ("spark_rapids_tpu.exec.broadcast", "TpuBroadcastExchangeExec",
+         "CpuBroadcastExchangeExec"),
+    ]
+    import importlib
+    for mod_name, tpu_name, cpu_name in pairs:
+        mod = importlib.import_module(mod_name)
+        tpu = getattr(mod, tpu_name)
+        cpu = getattr(mod, cpu_name)
+        tsig = list(inspect.signature(tpu.__init__).parameters)
+        csig = list(inspect.signature(cpu.__init__).parameters)
+        assert tsig == csig, (
+            f"{tpu_name}{tsig} != {cpu_name}{csig}: the planner swaps these "
+            "based on tagging; their constructors must stay in sync")
+
+
+def test_execs_declare_schema():
+    """Every exec class must implement the schema property."""
+    import importlib
+    from spark_rapids_tpu.exec.base import ExecNode
+    mods = ["basic", "aggregate", "join", "sort", "window", "generate",
+            "broadcast", "exchange", "cpu_relational"]
+    missing = []
+    for m in mods:
+        mod = importlib.import_module(f"spark_rapids_tpu.exec.{m}")
+        for name, cls in vars(mod).items():
+            if (isinstance(cls, type) and issubclass(cls, ExecNode)
+                    and cls.__module__ == mod.__name__
+                    and not name.startswith("_")
+                    and name.endswith("Exec")  # skip abstract intermediates
+                    and "schema" not in vars(cls)
+                    and not any("schema" in vars(b) for b in cls.__mro__
+                                if b is not ExecNode)):
+                if name in ("RowLocalExec",):
+                    continue
+                missing.append(f"{m}.{name}")
+    assert not missing, f"execs without schema: {missing}"
+
+
+def test_all_confs_documented():
+    for e in C.registered_entries():
+        assert e.doc and len(e.doc) > 10, f"{e.key} lacks documentation"
+        assert e.key.startswith("spark."), e.key
+
+
+def test_conf_doc_generation_contains_all_public_keys():
+    doc = C.help_doc()
+    for e in C.registered_entries():
+        if not e.internal:
+            assert e.key in doc, f"{e.key} missing from generated docs"

@@ -1,0 +1,126 @@
+"""Generate (explode/posexplode) and broadcast exchange/join tests
+(SURVEY.md §2.5: GpuGenerateExec, GpuBroadcastExchangeExec,
+GpuBroadcastHashJoinExec)."""
+import numpy as np
+
+from compare import assert_tpu_and_cpu_are_equal
+from spark_rapids_tpu.plan.logical import col, functions as F
+
+
+def test_explode_literal_array():
+    data = {"a": [1, 2, 3]}
+
+    def q(s):
+        return s.from_pydict(data).select(
+            col("a"), F.explode([10, 20, 30]).alias("x"))
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_posexplode_literal_array():
+    data = {"a": [1, 2]}
+
+    def q(s):
+        return s.from_pydict(data).select(
+            col("a"), F.posexplode(["p", "q", None]).alias("x"))
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_explode_on_tpu():
+    from spark_rapids_tpu.engine import TpuSession
+    s = TpuSession({})
+    df = s.from_pydict({"a": [1, 2]}).select(
+        col("a"), F.explode([1.5, 2.5]).alias("x"))
+    text = df.explain()
+    assert "GenerateExec" in text
+    rows = sorted(df.collect())
+    assert rows == [(1, 1.5), (1, 2.5), (2, 1.5), (2, 2.5)]
+
+
+def test_explode_then_filter_aggregate():
+    data = {"k": [1, 1, 2]}
+
+    def q(s):
+        df = s.from_pydict(data).select(
+            col("k"), F.explode([1, 2, 3, 4]).alias("x"))
+        return df.filter(col("x") > 1).group_by(col("k")) \
+            .agg(F.sum(col("x")).alias("sx"))
+    assert_tpu_and_cpu_are_equal(q)
+
+
+# ---- broadcast --------------------------------------------------------------
+
+def _join_data(n=500, m=20, seed=0):
+    rng = np.random.RandomState(seed)
+    return ({"k": rng.randint(0, m, n).tolist(),
+             "v": rng.uniform(0, 1, n).tolist()},
+            {"k": list(range(m)),
+             "name": [f"dim{i}" for i in range(m)]})
+
+
+def test_broadcast_hint_selects_broadcast_join():
+    from spark_rapids_tpu.engine import TpuSession
+    left, right = _join_data()
+    s = TpuSession({})
+    lf = s.from_pydict(left)
+    rf = s.from_pydict(right).hint("broadcast")
+    physical = lf.join(rf, on="k").physical_plan()
+    text = physical.tree_string()
+    assert "TpuBroadcastHashJoinExec" in text, text
+    assert "TpuBroadcastExchangeExec" in text, text
+
+
+def test_small_build_auto_broadcasts():
+    from spark_rapids_tpu.engine import TpuSession
+    left, right = _join_data()
+    s = TpuSession({})
+    physical = s.from_pydict(left).join(s.from_pydict(right), on="k") \
+        .physical_plan()
+    assert "TpuBroadcastHashJoinExec" in physical.tree_string()
+
+
+def test_broadcast_disabled_by_threshold():
+    from spark_rapids_tpu.engine import TpuSession
+    left, right = _join_data()
+    s = TpuSession({"spark.sql.autoBroadcastJoinThreshold": -1})
+    physical = s.from_pydict(left).join(s.from_pydict(right), on="k") \
+        .physical_plan()
+    text = physical.tree_string()
+    assert "TpuBroadcastHashJoinExec" not in text, text
+    assert "TpuHashJoinExec" in text, text
+
+
+def test_broadcast_join_results_match():
+    left, right = _join_data(seed=3)
+
+    def q(s):
+        return s.from_pydict(left).join(
+            s.from_pydict(right).hint("broadcast"), on="k")
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_broadcast_left_join_with_misses():
+    left, right = _join_data(seed=4, m=10)
+    right["k"] = [k for k in right["k"] if k % 2 == 0]
+    right["name"] = [f"dim{k}" for k in right["k"]]
+
+    def q(s):
+        return s.from_pydict(left).join(
+            s.from_pydict(right).hint("broadcast"), on="k", how="left")
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_broadcast_exchange_collects_once():
+    """The broadcast value must be built once and reused."""
+    from spark_rapids_tpu.engine import TpuSession
+    from spark_rapids_tpu.exec.base import ExecContext
+    from spark_rapids_tpu.exec.broadcast import TpuBroadcastExchangeExec
+    s = TpuSession({})
+    _, right = _join_data()
+    child = s.from_pydict(right).physical_plan()
+    bc = TpuBroadcastExchangeExec(child)
+    ctx = ExecContext(s.conf, runtime=s.runtime)
+    b1 = list(bc.execute(ctx))[0]
+    calls = bc.metrics.values.get("collectTime")
+    b2 = list(bc.execute(ctx))[0]
+    assert bc.metrics.values.get("collectTime") == calls  # not re-collected
+    assert b1.to_pylist() == b2.to_pylist()
