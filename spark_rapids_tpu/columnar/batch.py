@@ -176,21 +176,28 @@ class ColumnarBatch:
         sel = jnp.arange(cap, dtype=jnp.int32) < n
         return ColumnarBatch(cols, sel, Schema(fields))
 
+    def _live_rows(self):
+        """Host-side selector of live rows: prefix length when the batch is
+        already dense, else an index array (numpy boolean compaction — no
+        device gather, no jit compile on the D2H path)."""
+        sel_np = np.asarray(self.sel)
+        n = int(sel_np.sum())
+        if bool(sel_np[:n].all()):
+            return n, n
+        return np.flatnonzero(sel_np), n
+
     def to_arrow(self):
-        """D2H: compact and convert to a pyarrow Table."""
+        """D2H: convert live rows to a pyarrow Table (vectorized — one
+        buffer-level conversion per column, no per-row Python loop)."""
         import pyarrow as pa
-        b = self.compact()
-        n = b.num_rows_host()
-        arrays = []
-        for f, c in zip(b.schema, b.columns):
-            vals = c.to_pylist(n)
-            arrays.append(pa.array(vals, type=to_arrow(f.dtype)))
-        return pa.table(arrays, names=b.schema.names)
+        rows, _ = self._live_rows()
+        arrays = [c.to_arrow(rows, to_arrow(f.dtype))
+                  for f, c in zip(self.schema, self.columns)]
+        return pa.table(arrays, names=self.schema.names)
 
     def to_pylist(self) -> List[tuple]:
-        b = self.compact()
-        n = b.num_rows_host()
-        cols = [c.to_pylist(n) for c in b.columns]
+        rows, n = self._live_rows()
+        cols = [c.to_pylist(rows) for c in self.columns]
         return list(zip(*cols)) if cols else [()] * n
 
     def __repr__(self):  # pragma: no cover

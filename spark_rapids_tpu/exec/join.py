@@ -39,10 +39,19 @@ import numpy as np
 
 from ..columnar import Column, ColumnarBatch, concat_batches
 from ..columnar.batch import bucket_rows
+from ..utils import pow2_bucket as _pow2_bucket
 from ..ops import expressions as E
 from ..ops.hashing import _normalize_bits, hash_columns_double
 from ..types import Schema, StructField
 from .base import ExecContext, ExecNode, TpuExec
+
+
+def _pvary(x, axes):
+    """Mark a freshly-created array as varying over shard_map manual axes so
+    fori_loop carries typecheck (no-op when not under shard_map)."""
+    if not axes:
+        return x
+    return jax.lax.pcast(x, axes, to="varying")
 
 
 def _row_equal(lcol: Column, bcol: Column, bidx):
@@ -132,7 +141,8 @@ class TpuHashJoinExec(TpuExec):
         return lo, hi, jnp.max(width)
 
     def _count_kernel(self, max_dup: int, lbatch: ColumnarBatch,
-                      build: ColumnarBatch, bkeys, lo, hi):
+                      build: ColumnarBatch, bkeys, lo, hi,
+                      vary_axes: tuple = ()):
         """Verified match count per stream row + prefix starts + total."""
         lkeys = [e.eval(lbatch) for e in self.left_keys]
         cap_b = build.capacity
@@ -147,7 +157,8 @@ class TpuHashJoinExec(TpuExec):
             return cnt + ok.astype(jnp.int32)
 
         counts = jax.lax.fori_loop(
-            0, max_dup, body, jnp.zeros(lbatch.capacity, jnp.int32))
+            0, max_dup, body,
+            _pvary(jnp.zeros(lbatch.capacity, jnp.int32), vary_axes))
         if self.join_type == "left":
             counts = jnp.where(live & (counts == 0), 1, counts)
         starts = jnp.cumsum(counts) - counts
@@ -155,7 +166,8 @@ class TpuHashJoinExec(TpuExec):
 
     def _gather_kernel(self, max_dup: int, out_cap: int,
                        lbatch: ColumnarBatch, build: ColumnarBatch, bkeys,
-                       lo, hi, counts, starts, total):
+                       lo, hi, counts, starts, total,
+                       vary_axes: tuple = ()):
         """Scatter (left_row, build_row) pairs into output slots, then
         gather the joined columns."""
         lkeys = [e.eval(lbatch) for e in self.left_keys]
@@ -163,9 +175,9 @@ class TpuHashJoinExec(TpuExec):
         live = lbatch.sel
         blive = build.sel
 
-        l_idx = jnp.zeros(out_cap, jnp.int32)
-        b_idx = jnp.zeros(out_cap, jnp.int32)
-        matched = jnp.zeros(out_cap, jnp.bool_)
+        l_idx = _pvary(jnp.zeros(out_cap, jnp.int32), vary_axes)
+        b_idx = _pvary(jnp.zeros(out_cap, jnp.int32), vary_axes)
+        matched = _pvary(jnp.zeros(out_cap, jnp.bool_), vary_axes)
         rows = jnp.arange(lbatch.capacity, dtype=jnp.int32)
 
         def body(d, carry):
@@ -180,7 +192,7 @@ class TpuHashJoinExec(TpuExec):
             m_out = m_out.at[slot].set(True, mode="drop")
             return l_out, b_out, m_out, rank + ok.astype(jnp.int32)
 
-        zero_rank = jnp.zeros(lbatch.capacity, jnp.int32)
+        zero_rank = _pvary(jnp.zeros(lbatch.capacity, jnp.int32), vary_axes)
         l_idx, b_idx, matched, _ = jax.lax.fori_loop(
             0, max_dup, body, (l_idx, b_idx, matched, zero_rank))
         if self.join_type == "left":
@@ -243,7 +255,10 @@ class TpuHashJoinExec(TpuExec):
         for lbatch in self.children[0].execute(ctx):
             with self.metrics.timer("joinTime"):
                 lo, hi, max_dup_t = window_fn(lbatch, h1s)
-                max_dup = int(max_dup_t)  # host sync #1
+                # power-of-two bucket: max_dup is a data-dependent integer
+                # that becomes part of the kernel-cache key — raw values
+                # would force a recompile per distinct build-side skew
+                max_dup = _pow2_bucket(int(max_dup_t))  # host sync #1
                 count_fn = cached_kernel(
                     key + ("count", max_dup),
                     lambda: functools.partial(self._count_kernel, max_dup))

@@ -8,19 +8,26 @@ repartition-by-key is ONE XLA collective (`all_to_all` over ICI) inside a
 `shard_map`-traced program — no control plane, no staging copies, and the
 compiler overlaps it with compute.
 
-Key trick that makes this static-shape friendly: batches carry a selection
-mask, so "send rows with bucket==d to device d" does not compact anything —
-every device sends its full (identical) column data tiled n ways with n
-different selection masks.  Sel-mask shuffles trade bandwidth for zero
-dynamic shapes; the coalesce pass compacts after the exchange.
+Two exchange strategies, both static-shape:
+
+  * `exchange_compact` (default): each device compacts its live rows into a
+    fixed per-destination quota block [n, q] and ONE tiled `all_to_all`
+    moves exactly the owned rows — per-device traffic and received capacity
+    are O(cap), independent of mesh size.  Quota overflow is *detected*
+    (returned as a scalar) and the host driver retries with a doubled
+    quota — the bounded-capacity + overflow-retry pattern this framework
+    uses everywhere XLA's static shapes meet data-dependent sizes.
+  * `exchange_by_bucket` (fallback knob): sel-mask all_gather — every device
+    receives all n*cap rows with n different selection masks.  Zero overflow
+    risk, linear-in-n cost; kept for tiny meshes and as the safety net.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:
@@ -30,6 +37,7 @@ except ImportError:  # pragma: no cover
 
 from ..columnar import Column, ColumnarBatch
 from ..ops.hashing import hash_columns_double
+from ..utils import pow2_bucket
 from .mesh import DATA_AXIS
 
 
@@ -41,14 +49,75 @@ def _all_to_all(x, axis: str):
                               tiled=True)
 
 
+def default_quota(local_cap: int, n: int, factor: int = 2,
+                  minimum: int = 8) -> int:
+    """Per-destination row quota for exchange_compact: a power-of-two bucket
+    of factor*cap/n, clamped to cap.  `factor` absorbs hash imbalance so the
+    overflow-retry path stays cold."""
+    want = max(minimum, factor * local_cap // max(n, 1))
+    return min(pow2_bucket(want, minimum), local_cap)
+
+
+def exchange_compact(batch: ColumnarBatch, bucket, quota: int,
+                     axis: str = DATA_AXIS):
+    """Inside shard_map: route each live row to device `bucket[row]` with a
+    fixed quota of `quota` rows per destination.
+
+    Returns (out_batch, overflow):
+      * out_batch has capacity n*quota — quota rows received from each peer,
+        live rows flagged by its selection mask;
+      * overflow = total rows (across all devices) that exceeded their
+        destination quota and were DROPPED.  overflow == 0 means lossless;
+        a driver must treat overflow > 0 as a retry signal, not a result.
+
+    Reference contract analogue: RapidsShuffleTransport.scala:38-500 moves
+    partitions through bounded bounce-buffer pools with throttled receives;
+    here the bound is the static quota block and the "throttle" is the
+    compiled all_to_all schedule.
+    """
+    n = jax.lax.psum(1, axis)  # concrete: mesh size
+    cap = batch.capacity
+    live = batch.sel
+    dest = jnp.where(live, bucket.astype(jnp.int32), n)
+    # group rows by destination (stable: preserves row order within a dest)
+    order = jnp.argsort(dest, stable=True).astype(jnp.int32)
+    dsorted = jnp.take(dest, order)
+    start_of = jnp.searchsorted(dsorted, jnp.arange(n, dtype=jnp.int32)
+                                ).astype(jnp.int32)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    rank = pos - jnp.take(start_of, jnp.clip(dsorted, 0, n - 1))
+    fits = (dsorted < n) & (rank < quota)
+    slot = jnp.where(fits, dsorted * quota + rank, n * quota)
+    send_idx = jnp.full((n * quota,), cap, jnp.int32).at[slot].set(
+        order, mode="drop")
+    send_ok = jnp.zeros((n * quota,), jnp.bool_).at[slot].set(
+        True, mode="drop")
+    overflow = jnp.sum(((dsorted < n) & (rank >= quota)).astype(jnp.int32))
+
+    def exchange_col(c: Column) -> Column:
+        t = c.take(send_idx)
+        if c.dtype.is_string:
+            return Column(_all_to_all(t.data, axis),
+                          _all_to_all(t.valid, axis), c.dtype,
+                          _all_to_all(t.lengths, axis))
+        return Column(_all_to_all(t.data, axis), _all_to_all(t.valid, axis),
+                      c.dtype)
+
+    cols = [exchange_col(c) for c in batch.columns]
+    recv_sel = _all_to_all(send_ok, axis)
+    out = ColumnarBatch(cols, recv_sel, batch.schema)
+    return out, jax.lax.psum(overflow, axis)
+
+
 def exchange_by_bucket(batch: ColumnarBatch, bucket, axis: str = DATA_AXIS
                        ) -> ColumnarBatch:
-    """Inside shard_map: route each live row to device `bucket[row] % n`.
+    """Sel-mask fallback: route each live row to device `bucket[row] % n`.
 
     Returns a batch of capacity n*cap whose selection mask keeps exactly the
     rows this device owns.  Since every destination receives the SAME column
     data (only the selection mask differs per destination), the data movement
-    is an all_gather; only the mask needs a true all_to_all.
+    is an all_gather; only the mask needs a true all_to_all.  O(n*cap)
+    received capacity — fine for small meshes, disqualifying at pod scale.
     """
     n = jax.lax.psum(1, axis)
     cap = batch.capacity
@@ -78,8 +147,13 @@ def key_buckets(key_cols: Sequence[Column], live, n: int):
     return (h1 % jnp.uint64(n)).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# aggregate
+# ---------------------------------------------------------------------------
+
 def distributed_aggregate_step(agg, mesh: Mesh, axis: str = DATA_AXIS,
-                               pre=None):
+                               pre=None, quota=None,
+                               use_allgather: bool = False):
     """Build the full SPMD aggregation step over a mesh.
 
     Per device: [optional fused filter/project `pre`] -> update-aggregate
@@ -89,21 +163,260 @@ def distributed_aggregate_step(agg, mesh: Mesh, axis: str = DATA_AXIS,
     rapids/aggregate.scala Partial/Final modes + GpuShuffleExchangeExec), as
     one compiled XLA program.
 
-    `agg` is a TpuHashAggregateExec (provides the three kernels).
-    Returns a function: globally row-sharded batch -> row-sharded result
-    batch whose live rows are each device's owned groups.
+    Returns a function: globally row-sharded batch -> (row-sharded result
+    batch whose live rows are each device's owned groups, overflow scalar).
+    overflow > 0 means the exchange quota was exceeded: the result is
+    incomplete and the caller must retry with a larger quota (see
+    run_distributed_aggregate).  The sel-mask path never overflows.
     """
     n = mesh.shape[axis]
     nkeys = len(agg.grouping)
 
-    def step(local: ColumnarBatch) -> ColumnarBatch:
+    def step(local: ColumnarBatch):
         if pre is not None:
             local = pre(local)
         state = agg._update_kernel(local)
         bucket = key_buckets(list(state.columns[:nkeys]), state.sel, n)
-        gathered = exchange_by_bucket(state, bucket, axis)
+        if use_allgather:
+            gathered = exchange_by_bucket(state, bucket, axis)
+            overflow = jnp.int32(0)
+        else:
+            q = quota if quota is not None \
+                else default_quota(state.capacity, n)
+            gathered, overflow = exchange_compact(state, bucket, q, axis)
         merged = agg._merge_kernel(gathered)
-        return agg._finalize_kernel(merged)
+        return agg._finalize_kernel(merged), overflow
 
     return shard_map(step, mesh=mesh, in_specs=(P(axis),),
-                     out_specs=P(axis))
+                     out_specs=(P(axis), P()))
+
+
+def run_distributed_aggregate(agg, mesh: Mesh, batch: ColumnarBatch,
+                              pre=None, axis: str = DATA_AXIS,
+                              use_allgather: bool = False) -> ColumnarBatch:
+    """Host driver: run the SPMD aggregate with overflow-retry.
+
+    Doubles the exchange quota (recompiling) until the exchange is lossless;
+    terminates because quota caps at the local capacity, where every row
+    fits by construction."""
+    n = mesh.shape[axis]
+    local_cap = batch.capacity // n
+    quota = None if use_allgather else default_quota(local_cap, n)
+    while True:
+        step = jax.jit(distributed_aggregate_step(
+            agg, mesh, axis=axis, pre=pre, quota=quota,
+            use_allgather=use_allgather))
+        with mesh:
+            out, overflow = step(batch)
+        if use_allgather or int(overflow) == 0:
+            return out
+        if quota >= local_cap:  # pragma: no cover - cannot overflow at cap
+            raise AssertionError("overflow with quota == local capacity")
+        quota = min(local_cap, quota * 2)
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+def distributed_join_step(join, mesh: Mesh, max_dup: int, out_cap: int,
+                          quota_left: int, quota_right: int,
+                          axis: str = DATA_AXIS,
+                          use_allgather: bool = False):
+    """SPMD hash join: hash-partition both sides by join key, local
+    sort+searchsorted join per device (the reference pairs
+    GpuShuffleExchangeExec with GpuShuffledHashJoinExec the same way;
+    GpuShuffledHashJoinExec.scala:83-87).
+
+    Static knobs (bounded-capacity + overflow-retry, see module docstring):
+      * quota_left/right — exchange quotas per side;
+      * max_dup  — widest candidate hash window the probe loop scans;
+      * out_cap  — output slot count per device (inner/left only).
+
+    Returns fn: (left_sharded, right_sharded) ->
+        (out_batch, left_overflow, right_overflow, dup_overflow,
+         cap_overflow)
+    where the four scalars flag which knob was too small (0 = fine).
+    """
+    n = mesh.shape[axis]
+
+    def step(lleft: ColumnarBatch, lright: ColumnarBatch):
+        lkey_cols = [e.eval(lleft) for e in join.left_keys]
+        rkey_cols = [e.eval(lright) for e in join.right_keys]
+        lbucket = key_buckets(lkey_cols, lleft.sel, n)
+        rbucket = key_buckets(rkey_cols, lright.sel, n)
+        if use_allgather:
+            lex = exchange_by_bucket(lleft, lbucket, axis)
+            rex = exchange_by_bucket(lright, rbucket, axis)
+            lovf = rovf = jnp.int32(0)
+        else:
+            lex, lovf = exchange_compact(lleft, lbucket, quota_left, axis)
+            rex, rovf = exchange_compact(lright, rbucket, quota_right, axis)
+
+        build, bkeys, h1s = join._build_kernel(rex)
+        lo, hi, max_dup_t = join._window_kernel(lex, h1s)
+        dup_overflow = jnp.maximum(max_dup_t.astype(jnp.int32) - max_dup, 0)
+        counts, starts, total = join._count_kernel(
+            max_dup, lex, build, bkeys, lo, hi, vary_axes=(axis,))
+        if join.join_type in ("left_semi", "left_anti"):
+            out = join._semi_kernel(lex, counts)
+            out = ColumnarBatch(out.columns, out.sel, join._schema)
+            cap_overflow = jnp.int32(0)
+        else:
+            out = join._gather_kernel(max_dup, out_cap, lex, build, bkeys,
+                                      lo, hi, counts, starts, total,
+                                      vary_axes=(axis,))
+            cap_overflow = jnp.maximum(total.astype(jnp.int32) - out_cap, 0)
+        return (out, jax.lax.psum(lovf, axis), jax.lax.psum(rovf, axis),
+                jax.lax.psum(dup_overflow, axis),
+                jax.lax.psum(cap_overflow, axis))
+
+    return shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
+                     out_specs=(P(axis), P(), P(), P(), P()))
+
+
+def run_distributed_join(join, mesh: Mesh, left: ColumnarBatch,
+                         right: ColumnarBatch, axis: str = DATA_AXIS,
+                         max_dup: int = 8, out_cap=None,
+                         use_allgather: bool = False) -> ColumnarBatch:
+    """Host driver for the SPMD join with overflow-retry on all three knobs."""
+    n = mesh.shape[axis]
+    lcap, rcap = left.capacity // n, right.capacity // n
+    quota_l = default_quota(lcap, n)
+    quota_r = default_quota(rcap, n)
+    # received capacities are n*quota; out_cap defaults assume modest fanout
+    if out_cap is None:
+        out_cap = max(n * quota_l, 1024)
+    while True:
+        step = jax.jit(distributed_join_step(
+            join, mesh, max_dup, out_cap, quota_l, quota_r, axis=axis,
+            use_allgather=use_allgather))
+        with mesh:
+            out, l_ovf, r_ovf, dup_ovf, cap_ovf = step(left, right)
+        retry = False
+        if not use_allgather and int(l_ovf) > 0:
+            if quota_l >= lcap:  # pragma: no cover - cap always fits
+                raise AssertionError("left exchange overflow at full quota")
+            quota_l = min(lcap, quota_l * 2)
+            retry = True
+        if not use_allgather and int(r_ovf) > 0:
+            if quota_r >= rcap:  # pragma: no cover - cap always fits
+                raise AssertionError("right exchange overflow at full quota")
+            quota_r = min(rcap, quota_r * 2)
+            retry = True
+        if int(dup_ovf) > 0:
+            # power-of-two bucket: bounded kernel-cache keys
+            max_dup = pow2_bucket(max_dup + int(dup_ovf))
+            retry = True
+        if int(cap_ovf) > 0:
+            out_cap = out_cap * 2
+            retry = True
+        if not retry:
+            return out
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def _range_scalar_key(col: Column, ascending: bool, nulls_first: bool):
+    """A monotone float64 COARSENING of one sort column's order, used only
+    for range bucketing: rows that compare equal under the coarse key are
+    guaranteed to land on the same device, so local full-precision sorting
+    plus device order yields a correct global order.
+
+    (f64 precision loss over int64/strings only *merges* adjacent key values
+    — a coarsening — never reorders them.  Sentinels are ±inf, which MERGES
+    NaN with +inf data values and nulls with ±inf extremes rather than
+    inventing an order between them — merged rows colocate and the local
+    full-precision sort places them.)"""
+    if col.dtype.is_string:
+        cap, L = col.data.shape
+        w = col.data[:, :8].astype(jnp.uint64) if L >= 8 else jnp.pad(
+            col.data, ((0, 0), (0, 8 - L))).astype(jnp.uint64)
+        shifts = jnp.arange(56, -8, -8, dtype=jnp.uint64)
+        key = jnp.sum(w << shifts, axis=1, dtype=jnp.uint64).astype(
+            jnp.float64)
+    elif col.dtype.is_floating:
+        d = col.data.astype(jnp.float64)
+        # NaN is greatest under Spark sort semantics: merge it with +inf
+        key = jnp.where(jnp.isnan(d), jnp.float64(np.inf), d)
+    else:
+        key = col.data.astype(jnp.float64)
+    if not ascending:
+        key = -key
+    null_key = jnp.float64(-np.inf if nulls_first else np.inf)
+    return jnp.where(col.valid, key, null_key)
+
+
+def distributed_sort_step(sort_exprs, ascending, nulls_first, mesh: Mesh,
+                          quota: int, n_samples: int = 64,
+                          axis: str = DATA_AXIS,
+                          use_allgather: bool = False):
+    """SPMD global sort: sample range bounds -> range-partition exchange ->
+    local lexsort.  The reference realizes global sort as
+    GpuRangePartitioner (host-side reservoir sampling) + per-partition
+    GpuSortExec (GpuRangePartitioner.scala:42-216, GpuSortExec.scala); here
+    the sampling, exchange and sort are one compiled SPMD program.
+
+    Returns fn: sharded batch -> (sharded sorted batch, overflow).  Device
+    d's live rows are all <= device d+1's under the sort order, and locally
+    sorted — so shard order IS global order.
+    """
+    from ..exec.sort import sort_order
+    n = mesh.shape[axis]
+    first = sort_exprs[0]
+
+    def step(local: ColumnarBatch):
+        cap = local.capacity
+        c0 = first.eval(local)
+        coarse = _range_scalar_key(c0, ascending[0], nulls_first[0])
+        live = local.sel
+        m = jnp.sum(live.astype(jnp.int32))
+        # sample n_samples evenly spaced live coarse keys (sorted, dead last)
+        ckey = jnp.where(live, coarse, jnp.float64(np.inf))
+        csorted = jnp.sort(ckey)
+        sample_pos = (jnp.arange(n_samples, dtype=jnp.int32)
+                      * jnp.maximum(m, 1)) // n_samples
+        samples = jnp.take(csorted, jnp.clip(sample_pos, 0, cap - 1))
+        samples = jnp.where(m > 0, samples, jnp.float64(np.inf))
+        all_samples = jnp.sort(
+            jax.lax.all_gather(samples, axis, tiled=True))     # [n*n_samples]
+        bounds = jnp.take(all_samples,
+                          jnp.arange(1, n, dtype=jnp.int32) * n_samples)
+        bucket = jnp.searchsorted(bounds, coarse, side="left").astype(
+            jnp.int32)
+        if use_allgather:
+            ex = exchange_by_bucket(local, bucket, axis)
+            overflow = jnp.int32(0)
+        else:
+            ex, overflow = exchange_compact(local, bucket, quota, axis)
+        order = sort_order(ex, sort_exprs, ascending, nulls_first)
+        out = ex.take(order)
+        k = jnp.arange(out.capacity, dtype=jnp.int32)
+        out = out.with_sel(k < jnp.sum(ex.sel.astype(jnp.int32)))
+        return out, jax.lax.psum(overflow, axis)
+
+    return shard_map(step, mesh=mesh, in_specs=(P(axis),),
+                     out_specs=(P(axis), P()))
+
+
+def run_distributed_sort(sort_exprs, ascending, nulls_first, mesh: Mesh,
+                         batch: ColumnarBatch, axis: str = DATA_AXIS,
+                         use_allgather: bool = False) -> ColumnarBatch:
+    """Host driver for the SPMD sort with quota overflow-retry."""
+    n = mesh.shape[axis]
+    local_cap = batch.capacity // n
+    # range partitions are less uniform than hash: start with a wider quota
+    quota = default_quota(local_cap, n, factor=4)
+    while True:
+        step = jax.jit(distributed_sort_step(
+            sort_exprs, ascending, nulls_first, mesh, quota, axis=axis,
+            use_allgather=use_allgather))
+        with mesh:
+            out, overflow = step(batch)
+        if use_allgather or int(overflow) == 0:
+            return out
+        if quota >= local_cap:  # pragma: no cover - cannot overflow at cap
+            raise AssertionError("overflow with quota == local capacity")
+        quota = min(local_cap, quota * 2)

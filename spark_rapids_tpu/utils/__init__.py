@@ -1,0 +1,17 @@
+"""Shared utilities."""
+from __future__ import annotations
+
+
+def pow2_bucket(n: int, minimum: int = 1) -> int:
+    """Round a dynamic count up to a power-of-two bucket (>= minimum).
+
+    The framework's standard answer to data-dependent integers that become
+    static kernel shapes or kernel-cache keys: bucketing bounds the set of
+    compiled programs (log2 many) instead of one per distinct value.
+    n <= 0 stays 0."""
+    if n <= 0:
+        return 0
+    b = max(1, minimum)
+    while b < n:
+        b <<= 1
+    return b

@@ -1,0 +1,36 @@
+"""Force the JAX CPU backend before any backend initializes.
+
+The container's sitecustomize registers the axon TPU PJRT plugin in every
+interpreter and the ambient env pins JAX_PLATFORMS=axon; there is ONE
+exclusive TPU chip behind a machine-wide lease, and merely enumerating
+backends can block on that lease indefinitely (round-1 postmortem: the
+driver's bench/dryrun runs died rc=124 exactly this way).  Anything that
+wants CPU execution — the test suite, the multichip dryrun, bench fallback —
+must (a) drop the axon/tpu backend factories and (b) update the latched
+jax config, BEFORE first backend use.  This is the one shared copy of that
+dance; jax._src.xla_bridge is a private API, so when a jax upgrade moves it,
+fix it here only.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def force_cpu_backend(n_devices: Optional[int] = None) -> None:
+    """Pin this process to the CPU backend; optionally provision `n_devices`
+    virtual devices (only effective before the CPU backend initializes)."""
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax._src.xla_bridge as xb
+    for plat in ("axon", "tpu"):
+        xb._backend_factories.pop(plat, None)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")

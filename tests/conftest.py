@@ -3,28 +3,18 @@ multi-chip sharding is exercised without TPU hardware (the driver separately
 dry-runs the multichip path)."""
 import os
 
-# force CPU: the ambient environment pins JAX_PLATFORMS=axon (one exclusive
-# real TPU chip behind a machine-wide lease) — tests must not contend for it,
-# and need 8 virtual devices for the multi-chip sharding tests
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-# float64 columns are part of the supported type surface
+# float64 columns are part of the supported type surface.  Env vars are read
+# when jax first imports (sitecustomize already imported it), so the latched
+# configs are ALSO set below — the env vars only help subprocesses.
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
-# The container's sitecustomize registers the axon TPU PJRT plugin in every
-# interpreter; merely enumerating backends then blocks on the TPU lease even
-# under JAX_PLATFORMS=cpu.  Drop the factory before any backend initializes.
-import jax._src.xla_bridge as _xb  # noqa: E402
+# force CPU + 8 virtual devices: the ambient environment pins
+# JAX_PLATFORMS=axon (one exclusive real TPU chip behind a machine-wide
+# lease) — tests must not contend for it
+from spark_rapids_tpu.utils.cpu_backend import force_cpu_backend  # noqa: E402
 
-for _plat in ("axon", "tpu"):
-    _xb._backend_factories.pop(_plat, None)
+force_cpu_backend(n_devices=8)
 
 import jax  # noqa: E402
 
-# sitecustomize already imported jax, so the env vars above were read before
-# this file ran; set the latched configs directly too
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
