@@ -292,3 +292,21 @@ def help_doc(include_internal: bool = False) -> str:
         "",
     ]
     return "\n".join(lines)
+
+
+def write_config_docs(path: str = None) -> str:
+    """Emit docs/configs.md from the registry (the reference generates its
+    configs.md from RapidsConf.main the same way, RapidsConf.scala:689)."""
+    import os
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs", "configs.md")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    text = help_doc()
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+if __name__ == "__main__":  # python -m spark_rapids_tpu.config
+    print(write_config_docs())

@@ -68,6 +68,8 @@ class TpuSession:
 
     # -- planning -----------------------------------------------------------
     def plan(self, logical: L.LogicalPlan) -> ExecNode:
+        from .plan.pushdown import optimize_scans
+        logical = optimize_scans(logical, self.conf)
         meta = PlanMeta(logical, self.conf)
         meta.tag_tree()
         explain_mode = self.conf.explain
@@ -306,10 +308,17 @@ class DataFrame:
     def to_arrow(self):
         import pyarrow as pa
         physical = self.session.plan(self.plan)
+        runtime = self.session.runtime
+        ctx = ExecContext(self.session.conf, runtime=runtime)
         if isinstance(physical, TpuExec):
             physical = B.DeviceToHostExec(physical)
-        ctx = ExecContext(self.session.conf, runtime=self.session.runtime)
-        tables = list(physical.execute_cpu(ctx))
+            # device semaphore: this "task" holds a device slot for the
+            # duration of its device work (reference:
+            # GpuSemaphore.acquireIfNecessary, released on task completion)
+            with runtime.semaphore.held():
+                tables = list(physical.execute_cpu(ctx))
+        else:
+            tables = list(physical.execute_cpu(ctx))
         if not tables:
             from .types import to_arrow
             return pa.table({f.name: pa.array([], type=to_arrow(f.dtype))
@@ -342,9 +351,14 @@ class DataFrame:
                 f"set {C.EXPORT_COLUMNAR_RDD.key}=true to export device "
                 "columnar data")
         physical = self.session.plan(self.plan)
-        ctx = ExecContext(self.session.conf, runtime=self.session.runtime)
+        runtime = self.session.runtime
+        ctx = ExecContext(self.session.conf, runtime=runtime)
         if isinstance(physical, TpuExec):
-            yield from physical.execute(ctx)
+            runtime.semaphore.acquire_if_necessary()
+            try:
+                yield from physical.execute(ctx)
+            finally:
+                runtime.semaphore.task_done()
         else:
             for table in physical.execute_cpu(ctx):
                 from .columnar import ColumnarBatch
@@ -437,10 +451,12 @@ class DataFrameWriter:
         plan = L.LogicalWrite(path, fmt, self.df.plan, self._options,
                               self._partition_by)
         physical = self.df.session.plan(plan)
-        ctx = ExecContext(self.df.session.conf)
+        runtime = self.df.session.runtime
+        ctx = ExecContext(self.df.session.conf, runtime=runtime)
         if isinstance(physical, TpuExec):
-            for _ in physical.execute(ctx):
-                pass
+            with runtime.semaphore.held():
+                for _ in physical.execute(ctx):
+                    pass
         else:
             for _ in physical.execute_cpu(ctx):
                 pass

@@ -35,6 +35,7 @@ from ..ops import expressions as E
 from ..ops.aggregates import AggregateExpression
 from ..ops.hashing import hash_columns_double
 from ..types import (DoubleType, LongType, Schema, StructField)
+from ..utils.tracing import named_range
 from .base import ExecContext, ExecNode, TpuExec
 
 _I64_MAX = np.int64(2**63 - 1)
@@ -481,7 +482,8 @@ class TpuHashAggregateExec(TpuExec):
         state = None
         offset = 0
         for batch in self.children[0].execute(ctx):
-            with self.metrics.timer("computeAggTime"):
+            with self.metrics.timer("computeAggTime"), \
+                    named_range("agg_update"):
                 partial = update(batch, jnp.int64(offset)) if needs_off \
                     else update(batch)
             if needs_off:
@@ -491,7 +493,8 @@ class TpuHashAggregateExec(TpuExec):
             else:
                 with self.metrics.timer("concatTime"):
                     both = concat_batches([state, partial])
-                with self.metrics.timer("mergeAggTime"):
+                with self.metrics.timer("mergeAggTime"), \
+                        named_range("agg_merge"):
                     state = merge(both)
         if state is None:
             if grouped:

@@ -24,6 +24,7 @@ from ..config import MAX_READER_BATCH_SIZE_ROWS
 from ..ops import expressions as E
 from ..ops.cpu_eval import (cpu_cols_to_table, cpu_eval, table_to_cpu_cols)
 from ..types import BooleanType, Schema, StructField
+from ..utils.tracing import named_range
 from .base import CpuExec, ExecContext, ExecNode, TpuExec
 
 
@@ -37,6 +38,8 @@ class TpuScanMemoryExec(TpuExec):
 
     def __init__(self, table, schema: Schema, conf=None):
         super().__init__()
+        if list(table.column_names) != schema.names:
+            table = table.select(schema.names)  # pushdown pruned the scan
         self.table = table
         self._schema = schema
 
@@ -95,7 +98,8 @@ class RowLocalExec(TpuExec):
                                           self.batch_fn()))
             offset = 0
             for batch in self.children[0].execute(ctx):
-                with self.metrics.timer("totalTime"):
+                with self.metrics.timer("totalTime"), \
+                        named_range(self.name):
                     out = fn(batch, jnp.int64(offset))
                 offset += batch.num_rows_host()
                 self.metrics.add("numOutputBatches", 1)
@@ -103,7 +107,7 @@ class RowLocalExec(TpuExec):
             return
         fn = cached_kernel(key, self.batch_fn)
         for batch in self.children[0].execute(ctx):
-            with self.metrics.timer("totalTime"):
+            with self.metrics.timer("totalTime"), named_range(self.name):
                 out = fn(batch)
             self.metrics.add("numOutputBatches", 1)
             yield out
@@ -392,6 +396,8 @@ class DeviceToHostExec(CpuExec):
 class CpuScanMemoryExec(CpuExec):
     def __init__(self, table, schema: Schema):
         super().__init__()
+        if list(table.column_names) != schema.names:
+            table = table.select(schema.names)  # pushdown pruned the scan
         self.table = table
         self._schema = schema
 

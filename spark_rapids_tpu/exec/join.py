@@ -40,6 +40,7 @@ import numpy as np
 from ..columnar import Column, ColumnarBatch, concat_batches
 from ..columnar.batch import bucket_rows
 from ..utils import pow2_bucket as _pow2_bucket
+from ..utils.tracing import named_range
 from ..ops import expressions as E
 from ..ops.hashing import _normalize_bits, hash_columns_double
 from ..types import Schema, StructField
@@ -249,11 +250,11 @@ class TpuHashJoinExec(TpuExec):
                 else concat_batches(rbatches)
         else:
             rbatch = _empty_batch(self.children[1].schema)
-        with self.metrics.timer("buildTime"):
+        with self.metrics.timer("buildTime"), named_range("join_build"):
             build, bkeys, h1s = build_fn(rbatch)
 
         for lbatch in self.children[0].execute(ctx):
-            with self.metrics.timer("joinTime"):
+            with self.metrics.timer("joinTime"), named_range("join_stream"):
                 lo, hi, max_dup_t = window_fn(lbatch, h1s)
                 # power-of-two bucket: max_dup is a data-dependent integer
                 # that becomes part of the kernel-cache key — raw values

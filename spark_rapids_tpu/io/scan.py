@@ -254,33 +254,94 @@ def _evolve(table, schema: Schema):
 # the H2D edge)
 # --------------------------------------------------------------------------
 
+def _rg_can_match(rg_meta, name_to_idx: dict, predicates) -> bool:
+    """Row-group min/max statistics vs pushed predicates: False = provably
+    no row matches, skip the group (reference: pushed-down filters rebuilt
+    against the footer, GpuParquetScan.scala:106-147).  Conservative on any
+    missing/incomparable statistic."""
+    for (name, op, value) in predicates:
+        idx = name_to_idx.get(name)
+        if idx is None:
+            continue
+        stats = rg_meta.column(idx).statistics
+        if stats is None or not stats.has_min_max:
+            continue
+        lo, hi = stats.min, stats.max
+        try:
+            if op == "EqualTo" and (value < lo or value > hi):
+                return False
+            if op == "LessThan" and not (lo < value):
+                return False
+            if op == "LessThanOrEqual" and not (lo <= value):
+                return False
+            if op == "GreaterThan" and not (hi > value):
+                return False
+            if op == "GreaterThanOrEqual" and not (hi >= value):
+                return False
+        except TypeError:
+            continue  # incomparable literal vs file stats: keep the group
+    return True
+
+
+def _read_chunk(pf, chunk: List[int], columns, dump_prefix: str, seq: int):
+    """Decode the clipped row groups ONCE; if debug dumping is on, persist
+    the same table as a standalone parquet file for offline repro
+    (spark.rapids.sql.parquet.debug.dumpPrefix; reference dumps the
+    reassembled host buffer the same way)."""
+    table = pf.read_row_groups(chunk, columns=columns)
+    if dump_prefix:
+        import pyarrow.parquet as pq
+        pq.write_table(table, f"{dump_prefix}-{seq}.parquet")
+    return table
+
+
 def _iter_parquet(files, max_rows: int, max_bytes: int,
-                  columns: Optional[List[str]] = None):
+                  columns: Optional[List[str]] = None,
+                  predicates=None, metrics=None, dump_prefix: str = ""):
     """Yield arrow tables bounded by reader batch limits, grouping whole row
     groups per chunk like the reference's populateCurrentBlockChunk
-    (GpuParquetScan.scala:571)."""
+    (GpuParquetScan.scala:571).  Row groups whose statistics contradict the
+    pushed predicates are skipped before any bytes are read."""
     import pyarrow.parquet as pq
+    dump_seq = 0
     for path in files:
         pf = pq.ParquetFile(path)
         n_rg = pf.metadata.num_row_groups
         if n_rg == 0:
             continue
+        file_names = set(pf.schema_arrow.names)
+        cols = [c for c in columns if c in file_names] \
+            if columns is not None else None
+        if cols is not None and not cols:
+            cols = None  # no requested column exists: schema evolution path
+        name_to_idx = {n: i for i, n in enumerate(pf.schema_arrow.names)}
         chunk: List[int] = []
         rows = bytes_ = 0
         for rg in range(n_rg):
             meta = pf.metadata.row_group(rg)
+            if metrics is not None:
+                metrics.add("numRowGroups", 1)
+            if predicates and not _rg_can_match(meta, name_to_idx,
+                                                predicates):
+                if metrics is not None:
+                    metrics.add("numRowGroupsSkipped", 1)
+                continue
             if chunk and (rows + meta.num_rows > max_rows
                           or bytes_ + meta.total_byte_size > max_bytes):
-                yield path, pf.read_row_groups(chunk, columns=columns)
+                yield path, _read_chunk(pf, chunk, cols, dump_prefix,
+                                        dump_seq)
+                dump_seq += 1
                 chunk, rows, bytes_ = [], 0, 0
             chunk.append(rg)
             rows += meta.num_rows
             bytes_ += meta.total_byte_size
         if chunk:
-            yield path, pf.read_row_groups(chunk, columns=columns)
+            yield path, _read_chunk(pf, chunk, cols, dump_prefix, dump_seq)
+            dump_seq += 1
 
 
-def _iter_orc(files, max_rows: int, max_bytes: int):
+def _iter_orc(files, max_rows: int, max_bytes: int,
+              columns: Optional[List[str]] = None):
     """Stripe-granular ORC chunks (reference: GpuOrcScan.scala:247-711)."""
     from pyarrow import orc
     for path in files:
@@ -288,10 +349,15 @@ def _iter_orc(files, max_rows: int, max_bytes: int):
         n = of.nstripes
         if n == 0:
             continue
+        file_names = set(of.schema.names)
+        cols = [c for c in columns if c in file_names] \
+            if columns is not None else None
+        if cols is not None and not cols:
+            cols = None
         chunk = []
         rows = bytes_ = 0
         for s in range(n):
-            stripe = of.read_stripe(s)
+            stripe = of.read_stripe(s, columns=cols)
             if chunk and (rows + stripe.num_rows > max_rows
                           or bytes_ + stripe.nbytes > max_bytes):
                 yield path, _concat_record_batches(chunk)
@@ -320,18 +386,26 @@ def _iter_csv(files, file_schema: Schema, options: dict, max_rows: int):
 
 
 def _host_chunks(fmt: str, files, schema: Schema, options: dict,
-                 conf) -> Iterator:
+                 conf, metrics=None) -> Iterator:
     """Bounded arrow chunks, evolved to `schema` with any Hive partition
-    columns (options['__partitions__']) attached as constants."""
+    columns (options['__partitions__']) attached as constants.
+
+    `schema` may be column-pruned by the pushdown pass (plan/pushdown.py):
+    only its names are requested from the readers, and pushed predicates
+    (options['__predicates__']) skip parquet row groups by statistics."""
     import pyarrow as pa
     max_rows = min(conf.get(C.MAX_READER_BATCH_SIZE_ROWS), 1 << 20)
     max_bytes = conf.get(C.MAX_READER_BATCH_SIZE_BYTES)
     partitions = options.get("__partitions__") or {}
     part_names = {n for vals in partitions.values() for n in vals}
+    file_cols = [f.name for f in schema if f.name not in part_names]
     if fmt == "parquet":
-        it = _iter_parquet(files, max_rows, max_bytes)
+        it = _iter_parquet(files, max_rows, max_bytes, columns=file_cols,
+                           predicates=options.get("__predicates__"),
+                           metrics=metrics,
+                           dump_prefix=conf.get(C.PARQUET_DEBUG_DUMP_PREFIX))
     elif fmt == "orc":
-        it = _iter_orc(files, max_rows, max_bytes)
+        it = _iter_orc(files, max_rows, max_bytes, columns=file_cols)
     elif fmt == "csv":
         file_schema = Schema([f for f in schema
                               if f.name not in part_names])
@@ -342,6 +416,8 @@ def _host_chunks(fmt: str, files, schema: Schema, options: dict,
         vals = partitions.get(path) or partitions.get(os.path.abspath(path))
         if vals:
             for name, value in vals.items():
+                if name not in schema.names:
+                    continue  # pruned partition column
                 f = schema.field(name)
                 table = table.append_column(
                     name, pa.array([value] * table.num_rows,
@@ -375,7 +451,7 @@ class TpuFileScanExec(TpuExec):
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         produced = False
         for table in _host_chunks(self.fmt, self.files, self._schema,
-                                  self.options, ctx.conf):
+                                  self.options, ctx.conf, self.metrics):
             with self.metrics.timer("scanTime"):
                 batch = ColumnarBatch.from_arrow(table)
             self.metrics.add("numOutputRows", table.num_rows)
@@ -408,7 +484,7 @@ class CpuFileScanExec(CpuExec):
     def execute_cpu(self, ctx: ExecContext):
         produced = False
         for table in _host_chunks(self.fmt, self.files, self._schema,
-                                  self.options, ctx.conf):
+                                  self.options, ctx.conf, self.metrics):
             produced = True
             yield table
         if not produced:

@@ -72,7 +72,9 @@ class TpuSemaphore:
             return self
 
         def __exit__(self, *a):
-            self.sem.task_done(self.task_id)
+            # balance ONLY this acquisition: task_done() would drop every
+            # depth the task holds, silently releasing an enclosing held()
+            self.sem.release_if_necessary(self.task_id)
 
     def held(self, task_id=None) -> "_Held":
         return TpuSemaphore._Held(self, task_id)

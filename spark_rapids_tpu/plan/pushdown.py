@@ -1,0 +1,257 @@
+"""Scan pushdown: column pruning + predicate row-group skipping.
+
+Reference behavior (structure, not code): GpuParquetScan clips the columns
+read to the requested schema and rebuilds the pushed-down filters against
+the file footer so whole row groups can be skipped
+(GpuParquetScan.scala:106-147); FileSourceScanExec arrives already pruned by
+Spark's optimizer.  This engine has no Catalyst in front of it, so the
+equivalent optimizer pass lives here: a functional rewrite over the logical
+plan that
+
+  * computes the set of column names each scan must actually produce and
+    narrows the scan's schema to it (the exec then passes `columns=` to the
+    reader — no bytes decoded, no H2D for pruned columns), and
+  * collects conjunctive `col <op> literal` predicates sitting directly
+    above a scan (through other filters) into the scan options, where the
+    parquet reader tests them against row-group min/max statistics.
+
+The Filter node stays in the plan — row-group skipping is advisory; exact
+filtering still happens on device.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from ..types import Schema
+from .logical import (ColumnExpr, LogicalAggregate, LogicalDistinct,
+                      LogicalExpand, LogicalFilter, LogicalGenerate,
+                      LogicalJoin, LogicalLimit, LogicalPlan, LogicalProject,
+                      LogicalRepartition, LogicalScan, LogicalSort,
+                      LogicalUnion, LogicalWindow, LogicalWrite, SortOrder)
+
+# conjuncts with these ops and a (col, literal) shape can prune row groups
+_PUSHABLE = {"EqualTo", "LessThan", "GreaterThan", "LessThanOrEqual",
+             "GreaterThanOrEqual"}
+_FLIP = {"LessThan": "GreaterThan", "GreaterThan": "LessThan",
+         "LessThanOrEqual": "GreaterThanOrEqual",
+         "GreaterThanOrEqual": "LessThanOrEqual", "EqualTo": "EqualTo"}
+
+
+def col_refs(e, out: Set[str]) -> None:
+    """Collect column names referenced by a ColumnExpr tree (descends
+    arbitrarily nested arg containers — CaseWhen holds (cond, value) pairs,
+    window specs hold order lists, etc.)."""
+    if isinstance(e, SortOrder):
+        col_refs(e.child, out)
+        return
+    if isinstance(e, (list, tuple)):
+        for x in e:
+            col_refs(x, out)
+        return
+    if not isinstance(e, ColumnExpr):
+        return
+    if e.op == "col":
+        out.add(e.args[0])
+        return
+    for a in e.args:
+        col_refs(a, out)
+
+
+def _literal_of(a):
+    """Python literal value of an argument, or (None, False) if not one."""
+    if isinstance(a, ColumnExpr):
+        if a.op == "lit":
+            return a.args[0], True
+        return None, False
+    if isinstance(a, SortOrder):
+        return None, False
+    return a, True
+
+
+def _conjuncts(e, out: List[ColumnExpr]) -> None:
+    if isinstance(e, ColumnExpr) and e.op == "And":
+        _conjuncts(e.args[0], out)
+        _conjuncts(e.args[1], out)
+    else:
+        out.append(e)
+
+
+def extract_predicates(condition) -> List[Tuple[str, str, object]]:
+    """(col_name, op, literal) conjuncts usable against footer statistics."""
+    preds: List[Tuple[str, str, object]] = []
+    parts: List[ColumnExpr] = []
+    _conjuncts(condition, parts)
+    for p in parts:
+        if not (isinstance(p, ColumnExpr) and p.op in _PUSHABLE
+                and len(p.args) == 2):
+            continue
+        a, b = p.args
+        if isinstance(a, ColumnExpr) and a.op == "col":
+            v, ok = _literal_of(b)
+            if ok and v is not None:
+                preds.append((a.args[0], p.op, v))
+        elif isinstance(b, ColumnExpr) and b.op == "col":
+            v, ok = _literal_of(a)
+            if ok and v is not None:
+                preds.append((b.args[0], _FLIP[p.op], v))
+    return preds
+
+
+def optimize_scans(plan: LogicalPlan, conf=None) -> LogicalPlan:
+    """Functional rewrite: returns a plan whose scans are column-pruned and
+    carry pushdown predicates.  Never mutates the input tree (DataFrames
+    share logical nodes)."""
+    return _Rewriter(conf).rewrite(plan, required=None, preds=[])
+
+
+def _rebuild(node: LogicalPlan, children: List[LogicalPlan]) -> LogicalPlan:
+    """Shallow-copy a node with new children (logical nodes are simple
+    attribute bags; children is always a tuple attribute)."""
+    if all(c is old for c, old in zip(children, node.children)) \
+            and len(children) == len(node.children):
+        return node
+    import copy
+    new = copy.copy(node)
+    new.children = tuple(children)
+    new.__dict__.pop("_cached_schema", None)  # schema may have narrowed
+    return new
+
+
+class _Rewriter:
+    def __init__(self, conf):
+        self.conf = conf
+
+    def _child_names(self, plan: LogicalPlan) -> Set[str]:
+        from .overrides import plan_schema
+        from ..config import TpuConf
+        conf = self.conf if self.conf is not None else TpuConf()
+        return set(plan_schema(plan, conf).names)
+
+    def rewrite(self, node: LogicalPlan, required: Optional[Set[str]],
+                preds: List[Tuple[str, str, object]]) -> LogicalPlan:
+        """`required` = column names the parent needs (None = all);
+        `preds` = filter conjuncts that hold on every row this node produces
+        (only ever non-empty immediately below Filter chains)."""
+        _rewrite = self.rewrite
+        if isinstance(node, LogicalScan):
+            return _rewrite_scan(node, required, preds)
+
+        if isinstance(node, LogicalFilter):
+            child_req = None
+            if required is not None:
+                child_req = set(required)
+                col_refs(node.condition, child_req)
+            child_preds = preds + extract_predicates(node.condition)
+            child = _rewrite(node.children[0], child_req, child_preds)
+            return _rebuild(node, [child])
+
+        if isinstance(node, LogicalProject):
+            child_req: Set[str] = set()
+            for e in node.exprs:
+                col_refs(e, child_req)
+            child = _rewrite(node.children[0], child_req, [])
+            return _rebuild(node, [child])
+
+        if isinstance(node, LogicalAggregate):
+            child_req = set()
+            for e in list(node.grouping) + list(node.aggregates):
+                col_refs(e, child_req)
+            child = _rewrite(node.children[0], child_req, [])
+            return _rebuild(node, [child])
+
+        if isinstance(node, LogicalJoin):
+            refs: Set[str] = set() if required is None else set(required)
+            if node.condition is not None:
+                col_refs(node.condition, refs)
+            if node.using:
+                refs.update(node.using)
+            children = []
+            for c in node.children:
+                if required is None:
+                    children.append(_rewrite(c, None, []))
+                else:
+                    children.append(
+                        _rewrite(c, refs & self._child_names(c), []))
+            return _rebuild(node, children)
+
+        if isinstance(node, (LogicalSort, LogicalRepartition)):
+            child_req = None
+            if required is not None:
+                child_req = set(required)
+                keys = node.orders if isinstance(node, LogicalSort) \
+                    else node.keys
+                for o in keys:
+                    col_refs(o, child_req)
+            child = _rewrite(node.children[0], child_req, [])
+            return _rebuild(node, [child])
+
+        if isinstance(node, LogicalWindow):
+            child_req = None
+            if required is not None:
+                child_req = set(required)
+                for e in (list(node.window_exprs) + list(node.partition_by)
+                          + list(node.order_by)):
+                    col_refs(e, child_req)
+            child = _rewrite(node.children[0], child_req, [])
+            return _rebuild(node, [child])
+
+        if isinstance(node, LogicalGenerate):
+            child_req = None
+            if required is not None:
+                child_req = set(required) - set(node.names)
+                col_refs(node.generator, child_req)
+            child = _rewrite(node.children[0], child_req, [])
+            return _rebuild(node, [child])
+
+        if isinstance(node, LogicalExpand):
+            child_req = set()
+            for proj in node.projections:
+                for e in proj:
+                    col_refs(e, child_req)
+            child = _rewrite(node.children[0], child_req, [])
+            return _rebuild(node, [child])
+
+        if isinstance(node, LogicalUnion):
+            # do NOT prune through a union: children concatenate
+            # positionally, and only scan-backed children can narrow — a
+            # Project/Aggregate child keeps its declared output, so passing
+            # `required` down would mis-align the branches
+            children = [_rewrite(c, None, []) for c in node.children]
+            return _rebuild(node, children)
+
+        if isinstance(node, LogicalLimit):
+            # drop predicates: skipping row groups under a limit would
+            # change WHICH rows the limit takes
+            child = _rewrite(node.children[0], required, [])
+            return _rebuild(node, [child])
+
+        if isinstance(node, (LogicalDistinct, LogicalWrite)):
+            # distinct dedups FULL rows; write persists every child column
+            children = [_rewrite(c, None, []) for c in node.children]
+            return _rebuild(node, children)
+
+        # unknown node: be conservative — need everything, push nothing
+        children = [_rewrite(c, None, []) for c in node.children]
+        return _rebuild(node, children)
+
+
+def _rewrite_scan(scan: LogicalScan, required: Optional[Set[str]],
+                  preds: List[Tuple[str, str, object]]) -> LogicalScan:
+    new_opts = dict(scan.options)
+    schema = scan.schema
+    # CSV parses positionally against the declared schema — pruning there
+    # would misalign columns; parquet/orc/memory sources prune cleanly
+    if required is not None and scan.fmt != "csv":
+        keep = [f for f in schema.fields if f.name in required]
+        if not keep:  # count(*)-style: keep one narrow column for row counts
+            keep = [min(schema.fields,
+                        key=lambda f: 99 if f.dtype.is_string else 1)]
+        if len(keep) < len(schema.fields):
+            schema = Schema(keep)
+    file_preds = [(n, op, v) for (n, op, v) in preds
+                  if n in schema.names]
+    if scan.fmt == "parquet" and file_preds:
+        new_opts["__predicates__"] = file_preds
+    if schema is scan.schema and "__predicates__" not in new_opts:
+        return scan
+    return LogicalScan(scan.source, schema, scan.fmt, new_opts)

@@ -22,7 +22,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from ..columnar import ColumnarBatch
-from ..config import (SHUFFLE_DEVICE_RESIDENT, SHUFFLE_MAX_RECV_INFLIGHT,
+from ..config import (PINNED_POOL_SIZE, SHUFFLE_DEVICE_RESIDENT,
+                      SHUFFLE_MAX_RECV_INFLIGHT, SHUFFLE_TRANSPORT_CLASS,
                       TpuConf)
 from ..mem.buffer import (SpillPriorities, StorageTier, batch_to_host,
                           host_to_batch, read_leaves)
@@ -126,9 +127,7 @@ class ShuffleEnv:
         self.catalog = ShuffleBufferCatalog()
         self.received = ShuffleReceivedBufferCatalog()
         if transport is None:
-            transport = LoopbackTransport(
-                max_inflight_bytes=int(
-                    self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)))
+            transport = self._resolve_transport()
         self.transport = transport
         self.server = ShuffleServer(self)
         transport.register_server(executor_id, self.server)
@@ -138,6 +137,22 @@ class ShuffleEnv:
         self._shuffle_counter = [0]
         self._write_seq = [0]
         self._lock = threading.Lock()
+
+    def _resolve_transport(self) -> ShuffleTransport:
+        """Instantiate the conf-named transport class by reflection
+        (spark.rapids.shuffle.transport.class; reference:
+        RapidsConf.scala:505-510 + UCXShuffleTransport loading).  The pinned
+        host pool conf sizes the transport's bounce-buffer staging area."""
+        import importlib
+        name = str(self.conf.get(SHUFFLE_TRANSPORT_CLASS))
+        mod_name, _, cls_name = name.rpartition(".")
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        kwargs = {"max_inflight_bytes":
+                  int(self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT))}
+        pinned = int(self.conf.get(PINNED_POOL_SIZE))
+        if pinned > 0:
+            kwargs["pool_size"] = pinned
+        return cls(**kwargs)
 
     def baseline_leaves(self, buffer_id: int):
         with self._lock:

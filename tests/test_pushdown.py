@@ -1,0 +1,213 @@
+"""Scan pushdown: column pruning + parquet row-group skipping.
+
+Reference: GpuParquetScan.scala:106-147 (filters rebuilt against the
+footer), FileSourceScanExec's pruned requiredSchema.  Observable contract
+here: the physical scan's schema narrows, the reader requests only those
+columns, row groups contradicting pushed predicates never decode, and
+results stay bit-identical to the unpruned CPU oracle.
+"""
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.engine import TpuSession
+from spark_rapids_tpu.plan.logical import col, functions as f
+from spark_rapids_tpu.plan.pushdown import extract_predicates
+
+from compare import assert_tpu_and_cpu_are_equal
+
+
+def _walk(node):
+    yield node
+    for c in node.children:
+        yield from _walk(c)
+
+
+def _collect_physical(session, physical):
+    """Execute a captured physical plan (so its metrics are inspectable —
+    df.collect() would re-plan into fresh exec instances)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.exec import basic as B
+    from spark_rapids_tpu.exec.base import ExecContext, TpuExec
+    root = B.DeviceToHostExec(physical) if isinstance(physical, TpuExec) \
+        else physical
+    ctx = ExecContext(session.conf, runtime=session.runtime)
+    tables = list(root.execute_cpu(ctx))
+    return pa.concat_tables(tables)
+
+
+def _scan_of(physical):
+    from spark_rapids_tpu.io.scan import CpuFileScanExec, TpuFileScanExec
+    from spark_rapids_tpu.exec.basic import (CpuScanMemoryExec,
+                                             TpuScanMemoryExec)
+    for n in _walk(physical):
+        if isinstance(n, (TpuFileScanExec, CpuFileScanExec,
+                          TpuScanMemoryExec, CpuScanMemoryExec)):
+            return n
+    raise AssertionError("no scan in plan")
+
+
+@pytest.fixture
+def pq_file(tmp_path):
+    """4-column parquet, 1000 rows in 10 row groups of 100, x strictly
+    increasing so row-group min/max are tight and disjoint."""
+    n = 1000
+    rng = np.random.RandomState(4)
+    table = pa.table({
+        "x": np.arange(n, dtype=np.int64),
+        "y": rng.uniform(size=n),
+        "z": rng.randint(0, 50, n).astype(np.int32),
+        "s": pa.array([f"row{i}" for i in range(n)]),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(table, path, row_group_size=100)
+    return path
+
+
+def test_column_pruning_narrows_scan(pq_file):
+    s = TpuSession()
+    df = s.read.parquet(pq_file).select((col("x") + col("z")).alias("v"))
+    scan = _scan_of(df.physical_plan())
+    assert scan.schema.names == ["x", "z"]
+    got = sorted(r[0] for r in df.collect())
+    table = pq.read_table(pq_file)
+    want = sorted((table.column("x").to_numpy()
+                   + table.column("z").to_numpy()).tolist())
+    assert got == want
+
+
+def test_pruning_keeps_filter_columns(pq_file):
+    s = TpuSession()
+    df = (s.read.parquet(pq_file).filter(col("y") < 0.5)
+          .select(col("x")))
+    scan = _scan_of(df.physical_plan())
+    assert scan.schema.names == ["x", "y"]
+
+
+def test_no_pruning_for_select_star(pq_file):
+    s = TpuSession()
+    df = s.read.parquet(pq_file).filter(col("x") >= 0)
+    scan = _scan_of(df.physical_plan())
+    assert scan.schema.names == ["x", "y", "z", "s"]
+
+
+def test_count_star_keeps_one_narrow_column(pq_file):
+    s = TpuSession()
+    df = s.read.parquet(pq_file).agg(f.count(col("x") * 0 + 1).alias("c"))
+    # count over a literal-ish expr still references x; use pure count
+    df2 = s.read.parquet(pq_file).group_by().count() \
+        if hasattr(s.read.parquet(pq_file), "group_by") else df
+    assert df.collect()[0][0] == 1000
+
+
+def test_row_group_skipping_by_stats(pq_file):
+    s = TpuSession()
+    df = (s.read.parquet(pq_file)
+          .filter((col("x") >= 350) & (col("x") < 420))
+          .select(col("x")))
+    physical = df.physical_plan()
+    scan = _scan_of(physical)
+    out = _collect_physical(s, physical)
+    assert sorted(out.column("x").to_pylist()) == list(range(350, 420))
+    m = scan.metrics.values
+    # 10 groups of 100; only groups [300,400) and [400,500) can match
+    assert m.get("numRowGroups") == 10
+    assert m.get("numRowGroupsSkipped") == 8
+
+
+def test_equality_predicate_skips(pq_file):
+    s = TpuSession()
+    df = s.read.parquet(pq_file).filter(col("x") == 777).select(col("x"))
+    physical = df.physical_plan()
+    scan = _scan_of(physical)
+    out = _collect_physical(s, physical)
+    assert out.column("x").to_pylist() == [777]
+    assert scan.metrics.values.get("numRowGroupsSkipped") == 9
+
+
+def test_flipped_literal_side(pq_file):
+    s = TpuSession()
+    df = s.read.parquet(pq_file).filter(950 <= col("x")).select(col("x"))
+    physical = df.physical_plan()
+    scan = _scan_of(physical)
+    out = _collect_physical(s, physical)
+    assert sorted(out.column("x").to_pylist()) == list(range(950, 1000))
+    assert scan.metrics.values.get("numRowGroupsSkipped", 0) >= 9
+
+
+def test_pushdown_oracle_parity(pq_file):
+    def q(s):
+        return (s.read.parquet(pq_file)
+                .filter((col("x") > 100) & (col("y") < 0.8))
+                .select(col("x"), (col("y") * 2).alias("y2")))
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_memory_scan_pruned_before_h2d():
+    s = TpuSession()
+    table = pa.table({"a": np.arange(100, dtype=np.int64),
+                      "b": np.arange(100, dtype=np.float64),
+                      "huge": pa.array(["x" * 50] * 100)})
+    df = s.from_arrow(table).select(col("a"))
+    scan = _scan_of(df.physical_plan())
+    assert list(scan.table.column_names) == ["a"]
+    assert sorted(r[0] for r in df.collect()) == list(range(100))
+
+
+def test_extract_predicates_shapes():
+    c = (col("a") > 5) & (col("b") == "z") & (3 < col("a"))
+    preds = extract_predicates(c)
+    assert ("a", "GreaterThan", 5) in preds
+    assert ("b", "EqualTo", "z") in preds
+    assert ("a", "GreaterThan", 3) in preds  # flipped literal side
+
+
+def test_predicates_survive_projection_rename(pq_file):
+    """A filter above a projection must not push through a rename."""
+    s = TpuSession()
+    df = (s.read.parquet(pq_file)
+          .select(col("y").alias("x"), col("x").alias("w"))
+          .filter(col("x") < 0.5))  # refers to renamed y!
+    scan = _scan_of(df.physical_plan())
+    assert "__predicates__" not in scan.options
+    got = df.collect()
+    table = pq.read_table(pq_file)
+    y = table.column("y").to_numpy()
+    assert len(got) == int((y < 0.5).sum())
+
+
+def test_union_not_pruned_asymmetrically(pq_file):
+    """Union children concatenate positionally; pruning only the scan-backed
+    branch would mis-align columns (review regression)."""
+    s = TpuSession()
+    import pyarrow as pa
+    t = pa.table({"x": np.arange(5, dtype=np.int64),
+                  "y": np.arange(5, dtype=np.float64),
+                  "z": np.zeros(5, dtype=np.int32),
+                  "s": pa.array(["a"] * 5)})
+    left = s.from_arrow(t)
+    right = s.from_arrow(t).select(col("x"), col("y"), col("z"), col("s"))
+    df = left.union(right).order_by("x").select(col("x"))
+    got = [r[0] for r in df.collect()]
+    assert got == sorted([i for i in range(5)] * 2)
+
+
+def test_nested_semaphore_hold_survives_inner_exit():
+    from spark_rapids_tpu.mem.semaphore import TpuSemaphore
+    sem = TpuSemaphore(1)
+    with sem.held(task_id=7):
+        with sem.held(task_id=7):
+            pass
+        assert sem.active_tasks() == 1  # outer hold must survive
+    assert sem.active_tasks() == 0
+
+
+def test_limit_blocks_predicate_pushdown(pq_file):
+    """Filter above limit: skipping row groups would change WHICH rows the
+    limit takes."""
+    s = TpuSession()
+    df = s.read.parquet(pq_file).limit(10).filter(col("x") >= 5)
+    scan = _scan_of(df.physical_plan())
+    assert "__predicates__" not in scan.options
+    assert sorted(r[0] for r in df.collect()) == list(range(5, 10))
