@@ -1,0 +1,114 @@
+"""Per-stage TPU profiling harness (round-3 diagnosis of the 16s/run Q6).
+
+Measures, each under its own stderr-logged timer:
+  1. H2D bandwidth: device_put of numpy arrays, various sizes/dtypes
+  2. dispatch+sync latency: tiny jitted op round trip
+  3. compile time: Q6-shaped kernel
+  4. steady-state kernel time on device-resident data
+  5. D2H scalar fetch
+
+Run: JAX_PLATFORMS=<tpu|cpu> python benchmarks/profile_device.py
+"""
+import sys
+import time
+
+import numpy as np
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[prof +{time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    jax.config.update("jax_enable_x64", True)
+    dev = jax.devices()[0]
+    log(f"platform={dev.platform} device={dev}")
+
+    # 1. H2D bandwidth
+    for mb, dtype in [(1, np.float32), (8, np.float64), (48, np.float64),
+                      (48, np.float32), (48, np.int32)]:
+        n = mb * (1 << 20) // np.dtype(dtype).itemsize
+        host = np.arange(n, dtype=dtype)
+        t = time.perf_counter()
+        d = jax.device_put(host, dev)
+        d.block_until_ready()
+        dt = time.perf_counter() - t
+        log(f"H2D {mb}MB {np.dtype(dtype).name}: {dt:.3f}s "
+            f"({mb / dt:.1f} MB/s)")
+
+    # 2. dispatch+sync latency
+    f = jax.jit(lambda x: x + 1)
+    x = jax.device_put(np.float32(1.0), dev)
+    f(x).block_until_ready()
+    ts = []
+    for _ in range(10):
+        t = time.perf_counter()
+        f(x).block_until_ready()
+        ts.append(time.perf_counter() - t)
+    log(f"dispatch+sync latency: min={min(ts)*1e3:.1f}ms "
+        f"median={sorted(ts)[5]*1e3:.1f}ms")
+
+    # 3+4. Q6-shaped kernel: filter + project + masked sum over 6M f64 rows
+    n = 6_000_000
+    cap = 1 << 23
+    rng = np.random.RandomState(42)
+    cols = {
+        "price": np.zeros(cap), "disc": np.zeros(cap),
+        "qty": np.zeros(cap, np.int64), "ship": np.zeros(cap, np.int64),
+    }
+    cols["price"][:n] = rng.uniform(900.0, 105000.0, n)
+    cols["disc"][:n] = rng.choice(np.arange(0.0, 0.11, 0.01), n)
+    cols["qty"][:n] = rng.randint(1, 51, n)
+    cols["ship"][:n] = rng.randint(8035, 10592, n)
+    sel = np.arange(cap) < n
+
+    t = time.perf_counter()
+    dcols = {k: jax.device_put(v, dev) for k, v in cols.items()}
+    dsel = jax.device_put(sel, dev)
+    for v in dcols.values():
+        v.block_until_ready()
+    log(f"H2D 6M-row 4-col table ({sum(v.nbytes for v in cols.values())/2**20:.0f}MB): "
+        f"{time.perf_counter() - t:.3f}s")
+
+    def q6(c, s):
+        keep = (s & (c["ship"] >= 8766) & (c["ship"] < 9131)
+                & (c["disc"] >= 0.05) & (c["disc"] <= 0.07) & (c["qty"] < 24))
+        return jnp.sum(jnp.where(keep, c["price"] * c["disc"], 0.0))
+
+    jq6 = jax.jit(q6)
+    t = time.perf_counter()
+    r = jq6(dcols, dsel).block_until_ready()
+    log(f"Q6 kernel compile+run: {time.perf_counter() - t:.3f}s")
+    ts = []
+    for _ in range(5):
+        t = time.perf_counter()
+        jq6(dcols, dsel).block_until_ready()
+        ts.append(time.perf_counter() - t)
+    log(f"Q6 kernel steady-state: min={min(ts)*1e3:.1f}ms -> "
+        f"{n / min(ts) / 1e6:.0f} Mrows/s")
+
+    # f32 variant (TPU-native dtype)
+    dcols32 = {k: v.astype(jnp.float32) if v.dtype == jnp.float64 else
+               v.astype(jnp.int32) for k, v in dcols.items()}
+    jq6_32 = jax.jit(q6)
+    jq6_32(dcols32, dsel).block_until_ready()
+    ts = []
+    for _ in range(5):
+        t = time.perf_counter()
+        jq6_32(dcols32, dsel).block_until_ready()
+        ts.append(time.perf_counter() - t)
+    log(f"Q6 kernel f32/i32: min={min(ts)*1e3:.1f}ms -> "
+        f"{n / min(ts) / 1e6:.0f} Mrows/s")
+
+    # 5. D2H scalar
+    t = time.perf_counter()
+    float(r)
+    log(f"D2H scalar: {(time.perf_counter() - t)*1e3:.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

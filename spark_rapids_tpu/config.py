@@ -161,6 +161,15 @@ PINNED_POOL_SIZE = _conf(
     "spark.rapids.memory.pinnedPool.size", 0,
     "Size of the pinned host staging pool used for H2D/D2H transfer.",
     to_bytes)
+MEMORY_SCAN_CACHE_ENABLED = _conf(
+    "spark.rapids.sql.tpu.memoryScanCache.enabled", True,
+    "Keep device batches decoded from immutable in-memory tables "
+    "HBM-resident across queries so repeated scans skip the host->device "
+    "transfer (TPU-native storage-layer cache; Spark analogue df.cache()).",
+    _to_bool)
+MEMORY_SCAN_CACHE_SIZE = _conf(
+    "spark.rapids.sql.tpu.memoryScanCache.maxSize", 4 << 30,
+    "LRU byte bound on HBM held by the in-memory scan cache.", to_bytes)
 
 # --- formats ----------------------------------------------------------------
 CSV_ENABLED = _conf("spark.rapids.sql.format.csv.enabled", True,

@@ -48,8 +48,22 @@ class TpuScanMemoryExec(TpuExec):
         return self._schema
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..config import (MEMORY_SCAN_CACHE_ENABLED,
+                              MEMORY_SCAN_CACHE_SIZE)
+        from ..utils.scan_cache import MEMORY_SCAN_CACHE
         rows = self.table.num_rows
         limit = min(ctx.conf.get(MAX_READER_BATCH_SIZE_ROWS), 1 << 20)
+        use_cache = ctx.conf.get(MEMORY_SCAN_CACHE_ENABLED)
+        names = tuple(self._schema.names)
+        if use_cache:
+            cached = MEMORY_SCAN_CACHE.get(self.table, names, limit)
+            if cached is not None:
+                for batch, nrows in cached:
+                    self.metrics.add("numOutputRows", nrows)
+                    self.metrics.add("numOutputBatches", 1)
+                    yield batch
+                return
+        produced = []
         off = 0
         while off < rows or (rows == 0 and off == 0):
             chunk = self.table.slice(off, limit)
@@ -57,10 +71,15 @@ class TpuScanMemoryExec(TpuExec):
                 batch = ColumnarBatch.from_arrow(chunk)
             self.metrics.add("numOutputRows", chunk.num_rows)
             self.metrics.add("numOutputBatches", 1)
+            if use_cache:
+                produced.append((batch, chunk.num_rows))
             yield batch
             off += limit
             if rows == 0:
                 break
+        if use_cache:
+            MEMORY_SCAN_CACHE.put(self.table, names, limit, produced,
+                                  ctx.conf.get(MEMORY_SCAN_CACHE_SIZE))
 
     def describe(self):
         return f"TpuScanMemoryExec[rows={self.table.num_rows}]"

@@ -225,3 +225,64 @@ class TestSemaphore:
         with s.held("a"):
             assert s.active_tasks() == 1
         assert s.active_tasks() == 0
+
+
+class TestMemoryScanCache:
+    """Device-resident in-memory scan cache (utils/scan_cache.py)."""
+
+    def _q6ish(self, session, table):
+        from spark_rapids_tpu.plan.logical import col, functions as F
+        df = session.from_arrow(table)
+        return df.filter(col("a") > 2).agg(F.sum(col("a")).alias("s"))
+
+    def test_repeat_query_hits_cache(self):
+        import pyarrow as pa
+        from spark_rapids_tpu.engine import TpuSession
+        from spark_rapids_tpu.utils.scan_cache import MEMORY_SCAN_CACHE
+        MEMORY_SCAN_CACHE.clear()
+        table = pa.table({"a": list(range(100))})
+        s = TpuSession()
+        h0, m0 = MEMORY_SCAN_CACHE.hits, MEMORY_SCAN_CACHE.misses
+        r1 = self._q6ish(s, table).collect()
+        r2 = self._q6ish(s, table).collect()
+        assert r1 == r2
+        assert MEMORY_SCAN_CACHE.misses == m0 + 1
+        assert MEMORY_SCAN_CACHE.hits >= h0 + 1
+
+    def test_identity_not_equality(self):
+        """A different (even equal-content) table must not be served."""
+        import pyarrow as pa
+        from spark_rapids_tpu.engine import TpuSession
+        from spark_rapids_tpu.utils.scan_cache import MEMORY_SCAN_CACHE
+        MEMORY_SCAN_CACHE.clear()
+        s = TpuSession()
+        t1 = pa.table({"a": [1, 2, 3]})
+        self._q6ish(s, t1).collect()
+        t2 = pa.table({"a": [10, 20, 30]})
+        rows = self._q6ish(s, t2).collect()
+        assert rows[0][0] == 60
+
+    def test_disabled_by_conf(self):
+        import pyarrow as pa
+        from spark_rapids_tpu.engine import TpuSession
+        from spark_rapids_tpu.utils.scan_cache import MEMORY_SCAN_CACHE
+        MEMORY_SCAN_CACHE.clear()
+        s = TpuSession(
+            {"spark.rapids.sql.tpu.memoryScanCache.enabled": "false"})
+        table = pa.table({"a": [1, 2, 3, 4]})
+        self._q6ish(s, table).collect()
+        self._q6ish(s, table).collect()
+        assert MEMORY_SCAN_CACHE.hits == 0 and MEMORY_SCAN_CACHE.misses == 0
+
+    def test_lru_eviction_bound(self):
+        import pyarrow as pa
+        from spark_rapids_tpu.engine import TpuSession
+        from spark_rapids_tpu.utils.scan_cache import MEMORY_SCAN_CACHE
+        MEMORY_SCAN_CACHE.clear()
+        # ~tiny cap: every new table evicts the previous one
+        s = TpuSession(
+            {"spark.rapids.sql.tpu.memoryScanCache.maxSize": "64k"})
+        tables = [pa.table({"a": list(range(256))}) for _ in range(4)]
+        for t in tables:
+            self._q6ish(s, t).collect()
+        assert MEMORY_SCAN_CACHE.device_bytes <= 64 * 1024
