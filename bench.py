@@ -152,26 +152,33 @@ class StageReader:
         """Next parsed stage line, or None on timeout/eof (child killed on
         timeout)."""
         deadline = time.time() + budget_s
-        with self._lock:
-            while not self._lines:
-                if self._eof:
-                    return None
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    log(f"{self.label}: stage budget exceeded "
-                        f"({budget_s:.0f}s) — killing child")
-                    self.proc.kill()
-                    return None
-                self._lock.wait(timeout=min(remaining, 5))
-            line = self._lines.pop(0)
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            return None
-        self.stages.append(rec)
-        log(f"{self.label}: {rec}")
-        _write_partial(self.label, rec)
-        return rec
+        while True:
+            with self._lock:
+                while not self._lines:
+                    if self._eof:
+                        return None
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        log(f"{self.label}: stage budget exceeded "
+                            f"({budget_s:.0f}s) — killing child")
+                        self.proc.kill()
+                        return None
+                    self._lock.wait(timeout=min(remaining, 5))
+                line = self._lines.pop(0)
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                rec = None
+            if not isinstance(rec, dict) or "stage" not in rec:
+                # stray stdout from a library (plugin banner, warning):
+                # skip it, don't treat the child as dead
+                log(f"{self.label}: ignoring non-stage stdout: "
+                    f"{line.strip()[:120]}")
+                continue
+            self.stages.append(rec)
+            log(f"{self.label}: {rec}")
+            _write_partial(self.label, rec)
+            return rec
 
     def close(self):
         try:
@@ -241,18 +248,20 @@ def main():
     # 2. device child under per-stage budgets
     want_tpu = os.environ.get("JAX_PLATFORMS", "") not in ("cpu", "")
     dev = drive("device", "tpu" if want_tpu else "cpu")
+    unit_note = ""
     if not dev["runs"]:
         if dev["warmup"] is not None:
-            # warmup completed but runs hung/died: report warmup-derived
-            # number rather than nothing (clearly labeled)
+            # warmup completed but runs hung/died: report warmup time
+            # (compile+H2D inclusive) with an explicit unit marker
             dev["runs"] = [dev["warmup"]]
+            unit_note = ":warmup-only"
             log("device runs missing; falling back to warmup time")
         else:
             log("device child produced nothing; reporting CPU numbers")
             dev = cpu
 
     tpu_t = min(dev["runs"])
-    platform = dev["platform"] or "unknown"
+    platform = (dev["platform"] or "unknown") + unit_note
 
     # oracle cross-check (tolerate missing values from a killed child)
     if dev.get("value") is not None and cpu.get("value") is not None:

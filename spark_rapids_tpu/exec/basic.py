@@ -38,6 +38,10 @@ class TpuScanMemoryExec(TpuExec):
 
     def __init__(self, table, schema: Schema, conf=None):
         super().__init__()
+        # cache identity must be the ORIGINAL table: select() creates a new
+        # pyarrow object every planning pass, so keying on it would miss
+        # (and leak an entry) on every column-pruned query
+        self._cache_table = table
         if list(table.column_names) != schema.names:
             table = table.select(schema.names)  # pushdown pruned the scan
         self.table = table
@@ -54,9 +58,10 @@ class TpuScanMemoryExec(TpuExec):
         rows = self.table.num_rows
         limit = min(ctx.conf.get(MAX_READER_BATCH_SIZE_ROWS), 1 << 20)
         use_cache = ctx.conf.get(MEMORY_SCAN_CACHE_ENABLED)
+        max_cache = ctx.conf.get(MEMORY_SCAN_CACHE_SIZE)
         names = tuple(self._schema.names)
         if use_cache:
-            cached = MEMORY_SCAN_CACHE.get(self.table, names, limit)
+            cached = MEMORY_SCAN_CACHE.get(self._cache_table, names, limit)
             if cached is not None:
                 for batch, nrows in cached:
                     self.metrics.add("numOutputRows", nrows)
@@ -64,6 +69,7 @@ class TpuScanMemoryExec(TpuExec):
                     yield batch
                 return
         produced = []
+        produced_bytes = 0
         off = 0
         while off < rows or (rows == 0 and off == 0):
             chunk = self.table.slice(off, limit)
@@ -73,13 +79,19 @@ class TpuScanMemoryExec(TpuExec):
             self.metrics.add("numOutputBatches", 1)
             if use_cache:
                 produced.append((batch, chunk.num_rows))
+                produced_bytes += batch.device_size_bytes()
+                if produced_bytes > max_cache:
+                    # table can never fit: stop pinning batches so the scan
+                    # streams with bounded live memory again
+                    use_cache = False
+                    produced = []
             yield batch
             off += limit
             if rows == 0:
                 break
         if use_cache:
-            MEMORY_SCAN_CACHE.put(self.table, names, limit, produced,
-                                  ctx.conf.get(MEMORY_SCAN_CACHE_SIZE))
+            MEMORY_SCAN_CACHE.put(self._cache_table, names, limit, produced,
+                                  max_cache, produced_bytes)
 
     def describe(self):
         return f"TpuScanMemoryExec[rows={self.table.num_rows}]"

@@ -53,10 +53,11 @@ class MemoryScanCache:
         return e.batches
 
     def put(self, table, names: Tuple[str, ...], limit: int,
-            batches: List, max_bytes: int) -> None:
+            batches: List, max_bytes: int, nbytes: int) -> None:
         """`batches` is a list of (ColumnarBatch, live_row_count) pairs; the
-        count is cached host-side so serving a hit costs no device sync."""
-        nbytes = sum(b.device_size_bytes() for b, _ in batches)
+        count is cached host-side so serving a hit costs no device sync.
+        `nbytes` is the caller-accumulated device size of `batches` (one
+        computation shared with the caller's streaming cutoff)."""
         if nbytes > max_bytes:
             return  # too big to ever fit; don't thrash the cache
         key = self._key(table, names, limit)

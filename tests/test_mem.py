@@ -279,10 +279,48 @@ class TestMemoryScanCache:
         from spark_rapids_tpu.engine import TpuSession
         from spark_rapids_tpu.utils.scan_cache import MEMORY_SCAN_CACHE
         MEMORY_SCAN_CACHE.clear()
-        # ~tiny cap: every new table evicts the previous one
+        # each 1024-row int64 table is ~10 KiB of device bytes; a 24 KiB cap
+        # holds at most 2 entries, so inserting 4 must evict
         s = TpuSession(
-            {"spark.rapids.sql.tpu.memoryScanCache.maxSize": "64k"})
-        tables = [pa.table({"a": list(range(256))}) for _ in range(4)]
+            {"spark.rapids.sql.tpu.memoryScanCache.maxSize": "24k"})
+        tables = [pa.table({"a": list(range(1024))}) for _ in range(4)]
         for t in tables:
             self._q6ish(s, t).collect()
-        assert MEMORY_SCAN_CACHE.device_bytes <= 64 * 1024
+        assert len(MEMORY_SCAN_CACHE._entries) < 4, "eviction never ran"
+        assert MEMORY_SCAN_CACHE.device_bytes <= 24 * 1024
+        # the most-recent table survived and is served from cache
+        h0 = MEMORY_SCAN_CACHE.hits
+        self._q6ish(s, tables[-1]).collect()
+        assert MEMORY_SCAN_CACHE.hits == h0 + 1
+
+    def test_pruned_scan_hits_cache(self):
+        """Column pruning select()s a fresh table per planning pass; the
+        cache must key on the ORIGINAL table identity or it misses forever."""
+        import pyarrow as pa
+        from spark_rapids_tpu.engine import TpuSession
+        from spark_rapids_tpu.plan.logical import col, functions as F
+        from spark_rapids_tpu.utils.scan_cache import MEMORY_SCAN_CACHE
+        MEMORY_SCAN_CACHE.clear()
+        s = TpuSession()
+        t = pa.table({"a": list(range(50)), "b": [1.0] * 50,
+                      "unused": [0] * 50})
+        for _ in range(2):
+            rows = (s.from_arrow(t).filter(col("a") >= 25)
+                    .agg(F.sum(col("b")).alias("s")).collect())
+            assert rows[0][0] == 25.0
+        assert MEMORY_SCAN_CACHE.misses == 1
+        assert MEMORY_SCAN_CACHE.hits >= 1
+
+    def test_oversized_table_not_pinned(self):
+        """A table bigger than maxSize must stream, not accumulate."""
+        import pyarrow as pa
+        from spark_rapids_tpu.engine import TpuSession
+        from spark_rapids_tpu.utils.scan_cache import MEMORY_SCAN_CACHE
+        MEMORY_SCAN_CACHE.clear()
+        s = TpuSession(
+            {"spark.rapids.sql.tpu.memoryScanCache.maxSize": "4k",
+             "spark.rapids.sql.reader.batchSizeRows": "1024"})
+        t = pa.table({"a": list(range(8192))})
+        rows = self._q6ish(s, t).collect()
+        assert rows[0][0] == sum(x for x in range(8192) if x > 2)
+        assert MEMORY_SCAN_CACHE.device_bytes == 0
