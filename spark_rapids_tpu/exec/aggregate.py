@@ -606,7 +606,28 @@ class TpuHashAggregateExec(TpuExec):
                               lambda: self._merge_kernel)
         finalize = cached_kernel(key + ("finalize",),
                                  lambda: self._finalize_kernel)
+        # Deferred merging: buffer per-batch partials and merge FAN_IN at a
+        # time, so the expensive sort-based merge kernel (and the host
+        # row-count syncs inside concat_batches) run once per FAN_IN input
+        # batches instead of once per batch.  Merge aggregates are
+        # associative, and order-sensitive ones (First/Last) carry explicit
+        # row-offset tiebreak columns in the partial state, so K-way
+        # concat-then-merge equals the pairwise fold.
+        from ..config import AGG_MERGE_FAN_IN
+        fan_in = max(2, ctx.conf.get(AGG_MERGE_FAN_IN))
+
+        def fold(state, pending):
+            parts = ([state] if state is not None else []) + pending
+            if len(parts) == 1:
+                return parts[0]
+            with self.metrics.timer("concatTime"):
+                both = concat_batches(parts)
+            with self.metrics.timer("mergeAggTime"), \
+                    named_range("agg_merge"):
+                return merge(both)
+
         state = None
+        pending: list = []
         offset = 0
         for batch in self.children[0].execute(ctx):
             with self.metrics.timer("computeAggTime"), \
@@ -615,14 +636,12 @@ class TpuHashAggregateExec(TpuExec):
                     else update(batch)
             if needs_off:
                 offset += batch.num_rows_host()
-            if state is None:
-                state = partial
-            else:
-                with self.metrics.timer("concatTime"):
-                    both = concat_batches([state, partial])
-                with self.metrics.timer("mergeAggTime"), \
-                        named_range("agg_merge"):
-                    state = merge(both)
+            pending.append(partial)
+            if len(pending) >= fan_in:
+                state = fold(state, pending)
+                pending = []
+        if pending:
+            state = fold(state, pending)
         if state is None:
             if grouped:
                 return

@@ -1,0 +1,168 @@
+"""Planner-integrated SPMD execs: aggregate / join / sort over a device mesh.
+
+These are the physical operators the `distribute` planner pass
+(plan/transitions.py) swaps in when `spark.rapids.sql.tpu.mesh.devices` > 1:
+a planned DataFrame query then executes its shuffle-shaped subtrees as ONE
+compiled SPMD program over a `jax.sharding.Mesh`, with repartitioning as XLA
+all-to-all collectives over ICI.
+
+Reference analogue: the shuffle manager being THE execution path for every
+exchange (rapids/RapidsShuffleInternalManager.scala:73-170,
+rapids/GpuShuffleExchangeExec.scala:60-155).  The TPU-native design needs no
+separate exchange operator: partial-agg -> all-to-all -> merge (etc.) fuse
+into one XLA program per subtree, so the "exchange" is a collective the
+compiler schedules, not a materialization boundary.
+
+Input staging: each exec drains its single-chip child iterator, concatenates
+to one batch whose power-of-two capacity divides the mesh size, and
+device_puts it row-sharded.  Results are yielded as globally-sharded batches;
+downstream single-chip operators (and D2H) consume the global view.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..columnar import ColumnarBatch, concat_batches
+from ..columnar.batch import bucket_rows
+from ..parallel.mesh import DATA_AXIS, make_mesh, shard_batch
+from ..parallel.distributed import (run_distributed_aggregate,
+                                    run_distributed_join,
+                                    run_distributed_sort)
+from ..utils.tracing import named_range
+from .aggregate import TpuHashAggregateExec
+from .base import ExecContext
+from .join import TpuHashJoinExec, _empty_batch
+from .sort import TpuSortExec
+
+
+def resolve_mesh(conf) -> Optional["jax.sharding.Mesh"]:
+    """Mesh from session conf, or None when disabled/unavailable.
+
+    `spark.rapids.sql.tpu.mesh.devices` = 0 disables; N > 1 requires N
+    local devices (power of two, so sharded capacities divide evenly)."""
+    from .. import config as C
+    n = conf.get(C.MESH_DEVICES)
+    if n is None or int(n) <= 1:
+        return None
+    n = int(n)
+    if n & (n - 1):
+        raise ValueError(f"{C.MESH_DEVICES.key} must be a power of two, "
+                         f"got {n}")
+    if len(jax.devices()) < n:
+        return None  # planner falls back to single-chip execution
+    return make_mesh(n)
+
+
+def _drain_to_sharded(child, ctx: ExecContext, mesh, min_cap: int):
+    """Drain a child exec into ONE row-sharded batch (or None if empty)."""
+    batches = list(child.execute(ctx))
+    batches = [b for b in batches if b is not None]
+    if not batches:
+        return None
+    n = mesh.shape[DATA_AXIS]
+    if len(batches) == 1 and batches[0].capacity % n == 0 \
+            and batches[0].capacity >= min_cap:
+        big = batches[0]
+    else:
+        total = sum(b.num_rows_host() for b in batches)
+        cap = max(bucket_rows(max(total, 1)), min_cap, n)
+        big = concat_batches(batches, capacity=cap)
+    return shard_batch(big, mesh)
+
+
+class TpuDistributedAggregateExec(TpuHashAggregateExec):
+    """SPMD hash aggregate: local partial-agg -> compact all-to-all by key
+    hash -> merge -> finalize, one compiled program (parallel/distributed.py
+    distributed_aggregate_step)."""
+
+    def __init__(self, grouping, group_names, aggregates, child, mesh,
+                 use_allgather: bool = False):
+        super().__init__(grouping, group_names, aggregates, child)
+        self.mesh = mesh
+        self.use_allgather = use_allgather
+
+    def describe(self):
+        return (f"TpuDistributedAggregateExec[n="
+                f"{self.mesh.shape[DATA_AXIS]}]")
+
+    def execute(self, ctx: ExecContext):
+        n = self.mesh.shape[DATA_AXIS]
+        batch = _drain_to_sharded(self.children[0], ctx, self.mesh, n)
+        if batch is None:
+            # delegate empty-input semantics (global 1-row / grouped none)
+            yield from super().execute(ctx)
+            return
+        with self.metrics.timer("distributedAggTime"), \
+                named_range("dist_agg"):
+            out = run_distributed_aggregate(
+                self, self.mesh, batch, use_allgather=self.use_allgather,
+                cache_key=("dist",) + self.kernel_key())
+        self.metrics.add("numOutputBatches", 1)
+        yield out
+
+
+class TpuDistributedJoinExec(TpuHashJoinExec):
+    """SPMD hash join: both sides hash-partitioned by join key over the mesh
+    in one all-to-all, local sort+searchsorted join per device."""
+
+    def __init__(self, left, right, join_type, left_keys, right_keys,
+                 condition, out_schema, using_drop, mesh,
+                 use_allgather: bool = False):
+        super().__init__(left, right, join_type, left_keys, right_keys,
+                         condition, out_schema, using_drop)
+        self.mesh = mesh
+        self.use_allgather = use_allgather
+
+    def describe(self):
+        return (f"TpuDistributedJoinExec[{self.join_type}, n="
+                f"{self.mesh.shape[DATA_AXIS]}]")
+
+    def execute(self, ctx: ExecContext):
+        n = self.mesh.shape[DATA_AXIS]
+        left = _drain_to_sharded(self.children[0], ctx, self.mesh, n)
+        right = _drain_to_sharded(self.children[1], ctx, self.mesh, n)
+        if left is None or right is None:
+            # empty side: the single-chip kernels handle null/empty
+            # semantics (left rows with no matches etc.) without a mesh
+            yield from super().execute(ctx)
+            return
+        with self.metrics.timer("distributedJoinTime"), \
+                named_range("dist_join"):
+            out = run_distributed_join(
+                self, self.mesh, left, right,
+                use_allgather=self.use_allgather,
+                cache_key=("dist",) + self.kernel_key())
+        self.metrics.add("numOutputBatches", 1)
+        yield out
+
+
+class TpuDistributedSortExec(TpuSortExec):
+    """SPMD global sort: sampled range bounds -> range-partition all-to-all
+    -> local lexsort; shard order IS global order."""
+
+    child_coalesce_goal = None  # drains + concats itself
+
+    def __init__(self, sort_exprs, ascending, nulls_first, child, mesh,
+                 use_allgather: bool = False):
+        super().__init__(sort_exprs, ascending, nulls_first, child)
+        self.mesh = mesh
+        self.use_allgather = use_allgather
+
+    def describe(self):
+        return (f"TpuDistributedSortExec[n={self.mesh.shape[DATA_AXIS]}]")
+
+    def execute(self, ctx: ExecContext):
+        n = self.mesh.shape[DATA_AXIS]
+        batch = _drain_to_sharded(self.children[0], ctx, self.mesh, n)
+        if batch is None:
+            return
+        with self.metrics.timer("distributedSortTime"), \
+                named_range("dist_sort"):
+            out = run_distributed_sort(
+                self.sort_exprs, self.ascending, self.nulls_first,
+                self.mesh, batch, use_allgather=self.use_allgather,
+                cache_key=("dist",) + self.kernel_key())
+        self.metrics.add("numOutputBatches", 1)
+        yield out

@@ -65,6 +65,23 @@ class TpuShuffleExchangeExec(TpuExec):
         raise AssertionError(self.mode)
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        produced = False
+        for _p, out in self.execute_partitions(ctx):
+            if out is None:
+                continue
+            produced = True
+            self.metrics.add("numOutputBatches", 1)
+            yield out
+        if not produced:
+            # keep the one-batch-minimum contract for downstream operators
+            from .join import _empty_batch
+            yield _empty_batch(self.schema)
+
+    def execute_partitions(self, ctx: ExecContext):
+        """Yield (partition_id, coalesced batch | None) for every partition
+        in order.  The partition-aligned form TpuShuffledHashJoinExec zips
+        to pair build/stream sides (reference: EnsureRequirements places
+        matching HashPartitionings under GpuShuffledHashJoinExec)."""
         env = get_shuffle_env(ctx.runtime, ctx.conf) if ctx.runtime else None
         if env is None:
             from ..mem.runtime import TpuRuntime
@@ -91,16 +108,34 @@ class TpuShuffleExchangeExec(TpuExec):
                     num_writes += 1
         self.metrics.add("numPartitionsWritten", num_writes)
 
+        from ..config import SHUFFLE_ASYNC_FETCH
+
+        def _coalesced(parts):
+            if not parts:
+                return None
+            return parts[0] if len(parts) == 1 else concat_batches(parts)
+
         try:
             with self.metrics.timer("shuffleReadTime"):
-                for p in range(n):
-                    parts = list(env.fetch_partition(sid, p))
-                    if not parts:
-                        continue
-                    out = parts[0] if len(parts) == 1 \
-                        else concat_batches(parts)
-                    self.metrics.add("numOutputBatches", 1)
-                    yield out
+                if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
+                    # pipelined: the producer thread fetches partition k+1
+                    # while the consumer is still on k
+                    it = env.fetch_partitions_async(sid, range(n))
+                    next_p = 0
+                    parts: list = []
+                    for rid, batch in it:
+                        while next_p < rid:  # rids arrive non-decreasing
+                            yield next_p, _coalesced(parts)
+                            parts = []
+                            next_p += 1
+                        parts.append(batch)
+                    while next_p < n:
+                        yield next_p, _coalesced(parts)
+                        parts = []
+                        next_p += 1
+                else:
+                    for p in range(n):
+                        yield p, _coalesced(list(env.fetch_partition(sid, p)))
         finally:
             env.remove_shuffle(sid)
 

@@ -237,23 +237,28 @@ class TpuHashJoinExec(TpuExec):
     # ---- driver -----------------------------------------------------------
 
     def execute(self, ctx: ExecContext):
-        from ..utils.kernel_cache import cached_kernel
-        key = self.kernel_key()
-        build_fn = cached_kernel(key + ("build",),
-                                 lambda: self._build_kernel)
-        window_fn = cached_kernel(key + ("window",),
-                                  lambda: self._window_kernel)
-
         rbatches = list(self.children[1].execute(ctx))
         if rbatches:
             rbatch = rbatches[0] if len(rbatches) == 1 \
                 else concat_batches(rbatches)
         else:
             rbatch = _empty_batch(self.children[1].schema)
+        yield from self._join_stream(rbatch, self.children[0].execute(ctx))
+
+    def _join_stream(self, rbatch: ColumnarBatch, lbatches):
+        """Build once from `rbatch`, stream left batches through the probe
+        kernels.  Shared by the whole-build path (execute) and the
+        per-partition path (TpuShuffledHashJoinExec)."""
+        from ..utils.kernel_cache import cached_kernel
+        key = self.kernel_key()
+        build_fn = cached_kernel(key + ("build",),
+                                 lambda: self._build_kernel)
+        window_fn = cached_kernel(key + ("window",),
+                                  lambda: self._window_kernel)
         with self.metrics.timer("buildTime"), named_range("join_build"):
             build, bkeys, h1s = build_fn(rbatch)
 
-        for lbatch in self.children[0].execute(ctx):
+        for lbatch in lbatches:
             with self.metrics.timer("joinTime"), named_range("join_stream"):
                 lo, hi, max_dup_t = window_fn(lbatch, h1s)
                 # power-of-two bucket: max_dup is a data-dependent integer
@@ -287,3 +292,41 @@ class TpuHashJoinExec(TpuExec):
 def _empty_batch(schema: Schema) -> ColumnarBatch:
     data = {f.name: [] for f in schema}
     return ColumnarBatch.from_pydict(data, schema)
+
+
+class TpuShuffledHashJoinExec(TpuHashJoinExec):
+    """Partitioned hash join: both children are hash exchanges on the join
+    keys with the SAME partition count, so the single-build-batch bound
+    holds PER PARTITION instead of per input (reference:
+    rapids/GpuShuffledHashJoinExec.scala:83-87 — Spark's EnsureRequirements
+    places matching HashPartitionings; here the planner inserts the
+    exchanges directly, plan/physical.py)."""
+
+    def describe(self):
+        n = self.children[1].num_partitions
+        return (f"TpuShuffledHashJoinExec[{self.join_type}, "
+                f"keys={len(self.left_keys)}, partitions={n}]")
+
+    def execute(self, ctx: ExecContext):
+        from .exchange import TpuShuffleExchangeExec
+        lex, rex = self.children
+        assert isinstance(lex, TpuShuffleExchangeExec) \
+            and isinstance(rex, TpuShuffleExchangeExec) \
+            and lex.num_partitions == rex.num_partitions, \
+            "shuffled join requires aligned hash exchanges on both sides"
+        produced = False
+        for (lp, lbatch), (rp, rbatch) in zip(
+                lex.execute_partitions(ctx), rex.execute_partitions(ctx)):
+            assert lp == rp
+            if lbatch is None:
+                # no left rows in this partition: inner/left/semi/anti all
+                # produce nothing from it
+                continue
+            if rbatch is None:
+                rbatch = _empty_batch(rex.schema)
+            produced = True
+            yield from self._join_stream(rbatch, [lbatch])
+        if not produced:
+            # downstream operators (e.g. a global aggregate) require at
+            # least one batch to carry empty-input semantics
+            yield _empty_batch(self._schema)

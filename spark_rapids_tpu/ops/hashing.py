@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import Column
+from .expressions import Expression as _Expr
 
 def _u(x):
     return x.astype(jnp.uint64)
@@ -94,8 +95,11 @@ def _hash_bytes(col: Column, seed: int):
         carry = jnp.where(m, carry * jnp.uint64(1099511628211) ^ byte, carry)
         return carry, None
 
-    init = jnp.full((cap,), np.uint64((14695981039346656037 + seed * 31)
-                                      % 2**64), dtype=jnp.uint64)
+    # derive the init from a (possibly shard_map-varying) input so the scan
+    # carry has the same varying-axes type as xs: a constant init fails
+    # vma typing when this runs inside shard_map (distributed string keys)
+    vzero = (col.lengths ^ col.lengths).astype(jnp.uint64)
+    init = vzero + jnp.uint64((14695981039346656037 + seed * 31) % 2**64)
     h, _ = jax.lax.scan(step, init, (b.T, pos_mask.T))
     return mix64(h ^ _u(col.lengths.astype(jnp.int64)))
 
@@ -179,18 +183,25 @@ def spark_hash_column(col: Column, seed):
     elif dt.name == "boolean":
         h = murmur3_int(col.data.astype(jnp.int32), seed)
     elif dt.name == "float":
-        f = col.data.astype(jnp.float32)
-        f = jnp.where(jnp.isnan(f), jnp.float32(np.nan), f)
-        f = jnp.where(f == 0.0, jnp.float32(0.0), f)  # fold-proof -0.0 fix
-        bits = jax_bitcast_i32(f)
+        # normalize -0.0 and NaN in the INTEGER domain: float compares
+        # flush subnormals to zero on XLA (FTZ), which would alias 5e-45
+        # with 0.0 while the Spark oracle hashes the true bits
+        bits = jax_bitcast_i32(col.data.astype(jnp.float32))
+        bits = jnp.where(bits == jnp.int32(-2**31), jnp.int32(0), bits)
+        exp = bits & jnp.int32(0x7F800000)
+        mant = bits & jnp.int32(0x007FFFFF)
+        is_nan = (exp == jnp.int32(0x7F800000)) & (mant != 0)
+        bits = jnp.where(is_nan, jnp.int32(0x7FC00000), bits)
         h = murmur3_int(bits, seed)
     elif dt.name == "double":
-        d = col.data.astype(jnp.float64)
-        d = jnp.where(jnp.isnan(d), jnp.float64(np.nan), d)
-        d = jnp.where(d == 0.0, jnp.float64(0.0), d)  # fold-proof -0.0 fix
         # exact Spark bit parity on CPU; injective pair encoding on TPU
         # (documented incompat: emulated f64 has no true IEEE bits)
-        bits = f64_bits(d)
+        bits = f64_bits(col.data.astype(jnp.float64))
+        bits = jnp.where(bits == jnp.int64(-2**63), jnp.int64(0), bits)
+        exp = bits & jnp.int64(0x7FF0000000000000)
+        mant = bits & jnp.int64(0x000FFFFFFFFFFFFF)
+        is_nan = (exp == jnp.int64(0x7FF0000000000000)) & (mant != 0)
+        bits = jnp.where(is_nan, jnp.int64(0x7FF8000000000000), bits)
         h = murmur3_long(bits, seed)
     else:
         raise NotImplementedError(f"spark hash of {dt.name}")
@@ -263,3 +274,30 @@ def spark_hash_columns(cols, seed: int = 42):
     for c in cols:
         h = spark_hash_column(c, seed if h is None else h)
     return h
+
+
+class Murmur3Hash(_Expr):
+    """Spark `hash(...)` expression: murmur3_32 folded across the argument
+    columns with seed 42, nulls passing the running seed through unchanged
+    (reference: Murmur3Hash in HashExpression; GpuMurmur3Hash delegates to
+    the same cudf kernel the partitioner uses)."""
+
+    def __init__(self, *children, seed: int = 42):
+        self.children = tuple(children)
+        self.seed = int(seed)
+
+    @property
+    def dtype(self):
+        from ..types import IntegerType
+        return IntegerType
+
+    def eval(self, batch):
+        from ..types import IntegerType
+        h = self.seed
+        for ch in self.children:
+            h = spark_hash_column(ch.eval(batch), h)
+        cap = batch.capacity
+        if isinstance(h, int):  # no children: constant seed
+            h = jnp.full(cap, h, dtype=jnp.int32)
+        valid = jnp.ones(cap, dtype=jnp.bool_)
+        return Column(h.astype(jnp.int32), valid, IntegerType)

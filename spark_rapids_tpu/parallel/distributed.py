@@ -191,9 +191,20 @@ def distributed_aggregate_step(agg, mesh: Mesh, axis: str = DATA_AXIS,
                      out_specs=(P(axis), P()))
 
 
+def _jit_step(builder, cache_key):
+    """jit a distributed step, optionally through the process-wide kernel
+    cache (planner-integrated execs pass a structural key so repeated
+    queries reuse the compiled SPMD program instead of retracing)."""
+    if cache_key is None:
+        return jax.jit(builder())
+    from ..utils.kernel_cache import cached_kernel
+    return cached_kernel(cache_key, builder)
+
+
 def run_distributed_aggregate(agg, mesh: Mesh, batch: ColumnarBatch,
                               pre=None, axis: str = DATA_AXIS,
-                              use_allgather: bool = False) -> ColumnarBatch:
+                              use_allgather: bool = False,
+                              cache_key=None) -> ColumnarBatch:
     """Host driver: run the SPMD aggregate with overflow-retry.
 
     Doubles the exchange quota (recompiling) until the exchange is lossless;
@@ -203,9 +214,12 @@ def run_distributed_aggregate(agg, mesh: Mesh, batch: ColumnarBatch,
     local_cap = batch.capacity // n
     quota = None if use_allgather else default_quota(local_cap, n)
     while True:
-        step = jax.jit(distributed_aggregate_step(
-            agg, mesh, axis=axis, pre=pre, quota=quota,
-            use_allgather=use_allgather))
+        ck = None if cache_key is None else \
+            cache_key + (n, local_cap, quota, use_allgather)
+        step = _jit_step(
+            lambda: distributed_aggregate_step(
+                agg, mesh, axis=axis, pre=pre, quota=quota,
+                use_allgather=use_allgather), ck)
         with mesh:
             out, overflow = step(batch)
         if use_allgather or int(overflow) == 0:
@@ -278,7 +292,8 @@ def distributed_join_step(join, mesh: Mesh, max_dup: int, out_cap: int,
 def run_distributed_join(join, mesh: Mesh, left: ColumnarBatch,
                          right: ColumnarBatch, axis: str = DATA_AXIS,
                          max_dup: int = 8, out_cap=None,
-                         use_allgather: bool = False) -> ColumnarBatch:
+                         use_allgather: bool = False,
+                         cache_key=None) -> ColumnarBatch:
     """Host driver for the SPMD join with overflow-retry on all three knobs."""
     n = mesh.shape[axis]
     lcap, rcap = left.capacity // n, right.capacity // n
@@ -288,9 +303,13 @@ def run_distributed_join(join, mesh: Mesh, left: ColumnarBatch,
     if out_cap is None:
         out_cap = max(n * quota_l, 1024)
     while True:
-        step = jax.jit(distributed_join_step(
-            join, mesh, max_dup, out_cap, quota_l, quota_r, axis=axis,
-            use_allgather=use_allgather))
+        ck = None if cache_key is None else \
+            cache_key + (n, lcap, rcap, max_dup, out_cap, quota_l, quota_r,
+                         use_allgather)
+        step = _jit_step(
+            lambda: distributed_join_step(
+                join, mesh, max_dup, out_cap, quota_l, quota_r, axis=axis,
+                use_allgather=use_allgather), ck)
         with mesh:
             out, l_ovf, r_ovf, dup_ovf, cap_ovf = step(left, right)
         retry = False
@@ -403,16 +422,20 @@ def distributed_sort_step(sort_exprs, ascending, nulls_first, mesh: Mesh,
 
 def run_distributed_sort(sort_exprs, ascending, nulls_first, mesh: Mesh,
                          batch: ColumnarBatch, axis: str = DATA_AXIS,
-                         use_allgather: bool = False) -> ColumnarBatch:
+                         use_allgather: bool = False,
+                         cache_key=None) -> ColumnarBatch:
     """Host driver for the SPMD sort with quota overflow-retry."""
     n = mesh.shape[axis]
     local_cap = batch.capacity // n
     # range partitions are less uniform than hash: start with a wider quota
     quota = default_quota(local_cap, n, factor=4)
     while True:
-        step = jax.jit(distributed_sort_step(
-            sort_exprs, ascending, nulls_first, mesh, quota, axis=axis,
-            use_allgather=use_allgather))
+        ck = None if cache_key is None else \
+            cache_key + (n, local_cap, quota, use_allgather)
+        step = _jit_step(
+            lambda: distributed_sort_step(
+                sort_exprs, ascending, nulls_first, mesh, quota, axis=axis,
+                use_allgather=use_allgather), ck)
         with mesh:
             out, overflow = step(batch)
         if use_allgather or int(overflow) == 0:

@@ -51,6 +51,21 @@ def convert(meta: PlanMeta) -> ExecNode:
                     children[0], TpuBroadcastExchangeExec(children[1]),
                     plan.join_type, r["left_keys"], r["right_keys"],
                     r["condition"], out_schema, using_drop)
+            if _should_partition_join(plan, meta.conf):
+                # EnsureRequirements analogue: hash-partition BOTH sides on
+                # the join keys so the single-build-batch requirement holds
+                # per partition (reference GpuShuffledHashJoinExec.scala:83-87)
+                from .. import config as C
+                from ..exec.exchange import TpuShuffleExchangeExec
+                from ..exec.join import TpuShuffledHashJoinExec
+                n = meta.conf.get(C.SHUFFLE_PARTITIONS)
+                lex = TpuShuffleExchangeExec("hash", r["left_keys"], n,
+                                             children[0])
+                rex = TpuShuffleExchangeExec("hash", r["right_keys"], n,
+                                             children[1])
+                return TpuShuffledHashJoinExec(
+                    lex, rex, plan.join_type, r["left_keys"],
+                    r["right_keys"], r["condition"], out_schema, using_drop)
             return TpuHashJoinExec(children[0], children[1], plan.join_type,
                                    r["left_keys"], r["right_keys"],
                                    r["condition"], out_schema, using_drop)
@@ -75,6 +90,11 @@ def convert(meta: PlanMeta) -> ExecNode:
         cls = B.TpuUnionExec if all_tpu else B.CpuUnionExec
         return cls(children)
     if isinstance(plan, L.LogicalDistinct):
+        if on_tpu:
+            from ..exec.aggregate import TpuHashAggregateExec
+            child_schema = plan_schema(plan.children[0], meta.conf)
+            return TpuHashAggregateExec(r["grouping"], child_schema.names,
+                                        [], children[0])
         return CR.CpuDistinctExec(children[0])
     if isinstance(plan, L.LogicalExpand):
         cls = B.TpuExpandExec if on_tpu else B.CpuExpandExec
@@ -122,6 +142,17 @@ def _estimate_plan_bytes(plan: L.LogicalPlan):
                          L.LogicalLimit, L.LogicalRepartition)):
         return _estimate_plan_bytes(plan.children[0])
     return None
+
+
+def _should_partition_join(plan: "L.LogicalJoin", conf) -> bool:
+    """Partition a non-broadcast join when the build side is too big for
+    (or of unknown size relative to) one bounded build batch."""
+    from .. import config as C
+    if not conf.get(C.PARTITIONED_JOIN_ENABLED):
+        return False
+    est = _estimate_plan_bytes(plan.children[1])
+    threshold = conf.get(C.PARTITIONED_JOIN_THRESHOLD)
+    return est is None or est > int(threshold)
 
 
 def _should_broadcast_build(plan: "L.LogicalJoin", conf) -> bool:

@@ -58,8 +58,13 @@ def tag_node(meta: PlanMeta):
     elif isinstance(plan, L.LogicalUnion):
         pass
     elif isinstance(plan, L.LogicalDistinct):
-        meta.will_not_work("distinct is executed as CPU fallback until the "
-                           "TPU dedup kernel lands")
+        # device distinct = hash aggregate over all output columns with no
+        # aggregate expressions (Spark plans Distinct the same way; the
+        # reference then accelerates that HashAggregateExec)
+        schema = meta.input_schema()
+        grouping = [resolve(L.col(f.name), schema) for f in schema]
+        meta.resolved["grouping"] = grouping
+        meta.expr_metas = [ExprMeta(e, conf) for e in grouping]
     elif isinstance(plan, L.LogicalExpand):
         schema = meta.input_schema()
         projections = [[resolve(ce, schema) for ce in proj]

@@ -106,7 +106,66 @@ def assert_on_tpu(node: ExecNode, conf: TpuConf):
     walk(node)
 
 
+def distribute(node: ExecNode, conf: TpuConf) -> ExecNode:
+    """Swap shuffle-shaped subtrees for SPMD mesh operators when
+    spark.rapids.sql.tpu.mesh.devices > 1 (the planner integration of
+    parallel/distributed.py; reference analogue: the shuffle manager being
+    the execution path of every exchange,
+    rapids/GpuShuffleExchangeExec.scala:60-155)."""
+    from ..exec.distributed import (TpuDistributedAggregateExec,
+                                    TpuDistributedJoinExec,
+                                    TpuDistributedSortExec, resolve_mesh)
+    mesh = resolve_mesh(conf)
+    if mesh is None:
+        return node
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..exec.broadcast import TpuBroadcastHashJoinExec
+    from ..exec.join import TpuHashJoinExec, TpuShuffledHashJoinExec
+    from ..exec.sort import TpuSortExec
+    allgather = conf.get(C.MESH_USE_ALLGATHER)
+
+    def walk(n: ExecNode) -> ExecNode:
+        if isinstance(n, TpuShuffledHashJoinExec):
+            # the mesh all-to-all IS the exchange: unwrap the planner-
+            # inserted single-chip exchanges and join their inputs SPMD
+            left = n.children[0].children[0]
+            right = n.children[1].children[0]
+            out = TpuDistributedJoinExec(
+                walk(left), walk(right), n.join_type, n.left_keys,
+                n.right_keys, n.condition, n.schema, n.using_drop, mesh,
+                allgather)
+            return out
+        n.children = [walk(c) for c in n.children]
+        if isinstance(n, TpuDistributedAggregateExec) \
+                or isinstance(n, TpuDistributedSortExec) \
+                or isinstance(n, TpuDistributedJoinExec) \
+                or isinstance(n, TpuBroadcastHashJoinExec):
+            return n
+        if type(n) is TpuHashAggregateExec and n.grouping \
+                and not n._needs_offset():
+            # global (ungrouped) aggregates stay single-chip (their state
+            # is one row, an all-to-all buys nothing); offset-dependent
+            # aggregates (First/Last) keep the single-chip path so the
+            # arrival-order tiebreak stays deterministic
+            return TpuDistributedAggregateExec(
+                n.grouping, n.group_names, n.aggregates, n.children[0],
+                mesh, allgather)
+        if type(n) is TpuHashJoinExec:
+            return TpuDistributedJoinExec(
+                n.children[0], n.children[1], n.join_type, n.left_keys,
+                n.right_keys, n.condition, n.schema, n.using_drop, mesh,
+                allgather)
+        if type(n) is TpuSortExec:
+            return TpuDistributedSortExec(
+                n.sort_exprs, n.ascending, n.nulls_first, n.children[0],
+                mesh, allgather)
+        return n
+
+    return walk(node)
+
+
 def finalize(node: ExecNode, conf: TpuConf) -> ExecNode:
+    node = distribute(node, conf)
     node = insert_transitions(node)
     node = optimize_transitions(node)
     node = insert_coalesce(node, conf)

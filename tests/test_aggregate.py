@@ -193,3 +193,23 @@ def test_global_first_last_strings():
                       f.last(col("s")).alias("ls"))
     _assert_on_tpu(q)
     assert_tpu_and_cpu_are_equal(q)
+
+
+def test_agg_deferred_merge_fan_in_variants():
+    """K-way deferred merge must equal the pairwise fold for associative
+    and order-sensitive (First/Last) aggregates alike, at fan-ins that
+    divide, straddle, and exceed the batch count."""
+    for fan_in in ("2", "3", "8", "64"):
+        conf = {"spark.rapids.sql.reader.batchSizeRows": "64",
+                "spark.rapids.sql.tpu.agg.mergeFanIn": fan_in}
+
+        def q(s):
+            df = gen_df(s, seed=91, n=700, k=T.IntegerType, v=T.LongType)
+            return df.group_by("k").agg(
+                f.sum(col("v")).alias("sv"),
+                f.min(col("v")).alias("mn"),
+                f.max(col("v")).alias("mx"),
+                f.count(lit(1)).alias("c"),
+                f.first(col("v")).alias("fst"),
+                f.last(col("v")).alias("lst"))
+        assert_tpu_and_cpu_are_equal(q, conf=conf)
