@@ -170,6 +170,41 @@ MEMORY_SCAN_CACHE_ENABLED = _conf(
 MEMORY_SCAN_CACHE_SIZE = _conf(
     "spark.rapids.sql.tpu.memoryScanCache.maxSize", 4 << 30,
     "LRU byte bound on HBM held by the in-memory scan cache.", to_bytes)
+AGG_MERGE_FAN_IN = _conf(
+    "spark.rapids.sql.tpu.agg.mergeFanIn", 8,
+    "Number of per-batch partial aggregate states buffered before one "
+    "K-way concat+merge; larger values amortize merge-kernel dispatches "
+    "and host syncs across more input batches.", int)
+
+# --- multi-chip / shuffle planning ------------------------------------------
+MESH_DEVICES = _conf(
+    "spark.rapids.sql.tpu.mesh.devices", 0,
+    "Devices in the SPMD execution mesh.  >1 routes aggregate/join/sort "
+    "subtrees through the distributed all-to-all operators "
+    "(exec/distributed.py); 0/1 keeps single-chip execution.  Must be a "
+    "power of two and <= the local device count (falls back to single-chip "
+    "when fewer devices exist).", int)
+MESH_USE_ALLGATHER = _conf(
+    "spark.rapids.sql.tpu.mesh.useAllGather", False,
+    "Use the sel-mask all-gather exchange instead of the compact quota "
+    "all-to-all in distributed operators (zero overflow risk, O(n) cost; "
+    "debugging/safety knob).", _to_bool)
+SHUFFLE_PARTITIONS = _conf(
+    "spark.rapids.sql.tpu.shuffle.partitions", 8,
+    "Partition count for planner-inserted shuffle exchanges around "
+    "shuffled hash joins (spark.sql.shuffle.partitions analogue; the "
+    "single-build-batch bound then holds per partition, not per input).",
+    int)
+PARTITIONED_JOIN_ENABLED = _conf(
+    "spark.rapids.sql.tpu.join.partitioned.enabled", True,
+    "Insert hash-partition exchanges around non-broadcast equi-joins so "
+    "the build side is bounded per partition (EnsureRequirements "
+    "analogue; reference GpuShuffledHashJoinExec).", _to_bool)
+PARTITIONED_JOIN_THRESHOLD = _conf(
+    "spark.rapids.sql.tpu.join.partitioned.threshold", 64 << 20,
+    "Estimated build-side bytes above which a non-broadcast join is "
+    "planned with partition exchanges; below it the whole build side is "
+    "one batch.  Unknown sizes partition.", to_bytes)
 
 # --- formats ----------------------------------------------------------------
 CSV_ENABLED = _conf("spark.rapids.sql.format.csv.enabled", True,
@@ -202,17 +237,35 @@ SHUFFLE_TRANSPORT_CLASS = _conf(
 SHUFFLE_MAX_RECV_INFLIGHT = _conf(
     "spark.rapids.shuffle.maxReceiveInflightBytes", 1 << 30,
     "Cap on bytes of shuffle data in flight to a receiving task.", to_bytes)
+SHUFFLE_ASYNC_FETCH = _conf(
+    "spark.rapids.shuffle.asyncFetch.enabled", True,
+    "Pipeline the shuffle read: a producer thread fetches partition k+1 "
+    "while partition k is being consumed, bounded by "
+    "maxReceiveInflightBytes of un-consumed batches.", _to_bool)
 SHUFFLE_DEVICE_RESIDENT = _conf(
     "spark.rapids.shuffle.deviceResident.enabled", True,
     "Keep shuffle partitions resident in HBM (spillable) instead of "
     "serializing to host between stages.", _to_bool)
 
 # --- joins ------------------------------------------------------------------
+def _to_bytes_or_disabled(v) -> int:
+    """Byte size, or any negative value meaning 'disabled' (Spark allows
+    autoBroadcastJoinThreshold=-1; other byte confs stay strictly
+    non-negative via to_bytes)."""
+    try:
+        n = int(str(v).strip())
+        if n < 0:
+            return n
+    except ValueError:
+        pass
+    return to_bytes(v)
+
+
 AUTO_BROADCAST_JOIN_THRESHOLD = _conf(
     "spark.sql.autoBroadcastJoinThreshold", 10 << 20,
     "Maximum estimated size in bytes of a join build side that will be "
     "broadcast to every consumer instead of shuffled (Spark's conf key; "
-    "-1 disables broadcast joins).", to_bytes)
+    "-1 disables broadcast joins).", _to_bytes_or_disabled)
 
 # --- export -----------------------------------------------------------------
 EXPORT_COLUMNAR_RDD = _conf(

@@ -244,15 +244,102 @@ def cpu_eval(expr: E.Expression, cols, n: int) -> CpuCol:
         return np.arctan2(lv.astype(np.float64), rv.astype(np.float64)), \
             lm & rm
 
+    if t in ("Round", "BRound"):
+        v, m = rec(expr.child)
+        sv, sm = rec(expr.scale)
+        dt = expr.child.dtype
+        if dt.is_integral:
+            # python-int arithmetic per row: immune to 10**(-s) overflowing
+            # the column dtype (Spark rounds away all digits -> 0)
+            out = np.zeros(n, dtype=v.dtype)
+            for i in range(n):
+                s = int(sv[i])
+                x = int(v[i])
+                if s >= 0:
+                    out[i] = x
+                    continue
+                p = 10 ** (-s)
+                q, rem = divmod(abs(x), p)
+                if t == "BRound":
+                    up = rem * 2 > p or (rem * 2 == p and q % 2 != 0)
+                else:
+                    up = rem * 2 >= p
+                r = (q + (1 if up else 0)) * p * (1 if x >= 0 else -1)
+                info = np.iinfo(v.dtype)
+                span = int(info.max) - int(info.min) + 1
+                # Java intValue()/longValue() wrap on overflow, and so does
+                # the device's fixed-width arithmetic
+                out[i] = (r - info.min) % span + info.min
+            return out, m & sm
+        x = v.astype(np.float64)
+        p = np.power(10.0, sv.astype(np.float64))
+        scaled = x * p
+        with np.errstate(all="ignore"):
+            if t == "BRound":
+                r = np.rint(scaled)
+            else:
+                r = np.trunc(scaled + np.where(scaled >= 0, 0.5, -0.5))
+            out = np.where(np.isfinite(x), r / p, x)
+        return out.astype(dt.np_dtype), m & sm
+    if t == "Cot":
+        v, m = rec(expr.child)
+        with np.errstate(all="ignore"):
+            return 1.0 / np.tan(v.astype(np.float64)), m
+    if t == "Hypot":
+        lv, lm = rec(expr.left)
+        rv, rm = rec(expr.right)
+        return np.hypot(lv.astype(np.float64), rv.astype(np.float64)), \
+            lm & rm
+    if t == "Logarithm":
+        bv, bm = rec(expr.left)
+        xv, xm = rec(expr.right)
+        b = bv.astype(np.float64)
+        x = xv.astype(np.float64)
+        ok = (x > 0) & (b > 0)
+        with np.errstate(all="ignore"):
+            out = np.log(np.where(x > 0, x, 1.0)) \
+                / np.log(np.where(b > 0, b, 2.0))
+        return out, bm & xm & ok
+    if t in ("Least", "Greatest"):
+        dt = expr.dtype
+        parts = [rec(c) for c in expr.children]
+        acc_v = parts[0][0].astype(dt.np_dtype)
+        acc_m = parts[0][1].copy()
+        for pv, pm in parts[1:]:
+            v = pv.astype(dt.np_dtype)
+            if dt.is_floating:
+                vk = np.where(np.isnan(v), np.inf, v)
+                ak = np.where(np.isnan(acc_v), np.inf, acc_v)
+                vn, an = np.isnan(v), np.isnan(acc_v)
+                if t == "Least":
+                    better = (vk < ak) | (~vn & an)
+                else:
+                    better = (vk > ak) | (vn & ~an)
+            else:
+                better = (v < acc_v) if t == "Least" else (v > acc_v)
+            take = pm & (~acc_m | better)
+            acc_v = np.where(take, v, acc_v)
+            acc_m = acc_m | pm
+        return acc_v, acc_m
+    if t == "Murmur3Hash":
+        h = np.full(n, expr.seed, dtype=np.int32)
+        for ch in expr.children:
+            v, m = rec(ch)
+            h = _np_spark_hash(v, m, ch.dtype, h)
+        return h, np.ones(n, bool)
+
     # ---- strings ------------------------------------------------------
     if isinstance(expr, (S._StringUnary, S.Substring, S.Concat,
                          S.StartsWith, S.EndsWith, S.Contains, S.Like,
-                         S.StringLocate, S.StringReplace)):
+                         S.StringLocate, S.StringReplace, S._PadBase,
+                         S.StringRepeat, S.SubstringIndex,
+                         S.RegExpReplace)):
         return _cpu_string(expr, rec, n)
 
     # ---- datetime -----------------------------------------------------
     if isinstance(expr, (D._DatePart, D._DateArith, D.UnixTimestamp,
-                         D.FromUnixTime, D.TimeAdd)):
+                         D.FromUnixTime, D.TimeAdd, D.AddMonths,
+                         D.MonthsBetween, D.TruncDate, D.NextDay)):
         return _cpu_datetime(expr, rec, n)
 
     if t == "SparkPartitionID":
@@ -609,6 +696,111 @@ def _cpu_string(expr, rec, n: int) -> CpuCol:
         out = np.array([x.replace(search, repl) if x is not None else None
                         for x in v], dtype=object)
         return out, m
+    if t == "InitCap":
+        v, m = rec(expr.child)
+
+        def icap(s):
+            out = []
+            prev_space = True
+            for ch in s:
+                out.append(ch.upper() if prev_space else ch.lower())
+                prev_space = ch == " "
+            return "".join(out)
+        out = np.array([icap(x) if x is not None else None for x in v],
+                       dtype=object)
+        return out, m
+    if t == "Reverse":
+        v, m = rec(expr.child)
+        out = np.array([x[::-1] if x is not None else None for x in v],
+                       dtype=object)
+        return out, m
+    if t == "Ascii":
+        v, m = rec(expr.child)
+        out = np.array([(ord(x[0]) if x else 0) if x is not None else 0
+                        for x in v], dtype=np.int32)
+        return out, m
+    if t in ("StringLPad", "StringRPad"):
+        # args evaluated per row: the CPU executor is the fallback for the
+        # non-literal shapes the device tags away, so it cannot require
+        # literals itself
+        v, m = rec(expr.child)
+        wv, wm = rec(expr.length)
+        pv, pm = rec(expr.pad)
+
+        def dopad(s, want, pad):
+            want = max(int(want), 0)
+            if len(s) >= want:
+                return s[:want]
+            if not pad:
+                return s
+            fill = (pad * (want // len(pad) + 1))[:want - len(s)]
+            return fill + s if t == "StringLPad" else s + fill
+        out = np.array(
+            [dopad(x, w, p) if x is not None and p is not None else None
+             for x, w, p in zip(v, wv, pv)], dtype=object)
+        return out, m & wm & pm
+    if t == "StringRepeat":
+        v, m = rec(expr.child)
+        kv, km = rec(expr.times)
+        out = np.array(
+            [x * max(int(k), 0) if x is not None else None
+             for x, k in zip(v, kv)], dtype=object)
+        return out, m & km
+    if t == "SubstringIndex":
+        v, m = rec(expr.child)
+        dv, dm = rec(expr.delim)
+        cv, cm = rec(expr.count)
+
+        def ssi(s, delim, count):
+            if count == 0 or not delim:
+                return ""
+            if count > 0:
+                # count'th non-overlapping occurrence from the left
+                idx, seen = 0, 0
+                while seen < count:
+                    found = s.find(delim, idx)
+                    if found < 0:
+                        return s
+                    seen += 1
+                    if seen == count:
+                        return s[:found]
+                    idx = found + len(delim)
+                return s
+            # count < 0: |count|'th occurrence from the end of the
+            # left-to-right non-overlapping scan (device parity)
+            starts = []
+            idx = 0
+            while True:
+                found = s.find(delim, idx)
+                if found < 0:
+                    break
+                starts.append(found)
+                idx = found + len(delim)
+            if len(starts) < -count:
+                return s
+            return s[starts[len(starts) + count] + len(delim):]
+        out = np.array(
+            [ssi(x, d, int(c))
+             if x is not None and d is not None else None
+             for x, d, c in zip(v, dv, cv)], dtype=object)
+        return out, m & dm & cm
+    if t == "RegExpReplace":
+        import re
+        v, m = rec(expr.child)
+        pv, pm = rec(expr.pattern)
+        rv, rm = rec(expr.replacement)
+        cache = {}
+
+        def sub(s, pat, repl):
+            rx = cache.get(pat)
+            if rx is None:
+                rx = cache[pat] = re.compile(pat)
+            return rx.sub(repl, s)
+        out = np.array(
+            [sub(x, p, r)
+             if x is not None and p is not None and r is not None else None
+             for x, p, r in zip(v, pv, rv)], dtype=object)
+        return out, m & pm & rm
     raise NotImplementedError(f"cpu string {t}")
 
 
@@ -707,4 +899,184 @@ def _cpu_datetime(expr, rec, n: int) -> CpuCol:
         lv, lm = rec(expr.child)
         rv, rm = rec(expr.interval)
         return lv + rv.astype(np.int64), lm & rm
+    if t == "AddMonths":
+        lv, lm = rec(expr.left)
+        rv, rm = rec(expr.right)
+        out = np.zeros(n, dtype=np.int32)
+        epoch = datetime.date(1970, 1, 1)
+        for i in range(n):
+            d = epoch + datetime.timedelta(days=int(lv[i]))
+            total = d.year * 12 + (d.month - 1) + int(rv[i])
+            y, mo = total // 12, total % 12 + 1
+            last = _last_dom(y, mo)
+            out[i] = (datetime.date(y, mo, min(d.day, last)) - epoch).days
+        return out, lm & rm
+    if t == "MonthsBetween":
+        lv, lm = rec(expr.left)
+        rv, rm = rec(expr.right)
+        d1 = lv.astype(np.int64) if expr.left.dtype is DateType \
+            else lv // 86_400_000_000
+        d2 = rv.astype(np.int64) if expr.right.dtype is DateType \
+            else rv // 86_400_000_000
+        out = np.zeros(n, dtype=np.float64)
+        epoch = datetime.date(1970, 1, 1)
+        for i in range(n):
+            a = epoch + datetime.timedelta(days=int(d1[i]))
+            b = epoch + datetime.timedelta(days=int(d2[i]))
+            months = (a.year - b.year) * 12 + (a.month - b.month)
+            la, lb = _last_dom(a.year, a.month), _last_dom(b.year, b.month)
+            if a.day == b.day or (a.day == la and b.day == lb):
+                out[i] = float(months)
+            else:
+                out[i] = months + (a.day - b.day) / 31.0
+        from .expressions import Literal as _L
+        if isinstance(expr.round_off, _L) and bool(expr.round_off.value):
+            out = np.round(out * 1e8) / 1e8
+        return out, lm & rm
+    if t == "TruncDate":
+        lv, lm = rec(expr.child)
+        fv, fm = rec(expr.fmt)
+
+        def _lvl(fmt):
+            if fmt is None:
+                return None
+            fmt = fmt.lower()
+            if fmt in ("year", "yyyy", "yy"):
+                return "year"
+            if fmt == "quarter":
+                return "quarter"
+            if fmt in ("month", "mon", "mm"):
+                return "month"
+            return "week" if fmt == "week" else None
+        out = np.zeros(n, dtype=np.int32)
+        valid = lm & fm
+        epoch = datetime.date(1970, 1, 1)
+        for i in range(n):
+            level = _lvl(fv[i])
+            if level is None:
+                valid[i] = False
+                continue
+            d = epoch + datetime.timedelta(days=int(lv[i]))
+            if level == "year":
+                d = d.replace(month=1, day=1)
+            elif level == "quarter":
+                d = d.replace(month=(d.month - 1) // 3 * 3 + 1, day=1)
+            elif level == "month":
+                d = d.replace(day=1)
+            else:  # week -> previous/same Monday
+                d = d - datetime.timedelta(days=d.weekday())
+            out[i] = (d - epoch).days
+        return out, valid
+    if t == "NextDay":
+        lv, lm = rec(expr.child)
+        dv, dm = rec(expr.day)
+        out = np.zeros(n, dtype=np.int32)
+        valid = lm & dm
+        epoch = datetime.date(1970, 1, 1)
+        for i in range(n):
+            target = D._DAY_NAMES.get((dv[i] or "").strip().upper())
+            if target is None:
+                valid[i] = False
+                continue
+            d = epoch + datetime.timedelta(days=int(lv[i]))
+            delta = (target - d.weekday() + 7) % 7 or 7
+            out[i] = (d + datetime.timedelta(days=delta) - epoch).days
+        return out, valid
     raise NotImplementedError(f"cpu datetime {t}")
+
+
+def _last_dom(y: int, m: int) -> int:
+    import calendar
+    return calendar.monthrange(y, m)[1]
+
+
+# ---- murmur3 (numpy mirror of the public MurmurHash3_x86_32 spec) ---------
+
+def _np_u32(x):
+    return x.astype(np.uint32)
+
+
+def _np_rotl32(x, r):
+    return _np_u32((x << np.uint32(r)) | (x >> np.uint32(32 - r)))
+
+
+def _np_mix_k(k):
+    k = _np_u32(k * np.uint32(0xcc9e2d51))
+    k = _np_rotl32(k, 15)
+    return _np_u32(k * np.uint32(0x1b873593))
+
+
+def _np_mix_h(h, k):
+    h = _np_u32(h ^ _np_mix_k(k))
+    h = _np_rotl32(h, 13)
+    return _np_u32(h * np.uint32(5) + np.uint32(0xe6546b64))
+
+
+def _np_fmix(h, length):
+    h = _np_u32(h ^ np.uint32(length))
+    h ^= h >> np.uint32(16)
+    h = _np_u32(h * np.uint32(0x85ebca6b))
+    h ^= h >> np.uint32(13)
+    h = _np_u32(h * np.uint32(0xc2b2ae35))
+    h ^= h >> np.uint32(16)
+    return h
+
+
+def _np_hash_int(x_u32, seed_u32):
+    return _np_fmix(_np_mix_h(seed_u32, x_u32), 4)
+
+
+def _np_hash_long(x_i64, seed_u32):
+    u = x_i64.astype(np.uint64)
+    lo = _np_u32(u & np.uint64(0xFFFFFFFF))
+    hi = _np_u32(u >> np.uint64(32))
+    return _np_fmix(_np_mix_h(_np_mix_h(seed_u32, lo), hi), 8)
+
+
+def _np_hash_bytes(bs: bytes, seed: int) -> int:
+    h = np.uint32(seed)
+    nb = len(bs) // 4
+    for i in range(nb):
+        w = np.uint32(int.from_bytes(bs[4 * i:4 * i + 4], "little"))
+        h = _np_mix_h(h, w)
+    for i in range(nb * 4, len(bs)):
+        b = bs[i]
+        signed = b - 256 if b >= 128 else b
+        h = _np_mix_h(h, np.uint32(signed % 2**32))
+    return int(_np_fmix(h, len(bs)))
+
+
+def _np_spark_hash(v, m, dtype, seed_i32):
+    """One column folded into the running per-row seed (int32 array)."""
+    from ..types import (BooleanType, DateType, DoubleType, FloatType,
+                         IntegerType, LongType, TimestampType)
+    seed_u = seed_i32.astype(np.uint32)
+    with np.errstate(all="ignore"):
+        if dtype.is_string:
+            out = np.empty(len(v), dtype=np.int32)
+            for i, s in enumerate(v):
+                if not m[i] or s is None:
+                    out[i] = seed_i32[i]
+                else:
+                    out[i] = np.int32(np.uint32(_np_hash_bytes(
+                        s.encode("utf-8"), int(seed_u[i]))))
+            return out
+        if dtype in (LongType, TimestampType):
+            h = _np_hash_long(v.astype(np.int64), seed_u)
+        elif dtype is DoubleType:
+            d = v.astype(np.float64)
+            d = np.where(d == 0.0, 0.0, d)
+            # Java doubleToLongBits canonicalizes every NaN
+            d = np.where(np.isnan(d), np.float64(np.nan), d)
+            h = _np_hash_long(d.view(np.int64), seed_u)
+        elif dtype is FloatType:
+            f = v.astype(np.float32)
+            f = np.where(f == 0.0, np.float32(0.0), f)
+            f = np.where(np.isnan(f), np.float32(np.nan), f)
+            h = _np_hash_int(f.view(np.uint32), seed_u)
+        elif dtype is BooleanType:
+            h = _np_hash_int(v.astype(np.uint32), seed_u)
+        else:  # byte/short/int/date
+            h = _np_hash_int(v.astype(np.int32).astype(np.uint32), seed_u)
+    res = h.astype(np.int32)
+    return np.where(m, res, seed_i32)

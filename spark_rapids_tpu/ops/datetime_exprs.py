@@ -228,3 +228,170 @@ class TimeAdd(Expression):
         i = self.interval.eval(batch)
         return Column(c.data + i.data.astype(jnp.int64), c.valid & i.valid,
                       TimestampType).mask_invalid()
+
+
+class AddMonths(Expression):
+    """add_months(date, n): civil month arithmetic, day-of-month clamped to
+    the target month's last day (Spark/DateTimeUtils semantics)."""
+
+    def __init__(self, left, right):
+        self.left, self.right = left, right
+        self.children = (left, right)
+
+    @property
+    def dtype(self):
+        return DateType
+
+    def eval(self, batch):
+        d = self.left.eval(batch)
+        n = self.right.eval(batch)
+        days = d.data.astype(jnp.int64)
+        y, m, dom = dtu.civil_from_days(days)
+        total = (y.astype(jnp.int64) * 12 + (m.astype(jnp.int64) - 1)
+                 + n.data.astype(jnp.int64))
+        ny = dtu.floordiv(total, 12).astype(jnp.int32)
+        nm = (total - ny * 12 + 1).astype(jnp.int32)
+        nd = jnp.minimum(dom, dtu.last_day_of_month(ny, nm))
+        out = dtu.days_from_civil(ny, nm, nd)
+        valid = d.valid & n.valid
+        return Column(out.astype(jnp.int32), valid, DateType).mask_invalid()
+
+
+class MonthsBetween(Expression):
+    """months_between(d1, d2): whole months when the days-of-month match or
+    both are month ends, else fractional with /31 (Spark DateTimeUtils;
+    date inputs only — timestamps truncate to date first)."""
+
+    def __init__(self, left, right, round_off=None):
+        self.left, self.right = left, right
+        self.round_off = round_off if round_off is not None \
+            else Literal(True)
+        self.children = (left, right, self.round_off)
+
+    @property
+    def dtype(self):
+        from ..types import DoubleType
+        return DoubleType
+
+    def eval(self, batch):
+        from ..types import DoubleType
+        a = self.left.eval(batch)
+        b = self.right.eval(batch)
+        d1 = a.data.astype(jnp.int64) if self.left.dtype is DateType \
+            else dtu.micros_to_days(a.data)
+        d2 = b.data.astype(jnp.int64) if self.right.dtype is DateType \
+            else dtu.micros_to_days(b.data)
+        y1, m1, dom1 = dtu.civil_from_days(d1)
+        y2, m2, dom2 = dtu.civil_from_days(d2)
+        months = ((y1 - y2) * 12 + (m1 - m2)).astype(jnp.float64)
+        last1 = dtu.last_day_of_month(y1, m1)
+        last2 = dtu.last_day_of_month(y2, m2)
+        whole = (dom1 == dom2) | ((dom1 == last1) & (dom2 == last2))
+        frac = (dom1 - dom2).astype(jnp.float64) / 31.0
+        out = months + jnp.where(whole, 0.0, frac)
+        rnd = isinstance(self.round_off, Literal) and \
+            bool(self.round_off.value)
+        if rnd:
+            out = jnp.round(out * 1e8) / 1e8
+        valid = a.valid & b.valid
+        return Column(out, valid, DoubleType).mask_invalid()
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt) with LITERAL fmt: year|yyyy|yy, quarter, month|mon|mm,
+    week (Monday start).  Unknown formats -> null (Spark behavior)."""
+
+    def __init__(self, child, fmt):
+        self.child, self.fmt = child, fmt
+        self.children = (child, fmt)
+
+    @property
+    def dtype(self):
+        return DateType
+
+    def _level(self):
+        if not (isinstance(self.fmt, Literal)
+                and isinstance(self.fmt.value, str)):
+            raise ValueError("trunc format must be a string literal")
+        f = self.fmt.value.lower()
+        if f in ("year", "yyyy", "yy"):
+            return "year"
+        if f == "quarter":
+            return "quarter"
+        if f in ("month", "mon", "mm"):
+            return "month"
+        if f == "week":
+            return "week"
+        return None
+
+    def device_supported(self) -> bool:
+        try:
+            self._level()
+            return True
+        except ValueError:
+            return False
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        days = c.data.astype(jnp.int64)
+        level = self._level()
+        if level is None:
+            return Column(jnp.zeros_like(c.data), jnp.zeros_like(c.valid),
+                          DateType)
+        y, m, _ = dtu.civil_from_days(days)
+        one = jnp.ones_like(m)
+        if level == "year":
+            out = dtu.days_from_civil(y, one, one)
+        elif level == "quarter":
+            qm = ((m - 1) // 3) * 3 + 1
+            out = dtu.days_from_civil(y, qm, one)
+        elif level == "month":
+            out = dtu.days_from_civil(y, m, one)
+        else:  # week: previous (or same) Monday
+            out = days - (days + 3) % 7
+        return Column(out.astype(jnp.int32), c.valid, DateType)
+
+
+_DAY_NAMES = {"MO": 0, "MON": 0, "MONDAY": 0, "TU": 1, "TUE": 1,
+              "TUESDAY": 1, "WE": 2, "WED": 2, "WEDNESDAY": 2, "TH": 3,
+              "THU": 3, "THURSDAY": 3, "FR": 4, "FRI": 4, "FRIDAY": 4,
+              "SA": 5, "SAT": 5, "SATURDAY": 5, "SU": 6, "SUN": 6,
+              "SUNDAY": 6}
+
+
+class NextDay(Expression):
+    """next_day(date, dayOfWeek) with LITERAL day name: the first date LATER
+    than `date` falling on that weekday; unknown names -> null (Spark)."""
+
+    def __init__(self, child, day):
+        self.child, self.day = child, day
+        self.children = (child, day)
+
+    @property
+    def dtype(self):
+        return DateType
+
+    def _target(self):
+        if not (isinstance(self.day, Literal)
+                and isinstance(self.day.value, str)):
+            raise ValueError("next_day weekday must be a string literal")
+        return _DAY_NAMES.get(self.day.value.strip().upper())
+
+    def device_supported(self) -> bool:
+        try:
+            self._target()
+            return True
+        except ValueError:
+            return False
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        t = self._target()
+        if t is None:
+            return Column(jnp.zeros_like(c.data), jnp.zeros_like(c.valid),
+                          DateType)
+        days = c.data.astype(jnp.int64)
+        wd = (days + 3) % 7  # 0 = Monday
+        delta = (t - wd + 7) % 7
+        delta = jnp.where(delta == 0, 7, delta)
+        return Column((days + delta).astype(jnp.int32), c.valid, DateType)

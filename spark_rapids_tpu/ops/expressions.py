@@ -820,3 +820,59 @@ class Alias(Expression):
 
     def __repr__(self):
         return f"{self.child!r} AS {self.alias}"
+
+
+class _ExtremeN(Expression):
+    """least/greatest(e1..en): null-skipping n-ary extreme with Spark float
+    semantics (NaN is greater than any non-NaN; result is null only when
+    every argument is null)."""
+
+    def __init__(self, *children):
+        assert len(children) >= 2, "least/greatest needs >= 2 arguments"
+        self.children = tuple(children)
+
+    @property
+    def dtype(self):
+        return _common_type([c.dtype for c in self.children])
+
+    def eval(self, batch):
+        dt = self.dtype
+        t = dt.jnp_dtype
+        cols = [c.eval(batch) for c in self.children]
+        acc_v = cols[0].data.astype(t)
+        acc_m = cols[0].valid
+        for c in cols[1:]:
+            v = c.data.astype(t)
+            m = c.valid
+            better = self._better(v, acc_v)
+            take = m & (~acc_m | better)
+            acc_v = jnp.where(take, v, acc_v)
+            acc_m = acc_m | m
+        return Column(acc_v, acc_m, dt).mask_invalid()
+
+    def _cmp_key(self, x):
+        """NaN sorts greatest (Spark ordering)."""
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return jnp.where(jnp.isnan(x), jnp.inf, x), jnp.isnan(x)
+        return x, None
+
+
+class Least(_ExtremeN):
+    def _better(self, v, acc):
+        vk, vn = self._cmp_key(v)
+        ak, an = self._cmp_key(acc)
+        lt = vk < ak
+        if vn is not None:
+            # NaN < nothing except... NaN equals NaN; prefer keeping acc
+            lt = lt | (~vn & an)
+        return lt
+
+
+class Greatest(_ExtremeN):
+    def _better(self, v, acc):
+        vk, vn = self._cmp_key(v)
+        ak, an = self._cmp_key(acc)
+        gt = vk > ak
+        if vn is not None:
+            gt = gt | (vn & ~an)
+        return gt

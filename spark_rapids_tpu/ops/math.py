@@ -184,3 +184,104 @@ class Atan2(BinaryExpression):
 
     def do_op(self, l, r, valid):
         return jnp.arctan2(l.astype(jnp.float64), r.astype(jnp.float64)), valid
+
+
+class Cot(_DoubleUnary):
+    def do_op(self, x):
+        return 1.0 / jnp.tan(x)
+
+
+class Hypot(BinaryExpression):
+    @property
+    def dtype(self):
+        return DoubleType
+
+    def do_op(self, l, r, valid):
+        return jnp.hypot(l.astype(jnp.float64), r.astype(jnp.float64)), valid
+
+
+class Logarithm(BinaryExpression):
+    """log(base, x): null when x <= 0 or base <= 0 (Spark nullSafeEval)."""
+
+    @property
+    def dtype(self):
+        return DoubleType
+
+    def do_op(self, base, x, valid):
+        b = base.astype(jnp.float64)
+        v = x.astype(jnp.float64)
+        ok = (v > 0.0) & (b > 0.0)
+        out = jnp.log(jnp.where(v > 0, v, 1.0)) \
+            / jnp.log(jnp.where(b > 0, b, 2.0))
+        return out, valid & ok
+
+
+class _RoundBase(Expression):
+    """round/bround(child, scale) with literal scale.  Spark semantics:
+    HALF_UP (round) / HALF_EVEN (bround) at decimal `scale`; integral
+    inputs with scale >= 0 are unchanged."""
+
+    def __init__(self, child, scale=None):
+        from .expressions import Literal
+        self.child = child
+        self.scale = scale if scale is not None else Literal(0)
+        self.children = (child, self.scale)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def _scale(self) -> int:
+        from .expressions import Literal
+        if isinstance(self.scale, Literal) and \
+                isinstance(self.scale.value, int):
+            return int(self.scale.value)
+        raise ValueError("round scale must be an integer literal")
+
+    def device_supported(self) -> bool:
+        try:
+            self._scale()
+            return True
+        except ValueError:
+            return False
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        s = self._scale()
+        if c.dtype.is_integral:
+            if s >= 0:
+                return c
+            import numpy as _np
+            if 10 ** (-s) > int(_np.iinfo(c.data.dtype).max):
+                # every digit rounded away: Spark's BigDecimal yields 0
+                return Column(jnp.zeros_like(c.data), c.valid, c.dtype)
+            p = jnp.asarray(10 ** (-s), dtype=c.data.dtype)
+            half = p // 2
+            x = c.data
+            q = x // p
+            rem = x - q * p
+            if self.half_even:
+                up = (rem > half) | ((rem == half) & (q % 2 != 0))
+            else:
+                # HALF_UP on the absolute value
+                up = jnp.where(x >= 0, rem >= half, rem > half)
+            return Column((q + up.astype(c.data.dtype)) * p, c.valid,
+                          c.dtype)
+        x = c.data.astype(jnp.float64)
+        p = jnp.float64(10.0 ** s)
+        scaled = x * p
+        if self.half_even:
+            r = jnp.rint(scaled)
+        else:
+            r = jnp.trunc(scaled + jnp.where(scaled >= 0, 0.5, -0.5))
+        out = r / p
+        out = jnp.where(jnp.isfinite(x), out, x)
+        return Column(out.astype(c.dtype.jnp_dtype), c.valid, c.dtype)
+
+
+class Round(_RoundBase):
+    half_even = False
+
+
+class BRound(_RoundBase):
+    half_even = True

@@ -235,6 +235,22 @@ def _contains_at(c: Column, pat: np.ndarray):
     return acc
 
 
+def _nonoverlap_starts(occ, m: int):
+    """Greedy left-to-right suppression of overlapping matches: a match at
+    position p hides matches at p+1..p+m-1 (Spark's indexOf-then-advance
+    scan semantics)."""
+    import jax
+
+    def step(carry, col_occ):
+        active = col_occ & (carry == 0)
+        new_carry = jnp.where(active, m - 1, jnp.maximum(carry - 1, 0))
+        return new_carry, active
+
+    carry0 = jnp.zeros(occ.shape[0], dtype=jnp.int32)
+    _, starts = jax.lax.scan(step, carry0, occ.T)
+    return starts.T
+
+
 class Contains(_PatternPredicate):
     def eval(self, batch):
         c = self.child.eval(batch)
@@ -369,21 +385,8 @@ class StringReplace(Expression):
         if len(s) > c.max_len:
             return c
         occ = _contains_at(c, s)
-        # suppress overlapping matches left-to-right: greedy scan
         m = len(s)
-        L = c.max_len
-
-        def step(carry, col_occ):
-            # carry: remaining suppress count per row
-            active = col_occ & (carry == 0)
-            new_carry = jnp.where(active, m - 1,
-                                  jnp.maximum(carry - 1, 0))
-            return new_carry, active
-
-        import jax
-        carry0 = jnp.zeros(c.capacity, dtype=jnp.int32)
-        _, starts = jax.lax.scan(step, carry0, occ.T)
-        starts = starts.T  # [rows, L] non-overlapping match starts
+        starts = _nonoverlap_starts(occ, m)  # [rows, L]
         data = c.data
         for j in range(m):
             mask = jnp.roll(starts, j, axis=1)
@@ -391,3 +394,268 @@ class StringReplace(Expression):
                 mask = mask.at[:, :j].set(False)
             data = jnp.where(mask, int(r[j]), data)
         return Column(data, c.valid, StringType, c.lengths)
+
+
+class InitCap(_StringUnary):
+    """initcap: first character of each space-delimited word uppercased,
+    the rest lowercased (ASCII-exact, like the module's other case ops;
+    reference: stringFunctions.scala GpuInitCap, delimiter = space)."""
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        data = c.data
+        # word start = position 0 or previous byte is a space
+        prev = jnp.concatenate(
+            [jnp.full((c.capacity, 1), ord(" "), dtype=data.dtype),
+             data[:, :-1]], axis=1)
+        first = prev == ord(" ")
+        lower = (data >= ord("a")) & (data <= ord("z"))
+        upper = (data >= ord("A")) & (data <= ord("Z"))
+        out = jnp.where(first & lower, data - 32,
+                        jnp.where(~first & upper, data + 32, data))
+        return Column(out, c.valid, StringType, c.lengths)
+
+
+class Reverse(_StringUnary):
+    """Byte-wise reverse within each row's length (ASCII-exact)."""
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        L = c.max_len
+        pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+        idx = jnp.clip(c.lengths[:, None] - 1 - pos, 0, max(L - 1, 0))
+        rev = jnp.take_along_axis(c.data, idx, axis=1)
+        rev = jnp.where(pos < c.lengths[:, None], rev, 0)
+        return Column(rev, c.valid, StringType, c.lengths)
+
+
+class Ascii(_StringUnary):
+    """ascii(str): code point of the first character (ASCII-exact: first
+    byte); 0 for the empty string."""
+
+    @property
+    def dtype(self):
+        return IntegerType
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        first = c.data[:, 0].astype(jnp.int32) if c.max_len else \
+            jnp.zeros(c.capacity, jnp.int32)
+        return Column(jnp.where(c.lengths > 0, first, 0), c.valid,
+                      IntegerType)
+
+
+def _literal_int(e: Expression) -> int:
+    if isinstance(e, Literal) and isinstance(e.value, int):
+        return int(e.value)
+    raise ValueError("argument must be an integer literal")
+
+
+class _PadBase(Expression):
+    """lpad/rpad(str, len, pad) with LITERAL len/pad (static output width;
+    the reference requires literal pad arguments the same way)."""
+
+    def __init__(self, child, length, pad):
+        self.child, self.length, self.pad = child, length, pad
+        self.children = (child, length, pad)
+
+    @property
+    def dtype(self):
+        return StringType
+
+    def device_supported(self) -> bool:
+        try:
+            _literal_int(self.length)
+            _literal_bytes(self.pad)
+            return True
+        except ValueError:
+            return False
+
+    def _args(self):
+        want = max(_literal_int(self.length), 0)
+        pad = np.frombuffer(_literal_bytes(self.pad), dtype=np.uint8)
+        return want, pad
+
+
+class StringLPad(_PadBase):
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        want, pad = self._args()
+        L = bucket_strlen(max(want, 1))
+        c = c.pad_strings_to(max(L, c.max_len))
+        Lc = c.max_len
+        pos = jnp.arange(Lc, dtype=jnp.int32)[None, :]
+        # empty pad: nothing can be prepended, only truncation applies
+        npad = jnp.maximum(want - c.lengths, 0)[:, None] if len(pad) \
+            else jnp.zeros((c.capacity, 1), dtype=jnp.int32)
+        # output[j] = pad[j % len(pad)] for j < npad else str[j - npad]
+        sidx = jnp.clip(pos - npad, 0, Lc - 1)
+        from_str = jnp.take_along_axis(c.data, sidx, axis=1)
+        if len(pad):
+            pad_row = jnp.asarray(pad)[
+                jnp.arange(Lc, dtype=jnp.int32) % len(pad)]
+            pv = jnp.broadcast_to(pad_row[None, :], from_str.shape)
+        else:
+            pv = jnp.zeros_like(from_str)
+        out = jnp.where(pos < npad, pv, from_str)
+        if len(pad):
+            new_len = jnp.full_like(c.lengths, want)
+        else:  # nothing to pad with: truncate only
+            new_len = jnp.minimum(c.lengths, want)
+        new_len = new_len.astype(jnp.int32)
+        out = jnp.where(pos < new_len[:, None], out, 0)
+        return Column(out, c.valid, StringType, new_len)
+
+
+class StringRPad(_PadBase):
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        want, pad = self._args()
+        L = bucket_strlen(max(want, 1))
+        c = c.pad_strings_to(max(L, c.max_len))
+        Lc = c.max_len
+        pos = jnp.arange(Lc, dtype=jnp.int32)[None, :]
+        if len(pad):
+            # pad cycle restarts at the end of the source string
+            off = jnp.clip(pos - c.lengths[:, None], 0, Lc - 1)
+            pv = jnp.asarray(pad)[off % len(pad)]
+            new_len = jnp.full_like(c.lengths, want)
+        else:
+            pv = jnp.zeros_like(c.data)
+            new_len = jnp.minimum(c.lengths, want)
+        out = jnp.where(pos < c.lengths[:, None], c.data, pv)
+        new_len = jnp.where(c.lengths >= want, want, new_len).astype(
+            jnp.int32)
+        out = jnp.where(pos < new_len[:, None], out, 0)
+        return Column(out, c.valid, StringType, new_len)
+
+
+class StringRepeat(Expression):
+    """repeat(str, n) with LITERAL n (static output width)."""
+
+    def __init__(self, child, times):
+        self.child, self.times = child, times
+        self.children = (child, times)
+
+    @property
+    def dtype(self):
+        return StringType
+
+    def device_supported(self) -> bool:
+        try:
+            return _literal_int(self.times) >= 0
+        except ValueError:
+            return False
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        k = max(_literal_int(self.times), 0)
+        if k == 0 or c.max_len == 0:
+            z = jnp.zeros((c.capacity, 1), dtype=jnp.uint8)
+            return Column(z, c.valid, StringType,
+                          jnp.zeros(c.capacity, jnp.int32))
+        L = bucket_strlen(c.max_len * k)
+        pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+        lens = jnp.maximum(c.lengths, 1)[:, None]   # avoid mod-by-zero
+        src = jnp.clip(pos % lens, 0, c.max_len - 1)
+        out = jnp.take_along_axis(
+            jnp.pad(c.data, ((0, 0), (0, L - c.max_len))), src, axis=1)
+        new_len = (c.lengths * k).astype(jnp.int32)
+        out = jnp.where(pos < new_len[:, None], out, 0)
+        return Column(out, c.valid, StringType, new_len)
+
+
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) with LITERAL delim/count:
+    count>0 -> prefix before the count'th delimiter, count<0 -> suffix
+    after the count'th-from-the-end delimiter, 0 -> empty."""
+
+    def __init__(self, child, delim, count):
+        self.child, self.delim, self.count = child, delim, count
+        self.children = (child, delim, count)
+
+    @property
+    def dtype(self):
+        return StringType
+
+    def device_supported(self) -> bool:
+        try:
+            _literal_bytes(self.delim)
+            _literal_int(self.count)
+            return True
+        except ValueError:
+            return False
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        delim = np.frombuffer(_literal_bytes(self.delim), dtype=np.uint8)
+        count = _literal_int(self.count)
+        cap, L = c.capacity, c.max_len
+        if count == 0 or len(delim) == 0 or L == 0:
+            z = jnp.zeros((cap, max(L, 1)), dtype=jnp.uint8)
+            return Column(z, c.valid, StringType,
+                          jnp.zeros(cap, jnp.int32))
+        m = len(delim)
+        # non-overlapping occurrences, like Spark's indexOf-advance scan
+        occ = _nonoverlap_starts(_contains_at(c, delim), m)   # [cap, L]
+        pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+        if count > 0:
+            # end = start of the count'th occurrence (whole string if fewer)
+            rank = jnp.cumsum(occ.astype(jnp.int32), axis=1)
+            hit = occ & (rank == count)
+            found = jnp.any(hit, axis=1)
+            cut = jnp.argmax(hit, axis=1).astype(jnp.int32)
+            new_len = jnp.where(found, cut, c.lengths).astype(jnp.int32)
+            out = jnp.where(pos < new_len[:, None], c.data, 0)
+            return Column(out, c.valid, StringType, new_len)
+        # count < 0: start after the |count|'th occurrence from the end
+        total = jnp.sum(occ.astype(jnp.int32), axis=1)
+        rank = jnp.cumsum(occ.astype(jnp.int32), axis=1)
+        want = total + count  # index (1-based) from the left of the cut
+        hit = occ & (rank == (want + 1)[:, None])
+        found = jnp.any(hit, axis=1) & (want >= 0)
+        start = jnp.where(found,
+                          jnp.argmax(hit, axis=1).astype(jnp.int32) + m, 0)
+        new_len = (c.lengths - start).astype(jnp.int32)
+        idx = jnp.clip(pos + start[:, None], 0, L - 1)
+        out = jnp.take_along_axis(c.data, idx, axis=1)
+        out = jnp.where(pos < new_len[:, None], out, 0)
+        return Column(out, c.valid, StringType, new_len)
+
+
+_REGEX_META = set(b".^$*+?{}[]|()\\")
+
+
+class RegExpReplace(Expression):
+    """regexp_replace with a LITERAL pattern.  The device kernel supports
+    metacharacter-free patterns with equal-length replacement (delegating to
+    the StringReplace kernel); everything else is planner-tagged to the CPU
+    executor.  The reference similarly ships literal-only regexp support in
+    this era (stringFunctions.scala GpuRegExpReplace via cudf replace)."""
+
+    def __init__(self, child, pattern, replacement):
+        self.child, self.pattern, self.replacement = (child, pattern,
+                                                      replacement)
+        self.children = (child, pattern, replacement)
+
+    @property
+    def dtype(self):
+        return StringType
+
+    def device_supported(self) -> bool:
+        try:
+            pat = _literal_bytes(self.pattern)
+            rep = _literal_bytes(self.replacement)
+        except ValueError:
+            return False
+        if any(b in _REGEX_META for b in pat):
+            return False
+        return len(pat) == len(rep) and len(pat) > 0
+
+    def eval(self, batch):
+        if not self.device_supported():
+            raise NotImplementedError(
+                "device RegExpReplace requires a literal, metacharacter-"
+                "free pattern with equal-length replacement")
+        return StringReplace(self.child, self.pattern,
+                             self.replacement).eval(batch)

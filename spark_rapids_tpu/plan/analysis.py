@@ -125,14 +125,31 @@ def resolve(ce, schema: Schema, partition_id: int = 0) -> E.Expression:
                "StartsWith": S.StartsWith, "EndsWith": S.EndsWith,
                "Contains": S.Contains, "Like": S.Like, "Trim": S.StringTrim,
                "LTrim": S.StringTrimLeft, "RTrim": S.StringTrimRight,
-               "StringReplace": S.StringReplace, "Locate": S.StringLocate}
+               "StringReplace": S.StringReplace, "Locate": S.StringLocate,
+               "InitCap": S.InitCap, "Reverse": S.Reverse,
+               "Ascii": S.Ascii, "StringLPad": S.StringLPad,
+               "StringRPad": S.StringRPad, "StringRepeat": S.StringRepeat,
+               "SubstringIndex": S.SubstringIndex,
+               "RegExpReplace": S.RegExpReplace}
     _DATE = {"Year": D.Year, "Month": D.Month, "DayOfMonth": D.DayOfMonth,
              "Hour": D.Hour, "Minute": D.Minute, "Second": D.Second,
              "DayOfWeek": D.DayOfWeek, "DayOfYear": D.DayOfYear,
              "Quarter": D.Quarter, "LastDay": D.LastDay,
              "DateAdd": D.DateAdd, "DateSub": D.DateSub,
              "DateDiff": D.DateDiff, "UnixTimestamp": D.UnixTimestamp,
-             "FromUnixTime": D.FromUnixTime}
+             "FromUnixTime": D.FromUnixTime, "AddMonths": D.AddMonths,
+             "MonthsBetween": D.MonthsBetween, "TruncDate": D.TruncDate,
+             "NextDay": D.NextDay}
+    if op in ("Round", "BRound", "Hypot", "Cot", "Logarithm",
+              "Least", "Greatest", "Murmur3Hash"):
+        from ..ops import math as M
+        from ..ops.hashing import Murmur3Hash
+        args = [resolve(a, schema, partition_id) for a in ce.args]
+        _extra = {"Round": M.Round, "BRound": M.BRound, "Hypot": M.Hypot,
+                  "Cot": M.Cot, "Logarithm": M.Logarithm,
+                  "Least": E.Least, "Greatest": E.Greatest,
+                  "Murmur3Hash": Murmur3Hash}
+        return _extra[op](*args)
     if op in _STRING:
         args = [resolve(a, schema, partition_id) for a in ce.args]
         return _STRING[op](*args)

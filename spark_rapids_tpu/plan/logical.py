@@ -366,6 +366,91 @@ class functions:
         return ColumnExpr("Lead", (_wrap(e), offset, default))
 
     @staticmethod
+    def initcap(e):
+        return ColumnExpr("InitCap", (_wrap(e),))
+
+    @staticmethod
+    def reverse(e):
+        return ColumnExpr("Reverse", (_wrap(e),))
+
+    @staticmethod
+    def ascii(e):
+        return ColumnExpr("Ascii", (_wrap(e),))
+
+    @staticmethod
+    def lpad(e, length, pad=" "):
+        return ColumnExpr("StringLPad", (_wrap(e), _wrap(length),
+                                         _wrap(pad)))
+
+    @staticmethod
+    def rpad(e, length, pad=" "):
+        return ColumnExpr("StringRPad", (_wrap(e), _wrap(length),
+                                         _wrap(pad)))
+
+    @staticmethod
+    def repeat(e, n):
+        return ColumnExpr("StringRepeat", (_wrap(e), _wrap(n)))
+
+    @staticmethod
+    def substring_index(e, delim, count):
+        return ColumnExpr("SubstringIndex", (_wrap(e), _wrap(delim),
+                                             _wrap(count)))
+
+    @staticmethod
+    def regexp_replace(e, pattern, replacement):
+        return ColumnExpr("RegExpReplace", (_wrap(e), _wrap(pattern),
+                                            _wrap(replacement)))
+
+    @staticmethod
+    def round(e, scale=0):
+        return ColumnExpr("Round", (_wrap(e), _wrap(scale)))
+
+    @staticmethod
+    def bround(e, scale=0):
+        return ColumnExpr("BRound", (_wrap(e), _wrap(scale)))
+
+    @staticmethod
+    def hypot(a, b):
+        return ColumnExpr("Hypot", (_wrap(a), _wrap(b)))
+
+    @staticmethod
+    def cot(e):
+        return ColumnExpr("Cot", (_wrap(e),))
+
+    @staticmethod
+    def log_base(base, e):
+        return ColumnExpr("Logarithm", (_wrap(base), _wrap(e)))
+
+    @staticmethod
+    def least(*exprs):
+        return ColumnExpr("Least", tuple(_wrap(e) for e in exprs))
+
+    @staticmethod
+    def greatest(*exprs):
+        return ColumnExpr("Greatest", tuple(_wrap(e) for e in exprs))
+
+    @staticmethod
+    def hash(*exprs):
+        return ColumnExpr("Murmur3Hash", tuple(_wrap(e) for e in exprs))
+
+    @staticmethod
+    def add_months(e, n):
+        return ColumnExpr("AddMonths", (_wrap(e), _wrap(n)))
+
+    @staticmethod
+    def months_between(a, b, round_off=True):
+        return ColumnExpr("MonthsBetween", (_wrap(a), _wrap(b),
+                                            _wrap(round_off)))
+
+    @staticmethod
+    def trunc(e, fmt):
+        return ColumnExpr("TruncDate", (_wrap(e), _wrap(fmt)))
+
+    @staticmethod
+    def next_day(e, day_of_week):
+        return ColumnExpr("NextDay", (_wrap(e), _wrap(day_of_week)))
+
+    @staticmethod
     def explode(values):
         """Explode an array literal: one output row per element per input
         row (reference scope: GpuGenerateExec.scala:101+ supports

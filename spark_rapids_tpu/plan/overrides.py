@@ -121,6 +121,24 @@ _EXPR_RULES["StringReplace"] = _tag_replace
 _EXPR_RULES["AggregateExpression"] = _tag_agg
 
 
+def _tag_device_supported(meta: "ExprMeta", conf: TpuConf):
+    """Ops whose device kernel needs literal arguments (static shapes /
+    compiled patterns) expose device_supported(); tag the rest to CPU."""
+    e = meta.expr
+    if hasattr(e, "device_supported") and not e.device_supported():
+        meta.will_not_work(
+            f"{meta.name} arguments are not supported on TPU "
+            "(literal arguments with device-supported shapes required)")
+
+
+for _n in ("InitCap Reverse Ascii Cot Hypot Logarithm Least Greatest "
+           "Murmur3Hash AddMonths MonthsBetween").split():
+    _EXPR_RULES[_n] = None
+for _n in ("StringLPad StringRPad StringRepeat SubstringIndex "
+           "RegExpReplace Round BRound TruncDate NextDay").split():
+    _EXPR_RULES[_n] = _tag_device_supported
+
+
 def expr_conf_key(name: str) -> str:
     return f"spark.rapids.sql.expr.{name}"
 

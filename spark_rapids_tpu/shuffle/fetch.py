@@ -1,0 +1,96 @@
+"""Pipelined shuffle read: producer thread + bounded-bytes queue.
+
+The reference overlaps fetch with compute via a producer/consumer iterator
+with inflight-bytes throttling (rapids/shuffle/RapidsShuffleIterator.scala:
+17-258 — BufferReceiveState handoff — and RapidsShuffleTransport.scala:38-500
+— `maxReceiveInflightBytes` throttle on issued receives).  Here a daemon
+thread walks the partitions through `ShuffleEnv.fetch_partition` while the
+consumer drains already-fetched batches, so fetch of partition k+1 overlaps
+consumption of partition k; admission of new batches is bounded by
+`spark.rapids.shuffle.maxReceiveInflightBytes` of un-consumed device bytes
+(a batch larger than the cap is admitted alone rather than deadlocking, the
+same degenerate case the reference's bounce-buffer pool absorbs).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+
+class AsyncFetchIterator:
+    """Iterates (reduce_id, batch) across `reduce_ids` with prefetch.
+
+    The producer thread fetches partitions IN ORDER; `prefetched_partitions`
+    exposes which reduce ids the producer has started (test observability).
+    Errors in the producer re-raise in the consumer."""
+
+    _DONE = object()
+
+    def __init__(self, env, shuffle_id: int, reduce_ids: Sequence[int],
+                 remote_peers: Optional[List[str]] = None,
+                 max_inflight_bytes: int = 1 << 30):
+        self._env = env
+        self._sid = shuffle_id
+        self._rids = list(reduce_ids)
+        self._peers = remote_peers
+        self._max = max(int(max_inflight_bytes), 1)
+        self._q: "queue.Queue" = queue.Queue()
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._stop = False
+        self.prefetched_partitions: List[int] = []
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    # ---- producer ----------------------------------------------------------
+
+    def _admit(self, nbytes: int) -> bool:
+        """Block until `nbytes` fits under the inflight cap (or the queue is
+        empty — a single oversized batch must still make progress).
+        Returns False when the consumer shut down."""
+        with self._cv:
+            while not self._stop and self._inflight > 0 \
+                    and self._inflight + nbytes > self._max:
+                self._cv.wait(timeout=0.5)
+            if self._stop:
+                return False
+            self._inflight += nbytes
+            return True
+
+    def _produce(self) -> None:
+        try:
+            for rid in self._rids:
+                self.prefetched_partitions.append(rid)
+                for batch in self._env.fetch_partition(self._sid, rid,
+                                                       self._peers):
+                    nb = batch.device_size_bytes()
+                    if not self._admit(nb):
+                        return
+                    self._q.put((rid, batch, nb))
+            self._q.put(self._DONE)
+        except BaseException as ex:  # surfaced in the consumer
+            self._q.put(ex)
+
+    # ---- consumer ----------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Tuple[int, "object"]]:
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                rid, batch, nb = item
+                with self._cv:
+                    self._inflight -= nb
+                    self._cv.notify_all()
+                yield rid, batch
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()

@@ -214,6 +214,16 @@ class ShuffleEnv:
         for peer in remote_peers or []:
             yield from self._fetch_remote(peer, shuffle_id, reduce_id)
 
+    def fetch_partitions_async(self, shuffle_id: int, reduce_ids,
+                               remote_peers: Optional[List[str]] = None):
+        """Pipelined multi-partition read: fetch of partition k+1 overlaps
+        consumption of partition k, bounded by maxReceiveInflightBytes
+        (shuffle/fetch.py; reference RapidsShuffleIterator.scala:17-258)."""
+        from .fetch import AsyncFetchIterator
+        return AsyncFetchIterator(
+            self, shuffle_id, reduce_ids, remote_peers,
+            int(self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)))
+
     def _fetch_remote(self, peer: str, shuffle_id: int, reduce_id: int
                       ) -> Iterator[ColumnarBatch]:
         """doFetch (RapidsShuffleClient.scala:350-770): wildcard metadata
