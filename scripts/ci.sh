@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# CI entry point: lint + fast test tier (the reference's analogue is the
+# maven multi-module verify + jenkins pipelines, SURVEY.md §2.11).
+# Usage: scripts/ci.sh [--slow]   (--slow adds the SF0.05 TPC-H tier)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint (pyflakes-level) =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check spark_rapids_tpu tests benchmarks bench.py __graft_entry__.py
+else
+    python -m pyflakes spark_rapids_tpu tests benchmarks bench.py \
+        __graft_entry__.py 2>/dev/null || \
+    python -m flake8 --select=E9,F spark_rapids_tpu tests benchmarks \
+        bench.py __graft_entry__.py 2>/dev/null || \
+    echo "(no ruff/pyflakes/flake8 in image; syntax-checking instead)" && \
+    python -m compileall -q spark_rapids_tpu tests benchmarks bench.py \
+        __graft_entry__.py
+fi
+
+echo "== tests (fast tier) =="
+MARK="not slow"
+if [[ "${1:-}" == "--slow" ]]; then MARK=""; fi
+if [[ -n "$MARK" ]]; then
+    python -m pytest tests/ -q -m "$MARK"
+else
+    python -m pytest tests/ -q
+fi
+
+echo "== multichip dryrun =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+echo "CI OK"

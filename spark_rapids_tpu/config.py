@@ -223,6 +223,12 @@ ORC_READ_ENABLED = _conf("spark.rapids.sql.format.orc.read.enabled", True,
                          "Enable ORC reads.", _to_bool)
 ORC_WRITE_ENABLED = _conf("spark.rapids.sql.format.orc.write.enabled", True,
                           "Enable ORC writes.", _to_bool)
+PARQUET_DEVICE_DECODE = _conf(
+    "spark.rapids.sql.format.parquet.deviceDecode.enabled", True,
+    "Decode parquet PLAIN/dictionary pages of flat numeric/bool columns "
+    "on the device (host keeps only page headers, run structure, and "
+    "definition levels); columns outside scope fall back to the host "
+    "arrow reader per column.", _to_bool)
 PARQUET_DEBUG_DUMP_PREFIX = _conf(
     "spark.rapids.sql.parquet.debug.dumpPrefix", "",
     "If set, dump the clipped host parquet buffer to this path prefix for "

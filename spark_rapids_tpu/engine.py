@@ -310,15 +310,22 @@ class DataFrame:
         physical = self.session.plan(self.plan)
         runtime = self.session.runtime
         ctx = ExecContext(self.session.conf, runtime=runtime)
-        if isinstance(physical, TpuExec):
-            physical = B.DeviceToHostExec(physical)
-            # device semaphore: this "task" holds a device slot for the
-            # duration of its device work (reference:
-            # GpuSemaphore.acquireIfNecessary, released on task completion)
-            with runtime.semaphore.held():
+        try:
+            if isinstance(physical, TpuExec):
+                physical = B.DeviceToHostExec(physical)
+                # device semaphore: this "task" holds a device slot for the
+                # duration of its device work (reference:
+                # GpuSemaphore.acquireIfNecessary, released on task
+                # completion)
+                with runtime.semaphore.held():
+                    tables = list(physical.execute_cpu(ctx))
+            else:
                 tables = list(physical.execute_cpu(ctx))
-        else:
-            tables = list(physical.execute_cpu(ctx))
+        finally:
+            # task-completion cleanup, success or failure: releases
+            # resources operators registered (e.g. shuffle partitions
+            # orphaned by a mid-write error)
+            ctx.run_cleanups()
         if not tables:
             from .types import to_arrow
             return pa.table({f.name: pa.array([], type=to_arrow(f.dtype))
@@ -353,16 +360,19 @@ class DataFrame:
         physical = self.session.plan(self.plan)
         runtime = self.session.runtime
         ctx = ExecContext(self.session.conf, runtime=runtime)
-        if isinstance(physical, TpuExec):
-            runtime.semaphore.acquire_if_necessary()
-            try:
-                yield from physical.execute(ctx)
-            finally:
-                runtime.semaphore.task_done()
-        else:
-            for table in physical.execute_cpu(ctx):
-                from .columnar import ColumnarBatch
-                yield ColumnarBatch.from_arrow(table)
+        try:
+            if isinstance(physical, TpuExec):
+                runtime.semaphore.acquire_if_necessary()
+                try:
+                    yield from physical.execute(ctx)
+                finally:
+                    runtime.semaphore.task_done()
+            else:
+                for table in physical.execute_cpu(ctx):
+                    from .columnar import ColumnarBatch
+                    yield ColumnarBatch.from_arrow(table)
+        finally:
+            ctx.run_cleanups()
 
 
 class GroupedData:
@@ -453,10 +463,13 @@ class DataFrameWriter:
         physical = self.df.session.plan(plan)
         runtime = self.df.session.runtime
         ctx = ExecContext(self.df.session.conf, runtime=runtime)
-        if isinstance(physical, TpuExec):
-            with runtime.semaphore.held():
-                for _ in physical.execute(ctx):
+        try:
+            if isinstance(physical, TpuExec):
+                with runtime.semaphore.held():
+                    for _ in physical.execute(ctx):
+                        pass
+            else:
+                for _ in physical.execute_cpu(ctx):
                     pass
-        else:
-            for _ in physical.execute_cpu(ctx):
-                pass
+        finally:
+            ctx.run_cleanups()

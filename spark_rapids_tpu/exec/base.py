@@ -54,9 +54,30 @@ class ExecContext:
         self.partition_id = partition_id
         self.num_partitions = num_partitions
         self.runtime = runtime  # mem.runtime.TpuRuntime when active
+        # task-scoped cleanup callbacks (reference: task-completion
+        # listeners releasing GPU resources, GpuSemaphore.scala:27-161 /
+        # RapidsBufferCatalog task cleanup).  Operators register IDEMPOTENT
+        # callbacks for resources that would otherwise orphan when a query
+        # dies mid-flight; the engine runs them on task end, normal or not.
+        self.cleanups: list = []
+
+    def add_cleanup(self, cb) -> None:
+        self.cleanups.append(cb)
+
+    def run_cleanups(self) -> None:
+        """Run registered callbacks newest-first; a failing callback does
+        not prevent the rest from running."""
+        while self.cleanups:
+            cb = self.cleanups.pop()
+            try:
+                cb()
+            except Exception:  # pragma: no cover - best-effort teardown
+                pass
 
     def with_partition(self, pid: int, nparts: int) -> "ExecContext":
-        return ExecContext(self.conf, pid, nparts, self.runtime)
+        ctx = ExecContext(self.conf, pid, nparts, self.runtime)
+        ctx.cleanups = self.cleanups  # share the task scope
+        return ctx
 
 
 class ExecNode:

@@ -88,6 +88,10 @@ class TpuShuffleExchangeExec(TpuExec):
             ctx.runtime = TpuRuntime(ctx.conf)
             env = get_shuffle_env(ctx.runtime, ctx.conf)
         sid = env.new_shuffle_id()
+        # a query dying mid-WRITE would orphan the partitions already in
+        # the catalog (the read-phase try/finally below never runs);
+        # remove_shuffle is idempotent, so register it with the task scope
+        ctx.add_cleanup(lambda: env.remove_shuffle(sid))
         n = self.num_partitions
 
         child_batches = self.children[0].execute(ctx)
@@ -99,6 +103,18 @@ class TpuShuffleExchangeExec(TpuExec):
             bounds = sample_range_bounds(child_batches, self.keys,
                                          self.ascending, self.nulls_first, n)
 
+        if isinstance(child_batches, list):
+            # range mode materialized the list for bounds sampling: drop
+            # each batch reference once written so peak memory is the
+            # spillable partition store, not store + pinned inputs
+            seq = child_batches
+
+            def _draining(s=seq):
+                for i in range(len(s)):
+                    b, s[i] = s[i], None
+                    yield b
+            child_batches = _draining()
+
         num_writes = 0
         with self.metrics.timer("shuffleWriteTime"):
             for map_id, batch in enumerate(child_batches):
@@ -106,6 +122,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 for p, sub in split_by_partition(batch, pids, n):
                     env.write_partition(sid, map_id, p, sub)
                     num_writes += 1
+                batch = None
         self.metrics.add("numPartitionsWritten", num_writes)
 
         from ..config import SHUFFLE_ASYNC_FETCH

@@ -1,0 +1,514 @@
+"""Device-side Parquet decode for flat numeric/bool columns.
+
+Reference behavior: the signature move of the reference reader is host
+footer clipping + DEVICE page decode (GpuParquetScan.scala:316-345,536-569 —
+the clipped buffer goes to `Table.readParquet` on the GPU).  The TPU-first
+split keeps the same boundary but places it where this hardware wants it:
+
+  host control plane (scalar, tiny):
+    * thrift-compact PageHeader parsing (pure python, ~bytes per page)
+    * RLE/bit-packed run headers (a handful of varints per page)
+    * definition levels -> validity bitmap (numpy bit ops on 1 bit/row)
+    * decompression via pyarrow's codec (no python-snappy in the image)
+  device data plane (vector, the actual megabytes):
+    * PLAIN fixed-width value decode (byte matrix -> typed lanes, VPU
+      shifts; float64 reconstructed from bit fields on TPU where u64->f64
+      bitcast is unavailable)
+    * bit-packed dictionary-index unpacking (gather + shift + mask)
+    * dictionary gather and null-expansion (cumsum+gather, no scatter)
+
+Scope (planner falls back to the pyarrow host path otherwise, like the
+reference's fallback flags): PLAIN / RLE_DICTIONARY(+PLAIN_DICTIONARY)
+encodings, UNCOMPRESSED or pyarrow-supported codecs, flat non-nested
+columns of INT32/INT64/FLOAT/DOUBLE/BOOLEAN, data page v1/v2.
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..columnar import Column
+from ..columnar.batch import bucket_rows
+from ..types import DataType
+from ..utils.kernel_cache import cached_kernel
+
+
+class DeviceDecodeUnsupported(Exception):
+    """Raised when a chunk needs a shape this decoder does not cover; the
+    caller falls back to the pyarrow host path."""
+
+
+# --------------------------------------------------------------------------
+# thrift compact protocol (just enough for PageHeader)
+# --------------------------------------------------------------------------
+
+class _Thrift:
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def _byte(self) -> int:
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        out = shift = 0
+        while True:
+            b = self._byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read_struct(self) -> dict:
+        """Generic struct read -> {field_id: value}; nested structs become
+        dicts, unneeded field types are skipped."""
+        out = {}
+        fid = 0
+        while True:
+            head = self._byte()
+            if head == 0:  # STOP
+                return out
+            delta = head >> 4
+            ftype = head & 0x0F
+            fid = fid + delta if delta else self.zigzag()
+            out[fid] = self._value(ftype)
+
+    def _value(self, ftype: int):
+        if ftype == 1:
+            return True
+        if ftype == 2:
+            return False
+        if ftype == 3:
+            return self.zigzag()  # byte
+        if ftype in (4, 5, 6):
+            return self.zigzag()  # i16/i32/i64
+        if ftype == 7:
+            v = struct.unpack_from("<d", self.buf, self.pos)[0]
+            self.pos += 8
+            return v
+        if ftype == 8:  # binary
+            n = self.varint()
+            v = self.buf[self.pos:self.pos + n]
+            self.pos += n
+            return v
+        if ftype == 12:
+            return self.read_struct()
+        if ftype in (9, 10):  # list/set
+            head = self._byte()
+            n = head >> 4
+            etype = head & 0x0F
+            if n == 15:
+                n = self.varint()
+            return [self._value(etype) for _ in range(n)]
+        raise DeviceDecodeUnsupported(f"thrift type {ftype}")
+
+
+# page type enum
+_DATA_PAGE, _INDEX_PAGE, _DICT_PAGE, _DATA_PAGE_V2 = 0, 1, 2, 3
+# encodings
+_PLAIN, _PLAIN_DICT, _RLE, _BITPACK_DEP, _DELTA = 0, 2, 3, 4, 5
+_RLE_DICT = 8
+
+
+def _parse_page_header(buf: bytes, pos: int):
+    t = _Thrift(buf, pos)
+    s = t.read_struct()
+    return {
+        "type": s.get(1),
+        "uncompressed_size": s.get(2),
+        "compressed_size": s.get(3),
+        "data_v1": s.get(5),
+        "dict": s.get(7),
+        "data_v2": s.get(8),
+    }, t.pos
+
+
+# --------------------------------------------------------------------------
+# RLE / bit-packed hybrid (host: run headers; device: heavy unpacking)
+# --------------------------------------------------------------------------
+
+def _rle_segments(buf: bytes, bit_width: int, num_values: int):
+    """Scan the hybrid run structure -> [("rle", count, value) |
+    ("bp", count, byte_off, byte_len)]; positions only, no unpacking."""
+    segs = []
+    t = _Thrift(buf)
+    got = 0
+    vw = (bit_width + 7) // 8
+    while got < num_values:
+        header = t.varint()
+        if header & 1:  # bit-packed: groups of 8 values
+            groups = header >> 1
+            count = groups * 8
+            blen = groups * bit_width
+            segs.append(("bp", min(count, num_values - got), t.pos, blen))
+            t.pos += blen
+        else:
+            count = header >> 1
+            value = int.from_bytes(t.buf[t.pos:t.pos + vw], "little") \
+                if vw else 0
+            t.pos += vw
+            segs.append(("rle", min(count, num_values - got), value))
+        if count == 0:
+            # malformed zero-length run would spin forever; surface it as
+            # an unsupported shape so the caller falls back to pyarrow
+            raise DeviceDecodeUnsupported("zero-length RLE run")
+        got += count
+    return segs
+
+
+def _decode_levels(buf: bytes, bit_width: int, num_values: int) -> np.ndarray:
+    """Definition/repetition levels on the host (1-2 bits/row control
+    plane).  Returns int32[num_values]."""
+    out = np.zeros(num_values, dtype=np.int32)
+    off = 0
+    for seg in _rle_segments(buf, bit_width, num_values):
+        if seg[0] == "rle":
+            _, count, value = seg
+            out[off:off + count] = value
+        else:
+            _, count, bo, blen = seg
+            bits = np.unpackbits(
+                np.frombuffer(buf, dtype=np.uint8, count=blen, offset=bo),
+                bitorder="little")
+            vals = bits.reshape(-1, bit_width)
+            weights = (1 << np.arange(bit_width)).astype(np.int32)
+            dec = (vals * weights).sum(axis=1).astype(np.int32)
+            out[off:off + count] = dec[:count]
+        off += count
+    return out
+
+
+# --------------------------------------------------------------------------
+# device kernels (shapes bucketed; cached via kernel_cache)
+# --------------------------------------------------------------------------
+
+def _pad_bytes(raw: bytes, to_len: int) -> np.ndarray:
+    a = np.frombuffer(raw, dtype=np.uint8)
+    if len(a) < to_len:
+        a = np.concatenate([a, np.zeros(to_len - len(a), dtype=np.uint8)])
+    return a
+
+
+def _plain_decode(raw: bytes, n_values: int, phys: str, cap: int):
+    """PLAIN fixed-width decode on device -> jnp array [cap] (tail garbage
+    beyond n_values; callers mask by validity)."""
+    import jax
+    itemsize = {"INT32": 4, "INT64": 8, "FLOAT": 4, "DOUBLE": 8}[phys]
+    nbytes = cap * itemsize
+    host = _pad_bytes(raw[:n_values * itemsize], nbytes)
+    backend = jax.default_backend()
+
+    def build():
+        def k(u8):
+            m = u8.reshape(cap, itemsize)
+            if itemsize == 4:
+                w32 = (m[:, 0].astype(jnp.uint32)
+                       | (m[:, 1].astype(jnp.uint32) << 8)
+                       | (m[:, 2].astype(jnp.uint32) << 16)
+                       | (m[:, 3].astype(jnp.uint32) << 24))
+                return jax.lax.bitcast_convert_type(
+                    w32, jnp.int32 if phys == "INT32" else jnp.float32)
+            w = jnp.zeros(cap, dtype=jnp.uint64)
+            for i in range(itemsize):
+                w = w | (m[:, i].astype(jnp.uint64) << jnp.uint64(8 * i))
+            if phys == "INT64":
+                return w.astype(jnp.int64)
+            # DOUBLE
+            if backend == "cpu":
+                return jax.lax.bitcast_convert_type(w, jnp.float64)
+            # TPU: no u64->f64 bitcast (f64 is emulated); rebuild from
+            # bit fields.  ldexp in emulated f64 keeps ~49 mantissa bits —
+            # the same documented precision envelope as every other f64 op
+            # on this backend.
+            sign = jnp.where((w >> jnp.uint64(63)) != 0, -1.0, 1.0)
+            exp = ((w >> jnp.uint64(52)) & jnp.uint64(0x7FF)).astype(
+                jnp.int32)
+            mant = (w & jnp.uint64((1 << 52) - 1)).astype(jnp.float64)
+            frac = mant * jnp.float64(2.0 ** -52)
+            normal = jnp.ldexp(1.0 + frac, exp - 1023)
+            subnor = jnp.ldexp(frac, -1022)
+            val = jnp.where(exp == 0, subnor, normal)
+            val = jnp.where(exp == 0x7FF,
+                            jnp.where(mant == 0, jnp.float64(np.inf),
+                                      jnp.float64(np.nan)), val)
+            return sign * val
+        return k
+
+    fn = cached_kernel(("pq_plain", phys, cap, backend), build)
+    return fn(host)
+
+
+def _plain_decode_bool(raw: bytes, n_values: int, cap: int):
+    """PLAIN boolean: LSB-first bitpacked."""
+    nbytes = (cap + 7) // 8
+    host = _pad_bytes(raw[:(n_values + 7) // 8], nbytes)
+
+    def build():
+        def k(u8):
+            idx = jnp.arange(cap, dtype=jnp.int32)
+            byte = jnp.take(u8, idx >> 3, mode="clip")
+            return ((byte >> (idx & 7).astype(jnp.uint8)) & 1).astype(
+                jnp.bool_)
+        return k
+
+    fn = cached_kernel(("pq_bool", cap), build)
+    return fn(host)
+
+
+def _bitpacked_unpack(buf: bytes, bit_width: int, count: int, cap: int):
+    """k-bit packed ints -> int32 [cap] on device (bw <= 24: each value's
+    bits live in <= 4 consecutive bytes)."""
+    if bit_width > 24:
+        raise DeviceDecodeUnsupported(f"index bit width {bit_width}")
+    nbytes = (cap * bit_width + 7) // 8 + 4
+    host = _pad_bytes(buf, nbytes)
+
+    def build():
+        def k(u8):
+            i = jnp.arange(cap, dtype=jnp.int32)
+            bitpos = i * bit_width
+            b0 = bitpos >> 3
+            sh = (bitpos & 7).astype(jnp.uint32)
+            w = (jnp.take(u8, b0, mode="clip").astype(jnp.uint32)
+                 | (jnp.take(u8, b0 + 1, mode="clip").astype(jnp.uint32)
+                    << 8)
+                 | (jnp.take(u8, b0 + 2, mode="clip").astype(jnp.uint32)
+                    << 16)
+                 | (jnp.take(u8, b0 + 3, mode="clip").astype(jnp.uint32)
+                    << 24))
+            return ((w >> sh) & jnp.uint32((1 << bit_width) - 1)).astype(
+                jnp.int32)
+        return k
+
+    fn = cached_kernel(("pq_bp", bit_width, cap), build)
+    return fn(host)
+
+
+def _copy_range(buf, vals, off: int, count: int):
+    """Masked range write: buf[off:off+count] = vals[:count], one compiled
+    kernel per (buf_cap, vals_cap, dtype).  Unlike dynamic_update_slice this
+    never clamps the start (a bucket-padded `vals` may be longer than the
+    space remaining in `buf`)."""
+
+    def build():
+        def k(b, v, o, c):
+            i = jnp.arange(b.shape[0], dtype=jnp.int32)
+            src = jnp.take(v, jnp.clip(i - o, 0, v.shape[0] - 1),
+                           mode="clip")
+            m = (i >= o) & (i < o + c)
+            return jnp.where(m, src, b)
+        return k
+
+    fn = cached_kernel(("pq_copy", buf.shape[0], vals.shape[0],
+                        str(buf.dtype)), build)
+    return fn(buf, vals, jnp.int32(off), jnp.int32(count))
+
+
+def _indices_decode(payload: bytes, n_values: int, cap: int):
+    """Dictionary-index stream: [1B bit width][hybrid runs] -> int32[cap].
+
+    Single bit-packed run (the common writer output for a full page):
+    device unpack kernel.  Multi-segment streams (alternating short runs)
+    materialize on the host instead — per-segment device kernels would be
+    O(segments * capacity), and the run structure is already host-parsed."""
+    if not payload:
+        raise DeviceDecodeUnsupported("empty index page")
+    bw = payload[0]
+    if bw == 0:
+        return jnp.zeros(cap, dtype=jnp.int32)
+    segs = _rle_segments(payload[1:], bw, n_values)
+    if len(segs) == 1 and segs[0][0] == "bp" and bw <= 24:
+        _, count, bo, blen = segs[0]
+        return _bitpacked_unpack(payload[1 + bo:1 + bo + blen], bw, count,
+                                 cap)
+    host = np.zeros(cap, dtype=np.int32)
+    off = 0
+    for seg in segs:
+        if seg[0] == "rle":
+            _, count, value = seg
+            host[off:off + count] = value
+        else:
+            _, count, bo, blen = seg
+            bits = np.unpackbits(
+                np.frombuffer(payload, dtype=np.uint8, count=blen,
+                              offset=1 + bo), bitorder="little")
+            need = count * bw
+            vals = bits[:max(need, 0)].reshape(-1, bw)[:count]
+            weights = (1 << np.arange(bw)).astype(np.int64)
+            host[off:off + count] = (vals * weights).sum(axis=1)
+        off += count
+    return jnp.asarray(host)
+
+
+# --------------------------------------------------------------------------
+# column chunk decode
+# --------------------------------------------------------------------------
+
+_PHYS_OK = {"INT32", "INT64", "FLOAT", "DOUBLE", "BOOLEAN"}
+
+
+def _decompress(codec: str, payload: bytes, uncompressed_size: int) -> bytes:
+    if codec == "UNCOMPRESSED":
+        return payload
+    import pyarrow as pa
+    try:
+        c = pa.Codec(codec.lower())
+    except Exception as ex:
+        raise DeviceDecodeUnsupported(f"codec {codec}: {ex}")
+    out = c.decompress(payload, uncompressed_size)
+    return out.to_pybytes() if hasattr(out, "to_pybytes") else bytes(out)
+
+
+def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
+                        num_rows: int, max_def: int, cap: int) -> Column:
+    """One row-group column chunk -> device Column with `cap` capacity.
+
+    Raises DeviceDecodeUnsupported for any page shape outside scope."""
+    if phys not in _PHYS_OK:
+        raise DeviceDecodeUnsupported(f"physical type {phys}")
+    encs = set(col_meta.encodings)
+    if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
+                    "BIT_PACKED"}:
+        raise DeviceDecodeUnsupported(f"encodings {encs}")
+    start = col_meta.dictionary_page_offset \
+        if col_meta.dictionary_page_offset is not None \
+        else col_meta.data_page_offset
+    with open(path, "rb") as f:
+        f.seek(start)
+        raw = f.read(col_meta.total_compressed_size)
+    codec = col_meta.compression
+
+    dict_values = None
+    def_levels: List[np.ndarray] = []
+    value_pieces: List[Tuple] = []   # ("plain"|"dict", payload, n_nonnull)
+    pos = 0
+    rows_seen = 0
+    while rows_seen < num_rows and pos < len(raw):
+        header, pos = _parse_page_header(raw, pos)
+        payload = raw[pos:pos + header["compressed_size"]]
+        pos += header["compressed_size"]
+        ptype = header["type"]
+        if ptype == _DICT_PAGE:
+            info = header["dict"] or {}
+            n_dict = info.get(1, 0)
+            data = _decompress(codec, payload, header["uncompressed_size"])
+            if phys == "BOOLEAN":
+                raise DeviceDecodeUnsupported("boolean dictionary")
+            dict_values = _plain_decode(data, n_dict, phys,
+                                        bucket_rows(max(n_dict, 1)))
+            continue
+        if ptype == _DATA_PAGE:
+            info = header["data_v1"]
+            n_vals = info.get(1)
+            enc = info.get(2)
+            dl_enc = info.get(3)
+            data = _decompress(codec, payload, header["uncompressed_size"])
+            dpos = 0
+            if max_def > 0:
+                if dl_enc != _RLE:
+                    raise DeviceDecodeUnsupported("def level encoding")
+                ln = struct.unpack_from("<i", data, dpos)[0]
+                dpos += 4
+                dl = _decode_levels(data[dpos:dpos + ln],
+                                    max(max_def.bit_length(), 1), n_vals)
+                dpos += ln
+            else:
+                dl = np.full(n_vals, 0, dtype=np.int32)
+        elif ptype == _DATA_PAGE_V2:
+            info = header["data_v2"]
+            n_vals = info.get(1)
+            enc = info.get(4)
+            dl_len = info.get(5, 0)
+            rl_len = info.get(6, 0)
+            compressed_flag = info.get(7, True)
+            if rl_len:
+                raise DeviceDecodeUnsupported("repetition levels")
+            lv = payload[:dl_len]
+            body = payload[dl_len:]
+            if compressed_flag:
+                body = _decompress(
+                    codec, body,
+                    header["uncompressed_size"] - dl_len - rl_len)
+            if max_def > 0 and dl_len:
+                dl = _decode_levels(lv, max(max_def.bit_length(), 1),
+                                    n_vals)
+            else:
+                dl = np.full(n_vals, 0, dtype=np.int32)
+            data = body
+            dpos = 0
+        elif ptype == _INDEX_PAGE:
+            continue
+        else:
+            raise DeviceDecodeUnsupported(f"page type {ptype}")
+
+        nonnull = int((dl == max_def).sum()) if max_def > 0 else len(dl)
+        def_levels.append((dl == max_def) if max_def > 0
+                          else np.ones(len(dl), dtype=bool))
+        if enc == _PLAIN:
+            value_pieces.append(("plain", data[dpos:], nonnull))
+        elif enc in (_RLE_DICT, _PLAIN_DICT):
+            value_pieces.append(("dict", data[dpos:], nonnull))
+        else:
+            raise DeviceDecodeUnsupported(f"value encoding {enc}")
+        rows_seen += n_vals
+
+    if rows_seen < num_rows:
+        raise DeviceDecodeUnsupported("pages cover fewer rows than chunk")
+
+    valid_np = np.concatenate(def_levels)[:num_rows] if def_levels \
+        else np.ones(0, dtype=bool)
+    total_nonnull = int(valid_np.sum())
+    vcap = bucket_rows(max(total_nonnull, 1))
+
+    # assemble compact (non-null) value array on device
+    if phys == "BOOLEAN":
+        compact = jnp.zeros(vcap, dtype=jnp.bool_)
+    else:
+        compact = jnp.zeros(vcap, dtype=dtype.jnp_dtype)
+    off = 0
+    for kind, payload, nonnull in value_pieces:
+        if nonnull == 0:
+            continue
+        pcap = bucket_rows(nonnull)
+        if kind == "plain":
+            if phys == "BOOLEAN":
+                piece = _plain_decode_bool(payload, nonnull, pcap)
+            else:
+                piece = _plain_decode(payload, nonnull, phys, pcap)
+                piece = piece.astype(dtype.jnp_dtype)
+        else:
+            if dict_values is None:
+                raise DeviceDecodeUnsupported("dict page missing")
+            idx = _indices_decode(payload, nonnull, pcap)
+            piece = jnp.take(dict_values, idx, mode="clip").astype(
+                dtype.jnp_dtype)
+        compact = _copy_range(compact, piece, off, nonnull)
+        off += nonnull
+
+    # expand to row positions: out[r] = compact[cumsum(valid)-1], no scatter
+    valid_host = np.zeros(cap, dtype=bool)
+    valid_host[:num_rows] = valid_np
+
+    def build_expand():
+        def k(compact_v, valid_v):
+            vi = jnp.cumsum(valid_v.astype(jnp.int32)) - 1
+            out = jnp.take(compact_v, jnp.clip(vi, 0, compact_v.shape[0] - 1),
+                           mode="clip")
+            return jnp.where(valid_v, out,
+                             jnp.zeros_like(out))
+        return k
+
+    fn = cached_kernel(("pq_expand", vcap, cap, str(compact.dtype)),
+                       build_expand)
+    data = fn(compact, valid_host)
+    return Column(data, jnp.asarray(valid_host), dtype)

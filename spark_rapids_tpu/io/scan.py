@@ -295,6 +295,47 @@ def _read_chunk(pf, chunk: List[int], columns, dump_prefix: str, seq: int):
     return table
 
 
+def _leaf_index_map(pf) -> dict:
+    """TOP-LEVEL flat column name -> LEAF column index.  Row-group chunk
+    metadata (and statistics) index the FLATTENED leaves, which diverge
+    from arrow's top-level field indices as soon as the file has a nested
+    column — mapping by leaf path keeps flat names correct and simply
+    omits nested leaves (paths with a dot)."""
+    out = {}
+    for i in range(len(pf.schema.names)):
+        path = pf.schema.column(i).path  # dotted for nested leaves
+        if "." not in path:
+            out[path] = i
+    return out
+
+
+def _parquet_chunks(pf, max_rows: int, max_bytes: int, predicates,
+                    name_to_leaf: dict, metrics):
+    """Group row groups into reader-limit-bounded chunks, skipping groups
+    whose statistics contradict the pushed predicates (shared by the host
+    and device decode paths; reference populateCurrentBlockChunk,
+    GpuParquetScan.scala:571)."""
+    chunk: List[int] = []
+    rows = bytes_ = 0
+    for rg in range(pf.metadata.num_row_groups):
+        meta = pf.metadata.row_group(rg)
+        if metrics is not None:
+            metrics.add("numRowGroups", 1)
+        if predicates and not _rg_can_match(meta, name_to_leaf, predicates):
+            if metrics is not None:
+                metrics.add("numRowGroupsSkipped", 1)
+            continue
+        if chunk and (rows + meta.num_rows > max_rows
+                      or bytes_ + meta.total_byte_size > max_bytes):
+            yield chunk
+            chunk, rows, bytes_ = [], 0, 0
+        chunk.append(rg)
+        rows += meta.num_rows
+        bytes_ += meta.total_byte_size
+    if chunk:
+        yield chunk
+
+
 def _iter_parquet(files, max_rows: int, max_bytes: int,
                   columns: Optional[List[str]] = None,
                   predicates=None, metrics=None, dump_prefix: str = ""):
@@ -306,42 +347,56 @@ def _iter_parquet(files, max_rows: int, max_bytes: int,
     dump_seq = 0
     for path in files:
         pf = pq.ParquetFile(path)
-        n_rg = pf.metadata.num_row_groups
-        if n_rg == 0:
+        if pf.metadata.num_row_groups == 0:
             continue
         file_names = set(pf.schema_arrow.names)
         cols = [c for c in columns if c in file_names] \
             if columns is not None else None
         if cols is not None and not cols:
             cols = None  # no requested column exists: schema evolution path
-        name_to_idx = {n: i for i, n in enumerate(pf.schema_arrow.names)}
-        chunk: List[int] = []
-        rows = bytes_ = 0
-        for rg in range(n_rg):
-            meta = pf.metadata.row_group(rg)
-            if metrics is not None:
-                metrics.add("numRowGroups", 1)
-            if predicates and not _rg_can_match(meta, name_to_idx,
-                                                predicates):
-                if metrics is not None:
-                    metrics.add("numRowGroupsSkipped", 1)
-                continue
-            if chunk and (rows + meta.num_rows > max_rows
-                          or bytes_ + meta.total_byte_size > max_bytes):
-                yield path, _read_chunk(pf, chunk, cols, dump_prefix,
-                                        dump_seq)
-                dump_seq += 1
-                chunk, rows, bytes_ = [], 0, 0
-            chunk.append(rg)
-            rows += meta.num_rows
-            bytes_ += meta.total_byte_size
-        if chunk:
+        for chunk in _parquet_chunks(pf, max_rows, max_bytes, predicates,
+                                     _leaf_index_map(pf), metrics):
             yield path, _read_chunk(pf, chunk, cols, dump_prefix, dump_seq)
             dump_seq += 1
 
 
+def _orc_stripe_can_match(stripe, predicates) -> bool:
+    """Predicate-column min/max vs pushed predicates.  pyarrow exposes no
+    stripe statistics in the footer, so the reader decodes the (narrow)
+    predicate columns FIRST and computes the bounds itself — dead stripes
+    then skip the decode of every remaining column (projection-first
+    pushdown; the reference instead rebuilds a hive SearchArgument,
+    OrcFilters.scala:1-194)."""
+    import pyarrow.compute as pc
+    for (name, op, value) in predicates:
+        if name not in stripe.schema.names:
+            continue
+        col = stripe.column(name)
+        if col.null_count == len(col):
+            continue
+        try:
+            mm = pc.min_max(col)
+            lo, hi = mm["min"].as_py(), mm["max"].as_py()
+            if lo is None or hi is None:
+                continue
+            if op == "EqualTo" and (value < lo or value > hi):
+                return False
+            if op == "LessThan" and not (lo < value):
+                return False
+            if op == "LessThanOrEqual" and not (lo <= value):
+                return False
+            if op == "GreaterThan" and not (hi > value):
+                return False
+            if op == "GreaterThanOrEqual" and not (hi >= value):
+                return False
+        except Exception:
+            continue  # incomparable literal vs file data: keep the stripe
+    return True
+
+
 def _iter_orc(files, max_rows: int, max_bytes: int,
-              columns: Optional[List[str]] = None):
+              columns: Optional[List[str]] = None, predicates=None,
+              metrics=None):
     """Stripe-granular ORC chunks (reference: GpuOrcScan.scala:247-711)."""
     from pyarrow import orc
     for path in files:
@@ -354,9 +409,22 @@ def _iter_orc(files, max_rows: int, max_bytes: int,
             if columns is not None else None
         if cols is not None and not cols:
             cols = None
+        pred_cols = None
+        if predicates:
+            pred_cols = [nm for (nm, _, _) in predicates
+                         if nm in file_names]
+            pred_cols = sorted(set(pred_cols)) or None
         chunk = []
         rows = bytes_ = 0
         for s in range(n):
+            if pred_cols:
+                probe = of.read_stripe(s, columns=pred_cols)
+                if metrics is not None:
+                    metrics.add("numStripes", 1)
+                if not _orc_stripe_can_match(probe, predicates):
+                    if metrics is not None:
+                        metrics.add("numStripesSkipped", 1)
+                    continue
             stripe = of.read_stripe(s, columns=cols)
             if chunk and (rows + stripe.num_rows > max_rows
                           or bytes_ + stripe.nbytes > max_bytes):
@@ -405,7 +473,9 @@ def _host_chunks(fmt: str, files, schema: Schema, options: dict,
                            metrics=metrics,
                            dump_prefix=conf.get(C.PARQUET_DEBUG_DUMP_PREFIX))
     elif fmt == "orc":
-        it = _iter_orc(files, max_rows, max_bytes, columns=file_cols)
+        it = _iter_orc(files, max_rows, max_bytes, columns=file_cols,
+                       predicates=options.get("__predicates__"),
+                       metrics=metrics)
     elif fmt == "csv":
         file_schema = Schema([f for f in schema
                               if f.name not in part_names])
@@ -429,6 +499,105 @@ def _host_chunks(fmt: str, files, schema: Schema, options: dict,
 # execs
 # --------------------------------------------------------------------------
 
+def _device_parquet_batches(files, schema: Schema, options: dict, conf,
+                            metrics) -> Iterator[ColumnarBatch]:
+    """Parquet chunks decoded on DEVICE column-by-column
+    (io/parquet_device.py); any column outside the device decoder's scope
+    (strings, exotic encodings) is read for the same row groups through
+    pyarrow and merged, so the fallback is column-granular.  Chunking,
+    predicate skipping and partition columns mirror _iter_parquet."""
+    import jax.numpy as jnp
+    import pyarrow.parquet as pq
+    from ..columnar import Column
+    from ..columnar.batch import bucket_rows
+    from .parquet_device import (DeviceDecodeUnsupported, _copy_range,
+                                 decode_column_chunk)
+
+    max_rows = min(conf.get(C.MAX_READER_BATCH_SIZE_ROWS), 1 << 20)
+    max_bytes = conf.get(C.MAX_READER_BATCH_SIZE_BYTES)
+    predicates = options.get("__predicates__")
+    partitions = options.get("__partitions__") or {}
+    part_names = {n for vals in partitions.values() for n in vals}
+
+    for path in files:
+        pf = pq.ParquetFile(path)
+        if pf.metadata.num_row_groups == 0:
+            continue
+        name_to_leaf = _leaf_index_map(pf)
+        pvals = partitions.get(path) or partitions.get(os.path.abspath(path))
+
+        for chunk in _parquet_chunks(pf, max_rows, max_bytes, predicates,
+                                     name_to_leaf, metrics):
+            num_rows = sum(pf.metadata.row_group(rg).num_rows
+                           for rg in chunk)
+            cap = bucket_rows(max(num_rows, 1))
+            out_cols: dict = {}
+            host_names: List[str] = []
+            for f in schema:
+                if f.name in part_names or f.name not in name_to_leaf:
+                    continue
+                ci = name_to_leaf[f.name]
+                max_def = pf.schema.column(ci).max_definition_level
+                try:
+                    if f.dtype.is_string:
+                        raise DeviceDecodeUnsupported("string column")
+                    data = valid = None
+                    off = 0
+                    for rg in chunk:
+                        rgm = pf.metadata.row_group(rg)
+                        col = decode_column_chunk(
+                            path, rgm.column(ci), rgm.column(ci).physical_type,
+                            f.dtype, rgm.num_rows, max_def,
+                            bucket_rows(max(rgm.num_rows, 1)))
+                        if data is None:
+                            data = jnp.zeros(cap, dtype=col.data.dtype)
+                            valid = jnp.zeros(cap, dtype=jnp.bool_)
+                        data = _copy_range(data, col.data, off, rgm.num_rows)
+                        valid = _copy_range(valid, col.valid, off,
+                                            rgm.num_rows)
+                        off += rgm.num_rows
+                    out_cols[f.name] = Column(data, valid, f.dtype)
+                    if metrics is not None:
+                        metrics.add("numDeviceDecodedColumns", 1)
+                except DeviceDecodeUnsupported:
+                    host_names.append(f.name)
+                except Exception:
+                    # the hand-rolled page/run parsers must never be able
+                    # to fail a query the pyarrow path could read: ANY
+                    # other error also falls back, column-granular
+                    if metrics is not None:
+                        metrics.add("numDeviceDecodeErrors", 1)
+                    host_names.append(f.name)
+            if host_names:
+                table = pf.read_row_groups(chunk, columns=host_names)
+                host_batch = ColumnarBatch.from_arrow(
+                    _evolve(table, Schema([schema.field(n)
+                                           for n in host_names])),
+                    capacity=cap)
+                for n, c in zip(host_names, host_batch.columns):
+                    out_cols[n] = c
+            # partition constants + schema evolution nulls
+            for f in schema:
+                if f.name in out_cols:
+                    continue
+                value = (pvals or {}).get(f.name) if f.name in part_names \
+                    else None
+                if f.dtype.is_string:
+                    out_cols[f.name] = Column.from_strings(
+                        [value] * num_rows, capacity=cap)
+                else:
+                    import numpy as _np
+                    vals = _np.zeros(num_rows, dtype=f.dtype.np_dtype) \
+                        if value is None else _np.full(
+                            num_rows, value, dtype=f.dtype.np_dtype)
+                    vd = _np.full(num_rows, value is not None, dtype=bool)
+                    out_cols[f.name] = Column.from_numpy(
+                        vals, vd, f.dtype, capacity=cap)
+            sel = jnp.arange(cap, dtype=jnp.int32) < num_rows
+            yield ColumnarBatch([out_cols[f.name] for f in schema], sel,
+                                schema)
+
+
 class TpuFileScanExec(TpuExec):
     """Device file scan (GpuFileSourceScanExec / GpuBatchScanExec
     equivalent): host footer-clipped columnar decode, one H2D per chunk."""
@@ -450,6 +619,20 @@ class TpuFileScanExec(TpuExec):
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         produced = False
+        if self.fmt == "parquet" \
+                and ctx.conf.get(C.PARQUET_DEVICE_DECODE) \
+                and not ctx.conf.get(C.PARQUET_DEBUG_DUMP_PREFIX):
+            for batch in _device_parquet_batches(
+                    self.files, self._schema, self.options, ctx.conf,
+                    self.metrics):
+                self.metrics.add("numOutputRows", batch.num_rows_host())
+                self.metrics.add("numOutputBatches", 1)
+                produced = True
+                yield batch
+            if not produced:
+                yield ColumnarBatch.from_pydict(
+                    {f.name: [] for f in self._schema}, self._schema)
+            return
         for table in _host_chunks(self.fmt, self.files, self._schema,
                                   self.options, ctx.conf, self.metrics):
             with self.metrics.timer("scanTime"):

@@ -59,6 +59,10 @@ class _WriterCore:
         self.metrics = metrics
         self.task_uuid = uuid.uuid4().hex[:12]
         self.file_seq = 0
+        # write-stats tracker state (reference:
+        # BasicColumnarWriteStatsTracker.scala — numFiles/numOutputRows/
+        # numOutputBytes via _write_one, numParts here)
+        self._parts_seen: set = set()
 
     def write(self, table):
         if not self.partition_by:
@@ -93,6 +97,10 @@ class _WriterCore:
             part = table.slice(start, i - start).select(data_cols)
             sub = "/".join(f"{c}={_part_dir_value(row[c])}"
                            for c in self.partition_by)
+            if sub not in self._parts_seen:
+                self._parts_seen.add(sub)
+                # BasicColumnarWriteStatsTracker.newPartition analogue
+                self.metrics.add("numParts", 1)
             self._write_one(part, os.path.join(self.path, sub))
             start = i
 

@@ -250,7 +250,12 @@ def _rewrite_scan(scan: LogicalScan, required: Optional[Set[str]],
             schema = Schema(keep)
     file_preds = [(n, op, v) for (n, op, v) in preds
                   if n in schema.names]
-    if scan.fmt == "parquet" and file_preds:
+    if scan.fmt in ("parquet", "orc") and file_preds:
+        # parquet: row groups skipped by footer statistics before any read;
+        # orc: pyarrow exposes no stripe statistics, so the reader decodes
+        # the (narrow) predicate columns first and skips the remaining
+        # columns of provably-dead stripes (io/scan.py _iter_orc; the
+        # reference builds a hive sarg instead, OrcFilters.scala:1-194)
         new_opts["__predicates__"] = file_preds
     if schema is scan.schema and "__predicates__" not in new_opts:
         return scan

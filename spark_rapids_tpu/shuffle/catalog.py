@@ -61,6 +61,10 @@ class ShuffleBufferCatalog:
         with self._lock:
             return shuffle_id in self._by_shuffle
 
+    def num_buffers(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._blocks.values())
+
 
 class ShuffleReceivedBufferCatalog:
     """Reader-side registry for buffers fetched from remote executors."""

@@ -2,50 +2,34 @@
 
 The TPU-native replacement for the reference's UCX/RDMA transport
 (shuffle-plugin/src/main/scala/.../shuffle/ucx/ — UCX.scala endpoint
-handshake, UCXShuffleTransport.scala bounce pools): when a plan runs
-SPMD over a `jax.sharding.Mesh`, a repartition-by-key is ONE
-`all_to_all`/`all_gather` over ICI inside the compiled program
-(parallel/distributed.py) — no control plane, no staging copies, and XLA
-overlaps it with compute.  Cross-slice (DCN) traffic takes the same
-collective path through XLA's DCN-aware lowering when the mesh spans
-slices.
+handshake, UCXShuffleTransport.scala bounce pools).  The SPMD exchange
+itself is NOT a method on this class: when a plan runs over a mesh, the
+planner's distribute pass (plan/transitions.py) compiles the repartition
+INTO the query program as an `all_to_all` over ICI
+(parallel/distributed.py exchange_compact / exchange_by_bucket, used by
+exec/distributed.py) — there is no control plane or staging copy for a
+transport object to manage, which is exactly the point of the design.
 
-Off-mesh (host-driven task mode, and unit tests), the block-fetch SPI falls
-back to the loopback wire, so one transport class serves both execution
-modes — this is the class named by the default
-`spark.rapids.shuffle.transport.class`.
+What remains here is the host-driven block-fetch SPI for off-mesh task
+mode and unit tests: the loopback wire, bounce-buffer pool, and throttle
+inherited from LoopbackTransport.  This is the class named by the default
+`spark.rapids.shuffle.transport.class`, so a deployment can swap in a
+DCN-aware transport by conf (reference: RapidsConf.scala:505-510
+shuffle.transport.classname) while mesh execution keeps riding ICI.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
-from ..columnar import Column, ColumnarBatch
 from .transport import LoopbackTransport
 
 
 class IciShuffleTransport(LoopbackTransport):
-    """Mesh-collective shuffle + loopback block SPI."""
+    """Block-fetch SPI for host-driven mode; mesh repartitions compile to
+    collectives instead of passing through a transport (module docstring)."""
 
     def __init__(self, mesh=None, axis: Optional[str] = None, **kw):
         super().__init__(**kw)
         from ..parallel.mesh import DATA_AXIS
         self.mesh = mesh
         self.axis = axis or DATA_AXIS
-
-    # ---- SPMD path: one collective, traced into the program ----------------
-
-    def exchange(self, batch: ColumnarBatch, bucket) -> ColumnarBatch:
-        """Inside shard_map: route live rows to their owner device.  See
-        parallel/distributed.exchange_by_bucket for the sel-mask trick that
-        keeps this static-shape."""
-        from ..parallel.distributed import exchange_by_bucket
-        return exchange_by_bucket(batch, bucket, self.axis)
-
-    def exchange_by_keys(self, batch: ColumnarBatch,
-                         key_cols: Sequence[Column]) -> ColumnarBatch:
-        """Inside shard_map: hash-repartition by key columns."""
-        import jax
-        from ..parallel.distributed import key_buckets
-        n = jax.lax.psum(1, self.axis)
-        bucket = key_buckets(list(key_cols), batch.sel, n)
-        return self.exchange(batch, bucket)

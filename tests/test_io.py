@@ -283,3 +283,25 @@ def test_partitioned_read_user_schema_includes_partition_col(tmp_path):
                      T.StructField("p", T.LongType)])
     rows = sorted(s.read.csv(out, schema=full, header=True).collect())
     assert rows == [(10.0, 1), (20.0, 2), (30.0, 1)], rows
+
+
+def test_write_stats_tracker_metrics(tmp_path):
+    """numFiles/numOutputRows/numOutputBytes/numParts recorded per write
+    (reference: BasicColumnarWriteStatsTracker.scala)."""
+    from spark_rapids_tpu.engine import TpuSession
+    s = TpuSession()
+    df = s.from_pydict({"p": [1, 1, 2, 2, 3], "v": [10, 20, 30, 40, 50]})
+    out = str(tmp_path / "o")
+    plan = df.write.partition_by("p")
+    # drive through the physical exec so metrics are observable
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.exec.base import ExecContext
+    physical = s.plan(L.LogicalWrite(out, "parquet", df.plan, {}, ["p"]))
+    ctx = ExecContext(s.conf, runtime=s.runtime)
+    for _ in physical.execute(ctx):
+        pass
+    m = physical.metrics.values
+    assert m.get("numParts") == 3, m
+    assert m.get("numFiles") == 3, m
+    assert m.get("numOutputRows") == 5, m
+    assert m.get("numOutputBytes", 0) > 0, m

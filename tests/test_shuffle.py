@@ -420,3 +420,75 @@ class TestAsyncFetch:
         assert_tpu_and_cpu_are_equal(q)
         assert_tpu_and_cpu_are_equal(
             q, conf={"spark.rapids.shuffle.asyncFetch.enabled": "false"})
+
+
+class TestTaskScopeCleanup:
+    """A query dying mid-shuffle-write must not orphan catalog buffers
+    (task-completion cleanup; reference GpuSemaphore.scala:27-161 task
+    listeners)."""
+
+    def test_failure_mid_write_releases_partitions(self):
+        import pytest as _pytest
+        from spark_rapids_tpu.engine import TpuSession
+        from spark_rapids_tpu.exec.base import ExecContext, TpuExec
+        from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+        from spark_rapids_tpu.ops import expressions as E
+        from spark_rapids_tpu.shuffle.manager import get_shuffle_env
+        from spark_rapids_tpu.types import LongType
+
+        s = TpuSession()
+        runtime = s.runtime
+        env = get_shuffle_env(runtime, s.conf)
+
+        class Boom(TpuExec):
+            @property
+            def schema(self):
+                from spark_rapids_tpu.types import Schema, StructField
+                return Schema([StructField("k", LongType)])
+
+            def describe(self):
+                return "Boom"
+
+            def execute(self, ctx):
+                yield make_batch(seed=1).select_columns([0])
+                raise MemoryError("mid-write death")
+
+        ex = TpuShuffleExchangeExec(
+            "hash", [E.BoundReference(0, LongType, "k")], 4, Boom())
+        ctx = ExecContext(s.conf, runtime=runtime)
+        with _pytest.raises(MemoryError):
+            for _ in ex.execute(ctx):
+                pass
+        assert env.catalog.num_buffers() > 0, \
+            "setup failed: the mid-write death left nothing to orphan"
+        ctx.run_cleanups()
+        assert env.catalog.num_buffers() == 0, "orphaned shuffle buffers"
+
+    def test_collect_failure_runs_cleanups(self):
+        """End-to-end: a failing expression mid-query leaves the shuffle
+        catalog empty after collect() raises."""
+        import pytest as _pytest
+        from spark_rapids_tpu.engine import TpuSession
+        from spark_rapids_tpu.plan.logical import col
+        from spark_rapids_tpu.shuffle.manager import get_shuffle_env
+
+        s = TpuSession({"spark.rapids.sql.tpu.join.partitioned.threshold":
+                        "0",
+                        "spark.sql.autoBroadcastJoinThreshold": "-1"})
+        a = s.from_pydict({"k": list(range(100))})
+        b = s.from_pydict({"k": list(range(100))})
+        df = a.join(b, on="k")
+        # sabotage: make the join's gather kernel die after the exchanges
+        # have written by monkeypatching concat (hit on the read path)
+        env = get_shuffle_env(s.runtime, s.conf)
+        orig = env.fetch_partition
+
+        def boom(*args, **kw):
+            raise MemoryError("fetch death")
+        env.fetch_partition = boom
+        try:
+            with _pytest.raises(MemoryError):
+                df.collect()
+        finally:
+            env.fetch_partition = orig
+        assert env.catalog.num_buffers() == 0, "orphaned shuffle buffers"

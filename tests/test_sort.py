@@ -126,3 +126,28 @@ def test_sort_fallback_disabled_conf():
         return df.order_by("a", "t")
     cpu, tpu = run_both(q, {"spark.rapids.sql.exec.SortExec": "false"})
     assert_rows_equal(cpu, tpu, ignore_order=False)
+
+
+def test_external_sort_range_partitioned():
+    """Inputs past the batch target sort via range exchange + per-partition
+    lexsort instead of one giant concat; output order must still be exact
+    (including nulls/NaN placement) and arrive as multiple batches."""
+    conf = {"spark.rapids.sql.reader.batchSizeRows": "256",
+            "spark.rapids.sql.batchSizeBytes": "8k"}
+
+    def q(s):
+        df = gen_df(s, seed=41, n=4000, a=T.IntegerType, b=T.DoubleType,
+                    c=T.StringType)
+        return df.order_by(col("a"), col("b").desc(), "c")
+    cpu, tpu = run_both(q, conf=conf)
+    assert_rows_equal(cpu, tpu, ignore_order=False, approx_float=True)
+
+    # the external path actually produced multiple output batches
+    from spark_rapids_tpu.engine import TpuSession
+    from spark_rapids_tpu.exec.base import ExecContext
+    s = TpuSession(conf)
+    df = q(s)
+    node = s.plan(df.plan)
+    nb = sum(1 for _ in node.execute(ExecContext(s.conf,
+                                                 runtime=s.runtime)))
+    assert nb > 1, "external sort did not partition"

@@ -46,3 +46,44 @@ def test_tpch_q6_value():
     want = float((price[m] * disc[m]).sum())
     got = run_query(6, {})[0][0]
     assert abs(got - want) < 1e-6 * max(1.0, abs(want))
+
+
+# ---- slow tier: SF0.05, small reader batches -------------------------------
+# lineitem (300k rows) spans >= 5 reader batches at 65536 rows/batch, so the
+# multi-batch merge/concat/coalesce and deferred-agg-merge paths run under
+# the flagship oracle (VERDICT round-2: SF0.002 fit one batch and never
+# exercised them).  Deselect with -m "not slow".
+
+_SLOW_SF = 0.05
+_SLOW_CONF = {"spark.rapids.sql.reader.batchSizeRows": "65536"}
+_slow_tables = {}
+
+
+def _slow_run(qnum: int, conf: dict):
+    key = tuple(sorted(conf.items()))
+    if key not in _slow_tables:
+        s = TpuSession(dict(conf))
+        _slow_tables[key] = (s, load_tables(s, sf=_SLOW_SF))
+    s, tables = _slow_tables[key]
+    return QUERIES[qnum](tables).collect()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qnum", [1, 3, 6, 12, 18])
+def test_tpch_slow_tier_multibatch(qnum):
+    cpu = _slow_run(qnum, {**_SLOW_CONF,
+                           "spark.rapids.sql.enabled": "false"})
+    tpu = _slow_run(qnum, dict(_SLOW_CONF))
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=True)
+
+
+@pytest.mark.slow
+def test_slow_tier_actually_multibatch():
+    """Guard: lineitem must span >= 4 reader batches in this tier."""
+    s = TpuSession(dict(_SLOW_CONF))
+    tables = load_tables(s, sf=_SLOW_SF)
+    node = s.plan(tables["lineitem"].plan)
+    from spark_rapids_tpu.exec.base import ExecContext
+    nb = sum(1 for _ in node.execute(ExecContext(s.conf,
+                                                 runtime=s.runtime)))
+    assert nb >= 4, nb
