@@ -170,6 +170,13 @@ MEMORY_SCAN_CACHE_ENABLED = _conf(
 MEMORY_SCAN_CACHE_SIZE = _conf(
     "spark.rapids.sql.tpu.memoryScanCache.maxSize", 4 << 30,
     "LRU byte bound on HBM held by the in-memory scan cache.", to_bytes)
+WHOLE_STAGE_ENABLED = _conf(
+    "spark.rapids.sql.tpu.wholeStage.enabled", True,
+    "Compile scan->rowLocal->aggregate stages over equal-capacity batches "
+    "into ONE device program (batches stacked on a leading dim, per-batch "
+    "work vmapped, partials merged in-program) — the TPU analogue of "
+    "whole-stage codegen; one dispatch instead of O(batches), which is "
+    "what high host-link latency punishes.", _to_bool)
 AGG_MERGE_FAN_IN = _conf(
     "spark.rapids.sql.tpu.agg.mergeFanIn", 8,
     "Number of per-batch partial aggregate states buffered before one "

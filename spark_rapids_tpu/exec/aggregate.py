@@ -588,8 +588,104 @@ class TpuHashAggregateExec(TpuExec):
                 tuple(a.output_name for a in self.aggregates),
                 schema_key(self._schema))
 
+    # ---- whole-stage path --------------------------------------------------
+
+    def _try_whole_stage(self, ctx: ExecContext):
+        """Scan -> row-local -> aggregate as ONE compiled dispatch (the TPU
+        analogue of Spark's whole-stage codegen): equal-capacity input
+        batches stack on a leading axis, the per-batch pre+update work is
+        vmapped, partials merge and finalize inside the same program.  On a
+        high-latency host link (tunneled dev TPU) this collapses
+        O(batches) kernel dispatches + host syncs into one.
+
+        Returns the result batch, or None when the stage shape doesn't
+        qualify (caller falls back to the streaming loop)."""
+        from .. import config as C
+        from ..utils.kernel_cache import cached_kernel
+        from .basic import RowLocalExec
+        if not ctx.conf.get(C.WHOLE_STAGE_ENABLED) or self._needs_offset():
+            return None, None
+        child = self.children[0]
+        if isinstance(child, RowLocalExec):
+            if child._needs_row_offset():
+                # the fused stage threads a per-batch row offset
+                # (monotonically_increasing_id / rand); vmapping it with
+                # offset 0 would silently repeat per-batch streams
+                return None, None
+            pre_builder = child.batch_fn
+            pre_key = child.kernel_key()
+            source = child.children[0]
+        else:
+            pre_builder = None
+            pre_key = ()
+            source = child
+        batches = list(source.execute(ctx))
+        if not batches:
+            return None, (source, batches)
+        cap = batches[0].capacity
+        # every LEAF must agree in shape (capacity alone misses string
+        # width buckets) and the whole stack must respect the batch byte
+        # target: stacking pins inputs + a same-size copy in one dispatch
+        shape0 = [tuple(x.shape) for x in
+                  jax.tree_util.tree_flatten(batches[0])[0]]
+        total_bytes = 0
+        for b in batches:
+            total_bytes += b.device_size_bytes()
+            if b.capacity != cap \
+                    or b.schema.names != batches[0].schema.names \
+                    or [tuple(x.shape) for x in
+                        jax.tree_util.tree_flatten(b)[0]] != shape0:
+                return None, (source, batches)
+        if total_bytes * 2 > ctx.conf.get(C.BATCH_SIZE_BYTES):
+            return None, (source, batches)
+        k = len(batches)
+        grouped = bool(self.grouping)
+        update = self._update_kernel if grouped else self._global_kernel
+        merge = self._merge_kernel
+        finalize = self._finalize_kernel
+        state_schema = self._state_schema
+
+        def build():
+            def whole(stacked: ColumnarBatch):
+                pre = pre_builder() if pre_builder is not None else None
+
+                def one(b):
+                    if pre is not None:
+                        b = pre(b)
+                    return update(b)
+                partials = jax.vmap(one)(stacked)   # leaves [k, pcap, ...]
+                # flatten the batch axis into one merge input
+                cols = []
+                for c in partials.columns:
+                    data = c.data.reshape((-1,) + c.data.shape[2:])
+                    valid = c.valid.reshape(-1)
+                    lengths = c.lengths.reshape(-1) \
+                        if c.lengths is not None else None
+                    cols.append(Column(data, valid, c.dtype, lengths))
+                sel = partials.sel.reshape(-1)
+                both = ColumnarBatch(cols, sel, state_schema)
+                return finalize(merge(both))
+            return whole
+
+        key = (("whole_stage", k, cap, pre_key) + self.kernel_key())
+        fn = cached_kernel(key, build)
+        flat0, treedef = jax.tree_util.tree_flatten(batches[0])
+        flats = [jax.tree_util.tree_flatten(b)[0] for b in batches]
+        stacked = jax.tree_util.tree_unflatten(
+            treedef, [jnp.stack([f[i] for f in flats])
+                      for i in range(len(flat0))])
+        with self.metrics.timer("computeAggTime"), \
+                named_range("agg_whole_stage"):
+            out = fn(stacked)
+        self.metrics.add("numOutputBatches", 1)
+        return out, None
+
     def execute(self, ctx: ExecContext):
         from ..utils.kernel_cache import cached_kernel
+        whole, materialized = self._try_whole_stage(ctx)
+        if whole is not None:
+            yield whole
+            return
         grouped = bool(self.grouping)
         base_update = (self._update_kernel if grouped
                        else self._global_kernel)
@@ -626,10 +722,26 @@ class TpuHashAggregateExec(TpuExec):
                     named_range("agg_merge"):
                 return merge(both)
 
+        # if the whole-stage probe already drained the source, stream the
+        # materialized batches through the child's per-batch kernel instead
+        # of re-executing the scan (it would double I/O and decode work)
+        if materialized is not None:
+            from .basic import RowLocalExec
+            src_exec, src_batches = materialized
+            child = self.children[0]
+            if isinstance(child, RowLocalExec) \
+                    and src_exec is child.children[0]:
+                child_fn = cached_kernel(child.kernel_key(), child.batch_fn)
+                input_iter = (child_fn(b) for b in src_batches)
+            else:
+                input_iter = iter(src_batches)
+        else:
+            input_iter = self.children[0].execute(ctx)
+
         state = None
         pending: list = []
         offset = 0
-        for batch in self.children[0].execute(ctx):
+        for batch in input_iter:
             with self.metrics.timer("computeAggTime"), \
                     named_range("agg_update"):
                 partial = update(batch, jnp.int64(offset)) if needs_off \

@@ -295,21 +295,24 @@ def _bitpacked_unpack(buf: bytes, bit_width: int, count: int, cap: int):
 
 
 def _copy_range(buf, vals, off: int, count: int):
-    """Masked range write: buf[off:off+count] = vals[:count], one compiled
-    kernel per (buf_cap, vals_cap, dtype).  Unlike dynamic_update_slice this
-    never clamps the start (a bucket-padded `vals` may be longer than the
-    space remaining in `buf`)."""
+    """Masked range write on the leading axis: buf[off:off+count] =
+    vals[:count], one compiled kernel per (buf_shape, vals_shape, dtype).
+    Unlike dynamic_update_slice this never clamps the start (a
+    bucket-padded `vals` may be longer than the space remaining in
+    `buf`)."""
 
     def build():
         def k(b, v, o, c):
             i = jnp.arange(b.shape[0], dtype=jnp.int32)
             src = jnp.take(v, jnp.clip(i - o, 0, v.shape[0] - 1),
-                           mode="clip")
+                           mode="clip", axis=0)
             m = (i >= o) & (i < o + c)
+            if b.ndim > 1:
+                m = m.reshape((-1,) + (1,) * (b.ndim - 1))
             return jnp.where(m, src, b)
         return k
 
-    fn = cached_kernel(("pq_copy", buf.shape[0], vals.shape[0],
+    fn = cached_kernel(("pq_copy", buf.shape, vals.shape,
                         str(buf.dtype)), build)
     return fn(buf, vals, jnp.int32(off), jnp.int32(count))
 
@@ -354,7 +357,33 @@ def _indices_decode(payload: bytes, n_values: int, cap: int):
 # column chunk decode
 # --------------------------------------------------------------------------
 
-_PHYS_OK = {"INT32", "INT64", "FLOAT", "DOUBLE", "BOOLEAN"}
+_PHYS_OK = {"INT32", "INT64", "FLOAT", "DOUBLE", "BOOLEAN", "BYTE_ARRAY"}
+
+
+def _parse_byte_array_dict(data: bytes, n: int):
+    """PLAIN byte_array dictionary page -> (byte matrix [n_cap, L],
+    lengths [n_cap]) as numpy.  The dictionary is the SMALL side of a
+    dictionary-encoded column (distinct values only) — host parsing it is
+    control-plane work; the per-row index decode and gather stay on
+    device."""
+    from ..columnar.column import bucket_strlen
+    vals = []
+    pos = 0
+    for _ in range(n):
+        if pos + 4 > len(data):
+            raise DeviceDecodeUnsupported("truncated dictionary page")
+        ln = int.from_bytes(data[pos:pos + 4], "little")
+        pos += 4
+        vals.append(data[pos:pos + ln])
+        pos += ln
+    n_cap = bucket_rows(max(n, 1))
+    L = bucket_strlen(max((len(v) for v in vals), default=1) or 1)
+    mat = np.zeros((n_cap, L), dtype=np.uint8)
+    lens = np.zeros(n_cap, dtype=np.int32)
+    for i, v in enumerate(vals):
+        mat[i, :len(v)] = np.frombuffer(v, dtype=np.uint8)
+        lens[i] = len(v)
+    return mat, lens
 
 
 def _decompress(codec: str, payload: bytes, uncompressed_size: int) -> bytes:
@@ -404,8 +433,12 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
             data = _decompress(codec, payload, header["uncompressed_size"])
             if phys == "BOOLEAN":
                 raise DeviceDecodeUnsupported("boolean dictionary")
-            dict_values = _plain_decode(data, n_dict, phys,
-                                        bucket_rows(max(n_dict, 1)))
+            if phys == "BYTE_ARRAY":
+                mat, lens = _parse_byte_array_dict(data, n_dict)
+                dict_values = (jnp.asarray(mat), jnp.asarray(lens))
+            else:
+                dict_values = _plain_decode(data, n_dict, phys,
+                                            bucket_rows(max(n_dict, 1)))
             continue
         if ptype == _DATA_PAGE:
             info = header["data_v1"]
@@ -469,6 +502,45 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
         else np.ones(0, dtype=bool)
     total_nonnull = int(valid_np.sum())
     vcap = bucket_rows(max(total_nonnull, 1))
+    valid_host = np.zeros(cap, dtype=bool)
+    valid_host[:num_rows] = valid_np
+
+    if phys == "BYTE_ARRAY":
+        # dictionary-encoded strings only: PLAIN byte_array needs a
+        # sequential host offset walk over the full payload — that IS the
+        # pyarrow fallback, so don't duplicate it here
+        if not dtype.is_string:
+            raise DeviceDecodeUnsupported("byte_array into non-string")
+        if any(kind != "dict" for kind, _, _ in value_pieces):
+            raise DeviceDecodeUnsupported("plain byte_array page")
+        if dict_values is None:
+            raise DeviceDecodeUnsupported("dict page missing")
+        dmat, dlens = dict_values
+        cidx = jnp.zeros(vcap, dtype=jnp.int32)
+        off = 0
+        for _kind, payload, nonnull in value_pieces:
+            if nonnull == 0:
+                continue
+            idx = _indices_decode(payload, nonnull, bucket_rows(nonnull))
+            cidx = _copy_range(cidx, idx, off, nonnull)
+            off += nonnull
+
+        def build_sexpand():
+            def k(di, dm, dln, valid_v):
+                vi = jnp.cumsum(valid_v.astype(jnp.int32)) - 1
+                row_idx = jnp.take(di, jnp.clip(vi, 0, di.shape[0] - 1),
+                                   mode="clip")
+                data2 = jnp.take(dm, row_idx, axis=0, mode="clip")
+                lens2 = jnp.take(dln, row_idx, mode="clip")
+                data2 = jnp.where(valid_v[:, None], data2, 0)
+                lens2 = jnp.where(valid_v, lens2, 0)
+                return data2, lens2
+            return k
+
+        fn = cached_kernel(("pq_sexpand", vcap, cap, dmat.shape),
+                           build_sexpand)
+        data2, lens2 = fn(cidx, dmat, dlens, valid_host)
+        return Column(data2, jnp.asarray(valid_host), dtype, lens2)
 
     # assemble compact (non-null) value array on device
     if phys == "BOOLEAN":
@@ -496,9 +568,6 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
         off += nonnull
 
     # expand to row positions: out[r] = compact[cumsum(valid)-1], no scatter
-    valid_host = np.zeros(cap, dtype=bool)
-    valid_host[:num_rows] = valid_np
-
     def build_expand():
         def k(compact_v, valid_v):
             vi = jnp.cumsum(valid_v.astype(jnp.int32)) - 1

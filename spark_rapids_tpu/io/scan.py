@@ -539,24 +539,34 @@ def _device_parquet_batches(files, schema: Schema, options: dict, conf,
                 ci = name_to_leaf[f.name]
                 max_def = pf.schema.column(ci).max_definition_level
                 try:
-                    if f.dtype.is_string:
-                        raise DeviceDecodeUnsupported("string column")
-                    data = valid = None
-                    off = 0
+                    rg_cols = []
                     for rg in chunk:
                         rgm = pf.metadata.row_group(rg)
-                        col = decode_column_chunk(
+                        rg_cols.append((decode_column_chunk(
                             path, rgm.column(ci), rgm.column(ci).physical_type,
                             f.dtype, rgm.num_rows, max_def,
-                            bucket_rows(max(rgm.num_rows, 1)))
-                        if data is None:
-                            data = jnp.zeros(cap, dtype=col.data.dtype)
-                            valid = jnp.zeros(cap, dtype=jnp.bool_)
-                        data = _copy_range(data, col.data, off, rgm.num_rows)
-                        valid = _copy_range(valid, col.valid, off,
-                                            rgm.num_rows)
-                        off += rgm.num_rows
-                    out_cols[f.name] = Column(data, valid, f.dtype)
+                            bucket_rows(max(rgm.num_rows, 1))),
+                            rgm.num_rows))
+                    if f.dtype.is_string:
+                        width = max(c.max_len for c, _ in rg_cols)
+                        rg_cols = [(c.pad_strings_to(width), nr)
+                                   for c, nr in rg_cols]
+                        data = jnp.zeros((cap, width), dtype=jnp.uint8)
+                        lengths = jnp.zeros(cap, dtype=jnp.int32)
+                    else:
+                        data = jnp.zeros(cap,
+                                         dtype=rg_cols[0][0].data.dtype)
+                        lengths = None
+                    valid = jnp.zeros(cap, dtype=jnp.bool_)
+                    off = 0
+                    for col, nr in rg_cols:
+                        data = _copy_range(data, col.data, off, nr)
+                        valid = _copy_range(valid, col.valid, off, nr)
+                        if lengths is not None:
+                            lengths = _copy_range(lengths, col.lengths,
+                                                  off, nr)
+                        off += nr
+                    out_cols[f.name] = Column(data, valid, f.dtype, lengths)
                     if metrics is not None:
                         metrics.add("numDeviceDecodedColumns", 1)
                 except DeviceDecodeUnsupported:

@@ -56,6 +56,11 @@ def fresh_buffer_id() -> int:
         return _next_id[0]
 
 
+# layouts whose contiguous pack failed once (batch_to_host falls back to
+# per-leaf transfers for them without re-tracing the broken kernel)
+_contig_failed_layouts: set = set()
+
+
 @dataclass
 class ColumnLeafMeta:
     """Layout of one column's leaves inside the flat disk image."""
@@ -77,21 +82,49 @@ class BatchMeta:
 
 
 def batch_to_host(batch: ColumnarBatch) -> Tuple[List[np.ndarray], BatchMeta]:
-    """D2H: pull every leaf down as numpy (the spill copy)."""
+    """D2H: pull the batch down as numpy (the spill copy).
+
+    The transfer is CONTIGUOUS: one device pack kernel + ONE device->host
+    move of a single buffer, then host-side views slice the leaves back out
+    (columnar/contiguous.py; reference GpuColumnVectorFromBuffer carves
+    columns from one allocation for the same reason).  Falls back to
+    per-leaf pulls if packing is unsupported for a dtype/backend combo."""
     import jax
+    from ..columnar.contiguous import _layout_key, contiguous_to_host
+    key = _layout_key(batch)
+    flat_leaves = None
+    if key not in _contig_failed_layouts:
+        try:
+            flat_leaves, _cmeta = contiguous_to_host(batch)
+        except Exception as ex:
+            # latch per layout: re-attempting the failed pack would pay the
+            # trace again on every spill, silently
+            _contig_failed_layouts.add(key)
+            import warnings
+            warnings.warn(f"contiguous D2H pack failed for layout "
+                          f"{key!r} ({ex!r}); falling back to per-leaf "
+                          "transfers for this layout")
+    if flat_leaves is None:
+        flat_leaves = []
+        for c in batch.columns:
+            flat_leaves.append(np.asarray(jax.device_get(c.data)))
+            flat_leaves.append(np.asarray(jax.device_get(c.valid)))
+            if c.lengths is not None:
+                flat_leaves.append(np.asarray(jax.device_get(c.lengths)))
+        flat_leaves.append(np.asarray(jax.device_get(batch.sel)))
     leaves: List[np.ndarray] = []
     leaf_meta: List[ColumnLeafMeta] = []
+    i = 0
     for c in batch.columns:
-        arrs = [np.asarray(jax.device_get(c.data)),
-                np.asarray(jax.device_get(c.valid))]
-        if c.lengths is not None:
-            arrs.append(np.asarray(jax.device_get(c.lengths)))
+        n = 3 if c.lengths is not None else 2
+        arrs = flat_leaves[i:i + n]
+        i += n
         leaves.extend(arrs)
         leaf_meta.append(ColumnLeafMeta(
             c.dtype.name,
             [a.shape for a in arrs],
             [a.dtype.str for a in arrs]))
-    sel = np.asarray(jax.device_get(batch.sel))
+    sel = flat_leaves[i]
     leaves.append(sel)
     meta = BatchMeta(batch.schema, batch.capacity, leaf_meta, sel.shape,
                      sum(a.nbytes for a in leaves))

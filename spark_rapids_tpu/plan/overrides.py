@@ -134,6 +134,13 @@ def _tag_device_supported(meta: "ExprMeta", conf: TpuConf):
 for _n in ("InitCap Reverse Ascii Cot Hypot Logarithm Least Greatest "
            "Murmur3Hash AddMonths MonthsBetween").split():
     _EXPR_RULES[_n] = None
+# window functions: resolved via ops/windows.resolve_window_func (not the
+# Expression tree), but registered here so the per-op kill-switch conf
+# surface matches the reference's window rule table (GpuOverrides window
+# expressions; the conf check runs in plan/tagging._tag_window)
+for _n in ("RowNumber Rank DenseRank Lag Lead WindowExpression "
+           "WindowSpecDefinition SpecifiedWindowFrame").split():
+    _EXPR_RULES[_n] = None
 for _n in ("StringLPad StringRPad StringRepeat SubstringIndex "
            "RegExpReplace Round BRound TruncDate NextDay").split():
     _EXPR_RULES[_n] = _tag_device_supported

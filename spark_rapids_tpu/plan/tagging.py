@@ -14,7 +14,7 @@ from ..ops.aggregates import AggregateExpression
 from ..types import StringType
 from . import logical as L
 from .analysis import resolve
-from .overrides import ExprMeta, PlanMeta, plan_schema
+from .overrides import ExprMeta, PlanMeta, expr_conf_key, plan_schema
 
 _TPU_JOIN_TYPES = {"inner", "left", "left_outer", "left_semi", "left_anti"}
 
@@ -260,6 +260,14 @@ def _tag_window(meta: PlanMeta):
 
     try:
         meta.resolved["funcs"] = _resolve_funcs(device=True)
+        # per-op kill-switch conf parity with the reference's window rules
+        # (spark.rapids.sql.expr.RowNumber etc.; GpuOverrides window
+        # expression table)
+        for f in meta.resolved["funcs"]:
+            if not meta.conf.is_op_enabled(expr_conf_key(f.kind)):
+                meta.will_not_work(
+                    f"window function {f.kind} has been disabled; set "
+                    f"{expr_conf_key(f.kind)}=true to enable")
     except WindowUnsupported as e:
         meta.will_not_work(f"window: {e}")
         meta.resolved["funcs"] = _resolve_funcs(device=False)

@@ -213,3 +213,97 @@ def test_agg_deferred_merge_fan_in_variants():
                 f.first(col("v")).alias("fst"),
                 f.last(col("v")).alias("lst"))
         assert_tpu_and_cpu_are_equal(q, conf=conf)
+
+
+def test_whole_stage_single_dispatch_agg():
+    """Whole-stage path: multi-batch scan -> fused filter/project ->
+    aggregate matches the streaming loop (conf off) exactly."""
+    conf_on = {"spark.rapids.sql.reader.batchSizeRows": "128"}
+    conf_off = {**conf_on, "spark.rapids.sql.tpu.wholeStage.enabled":
+                "false"}
+
+    def q(s):
+        df = gen_df(s, seed=71, n=1000, k=T.IntegerType, v=T.LongType)
+        return (df.filter(col("v") % 2 == 0)
+                .select(col("k"), (col("v") * 3).alias("w"))
+                .group_by("k").agg(f.sum(col("w")).alias("s"),
+                                   f.count(lit(1)).alias("c"),
+                                   f.max(col("w")).alias("mx")))
+    a = assert_tpu_and_cpu_are_equal(q, conf=conf_on)
+    b = assert_tpu_and_cpu_are_equal(q, conf=conf_off)
+    assert sorted(a, key=repr) == sorted(b, key=repr)
+
+
+def test_whole_stage_global_agg():
+    conf = {"spark.rapids.sql.reader.batchSizeRows": "64"}
+
+    def q(s):
+        df = gen_df(s, seed=72, n=700, v=T.LongType)
+        return df.agg(f.sum(col("v")).alias("s"),
+                      f.min(col("v")).alias("mn"))
+    assert_tpu_and_cpu_are_equal(q, conf=conf)
+
+
+def test_whole_stage_unequal_batches_fall_back():
+    """A trailing short batch (different capacity bucket) must take the
+    streaming path and still be correct."""
+    conf = {"spark.rapids.sql.reader.batchSizeRows": "600"}
+
+    def q(s):
+        # 1000 rows -> batches of 600 (cap 1024) and 400 (cap 512)
+        df = gen_df(s, seed=73, n=1000, k=T.IntegerType, v=T.LongType)
+        return df.group_by("k").agg(f.count(lit(1)).alias("c"))
+    assert_tpu_and_cpu_are_equal(q, conf=conf)
+
+
+def test_whole_stage_monotonic_id_correct():
+    """Row-offset expressions must NOT take the whole-stage path (vmapped
+    offset-0 would repeat per-batch id streams; review regression)."""
+    conf = {"spark.rapids.sql.reader.batchSizeRows": "128"}
+
+    def q(s):
+        df = gen_df(s, seed=74, n=256, v=T.LongType)
+        return (df.select(f.monotonically_increasing_id().alias("id"))
+                .agg(f.max(col("id")).alias("mx"),
+                     f.count(col("id")).alias("c")))
+    rows = assert_tpu_and_cpu_are_equal(q, conf=conf)
+    assert rows[0] == (255, 256), rows
+
+
+def test_whole_stage_mixed_string_widths_fall_back():
+    """Equal capacities but different string width buckets must stream,
+    not crash at jnp.stack (review regression)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.engine import TpuSession
+    conf = {"spark.rapids.sql.reader.batchSizeRows": "128"}
+
+    def q(s):
+        t = pa.table({"s": ["ab"] * 128 + ["x" * 40] * 128,
+                      "v": list(range(256))})
+        return (s.from_arrow(t).group_by("s")
+                .agg(f.sum(col("v")).alias("sv")))
+    assert_tpu_and_cpu_are_equal(q, conf=conf)
+
+
+def test_whole_stage_fallback_does_not_rescan():
+    """When the probe bails (unequal caps) the scan must not re-execute
+    (review: double I/O)."""
+    from spark_rapids_tpu.engine import TpuSession
+    from spark_rapids_tpu.exec.base import ExecContext
+    s = TpuSession({"spark.rapids.sql.reader.batchSizeRows": "600"})
+    df = gen_df(s, seed=75, n=1000, k=T.IntegerType, v=T.LongType)
+    q = df.group_by("k").agg(f.count(lit(1)).alias("c"))
+    node = s.plan(q.plan)
+
+    def find_scan(n):
+        if type(n).__name__ == "TpuScanMemoryExec":
+            return n
+        for c in n.children:
+            r = find_scan(c)
+            if r:
+                return r
+    scan = find_scan(node)
+    list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
+    # 1000 rows in 600-row batches = 2 scan output batches, counted ONCE
+    assert scan.metrics.values.get("numOutputBatches") == 2, \
+        scan.metrics.values

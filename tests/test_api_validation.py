@@ -83,3 +83,13 @@ def test_conf_doc_generation_contains_all_public_keys():
     for e in C.registered_entries():
         if not e.internal:
             assert e.key in doc, f"{e.key} missing from generated docs"
+
+
+def test_discovery_resource_information():
+    """Discovery plugin analogue emits Spark's ResourceInformation shape
+    (reference: ExclusiveModeGpuDiscoveryPlugin)."""
+    from spark_rapids_tpu.discovery import resource_information
+    info = resource_information("cpu")
+    assert info["name"] == "tpu"
+    assert len(info["addresses"]) == 8  # virtual mesh in the test env
+    assert all(isinstance(a, str) for a in info["addresses"])

@@ -1,0 +1,77 @@
+"""Contiguous-buffer batches (columnar/contiguous.py): pack a whole batch
+into ONE device buffer and back (GpuColumnVectorFromBuffer analogue)."""
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from spark_rapids_tpu import types as T  # noqa: E402
+from spark_rapids_tpu.columnar import ColumnarBatch  # noqa: E402
+from compare import assert_rows_equal  # noqa: E402
+from spark_rapids_tpu.columnar.contiguous import (contiguous_to_host,  # noqa: E402
+                                                  pack_batch,
+                                                  unpack_batch)
+
+
+def _mixed_batch(n=500, seed=3):
+    rng = np.random.RandomState(seed)
+    schema = T.Schema([
+        T.StructField("i", T.IntegerType), T.StructField("l", T.LongType),
+        T.StructField("d", T.DoubleType), T.StructField("f", T.FloatType),
+        T.StructField("b", T.BooleanType), T.StructField("s", T.StringType),
+        T.StructField("dt", T.DateType),
+    ])
+    data = {
+        "i": [None if i % 11 == 0 else int(x) for i, x in
+              enumerate(rng.randint(-2**31, 2**31 - 1, n))],
+        "l": rng.randint(-2**62, 2**62, n).tolist(),
+        "d": [float("nan") if i % 13 == 0 else float(x) for i, x in
+              enumerate(rng.uniform(-1e6, 1e6, n))],
+        "f": [float(np.float32(x)) for x in rng.uniform(-10, 10, n)],
+        "b": (rng.rand(n) < 0.5).tolist(),
+        "s": [None if i % 7 == 0 else f"val{i}" for i in range(n)],
+        "dt": rng.randint(-10000, 10000, n).tolist(),
+    }
+    return ColumnarBatch.from_pydict(data, schema)
+
+
+def test_pack_unpack_roundtrip():
+    b = _mixed_batch()
+    cb = pack_batch(b)
+    assert cb.buffer.dtype == np.uint8 and cb.buffer.ndim == 1
+    assert cb.nbytes == cb.buffer.shape[0]
+    out = unpack_batch(cb)
+    assert_rows_equal(b.to_pylist(), out.to_pylist(), ignore_order=False,
+                      approx_float=True)
+
+
+def test_contiguous_to_host_matches_leaves():
+    import jax
+    b = _mixed_batch(seed=9)
+    leaves, meta = contiguous_to_host(b)
+    # leaf order: per column data/valid[,lengths], sel last
+    i = 0
+    for c in b.columns:
+        np.testing.assert_array_equal(leaves[i],
+                                      np.asarray(jax.device_get(c.data)))
+        np.testing.assert_array_equal(
+            leaves[i + 1], np.asarray(jax.device_get(c.valid)))
+        i += 2
+        if c.lengths is not None:
+            np.testing.assert_array_equal(
+                leaves[i], np.asarray(jax.device_get(c.lengths)))
+            i += 1
+    np.testing.assert_array_equal(leaves[i],
+                                  np.asarray(jax.device_get(b.sel)))
+
+
+def test_spill_roundtrip_through_contiguous(tmp_path):
+    """batch_to_host (now one contiguous D2H) + host_to_batch round trip."""
+    from spark_rapids_tpu.mem.buffer import batch_to_host, host_to_batch
+    b = _mixed_batch(seed=11)
+    leaves, meta = batch_to_host(b)
+    out = host_to_batch(leaves, meta)
+    assert_rows_equal(b.to_pylist(), out.to_pylist(), ignore_order=False,
+                      approx_float=True)

@@ -173,3 +173,35 @@ def test_nested_columns_do_not_misalign_leaves(tmp_path):
     rows = sorted(s.read.parquet(str(d)).select(col("a"), col("b"))
                   .collect())
     assert rows == [(1, 100), (2, 200), (3, 300), (4, 400), (5, 500)], rows
+
+
+def test_dict_string_decoded_on_device(tmp_path):
+    """Dictionary-encoded strings take the device path (dict parsed on
+    host, index decode + gather on device); PLAIN strings fall back."""
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(_table(n=2000, seed=5), p, compression="NONE",
+                   use_dictionary=True)
+    s = TpuSession()
+    node = s.plan(s.read.parquet(p).plan)
+    from spark_rapids_tpu.exec.base import ExecContext
+    list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
+
+    def find_scan(n):
+        if type(n).__name__ == "TpuFileScanExec":
+            return n
+        for c in n.children:
+            r = find_scan(c)
+            if r:
+                return r
+    scan = find_scan(node)
+    # all 7 columns (6 numeric/bool/date + the string) decoded on device
+    assert scan.metrics.values.get("numDeviceDecodedColumns", 0) >= 7
+
+
+def test_string_heavy_query_roundtrip(tmp_path):
+    def q(df):
+        return (df.filter(col("s").is_not_null())
+                .group_by("s").agg(f.count(col("i")).alias("c"))
+                .order_by("s"))
+    for wc in (WRITE_CONFS[1], WRITE_CONFS[2]):
+        _roundtrip(tmp_path, wc, _table(n=2500, seed=6), query=q)

@@ -303,3 +303,21 @@ def test_string_min_suffix_frame_falls_back():
         return s.from_pydict(data).select(
             col("o"), F.min(col("s")).over(w).alias("mn"))
     _check(q)
+
+
+def test_window_func_kill_switch():
+    """Per-op conf disables a window function like the reference's expr
+    kill-switches (spark.rapids.sql.expr.RowNumber=false -> CPU window)."""
+    from spark_rapids_tpu.engine import TpuSession
+    data = base_data(61)
+
+    def q(s):
+        w = Window.partitionBy(col("k")).orderBy(col("o"))
+        return s.from_pydict(data).select(
+            col("k"), col("o"), F.row_number().over(w).alias("rn"))
+    s = TpuSession({"spark.rapids.sql.expr.RowNumber": "false"})
+    text = s.explain_str(q(s).plan)
+    assert "RowNumber has been disabled" in text
+    # and it still answers via the CPU window exec, matching the oracle
+    assert_tpu_and_cpu_are_equal(
+        q, conf={"spark.rapids.sql.expr.RowNumber": "false"})
