@@ -183,6 +183,14 @@ AGG_MERGE_FAN_IN = _conf(
     "K-way concat+merge; larger values amortize merge-kernel dispatches "
     "and host syncs across more input batches.", int)
 
+CLUSTER_EXECUTORS = _conf(
+    "spark.rapids.sql.tpu.cluster.executors", 1,
+    "Host-mode executor count: each executor owns a runtime + shuffle env "
+    "on a shared transport wire; shuffle map tasks write to their "
+    "executor's catalog and reduce tasks fetch remote blocks through the "
+    "client/server path (plugin.py TpuCluster; reference: one plugin "
+    "executor per Spark executor).", int)
+
 # --- multi-chip / shuffle planning ------------------------------------------
 MESH_DEVICES = _conf(
     "spark.rapids.sql.tpu.mesh.devices", 0,

@@ -62,6 +62,18 @@ class TpuSession:
             self._runtime = TpuRuntime(self.conf)
         return self._runtime
 
+    @property
+    def cluster(self):
+        """Multi-executor host-mode cluster, or None (plugin.TpuCluster;
+        enabled by spark.rapids.sql.tpu.cluster.executors > 1)."""
+        if getattr(self, "_cluster", None) is None:
+            if int(self.conf.get(C.CLUSTER_EXECUTORS)) > 1:
+                from .plugin import TpuCluster
+                self._cluster = TpuCluster(self.conf)
+            else:
+                self._cluster = False  # resolved: disabled
+        return self._cluster or None
+
     def set(self, key: str, value) -> "TpuSession":
         self.conf.set(key, value)
         return self
@@ -309,7 +321,8 @@ class DataFrame:
         import pyarrow as pa
         physical = self.session.plan(self.plan)
         runtime = self.session.runtime
-        ctx = ExecContext(self.session.conf, runtime=runtime)
+        ctx = ExecContext(self.session.conf, runtime=runtime,
+                          cluster=self.session.cluster)
         try:
             if isinstance(physical, TpuExec):
                 physical = B.DeviceToHostExec(physical)
@@ -359,7 +372,8 @@ class DataFrame:
                 "columnar data")
         physical = self.session.plan(self.plan)
         runtime = self.session.runtime
-        ctx = ExecContext(self.session.conf, runtime=runtime)
+        ctx = ExecContext(self.session.conf, runtime=runtime,
+                          cluster=self.session.cluster)
         try:
             if isinstance(physical, TpuExec):
                 runtime.semaphore.acquire_if_necessary()
@@ -462,7 +476,8 @@ class DataFrameWriter:
                               self._partition_by)
         physical = self.df.session.plan(plan)
         runtime = self.df.session.runtime
-        ctx = ExecContext(self.df.session.conf, runtime=runtime)
+        ctx = ExecContext(self.df.session.conf, runtime=runtime,
+                          cluster=self.df.session.cluster)
         try:
             if isinstance(physical, TpuExec):
                 with runtime.semaphore.held():

@@ -619,25 +619,30 @@ class TpuHashAggregateExec(TpuExec):
             pre_builder = None
             pre_key = ()
             source = child
-        batches = list(source.execute(ctx))
-        if not batches:
-            return None, (source, batches)
-        cap = batches[0].capacity
-        # every LEAF must agree in shape (capacity alone misses string
-        # width buckets) and the whole stack must respect the batch byte
-        # target: stacking pins inputs + a same-size copy in one dispatch
-        shape0 = [tuple(x.shape) for x in
-                  jax.tree_util.tree_flatten(batches[0])[0]]
+        # drain INCREMENTALLY: eligibility (leaf shapes, byte budget) is
+        # checked per batch so an over-budget input bails to the streaming
+        # loop with the tail still unconsumed — the probe must never pin a
+        # bigger working set than whole-stage itself would use
+        src_iter = iter(source.execute(ctx))
+        batches: list = []
+        shape0 = None
+        cap = 0
+        byte_budget = ctx.conf.get(C.BATCH_SIZE_BYTES) // 2
         total_bytes = 0
-        for b in batches:
+        for b in src_iter:
+            shapes = [tuple(x.shape) for x in
+                      jax.tree_util.tree_flatten(b)[0]]
             total_bytes += b.device_size_bytes()
-            if b.capacity != cap \
+            if shape0 is None:
+                cap = b.capacity
+                shape0 = shapes
+            batches.append(b)
+            if shapes != shape0 \
                     or b.schema.names != batches[0].schema.names \
-                    or [tuple(x.shape) for x in
-                        jax.tree_util.tree_flatten(b)[0]] != shape0:
-                return None, (source, batches)
-        if total_bytes * 2 > ctx.conf.get(C.BATCH_SIZE_BYTES):
-            return None, (source, batches)
+                    or total_bytes > byte_budget:
+                return None, (source, batches, src_iter)
+        if not batches:
+            return None, (source, batches, src_iter)
         k = len(batches)
         grouped = bool(self.grouping)
         update = self._update_kernel if grouped else self._global_kernel
@@ -726,15 +731,17 @@ class TpuHashAggregateExec(TpuExec):
         # materialized batches through the child's per-batch kernel instead
         # of re-executing the scan (it would double I/O and decode work)
         if materialized is not None:
+            import itertools
             from .basic import RowLocalExec
-            src_exec, src_batches = materialized
+            src_exec, src_batches, src_rest = materialized
+            upstream = itertools.chain(src_batches, src_rest)
             child = self.children[0]
             if isinstance(child, RowLocalExec) \
                     and src_exec is child.children[0]:
                 child_fn = cached_kernel(child.kernel_key(), child.batch_fn)
-                input_iter = (child_fn(b) for b in src_batches)
+                input_iter = (child_fn(b) for b in upstream)
             else:
-                input_iter = iter(src_batches)
+                input_iter = upstream
         else:
             input_iter = self.children[0].execute(ctx)
 

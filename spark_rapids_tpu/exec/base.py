@@ -49,11 +49,12 @@ class ExecContext:
     """Per-query execution context: conf, partition id, runtime services."""
 
     def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
-                 num_partitions: int = 1, runtime=None):
+                 num_partitions: int = 1, runtime=None, cluster=None):
         self.conf = conf or TpuConf()
         self.partition_id = partition_id
         self.num_partitions = num_partitions
         self.runtime = runtime  # mem.runtime.TpuRuntime when active
+        self.cluster = cluster  # plugin.TpuCluster in multi-executor mode
         # task-scoped cleanup callbacks (reference: task-completion
         # listeners releasing GPU resources, GpuSemaphore.scala:27-161 /
         # RapidsBufferCatalog task cleanup).  Operators register IDEMPOTENT
@@ -75,7 +76,8 @@ class ExecContext:
                 pass
 
     def with_partition(self, pid: int, nparts: int) -> "ExecContext":
-        ctx = ExecContext(self.conf, pid, nparts, self.runtime)
+        ctx = ExecContext(self.conf, pid, nparts, self.runtime,
+                          self.cluster)
         ctx.cleanups = self.cleanups  # share the task scope
         return ctx
 

@@ -81,7 +81,16 @@ class TpuShuffleExchangeExec(TpuExec):
         """Yield (partition_id, coalesced batch | None) for every partition
         in order.  The partition-aligned form TpuShuffledHashJoinExec zips
         to pair build/stream sides (reference: EnsureRequirements places
-        matching HashPartitionings under GpuShuffledHashJoinExec)."""
+        matching HashPartitionings under GpuShuffledHashJoinExec).
+
+        Multi-executor mode (plugin.TpuCluster): map task m writes to
+        executor (m % N)'s catalog; reduce task p runs on executor
+        (p % N), serving local blocks and pulling the rest through the
+        transport client/server (bounce buffers + throttle), like the
+        reference's RapidsCachingReader local/remote split."""
+        if ctx.cluster is not None:
+            yield from self._execute_partitions_cluster(ctx)
+            return
         env = get_shuffle_env(ctx.runtime, ctx.conf) if ctx.runtime else None
         if env is None:
             from ..mem.runtime import TpuRuntime
@@ -93,45 +102,11 @@ class TpuShuffleExchangeExec(TpuExec):
         # remove_shuffle is idempotent, so register it with the task scope
         ctx.add_cleanup(lambda: env.remove_shuffle(sid))
         n = self.num_partitions
-
-        child_batches = self.children[0].execute(ctx)
-        bounds = None
-        if self.mode == "range" and n > 1:
-            # range bounds need a pass over the data (reference reservoir-
-            # samples on the host: GpuRangePartitioner.scala:42-216)
-            child_batches = list(child_batches)
-            bounds = sample_range_bounds(child_batches, self.keys,
-                                         self.ascending, self.nulls_first, n)
-
-        if isinstance(child_batches, list):
-            # range mode materialized the list for bounds sampling: drop
-            # each batch reference once written so peak memory is the
-            # spillable partition store, not store + pinned inputs
-            seq = child_batches
-
-            def _draining(s=seq):
-                for i in range(len(s)):
-                    b, s[i] = s[i], None
-                    yield b
-            child_batches = _draining()
-
-        num_writes = 0
-        with self.metrics.timer("shuffleWriteTime"):
-            for map_id, batch in enumerate(child_batches):
-                pids = self._partition_ids(batch, map_id, bounds)
-                for p, sub in split_by_partition(batch, pids, n):
-                    env.write_partition(sid, map_id, p, sub)
-                    num_writes += 1
-                batch = None
-        self.metrics.add("numPartitionsWritten", num_writes)
+        self._write_phase(ctx, n, lambda map_id, p, sub:
+                          env.write_partition(sid, map_id, p, sub))
 
         from ..config import SHUFFLE_ASYNC_FETCH
-
-        def _coalesced(parts):
-            if not parts:
-                return None
-            return parts[0] if len(parts) == 1 else concat_batches(parts)
-
+        _coalesced = _coalesce_parts
         try:
             with self.metrics.timer("shuffleReadTime"):
                 if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
@@ -155,6 +130,65 @@ class TpuShuffleExchangeExec(TpuExec):
                         yield p, _coalesced(list(env.fetch_partition(sid, p)))
         finally:
             env.remove_shuffle(sid)
+
+    def _write_phase(self, ctx: ExecContext, n: int, write) -> None:
+        """Shared write side: drain the child, compute partition ids, split,
+        hand each piece to `write(map_id, p, sub)`.  Range mode samples
+        bounds over a materialized list, then DROPS each batch reference as
+        written so peak memory is the spillable partition store, not store
+        plus pinned inputs."""
+        child_batches = self.children[0].execute(ctx)
+        bounds = None
+        if self.mode == "range" and n > 1:
+            # range bounds need a pass over the data (reference reservoir-
+            # samples on the host: GpuRangePartitioner.scala:42-216)
+            child_batches = list(child_batches)
+            bounds = sample_range_bounds(child_batches, self.keys,
+                                         self.ascending, self.nulls_first, n)
+            seq = child_batches
+
+            def _draining(s=seq):
+                for i in range(len(s)):
+                    b, s[i] = s[i], None
+                    yield b
+            child_batches = _draining()
+
+        num_writes = 0
+        with self.metrics.timer("shuffleWriteTime"):
+            for map_id, batch in enumerate(child_batches):
+                pids = self._partition_ids(batch, map_id, bounds)
+                for p, sub in split_by_partition(batch, pids, n):
+                    write(map_id, p, sub)
+                    num_writes += 1
+                batch = None
+        self.metrics.add("numPartitionsWritten", num_writes)
+
+    def _execute_partitions_cluster(self, ctx: ExecContext):
+        """Multi-executor read/write (see execute_partitions docstring)."""
+        cluster = ctx.cluster
+        sid = cluster.new_shuffle_id()
+        ctx.add_cleanup(lambda: cluster.remove_shuffle(sid))
+        n = self.num_partitions
+        self._write_phase(ctx, n, lambda map_id, p, sub:
+                          cluster.env_for(map_id).write_partition(
+                              sid, map_id, p, sub))
+
+        try:
+            with self.metrics.timer("shuffleReadTime"):
+                for p in range(n):
+                    owner = cluster.env_for(p)
+                    peers = cluster.peer_ids(owner.executor_id)
+                    parts = list(owner.fetch_partition(
+                        sid, p, remote_peers=peers))
+                    yield p, _coalesce_parts(parts)
+        finally:
+            cluster.remove_shuffle(sid)
+
+
+def _coalesce_parts(parts):
+    if not parts:
+        return None
+    return parts[0] if len(parts) == 1 else concat_batches(parts)
 
 
 def make_repartition_exec(plan, keys, child: ExecNode,

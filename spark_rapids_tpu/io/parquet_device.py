@@ -374,6 +374,10 @@ def _parse_byte_array_dict(data: bytes, n: int):
             raise DeviceDecodeUnsupported("truncated dictionary page")
         ln = int.from_bytes(data[pos:pos + 4], "little")
         pos += 4
+        if pos + ln > len(data):
+            # a short read here would silently store truncated string
+            # values; fall back to the pyarrow reader instead
+            raise DeviceDecodeUnsupported("truncated dictionary value")
         vals.append(data[pos:pos + ln])
         pos += ln
     n_cap = bucket_rows(max(n, 1))

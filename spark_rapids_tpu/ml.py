@@ -1,0 +1,69 @@
+"""ML integration: hand query results to jax ML as device matrices.
+
+Reference analogue: ColumnarRdd + the spark-rapids-ml/XGBoost handoff
+(ColumnarRdd.scala — exports the plugin's device columnar batches to ML
+libraries without a host round trip).  Here the handoff target is jax
+itself: a DataFrame's numeric columns become ONE device-resident
+[rows, features] matrix (plus an optional label vector) that feeds
+jax/flax/optax training directly — the data never leaves HBM between the
+SQL pipeline and the model.
+
+Gated by the same conf as the batch export
+(spark.rapids.sql.exportColumnarRdd, like the reference)."""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .columnar import ColumnarBatch
+
+
+def _batch_features(batch: ColumnarBatch, cols: List[str], dtype):
+    mat = jnp.stack([batch.column(n).data.astype(dtype) for n in cols],
+                    axis=1)
+    return mat, batch.sel
+
+
+def to_feature_matrix(df, feature_cols: Optional[List[str]] = None,
+                      label_col: Optional[str] = None,
+                      dtype=jnp.float32
+                      ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """DataFrame -> (features [n, d], labels [n] | None), device-resident.
+
+    `feature_cols` defaults to every numeric column (minus the label).
+    Rows with any null feature (or null label) are dropped, matching the
+    standard assembler behavior; `dtype` defaults to float32 — the
+    TPU-native training dtype — rather than the SQL column types."""
+    schema = df.schema
+    if feature_cols is None:
+        feature_cols = [f.name for f in schema
+                        if f.dtype.is_numeric and f.name != label_col]
+    if not feature_cols:
+        raise ValueError("no numeric feature columns")
+    mats, labels, keeps = [], [], []
+    for batch in df.to_device_batches():   # conf-gated, engine.py
+        mat, sel = _batch_features(batch, feature_cols, dtype)
+        keep = sel
+        for n in feature_cols:
+            keep = keep & batch.column(n).valid
+        if label_col is not None:
+            lab = batch.column(label_col)
+            keep = keep & lab.valid
+            labels.append(lab.data.astype(dtype))
+        mats.append(mat)
+        keeps.append(keep)
+    if not mats:
+        empty = jnp.zeros((0, len(feature_cols)), dtype=dtype)
+        return empty, (jnp.zeros((0,), dtype=dtype)
+                       if label_col is not None else None)
+    mat = jnp.concatenate(mats)
+    keep = jnp.concatenate(keeps)
+    # compact live rows to the front with one gather (no host round trip)
+    order = jnp.argsort(~keep, stable=True)
+    n = int(jnp.sum(keep))
+    mat = jnp.take(mat, order, axis=0)[:n]
+    lab = None
+    if label_col is not None:
+        lab = jnp.take(jnp.concatenate(labels), order)[:n]
+    return mat, lab

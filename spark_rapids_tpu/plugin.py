@@ -1,0 +1,115 @@
+"""Plugin bootstrap + local multi-executor cluster.
+
+Reference analogue: com/nvidia/spark/SQLPlugin.scala + rapids/Plugin.scala
+(RapidsDriverPlugin.init validates confs and broadcasts them,
+RapidsExecutorPlugin.init brings up the device, memory pools and shuffle
+wiring per executor, both with shutdown hooks; Plugin.scala:208-247).
+
+The TPU-native process model differs on purpose: mesh SPMD execution
+replaces executor fan-out for on-chip scale-out, so "executors" here are
+the HOST-MODE shuffle domains — each owns a runtime (pool, semaphore,
+spill stores) and a ShuffleEnv registered on a shared transport wire.
+`TpuCluster` runs N of them in one interpreter: map tasks of a shuffle
+write to their executor's catalog, reduce tasks fetch local blocks and
+pull the rest through the transport client/server path (bounce buffers,
+throttle, metadata round trip) exactly as a multi-process deployment
+would."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import config as C
+from .config import TpuConf
+
+
+class TpuDriverPlugin:
+    """Driver-side bootstrap: validate confs once, produce the dict every
+    executor plugin initializes from (the reference broadcasts the same
+    way; Plugin.scala RapidsDriverPlugin.init)."""
+
+    def __init__(self, conf: Optional[TpuConf] = None):
+        self.conf = conf or TpuConf()
+        self._initialized = False
+
+    def init(self) -> dict:
+        # touching every registered entry validates types/values eagerly,
+        # like the reference's conf validation at plugin init
+        for entry in C.registered_entries():
+            entry.get(self.conf)
+        n = int(self.conf.get(C.CLUSTER_EXECUTORS))
+        if n < 1:
+            raise ValueError(f"{C.CLUSTER_EXECUTORS.key} must be >= 1")
+        self._initialized = True
+        return dict(self.conf._settings)
+
+    def shutdown(self) -> None:
+        self._initialized = False
+
+
+class TpuExecutorPlugin:
+    """Per-executor bring-up: runtime (HBM pool, semaphore, spill stores)
+    + shuffle env on the shared wire; shutdown releases everything
+    (reference: RapidsExecutorPlugin.init/shutdown)."""
+
+    def __init__(self, executor_id: str, conf: TpuConf, transport=None,
+                 pool_limit_bytes: Optional[int] = None):
+        from .mem.runtime import TpuRuntime
+        from .shuffle.manager import ShuffleEnv
+        self.executor_id = executor_id
+        self.conf = conf
+        self.runtime = TpuRuntime(conf, pool_limit_bytes=pool_limit_bytes)
+        self.env = ShuffleEnv(self.runtime, conf, executor_id, transport)
+
+    def shutdown(self) -> None:
+        # drop every shuffle the env still holds (idempotent per shuffle)
+        for sid in list(self.env.catalog._by_shuffle):
+            self.env.remove_shuffle(sid)
+
+
+class TpuCluster:
+    """N executor plugins over one loopback/ICI transport wire."""
+
+    def __init__(self, conf: TpuConf, n_executors: Optional[int] = None):
+        from .shuffle.ici import IciShuffleTransport
+        self.conf = conf
+        self.n = int(n_executors if n_executors is not None
+                     else conf.get(C.CLUSTER_EXECUTORS))
+        self.driver = TpuDriverPlugin(conf)
+        self.driver.init()
+        self.transport = IciShuffleTransport(
+            max_inflight_bytes=int(conf.get(C.SHUFFLE_MAX_RECV_INFLIGHT)))
+        # N executors share ONE device: split the allocFraction pool budget
+        # between them so their combined accounting (and spill triggers)
+        # reflects physical HBM, not N times it
+        from .mem.runtime import _detect_hbm_bytes
+        total_pool = int(_detect_hbm_bytes()
+                         * float(conf.get(C.TPU_ALLOC_FRACTION)))
+        per_executor = max(total_pool // self.n, 1)
+        self.executors: List[TpuExecutorPlugin] = [
+            TpuExecutorPlugin(f"exec-{i}", conf, self.transport,
+                              pool_limit_bytes=per_executor)
+            for i in range(self.n)]
+        import threading
+        self._sid = [0]
+        self._sid_lock = threading.Lock()
+
+    def new_shuffle_id(self) -> int:
+        with self._sid_lock:
+            self._sid[0] += 1
+            return self._sid[0]
+
+    def env_for(self, task_id: int):
+        return self.executors[task_id % self.n].env
+
+    def peer_ids(self, excluding: str) -> List[str]:
+        return [e.executor_id for e in self.executors
+                if e.executor_id != excluding]
+
+    def remove_shuffle(self, sid: int) -> None:
+        for e in self.executors:
+            e.env.remove_shuffle(sid)
+
+    def shutdown(self) -> None:
+        for e in self.executors:
+            e.shutdown()
+        self.driver.shutdown()
