@@ -244,6 +244,21 @@ PARQUET_DEVICE_DECODE = _conf(
     "on the device (host keeps only page headers, run structure, and "
     "definition levels); columns outside scope fall back to the host "
     "arrow reader per column.", _to_bool)
+PARQUET_DEVICE_ENCODE = _conf(
+    "spark.rapids.sql.format.parquet.deviceEncode.enabled", True,
+    "Encode parquet writes on the device: null compaction, string "
+    "[len][bytes] stream packing, and column statistics run as device "
+    "kernels and the encoded page payload is the only D2H transfer; the "
+    "host writes definition-level runs, page headers, and the thrift "
+    "footer.  Partitioned writes fall back to the host arrow encoder.",
+    _to_bool)
+CSV_DEVICE_DECODE = _conf(
+    "spark.rapids.sql.format.csv.deviceDecode.enabled", True,
+    "Tokenize and parse CSV on the device: the host computes only the "
+    "delimiter index structure (one vectorized scan), the device gathers "
+    "per-column byte matrices from the raw file buffer and runs the "
+    "string->value parse kernels.  Files with quoting, CR line endings, "
+    "or jagged rows fall back to the host arrow reader.", _to_bool)
 PARQUET_DEBUG_DUMP_PREFIX = _conf(
     "spark.rapids.sql.parquet.debug.dumpPrefix", "",
     "If set, dump the clipped host parquet buffer to this path prefix for "

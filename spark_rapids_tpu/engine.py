@@ -59,7 +59,17 @@ class TpuSession:
     def runtime(self):
         if self._runtime is None:
             from .mem.runtime import TpuRuntime
-            self._runtime = TpuRuntime(self.conf)
+            limit = None
+            if int(self.conf.get(C.CLUSTER_EXECUTORS)) > 1:
+                # cluster mode: the N executor pools already claim half of
+                # the allocFraction budget (plugin.TpuCluster); the driving
+                # session's compute pool takes the other half so combined
+                # accounting reflects ONE physical device, not two
+                from .mem.runtime import _detect_hbm_bytes
+                limit = int(_detect_hbm_bytes()
+                            * float(self.conf.get(C.TPU_ALLOC_FRACTION))
+                            ) // 2
+            self._runtime = TpuRuntime(self.conf, pool_limit_bytes=limit)
         return self._runtime
 
     @property

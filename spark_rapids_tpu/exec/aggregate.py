@@ -607,10 +607,12 @@ class TpuHashAggregateExec(TpuExec):
             return None, None
         child = self.children[0]
         if isinstance(child, RowLocalExec):
-            if child._needs_row_offset():
+            if child._needs_row_offset() or child._needs_input_file():
                 # the fused stage threads a per-batch row offset
                 # (monotonically_increasing_id / rand); vmapping it with
-                # offset 0 would silently repeat per-batch streams
+                # offset 0 would silently repeat per-batch streams.
+                # input_file_name() likewise bakes a per-FILE constant that
+                # one vmapped program cannot vary across batches
                 return None, None
             pre_builder = child.batch_fn
             pre_key = child.kernel_key()

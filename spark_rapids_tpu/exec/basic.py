@@ -55,6 +55,7 @@ class TpuScanMemoryExec(TpuExec):
         from ..config import (MEMORY_SCAN_CACHE_ENABLED,
                               MEMORY_SCAN_CACHE_SIZE)
         from ..utils.scan_cache import MEMORY_SCAN_CACHE
+        E.clear_input_file()  # in-memory rows have no file provenance
         rows = self.table.num_rows
         limit = min(ctx.conf.get(MAX_READER_BATCH_SIZE_ROWS), 1 << 20)
         use_cache = ctx.conf.get(MEMORY_SCAN_CACHE_ENABLED)
@@ -116,23 +117,46 @@ class RowLocalExec(TpuExec):
     def _needs_row_offset(self) -> bool:
         return any(E.tree_needs_row_offset(e) for e in self.expressions())
 
+    def _needs_input_file(self) -> bool:
+        return any(E.tree_needs_input_file(e) for e in self.expressions())
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         from ..utils.kernel_cache import cached_kernel
         key = self.kernel_key()
+        needs_file = self._needs_input_file()
         if self._needs_row_offset():
             # stateful exprs (mono id / rand): thread the partition row
             # offset through as a traced argument; costs one host sync per
-            # batch, paid only when such an expression is present
-            fn = cached_kernel(
-                key + ("row_offset",),
-                lambda: functools.partial(E.eval_with_row_offset,
-                                          self.batch_fn()))
+            # batch, paid only when such an expression is present.
+            # input_file_name() may appear in the SAME projection — the
+            # per-batch file key composes with the offset threading.
             offset = 0
             for batch in self.children[0].execute(ctx):
+                fkey = key + ("row_offset",)
+                if needs_file:
+                    fkey += (E.current_input_file(),)
+                fn = cached_kernel(
+                    fkey,
+                    lambda: functools.partial(E.eval_with_row_offset,
+                                              self.batch_fn()))
                 with self.metrics.timer("totalTime"), \
                         named_range(self.name):
                     out = fn(batch, jnp.int64(offset))
                 offset += batch.num_rows_host()
+                self.metrics.add("numOutputBatches", 1)
+                yield out
+            return
+        if needs_file:
+            # input_file_name()/block exprs bake the scan's current file
+            # into the program as a constant; key the cache on it so each
+            # file gets its own compiled constant (files are few, so the
+            # recompile count is bounded — reference GpuInputFileBlock
+            # reads the holder per task the same way)
+            for batch in self.children[0].execute(ctx):
+                fn = cached_kernel(key + (E.current_input_file(),),
+                                   self.batch_fn)
+                with self.metrics.timer("totalTime"), named_range(self.name):
+                    out = fn(batch)
                 self.metrics.add("numOutputBatches", 1)
                 yield out
             return

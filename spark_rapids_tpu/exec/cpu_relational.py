@@ -124,6 +124,18 @@ class CpuHashAggregateExec(CpuExec):
 
     def _agg_value(self, a: AggregateExpression, vals, valid, idx):
         sel = [i for i in idx if valid[i]]
+        if a.distinct and a.func in ("Sum", "Count", "Average"):
+            # dedup values within the group (NaNs fold to one value)
+            seen = set()
+            dd = []
+            for i in sel:
+                v = vals[i]
+                v = v.item() if isinstance(v, np.generic) else v
+                key = "\0nan" if isinstance(v, float) and np.isnan(v) else v
+                if key not in seen:
+                    seen.add(key)
+                    dd.append(i)
+            sel = dd
         if a.func == "Count":
             return len(sel)
         if a.func in ("First", "Last"):

@@ -173,14 +173,39 @@ class TpuShuffleExchangeExec(TpuExec):
                           cluster.env_for(map_id).write_partition(
                               sid, map_id, p, sub))
 
+        def _route(p):
+            owner = cluster.env_for(p)
+            return owner, cluster.peer_ids(owner.executor_id)
+
+        from ..config import SHUFFLE_ASYNC_FETCH, SHUFFLE_MAX_RECV_INFLIGHT
         try:
             with self.metrics.timer("shuffleReadTime"):
-                for p in range(n):
-                    owner = cluster.env_for(p)
-                    peers = cluster.peer_ids(owner.executor_id)
-                    parts = list(owner.fetch_partition(
-                        sid, p, remote_peers=peers))
-                    yield p, _coalesce_parts(parts)
+                if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
+                    # same pipelining as the single-executor path: remote
+                    # transport round-trips overlap consumption
+                    from ..shuffle.fetch import AsyncFetchIterator
+                    it = AsyncFetchIterator(
+                        None, sid, range(n), None,
+                        int(ctx.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
+                        route=_route)
+                    next_p = 0
+                    parts: list = []
+                    for rid, batch in it:
+                        while next_p < rid:
+                            yield next_p, _coalesce_parts(parts)
+                            parts = []
+                            next_p += 1
+                        parts.append(batch)
+                    while next_p < n:
+                        yield next_p, _coalesce_parts(parts)
+                        parts = []
+                        next_p += 1
+                else:
+                    for p in range(n):
+                        owner, peers = _route(p)
+                        parts = list(owner.fetch_partition(
+                            sid, p, remote_peers=peers))
+                        yield p, _coalesce_parts(parts)
         finally:
             cluster.remove_shuffle(sid)
 

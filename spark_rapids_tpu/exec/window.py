@@ -28,7 +28,13 @@ from .sort import sort_order
 
 
 class TpuWindowExec(TpuExec):
-    child_coalesce_goal = "single"
+    # "target", not "single": inputs under the batch target still coalesce
+    # to one batch (the fast path), while oversized inputs arrive as
+    # multiple batches and take the external partitioned path in execute()
+    # (reference: GpuWindowExec requires a single batch per Spark partition,
+    # and Spark's planner provides the hash exchange; here the exec inserts
+    # its own, like TpuSortExec's external sort).
+    child_coalesce_goal = "target"
 
     def __init__(self, part_exprs: Sequence[E.Expression],
                  order_exprs: Sequence[E.Expression],
@@ -85,12 +91,38 @@ class TpuWindowExec(TpuExec):
                        repr(f.default)) for f in self.funcs))
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from .. import config as C
         from ..utils.kernel_cache import cached_kernel
         batches = list(self.children[0].execute(ctx))
         if not batches:
             return
-        batch = batches[0] if len(batches) == 1 else concat_batches(batches)
         fn = cached_kernel(self.kernel_key(), lambda: self._window_kernel)
+        total = sum(b.device_size_bytes() for b in batches)
+        target = ctx.conf.get(C.BATCH_SIZE_BYTES)
+        if len(batches) > 1 and total > target and self.part_exprs:
+            # external window (the sort-exec shape, exec/sort.py:157-180):
+            # a PARTITION-BY hash exchange through the spillable shuffle
+            # store keeps every window partition whole within one hash
+            # partition, so the single-batch kernel is per-partition and
+            # peak HBM is bounded by the exchange target, not the input.
+            # Spark's own physical plan for window is the same exchange
+            # (hashpartitioning on the window partition spec); a global
+            # window (no PARTITION BY) is a single Spark partition there
+            # too, so it keeps the concat path below.
+            from .exchange import TpuShuffleExchangeExec
+            from .sort import _PrefetchedSource
+            n_parts = max(2, -(-total // max(target, 1)))
+            ex = TpuShuffleExchangeExec(
+                "hash", self.part_exprs, int(n_parts),
+                _PrefetchedSource(batches, self.children[0].schema))
+            del batches  # the source owns (and drains) the only reference
+            for part in ex.execute(ctx):
+                with self.metrics.timer("windowTime"):
+                    out = fn(part)
+                self.metrics.add("numOutputBatches", 1)
+                yield out
+            return
+        batch = batches[0] if len(batches) == 1 else concat_batches(batches)
         with self.metrics.timer("windowTime"):
             out = fn(batch)
         self.metrics.add("numOutputBatches", 1)

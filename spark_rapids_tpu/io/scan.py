@@ -482,17 +482,27 @@ def _host_chunks(fmt: str, files, schema: Schema, options: dict,
         it = _iter_csv(files, file_schema, options, max_rows)
     else:
         raise NotImplementedError(f"scan format {fmt}")
-    for path, table in it:
-        vals = partitions.get(path) or partitions.get(os.path.abspath(path))
-        if vals:
-            for name, value in vals.items():
-                if name not in schema.names:
-                    continue  # pruned partition column
-                f = schema.field(name)
-                table = table.append_column(
-                    name, pa.array([value] * table.num_rows,
-                                   type=to_arrow(f.dtype)))
-        yield _evolve(table, schema)
+    from ..ops.expressions import clear_input_file, publish_input_file
+    try:
+        for path, table in it:
+            vals = partitions.get(path) \
+                or partitions.get(os.path.abspath(path))
+            if vals:
+                for name, value in vals.items():
+                    if name not in schema.names:
+                        continue  # pruned partition column
+                    f = schema.field(name)
+                    table = table.append_column(
+                        name, pa.array([value] * table.num_rows,
+                                       type=to_arrow(f.dtype)))
+            # provenance for input_file_name()/block expressions
+            # (reference: InputFileBlockHolder.set in the readers)
+            publish_input_file(path)
+            yield _evolve(table, schema)
+    finally:
+        # past the scan (exchange, join probe, collect) the provenance is
+        # undefined and Spark reports ("", -1, -1)
+        clear_input_file()
 
 
 # --------------------------------------------------------------------------
@@ -519,12 +529,32 @@ def _device_parquet_batches(files, schema: Schema, options: dict, conf,
     partitions = options.get("__partitions__") or {}
     part_names = {n for vals in partitions.values() for n in vals}
 
+    from ..ops.expressions import clear_input_file, publish_input_file
+    files = list(files)
+    try:
+        yield from _device_parquet_files(
+            files, schema, options, conf, metrics, max_rows, max_bytes,
+            predicates, partitions, part_names, publish_input_file)
+    finally:
+        clear_input_file()
+
+
+def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
+                          max_bytes, predicates, partitions, part_names,
+                          publish_input_file):
+    import jax.numpy as jnp
+    import pyarrow.parquet as pq
+    from ..columnar import Column
+    from ..columnar.batch import bucket_rows
+    from .parquet_device import (DeviceDecodeUnsupported, _copy_range,
+                                 decode_column_chunk)
     for path in files:
         pf = pq.ParquetFile(path)
         if pf.metadata.num_row_groups == 0:
             continue
         name_to_leaf = _leaf_index_map(pf)
         pvals = partitions.get(path) or partitions.get(os.path.abspath(path))
+        publish_input_file(path)
 
         for chunk in _parquet_chunks(pf, max_rows, max_bytes, predicates,
                                      name_to_leaf, metrics):
@@ -629,6 +659,36 @@ class TpuFileScanExec(TpuExec):
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         produced = False
+        if self.fmt == "csv" and ctx.conf.get(C.CSV_DEVICE_DECODE) \
+                and not self.options.get("__partitions__"):
+            from .csv_device import CsvDeviceUnsupported, device_csv_batches
+            for path in self.files:
+                try:
+                    # tokenization errors surface before the first yield of
+                    # a file, so the fallback is file-granular
+                    for batch, nrows in device_csv_batches(
+                            [path], self._schema, self.options, ctx.conf,
+                            self.metrics):
+                        self.metrics.add("numOutputRows", nrows)
+                        self.metrics.add("numOutputBatches", 1)
+                        self.metrics.add("numDeviceDecodedColumns",
+                                         len(self._schema))
+                        produced = True
+                        yield batch
+                except CsvDeviceUnsupported:
+                    for table in _host_chunks(
+                            "csv", [path], self._schema, self.options,
+                            ctx.conf, self.metrics):
+                        with self.metrics.timer("scanTime"):
+                            batch = ColumnarBatch.from_arrow(table)
+                        self.metrics.add("numOutputRows", table.num_rows)
+                        self.metrics.add("numOutputBatches", 1)
+                        produced = True
+                        yield batch
+            if not produced:
+                yield ColumnarBatch.from_pydict(
+                    {f.name: [] for f in self._schema}, self._schema)
+            return
         if self.fmt == "parquet" \
                 and ctx.conf.get(C.PARQUET_DEVICE_DECODE) \
                 and not ctx.conf.get(C.PARQUET_DEBUG_DUMP_PREFIX):

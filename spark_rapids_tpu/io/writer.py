@@ -115,6 +115,18 @@ class _WriterCore:
         self.metrics.add("numOutputRows", table.num_rows)
         self.metrics.add("numOutputBytes", nbytes)
 
+    def write_encoded(self, data: bytes, num_rows: int):
+        """Write an already-encoded (device path) file image."""
+        os.makedirs(self.path, exist_ok=True)
+        name = (f"part-{self.file_seq:05d}-{self.task_uuid}"
+                f"{_EXT[self.fmt]}")
+        self.file_seq += 1
+        with open(os.path.join(self.path, name), "wb") as f:
+            f.write(data)
+        self.metrics.add("numFiles", 1)
+        self.metrics.add("numOutputRows", num_rows)
+        self.metrics.add("numOutputBytes", len(data))
+
 
 class TpuDataWritingExec(TpuExec):
     """Device write command (GpuDataWritingCommandExec equivalent): drains
@@ -135,13 +147,30 @@ class TpuDataWritingExec(TpuExec):
     def describe(self):
         return f"TpuDataWritingExec[{self.fmt}, {self.path}]"
 
+    def _device_encode_ok(self, ctx) -> bool:
+        from .. import config as C
+        from .parquet_device_write import _TYPE_MAP
+        return (self.fmt == "parquet" and not self.partition_by
+                and ctx.conf.get(C.PARQUET_DEVICE_ENCODE)
+                and all(f.dtype in _TYPE_MAP for f in self.schema))
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         core = _WriterCore(self.path, self.fmt, self.options,
                            self.partition_by, self.metrics)
+        device_encode = self._device_encode_ok(ctx)
         wrote = False
         for batch in self.children[0].execute(ctx):
             with self.metrics.timer("writeTime"):
-                core.write(batch.to_arrow())
+                if device_encode:
+                    # reference shape: encode on device, stream host
+                    # buffers out (GpuParquetFileFormat.scala:192-214)
+                    from .parquet_device_write import encode_parquet_file
+                    data = encode_parquet_file(
+                        batch, self.options.get("compression", "snappy"))
+                    core.write_encoded(data, batch.num_rows_host())
+                    self.metrics.add("numDeviceEncodedFiles", 1)
+                else:
+                    core.write(batch.to_arrow())
             wrote = True
         if not wrote:
             core.write(_empty_table(self.schema))

@@ -338,10 +338,37 @@ def cpu_eval(expr: E.Expression, cols, n: int) -> CpuCol:
 
     # ---- datetime -----------------------------------------------------
     if isinstance(expr, (D._DatePart, D._DateArith, D.UnixTimestamp,
-                         D.FromUnixTime, D.TimeAdd, D.AddMonths,
+                         D.FromUnixTime, D.TimeAdd, D.TimeSub, D.AddMonths,
                          D.MonthsBetween, D.TruncDate, D.NextDay)):
         return _cpu_datetime(expr, rec, n)
 
+    if t == "AtLeastNNonNulls":
+        count = np.zeros(n, dtype=np.int32)
+        for ch in expr.children:
+            v, m = rec(ch)
+            ok = m.copy()
+            if ch.dtype.is_floating:
+                with np.errstate(all="ignore"):
+                    ok &= ~np.isnan(v.astype(np.float64))
+            count += ok.astype(np.int32)
+        return count >= expr.n, np.ones(n, bool)
+    if t == "NormalizeNaNAndZero":
+        v, m = rec(expr.child)
+        if expr.child.dtype.is_floating:
+            v = np.where(v == 0, np.zeros((), v.dtype), v)
+        return v, m
+    if t == "KnownFloatingPointNormalized":
+        return rec(expr.child)
+    if t == "InputFileName":
+        from .expressions import current_input_file
+        out = np.empty(n, dtype=object)
+        out[:] = current_input_file()[0]
+        return out, np.ones(n, bool)
+    if t in ("InputFileBlockStart", "InputFileBlockLength"):
+        from .expressions import current_input_file
+        slot = 1 if t == "InputFileBlockStart" else 2
+        return (np.full(n, current_input_file()[slot], dtype=np.int64),
+                np.ones(n, bool))
     if t == "SparkPartitionID":
         return np.full(n, expr.partition_id, dtype=np.int32), np.ones(n, bool)
     if t == "MonotonicallyIncreasingID":
@@ -509,7 +536,8 @@ _MATH_UNARY = {
     "Sqrt": np.sqrt, "Cbrt": np.cbrt, "Exp": np.exp, "Expm1": np.expm1,
     "Sin": np.sin, "Cos": np.cos, "Tan": np.tan, "Asin": np.arcsin,
     "Acos": np.arccos, "Atan": np.arctan, "Sinh": np.sinh, "Cosh": np.cosh,
-    "Tanh": np.tanh, "ToDegrees": np.degrees, "ToRadians": np.radians,
+    "Tanh": np.tanh, "Asinh": np.arcsinh, "Acosh": np.arccosh,
+    "Atanh": np.arctanh, "ToDegrees": np.degrees, "ToRadians": np.radians,
     "Signum": np.sign, "Rint": np.round,
     "Log": np.log, "Log2": np.log2, "Log10": np.log10, "Log1p": np.log1p,
 }
@@ -895,10 +923,11 @@ def _cpu_datetime(expr, rec, n: int) -> CpuCol:
                 seconds=int(v[i]))
             out[i] = dt.strftime("%Y-%m-%d %H:%M:%S")
         return out, m
-    if t == "TimeAdd":
+    if t in ("TimeAdd", "TimeSub"):
         lv, lm = rec(expr.child)
         rv, rm = rec(expr.interval)
-        return lv + rv.astype(np.int64), lm & rm
+        sign = 1 if t == "TimeAdd" else -1
+        return lv + sign * rv.astype(np.int64), lm & rm
     if t == "AddMonths":
         lv, lm = rec(expr.left)
         rv, rm = rec(expr.right)

@@ -230,6 +230,26 @@ class TimeAdd(Expression):
                       TimestampType).mask_invalid()
 
 
+class TimeSub(Expression):
+    """timestamp - interval literal (micros) (Spark TimeSub; reference
+    GpuTimeSub in datetimeExpressions.scala)."""
+
+    def __init__(self, child, interval_micros: Expression):
+        self.child = child
+        self.interval = interval_micros
+        self.children = (child, interval_micros)
+
+    @property
+    def dtype(self):
+        return TimestampType
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        i = self.interval.eval(batch)
+        return Column(c.data - i.data.astype(jnp.int64), c.valid & i.valid,
+                      TimestampType).mask_invalid()
+
+
 class AddMonths(Expression):
     """add_months(date, n): civil month arithmetic, day-of-month clamped to
     the target month's last day (Spark/DateTimeUtils semantics)."""

@@ -752,6 +752,147 @@ def tree_needs_row_offset(expr: "Expression") -> bool:
     return any(tree_needs_row_offset(c) for c in expr.children)
 
 
+# Per-task input-file provenance (reference: GpuInputFileName /
+# GpuInputFileBlockStart/Length read Spark's InputFileBlockHolder,
+# org/.../rapids/GpuInputFileBlock.scala).  File scan execs publish the
+# (name, block start, block length) of the file each batch came from; the
+# expressions bake it into the per-batch program as a constant (the
+# executing operator keys its kernel cache on the current holder value, so
+# a new file compiles a new constant program — see RowLocalExec.execute).
+# Like Spark, the value is only meaningful directly above a file scan;
+# elsewhere it is ("", -1, -1).
+_INPUT_FILE = [("", -1, -1)]
+
+
+def set_input_file(name: str, start: int, length: int) -> None:
+    _INPUT_FILE[0] = (name, start, length)
+
+
+def publish_input_file(path: str) -> None:
+    """Publish provenance for one whole-file split: start=0, length=file
+    size (-1 when unstattable).  The single place the block-semantics rule
+    lives; every reader calls this."""
+    import os
+    try:
+        set_input_file(path, 0, os.path.getsize(path))
+    except OSError:
+        set_input_file(path, 0, -1)
+
+
+def clear_input_file() -> None:
+    _INPUT_FILE[0] = ("", -1, -1)
+
+
+def current_input_file():
+    return _INPUT_FILE[0]
+
+
+def tree_needs_input_file(expr: "Expression") -> bool:
+    if isinstance(expr, (InputFileName, InputFileBlockStart,
+                         InputFileBlockLength)):
+        return True
+    return any(tree_needs_input_file(c) for c in expr.children)
+
+
+class InputFileName(Expression):
+    """input_file_name(): the file the current batch was read from."""
+
+    @property
+    def dtype(self):
+        return StringType
+
+    def eval(self, batch):
+        return Column.from_strings([current_input_file()[0]]
+                                   * batch.capacity)
+
+    def __repr__(self):
+        return "input_file_name()"
+
+
+class _InputFileLong(Expression):
+    _slot = 1
+
+    @property
+    def dtype(self):
+        return LongType
+
+    def eval(self, batch):
+        cap = batch.capacity
+        v = current_input_file()[self._slot]
+        return Column(jnp.full((cap,), v, dtype=jnp.int64),
+                      jnp.ones(cap, dtype=jnp.bool_), LongType)
+
+
+class InputFileBlockStart(_InputFileLong):
+    _slot = 1
+
+
+class InputFileBlockLength(_InputFileLong):
+    _slot = 2
+
+
+class AtLeastNNonNulls(Expression):
+    """True when at least n of the children are non-null (and non-NaN for
+    float children) — the predicate behind df.na.drop (Spark
+    AtLeastNNonNulls semantics)."""
+
+    def __init__(self, n: int, children: Sequence["Expression"]):
+        self.n = int(n)
+        self.children = tuple(children)
+
+    @property
+    def dtype(self):
+        return BooleanType
+
+    def eval(self, batch):
+        cap = batch.capacity
+        count = jnp.zeros(cap, dtype=jnp.int32)
+        for ch in self.children:
+            c = ch.eval(batch)
+            ok = c.valid
+            if c.dtype.is_floating:
+                ok = ok & ~jnp.isnan(c.data)
+            count = count + ok.astype(jnp.int32)
+        return Column(count >= self.n, jnp.ones(cap, dtype=jnp.bool_),
+                      BooleanType)
+
+    def __repr__(self):
+        return f"AtLeastNNonNulls({self.n}, {list(self.children)!r})"
+
+
+class NormalizeNaNAndZero(UnaryExpression):
+    """Canonicalize float values for grouping/join keys: every NaN becomes
+    THE NaN, -0.0 becomes 0.0 (Spark NormalizeFloatingNumbers.scala
+    semantics; the reference implements it as GpuNormalizeNaNAndZero with
+    cuDF normalize_nans_and_zeros)."""
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch):
+        c = self.child.eval(batch)
+        if not c.dtype.is_floating:
+            return c
+        x = c.data
+        nan = jnp.array(float("nan"), dtype=x.dtype)
+        data = jnp.where(jnp.isnan(x), nan,
+                         jnp.where(x == 0, jnp.zeros((), x.dtype), x))
+        return Column(data, c.valid, c.dtype)
+
+
+class KnownFloatingPointNormalized(UnaryExpression):
+    """Analyzer marker that its input is already normalized — a pure
+    passthrough on device, kept so plans containing it stay on TPU."""
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch):
+        return self.child.eval(batch)
+
+
 class SparkPartitionID(Expression):
     def __init__(self, partition_id: int = 0):
         self.partition_id = partition_id

@@ -124,6 +124,22 @@ class Tanh(_DoubleUnary):
         return jnp.tanh(x)
 
 
+class Asinh(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.arcsinh(x)
+
+
+class Acosh(_DoubleUnary):
+    def do_op(self, x):
+        # x < 1 -> NaN, matching StrictMath.log(x + sqrt(x*x - 1)) domain
+        return jnp.arccosh(x)
+
+
+class Atanh(_DoubleUnary):
+    def do_op(self, x):
+        return jnp.arctanh(x)
+
+
 class ToDegrees(_DoubleUnary):
     def do_op(self, x):
         return jnp.degrees(x)

@@ -28,9 +28,12 @@ for _n in ("Add Subtract Multiply Divide IntegralDivide Remainder Pmod "
            "ShiftLeft ShiftRight ShiftRightUnsigned").split():
     _SIMPLE[_n] = getattr(E, _n)
 for _n in ("Sqrt Cbrt Exp Expm1 Log Log2 Log10 Log1p Sin Cos Tan Asin Acos "
-           "Atan Sinh Cosh Tanh ToDegrees ToRadians Signum Floor Ceil Rint "
-           "Pow Atan2").split():
+           "Atan Sinh Cosh Tanh Asinh Acosh Atanh ToDegrees ToRadians "
+           "Signum Floor Ceil Rint Pow Atan2").split():
     _SIMPLE[_n] = getattr(M, _n)
+for _n in ("NormalizeNaNAndZero", "KnownFloatingPointNormalized",
+           "InputFileName", "InputFileBlockStart", "InputFileBlockLength"):
+    _SIMPLE[_n] = getattr(E, _n)
 
 _COMPARISONS = {"EqualTo", "LessThan", "GreaterThan", "LessThanOrEqual",
                 "GreaterThanOrEqual", "EqualNullSafe"}
@@ -140,6 +143,15 @@ def resolve(ce, schema: Schema, partition_id: int = 0) -> E.Expression:
              "FromUnixTime": D.FromUnixTime, "AddMonths": D.AddMonths,
              "MonthsBetween": D.MonthsBetween, "TruncDate": D.TruncDate,
              "NextDay": D.NextDay}
+    if op == "AtLeastNNonNulls":
+        n, child_ces = ce.args
+        return E.AtLeastNNonNulls(
+            n, [resolve(a, schema, partition_id) for a in child_ces])
+    if op in ("TimeAdd", "TimeSub"):
+        from ..ops import datetime_exprs as D2
+        cls = D2.TimeAdd if op == "TimeAdd" else D2.TimeSub
+        return cls(resolve(ce.args[0], schema, partition_id),
+                   resolve(ce.args[1], schema, partition_id))
     if op in ("Round", "BRound", "Hypot", "Cot", "Logarithm",
               "Least", "Greatest", "Murmur3Hash"):
         from ..ops import math as M

@@ -237,6 +237,30 @@ class functions:
         return WhenBuilder([(cond, _wrap(value))])
 
     @staticmethod
+    def input_file_name():
+        return ColumnExpr("InputFileName", ())
+
+    @staticmethod
+    def input_file_block_start():
+        return ColumnExpr("InputFileBlockStart", ())
+
+    @staticmethod
+    def input_file_block_length():
+        return ColumnExpr("InputFileBlockLength", ())
+
+    @staticmethod
+    def asinh(e):
+        return ColumnExpr("Asinh", (_wrap(e),))
+
+    @staticmethod
+    def acosh(e):
+        return ColumnExpr("Acosh", (_wrap(e),))
+
+    @staticmethod
+    def atanh(e):
+        return ColumnExpr("Atanh", (_wrap(e),))
+
+    @staticmethod
     def coalesce(*exprs):
         return ColumnExpr("Coalesce", tuple(_wrap(e) for e in exprs))
 

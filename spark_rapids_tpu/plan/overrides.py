@@ -78,8 +78,16 @@ def _tag_replace(meta: "ExprMeta", conf: TpuConf):
 
 def _tag_agg(meta: "ExprMeta", conf: TpuConf):
     e: AggregateExpression = meta.expr
-    if e.distinct:
-        meta.will_not_work("distinct aggregates are not supported on TPU yet")
+    if not conf.is_op_enabled(expr_conf_key(e.func)):
+        # per-function kill-switch, like the reference's expr rules for
+        # Sum/Count/Min/Max/Average/First/Last (GpuOverrides.scala)
+        meta.will_not_work(
+            f"aggregate {e.func} has been disabled; set "
+            f"{expr_conf_key(e.func)}=true to enable")
+    if e.distinct and e.func in ("First", "Last"):
+        # value depends on arrival order after dedup; Spark itself plans
+        # these as non-distinct — reject defensively
+        meta.will_not_work(f"distinct {e.func} is not supported on TPU")
     if e.func in ("Min", "Max") and e.child is not None \
             and e.child.dtype.is_string:
         meta.will_not_work("min/max over strings is not supported on TPU "
@@ -132,7 +140,16 @@ def _tag_device_supported(meta: "ExprMeta", conf: TpuConf):
 
 
 for _n in ("InitCap Reverse Ascii Cot Hypot Logarithm Least Greatest "
-           "Murmur3Hash AddMonths MonthsBetween").split():
+           "Murmur3Hash AddMonths MonthsBetween "
+           "Asinh Acosh Atanh AtLeastNNonNulls TimeSub "
+           "NormalizeNaNAndZero KnownFloatingPointNormalized "
+           "InputFileName InputFileBlockStart InputFileBlockLength "
+           "AttributeReference SortOrder").split():
+    _EXPR_RULES[_n] = None
+# aggregate functions are registered by name like the reference's expr
+# rules for Sum/Count/... (GpuOverrides.scala agg entries); the kill-switch
+# conf check runs in _tag_agg against the AggregateExpression's func name
+for _n in ("Sum Count Min Max Average First Last").split():
     _EXPR_RULES[_n] = None
 # window functions: resolved via ops/windows.resolve_window_func (not the
 # Expression tree), but registered here so the per-op kill-switch conf

@@ -135,6 +135,15 @@ def _tag_aggregate(meta: PlanMeta):
     meta.resolved["aggregates"] = aggs
     meta.expr_metas = [ExprMeta(e, conf) for e in grouping]
     meta.expr_metas += [ExprMeta(e, conf) for e in aggs]
+    # one distinct child is deduped inside the update kernel; several
+    # distinct children would each need their own dedup ordering, which a
+    # single sorted pass cannot provide (the reference likewise falls back
+    # for multi-distinct, GpuHashAggregateMeta.tagPlanForGpu,
+    # aggregate.scala:64-111)
+    distinct_children = {repr(a.child) for a in aggs if a.distinct}
+    if len(distinct_children) > 1:
+        meta.will_not_work(
+            "multiple distinct aggregate children are not supported on TPU")
     if conf.get(C.HAS_NANS):
         # like the reference's hasNans gate on float agg keys
         for g in grouping:

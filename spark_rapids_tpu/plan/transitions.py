@@ -142,11 +142,14 @@ def distribute(node: ExecNode, conf: TpuConf) -> ExecNode:
                 or isinstance(n, TpuBroadcastHashJoinExec):
             return n
         if type(n) is TpuHashAggregateExec and n.grouping \
-                and not n._needs_offset():
+                and not n._needs_offset() \
+                and not any(a.distinct for a in n.aggregates):
             # global (ungrouped) aggregates stay single-chip (their state
             # is one row, an all-to-all buys nothing); offset-dependent
             # aggregates (First/Last) keep the single-chip path so the
-            # arrival-order tiebreak stays deterministic
+            # arrival-order tiebreak stays deterministic; distinct
+            # aggregates dedup inside ONE update kernel (partial states are
+            # not mergeable across shards), so they stay single-chip too
             return TpuDistributedAggregateExec(
                 n.grouping, n.group_names, n.aggregates, n.children[0],
                 mesh, allgather)

@@ -78,12 +78,13 @@ class TpuCluster:
         self.driver.init()
         self.transport = IciShuffleTransport(
             max_inflight_bytes=int(conf.get(C.SHUFFLE_MAX_RECV_INFLIGHT)))
-        # N executors share ONE device: split the allocFraction pool budget
-        # between them so their combined accounting (and spill triggers)
-        # reflects physical HBM, not N times it
+        # N executors share ONE device WITH the driving session's compute
+        # pool (engine.TpuSession.runtime, which halves itself in cluster
+        # mode): the executors split one half of the allocFraction budget,
+        # so session + executors together account for physical HBM once
         from .mem.runtime import _detect_hbm_bytes
         total_pool = int(_detect_hbm_bytes()
-                         * float(conf.get(C.TPU_ALLOC_FRACTION)))
+                         * float(conf.get(C.TPU_ALLOC_FRACTION))) // 2
         per_executor = max(total_pool // self.n, 1)
         self.executors: List[TpuExecutorPlugin] = [
             TpuExecutorPlugin(f"exec-{i}", conf, self.transport,

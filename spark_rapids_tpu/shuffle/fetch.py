@@ -29,11 +29,14 @@ class AsyncFetchIterator:
 
     def __init__(self, env, shuffle_id: int, reduce_ids: Sequence[int],
                  remote_peers: Optional[List[str]] = None,
-                 max_inflight_bytes: int = 1 << 30):
+                 max_inflight_bytes: int = 1 << 30, route=None):
         self._env = env
         self._sid = shuffle_id
         self._rids = list(reduce_ids)
         self._peers = remote_peers
+        # cluster mode: `route(rid) -> (env, peers)` picks the serving
+        # executor per partition (exchange._execute_partitions_cluster)
+        self._route = route
         self._max = max(int(max_inflight_bytes), 1)
         self._q: "queue.Queue" = queue.Queue()
         self._cv = threading.Condition()
@@ -62,8 +65,9 @@ class AsyncFetchIterator:
         try:
             for rid in self._rids:
                 self.prefetched_partitions.append(rid)
-                for batch in self._env.fetch_partition(self._sid, rid,
-                                                       self._peers):
+                env, peers = (self._route(rid) if self._route is not None
+                              else (self._env, self._peers))
+                for batch in env.fetch_partition(self._sid, rid, peers):
                     nb = batch.device_size_bytes()
                     if not self._admit(nb):
                         return

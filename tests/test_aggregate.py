@@ -146,14 +146,49 @@ def test_agg_expression_keys_and_values():
     assert_tpu_and_cpu_are_equal(q)
 
 
-def test_distinct_agg_falls_back():
+def test_single_distinct_agg_on_device():
+    """One distinct child dedups inside the update kernel (sorted
+    (group, value) adjacency; exec/aggregate.py _distinct_child)."""
+    def q(s):
+        df = gen_df(s, seed=29, n=300, k=T.IntegerType, v=T.IntegerType)
+        return df.group_by("k").agg(
+            f.count_distinct(col("v")).alias("cd"),
+            f.sum(col("v")).alias("sv"),        # mixed: non-distinct too
+            f.count(col("v")).alias("c"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_distinct_agg_strings_and_sum_distinct():
+    def q(s):
+        df = gen_df(s, seed=30, n=300, k=T.IntegerType, s_=T.StringType)
+        return df.group_by("k").agg(
+            f.count_distinct(col("s_")).alias("cd"))
+    _assert_on_tpu(q)
+    assert_tpu_and_cpu_are_equal(q)
+
+    def q2(s):
+        df = gen_df(s, seed=31, n=300, k=T.IntegerType, v=T.LongType)
+        return df.group_by("k").agg(
+            f._agg("Sum", col("v"), distinct=True).alias("sd"))
+    _assert_on_tpu(q2)
+    assert_tpu_and_cpu_are_equal(q2)
+
+
+def test_multi_distinct_agg_falls_back():
+    """Two DIFFERENT distinct children cannot share one sorted dedup pass;
+    falls back like the reference (GpuHashAggregateMeta.tagPlanForGpu)."""
     from spark_rapids_tpu.engine import TpuSession
 
     def q(s):
-        df = gen_df(s, seed=29, n=300, k=T.IntegerType, v=T.IntegerType)
-        return df.group_by("k").agg(f.count_distinct(col("v")).alias("cd"))
+        df = gen_df(s, seed=32, n=300, k=T.IntegerType, v=T.IntegerType,
+                    w=T.IntegerType)
+        return df.group_by("k").agg(
+            f.count_distinct(col("v")).alias("cv"),
+            f.count_distinct(col("w")).alias("cw"))
     text = q(TpuSession()).explain()
-    assert "distinct" in text
+    assert "multiple distinct" in text
+    assert_tpu_and_cpu_are_equal(q)
 
 
 def test_min_with_inf_and_nan_group():

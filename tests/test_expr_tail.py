@@ -228,3 +228,110 @@ class TestNonLiteralFallbacks:
             .select(f.trunc(col("d"),
                             f.when(col("x"), "year").otherwise("mon"))
                     .alias("r")))
+
+
+class TestRound3Tail:
+    """Round-3 close-out of the reference rule table: inverse hyperbolics,
+    AtLeastNNonNulls, TimeSub, float normalization, input-file provenance
+    (reference: GpuOverrides.scala expr rules; GpuInputFileBlock.scala)."""
+
+    def test_asinh(self):
+        def q(s):
+            df = gen_df(s, seed=90, n=300, a=T.DoubleType)
+            return df.select(f.asinh(col("a")).alias("r"))
+        assert_tpu_and_cpu_are_equal(q)
+
+    def test_acosh_in_domain(self):
+        def q(s):
+            df = gen_df(s, seed=91, n=300, a=T.DoubleType)
+            # abs(a) + 1 >= 1 keeps acosh in-domain
+            return df.select(f.acosh(f.abs(col("a")) + 1.0).alias("r"))
+        assert_tpu_and_cpu_are_equal(q)
+
+    def test_atanh_in_domain(self):
+        def q(s):
+            df = gen_df(s, seed=92, n=300, a=T.DoubleType)
+            # a / (abs(a) + 1) is in (-1, 1)
+            return df.select(
+                f.atanh(col("a") / (f.abs(col("a")) + 1.0)).alias("r"))
+        assert_tpu_and_cpu_are_equal(q)
+
+    def test_at_least_n_non_nulls(self):
+        from spark_rapids_tpu.plan.logical import ColumnExpr
+
+        def q(s):
+            df = gen_df(s, seed=93, n=400, a=T.DoubleType, b=T.IntegerType,
+                        c=T.StringType)
+            pred = ColumnExpr("AtLeastNNonNulls",
+                              (2, (col("a"), col("b"), col("c"))))
+            return df.filter(pred)
+        assert_tpu_and_cpu_are_equal(q)
+
+    def test_normalize_nan_and_zero(self):
+        from spark_rapids_tpu.plan.logical import ColumnExpr
+
+        def q(s):
+            df = s.from_pydict(
+                {"a": [0.0, -0.0, 1.5, None, float("nan"), -2.25]},
+                T.schema_of(a=T.DoubleType))
+            norm = ColumnExpr("NormalizeNaNAndZero", (col("a"),))
+            known = ColumnExpr("KnownFloatingPointNormalized", (norm,))
+            # 1/x distinguishes -0.0 (-inf) from 0.0 (+inf): after
+            # normalization both must be +inf
+            return df.select((1.0 / known.alias("n")).alias("inv"))
+        assert_tpu_and_cpu_are_equal(q)
+
+    def test_time_sub(self):
+        from spark_rapids_tpu.plan.logical import ColumnExpr, lit
+
+        def q(s):
+            df = s.from_pydict(
+                {"t": [0, 1_600_000_000_000_000, None,
+                       -9_000_000_000_000, 86_400_000_000]},
+                T.schema_of(t=T.TimestampType))
+            sub = ColumnExpr("TimeSub", (col("t"), lit(3_600_000_000)))
+            add = ColumnExpr("TimeAdd", (col("t"), lit(1_000_000)))
+            return df.select(sub.alias("s"), add.alias("a"))
+        assert_tpu_and_cpu_are_equal(q)
+
+    def test_input_file_name_parquet(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        f1 = str(tmp_path / "part1.parquet")
+        f2 = str(tmp_path / "part2.parquet")
+        pq.write_table(pa.table({"x": [1, 2, 3]}), f1)
+        pq.write_table(pa.table({"x": [10, 20]}), f2)
+
+        def q(s):
+            df = s.read.parquet(str(tmp_path))
+            return df.select(col("x"), f.input_file_name().alias("fn"),
+                             f.input_file_block_start().alias("bs"),
+                             f.input_file_block_length().alias("bl"))
+        rows = assert_tpu_and_cpu_are_equal(q)
+        by_file = {}
+        for x, fn, bs, bl in rows:
+            by_file.setdefault(fn, []).append(x)
+            assert bs == 0 and bl > 0
+        assert len(by_file) == 2
+        assert sorted(v for vs in by_file.values() for v in vs) == \
+            [1, 2, 3, 10, 20]
+
+    def test_input_file_name_memory_scan_is_empty(self):
+        def q(s):
+            df = s.from_pydict({"x": [1, 2]}, T.schema_of(x=T.IntegerType))
+            return df.select(f.input_file_name().alias("fn"))
+        rows = assert_tpu_and_cpu_are_equal(q)
+        assert all(r[0] == "" for r in rows)
+
+    def test_agg_func_kill_switch(self):
+        """Disabling one aggregate function forces the agg to CPU, like
+        the reference's per-expr conf for Sum (GpuOverrides.scala)."""
+        from spark_rapids_tpu.engine import TpuSession
+
+        def q(s):
+            df = gen_df(s, seed=95, n=200, k=T.IntegerType, v=T.LongType)
+            return df.group_by("k").agg(f.sum(col("v")).alias("sv"))
+        text = q(TpuSession({"spark.rapids.sql.expr.Sum": "false"})).explain()
+        assert "Sum has been disabled" in text
+        assert_tpu_and_cpu_are_equal(
+            q, conf={"spark.rapids.sql.expr.Sum": "false"})
