@@ -1,0 +1,6 @@
+"""TPC-DS-like benchmark subset (BASELINE.md staged config 3)."""
+from .datagen import generate, load_tables
+from .queries import QUERIES
+from .schema import SCHEMAS
+
+__all__ = ["generate", "load_tables", "QUERIES", "SCHEMAS"]

@@ -1,0 +1,170 @@
+"""TPC-DS-like data generator (structure-faithful, not dsdgen-exact).
+
+Row counts scale with `sf` like the spec (store_sales ~ 2.88M * sf); the
+foreign keys (store_sales -> every dimension) and the value domains the
+star-join queries filter on (manufacturer/manager ids, month/year windows,
+demographics tuples, promo channel flags, store names, zip prefixes,
+hour/minute buckets) are generated so each query selects a meaningful,
+non-empty subset at tiny scale factors."""
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+GENDERS = ["M", "F"]
+MARITAL = ["S", "M", "D", "W", "U"]
+EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree",
+             "4 yr Degree", "Advanced Degree", "Unknown"]
+CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
+              "Men", "Music", "Shoes", "Sports", "Women"]
+DAY_NAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+             "Friday", "Saturday"]
+STORE_NAMES = ["ought", "able", "ese", "anti", "cally", "ation", "eing"]
+
+
+def generate(sf: float = 0.001, seed: int = 7):
+    """Returns {table_name: dict of column -> python list}."""
+    rng = np.random.RandomState(seed)
+    out = {}
+
+    # date_dim: one row per day, 1998-01-01 .. 2003-12-31 (the window the
+    # query templates' d_year in {1998..2002} filters land in)
+    start = datetime.date(1998, 1, 1)
+    end = datetime.date(2003, 12, 31)
+    n_days = (end - start).days + 1
+    dates = [start + datetime.timedelta(days=i) for i in range(n_days)]
+    first_sk = 2_450_815  # spec-like offset; value only needs consistency
+    out["date_dim"] = {
+        "d_date_sk": [first_sk + i for i in range(n_days)],
+        "d_date": [(d - _EPOCH).days for d in dates],
+        "d_year": [d.year for d in dates],
+        "d_moy": [d.month for d in dates],
+        "d_dom": [d.day for d in dates],
+        "d_qoy": [(d.month - 1) // 3 + 1 for d in dates],
+        "d_day_name": [DAY_NAMES[d.weekday() % 7] for d in dates],
+    }
+
+    # time_dim at minute granularity (86400-second spec table folded x60)
+    out["time_dim"] = {
+        "t_time_sk": list(range(1440)),
+        "t_hour": [m // 60 for m in range(1440)],
+        "t_minute": [m % 60 for m in range(1440)],
+    }
+
+    n_item = max(40, int(18_000 * sf))
+    brand_id = (rng.randint(1, 11, n_item) * 1_000_000
+                + rng.randint(1, 17, n_item))
+    cat_id = rng.randint(1, len(CATEGORIES) + 1, n_item)
+    out["item"] = {
+        "i_item_sk": list(range(1, n_item + 1)),
+        "i_item_id": [f"AAAAAAAA{i:08d}" for i in range(1, n_item + 1)],
+        "i_brand_id": brand_id.tolist(),
+        "i_brand": [f"brand#{b % 97}" for b in brand_id],
+        "i_category_id": cat_id.tolist(),
+        "i_category": [CATEGORIES[c - 1] for c in cat_id],
+        # ids cycle so every query parameter selects a non-empty subset at
+        # tiny scale factors (the spec's substitution parameters are drawn
+        # from the populated domain the same way)
+        "i_manufact_id": [(i * 13) % 20 + 1 for i in range(n_item)],
+        "i_manufact": [f"manufact#{(i * 13) % 20 + 1}"
+                       for i in range(n_item)],
+        "i_manager_id": [(i * 7) % 40 + 1 for i in range(n_item)],
+        "i_current_price": np.round(rng.uniform(0.5, 100.0, n_item),
+                                    2).tolist(),
+    }
+
+    # demographics is a CROSS PRODUCT in the spec (1,920,800 rows = every
+    # combination repeated): cycle the 2x5x7 tuple space so every queried
+    # tuple exists at any scale
+    n_cd = max(70, int(1_920_800 * sf * 0.01))
+    combos = [(g, m, e) for g in GENDERS for m in MARITAL
+              for e in EDUCATION]
+    out["customer_demographics"] = {
+        "cd_demo_sk": list(range(1, n_cd + 1)),
+        "cd_gender": [combos[i % 70][0] for i in range(n_cd)],
+        "cd_marital_status": [combos[i % 70][1] for i in range(n_cd)],
+        "cd_education_status": [combos[i % 70][2] for i in range(n_cd)],
+    }
+
+    n_hd = max(10, int(7_200 * sf * 10))
+    out["household_demographics"] = {
+        "hd_demo_sk": list(range(1, n_hd + 1)),
+        "hd_dep_count": rng.randint(0, 10, n_hd).tolist(),
+        "hd_vehicle_count": rng.randint(0, 5, n_hd).tolist(),
+    }
+
+    n_promo = max(5, int(300 * sf * 10))
+    out["promotion"] = {
+        "p_promo_sk": list(range(1, n_promo + 1)),
+        "p_channel_email": ["Y" if r < 0.5 else "N"
+                            for r in rng.rand(n_promo)],
+        "p_channel_event": ["Y" if r < 0.3 else "N"
+                            for r in rng.rand(n_promo)],
+    }
+
+    n_store = max(4, int(1_002 * sf * 2))
+    out["store"] = {
+        "s_store_sk": list(range(1, n_store + 1)),
+        "s_store_name": [STORE_NAMES[i % len(STORE_NAMES)]
+                         for i in range(n_store)],
+        "s_zip": [f"{rng.randint(10000, 99999)}" for _ in range(n_store)],
+        "s_number_employees": rng.randint(200, 301, n_store).tolist(),
+    }
+
+    n_ca = max(20, int(50_000 * sf))
+    out["customer_address"] = {
+        "ca_address_sk": list(range(1, n_ca + 1)),
+        "ca_zip": [f"{rng.randint(10000, 99999)}" for _ in range(n_ca)],
+        "ca_gmt_offset": rng.choice([-10.0, -9.0, -8.0, -7.0, -6.0, -5.0],
+                                    n_ca).tolist(),
+    }
+
+    n_cust = max(30, int(100_000 * sf))
+    out["customer"] = {
+        "c_customer_sk": list(range(1, n_cust + 1)),
+        "c_customer_id": [f"CUST{i:011d}" for i in range(1, n_cust + 1)],
+        "c_current_addr_sk": rng.randint(1, n_ca + 1, n_cust).tolist(),
+        "c_birth_month": rng.randint(1, 13, n_cust).tolist(),
+    }
+
+    n_ss = max(300, int(2_880_000 * sf))
+    date_sks = np.array(out["date_dim"]["d_date_sk"])
+    out["store_sales"] = {
+        "ss_sold_date_sk": rng.choice(date_sks, n_ss).tolist(),
+        "ss_sold_time_sk": rng.randint(0, 1440, n_ss).tolist(),
+        "ss_item_sk": rng.randint(1, n_item + 1, n_ss).tolist(),
+        "ss_customer_sk": rng.randint(1, n_cust + 1, n_ss).tolist(),
+        "ss_cdemo_sk": rng.randint(1, n_cd + 1, n_ss).tolist(),
+        "ss_hdemo_sk": rng.randint(1, n_hd + 1, n_ss).tolist(),
+        "ss_addr_sk": rng.randint(1, n_ca + 1, n_ss).tolist(),
+        "ss_store_sk": rng.randint(1, n_store + 1, n_ss).tolist(),
+        "ss_promo_sk": rng.randint(1, n_promo + 1, n_ss).tolist(),
+        "ss_ticket_number": list(range(1, n_ss + 1)),
+        "ss_quantity": rng.randint(1, 101, n_ss).tolist(),
+        "ss_list_price": np.round(rng.uniform(1.0, 200.0, n_ss),
+                                  2).tolist(),
+        "ss_sales_price": np.round(rng.uniform(0.5, 180.0, n_ss),
+                                   2).tolist(),
+        "ss_ext_discount_amt": np.round(rng.uniform(0.0, 500.0, n_ss),
+                                        2).tolist(),
+        "ss_ext_sales_price": np.round(rng.uniform(1.0, 2000.0, n_ss),
+                                       2).tolist(),
+        "ss_ext_wholesale_cost": np.round(rng.uniform(1.0, 1000.0, n_ss),
+                                          2).tolist(),
+        "ss_coupon_amt": np.round(rng.uniform(0.0, 100.0, n_ss),
+                                  2).tolist(),
+        "ss_net_profit": np.round(rng.uniform(-500.0, 500.0, n_ss),
+                                  2).tolist(),
+    }
+    return out
+
+
+def load_tables(session, sf: float = 0.001, seed: int = 7):
+    """{name: DataFrame} on the given session."""
+    from .schema import SCHEMAS
+    data = generate(sf, seed)
+    return {name: session.from_pydict(data[name], SCHEMAS[name])
+            for name in SCHEMAS}

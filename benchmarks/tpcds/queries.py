@@ -1,0 +1,136 @@
+"""TPC-DS star-join queries in the DataFrame API (public TPC-DS spec
+templates, expressed in this repo's own DSL — BASELINE.md staged config 3).
+
+Each `qN(t)` takes {table_name: DataFrame} and returns a DataFrame.  The
+shapes exercised: dimension broadcast joins into the store_sales fact,
+multi-dimension chains, string-prefix anti-conditions (q19), and the
+pure-count multi-way join (q96)."""
+from __future__ import annotations
+
+from spark_rapids_tpu.plan.logical import col, functions as F, lit
+
+
+def q3(t):
+    """Brand revenue by year for one manufacturer in November."""
+    dd = t["date_dim"].filter(col("d_moy") == 11)
+    it = t["item"].filter(col("i_manufact_id") == 12)
+    return (dd.join(t["store_sales"],
+                    on=col("d_date_sk") == col("ss_sold_date_sk"))
+            .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+            .group_by(col("d_year"), col("i_brand_id"), col("i_brand"))
+            .agg(F.sum(col("ss_ext_discount_amt")).alias("sum_agg"))
+            .order_by(col("d_year"), col("sum_agg").desc(),
+                      col("i_brand_id"))
+            .limit(100))
+
+
+def q7(t):
+    """Average sales metrics per item for one demographics tuple with a
+    non-event/non-email promotion."""
+    cd = t["customer_demographics"].filter(
+        (col("cd_gender") == "M") & (col("cd_marital_status") == "S")
+        & (col("cd_education_status") == "College"))
+    dd = t["date_dim"].filter(col("d_year") == 2000)
+    pr = t["promotion"].filter((col("p_channel_email") == "N")
+                               | (col("p_channel_event") == "N"))
+    return (t["store_sales"]
+            .join(cd, on=col("ss_cdemo_sk") == col("cd_demo_sk"))
+            .join(dd, on=col("ss_sold_date_sk") == col("d_date_sk"))
+            .join(t["item"], on=col("ss_item_sk") == col("i_item_sk"))
+            .join(pr, on=col("ss_promo_sk") == col("p_promo_sk"))
+            .group_by(col("i_item_id"))
+            .agg(F.avg(col("ss_quantity")).alias("agg1"),
+                 F.avg(col("ss_list_price")).alias("agg2"),
+                 F.avg(col("ss_coupon_amt")).alias("agg3"),
+                 F.avg(col("ss_sales_price")).alias("agg4"))
+            .order_by(col("i_item_id"))
+            .limit(100))
+
+
+def q19(t):
+    """Brand revenue where the customer's zip prefix differs from the
+    store's (out-of-neighborhood purchases)."""
+    dd = t["date_dim"].filter((col("d_moy") == 11)
+                              & (col("d_year") == 1998))
+    it = t["item"].filter(col("i_manager_id") == 8)
+    joined = (dd.join(t["store_sales"],
+                      on=col("d_date_sk") == col("ss_sold_date_sk"))
+              .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+              .join(t["customer"],
+                    on=col("ss_customer_sk") == col("c_customer_sk"))
+              .join(t["customer_address"],
+                    on=col("c_current_addr_sk") == col("ca_address_sk"))
+              .join(t["store"], on=col("ss_store_sk") == col("s_store_sk"))
+              .filter(F.substring(col("ca_zip"), 1, 5)
+                      != F.substring(col("s_zip"), 1, 5)))
+    return (joined
+            .group_by(col("i_brand_id"), col("i_brand"),
+                      col("i_manufact_id"), col("i_manufact"))
+            .agg(F.sum(col("ss_ext_sales_price")).alias("ext_price"))
+            .order_by(col("ext_price").desc(), col("i_brand"),
+                      col("i_brand_id"), col("i_manufact_id"),
+                      col("i_manufact"))
+            .limit(100))
+
+
+def q42(t):
+    """Category revenue for one manager's items in November."""
+    dd = t["date_dim"].filter((col("d_moy") == 11)
+                              & (col("d_year") == 2000))
+    it = t["item"].filter(col("i_manager_id") == 1)
+    return (dd.join(t["store_sales"],
+                    on=col("d_date_sk") == col("ss_sold_date_sk"))
+            .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+            .group_by(col("d_year"), col("i_category_id"),
+                      col("i_category"))
+            .agg(F.sum(col("ss_ext_sales_price")).alias("total_sales"))
+            .order_by(col("total_sales").desc(), col("d_year"),
+                      col("i_category_id"), col("i_category"))
+            .limit(100))
+
+
+def q52(t):
+    """Brand revenue for one manager's items in November (brand cut of
+    q42)."""
+    dd = t["date_dim"].filter((col("d_moy") == 11)
+                              & (col("d_year") == 2000))
+    it = t["item"].filter(col("i_manager_id") == 1)
+    return (dd.join(t["store_sales"],
+                    on=col("d_date_sk") == col("ss_sold_date_sk"))
+            .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+            .group_by(col("d_year"), col("i_brand"), col("i_brand_id"))
+            .agg(F.sum(col("ss_ext_sales_price")).alias("ext_price"))
+            .order_by(col("d_year"), col("ext_price").desc(),
+                      col("i_brand_id"))
+            .limit(100))
+
+
+def q55(t):
+    """Brand revenue for one manager in one month."""
+    dd = t["date_dim"].filter((col("d_moy") == 11)
+                              & (col("d_year") == 1999))
+    it = t["item"].filter(col("i_manager_id") == 28)
+    return (dd.join(t["store_sales"],
+                    on=col("d_date_sk") == col("ss_sold_date_sk"))
+            .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+            .group_by(col("i_brand_id"), col("i_brand"))
+            .agg(F.sum(col("ss_ext_sales_price")).alias("ext_price"))
+            .order_by(col("ext_price").desc(), col("i_brand_id"))
+            .limit(100))
+
+
+def q96(t):
+    """Count of evening purchases by high-dependent-count households at
+    one store."""
+    td = t["time_dim"].filter((col("t_hour") == 20)
+                              & (col("t_minute") >= 30))
+    hd = t["household_demographics"].filter(col("hd_dep_count") == 7)
+    st = t["store"].filter(col("s_store_name") == "ese")
+    return (t["store_sales"]
+            .join(hd, on=col("ss_hdemo_sk") == col("hd_demo_sk"))
+            .join(td, on=col("ss_sold_time_sk") == col("t_time_sk"))
+            .join(st, on=col("ss_store_sk") == col("s_store_sk"))
+            .agg(F.count(lit(1)).alias("cnt")))
+
+
+QUERIES = {3: q3, 7: q7, 19: q19, 42: q42, 52: q52, 55: q55, 96: q96}

@@ -1,0 +1,68 @@
+"""TPC-DS table schemas (subset backing the q3/q7/q19/q42/q52/q55/q96
+star-join tier; columns trimmed to those the queries touch plus keys).
+Reference counterpart: the TPC-DS benchmark drivers the reference ships
+under integration_tests (BASELINE.md staged config 3: TPC-DS q3/q5
+broadcast + shuffled hash joins)."""
+from spark_rapids_tpu.types import (DateType, DoubleType, LongType, Schema,
+                                    StringType, StructField as F)
+
+DATE_DIM = Schema([
+    F("d_date_sk", LongType), F("d_date", DateType),
+    F("d_year", LongType), F("d_moy", LongType), F("d_dom", LongType),
+    F("d_qoy", LongType), F("d_day_name", StringType)])
+
+ITEM = Schema([
+    F("i_item_sk", LongType), F("i_item_id", StringType),
+    F("i_brand_id", LongType), F("i_brand", StringType),
+    F("i_category_id", LongType), F("i_category", StringType),
+    F("i_manufact_id", LongType), F("i_manufact", StringType),
+    F("i_manager_id", LongType), F("i_current_price", DoubleType)])
+
+STORE_SALES = Schema([
+    F("ss_sold_date_sk", LongType), F("ss_sold_time_sk", LongType),
+    F("ss_item_sk", LongType), F("ss_customer_sk", LongType),
+    F("ss_cdemo_sk", LongType), F("ss_hdemo_sk", LongType),
+    F("ss_addr_sk", LongType), F("ss_store_sk", LongType),
+    F("ss_promo_sk", LongType), F("ss_ticket_number", LongType),
+    F("ss_quantity", LongType), F("ss_list_price", DoubleType),
+    F("ss_sales_price", DoubleType), F("ss_ext_discount_amt", DoubleType),
+    F("ss_ext_sales_price", DoubleType),
+    F("ss_ext_wholesale_cost", DoubleType), F("ss_coupon_amt", DoubleType),
+    F("ss_net_profit", DoubleType)])
+
+CUSTOMER_DEMOGRAPHICS = Schema([
+    F("cd_demo_sk", LongType), F("cd_gender", StringType),
+    F("cd_marital_status", StringType),
+    F("cd_education_status", StringType)])
+
+PROMOTION = Schema([
+    F("p_promo_sk", LongType), F("p_channel_email", StringType),
+    F("p_channel_event", StringType)])
+
+CUSTOMER = Schema([
+    F("c_customer_sk", LongType), F("c_customer_id", StringType),
+    F("c_current_addr_sk", LongType), F("c_birth_month", LongType)])
+
+CUSTOMER_ADDRESS = Schema([
+    F("ca_address_sk", LongType), F("ca_zip", StringType),
+    F("ca_gmt_offset", DoubleType)])
+
+STORE = Schema([
+    F("s_store_sk", LongType), F("s_store_name", StringType),
+    F("s_zip", StringType), F("s_number_employees", LongType)])
+
+HOUSEHOLD_DEMOGRAPHICS = Schema([
+    F("hd_demo_sk", LongType), F("hd_dep_count", LongType),
+    F("hd_vehicle_count", LongType)])
+
+TIME_DIM = Schema([
+    F("t_time_sk", LongType), F("t_hour", LongType),
+    F("t_minute", LongType)])
+
+SCHEMAS = {
+    "date_dim": DATE_DIM, "item": ITEM, "store_sales": STORE_SALES,
+    "customer_demographics": CUSTOMER_DEMOGRAPHICS, "promotion": PROMOTION,
+    "customer": CUSTOMER, "customer_address": CUSTOMER_ADDRESS,
+    "store": STORE, "household_demographics": HOUSEHOLD_DEMOGRAPHICS,
+    "time_dim": TIME_DIM,
+}

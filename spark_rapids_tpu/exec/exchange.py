@@ -106,28 +106,17 @@ class TpuShuffleExchangeExec(TpuExec):
                           env.write_partition(sid, map_id, p, sub))
 
         from ..config import SHUFFLE_ASYNC_FETCH
-        _coalesced = _coalesce_parts
         try:
             with self.metrics.timer("shuffleReadTime"):
                 if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
                     # pipelined: the producer thread fetches partition k+1
                     # while the consumer is still on k
-                    it = env.fetch_partitions_async(sid, range(n))
-                    next_p = 0
-                    parts: list = []
-                    for rid, batch in it:
-                        while next_p < rid:  # rids arrive non-decreasing
-                            yield next_p, _coalesced(parts)
-                            parts = []
-                            next_p += 1
-                        parts.append(batch)
-                    while next_p < n:
-                        yield next_p, _coalesced(parts)
-                        parts = []
-                        next_p += 1
+                    yield from _drain_async(
+                        env.fetch_partitions_async(sid, range(n)), n)
                 else:
                     for p in range(n):
-                        yield p, _coalesced(list(env.fetch_partition(sid, p)))
+                        yield p, _coalesce_parts(
+                            list(env.fetch_partition(sid, p)))
         finally:
             env.remove_shuffle(sid)
 
@@ -184,22 +173,10 @@ class TpuShuffleExchangeExec(TpuExec):
                     # same pipelining as the single-executor path: remote
                     # transport round-trips overlap consumption
                     from ..shuffle.fetch import AsyncFetchIterator
-                    it = AsyncFetchIterator(
+                    yield from _drain_async(AsyncFetchIterator(
                         None, sid, range(n), None,
                         int(ctx.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
-                        route=_route)
-                    next_p = 0
-                    parts: list = []
-                    for rid, batch in it:
-                        while next_p < rid:
-                            yield next_p, _coalesce_parts(parts)
-                            parts = []
-                            next_p += 1
-                        parts.append(batch)
-                    while next_p < n:
-                        yield next_p, _coalesce_parts(parts)
-                        parts = []
-                        next_p += 1
+                        route=_route), n)
                 else:
                     for p in range(n):
                         owner, peers = _route(p)
@@ -208,6 +185,24 @@ class TpuShuffleExchangeExec(TpuExec):
                         yield p, _coalesce_parts(parts)
         finally:
             cluster.remove_shuffle(sid)
+
+
+def _drain_async(it, n: int):
+    """Consume an AsyncFetchIterator's (rid, batch) stream (rids arrive
+    non-decreasing) back into (partition, coalesced-batch) order, emitting
+    every partition 0..n-1 exactly once (empty ones included)."""
+    next_p = 0
+    parts: list = []
+    for rid, batch in it:
+        while next_p < rid:
+            yield next_p, _coalesce_parts(parts)
+            parts = []
+            next_p += 1
+        parts.append(batch)
+    while next_p < n:
+        yield next_p, _coalesce_parts(parts)
+        parts = []
+        next_p += 1
 
 
 def _coalesce_parts(parts):

@@ -45,12 +45,6 @@ class CsvDeviceUnsupported(Exception):
     pass
 
 
-def _opt_bool(v) -> bool:
-    if isinstance(v, str):
-        return v.strip().lower() in ("true", "1", "yes")
-    return bool(v)
-
-
 def _tokenize(raw: np.ndarray, sep: int, header: bool):
     """Host control plane: (starts, lengths) int64 matrices of shape
     (rows, ncols-as-found) from one delimiter scan.  Raises
@@ -151,6 +145,8 @@ def device_csv_batches(files, schema: Schema, options: dict, conf,
 
     from .. import config as C
     from ..ops.expressions import clear_input_file, publish_input_file
+
+    from .scan import _opt_bool
 
     sep = options.get("sep", options.get("delimiter", ","))
     if not isinstance(sep, str) or len(sep.encode()) != 1:

@@ -127,27 +127,27 @@ class _ThriftWriter:
 
 def _rle_def_levels(valid: np.ndarray) -> bytes:
     """Definition levels (0/1, bit width 1) as parquet RLE: 4-byte LE
-    length prefix + run-length runs (varint(count << 1) + value byte)."""
+    length prefix + run-length runs (varint(count << 1) + value byte).
+    Run boundaries come from one vectorized diff, so Python work is
+    O(runs), not O(rows)."""
     out = bytearray()
     n = valid.size
-    i = 0
     v = valid.astype(np.uint8)
-    while i < n:
-        j = i
-        while j < n and v[j] == v[i]:
-            j += 1
-        count = j - i
-        header = count << 1
-        while True:
-            b = header & 0x7F
-            header >>= 7
-            if header:
-                out.append(b | 0x80)
-            else:
-                out.append(b)
-                break
-        out.append(int(v[i]))
-        i = j
+    if n:
+        bounds = np.flatnonzero(np.diff(v)) + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [n]])
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            header = (e - s) << 1
+            while True:
+                b = header & 0x7F
+                header >>= 7
+                if header:
+                    out.append(b | 0x80)
+                else:
+                    out.append(b)
+                    break
+            out.append(int(v[s]))
     return struct.pack("<I", len(out)) + bytes(out)
 
 

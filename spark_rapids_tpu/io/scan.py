@@ -657,8 +657,18 @@ class TpuFileScanExec(TpuExec):
     def describe(self):
         return f"TpuFileScanExec[{self.fmt}, files={len(self.files)}]"
 
-    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
-        produced = False
+    def _host_batches(self, paths, ctx) -> Iterator[ColumnarBatch]:
+        """Host decode + H2D for `paths` (the fallback tail every device
+        branch shares)."""
+        for table in _host_chunks(self.fmt, paths, self._schema,
+                                  self.options, ctx.conf, self.metrics):
+            with self.metrics.timer("scanTime"):
+                batch = ColumnarBatch.from_arrow(table)
+            self.metrics.add("numOutputRows", table.num_rows)
+            self.metrics.add("numOutputBatches", 1)
+            yield batch
+
+    def _batches(self, ctx) -> Iterator[ColumnarBatch]:
         if self.fmt == "csv" and ctx.conf.get(C.CSV_DEVICE_DECODE) \
                 and not self.options.get("__partitions__"):
             from .csv_device import CsvDeviceUnsupported, device_csv_batches
@@ -673,21 +683,9 @@ class TpuFileScanExec(TpuExec):
                         self.metrics.add("numOutputBatches", 1)
                         self.metrics.add("numDeviceDecodedColumns",
                                          len(self._schema))
-                        produced = True
                         yield batch
                 except CsvDeviceUnsupported:
-                    for table in _host_chunks(
-                            "csv", [path], self._schema, self.options,
-                            ctx.conf, self.metrics):
-                        with self.metrics.timer("scanTime"):
-                            batch = ColumnarBatch.from_arrow(table)
-                        self.metrics.add("numOutputRows", table.num_rows)
-                        self.metrics.add("numOutputBatches", 1)
-                        produced = True
-                        yield batch
-            if not produced:
-                yield ColumnarBatch.from_pydict(
-                    {f.name: [] for f in self._schema}, self._schema)
+                    yield from self._host_batches([path], ctx)
             return
         if self.fmt == "parquet" \
                 and ctx.conf.get(C.PARQUET_DEVICE_DECODE) \
@@ -697,18 +695,13 @@ class TpuFileScanExec(TpuExec):
                     self.metrics):
                 self.metrics.add("numOutputRows", batch.num_rows_host())
                 self.metrics.add("numOutputBatches", 1)
-                produced = True
                 yield batch
-            if not produced:
-                yield ColumnarBatch.from_pydict(
-                    {f.name: [] for f in self._schema}, self._schema)
             return
-        for table in _host_chunks(self.fmt, self.files, self._schema,
-                                  self.options, ctx.conf, self.metrics):
-            with self.metrics.timer("scanTime"):
-                batch = ColumnarBatch.from_arrow(table)
-            self.metrics.add("numOutputRows", table.num_rows)
-            self.metrics.add("numOutputBatches", 1)
+        yield from self._host_batches(self.files, ctx)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        produced = False
+        for batch in self._batches(ctx):
             produced = True
             yield batch
         if not produced:

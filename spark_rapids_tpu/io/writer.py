@@ -150,7 +150,12 @@ class TpuDataWritingExec(TpuExec):
     def _device_encode_ok(self, ctx) -> bool:
         from .. import config as C
         from .parquet_device_write import _TYPE_MAP
+        # codecs beyond snappy/uncompressed (gzip, zstd, ...) only exist in
+        # the host arrow encoder — fall back rather than silently writing
+        # uncompressed
+        codec = str(self.options.get("compression", "snappy")).lower()
         return (self.fmt == "parquet" and not self.partition_by
+                and codec in ("snappy", "none", "uncompressed")
                 and ctx.conf.get(C.PARQUET_DEVICE_ENCODE)
                 and all(f.dtype in _TYPE_MAP for f in self.schema))
 

@@ -1,0 +1,70 @@
+"""TPC-DS star-join subset: CPU-vs-TPU oracle (the same coverage model as
+tests/test_tpch.py; reference: the TPC-DS drivers under the reference's
+integration_tests and BASELINE.md staged config 3)."""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchmarks.tpcds import QUERIES, load_tables  # noqa: E402
+from compare import assert_rows_equal  # noqa: E402
+from spark_rapids_tpu.engine import TpuSession  # noqa: E402
+
+SF = 0.002
+
+
+def run_query(qnum: int, conf: dict):
+    s = TpuSession(conf)
+    tables = load_tables(s, sf=SF)
+    return QUERIES[qnum](tables).collect()
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpcds_query(qnum):
+    cpu = run_query(qnum, {"spark.rapids.sql.enabled": "false"})
+    tpu = run_query(qnum, {})
+    assert len(cpu) > 0 or qnum in (19,), f"q{qnum} selected nothing"
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=True)
+
+
+def test_tpcds_all_device():
+    """Every subset query plans fully on-device with variableFloatAgg on
+    (the bench conf), like the TPC-H suite."""
+    conf = {"spark.rapids.sql.variableFloatAgg.enabled": "true"}
+    for qnum in sorted(QUERIES):
+        s = TpuSession(dict(conf))
+        tables = load_tables(s, sf=SF)
+        plan = s.plan(QUERIES[qnum](tables).plan)
+        bad = set()
+
+        def walk(n):
+            if type(n).__name__.startswith("Cpu"):
+                bad.add(type(n).__name__)
+            for c in n.children:
+                walk(c)
+        walk(plan)
+        assert not bad, f"q{qnum} fell back: {sorted(bad)}"
+
+
+def test_tpcds_q96_value():
+    """Anchor the count query against an independently computed value."""
+    import numpy as np
+    from benchmarks.tpcds import generate
+    data = generate(SF)
+    ss = data["store_sales"]
+    hd = data["household_demographics"]
+    td = data["time_dim"]
+    st = data["store"]
+    hd_ok = {sk for sk, dc in zip(hd["hd_demo_sk"], hd["hd_dep_count"])
+             if dc == 7}
+    td_ok = {sk for sk, h, m in zip(td["t_time_sk"], td["t_hour"],
+                                    td["t_minute"]) if h == 20 and m >= 30}
+    st_ok = {sk for sk, n in zip(st["s_store_sk"], st["s_store_name"])
+             if n == "ese"}
+    want = sum(1 for h, t, s in zip(ss["ss_hdemo_sk"], ss["ss_sold_time_sk"],
+                                    ss["ss_store_sk"])
+               if h in hd_ok and t in td_ok and s in st_ok)
+    got = run_query(96, {})
+    assert got == [(want,)], (got, want)
