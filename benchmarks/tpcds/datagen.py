@@ -44,7 +44,8 @@ def generate(sf: float = 0.001, seed: int = 7):
         "d_moy": [d.month for d in dates],
         "d_dom": [d.day for d in dates],
         "d_qoy": [(d.month - 1) // 3 + 1 for d in dates],
-        "d_day_name": [DAY_NAMES[d.weekday() % 7] for d in dates],
+        # weekday() is Monday=0; DAY_NAMES is Sunday-first
+        "d_day_name": [DAY_NAMES[(d.weekday() + 1) % 7] for d in dates],
     }
 
     # time_dim at minute granularity (86400-second spec table folded x60)
@@ -158,6 +159,74 @@ def generate(sf: float = 0.001, seed: int = 7):
                                   2).tolist(),
         "ss_net_profit": np.round(rng.uniform(-500.0, 500.0, n_ss),
                                   2).tolist(),
+    }
+    # returns + catalog/web channels (q5's three-channel union)
+    n_sr = max(60, int(287_000 * sf))
+    out["store_returns"] = {
+        "sr_returned_date_sk": rng.choice(date_sks, n_sr).tolist(),
+        "sr_store_sk": rng.randint(1, n_store + 1, n_sr).tolist(),
+        "sr_return_amt": np.round(rng.uniform(1.0, 800.0, n_sr),
+                                  2).tolist(),
+        "sr_net_loss": np.round(rng.uniform(0.5, 300.0, n_sr), 2).tolist(),
+    }
+
+    n_cp = max(6, int(11_718 * sf))
+    out["catalog_page"] = {
+        "cp_catalog_page_sk": list(range(1, n_cp + 1)),
+        "cp_catalog_page_id": [f"CPAG{i:012d}" for i in range(1, n_cp + 1)],
+    }
+
+    n_cs = max(150, int(1_440_000 * sf))
+    out["catalog_sales"] = {
+        "cs_sold_date_sk": rng.choice(date_sks, n_cs).tolist(),
+        "cs_catalog_page_sk": rng.randint(1, n_cp + 1, n_cs).tolist(),
+        "cs_item_sk": rng.randint(1, n_item + 1, n_cs).tolist(),
+        "cs_order_number": list(range(1, n_cs + 1)),
+        "cs_ext_sales_price": np.round(rng.uniform(1.0, 2000.0, n_cs),
+                                       2).tolist(),
+        "cs_net_profit": np.round(rng.uniform(-400.0, 600.0, n_cs),
+                                  2).tolist(),
+    }
+
+    n_cr = max(30, int(144_000 * sf))
+    out["catalog_returns"] = {
+        "cr_returned_date_sk": rng.choice(date_sks, n_cr).tolist(),
+        "cr_catalog_page_sk": rng.randint(1, n_cp + 1, n_cr).tolist(),
+        "cr_return_amount": np.round(rng.uniform(1.0, 900.0, n_cr),
+                                     2).tolist(),
+        "cr_net_loss": np.round(rng.uniform(0.5, 400.0, n_cr), 2).tolist(),
+    }
+
+    n_web = max(3, int(30 * sf * 10))
+    out["web_site"] = {
+        "web_site_sk": list(range(1, n_web + 1)),
+        "web_site_id": [f"WSIT{i:012d}" for i in range(1, n_web + 1)],
+    }
+
+    n_ws = max(100, int(720_000 * sf))
+    out["web_sales"] = {
+        "ws_sold_date_sk": rng.choice(date_sks, n_ws).tolist(),
+        "ws_web_site_sk": rng.randint(1, n_web + 1, n_ws).tolist(),
+        "ws_item_sk": rng.randint(1, n_item + 1, n_ws).tolist(),
+        "ws_order_number": list(range(1, n_ws + 1)),
+        "ws_ext_sales_price": np.round(rng.uniform(1.0, 1500.0, n_ws),
+                                       2).tolist(),
+        "ws_net_profit": np.round(rng.uniform(-300.0, 500.0, n_ws),
+                                  2).tolist(),
+    }
+
+    # web returns reference a sold web order (item, order) so the q5 left
+    # join resolves a site for most returns
+    n_wr = max(20, int(72_000 * sf))
+    wr_pick = rng.randint(0, n_ws, n_wr)
+    out["web_returns"] = {
+        "wr_returned_date_sk": rng.choice(date_sks, n_wr).tolist(),
+        "wr_item_sk": [out["web_sales"]["ws_item_sk"][i] for i in wr_pick],
+        "wr_order_number": [out["web_sales"]["ws_order_number"][i]
+                            for i in wr_pick],
+        "wr_return_amt": np.round(rng.uniform(1.0, 700.0, n_wr),
+                                  2).tolist(),
+        "wr_net_loss": np.round(rng.uniform(0.5, 350.0, n_wr), 2).tolist(),
     }
     return out
 

@@ -24,6 +24,87 @@ def q3(t):
             .limit(100))
 
 
+def q5(t):
+    """Sales/returns/profit per channel over a 14-day window, rolled up by
+    (channel, id) — the reference's headline TPCxBB-era shape: three
+    union'd sales+returns channels, a dimension join each, and a ROLLUP
+    aggregate (BASELINE staged config 3)."""
+    dd = t["date_dim"].filter((col("d_date") >= "2000-08-23")
+                              & (col("d_date") <= "2000-09-06"))
+
+    def channel(sales, returns, sales_cols, ret_cols, dim, dim_key,
+                dim_id, prefix):
+        """One channel: union sales rows (returns zeroed) with return rows
+        (sales zeroed), join the date window and the channel dimension,
+        aggregate per dimension id."""
+        s_key, s_date, s_price, s_profit = sales_cols
+        r_key, r_date, r_amt, r_loss = ret_cols
+        s_part = sales.select(
+            col(s_key).alias("page_sk"), col(s_date).alias("date_sk"),
+            col(s_price).alias("sales_price"),
+            col(s_profit).alias("profit"),
+            (col(s_price) * 0.0).alias("return_amt"),
+            (col(s_price) * 0.0).alias("net_loss"))
+        r_part = returns.select(
+            col(r_key).alias("page_sk"), col(r_date).alias("date_sk"),
+            (col(r_amt) * 0.0).alias("sales_price"),
+            (col(r_amt) * 0.0).alias("profit"),
+            col(r_amt).alias("return_amt"), col(r_loss).alias("net_loss"))
+        return (s_part.union(r_part)
+                .join(dd, on=col("date_sk") == col("d_date_sk"))
+                .join(dim, on=col("page_sk") == col(dim_key))
+                .group_by(col(dim_id))
+                .agg(F.sum(col("sales_price")).alias("sales"),
+                     F.sum(col("return_amt")).alias("returns"),
+                     F.sum(col("profit") - col("net_loss")).alias("profit"))
+                .select(lit(prefix[0]).alias("channel"),
+                        col(dim_id).alias("id"), col("sales"),
+                        col("returns"), col("profit")))
+
+    ssr = channel(
+        t["store_sales"], t["store_returns"],
+        ("ss_store_sk", "ss_sold_date_sk", "ss_ext_sales_price",
+         "ss_net_profit"),
+        ("sr_store_sk", "sr_returned_date_sk", "sr_return_amt",
+         "sr_net_loss"),
+        t["store"], "s_store_sk", "s_store_name", ("store channel",))
+    csr = channel(
+        t["catalog_sales"], t["catalog_returns"],
+        ("cs_catalog_page_sk", "cs_sold_date_sk", "cs_ext_sales_price",
+         "cs_net_profit"),
+        ("cr_catalog_page_sk", "cr_returned_date_sk", "cr_return_amount",
+         "cr_net_loss"),
+        t["catalog_page"], "cp_catalog_page_sk", "cp_catalog_page_id",
+        ("catalog channel",))
+    # web returns resolve their site through the originating sale
+    # (left outer on item+order, the spec's join)
+    wr = (t["web_returns"]
+          .join(t["web_sales"]
+                .select(col("ws_item_sk").alias("wsi"),
+                        col("ws_order_number").alias("wso"),
+                        col("ws_web_site_sk").alias("site_sk")),
+                on=(col("wr_item_sk") == col("wsi"))
+                & (col("wr_order_number") == col("wso")), how="left")
+          .select(col("site_sk").alias("wr_site_sk"),
+                  col("wr_returned_date_sk"), col("wr_return_amt"),
+                  col("wr_net_loss")))
+    wsr = channel(
+        t["web_sales"], wr,
+        ("ws_web_site_sk", "ws_sold_date_sk", "ws_ext_sales_price",
+         "ws_net_profit"),
+        ("wr_site_sk", "wr_returned_date_sk", "wr_return_amt",
+         "wr_net_loss"),
+        t["web_site"], "web_site_sk", "web_site_id", ("web channel",))
+
+    return (ssr.union(csr).union(wsr)
+            .rollup(col("channel"), col("id"))
+            .agg(F.sum(col("sales")).alias("sales"),
+                 F.sum(col("returns")).alias("returns"),
+                 F.sum(col("profit")).alias("profit"))
+            .order_by(col("channel"), col("id"))
+            .limit(100))
+
+
 def q7(t):
     """Average sales metrics per item for one demographics tuple with a
     non-event/non-email promotion."""
@@ -133,4 +214,5 @@ def q96(t):
             .agg(F.count(lit(1)).alias("cnt")))
 
 
-QUERIES = {3: q3, 7: q7, 19: q19, 42: q42, 52: q52, 55: q55, 96: q96}
+QUERIES = {3: q3, 5: q5, 7: q7, 19: q19, 42: q42, 52: q52, 55: q55,
+           96: q96}

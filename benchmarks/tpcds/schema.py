@@ -1,5 +1,5 @@
-"""TPC-DS table schemas (subset backing the q3/q7/q19/q42/q52/q55/q96
-star-join tier; columns trimmed to those the queries touch plus keys).
+"""TPC-DS table schemas (subset backing the q3/q5/q7/q19/q42/q52/q55/q96
+tier; columns trimmed to those the queries touch plus keys).
 Reference counterpart: the TPC-DS benchmark drivers the reference ships
 under integration_tests (BASELINE.md staged config 3: TPC-DS q3/q5
 broadcast + shuffled hash joins)."""
@@ -59,10 +59,42 @@ TIME_DIM = Schema([
     F("t_time_sk", LongType), F("t_hour", LongType),
     F("t_minute", LongType)])
 
+STORE_RETURNS = Schema([
+    F("sr_returned_date_sk", LongType), F("sr_store_sk", LongType),
+    F("sr_return_amt", DoubleType), F("sr_net_loss", DoubleType)])
+
+CATALOG_SALES = Schema([
+    F("cs_sold_date_sk", LongType), F("cs_catalog_page_sk", LongType),
+    F("cs_item_sk", LongType), F("cs_order_number", LongType),
+    F("cs_ext_sales_price", DoubleType), F("cs_net_profit", DoubleType)])
+
+CATALOG_RETURNS = Schema([
+    F("cr_returned_date_sk", LongType), F("cr_catalog_page_sk", LongType),
+    F("cr_return_amount", DoubleType), F("cr_net_loss", DoubleType)])
+
+WEB_SALES = Schema([
+    F("ws_sold_date_sk", LongType), F("ws_web_site_sk", LongType),
+    F("ws_item_sk", LongType), F("ws_order_number", LongType),
+    F("ws_ext_sales_price", DoubleType), F("ws_net_profit", DoubleType)])
+
+WEB_RETURNS = Schema([
+    F("wr_returned_date_sk", LongType), F("wr_item_sk", LongType),
+    F("wr_order_number", LongType), F("wr_return_amt", DoubleType),
+    F("wr_net_loss", DoubleType)])
+
+CATALOG_PAGE = Schema([
+    F("cp_catalog_page_sk", LongType), F("cp_catalog_page_id", StringType)])
+
+WEB_SITE = Schema([
+    F("web_site_sk", LongType), F("web_site_id", StringType)])
+
 SCHEMAS = {
     "date_dim": DATE_DIM, "item": ITEM, "store_sales": STORE_SALES,
     "customer_demographics": CUSTOMER_DEMOGRAPHICS, "promotion": PROMOTION,
     "customer": CUSTOMER, "customer_address": CUSTOMER_ADDRESS,
     "store": STORE, "household_demographics": HOUSEHOLD_DEMOGRAPHICS,
-    "time_dim": TIME_DIM,
+    "time_dim": TIME_DIM, "store_returns": STORE_RETURNS,
+    "catalog_sales": CATALOG_SALES, "catalog_returns": CATALOG_RETURNS,
+    "web_sales": WEB_SALES, "web_returns": WEB_RETURNS,
+    "catalog_page": CATALOG_PAGE, "web_site": WEB_SITE,
 }

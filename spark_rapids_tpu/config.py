@@ -199,6 +199,21 @@ MESH_DEVICES = _conf(
     "(exec/distributed.py); 0/1 keeps single-chip execution.  Must be a "
     "power of two and <= the local device count (falls back to single-chip "
     "when fewer devices exist).", int)
+MESH_COORDINATOR = _conf(
+    "spark.rapids.sql.tpu.mesh.coordinator", "",
+    "host:port of the jax.distributed coordinator for MULTI-HOST meshes "
+    "(empty = single host).  When set, session startup joins the "
+    "coordination service so jax.devices() enumerates every host's chips "
+    "and the SPMD mesh spans the pod; collectives ride ICI within a slice "
+    "and DCN across slices.  Process count/id come from the companion "
+    "confs or JAX_NUM_PROCESSES/JAX_PROCESS_ID.", str)
+MESH_NUM_PROCESSES = _conf(
+    "spark.rapids.sql.tpu.mesh.numProcesses", 0,
+    "Total processes in the multi-host mesh (0 = let jax infer from the "
+    "TPU runtime, which works on Cloud TPU pods).", int)
+MESH_PROCESS_ID = _conf(
+    "spark.rapids.sql.tpu.mesh.processId", 0,
+    "This process's id in [0, numProcesses) for multi-host bring-up.", int)
 MESH_USE_ALLGATHER = _conf(
     "spark.rapids.sql.tpu.mesh.useAllGather", False,
     "Use the sel-mask all-gather exchange instead of the compact quota "

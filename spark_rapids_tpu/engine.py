@@ -243,6 +243,13 @@ class DataFrame:
 
     groupBy = group_by
 
+    def rollup(self, *cols) -> "GroupedData":
+        """GROUP BY ROLLUP: grouping sets {(k1..kn), (k1..kn-1), ..., ()}
+        planned as an Expand fan-out + one hash aggregate keyed on
+        (keys..., grouping id), Spark's physical shape (reference:
+        GpuExpandExec, rapids/GpuExpandExec.scala)."""
+        return GroupedData(self, self._wrap_cols(cols), rollup=True)
+
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
 
@@ -400,9 +407,11 @@ class DataFrame:
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, keys: List[ColumnExpr]):
+    def __init__(self, df: DataFrame, keys: List[ColumnExpr],
+                 rollup: bool = False):
         self.df = df
         self.keys = keys
+        self.rollup = rollup
 
     def agg(self, *aggs) -> "DataFrame":
         """Aggregate; compound expressions over aggregates (e.g.
@@ -445,12 +454,50 @@ class GroupedData:
                 compound = True
                 projections.append(rewritten.alias(e.output_name))
 
-        agg_plan = L.LogicalAggregate(self.keys, leaf_aggs, self.df.plan)
-        if not compound:
-            return DataFrame(self.df.session, agg_plan)
+        child_plan = self.df.plan
+        group_keys = list(self.keys)
+        if self.rollup:
+            child_plan, group_keys = self._expand_rollup(child_plan)
+        agg_plan = L.LogicalAggregate(group_keys, leaf_aggs, child_plan)
         key_cols = [col(k.output_name) for k in self.keys]
+        if not compound and not self.rollup:
+            return DataFrame(self.df.session, agg_plan)
+        if not compound:
+            projections = [col(a.output_name) for a in leaf_aggs]
+        # rollup drops the internal grouping-id column here
         return DataFrame(self.df.session, L.LogicalProject(
             key_cols + projections, agg_plan))
+
+    def _expand_rollup(self, child_plan):
+        """Expand fan-out for ROLLUP grouping sets: one projection per set.
+        Every ORIGINAL column passes through unchanged (aggregates over a
+        grouping-key column must still see real values in subtotal rows —
+        Spark's Expand nulls only duplicated grouping COPIES), plus one
+        nullable copy per key for grouping and a grouping-id column so a
+        rolled-up null never merges with a data null."""
+        schema = self.df.schema
+        key_names = [k.output_name for k in self.keys]
+        for k, name in zip(self.keys, key_names):
+            if k.op != "col" or name not in schema.names:
+                raise ValueError(
+                    "rollup keys must be existing columns; project "
+                    f"{name!r} first")
+        gid = "_grouping_id"
+        projections = []
+        n = len(self.keys)
+        for g in range(n, -1, -1):  # keep keys[:g]
+            proj = [col(f.name) for f in schema]
+            for name in key_names:
+                f = schema.field(name)
+                copy = (col(name) if name in key_names[:g]
+                        else lit(None).cast(f.dtype))
+                proj.append(copy.alias(f"_gkey_{name}"))
+            proj.append(lit(n - g).alias(gid))
+            projections.append(proj)
+        expand = L.LogicalExpand(projections, child_plan)
+        group_keys = [col(f"_gkey_{name}").alias(name)
+                      for name in key_names] + [col(gid)]
+        return expand, group_keys
 
     def count(self) -> "DataFrame":
         return self.agg(functions.count(lit(1)).alias("count"))

@@ -43,6 +43,7 @@ def resolve_mesh(conf) -> Optional["jax.sharding.Mesh"]:
     `spark.rapids.sql.tpu.mesh.devices` = 0 disables; N > 1 requires N
     local devices (power of two, so sharded capacities divide evenly)."""
     from .. import config as C
+    from ..parallel.mesh import init_distributed
     n = conf.get(C.MESH_DEVICES)
     if n is None or int(n) <= 1:
         return None
@@ -50,6 +51,9 @@ def resolve_mesh(conf) -> Optional["jax.sharding.Mesh"]:
     if n & (n - 1):
         raise ValueError(f"{C.MESH_DEVICES.key} must be a power of two, "
                          f"got {n}")
+    # multi-host: join the coordination service BEFORE enumerating devices
+    # so jax.devices() is the global pod list (no-op without a coordinator)
+    init_distributed(conf)
     if len(jax.devices()) < n:
         return None  # planner falls back to single-chip execution
     return make_mesh(n)

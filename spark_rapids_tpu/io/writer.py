@@ -168,10 +168,13 @@ class TpuDataWritingExec(TpuExec):
             with self.metrics.timer("writeTime"):
                 if device_encode:
                     # reference shape: encode on device, stream host
-                    # buffers out (GpuParquetFileFormat.scala:192-214)
+                    # buffers out (GpuParquetFileFormat.scala:192-214);
+                    # codec normalized once so the gate and the encoder
+                    # can never disagree
                     from .parquet_device_write import encode_parquet_file
-                    data = encode_parquet_file(
-                        batch, self.options.get("compression", "snappy"))
+                    codec = str(self.options.get("compression",
+                                                 "snappy")).lower()
+                    data = encode_parquet_file(batch, codec)
                     core.write_encoded(data, batch.num_rows_host())
                     self.metrics.add("numDeviceEncodedFiles", 1)
                 else:

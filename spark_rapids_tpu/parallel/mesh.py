@@ -23,10 +23,50 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 
 
+def init_distributed(conf=None) -> bool:
+    """Multi-host bring-up: join the jax.distributed coordination service so
+    `jax.devices()` enumerates EVERY host's chips and one Mesh spans the
+    pod (collectives ride ICI within a slice, DCN across slices — XLA
+    routes by device topology; the reference's analogue is the UCX
+    management-port handshake that exchanges worker addresses,
+    shuffle-plugin UCX.scala:193-247).
+
+    Controlled by spark.rapids.sql.tpu.mesh.coordinator (host:port);
+    process count/id come from the companion confs or the standard
+    JAX_NUM_PROCESSES/JAX_PROCESS_ID environment.  Returns True when
+    distributed mode was initialized (idempotent; False = single-host)."""
+    import os
+
+    from .. import config as C
+    coordinator = ""
+    n_proc = proc_id = None
+    if conf is not None:
+        coordinator = str(conf.get(C.MESH_COORDINATOR) or "")
+        n_proc = conf.get(C.MESH_NUM_PROCESSES)
+        proc_id = conf.get(C.MESH_PROCESS_ID)
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR", "")
+    if not coordinator:
+        return False
+    kwargs = {"coordinator_address": coordinator}
+    if n_proc:  # conf provided the topology: conf's process id goes with it
+        kwargs["num_processes"] = int(n_proc)
+        kwargs["process_id"] = int(proc_id or 0)
+    elif int(os.environ.get("JAX_NUM_PROCESSES", 0) or 0):
+        kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+        kwargs["process_id"] = int(os.environ.get("JAX_PROCESS_ID", 0))
+    if getattr(init_distributed, "_done", None) == coordinator:
+        return True  # idempotent per coordinator
+    jax.distributed.initialize(**kwargs)
+    init_distributed._done = coordinator
+    return True
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axis: str = DATA_AXIS,
               devices: Optional[Sequence] = None) -> Mesh:
-    """A 1-D mesh over the first `n_devices` local devices."""
+    """A 1-D mesh over the first `n_devices` devices.  After
+    `init_distributed`, jax.devices() is the GLOBAL pod device list, so the
+    same call shapes a multi-host mesh."""
     devs = list(devices) if devices is not None else jax.devices()
     n = n_devices if n_devices is not None else len(devs)
     if n > len(devs):

@@ -342,3 +342,47 @@ def test_whole_stage_fallback_does_not_rescan():
     # 1000 rows in 600-row batches = 2 scan output batches, counted ONCE
     assert scan.metrics.values.get("numOutputBatches") == 2, \
         scan.metrics.values
+
+
+def test_rollup_grouping_sets():
+    """ROLLUP = Expand fan-out + one aggregate; a data-null key must stay a
+    separate output row from the rolled-up subtotal row (grouping-id
+    distinguishes them, like Spark's grouping_id)."""
+    def q(s):
+        df = s.from_pydict(
+            {"ch": ["a", "a", "b", "b", None],
+             "id": ["x", "y", "x", "x", "z"],
+             "v": [1.0, 2.0, 3.0, 4.0, 5.0]},
+            T.schema_of(ch=T.StringType, id=T.StringType, v=T.DoubleType))
+        return (df.rollup(col("ch"), col("id"))
+                .agg(f.sum(col("v")).alias("sv"),
+                     f.count(col("v")).alias("c")))
+    _assert_on_tpu(q, FLOAT_AGG)
+    rows = assert_tpu_and_cpu_are_equal(q, conf=FLOAT_AGG)
+    # 4 leaf groups + 3 channel subtotals (a, b, None) + grand total
+    assert len(rows) == 8
+    assert (None, None, 15.0, 5) in rows       # grand total
+    assert (None, None, 5.0, 1) in rows        # ch=None data group
+
+
+def test_rollup_compound_agg():
+    def q(s):
+        df = gen_df(s, seed=33, n=200, k=T.IntegerType, g=T.IntegerType,
+                    v=T.LongType)
+        return df.rollup(col("k"), col("g")).agg(
+            (f.sum(col("v")) / f.count(col("v"))).alias("m"))
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_rollup_aggregate_over_key_column():
+    """Aggregates over a grouping-key column must see REAL values in
+    subtotal rows (Expand nulls only the grouping copies, not the
+    originals — Spark semantics)."""
+    def q(s):
+        df = s.from_pydict(
+            {"k": [1, 1, 2, 2], "v": [10, 20, 30, 40]},
+            T.schema_of(k=T.IntegerType, v=T.LongType))
+        return df.rollup(col("k")).agg(f.sum(col("k")).alias("sk"),
+                                       f.sum(col("v")).alias("sv"))
+    rows = assert_tpu_and_cpu_are_equal(q)
+    assert (None, 6, 100) in rows  # grand total: sum(k)=6, not NULL

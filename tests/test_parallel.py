@@ -468,3 +468,54 @@ def test_distributed_sort_skew_retry(mesh):
                                  batch)
     got = _global_rows(out, N_DEV)
     assert got == sorted(zip(a.tolist(), b.tolist()))
+
+
+class TestMultiHostInit:
+    """Multi-host bring-up plumbing (parallel/mesh.py init_distributed):
+    conf/env -> jax.distributed.initialize args; single-host no-op."""
+
+    def _record(self, monkeypatch):
+        calls = []
+        import jax
+
+        def fake_initialize(**kw):
+            calls.append(kw)
+        monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+        from spark_rapids_tpu.parallel import mesh
+        monkeypatch.setattr(mesh.init_distributed, "_done", None,
+                            raising=False)
+        return calls
+
+    def test_no_coordinator_is_single_host_noop(self, monkeypatch):
+        calls = self._record(monkeypatch)
+        monkeypatch.delenv("JAX_COORDINATOR", raising=False)
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.parallel.mesh import init_distributed
+        assert init_distributed(TpuConf()) is False
+        assert calls == []
+
+    def test_conf_coordinator_joins(self, monkeypatch):
+        calls = self._record(monkeypatch)
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.parallel.mesh import init_distributed
+        conf = TpuConf({
+            "spark.rapids.sql.tpu.mesh.coordinator": "host0:1234",
+            "spark.rapids.sql.tpu.mesh.numProcesses": "4",
+            "spark.rapids.sql.tpu.mesh.processId": "2"})
+        assert init_distributed(conf) is True
+        assert calls == [{"coordinator_address": "host0:1234",
+                          "num_processes": 4, "process_id": 2}]
+        # idempotent: second call does not re-initialize
+        assert init_distributed(conf) is True
+        assert len(calls) == 1
+
+    def test_env_fallback(self, monkeypatch):
+        calls = self._record(monkeypatch)
+        monkeypatch.setenv("JAX_COORDINATOR", "envhost:9")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+        monkeypatch.setenv("JAX_PROCESS_ID", "1")
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.parallel.mesh import init_distributed
+        assert init_distributed(TpuConf()) is True
+        assert calls == [{"coordinator_address": "envhost:9",
+                          "num_processes": 2, "process_id": 1}]
