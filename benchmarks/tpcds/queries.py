@@ -33,7 +33,7 @@ def q5(t):
                               & (col("d_date") <= "2000-09-06"))
 
     def channel(sales, returns, sales_cols, ret_cols, dim, dim_key,
-                dim_id, prefix):
+                dim_id, label):
         """One channel: union sales rows (returns zeroed) with return rows
         (sales zeroed), join the date window and the channel dimension,
         aggregate per dimension id."""
@@ -57,7 +57,7 @@ def q5(t):
                 .agg(F.sum(col("sales_price")).alias("sales"),
                      F.sum(col("return_amt")).alias("returns"),
                      F.sum(col("profit") - col("net_loss")).alias("profit"))
-                .select(lit(prefix[0]).alias("channel"),
+                .select(lit(label).alias("channel"),
                         col(dim_id).alias("id"), col("sales"),
                         col("returns"), col("profit")))
 
@@ -67,7 +67,7 @@ def q5(t):
          "ss_net_profit"),
         ("sr_store_sk", "sr_returned_date_sk", "sr_return_amt",
          "sr_net_loss"),
-        t["store"], "s_store_sk", "s_store_name", ("store channel",))
+        t["store"], "s_store_sk", "s_store_name", "store channel")
     csr = channel(
         t["catalog_sales"], t["catalog_returns"],
         ("cs_catalog_page_sk", "cs_sold_date_sk", "cs_ext_sales_price",
@@ -75,7 +75,7 @@ def q5(t):
         ("cr_catalog_page_sk", "cr_returned_date_sk", "cr_return_amount",
          "cr_net_loss"),
         t["catalog_page"], "cp_catalog_page_sk", "cp_catalog_page_id",
-        ("catalog channel",))
+        "catalog channel")
     # web returns resolve their site through the originating sale
     # (left outer on item+order, the spec's join)
     wr = (t["web_returns"]
@@ -94,7 +94,7 @@ def q5(t):
          "ws_net_profit"),
         ("wr_site_sk", "wr_returned_date_sk", "wr_return_amt",
          "wr_net_loss"),
-        t["web_site"], "web_site_sk", "web_site_id", ("web channel",))
+        t["web_site"], "web_site_sk", "web_site_id", "web channel")
 
     return (ssr.union(csr).union(wsr)
             .rollup(col("channel"), col("id"))

@@ -199,6 +199,13 @@ MESH_DEVICES = _conf(
     "(exec/distributed.py); 0/1 keeps single-chip execution.  Must be a "
     "power of two and <= the local device count (falls back to single-chip "
     "when fewer devices exist).", int)
+PALLAS_ENABLED = _conf(
+    "spark.rapids.sql.tpu.pallas.enabled", False,
+    "Use hand-written pallas kernels where available (currently the "
+    "prefix-sum inside segmented aggregation: one sequential-grid VMEM "
+    "pass with an SMEM carry instead of XLA's log-depth scan).  Any "
+    "pallas failure (unsupported dtype on the chip, CPU backend) falls "
+    "back to the XLA lowering per call.", _to_bool)
 MESH_COORDINATOR = _conf(
     "spark.rapids.sql.tpu.mesh.coordinator", "",
     "host:port of the jax.distributed coordinator for MULTI-HOST meshes "

@@ -250,6 +250,12 @@ class DataFrame:
         GpuExpandExec, rapids/GpuExpandExec.scala)."""
         return GroupedData(self, self._wrap_cols(cols), rollup=True)
 
+    def cube(self, *cols) -> "GroupedData":
+        """GROUP BY CUBE: every subset of the keys as a grouping set (the
+        same Expand + grouping-id plan as rollup, 2^n projections)."""
+        return GroupedData(self, self._wrap_cols(cols), rollup=True,
+                           cube=True)
+
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
 
@@ -408,10 +414,11 @@ class DataFrame:
 
 class GroupedData:
     def __init__(self, df: DataFrame, keys: List[ColumnExpr],
-                 rollup: bool = False):
+                 rollup: bool = False, cube: bool = False):
         self.df = df
         self.keys = keys
         self.rollup = rollup
+        self.cube = cube
 
     def agg(self, *aggs) -> "DataFrame":
         """Aggregate; compound expressions over aggregates (e.g.
@@ -483,16 +490,26 @@ class GroupedData:
                     "rollup keys must be existing columns; project "
                     f"{name!r} first")
         gid = "_grouping_id"
-        projections = []
         n = len(self.keys)
-        for g in range(n, -1, -1):  # keep keys[:g]
+        if self.cube:
+            # every subset; grouping id = bitmask of PRUNED keys (Spark's
+            # grouping_id bit convention)
+            sets = [[name for b, name in enumerate(key_names)
+                     if not (mask >> (n - 1 - b)) & 1]
+                    for mask in range(1 << n)]
+            gids = list(range(1 << n))
+        else:
+            sets = [key_names[:g] for g in range(n, -1, -1)]
+            gids = [(1 << (n - g)) - 1 for g in range(n, -1, -1)]
+        projections = []
+        for kept, g_val in zip(sets, gids):
             proj = [col(f.name) for f in schema]
             for name in key_names:
                 f = schema.field(name)
-                copy = (col(name) if name in key_names[:g]
+                copy = (col(name) if name in kept
                         else lit(None).cast(f.dtype))
                 proj.append(copy.alias(f"_gkey_{name}"))
-            proj.append(lit(n - g).alias(gid))
+            proj.append(lit(g_val).alias(gid))
             projections.append(proj)
         expand = L.LogicalExpand(projections, child_plan)
         group_keys = [col(f"_gkey_{name}").alias(name)

@@ -51,9 +51,13 @@ def group_rows(key_cols: Sequence[Column], live, value_cols=None):
     WITHIN each group (the distinct-aggregate dedup needs this)."""
     cap = live.shape[0]
     if not key_cols and not value_cols:
-        order = jnp.arange(cap, dtype=jnp.int32)
+        # one group — but the contract (dead rows LAST) must still hold:
+        # merge states interleave live/dead rows, and the searchsorted
+        # segmented reducers require gid sorted after the dead->cap-1 remap
+        order = jnp.lexsort(((~live).astype(jnp.int8),)).astype(jnp.int32)
         gid = jnp.zeros(cap, dtype=jnp.int32)
-        boundary = jnp.zeros(cap, dtype=jnp.bool_)
+        live_s = jnp.take(live, order)
+        boundary = jnp.zeros(cap, dtype=jnp.bool_).at[0].set(live_s[0])
         return order, gid, boundary, jnp.minimum(jnp.sum(live), 1)
     h1, h2 = hash_columns_double(key_cols, live) if key_cols else (
         jnp.zeros(cap, jnp.uint64), jnp.zeros(cap, jnp.uint64))
@@ -113,11 +117,55 @@ def _shift1_rows(m):
 # --------------------------------------------------------------------------
 # segment reducers (sorted ids, masked)
 # --------------------------------------------------------------------------
+#
+# INTEGER sums/counts exploit sortedness: prefix-sum + two searchsorted
+# gathers instead of XLA scatter-add (scatter serializes on the TPU;
+# cumsum/compare/gather are native VPU shapes).  Exact even under int64
+# overflow — modular addition is associative, so a prefix DIFFERENCE wraps
+# to the same value the per-segment wrap produces.  FLOATS keep the
+# scatter: a segment sum as a difference of two running prefixes loses the
+# segment entirely once the running total dwarfs it (1e300-scale values in
+# a batch would absorb 1e5-scale segment sums to 0.0) — not an "order
+# variance" the variableFloatAgg conf covers, but catastrophic
+# cancellation.  min/max have no invertible prefix form and keep
+# segment_min/max.
 
 def _seg_sum(vals, gid, contribute, cap):
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        v = jnp.where(contribute, vals, jnp.zeros((), vals.dtype))
+        return jax.ops.segment_sum(v, gid, num_segments=cap,
+                                   indices_are_sorted=True)
     v = jnp.where(contribute, vals, jnp.zeros((), vals.dtype))
-    return jax.ops.segment_sum(v, gid, num_segments=cap,
-                               indices_are_sorted=True)
+    c = _masked_cumsum(v)
+    seg = jnp.arange(cap, dtype=gid.dtype)
+    start = jnp.searchsorted(gid, seg, side="left")
+    end = jnp.searchsorted(gid, seg, side="right")
+    zero = jnp.zeros((), c.dtype)
+    total = jnp.where(end > 0, c[jnp.clip(end - 1, 0, cap - 1)], zero)
+    prev = jnp.where(start > 0, c[jnp.clip(start - 1, 0, cap - 1)], zero)
+    return jnp.where(end > start, total - prev,
+                     zero).astype(vals.dtype)
+
+
+_PALLAS_CUMSUM = [False]  # flipped by the conf via set_pallas_cumsum
+
+
+def set_pallas_cumsum(enabled: bool) -> None:
+    _PALLAS_CUMSUM[0] = bool(enabled)
+
+
+def _masked_cumsum(v):
+    # pallas path: real TPU only (CPU lacks non-interpret pallas) and
+    # 32-bit dtypes only (64-bit is emulated on current chips and does not
+    # lower); everything else takes XLA's cumsum
+    if _PALLAS_CUMSUM[0] and v.dtype.itemsize < 8 \
+            and jax.default_backend() == "tpu":
+        from ..ops.pallas_kernels import cumsum_1d
+        try:
+            return cumsum_1d(v)
+        except Exception:
+            pass
+    return jnp.cumsum(v)
 
 
 def _seg_min(vals, gid, contribute, cap, fill):
@@ -582,6 +630,8 @@ class TpuHashAggregateExec(TpuExec):
     def kernel_key(self) -> tuple:
         from ..utils.kernel_cache import expr_key, schema_key
         return ("TpuHashAggregateExec",
+                # the pallas-cumsum flag changes the traced program
+                ("pallas" if _PALLAS_CUMSUM[0] else "xla"),
                 tuple(expr_key(g) for g in self.grouping),
                 tuple(self.group_names),
                 tuple(expr_key(a) for a in self.aggregates),
@@ -689,6 +739,8 @@ class TpuHashAggregateExec(TpuExec):
 
     def execute(self, ctx: ExecContext):
         from ..utils.kernel_cache import cached_kernel
+        from .. import config as C
+        set_pallas_cumsum(ctx.conf.get(C.PALLAS_ENABLED))
         whole, materialized = self._try_whole_stage(ctx)
         if whole is not None:
             yield whole

@@ -92,6 +92,9 @@ class TpuDistributedAggregateExec(TpuHashAggregateExec):
                 f"{self.mesh.shape[DATA_AXIS]}]")
 
     def execute(self, ctx: ExecContext):
+        from .. import config as C
+        from .aggregate import set_pallas_cumsum
+        set_pallas_cumsum(ctx.conf.get(C.PALLAS_ENABLED))
         n = self.mesh.shape[DATA_AXIS]
         batch = _drain_to_sharded(self.children[0], ctx, self.mesh, n)
         if batch is None:

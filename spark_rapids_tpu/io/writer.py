@@ -147,15 +147,17 @@ class TpuDataWritingExec(TpuExec):
     def describe(self):
         return f"TpuDataWritingExec[{self.fmt}, {self.path}]"
 
+    def _codec(self) -> str:
+        return str(self.options.get("compression", "snappy")).lower()
+
     def _device_encode_ok(self, ctx) -> bool:
         from .. import config as C
         from .parquet_device_write import _TYPE_MAP
         # codecs beyond snappy/uncompressed (gzip, zstd, ...) only exist in
         # the host arrow encoder — fall back rather than silently writing
         # uncompressed
-        codec = str(self.options.get("compression", "snappy")).lower()
         return (self.fmt == "parquet" and not self.partition_by
-                and codec in ("snappy", "none", "uncompressed")
+                and self._codec() in ("snappy", "none", "uncompressed")
                 and ctx.conf.get(C.PARQUET_DEVICE_ENCODE)
                 and all(f.dtype in _TYPE_MAP for f in self.schema))
 
@@ -169,12 +171,10 @@ class TpuDataWritingExec(TpuExec):
                 if device_encode:
                     # reference shape: encode on device, stream host
                     # buffers out (GpuParquetFileFormat.scala:192-214);
-                    # codec normalized once so the gate and the encoder
-                    # can never disagree
+                    # the _codec() helper is the ONE normalization point
+                    # shared with the gate, so they can never disagree
                     from .parquet_device_write import encode_parquet_file
-                    codec = str(self.options.get("compression",
-                                                 "snappy")).lower()
-                    data = encode_parquet_file(batch, codec)
+                    data = encode_parquet_file(batch, self._codec())
                     core.write_encoded(data, batch.num_rows_host())
                     self.metrics.add("numDeviceEncodedFiles", 1)
                 else:

@@ -31,6 +31,11 @@ def force_cpu_backend(n_devices: Optional[int] = None) -> None:
     import jax._src.xla_bridge as xb
     for plat in ("axon", "tpu"):
         xb._backend_factories.pop(plat, None)
+    # keep "tpu" a KNOWN platform name (identity alias, no factory): pallas
+    # registers tpu lowering rules at import time and refuses unknown
+    # platforms; an alias satisfies the check without any lease-touching
+    # backend factory
+    xb._platform_aliases.setdefault("tpu", "tpu")
 
     import jax
     jax.config.update("jax_platforms", "cpu")

@@ -386,3 +386,20 @@ def test_rollup_aggregate_over_key_column():
                                        f.sum(col("v")).alias("sv"))
     rows = assert_tpu_and_cpu_are_equal(q)
     assert (None, 6, 100) in rows  # grand total: sum(k)=6, not NULL
+
+
+def test_cube_grouping_sets():
+    """CUBE = every subset of the keys; 2^n grouping sets through the same
+    Expand + grouping-id plan as rollup."""
+    def q(s):
+        df = s.from_pydict(
+            {"a": [1, 1, 2, 2], "b": ["x", "y", "x", "y"],
+             "v": [10, 20, 30, 40]},
+            T.schema_of(a=T.IntegerType, b=T.StringType, v=T.LongType))
+        return df.cube(col("a"), col("b")).agg(f.sum(col("v")).alias("sv"))
+    rows = assert_tpu_and_cpu_are_equal(q)
+    # 4 leaf + 2 a-subtotals + 2 b-subtotals + grand = 9
+    assert len(rows) == 9
+    assert (None, "x", 40) in rows   # b-only set: a rolled up
+    assert (1, None, 30) in rows     # a-only set
+    assert (None, None, 100) in rows

@@ -1,0 +1,91 @@
+"""Pallas kernel tests (interpret mode on the CPU backend) + the
+gather-based segmented-sum rewrite they back (exec/aggregate.py
+_seg_sum)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.float32, np.int64,
+                                   np.float64])
+@pytest.mark.parametrize("n", [1024, 4096, 8192])
+def test_cumsum_1d_interpret(dtype, n):
+    from spark_rapids_tpu.ops.pallas_kernels import cumsum_1d
+    rng = np.random.RandomState(n)
+    if np.issubdtype(dtype, np.integer):
+        v = rng.randint(-1000, 1000, n).astype(dtype)
+    else:
+        v = rng.randn(n).astype(dtype)
+    got = np.asarray(cumsum_1d(jnp.asarray(v), interpret=True))
+    if np.issubdtype(dtype, np.integer):
+        assert (got == np.cumsum(v)).all()
+    else:
+        # summation ORDER differs from np.cumsum (blocked row-major);
+        # compare against the exact f64 prefix at the dtype's tolerance
+        want = np.cumsum(v.astype(np.float64))
+        tol = 1e-4 if dtype is np.float32 else 1e-9
+        np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+def test_cumsum_1d_rejects_unaligned():
+    from spark_rapids_tpu.ops.pallas_kernels import cumsum_1d
+    with pytest.raises(ValueError):
+        cumsum_1d(jnp.zeros(1000), interpret=True)
+
+
+def test_seg_sum_float_keeps_scatter_semantics():
+    """Float sums must survive huge-magnitude neighbors (prefix-diff would
+    absorb small segments after a 1e300 running total — the reason floats
+    keep scatter, exec/aggregate.py _seg_sum)."""
+    from spark_rapids_tpu.exec.aggregate import _seg_sum
+    cap = 1024
+    gid = np.zeros(cap, np.int32)
+    gid[2:] = np.arange(2, cap)  # seg 0: rows 0-1, then singletons
+    vals = np.full(cap, 123.5)
+    vals[0] = 1e300
+    contribute = np.ones(cap, bool)
+    got = np.asarray(_seg_sum(jnp.asarray(vals), jnp.asarray(gid),
+                              jnp.asarray(contribute), cap))
+    assert got[0] == 1e300 + 123.5
+    assert got[5] == 123.5  # NOT absorbed to 0.0
+
+
+def test_seg_sum_gather_matches_scatter():
+    """The searchsorted/prefix-sum segmented sum must equal XLA's
+    scatter-based segment_sum on sorted ids, including empty segments,
+    masked rows, and the dead-rows-at-cap-1 convention."""
+    import jax
+    from spark_rapids_tpu.exec.aggregate import _seg_sum
+    rng = np.random.RandomState(9)
+    cap = 2048
+    n_live = 1500
+    gid = np.sort(rng.randint(0, 40, n_live))
+    gid = np.concatenate([gid, np.full(cap - n_live, cap - 1)])
+    vals = rng.randint(-100, 100, cap).astype(np.int64)
+    contribute = rng.rand(cap) < 0.8
+    contribute[n_live:] = False
+    got = np.asarray(_seg_sum(jnp.asarray(vals), jnp.asarray(gid),
+                              jnp.asarray(contribute), cap))
+    v = np.where(contribute, vals, 0)
+    want = np.asarray(jax.ops.segment_sum(
+        jnp.asarray(v), jnp.asarray(gid), num_segments=cap,
+        indices_are_sorted=True))
+    assert (got == want).all()
+
+
+def test_seg_sum_int_overflow_wraps_like_scatter():
+    """int64 prefix-diff wraps identically to per-segment accumulation
+    (modular addition is associative)."""
+    import jax
+    from spark_rapids_tpu.exec.aggregate import _seg_sum
+    cap = 1024
+    gid = np.sort(np.arange(cap) % 7).astype(np.int32)
+    vals = np.full(cap, 2**61, np.int64)
+    contribute = np.ones(cap, bool)
+    got = np.asarray(_seg_sum(jnp.asarray(vals), jnp.asarray(gid),
+                              jnp.asarray(contribute), cap))
+    want = np.asarray(jax.ops.segment_sum(
+        jnp.asarray(vals), jnp.asarray(gid), num_segments=cap,
+        indices_are_sorted=True))
+    assert (got == want).all()
