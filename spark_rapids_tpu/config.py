@@ -274,6 +274,13 @@ PARQUET_DEVICE_ENCODE = _conf(
     "host writes definition-level runs, page headers, and the thrift "
     "footer.  Partitioned writes fall back to the host arrow encoder.",
     _to_bool)
+ORC_DEVICE_DECODE = _conf(
+    "spark.rapids.sql.format.orc.deviceDecode.enabled", True,
+    "Decode ORC FLOAT/DOUBLE columns on the device (host keeps the "
+    "protobuf control plane, zlib inflation, and the byte-RLE PRESENT "
+    "bitmap; the device reinterprets the IEEE payload and expands nulls). "
+    "RLEv2-encoded columns (ints/strings/dates) fall back to the host "
+    "stripe reader column-granularly.", _to_bool)
 CSV_DEVICE_DECODE = _conf(
     "spark.rapids.sql.format.csv.deviceDecode.enabled", True,
     "Tokenize and parse CSV on the device: the host computes only the "

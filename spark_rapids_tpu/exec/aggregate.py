@@ -131,18 +131,19 @@ def _shift1_rows(m):
 # segment_min/max.
 
 def _seg_sum(vals, gid, contribute, cap):
+    v = jnp.where(contribute, vals, jnp.zeros((), vals.dtype))
     if jnp.issubdtype(vals.dtype, jnp.floating):
-        v = jnp.where(contribute, vals, jnp.zeros((), vals.dtype))
         return jax.ops.segment_sum(v, gid, num_segments=cap,
                                    indices_are_sorted=True)
-    v = jnp.where(contribute, vals, jnp.zeros((), vals.dtype))
     c = _masked_cumsum(v)
+    n = v.shape[0]  # rows; cap is the SEGMENT count (may be smaller: the
+    #                 global kernel reduces a whole batch to 1 segment)
     seg = jnp.arange(cap, dtype=gid.dtype)
     start = jnp.searchsorted(gid, seg, side="left")
     end = jnp.searchsorted(gid, seg, side="right")
     zero = jnp.zeros((), c.dtype)
-    total = jnp.where(end > 0, c[jnp.clip(end - 1, 0, cap - 1)], zero)
-    prev = jnp.where(start > 0, c[jnp.clip(start - 1, 0, cap - 1)], zero)
+    total = jnp.where(end > 0, c[jnp.clip(end - 1, 0, n - 1)], zero)
+    prev = jnp.where(start > 0, c[jnp.clip(start - 1, 0, n - 1)], zero)
     return jnp.where(end > start, total - prev,
                      zero).astype(vals.dtype)
 

@@ -509,6 +509,71 @@ def _host_chunks(fmt: str, files, schema: Schema, options: dict,
 # execs
 # --------------------------------------------------------------------------
 
+def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
+                        metrics) -> Iterator[ColumnarBatch]:
+    """Stripe-granular ORC decode with FLOAT/DOUBLE columns on device and
+    column-granular pyarrow fallback for everything else
+    (io/orc_device.py).  The whole control plane parses BEFORE the first
+    yield, so unsupported files fall back file-granularly; stripe
+    predicates skip provably-dead stripes like the host reader."""
+    from pyarrow import orc as paorc
+
+    from ..columnar.batch import bucket_rows
+    from ..ops.expressions import clear_input_file, publish_input_file
+    from .orc_device import OrcFileInfo, decode_float_column
+
+    info = OrcFileInfo(path)  # raises OrcDeviceUnsupported pre-yield
+    predicates = options.get("__predicates__")
+    of = paorc.ORCFile(path)
+    file_names = set(of.schema.names)
+    pred_cols = sorted({nm for (nm, _, _) in predicates or []
+                        if nm in file_names}) or None
+    try:
+        publish_input_file(path)
+        import jax.numpy as jnp
+        for si in range(len(info.stripes)):
+            if pred_cols:
+                probe = of.read_stripe(si, columns=pred_cols)
+                if metrics is not None:
+                    metrics.add("numStripes", 1)
+                if not _orc_stripe_can_match(probe, predicates):
+                    if metrics is not None:
+                        metrics.add("numStripesSkipped", 1)
+                    continue
+            rows = info.stripes[si]["numberOfRows"]
+            cap = bucket_rows(max(rows, 1))
+            out_cols: dict = {}
+            host_names: List[str] = []
+            for f in schema:
+                if f.name not in info.columns:
+                    host_names.append(f.name)  # evolution: nulls via host
+                    continue
+                try:
+                    from contextlib import nullcontext
+                    with metrics.timer("scanTime") if metrics is not None \
+                            else nullcontext():
+                        out_cols[f.name] = decode_float_column(
+                            info, si, f.name, f.dtype, cap)
+                    if metrics is not None:
+                        metrics.add("numDeviceDecodedColumns", 1)
+                except Exception:
+                    host_names.append(f.name)
+            if host_names:
+                table = of.read_stripe(
+                    si, columns=[n for n in host_names if n in file_names])
+                host_batch = ColumnarBatch.from_arrow(
+                    _evolve(table, Schema([schema.field(n)
+                                           for n in host_names])),
+                    capacity=cap)
+                for n, c in zip(host_names, host_batch.columns):
+                    out_cols[n] = c
+            sel = jnp.arange(cap, dtype=jnp.int32) < rows
+            yield ColumnarBatch([out_cols[f.name] for f in schema], sel,
+                                schema)
+    finally:
+        clear_input_file()
+
+
 def _device_parquet_batches(files, schema: Schema, options: dict, conf,
                             metrics) -> Iterator[ColumnarBatch]:
     """Parquet chunks decoded on DEVICE column-by-column
@@ -669,6 +734,17 @@ class TpuFileScanExec(TpuExec):
             yield batch
 
     def _batches(self, ctx) -> Iterator[ColumnarBatch]:
+        if self.fmt == "orc" and ctx.conf.get(C.ORC_DEVICE_DECODE) \
+                and not self.options.get("__partitions__"):
+            from .orc_device import OrcDeviceUnsupported
+            for path in self.files:
+                try:
+                    yield from _device_orc_batches(
+                        path, self._schema, self.options, ctx.conf,
+                        self.metrics)
+                except OrcDeviceUnsupported:
+                    yield from self._host_batches([path], ctx)
+            return
         if self.fmt == "csv" and ctx.conf.get(C.CSV_DEVICE_DECODE) \
                 and not self.options.get("__partitions__"):
             from .csv_device import CsvDeviceUnsupported, device_csv_batches

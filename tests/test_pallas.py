@@ -89,3 +89,19 @@ def test_seg_sum_int_overflow_wraps_like_scatter():
         jnp.asarray(vals), jnp.asarray(gid), num_segments=cap,
         indices_are_sorted=True))
     assert (got == want).all()
+
+
+def test_seg_sum_fewer_segments_than_rows():
+    """cap (segment count) smaller than the row count — the global
+    kernel's 1-segment whole-batch reduction shape (regression: prefix
+    indices were clipped to cap-1 instead of rows-1)."""
+    import jax
+    from spark_rapids_tpu.exec.aggregate import _seg_sum
+    rows = 1024
+    gid = np.zeros(rows, np.int32)
+    vals = np.arange(rows, dtype=np.int64)
+    contribute = (np.arange(rows) % 3) == 0
+    got = np.asarray(_seg_sum(jnp.asarray(vals), jnp.asarray(gid),
+                              jnp.asarray(contribute), 1))
+    want = int(vals[contribute].sum())
+    assert got.tolist() == [want]
