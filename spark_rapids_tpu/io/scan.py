@@ -520,7 +520,8 @@ def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
 
     from ..columnar.batch import bucket_rows
     from ..ops.expressions import clear_input_file, publish_input_file
-    from .orc_device import OrcFileInfo, decode_float_column
+    from .orc_device import (OrcDeviceUnsupported, OrcFileInfo,
+                             decode_column)
 
     info = OrcFileInfo(path)  # raises OrcDeviceUnsupported pre-yield
     predicates = options.get("__predicates__")
@@ -552,11 +553,19 @@ def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
                     from contextlib import nullcontext
                     with metrics.timer("scanTime") if metrics is not None \
                             else nullcontext():
-                        out_cols[f.name] = decode_float_column(
+                        out_cols[f.name] = decode_column(
                             info, si, f.name, f.dtype, cap)
                     if metrics is not None:
                         metrics.add("numDeviceDecodedColumns", 1)
+                except OrcDeviceUnsupported:
+                    host_names.append(f.name)  # expected scope fallback
                 except Exception:
+                    # the hand-rolled protobuf/RLEv2 parsers must never be
+                    # able to fail a query the pyarrow path could read; a
+                    # surprise error falls back too but is COUNTED so a
+                    # regression disabling the device path stays visible
+                    if metrics is not None:
+                        metrics.add("numDeviceDecodeErrors", 1)
                     host_names.append(f.name)
             if host_names:
                 table = of.read_stripe(
@@ -568,9 +577,13 @@ def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
                 for n, c in zip(host_names, host_batch.columns):
                     out_cols[n] = c
             sel = jnp.arange(cap, dtype=jnp.int32) < rows
+            if metrics is not None:
+                metrics.add("numOutputRows", rows)
+                metrics.add("numOutputBatches", 1)
             yield ColumnarBatch([out_cols[f.name] for f in schema], sel,
                                 schema)
     finally:
+        info.close()
         clear_input_file()
 
 
