@@ -276,11 +276,11 @@ PARQUET_DEVICE_ENCODE = _conf(
     _to_bool)
 ORC_DEVICE_DECODE = _conf(
     "spark.rapids.sql.format.orc.deviceDecode.enabled", True,
-    "Decode ORC FLOAT/DOUBLE and SHORT/INT/LONG/DATE columns on the "
-    "device (host keeps the protobuf control plane, zlib inflation, the "
-    "byte-RLE PRESENT bitmap, and the RLEv2 run headers; the device "
-    "reinterprets IEEE payloads, bit-extracts DIRECT runs, and expands "
-    "nulls).  Strings/timestamps and exotic runs fall back to the host "
+    "Decode ORC columns on the device: floats/doubles (IEEE payload), "
+    "ints/dates (RLEv2 DIRECT bit-extraction), strings (DIRECT_V2 and "
+    "DICTIONARY_V2 blob gathers), and booleans.  The host keeps the "
+    "protobuf control plane, zlib inflation, byte-RLE bitmaps, and RLEv2 "
+    "run headers.  Timestamps and exotic runs fall back to the host "
     "stripe reader column-granularly.", _to_bool)
 CSV_DEVICE_DECODE = _conf(
     "spark.rapids.sql.format.csv.deviceDecode.enabled", True,

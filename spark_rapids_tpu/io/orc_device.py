@@ -9,14 +9,14 @@ inflation, and the byte-RLE PRESENT bitmap — while the device does the
 vector work: IEEE bytes reinterpreted in one transfer and nulls expanded
 with the same cumsum+gather kernel the parquet path compiles.
 
-Scope: FLOAT/DOUBLE (raw IEEE payload) and SHORT/INT/LONG/DATE (RLEv2:
-the host walks run headers, the device bit-extracts every DIRECT run's
-packed values — the volume case for real data — while SHORT_REPEAT fills
-and DELTA prefix chains come from the header walk itself) in uncompressed
-or zlib files.  Strings/timestamps, PATCHED_BASE runs, and DIRECT widths
-past the 8-byte extraction window fall back to the pyarrow stripe reader
-COLUMN-granularly, exactly like the parquet decoder's
-unsupported-encoding fallback.
+Scope (uncompressed or zlib files): FLOAT/DOUBLE (raw IEEE payload),
+SHORT/INT/LONG/DATE (RLEv2: host walks run headers, device bit-extracts
+every DIRECT run's packed values — the volume case for real data),
+STRING (DIRECT_V2 length+blob gather and DICTIONARY_V2 index+dictionary
+gather through the unsigned RLEv2 path), and BOOLEAN.  Timestamps,
+PATCHED_BASE runs, and DIRECT widths past the 8-byte extraction window
+fall back to the pyarrow stripe reader COLUMN-granularly, exactly like
+the parquet decoder's unsupported-encoding fallback.
 """
 from __future__ import annotations
 
@@ -158,9 +158,10 @@ def _parse_footer(buf: bytes) -> Tuple[list, list, int]:
     return stripes, types, total_rows
 
 
-def _parse_stripe_footer(buf: bytes) -> List[dict]:
-    """-> streams [(kind, column, length)] in file order."""
-    streams = []
+def _parse_stripe_footer(buf: bytes) -> Tuple[List[dict], List[dict]]:
+    """-> (streams [(kind, column, length)] in file order,
+           encodings [{kind, dictionarySize}] per column id)."""
+    streams, encodings = [], []
     for fnum, _wt, v in _Proto(buf).fields():
         if fnum == 1:  # Stream
             st = {"kind": 0, "column": 0, "length": 0}
@@ -172,7 +173,15 @@ def _parse_stripe_footer(buf: bytes) -> List[dict]:
                 elif fn2 == 3:
                     st["length"] = v2
             streams.append(st)
-    return streams
+        elif fnum == 2:  # ColumnEncoding
+            enc = {"kind": 0, "dictionarySize": 0}
+            for fn2, _w2, v2 in _Proto(v).fields():
+                if fn2 == 1:
+                    enc["kind"] = v2
+                elif fn2 == 2:
+                    enc["dictionarySize"] = v2
+            encodings.append(enc)
+    return streams, encodings
 
 
 def _decode_present(raw: bytes, num_rows: int) -> np.ndarray:
@@ -257,7 +266,11 @@ class OrcFileInfo:
         foot_off = s["offset"] + s["indexLength"] + s["dataLength"]
         footer = _inflate(self.read_range(foot_off, s["footerLength"]),
                           self.compression)
-        streams = _parse_stripe_footer(footer)
+        streams, encodings = _parse_stripe_footer(footer)
+        enc_cache = getattr(self, "_enc_cache", None)
+        if enc_cache is None:
+            enc_cache = self._enc_cache = {}
+        enc_cache[si] = encodings
         # assign absolute offsets (streams are laid out in order after the
         # index region; PRESENT/DATA live in the data region but ORC
         # counts index streams first in the same list)
@@ -267,6 +280,10 @@ class OrcFileInfo:
             off += st["length"]
         cache[si] = streams
         return streams
+
+    def stripe_encodings(self, si: int) -> List[dict]:
+        self.stripe_streams(si)  # populates the encoding cache
+        return self._enc_cache[si]
 
     def column_streams(self, si: int, cid: int):
         """(present_raw, data_raw) for one column of one stripe, inflated."""
@@ -380,13 +397,16 @@ def _unpack_bits_host(body: bytes, bit_off: int, count: int,
     return out
 
 
-def rlev2_runs(body: bytes, n_values: int):
+def rlev2_runs(body: bytes, n_values: int, signed: bool = True):
     """Walk the RLEv2 run headers.
 
     Returns (host_vals int64[n_values] with SR/DELTA positions filled,
-    direct_runs [(width, byte_offset, count, out_offset)]).  Raises
-    OrcDeviceUnsupported on PATCHED_BASE (outlier encoding) or widths the
-    8-byte device window cannot extract (>56 bits)."""
+    direct_runs [(width, byte_offset, count, out_offset)]).  `signed`
+    selects zigzag decode for SR/DIRECT values (value streams) vs raw
+    unsigned (LENGTH / dictionary-index streams; DELTA's first delta stays
+    zigzag either way, per the spec).  Raises OrcDeviceUnsupported on
+    PATCHED_BASE (outlier encoding) or widths the 8-byte device window
+    cannot extract (>56 bits)."""
     host_vals = np.zeros(n_values, np.int64)
     direct = []
     pos = out = 0
@@ -399,7 +419,7 @@ def rlev2_runs(body: bytes, n_values: int):
             v = 0
             for b in body[pos + 1:pos + 1 + w]:
                 v = (v << 8) | b
-            host_vals[out:out + rep] = _zigzag(v)
+            host_vals[out:out + rep] = _zigzag(v) if signed else v
             pos += 1 + w
             out += rep
         elif enc == 1:  # DIRECT: bit-packed zigzag values
@@ -417,7 +437,7 @@ def rlev2_runs(body: bytes, n_values: int):
             ln = (((h & 1) << 8) | body[pos + 1]) + 1
             pos += 2
             base_u, pos = _varint(body, pos)
-            base = _zigzag(base_u)
+            base = _zigzag(base_u) if signed else base_u
             delta0_u, pos = _varint(body, pos)
             delta0 = _zigzag(delta0_u)
             vals = np.empty(ln, np.int64)
@@ -434,7 +454,9 @@ def rlev2_runs(body: bytes, n_values: int):
                 sign = 1 if delta0 >= 0 else -1
                 vals[2:] = vals[1] + sign * np.cumsum(deltas)
             elif width:
-                pos += ((ln - 2) * width + 7) // 8
+                # ln <= 2 has no packed payload; ((ln-2)*w+7)//8 would be
+                # NEGATIVE under floor division and rewind the stream
+                pos += max(0, ((ln - 2) * width + 7) // 8)
             host_vals[out:out + ln] = vals
             out += ln
         else:  # PATCHED_BASE
@@ -445,20 +467,81 @@ def rlev2_runs(body: bytes, n_values: int):
     return host_vals, direct
 
 
+def _rlev2_device_values(data_raw: bytes, count: int, out_cap: int,
+                         signed: bool = True):
+    """RLEv2 stream -> device int64[out_cap] with values at [0:count].
+
+    Host walks the run headers (SHORT_REPEAT fills and DELTA prefix chains
+    decoded there); the DEVICE bit-extracts every DIRECT run's packed
+    values with one vectorized 8-byte-window gather+shift.  All device
+    inputs are padded to power-of-two buckets so the compiled kernel is
+    shared across stripes/files (padding rows carry width 0 -> value 0 and
+    dest out_cap -> dropped by the scatter's OOB mode)."""
+    import jax.numpy as jnp
+
+    from ..columnar.batch import bucket_rows
+    from ..utils.kernel_cache import cached_kernel
+
+    host_vals, direct = rlev2_runs(data_raw, count, signed)
+    n_direct = sum(ln for (_w, _o, ln, _d) in direct)
+    dbucket = bucket_rows(max(n_direct, 1))
+    bitpos = np.zeros(dbucket, np.int64)
+    widths = np.zeros(dbucket, np.int64)
+    dests = np.full(dbucket, out_cap, np.int64)
+    pos = 0
+    for (width, off, ln, out_off) in direct:
+        bitpos[pos:pos + ln] = off * 8 \
+            + np.arange(ln, dtype=np.int64) * width
+        widths[pos:pos + ln] = width
+        dests[pos:pos + ln] = out_off + np.arange(ln, dtype=np.int64)
+        pos += ln
+    pbucket = bucket_rows(max(len(data_raw), 1))
+    packed = np.zeros(pbucket, np.uint8)
+    packed[:len(data_raw)] = np.frombuffer(data_raw, np.uint8)
+    compact = np.zeros(out_cap, np.int64)
+    compact[:count] = host_vals
+
+    def build():
+        def k(packed_v, compact_v, bitpos_v, widths_v, dests_v):
+            # big-endian 8-byte window starting at the value's byte
+            byte0 = bitpos_v // 8
+            idx = byte0[:, None] + jnp.arange(8, dtype=jnp.int64)[None]
+            win = jnp.take(packed_v, jnp.clip(idx, 0,
+                                              packed_v.shape[0] - 1),
+                           mode="clip").astype(jnp.uint64)
+            shifts = jnp.arange(56, -8, -8, dtype=jnp.uint64)
+            word = jnp.sum(win << shifts, axis=1, dtype=jnp.uint64)
+            # padding rows have width 0: clamp the shift below 64
+            # (UB otherwise); their mask is 0 so the value is 0 anyway
+            used = jnp.clip(64 - (bitpos_v % 8) - widths_v, 0, 63
+                            ).astype(jnp.uint64)
+            mask = (jnp.uint64(1) << widths_v.astype(jnp.uint64)) \
+                - jnp.uint64(1)
+            u = (word >> used) & mask
+            if signed:
+                v = (u >> jnp.uint64(1)).astype(jnp.int64) \
+                    * jnp.where((u & jnp.uint64(1)) > 0, -1, 1) \
+                    - jnp.where((u & jnp.uint64(1)) > 0, 1, 0)
+            else:
+                v = u.astype(jnp.int64)
+            return compact_v.at[dests_v].set(v, mode="drop")
+        return k
+
+    fn = cached_kernel(("rlev2_vals", out_cap, pbucket, dbucket, signed),
+                       build)
+    return fn(jnp.asarray(packed), jnp.asarray(compact),
+              jnp.asarray(bitpos), jnp.asarray(widths), jnp.asarray(dests))
+
+
 def decode_int_column(info: OrcFileInfo, si: int, name: str, dtype,
                       cap: int):
-    """One stripe's SHORT/INT/LONG/DATE column: host walks the RLEv2 run
-    headers, the DEVICE extracts every DIRECT run's bit-packed values (an
-    8-byte gather + shift per value, vectorized over the whole stripe) and
-    merges them with the host-decoded SR/DELTA positions; nulls expand with
-    the shared cumsum+gather kernel."""
-    import jax
+    """One stripe's SHORT/INT/LONG/DATE column: RLEv2 values via
+    _rlev2_device_values, nulls expanded with the shared cumsum+gather
+    kernel."""
     import jax.numpy as jnp
 
     from ..columnar import Column
     from ..utils.kernel_cache import cached_kernel
-
-    from ..columnar.batch import bucket_rows
 
     cid, kind = info.columns[name]
     if kind not in _INT_KINDS:
@@ -468,70 +551,147 @@ def decode_int_column(info: OrcFileInfo, si: int, name: str, dtype,
     valid = (np.ones(rows, bool) if present_raw is None
              else _decode_present(present_raw, rows))
     nonnull = int(valid.sum())
-    host_vals, direct = rlev2_runs(data_raw, nonnull)
+    compact = _rlev2_device_values(data_raw, nonnull, cap, signed=True)
+    valid_cap = np.zeros(cap, bool)
+    valid_cap[:rows] = valid
+    data = _null_expand(compact, valid_cap, cap)
+    return Column(data.astype(dtype.jnp_dtype), jnp.asarray(valid_cap),
+                  dtype)
 
-    # per-value bit positions/destinations for every DIRECT run (host
-    # index arithmetic, vectorized per run).  All device inputs are padded
-    # to power-of-two buckets so the compiled kernel is shared across
-    # stripes/files instead of retracing per exact stream size (padding
-    # rows carry width 0 -> value 0 and dest cap -> dropped by the
-    # scatter's OOB mode)
-    n_direct = sum(ln for (_w, _o, ln, _d) in direct)
-    dbucket = bucket_rows(max(n_direct, 1))
-    bitpos = np.zeros(dbucket, np.int64)
-    widths = np.zeros(dbucket, np.int64)
-    dests = np.full(dbucket, cap, np.int64)
-    pos = 0
-    for (width, off, ln, out_off) in direct:
-        bitpos[pos:pos + ln] = off * 8 \
-            + np.arange(ln, dtype=np.int64) * width
-        widths[pos:pos + ln] = width
-        dests[pos:pos + ln] = out_off + np.arange(ln, dtype=np.int64)
-        pos += ln
 
-    pbucket = bucket_rows(max(len(data_raw), 1))
-    packed = np.zeros(pbucket, np.uint8)
-    packed[:len(data_raw)] = np.frombuffer(data_raw, np.uint8)
-    compact = np.zeros(cap, np.int64)
-    compact[:nonnull] = host_vals
+# string column encodings (ColumnEncoding.Kind)
+_ENC_DIRECT, _ENC_DICT = 0, 1
+_ENC_DIRECT_V2, _ENC_DICT_V2 = 2, 3
+_KIND_STRING = 7
+_LENGTH, _DICT_DATA = 2, 3  # Stream.Kind: LENGTH=2, DICTIONARY_DATA=3
+
+
+def decode_string_column(info: OrcFileInfo, si: int, name: str, dtype,
+                         cap: int):
+    """One stripe's STRING column: LENGTH / dictionary-index streams
+    decode through the unsigned RLEv2 device path, then ONE 2-D gather
+    builds the padded byte matrix from the blob (direct) or dictionary
+    blob (DICTIONARY_V2), and nulls expand row-wise."""
+    import jax.numpy as jnp
+
+    from ..columnar import Column
+    from ..columnar.column import bucket_strlen
+    from ..utils.kernel_cache import cached_kernel
+
+    cid, kind = info.columns[name]
+    if kind != _KIND_STRING:
+        raise OrcDeviceUnsupported(f"type kind {kind} is not STRING")
+    enc = info.stripe_encodings(si)[cid]["kind"]
+    if enc not in (_ENC_DIRECT_V2, _ENC_DICT_V2):
+        raise OrcDeviceUnsupported(f"string encoding kind {enc}")
+    rows = info.stripes[si]["numberOfRows"]
+    streams = {st["kind"]: st for st in info.stripe_streams(si)
+               if st["column"] == cid}
+    present_raw = None
+    if _PRESENT in streams:
+        st = streams[_PRESENT]
+        present_raw = _inflate(info.read_range(st["abs_offset"],
+                                               st["length"]),
+                               info.compression)
+
+    def body(kind_):
+        st = streams.get(kind_)
+        if st is None:
+            raise OrcDeviceUnsupported(f"stream kind {kind_} missing")
+        return _inflate(info.read_range(st["abs_offset"], st["length"]),
+                        info.compression)
+
+    valid = (np.ones(rows, bool) if present_raw is None
+             else _decode_present(present_raw, rows))
+    nonnull = int(valid.sum())
     valid_cap = np.zeros(cap, bool)
     valid_cap[:rows] = valid
 
+    if enc == _ENC_DIRECT_V2:
+        lengths = _rlev2_device_values(body(_LENGTH), nonnull, cap,
+                                       signed=False)
+        blob = np.frombuffer(body(_DATA), np.uint8)
+    else:
+        dict_size = info.stripe_encodings(si)[cid]["dictionarySize"]
+        dcap = max(int(dict_size), 1)
+        from ..columnar.batch import bucket_rows
+        dbucket = bucket_rows(dcap)
+        dict_lengths = _rlev2_device_values(body(_LENGTH), dict_size,
+                                            dbucket, signed=False)
+        indices = _rlev2_device_values(body(_DATA), nonnull, cap,
+                                       signed=False)
+        blob = np.frombuffer(body(_DICT_DATA), np.uint8)
+        # per-entry byte offsets inside the dictionary blob
+        dict_ends = jnp.cumsum(dict_lengths)
+        dict_starts = dict_ends - dict_lengths
+        lengths = jnp.take(dict_lengths,
+                           jnp.clip(indices, 0, dbucket - 1), mode="clip")
+        starts_dict = jnp.take(dict_starts,
+                               jnp.clip(indices, 0, dbucket - 1),
+                               mode="clip")
+
+    max_len = int(jnp.max(jnp.where(
+        jnp.arange(cap) < nonnull, lengths, 0)))  # one scalar sync
+    width = bucket_strlen(max_len)
+    from ..columnar.batch import bucket_rows
+    bbucket = bucket_rows(max(len(blob), 1))
+    blob_pad = np.zeros(bbucket, np.uint8)
+    blob_pad[:len(blob)] = blob
+
+    if enc == _ENC_DIRECT_V2:
+        ends = jnp.cumsum(lengths)
+        starts = ends - lengths
+    else:
+        starts = starts_dict
+
     def build():
-        def k(packed_v, compact_v, bitpos_v, widths_v, dests_v, valid_v):
-            if bitpos_v.shape[0]:
-                # big-endian 8-byte window starting at the value's byte
-                byte0 = bitpos_v // 8
-                idx = byte0[:, None] + jnp.arange(8, dtype=jnp.int64)[None]
-                win = jnp.take(packed_v, jnp.clip(idx, 0,
-                                                  packed_v.shape[0] - 1),
-                               mode="clip").astype(jnp.uint64)
-                shifts = jnp.arange(56, -8, -8, dtype=jnp.uint64)
-                word = jnp.sum(win << shifts, axis=1, dtype=jnp.uint64)
-                # padding rows have width 0: clamp the shift below 64
-                # (UB otherwise); their mask is 0 so the value is 0 anyway
-                used = jnp.clip(64 - (bitpos_v % 8) - widths_v, 0, 63
-                                ).astype(jnp.uint64)
-                mask = (jnp.uint64(1) << widths_v.astype(jnp.uint64)) \
-                    - jnp.uint64(1)
-                u = (word >> used) & mask
-                s = (u >> jnp.uint64(1)).astype(jnp.int64) \
-                    * jnp.where((u & jnp.uint64(1)) > 0, -1, 1) \
-                    - jnp.where((u & jnp.uint64(1)) > 0, 1, 0)
-                compact_v = compact_v.at[dests_v].set(s, mode="drop")
-            vi = jnp.cumsum(valid_v.astype(jnp.int32)) - 1
-            out = jnp.take(compact_v,
-                           jnp.clip(vi, 0, compact_v.shape[0] - 1),
-                           mode="clip")
-            return jnp.where(valid_v, out, jnp.zeros_like(out))
+        def k(blob_v, starts_v, lengths_v, valid_v):
+            posw = jnp.arange(width, dtype=jnp.int64)[None, :]
+            idx = jnp.clip(starts_v[:, None] + posw, 0,
+                           blob_v.shape[0] - 1)
+            in_str = posw < lengths_v[:, None]
+            mat = jnp.where(in_str, jnp.take(blob_v, idx, mode="clip"), 0)
+            # expand compact rows to row positions (row-wise gather)
+            vi = jnp.clip(jnp.cumsum(valid_v.astype(jnp.int32)) - 1, 0,
+                          mat.shape[0] - 1)
+            mat_rows = jnp.take(mat, vi, axis=0)
+            len_rows = jnp.take(lengths_v, vi)
+            mat_rows = jnp.where(valid_v[:, None], mat_rows, 0)
+            len_rows = jnp.where(valid_v, len_rows, 0)
+            return mat_rows.astype(jnp.uint8), \
+                len_rows.astype(jnp.int32)
         return k
 
-    fn = cached_kernel(("orc_int", cap, pbucket, dbucket), build)
-    data = fn(jnp.asarray(packed), jnp.asarray(compact),
-              jnp.asarray(bitpos), jnp.asarray(widths), jnp.asarray(dests),
-              jnp.asarray(valid_cap))
-    return Column(data.astype(dtype.jnp_dtype), jnp.asarray(valid_cap),
-                  dtype)
+    fn = cached_kernel(("orc_str", cap, width, bbucket), build)
+    data, lens = fn(jnp.asarray(blob_pad), starts, lengths,
+                    jnp.asarray(valid_cap))
+    return Column(data, jnp.asarray(valid_cap), dtype, lens)
+
+
+_KIND_BOOL = 0
+
+
+def decode_bool_column(info: OrcFileInfo, si: int, name: str, dtype,
+                       cap: int):
+    """BOOLEAN values are the same byte-RLE bitmap as PRESENT: the host
+    expands the few runs, the device does the null expansion."""
+    import jax.numpy as jnp
+
+    from ..columnar import Column
+
+    cid, _kind = info.columns[name]
+    rows = info.stripes[si]["numberOfRows"]
+    present_raw, data_raw = info.column_streams(si, cid)
+    valid = (np.ones(rows, bool) if present_raw is None
+             else _decode_present(present_raw, rows))
+    nonnull = int(valid.sum())
+    bits = _decode_present(data_raw, nonnull)
+    compact = np.zeros(cap, bool)
+    compact[:nonnull] = bits[:nonnull]
+    valid_cap = np.zeros(cap, bool)
+    valid_cap[:rows] = valid
+    data = _null_expand(compact, valid_cap, cap)
+    return Column(data, jnp.asarray(valid_cap), dtype)
 
 
 def decode_column(info: OrcFileInfo, si: int, name: str, dtype, cap: int):
@@ -542,4 +702,8 @@ def decode_column(info: OrcFileInfo, si: int, name: str, dtype, cap: int):
         return decode_float_column(info, si, name, dtype, cap)
     if kind in _INT_KINDS:
         return decode_int_column(info, si, name, dtype, cap)
+    if kind == _KIND_STRING:
+        return decode_string_column(info, si, name, dtype, cap)
+    if kind == _KIND_BOOL:
+        return decode_bool_column(info, si, name, dtype, cap)
     raise OrcDeviceUnsupported(f"type kind {kind} not device-decodable")

@@ -511,11 +511,11 @@ def _host_chunks(fmt: str, files, schema: Schema, options: dict,
 
 def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
                         metrics) -> Iterator[ColumnarBatch]:
-    """Stripe-granular ORC decode with FLOAT/DOUBLE columns on device and
-    column-granular pyarrow fallback for everything else
-    (io/orc_device.py).  The whole control plane parses BEFORE the first
-    yield, so unsupported files fall back file-granularly; stripe
-    predicates skip provably-dead stripes like the host reader."""
+    """Stripe-granular ORC decode with floats/doubles, RLEv2 ints/dates,
+    strings, and booleans on device and column-granular pyarrow fallback
+    for the rest (io/orc_device.py).  The whole control plane parses
+    BEFORE the first yield, so unsupported files fall back file-granularly;
+    stripe predicates skip provably-dead stripes like the host reader."""
     from pyarrow import orc as paorc
 
     from ..columnar.batch import bucket_rows
