@@ -1,4 +1,4 @@
-"""Device-side ORC decode for float/double columns.
+"""Device-side ORC decode.
 
 The reference reassembles clipped ORC stripes in a host buffer and decodes
 them on device (GpuOrcScan.scala:247-711, Table.readORC).  The TPU-native
@@ -9,14 +9,15 @@ inflation, and the byte-RLE PRESENT bitmap — while the device does the
 vector work: IEEE bytes reinterpreted in one transfer and nulls expanded
 with the same cumsum+gather kernel the parquet path compiles.
 
-Scope (uncompressed or zlib files): FLOAT/DOUBLE (raw IEEE payload),
-SHORT/INT/LONG/DATE (RLEv2: host walks run headers, device bit-extracts
-every DIRECT run's packed values — the volume case for real data),
-STRING (DIRECT_V2 length+blob gather and DICTIONARY_V2 index+dictionary
-gather through the unsigned RLEv2 path), and BOOLEAN.  Timestamps,
-PATCHED_BASE runs, and DIRECT widths past the 8-byte extraction window
-fall back to the pyarrow stripe reader COLUMN-granularly, exactly like
-the parquet decoder's unsupported-encoding fallback.
+Scope (uncompressed or zlib files): every ORC primitive — FLOAT/DOUBLE
+(raw IEEE payload), SHORT/INT/LONG/DATE (RLEv2: host walks run headers,
+device bit-extracts every DIRECT run's packed values through a 9-byte
+window covering widths up to 64), STRING (DIRECT_V2 length+blob gather
+and DICTIONARY_V2 index+dictionary gather through the unsigned RLEv2
+path), BOOLEAN, and TIMESTAMP (2015-epoch seconds + trailing-zero
+compressed nanos combined in-kernel).  PATCHED_BASE runs and non-struct
+nesting fall back to the pyarrow stripe reader COLUMN-granularly, exactly
+like the parquet decoder's unsupported-encoding fallback.
 """
 from __future__ import annotations
 
@@ -158,10 +159,13 @@ def _parse_footer(buf: bytes) -> Tuple[list, list, int]:
     return stripes, types, total_rows
 
 
-def _parse_stripe_footer(buf: bytes) -> Tuple[List[dict], List[dict]]:
+def _parse_stripe_footer(buf: bytes
+                         ) -> Tuple[List[dict], List[dict], str]:
     """-> (streams [(kind, column, length)] in file order,
-           encodings [{kind, dictionarySize}] per column id)."""
+           encodings [{kind, dictionarySize}] per column id,
+           writerTimezone)."""
     streams, encodings = [], []
+    writer_tz = ""
     for fnum, _wt, v in _Proto(buf).fields():
         if fnum == 1:  # Stream
             st = {"kind": 0, "column": 0, "length": 0}
@@ -181,7 +185,9 @@ def _parse_stripe_footer(buf: bytes) -> Tuple[List[dict], List[dict]]:
                 elif fn2 == 2:
                     enc["dictionarySize"] = v2
             encodings.append(enc)
-    return streams, encodings
+        elif fnum == 3:  # writerTimezone
+            writer_tz = v.decode()
+    return streams, encodings, writer_tz
 
 
 def _decode_present(raw: bytes, num_rows: int) -> np.ndarray:
@@ -266,11 +272,15 @@ class OrcFileInfo:
         foot_off = s["offset"] + s["indexLength"] + s["dataLength"]
         footer = _inflate(self.read_range(foot_off, s["footerLength"]),
                           self.compression)
-        streams, encodings = _parse_stripe_footer(footer)
+        streams, encodings, writer_tz = _parse_stripe_footer(footer)
         enc_cache = getattr(self, "_enc_cache", None)
         if enc_cache is None:
             enc_cache = self._enc_cache = {}
         enc_cache[si] = encodings
+        tz_cache = getattr(self, "_tz_cache", None)
+        if tz_cache is None:
+            tz_cache = self._tz_cache = {}
+        tz_cache[si] = writer_tz
         # assign absolute offsets (streams are laid out in order after the
         # index region; PRESENT/DATA live in the data region but ORC
         # counts index streams first in the same list)
@@ -285,20 +295,27 @@ class OrcFileInfo:
         self.stripe_streams(si)  # populates the encoding cache
         return self._enc_cache[si]
 
+    def stripe_writer_timezone(self, si: int) -> str:
+        self.stripe_streams(si)
+        return self._tz_cache[si]
+
+    def stream_body(self, si: int, cid: int, kind: int,
+                    required: bool = True):
+        """One column stream's inflated bytes, or None when absent and not
+        required — the single read+inflate point every decoder shares."""
+        for st in self.stripe_streams(si):
+            if st["column"] == cid and st["kind"] == kind:
+                return _inflate(self.read_range(st["abs_offset"],
+                                                st["length"]),
+                                self.compression)
+        if required:
+            raise OrcDeviceUnsupported(f"stream kind {kind} missing")
+        return None
+
     def column_streams(self, si: int, cid: int):
         """(present_raw, data_raw) for one column of one stripe, inflated."""
-        present_raw = data_raw = None
-        for st in self.stripe_streams(si):
-            if st["column"] != cid:
-                continue
-            body = self.read_range(st["abs_offset"], st["length"])
-            if st["kind"] == _PRESENT:
-                present_raw = _inflate(body, self.compression)
-            elif st["kind"] == _DATA:
-                data_raw = _inflate(body, self.compression)
-        if data_raw is None:
-            raise OrcDeviceUnsupported("DATA stream missing")
-        return present_raw, data_raw
+        return (self.stream_body(si, cid, _PRESENT, required=False),
+                self.stream_body(si, cid, _DATA))
 
 
 def _null_expand(compact: np.ndarray, valid_cap: np.ndarray, cap: int):
@@ -426,8 +443,7 @@ def rlev2_runs(body: bytes, n_values: int, signed: bool = True):
             width = _W5[(h >> 1) & 31]
             ln = (((h & 1) << 8) | body[pos + 1]) + 1
             pos += 2
-            if width > 56:
-                raise OrcDeviceUnsupported(f"DIRECT width {width}")
+
             direct.append((width, pos, ln, out))
             pos += (ln * width + 7) // 8
             out += ln
@@ -503,21 +519,32 @@ def _rlev2_device_values(data_raw: bytes, count: int, out_cap: int,
 
     def build():
         def k(packed_v, compact_v, bitpos_v, widths_v, dests_v):
-            # big-endian 8-byte window starting at the value's byte
+            # big-endian 9-byte window starting at the value's byte: a
+            # 64-bit hi word + one spill byte covers any bit offset (0-7)
+            # with widths up to the full 64
             byte0 = bitpos_v // 8
-            idx = byte0[:, None] + jnp.arange(8, dtype=jnp.int64)[None]
+            idx = byte0[:, None] + jnp.arange(9, dtype=jnp.int64)[None]
             win = jnp.take(packed_v, jnp.clip(idx, 0,
                                               packed_v.shape[0] - 1),
                            mode="clip").astype(jnp.uint64)
             shifts = jnp.arange(56, -8, -8, dtype=jnp.uint64)
-            word = jnp.sum(win << shifts, axis=1, dtype=jnp.uint64)
-            # padding rows have width 0: clamp the shift below 64
-            # (UB otherwise); their mask is 0 so the value is 0 anyway
-            used = jnp.clip(64 - (bitpos_v % 8) - widths_v, 0, 63
-                            ).astype(jnp.uint64)
-            mask = (jnp.uint64(1) << widths_v.astype(jnp.uint64)) \
-                - jnp.uint64(1)
-            u = (word >> used) & mask
+            word = jnp.sum(win[:, :8] << shifts, axis=1, dtype=jnp.uint64)
+            spill = win[:, 8]
+            # bits span [b, b+W) of the 72-bit window; s = right gap
+            s = 72 - (bitpos_v % 8) - widths_v
+            # padding rows have width 0 (s up to 72): clamp shifts below
+            # 64 (UB otherwise); their mask is 0 so the value is 0 anyway
+            hi = word >> jnp.clip(s - 8, 0, 63).astype(jnp.uint64)
+            lo = (word << jnp.clip(8 - s, 0, 63).astype(jnp.uint64)) \
+                | (spill >> jnp.clip(s, 0, 63).astype(jnp.uint64))
+            raw = jnp.where(s >= 8, hi, lo)
+            mask = jnp.where(
+                widths_v >= 64,
+                jnp.uint64(0xFFFFFFFFFFFFFFFF),
+                (jnp.uint64(1) << jnp.clip(widths_v, 0, 63
+                                           ).astype(jnp.uint64))
+                - jnp.uint64(1))
+            u = raw & mask
             if signed:
                 v = (u >> jnp.uint64(1)).astype(jnp.int64) \
                     * jnp.where((u & jnp.uint64(1)) > 0, -1, 1) \
@@ -541,7 +568,6 @@ def decode_int_column(info: OrcFileInfo, si: int, name: str, dtype,
     import jax.numpy as jnp
 
     from ..columnar import Column
-    from ..utils.kernel_cache import cached_kernel
 
     cid, kind = info.columns[name]
     if kind not in _INT_KINDS:
@@ -575,6 +601,7 @@ def decode_string_column(info: OrcFileInfo, si: int, name: str, dtype,
     import jax.numpy as jnp
 
     from ..columnar import Column
+    from ..columnar.batch import bucket_rows
     from ..columnar.column import bucket_strlen
     from ..utils.kernel_cache import cached_kernel
 
@@ -585,21 +612,10 @@ def decode_string_column(info: OrcFileInfo, si: int, name: str, dtype,
     if enc not in (_ENC_DIRECT_V2, _ENC_DICT_V2):
         raise OrcDeviceUnsupported(f"string encoding kind {enc}")
     rows = info.stripes[si]["numberOfRows"]
-    streams = {st["kind"]: st for st in info.stripe_streams(si)
-               if st["column"] == cid}
-    present_raw = None
-    if _PRESENT in streams:
-        st = streams[_PRESENT]
-        present_raw = _inflate(info.read_range(st["abs_offset"],
-                                               st["length"]),
-                               info.compression)
+    present_raw = info.stream_body(si, cid, _PRESENT, required=False)
 
     def body(kind_):
-        st = streams.get(kind_)
-        if st is None:
-            raise OrcDeviceUnsupported(f"stream kind {kind_} missing")
-        return _inflate(info.read_range(st["abs_offset"], st["length"]),
-                        info.compression)
+        return info.stream_body(si, cid, kind_)
 
     valid = (np.ones(rows, bool) if present_raw is None
              else _decode_present(present_raw, rows))
@@ -613,9 +629,7 @@ def decode_string_column(info: OrcFileInfo, si: int, name: str, dtype,
         blob = np.frombuffer(body(_DATA), np.uint8)
     else:
         dict_size = info.stripe_encodings(si)[cid]["dictionarySize"]
-        dcap = max(int(dict_size), 1)
-        from ..columnar.batch import bucket_rows
-        dbucket = bucket_rows(dcap)
+        dbucket = bucket_rows(max(int(dict_size), 1))
         dict_lengths = _rlev2_device_values(body(_LENGTH), dict_size,
                                             dbucket, signed=False)
         indices = _rlev2_device_values(body(_DATA), nonnull, cap,
@@ -633,7 +647,6 @@ def decode_string_column(info: OrcFileInfo, si: int, name: str, dtype,
     max_len = int(jnp.max(jnp.where(
         jnp.arange(cap) < nonnull, lengths, 0)))  # one scalar sync
     width = bucket_strlen(max_len)
-    from ..columnar.batch import bucket_rows
     bbucket = bucket_rows(max(len(blob), 1))
     blob_pad = np.zeros(bbucket, np.uint8)
     blob_pad[:len(blob)] = blob
@@ -669,6 +682,69 @@ def decode_string_column(info: OrcFileInfo, si: int, name: str, dtype,
 
 
 _KIND_BOOL = 0
+_KIND_TIMESTAMP = 9
+_SECONDARY = 5
+# ORC timestamp epoch: 2015-01-01 00:00:00 UTC, in seconds since 1970
+_ORC_TS_EPOCH = 1420070400
+
+
+def decode_timestamp_column(info: OrcFileInfo, si: int, name: str, dtype,
+                            cap: int):
+    """TIMESTAMP = DATA (signed RLEv2 seconds from the 2015 epoch) +
+    SECONDARY (unsigned RLEv2 nanos with the trailing-zero compression:
+    low 3 bits t != 0 means nanos = (v >> 3) * 10^(t+1)).  Both streams
+    ride the shared RLEv2 device path; the epoch shift, zero expansion,
+    and micros combine run in one kernel with the null expansion."""
+    import jax.numpy as jnp
+
+    from ..columnar import Column
+    from ..utils.kernel_cache import cached_kernel
+
+    cid, kind = info.columns[name]
+    if kind != _KIND_TIMESTAMP:
+        raise OrcDeviceUnsupported(f"type kind {kind} is not TIMESTAMP")
+    rows = info.stripes[si]["numberOfRows"]
+    # ORC timestamps are relative to the WRITER's timezone; only GMT/UTC
+    # files decode without a tz conversion table (non-GMT writers fall
+    # back to the host reader rather than silently shifting hours)
+    tz = info.stripe_writer_timezone(si)
+    if tz not in ("", "GMT", "UTC", "Etc/UTC", "Etc/GMT"):
+        raise OrcDeviceUnsupported(f"writer timezone {tz!r}")
+    present_raw = info.stream_body(si, cid, _PRESENT, required=False)
+
+    def body(kind_):
+        return info.stream_body(si, cid, kind_)
+
+    valid = (np.ones(rows, bool) if present_raw is None
+             else _decode_present(present_raw, rows))
+    nonnull = int(valid.sum())
+    secs = _rlev2_device_values(body(_DATA), nonnull, cap, signed=True)
+    nraw = _rlev2_device_values(body(_SECONDARY), nonnull, cap,
+                                signed=False)
+    valid_cap = np.zeros(cap, bool)
+    valid_cap[:rows] = valid
+
+    def build():
+        def k(secs_v, nraw_v, valid_v):
+            t = nraw_v & 7
+            pow10 = jnp.asarray(
+                np.array([1, 100, 1000, 10000, 100000, 1000000, 10000000,
+                          100000000], dtype=np.int64))
+            nanos = (nraw_v >> 3) * jnp.take(pow10, t, mode="clip")
+            # ORC nanos are always the POSITIVE fraction; for pre-epoch
+            # times with a fraction the seconds were decremented by the
+            # writer, so the straight combine is exact
+            micros = (secs_v + _ORC_TS_EPOCH) * 1_000_000 + nanos // 1000
+            vi = jnp.clip(jnp.cumsum(valid_v.astype(jnp.int32)) - 1, 0,
+                          micros.shape[0] - 1)
+            out = jnp.take(micros, vi, mode="clip")
+            return jnp.where(valid_v, out, jnp.zeros_like(out))
+        return k
+
+    fn = cached_kernel(("orc_ts", cap), build)
+    data = fn(secs, nraw, jnp.asarray(valid_cap))
+    return Column(data.astype(dtype.jnp_dtype), jnp.asarray(valid_cap),
+                  dtype)
 
 
 def decode_bool_column(info: OrcFileInfo, si: int, name: str, dtype,
@@ -706,4 +782,6 @@ def decode_column(info: OrcFileInfo, si: int, name: str, dtype, cap: int):
         return decode_string_column(info, si, name, dtype, cap)
     if kind == _KIND_BOOL:
         return decode_bool_column(info, si, name, dtype, cap)
+    if kind == _KIND_TIMESTAMP:
+        return decode_timestamp_column(info, si, name, dtype, cap)
     raise OrcDeviceUnsupported(f"type kind {kind} not device-decodable")

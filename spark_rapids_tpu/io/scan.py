@@ -512,7 +512,7 @@ def _host_chunks(fmt: str, files, schema: Schema, options: dict,
 def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
                         metrics) -> Iterator[ColumnarBatch]:
     """Stripe-granular ORC decode with floats/doubles, RLEv2 ints/dates,
-    strings, and booleans on device and column-granular pyarrow fallback
+    strings, booleans, and timestamps on device and column-granular pyarrow fallback
     for the rest (io/orc_device.py).  The whole control plane parses
     BEFORE the first yield, so unsupported files fall back file-granularly;
     stripe predicates skip provably-dead stripes like the host reader."""

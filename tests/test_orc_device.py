@@ -167,12 +167,13 @@ class TestRlev2IntDecode:
             "i": pa.array(ints, pa.int64()),
             "dt": pa.array(dates, pa.date32())})
 
-    def test_wide_values_fall_back_correctly(self, tmp_path):
+    def test_wide_values_decode_on_device(self, tmp_path):
         import pyarrow as pa
-        # values needing >56 bits force the host fallback for the column
-        self._roundtrip(tmp_path, {
+        # values needing >56 bits use the 9-byte extraction window
+        q = self._roundtrip(tmp_path, {
             "big": pa.array([2**60, -2**60, 2**61, 5] * 100, pa.int64()),
             "ok": pa.array(list(range(400)), pa.int64())})
+        assert _device_cols(q) >= 2, "wide ints fell back"
 
     def test_int_pipeline_agg(self, tmp_path):
         import pyarrow as pa
@@ -284,3 +285,32 @@ def test_bool_decode(tmp_path):
     assert_rows_equal(q(cpu).collect(), q(dev).collect(),
                       ignore_order=False)
     assert _device_cols(q) >= 1
+
+
+def test_timestamp_decode(tmp_path):
+    """TIMESTAMP: 2015-epoch seconds + trailing-zero-compressed nanos,
+    incl. pre-epoch values and sub-second fractions."""
+    import datetime
+    import pyarrow as pa
+    from pyarrow import orc
+    vals = [
+        datetime.datetime(2015, 1, 1, 0, 0, 0),
+        datetime.datetime(2020, 6, 15, 12, 34, 56, 789000),
+        datetime.datetime(1969, 12, 31, 23, 59, 59, 999999),
+        datetime.datetime(1970, 1, 1, 0, 0, 0),
+        None,
+        datetime.datetime(2014, 12, 31, 23, 59, 59, 500000),
+        datetime.datetime(2038, 1, 19, 3, 14, 7, 123456),
+        datetime.datetime(1900, 1, 1, 0, 0, 1),
+    ] * 200
+    p = tmp_path / "t.orc"
+    orc.write_table(pa.table({"ts": pa.array(vals,
+                                             pa.timestamp("us"))}), str(p))
+
+    def q(s):
+        return s.read.orc(str(p))
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    dev = TpuSession({})
+    assert_rows_equal(q(cpu).collect(), q(dev).collect(),
+                      ignore_order=False)
+    assert _device_cols(q) >= 1, "timestamps fell back"
