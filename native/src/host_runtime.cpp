@@ -222,4 +222,61 @@ void murmur3_long_batch(const int64_t* vals, const uint8_t* valid,
   }
 }
 
+// Quote-aware CSV tokenizer (RFC-4180 subset: double-quote quoting with
+// "" escapes; LF row terminators).  The numpy delimiter scan in
+// io/csv_device.py cannot see quoting state — this single native pass
+// can, which is what extends the device CSV decode path to quoted files.
+//
+// Per field i (< cap_fields): starts[i]/lens[i] describe the value bytes.
+// Unquoted fields point at the raw span; quoted fields point INSIDE the
+// quotes.  flags[i] low bits: 0 = unquoted, 1 = quoted clean, 2 = quoted
+// with doubled-quote escapes still embedded (the caller rewrites those
+// few); bit 2 (value 4) marks the LAST field of a row.  Returns the
+// field count, or -1 on malformed quoting / field overflow / CR byte
+// (caller falls back to the host reader).
+int64_t csv_tokenize(const uint8_t* data, int64_t n, uint8_t sep,
+                     int64_t* starts, int64_t* lens, uint8_t* flags,
+                     int64_t cap_fields) {
+  int64_t nf = 0;
+  int64_t i = 0;
+  while (i < n) {
+    if (nf >= cap_fields) return -1;
+    uint8_t flag;
+    if (data[i] == '"') {  // quoted field
+      int64_t start = ++i;
+      flag = 1;
+      for (;;) {
+        if (i >= n) return -1;           // unterminated quote
+        if (data[i] == '"') {
+          if (i + 1 < n && data[i + 1] == '"') {  // escaped quote
+            flag = 2;
+            i += 2;
+            continue;
+          }
+          break;
+        }
+        ++i;
+      }
+      starts[nf] = start;
+      lens[nf] = i - start;
+      ++i;  // past closing quote
+      if (i < n && data[i] != sep && data[i] != '\n') return -1;
+    } else {  // unquoted field: runs to sep/newline
+      int64_t start = i;
+      flag = 0;
+      while (i < n && data[i] != sep && data[i] != '\n') {
+        if (data[i] == '"' || data[i] == '\r') return -1;
+        ++i;
+      }
+      starts[nf] = start;
+      lens[nf] = i - start;
+    }
+    if (i >= n || data[i] == '\n') flag |= 4;  // last field of its row
+    flags[nf] = flag;
+    ++nf;
+    if (i < n) ++i;  // past sep or newline
+  }
+  return nf;
+}
+
 }  // extern "C"

@@ -289,8 +289,9 @@ CSV_DEVICE_DECODE = _conf(
     "Tokenize and parse CSV on the device: the host computes only the "
     "delimiter index structure (one vectorized scan), the device gathers "
     "per-column byte matrices from the raw file buffer and runs the "
-    "string->value parse kernels.  Files with quoting, CR line endings, "
-    "or jagged rows fall back to the host arrow reader.", _to_bool)
+    "string->value parse kernels; quoted files tokenize through the "
+    "native C scanner.  CR line endings and jagged rows fall back to "
+    "the host arrow reader.", _to_bool)
 PARQUET_DEBUG_DUMP_PREFIX = _conf(
     "spark.rapids.sql.parquet.debug.dumpPrefix", "",
     "If set, dump the clipped host parquet buffer to this path prefix for "

@@ -8,7 +8,10 @@ what each side is good at:
   host   - ONE vectorized numpy scan over the raw bytes finds every
            delimiter and validates the rectangular structure (rows x cols);
            this is index arithmetic, not parsing, and is O(bytes) with no
-           Python per-row loop;
+           Python per-row loop.  Quoted files route through the native C
+           tokenizer instead (host_runtime.cpp csv_tokenize), which
+           handles embedded separators/newlines and doubled-quote escapes
+           in one stateful pass;
   device - the raw byte buffer is uploaded ONCE per file; each column's
            field bytes are gathered into a padded byte matrix by a 2-D
            take, and the existing string->value parse kernels (ops/cast.py
@@ -18,8 +21,11 @@ what each side is good at:
 
 Spark CSV null semantics match the host reader (io/scan.py
 _read_csv_arrow): unquoted empty, NULL and null tokens are null for every
-type.  Files outside the device tokenizer's scope (quote characters, CR
-line endings, jagged rows, multi-byte separators) raise
+type; quoted tokens stay literal.  One deliberate divergence: an
+UNPARSEABLE quoted value in a numeric column decodes as null (Spark's
+PERMISSIVE mode) where the pyarrow host reader raises — the device path
+follows Spark.  Files outside the tokenizers' scope (CR line endings, jagged
+rows, multi-byte separators, >2 GiB offsets) raise
 `CsvDeviceUnsupported` and the scan exec falls back to the host arrow
 reader for that file — the same file-granular fallback discipline as the
 parquet device decoder's column-granular one (io/parquet_device.py).
@@ -50,7 +56,9 @@ def _tokenize(raw: np.ndarray, sep: int, header: bool):
     (rows, ncols-as-found) from one delimiter scan.  Raises
     CsvDeviceUnsupported for structures the device gather cannot express."""
     if _QUOTE in raw:
-        raise CsvDeviceUnsupported("quoted fields")
+        # quoting needs stateful scanning (embedded separators/newlines,
+        # doubled-quote escapes) — one pass in the native tokenizer
+        return _tokenize_native(raw, sep, header)
     if _CR in raw:
         raise CsvDeviceUnsupported("CR line endings")
     if raw.size and raw[-1] != _NL:
@@ -64,7 +72,8 @@ def _tokenize(raw: np.ndarray, sep: int, header: bool):
     body = raw[data_start:]
     rows = int(np.count_nonzero(body == _NL))
     if rows == 0:
-        return raw, np.zeros((0, 1), np.int64), np.zeros((0, 1), np.int64)
+        return raw, np.zeros((0, 1), np.int64), np.zeros((0, 1), np.int64), \
+            None
     d = np.flatnonzero((body == sep) | (body == _NL)).astype(np.int64)
     if d.size % rows != 0:
         raise CsvDeviceUnsupported("jagged rows")
@@ -82,13 +91,63 @@ def _tokenize(raw: np.ndarray, sep: int, header: bool):
     if ncols > 1:
         starts[:, 1:] = bounds[:, :-1] + 1
     lengths = bounds - starts
-    return raw, starts + data_start, lengths
+    return raw, starts + data_start, lengths, None
+
+
+def _tokenize_native(raw: np.ndarray, sep: int, header: bool):
+    """Quote-aware tokenization through the C scanner
+    (native/src/host_runtime.cpp csv_tokenize): handles embedded
+    separators/newlines and doubled-quote escapes; escaped fields are
+    rewritten into a side buffer appended to the upload.  Returns
+    (raw, starts, lengths, quoted) with `quoted` marking fields whose
+    emptiness/NULL token must NOT read as null (quoted semantics)."""
+    from ..native import csv_tokenize
+
+    if raw.size and raw[-1] != _NL:
+        raw = np.concatenate([raw, np.array([_NL], dtype=np.uint8)])
+    tok = csv_tokenize(raw, sep)
+    if tok is None:
+        raise CsvDeviceUnsupported("quoted fields (native tokenizer "
+                                   "unavailable or malformed quoting)")
+    starts, lens, flags, nf = tok
+    if nf == 0:
+        return raw, np.zeros((0, 1), np.int64), np.zeros((0, 1), np.int64), \
+            None
+    row_last = np.flatnonzero(flags & 4)
+    ncols = int(row_last[0]) + 1
+    rows = row_last.size
+    if nf != rows * ncols or not (
+            row_last == np.arange(1, rows + 1) * ncols - 1).all():
+        raise CsvDeviceUnsupported("jagged rows")
+    # unescape the (rare) fields with doubled quotes into a side buffer
+    esc = np.flatnonzero((flags & 3) == 2)
+    if esc.size:
+        side = bytearray()
+        base = int(raw.size)
+        for i in esc.tolist():
+            s, l = int(starts[i]), int(lens[i])
+            fixed = raw[s:s + l].tobytes().replace(b'""', b'"')
+            starts[i] = base + len(side)
+            lens[i] = len(fixed)
+            side.extend(fixed)
+        raw = np.concatenate([raw, np.frombuffer(bytes(side),
+                                                 dtype=np.uint8)])
+    starts = starts.reshape(rows, ncols)
+    lengths = lens.reshape(rows, ncols)
+    quoted = ((flags & 3) > 0).reshape(rows, ncols)
+    if header:
+        starts, lengths, quoted = starts[1:], lengths[1:], quoted[1:]
+    return raw, starts, lengths, quoted
 
 
 def _decode_chunk(raw_dev, starts: np.ndarray, lengths: np.ndarray,
-                  schema: Schema, conf) -> ColumnarBatch:
+                  schema: Schema, conf,
+                  quoted: "np.ndarray | None" = None) -> ColumnarBatch:
     """Gather each column's field bytes on device and parse to the target
-    dtype.  `starts`/`lengths` are the chunk's host token structure."""
+    dtype.  `starts`/`lengths` are the chunk's host token structure;
+    `quoted` marks fields whose null-token forms stay literal (a quoted
+    "" is the empty string, a quoted "NULL" is the word — pyarrow's
+    quoted_strings_can_be_null=False semantics)."""
     import jax.numpy as jnp
 
     from ..ops import cast as castmod
@@ -106,15 +165,19 @@ def _decode_chunk(raw_dev, starts: np.ndarray, lengths: np.ndarray,
         ln = np.zeros(cap, dtype=np.int32)
         s[:rows] = starts[:, i]
         ln[:rows] = lengths[:, i]
+        qm = np.zeros(cap, dtype=bool)
+        if quoted is not None and rows:
+            qm[:rows] = quoted[:, i]
         key = ("csv_decode", f.dtype.name, cap, width)
 
         def make(dtype=f.dtype, width=width):
-            def fn(raw, s, ln, sel):
+            def fn(raw, s, ln, sel, qm):
                 pos = jnp.arange(width, dtype=jnp.int32)[None, :]
                 idx = jnp.clip(s[:, None] + pos, 0, raw.shape[0] - 1)
                 in_field = pos < ln[:, None]
                 data = jnp.where(in_field, raw[idx], 0)
-                # Spark CSV null tokens: empty, NULL, null (for all types)
+                # Spark CSV null tokens: empty, NULL, null (for all
+                # types) — but only for UNQUOTED fields
                 is_null = (ln == 0)
                 for tok in (b"NULL", b"null"):
                     t = np.frombuffer(tok, dtype=np.uint8)
@@ -123,7 +186,7 @@ def _decode_chunk(raw_dev, starts: np.ndarray, lengths: np.ndarray,
                         for j, b in enumerate(t):
                             m = m & (data[:, j] == b)
                         is_null = is_null | m
-                valid = sel & ~is_null
+                valid = sel & ~(is_null & ~qm)
                 c = Column(data, valid, StringType, ln.astype(jnp.int32))
                 if dtype.is_string:
                     return c.mask_invalid()
@@ -133,7 +196,8 @@ def _decode_chunk(raw_dev, starts: np.ndarray, lengths: np.ndarray,
             return jax.jit(fn)
 
         fn = cached_kernel(key, make)
-        cols.append(fn(raw_dev, jnp.asarray(s), jnp.asarray(ln), sel))
+        cols.append(fn(raw_dev, jnp.asarray(s), jnp.asarray(ln), sel,
+                       jnp.asarray(qm)))
     return ColumnarBatch(cols, sel, schema)
 
 
@@ -158,7 +222,11 @@ def device_csv_batches(files, schema: Schema, options: dict, conf,
     try:
         for path in files:
             raw = np.fromfile(path, dtype=np.uint8)
-            raw, starts, lengths = _tokenize(raw, sep_b, header)
+            raw, starts, lengths, quoted = _tokenize(raw, sep_b, header)
+            if raw.size >= 2**31:
+                # the decode kernel carries int32 byte offsets; a bigger
+                # buffer would wrap silently — host reader handles it
+                raise CsvDeviceUnsupported(">2 GiB file offsets")
             rows, ncols = starts.shape
             if rows and ncols != len(schema):
                 # single empty-string column: an empty line is one empty
@@ -173,13 +241,16 @@ def device_csv_batches(files, schema: Schema, options: dict, conf,
             off = 0
             while off < rows or (rows == 0 and off == 0):
                 hi = min(off + max_rows, rows)
+                qchunk = quoted[off:hi] if quoted is not None else None
                 if metrics is not None:
                     with metrics.timer("scanTime"):
                         batch = _decode_chunk(raw_dev, starts[off:hi],
-                                              lengths[off:hi], schema, conf)
+                                              lengths[off:hi], schema,
+                                              conf, qchunk)
                 else:
                     batch = _decode_chunk(raw_dev, starts[off:hi],
-                                          lengths[off:hi], schema, conf)
+                                          lengths[off:hi], schema, conf,
+                                          qchunk)
                 yield batch, hi - off
                 off = hi
                 if rows == 0:

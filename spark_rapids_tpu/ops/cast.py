@@ -242,6 +242,26 @@ def _parse_float(c: Column, dst: DataType) -> Column:
     exp_ok = (e_count == 0) | (jnp.sum(exp_digit, axis=1) > 0)
     ok = (jnp.all(legal | ~in_range, axis=1) & (lens > 0) & has_mant_digit
           & (dot_count <= 1) & (e_count <= 1) & exp_ok)
+    # special tokens Spark/Java accept (case-insensitive, optional sign):
+    # NaN, Inf, Infinity
+    first = ch[:, 0] if L > 0 else jnp.zeros(cap, jnp.uint8)
+    sign_off = ((first == ord("-")) | (first == ord("+"))
+                ).astype(jnp.int32)
+    low = jnp.where((ch >= 65) & (ch <= 90), ch + 32, ch)
+
+    def tok_match(tok: bytes):
+        m = (lens - sign_off) == len(tok)
+        for j, b in enumerate(tok):
+            cj = jnp.take_along_axis(
+                low, jnp.clip(sign_off + j, 0, L - 1)[:, None],
+                axis=1)[:, 0]
+            m = m & (cj == b)
+        return m
+    is_nan = tok_match(b"nan")
+    is_inf = tok_match(b"inf") | tok_match(b"infinity")
+    inf_v = jnp.where(neg, -jnp.inf, jnp.inf)
+    val = jnp.where(is_nan, jnp.nan, jnp.where(is_inf, inf_v, val))
+    ok = ok | is_nan | is_inf
     return Column(val.astype(dst.jnp_dtype), c.valid & ok, dst).mask_invalid()
 
 

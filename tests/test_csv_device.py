@@ -2,8 +2,9 @@
 
 Coverage model mirrors the reference's CSV compat carve-outs
 (GpuBatchScanExec.scala:309-477 + docs/compatibility.md CSV section):
-well-formed unquoted files decode on device; quoting/CR/jagged files fall
-back to the host reader, file-granular."""
+well-formed files decode on device — including RFC-4180 quoting through
+the native tokenizer; CR/jagged files fall back to the host reader,
+file-granular."""
 import sys
 from pathlib import Path
 
@@ -88,12 +89,25 @@ def test_device_csv_no_header_and_chunked(tmp_path):
         conf={"spark.rapids.sql.reader.batchSizeRows": "128"})
 
 
-def test_device_csv_quoted_falls_back_correctly(tmp_path):
+def test_device_csv_quoted_decodes_on_device(tmp_path):
+    """Quoted files go through the native tokenizer (embedded separators,
+    newlines, doubled-quote escapes) and still decode on device."""
     p = tmp_path / "t.csv"
-    p.write_text('i,l,d,s,b,dt\n1,2,0.5,"a,b",true,2024-01-01\n')
+    p.write_text('i,l,d,s,b,dt\n'
+                 '1,2,0.5,"a,b",true,2024-01-01\n'
+                 '2,3,1.5,"line\nbreak",false,2024-01-02\n'
+                 '3,4,2.5,"he said ""hi""",true,2024-01-03\n'
+                 '4,5,3.5,"",false,2024-01-04\n'
+                 '5,6,4.5,"NULL",true,2024-01-05\n')
     q = _q(p)
-    assert_tpu_and_cpu_are_equal(q, ignore_order=False)
-    assert _device_stats(q) == 0, "quoted file must use the host reader"
+    rows = assert_tpu_and_cpu_are_equal(q, ignore_order=False)
+    assert _device_stats(q) > 0, "quoted file fell back off-device"
+    by_i = {r[0]: r[3] for r in rows}
+    assert by_i[1] == "a,b"
+    assert by_i[2] == "line\nbreak"
+    assert by_i[3] == 'he said "hi"'
+    assert by_i[4] == ""          # quoted empty is the empty string
+    assert by_i[5] == "NULL"      # quoted NULL is the word, not null
 
 
 def test_device_csv_mixed_files_partial_fallback(tmp_path):
