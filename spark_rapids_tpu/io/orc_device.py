@@ -190,23 +190,27 @@ def _parse_stripe_footer(buf: bytes
     return streams, encodings, writer_tz
 
 
-def _decode_present(raw: bytes, num_rows: int) -> np.ndarray:
-    """ORC boolean RLE (byte-RLE over MSB-first bits) -> bool[num_rows]."""
-    out_bytes = bytearray()
+def _byte_rle(raw: bytes, need: int) -> bytes:
+    """ORC byte-RLE expansion (runs of h+3 repeats / 256-h literals)."""
+    out = bytearray()
     pos = 0
-    need = (num_rows + 7) // 8
-    while pos < len(raw) and len(out_bytes) < need:
+    while pos < len(raw) and len(out) < need:
         h = raw[pos]
         pos += 1
         if h < 128:  # run: h+3 copies of the next byte
-            out_bytes.extend(raw[pos:pos + 1] * (h + 3))
+            out.extend(raw[pos:pos + 1] * (h + 3))
             pos += 1
         else:  # literals: 256-h bytes verbatim
             k = 256 - h
-            out_bytes.extend(raw[pos:pos + k])
+            out.extend(raw[pos:pos + k])
             pos += k
-    bits = np.unpackbits(np.frombuffer(bytes(out_bytes[:need]),
-                                       dtype=np.uint8))
+    return bytes(out[:need])
+
+
+def _decode_present(raw: bytes, num_rows: int) -> np.ndarray:
+    """ORC boolean RLE (byte-RLE over MSB-first bits) -> bool[num_rows]."""
+    bits = np.unpackbits(np.frombuffer(
+        _byte_rle(raw, (num_rows + 7) // 8), dtype=np.uint8))
     return bits[:num_rows].astype(bool)
 
 
@@ -747,6 +751,31 @@ def decode_timestamp_column(info: OrcFileInfo, si: int, name: str, dtype,
                   dtype)
 
 
+def decode_byte_column(info: OrcFileInfo, si: int, name: str, dtype,
+                       cap: int):
+    """TINYINT values are byte-RLE literal bytes (signed int8)."""
+    import jax.numpy as jnp
+
+    from ..columnar import Column
+
+    cid, _kind = info.columns[name]
+    rows = info.stripes[si]["numberOfRows"]
+    present_raw, data_raw = info.column_streams(si, cid)
+    valid = (np.ones(rows, bool) if present_raw is None
+             else _decode_present(present_raw, rows))
+    nonnull = int(valid.sum())
+    vals = np.frombuffer(_byte_rle(data_raw, nonnull), dtype=np.int8)
+    if vals.size < nonnull:
+        raise OrcDeviceUnsupported("BYTE stream shorter than non-null rows")
+    compact = np.zeros(cap, np.int8)
+    compact[:nonnull] = vals
+    valid_cap = np.zeros(cap, bool)
+    valid_cap[:rows] = valid
+    data = _null_expand(compact, valid_cap, cap)
+    return Column(data.astype(dtype.jnp_dtype), jnp.asarray(valid_cap),
+                  dtype)
+
+
 def decode_bool_column(info: OrcFileInfo, si: int, name: str, dtype,
                        cap: int):
     """BOOLEAN values are the same byte-RLE bitmap as PRESENT: the host
@@ -784,4 +813,6 @@ def decode_column(info: OrcFileInfo, si: int, name: str, dtype, cap: int):
         return decode_bool_column(info, si, name, dtype, cap)
     if kind == _KIND_TIMESTAMP:
         return decode_timestamp_column(info, si, name, dtype, cap)
+    if kind == _KIND_BYTE:
+        return decode_byte_column(info, si, name, dtype, cap)
     raise OrcDeviceUnsupported(f"type kind {kind} not device-decodable")

@@ -314,3 +314,21 @@ def test_timestamp_decode(tmp_path):
     assert_rows_equal(q(cpu).collect(), q(dev).collect(),
                       ignore_order=False)
     assert _device_cols(q) >= 1, "timestamps fell back"
+
+
+def test_tinyint_decode(tmp_path):
+    import pyarrow as pa
+    from pyarrow import orc
+    rng = np.random.RandomState(12)
+    vals = [None if rng.rand() < 0.15 else int(v)
+            for v in rng.randint(-128, 128, 2000)]
+    p = tmp_path / "t.orc"
+    orc.write_table(pa.table({"b": pa.array(vals, pa.int8())}), str(p))
+
+    def q(s):
+        return s.read.orc(str(p))
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    dev = TpuSession({})
+    assert_rows_equal(q(cpu).collect(), q(dev).collect(),
+                      ignore_order=False)
+    assert _device_cols(q) >= 1, "tinyint fell back"
