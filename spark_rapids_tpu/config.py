@@ -282,9 +282,9 @@ ORC_DEVICE_DECODE = _conf(
     "(DIRECT_V2 and DICTIONARY_V2 blob gathers), booleans, and "
     "timestamps.  The host keeps the protobuf control plane, zlib "
     "inflation, byte-RLE bitmaps, and RLEv2 run headers.  "
-    "Char/varchar/decimal/binary, PATCHED_BASE runs, non-GMT writer "
-    "timezones, and nested types fall back to the host stripe reader "
-    "column-granularly.", _to_bool)
+    "Char/varchar/decimal/binary, non-GMT writer timezones, and nested "
+    "types fall back to the host stripe reader column-granularly.",
+    _to_bool)
 CSV_DEVICE_DECODE = _conf(
     "spark.rapids.sql.format.csv.deviceDecode.enabled", True,
     "Tokenize and parse CSV on the device: the host computes only the "

@@ -15,9 +15,12 @@ device bit-extracts every DIRECT run's packed values through a 9-byte
 window covering widths up to 64), STRING (DIRECT_V2 length+blob gather
 and DICTIONARY_V2 index+dictionary gather through the unsigned RLEv2
 path), BOOLEAN, and TIMESTAMP (2015-epoch seconds + trailing-zero
-compressed nanos combined in-kernel).  PATCHED_BASE runs and non-struct
-nesting fall back to the pyarrow stripe reader COLUMN-granularly, exactly
-like the parquet decoder's unsupported-encoding fallback.
+compressed nanos combined in-kernel).  All four RLEv2 sub-encodings
+decode (SHORT_REPEAT/DIRECT/DELTA/PATCHED_BASE — patched runs are rare
+outlier forms and decode on host within the run walk).  Char/varchar/
+decimal/binary and nested types fall back to the pyarrow stripe reader
+COLUMN-granularly, exactly like the parquet decoder's
+unsupported-encoding fallback.
 """
 from __future__ import annotations
 
@@ -479,8 +482,34 @@ def rlev2_runs(body: bytes, n_values: int, signed: bool = True):
                 pos += max(0, ((ln - 2) * width + 7) // 8)
             host_vals[out:out + ln] = vals
             out += ln
-        else:  # PATCHED_BASE
-            raise OrcDeviceUnsupported("PATCHED_BASE run")
+        else:  # PATCHED_BASE: base + packed deltas, outliers patched in
+            width = _W5[(h >> 1) & 31]
+            ln = (((h & 1) << 8) | body[pos + 1]) + 1
+            b3, b4 = body[pos + 2], body[pos + 3]
+            bw = ((b3 >> 5) & 7) + 1          # base width, bytes
+            pw = _W5[b3 & 31]                 # patch value width, bits
+            pgw = ((b4 >> 5) & 7) + 1         # patch gap width, bits
+            pll = b4 & 31                     # patch list entries
+            pos += 4
+            base = int.from_bytes(body[pos:pos + bw], "big")
+            msb = 1 << (bw * 8 - 1)
+            if base & msb:                    # sign-magnitude base
+                base = -(base & (msb - 1))
+            pos += bw
+            deltas = _unpack_bits_host(body, pos * 8, ln,
+                                       width).astype(object)
+            pos += (ln * width + 7) // 8
+            pw_total = next(w for w in _W5 if w >= pgw + pw)
+            patches = _unpack_bits_host(body, pos * 8, pll, pw_total)
+            pos += (pll * pw_total + 7) // 8
+            gap_pos = 0
+            for pe in patches.tolist():
+                gap_pos += int(pe) >> pw
+                pval = int(pe) & ((1 << pw) - 1)
+                if pval:
+                    deltas[gap_pos] = int(deltas[gap_pos]) | (pval << width)
+            host_vals[out:out + ln] = base + deltas.astype(np.int64)
+            out += ln
     if out != n_values:
         raise OrcDeviceUnsupported(
             f"RLEv2 stream decoded {out} of {n_values} values")

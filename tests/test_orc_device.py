@@ -332,3 +332,28 @@ def test_tinyint_decode(tmp_path):
     assert_rows_equal(q(cpu).collect(), q(dev).collect(),
                       ignore_order=False)
     assert _device_cols(q) >= 1, "tinyint fell back"
+
+
+def test_patched_base_runs(tmp_path):
+    """Mostly-small values with rare huge outliers make the writer emit
+    PATCHED_BASE runs (base + packed deltas + patch list); signed
+    negatives exercise the sign-magnitude base."""
+    import pyarrow as pa
+    from pyarrow import orc
+    rng = np.random.RandomState(13)
+    vals = rng.randint(0, 100, 5000).astype(np.int64)
+    vals[::512] = 2**45
+    neg = rng.randint(-100, 0, 5000).astype(np.int64)
+    neg[::700] = -(2**40)
+    p = tmp_path / "t.orc"
+    orc.write_table(pa.table({
+        "v": pa.array(vals.tolist(), pa.int64()),
+        "n": pa.array(neg.tolist(), pa.int64())}), str(p))
+
+    def q(s):
+        return s.read.orc(str(p))
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    dev = TpuSession({})
+    assert_rows_equal(q(cpu).collect(), q(dev).collect(),
+                      ignore_order=False)
+    assert _device_cols(q) >= 2, "patched-base columns fell back"
