@@ -120,6 +120,131 @@ _PLAIN, _PLAIN_DICT, _RLE, _BITPACK_DEP, _DELTA = 0, 2, 3, 4, 5
 _RLE_DICT = 8
 
 
+_DELTA_BP = 5  # Encoding.DELTA_BINARY_PACKED
+
+
+def _uvarint(buf: bytes, pos: int):
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _delta_bp_decode(payload: bytes, n_values: int, cap: int):
+    """DELTA_BINARY_PACKED ints: host walks the block/miniblock headers
+    (a handful per page), the DEVICE unpacks every miniblock's
+    little-endian bit-packed deltas in one vectorized gather+shift, adds
+    the per-block min deltas, and rebuilds values with one masked cumsum.
+    The format stores first_value + (n-1) deltas; miniblocks are padded
+    to full size, so padding lanes are masked out of the cumsum."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils.kernel_cache import cached_kernel
+
+    pos = 0
+    block, pos = _uvarint(payload, pos)
+    minis, pos = _uvarint(payload, pos)
+    total, pos = _uvarint(payload, pos)
+    fz, pos = _uvarint(payload, pos)
+    first = (fz >> 1) ^ -(fz & 1)
+    if total != n_values:
+        raise DeviceDecodeUnsupported(
+            f"delta count {total} != page values {n_values}")
+    vpm = block // max(minis, 1)
+    n_delta = max(total - 1, 0)
+
+    bitpos_l, width_l, dest_l, mind_l = [], [], [], []
+    taken = 0
+    while taken < n_delta:
+        mz, pos = _uvarint(payload, pos)
+        min_d = (mz >> 1) ^ -(mz & 1)
+        widths = payload[pos:pos + minis]
+        pos += minis
+        for mi in range(minis):
+            if taken >= n_delta:
+                break
+            w = widths[mi]
+            take = min(vpm, n_delta - taken)
+            if w:
+                bitpos_l.append(pos * 8 + np.arange(take, dtype=np.int64)
+                                * w)
+                width_l.append(np.full(take, w, np.int64))
+                dest_l.append(taken + np.arange(take, dtype=np.int64))
+                pos += (vpm * w + 7) // 8   # padded to FULL miniblock
+            mind_l.append(np.full(take, min_d, np.int64))
+            taken += take
+
+    from ..columnar.batch import bucket_rows
+    dcap = bucket_rows(max(n_delta, 1))
+    mind = np.zeros(dcap, np.int64)
+    if mind_l:
+        md = np.concatenate(mind_l)
+        mind[:md.size] = md
+    n_packed = sum(b.size for b in bitpos_l)
+    pbucket = bucket_rows(max(n_packed, 1))
+    bitpos = np.zeros(pbucket, np.int64)
+    widths_a = np.zeros(pbucket, np.int64)
+    dests = np.full(pbucket, dcap, np.int64)
+    o = 0
+    for b, w, d in zip(bitpos_l, width_l, dest_l):
+        bitpos[o:o + b.size] = b
+        widths_a[o:o + b.size] = w
+        dests[o:o + b.size] = d
+        o += b.size
+    rbucket = bucket_rows(max(len(payload), 1))
+    raw = np.zeros(rbucket, np.uint8)
+    raw[:len(payload)] = np.frombuffer(payload, np.uint8)
+
+    def build():
+        def k(raw_v, bitpos_v, widths_v, dests_v, mind_v, first_v,
+              n_delta_v):
+            # little-endian 9-byte window (parquet packs lsb-first)
+            byte0 = bitpos_v // 8
+            idx = byte0[:, None] + jnp.arange(9, dtype=jnp.int64)[None]
+            win = jnp.take(raw_v, jnp.clip(idx, 0, raw_v.shape[0] - 1),
+                           mode="clip").astype(jnp.uint64)
+            shifts = (jnp.arange(9, dtype=jnp.uint64) * 8)[:8]
+            word = jnp.sum(win[:, :8] << shifts, axis=1, dtype=jnp.uint64)
+            spill = win[:, 8]
+            b = (bitpos_v % 8).astype(jnp.uint64)
+            lo = word >> b
+            # b == 0 would shift by 64 (UB); the where() discards that
+            # lane, so clamp the shift to stay defined
+            hi = jnp.where(
+                b > 0,
+                spill << jnp.clip(jnp.uint64(64) - b, jnp.uint64(0),
+                                  jnp.uint64(63)), jnp.uint64(0))
+            mask = jnp.where(
+                widths_v >= 64, jnp.uint64(0xFFFFFFFFFFFFFFFF),
+                (jnp.uint64(1) << jnp.clip(widths_v, 0, 63
+                                           ).astype(jnp.uint64))
+                - jnp.uint64(1))
+            u = ((lo | hi) & mask).astype(jnp.int64)
+            deltas = jnp.zeros(dcap, jnp.int64).at[dests_v].set(
+                u, mode="drop")
+            lane = jnp.arange(dcap, dtype=jnp.int64)
+            deltas = jnp.where(lane < n_delta_v, deltas + mind_v, 0)
+            c = jnp.cumsum(deltas)
+            vals = jnp.zeros(cap, jnp.int64).at[0].set(first_v)
+            n_out = jnp.minimum(n_delta_v + 1, cap)
+            take_idx = jnp.clip(jnp.arange(cap) - 1, 0, dcap - 1)
+            vals = jnp.where(
+                (jnp.arange(cap) >= 1) & (jnp.arange(cap) < n_out),
+                first_v + jnp.take(c, take_idx, mode="clip"), vals)
+            return vals
+        return k
+
+    fn = cached_kernel(("pq_delta_bp", cap, dcap, pbucket, rbucket), build)
+    return fn(jnp.asarray(raw), jnp.asarray(bitpos), jnp.asarray(widths_a),
+              jnp.asarray(dests), jnp.asarray(mind),
+              jnp.int64(first), jnp.int64(n_delta))
+
+
 def _parse_page_header(buf: bytes, pos: int):
     t = _Thrift(buf, pos)
     s = t.read_struct()
@@ -411,8 +536,10 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
         raise DeviceDecodeUnsupported(f"physical type {phys}")
     encs = set(col_meta.encodings)
     if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
-                    "BIT_PACKED"}:
+                    "BIT_PACKED", "DELTA_BINARY_PACKED"}:
         raise DeviceDecodeUnsupported(f"encodings {encs}")
+    if "DELTA_BINARY_PACKED" in encs and phys not in ("INT32", "INT64"):
+        raise DeviceDecodeUnsupported("DELTA_BINARY_PACKED non-int")
     start = col_meta.dictionary_page_offset \
         if col_meta.dictionary_page_offset is not None \
         else col_meta.data_page_offset
@@ -495,6 +622,8 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
             value_pieces.append(("plain", data[dpos:], nonnull))
         elif enc in (_RLE_DICT, _PLAIN_DICT):
             value_pieces.append(("dict", data[dpos:], nonnull))
+        elif enc == _DELTA_BP:
+            value_pieces.append(("delta_bp", data[dpos:], nonnull))
         else:
             raise DeviceDecodeUnsupported(f"value encoding {enc}")
         rows_seen += n_vals
@@ -562,6 +691,9 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
             else:
                 piece = _plain_decode(payload, nonnull, phys, pcap)
                 piece = piece.astype(dtype.jnp_dtype)
+        elif kind == "delta_bp":
+            piece = _delta_bp_decode(payload, nonnull, pcap).astype(
+                dtype.jnp_dtype)
         else:
             if dict_values is None:
                 raise DeviceDecodeUnsupported("dict page missing")

@@ -205,3 +205,46 @@ def test_string_heavy_query_roundtrip(tmp_path):
                 .order_by("s"))
     for wc in (WRITE_CONFS[1], WRITE_CONFS[2]):
         _roundtrip(tmp_path, wc, _table(n=2500, seed=6), query=q)
+
+
+def test_delta_binary_packed_decode(tmp_path):
+    """DELTA_BINARY_PACKED int pages decode on device (host walks
+    block/miniblock headers; device unpacks little-endian deltas and
+    rebuilds values with one masked cumsum)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from compare import assert_rows_equal
+    from spark_rapids_tpu.engine import TpuSession
+    rng = np.random.RandomState(14)
+    n = 5000
+    vals = [None if rng.rand() < 0.1 else int(v)
+            for v in rng.randint(-10**9, 10**9, n)]
+    seq = list(range(n))
+    p = tmp_path / "t.parquet"
+    pq.write_table(pa.table({
+        "a": pa.array(vals, pa.int64()),
+        "seq": pa.array(seq, pa.int32())}), str(p),
+        use_dictionary=False,
+        column_encoding={"a": "DELTA_BINARY_PACKED",
+                         "seq": "DELTA_BINARY_PACKED"},
+        compression="none")
+
+    def q(s):
+        return s.read.parquet(str(p))
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    dev = TpuSession({})
+    assert_rows_equal(q(cpu).collect(), q(dev).collect(),
+                      ignore_order=False)
+    # the device decoder actually engaged
+    node = dev.plan(q(dev).plan)
+    from spark_rapids_tpu.exec.base import ExecContext
+    list(node.execute(ExecContext(dev.conf, runtime=dev.runtime)))
+    total = [0]
+
+    def walk(nd):
+        total[0] += nd.metrics.values.get("numDeviceDecodedColumns", 0)
+        for c in nd.children:
+            walk(c)
+    walk(node)
+    assert total[0] >= 2, "delta-packed columns fell back"
