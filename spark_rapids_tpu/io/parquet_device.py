@@ -18,9 +18,11 @@ split keeps the same boundary but places it where this hardware wants it:
     * dictionary gather and null-expansion (cumsum+gather, no scatter)
 
 Scope (planner falls back to the pyarrow host path otherwise, like the
-reference's fallback flags): PLAIN / RLE_DICTIONARY(+PLAIN_DICTIONARY)
-encodings, UNCOMPRESSED or pyarrow-supported codecs, flat non-nested
-columns of INT32/INT64/FLOAT/DOUBLE/BOOLEAN, data page v1/v2.
+reference's fallback flags): PLAIN / RLE_DICTIONARY(+PLAIN_DICTIONARY) /
+DELTA_BINARY_PACKED (ints) / BYTE_STREAM_SPLIT (floats+ints) encodings,
+UNCOMPRESSED or pyarrow-supported codecs, flat non-nested columns of
+INT32/INT64/FLOAT/DOUBLE/BOOLEAN (+BYTE_ARRAY via dictionaries), data
+page v1/v2.
 """
 from __future__ import annotations
 
@@ -120,7 +122,8 @@ _PLAIN, _PLAIN_DICT, _RLE, _BITPACK_DEP, _DELTA = 0, 2, 3, 4, 5
 _RLE_DICT = 8
 
 
-_DELTA_BP = 5  # Encoding.DELTA_BINARY_PACKED
+_DELTA_BP = 5   # Encoding.DELTA_BINARY_PACKED
+_BSS = 9        # Encoding.BYTE_STREAM_SPLIT
 
 
 def _uvarint(buf: bytes, pos: int):
@@ -485,6 +488,53 @@ def _indices_decode(payload: bytes, n_values: int, cap: int):
 _PHYS_OK = {"INT32", "INT64", "FLOAT", "DOUBLE", "BOOLEAN", "BYTE_ARRAY"}
 
 
+def _bss_decode(payload: bytes, n_values: int, phys: str, cap: int):
+    """BYTE_STREAM_SPLIT: value i's k-th byte lives in byte plane k
+    (payload[k*n + i]) — decode is ONE device gather over the plane
+    layout plus a little-endian byte combine.  float32 bitcasts on
+    device; float64 combines on host (f64<->int bitcasts are
+    unimplemented on the emulated-f64 chip — the same carve-out as the
+    sort keys, exec/sort.py:float_sort_keys)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils.kernel_cache import cached_kernel
+
+    width = 4 if phys in ("FLOAT", "INT32") else 8
+    if len(payload) < n_values * width:
+        raise DeviceDecodeUnsupported("BYTE_STREAM_SPLIT short payload")
+    if phys == "DOUBLE":
+        planes = np.frombuffer(payload[:n_values * 8], np.uint8
+                               ).reshape(8, n_values)
+        vals = np.ascontiguousarray(planes.T).reshape(-1).view(np.float64)
+        out = np.zeros(cap, np.float64)
+        out[:n_values] = vals
+        return jnp.asarray(out)
+    raw = np.zeros(bucket_rows(max(len(payload), 1)), np.uint8)
+    raw[:len(payload)] = np.frombuffer(payload, np.uint8)
+
+    def build():
+        def k(raw_v, n_v):
+            lane = jnp.arange(cap, dtype=jnp.int64)
+            idx = (jnp.arange(width, dtype=jnp.int64)[None, :] * n_v
+                   + lane[:, None])
+            b = jnp.take(raw_v, jnp.clip(idx, 0, raw_v.shape[0] - 1),
+                         mode="clip").astype(jnp.uint32 if width == 4
+                                             else jnp.uint64)
+            sh = (jnp.arange(width, dtype=b.dtype) * 8)
+            word = jnp.sum(b << sh[None, :], axis=1, dtype=b.dtype)
+            word = jnp.where(lane < n_v, word, jnp.zeros((), b.dtype))
+            if phys == "FLOAT":
+                return jax.lax.bitcast_convert_type(word, jnp.float32)
+            if phys == "INT32":
+                return word.astype(jnp.int32)
+            return word.astype(jnp.int64)
+        return k
+
+    fn = cached_kernel(("pq_bss", phys, cap, int(raw.size)), build)
+    return fn(jnp.asarray(raw), jnp.int64(n_values))
+
+
 def _parse_byte_array_dict(data: bytes, n: int):
     """PLAIN byte_array dictionary page -> (byte matrix [n_cap, L],
     lengths [n_cap]) as numpy.  The dictionary is the SMALL side of a
@@ -536,10 +586,14 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
         raise DeviceDecodeUnsupported(f"physical type {phys}")
     encs = set(col_meta.encodings)
     if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
-                    "BIT_PACKED", "DELTA_BINARY_PACKED"}:
+                    "BIT_PACKED", "DELTA_BINARY_PACKED",
+                    "BYTE_STREAM_SPLIT"}:
         raise DeviceDecodeUnsupported(f"encodings {encs}")
     if "DELTA_BINARY_PACKED" in encs and phys not in ("INT32", "INT64"):
         raise DeviceDecodeUnsupported("DELTA_BINARY_PACKED non-int")
+    if "BYTE_STREAM_SPLIT" in encs and phys not in ("FLOAT", "DOUBLE",
+                                                    "INT32", "INT64"):
+        raise DeviceDecodeUnsupported("BYTE_STREAM_SPLIT phys type")
     start = col_meta.dictionary_page_offset \
         if col_meta.dictionary_page_offset is not None \
         else col_meta.data_page_offset
@@ -624,6 +678,8 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
             value_pieces.append(("dict", data[dpos:], nonnull))
         elif enc == _DELTA_BP:
             value_pieces.append(("delta_bp", data[dpos:], nonnull))
+        elif enc == _BSS:
+            value_pieces.append(("bss", data[dpos:], nonnull))
         else:
             raise DeviceDecodeUnsupported(f"value encoding {enc}")
         rows_seen += n_vals
@@ -693,6 +749,9 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
                 piece = piece.astype(dtype.jnp_dtype)
         elif kind == "delta_bp":
             piece = _delta_bp_decode(payload, nonnull, pcap).astype(
+                dtype.jnp_dtype)
+        elif kind == "bss":
+            piece = _bss_decode(payload, nonnull, phys, pcap).astype(
                 dtype.jnp_dtype)
         else:
             if dict_values is None:
