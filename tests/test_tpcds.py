@@ -68,3 +68,16 @@ def test_tpcds_q96_value():
                if h in hd_ok and t in td_ok and s in st_ok)
     got = run_query(96, {})
     assert got == [(want,)], (got, want)
+
+
+@pytest.mark.slow
+def test_tpcds_q5_multi_batch_tier():
+    """q5 (three-channel union + rollup) at a scale where store_sales
+    spans multiple reader batches (the TPC-H slow tier's coverage model)."""
+    conf = {"spark.rapids.sql.reader.batchSizeRows": "4096",
+            "spark.rapids.sql.variableFloatAgg.enabled": "true"}
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    tpu = TpuSession(conf)
+    got = QUERIES[5](load_tables(tpu, sf=0.02)).collect()
+    want = QUERIES[5](load_tables(cpu, sf=0.02)).collect()
+    assert_rows_equal(want, got, ignore_order=True, approx_float=True)
