@@ -689,3 +689,16 @@ class LogicalWrite(LogicalPlan):
         self.options = options or {}
         self.partition_by = partition_by or []
         self.children = (child,)
+
+
+class LogicalPlaceholder(LogicalPlan):
+    """Stage-input marker for SHIPPED plan fragments.
+
+    The multi-process cluster driver (cluster.py) serializes a reduce-side
+    fragment with this node where the shuffle feed attaches; the executing
+    worker (shuffle/worker.py) swaps in an in-memory scan over the
+    partitions it fetched.  The analogue of the shuffle-read RDD boundary
+    in a serialized Spark task binary."""
+
+    def __init__(self, schema: "Schema"):
+        self.schema = schema

@@ -309,6 +309,9 @@ _DISPLAY_NAMES = {
     L.LogicalDistinct: "HashAggregateExec",
     L.LogicalScan: "FileSourceScanExec",
     L.LogicalJoin: "SortMergeJoinExec",
+    # shipped-fragment stage input (cluster.py); swapped for a scan before
+    # planning, but tagging/explain must still name it if one leaks through
+    L.LogicalPlaceholder: "ShuffleQueryStageExec",
 }
 
 
@@ -338,7 +341,7 @@ def plan_schema(plan: L.LogicalPlan, conf: TpuConf) -> Schema:
 
 
 def _compute_schema(plan: L.LogicalPlan, conf: TpuConf) -> Schema:
-    if isinstance(plan, L.LogicalScan):
+    if isinstance(plan, (L.LogicalScan, L.LogicalPlaceholder)):
         return plan.schema
     if isinstance(plan, L.LogicalProject):
         child = plan_schema(plan.children[0], conf)

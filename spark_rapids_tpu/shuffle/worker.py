@@ -1,0 +1,220 @@
+"""Executor worker process: shuffle server + task runner over the socket
+wire.
+
+The multi-process deployment model (cluster.ProcCluster spawns N of these):
+each worker owns a full executor bring-up — TpuSession/runtime (HBM pool,
+semaphore, spill stores) and a ShuffleEnv registered on a SocketTransport —
+and executes serialized plan fragments sent over the control RPC:
+
+  * run_map: execute a pickled logical fragment (typically scan slice +
+    row-local work), hash-partition the output batches on device, write
+    every partition to the LOCAL shuffle catalog (RapidsCachingWriter
+    analogue — data stays put until fetched);
+  * run_reduce: for each owned partition, serve local blocks from the
+    catalog and pull the rest from PEER WORKER PROCESSES over TCP
+    (metadata round trip + chunked buffer streams through bounce buffers),
+    then run the pickled reduce fragment over the fetched rows and return
+    the result as arrow IPC bytes.
+
+Reference analogue: the executor side of RapidsShuffleInternalManager with
+UCX transport (shuffle-plugin/.../RapidsShuffleInternalManager.scala:73-337
++ ucx/UCXShuffleTransport.scala:47-507); the control RPC plays the role of
+Spark's task dispatch + the UCX management-port handshake.
+"""
+from __future__ import annotations
+
+import copy
+import json
+import os
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from ..plan import logical as L
+
+
+def attach_stage_input(plan: "L.LogicalPlan", table) -> "L.LogicalPlan":
+    """Swap every LogicalPlaceholder for an in-memory scan of `table`."""
+    if isinstance(plan, L.LogicalPlaceholder):
+        return L.LogicalScan(table, plan.schema, "memory")
+    if not plan.children:
+        return plan
+    new = copy.copy(plan)
+    new.children = tuple(attach_stage_input(c, table)
+                         for c in plan.children)
+    return new
+
+
+class WorkerHandler:
+    """RPC dispatch target; owns the executor-side session/runtime/env."""
+
+    def __init__(self, executor_id: str, conf_dict: Dict):
+        from ..engine import TpuSession
+        from ..config import (PINNED_POOL_SIZE, SHUFFLE_MAX_RECV_INFLIGHT)
+        from .manager import ShuffleEnv
+        from .net import SocketTransport
+        self.executor_id = executor_id
+        self.session = TpuSession(conf_dict)
+        self.runtime = self.session.runtime
+        kwargs = {"max_inflight_bytes":
+                  int(self.session.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
+                  "rpc_handler": self.dispatch}
+        pinned = int(self.session.conf.get(PINNED_POOL_SIZE))
+        if pinned > 0:
+            kwargs["pool_size"] = pinned
+        self.transport = SocketTransport(**kwargs)
+        self.env = ShuffleEnv(self.runtime, self.session.conf, executor_id,
+                              self.transport)
+        # exchange execs resolve the env through the runtime singleton
+        self.runtime._shuffle_env = self.env
+        self.peers: List[str] = []
+        self.shutdown_event = threading.Event()
+
+    # ---- rpc methods -------------------------------------------------------
+
+    def dispatch(self, method: str, kwargs: Dict):
+        fn = getattr(self, f"rpc_{method}", None)
+        if fn is None:
+            raise ValueError(f"unknown rpc method {method!r}")
+        return fn(**kwargs)
+
+    def rpc_ping(self):
+        return {"executor_id": self.executor_id,
+                "platform": self._platform()}
+
+    def _platform(self) -> str:
+        import jax
+        return jax.devices()[0].platform
+
+    def rpc_set_peers(self, peers: Dict[str, tuple]):
+        self.transport.set_peers(peers)
+        self.peers = [p for p in peers if p != self.executor_id]
+        return sorted(peers)
+
+    def rpc_run_map(self, sid: int, plan_blob: bytes,
+                    key_names: List[str], n_parts: int):
+        """Execute the fragment, hash-partition on the keys, write all
+        partitions to the local catalog.  Returns per-partition row
+        counts (the MapStatus analogue)."""
+        import pickle
+
+        from ..columnar import ColumnarBatch
+        from ..exec.base import ExecContext, TpuExec
+        from ..ops import expressions as E
+        from .partition import hash_partition_ids, split_by_partition
+
+        logical = pickle.loads(plan_blob)
+        physical = self.session.plan(logical)
+        schema = physical.schema
+        names = schema.names
+        refs = [E.BoundReference(names.index(k), schema.field(k).dtype, k)
+                for k in key_names]
+        ctx = ExecContext(self.session.conf, runtime=self.runtime)
+        written: Dict[int, int] = {}
+        on_tpu = isinstance(physical, TpuExec)
+
+        def batches():
+            if on_tpu:
+                yield from physical.execute(ctx)
+            else:
+                for t in physical.execute_cpu(ctx):
+                    yield ColumnarBatch.from_arrow(t)
+
+        try:
+            if on_tpu:
+                self.runtime.semaphore.acquire_if_necessary()
+            try:
+                for map_id, batch in enumerate(batches()):
+                    if refs:
+                        pids = hash_partition_ids(
+                            [r.eval(batch) for r in refs], n_parts)
+                    else:
+                        from .partition import round_robin_partition_ids
+                        pids = round_robin_partition_ids(
+                            batch.capacity, n_parts, map_id)
+                    for p, sub in split_by_partition(batch, pids, n_parts):
+                        self.env.write_partition(sid, map_id, p, sub)
+                        written[p] = written.get(p, 0) + sub.num_rows_host()
+            finally:
+                if on_tpu:
+                    self.runtime.semaphore.task_done()
+        finally:
+            ctx.run_cleanups()
+        return {"written_rows": written}
+
+    def rpc_run_reduce(self, sid: int, partitions: List[int],
+                       plan_blob: bytes):
+        """Fetch owned partitions (local + every peer over the wire), run
+        the reduce fragment per partition, return arrow IPC bytes."""
+        import pickle
+
+        import pyarrow as pa
+
+        from ..engine import DataFrame
+
+        logical = pickle.loads(plan_blob)
+        outs: List[pa.Table] = []
+        for p in partitions:
+            batches = list(self.env.fetch_partition(
+                sid, p, remote_peers=self.peers))
+            tabs = [b.to_arrow() for b in batches]
+            tabs = [t for t in tabs if t.num_rows]
+            if not tabs:
+                continue
+            table = pa.concat_tables(tabs)
+            df = DataFrame(self.session, attach_stage_input(logical, table))
+            outs.append(df.to_arrow())
+        if not outs:
+            return None
+        result = pa.concat_tables(outs)
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, result.schema) as w:
+            w.write_table(result)
+        return sink.getvalue().to_pybytes()
+
+    def rpc_transport_counters(self):
+        return dict(self.transport.counters)
+
+    def rpc_remove_shuffle(self, sid: int):
+        self.env.remove_shuffle(sid)
+        return True
+
+    def rpc_shutdown(self):
+        self.shutdown_event.set()
+        return True
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--executor-id", required=True)
+    args = parser.parse_args(argv)
+
+    if os.environ.get("SPARK_RAPIDS_TPU_WORKER_CPU") == "1":
+        from ..utils.cpu_backend import force_cpu_backend
+        force_cpu_backend()
+
+    conf = json.loads(os.environ.get("SPARK_RAPIDS_TPU_CONF", "{}"))
+    handler = WorkerHandler(args.executor_id, conf)
+    # announce the data/control port on stdout for the driver
+    print(json.dumps({"ready": True,
+                      "executor_id": args.executor_id,
+                      "host": handler.transport.address[0],
+                      "port": handler.transport.address[1]}), flush=True)
+
+    # exit when the driver asks, or when it dies (stdin EOF)
+    def stdin_watch():
+        try:
+            sys.stdin.read()
+        except Exception:  # noqa: BLE001
+            pass
+        handler.shutdown_event.set()
+
+    threading.Thread(target=stdin_watch, daemon=True).start()
+    handler.shutdown_event.wait()
+    handler.transport.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,13 @@ class DataType:
             raise TypeError(f"{self.name} has no single-buffer device dtype")
         return jnp.dtype(self.np_dtype)
 
+    def __reduce__(self):
+        # identity checks (`dtype is StringType`) are used on hot paths;
+        # unpickling must return the module singleton, not a copy —
+        # metadata crosses process boundaries in the socket shuffle
+        # (shuffle/net.py) and in shipped plan fragments (cluster.py)
+        return (_canonical_type, (self.name,))
+
 
 BooleanType = DataType("boolean", np.dtype(np.bool_))
 ByteType = DataType("byte", np.dtype(np.int8))
@@ -75,6 +82,12 @@ NullType = DataType("null", None)
 
 ALL_TYPES = (BooleanType, ByteType, ShortType, IntegerType, LongType, FloatType,
              DoubleType, DateType, TimestampType, StringType)
+
+_TYPES_BY_NAME = {t.name: t for t in ALL_TYPES + (NullType,)}
+
+
+def _canonical_type(name: str) -> DataType:
+    return _TYPES_BY_NAME[name]
 
 # The type gate: what the engine supports on device at all
 # (reference: GpuOverrides.isSupportedType).

