@@ -1,25 +1,36 @@
-"""Flagship benchmark: TPC-H Q6 shape on the device engine vs the CPU path.
+"""Flagship benchmark: TPC-H Q6/Q1 + scan-included Q6 + TPC-DS q5 on the
+device engine vs this framework's own CPU (pyarrow) executors.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-  value       = device-engine steady-state throughput (million rows/sec
-                through the filter->project->aggregate pipeline, over
-                device-resident data — the scan cache keeps the table in
-                HBM across runs, the TPU-native analogue of Spark's storage
-                layer keeping hot tables in cluster memory)
-  vs_baseline = speedup over this framework's own CPU (pyarrow) executors,
-                the stand-in for the reference's CPU-Spark-vs-GPU oracle
-                (reference headline: TPCxBB-like Q5 19.8x, README.md:7-15).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+  metric/value = device-engine steady-state throughput on the HEADLINE
+                query (TPC-H Q6 over a device-resident cached table, the
+                same metric as rounds 1-3 so the series stays comparable)
+  vs_baseline  = speedup over the CPU oracle on the same query (the
+                 stand-in for the reference's CPU-Spark-vs-GPU headline,
+                 19.8x, reference README.md:7-15)
+  extra        = per-query breakdown (Q6 cached, Q6 scan-included from
+                 parquet on disk, Q1 grouped agg, TPC-DS q5 joins),
+                 tunnel/transfer microbench (H2D/D2H MB/s, dispatch
+                 latency), effective GB/s vs an HBM roofline, and
+                 vs_ref_headline = vs_baseline / 19.8 (the
+                 engine-vs-reference-target ratio; VERDICT r3 item 10).
 
-Robustness (round-2 postmortem: BENCH_r02 rc=124 — run 1 hung on the
-tunneled device and the buffered result died with the process):
-  * ALL device work runs in a CHILD process that streams one JSON line per
-    completed stage; the parent enforces a budget per stage and SIGKILLs a
-    hung child — evidence gathered so far survives;
-  * the parent mirrors every stage into BENCH_partial.json as it arrives;
+Robustness (round-2 postmortem: a hung device run must not erase the
+evidence; round-3 postmortem: SIGKILLing a TPU-attached child can poison
+the machine-wide tunnel lease for 30+ min):
+  * ALL device work runs in a CHILD that streams one JSON line per
+    completed stage; the parent mirrors every line into BENCH_partial.json;
+  * the child enforces ITS OWN deadline: after every stage/run it checks
+    the clock, emits {"stage":"abort"} and exits CLEANLY (sys.exit(0));
+  * the parent NEVER kills a TPU-mode child. On budget overrun it
+    ABANDONS the child (stops reading; the child finishes or aborts on
+    its own deadline and exits cleanly whenever the lease lets it);
+    TPU children are started in their own session (setsid) so a driver
+    process-group kill cannot SIGKILL them either;
   * the CPU oracle runs first in its own forced-CPU child, so a device
     hang can never erase the baseline;
-  * if the device child dies with zero completed runs, the CPU numbers are
-    reported (unit carries the platform) instead of nothing.
+  * if the chip is unavailable, the device engine is measured on the CPU
+    backend instead and the unit carries the platform tag ([cpu]).
 """
 from __future__ import annotations
 
@@ -30,20 +41,18 @@ import sys
 import threading
 import time
 
+REPO = os.path.dirname(os.path.abspath(__file__))
 N_ROWS = int(os.environ.get("BENCH_ROWS", 6_000_000))  # ~SF1 lineitem
-# Budgets are sized so the WORST chain (probe succeeds late + one later
-# stage hangs at budget) still prints the final JSON line inside ~430s —
-# the driver's own benchmark timeout killed rounds 1 and 2 at ~450s and a
-# driver kill loses the line (BENCH_partial.json survives either way).
-STAGE_BUDGET = {  # seconds, per stage, enforced by the parent
-    "backend": int(os.environ.get("BENCH_TPU_PROBE_S", "240")),
-    "datagen": 60,
-    "warmup": 150,
-    "run": 60,
-}
+TPCDS_SF = float(os.environ.get("BENCH_TPCDS_SF", 0.1))
 N_RUNS = 3
-
+# The driver's own benchmark timeout killed rounds 1-2 at ~450s; everything
+# must finish (or be abandoned) inside this global budget.
+GLOBAL_BUDGET_S = float(os.environ.get("BENCH_GLOBAL_S", 400))
+TPU_PROBE_S = float(os.environ.get("BENCH_TPU_PROBE_S", 240))
 T0 = time.time()
+
+# 1994-01-01 / 1995-01-01 / 1998-09-02 as days since epoch
+D_1994, D_1995, D_19980902 = 8766, 9131, 10471
 
 
 def log(msg: str) -> None:
@@ -52,32 +61,60 @@ def log(msg: str) -> None:
 
 
 # --------------------------------------------------------------------------
-# child: executes the pipeline on one backend, emits a JSON line per stage
+# child: executes the workload on one backend, emits a JSON line per stage
 # --------------------------------------------------------------------------
 
+_SILENT = False
+_DEADLINE = [float("inf")]
+
+
+def emit(stage: str, **kw):
+    global _SILENT
+    if _SILENT:
+        return
+    try:
+        print(json.dumps({"stage": stage, **kw}), flush=True)
+    except (BrokenPipeError, OSError):
+        # parent abandoned us; keep running to a clean exit, silently
+        _SILENT = True
+
+
+def checkpoint(label: str) -> None:
+    """Clean in-process deadline: abort BETWEEN units of work, never via a
+    signal — a SIGKILLed TPU-attached process poisons the tunnel lease."""
+    if time.time() > _DEADLINE[0]:
+        emit("abort", reason="deadline", at=label)
+        sys.exit(0)
+
+
 def make_lineitem(n: int):
+    """Q6+Q1 lineitem: the 4 Q6 columns (same distributions as rounds 1-3,
+    keeping the headline comparable) plus Q1's returnflag/linestatus/tax."""
     import numpy as np
     import pyarrow as pa
     rng = np.random.RandomState(42)
     price = rng.uniform(900.0, 105000.0, n)
     discount = rng.choice(np.arange(0.0, 0.11, 0.01), n)
     quantity = rng.randint(1, 51, n).astype(np.int64)
-    # days since epoch across 1992-1998 (TPC-H date range)
     shipdate = rng.randint(8035, 10592, n).astype(np.int64)
+    returnflag = np.array(["A", "N", "R"])[rng.randint(0, 3, n)]
+    linestatus = np.array(["F", "O"])[rng.randint(0, 2, n)]
+    tax = np.round(rng.uniform(0.0, 0.08, n), 2)
     return pa.table({
         "l_extendedprice": price,
         "l_discount": discount,
-        "l_quantity": quantity,
+        "l_quantity": quantity.astype(np.float64),
         "l_shipdate": shipdate,
+        "l_returnflag": returnflag,
+        "l_linestatus": linestatus,
+        "l_tax": tax,
     })
 
 
-def q6(session, table):
+def q6(df):
     from spark_rapids_tpu.plan.logical import col, functions as F
-    df = session.from_arrow(table)
-    # 1994-01-01 = day 8766, 1995-01-01 = day 9131
-    return (df.filter((col("l_shipdate") >= 8766)
-                      & (col("l_shipdate") < 9131)
+    return (df.filter((col("l_shipdate") >= D_1994)
+                      & (col("l_shipdate") < D_1995)
                       & (col("l_discount") >= 0.05)
                       & (col("l_discount") <= 0.07)
                       & (col("l_quantity") < 24))
@@ -85,10 +122,84 @@ def q6(session, table):
                  .alias("revenue")))
 
 
-def child_main(mode: str) -> None:
-    def emit(stage: str, **kw):
-        print(json.dumps({"stage": stage, **kw}), flush=True)
+def q1(df):
+    from spark_rapids_tpu.plan.logical import col, functions as F, lit
+    li = df.filter(col("l_shipdate") <= D_19980902)
+    disc = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    return (li.group_by(col("l_returnflag"), col("l_linestatus"))
+            .agg(F.sum(col("l_quantity")).alias("sum_qty"),
+                 F.sum(col("l_extendedprice")).alias("sum_base_price"),
+                 F.sum(disc).alias("sum_disc_price"),
+                 F.sum(disc * (lit(1.0) + col("l_tax"))).alias("sum_charge"),
+                 F.avg(col("l_quantity")).alias("avg_qty"),
+                 F.avg(col("l_extendedprice")).alias("avg_price"),
+                 F.avg(col("l_discount")).alias("avg_disc"),
+                 F.count(lit(1)).alias("count_order"))
+            .order_by("l_returnflag", "l_linestatus"))
 
+
+def checksum(rows) -> float:
+    """Stable scalar over a collected result for the oracle cross-check."""
+    acc = 0.0
+    for r in rows:
+        for v in r:
+            if isinstance(v, bool) or v is None:
+                acc += 1.0 if v else 0.0
+            elif isinstance(v, (int, float)):
+                acc += float(v)
+            else:
+                acc += float(sum(str(v).encode()) % 1000)
+    return acc
+
+
+def timed(name: str, fn, n_runs: int) -> None:
+    t0 = time.time()
+    val = fn()
+    emit("warmup", q=name, t=time.time() - t0, value=val)
+    checkpoint(name)
+    for i in range(n_runs):
+        t0 = time.time()
+        val = fn()
+        emit("run", q=name, i=i, t=time.time() - t0, value=val)
+        checkpoint(name)
+
+
+def transfer_microbench():
+    """Tunnel/link microbench: H2D and D2H MB/s, per-dispatch latency.
+    Context for the roofline numbers (tunneled dev TPUs: D2H ~26 MB/s)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    h = np.empty(16 << 20, np.uint8)  # 16 MiB
+    t = []
+    for _ in range(2):
+        t0 = time.time()
+        d = jax.device_put(h)
+        d.block_until_ready()
+        t.append(time.time() - t0)
+    h2d = (16 / min(t)) if min(t) > 0 else 0.0
+    small = jax.device_put(np.empty(2 << 20, np.uint8))
+    small.block_until_ready()
+    t0 = time.time()
+    np.asarray(small)
+    d2h_t = time.time() - t0
+    d2h = (2 / d2h_t) if d2h_t > 0 else 0.0
+    x = jnp.ones(1024, jnp.float32)
+    f = jax.jit(lambda a: a + 1)
+    f(x).block_until_ready()
+    t0 = time.time()
+    for _ in range(20):
+        y = f(x)
+    y.block_until_ready()
+    disp_ms = (time.time() - t0) / 20 * 1e3
+    emit("transfer", h2d_mb_s=round(h2d, 1), d2h_mb_s=round(d2h, 1),
+         dispatch_ms=round(disp_ms, 3))
+
+
+def child_main(mode: str) -> None:
+    _DEADLINE[0] = time.time() + float(
+        os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))
+    sys.path.insert(0, REPO)
     t0 = time.time()
     if mode in ("cpu", "oracle"):
         # env JAX_PLATFORMS=cpu alone is NOT sufficient: the container's
@@ -100,51 +211,92 @@ def child_main(mode: str) -> None:
     import jax
     platform = jax.devices()[0].platform
     emit("backend", platform=platform, t=time.time() - t0)
+    checkpoint("backend")
 
     t0 = time.time()
     table = make_lineitem(N_ROWS)
     emit("datagen", rows=N_ROWS, t=time.time() - t0)
+    checkpoint("datagen")
 
     from spark_rapids_tpu.engine import TpuSession
     if mode == "oracle":
         conf = {"spark.rapids.sql.enabled": "false"}
     else:
-        # variableFloatAgg: Q6's sum() is over doubles; without this the
-        # aggregate falls back to CPU (and the bench degenerates into a
-        # D2H-bound CPU query).  The reference enables the same conf for
-        # its TPC-H/TPCxBB runs (docs/configs.md variableFloatAgg; its
-        # default is also off for bit-exact Spark parity).
+        # variableFloatAgg: sums/avgs over doubles; without it the aggregate
+        # falls back to CPU and the bench degenerates into a D2H-bound CPU
+        # query (round-2 postmortem).  The reference enables the same conf
+        # for its TPC-H/TPCxBB runs (docs/configs.md variableFloatAgg).
         conf = {"spark.rapids.sql.variableFloatAgg.enabled": "true"}
     session = TpuSession(conf)
+    li = session.from_arrow(table)
 
-    # warmup: compile + H2D (populates the device scan cache + kernel cache)
+    # the oracle has no compile/H2D warmup effects, so one run suffices
+    # (the parent takes min over warmup+runs for the CPU child)
+    heavy_runs = 1 if mode == "oracle" else 2
+    # headline first: if the deadline lands mid-suite, Q6-cached survives
+    timed("q6", lambda: checksum(q6(li).collect()),
+          N_RUNS if mode != "oracle" else 1)
+    timed("q1", lambda: checksum(q1(li).collect()), heavy_runs)
+
+    try:
+        transfer_microbench()
+    except Exception as e:  # microbench must never sink the bench
+        emit("transfer", error=repr(e)[:200])
+    checkpoint("transfer")
+
+    # scan-included Q6: parquet from disk through the device decode path
+    # (file scans are NOT in the memory scan cache — every run re-decodes)
+    pq_dir = os.path.join("/tmp", f"bench_lineitem_{N_ROWS}")
+    pq_path = os.path.join(pq_dir, "lineitem.parquet")
+    if not os.path.exists(pq_path):
+        import pyarrow.parquet as papq
+        os.makedirs(pq_dir, exist_ok=True)
+        # per-pid temp name: the oracle and device children run
+        # CONCURRENTLY and may both lose the exists() race; the atomic
+        # replace makes last-writer-wins safe
+        tmp = f"{pq_path}.{os.getpid()}.tmp"
+        papq.write_table(table, tmp, compression="snappy")
+        os.replace(tmp, pq_path)
+    emit("parquet_ready", path=pq_path,
+         bytes=os.path.getsize(pq_path))
+    checkpoint("parquet_ready")
+    timed("q6_scan",
+          lambda: checksum(q6(session.read.parquet(pq_path)).collect()),
+          heavy_runs)
+
+    # TPC-DS q5 (3-channel union + dim joins + ROLLUP) — BASELINE config 3
     t0 = time.time()
-    rows = q6(session, table).collect()
-    emit("warmup", t=time.time() - t0, value=rows[0][0])
-
-    for i in range(N_RUNS):
-        t0 = time.time()
-        rows = q6(session, table).collect()
-        emit("run", i=i, t=time.time() - t0, value=rows[0][0])
+    from benchmarks.tpcds.datagen import load_tables as ds_load
+    from benchmarks.tpcds.queries import q5 as ds_q5
+    ds = ds_load(session, sf=TPCDS_SF)
+    emit("tpcds_datagen", sf=TPCDS_SF, t=time.time() - t0)
+    checkpoint("tpcds_datagen")
+    timed("tpcds_q5", lambda: checksum(ds_q5(ds).collect()), heavy_runs)
+    emit("done", t=time.time() - (_DEADLINE[0] - float(
+        os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))))
 
 
 # --------------------------------------------------------------------------
-# parent: budget-enforced orchestration
+# parent: budget-enforced orchestration (never kills a TPU child)
 # --------------------------------------------------------------------------
 
 class StageReader:
-    """Reads JSON stage lines from a child under per-stage budgets."""
+    """Reads JSON stage lines from a child under per-read budgets."""
 
-    def __init__(self, label: str, mode: str):
+    def __init__(self, label: str, mode: str, deadline_s: float):
         self.label = label
+        self.tpu = mode == "tpu"
         env = dict(os.environ)
-        if mode == "cpu" or mode == "oracle":
+        if mode in ("cpu", "oracle"):
             env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_CHILD_DEADLINE_S"] = str(max(deadline_s, 5.0))
         self.proc = subprocess.Popen(
             [sys.executable, "-u", os.path.abspath(__file__),
              f"--child={mode}"],
-            stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env)
-        self.stages: list = []
+            stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env,
+            # own session: a driver-level process-group SIGKILL must not
+            # hit a TPU-attached child (lease poisoning, round-3 memory)
+            start_new_session=self.tpu)
         self._lines: list = []
         self._reader = threading.Thread(target=self._pump, daemon=True)
         self._lock = threading.Condition()
@@ -161,8 +313,9 @@ class StageReader:
             self._lock.notify()
 
     def next_stage(self, budget_s: float):
-        """Next parsed stage line, or None on timeout/eof (child killed on
-        timeout)."""
+        """Next parsed stage line, or None on timeout/eof.  On timeout the
+        child is ABANDONED (TPU mode) or killed (CPU mode) — never a signal
+        at a TPU-attached process."""
         deadline = time.time() + budget_s
         while True:
             with self._lock:
@@ -171,9 +324,14 @@ class StageReader:
                         return None
                     remaining = deadline - time.time()
                     if remaining <= 0:
-                        log(f"{self.label}: stage budget exceeded "
-                            f"({budget_s:.0f}s) — killing child")
-                        self.proc.kill()
+                        if self.tpu:
+                            log(f"{self.label}: budget exceeded "
+                                f"({budget_s:.0f}s) — ABANDONING child "
+                                f"(it exits on its own deadline)")
+                        else:
+                            log(f"{self.label}: budget exceeded "
+                                f"({budget_s:.0f}s) — killing CPU child")
+                            self.proc.kill()
                         return None
                     self._lock.wait(timeout=min(remaining, 5))
                 line = self._lines.pop(0)
@@ -182,17 +340,16 @@ class StageReader:
             except json.JSONDecodeError:
                 rec = None
             if not isinstance(rec, dict) or "stage" not in rec:
-                # stray stdout from a library (plugin banner, warning):
-                # skip it, don't treat the child as dead
                 log(f"{self.label}: ignoring non-stage stdout: "
                     f"{line.strip()[:120]}")
                 continue
-            self.stages.append(rec)
             log(f"{self.label}: {rec}")
             _write_partial(self.label, rec)
             return rec
 
     def close(self):
+        if self.tpu:
+            return  # abandoned, exits on its own clean deadline
         try:
             self.proc.kill()
         except OSError:
@@ -205,37 +362,52 @@ _PARTIAL: dict = {"stages": []}
 def _write_partial(label: str, rec: dict) -> None:
     _PARTIAL["stages"].append({"child": label, **rec})
     try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_partial.json"), "w") as f:
+        with open(os.path.join(REPO, "BENCH_partial.json"), "w") as f:
             json.dump(_PARTIAL, f, indent=1)
     except OSError:
         pass
 
 
-def drive(label: str, mode: str) -> dict:
-    """Run one child through its stages; returns {platform, warmup, runs,
-    value}."""
-    r = StageReader(label, mode)
-    out = {"platform": None, "warmup": None, "runs": [], "value": None}
+def collect(r: "StageReader", end_at: float,
+            reserve_s: float = 0.0) -> dict:
+    """Read a child's stages until eof/abort/deadline.  Returns
+    {platform, runs: {q: [t..]}, warmup: {q: t}, values: {q: v},
+    transfer: {...}}.  reserve_s caps the FIRST read (backend init) so an
+    unavailable chip is abandoned with enough budget left for a fallback
+    child."""
+    out = {"platform": None, "runs": {}, "warmup": {}, "values": {},
+           "transfer": None, "aborted": False}
+    first = True
     try:
-        rec = r.next_stage(STAGE_BUDGET["backend"])
-        if not rec or rec.get("stage") != "backend":
-            return out
-        out["platform"] = rec["platform"]
-        rec = r.next_stage(STAGE_BUDGET["datagen"])
-        if not rec or rec.get("stage") != "datagen":
-            return out
-        rec = r.next_stage(STAGE_BUDGET["warmup"])
-        if not rec or rec.get("stage") != "warmup":
-            return out
-        out["warmup"] = rec["t"]
-        out["value"] = rec.get("value")
-        for _ in range(N_RUNS):
-            rec = r.next_stage(STAGE_BUDGET["run"])
-            if not rec or rec.get("stage") != "run":
+        while True:
+            budget = min(TPU_PROBE_S if first else 150.0,
+                         end_at - time.time())
+            if first and reserve_s:
+                budget = min(budget,
+                             max(30.0, end_at - reserve_s - time.time()))
+            if budget <= 0:
                 break
-            out["runs"].append(rec["t"])
-            out["value"] = rec.get("value", out["value"])
+            rec = r.next_stage(budget)
+            if rec is None:
+                break
+            first = False
+            st = rec.get("stage")
+            if st == "backend":
+                out["platform"] = rec.get("platform")
+            elif st == "warmup":
+                out["warmup"][rec["q"]] = rec["t"]
+                out["values"][rec["q"]] = rec.get("value")
+            elif st == "run":
+                out["runs"].setdefault(rec["q"], []).append(rec["t"])
+                out["values"][rec["q"]] = rec.get("value", None)
+            elif st == "transfer":
+                out["transfer"] = {k: v for k, v in rec.items()
+                                   if k != "stage"}
+            elif st == "abort":
+                out["aborted"] = True
+                break
+            elif st == "done":
+                break
         return out
     finally:
         r.close()
@@ -246,59 +418,98 @@ def main():
         child_main(sys.argv[1].split("=", 1)[1])
         return
 
-    # 1. CPU oracle first: a later device hang cannot erase the baseline
-    cpu = drive("cpu-oracle", "oracle")
-    if not cpu["runs"]:
-        log("FATAL: CPU oracle produced no runs")
+    end_at = T0 + GLOBAL_BUDGET_S
+    want_tpu = os.environ.get("JAX_PLATFORMS", "") not in ("cpu", "")
+
+    # 1. start the TPU child FIRST: it spends its opening minutes blocked in
+    # backend init (tunnel lease), which overlaps for free with the oracle;
+    # its stage lines buffer in the reader thread until we consume them
+    tpu_reader = None
+    if want_tpu:
+        tpu_reader = StageReader("device", "tpu",
+                                 end_at - time.time() - 5)
+
+    # 2. CPU oracle (forced-CPU child, drops the axon plugin factories, so
+    # it cannot block on the device lease)
+    cpu = collect(StageReader("cpu-oracle", "oracle",
+                              min(end_at, T0 + 210) - time.time()),
+                  min(end_at, T0 + 210))
+    if not cpu["runs"].get("q6") and not cpu["warmup"].get("q6"):
+        log("FATAL: CPU oracle produced no q6 runs")
         print(json.dumps({"metric": "tpch_q6_like_device_throughput",
                           "value": 0.0, "unit": "Mrows/s[none]",
                           "vs_baseline": 0.0}))
         return
-    cpu_t = min(cpu["runs"])
-    log(f"cpu oracle steady-state: {cpu_t:.3f}s")
+    # the oracle has no warmup effects: fold warmup times in as runs
+    for q, t in cpu["warmup"].items():
+        cpu["runs"].setdefault(q, []).append(t)
 
-    # 2. device child under per-stage budgets
-    want_tpu = os.environ.get("JAX_PLATFORMS", "") not in ("cpu", "")
-    dev = drive("device", "tpu" if want_tpu else "cpu")
+    # 3. consume the device child (already running), fall back to CPU engine
+    dev = (collect(tpu_reader, end_at, reserve_s=130.0)
+           if tpu_reader else {"runs": {}, "warmup": {}})
     unit_note = ""
-    if not dev["runs"]:
-        if dev["warmup"] is not None:
-            # warmup completed but runs hung/died: report warmup time
-            # (compile+H2D inclusive) with an explicit unit marker
-            dev["runs"] = [dev["warmup"]]
-            unit_note = ":warmup-only"
-            log("device runs missing; falling back to warmup time")
-        elif want_tpu:
-            # chip unavailable (lease outage): run the DEVICE ENGINE on the
-            # CPU backend so the artifact still measures this engine against
-            # its pyarrow oracle — the unit's [cpu] tag marks the platform
+    if not dev["runs"].get("q6") and dev.get("warmup", {}).get("q6"):
+        # deadline landed between warmup and run 1: the warmup time
+        # (compile+H2D inclusive) is still device evidence — report it
+        # with an explicit unit marker instead of discarding it
+        log("device runs missing; falling back to warmup time")
+        dev["runs"]["q6"] = [dev["warmup"]["q6"]]
+        unit_note = ":warmup-only"
+    if not dev["runs"].get("q6"):
+        if want_tpu:
             log("TPU unavailable; measuring the device engine on the CPU "
                 "backend instead")
-            dev = drive("device-cpu", "cpu")
-            if not dev["runs"]:
-                log("device child produced nothing; reporting CPU numbers")
-                dev = cpu
-        else:
-            log("device child produced nothing; reporting CPU numbers")
-            dev = cpu
+        dev = collect(StageReader("device-cpu", "cpu",
+                                  end_at - time.time()), end_at)
+    if not dev["runs"].get("q6"):
+        log("device child produced nothing; reporting CPU numbers")
+        dev = cpu
 
-    tpu_t = min(dev["runs"])
     platform = (dev["platform"] or "unknown") + unit_note
+    per_query = {}
+    mismatch = False
+    for q in sorted(set(dev["runs"]) | set(cpu["runs"])):
+        d = min(dev["runs"][q]) if dev["runs"].get(q) else None
+        c = min(cpu["runs"][q]) if cpu["runs"].get(q) else None
+        entry = {"dev_s": round(d, 4) if d else None,
+                 "cpu_s": round(c, 4) if c else None,
+                 "vs_oracle": round(c / d, 3) if d and c else None,
+                 "warmup_s": round(dev["warmup"].get(q, 0), 2)}
+        dv, cv = dev["values"].get(q), cpu["values"].get(q)
+        if dv is not None and cv is not None:
+            entry["match"] = bool(abs(dv - cv) <= 1e-4 * max(1.0, abs(cv)))
+            if not entry["match"]:
+                mismatch = True
+                log(f"ORACLE MISMATCH {q}: dev={dv} cpu={cv}")
+        per_query[q] = entry
 
-    # oracle cross-check (tolerate missing values from a killed child)
-    if dev.get("value") is not None and cpu.get("value") is not None:
-        ok = abs(dev["value"] - cpu["value"]) < 1e-4 * abs(cpu["value"])
-        log(f"oracle check: device={dev['value']} cpu={cpu['value']} "
-            f"match={ok}")
-        if not ok:
-            platform += ":MISMATCH"
-
-    mrows_s = N_ROWS / tpu_t / 1e6
+    q6_t = min(dev["runs"]["q6"])
+    cpu_t = min(cpu["runs"]["q6"])
+    vs = cpu_t / q6_t
+    if mismatch:
+        platform += ":MISMATCH"
+    # Q6 touches 4 float64/int64 columns -> 32 B/row per pass
+    eff_gb_s = N_ROWS * 32 / q6_t / 1e9
+    extra = {
+        "per_query": per_query,
+        "transfer": dev.get("transfer"),
+        "q6_effective_gb_s": round(eff_gb_s, 2),
+        "hbm_roofline_note": "v5e HBM ~819 GB/s; q6 reads 32 B/row",
+        "vs_ref_headline": round(vs / 19.8, 4),
+        "tpcds_sf": TPCDS_SF,
+        "aborted": dev.get("aborted", False),
+    }
+    try:
+        with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
+            json.dump({"dev": dev, "cpu": cpu, "extra": extra}, f, indent=1)
+    except OSError:
+        pass
     print(json.dumps({
         "metric": f"tpch_q6_like_{N_ROWS // 1_000_000}M_rows_device_throughput",
-        "value": round(mrows_s, 3),
+        "value": round(N_ROWS / q6_t / 1e6, 3),
         "unit": f"Mrows/s[{platform}]",
-        "vs_baseline": round(cpu_t / tpu_t, 3),
+        "vs_baseline": round(vs, 3),
+        "extra": extra,
     }))
 
 
