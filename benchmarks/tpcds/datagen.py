@@ -46,6 +46,9 @@ def generate(sf: float = 0.001, seed: int = 7):
         "d_qoy": [(d.month - 1) // 3 + 1 for d in dates],
         # weekday() is Monday=0; DAY_NAMES is Sunday-first
         "d_day_name": [DAY_NAMES[(d.weekday() + 1) % 7] for d in dates],
+        # consecutive month counter (spec's d_month_seq, offset-free here:
+        # only equality/range against values from the same column is used)
+        "d_month_seq": [(d.year - 1998) * 12 + d.month - 1 for d in dates],
     }
 
     # time_dim at minute granularity (86400-second spec table folded x60)
@@ -75,6 +78,9 @@ def generate(sf: float = 0.001, seed: int = 7):
         "i_manager_id": [(i * 7) % 40 + 1 for i in range(n_item)],
         "i_current_price": np.round(rng.uniform(0.5, 100.0, n_item),
                                     2).tolist(),
+        "i_class_id": [(i * 3) % 16 + 1 for i in range(n_item)],
+        "i_class": [f"class#{(i * 3) % 16 + 1}" for i in range(n_item)],
+        "i_item_desc": [f"item description {i}" for i in range(n_item)],
     }
 
     # demographics is a CROSS PRODUCT in the spec (1,920,800 rows = every
@@ -88,13 +94,19 @@ def generate(sf: float = 0.001, seed: int = 7):
         "cd_gender": [combos[i % 70][0] for i in range(n_cd)],
         "cd_marital_status": [combos[i % 70][1] for i in range(n_cd)],
         "cd_education_status": [combos[i % 70][2] for i in range(n_cd)],
+        "cd_dep_count": rng.randint(0, 7, n_cd).tolist(),
+        "cd_dep_employed_count": rng.randint(0, 7, n_cd).tolist(),
+        "cd_dep_college_count": rng.randint(0, 7, n_cd).tolist(),
     }
 
     n_hd = max(10, int(7_200 * sf * 10))
+    buy_potentials = [">10000", "5001-10000", "1001-5000", "501-1000",
+                      "0-500", "Unknown"]
     out["household_demographics"] = {
         "hd_demo_sk": list(range(1, n_hd + 1)),
         "hd_dep_count": rng.randint(0, 10, n_hd).tolist(),
         "hd_vehicle_count": rng.randint(0, 5, n_hd).tolist(),
+        "hd_buy_potential": [buy_potentials[i % 6] for i in range(n_hd)],
     }
 
     n_promo = max(5, int(300 * sf * 10))
@@ -107,12 +119,23 @@ def generate(sf: float = 0.001, seed: int = 7):
     }
 
     n_store = max(4, int(1_002 * sf * 2))
+    states = ["TN", "SD", "AL", "GA", "MI", "OH", "TX", "CA"]
+    counties = ["Williamson County", "Ziebach County", "Walker County",
+                "Daviess County", "Barrow County", "Franklin Parish",
+                "Luce County", "Richland County"]
+    cities = ["Midway", "Fairview", "Oakland", "Springdale", "Union",
+              "Salem", "Plainview", "Glendale"]
     out["store"] = {
         "s_store_sk": list(range(1, n_store + 1)),
         "s_store_name": [STORE_NAMES[i % len(STORE_NAMES)]
                          for i in range(n_store)],
         "s_zip": [f"{rng.randint(10000, 99999)}" for _ in range(n_store)],
         "s_number_employees": rng.randint(200, 301, n_store).tolist(),
+        "s_company_name": [f"Unknown#{i % 3}" for i in range(n_store)],
+        "s_state": [states[i % len(states)] for i in range(n_store)],
+        "s_county": [counties[i % len(counties)] for i in range(n_store)],
+        "s_city": [cities[i % len(cities)] for i in range(n_store)],
+        "s_gmt_offset": [-5.0 if i % 2 else -6.0 for i in range(n_store)],
     }
 
     n_ca = max(20, int(50_000 * sf))
@@ -121,6 +144,10 @@ def generate(sf: float = 0.001, seed: int = 7):
         "ca_zip": [f"{rng.randint(10000, 99999)}" for _ in range(n_ca)],
         "ca_gmt_offset": rng.choice([-10.0, -9.0, -8.0, -7.0, -6.0, -5.0],
                                     n_ca).tolist(),
+        "ca_state": [states[i % len(states)] for i in range(n_ca)],
+        "ca_county": [counties[i % len(counties)] for i in range(n_ca)],
+        "ca_city": [cities[i % len(cities)] for i in range(n_ca)],
+        "ca_country": ["United States"] * n_ca,
     }
 
     n_cust = max(30, int(100_000 * sf))
@@ -129,21 +156,50 @@ def generate(sf: float = 0.001, seed: int = 7):
         "c_customer_id": [f"CUST{i:011d}" for i in range(1, n_cust + 1)],
         "c_current_addr_sk": rng.randint(1, n_ca + 1, n_cust).tolist(),
         "c_birth_month": rng.randint(1, 13, n_cust).tolist(),
+        "c_current_cdemo_sk": rng.randint(1, n_cd + 1, n_cust).tolist(),
+        "c_current_hdemo_sk": rng.randint(1, n_hd + 1, n_cust).tolist(),
+        "c_first_name": [f"First{i % 997}" for i in range(n_cust)],
+        "c_last_name": [f"Last{i % 991}" for i in range(n_cust)],
+        "c_salutation": [["Mr.", "Mrs.", "Ms.", "Dr."][i % 4]
+                         for i in range(n_cust)],
+        "c_preferred_cust_flag": [["Y", "N"][i % 2] for i in range(n_cust)],
+    }
+
+    n_cc = max(2, int(6 * sf * 10))
+    out["call_center"] = {
+        "cc_call_center_sk": list(range(1, n_cc + 1)),
+        "cc_name": [f"call center {i}" for i in range(1, n_cc + 1)],
     }
 
     n_ss = max(300, int(2_880_000 * sf))
     date_sks = np.array(out["date_dim"]["d_date_sk"])
+    # a ticket covers ~4 line items sharing customer/demographics/address/
+    # store/date (the spec generates baskets the same way) — the per-ticket
+    # count queries (q34/q73) and ticket-grouped sums (q68) need real
+    # multi-row tickets
+    n_tick = (n_ss + 3) // 4
+    per_tick = np.minimum(4, n_ss - 4 * np.arange(n_tick))
+
+    def per_ticket(vals):
+        return np.repeat(np.asarray(vals), per_tick)[:n_ss]
     out["store_sales"] = {
-        "ss_sold_date_sk": rng.choice(date_sks, n_ss).tolist(),
+        "ss_sold_date_sk": per_ticket(
+            rng.choice(date_sks, n_tick)).tolist(),
         "ss_sold_time_sk": rng.randint(0, 1440, n_ss).tolist(),
         "ss_item_sk": rng.randint(1, n_item + 1, n_ss).tolist(),
-        "ss_customer_sk": rng.randint(1, n_cust + 1, n_ss).tolist(),
-        "ss_cdemo_sk": rng.randint(1, n_cd + 1, n_ss).tolist(),
-        "ss_hdemo_sk": rng.randint(1, n_hd + 1, n_ss).tolist(),
-        "ss_addr_sk": rng.randint(1, n_ca + 1, n_ss).tolist(),
-        "ss_store_sk": rng.randint(1, n_store + 1, n_ss).tolist(),
+        "ss_customer_sk": per_ticket(
+            rng.randint(1, n_cust + 1, n_tick)).tolist(),
+        "ss_cdemo_sk": per_ticket(
+            rng.randint(1, n_cd + 1, n_tick)).tolist(),
+        "ss_hdemo_sk": per_ticket(
+            rng.randint(1, n_hd + 1, n_tick)).tolist(),
+        "ss_addr_sk": per_ticket(
+            rng.randint(1, n_ca + 1, n_tick)).tolist(),
+        "ss_store_sk": per_ticket(
+            rng.randint(1, n_store + 1, n_tick)).tolist(),
         "ss_promo_sk": rng.randint(1, n_promo + 1, n_ss).tolist(),
-        "ss_ticket_number": list(range(1, n_ss + 1)),
+        "ss_ticket_number": per_ticket(
+            np.arange(1, n_tick + 1)).tolist(),
         "ss_quantity": rng.randint(1, 101, n_ss).tolist(),
         "ss_list_price": np.round(rng.uniform(1.0, 200.0, n_ss),
                                   2).tolist(),
@@ -160,14 +216,25 @@ def generate(sf: float = 0.001, seed: int = 7):
         "ss_net_profit": np.round(rng.uniform(-500.0, 500.0, n_ss),
                                   2).tolist(),
     }
-    # returns + catalog/web channels (q5's three-channel union)
+    # returns + catalog/web channels (q5's three-channel union).  Store
+    # returns reference a sold ticket (customer, item, ticket_number) so
+    # the multi-fact chains (q25/q29: sale -> return -> catalog re-purchase)
+    # resolve at tiny scale factors.
     n_sr = max(60, int(287_000 * sf))
+    sr_pick = rng.randint(0, n_ss, n_sr)
     out["store_returns"] = {
         "sr_returned_date_sk": rng.choice(date_sks, n_sr).tolist(),
         "sr_store_sk": rng.randint(1, n_store + 1, n_sr).tolist(),
         "sr_return_amt": np.round(rng.uniform(1.0, 800.0, n_sr),
                                   2).tolist(),
         "sr_net_loss": np.round(rng.uniform(0.5, 300.0, n_sr), 2).tolist(),
+        "sr_item_sk": [out["store_sales"]["ss_item_sk"][i]
+                       for i in sr_pick],
+        "sr_customer_sk": [out["store_sales"]["ss_customer_sk"][i]
+                           for i in sr_pick],
+        "sr_ticket_number": [out["store_sales"]["ss_ticket_number"][i]
+                             for i in sr_pick],
+        "sr_return_quantity": rng.randint(1, 51, n_sr).tolist(),
     }
 
     n_cp = max(6, int(11_718 * sf))
@@ -185,6 +252,18 @@ def generate(sf: float = 0.001, seed: int = 7):
         "cs_ext_sales_price": np.round(rng.uniform(1.0, 2000.0, n_cs),
                                        2).tolist(),
         "cs_net_profit": np.round(rng.uniform(-400.0, 600.0, n_cs),
+                                  2).tolist(),
+        "cs_bill_customer_sk": rng.randint(1, n_cust + 1, n_cs).tolist(),
+        "cs_ship_customer_sk": rng.randint(1, n_cust + 1, n_cs).tolist(),
+        "cs_bill_cdemo_sk": rng.randint(1, n_cd + 1, n_cs).tolist(),
+        "cs_call_center_sk": rng.randint(1, n_cc + 1, n_cs).tolist(),
+        "cs_promo_sk": rng.randint(1, n_promo + 1, n_cs).tolist(),
+        "cs_quantity": rng.randint(1, 101, n_cs).tolist(),
+        "cs_list_price": np.round(rng.uniform(1.0, 200.0, n_cs),
+                                  2).tolist(),
+        "cs_sales_price": np.round(rng.uniform(0.5, 180.0, n_cs),
+                                   2).tolist(),
+        "cs_coupon_amt": np.round(rng.uniform(0.0, 100.0, n_cs),
                                   2).tolist(),
     }
 
@@ -213,6 +292,7 @@ def generate(sf: float = 0.001, seed: int = 7):
                                        2).tolist(),
         "ws_net_profit": np.round(rng.uniform(-300.0, 500.0, n_ws),
                                   2).tolist(),
+        "ws_bill_customer_sk": rng.randint(1, n_cust + 1, n_ws).tolist(),
     }
 
     # web returns reference a sold web order (item, order) so the q5 left

@@ -9,14 +9,17 @@ from spark_rapids_tpu.types import (DateType, DoubleType, LongType, Schema,
 DATE_DIM = Schema([
     F("d_date_sk", LongType), F("d_date", DateType),
     F("d_year", LongType), F("d_moy", LongType), F("d_dom", LongType),
-    F("d_qoy", LongType), F("d_day_name", StringType)])
+    F("d_qoy", LongType), F("d_day_name", StringType),
+    F("d_month_seq", LongType)])
 
 ITEM = Schema([
     F("i_item_sk", LongType), F("i_item_id", StringType),
     F("i_brand_id", LongType), F("i_brand", StringType),
     F("i_category_id", LongType), F("i_category", StringType),
     F("i_manufact_id", LongType), F("i_manufact", StringType),
-    F("i_manager_id", LongType), F("i_current_price", DoubleType)])
+    F("i_manager_id", LongType), F("i_current_price", DoubleType),
+    F("i_class_id", LongType), F("i_class", StringType),
+    F("i_item_desc", StringType)])
 
 STORE_SALES = Schema([
     F("ss_sold_date_sk", LongType), F("ss_sold_time_sk", LongType),
@@ -33,7 +36,9 @@ STORE_SALES = Schema([
 CUSTOMER_DEMOGRAPHICS = Schema([
     F("cd_demo_sk", LongType), F("cd_gender", StringType),
     F("cd_marital_status", StringType),
-    F("cd_education_status", StringType)])
+    F("cd_education_status", StringType), F("cd_dep_count", LongType),
+    F("cd_dep_employed_count", LongType),
+    F("cd_dep_college_count", LongType)])
 
 PROMOTION = Schema([
     F("p_promo_sk", LongType), F("p_channel_email", StringType),
@@ -41,19 +46,27 @@ PROMOTION = Schema([
 
 CUSTOMER = Schema([
     F("c_customer_sk", LongType), F("c_customer_id", StringType),
-    F("c_current_addr_sk", LongType), F("c_birth_month", LongType)])
+    F("c_current_addr_sk", LongType), F("c_birth_month", LongType),
+    F("c_current_cdemo_sk", LongType), F("c_current_hdemo_sk", LongType),
+    F("c_first_name", StringType), F("c_last_name", StringType),
+    F("c_salutation", StringType), F("c_preferred_cust_flag", StringType)])
 
 CUSTOMER_ADDRESS = Schema([
     F("ca_address_sk", LongType), F("ca_zip", StringType),
-    F("ca_gmt_offset", DoubleType)])
+    F("ca_gmt_offset", DoubleType), F("ca_state", StringType),
+    F("ca_county", StringType), F("ca_city", StringType),
+    F("ca_country", StringType)])
 
 STORE = Schema([
     F("s_store_sk", LongType), F("s_store_name", StringType),
-    F("s_zip", StringType), F("s_number_employees", LongType)])
+    F("s_zip", StringType), F("s_number_employees", LongType),
+    F("s_company_name", StringType), F("s_state", StringType),
+    F("s_county", StringType), F("s_city", StringType),
+    F("s_gmt_offset", DoubleType)])
 
 HOUSEHOLD_DEMOGRAPHICS = Schema([
     F("hd_demo_sk", LongType), F("hd_dep_count", LongType),
-    F("hd_vehicle_count", LongType)])
+    F("hd_vehicle_count", LongType), F("hd_buy_potential", StringType)])
 
 TIME_DIM = Schema([
     F("t_time_sk", LongType), F("t_hour", LongType),
@@ -61,12 +74,19 @@ TIME_DIM = Schema([
 
 STORE_RETURNS = Schema([
     F("sr_returned_date_sk", LongType), F("sr_store_sk", LongType),
-    F("sr_return_amt", DoubleType), F("sr_net_loss", DoubleType)])
+    F("sr_return_amt", DoubleType), F("sr_net_loss", DoubleType),
+    F("sr_item_sk", LongType), F("sr_customer_sk", LongType),
+    F("sr_ticket_number", LongType), F("sr_return_quantity", LongType)])
 
 CATALOG_SALES = Schema([
     F("cs_sold_date_sk", LongType), F("cs_catalog_page_sk", LongType),
     F("cs_item_sk", LongType), F("cs_order_number", LongType),
-    F("cs_ext_sales_price", DoubleType), F("cs_net_profit", DoubleType)])
+    F("cs_ext_sales_price", DoubleType), F("cs_net_profit", DoubleType),
+    F("cs_bill_customer_sk", LongType), F("cs_ship_customer_sk", LongType),
+    F("cs_bill_cdemo_sk", LongType), F("cs_call_center_sk", LongType),
+    F("cs_promo_sk", LongType), F("cs_quantity", LongType),
+    F("cs_list_price", DoubleType), F("cs_sales_price", DoubleType),
+    F("cs_coupon_amt", DoubleType)])
 
 CATALOG_RETURNS = Schema([
     F("cr_returned_date_sk", LongType), F("cr_catalog_page_sk", LongType),
@@ -75,7 +95,8 @@ CATALOG_RETURNS = Schema([
 WEB_SALES = Schema([
     F("ws_sold_date_sk", LongType), F("ws_web_site_sk", LongType),
     F("ws_item_sk", LongType), F("ws_order_number", LongType),
-    F("ws_ext_sales_price", DoubleType), F("ws_net_profit", DoubleType)])
+    F("ws_ext_sales_price", DoubleType), F("ws_net_profit", DoubleType),
+    F("ws_bill_customer_sk", LongType)])
 
 WEB_RETURNS = Schema([
     F("wr_returned_date_sk", LongType), F("wr_item_sk", LongType),
@@ -88,6 +109,9 @@ CATALOG_PAGE = Schema([
 WEB_SITE = Schema([
     F("web_site_sk", LongType), F("web_site_id", StringType)])
 
+CALL_CENTER = Schema([
+    F("cc_call_center_sk", LongType), F("cc_name", StringType)])
+
 SCHEMAS = {
     "date_dim": DATE_DIM, "item": ITEM, "store_sales": STORE_SALES,
     "customer_demographics": CUSTOMER_DEMOGRAPHICS, "promotion": PROMOTION,
@@ -97,4 +121,5 @@ SCHEMAS = {
     "catalog_sales": CATALOG_SALES, "catalog_returns": CATALOG_RETURNS,
     "web_sales": WEB_SALES, "web_returns": WEB_RETURNS,
     "catalog_page": CATALOG_PAGE, "web_site": WEB_SITE,
+    "call_center": CALL_CENTER,
 }
