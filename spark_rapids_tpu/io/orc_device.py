@@ -237,6 +237,9 @@ class OrcFileInfo:
         ps_len = tail[-1]
         ps = _parse_postscript(tail[-1 - ps_len:-1])
         self.compression = ps["compression"]
+        self._ps_len = ps_len
+        self._footer_len = ps["footerLength"]
+        self._metadata_len = ps["metadataLength"]
         need = ps["footerLength"] + ps_len + 1
         if need > len(tail):
             with open(path, "rb") as f:
@@ -253,6 +256,35 @@ class OrcFileInfo:
         self.columns: Dict[str, Tuple[int, int]] = {}
         for name, cid in zip(field_names, subtypes):
             self.columns[name] = (cid, self.types[cid][0])
+
+    def stripe_stats(self) -> Optional[list]:
+        """Per-stripe column bounds from the Metadata section:
+        [stripe][type-column-id] -> (lo, hi) or None.  The reference
+        evaluates its SearchArgument against the same stripe statistics
+        (OrcFilters.scala:1-194); parsing them here lets the planner skip
+        dead stripes WITHOUT decoding predicate columns first.  Returns
+        None when the file carries no metadata section."""
+        cached = getattr(self, "_stripe_stats", None)
+        if cached is not None:
+            return cached or None
+        if not self._metadata_len:
+            self._stripe_stats = []
+            return None
+        start = (self.size - 1 - self._ps_len - self._footer_len
+                 - self._metadata_len)
+        raw = self.read_range(start, self._metadata_len)
+        meta = _inflate(raw, self.compression)
+        out = []
+        for fnum, _wt, v in _Proto(meta).fields():
+            if fnum != 1:  # Metadata.stripeStats
+                continue
+            cols: List[Optional[Tuple]] = []
+            for f2, _w2, v2 in _Proto(v).fields():
+                if f2 == 1:  # StripeStatistics.colStats
+                    cols.append(_parse_column_statistics(v2))
+            out.append(cols)
+        self._stripe_stats = out
+        return out or None
 
     def read_range(self, offset: int, length: int) -> bytes:
         fh = getattr(self, "_fh", None)
@@ -405,6 +437,45 @@ def _zigzag(u: int) -> int:
     return (u >> 1) ^ -(u & 1)
 
 
+def _parse_column_statistics(buf: bytes) -> Optional[Tuple]:
+    """One orc_proto.ColumnStatistics -> (lo, hi) comparable bounds, or
+    None when the column kind carries no usable bounds (timestamps use a
+    different epoch/unit than the engine's micros; booleans/binary have
+    bucket/byte stats)."""
+    lo = hi = None
+    try:
+        for fnum, _wt, v in _Proto(buf).fields():
+            if fnum == 2:  # IntegerStatistics (sint64 zigzag)
+                for f2, _w2, v2 in _Proto(v).fields():
+                    if f2 == 1:
+                        lo = _zigzag(v2)
+                    elif f2 == 2:
+                        hi = _zigzag(v2)
+            elif fnum == 3:  # DoubleStatistics (wire doubles)
+                for f2, _w2, v2 in _Proto(v).fields():
+                    if f2 == 1 and len(v2) == 8:
+                        lo = struct.unpack("<d", v2)[0]
+                    elif f2 == 2 and len(v2) == 8:
+                        hi = struct.unpack("<d", v2)[0]
+            elif fnum == 4:  # StringStatistics
+                for f2, _w2, v2 in _Proto(v).fields():
+                    if f2 == 1:
+                        lo = v2.decode("utf-8", "replace")
+                    elif f2 == 2:
+                        hi = v2.decode("utf-8", "replace")
+            elif fnum == 7:  # DateStatistics (sint32 zigzag, days)
+                for f2, _w2, v2 in _Proto(v).fields():
+                    if f2 == 1:
+                        lo = _zigzag(v2)
+                    elif f2 == 2:
+                        hi = _zigzag(v2)
+    except (OrcDeviceUnsupported, IndexError, struct.error):
+        return None
+    if lo is None or hi is None:
+        return None
+    return (lo, hi)
+
+
 def _unpack_bits_host(body: bytes, bit_off: int, count: int,
                       width: int) -> np.ndarray:
     """Host big-endian bit unpack (DELTA payloads — small)."""
@@ -428,9 +499,12 @@ def rlev2_runs(body: bytes, n_values: int, signed: bool = True):
     direct_runs [(width, byte_offset, count, out_offset)]).  `signed`
     selects zigzag decode for SR/DIRECT values (value streams) vs raw
     unsigned (LENGTH / dictionary-index streams; DELTA's first delta stays
-    zigzag either way, per the spec).  Raises OrcDeviceUnsupported on
-    PATCHED_BASE (outlier encoding) or widths the 8-byte device window
-    cannot extract (>56 bits)."""
+    zigzag either way, per the spec).  All four RLEv2 sub-encodings
+    decode: SR/DELTA/PATCHED_BASE values land in host_vals during this
+    walk (PATCHED_BASE is the rare outlier encoding; resolving its patch
+    list costs only the header walk already being paid), and DIRECT runs
+    return as descriptors for the device bit-extraction kernel, whose
+    9-byte window covers widths up to 64 bits."""
     host_vals = np.zeros(n_values, np.int64)
     direct = []
     pos = out = 0

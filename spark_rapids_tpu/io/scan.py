@@ -360,13 +360,28 @@ def _iter_parquet(files, max_rows: int, max_bytes: int,
             dump_seq += 1
 
 
+def _bounds_can_match(lo, hi, op, value) -> bool:
+    """min/max bounds vs one pushed predicate (False = provably dead)."""
+    try:
+        if op == "EqualTo" and (value < lo or value > hi):
+            return False
+        if op == "LessThan" and not (lo < value):
+            return False
+        if op == "LessThanOrEqual" and not (lo <= value):
+            return False
+        if op == "GreaterThan" and not (hi > value):
+            return False
+        if op == "GreaterThanOrEqual" and not (hi >= value):
+            return False
+    except TypeError:
+        return True  # incomparable literal vs file data: keep the stripe
+    return True
+
+
 def _orc_stripe_can_match(stripe, predicates) -> bool:
-    """Predicate-column min/max vs pushed predicates.  pyarrow exposes no
-    stripe statistics in the footer, so the reader decodes the (narrow)
-    predicate columns FIRST and computes the bounds itself — dead stripes
-    then skip the decode of every remaining column (projection-first
-    pushdown; the reference instead rebuilds a hive SearchArgument,
-    OrcFilters.scala:1-194)."""
+    """Predicate-column min/max computed from the decoded predicate
+    columns (fallback when the file has no metadata section; the primary
+    path is footer stripe statistics, _orc_stats_can_match)."""
     import pyarrow.compute as pc
     for (name, op, value) in predicates:
         if name not in stripe.schema.names:
@@ -377,20 +392,29 @@ def _orc_stripe_can_match(stripe, predicates) -> bool:
         try:
             mm = pc.min_max(col)
             lo, hi = mm["min"].as_py(), mm["max"].as_py()
-            if lo is None or hi is None:
-                continue
-            if op == "EqualTo" and (value < lo or value > hi):
-                return False
-            if op == "LessThan" and not (lo < value):
-                return False
-            if op == "LessThanOrEqual" and not (lo <= value):
-                return False
-            if op == "GreaterThan" and not (hi > value):
-                return False
-            if op == "GreaterThanOrEqual" and not (hi >= value):
-                return False
         except Exception:
-            continue  # incomparable literal vs file data: keep the stripe
+            continue
+        if lo is None or hi is None:
+            continue
+        if not _bounds_can_match(lo, hi, op, value):
+            return False
+    return True
+
+
+def _orc_stats_can_match(stats_row, columns_map, predicates) -> bool:
+    """Stripe-footer statistics vs pushed predicates — the reference's
+    SearchArgument evaluation (OrcFilters.scala:1-194) without decoding a
+    single value.  Undecidable predicates keep the stripe (safe)."""
+    for (name, op, value) in predicates:
+        entry = columns_map.get(name)
+        if entry is None:
+            continue
+        cid = entry[0]
+        st = stats_row[cid] if cid < len(stats_row) else None
+        if st is None:
+            continue
+        if not _bounds_can_match(st[0], st[1], op, value):
+            return False
     return True
 
 
@@ -414,14 +438,22 @@ def _iter_orc(files, max_rows: int, max_bytes: int,
             pred_cols = [nm for (nm, _, _) in predicates
                          if nm in file_names]
             pred_cols = sorted(set(pred_cols)) or None
+        stats = cols_map = None
+        if pred_cols:
+            stats, cols_map = _orc_stats_for(path)
         chunk = []
         rows = bytes_ = 0
         for s in range(n):
             if pred_cols:
-                probe = of.read_stripe(s, columns=pred_cols)
                 if metrics is not None:
                     metrics.add("numStripes", 1)
-                if not _orc_stripe_can_match(probe, predicates):
+                if stats is not None and s < len(stats):
+                    alive = _orc_stats_can_match(stats[s], cols_map,
+                                                 predicates)
+                else:  # no metadata section: decode predicate cols only
+                    alive = _orc_stripe_can_match(
+                        of.read_stripe(s, columns=pred_cols), predicates)
+                if not alive:
                     if metrics is not None:
                         metrics.add("numStripesSkipped", 1)
                     continue
@@ -435,6 +467,18 @@ def _iter_orc(files, max_rows: int, max_bytes: int,
             bytes_ += stripe.nbytes
         if chunk:
             yield path, _concat_record_batches(chunk)
+
+
+def _orc_stats_for(path: str):
+    """(stripe_stats, column_map) via the hand-rolled footer reader, or
+    (None, None) when the file is outside its scope (e.g. snappy) or has
+    no metadata section — the caller then probes predicate columns."""
+    try:
+        from .orc_device import OrcFileInfo
+        fi = OrcFileInfo(path)
+        return fi.stripe_stats(), fi.columns
+    except Exception:
+        return None, None
 
 
 def _concat_record_batches(batches):
@@ -529,15 +573,26 @@ def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
     file_names = set(of.schema.names)
     pred_cols = sorted({nm for (nm, _, _) in predicates or []
                         if nm in file_names}) or None
+    stats = None
+    if pred_cols:
+        try:
+            stats = info.stripe_stats()
+        except Exception:
+            stats = None  # stats are an optimization, never a failure
     try:
         publish_input_file(path)
         import jax.numpy as jnp
         for si in range(len(info.stripes)):
             if pred_cols:
-                probe = of.read_stripe(si, columns=pred_cols)
                 if metrics is not None:
                     metrics.add("numStripes", 1)
-                if not _orc_stripe_can_match(probe, predicates):
+                if stats is not None and si < len(stats):
+                    alive = _orc_stats_can_match(stats[si], info.columns,
+                                                 predicates)
+                else:  # no metadata section: decode predicate cols only
+                    alive = _orc_stripe_can_match(
+                        of.read_stripe(si, columns=pred_cols), predicates)
+                if not alive:
                     if metrics is not None:
                         metrics.add("numStripesSkipped", 1)
                     continue

@@ -252,3 +252,47 @@ def test_orc_stripe_pushdown_skips():
     cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
     want = cpu.read.orc(p).filter(col("k") >= 19000).select(col("v")).collect()
     assert sorted(rows) == sorted(want)
+
+
+def test_orc_stripe_statistics_prune_without_probe_reads():
+    """The stripe skip decision comes from footer statistics (metadata
+    section), not from decoding predicate columns: bounds parse for
+    int/string/double and _orc_stats_can_match prunes on them
+    (ADVICE r3: the probe read decoded predicate columns twice)."""
+    import os
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.orc as paorc
+
+    from spark_rapids_tpu.io.orc_device import OrcFileInfo
+    from spark_rapids_tpu.io.scan import _orc_stats_can_match
+
+    d = tempfile.mkdtemp()
+    p = os.path.join(d, "stats.orc")
+    t = pa.table({"k": pa.array(list(range(20000)), type=pa.int64()),
+                  "s": pa.array([f"val{i:05d}" for i in range(20000)]),
+                  "x": pa.array([i * 0.5 for i in range(20000)])})
+    paorc.write_table(t, p, stripe_size=64 * 1024)
+
+    fi = OrcFileInfo(p)
+    stats = fi.stripe_stats()
+    assert stats is not None and len(stats) == len(fi.stripes) > 1
+    k_cid = fi.columns["k"][0]
+    lo0, hi0 = stats[0][k_cid]
+    assert lo0 == 0 and hi0 < 20000
+
+    # first stripe dies for k >= 19000; last stripe survives
+    preds = [("k", "GreaterThanOrEqual", 19000)]
+    assert not _orc_stats_can_match(stats[0], fi.columns, preds)
+    assert _orc_stats_can_match(stats[-1], fi.columns, preds)
+    # string + double bounds prune too
+    assert not _orc_stats_can_match(stats[0], fi.columns,
+                                    [("s", "GreaterThan", "val19999")])
+    assert not _orc_stats_can_match(stats[-1], fi.columns,
+                                    [("x", "LessThan", 1.0)])
+    # unknown column / undecidable literal keeps the stripe
+    assert _orc_stats_can_match(stats[0], fi.columns,
+                                [("missing", "EqualTo", 5)])
+    assert _orc_stats_can_match(stats[0], fi.columns,
+                                [("k", "EqualTo", "not-an-int")])
