@@ -173,11 +173,14 @@ def _compact_values(col: Column, live) -> Tuple[np.ndarray, int, dict]:
         def make():
             def k(data, lengths, ok):
                 slot = 4 + width
-                # byte offset of each value: 4+len of preceding non-nulls
-                sizes = jnp.where(ok, 4 + lengths, 0)
+                # byte offset of each value: 4+len of preceding non-nulls.
+                # int64 accumulation: an int32 cumsum would silently wrap
+                # (and corrupt the page) once total payload nears 2 GiB
+                sizes = jnp.where(ok, 4 + lengths.astype(jnp.int64),
+                                  jnp.int64(0))
                 ends = jnp.cumsum(sizes)
                 starts = ends - sizes
-                total = ends[-1] if cap else jnp.int32(0)
+                total = ends[-1] if cap else jnp.int64(0)
                 out = jnp.zeros(cap * slot, dtype=jnp.uint8)
                 # little-endian 4-byte length prefix
                 pos4 = jnp.arange(4, dtype=jnp.int32)[None, :]

@@ -54,8 +54,16 @@ def init_distributed(conf=None) -> bool:
     elif int(os.environ.get("JAX_NUM_PROCESSES", 0) or 0):
         kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
         kwargs["process_id"] = int(os.environ.get("JAX_PROCESS_ID", 0))
-    if getattr(init_distributed, "_done", None) == coordinator:
+    done = getattr(init_distributed, "_done", None)
+    if done == coordinator:
         return True  # idempotent per coordinator
+    if done is not None:
+        # jax.distributed.initialize would raise an opaque RuntimeError;
+        # name the actual misconfiguration instead
+        raise RuntimeError(
+            f"jax.distributed already initialized with coordinator "
+            f"{done!r}; cannot re-initialize with {coordinator!r} in the "
+            f"same process")
     jax.distributed.initialize(**kwargs)
     init_distributed._done = coordinator
     return True

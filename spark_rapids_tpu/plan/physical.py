@@ -123,25 +123,93 @@ def _convert_scan(meta: PlanMeta, on_tpu: bool) -> ExecNode:
     return make_scan_exec(plan, on_tpu, meta.conf)
 
 
-def _estimate_plan_bytes(plan: L.LogicalPlan):
-    """Rough byte-size estimate of a subtree's output (Spark's stats
-    sizeInBytes, simplified).  None = unknown."""
+def _schema_row_bytes(schema) -> int:
+    """Estimated bytes per row of a schema (strings at a fixed guess —
+    Spark's defaultSizeInBytes per type, simplified)."""
+    total = 0
+    for f in schema:
+        if f.dtype.np_dtype is not None:
+            total += f.dtype.np_dtype.itemsize
+        else:
+            total += 32  # string/unknown
+    return max(total, 1)
+
+
+def _estimate_plan_rows(plan: L.LogicalPlan, conf):
+    """Rough output row-count estimate (Spark's stats rowCount,
+    simplified; VERDICT r3: estimates must survive aggregates/joins so a
+    pre-aggregated dimension can still broadcast).  Upper-bound-ish:
+    over-estimating keeps a huge build side off the broadcast path, which
+    is the safe direction.  None = unknown."""
     import os
     if isinstance(plan, L.LogicalScan):
         if plan.fmt == "memory":
-            src = plan.source
-            nbytes = getattr(src, "nbytes", None)
-            if nbytes is not None:
-                return int(nbytes)
-            return None
+            rows = getattr(plan.source, "num_rows", None)
+            return int(rows) if rows is not None else None
         try:
-            return sum(os.path.getsize(f) for f in plan.files)
-        except OSError:
+            nbytes = sum(os.path.getsize(f) for f in plan.source)
+        except (OSError, TypeError):
             return None
+        return nbytes // _schema_row_bytes(plan.schema)
     if isinstance(plan, (L.LogicalProject, L.LogicalFilter, L.LogicalSort,
-                         L.LogicalLimit, L.LogicalRepartition)):
-        return _estimate_plan_bytes(plan.children[0])
+                         L.LogicalRepartition, L.LogicalWindow)):
+        # no-CBO Spark keeps the child estimate through row-local nodes
+        # (filters keep it too: selectivity guessing under-estimates, the
+        # dangerous direction for broadcast).  Generate (explode) is NOT
+        # row-preserving — its fan-out is unbounded, so it stays unknown.
+        return _estimate_plan_rows(plan.children[0], conf)
+    if isinstance(plan, L.LogicalLimit):
+        child = _estimate_plan_rows(plan.children[0], conf)
+        return plan.n if child is None else min(plan.n, child)
+    if isinstance(plan, L.LogicalAggregate):
+        if not plan.grouping:
+            return 1
+        return _estimate_plan_rows(plan.children[0], conf)  # upper bound
+    if isinstance(plan, L.LogicalDistinct):
+        return _estimate_plan_rows(plan.children[0], conf)
+    if isinstance(plan, L.LogicalUnion):
+        parts = [_estimate_plan_rows(c, conf) for c in plan.children]
+        return None if any(p is None for p in parts) else sum(parts)
+    if isinstance(plan, L.LogicalExpand):
+        child = _estimate_plan_rows(plan.children[0], conf)
+        return None if child is None else child * len(plan.projections)
+    if isinstance(plan, L.LogicalJoin):
+        left = _estimate_plan_rows(plan.children[0], conf)
+        right = _estimate_plan_rows(plan.children[1], conf)
+        if left is None or right is None:
+            return None
+        if plan.join_type in ("left_semi", "left_anti"):
+            return left
+        # star-join heuristic: fact side dominates an equi-join's output;
+        # dim x dim stays small.  (True worst case is the product — using
+        # it would disable broadcast everywhere.)
+        return max(left, right)
     return None
+
+
+def _estimate_plan_bytes(plan: L.LogicalPlan, conf):
+    """Rough byte-size estimate of a subtree's output: estimated rows x
+    OUTPUT schema width (so a projection that drops wide columns shrinks
+    the estimate, unlike passing raw file size through).  None =
+    unknown."""
+    import os
+    if isinstance(plan, L.LogicalScan):
+        # raw source size: better than rows x width for compressed files
+        if plan.fmt == "memory":
+            nbytes = getattr(plan.source, "nbytes", None)
+            return int(nbytes) if nbytes is not None else None
+        try:
+            return sum(os.path.getsize(f) for f in plan.source)
+        except (OSError, TypeError):
+            return None
+    rows = _estimate_plan_rows(plan, conf)
+    if rows is None:
+        return None
+    try:
+        schema = plan_schema(plan, conf)
+    except Exception:
+        return None
+    return rows * _schema_row_bytes(schema)
 
 
 def _should_partition_join(plan: "L.LogicalJoin", conf) -> bool:
@@ -150,7 +218,7 @@ def _should_partition_join(plan: "L.LogicalJoin", conf) -> bool:
     from .. import config as C
     if not conf.get(C.PARTITIONED_JOIN_ENABLED):
         return False
-    est = _estimate_plan_bytes(plan.children[1])
+    est = _estimate_plan_bytes(plan.children[1], conf)
     threshold = conf.get(C.PARTITIONED_JOIN_THRESHOLD)
     return est is None or est > int(threshold)
 
@@ -167,5 +235,5 @@ def _should_broadcast_build(plan: "L.LogicalJoin", conf) -> bool:
     threshold = conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
     if threshold is None or int(threshold) < 0:
         return False
-    est = _estimate_plan_bytes(right)
+    est = _estimate_plan_bytes(right, conf)
     return est is not None and est <= int(threshold)
