@@ -226,6 +226,13 @@ MESH_USE_ALLGATHER = _conf(
     "Use the sel-mask all-gather exchange instead of the compact quota "
     "all-to-all in distributed operators (zero overflow risk, O(n) cost; "
     "debugging/safety knob).", _to_bool)
+MESH_INPUT_CHUNK_ROWS = _conf(
+    "spark.rapids.sql.tpu.mesh.inputChunkRows", 1 << 20,
+    "Row budget per SPMD input chunk.  Distributed aggregate/join STREAM "
+    "their input through the mesh in chunks of at most this many rows "
+    "(partial-agg then device-resident state merge; per-chunk probe "
+    "against a resident build side), so an input larger than HBM never "
+    "materializes as one host-side concat.", int)
 SHUFFLE_PARTITIONS = _conf(
     "spark.rapids.sql.tpu.shuffle.partitions", 8,
     "Partition count for planner-inserted shuffle exchanges around "
@@ -266,6 +273,15 @@ PARQUET_DEVICE_DECODE = _conf(
     "on the device (host keeps only page headers, run structure, and "
     "definition levels); columns outside scope fall back to the host "
     "arrow reader per column.", _to_bool)
+ORC_DEVICE_ENCODE = _conf(
+    "spark.rapids.sql.format.orc.deviceEncode.enabled", True,
+    "Encode ORC writes on the device: null compaction, contiguous string "
+    "byte packing + lengths, and min/max/count statistics run as device "
+    "kernels and the compacted stream payload is the only D2H transfer; "
+    "the host writes RLE runs and the protobuf stripe footer / metadata "
+    "/ footer / postscript (io/orc_device_write.py).  Timestamp columns "
+    "and partitioned writes fall back to the host arrow encoder.",
+    _to_bool)
 PARQUET_DEVICE_ENCODE = _conf(
     "spark.rapids.sql.format.parquet.deviceEncode.enabled", True,
     "Encode parquet writes on the device: null compaction, string "

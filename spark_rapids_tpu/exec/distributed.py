@@ -13,10 +13,14 @@ separate exchange operator: partial-agg -> all-to-all -> merge (etc.) fuse
 into one XLA program per subtree, so the "exchange" is a collective the
 compiler schedules, not a materialization boundary.
 
-Input staging: each exec drains its single-chip child iterator, concatenates
-to one batch whose power-of-two capacity divides the mesh size, and
-device_puts it row-sharded.  Results are yielded as globally-sharded batches;
-downstream single-chip operators (and D2H) consume the global view.
+Input staging is STREAMED (VERDICT r3 item 4): the child iterator is staged
+in chunks of spark.rapids.sql.tpu.mesh.inputChunkRows rows; aggregates keep
+a mesh-resident compacted partial state merged chunk-by-chunk, and joins
+keep the exchanged build side resident while probe chunks stream through —
+peak memory is one chunk plus the resident state, never the whole input.
+Sort still stages its full input (sampled range bounds need a complete
+pass).  Results are yielded as globally-sharded batches; downstream
+single-chip operators (and D2H) consume the global view.
 """
 from __future__ import annotations
 
@@ -28,7 +32,9 @@ from ..columnar import ColumnarBatch, concat_batches
 from ..columnar.batch import bucket_rows
 from ..parallel.mesh import DATA_AXIS, make_mesh, shard_batch
 from ..parallel.distributed import (run_distributed_aggregate,
+                                    run_distributed_aggregate_streaming,
                                     run_distributed_join,
+                                    run_distributed_join_streaming,
                                     run_distributed_sort)
 from ..utils.tracing import named_range
 from .aggregate import TpuHashAggregateExec
@@ -59,12 +65,8 @@ def resolve_mesh(conf) -> Optional["jax.sharding.Mesh"]:
     return make_mesh(n)
 
 
-def _drain_to_sharded(child, ctx: ExecContext, mesh, min_cap: int):
-    """Drain a child exec into ONE row-sharded batch (or None if empty)."""
-    batches = list(child.execute(ctx))
-    batches = [b for b in batches if b is not None]
-    if not batches:
-        return None
+def _stage_chunk(batches, mesh, min_cap: int):
+    """Concat a LIST of batches to one shardable batch and mesh it."""
     n = mesh.shape[DATA_AXIS]
     if len(batches) == 1 and batches[0].capacity % n == 0 \
             and batches[0].capacity >= min_cap:
@@ -74,6 +76,32 @@ def _drain_to_sharded(child, ctx: ExecContext, mesh, min_cap: int):
         cap = max(bucket_rows(max(total, 1)), min_cap, n)
         big = concat_batches(batches, capacity=cap)
     return shard_batch(big, mesh)
+
+
+def _drain_to_sharded(child, ctx: ExecContext, mesh, min_cap: int):
+    """Drain a child exec into ONE row-sharded batch (or None if empty)."""
+    batches = [b for b in child.execute(ctx) if b is not None]
+    if not batches:
+        return None
+    return _stage_chunk(batches, mesh, min_cap)
+
+
+def _sharded_chunks(child, ctx: ExecContext, mesh, min_cap: int,
+                    chunk_rows: int):
+    """Stream a child exec as row-sharded CHUNKS of at most ~chunk_rows
+    rows each (VERDICT r3 item 4: the input is never concatenated whole on
+    the host; peak staging is one chunk)."""
+    pending, rows = [], 0
+    for b in child.execute(ctx):
+        if b is None:
+            continue
+        pending.append(b)
+        rows += b.num_rows_host()
+        if rows >= chunk_rows:
+            yield _stage_chunk(pending, mesh, min_cap)
+            pending, rows = [], 0
+    if pending:
+        yield _stage_chunk(pending, mesh, min_cap)
 
 
 class TpuDistributedAggregateExec(TpuHashAggregateExec):
@@ -96,16 +124,18 @@ class TpuDistributedAggregateExec(TpuHashAggregateExec):
         from .aggregate import set_pallas_cumsum
         set_pallas_cumsum(ctx.conf.get(C.PALLAS_ENABLED))
         n = self.mesh.shape[DATA_AXIS]
-        batch = _drain_to_sharded(self.children[0], ctx, self.mesh, n)
-        if batch is None:
+        chunk_rows = max(int(ctx.conf.get(C.MESH_INPUT_CHUNK_ROWS)), n)
+        chunks = _sharded_chunks(self.children[0], ctx, self.mesh, n,
+                                 chunk_rows)
+        with self.metrics.timer("distributedAggTime"), \
+                named_range("dist_agg"):
+            out = run_distributed_aggregate_streaming(
+                self, self.mesh, chunks, use_allgather=self.use_allgather,
+                cache_key=("dist",) + self.kernel_key())
+        if out is None:
             # delegate empty-input semantics (global 1-row / grouped none)
             yield from super().execute(ctx)
             return
-        with self.metrics.timer("distributedAggTime"), \
-                named_range("dist_agg"):
-            out = run_distributed_aggregate(
-                self, self.mesh, batch, use_allgather=self.use_allgather,
-                cache_key=("dist",) + self.kernel_key())
         self.metrics.add("numOutputBatches", 1)
         yield out
 
@@ -127,22 +157,32 @@ class TpuDistributedJoinExec(TpuHashJoinExec):
                 f"{self.mesh.shape[DATA_AXIS]}]")
 
     def execute(self, ctx: ExecContext):
+        from .. import config as C
         n = self.mesh.shape[DATA_AXIS]
-        left = _drain_to_sharded(self.children[0], ctx, self.mesh, n)
+        chunk_rows = max(int(ctx.conf.get(C.MESH_INPUT_CHUNK_ROWS)), n)
         right = _drain_to_sharded(self.children[1], ctx, self.mesh, n)
-        if left is None or right is None:
-            # empty side: the single-chip kernels handle null/empty
+        if right is None:
+            # empty build side: the single-chip kernels handle null/empty
             # semantics (left rows with no matches etc.) without a mesh
             yield from super().execute(ctx)
             return
+        produced = False
         with self.metrics.timer("distributedJoinTime"), \
                 named_range("dist_join"):
-            out = run_distributed_join(
-                self, self.mesh, left, right,
-                use_allgather=self.use_allgather,
-                cache_key=("dist",) + self.kernel_key())
-        self.metrics.add("numOutputBatches", 1)
-        yield out
+            # stream the probe side: every supported join type
+            # (inner/left/left_semi/left_anti) is per-left-row independent,
+            # so per-chunk results compose by concatenation
+            for out in run_distributed_join_streaming(
+                    self, self.mesh,
+                    _sharded_chunks(self.children[0], ctx, self.mesh, n,
+                                    chunk_rows),
+                    right, use_allgather=self.use_allgather,
+                    cache_key=("dist",) + self.kernel_key()):
+                produced = True
+                self.metrics.add("numOutputBatches", 1)
+                yield out
+        if not produced:
+            yield _empty_batch(self.schema)
 
 
 class TpuDistributedSortExec(TpuSortExec):

@@ -230,6 +230,170 @@ def run_distributed_aggregate(agg, mesh: Mesh, batch: ColumnarBatch,
 
 
 # ---------------------------------------------------------------------------
+# streaming aggregate (VERDICT r3 item 4: no whole-input host concat)
+# ---------------------------------------------------------------------------
+
+def _concat_local(a: ColumnarBatch, b: ColumnarBatch,
+                  schema) -> ColumnarBatch:
+    """Trace-safe per-device concat of two state batches (live rows stay
+    wherever their sel marks them; the merge kernel keys off sel, not
+    position).  Unlike columnar.concat_batches this never syncs row counts
+    to the host, so it can run inside a shard_map program."""
+    cols = []
+    for ca, cb, f in zip(a.columns, b.columns, schema):
+        if f.dtype.is_string:
+            ml = max(ca.max_len, cb.max_len)
+            pa_, pb = ca.pad_strings_to(ml), cb.pad_strings_to(ml)
+            cols.append(Column(
+                jnp.concatenate([pa_.data, pb.data], axis=0),
+                jnp.concatenate([pa_.valid, pb.valid]), f.dtype,
+                jnp.concatenate([pa_.lengths, pb.lengths])))
+        else:
+            cols.append(Column(
+                jnp.concatenate([ca.data, cb.data]),
+                jnp.concatenate([ca.valid, cb.valid]), f.dtype))
+    sel = jnp.concatenate([a.sel, b.sel])
+    return ColumnarBatch(cols, sel, schema)
+
+
+def distributed_aggregate_partial_step(agg, mesh: Mesh,
+                                       axis: str = DATA_AXIS, pre=None,
+                                       quota=None,
+                                       use_allgather: bool = False):
+    """The streaming chunk step: update -> all_to_all by key hash -> merge,
+    WITHOUT finalize.  Because the exchange routes every state row by key
+    hash, a given group's partials land on the same device in every chunk —
+    so cross-chunk merging is purely device-local (no further collective).
+
+    Returns fn: sharded chunk -> (sharded state, overflow, max_groups)
+    where max_groups is the largest per-device live-group count (for the
+    host's state-compaction decision)."""
+    n = mesh.shape[axis]
+    nkeys = len(agg.grouping)
+
+    def step(local: ColumnarBatch):
+        if pre is not None:
+            local = pre(local)
+        state = agg._update_kernel(local)
+        bucket = key_buckets(list(state.columns[:nkeys]), state.sel, n)
+        if use_allgather:
+            gathered = exchange_by_bucket(state, bucket, axis)
+            overflow = jnp.int32(0)
+        else:
+            q = quota if quota is not None \
+                else default_quota(state.capacity, n)
+            gathered, overflow = exchange_compact(state, bucket, q, axis)
+        merged = agg._merge_kernel(gathered)
+        ng = jax.lax.pmax(jnp.sum(merged.sel.astype(jnp.int32)), axis)
+        return merged, overflow, ng
+
+    return shard_map(step, mesh=mesh, in_specs=(P(axis),),
+                     out_specs=(P(axis), P(), P()))
+
+
+def distributed_aggregate_combine_step(agg, mesh: Mesh,
+                                       axis: str = DATA_AXIS):
+    """Cross-chunk state merge, device-local: concat the running state with
+    a chunk's partial state and re-merge.  Returns fn:
+    (state, partial) -> (merged state at concat capacity, max_groups)."""
+    def step(a: ColumnarBatch, b: ColumnarBatch):
+        merged = agg._merge_kernel(_concat_local(a, b, agg._state_schema))
+        ng = jax.lax.pmax(jnp.sum(merged.sel.astype(jnp.int32)), axis)
+        return merged, ng
+
+    return shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
+                     out_specs=(P(axis), P()))
+
+
+def distributed_shrink_step(mesh: Mesh, new_local_cap: int,
+                            axis: str = DATA_AXIS):
+    """Compact a state batch down to `new_local_cap` rows per device (live
+    groups are front-compacted by the merge kernel, so a prefix slice is
+    lossless once new_local_cap >= every device's live count)."""
+    def step(state: ColumnarBatch):
+        idx = jnp.arange(new_local_cap, dtype=jnp.int32)
+        cols = [c.take(idx) for c in state.columns]
+        return ColumnarBatch(cols, jnp.take(state.sel, idx), state.schema)
+
+    return shard_map(step, mesh=mesh, in_specs=(P(axis),),
+                     out_specs=P(axis))
+
+
+def distributed_finalize_step(agg, mesh: Mesh, axis: str = DATA_AXIS):
+    def step(state: ColumnarBatch):
+        return agg._finalize_kernel(state)
+    return shard_map(step, mesh=mesh, in_specs=(P(axis),),
+                     out_specs=P(axis))
+
+
+def run_distributed_aggregate_streaming(agg, mesh: Mesh, chunks,
+                                        pre=None, axis: str = DATA_AXIS,
+                                        use_allgather: bool = False,
+                                        cache_key=None):
+    """Host driver: stream sharded input chunks through the mesh.
+
+    Per chunk: partial step (update/exchange/merge) with quota
+    overflow-retry; then a device-local combine with the running state;
+    then, when the running state's capacity is far above its live-group
+    count, a prefix-slice compaction (one host sync per chunk reads the
+    max group count).  Peak device memory is one chunk + the compacted
+    state — never the whole input (reference: partial/final agg pair
+    streams batches through the shuffle the same way).  Returns the
+    finalized sharded result, or None for empty input."""
+    from ..columnar.batch import bucket_rows
+    n = mesh.shape[axis]
+    state = None
+    state_ng = 0
+    for chunk in chunks:
+        local_cap = chunk.capacity // n
+        quota = None if use_allgather else default_quota(local_cap, n)
+        while True:
+            ck = None if cache_key is None else \
+                cache_key + ("spartial", n, local_cap, quota, use_allgather)
+            pstep = _jit_step(
+                lambda: distributed_aggregate_partial_step(
+                    agg, mesh, axis=axis, pre=pre, quota=quota,
+                    use_allgather=use_allgather), ck)
+            with mesh:
+                partial, overflow, ng = pstep(chunk)
+            if use_allgather or int(overflow) == 0:
+                break
+            quota = min(local_cap, quota * 2)
+        if state is None:
+            state, state_ng = partial, int(ng)
+        else:
+            a_cap = state.capacity // n
+            b_cap = partial.capacity // n
+            ck = None if cache_key is None else \
+                cache_key + ("scombine", n, a_cap, b_cap)
+            cstep = _jit_step(
+                lambda: distributed_aggregate_combine_step(agg, mesh, axis),
+                ck)
+            with mesh:
+                state, ng = cstep(state, partial)
+            state_ng = int(ng)
+        # compact: keep the state near its live size so capacity doesn't
+        # grow with chunk COUNT when the group count is small
+        state_local = state.capacity // n
+        target = bucket_rows(max(state_ng, 1))
+        if target < state_local:
+            ck = None if cache_key is None else \
+                cache_key + ("sshrink", n, state_local, target)
+            sstep = _jit_step(
+                lambda: distributed_shrink_step(mesh, target, axis), ck)
+            with mesh:
+                state = sstep(state)
+    if state is None:
+        return None
+    ck = None if cache_key is None else \
+        cache_key + ("sfinal", n, state.capacity // n)
+    fstep = _jit_step(lambda: distributed_finalize_step(agg, mesh, axis),
+                      ck)
+    with mesh:
+        return fstep(state)
+
+
+# ---------------------------------------------------------------------------
 # join
 # ---------------------------------------------------------------------------
 
@@ -332,6 +496,132 @@ def run_distributed_join(join, mesh: Mesh, left: ColumnarBatch,
             retry = True
         if not retry:
             return out
+
+
+def distributed_join_build_exchange_step(join, mesh: Mesh, quota_right: int,
+                                         axis: str = DATA_AXIS,
+                                         use_allgather: bool = False):
+    """Exchange the BUILD side by join-key hash once; the exchanged batch
+    stays mesh-resident for every probe chunk (the reference keeps the
+    built hash table across stream batches the same way,
+    GpuShuffledHashJoinExec.scala:83-87)."""
+    n = mesh.shape[axis]
+
+    def step(lright: ColumnarBatch):
+        rkey_cols = [e.eval(lright) for e in join.right_keys]
+        rbucket = key_buckets(rkey_cols, lright.sel, n)
+        if use_allgather:
+            rex = exchange_by_bucket(lright, rbucket, axis)
+            rovf = jnp.int32(0)
+        else:
+            rex, rovf = exchange_compact(lright, rbucket, quota_right, axis)
+        return rex, jax.lax.psum(rovf, axis)
+
+    return shard_map(step, mesh=mesh, in_specs=(P(axis),),
+                     out_specs=(P(axis), P()))
+
+
+def distributed_join_probe_step(join, mesh: Mesh, max_dup: int,
+                                out_cap: int, quota_left: int,
+                                axis: str = DATA_AXIS,
+                                use_allgather: bool = False):
+    """Per-chunk probe: exchange one STREAM-side chunk by key hash and join
+    it against the resident exchanged build side.  Correct per chunk for
+    inner/left/left_semi/left_anti because each left row's result depends
+    only on the build side."""
+    n = mesh.shape[axis]
+
+    def step(lleft: ColumnarBatch, rex: ColumnarBatch):
+        lkey_cols = [e.eval(lleft) for e in join.left_keys]
+        lbucket = key_buckets(lkey_cols, lleft.sel, n)
+        if use_allgather:
+            lex = exchange_by_bucket(lleft, lbucket, axis)
+            lovf = jnp.int32(0)
+        else:
+            lex, lovf = exchange_compact(lleft, lbucket, quota_left, axis)
+        build, bkeys, h1s = join._build_kernel(rex)
+        lo, hi, max_dup_t = join._window_kernel(lex, h1s)
+        dup_overflow = jnp.maximum(max_dup_t.astype(jnp.int32) - max_dup, 0)
+        counts, starts, total = join._count_kernel(
+            max_dup, lex, build, bkeys, lo, hi, vary_axes=(axis,))
+        if join.join_type in ("left_semi", "left_anti"):
+            out = join._semi_kernel(lex, counts)
+            out = ColumnarBatch(out.columns, out.sel, join._schema)
+            cap_overflow = jnp.int32(0)
+        else:
+            out = join._gather_kernel(max_dup, out_cap, lex, build, bkeys,
+                                      lo, hi, counts, starts, total,
+                                      vary_axes=(axis,))
+            cap_overflow = jnp.maximum(total.astype(jnp.int32) - out_cap, 0)
+        return (out, jax.lax.psum(lovf, axis),
+                jax.lax.psum(dup_overflow, axis),
+                jax.lax.psum(cap_overflow, axis))
+
+    return shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
+                     out_specs=(P(axis), P(), P(), P()))
+
+
+def run_distributed_join_streaming(join, mesh: Mesh, left_chunks,
+                                   right: ColumnarBatch,
+                                   axis: str = DATA_AXIS, max_dup: int = 8,
+                                   out_cap=None,
+                                   use_allgather: bool = False,
+                                   cache_key=None):
+    """Host driver: exchange the build side once (quota overflow-retry),
+    then stream probe chunks through the mesh, yielding one sharded output
+    batch per chunk.  Retry knobs (left quota / dup window / out capacity)
+    warm up across chunks, so steady state is one dispatch per chunk."""
+    n = mesh.shape[axis]
+    rcap = right.capacity // n
+    quota_r = default_quota(rcap, n)
+    while True:
+        ck = None if cache_key is None else \
+            cache_key + ("jbuild", n, rcap, quota_r, use_allgather)
+        bstep = _jit_step(
+            lambda: distributed_join_build_exchange_step(
+                join, mesh, quota_r, axis=axis,
+                use_allgather=use_allgather), ck)
+        with mesh:
+            rex, rovf = bstep(right)
+        if use_allgather or int(rovf) == 0:
+            break
+        if quota_r >= rcap:  # pragma: no cover - cap always fits
+            raise AssertionError("right exchange overflow at full quota")
+        quota_r = min(rcap, quota_r * 2)
+
+    quota_l = None
+    for chunk in left_chunks:
+        lcap = chunk.capacity // n
+        if quota_l is None or quota_l > lcap:
+            quota_l = default_quota(lcap, n)
+        if out_cap is None:
+            out_cap = max(n * quota_l, 1024)
+        while True:
+            ck = None if cache_key is None else \
+                cache_key + ("jprobe", n, lcap, rcap, max_dup, out_cap,
+                             quota_l, quota_r, use_allgather)
+            pstep = _jit_step(
+                lambda: distributed_join_probe_step(
+                    join, mesh, max_dup, out_cap, quota_l, axis=axis,
+                    use_allgather=use_allgather), ck)
+            with mesh:
+                out, l_ovf, dup_ovf, cap_ovf = pstep(chunk, rex)
+            retry = False
+            if not use_allgather and int(l_ovf) > 0:
+                if quota_l >= lcap:  # pragma: no cover - cap always fits
+                    raise AssertionError(
+                        "left exchange overflow at full quota")
+                quota_l = min(lcap, quota_l * 2)
+                retry = True
+            if int(dup_ovf) > 0:
+                max_dup = pow2_bucket(max_dup + int(dup_ovf))
+                retry = True
+            if int(cap_ovf) > 0:
+                out_cap = out_cap * 2
+                retry = True
+            if not retry:
+                break
+        yield out
 
 
 # ---------------------------------------------------------------------------
