@@ -279,4 +279,26 @@ int64_t csv_tokenize(const uint8_t* data, int64_t n, uint8_t sep,
   return nf;
 }
 
+// Parquet PLAIN BYTE_ARRAY layout scan: [u32-le length][bytes]... -> value
+// offsets/lengths.  The walk is inherently sequential (each length
+// determines the next offset), which is exactly the scalar control-plane
+// work the host keeps while the device gathers the payload bytes
+// (io/parquet_device.py).  Returns bytes consumed, or -1 on truncation.
+int64_t pq_byte_array_scan(const uint8_t* data, int64_t n, int64_t n_values,
+                           int64_t* offsets, int64_t* lens) {
+  int64_t pos = 0;
+  for (int64_t v = 0; v < n_values; ++v) {
+    if (pos + 4 > n) return -1;
+    uint32_t ln = (uint32_t)data[pos] | ((uint32_t)data[pos + 1] << 8) |
+                  ((uint32_t)data[pos + 2] << 16) |
+                  ((uint32_t)data[pos + 3] << 24);
+    pos += 4;
+    if (pos + (int64_t)ln > n) return -1;
+    offsets[v] = pos;
+    lens[v] = (int64_t)ln;
+    pos += ln;
+  }
+  return pos;
+}
+
 }  // extern "C"

@@ -21,8 +21,10 @@ Scope (planner falls back to the pyarrow host path otherwise, like the
 reference's fallback flags): PLAIN / RLE_DICTIONARY(+PLAIN_DICTIONARY) /
 DELTA_BINARY_PACKED (ints) / BYTE_STREAM_SPLIT (floats+ints) encodings,
 UNCOMPRESSED or pyarrow-supported codecs, flat non-nested columns of
-INT32/INT64/FLOAT/DOUBLE/BOOLEAN (+BYTE_ARRAY via dictionaries), data
-page v1/v2.
+INT32/INT64/FLOAT/DOUBLE/BOOLEAN/BYTE_ARRAY (strings both
+dictionary-encoded AND plain: the host scans the length-prefixed layout
+into offsets — a native single pass — and the device gathers the payload
+bytes into the padded matrix), data page v1/v2.
 """
 from __future__ import annotations
 
@@ -535,6 +537,65 @@ def _bss_decode(payload: bytes, n_values: int, phys: str, cap: int):
     return fn(jnp.asarray(raw), jnp.int64(n_values))
 
 
+def _scan_plain_byte_array(payload: bytes, n: int):
+    """PLAIN BYTE_ARRAY page body -> (payload u8 array, offsets, lengths).
+    The sequential length-prefix walk is host control-plane work (native
+    single pass, python fallback); the payload bytes go to the device
+    gather untouched."""
+    from ..native import pq_byte_array_scan
+    arr = np.frombuffer(payload, dtype=np.uint8)
+    res = pq_byte_array_scan(arr, n)
+    if res is not None:
+        return arr, res[0], res[1]
+    offs = np.empty(n, np.int64)
+    lens = np.empty(n, np.int64)
+    pos = 0
+    for i in range(n):
+        if pos + 4 > len(payload):
+            raise DeviceDecodeUnsupported("truncated byte_array page")
+        ln = int.from_bytes(payload[pos:pos + 4], "little")
+        pos += 4
+        if pos + ln > len(payload):
+            raise DeviceDecodeUnsupported("truncated byte_array value")
+        offs[i] = pos
+        lens[i] = ln
+        pos += ln
+    return arr, offs, lens
+
+
+def _byte_array_gather(payload: np.ndarray, offsets: np.ndarray,
+                       lengths: np.ndarray, cap: int, width: int):
+    """Device gather of length-prefixed values into a padded byte matrix:
+    mat[i, j] = payload[offsets[i] + j] masked to j < lengths[i].
+    The payload is padded to a power-of-two bucket so the kernel-cache
+    key space stays bounded across pages (raw page sizes are
+    data-dependent and would force one compile per page)."""
+    n = len(offsets)
+    offs = np.zeros(cap, np.int32)
+    offs[:n] = offsets
+    lens = np.zeros(cap, np.int32)
+    lens[:n] = lengths
+    from ..utils import pow2_bucket
+    pcap = pow2_bucket(max(int(payload.size), 1))
+    if payload.size < pcap:
+        payload = np.concatenate(
+            [payload, np.zeros(pcap - payload.size, np.uint8)])
+
+    def build():
+        def k(buf, o, ln):
+            j = jnp.arange(width, dtype=jnp.int32)[None, :]
+            idx = o[:, None] + j
+            mat = jnp.take(buf, jnp.clip(idx, 0, buf.shape[0] - 1),
+                           mode="clip")
+            return jnp.where(j < ln[:, None], mat,
+                             jnp.zeros((), jnp.uint8))
+        return k
+
+    lens_dev = jnp.asarray(lens)
+    fn = cached_kernel(("pq_ba_gather", cap, width, pcap), build)
+    return fn(jnp.asarray(payload), jnp.asarray(offs), lens_dev), lens_dev
+
+
 def _parse_byte_array_dict(data: bytes, n: int):
     """PLAIN byte_array dictionary page -> (byte matrix [n_cap, L],
     lengths [n_cap]) as numpy.  The dictionary is the SMALL side of a
@@ -695,40 +756,66 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
     valid_host[:num_rows] = valid_np
 
     if phys == "BYTE_ARRAY":
-        # dictionary-encoded strings only: PLAIN byte_array needs a
-        # sequential host offset walk over the full payload — that IS the
-        # pyarrow fallback, so don't duplicate it here
         if not dtype.is_string:
             raise DeviceDecodeUnsupported("byte_array into non-string")
-        if any(kind != "dict" for kind, _, _ in value_pieces):
-            raise DeviceDecodeUnsupported("plain byte_array page")
-        if dict_values is None:
-            raise DeviceDecodeUnsupported("dict page missing")
-        dmat, dlens = dict_values
-        cidx = jnp.zeros(vcap, dtype=jnp.int32)
+        from ..columnar.column import bucket_strlen
+        # PLAIN pages: host scans the length-prefixed layout into
+        # offsets/lengths (native single pass, the CSV-tokenizer split);
+        # dictionary pages decode via index gather.  Mixed pages (writers
+        # fall back to PLAIN when the dictionary overflows) compose.
+        scans = []
+        max_len = 1
+        for kind, payload, nonnull in value_pieces:
+            if kind == "plain":
+                arr, offs, lens = _scan_plain_byte_array(payload, nonnull)
+                scans.append((arr, offs, lens))
+                if nonnull:
+                    max_len = max(max_len, int(lens[:nonnull].max()))
+            elif kind == "dict":
+                if dict_values is None:
+                    raise DeviceDecodeUnsupported("dict page missing")
+                scans.append(None)
+                max_len = max(max_len, int(dict_values[0].shape[1]))
+            else:
+                raise DeviceDecodeUnsupported(f"byte_array via {kind}")
+        width = bucket_strlen(max_len)
+        cmat = jnp.zeros((vcap, width), dtype=jnp.uint8)
+        clen = jnp.zeros(vcap, dtype=jnp.int32)
         off = 0
-        for _kind, payload, nonnull in value_pieces:
+        for (kind, payload, nonnull), scan in zip(value_pieces, scans):
             if nonnull == 0:
                 continue
-            idx = _indices_decode(payload, nonnull, bucket_rows(nonnull))
-            cidx = _copy_range(cidx, idx, off, nonnull)
+            pcap = bucket_rows(nonnull)
+            if kind == "dict":
+                dmat, dlens = dict_values
+                if int(dmat.shape[1]) < width:
+                    dmat = jnp.pad(dmat,
+                                   ((0, 0), (0, width - dmat.shape[1])))
+                idx = _indices_decode(payload, nonnull, pcap)
+                pmat = jnp.take(dmat, idx, axis=0, mode="clip")
+                plen = jnp.take(dlens, idx, mode="clip").astype(jnp.int32)
+            else:
+                arr, offs, lens = scan
+                pmat, plen = _byte_array_gather(arr, offs, lens, pcap,
+                                                width)
+            cmat = _copy_range(cmat, pmat, off, nonnull)
+            clen = _copy_range(clen, plen, off, nonnull)
             off += nonnull
 
         def build_sexpand():
-            def k(di, dm, dln, valid_v):
+            def k(cm, cl, valid_v):
                 vi = jnp.cumsum(valid_v.astype(jnp.int32)) - 1
-                row_idx = jnp.take(di, jnp.clip(vi, 0, di.shape[0] - 1),
-                                   mode="clip")
-                data2 = jnp.take(dm, row_idx, axis=0, mode="clip")
-                lens2 = jnp.take(dln, row_idx, mode="clip")
-                data2 = jnp.where(valid_v[:, None], data2, 0)
+                ridx = jnp.clip(vi, 0, cm.shape[0] - 1)
+                data2 = jnp.take(cm, ridx, axis=0, mode="clip")
+                lens2 = jnp.take(cl, ridx, mode="clip")
+                data2 = jnp.where(valid_v[:, None], data2,
+                                  jnp.zeros((), jnp.uint8))
                 lens2 = jnp.where(valid_v, lens2, 0)
                 return data2, lens2
             return k
 
-        fn = cached_kernel(("pq_sexpand", vcap, cap, dmat.shape),
-                           build_sexpand)
-        data2, lens2 = fn(cidx, dmat, dlens, valid_host)
+        fn = cached_kernel(("pq_sexpand", vcap, cap, width), build_sexpand)
+        data2, lens2 = fn(cmat, clen, valid_host)
         return Column(data2, jnp.asarray(valid_host), dtype, lens2)
 
     # assemble compact (non-null) value array on device

@@ -81,6 +81,10 @@ def get_lib():
                                      ctypes.c_uint8, ctypes.c_void_p,
                                      ctypes.c_void_p, ctypes.c_void_p,
                                      ctypes.c_int64]
+        lib.pq_byte_array_scan.restype = ctypes.c_int64
+        lib.pq_byte_array_scan.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                           ctypes.c_int64, ctypes.c_void_p,
+                                           ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -247,3 +251,21 @@ def csv_tokenize(data: np.ndarray, sep: int):
     if nf < 0:
         return None
     return starts[:nf], lens[:nf], flags[:nf], int(nf)
+
+
+def pq_byte_array_scan(data: np.ndarray, n_values: int):
+    """Scan a parquet PLAIN BYTE_ARRAY page body into (offsets, lengths)
+    int64 arrays (offsets point past each value's u32 length prefix).
+    Returns None when the native library is unavailable or the page is
+    truncated — the caller then walks the layout in python or falls back."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    d = np.ascontiguousarray(data, dtype=np.uint8)
+    offsets = np.empty(n_values, dtype=np.int64)
+    lens = np.empty(n_values, dtype=np.int64)
+    consumed = lib.pq_byte_array_scan(d.ctypes.data, d.size, n_values,
+                                      offsets.ctypes.data, lens.ctypes.data)
+    if consumed < 0:
+        return None
+    return offsets, lens

@@ -279,3 +279,58 @@ def test_byte_stream_split_decode(tmp_path):
     dev = TpuSession({})
     assert_rows_equal(q(cpu).collect(), q(dev).collect(),
                       ignore_order=False, approx_float=True)
+
+
+def test_plain_byte_array_strings_decode_on_device(tmp_path):
+    """VERDICT r3 item 6: un-dictionaried (PLAIN) BYTE_ARRAY strings must
+    decode device-side — host scans the length-prefixed layout into
+    offsets (native pq_byte_array_scan), the device gathers the padded
+    byte matrix."""
+    p = str(tmp_path / "t.parquet")
+    rng = np.random.RandomState(3)
+    vals = [None if rng.rand() < 0.1
+            else "x" * int(rng.randint(0, 40)) + str(int(x))
+            for x in rng.randint(0, 10**9, 3000)]
+    t = pa.table({"s": pa.array(vals), "v": rng.uniform(0, 1, 3000)})
+    pq.write_table(t, p, compression="NONE", use_dictionary=False)
+
+    s = TpuSession()
+    node = s.plan(s.read.parquet(p).plan)
+    from spark_rapids_tpu.exec.base import ExecContext
+    batches = list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
+    assert batches
+
+    def find_scan(n):
+        if type(n).__name__ == "TpuFileScanExec":
+            return n
+        for c in n.children:
+            r = find_scan(c)
+            if r:
+                return r
+    scan = find_scan(node)
+    # BOTH columns device-decoded: the string column no longer falls back
+    assert scan.metrics.values.get("numDeviceDecodedColumns", 0) >= 2, \
+        scan.metrics.values
+
+    got = [r[0] for b in batches for r in b.to_pylist()]
+    assert got == vals
+
+
+def test_mixed_plain_and_dict_string_pages(tmp_path):
+    """Writers switch to PLAIN mid-column when the dictionary overflows;
+    both page kinds must compose in one chunk."""
+    rng = np.random.RandomState(4)
+    # low-cardinality head (dictionary) then high-cardinality tail (PLAIN
+    # after dict overflow, forced by a tiny dictionary_pagesize_limit)
+    vals = ([f"k{int(x)}" for x in rng.randint(0, 8, 1500)]
+            + [f"u{int(x)}" for x in rng.randint(0, 10**9, 1500)])
+    t = pa.table({"s": pa.array(vals)})
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(t, p, compression="NONE", use_dictionary=True,
+                   dictionary_pagesize_limit=2048)
+
+    s = TpuSession()
+    got = [r[0] for r in s.read.parquet(p).collect()]
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    want = [r[0] for r in cpu.read.parquet(p).collect()]
+    assert got == want == vals
