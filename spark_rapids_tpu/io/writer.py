@@ -152,14 +152,22 @@ class TpuDataWritingExec(TpuExec):
 
     def _device_encode_ok(self, ctx) -> bool:
         from .. import config as C
-        from .parquet_device_write import _TYPE_MAP
-        # codecs beyond snappy/uncompressed (gzip, zstd, ...) only exist in
-        # the host arrow encoder — fall back rather than silently writing
-        # uncompressed
-        return (self.fmt == "parquet" and not self.partition_by
-                and self._codec() in ("snappy", "none", "uncompressed")
-                and ctx.conf.get(C.PARQUET_DEVICE_ENCODE)
-                and all(f.dtype in _TYPE_MAP for f in self.schema))
+        if self.partition_by:
+            return False
+        if self.fmt == "parquet":
+            from .parquet_device_write import _TYPE_MAP
+            # codecs beyond snappy/uncompressed (gzip, zstd, ...) only
+            # exist in the host arrow encoder — fall back rather than
+            # silently writing uncompressed
+            return (self._codec() in ("snappy", "none", "uncompressed")
+                    and ctx.conf.get(C.PARQUET_DEVICE_ENCODE)
+                    and all(f.dtype in _TYPE_MAP for f in self.schema))
+        if self.fmt == "orc":
+            from .orc_device_write import ORC_ENCODABLE
+            return (bool(ctx.conf.get(C.ORC_DEVICE_ENCODE))
+                    and all(f.dtype in ORC_ENCODABLE
+                            for f in self.schema))
+        return False
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         core = _WriterCore(self.path, self.fmt, self.options,
@@ -170,11 +178,17 @@ class TpuDataWritingExec(TpuExec):
             with self.metrics.timer("writeTime"):
                 if device_encode:
                     # reference shape: encode on device, stream host
-                    # buffers out (GpuParquetFileFormat.scala:192-214);
-                    # the _codec() helper is the ONE normalization point
-                    # shared with the gate, so they can never disagree
-                    from .parquet_device_write import encode_parquet_file
-                    data = encode_parquet_file(batch, self._codec())
+                    # buffers out (GpuParquetFileFormat.scala:192-214,
+                    # GpuOrcFileFormat.scala:1-164); the _codec() helper
+                    # is the ONE normalization point shared with the
+                    # gate, so they can never disagree
+                    if self.fmt == "orc":
+                        from .orc_device_write import encode_orc_file
+                        data = encode_orc_file(batch)
+                    else:
+                        from .parquet_device_write import (
+                            encode_parquet_file)
+                        data = encode_parquet_file(batch, self._codec())
                     core.write_encoded(data, batch.num_rows_host())
                     self.metrics.add("numDeviceEncodedFiles", 1)
                 else:

@@ -1,0 +1,150 @@
+"""Device ORC ENCODE tests (io/orc_device_write.py, VERDICT r3 item 5).
+
+Round-trip model mirrors the parquet encoder's tests: write with the
+device encoder, read back with (a) plain pyarrow, (b) both engines'
+readers (including this framework's own device ORC decoder), and compare
+against the host arrow encoder's rows.  Reference coverage model:
+GpuOrcFileFormat writes read back by Spark
+(sql-plugin/.../rapids/GpuOrcFileFormat.scala:1-164)."""
+import datetime
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from compare import assert_rows_equal  # noqa: E402
+from spark_rapids_tpu import types as T  # noqa: E402
+from spark_rapids_tpu.engine import TpuSession  # noqa: E402
+from spark_rapids_tpu.plan.logical import col  # noqa: E402
+
+SCHEMA = T.schema_of(i=T.IntegerType, l=T.LongType, f=T.FloatType,
+                     d=T.DoubleType, s=T.StringType, b=T.BooleanType,
+                     dt=T.DateType)
+
+
+def make_data(n=500, seed=11):
+    rng = np.random.RandomState(seed)
+
+    def maybe(vals):
+        return [None if rng.rand() < 0.15 else v for v in vals]
+    return {
+        "i": maybe(rng.randint(-2**31, 2**31, n).tolist()),
+        "l": maybe(rng.randint(-2**62, 2**62, n).tolist()),
+        "f": maybe(np.round(rng.randn(n), 3).tolist()),
+        "d": maybe((rng.randn(n) * 1e6).tolist()),
+        "s": maybe([f"value-{i}-{'x' * (i % 17)}" for i in range(n)]),
+        "b": maybe((rng.rand(n) < 0.5).tolist()),
+        "dt": maybe(rng.randint(-30000, 30000, n).tolist()),
+    }
+
+
+def _one_file(d):
+    files = [f for f in os.listdir(d) if f.endswith(".orc")]
+    assert len(files) == 1, files
+    return os.path.join(d, files[0])
+
+
+def test_pyarrow_reads_device_encoded_orc(tmp_path):
+    from pyarrow import orc as paorc
+    data = make_data()
+    s = TpuSession()
+    s.from_pydict(data, SCHEMA).write.orc(str(tmp_path / "t"))
+    got = paorc.ORCFile(_one_file(str(tmp_path / "t"))).read()
+    for name in SCHEMA.names:
+        want = data[name]
+        if name == "f":  # float32 storage rounds the python doubles
+            want = [None if v is None else float(np.float32(v))
+                    for v in want]
+        have = got.column(name).to_pylist()
+        if name == "dt":  # arrow materializes date32 as datetime.date
+            have = [None if v is None else (v - _EPOCH).days for v in have]
+        assert have == want, name
+
+
+def test_device_encode_round_trip_both_engines(tmp_path):
+    data = make_data(seed=12)
+    dev = TpuSession()
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    dev.from_pydict(data, SCHEMA).write.orc(str(tmp_path / "dev"))
+    cpu.from_pydict(data, SCHEMA).write.orc(str(tmp_path / "cpu"))
+    want = cpu.read.orc(str(tmp_path / "cpu")).collect()
+    via_dev_reader = dev.read.orc(str(tmp_path / "dev")).collect()
+    via_cpu_reader = cpu.read.orc(str(tmp_path / "dev")).collect()
+    assert_rows_equal(want, via_dev_reader, ignore_order=True,
+                      approx_float=True)
+    assert_rows_equal(want, via_cpu_reader, ignore_order=True,
+                      approx_float=True)
+
+
+def test_device_encode_was_actually_used(tmp_path):
+    """The write metric proves the device encoder ran (not the host arrow
+    fallback)."""
+    from spark_rapids_tpu.exec.base import ExecContext
+    s = TpuSession()
+    df = s.from_pydict(make_data(100), SCHEMA)
+    # drive the write exec directly so its metrics are inspectable
+    from spark_rapids_tpu.plan import logical as L
+    node = s.plan(L.LogicalWrite(str(tmp_path / "t"), "orc", df.plan))
+    list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
+    assert node.metrics.values.get("numDeviceEncodedFiles", 0) == 1, \
+        node.metrics.values
+
+
+def test_own_stripe_stats_pruning_on_own_files(tmp_path):
+    """The encoder writes the Metadata section; this framework's stripe
+    statistics pruning must parse its own output."""
+    from spark_rapids_tpu.io.orc_device import OrcFileInfo
+    from spark_rapids_tpu.io.scan import _orc_stats_can_match
+    s = TpuSession()
+    data = {"k": list(range(1000)), "v": [float(i) for i in range(1000)]}
+    sch = T.schema_of(k=T.LongType, v=T.DoubleType)
+    s.from_pydict(data, sch).write.orc(str(tmp_path / "t"))
+    fi = OrcFileInfo(_one_file(str(tmp_path / "t")))
+    stats = fi.stripe_stats()
+    assert stats is not None and len(stats) == 1
+    assert stats[0][fi.columns["k"][0]] == (0, 999)
+    assert not _orc_stats_can_match(stats[0], fi.columns,
+                                    [("k", "GreaterThan", 5000)])
+
+
+def test_timestamp_falls_back_to_host(tmp_path):
+    """Timestamps are outside the device encoder's scope: the write must
+    fall back (and still round-trip)."""
+    from spark_rapids_tpu.exec.base import ExecContext
+    from spark_rapids_tpu.plan import logical as L
+    s = TpuSession()
+    data = {"ts": [1_000_000 * i for i in range(100)]}
+    sch = T.schema_of(ts=T.TimestampType)
+    df = s.from_pydict(data, sch)
+    node = s.plan(L.LogicalWrite(str(tmp_path / "t"), "orc", df.plan))
+    list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
+    assert node.metrics.values.get("numDeviceEncodedFiles", 0) == 0
+    got = s.read.orc(str(tmp_path / "t")).collect()
+    assert len(got) == 100
+
+
+def test_kill_switch_uses_host_encoder(tmp_path):
+    from spark_rapids_tpu.exec.base import ExecContext
+    from spark_rapids_tpu.plan import logical as L
+    s = TpuSession(
+        {"spark.rapids.sql.format.orc.deviceEncode.enabled": "false"})
+    df = s.from_pydict(make_data(50), SCHEMA)
+    node = s.plan(L.LogicalWrite(str(tmp_path / "t"), "orc", df.plan))
+    list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
+    assert node.metrics.values.get("numDeviceEncodedFiles", 0) == 0
+    got = s.read.orc(str(tmp_path / "t")).collect()
+    assert len(got) == 50
+
+
+def test_empty_and_all_null(tmp_path):
+    s = TpuSession()
+    data = {"a": [None] * 20, "b": [None] * 20}
+    sch = T.schema_of(a=T.LongType, b=T.StringType)
+    s.from_pydict(data, sch).write.orc(str(tmp_path / "nulls"))
+    got = s.read.orc(str(tmp_path / "nulls")).collect()
+    assert len(got) == 20 and all(r == (None, None) for r in got)
