@@ -203,7 +203,14 @@ class CpuWindowExec(CpuExec):
         arrays = [table.column(i) for i in range(table.num_columns)]
         names = list(table.column_names)
         for f in self.funcs:
-            arrays.append(pa.array(out[f.name], type=to_arrow(f.dtype)))
+            vals = out[f.name]
+            if f.dtype.is_integral:
+                # python-int accumulation is unbounded; Spark (non-ANSI)
+                # and the device path wrap at int64 — match them
+                vals = [None if v is None
+                        else (int(v) + 2**63) % 2**64 - 2**63
+                        for v in vals]
+            arrays.append(pa.array(vals, type=to_arrow(f.dtype)))
             names.append(f.name)
         yield pa.table(arrays, names=names)
 

@@ -232,12 +232,36 @@ def _frame_bounds(func: WindowFunc, iota, seg_first, seg_last, peer_last):
     return a, b
 
 
-def _prefix_sum_frame(vals_f, a, b):
-    """sum over rows [a, b] via padded prefix sums; empty frame -> 0."""
-    p = jnp.cumsum(vals_f)
+def _prefix_sum_frame(vals_f, a, b, seg_start=None):
+    """Sum over rows [a, b] via prefix sums; empty frame -> 0.
+
+    With `seg_start` the prefix sum is SEGMENTED (resets at every segment
+    start).  Frames never cross segment boundaries, and a global float
+    cumsum would let one segment's values poison every later frame's
+    subtraction — catastrophically (a 1e300 value absorbs everything
+    below ~1e284) or absorbingly (inf - inf = NaN).  Integer counts are
+    exact under wraparound, so callers may omit seg_start for them."""
+    if seg_start is None:
+        p = jnp.cumsum(vals_f)
+    else:
+        def comb(x, y):
+            vx, rx = x
+            vy, ry = y
+            return (jnp.where(ry, vy, vx + vy), rx | ry)
+        p, _ = jax.lax.associative_scan(comb, (vals_f, seg_start))
     p = jnp.concatenate([jnp.zeros(1, dtype=p.dtype), p])
     take = lambda idx: jnp.take(p, jnp.clip(idx, 0, p.shape[0] - 1))
-    return jnp.where(b >= a, take(b + 1) - take(a), jnp.zeros((), p.dtype))
+    if seg_start is None:
+        lower = take(a)
+    else:
+        # frames start no earlier than their own segment (a >= seg_first);
+        # when a IS the segment start the lower term is 0 — take(a) would
+        # be the PREVIOUS segment's tail, which the reset already excluded
+        # from take(b + 1)
+        a_c = jnp.clip(a, 0, seg_start.shape[0] - 1)
+        lower = jnp.where(jnp.take(seg_start, a_c),
+                          jnp.zeros((), p.dtype), take(a))
+    return jnp.where(b >= a, take(b + 1) - lower, jnp.zeros((), p.dtype))
 
 
 def eval_window_func(func: WindowFunc, sorted_batch: ColumnarBatch,
@@ -307,8 +331,29 @@ def eval_window_func(func: WindowFunc, sorted_batch: ColumnarBatch,
                                   and c.dtype.is_integral) else jnp.float64
         vals = jnp.where(c.valid, c.data.astype(acc_dtype),
                          jnp.zeros((), acc_dtype))
-        s = _prefix_sum_frame(vals, a, b)
         n = _prefix_sum_frame(c.valid.astype(jnp.int64), a, b)
+        if acc_dtype == jnp.float64:
+            # float sums are SEGMENTED (cross-segment cancellation: one
+            # huge value would absorb every later segment's values in a
+            # global cumsum) and split finite/non-finite: an inf/NaN
+            # inside the segment but OUTSIDE a bounded frame must not
+            # leak in via the prefix subtraction, so the IEEE result is
+            # rebuilt from per-frame counts of nan/+inf/-inf
+            finite = jnp.isfinite(vals)
+            s = _prefix_sum_frame(jnp.where(finite, vals, 0.0), a, b,
+                                  seg_start)
+            n_nan = _prefix_sum_frame(
+                jnp.isnan(vals).astype(jnp.int64), a, b)
+            n_pinf = _prefix_sum_frame(
+                (vals == jnp.inf).astype(jnp.int64), a, b)
+            n_ninf = _prefix_sum_frame(
+                (vals == -jnp.inf).astype(jnp.int64), a, b)
+            s = jnp.where(
+                (n_nan > 0) | ((n_pinf > 0) & (n_ninf > 0)), jnp.nan,
+                jnp.where(n_pinf > 0, jnp.inf,
+                          jnp.where(n_ninf > 0, -jnp.inf, s)))
+        else:
+            s = _prefix_sum_frame(vals, a, b)
         if func.kind == "Sum":
             return Column(s.astype(func.dtype.jnp_dtype), n > 0, func.dtype)
         avg = s.astype(jnp.float64) / jnp.maximum(n, 1).astype(jnp.float64)
