@@ -1,0 +1,170 @@
+"""TPCxBB-like data generator (structure-faithful, not bigbench-exact).
+
+Row counts scale with `sf` like the benchmark (web_clickstreams is the
+big fact; the reference's headline chart is SF10,000 on this schema).
+Foreign keys and the value domains Q5/Q16/Q21/Q22 filter on (category
+ids 1..7, the 2001-03-16 price-change window, the 2003 return chain, the
+2001-05-08 inventory window) are generated so each query selects a
+meaningful subset at tiny scale factors.  Reference counterpart:
+TpcxbbLikeSpark.scala:49-290 + the four charted *Like query objects."""
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
+              "Men", "Music", "Shoes", "Sports", "Women"]
+EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree",
+             "4 yr Degree", "Advanced Degree", "Unknown"]
+STATES = ["TN", "SD", "AL", "GA", "MI", "OH", "TX", "CA"]
+
+
+def generate(sf: float = 0.001, seed: int = 13):
+    """Returns {table_name: dict of column -> python list}."""
+    rng = np.random.RandomState(seed)
+    out = {}
+
+    start = datetime.date(2001, 1, 1)
+    end = datetime.date(2005, 12, 31)
+    n_days = (end - start).days + 1
+    dates = [start + datetime.timedelta(days=i) for i in range(n_days)]
+    first_sk = 36890
+    date_sks = np.arange(first_sk, first_sk + n_days)
+    out["date_dim"] = {
+        "d_date_sk": date_sks.tolist(),
+        "d_date": [(d - _EPOCH).days for d in dates],
+        "d_year": [d.year for d in dates],
+        "d_moy": [d.month for d in dates],
+    }
+
+    n_item = max(50, int(18_000 * sf))
+    cat_id = rng.randint(1, len(CATEGORIES) + 1, n_item)
+    out["item"] = {
+        "i_item_sk": list(range(1, n_item + 1)),
+        "i_item_id": [f"AAAAAAAA{i:08d}" for i in range(1, n_item + 1)],
+        "i_item_desc": [f"item description {i}" for i in range(n_item)],
+        "i_category": [CATEGORIES[c - 1] for c in cat_id],
+        "i_category_id": cat_id.tolist(),
+        "i_current_price": np.round(rng.uniform(0.5, 5.0, n_item),
+                                    2).tolist(),
+    }
+
+    n_cd = 70
+    combos = [(g, e) for g in ["M", "F"] for e in EDUCATION]
+    out["customer_demographics"] = {
+        "cd_demo_sk": list(range(1, n_cd + 1)),
+        "cd_gender": [combos[i % len(combos)][0] for i in range(n_cd)],
+        "cd_education_status": [combos[i % len(combos)][1]
+                                for i in range(n_cd)],
+    }
+
+    n_cust = max(40, int(100_000 * sf))
+    out["customer"] = {
+        "c_customer_sk": list(range(1, n_cust + 1)),
+        "c_current_cdemo_sk": rng.randint(1, n_cd + 1, n_cust).tolist(),
+    }
+
+    # the big fact: one row per click (reference SF10000 has ~26B)
+    n_wcs = max(500, int(5_000_000 * sf))
+    user = rng.randint(1, n_cust + 1, n_wcs).astype(object)
+    null_mask = rng.rand(n_wcs) < 0.05  # logged-out clicks
+    user[null_mask] = None
+    out["web_clickstreams"] = {
+        "wcs_user_sk": user.tolist(),
+        "wcs_item_sk": rng.randint(1, n_item + 1, n_wcs).tolist(),
+    }
+
+    n_store = max(4, int(1_002 * sf * 2))
+    out["store"] = {
+        "s_store_sk": list(range(1, n_store + 1)),
+        "s_store_id": [f"STORE{i:08d}" for i in range(1, n_store + 1)],
+        "s_store_name": [f"store {i}" for i in range(1, n_store + 1)],
+    }
+
+    n_ss = max(400, int(2_880_000 * sf))
+    n_tick = (n_ss + 3) // 4
+    per_tick = np.minimum(4, n_ss - 4 * np.arange(n_tick))
+
+    def per_ticket(vals):
+        return np.repeat(np.asarray(vals), per_tick)[:n_ss]
+    out["store_sales"] = {
+        "ss_sold_date_sk": per_ticket(rng.choice(date_sks,
+                                                 n_tick)).tolist(),
+        "ss_item_sk": rng.randint(1, n_item + 1, n_ss).tolist(),
+        "ss_store_sk": per_ticket(rng.randint(1, n_store + 1,
+                                              n_tick)).tolist(),
+        "ss_customer_sk": per_ticket(rng.randint(1, n_cust + 1,
+                                                 n_tick)).tolist(),
+        "ss_ticket_number": per_ticket(np.arange(1, n_tick + 1)).tolist(),
+        "ss_quantity": rng.randint(1, 100, n_ss).tolist(),
+    }
+
+    # returns reference sold tickets so Q21's chain resolves; returned
+    # within ~6 months of the sale
+    n_sr = max(100, int(287_000 * sf))
+    sr_pick = rng.randint(0, n_ss, n_sr)
+    sold = np.asarray(out["store_sales"]["ss_sold_date_sk"])[sr_pick]
+    out["store_returns"] = {
+        "sr_returned_date_sk": np.minimum(
+            sold + rng.randint(1, 180, n_sr),
+            int(date_sks[-1])).tolist(),
+        "sr_item_sk": [out["store_sales"]["ss_item_sk"][i]
+                       for i in sr_pick],
+        "sr_customer_sk": [out["store_sales"]["ss_customer_sk"][i]
+                           for i in sr_pick],
+        "sr_ticket_number": [out["store_sales"]["ss_ticket_number"][i]
+                             for i in sr_pick],
+        "sr_return_quantity": rng.randint(1, 20, n_sr).tolist(),
+    }
+
+    n_wh = max(3, int(20 * sf * 5))
+    out["warehouse"] = {
+        "w_warehouse_sk": list(range(1, n_wh + 1)),
+        "w_warehouse_name": [f"warehouse {i}" for i in range(1, n_wh + 1)],
+        "w_state": [STATES[i % len(STATES)] for i in range(n_wh)],
+    }
+
+    n_ws = max(300, int(720_000 * sf))
+    out["web_sales"] = {
+        "ws_sold_date_sk": rng.choice(date_sks, n_ws).tolist(),
+        "ws_item_sk": rng.randint(1, n_item + 1, n_ws).tolist(),
+        "ws_bill_customer_sk": rng.randint(1, n_cust + 1, n_ws).tolist(),
+        "ws_order_number": list(range(1, n_ws + 1)),
+        "ws_quantity": rng.randint(1, 100, n_ws).tolist(),
+        "ws_sales_price": np.round(rng.uniform(0.5, 300.0, n_ws),
+                                   2).tolist(),
+        "ws_warehouse_sk": rng.randint(1, n_wh + 1, n_ws).tolist(),
+    }
+
+    n_wr = max(60, int(72_000 * sf))
+    wr_pick = rng.randint(0, n_ws, n_wr)
+    out["web_returns"] = {
+        "wr_order_number": [out["web_sales"]["ws_order_number"][i]
+                            for i in wr_pick],
+        "wr_item_sk": [out["web_sales"]["ws_item_sk"][i]
+                       for i in wr_pick],
+        "wr_refunded_cash": np.round(rng.uniform(0.5, 200.0, n_wr),
+                                     2).tolist(),
+    }
+
+    # inventory snapshots around the Q22 price-change date (the spec has
+    # weekly snapshots for every item x warehouse; sample that grid)
+    n_inv = max(400, int(1_000_000 * sf))
+    out["inventory"] = {
+        "inv_date_sk": rng.choice(date_sks[:730], n_inv).tolist(),
+        "inv_item_sk": rng.randint(1, n_item + 1, n_inv).tolist(),
+        "inv_warehouse_sk": rng.randint(1, n_wh + 1, n_inv).tolist(),
+        "inv_quantity_on_hand": rng.randint(0, 1000, n_inv).tolist(),
+    }
+    return out
+
+
+def load_tables(session, sf: float = 0.001, seed: int = 13):
+    """{name: DataFrame} on the given session."""
+    from .schema import SCHEMAS
+    data = generate(sf, seed)
+    return {name: session.from_pydict(data[name], SCHEMAS[name])
+            for name in SCHEMAS}

@@ -1,0 +1,132 @@
+"""The reference's four CHARTED TPCxBB-like queries in this repo's DSL —
+the headline benchmark (reference README.md:7-15: Q5 19.8x, Q16 5.3x,
+Q21 12.7x, Q22 27.1x on SF10,000).  Behavior follows
+TpcxbbLikeSpark.scala's SQL (Q5Like:809-864, Q16Like:1377-1417,
+Q21Like:1542-1628, Q22Like:1630-1682); each `qN(t)` takes
+{table_name: DataFrame} and returns a DataFrame.
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu.plan.logical import col, functions as F, lit
+
+
+def q5(t):
+    """Per-user clicks-in-category feature matrix joined to demographics
+    (the logistic-regression input; the ml handoff consumes the result)."""
+    clicks = (t["web_clickstreams"]
+              .filter(~col("wcs_user_sk").is_null())
+              .join(t["item"], on=col("wcs_item_sk") == col("i_item_sk")))
+    aggs = [F.sum(F.when(col("i_category") == "Books", 1).otherwise(0))
+            .alias("clicks_in_category")]
+    for c in range(1, 8):
+        aggs.append(F.sum(F.when(col("i_category_id") == c, 1)
+                          .otherwise(0)).alias(f"clicks_in_{c}"))
+    per_user = (clicks.group_by(col("wcs_user_sk")).agg(*aggs))
+    college = col("cd_education_status").isin(
+        "Advanced Degree", "College", "4 yr Degree", "2 yr Degree")
+    return (per_user
+            .join(t["customer"],
+                  on=col("wcs_user_sk") == col("c_customer_sk"))
+            .join(t["customer_demographics"],
+                  on=col("c_current_cdemo_sk") == col("cd_demo_sk"))
+            .select(col("clicks_in_category"),
+                    F.when(college, 1).otherwise(0)
+                    .alias("college_education"),
+                    F.when(col("cd_gender") == "M", 1).otherwise(0)
+                    .alias("male"),
+                    *[col(f"clicks_in_{c}") for c in range(1, 8)]))
+
+
+def q16(t):
+    """Sales impact of a price change: web sales net of refunds in the 30
+    days before/after 2001-03-16, by warehouse state and item."""
+    dd = t["date_dim"].filter(col("d_date").between("2001-02-14",
+                                                    "2001-04-15"))
+    net = col("ws_sales_price") - F.coalesce(col("wr_refunded_cash"),
+                                             lit(0.0))
+    return (t["web_sales"]
+            .join(t["web_returns"],
+                  on=(col("ws_order_number") == col("wr_order_number"))
+                  & (col("ws_item_sk") == col("wr_item_sk")), how="left")
+            .join(t["item"], on=col("ws_item_sk") == col("i_item_sk"))
+            .join(t["warehouse"],
+                  on=col("ws_warehouse_sk") == col("w_warehouse_sk"))
+            .join(dd, on=col("ws_sold_date_sk") == col("d_date_sk"))
+            .group_by(col("w_state"), col("i_item_id"))
+            .agg(F.sum(F.when(col("d_date") < "2001-03-16", net)
+                       .otherwise(0.0)).alias("sales_before"),
+                 F.sum(F.when(col("d_date") >= "2001-03-16", net)
+                       .otherwise(0.0)).alias("sales_after"))
+            .order_by(col("w_state"), col("i_item_id"))
+            .limit(100))
+
+
+def q21(t):
+    """Items sold in a month, returned within 6 months, re-purchased on
+    the web by the same customer — quantities by item and store."""
+    d1 = t["date_dim"].filter((col("d_year") == 2003)
+                              & (col("d_moy") == 1)) \
+        .select(col("d_date_sk").alias("d1_sk"))
+    d2 = t["date_dim"].filter((col("d_year") == 2003)
+                              & col("d_moy").between(1, 7)) \
+        .select(col("d_date_sk").alias("d2_sk"))
+    d3 = t["date_dim"].filter(col("d_year").between(2003, 2005)) \
+        .select(col("d_date_sk").alias("d3_sk"))
+    part_sr = (t["store_returns"]
+               .join(d2, on=col("sr_returned_date_sk") == col("d2_sk")))
+    part_ws = (t["web_sales"]
+               .join(d3, on=col("ws_sold_date_sk") == col("d3_sk"))
+               .select(col("ws_item_sk"), col("ws_bill_customer_sk"),
+                       col("ws_quantity")))
+    part_ss = (t["store_sales"]
+               .join(d1, on=col("ss_sold_date_sk") == col("d1_sk")))
+    return (part_sr
+            .join(part_ws,
+                  on=(col("sr_item_sk") == col("ws_item_sk"))
+                  & (col("sr_customer_sk") == col("ws_bill_customer_sk")))
+            .join(part_ss,
+                  on=(col("ss_ticket_number") == col("sr_ticket_number"))
+                  & (col("ss_item_sk") == col("sr_item_sk"))
+                  & (col("ss_customer_sk") == col("sr_customer_sk")))
+            .join(t["store"], on=col("s_store_sk") == col("ss_store_sk"))
+            .join(t["item"], on=col("i_item_sk") == col("ss_item_sk"))
+            .group_by(col("i_item_id"), col("i_item_desc"),
+                      col("s_store_id"), col("s_store_name"))
+            .agg(F.sum(col("ss_quantity")).alias("store_sales_quantity"),
+                 F.sum(col("sr_return_quantity"))
+                 .alias("store_returns_quantity"),
+                 F.sum(col("ws_quantity")).alias("web_sales_quantity"))
+            .order_by(col("i_item_id"), col("i_item_desc"),
+                      col("s_store_id"), col("s_store_name"))
+            .limit(100))
+
+
+def q22(t):
+    """Inventory change around a price change (2001-05-08 +/- 30 days) by
+    warehouse, for items in a price band; keep items whose after/before
+    ratio is within [2/3, 3/2]."""
+    it = t["item"].filter(col("i_current_price").between(0.98, 1.5))
+    dd = t["date_dim"].filter(col("d_date").between("2001-04-08",
+                                                    "2001-06-07"))
+    grouped = (t["inventory"]
+               .join(it, on=col("i_item_sk") == col("inv_item_sk"))
+               .join(t["warehouse"],
+                     on=col("inv_warehouse_sk") == col("w_warehouse_sk"))
+               .join(dd, on=col("inv_date_sk") == col("d_date_sk"))
+               .group_by(col("w_warehouse_name"), col("i_item_id"))
+               .agg(F.sum(F.when(col("d_date") < "2001-05-08",
+                                 col("inv_quantity_on_hand"))
+                          .otherwise(0)).alias("inv_before"),
+                    F.sum(F.when(col("d_date") >= "2001-05-08",
+                                 col("inv_quantity_on_hand"))
+                          .otherwise(0)).alias("inv_after")))
+    ratio = col("inv_after") / col("inv_before")
+    return (grouped
+            .filter((col("inv_before") > 0)
+                    & (ratio >= lit(2.0) / 3.0)
+                    & (ratio <= lit(3.0) / 2.0))
+            .order_by(col("w_warehouse_name"), col("i_item_id"))
+            .limit(100))
+
+
+QUERIES = {5: q5, 16: q16, 21: q21, 22: q22}

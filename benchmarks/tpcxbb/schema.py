@@ -1,0 +1,66 @@
+"""TPCxBB-like table schemas (the subset backing the reference's four
+charted queries Q5/Q16/Q21/Q22 — BASELINE.md headline: Q5 19.8x).
+Reference counterpart: TpcxbbLikeSpark.scala:49-290 (csv/parquet
+converters + table registration)."""
+from spark_rapids_tpu.types import (DateType, DoubleType, LongType, Schema,
+                                    StringType, StructField as F)
+
+DATE_DIM = Schema([
+    F("d_date_sk", LongType), F("d_date", DateType),
+    F("d_year", LongType), F("d_moy", LongType)])
+
+ITEM = Schema([
+    F("i_item_sk", LongType), F("i_item_id", StringType),
+    F("i_item_desc", StringType), F("i_category", StringType),
+    F("i_category_id", LongType), F("i_current_price", DoubleType)])
+
+CUSTOMER = Schema([
+    F("c_customer_sk", LongType), F("c_current_cdemo_sk", LongType)])
+
+CUSTOMER_DEMOGRAPHICS = Schema([
+    F("cd_demo_sk", LongType), F("cd_gender", StringType),
+    F("cd_education_status", StringType)])
+
+WEB_CLICKSTREAMS = Schema([
+    F("wcs_user_sk", LongType), F("wcs_item_sk", LongType)])
+
+STORE = Schema([
+    F("s_store_sk", LongType), F("s_store_id", StringType),
+    F("s_store_name", StringType)])
+
+STORE_SALES = Schema([
+    F("ss_sold_date_sk", LongType), F("ss_item_sk", LongType),
+    F("ss_store_sk", LongType), F("ss_customer_sk", LongType),
+    F("ss_ticket_number", LongType), F("ss_quantity", LongType)])
+
+STORE_RETURNS = Schema([
+    F("sr_returned_date_sk", LongType), F("sr_item_sk", LongType),
+    F("sr_customer_sk", LongType), F("sr_ticket_number", LongType),
+    F("sr_return_quantity", LongType)])
+
+WEB_SALES = Schema([
+    F("ws_sold_date_sk", LongType), F("ws_item_sk", LongType),
+    F("ws_bill_customer_sk", LongType), F("ws_order_number", LongType),
+    F("ws_quantity", LongType), F("ws_sales_price", DoubleType),
+    F("ws_warehouse_sk", LongType)])
+
+WEB_RETURNS = Schema([
+    F("wr_order_number", LongType), F("wr_item_sk", LongType),
+    F("wr_refunded_cash", DoubleType)])
+
+WAREHOUSE = Schema([
+    F("w_warehouse_sk", LongType), F("w_warehouse_name", StringType),
+    F("w_state", StringType)])
+
+INVENTORY = Schema([
+    F("inv_date_sk", LongType), F("inv_item_sk", LongType),
+    F("inv_warehouse_sk", LongType), F("inv_quantity_on_hand", LongType)])
+
+SCHEMAS = {
+    "date_dim": DATE_DIM, "item": ITEM, "customer": CUSTOMER,
+    "customer_demographics": CUSTOMER_DEMOGRAPHICS,
+    "web_clickstreams": WEB_CLICKSTREAMS, "store": STORE,
+    "store_sales": STORE_SALES, "store_returns": STORE_RETURNS,
+    "web_sales": WEB_SALES, "web_returns": WEB_RETURNS,
+    "warehouse": WAREHOUSE, "inventory": INVENTORY,
+}

@@ -1,0 +1,74 @@
+"""TPCxBB-like headline queries: CPU-vs-TPU oracle (the reference's
+charted benchmark — README.md:7-15: Q5 19.8x / Q16 5.3x / Q21 12.7x /
+Q22 27.1x on SF10,000; behavior from TpcxbbLikeSpark.scala's SQL)."""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchmarks.tpcxbb import QUERIES, generate, load_tables  # noqa: E402
+from compare import assert_rows_equal  # noqa: E402
+from spark_rapids_tpu.engine import TpuSession  # noqa: E402
+
+SF = 0.002
+
+
+def run_query(qnum: int, conf: dict):
+    s = TpuSession(conf)
+    tables = load_tables(s, sf=SF)
+    return QUERIES[qnum](tables).collect()
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpcxbb_query(qnum):
+    cpu = run_query(qnum, {"spark.rapids.sql.enabled": "false"})
+    tpu = run_query(qnum, {})
+    assert len(cpu) > 0, f"q{qnum} selected nothing"
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=True)
+
+
+def test_tpcxbb_all_device():
+    """Every headline query plans fully on-device with the bench conf."""
+    conf = {"spark.rapids.sql.variableFloatAgg.enabled": "true"}
+    for qnum in sorted(QUERIES):
+        s = TpuSession(dict(conf))
+        tables = load_tables(s, sf=SF)
+        plan = s.plan(QUERIES[qnum](tables).plan)
+        bad = set()
+
+        def walk(n):
+            if type(n).__name__.startswith("Cpu"):
+                bad.add(type(n).__name__)
+            for c in n.children:
+                walk(c)
+        walk(plan)
+        assert not bad, f"q{qnum} fell back: {sorted(bad)}"
+
+
+def test_q5_feature_matrix_values():
+    """Anchor Q5 against an independently computed feature matrix."""
+    import collections
+    data = generate(SF)
+    item_cat = dict(zip(data["item"]["i_item_sk"],
+                        data["item"]["i_category"]))
+    item_cid = dict(zip(data["item"]["i_item_sk"],
+                        data["item"]["i_category_id"]))
+    clicks = collections.defaultdict(lambda: [0] * 8)
+    for u, i in zip(data["web_clickstreams"]["wcs_user_sk"],
+                    data["web_clickstreams"]["wcs_item_sk"]):
+        if u is None:
+            continue
+        if item_cat[i] == "Books":
+            clicks[u][0] += 1
+        cid = item_cid[i]
+        if 1 <= cid <= 7:
+            clicks[u][cid] += 1
+    s = TpuSession({"spark.rapids.sql.enabled": "false"})
+    rows = QUERIES[5](load_tables(s, sf=SF)).collect()
+    # every customer with clicks appears once; check the category sums
+    got_total = sum(r[0] for r in rows)
+    want_total = sum(v[0] for v in clicks.values())
+    assert got_total == want_total
+    assert len(rows) == len(clicks)
