@@ -140,6 +140,14 @@ def _tag_aggregate(meta: PlanMeta):
     # single sorted pass cannot provide (the reference likewise falls back
     # for multi-distinct, GpuHashAggregateMeta.tagPlanForGpu,
     # aggregate.scala:64-111)
+    for a in aggs:
+        if a.func == "Percentile":
+            # exact percentile needs the group's full multiset (state is
+            # unbounded/unmergeable); the reference ships no GPU
+            # Percentile rule either — CPU fallback is parity
+            meta.will_not_work(
+                "percentile is not supported on TPU (falls back, like "
+                "the reference)")
     distinct_children = {repr(a.child) for a in aggs if a.distinct}
     if len(distinct_children) > 1:
         meta.will_not_work(
@@ -191,6 +199,37 @@ def _tag_join(meta: PlanMeta):
     if not lkeys:
         meta.will_not_work("join without equi-join keys is not supported "
                            "on TPU (no cross/theta join)")
+    # key TYPE coercion (Spark inserts the same implicit casts): an
+    # int32-vs-int64 key pair compares equal by value but HASHES
+    # differently (murmur3 hashInt vs hashLong), so an uncoerced pair
+    # silently matches nothing in the hash join / hash partitioning.
+    # coerce_pair handles null/string/date-vs-timestamp; numerics then
+    # need the promotion MATERIALIZED as casts — join keys are evaluated
+    # separately per side, so there is no BinaryExpression to promote
+    # them internally.
+    from ..ops.cast import Cast
+    from ..types import promote
+    from .analysis import AnalysisError, coerce_pair
+    for i, (lk, rk) in enumerate(zip(lkeys, rkeys)):
+        if lk.dtype is rk.dtype:
+            continue
+        try:
+            lk, rk = coerce_pair(lk, rk, "EqualTo")
+        except AnalysisError as e:
+            meta.will_not_work(f"join key: {e}")
+            continue
+        if lk.dtype is not rk.dtype:
+            if not (lk.dtype.is_numeric and rk.dtype.is_numeric):
+                meta.will_not_work(
+                    f"join key type mismatch {lk.dtype.name} vs "
+                    f"{rk.dtype.name} has no implicit coercion")
+                continue
+            target = promote(lk.dtype, rk.dtype)
+            if lk.dtype is not target:
+                lk = Cast(lk, target)
+            if rk.dtype is not target:
+                rk = Cast(rk, target)
+        lkeys[i], rkeys[i] = lk, rk
     meta.resolved["left_keys"] = lkeys
     meta.resolved["right_keys"] = rkeys
     meta.resolved["condition"] = cond
@@ -279,7 +318,13 @@ def _tag_window(meta: PlanMeta):
                     f"{expr_conf_key(f.kind)}=true to enable")
     except WindowUnsupported as e:
         meta.will_not_work(f"window: {e}")
-        meta.resolved["funcs"] = _resolve_funcs(device=False)
+        try:
+            meta.resolved["funcs"] = _resolve_funcs(device=False)
+        except WindowUnsupported as e2:
+            # unsupported on BOTH engines (e.g. percentile windows):
+            # surface a proper analysis error, not a planner-internal one
+            from .analysis import AnalysisError
+            raise AnalysisError(f"window: {e2}") from e2
     meta.expr_metas = [ExprMeta(e, meta.conf)
                        for e in part_exprs + order_exprs] + \
         [ExprMeta(f.child, meta.conf)
