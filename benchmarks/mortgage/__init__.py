@@ -1,0 +1,2 @@
+from .datagen import generate, load_tables  # noqa: F401
+from .queries import QUERIES  # noqa: F401

@@ -1,0 +1,81 @@
+"""Mortgage-like data generator (structure-faithful to the reference's
+ETL benchmark inputs: Fannie-Mae-style performance + acquisition files).
+
+Reference counterpart: mortgage/MortgageSpark.scala ReadPerformanceCsv
+(:34-79) / ReadAcquisitionCsv (:81-118).  Each loan gets a monthly
+performance history whose delinquency status evolves (so the
+delinquency-window ETL selects meaningful ever_30/90/180 cohorts), plus
+one acquisition row."""
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+SERVICERS = ["BANK A", "BANK B", "CREDIT UNION C", "LENDER D", "OTHER"]
+CHANNELS = ["R", "C", "B"]
+
+
+def _days(y, m):
+    return (datetime.date(y, m, 1) - _EPOCH).days
+
+
+def generate(sf: float = 0.001, seed: int = 29):
+    """Returns {table_name: dict of column -> python list}."""
+    rng = np.random.RandomState(seed)
+    n_loans = max(60, int(50_000 * sf))
+    months = 24  # two years of reporting history per loan
+
+    loan_ids = np.arange(1, n_loans + 1)
+    quarters = [f"200{1 + i % 4}Q{1 + (i // 4) % 4}" for i in range(n_loans)]
+    start_year = rng.randint(2001, 2004, n_loans)
+
+    perf = {k: [] for k in
+            ("loan_id", "quarter", "monthly_reporting_period",
+             "servicer", "interest_rate", "current_actual_upb",
+             "current_loan_delinquency_status")}
+    for li in range(n_loans):
+        status = 0
+        upb = float(rng.uniform(50_000, 500_000))
+        rate = float(np.round(rng.uniform(2.5, 8.0), 3))
+        y0 = int(start_year[li])
+        for m in range(months):
+            y, mo = y0 + m // 12, 1 + m % 12
+            # delinquency random walk: mostly current, occasional spirals
+            if status == 0:
+                status = int(rng.rand() < 0.06)
+            else:
+                status = 0 if rng.rand() < 0.4 else status + 1
+            upb = max(0.0, upb - float(rng.uniform(200, 2000)))
+            perf["loan_id"].append(int(loan_ids[li]))
+            perf["quarter"].append(quarters[li])
+            perf["monthly_reporting_period"].append(_days(y, mo))
+            perf["servicer"].append(SERVICERS[li % len(SERVICERS)])
+            perf["interest_rate"].append(rate)
+            perf["current_actual_upb"].append(round(upb, 2))
+            perf["current_loan_delinquency_status"].append(status)
+
+    acq = {
+        "loan_id": loan_ids.tolist(),
+        "quarter": quarters,
+        "orig_channel": [CHANNELS[i % 3] for i in range(n_loans)],
+        "seller_name": [SERVICERS[i % len(SERVICERS)]
+                        for i in range(n_loans)],
+        "orig_interest_rate": np.round(rng.uniform(2.5, 8.0, n_loans),
+                                       3).tolist(),
+        "orig_upb": rng.randint(50_000, 500_000, n_loans).tolist(),
+        "orig_loan_term": rng.choice([180, 240, 360], n_loans).tolist(),
+        "dti": np.round(rng.uniform(5, 60, n_loans), 1).tolist(),
+        "borrower_credit_score": rng.randint(550, 830, n_loans).tolist(),
+        "zip": rng.randint(100, 999, n_loans).tolist(),
+    }
+    return {"performance": perf, "acquisition": acq}
+
+
+def load_tables(session, sf: float = 0.001, seed: int = 29):
+    from .schema import SCHEMAS
+    data = generate(sf, seed)
+    return {name: session.from_pydict(data[name], SCHEMAS[name])
+            for name in SCHEMAS}

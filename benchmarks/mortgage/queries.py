@@ -1,0 +1,142 @@
+"""The reference's Mortgage ETL + aggregate drivers in this repo's DSL.
+
+Behavior from mortgage/MortgageSpark.scala:
+  * performance_delinquency — CreatePerformanceDelinquency (:213-299):
+    per-loan ever_30/90/180 cohorts (conditional min/max aggregation),
+    a 12-month EXPLODE fan-out with the floor/mod month-bucket
+    arithmetic ("josh_mody"), re-aggregation, and a multi-key left join
+    back onto the monthly history;
+  * simple_aggregates — SimpleAggregates (:349-365);
+  * aggregates_with_percentiles — AggregatesWithPercentiles (:367-389)
+    (grouping on loan_id directly; the reference's hex(hash(...))
+    anonymization wrapper is orthogonal to the aggregate shape).  The
+    percentile aggregate falls back to the CPU executors on both sides,
+    matching the reference, which ships no GPU Percentile rule;
+  * aggregates_with_join — AggregatesWithJoin (:391-421).
+
+Each `qname(t)` takes {table_name: DataFrame} and returns a DataFrame.
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu.plan.logical import col, functions as F, lit
+from spark_rapids_tpu.types import IntegerType, LongType
+
+
+def performance_delinquency(t):
+    df = (t["performance"]
+          .with_column("timestamp_month",
+                       F.month(col("monthly_reporting_period")))
+          .with_column("timestamp_year",
+                       F.year(col("monthly_reporting_period"))))
+
+    status = col("current_loan_delinquency_status")
+    agg_df = (df.select(
+        col("quarter"), col("loan_id"), status,
+        F.when(status >= 1, col("monthly_reporting_period"))
+        .alias("d30"),
+        F.when(status >= 3, col("monthly_reporting_period"))
+        .alias("d90"),
+        F.when(status >= 6, col("monthly_reporting_period"))
+        .alias("d180"))
+        .group_by(col("quarter"), col("loan_id"))
+        .agg(F.max(status).alias("d12"),
+             F.min(col("d30")).alias("delinquency_30"),
+             F.min(col("d90")).alias("delinquency_90"),
+             F.min(col("d180")).alias("delinquency_180"))
+        .select(col("quarter"), col("loan_id"),
+                (col("d12") >= 1).alias("ever_30"),
+                (col("d12") >= 3).alias("ever_90"),
+                (col("d12") >= 6).alias("ever_180"),
+                col("delinquency_30"), col("delinquency_90"),
+                col("delinquency_180")))
+
+    joined = (df.select(col("quarter"), col("loan_id"),
+                        col("current_loan_delinquency_status")
+                        .alias("delinquency_12"),
+                        col("current_actual_upb").alias("upb_12"),
+                        col("timestamp_month"), col("timestamp_year"))
+              .join(agg_df, on=["loan_id", "quarter"], how="left"))
+
+    months = 12
+    mody = F.floor(((col("timestamp_year") * 12 + col("timestamp_month"))
+                    - 24000 - col("month_y")) / months)
+    test_df = (joined
+               .with_column("month_y", F.explode(list(range(12))))
+               .select(col("quarter"), col("loan_id"),
+                       mody.cast(LongType).alias("josh_mody_n"),
+                       col("ever_30"), col("ever_90"), col("ever_180"),
+                       col("month_y"), col("delinquency_12"),
+                       col("upb_12"))
+               .group_by(col("quarter"), col("loan_id"),
+                         col("josh_mody_n"), col("ever_30"),
+                         col("ever_90"), col("ever_180"), col("month_y"))
+               .agg(F.max(col("delinquency_12")).alias("delinquency_12"),
+                    F.min(col("upb_12")).alias("upb_12")))
+    mseq = lit(24000) + (col("josh_mody_n") * months) + col("month_y")
+    test_df = (test_df
+               .with_column("timestamp_year",
+                            F.floor((mseq - 1) / 12).cast(LongType))
+               .with_column("timestamp_month_tmp",
+                            (mseq % 12).cast(LongType))
+               .with_column("timestamp_month",
+                            F.when(col("timestamp_month_tmp") == 0, 12)
+                            .otherwise(col("timestamp_month_tmp")))
+               .with_column("delinquency_12",
+                            (col("delinquency_12") > 3).cast(IntegerType)
+                            + (col("upb_12") == 0.0).cast(IntegerType))
+               .select(col("quarter"), col("loan_id"),
+                       col("timestamp_year"), col("timestamp_month"),
+                       col("delinquency_12"), col("upb_12")))
+
+    return (t["performance"]
+            .with_column("timestamp_month",
+                         F.month(col("monthly_reporting_period")))
+            .with_column("timestamp_year",
+                         F.year(col("monthly_reporting_period")))
+            .join(test_df,
+                  on=["quarter", "loan_id", "timestamp_year",
+                      "timestamp_month"], how="left"))
+
+
+def simple_aggregates(t):
+    max_rate = (t["performance"]
+                .with_column("monthval",
+                             F.month(col("monthly_reporting_period")))
+                .group_by(col("monthval"), col("loan_id"))
+                .agg(F.max(col("interest_rate"))
+                     .alias("max_monthly_rate")))
+    return (max_rate
+            .join(t["acquisition"], on=["loan_id"])
+            .group_by(col("zip"), col("monthval"))
+            .agg(F.min(col("max_monthly_rate"))
+                 .alias("min_max_monthly_rate")))
+
+
+def aggregates_with_percentiles(t):
+    rate = col("interest_rate")
+    return (t["performance"]
+            .group_by(col("loan_id"))
+            .agg(F.min(rate).alias("interest_rate_min"),
+                 F.max(rate).alias("interest_rate_max"),
+                 F.avg(rate).alias("interest_rate_avg"),
+                 F.percentile(rate, 0.5).alias("interest_rate_50p"),
+                 F.percentile(rate, 0.75).alias("interest_rate_75p"),
+                 F.percentile(rate, 0.90).alias("interest_rate_90p"),
+                 F.percentile(rate, 0.99).alias("interest_rate_99p")))
+
+
+def aggregates_with_join(t):
+    perf = (t["performance"]
+            .group_by(col("loan_id"))
+            .agg(F.min(col("interest_rate")).alias("min_int_rate")))
+    acq = (t["acquisition"]
+           .group_by(col("loan_id"))
+           .agg(F.first(col("orig_interest_rate")).alias("first_int_rate"),
+                F.coalesce(F.max(col("dti")), lit(0.0)).alias("max_dti")))
+    return perf.join(acq, on=["loan_id"], how="left")
+
+
+QUERIES = {"delinquency": performance_delinquency,
+           "simple_aggregates": simple_aggregates,
+           "aggregates_with_percentiles": aggregates_with_percentiles,
+           "aggregates_with_join": aggregates_with_join}

@@ -1,0 +1,20 @@
+"""Mortgage-like table schemas (reference: MortgageSpark.scala
+performanceSchema :37-69 / acquisitionSchema :84-117, trimmed to the
+columns the ETL and aggregate drivers touch)."""
+from spark_rapids_tpu.types import (DateType, DoubleType, LongType, Schema,
+                                    StringType, StructField as F)
+
+PERFORMANCE = Schema([
+    F("loan_id", LongType), F("quarter", StringType),
+    F("monthly_reporting_period", DateType), F("servicer", StringType),
+    F("interest_rate", DoubleType), F("current_actual_upb", DoubleType),
+    F("current_loan_delinquency_status", LongType)])
+
+ACQUISITION = Schema([
+    F("loan_id", LongType), F("quarter", StringType),
+    F("orig_channel", StringType), F("seller_name", StringType),
+    F("orig_interest_rate", DoubleType), F("orig_upb", LongType),
+    F("orig_loan_term", LongType), F("dti", DoubleType),
+    F("borrower_credit_score", LongType), F("zip", LongType)])
+
+SCHEMAS = {"performance": PERFORMANCE, "acquisition": ACQUISITION}

@@ -167,6 +167,21 @@ class CpuHashAggregateExec(CpuExec):
             return max(data)
         if a.func == "Average":
             return sum(data) / len(data)
+        if a.func == "Percentile":
+            # exact percentile, linear interpolation between closest
+            # ranks; NaN sorts GREATEST (the same ordering Max uses), so
+            # p=1.0 with a NaN present is NaN, matching Spark's child
+            # ordering
+            def rank_key(d):
+                nan = isinstance(d, float) and np.isnan(d)
+                return (nan, 0.0 if nan else float(d))
+            ordered = sorted(data, key=rank_key)
+            pos = a.param * (len(ordered) - 1)
+            lo, hi = int(np.floor(pos)), int(np.ceil(pos))
+            vlo, vhi = float(ordered[lo]), float(ordered[hi])
+            if lo == hi:  # exact rank: never interpolate (NaN at hi
+                return vlo  # must not bleed into a finite rank)
+            return vlo + (vhi - vlo) * (pos - lo)
         raise NotImplementedError(a.func)
 
 

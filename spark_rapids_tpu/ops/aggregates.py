@@ -19,10 +19,12 @@ from .expressions import Expression
 class AggregateExpression(Expression):
     """A resolved aggregate call appearing in an agg list."""
 
-    func: str                 # Sum|Min|Max|Count|Average|First|Last
+    func: str                 # Sum|Min|Max|Count|Average|First|Last|Percentile
     child: Optional[Expression]  # None for count(*)
     distinct: bool = False
     output_name: str = ""
+    # Percentile's p in [0, 1] (exact percentile, linear interpolation)
+    param: Optional[float] = None
 
     def __post_init__(self):
         self.children = (self.child,) if self.child is not None else ()
@@ -31,7 +33,7 @@ class AggregateExpression(Expression):
     def dtype(self) -> DataType:
         if self.func == "Count":
             return LongType
-        if self.func == "Average":
+        if self.func in ("Average", "Percentile"):
             return DoubleType
         if self.func == "Sum":
             ct = self.child.dtype
@@ -50,4 +52,5 @@ class AggregateExpression(Expression):
         return f"{self.func}({d}{inner})"
 
 
-AGG_FUNCS = ("Sum", "Min", "Max", "Count", "Average", "First", "Last")
+AGG_FUNCS = ("Sum", "Min", "Max", "Count", "Average", "First", "Last",
+             "Percentile")

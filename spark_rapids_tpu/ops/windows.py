@@ -109,6 +109,8 @@ def resolve_window_func(func_ce, spec, schema: Schema, resolve,
 
     from .aggregates import AGG_FUNCS
     if op in AGG_FUNCS:
+        if op == "Percentile":
+            raise WindowUnsupported("percentile window aggregates")
         child_ce, distinct = func_ce.args
         if distinct:
             raise WindowUnsupported("DISTINCT window aggregates")

@@ -108,6 +108,19 @@ def resolve(ce, schema: Schema, partition_id: int = 0) -> E.Expression:
             if otherwise is not None else None
         return E.CaseWhen(rb, ro)
     if op in AGG_FUNCS:
+        if op == "Percentile":
+            child_ce, distinct, pct = ce.args
+            if distinct:
+                raise AnalysisError("percentile(DISTINCT) is not supported")
+            if not (0.0 <= float(pct) <= 1.0):
+                raise AnalysisError(f"percentile p={pct} outside [0, 1]")
+            child = resolve(child_ce, schema, partition_id)
+            if not child.dtype.is_numeric:
+                raise AnalysisError(
+                    f"percentile over {child.dtype.name}")
+            return AggregateExpression(op, child, False,
+                                       output_name=ce.output_name,
+                                       param=float(pct))
         child_ce, distinct = ce.args
         child = None
         if not (child_ce.op == "lit" and child_ce.args[0] in (1, "*")):

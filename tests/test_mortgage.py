@@ -1,0 +1,133 @@
+"""Mortgage-like ETL drivers: CPU-vs-TPU oracle (reference:
+mortgage/MortgageSpark.scala — the delinquency-window ETL with its
+12-month explode fan-out, plus the aggregate drivers)."""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchmarks.mortgage import QUERIES, load_tables  # noqa: E402
+from compare import assert_rows_equal  # noqa: E402
+from spark_rapids_tpu.engine import TpuSession  # noqa: E402
+
+SF = 0.002
+
+
+def run_query(name: str, conf: dict):
+    s = TpuSession(conf)
+    tables = load_tables(s, sf=SF)
+    return QUERIES[name](tables).collect()
+
+
+@pytest.mark.parametrize("name", sorted(QUERIES))
+def test_mortgage_query(name):
+    cpu = run_query(name, {"spark.rapids.sql.enabled": "false"})
+    tpu = run_query(name, {})
+    assert len(cpu) > 0, f"{name} selected nothing"
+    assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=True)
+
+
+def test_mortgage_all_device():
+    conf = {"spark.rapids.sql.variableFloatAgg.enabled": "true"}
+    # percentile falls back BY DESIGN (the reference ships no GPU
+    # Percentile rule either) — every other driver plans fully on-device
+    for name in sorted(set(QUERIES) - {"aggregates_with_percentiles"}):
+        s = TpuSession(dict(conf))
+        tables = load_tables(s, sf=SF)
+        plan = s.plan(QUERIES[name](tables).plan)
+        bad = set()
+
+        def walk(n):
+            if type(n).__name__.startswith("Cpu"):
+                bad.add(type(n).__name__)
+            for c in n.children:
+                walk(c)
+        walk(plan)
+        assert not bad, f"{name} fell back: {sorted(bad)}"
+
+
+def test_delinquency_cohorts_value():
+    """Anchor the ever_30/90/180 cohort logic against a hand computation:
+    loans whose worst status >= k must carry ever_k on every row."""
+    import collections
+
+    from benchmarks.mortgage import generate
+    data = generate(SF)
+    worst = collections.Counter()
+    for lid, st in zip(data["performance"]["loan_id"],
+                       data["performance"]["current_loan_delinquency_status"]):
+        worst[lid] = max(worst[lid], st)
+    want_ever30 = {lid for lid, w in worst.items() if w >= 1}
+    want_deep = {lid for lid, w in worst.items() if w > 3}
+    s = TpuSession({"spark.rapids.sql.enabled": "false"})
+    df = QUERIES["delinquency"](load_tables(s, sf=SF))
+    rows = df.collect()
+    assert len(want_ever30) > 0
+    names = df.schema.names
+    li = names.index("loan_id")
+    d12 = names.index("delinquency_12")
+    # the rolled-up delinquency_12 flag (status>3 or upb==0) may only
+    # mark loans whose history actually went that deep (or whose balance
+    # reached 0) — the cohort containment the ETL exists to compute
+    upb_zero = {lid for lid, w in worst.items()}  # upb path checked below
+    got_deep = {r[li] for r in rows if r[d12] is not None and r[d12] > 0}
+    assert got_deep, "no delinquent cohort rows survived the ETL"
+    zero_bal = set()
+    from benchmarks.mortgage import generate as _gen
+    data2 = _gen(SF)
+    for lid, upb in zip(data2["performance"]["loan_id"],
+                        data2["performance"]["current_actual_upb"]):
+        if upb == 0.0:
+            zero_bal.add(lid)
+    assert got_deep <= (want_deep | zero_bal),         got_deep - (want_deep | zero_bal)
+
+
+def test_percentile_falls_back_like_reference():
+    s = TpuSession({})
+    tables = load_tables(s, sf=SF)
+    text = s.explain_str(QUERIES["aggregates_with_percentiles"](tables).plan)
+    assert "percentile is not supported on TPU" in text, text
+
+
+def test_percentile_against_numpy():
+    """Independent oracle for the percentile aggregate: numpy over the
+    raw per-loan rate lists (both engine paths share the CPU agg exec, so
+    self-comparison would prove nothing)."""
+    import collections
+
+    import numpy as np
+
+    from benchmarks.mortgage import generate
+    data = generate(SF)
+    per_loan = collections.defaultdict(list)
+    for lid, r in zip(data["performance"]["loan_id"],
+                      data["performance"]["interest_rate"]):
+        per_loan[lid].append(r)
+    s = TpuSession({})
+    rows = QUERIES["aggregates_with_percentiles"](
+        load_tables(s, sf=SF)).collect()
+    assert len(rows) == len(per_loan)
+    for r in rows:
+        lid = r[0]
+        want50 = float(np.percentile(per_loan[lid], 50))
+        want99 = float(np.percentile(per_loan[lid], 99))
+        assert abs(r[4] - want50) < 1e-9, (lid, r[4], want50)
+        assert abs(r[7] - want99) < 1e-9, (lid, r[7], want99)
+
+
+def test_percentile_nan_sorts_greatest():
+    """NaN ranks greatest (the Max convention): p=1.0 with a NaN present
+    is NaN; p=0.5 interpolates over the ordering with NaN last."""
+    import math
+    s = TpuSession({"spark.rapids.sql.enabled": "false"})
+    from spark_rapids_tpu.plan.logical import col, functions as F
+    df = s.from_pydict({"k": [1, 1, 1], "v": [1.0, 2.0, float("nan")]})
+    rows = df.group_by(col("k")).agg(
+        F.percentile(col("v"), 1.0).alias("p100"),
+        F.percentile(col("v"), 0.5).alias("p50"),
+        F.max(col("v")).alias("mx")).collect()
+    (k, p100, p50, mx) = rows[0]
+    assert math.isnan(p100) and math.isnan(mx)
+    assert p50 == 2.0  # middle rank is the finite 2.0, no interpolation
