@@ -272,6 +272,17 @@ def child_main(mode: str) -> None:
     emit("tpcds_datagen", sf=TPCDS_SF, t=time.time() - t0)
     checkpoint("tpcds_datagen")
     timed("tpcds_q5", lambda: checksum(ds_q5(ds).collect()), heavy_runs)
+
+    # the reference's HEADLINE query: TPCxBB-like Q5 (19.8x on the chart,
+    # reference README.md:7-15) — clickstream x item join + per-user
+    # conditional-sum pivot + demographics join
+    t0 = time.time()
+    from benchmarks.tpcxbb.datagen import load_tables as xbb_load
+    from benchmarks.tpcxbb.queries import q5 as xbb_q5
+    xbb = xbb_load(session, sf=TPCDS_SF)
+    emit("tpcxbb_datagen", sf=TPCDS_SF, t=time.time() - t0)
+    checkpoint("tpcxbb_datagen")
+    timed("tpcxbb_q5", lambda: checksum(xbb_q5(xbb).collect()), heavy_runs)
     emit("done", t=time.time() - (_DEADLINE[0] - float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))))
 

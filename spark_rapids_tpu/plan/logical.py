@@ -203,6 +203,14 @@ class functions:
         return functions._agg("Sum", e)
 
     @staticmethod
+    def percentile(e, p: float):
+        """Exact percentile with linear interpolation (Spark's
+        `percentile`).  No device rule exists — the aggregate falls back
+        to the CPU executors, exactly like the reference (which ships no
+        GPU Percentile rule in this era)."""
+        return ColumnExpr("Percentile", (_wrap(e), False, float(p)))
+
+    @staticmethod
     def avg(e):
         return functions._agg("Average", e)
 

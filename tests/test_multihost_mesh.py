@@ -94,15 +94,17 @@ def test_two_process_mesh_bringup(tmp_path):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for i in range(2)]
     outs = []
-    for p in procs:
-        try:
+    try:
+        for p in procs:
             out, err = p.communicate(timeout=180)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # a failed assert/timeout must not orphan the OTHER worker (it
+        # would block on the dead coordinator for minutes)
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            raise
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
 
     n = 4 * 4  # devices * rows per device
     want = float(sum(range(n)))
