@@ -231,9 +231,10 @@ void murmur3_long_batch(const int64_t* vals, const uint8_t* valid,
 // Unquoted fields point at the raw span; quoted fields point INSIDE the
 // quotes.  flags[i] low bits: 0 = unquoted, 1 = quoted clean, 2 = quoted
 // with doubled-quote escapes still embedded (the caller rewrites those
-// few); bit 2 (value 4) marks the LAST field of a row.  Returns the
-// field count, or -1 on malformed quoting / field overflow / CR byte
-// (caller falls back to the host reader).
+// few); bit 2 (value 4) marks the LAST field of a row.  CRLF row
+// endings are accepted in unquoted context (the CR is excluded from the
+// field); returns the field count, or -1 on malformed quoting / field
+// overflow / bare CR (caller falls back to the host reader).
 int64_t csv_tokenize(const uint8_t* data, int64_t n, uint8_t sep,
                      int64_t* starts, int64_t* lens, uint8_t* flags,
                      int64_t cap_fields) {
@@ -260,16 +261,22 @@ int64_t csv_tokenize(const uint8_t* data, int64_t n, uint8_t sep,
       starts[nf] = start;
       lens[nf] = i - start;
       ++i;  // past closing quote
+      if (i + 1 < n && data[i] == '\r' && data[i + 1] == '\n') ++i;  // CRLF
       if (i < n && data[i] != sep && data[i] != '\n') return -1;
-    } else {  // unquoted field: runs to sep/newline
+    } else {  // unquoted field: runs to sep/newline (CRLF = newline)
       int64_t start = i;
       flag = 0;
       while (i < n && data[i] != sep && data[i] != '\n') {
-        if (data[i] == '"' || data[i] == '\r') return -1;
+        if (data[i] == '\r') {
+          if (i + 1 < n && data[i + 1] == '\n') break;  // CRLF row end
+          return -1;  // bare CR (old-Mac line ending): out of scope
+        }
+        if (data[i] == '"') return -1;
         ++i;
       }
       starts[nf] = start;
       lens[nf] = i - start;
+      if (i < n && data[i] == '\r') ++i;  // settle on the NL
     }
     if (i >= n || data[i] == '\n') flag |= 4;  // last field of its row
     flags[nf] = flag;

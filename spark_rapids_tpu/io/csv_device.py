@@ -58,9 +58,17 @@ def _tokenize(raw: np.ndarray, sep: int, header: bool):
     if _QUOTE in raw:
         # quoting needs stateful scanning (embedded separators/newlines,
         # doubled-quote escapes) — one pass in the native tokenizer
+        # (which understands CRLF in unquoted context)
         return _tokenize_native(raw, sep, header)
     if _CR in raw:
-        raise CsvDeviceUnsupported("CR line endings")
+        # CRLF files: strip the CRs in one vectorized pass (every CR must
+        # precede a NL — a bare CR is the old-Mac line ending, out of
+        # scope like pyarrow's default)
+        cr = np.flatnonzero(raw == _CR)
+        nxt = cr + 1
+        if nxt[-1] >= raw.size or not (raw[nxt] == _NL).all():
+            raise CsvDeviceUnsupported("bare CR line endings")
+        raw = np.delete(raw, cr)
     if raw.size and raw[-1] != _NL:
         raw = np.concatenate([raw, np.array([_NL], dtype=np.uint8)])
     data_start = 0

@@ -154,3 +154,36 @@ def test_device_csv_pipeline_into_agg(tmp_path):
                 .agg(f.count(col("l")).alias("c"),
                      f.min(col("d")).alias("mn")))
     assert_tpu_and_cpu_are_equal(q)
+
+
+def test_crlf_line_endings_decode_on_device(tmp_path):
+    """CRLF files (the Windows default) decode on device: the unquoted
+    path strips CRs in one vectorized pass; the native tokenizer treats
+    CRLF as the row terminator in unquoted context."""
+    p = str(tmp_path / "t.csv")
+    with open(p, "wb") as f:
+        f.write(b"a,b,c\r\n1,foo,1.5\r\n2,bar,2.5\r\n3,baz,-0.5\r\n")
+    sch = T.schema_of(a=T.LongType, b=T.StringType, c=T.DoubleType)
+    s = TpuSession()
+    got = s.read.csv(p, schema=sch, header=True).collect()
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    want = cpu.read.csv(p, schema=sch, header=True).collect()
+    assert got == want == [(1, "foo", 1.5), (2, "bar", 2.5),
+                           (3, "baz", -0.5)]
+    assert _device_stats(
+        lambda s2: s2.read.csv(p, schema=sch, header=True)) == 3
+
+
+def test_crlf_quoted_fields(tmp_path):
+    """Quoted CRLF files go through the native tokenizer; a quoted field
+    may even CONTAIN a CR (it is data there, not a terminator)."""
+    p = str(tmp_path / "t.csv")
+    with open(p, "wb") as f:
+        f.write(b'1,"fo,o"\r\n2,"b""ar"\r\n3,plain\r\n')
+    sch = T.schema_of(a=T.LongType, b=T.StringType)
+    s = TpuSession()
+    got = s.read.csv(p, schema=sch).collect()
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    want = cpu.read.csv(p, schema=sch).collect()
+    assert got == want == [(1, "fo,o"), (2, 'b"ar'), (3, "plain")]
+    assert _device_stats(lambda s2: s2.read.csv(p, schema=sch)) == 2
