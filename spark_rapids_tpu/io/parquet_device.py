@@ -21,10 +21,12 @@ Scope (planner falls back to the pyarrow host path otherwise, like the
 reference's fallback flags): PLAIN / RLE_DICTIONARY(+PLAIN_DICTIONARY) /
 DELTA_BINARY_PACKED (ints) / BYTE_STREAM_SPLIT (floats+ints) encodings,
 UNCOMPRESSED or pyarrow-supported codecs, flat non-nested columns of
-INT32/INT64/FLOAT/DOUBLE/BOOLEAN/BYTE_ARRAY (strings both
-dictionary-encoded AND plain: the host scans the length-prefixed layout
-into offsets — a native single pass — and the device gathers the payload
-bytes into the padded matrix), data page v1/v2.
+INT32/INT64/FLOAT/DOUBLE/BOOLEAN/BYTE_ARRAY (strings dictionary-encoded,
+PLAIN — the host scans the length-prefixed layout into offsets, a native
+single pass, and the device gathers the payload bytes into the padded
+matrix — and DELTA_LENGTH_BYTE_ARRAY, whose lengths decode through the
+DELTA_BINARY_PACKED kernel; DELTA_BYTE_ARRAY's incremental prefixes are
+inherently sequential and fall back), data page v1/v2.
 """
 from __future__ import annotations
 
@@ -125,6 +127,7 @@ _RLE_DICT = 8
 
 
 _DELTA_BP = 5   # Encoding.DELTA_BINARY_PACKED
+_DELTA_LBA = 6  # Encoding.DELTA_LENGTH_BYTE_ARRAY
 _BSS = 9        # Encoding.BYTE_STREAM_SPLIT
 
 
@@ -139,18 +142,10 @@ def _uvarint(buf: bytes, pos: int):
         shift += 7
 
 
-def _delta_bp_decode(payload: bytes, n_values: int, cap: int):
-    """DELTA_BINARY_PACKED ints: host walks the block/miniblock headers
-    (a handful per page), the DEVICE unpacks every miniblock's
-    little-endian bit-packed deltas in one vectorized gather+shift, adds
-    the per-block min deltas, and rebuilds values with one masked cumsum.
-    The format stores first_value + (n-1) deltas; miniblocks are padded
-    to full size, so padding lanes are masked out of the cumsum."""
-    import jax
-    import jax.numpy as jnp
-
-    from ..utils.kernel_cache import cached_kernel
-
+def _delta_bp_plan(payload: bytes, n_values: int):
+    """Walk DELTA_BINARY_PACKED block/miniblock headers (a handful per
+    page).  Returns (first, n_delta, bitpos runs, width runs, dest runs,
+    per-delta min_deltas, consumed_bytes)."""
     pos = 0
     block, pos = _uvarint(payload, pos)
     minis, pos = _uvarint(payload, pos)
@@ -183,6 +178,61 @@ def _delta_bp_decode(payload: bytes, n_values: int, cap: int):
                 pos += (vpm * w + 7) // 8   # padded to FULL miniblock
             mind_l.append(np.full(take, min_d, np.int64))
             taken += take
+    return first, n_delta, bitpos_l, width_l, dest_l, mind_l, pos
+
+
+def _delta_lengths_host(payload: bytes, n_values: int):
+    """DELTA_BINARY_PACKED decode entirely on the HOST (numpy): used for
+    DELTA_LENGTH_BYTE_ARRAY string lengths, which only ever feed
+    host-side offset computation — a device round trip per page would
+    stall the decode on a D2H sync for values the device never uses.
+    Returns (int64 values[n_values], consumed_bytes)."""
+    first, n_delta, bitpos_l, _width_l, dest_l, mind_l, consumed = \
+        _delta_bp_plan(payload, n_values)
+    deltas = np.zeros(max(n_delta, 1), np.int64)
+    pad = np.concatenate([np.frombuffer(payload, np.uint8),
+                          np.zeros(9, np.uint8)])
+    for b, w, d in zip(bitpos_l, _width_l, dest_l):
+        byte0 = (b // 8).astype(np.int64)
+        win = pad[byte0[:, None] + np.arange(9)]
+        word = (win[:, :8].astype(np.uint64)
+                << (np.arange(8, dtype=np.uint64) * np.uint64(8))
+                ).sum(axis=1).astype(np.uint64)
+        spill = win[:, 8].astype(np.uint64)
+        sh = (b % 8).astype(np.uint64)
+        lo = word >> sh
+        hi = np.where(sh > 0,
+                      spill << ((np.uint64(64) - sh) & np.uint64(63)),
+                      np.uint64(0))
+        width = int(w[0])
+        mask = np.uint64(0xFFFFFFFFFFFFFFFF) if width >= 64 else \
+            np.uint64((1 << width) - 1)
+        deltas[d] = ((lo | hi) & mask).astype(np.int64)
+    if mind_l:
+        mind = np.concatenate(mind_l)
+        deltas[:n_delta] += mind[:n_delta]
+    out = np.empty(max(n_values, 1), np.int64)[:n_values]
+    if n_values:
+        out[0] = first
+        if n_delta:
+            out[1:] = first + np.cumsum(deltas[:n_delta])
+    return out, consumed
+
+
+def _delta_bp_decode(payload: bytes, n_values: int, cap: int):
+    """DELTA_BINARY_PACKED ints: host walks the block/miniblock headers
+    (_delta_bp_plan), the DEVICE unpacks every miniblock's little-endian
+    bit-packed deltas in one vectorized gather+shift, adds the per-block
+    min deltas, and rebuilds values with one masked cumsum.  The format
+    stores first_value + (n-1) deltas; miniblocks are padded to full
+    size, so padding lanes are masked out of the cumsum."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils.kernel_cache import cached_kernel
+
+    first, n_delta, bitpos_l, width_l, dest_l, mind_l, _pos = \
+        _delta_bp_plan(payload, n_values)
 
     from ..columnar.batch import bucket_rows
     dcap = bucket_rows(max(n_delta, 1))
@@ -648,10 +698,13 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
     encs = set(col_meta.encodings)
     if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
                     "BIT_PACKED", "DELTA_BINARY_PACKED",
-                    "BYTE_STREAM_SPLIT"}:
+                    "BYTE_STREAM_SPLIT", "DELTA_LENGTH_BYTE_ARRAY"}:
         raise DeviceDecodeUnsupported(f"encodings {encs}")
-    if "DELTA_BINARY_PACKED" in encs and phys not in ("INT32", "INT64"):
+    if "DELTA_BINARY_PACKED" in encs and phys not in ("INT32", "INT64",
+                                                      "BYTE_ARRAY"):
         raise DeviceDecodeUnsupported("DELTA_BINARY_PACKED non-int")
+    if "DELTA_LENGTH_BYTE_ARRAY" in encs and phys != "BYTE_ARRAY":
+        raise DeviceDecodeUnsupported("DELTA_LENGTH_BYTE_ARRAY non-string")
     if "BYTE_STREAM_SPLIT" in encs and phys not in ("FLOAT", "DOUBLE",
                                                     "INT32", "INT64"):
         raise DeviceDecodeUnsupported("BYTE_STREAM_SPLIT phys type")
@@ -739,6 +792,8 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
             value_pieces.append(("dict", data[dpos:], nonnull))
         elif enc == _DELTA_BP:
             value_pieces.append(("delta_bp", data[dpos:], nonnull))
+        elif enc == _DELTA_LBA and phys == "BYTE_ARRAY":
+            value_pieces.append(("delta_lba", data[dpos:], nonnull))
         elif enc == _BSS:
             value_pieces.append(("bss", data[dpos:], nonnull))
         else:
@@ -771,6 +826,25 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
                 scans.append((arr, offs, lens))
                 if nonnull:
                     max_len = max(max_len, int(lens[:nonnull].max()))
+            elif kind == "delta_lba":
+                # lengths decode through the DELTA_BINARY_PACKED device
+                # kernel; the byte payload follows the delta block, so
+                # offsets are one host cumsum over the (small) lengths
+                lvals, consumed = _delta_lengths_host(payload, nonnull)
+                lens = lvals.astype(np.int64)
+                if (lens < 0).any():
+                    raise DeviceDecodeUnsupported("negative string length")
+                offs = np.zeros(nonnull, np.int64)
+                if nonnull > 1:
+                    np.cumsum(lens[:-1], out=offs[1:])
+                offs += consumed
+                arr = np.frombuffer(payload, np.uint8)
+                if nonnull and int(offs[-1] + lens[-1]) > arr.size:
+                    raise DeviceDecodeUnsupported(
+                        "truncated delta_length byte payload")
+                scans.append((arr, offs, lens))
+                if nonnull:
+                    max_len = max(max_len, int(lens.max()))
             elif kind == "dict":
                 if dict_values is None:
                     raise DeviceDecodeUnsupported("dict page missing")
@@ -794,7 +868,7 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
                 idx = _indices_decode(payload, nonnull, pcap)
                 pmat = jnp.take(dmat, idx, axis=0, mode="clip")
                 plen = jnp.take(dlens, idx, mode="clip").astype(jnp.int32)
-            else:
+            else:  # plain / delta_lba: (payload, offsets, lengths) gather
                 arr, offs, lens = scan
                 pmat, plen = _byte_array_gather(arr, offs, lens, pcap,
                                                 width)

@@ -49,6 +49,15 @@ def _table(n=4000, seed=0, with_null=True, with_strings=True):
     return t
 
 
+def _find_scan(n):
+    if type(n).__name__ == "TpuFileScanExec":
+        return n
+    for c in n.children:
+        r = _find_scan(c)
+        if r:
+            return r
+
+
 def _roundtrip(tmp_path, write_conf, table, read_conf=None, query=None):
     p = str(tmp_path / "t.parquet")
     pq.write_table(table, p, **write_conf)
@@ -93,14 +102,7 @@ def test_device_decode_actually_used(tmp_path):
     batches = list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
     assert batches
 
-    def find_scan(n):
-        if type(n).__name__ == "TpuFileScanExec":
-            return n
-        for c in n.children:
-            r = find_scan(c)
-            if r:
-                return r
-    scan = find_scan(node)
+    scan = _find_scan(node)
     # 6 numeric/bool/date columns decoded on device; strings fell back
     assert scan.metrics.values.get("numDeviceDecodedColumns", 0) >= 6
 
@@ -139,14 +141,7 @@ def test_pushdown_skips_row_groups_on_device_path(tmp_path):
             for r in b.to_pylist()]
     assert len(rows) >= 1000  # filter applied above the scan
 
-    def find_scan(n):
-        if type(n).__name__ == "TpuFileScanExec":
-            return n
-        for c in n.children:
-            r = find_scan(c)
-            if r:
-                return r
-    scan = find_scan(node)
+    scan = _find_scan(node)
     assert scan.metrics.values.get("numRowGroupsSkipped", 0) >= 8
 
 
@@ -186,14 +181,7 @@ def test_dict_string_decoded_on_device(tmp_path):
     from spark_rapids_tpu.exec.base import ExecContext
     list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
 
-    def find_scan(n):
-        if type(n).__name__ == "TpuFileScanExec":
-            return n
-        for c in n.children:
-            r = find_scan(c)
-            if r:
-                return r
-    scan = find_scan(node)
+    scan = _find_scan(node)
     # all 7 columns (6 numeric/bool/date + the string) decoded on device
     assert scan.metrics.values.get("numDeviceDecodedColumns", 0) >= 7
 
@@ -300,14 +288,7 @@ def test_plain_byte_array_strings_decode_on_device(tmp_path):
     batches = list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
     assert batches
 
-    def find_scan(n):
-        if type(n).__name__ == "TpuFileScanExec":
-            return n
-        for c in n.children:
-            r = find_scan(c)
-            if r:
-                return r
-    scan = find_scan(node)
+    scan = _find_scan(node)
     # BOTH columns device-decoded: the string column no longer falls back
     assert scan.metrics.values.get("numDeviceDecodedColumns", 0) >= 2, \
         scan.metrics.values
@@ -334,3 +315,28 @@ def test_mixed_plain_and_dict_string_pages(tmp_path):
     cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
     want = [r[0] for r in cpu.read.parquet(p).collect()]
     assert got == want == vals
+
+
+def test_delta_length_byte_array_strings(tmp_path):
+    """DELTA_LENGTH_BYTE_ARRAY strings decode on device: lengths through
+    the DELTA_BINARY_PACKED kernel, bytes through the offset gather."""
+    p = str(tmp_path / "t.parquet")
+    rng = np.random.RandomState(5)
+    vals = [None if rng.rand() < 0.1
+            else "x" * int(rng.randint(0, 30)) + str(int(v))
+            for v in rng.randint(0, 10**9, 4000)]
+    t = pa.table({"s": pa.array(vals), "v": rng.uniform(0, 1, 4000)})
+    pq.write_table(t, p, compression="NONE", use_dictionary=False,
+                   row_group_size=900,
+                   column_encoding={"s": "DELTA_LENGTH_BYTE_ARRAY",
+                                    "v": "PLAIN"})
+    s = TpuSession()
+    node = s.plan(s.read.parquet(p).plan)
+    from spark_rapids_tpu.exec.base import ExecContext
+    batches = list(node.execute(ExecContext(s.conf, runtime=s.runtime)))
+    got = [r[0] for b in batches for r in b.to_pylist()]
+    assert got == vals
+
+    scan = _find_scan(node)
+    assert scan.metrics.values.get("numDeviceDecodedColumns", 0) >= 2, \
+        scan.metrics.values  # both columns on device, zero fallbacks
