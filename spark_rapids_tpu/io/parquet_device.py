@@ -700,8 +700,7 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
                     "BIT_PACKED", "DELTA_BINARY_PACKED",
                     "BYTE_STREAM_SPLIT", "DELTA_LENGTH_BYTE_ARRAY"}:
         raise DeviceDecodeUnsupported(f"encodings {encs}")
-    if "DELTA_BINARY_PACKED" in encs and phys not in ("INT32", "INT64",
-                                                      "BYTE_ARRAY"):
+    if "DELTA_BINARY_PACKED" in encs and phys not in ("INT32", "INT64"):
         raise DeviceDecodeUnsupported("DELTA_BINARY_PACKED non-int")
     if "DELTA_LENGTH_BYTE_ARRAY" in encs and phys != "BYTE_ARRAY":
         raise DeviceDecodeUnsupported("DELTA_LENGTH_BYTE_ARRAY non-string")
