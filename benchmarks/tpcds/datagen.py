@@ -79,6 +79,8 @@ def generate(sf: float = 0.001, seed: int = 7):
         "i_current_price": np.round(rng.uniform(0.5, 100.0, n_item),
                                     2).tolist(),
         "i_class_id": [(i * 3) % 16 + 1 for i in range(n_item)],
+        "i_color": [["red", "blue", "green", "amber", "slate", "navy"]
+                    [i % 6] for i in range(n_item)],
         "i_class": [f"class#{(i * 3) % 16 + 1}" for i in range(n_item)],
         "i_item_desc": [f"item description {i}" for i in range(n_item)],
     }
@@ -265,6 +267,7 @@ def generate(sf: float = 0.001, seed: int = 7):
                                    2).tolist(),
         "cs_coupon_amt": np.round(rng.uniform(0.0, 100.0, n_cs),
                                   2).tolist(),
+        "cs_bill_addr_sk": rng.randint(1, n_ca + 1, n_cs).tolist(),
     }
 
     n_cr = max(30, int(144_000 * sf))
@@ -293,7 +296,43 @@ def generate(sf: float = 0.001, seed: int = 7):
         "ws_net_profit": np.round(rng.uniform(-300.0, 500.0, n_ws),
                                   2).tolist(),
         "ws_bill_customer_sk": rng.randint(1, n_cust + 1, n_ws).tolist(),
+        "ws_bill_addr_sk": rng.randint(1, n_ca + 1, n_ws).tolist(),
+        "ws_ext_discount_amt": np.round(rng.uniform(0.0, 500.0, n_ws),
+                                        2).tolist(),
     }
+
+    # omni-channel overlap: the set-operation queries (q38 INTERSECT /
+    # q87 EXCEPT) compare (customer, date) sets ACROSS channels, and at
+    # tiny scale factors independent uniform draws never collide — pin
+    # the first rows of each channel to the same customers on the same
+    # day so the intersect is provably non-empty at any sf
+    k_omni = min(25, n_cust, n_ss, n_cs, n_ws)
+    d_omni = int(date_sks[800])  # a 2000 date inside the q38/q87 window
+    for i in range(k_omni):
+        out["store_sales"]["ss_sold_date_sk"][i] = d_omni
+        out["store_sales"]["ss_customer_sk"][i] = i + 1
+        out["catalog_sales"]["cs_sold_date_sk"][i] = d_omni
+        out["catalog_sales"]["cs_bill_customer_sk"][i] = i + 1
+        out["web_sales"]["ws_sold_date_sk"][i] = d_omni
+        out["web_sales"]["ws_bill_customer_sk"][i] = i + 1
+
+    # ...and STORE-ONLY customers for the EXCEPT/anti queries (q69/q87):
+    # the last k_solo customers get store activity in 2000 but every
+    # web/catalog row of theirs is remapped to an omni customer, and
+    # their address pins to ca 1 (state TN) so state filters keep them
+    k_solo = min(12, n_cust // 4)
+    solo = set(range(n_cust - k_solo + 1, n_cust + 1))
+    for i, c in enumerate(out["web_sales"]["ws_bill_customer_sk"]):
+        if c in solo:
+            out["web_sales"]["ws_bill_customer_sk"][i] = 1 + i % k_omni
+    for key in ("cs_bill_customer_sk", "cs_ship_customer_sk"):
+        for i, c in enumerate(out["catalog_sales"][key]):
+            if c in solo:
+                out["catalog_sales"][key][i] = 1 + i % k_omni
+    for j, c in enumerate(sorted(solo)):
+        out["store_sales"]["ss_sold_date_sk"][k_omni + j] = d_omni
+        out["store_sales"]["ss_customer_sk"][k_omni + j] = c
+        out["customer"]["c_current_addr_sk"][c - 1] = 1  # TN address
 
     # web returns reference a sold web order (item, order) so the q5 left
     # join resolves a site for most returns

@@ -19,7 +19,7 @@ ITEM = Schema([
     F("i_manufact_id", LongType), F("i_manufact", StringType),
     F("i_manager_id", LongType), F("i_current_price", DoubleType),
     F("i_class_id", LongType), F("i_class", StringType),
-    F("i_item_desc", StringType)])
+    F("i_item_desc", StringType), F("i_color", StringType)])
 
 STORE_SALES = Schema([
     F("ss_sold_date_sk", LongType), F("ss_sold_time_sk", LongType),
@@ -86,7 +86,7 @@ CATALOG_SALES = Schema([
     F("cs_bill_cdemo_sk", LongType), F("cs_call_center_sk", LongType),
     F("cs_promo_sk", LongType), F("cs_quantity", LongType),
     F("cs_list_price", DoubleType), F("cs_sales_price", DoubleType),
-    F("cs_coupon_amt", DoubleType)])
+    F("cs_coupon_amt", DoubleType), F("cs_bill_addr_sk", LongType)])
 
 CATALOG_RETURNS = Schema([
     F("cr_returned_date_sk", LongType), F("cr_catalog_page_sk", LongType),
@@ -96,7 +96,8 @@ WEB_SALES = Schema([
     F("ws_sold_date_sk", LongType), F("ws_web_site_sk", LongType),
     F("ws_item_sk", LongType), F("ws_order_number", LongType),
     F("ws_ext_sales_price", DoubleType), F("ws_net_profit", DoubleType),
-    F("ws_bill_customer_sk", LongType)])
+    F("ws_bill_customer_sk", LongType), F("ws_bill_addr_sk", LongType),
+    F("ws_ext_discount_amt", DoubleType)])
 
 WEB_RETURNS = Schema([
     F("wr_returned_date_sk", LongType), F("wr_item_sk", LongType),
