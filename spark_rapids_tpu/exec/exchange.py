@@ -188,20 +188,19 @@ class TpuShuffleExchangeExec(TpuExec):
 
 
 def _drain_async(it, n: int):
-    """Consume an AsyncFetchIterator's (rid, batch) stream (rids arrive
-    non-decreasing) back into (partition, coalesced-batch) order, emitting
-    every partition 0..n-1 exactly once (empty ones included)."""
+    """Consume an AsyncFetchIterator's stream back into (partition,
+    coalesced-batch) order, emitting every partition 0..n-1 exactly once
+    (empty ones included)."""
+    from ..shuffle.fetch import iter_partition_groups
     next_p = 0
-    parts: list = []
-    for rid, batch in it:
+    for rid, parts in iter_partition_groups(it):
         while next_p < rid:
-            yield next_p, _coalesce_parts(parts)
-            parts = []
+            yield next_p, None
             next_p += 1
-        parts.append(batch)
+        yield rid, _coalesce_parts(parts)
+        next_p = rid + 1
     while next_p < n:
-        yield next_p, _coalesce_parts(parts)
-        parts = []
+        yield next_p, None
         next_p += 1
 
 

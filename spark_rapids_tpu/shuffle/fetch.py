@@ -98,3 +98,20 @@ class AsyncFetchIterator:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
+
+
+def iter_partition_groups(it):
+    """Group an AsyncFetchIterator's (reduce_id, batch) stream into
+    (reduce_id, [batches]) — the ONE place that encodes the producer's
+    in-order emission contract (a rid change marks the previous
+    partition complete).  Only non-empty partitions are yielded; callers
+    needing every id walk the gaps themselves."""
+    current, pending = None, []
+    for rid, batch in it:
+        if current is not None and rid != current:
+            yield current, pending
+            pending = []
+        current = rid
+        pending.append(batch)
+    if current is not None:
+        yield current, pending

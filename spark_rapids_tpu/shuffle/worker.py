@@ -154,16 +154,32 @@ class WorkerHandler:
 
         logical = pickle.loads(plan_blob)
         outs: List[pa.Table] = []
-        for p in partitions:
-            batches = list(self.env.fetch_partition(
-                sid, p, remote_peers=self.peers))
+
+        def reduce_one(batches: list) -> None:
             tabs = [b.to_arrow() for b in batches]
             tabs = [t for t in tabs if t.num_rows]
             if not tabs:
-                continue
+                return
             table = pa.concat_tables(tabs)
-            df = DataFrame(self.session, attach_stage_input(logical, table))
+            df = DataFrame(self.session,
+                           attach_stage_input(logical, table))
             outs.append(df.to_arrow())
+
+        from ..config import SHUFFLE_ASYNC_FETCH
+        if self.session.conf.get(SHUFFLE_ASYNC_FETCH):
+            # pipelined read: the producer thread fetches partition k+1
+            # from peer workers over the wire (bounded by
+            # maxReceiveInflightBytes) while the reduce fragment computes
+            # on partition k
+            from .fetch import iter_partition_groups
+            for _rid, batches in iter_partition_groups(
+                    self.env.fetch_partitions_async(
+                        sid, partitions, remote_peers=self.peers)):
+                reduce_one(batches)
+        else:  # conf kill-switch: synchronous per-partition fetch
+            for p in partitions:
+                reduce_one(list(self.env.fetch_partition(
+                    sid, p, remote_peers=self.peers)))
         if not outs:
             return None
         result = pa.concat_tables(outs)
