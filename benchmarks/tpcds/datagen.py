@@ -184,11 +184,18 @@ def generate(sf: float = 0.001, seed: int = 7):
 
     def per_ticket(vals):
         return np.repeat(np.asarray(vals), per_tick)[:n_ss]
+    # items are DISTINCT within a ticket so (ss_item_sk,
+    # ss_ticket_number) is a key, like the spec's store_sales PK —
+    # q93's sale->return join depends on it
+    within = np.arange(n_ss) - np.repeat(4 * np.arange(n_tick),
+                                         per_tick)[:n_ss]
+    ss_items = ((per_ticket(rng.randint(0, n_item, n_tick)) + within)
+                % n_item) + 1
     out["store_sales"] = {
         "ss_sold_date_sk": per_ticket(
             rng.choice(date_sks, n_tick)).tolist(),
         "ss_sold_time_sk": rng.randint(0, 1440, n_ss).tolist(),
-        "ss_item_sk": rng.randint(1, n_item + 1, n_ss).tolist(),
+        "ss_item_sk": ss_items.tolist(),
         "ss_customer_sk": per_ticket(
             rng.randint(1, n_cust + 1, n_tick)).tolist(),
         "ss_cdemo_sk": per_ticket(
@@ -223,7 +230,11 @@ def generate(sf: float = 0.001, seed: int = 7):
     # the multi-fact chains (q25/q29: sale -> return -> catalog re-purchase)
     # resolve at tiny scale factors.
     n_sr = max(60, int(287_000 * sf))
-    sr_pick = rng.randint(0, n_ss, n_sr)
+    # sample sale ROWS without replacement: with the per-ticket distinct
+    # items above, (sr_item_sk, sr_ticket_number) is then a key, so the
+    # q93-style left join can never fan out
+    sr_pick = rng.choice(n_ss, size=min(n_sr, n_ss), replace=False)
+    n_sr = len(sr_pick)
     out["store_returns"] = {
         "sr_returned_date_sk": rng.choice(date_sks, n_sr).tolist(),
         "sr_store_sk": rng.randint(1, n_store + 1, n_sr).tolist(),
@@ -347,6 +358,28 @@ def generate(sf: float = 0.001, seed: int = 7):
                                   2).tolist(),
         "wr_net_loss": np.round(rng.uniform(0.5, 350.0, n_wr), 2).tolist(),
     }
+    # inventory snapshots (spec: weekly per item x warehouse; sampled)
+    n_wh = max(3, int(20 * sf * 5))
+    out["warehouse"] = {
+        "w_warehouse_sk": list(range(1, n_wh + 1)),
+        "w_warehouse_name": [f"warehouse {i}"
+                             for i in range(1, n_wh + 1)],
+    }
+    n_inv = max(500, int(1_000_000 * sf))
+    out["inventory"] = {
+        "inv_date_sk": rng.choice(date_sks, n_inv).tolist(),
+        "inv_item_sk": rng.randint(1, n_item + 1, n_inv).tolist(),
+        "inv_warehouse_sk": rng.randint(1, n_wh + 1, n_inv).tolist(),
+        "inv_quantity_on_hand": rng.randint(0, 1000, n_inv).tolist(),
+    }
+
+    out["reason"] = {
+        "r_reason_sk": list(range(1, 10)),
+        "r_reason_desc": [f"reason {i}" for i in range(1, 10)],
+    }
+    # store returns carry a reason for q93's per-reason adjustment
+    out["store_returns"]["sr_reason_sk"] = \
+        rng.randint(1, 10, n_sr).tolist()
     return out
 
 

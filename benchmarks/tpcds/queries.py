@@ -1324,9 +1324,72 @@ def q58(t):
             .limit(100))
 
 
+def _inventory_price_band(t, fact, date_key, item_key):
+    """q37/q82 skeleton: items in a price band with inventory on hand in
+    a window, that also sold through the channel."""
+    it = t["item"].filter(col("i_current_price").between(20.0, 50.0))
+    dd = (t["date_dim"].filter(col("d_year") == 2000)
+          .select(col("d_date_sk").alias("inv_dsk")))
+    stocked = (t["inventory"]
+               .filter(col("inv_quantity_on_hand").between(100, 500))
+               .join(dd, on=col("inv_date_sk") == col("inv_dsk"))
+               .select(col("inv_item_sk")).distinct())
+    sold = (t[fact]
+            .join(t["date_dim"].filter(col("d_year") == 2000)
+                  .select(col("d_date_sk").alias("sold_dsk")),
+                  on=col(date_key) == col("sold_dsk"))
+            .select(col(item_key).alias("sold_item")).distinct())
+    return (it
+            .join(stocked, on=col("i_item_sk") == col("inv_item_sk"),
+                  how="left_semi")
+            .join(sold, on=col("i_item_sk") == col("sold_item"),
+                  how="left_semi")
+            .select(col("i_item_id"), col("i_item_desc"),
+                    col("i_current_price"))
+            .order_by(col("i_item_id"))
+            .limit(100))
+
+
+def q37(t):
+    """Catalog items in a price band with inventory on hand (inventory
+    semi-join; spec window widened to the year for tiny-sf population)."""
+    return _inventory_price_band(t, "catalog_sales", "cs_sold_date_sk",
+                                 "cs_item_sk")
+
+
+def q82(t):
+    """q37's store twin."""
+    return _inventory_price_band(t, "store_sales", "ss_sold_date_sk",
+                                 "ss_item_sk")
+
+
+def q93(t):
+    """Per-customer effective sales after backing out returns for one
+    return reason (sale left-joined to its returns on ticket+item)."""
+    sr = (t["store_returns"]
+          .join(t["reason"].filter(col("r_reason_desc") == "reason 3"),
+                on=col("sr_reason_sk") == col("r_reason_sk"))
+          .select(col("sr_ticket_number").alias("rt"),
+                  col("sr_item_sk").alias("ri"),
+                  col("sr_return_quantity")))
+    act = (t["store_sales"]
+           .join(sr, on=(col("ss_ticket_number") == col("rt"))
+                 & (col("ss_item_sk") == col("ri")), how="left")
+           .with_column(
+               "act_sales",
+               F.when(~col("sr_return_quantity").is_null(),
+                      (col("ss_quantity") - col("sr_return_quantity"))
+                      * col("ss_sales_price"))
+               .otherwise(col("ss_quantity") * col("ss_sales_price"))))
+    return (act.group_by(col("ss_customer_sk"))
+            .agg(F.sum(col("act_sales")).alias("sumsales"))
+            .order_by(col("sumsales").desc(), col("ss_customer_sk"))
+            .limit(100))
+
+
 QUERIES = {n: globals()[f"q{n}"] for n in
            (1, 3, 5, 6, 7, 8, 10, 12, 13, 15, 19, 20, 25, 26, 27, 29,
-            31, 33, 34, 35, 36, 38, 42, 43, 45, 46, 47, 48, 52, 54, 55,
-            56, 57, 58, 59, 60, 65, 68, 69, 73, 79, 87, 88, 89, 92, 96,
-            98)}
+            31, 33, 34, 35, 36, 37, 38, 42, 43, 45, 46, 47, 48, 52, 54,
+            55, 56, 57, 58, 59, 60, 65, 68, 69, 73, 79, 82, 87, 88, 89,
+            92, 93, 96, 98)}
 

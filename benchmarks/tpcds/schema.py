@@ -76,7 +76,19 @@ STORE_RETURNS = Schema([
     F("sr_returned_date_sk", LongType), F("sr_store_sk", LongType),
     F("sr_return_amt", DoubleType), F("sr_net_loss", DoubleType),
     F("sr_item_sk", LongType), F("sr_customer_sk", LongType),
-    F("sr_ticket_number", LongType), F("sr_return_quantity", LongType)])
+    F("sr_ticket_number", LongType), F("sr_return_quantity", LongType),
+    F("sr_reason_sk", LongType)])
+
+WAREHOUSE = Schema([
+    F("w_warehouse_sk", LongType), F("w_warehouse_name", StringType)])
+
+INVENTORY = Schema([
+    F("inv_date_sk", LongType), F("inv_item_sk", LongType),
+    F("inv_warehouse_sk", LongType),
+    F("inv_quantity_on_hand", LongType)])
+
+REASON = Schema([
+    F("r_reason_sk", LongType), F("r_reason_desc", StringType)])
 
 CATALOG_SALES = Schema([
     F("cs_sold_date_sk", LongType), F("cs_catalog_page_sk", LongType),
@@ -122,5 +134,6 @@ SCHEMAS = {
     "catalog_sales": CATALOG_SALES, "catalog_returns": CATALOG_RETURNS,
     "web_sales": WEB_SALES, "web_returns": WEB_RETURNS,
     "catalog_page": CATALOG_PAGE, "web_site": WEB_SITE,
-    "call_center": CALL_CENTER,
+    "call_center": CALL_CENTER, "warehouse": WAREHOUSE,
+    "inventory": INVENTORY, "reason": REASON,
 }
