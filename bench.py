@@ -209,6 +209,19 @@ def child_main(mode: str) -> None:
         from spark_rapids_tpu.utils.cpu_backend import force_cpu_backend
         force_cpu_backend()
     import jax
+    # persistent compilation cache: the q1/q5 whole-stage programs cost
+    # 40s+ to compile on the tunneled chip; caching them on disk makes
+    # every bench rerun (including the driver's end-of-round run) start
+    # from warm compiles
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           "/tmp/jax_bench_cache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass  # cache is an optimization, never a dependency
     platform = jax.devices()[0].platform
     emit("backend", platform=platform, t=time.time() - t0)
     checkpoint("backend")

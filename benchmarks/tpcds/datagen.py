@@ -312,6 +312,16 @@ def generate(sf: float = 0.001, seed: int = 7):
                                         2).tolist(),
     }
 
+    # catalog/web orders span ~3 line items (dsdgen baskets), so the
+    # multi-warehouse-order EXISTS queries (q16/q94/q95) have real
+    # multi-row orders to find.  A reassignment, not a draw, placed
+    # BEFORE web_returns/catalog_returns copy order numbers from their
+    # sales rows, so returns stay consistent with their orders.
+    out["catalog_sales"]["cs_order_number"] = \
+        [i // 3 + 1 for i in range(n_cs)]
+    out["web_sales"]["ws_order_number"] = \
+        [i // 3 + 1 for i in range(n_ws)]
+
     # omni-channel overlap: the set-operation queries (q38 INTERSECT /
     # q87 EXCEPT) compare (customer, date) sets ACROSS channels, and at
     # tiny scale factors independent uniform draws never collide — pin
@@ -365,11 +375,19 @@ def generate(sf: float = 0.001, seed: int = 7):
         "w_warehouse_name": [f"warehouse {i}"
                              for i in range(1, n_wh + 1)],
     }
-    n_inv = max(500, int(1_000_000 * sf))
+    # weekly snapshots for every (item, warehouse) pair, like dsdgen's
+    # inventory (items capped so the row count stays bounded at bench
+    # scale factors; the variability queries q39/q21 need every pair
+    # present in every month, not a sparse random sample)
+    inv_items = min(n_item, 400)
+    weekly = date_sks[::7]
+    wk, it_, wh_ = np.meshgrid(weekly, np.arange(1, inv_items + 1),
+                               np.arange(1, n_wh + 1), indexing="ij")
+    n_inv = wk.size
     out["inventory"] = {
-        "inv_date_sk": rng.choice(date_sks, n_inv).tolist(),
-        "inv_item_sk": rng.randint(1, n_item + 1, n_inv).tolist(),
-        "inv_warehouse_sk": rng.randint(1, n_wh + 1, n_inv).tolist(),
+        "inv_date_sk": wk.ravel().tolist(),
+        "inv_item_sk": it_.ravel().tolist(),
+        "inv_warehouse_sk": wh_.ravel().tolist(),
         "inv_quantity_on_hand": rng.randint(0, 1000, n_inv).tolist(),
     }
 
@@ -380,6 +398,122 @@ def generate(sf: float = 0.001, seed: int = 7):
     # store returns carry a reason for q93's per-reason adjustment
     out["store_returns"]["sr_reason_sk"] = \
         rng.randint(1, 10, n_sr).tolist()
+
+    # ---------------------------------------------------------------
+    # Columns and tables for the shipping/returns/demographic queries
+    # (q16/q24/q30/q32/q40/q49/q62/q66/q71/q72/q75-q78/q80/q81/q83-q85/
+    # q90/q91/q94/q95/q99).  ALL new draws happen after every original
+    # draw so the original columns' rng stream — and therefore every
+    # already-anchored query result — is unchanged.
+    # ---------------------------------------------------------------
+    sm_types = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "LIBRARY"]
+    sm_carriers = ["UPS", "FEDEX", "AIRBORNE", "USPS"]
+    out["ship_mode"] = {
+        "sm_ship_mode_sk": list(range(1, 21)),
+        "sm_type": [sm_types[i % 5] for i in range(20)],
+        "sm_carrier": [sm_carriers[i % 4] for i in range(20)],
+    }
+    n_wp = max(5, int(60 * sf * 10))
+    out["web_page"] = {
+        "wp_web_page_sk": list(range(1, n_wp + 1)),
+        "wp_char_count": rng.randint(2500, 7500, n_wp).tolist(),
+    }
+    out["income_band"] = {
+        "ib_income_band_sk": list(range(1, 21)),
+        "ib_lower_bound": [i * 10_000 for i in range(20)],
+        "ib_upper_bound": [(i + 1) * 10_000 for i in range(20)],
+    }
+    out["household_demographics"]["hd_income_band_sk"] = \
+        rng.randint(1, 21, n_hd).tolist()
+    # deterministic cycle: every market id up to n_store exists, so
+    # q24's single-market cut is never empty
+    out["store"]["s_market_id"] = \
+        [(i % 10) + 1 for i in range(n_store)]
+    countries = ["UNITED STATES", "CANADA", "MEXICO", "BRAZIL", "JAPAN",
+                 "GERMANY"]
+    out["customer"]["c_birth_country"] = \
+        [countries[i % 6] for i in range(n_cust)]
+    out["store_returns"]["sr_cdemo_sk"] = \
+        rng.randint(1, n_cd + 1, n_sr).tolist()
+
+    # web_sales: quantities/prices, shipping control plane, promo/time/
+    # page keys.  Ship date = sold date + a 1..120-day lag (date_sks are
+    # consecutive, so sk arithmetic IS date arithmetic), clipped to the
+    # calendar.
+    last_sk = int(date_sks[-1])
+    ws_sold = np.asarray(out["web_sales"]["ws_sold_date_sk"])
+    out["web_sales"].update({
+        "ws_quantity": rng.randint(1, 101, n_ws).tolist(),
+        "ws_list_price": np.round(rng.uniform(1.0, 200.0, n_ws),
+                                  2).tolist(),
+        "ws_sales_price": np.round(rng.uniform(0.5, 180.0, n_ws),
+                                   2).tolist(),
+        "ws_ship_date_sk": np.minimum(
+            ws_sold + rng.randint(1, 121, n_ws), last_sk).tolist(),
+        "ws_warehouse_sk": rng.randint(1, n_wh + 1, n_ws).tolist(),
+        "ws_ship_mode_sk": rng.randint(1, 21, n_ws).tolist(),
+        "ws_promo_sk": rng.randint(1, n_promo + 1, n_ws).tolist(),
+        "ws_sold_time_sk": rng.randint(0, 1440, n_ws).tolist(),
+        "ws_web_page_sk": rng.randint(1, n_wp + 1, n_ws).tolist(),
+        "ws_ship_customer_sk": rng.randint(1, n_cust + 1, n_ws).tolist(),
+        "ws_ship_addr_sk": rng.randint(1, n_ca + 1, n_ws).tolist(),
+        "ws_ship_hdemo_sk": rng.randint(1, n_hd + 1, n_ws).tolist(),
+    })
+    cs_sold = np.asarray(out["catalog_sales"]["cs_sold_date_sk"])
+    out["catalog_sales"].update({
+        "cs_ship_date_sk": np.minimum(
+            cs_sold + rng.randint(1, 121, n_cs), last_sk).tolist(),
+        "cs_ship_mode_sk": rng.randint(1, 21, n_cs).tolist(),
+        "cs_warehouse_sk": rng.randint(1, n_wh + 1, n_cs).tolist(),
+        "cs_ship_addr_sk": rng.randint(1, n_ca + 1, n_cs).tolist(),
+        "cs_ext_discount_amt": np.round(rng.uniform(0.0, 500.0, n_cs),
+                                        2).tolist(),
+        "cs_sold_time_sk": rng.randint(0, 1440, n_cs).tolist(),
+        "cs_ship_hdemo_sk": rng.randint(1, n_hd + 1, n_cs).tolist(),
+    })
+    # catalog returns reference a sold catalog order (item, order) the
+    # way web_returns reference web orders, so return-aware catalog
+    # queries (q16/q49/q78/q83) resolve
+    cr_pick = rng.randint(0, n_cs, n_cr)
+    out["catalog_returns"].update({
+        "cr_item_sk": [out["catalog_sales"]["cs_item_sk"][i]
+                       for i in cr_pick],
+        "cr_order_number": [out["catalog_sales"]["cs_order_number"][i]
+                            for i in cr_pick],
+        "cr_call_center_sk": rng.randint(1, n_cc + 1, n_cr).tolist(),
+        "cr_returning_customer_sk":
+            rng.randint(1, n_cust + 1, n_cr).tolist(),
+        "cr_return_quantity": rng.randint(1, 51, n_cr).tolist(),
+    })
+    out["web_returns"].update({
+        "wr_returning_customer_sk":
+            rng.randint(1, n_cust + 1, n_wr).tolist(),
+        "wr_reason_sk": rng.randint(1, 10, n_wr).tolist(),
+        "wr_return_quantity": rng.randint(1, 51, n_wr).tolist(),
+        "wr_refunded_cdemo_sk": rng.randint(1, n_cd + 1, n_wr).tolist(),
+        "wr_refunded_addr_sk": rng.randint(1, n_ca + 1, n_wr).tolist(),
+        "wr_web_page_sk": rng.randint(1, n_wp + 1, n_wr).tolist(),
+    })
+    # the refunding and returning person are the same household (as in
+    # dsdgen), so q85's paired-demographics equality can match
+    out["web_returns"]["wr_returning_cdemo_sk"] = \
+        list(out["web_returns"]["wr_refunded_cdemo_sk"])
+    # stores share the customer-address zip space so q24's zip equi-join
+    # resolves (an override, not a draw: the rng stream is untouched)
+    out["store"]["s_zip"] = [out["customer_address"]["ca_zip"][i % n_ca]
+                             for i in range(n_store)]
+
+    # q76's NULL-key channel rows (dsdgen leaves these fks null for a
+    # fraction of rows; every other query inner-joins them away on both
+    # engines).  The nulled slice starts past the pinned omni/solo rows.
+    null_n = max(6, n_ss // 200)
+    lo = k_omni + k_solo + 2
+    for i in range(lo, min(lo + null_n, n_ss)):
+        out["store_sales"]["ss_store_sk"][i] = None
+    for i in range(min(null_n, n_ws)):
+        out["web_sales"]["ws_ship_customer_sk"][i] = None
+    for i in range(min(null_n, n_cs)):
+        out["catalog_sales"]["cs_ship_addr_sk"][i] = None
     return out
 
 

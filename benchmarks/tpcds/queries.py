@@ -1,10 +1,23 @@
-"""TPC-DS star-join queries in the DataFrame API (public TPC-DS spec
-templates, expressed in this repo's own DSL — BASELINE.md staged config 3).
+"""The FULL TPC-DS query suite, q1-q99, in the DataFrame API (public
+TPC-DS spec templates, expressed in this repo's own DSL — BASELINE.md
+staged config 3; breadth model: the reference's TPC-DS/TPCxBB drivers
+under integration_tests).
 
-Each `qN(t)` takes {table_name: DataFrame} and returns a DataFrame.  The
-shapes exercised: dimension broadcast joins into the store_sales fact,
-multi-dimension chains, string-prefix anti-conditions (q19), and the
-pure-count multi-way join (q96)."""
+Each `qN(t)` takes {table_name: DataFrame} and returns a DataFrame.
+Every query shape in the spec is exercised: star joins, multi-fact
+chains, EXISTS/NOT-EXISTS rewrites (semi/anti joins), INTERSECT/EXCEPT
+(semi/anti chains), year-over-year self joins, rank/cumulative windows
+over aggregates, ROLLUPs, FULL OUTER channel joins, and scalar-subquery
+composition (driver-side, the tpch q11/q15/q22 convention).
+
+Tiny-scale-factor conventions, applied consistently and documented per
+query: substitution parameters are chosen from the generator's populated
+domains (the spec draws them from the data the same way); a handful of
+1-in-N single-bin predicates are widened to a band of bins when one bin
+of a tiny table selects nothing; monthly granularity stands in for the
+spec's week_seq, which the tiny date_dim does not carry; and columns the
+tiny tables do not carry use the closest generated stand-in (noted in
+each docstring)."""
 from __future__ import annotations
 
 from spark_rapids_tpu.plan.logical import col, functions as F, lit
@@ -1387,9 +1400,1781 @@ def q93(t):
             .limit(100))
 
 
-QUERIES = {n: globals()[f"q{n}"] for n in
-           (1, 3, 5, 6, 7, 8, 10, 12, 13, 15, 19, 20, 25, 26, 27, 29,
-            31, 33, 34, 35, 36, 37, 38, 42, 43, 45, 46, 47, 48, 52, 54,
-            55, 56, 57, 58, 59, 60, 65, 68, 69, 73, 79, 82, 87, 88, 89,
-            92, 93, 96, 98)}
+def q21(t):
+    """Warehouse inventory balance around a pivot date: on-hand before vs
+    after, kept when the ratio stays within [2/3, 3/2]."""
+    dd = t["date_dim"].filter(col("d_date").between("2000-02-10",
+                                                    "2000-04-10"))
+    it = t["item"].filter(col("i_current_price").between(0.99, 60.0))
+    return (t["inventory"]
+            .join(dd, on=col("inv_date_sk") == col("d_date_sk"))
+            .join(it, on=col("inv_item_sk") == col("i_item_sk"))
+            .join(t["warehouse"],
+                  on=col("inv_warehouse_sk") == col("w_warehouse_sk"))
+            .group_by(col("w_warehouse_name"), col("i_item_id"))
+            .agg(F.sum(F.when(col("d_date") < "2000-03-11",
+                              col("inv_quantity_on_hand"))
+                       .otherwise(0)).alias("inv_before"),
+                 F.sum(F.when(col("d_date") >= "2000-03-11",
+                              col("inv_quantity_on_hand"))
+                       .otherwise(0)).alias("inv_after"))
+            .filter(F.when(col("inv_before") > 0,
+                           col("inv_after") / col("inv_before"))
+                    .otherwise(0.0).between(2.0 / 3.0, 3.0 / 2.0))
+            .order_by(col("w_warehouse_name"), col("i_item_id"))
+            .limit(100))
+
+
+def q22(t):
+    """Average inventory on hand over a year, ROLLUP'd down the product
+    hierarchy (category/brand/class/item; i_item_desc stands in for the
+    spec's i_product_name, which the tiny-sf item table does not carry)."""
+    dd = t["date_dim"].filter(col("d_month_seq").between(24, 35))
+    return (t["inventory"]
+            .join(dd, on=col("inv_date_sk") == col("d_date_sk"))
+            .join(t["item"], on=col("inv_item_sk") == col("i_item_sk"))
+            .rollup(col("i_category"), col("i_brand"), col("i_class"),
+                    col("i_item_desc"))
+            .agg(F.avg(col("inv_quantity_on_hand")).alias("qoh"))
+            .order_by(col("qoh"), col("i_category"), col("i_brand"),
+                      col("i_class"), col("i_item_desc"))
+            .limit(100))
+
+
+def q41(t):
+    """Manufacturers with at least one item in the queried color set —
+    the spec's correlated count(*)>0 subquery as a distinct semi-join
+    (i_item_desc stands in for i_product_name)."""
+    inner = (t["item"]
+             .filter(col("i_color").isin("red", "navy", "slate"))
+             .select(col("i_manufact").alias("m_manufact"))
+             .distinct())
+    return (t["item"]
+            .filter(col("i_manufact_id").between(5, 15))
+            .join(inner, on=col("i_manufact") == col("m_manufact"),
+                  how="left_semi")
+            .select(col("i_item_desc")).distinct()
+            .order_by(col("i_item_desc"))
+            .limit(100))
+
+
+def q44(t):
+    """Best and worst ten items by average store net profit, paired rank
+    by rank (two opposite-order rank windows joined on position)."""
+    from spark_rapids_tpu.plan.logical import Window
+    perf = (t["store_sales"]
+            .filter(col("ss_store_sk") == 4)
+            .group_by(col("ss_item_sk"))
+            .agg(F.avg(col("ss_net_profit")).alias("rank_col")))
+    asc = (perf.with_column(
+        "rnk", F.rank().over(Window.order_by(col("rank_col").asc())))
+        .filter(col("rnk") < 11)
+        .select(col("ss_item_sk").alias("worst_sk"), col("rnk")))
+    desc = (perf.with_column(
+        "rnk2", F.rank().over(Window.order_by(col("rank_col").desc())))
+        .filter(col("rnk2") < 11)
+        .select(col("ss_item_sk").alias("best_sk"), col("rnk2")))
+    i1 = t["item"].select(col("i_item_sk").alias("i1_sk"),
+                          col("i_item_desc").alias("best_performing"))
+    i2 = t["item"].select(col("i_item_sk").alias("i2_sk"),
+                          col("i_item_desc").alias("worst_performing"))
+    return (asc.join(desc, on=col("rnk") == col("rnk2"))
+            .join(i1, on=col("best_sk") == col("i1_sk"))
+            .join(i2, on=col("worst_sk") == col("i2_sk"))
+            .select(col("rnk"), col("best_performing"),
+                    col("worst_performing"))
+            .order_by(col("rnk"))
+            .limit(100))
+
+
+def _quarterly_deviation(t, attr_col, period_col):
+    """Shared q53/q63 shape: per-{manufacturer,manager} period sales vs
+    the attribute's average over all periods (window over agg), keeping
+    periods deviating by more than 10%."""
+    from spark_rapids_tpu.plan.logical import Window
+    dd = t["date_dim"].filter(col("d_month_seq").between(12, 23))
+    it = t["item"].filter(
+        (col("i_category").isin("Books", "Children", "Electronics")
+         & col("i_class").isin("class#1", "class#3", "class#5"))
+        | (col("i_category").isin("Women", "Music", "Men")
+           & col("i_class").isin("class#2", "class#4", "class#6")))
+    sums = (t["store_sales"]
+            .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+            .join(dd, on=col("ss_sold_date_sk") == col("d_date_sk"))
+            .join(t["store"], on=col("ss_store_sk") == col("s_store_sk"))
+            .group_by(col(attr_col), col(period_col))
+            .agg(F.sum(col("ss_sales_price")).alias("sum_sales")))
+    w = Window.partition_by(col(attr_col))
+    return (sums
+            .with_column("avg_quarterly_sales",
+                         F.avg(col("sum_sales")).over(w))
+            .filter(F.when(col("avg_quarterly_sales") > 0.0,
+                           F.abs(col("sum_sales")
+                                 - col("avg_quarterly_sales"))
+                           / col("avg_quarterly_sales")).otherwise(0.0)
+                    > 0.1)
+            .order_by(col("avg_quarterly_sales"), col("sum_sales"),
+                      col(attr_col))
+            .limit(100))
+
+
+def q53(t):
+    """Manufacturer quarterly sales deviating from their yearly average."""
+    return _quarterly_deviation(t, "i_manufact_id", "d_qoy")
+
+
+def q63(t):
+    """q53's manager/monthly twin."""
+    return _quarterly_deviation(t, "i_manager_id", "d_moy")
+
+
+def q67(t):
+    """Store/item sales ROLLUP down the full product-time hierarchy with
+    a top-100-per-category rank (i_item_id and s_store_name stand in for
+    the spec's i_product_name and s_store_id)."""
+    from spark_rapids_tpu.plan.logical import Window
+    dd = t["date_dim"].filter(col("d_month_seq").between(24, 35))
+    rolled = (t["store_sales"]
+              .join(dd, on=col("ss_sold_date_sk") == col("d_date_sk"))
+              .join(t["store"],
+                    on=col("ss_store_sk") == col("s_store_sk"))
+              .join(t["item"], on=col("ss_item_sk") == col("i_item_sk"))
+              .rollup(col("i_category"), col("i_class"), col("i_brand"),
+                      col("i_item_id"), col("d_year"), col("d_qoy"),
+                      col("d_moy"), col("s_store_name"))
+              .agg(F.sum(col("ss_sales_price") * col("ss_quantity"))
+                   .alias("sumsales")))
+    w = Window.partition_by(col("i_category")) \
+        .order_by(col("sumsales").desc())
+    return (rolled.with_column("rk", F.rank().over(w))
+            .filter(col("rk") <= 100)
+            .order_by(col("i_category"), col("i_class"), col("i_brand"),
+                      col("i_item_id"), col("d_year"), col("d_qoy"),
+                      col("d_moy"), col("s_store_name"), col("sumsales"),
+                      col("rk"))
+            .limit(100))
+
+
+def q70(t):
+    """Profit ROLLUP by state/county, restricted to the five most
+    profitable states (rank window over an aggregate, semi-joined back
+    into the store dimension)."""
+    from spark_rapids_tpu.plan.logical import Window
+    dd = t["date_dim"].filter(col("d_month_seq").between(24, 35))
+    state_rank = (t["store_sales"]
+                  .join(dd, on=col("ss_sold_date_sk") == col("d_date_sk"))
+                  .join(t["store"],
+                        on=col("ss_store_sk") == col("s_store_sk"))
+                  .group_by(col("s_state"))
+                  .agg(F.sum(col("ss_net_profit")).alias("sp"))
+                  .with_column("r", F.rank().over(
+                      Window.order_by(col("sp").desc())))
+                  .filter(col("r") <= 5)
+                  .select(col("s_state").alias("top_state")))
+    st = t["store"].join(state_rank,
+                         on=col("s_state") == col("top_state"),
+                         how="left_semi")
+    return (t["store_sales"]
+            .join(dd, on=col("ss_sold_date_sk") == col("d_date_sk"))
+            .join(st, on=col("ss_store_sk") == col("s_store_sk"))
+            .rollup(col("s_state"), col("s_county"))
+            .agg(F.sum(col("ss_net_profit")).alias("total_sum"))
+            .order_by(col("total_sum").desc(), col("s_state"),
+                      col("s_county"))
+            .limit(100))
+
+
+def q86(t):
+    """q36's web twin: net-paid ROLLUP by category/class with an
+    in-category rank (ws_ext_sales_price stands in for ws_net_paid)."""
+    from spark_rapids_tpu.plan.logical import Window
+    dd = t["date_dim"].filter(col("d_month_seq").between(24, 35))
+    rolled = (t["web_sales"]
+              .join(dd, on=col("ws_sold_date_sk") == col("d_date_sk"))
+              .join(t["item"], on=col("ws_item_sk") == col("i_item_sk"))
+              .rollup(col("i_category"), col("i_class"))
+              .agg(F.sum(col("ws_ext_sales_price"))
+                   .alias("total_sum")))
+    w = Window.partition_by(col("i_category")) \
+        .order_by(col("total_sum").desc())
+    return (rolled
+            .with_column("rank_within_parent", F.rank().over(w))
+            .order_by(col("i_category"), col("rank_within_parent"))
+            .limit(100))
+
+
+def q97(t):
+    """Channel overlap of (customer, item) purchase pairs: store vs
+    catalog FULL OUTER join, counted into store-only / catalog-only /
+    both buckets."""
+    dd = t["date_dim"].filter(col("d_month_seq").between(24, 35))
+    ssci = (t["store_sales"]
+            .join(dd, on=col("ss_sold_date_sk") == col("d_date_sk"))
+            .select(col("ss_customer_sk").alias("sc"),
+                    col("ss_item_sk").alias("si"))
+            .distinct())
+    csci = (t["catalog_sales"]
+            .join(dd, on=col("cs_sold_date_sk") == col("d_date_sk"))
+            .select(col("cs_bill_customer_sk").alias("cc"),
+                    col("cs_item_sk").alias("ci"))
+            .distinct())
+    return (ssci.join(csci, on=(col("sc") == col("cc"))
+                      & (col("si") == col("ci")), how="full")
+            .agg(F.sum(F.when(col("sc").is_not_null()
+                              & col("cc").is_null(), 1).otherwise(0))
+                 .alias("store_only"),
+                 F.sum(F.when(col("sc").is_null()
+                              & col("cc").is_not_null(), 1).otherwise(0))
+                 .alias("catalog_only"),
+                 F.sum(F.when(col("sc").is_not_null()
+                              & col("cc").is_not_null(), 1).otherwise(0))
+                 .alias("store_and_catalog")))
+
+
+def q2(t):
+    """Year-over-year web+catalog day-of-week ratios (q59's two-channel
+    twin: the channels union BEFORE the pivot; monthly granularity stands
+    in for week_seq as in q59)."""
+    wscs = (t["web_sales"]
+            .select(col("ws_sold_date_sk").alias("sold_date_sk"),
+                    col("ws_ext_sales_price").alias("sales_price"))
+            .union(t["catalog_sales"]
+                   .select(col("cs_sold_date_sk").alias("sold_date_sk"),
+                           col("cs_ext_sales_price")
+                           .alias("sales_price"))))
+
+    def pivot(year, prefix):
+        dd = t["date_dim"].filter(col("d_year") == year)
+        sums = [F.sum(F.when(col("d_day_name") == day,
+                             col("sales_price")).otherwise(0.0))
+                .alias(f"{prefix}_{day[:3].lower()}")
+                for day in ["Sunday", "Monday", "Tuesday", "Wednesday",
+                            "Thursday", "Friday", "Saturday"]]
+        return (wscs.join(dd,
+                          on=col("sold_date_sk") == col("d_date_sk"))
+                .group_by(col("d_moy"))
+                .agg(*sums)
+                .select(col("d_moy").alias(f"{prefix}_moy"),
+                        *[col(f"{prefix}_{d}") for d in
+                          ("sun", "mon", "tue", "wed", "thu", "fri",
+                           "sat")]))
+
+    y1, y2 = pivot(2001, "a"), pivot(2002, "b")
+    out = [col("a_moy")]
+    for d in ("sun", "mon", "tue", "wed", "thu", "fri", "sat"):
+        out.append(F.round(col("b_{0}".format(d))
+                           / col("a_{0}".format(d)), 2)
+                   .alias(f"r_{d}"))
+    return (y1.join(y2, on=col("a_moy") == col("b_moy"))
+            .select(*out)
+            .order_by(col("a_moy"))
+            .limit(100))
+
+
+def q9(t):
+    """Five quantity-band CASE picks (bucket count decides whether the
+    discount or the profit average is reported), composed driver-side
+    from per-band aggregates like the other scalar-subquery queries
+    (q88/tpch q11)."""
+    bands = [(1, 20, 74129), (21, 40, 122840), (41, 60, 56580),
+             (61, 80, 10097), (81, 100, 165306)]
+    data = {}
+    for i, (lo, hi, thresh) in enumerate(bands, start=1):
+        row = (t["store_sales"]
+               .filter(col("ss_quantity").between(lo, hi))
+               .agg(F.count(lit(1)).alias("cnt"),
+                    F.avg(col("ss_ext_discount_amt")).alias("disc"),
+                    F.avg(col("ss_net_profit")).alias("prof"))
+               .collect()[0])
+        cnt, disc, prof = row
+        # the spec's threshold count scaled to the tiny-sf row budget
+        data[f"bucket{i}"] = [float(disc if (cnt or 0) > thresh * 1e-4
+                                    else prof)]
+    return t["store_sales"].session.from_pydict(data)
+
+
+def q17(t):
+    """Quantity statistics (mean + stdev + coefficient of variation) over
+    the sale->return->catalog-repurchase chain, by item and store state.
+    stdev_samp is composed from sum/sum-of-squares/count, the same
+    decomposition the engine's two-pass variance would use."""
+    joined = _sale_return_catalog(
+        t, col("d_qoy") == 1, col("d_qoy").isin(1, 2, 3),
+        col("d_qoy").isin(1, 2, 3))
+
+    def stats(q, name):
+        n = F.count(lit(1))
+        s = F.sum(q)
+        s2 = F.sum(q * q)
+        return [n.alias(f"{name}_count"), s.alias(f"{name}_sum"),
+                s2.alias(f"{name}_sumsq")]
+
+    aggd = (joined
+            .group_by(col("i_item_id"), col("i_item_desc"),
+                      col("s_state"))
+            .agg(*(stats(col("ss_quantity").cast("double"), "ss")
+                   + stats(col("sr_return_quantity").cast("double"), "sr")
+                   + stats(col("cs_quantity").cast("double"), "cs"))))
+    out = [col("i_item_id"), col("i_item_desc"), col("s_state")]
+    for name in ("ss", "sr", "cs"):
+        n, s, s2 = (col(f"{name}_count"), col(f"{name}_sum"),
+                    col(f"{name}_sumsq"))
+        mean = s / n
+        var = F.when(n > 1, (s2 - s * s / n) / (n - 1)).otherwise(0.0)
+        out += [n.alias(f"{name}_qty_count"),
+                mean.alias(f"{name}_qty_av"),
+                F.sqrt(var).alias(f"{name}_qty_stdev"),
+                (F.sqrt(var) / mean).alias(f"{name}_qty_cov")]
+    return (aggd.select(*out)
+            .order_by(col("i_item_id"), col("i_item_desc"),
+                      col("s_state"))
+            .limit(100))
+
+
+def q18(t):
+    """Catalog purchase averages for a demographic slice, ROLLUP'd down
+    the customer geography (the spec's c_birth_year output is omitted:
+    the tiny-sf customer table carries birth month only)."""
+    cd = t["customer_demographics"].filter(
+        (col("cd_gender") == "F")
+        & (col("cd_education_status") == "Unknown"))
+    cust = t["customer"].filter(col("c_birth_month").isin(1, 6, 8, 9,
+                                                          12, 2))
+    dd = t["date_dim"].filter(col("d_year") == 1998)
+    return (t["catalog_sales"]
+            .join(cd, on=col("cs_bill_cdemo_sk") == col("cd_demo_sk"))
+            .join(cust,
+                  on=col("cs_bill_customer_sk") == col("c_customer_sk"))
+            .join(t["customer_address"],
+                  on=col("c_current_addr_sk") == col("ca_address_sk"))
+            .join(dd, on=col("cs_sold_date_sk") == col("d_date_sk"))
+            .join(t["item"], on=col("cs_item_sk") == col("i_item_sk"))
+            .rollup(col("ca_country"), col("ca_state"), col("ca_county"),
+                    col("i_item_id"))
+            .agg(F.avg(col("cs_quantity").cast("double")).alias("agg1"),
+                 F.avg(col("cs_list_price")).alias("agg2"),
+                 F.avg(col("cs_coupon_amt")).alias("agg3"),
+                 F.avg(col("cs_sales_price")).alias("agg4"))
+            .order_by(col("ca_country"), col("ca_state"),
+                      col("ca_county"), col("i_item_id"))
+            .limit(100))
+
+
+def q28(t):
+    """Six list-price band statistics (avg + count + distinct count per
+    band), composed driver-side like q88/q9."""
+    bands = [(0, 5, 11, 40, 14), (6, 10, 91, 200, 108),
+             (11, 15, 66, 350, 123), (16, 20, 142, 500, 272),
+             (21, 25, 135, 650, 146), (26, 30, 28, 800, 123)]
+    data = {}
+    for i, (qlo, qhi, plo, wlo, clo) in enumerate(bands, 1):
+        row = (t["store_sales"]
+               .filter(col("ss_quantity").between(qlo, qhi)
+                       & (col("ss_list_price").between(plo, plo + 10)
+                          | col("ss_coupon_amt").between(clo, clo + 1000)
+                          | col("ss_ext_wholesale_cost")
+                          .between(wlo, wlo + 100)))
+               .agg(F.avg(col("ss_list_price")).alias("a"),
+                    F.count(col("ss_list_price")).alias("c"),
+                    F.count_distinct(col("ss_list_price")).alias("d"))
+               .collect()[0])
+        data[f"b{i}_avg"] = [float(row[0] or 0.0)]
+        data[f"b{i}_count"] = [int(row[1] or 0)]
+        data[f"b{i}_distinct"] = [int(row[2] or 0)]
+    return t["store_sales"].session.from_pydict(data)
+
+
+def q39(t):
+    """Inventory demand variability: per (item, warehouse, month) mean
+    and stdev of on-hand quantity, consecutive months self-joined where
+    both months' coefficient of variation exceeds 0.3 (the spec's 1.0
+    threshold, scaled to the generator's uniform quantities whose cov
+    tops out near 0.6; stdev composed from sum/sumsq/count as in q17)."""
+    dd = t["date_dim"].filter(col("d_year") == 2001)
+    base = (t["inventory"]
+            .join(dd, on=col("inv_date_sk") == col("d_date_sk"))
+            .join(t["item"], on=col("inv_item_sk") == col("i_item_sk"))
+            .join(t["warehouse"],
+                  on=col("inv_warehouse_sk") == col("w_warehouse_sk"))
+            .group_by(col("w_warehouse_sk"), col("i_item_sk"),
+                      col("d_moy"))
+            .agg(F.count(lit(1)).alias("n"),
+                 F.sum(col("inv_quantity_on_hand").cast("double"))
+                 .alias("s"),
+                 F.sum(col("inv_quantity_on_hand").cast("double")
+                       * col("inv_quantity_on_hand").cast("double"))
+                 .alias("s2")))
+    mean = col("s") / col("n")
+    var = F.when(col("n") > 1,
+                 (col("s2") - col("s") * col("s") / col("n"))
+                 / (col("n") - 1)).otherwise(0.0)
+    cov = (base
+           .with_column("mean", mean)
+           .with_column("cov", F.when(col("mean") == 0.0, 0.0)
+                        .otherwise(F.sqrt(var) / col("mean")))
+           .filter(col("cov") > 0.3))
+    m1 = cov.select(col("w_warehouse_sk").alias("w1"),
+                    col("i_item_sk").alias("i1"),
+                    col("d_moy").alias("moy1"),
+                    col("mean").alias("mean1"), col("cov").alias("cov1")) \
+        .filter(col("moy1") == 3)
+    m2 = cov.select(col("w_warehouse_sk").alias("w2"),
+                    col("i_item_sk").alias("i2"),
+                    col("d_moy").alias("moy2"),
+                    col("mean").alias("mean2"), col("cov").alias("cov2")) \
+        .filter(col("moy2") == 4)
+    return (m1.join(m2, on=(col("w1") == col("w2"))
+                    & (col("i1") == col("i2")))
+            .select(col("w1"), col("i1"), col("mean1"), col("cov1"),
+                    col("mean2"), col("cov2"))
+            .order_by(col("w1"), col("i1"), col("mean1"), col("cov1"),
+                      col("mean2"), col("cov2"))
+            .limit(100))
+
+
+def q50(t):
+    """Return-latency buckets per store: days between sale and return,
+    counted into <=30/31-60/61-90/91-120/>120 bands (date_dim joined
+    twice, once per side of the sale->return pair)."""
+    d1 = t["date_dim"].select(col("d_date_sk").alias("sold_dsk"),
+                              col("d_date").alias("sold_date"))
+    d2 = (t["date_dim"].filter((col("d_year") == 2001)
+                               & (col("d_moy") == 8))
+          .select(col("d_date_sk").alias("ret_dsk"),
+                  col("d_date").alias("ret_date")))
+    joined = (t["store_sales"]
+              .join(t["store_returns"],
+                    on=(col("ss_ticket_number") == col("sr_ticket_number"))
+                    & (col("ss_item_sk") == col("sr_item_sk"))
+                    & (col("ss_customer_sk") == col("sr_customer_sk")))
+              .join(d1, on=col("ss_sold_date_sk") == col("sold_dsk"))
+              .join(d2, on=col("sr_returned_date_sk") == col("ret_dsk"))
+              .join(t["store"],
+                    on=col("ss_store_sk") == col("s_store_sk"))
+              .with_column("lag_days", F.datediff(col("ret_date"),
+                                                  col("sold_date"))))
+    buckets = [
+        F.sum(F.when(col("lag_days") <= 30, 1).otherwise(0))
+        .alias("d30"),
+        F.sum(F.when((col("lag_days") > 30) & (col("lag_days") <= 60), 1)
+              .otherwise(0)).alias("d31_60"),
+        F.sum(F.when((col("lag_days") > 60) & (col("lag_days") <= 90), 1)
+              .otherwise(0)).alias("d61_90"),
+        F.sum(F.when((col("lag_days") > 90) & (col("lag_days") <= 120), 1)
+              .otherwise(0)).alias("d91_120"),
+        F.sum(F.when(col("lag_days") > 120, 1).otherwise(0))
+        .alias("d120plus")]
+    return (joined
+            .group_by(col("s_store_name"), col("s_company_name"),
+                      col("s_county"), col("s_city"), col("s_state"),
+                      col("s_zip"))
+            .agg(*buckets)
+            .order_by(col("s_store_name"), col("s_company_name"),
+                      col("s_county"), col("s_city"), col("s_state"),
+                      col("s_zip"))
+            .limit(100))
+
+
+def q51(t):
+    """Cumulative web vs store revenue per item over time: running sums
+    windowed per item, FULL OUTER joined on (item, period), kept while
+    the web cumulative exceeds the store cumulative (monthly periods
+    stand in for the spec's daily ones at tiny scale factors, the q59/q2
+    convention)."""
+    from spark_rapids_tpu.plan.logical import Window
+    dd = t["date_dim"].filter(col("d_month_seq").between(24, 35))
+
+    def cumulative(sales, item_c, date_c, price_c, prefix):
+        daily = (sales.join(dd, on=col(date_c) == col("d_date_sk"))
+                 .group_by(col(item_c), col("d_month_seq"))
+                 .agg(F.sum(col(price_c)).alias("daily")))
+        w = (Window.partition_by(col(item_c))
+             .order_by(col("d_month_seq"))
+             .rows_between(-(1 << 62), 0))
+        return (daily
+                .with_column("cume", F.sum(col("daily")).over(w))
+                .select(col(item_c).alias(f"{prefix}_item_sk"),
+                        col("d_month_seq").alias(f"{prefix}_date"),
+                        col("cume").alias(f"{prefix}_cume")))
+
+    web = cumulative(t["web_sales"], "ws_item_sk", "ws_sold_date_sk",
+                     "ws_ext_sales_price", "web")
+    store = cumulative(t["store_sales"], "ss_item_sk",
+                       "ss_sold_date_sk", "ss_ext_sales_price", "store")
+    return (web.join(store,
+                     on=(col("web_item_sk") == col("store_item_sk"))
+                     & (col("web_date") == col("store_date")),
+                     how="full")
+            .filter(col("web_cume") > col("store_cume"))
+            .select(F.coalesce(col("web_item_sk"), col("store_item_sk"))
+                    .alias("item_sk"),
+                    F.coalesce(col("web_date"), col("store_date"))
+                    .alias("d_date"),
+                    col("web_cume"), col("store_cume"))
+            .order_by(col("item_sk"), col("d_date"))
+            .limit(100))
+
+
+def q61(t):
+    """Promotional share of store revenue for one category and month:
+    promotional sales (email/event promos) over all sales, the two
+    single-row aggregates composed driver-side (q88's pattern)."""
+    dd = t["date_dim"].filter((col("d_year") == 1998)
+                              & (col("d_moy") == 11))
+    it = t["item"].filter(col("i_category") == "Jewelry")
+    st = t["store"].filter(col("s_gmt_offset") == -5.0)
+    base = (t["store_sales"]
+            .join(dd, on=col("ss_sold_date_sk") == col("d_date_sk"))
+            .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+            .join(st, on=col("ss_store_sk") == col("s_store_sk"))
+            .join(t["customer"],
+                  on=col("ss_customer_sk") == col("c_customer_sk"))
+            .join(t["customer_address"],
+                  on=col("c_current_addr_sk") == col("ca_address_sk"))
+            .filter(col("ca_gmt_offset") == -5.0))
+    promo = (base.join(t["promotion"],
+                       on=col("ss_promo_sk") == col("p_promo_sk"))
+             .filter((col("p_channel_email") == "Y")
+                     | (col("p_channel_event") == "Y"))
+             .agg(F.sum(col("ss_ext_sales_price")).alias("promotions"))
+             .collect()[0][0])
+    total = (base.agg(F.sum(col("ss_ext_sales_price")).alias("total"))
+             .collect()[0][0])
+    promo = float(promo or 0.0)
+    total = float(total or 0.0)
+    ratio = promo / total * 100.0 if total else 0.0
+    return t["store_sales"].session.from_pydict(
+        {"promotions": [promo], "total": [total], "ratio": [ratio]})
+
+
+def _year_total(t, sales_key, cust_key, date_key, price_col, year,
+                prefix):
+    """Per-customer yearly revenue for one channel — the q4/q11/q74
+    building block (ext_sales_price stands in for the spec's list-price
+    minus discount arithmetic, which the tiny-sf fact tables fold into
+    one column)."""
+    dd = t["date_dim"].filter(col("d_year") == year)
+    return (t[sales_key]
+            .join(dd, on=col(date_key) == col("d_date_sk"))
+            .join(t["customer"],
+                  on=col(cust_key) == col("c_customer_sk"))
+            .group_by(col("c_customer_sk"))
+            .agg(F.sum(col(price_col)).alias(f"{prefix}_total"))
+            .select(col("c_customer_sk").alias(f"{prefix}_cust"),
+                    col(f"{prefix}_total")))
+
+
+def q11(t):
+    """Customers whose web growth outpaced their store growth between two
+    years (four per-channel year totals self-joined per customer)."""
+    s1 = _year_total(t, "store_sales", "ss_customer_sk",
+                     "ss_sold_date_sk", "ss_ext_sales_price", 2001, "s1")
+    s2 = _year_total(t, "store_sales", "ss_customer_sk",
+                     "ss_sold_date_sk", "ss_ext_sales_price", 2002, "s2")
+    w1 = _year_total(t, "web_sales", "ws_bill_customer_sk",
+                     "ws_sold_date_sk", "ws_ext_sales_price", 2001, "w1")
+    w2 = _year_total(t, "web_sales", "ws_bill_customer_sk",
+                     "ws_sold_date_sk", "ws_ext_sales_price", 2002, "w2")
+    return (s1.join(s2, on=col("s1_cust") == col("s2_cust"))
+            .join(w1, on=col("s1_cust") == col("w1_cust"))
+            .join(w2, on=col("s1_cust") == col("w2_cust"))
+            .filter((col("s1_total") > 0) & (col("w1_total") > 0)
+                    & (col("w2_total") / col("w1_total")
+                       > col("s2_total") / col("s1_total")))
+            .join(t["customer"],
+                  on=col("s1_cust") == col("c_customer_sk"))
+            .select(col("c_customer_id"), col("c_first_name"),
+                    col("c_last_name"), col("c_preferred_cust_flag"))
+            .order_by(col("c_customer_id"))
+            .limit(100))
+
+
+def q4(t):
+    """q11 plus the catalog channel: customers whose catalog growth beats
+    store growth AND web growth beats store growth (six year totals)."""
+    s1 = _year_total(t, "store_sales", "ss_customer_sk",
+                     "ss_sold_date_sk", "ss_ext_sales_price", 2001, "s1")
+    s2 = _year_total(t, "store_sales", "ss_customer_sk",
+                     "ss_sold_date_sk", "ss_ext_sales_price", 2002, "s2")
+    c1 = _year_total(t, "catalog_sales", "cs_bill_customer_sk",
+                     "cs_sold_date_sk", "cs_ext_sales_price", 2001, "c1")
+    c2 = _year_total(t, "catalog_sales", "cs_bill_customer_sk",
+                     "cs_sold_date_sk", "cs_ext_sales_price", 2002, "c2")
+    w1 = _year_total(t, "web_sales", "ws_bill_customer_sk",
+                     "ws_sold_date_sk", "ws_ext_sales_price", 2001, "w1")
+    w2 = _year_total(t, "web_sales", "ws_bill_customer_sk",
+                     "ws_sold_date_sk", "ws_ext_sales_price", 2002, "w2")
+    return (s1.join(s2, on=col("s1_cust") == col("s2_cust"))
+            .join(c1, on=col("s1_cust") == col("c1_cust"))
+            .join(c2, on=col("s1_cust") == col("c2_cust"))
+            .join(w1, on=col("s1_cust") == col("w1_cust"))
+            .join(w2, on=col("s1_cust") == col("w2_cust"))
+            .filter((col("s1_total") > 0) & (col("c1_total") > 0)
+                    & (col("w1_total") > 0)
+                    & (col("c2_total") / col("c1_total")
+                       > col("s2_total") / col("s1_total"))
+                    & (col("w2_total") / col("w1_total")
+                       > col("s2_total") / col("s1_total")))
+            .join(t["customer"],
+                  on=col("s1_cust") == col("c_customer_sk"))
+            .select(col("c_customer_id"), col("c_first_name"),
+                    col("c_last_name"), col("c_preferred_cust_flag"))
+            .order_by(col("c_customer_id"))
+            .limit(100))
+
+
+def q74(t):
+    """q11's earlier-year twin (1999 vs 2000), kept as its own entry
+    because the spec's parameter bindings differ."""
+    s1 = _year_total(t, "store_sales", "ss_customer_sk",
+                     "ss_sold_date_sk", "ss_ext_sales_price", 1999, "s1")
+    s2 = _year_total(t, "store_sales", "ss_customer_sk",
+                     "ss_sold_date_sk", "ss_ext_sales_price", 2000, "s2")
+    w1 = _year_total(t, "web_sales", "ws_bill_customer_sk",
+                     "ws_sold_date_sk", "ws_ext_sales_price", 1999, "w1")
+    w2 = _year_total(t, "web_sales", "ws_bill_customer_sk",
+                     "ws_sold_date_sk", "ws_ext_sales_price", 2000, "w2")
+    return (s1.join(s2, on=col("s1_cust") == col("s2_cust"))
+            .join(w1, on=col("s1_cust") == col("w1_cust"))
+            .join(w2, on=col("s1_cust") == col("w2_cust"))
+            .filter((col("s1_total") > 0) & (col("w1_total") > 0)
+                    & (col("w2_total") / col("w1_total")
+                       > col("s2_total") / col("s1_total")))
+            .join(t["customer"],
+                  on=col("s1_cust") == col("c_customer_sk"))
+            .select(col("c_customer_id"), col("c_first_name"),
+                    col("c_last_name"))
+            .order_by(col("c_customer_id"))
+            .limit(100))
+
+
+def q14(t):
+    """Cross-channel items (brand/class/category sold through ALL THREE
+    channels — the spec's INTERSECT, expressed as semi-join chains like
+    q38) whose channel sales beat the all-channel average (driver-side
+    scalar), ROLLUP'd by channel and hierarchy."""
+    dd = t["date_dim"].filter(col("d_year").isin(1999, 2000, 2001))
+
+    def channel_keys(sales, item_c, date_c, p):
+        return (t[sales]
+                .join(dd, on=col(date_c) == col("d_date_sk"))
+                .join(t["item"], on=col(item_c) == col("i_item_sk"))
+                .select(col("i_brand_id").alias(f"{p}_brand"),
+                        col("i_class_id").alias(f"{p}_class"),
+                        col("i_category_id").alias(f"{p}_cat"))
+                .distinct())
+
+    ss_k = channel_keys("store_sales", "ss_item_sk", "ss_sold_date_sk",
+                        "s")
+    cs_k = channel_keys("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                        "c")
+    ws_k = channel_keys("web_sales", "ws_item_sk", "ws_sold_date_sk",
+                        "w")
+    cross = (ss_k
+             .join(cs_k, on=(col("s_brand") == col("c_brand"))
+                   & (col("s_class") == col("c_class"))
+                   & (col("s_cat") == col("c_cat")), how="left_semi")
+             .join(ws_k, on=(col("s_brand") == col("w_brand"))
+                   & (col("s_class") == col("w_class"))
+                   & (col("s_cat") == col("w_cat")), how="left_semi"))
+    cross_items = (t["item"]
+                   .join(cross,
+                         on=(col("i_brand_id") == col("s_brand"))
+                         & (col("i_class_id") == col("s_class"))
+                         & (col("i_category_id") == col("s_cat")),
+                         how="left_semi")
+                   .select(col("i_item_sk").alias("ci_sk")))
+
+    # average per-channel (quantity x list_price) — the spec's scalar CTE
+    avg_rows = []
+    for sales, qty_c, price_c, date_c in (
+            ("store_sales", "ss_quantity", "ss_list_price",
+             "ss_sold_date_sk"),
+            ("catalog_sales", "cs_quantity", "cs_list_price",
+             "cs_sold_date_sk"),
+            ("web_sales", "ws_quantity", "ws_list_price",
+             "ws_sold_date_sk")):
+        v = (t[sales].join(dd, on=col(date_c) == col("d_date_sk"))
+             .agg(F.avg(col(qty_c).cast("double") * col(price_c))
+                  .alias("a")).collect()[0][0])
+        avg_rows.append(float(v or 0.0))
+    avg_sales = sum(avg_rows) / len(avg_rows)
+
+    dd2 = t["date_dim"].filter((col("d_year") == 2001)
+                               & (col("d_moy") == 11))
+
+    def channel_sales(sales, item_c, date_c, qty_c, price_c, label):
+        return (t[sales]
+                .join(dd2, on=col(date_c) == col("d_date_sk"))
+                .join(cross_items, on=col(item_c) == col("ci_sk"),
+                      how="left_semi")
+                .join(t["item"], on=col(item_c) == col("i_item_sk"))
+                .group_by(col("i_brand_id"), col("i_class_id"),
+                          col("i_category_id"))
+                .agg(F.sum(col(qty_c).cast("double") * col(price_c))
+                     .alias("sales"),
+                     F.count(lit(1)).alias("number_sales"))
+                .filter(col("sales") > avg_sales)
+                .select(lit(label).alias("channel"), col("i_brand_id"),
+                        col("i_class_id"), col("i_category_id"),
+                        col("sales"), col("number_sales")))
+
+    unioned = (channel_sales("store_sales", "ss_item_sk",
+                             "ss_sold_date_sk", "ss_quantity",
+                             "ss_list_price", "store")
+               .union(channel_sales("catalog_sales", "cs_item_sk",
+                                    "cs_sold_date_sk", "cs_quantity",
+                                    "cs_list_price", "catalog"))
+               .union(channel_sales("web_sales", "ws_item_sk",
+                                    "ws_sold_date_sk", "ws_quantity",
+                                    "ws_list_price", "web")))
+    return (unioned
+            .rollup(col("channel"), col("i_brand_id"), col("i_class_id"),
+                    col("i_category_id"))
+            .agg(F.sum(col("sales")).alias("sum_sales"),
+                 F.sum(col("number_sales")).alias("sum_number_sales"))
+            .order_by(col("channel"), col("i_brand_id"),
+                      col("i_class_id"), col("i_category_id"))
+            .limit(100))
+
+
+def q23(t):
+    """Catalog+web revenue in one month from the best store customers
+    buying frequently-bought-in-store items (two scalar CTEs: the
+    frequent-item set as a semi-join, the best-customer cut against a
+    driver-side max)."""
+    dd4 = t["date_dim"].filter(col("d_year").isin(2000, 2001, 2002,
+                                                  2003))
+    # items sold on >4 distinct days in the window (spec: count(*) > 4
+    # per (item, date) key folded to a per-item frequency)
+    frequent = (t["store_sales"]
+                .join(dd4, on=col("ss_sold_date_sk") == col("d_date_sk"))
+                .group_by(col("ss_item_sk"))
+                .agg(F.count_distinct(col("ss_sold_date_sk"))
+                     .alias("days"))
+                .filter(col("days") > 4)
+                .select(col("ss_item_sk").alias("freq_sk")))
+    # customer store totals and the max of them
+    totals = (t["store_sales"]
+              .group_by(col("ss_customer_sk"))
+              .agg(F.sum(col("ss_quantity").cast("double")
+                         * col("ss_sales_price")).alias("csales")))
+    tpcds_cmax = float(totals.agg(F.max(col("csales")).alias("m"))
+                       .collect()[0][0] or 0.0)
+    best = (totals.filter(col("csales") > 0.5 * tpcds_cmax)
+            .select(col("ss_customer_sk").alias("best_cust")))
+    dd1 = t["date_dim"].filter((col("d_year") == 2000)
+                               & (col("d_moy") == 2))
+    cs_part = (t["catalog_sales"]
+               .join(dd1, on=col("cs_sold_date_sk") == col("d_date_sk"))
+               .join(frequent, on=col("cs_item_sk") == col("freq_sk"),
+                     how="left_semi")
+               .join(best,
+                     on=col("cs_bill_customer_sk") == col("best_cust"),
+                     how="left_semi")
+               .select((col("cs_quantity").cast("double")
+                        * col("cs_list_price")).alias("sales")))
+    ws_part = (t["web_sales"]
+               .join(dd1, on=col("ws_sold_date_sk") == col("d_date_sk"))
+               .join(frequent, on=col("ws_item_sk") == col("freq_sk"),
+                     how="left_semi")
+               .join(best,
+                     on=col("ws_bill_customer_sk") == col("best_cust"),
+                     how="left_semi")
+               .select((col("ws_quantity").cast("double")
+                        * col("ws_list_price")).alias("sales")))
+    return (cs_part.union(ws_part)
+            .agg(F.sum(col("sales")).alias("total_sales")))
+
+
+def q16(t):
+    """Catalog orders in a 60-day window shipped from a state, fulfilled
+    from MORE than one warehouse (EXISTS with an inequality -> semi join
+    on order with warehouse mismatch) and never returned (NOT EXISTS ->
+    anti join).  cs_ext_sales_price stands in for the spec's
+    cs_ext_ship_cost; the call-center county filter is folded into the
+    join (the tiny-sf call_center table carries no county)."""
+    dd = t["date_dim"].filter(col("d_date").between("2002-02-01",
+                                                    "2002-04-02"))
+    ca = t["customer_address"].filter(col("ca_state") == "GA")
+    other_wh = t["catalog_sales"].select(
+        col("cs_order_number").alias("o2"),
+        col("cs_warehouse_sk").alias("w2"))
+    returned = t["catalog_returns"].select(
+        col("cr_order_number").alias("ro"))
+    base = (t["catalog_sales"]
+            .join(dd, on=col("cs_ship_date_sk") == col("d_date_sk"))
+            .join(ca, on=col("cs_ship_addr_sk") == col("ca_address_sk"))
+            .join(t["call_center"],
+                  on=col("cs_call_center_sk") == col("cc_call_center_sk"))
+            .join(other_wh, on=(col("cs_order_number") == col("o2"))
+                  & (col("cs_warehouse_sk") != col("w2")),
+                  how="left_semi")
+            .join(returned, on=col("cs_order_number") == col("ro"),
+                  how="left_anti"))
+    return (base.agg(F.count_distinct(col("cs_order_number"))
+                     .alias("order_count"),
+                     F.sum(col("cs_ext_sales_price"))
+                     .alias("total_shipping_cost"),
+                     F.sum(col("cs_net_profit")).alias("total_net_profit")))
+
+
+def q94(t):
+    """q16's web twin: web orders shipped from more than one warehouse
+    with no returns (ws_ext_sales_price stands in for ws_ext_ship_cost;
+    the 60-day window widened to four months for the tiny-sf row
+    budget)."""
+    dd = t["date_dim"].filter(col("d_date").between("1999-02-01",
+                                                    "1999-06-02"))
+    ca = t["customer_address"].filter(col("ca_state") == "TX")
+    other_wh = t["web_sales"].select(
+        col("ws_order_number").alias("o2"),
+        col("ws_warehouse_sk").alias("w2"))
+    returned = t["web_returns"].select(
+        col("wr_order_number").alias("ro"))
+    base = (t["web_sales"]
+            .join(dd, on=col("ws_ship_date_sk") == col("d_date_sk"))
+            .join(ca, on=col("ws_ship_addr_sk") == col("ca_address_sk"))
+            .join(t["web_site"],
+                  on=col("ws_web_site_sk") == col("web_site_sk"))
+            .join(other_wh, on=(col("ws_order_number") == col("o2"))
+                  & (col("ws_warehouse_sk") != col("w2")),
+                  how="left_semi")
+            .join(returned, on=col("ws_order_number") == col("ro"),
+                  how="left_anti"))
+    return (base.agg(F.count_distinct(col("ws_order_number"))
+                     .alias("order_count"),
+                     F.sum(col("ws_ext_sales_price"))
+                     .alias("total_shipping_cost"),
+                     F.sum(col("ws_net_profit")).alias("total_net_profit")))
+
+
+def q95(t):
+    """Web orders from multi-warehouse fulfilment where the order WAS
+    returned (q94's returned complement: both the order and its return
+    must sit in the two-warehouse order set; q94's widened four-month
+    window, which the added was-returned cut needs even more)."""
+    dd = t["date_dim"].filter(col("d_date").between("1999-02-01",
+                                                    "1999-06-02"))
+    ca = t["customer_address"].filter(col("ca_state") == "TX")
+    ws1 = t["web_sales"].select(col("ws_order_number").alias("p1"),
+                                col("ws_warehouse_sk").alias("pw1"))
+    ws2 = t["web_sales"].select(col("ws_order_number").alias("p2"),
+                                col("ws_warehouse_sk").alias("pw2"))
+    ws_wh = (ws1.join(ws2, on=(col("p1") == col("p2"))
+                      & (col("pw1") != col("pw2")))
+             .select(col("p1").alias("wh_order")).distinct())
+    returned = (t["web_returns"]
+                .join(ws_wh, on=col("wr_order_number") == col("wh_order"),
+                      how="left_semi")
+                .select(col("wr_order_number").alias("ro")).distinct())
+    base = (t["web_sales"]
+            .join(dd, on=col("ws_ship_date_sk") == col("d_date_sk"))
+            .join(ca, on=col("ws_ship_addr_sk") == col("ca_address_sk"))
+            .join(t["web_site"],
+                  on=col("ws_web_site_sk") == col("web_site_sk"))
+            .join(ws_wh, on=col("ws_order_number") == col("wh_order"),
+                  how="left_semi")
+            .join(returned, on=col("ws_order_number") == col("ro"),
+                  how="left_semi"))
+    return (base.agg(F.count_distinct(col("ws_order_number"))
+                     .alias("order_count"),
+                     F.sum(col("ws_ext_sales_price"))
+                     .alias("total_shipping_cost"),
+                     F.sum(col("ws_net_profit")).alias("total_net_profit")))
+
+
+def _ship_latency_buckets(t, sales_key, sold_c, ship_c, wh_c, mode_c,
+                          group_dim, group_key, group_out):
+    """q62/q99 core: days between order and ship, bucketed per
+    (warehouse, ship mode, {web site | call center})."""
+    dd = t["date_dim"].filter(col("d_month_seq").between(24, 35)) \
+        .select(col("d_date_sk").alias("ship_dsk"))
+    lag = col(ship_c) - col(sold_c)  # consecutive date_sks: sk diff IS days
+    buckets = [
+        F.sum(F.when(lag <= 30, 1).otherwise(0)).alias("d30"),
+        F.sum(F.when((lag > 30) & (lag <= 60), 1).otherwise(0))
+        .alias("d31_60"),
+        F.sum(F.when((lag > 60) & (lag <= 90), 1).otherwise(0))
+        .alias("d61_90"),
+        F.sum(F.when((lag > 90) & (lag <= 120), 1).otherwise(0))
+        .alias("d91_120"),
+        F.sum(F.when(lag > 120, 1).otherwise(0)).alias("d120plus")]
+    return (t[sales_key]
+            .join(dd, on=col(ship_c) == col("ship_dsk"))
+            .join(t["warehouse"], on=col(wh_c) == col("w_warehouse_sk"))
+            .join(t["ship_mode"],
+                  on=col(mode_c) == col("sm_ship_mode_sk"))
+            .join(t[group_dim], on=group_key)
+            .group_by(col("w_warehouse_name"), col("sm_type"),
+                      col(group_out))
+            .agg(*buckets)
+            .order_by(col("w_warehouse_name"), col("sm_type"),
+                      col(group_out))
+            .limit(100))
+
+
+def q62(t):
+    """Web ship-latency buckets per warehouse x ship mode x site."""
+    return _ship_latency_buckets(
+        t, "web_sales", "ws_sold_date_sk", "ws_ship_date_sk",
+        "ws_warehouse_sk", "ws_ship_mode_sk", "web_site",
+        col("ws_web_site_sk") == col("web_site_sk"), "web_site_id")
+
+
+def q99(t):
+    """q62's catalog twin (call center instead of web site)."""
+    return _ship_latency_buckets(
+        t, "catalog_sales", "cs_sold_date_sk", "cs_ship_date_sk",
+        "cs_warehouse_sk", "cs_ship_mode_sk", "call_center",
+        col("cs_call_center_sk") == col("cc_call_center_sk"), "cc_name")
+
+
+def q66(t):
+    """Warehouse shipping volume pivoted into monthly columns (web +
+    catalog union, carrier-filtered, time-of-day window; w_warehouse_name
+    is the only warehouse attribute the tiny-sf table carries)."""
+    dd = t["date_dim"].filter(col("d_year") == 2001)
+    td = t["time_dim"].filter(col("t_hour").between(8, 16))
+    sm = t["ship_mode"].filter(col("sm_carrier").isin("UPS", "FEDEX"))
+
+    def channel(sales, date_c, time_c, wh_c, mode_c, qty_c, price_c):
+        monthly = [F.sum(F.when(col("d_moy") == m,
+                                col(qty_c).cast("double") * col(price_c))
+                         .otherwise(0.0)).alias(f"m{m}_sales")
+                   for m in range(1, 13)]
+        return (t[sales]
+                .join(dd, on=col(date_c) == col("d_date_sk"))
+                .join(td, on=col(time_c) == col("t_time_sk"))
+                .join(sm, on=col(mode_c) == col("sm_ship_mode_sk"))
+                .join(t["warehouse"],
+                      on=col(wh_c) == col("w_warehouse_sk"))
+                .group_by(col("w_warehouse_name"), col("d_year"))
+                .agg(*monthly))
+
+    web = channel("web_sales", "ws_sold_date_sk", "ws_sold_time_sk",
+                  "ws_warehouse_sk", "ws_ship_mode_sk", "ws_quantity",
+                  "ws_list_price")
+    cat = channel("catalog_sales", "cs_sold_date_sk", "cs_sold_time_sk",
+                  "cs_warehouse_sk", "cs_ship_mode_sk", "cs_quantity",
+                  "cs_list_price")
+    return (web.union(cat)
+            .group_by(col("w_warehouse_name"), col("d_year"))
+            .agg(*[F.sum(col(f"m{m}_sales")).alias(f"jan_dec_{m}")
+                   for m in range(1, 13)])
+            .order_by(col("w_warehouse_name"))
+            .limit(100))
+
+
+def q71(t):
+    """Brand revenue by hour across all three channels for one month,
+    restricted to breakfast/dinner hours (union BEFORE the time join)."""
+    dd = t["date_dim"].filter((col("d_moy") == 11)
+                              & (col("d_year") == 1999))
+    # a band of managers instead of the spec's single one: at tiny sf a
+    # 1-in-40 manager cut of one month's meal-hour rows selects nothing
+    it = t["item"].filter(col("i_manager_id").between(1, 8))
+    td = t["time_dim"].filter(col("t_hour").isin(7, 8, 18, 19))
+    parts = [
+        ("web_sales", "ws_ext_sales_price", "ws_item_sk",
+         "ws_sold_date_sk", "ws_sold_time_sk"),
+        ("catalog_sales", "cs_ext_sales_price", "cs_item_sk",
+         "cs_sold_date_sk", "cs_sold_time_sk"),
+        ("store_sales", "ss_ext_sales_price", "ss_item_sk",
+         "ss_sold_date_sk", "ss_sold_time_sk")]
+    unioned = None
+    for sales, price_c, item_c, date_c, time_c in parts:
+        part = (t[sales]
+                .join(dd, on=col(date_c) == col("d_date_sk"))
+                .select(col(price_c).alias("ext_price"),
+                        col(item_c).alias("sold_item_sk"),
+                        col(time_c).alias("time_sk")))
+        unioned = part if unioned is None else unioned.union(part)
+    return (unioned
+            .join(it, on=col("sold_item_sk") == col("i_item_sk"))
+            .join(td, on=col("time_sk") == col("t_time_sk"))
+            .group_by(col("i_brand_id"), col("i_brand"), col("t_hour"),
+                      col("t_minute"))
+            .agg(F.sum(col("ext_price")).alias("ext_price_sum"))
+            .order_by(col("ext_price_sum").desc(), col("i_brand_id"),
+                      col("t_hour"), col("t_minute"))
+            .limit(100))
+
+
+def q72(t):
+    """Catalog lines whose inventory at a warehouse ran below the ordered
+    quantity in the sale month, by demographic slice, with promo and
+    return left joins counted (monthly inventory stands in for the
+    spec's week_seq alignment; ship >5 days after sale kept)."""
+    dd1 = (t["date_dim"].filter(col("d_year") == 2000)
+           .select(col("d_date_sk").alias("sold_dsk"),
+                   col("d_moy").alias("sold_moy"),
+                   col("d_date").alias("sold_date")))
+    dd2 = t["date_dim"].select(col("d_date_sk").alias("inv_dsk"),
+                               col("d_moy").alias("inv_moy"),
+                               col("d_year").alias("inv_year"))
+    cd = t["customer_demographics"].filter(
+        col("cd_marital_status") == "M")
+    hd = t["household_demographics"].filter(
+        col("hd_buy_potential") == ">10000")
+    joined = (t["catalog_sales"]
+              .join(dd1, on=col("cs_sold_date_sk") == col("sold_dsk"))
+              .join(t["inventory"],
+                    on=col("cs_item_sk") == col("inv_item_sk"))
+              .join(dd2, on=col("inv_date_sk") == col("inv_dsk"))
+              .filter((col("inv_year") == 2000)
+                      & (col("inv_moy") == col("sold_moy"))
+                      & (col("inv_quantity_on_hand") < col("cs_quantity"))
+                      & (col("cs_ship_date_sk") - col("cs_sold_date_sk")
+                         > 5))
+              .join(t["warehouse"],
+                    on=col("inv_warehouse_sk") == col("w_warehouse_sk"))
+              .join(t["item"], on=col("cs_item_sk") == col("i_item_sk"))
+              .join(cd, on=col("cs_bill_cdemo_sk") == col("cd_demo_sk"))
+              .join(hd, on=col("cs_ship_hdemo_sk") == col("hd_demo_sk"))
+              .join(t["promotion"],
+                    on=col("cs_promo_sk") == col("p_promo_sk"),
+                    how="left")
+              .join(t["catalog_returns"]
+                    .select(col("cr_item_sk").alias("cri"),
+                            col("cr_order_number").alias("cro")),
+                    on=(col("cs_item_sk") == col("cri"))
+                    & (col("cs_order_number") == col("cro")),
+                    how="left"))
+    return (joined
+            .group_by(col("i_item_desc"), col("w_warehouse_name"),
+                      col("sold_moy"))
+            .agg(F.sum(F.when(col("p_promo_sk").is_null(), 1)
+                       .otherwise(0)).alias("no_promo"),
+                 F.sum(F.when(col("p_promo_sk").is_not_null(), 1)
+                       .otherwise(0)).alias("promo"),
+                 F.count(lit(1)).alias("total_cnt"))
+            .order_by(col("total_cnt").desc(), col("i_item_desc"),
+                      col("w_warehouse_name"), col("sold_moy"))
+            .limit(100))
+
+
+def q76(t):
+    """Sales rows whose channel foreign key is NULL (dsdgen leaves a
+    fraction of fks null), unioned across channels and counted per
+    year/quarter/category."""
+    parts = []
+    for sales, null_c, price_c, item_c, date_c, channel, col_name in (
+            ("store_sales", "ss_store_sk", "ss_ext_sales_price",
+             "ss_item_sk", "ss_sold_date_sk", "store", "ss_store_sk"),
+            ("web_sales", "ws_ship_customer_sk", "ws_ext_sales_price",
+             "ws_item_sk", "ws_sold_date_sk", "web",
+             "ws_ship_customer_sk"),
+            ("catalog_sales", "cs_ship_addr_sk", "cs_ext_sales_price",
+             "cs_item_sk", "cs_sold_date_sk", "catalog",
+             "cs_ship_addr_sk")):
+        parts.append(
+            t[sales].filter(col(null_c).is_null())
+            .join(t["item"], on=col(item_c) == col("i_item_sk"))
+            .join(t["date_dim"],
+                  on=col(date_c) == col("d_date_sk"))
+            .select(lit(channel).alias("channel"),
+                    lit(col_name).alias("col_name"), col("d_year"),
+                    col("d_qoy"), col("i_category"),
+                    col(price_c).alias("ext_sales_price")))
+    unioned = parts[0].union(parts[1]).union(parts[2])
+    return (unioned
+            .group_by(col("channel"), col("col_name"), col("d_year"),
+                      col("d_qoy"), col("i_category"))
+            .agg(F.count(lit(1)).alias("sales_cnt"),
+                 F.sum(col("ext_sales_price")).alias("sales_amt"))
+            .order_by(col("channel"), col("col_name"), col("d_year"),
+                      col("d_qoy"), col("i_category"))
+            .limit(100))
+
+
+def _returns_above_state_avg(t, returns_key, cust_c, date_c, amt_c,
+                             year, out_state):
+    """q30/q81 core: customers returning more than 1.2x their state's
+    average (q1's channel twins; the returning customer's CURRENT address
+    state stands in for the spec's return-address state, which the
+    tiny-sf returns tables do not carry)."""
+    dd = t["date_dim"].filter(col("d_year") == year)
+    ctr = (t[returns_key]
+           .join(dd, on=col(date_c) == col("d_date_sk"))
+           .join(t["customer"],
+                 on=col(cust_c) == col("c_customer_sk"))
+           .join(t["customer_address"],
+                 on=col("c_current_addr_sk") == col("ca_address_sk"))
+           .group_by(col(cust_c), col("ca_state"))
+           .agg(F.sum(col(amt_c)).alias("ctr_total_return")))
+    avg_ctr = (ctr.group_by(col("ca_state"))
+               .agg((F.avg(col("ctr_total_return")) * 1.2)
+                    .alias("avg_return"))
+               .select(col("ca_state").alias("avg_state"),
+                       col("avg_return")))
+    return (ctr
+            .join(avg_ctr, on=col("ca_state") == col("avg_state"))
+            .filter(col("ctr_total_return") > col("avg_return"))
+            .filter(col("ca_state") == out_state)
+            .join(t["customer"],
+                  on=col(cust_c) == col("c_customer_sk"))
+            .select(col("c_customer_id"), col("c_salutation"),
+                    col("c_first_name"), col("c_last_name"),
+                    col("ctr_total_return"))
+            .order_by(col("c_customer_id"), col("ctr_total_return"))
+            .limit(100))
+
+
+def q30(t):
+    """Web customers returning more than 1.2x their state's average."""
+    return _returns_above_state_avg(
+        t, "web_returns", "wr_returning_customer_sk",
+        "wr_returned_date_sk", "wr_return_amt", 2002, "TN")
+
+
+def q81(t):
+    """q30's catalog twin."""
+    return _returns_above_state_avg(
+        t, "catalog_returns", "cr_returning_customer_sk",
+        "cr_returned_date_sk", "cr_return_amount", 2000, "GA")
+
+
+def q32(t):
+    """Catalog discounts exceeding 1.3x the item's average discount over
+    a 90-day window (q92's catalog twin)."""
+    dd = t["date_dim"].filter(col("d_date").between("2000-01-27",
+                                                    "2000-04-26"))
+    it = t["item"].filter(col("i_manufact_id") == 7)
+    windowed = (t["catalog_sales"]
+                .join(dd, on=col("cs_sold_date_sk") == col("d_date_sk")))
+    item_avg = (windowed
+                .group_by(col("cs_item_sk"))
+                .agg((F.avg(col("cs_ext_discount_amt")) * 1.3)
+                     .alias("disc_bar"))
+                .select(col("cs_item_sk").alias("bar_sk"),
+                        col("disc_bar")))
+    return (windowed
+            .join(it, on=col("cs_item_sk") == col("i_item_sk"))
+            .join(item_avg, on=col("cs_item_sk") == col("bar_sk"))
+            .filter(col("cs_ext_discount_amt") > col("disc_bar"))
+            .agg(F.sum(col("cs_ext_discount_amt"))
+                 .alias("excess_discount_amount")))
+
+
+def q40(t):
+    """Catalog net value per warehouse/item/state around a pivot date,
+    returns backed out via the sale's left-joined return row
+    (cr_return_amount stands in for the spec's cr_refunded_cash)."""
+    dd = t["date_dim"].filter(col("d_date").between("2000-02-10",
+                                                    "2000-04-10"))
+    it = t["item"].filter(col("i_current_price").between(0.99, 60.0))
+    cr = t["catalog_returns"].select(
+        col("cr_item_sk").alias("cri"),
+        col("cr_order_number").alias("cro"),
+        col("cr_return_amount"))
+    joined = (t["catalog_sales"]
+              .join(cr, on=(col("cs_item_sk") == col("cri"))
+                    & (col("cs_order_number") == col("cro")), how="left")
+              .join(dd, on=col("cs_sold_date_sk") == col("d_date_sk"))
+              .join(it, on=col("cs_item_sk") == col("i_item_sk"))
+              .join(t["warehouse"],
+                    on=col("cs_warehouse_sk") == col("w_warehouse_sk"))
+              .with_column("net", col("cs_sales_price")
+                           - F.coalesce(col("cr_return_amount"),
+                                        lit(0.0))))
+    return (joined
+            .group_by(col("w_warehouse_name"), col("i_item_id"))
+            .agg(F.sum(F.when(col("d_date") < "2000-03-11", col("net"))
+                       .otherwise(0.0)).alias("sales_before"),
+                 F.sum(F.when(col("d_date") >= "2000-03-11", col("net"))
+                       .otherwise(0.0)).alias("sales_after"))
+            .order_by(col("w_warehouse_name"), col("i_item_id"))
+            .limit(100))
+
+
+def q49(t):
+    """Worst return ratios per channel: currency and quantity return
+    rates ranked per channel, the top tier unioned (net_paid stood in by
+    ext_sales_price; returns tied to their sale by order/ticket+item)."""
+    from spark_rapids_tpu.plan.logical import Window
+    dd = t["date_dim"].filter((col("d_year") == 2000)
+                              & (col("d_moy") == 12))
+
+    def channel(sales, ret, s_item, s_ord, s_qty, s_price, r_item,
+                r_ord, r_qty, r_amt, date_c, label):
+        rets = t[ret].select(col(r_item).alias("ri"),
+                             col(r_ord).alias("ro"),
+                             col(r_qty).alias("rq"),
+                             col(r_amt).alias("ra"))
+        base = (t[sales]
+                .join(dd, on=col(date_c) == col("d_date_sk"))
+                .filter(col(s_qty) > 0)
+                .join(rets, on=(col(s_item) == col("ri"))
+                      & (col(s_ord) == col("ro")), how="left")
+                .group_by(col(s_item))
+                .agg(F.sum(F.coalesce(col("rq"), lit(0)).cast("double"))
+                     .alias("return_qty"),
+                     F.sum(col(s_qty).cast("double")).alias("sold_qty"),
+                     F.sum(F.coalesce(col("ra"), lit(0.0)))
+                     .alias("return_amt"),
+                     F.sum(col(s_price)).alias("sold_amt"))
+                .with_column("return_ratio",
+                             col("return_qty") / col("sold_qty"))
+                .with_column("currency_ratio",
+                             col("return_amt") / col("sold_amt")))
+        ranked = (base
+                  .with_column("return_rank", F.rank().over(
+                      Window.order_by(col("return_ratio"))))
+                  .with_column("currency_rank", F.rank().over(
+                      Window.order_by(col("currency_ratio")))))
+        return (ranked
+                .filter((col("return_rank") <= 10)
+                        | (col("currency_rank") <= 10))
+                .select(lit(label).alias("channel"),
+                        col(s_item).alias("item"), col("return_ratio"),
+                        col("return_rank"), col("currency_rank")))
+
+    web = channel("web_sales", "web_returns", "ws_item_sk",
+                  "ws_order_number", "ws_quantity", "ws_ext_sales_price",
+                  "wr_item_sk", "wr_order_number", "wr_return_quantity",
+                  "wr_return_amt", "ws_sold_date_sk", "web")
+    cat = channel("catalog_sales", "catalog_returns", "cs_item_sk",
+                  "cs_order_number", "cs_quantity", "cs_ext_sales_price",
+                  "cr_item_sk", "cr_order_number", "cr_return_quantity",
+                  "cr_return_amount", "cs_sold_date_sk", "catalog")
+    st = channel("store_sales", "store_returns", "ss_item_sk",
+                 "ss_ticket_number", "ss_quantity", "ss_ext_sales_price",
+                 "sr_item_sk", "sr_ticket_number", "sr_return_quantity",
+                 "sr_return_amt", "ss_sold_date_sk", "store")
+    return (web.union(cat).union(st)
+            .distinct()
+            .order_by(col("channel"), col("return_rank"),
+                      col("currency_rank"), col("item"))
+            .limit(100))
+
+
+def q83(t):
+    """Items returned through all three channels in one year, joined
+    pairwise on item with per-channel return shares (the year stands in
+    for the spec's three week_seq windows: three independently-drawn
+    return streams share no item in any narrower window at tiny sf)."""
+    dd = t["date_dim"].filter(col("d_year") == 2000)
+
+    def channel_returns(ret, item_c, date_c, qty_c, prefix):
+        return (t[ret]
+                .join(dd, on=col(date_c) == col("d_date_sk"))
+                .join(t["item"], on=col(item_c) == col("i_item_sk"))
+                .group_by(col("i_item_id"))
+                .agg(F.sum(col(qty_c).cast("double"))
+                     .alias(f"{prefix}_qty"))
+                .select(col("i_item_id").alias(f"{prefix}_item"),
+                        col(f"{prefix}_qty")))
+
+    sr = channel_returns("store_returns", "sr_item_sk",
+                         "sr_returned_date_sk", "sr_return_quantity",
+                         "sr")
+    cr = channel_returns("catalog_returns", "cr_item_sk",
+                         "cr_returned_date_sk", "cr_return_quantity",
+                         "cr")
+    wr = channel_returns("web_returns", "wr_item_sk",
+                         "wr_returned_date_sk", "wr_return_quantity",
+                         "wr")
+    total = (col("sr_qty") + col("cr_qty") + col("wr_qty")) / 3.0
+    return (sr.join(cr, on=col("sr_item") == col("cr_item"))
+            .join(wr, on=col("sr_item") == col("wr_item"))
+            .select(col("sr_item").alias("item_id"), col("sr_qty"),
+                    (col("sr_qty") / total / 3.0 * 100.0)
+                    .alias("sr_dev"),
+                    col("cr_qty"),
+                    (col("cr_qty") / total / 3.0 * 100.0)
+                    .alias("cr_dev"),
+                    col("wr_qty"),
+                    (col("wr_qty") / total / 3.0 * 100.0)
+                    .alias("wr_dev"),
+                    total.alias("average"))
+            .order_by(col("item_id"), col("sr_qty"))
+            .limit(100))
+
+
+def q84(t):
+    """Customers in one city within an income band, surfaced through
+    their store returns (income band resolved customer -> household
+    demographics -> income_band; cd tied to the return's demographic)."""
+    ca = t["customer_address"].filter(col("ca_city") == "Midway")
+    ib = t["income_band"].filter((col("ib_lower_bound") >= 20_000)
+                                 & (col("ib_upper_bound") <= 70_000))
+    return (t["customer"]
+            .join(ca, on=col("c_current_addr_sk") == col("ca_address_sk"))
+            .join(t["household_demographics"],
+                  on=col("c_current_hdemo_sk") == col("hd_demo_sk"))
+            .join(ib, on=col("hd_income_band_sk")
+                  == col("ib_income_band_sk"))
+            .join(t["customer_demographics"],
+                  on=col("c_current_cdemo_sk") == col("cd_demo_sk"))
+            .join(t["store_returns"],
+                  on=col("sr_cdemo_sk") == col("cd_demo_sk"))
+            .select(col("c_customer_id").alias("customer_id"),
+                    F.concat(col("c_last_name"), lit(", "),
+                             col("c_first_name")).alias("customername"))
+            .order_by(col("customer_id"))
+            .limit(100))
+
+
+def q90(t):
+    """AM/PM ratio of web order counts for one page-size class and
+    household size (two scalar window counts composed driver-side like
+    q88/q61)."""
+    hd = t["household_demographics"].filter(col("hd_dep_count") == 6)
+    wp = t["web_page"].filter(col("wp_char_count").between(5000, 5200))
+
+    def count_window(h_lo, h_hi):
+        td = t["time_dim"].filter(col("t_hour").between(h_lo, h_hi))
+        v = (t["web_sales"]
+             .join(td, on=col("ws_sold_time_sk") == col("t_time_sk"))
+             .join(hd, on=col("ws_ship_hdemo_sk") == col("hd_demo_sk"))
+             .join(wp, on=col("ws_web_page_sk") == col("wp_web_page_sk"))
+             .agg(F.count(lit(1)).alias("c")).collect()[0][0])
+        return int(v or 0)
+
+    amc, pmc = count_window(8, 9), count_window(19, 20)
+    ratio = (amc / pmc) if pmc else 0.0
+    return t["web_sales"].session.from_pydict(
+        {"am_count": [amc], "pm_count": [pmc], "am_pm_ratio": [ratio]})
+
+
+def q91(t):
+    """Call-center losses from returns by educated/affluent customers in
+    one month (cc_name stands in for the spec's manager rollup columns)."""
+    # predicates broadened from the spec's single-month/single-tuple
+    # bindings (q88's convention): a 1/35 demographic tuple of one
+    # month's catalog returns selects nothing at tiny sf
+    dd = t["date_dim"].filter(col("d_year") == 1998)
+    cd = t["customer_demographics"].filter(
+        col("cd_education_status").isin("Unknown", "Advanced Degree"))
+    hd = t["household_demographics"].filter(
+        col("hd_buy_potential").isin(">10000", "1001-5000"))
+    ca = t["customer_address"]
+    return (t["catalog_returns"]
+            .join(dd, on=col("cr_returned_date_sk") == col("d_date_sk"))
+            .join(t["call_center"],
+                  on=col("cr_call_center_sk") == col("cc_call_center_sk"))
+            .join(t["customer"], on=col("cr_returning_customer_sk")
+                  == col("c_customer_sk"))
+            .join(cd, on=col("c_current_cdemo_sk") == col("cd_demo_sk"))
+            .join(hd, on=col("c_current_hdemo_sk") == col("hd_demo_sk"))
+            .join(ca, on=col("c_current_addr_sk") == col("ca_address_sk"))
+            .group_by(col("cc_name"), col("cd_marital_status"),
+                      col("cd_education_status"))
+            .agg(F.sum(col("cr_net_loss")).alias("returns_loss"))
+            .order_by(col("returns_loss").desc(), col("cc_name"))
+            .limit(100))
+
+
+def q24(t):
+    """Store-channel net paid per customer and item color where the
+    customer's birth country differs from their address country and the
+    store shares the customer's zip; customers spending above 5% of the
+    average (driver-side scalar threshold; ss_sales_price stands in for
+    ss_net_paid)."""
+    # the spec's single-market cut is omitted: the zip+birth-country
+    # funnel already leaves ~a dozen rows at tiny sf, and a handful of
+    # stores cannot cover every market id
+    st = t["store"]
+    netpaid = (t["store_sales"]
+               .join(t["store_returns"],
+                     on=(col("ss_ticket_number") == col("sr_ticket_number"))
+                     & (col("ss_item_sk") == col("sr_item_sk")))
+               .join(st, on=col("ss_store_sk") == col("s_store_sk"))
+               .join(t["item"], on=col("ss_item_sk") == col("i_item_sk"))
+               .join(t["customer"],
+                     on=col("ss_customer_sk") == col("c_customer_sk"))
+               .join(t["customer_address"],
+                     on=col("c_current_addr_sk") == col("ca_address_sk"))
+               .filter((F.upper(col("c_birth_country"))
+                        != F.upper(col("ca_country")))
+                       & (col("s_zip") == col("ca_zip")))
+               .group_by(col("c_last_name"), col("c_first_name"),
+                         col("s_store_name"), col("ca_state"),
+                         col("s_state"), col("i_color"),
+                         col("i_current_price"), col("i_manager_id"))
+               .agg(F.sum(col("ss_sales_price")).alias("netpaid")))
+    thr = (netpaid.agg(F.avg(col("netpaid")).alias("a"))
+           .collect()[0][0])
+    thr = 0.05 * float(thr or 0.0)
+    return (netpaid
+            .filter(col("i_color") == "red")
+            .group_by(col("c_last_name"), col("c_first_name"),
+                      col("s_store_name"))
+            .agg(F.sum(col("netpaid")).alias("paid"))
+            .filter(col("paid") > thr)
+            .order_by(col("c_last_name"), col("c_first_name"),
+                      col("s_store_name"))
+            .limit(100))
+
+
+def q64(t):
+    """Cross-channel item economics two years running: store sales with
+    a return and a healthy catalog channel (items whose catalog revenue
+    dwarfs their catalog refunds), dimensioned through customer
+    demographics, income bands, and geography; the per-year rollups are
+    self-joined to compare consecutive years (the spec's widest
+    snowflake, trimmed to the columns the tiny-sf tables carry)."""
+    # cs_ui: items whose catalog revenue > 2x their refunds
+    cr_agg = (t["catalog_returns"]
+              .group_by(col("cr_item_sk"))
+              .agg(F.sum(col("cr_return_amount")).alias("refund"))
+              .select(col("cr_item_sk").alias("cri"), col("refund")))
+    cs_ui = (t["catalog_sales"]
+             .group_by(col("cs_item_sk"))
+             .agg(F.sum(col("cs_ext_sales_price")).alias("cs_rev"))
+             .join(cr_agg, on=col("cs_item_sk") == col("cri"),
+                   how="left")
+             .filter(col("cs_rev")
+                     > 2.0 * F.coalesce(col("refund"), lit(0.0)))
+             .select(col("cs_item_sk").alias("ui_sk")))
+    it = t["item"].filter(col("i_color").isin("amber", "navy")
+                          & col("i_current_price").between(10.0, 80.0))
+
+    def cross_sales(year, prefix):
+        dd = t["date_dim"].filter(col("d_year") == year)
+        base = (t["store_sales"]
+                .join(t["store_returns"],
+                      on=(col("ss_ticket_number")
+                          == col("sr_ticket_number"))
+                      & (col("ss_item_sk") == col("sr_item_sk")))
+                .join(cs_ui, on=col("ss_item_sk") == col("ui_sk"),
+                      how="left_semi")
+                .join(dd, on=col("ss_sold_date_sk") == col("d_date_sk"))
+                .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+                .join(t["store"],
+                      on=col("ss_store_sk") == col("s_store_sk"))
+                .join(t["customer"],
+                      on=col("ss_customer_sk") == col("c_customer_sk"))
+                .join(t["customer_demographics"],
+                      on=col("c_current_cdemo_sk") == col("cd_demo_sk"))
+                .join(t["household_demographics"],
+                      on=col("c_current_hdemo_sk") == col("hd_demo_sk"))
+                .join(t["income_band"], on=col("hd_income_band_sk")
+                      == col("ib_income_band_sk"))
+                .join(t["customer_address"],
+                      on=col("c_current_addr_sk") == col("ca_address_sk")))
+        return (base
+                .group_by(col("i_item_desc"), col("s_store_name"),
+                          col("s_zip"))
+                .agg(F.count(lit(1)).alias(f"{prefix}_cnt"),
+                     F.sum(col("ss_ext_sales_price"))
+                     .alias(f"{prefix}_sales"),
+                     F.sum(col("ss_ext_wholesale_cost"))
+                     .alias(f"{prefix}_cost"))
+                .select(col("i_item_desc").alias(f"{prefix}_item"),
+                        col("s_store_name").alias(f"{prefix}_store"),
+                        col("s_zip").alias(f"{prefix}_zip"),
+                        col(f"{prefix}_cnt"), col(f"{prefix}_sales"),
+                        col(f"{prefix}_cost")))
+
+    y1 = cross_sales(2000, "y1")
+    y2 = cross_sales(2001, "y2")
+    return (y1.join(y2, on=(col("y1_item") == col("y2_item"))
+                    & (col("y1_store") == col("y2_store"))
+                    & (col("y1_zip") == col("y2_zip")))
+            .filter(col("y2_cnt") <= col("y1_cnt"))
+            .select(col("y1_item"), col("y1_store"), col("y1_zip"),
+                    col("y1_cnt"), col("y1_sales"), col("y1_cost"),
+                    col("y2_cnt"), col("y2_sales"), col("y2_cost"))
+            .order_by(col("y1_item"), col("y1_store"), col("y1_zip"))
+            .limit(100))
+
+
+def q75(t):
+    """Yearly item-family volumes net of returns across all channels,
+    consecutive years joined where current volume dropped below 90% of
+    the prior year's."""
+    def channel(sales, ret, s_item, s_ord, s_qty, s_price, r_item,
+                r_ord, r_qty, r_amt, date_c):
+        rets = t[ret].select(col(r_item).alias("ri"),
+                             col(r_ord).alias("ro"),
+                             col(r_qty).alias("rq"),
+                             col(r_amt).alias("ra"))
+        return (t[sales]
+                .join(t["date_dim"],
+                      on=col(date_c) == col("d_date_sk"))
+                .join(t["item"], on=col(s_item) == col("i_item_sk"))
+                .join(rets, on=(col(s_item) == col("ri"))
+                      & (col(s_ord) == col("ro")), how="left")
+                .select(col("d_year"), col("i_brand_id"),
+                        col("i_class_id"), col("i_category_id"),
+                        col("i_manufact_id"),
+                        (col(s_qty) - F.coalesce(col("rq"), lit(0)))
+                        .cast("double").alias("sales_cnt"),
+                        (col(s_price) - F.coalesce(col("ra"), lit(0.0)))
+                        .alias("sales_amt")))
+
+    all_sales = (channel("store_sales", "store_returns", "ss_item_sk",
+                         "ss_ticket_number", "ss_quantity",
+                         "ss_ext_sales_price", "sr_item_sk",
+                         "sr_ticket_number", "sr_return_quantity",
+                         "sr_return_amt", "ss_sold_date_sk")
+                 .union(channel("catalog_sales", "catalog_returns",
+                                "cs_item_sk", "cs_order_number",
+                                "cs_quantity", "cs_ext_sales_price",
+                                "cr_item_sk", "cr_order_number",
+                                "cr_return_quantity", "cr_return_amount",
+                                "cs_sold_date_sk"))
+                 .union(channel("web_sales", "web_returns", "ws_item_sk",
+                                "ws_order_number", "ws_quantity",
+                                "ws_ext_sales_price", "wr_item_sk",
+                                "wr_order_number", "wr_return_quantity",
+                                "wr_return_amt", "ws_sold_date_sk"))
+                 .group_by(col("d_year"), col("i_brand_id"),
+                           col("i_class_id"), col("i_category_id"),
+                           col("i_manufact_id"))
+                 .agg(F.sum(col("sales_cnt")).alias("sales_cnt"),
+                      F.sum(col("sales_amt")).alias("sales_amt")))
+    prev = all_sales.filter(col("d_year") == 2001).select(
+        col("i_brand_id").alias("pb"), col("i_class_id").alias("pc"),
+        col("i_category_id").alias("pg"),
+        col("i_manufact_id").alias("pm"),
+        col("sales_cnt").alias("prev_cnt"),
+        col("sales_amt").alias("prev_amt"))
+    curr = all_sales.filter(col("d_year") == 2002)
+    return (curr.join(prev, on=(col("i_brand_id") == col("pb"))
+                      & (col("i_class_id") == col("pc"))
+                      & (col("i_category_id") == col("pg"))
+                      & (col("i_manufact_id") == col("pm")))
+            .filter((col("prev_cnt") > 0)
+                    & (col("sales_cnt") / col("prev_cnt") < 0.9))
+            .select(col("i_brand_id"), col("i_class_id"),
+                    col("i_category_id"), col("i_manufact_id"),
+                    col("prev_cnt"), col("sales_cnt"),
+                    (col("sales_cnt") - col("prev_cnt"))
+                    .alias("sales_cnt_diff"),
+                    (col("sales_amt") - col("prev_amt"))
+                    .alias("sales_amt_diff"))
+            .order_by(col("sales_cnt_diff"), col("i_brand_id"),
+                      col("i_class_id"), col("i_category_id"),
+                      col("i_manufact_id"))
+            .limit(100))
+
+
+def q77(t):
+    """Per-channel sales and returns over a 30-day window, FULL OUTER
+    joined per channel entity (store / call center / web page) and
+    ROLLUP'd across channels (q5's profit-focused sibling)."""
+    dd = t["date_dim"].filter((col("d_date") >= "2000-08-23")
+                              & (col("d_date") <= "2000-09-22"))
+
+    def side(tbl, date_c, key_c, amt_c, profit_c, prefix):
+        aggs = [F.sum(col(amt_c)).alias(f"{prefix}_amt"),
+                F.sum(col(profit_c)).alias(f"{prefix}_profit")]
+        return (t[tbl].join(dd, on=col(date_c) == col("d_date_sk"))
+                .group_by(col(key_c))
+                .agg(*aggs)
+                .select(col(key_c).alias(f"{prefix}_key"),
+                        col(f"{prefix}_amt"), col(f"{prefix}_profit")))
+
+    def channel(label, sales, returns):
+        return (sales.join(returns, on=col("s_key") == col("r_key"),
+                           how="full")
+                .select(lit(label).alias("channel"),
+                        F.coalesce(col("s_key"), col("r_key"))
+                        .alias("id"),
+                        F.coalesce(col("s_amt"), lit(0.0))
+                        .alias("sales"),
+                        F.coalesce(col("r_amt"), lit(0.0))
+                        .alias("returns"),
+                        (F.coalesce(col("s_profit"), lit(0.0))
+                         - F.coalesce(col("r_profit"), lit(0.0)))
+                        .alias("profit")))
+
+    ss = side("store_sales", "ss_sold_date_sk", "ss_store_sk",
+              "ss_ext_sales_price", "ss_net_profit", "s")
+    sr = side("store_returns", "sr_returned_date_sk", "sr_store_sk",
+              "sr_return_amt", "sr_net_loss", "r")
+    cs = side("catalog_sales", "cs_sold_date_sk", "cs_call_center_sk",
+              "cs_ext_sales_price", "cs_net_profit", "s")
+    cr = side("catalog_returns", "cr_returned_date_sk",
+              "cr_call_center_sk", "cr_return_amount", "cr_net_loss",
+              "r")
+    ws = side("web_sales", "ws_sold_date_sk", "ws_web_page_sk",
+              "ws_ext_sales_price", "ws_net_profit", "s")
+    wr = side("web_returns", "wr_returned_date_sk", "wr_web_page_sk",
+              "wr_return_amt", "wr_net_loss", "r")
+    unioned = (channel("store channel", ss, sr)
+               .union(channel("catalog channel", cs, cr))
+               .union(channel("web channel", ws, wr)))
+    return (unioned
+            .rollup(col("channel"), col("id"))
+            .agg(F.sum(col("sales")).alias("sales"),
+                 F.sum(col("returns")).alias("returns"),
+                 F.sum(col("profit")).alias("profit"))
+            .order_by(col("channel"), col("id"))
+            .limit(100))
+
+
+def q78(t):
+    """Yearly (customer, item) volumes per channel EXCLUDING returned
+    sales (left-join-null return filters), store joined against web and
+    catalog activity of the same customer/item/year."""
+    def channel(sales, ret, s_item, s_ord_or_tick, s_cust, s_qty,
+                s_price, r_item, r_ord, date_c, prefix):
+        rets = t[ret].select(col(r_item).alias(f"{prefix}ri"),
+                             col(r_ord).alias(f"{prefix}ro"))
+        base = (t[sales]
+                .join(rets,
+                      on=(col(s_item) == col(f"{prefix}ri"))
+                      & (col(s_ord_or_tick) == col(f"{prefix}ro")),
+                      how="left")
+                .filter(col(f"{prefix}ro").is_null())
+                .join(t["date_dim"],
+                      on=col(date_c) == col("d_date_sk")))
+        return (base
+                .group_by(col("d_year"), col(s_item), col(s_cust))
+                .agg(F.sum(col(s_qty).cast("double"))
+                     .alias(f"{prefix}_qty"),
+                     F.sum(col(s_price)).alias(f"{prefix}_amt"))
+                .select(col("d_year").alias(f"{prefix}_year"),
+                        col(s_item).alias(f"{prefix}_item"),
+                        col(s_cust).alias(f"{prefix}_cust"),
+                        col(f"{prefix}_qty"), col(f"{prefix}_amt")))
+
+    ss = channel("store_sales", "store_returns", "ss_item_sk",
+                 "ss_ticket_number", "ss_customer_sk", "ss_quantity",
+                 "ss_ext_sales_price", "sr_item_sk", "sr_ticket_number",
+                 "ss_sold_date_sk", "ss")
+    ws = channel("web_sales", "web_returns", "ws_item_sk",
+                 "ws_order_number", "ws_bill_customer_sk", "ws_quantity",
+                 "ws_ext_sales_price", "wr_item_sk", "wr_order_number",
+                 "ws_sold_date_sk", "ws")
+    cs = channel("catalog_sales", "catalog_returns", "cs_item_sk",
+                 "cs_order_number", "cs_bill_customer_sk", "cs_quantity",
+                 "cs_ext_sales_price", "cr_item_sk", "cr_order_number",
+                 "cs_sold_date_sk", "cs")
+    return (ss.filter(col("ss_year") == 2000)
+            .join(ws, on=(col("ws_year") == col("ss_year"))
+                  & (col("ws_item") == col("ss_item"))
+                  & (col("ws_cust") == col("ss_cust")), how="left")
+            .join(cs, on=(col("cs_year") == col("ss_year"))
+                  & (col("cs_item") == col("ss_item"))
+                  & (col("cs_cust") == col("ss_cust")), how="left")
+            .filter((F.coalesce(col("ws_qty"), lit(0.0)) > 0)
+                    | (F.coalesce(col("cs_qty"), lit(0.0)) > 0))
+            .select(col("ss_item"), col("ss_cust"), col("ss_qty"),
+                    col("ss_amt"),
+                    (col("ss_qty")
+                     / (F.coalesce(col("ws_qty"), lit(0.0))
+                        + F.coalesce(col("cs_qty"), lit(0.0))))
+                    .alias("ratio"))
+            .order_by(col("ratio").desc(), col("ss_qty").desc(),
+                      col("ss_item"), col("ss_cust"))
+            .limit(100))
+
+
+def q80(t):
+    """30-day sales/returns/profit per item across channels with a
+    non-event promotion filter, returns tied to their sale, ROLLUP'd by
+    channel and item (q5 by item instead of by outlet; p_channel_event
+    stands in for the spec's p_channel_tv)."""
+    dd = t["date_dim"].filter((col("d_date") >= "2000-08-23")
+                              & (col("d_date") <= "2000-09-22"))
+    it = t["item"].filter(col("i_current_price") > 50.0)
+    pr = t["promotion"].filter(col("p_channel_event") == "N")
+
+    def channel(sales, ret, s_item, s_ord, s_promo, s_price, s_profit,
+                r_item, r_ord, r_amt, r_loss, date_c, ent, label):
+        rets = t[ret].select(col(r_item).alias("ri"),
+                             col(r_ord).alias("ro"),
+                             col(r_amt).alias("ramt"),
+                             col(r_loss).alias("rloss"))
+        return (t[sales]
+                .join(dd, on=col(date_c) == col("d_date_sk"))
+                .join(it, on=col(s_item) == col("i_item_sk"))
+                .join(pr, on=col(s_promo) == col("p_promo_sk"))
+                .join(rets, on=(col(s_item) == col("ri"))
+                      & (col(s_ord) == col("ro")), how="left")
+                .group_by(col(ent))
+                .agg(F.sum(col(s_price)).alias("sales"),
+                     F.sum(F.coalesce(col("ramt"), lit(0.0)))
+                     .alias("returns"),
+                     F.sum(col(s_profit)
+                           - F.coalesce(col("rloss"), lit(0.0)))
+                     .alias("profit"))
+                .select(lit(label).alias("channel"),
+                        col(ent).alias("id"), col("sales"),
+                        col("returns"), col("profit")))
+
+    ssr = channel("store_sales", "store_returns", "ss_item_sk",
+                  "ss_ticket_number", "ss_promo_sk",
+                  "ss_ext_sales_price", "ss_net_profit", "sr_item_sk",
+                  "sr_ticket_number", "sr_return_amt", "sr_net_loss",
+                  "ss_sold_date_sk", "ss_store_sk", "store channel")
+    csr = channel("catalog_sales", "catalog_returns", "cs_item_sk",
+                  "cs_order_number", "cs_promo_sk",
+                  "cs_ext_sales_price", "cs_net_profit", "cr_item_sk",
+                  "cr_order_number", "cr_return_amount", "cr_net_loss",
+                  "cs_sold_date_sk", "cs_catalog_page_sk",
+                  "catalog channel")
+    wsr = channel("web_sales", "web_returns", "ws_item_sk",
+                  "ws_order_number", "ws_promo_sk",
+                  "ws_ext_sales_price", "ws_net_profit", "wr_item_sk",
+                  "wr_order_number", "wr_return_amt", "wr_net_loss",
+                  "ws_sold_date_sk", "ws_web_site_sk", "web channel")
+    return (ssr.union(csr).union(wsr)
+            .rollup(col("channel"), col("id"))
+            .agg(F.sum(col("sales")).alias("sales"),
+                 F.sum(col("returns")).alias("returns"),
+                 F.sum(col("profit")).alias("profit"))
+            .order_by(col("channel"), col("id"))
+            .limit(100))
+
+
+def q85(t):
+    """Web return reasons with quantity/refund/fee averages for coupled
+    demographic-and-price or geography-and-profit slices (the spec's
+    triple-OR join conditions kept as post-join filters; wr_net_loss
+    stands in for wr_fee, wr_return_amt for wr_refunded_cash)."""
+    cd1 = t["customer_demographics"].select(
+        col("cd_demo_sk").alias("cd1_sk"),
+        col("cd_marital_status").alias("ms1"),
+        col("cd_education_status").alias("es1"))
+    cd2 = t["customer_demographics"].select(
+        col("cd_demo_sk").alias("cd2_sk"),
+        col("cd_marital_status").alias("ms2"),
+        col("cd_education_status").alias("es2"))
+    # education-only tuples with widened price bands (the spec's exact
+    # (marital, education) pairs select ~1/35 of demographics — nothing
+    # at tiny sf; the coupled-OR SHAPE is what the query exercises)
+    demo_price = (
+        ((col("es1") == "4 yr Degree")
+         & col("ws_sales_price").between(0.0, 180.0))
+        | ((col("es1") == "College")
+           & col("ws_sales_price").between(0.0, 120.0))
+        | ((col("es1") == "Secondary")
+           & col("ws_sales_price").between(50.0, 180.0)))
+    geo_profit = (
+        (col("ca_state").isin("TN", "SD", "AL")
+         & col("ws_net_profit").between(0, 200))
+        | (col("ca_state").isin("GA", "MI", "OH")
+           & col("ws_net_profit").between(50, 300))
+        | (col("ca_state").isin("TX", "CA")
+           & col("ws_net_profit").between(-100, 250)))
+    return (t["web_sales"]
+            .join(t["web_returns"],
+                  on=(col("ws_item_sk") == col("wr_item_sk"))
+                  & (col("ws_order_number") == col("wr_order_number")))
+            .join(t["web_page"],
+                  on=col("ws_web_page_sk") == col("wp_web_page_sk"))
+            .join(cd1, on=col("wr_refunded_cdemo_sk") == col("cd1_sk"))
+            .join(cd2, on=col("wr_returning_cdemo_sk") == col("cd2_sk"))
+            .join(t["customer_address"],
+                  on=col("wr_refunded_addr_sk") == col("ca_address_sk"))
+            .join(t["reason"],
+                  on=col("wr_reason_sk") == col("r_reason_sk"))
+            .filter((col("ms1") == col("ms2")) & (col("es1") == col("es2"))
+                    & demo_price & geo_profit)
+            .group_by(col("r_reason_desc"))
+            .agg(F.avg(col("ws_quantity").cast("double")).alias("q_avg"),
+                 F.avg(col("wr_return_amt")).alias("refund_avg"),
+                 F.avg(col("wr_net_loss")).alias("fee_avg"))
+            .order_by(col("r_reason_desc"), col("q_avg"),
+                      col("refund_avg"), col("fee_avg"))
+            .limit(100))
+
+
+QUERIES = {n: globals()[f"q{n}"] for n in range(1, 100)}
 

@@ -1,4 +1,4 @@
-"""TPC-DS table schemas (subset backing the q3/q5/q7/q19/q42/q52/q55/q96
+"""TPC-DS table schemas (all 24 tables the 99-query
 tier; columns trimmed to those the queries touch plus keys).
 Reference counterpart: the TPC-DS benchmark drivers the reference ships
 under integration_tests (BASELINE.md staged config 3: TPC-DS q3/q5
@@ -49,7 +49,8 @@ CUSTOMER = Schema([
     F("c_current_addr_sk", LongType), F("c_birth_month", LongType),
     F("c_current_cdemo_sk", LongType), F("c_current_hdemo_sk", LongType),
     F("c_first_name", StringType), F("c_last_name", StringType),
-    F("c_salutation", StringType), F("c_preferred_cust_flag", StringType)])
+    F("c_salutation", StringType), F("c_preferred_cust_flag", StringType),
+    F("c_birth_country", StringType)])
 
 CUSTOMER_ADDRESS = Schema([
     F("ca_address_sk", LongType), F("ca_zip", StringType),
@@ -62,11 +63,12 @@ STORE = Schema([
     F("s_zip", StringType), F("s_number_employees", LongType),
     F("s_company_name", StringType), F("s_state", StringType),
     F("s_county", StringType), F("s_city", StringType),
-    F("s_gmt_offset", DoubleType)])
+    F("s_gmt_offset", DoubleType), F("s_market_id", LongType)])
 
 HOUSEHOLD_DEMOGRAPHICS = Schema([
     F("hd_demo_sk", LongType), F("hd_dep_count", LongType),
-    F("hd_vehicle_count", LongType), F("hd_buy_potential", StringType)])
+    F("hd_vehicle_count", LongType), F("hd_buy_potential", StringType),
+    F("hd_income_band_sk", LongType)])
 
 TIME_DIM = Schema([
     F("t_time_sk", LongType), F("t_hour", LongType),
@@ -77,7 +79,7 @@ STORE_RETURNS = Schema([
     F("sr_return_amt", DoubleType), F("sr_net_loss", DoubleType),
     F("sr_item_sk", LongType), F("sr_customer_sk", LongType),
     F("sr_ticket_number", LongType), F("sr_return_quantity", LongType),
-    F("sr_reason_sk", LongType)])
+    F("sr_reason_sk", LongType), F("sr_cdemo_sk", LongType)])
 
 WAREHOUSE = Schema([
     F("w_warehouse_sk", LongType), F("w_warehouse_name", StringType)])
@@ -98,23 +100,53 @@ CATALOG_SALES = Schema([
     F("cs_bill_cdemo_sk", LongType), F("cs_call_center_sk", LongType),
     F("cs_promo_sk", LongType), F("cs_quantity", LongType),
     F("cs_list_price", DoubleType), F("cs_sales_price", DoubleType),
-    F("cs_coupon_amt", DoubleType), F("cs_bill_addr_sk", LongType)])
+    F("cs_coupon_amt", DoubleType), F("cs_bill_addr_sk", LongType),
+    F("cs_ship_date_sk", LongType), F("cs_ship_mode_sk", LongType),
+    F("cs_warehouse_sk", LongType), F("cs_ship_addr_sk", LongType),
+    F("cs_ext_discount_amt", DoubleType), F("cs_sold_time_sk", LongType),
+    F("cs_ship_hdemo_sk", LongType)])
 
 CATALOG_RETURNS = Schema([
     F("cr_returned_date_sk", LongType), F("cr_catalog_page_sk", LongType),
-    F("cr_return_amount", DoubleType), F("cr_net_loss", DoubleType)])
+    F("cr_return_amount", DoubleType), F("cr_net_loss", DoubleType),
+    F("cr_item_sk", LongType), F("cr_order_number", LongType),
+    F("cr_call_center_sk", LongType),
+    F("cr_returning_customer_sk", LongType),
+    F("cr_return_quantity", LongType)])
 
 WEB_SALES = Schema([
     F("ws_sold_date_sk", LongType), F("ws_web_site_sk", LongType),
     F("ws_item_sk", LongType), F("ws_order_number", LongType),
     F("ws_ext_sales_price", DoubleType), F("ws_net_profit", DoubleType),
     F("ws_bill_customer_sk", LongType), F("ws_bill_addr_sk", LongType),
-    F("ws_ext_discount_amt", DoubleType)])
+    F("ws_ext_discount_amt", DoubleType),
+    F("ws_quantity", LongType), F("ws_list_price", DoubleType),
+    F("ws_sales_price", DoubleType), F("ws_ship_date_sk", LongType),
+    F("ws_warehouse_sk", LongType), F("ws_ship_mode_sk", LongType),
+    F("ws_promo_sk", LongType), F("ws_sold_time_sk", LongType),
+    F("ws_web_page_sk", LongType), F("ws_ship_customer_sk", LongType),
+    F("ws_ship_addr_sk", LongType), F("ws_ship_hdemo_sk", LongType)])
 
 WEB_RETURNS = Schema([
     F("wr_returned_date_sk", LongType), F("wr_item_sk", LongType),
     F("wr_order_number", LongType), F("wr_return_amt", DoubleType),
-    F("wr_net_loss", DoubleType)])
+    F("wr_net_loss", DoubleType),
+    F("wr_returning_customer_sk", LongType), F("wr_reason_sk", LongType),
+    F("wr_return_quantity", LongType),
+    F("wr_refunded_cdemo_sk", LongType),
+    F("wr_returning_cdemo_sk", LongType),
+    F("wr_refunded_addr_sk", LongType), F("wr_web_page_sk", LongType)])
+
+SHIP_MODE = Schema([
+    F("sm_ship_mode_sk", LongType), F("sm_type", StringType),
+    F("sm_carrier", StringType)])
+
+WEB_PAGE = Schema([
+    F("wp_web_page_sk", LongType), F("wp_char_count", LongType)])
+
+INCOME_BAND = Schema([
+    F("ib_income_band_sk", LongType), F("ib_lower_bound", LongType),
+    F("ib_upper_bound", LongType)])
 
 CATALOG_PAGE = Schema([
     F("cp_catalog_page_sk", LongType), F("cp_catalog_page_id", StringType)])
@@ -135,5 +167,6 @@ SCHEMAS = {
     "web_sales": WEB_SALES, "web_returns": WEB_RETURNS,
     "catalog_page": CATALOG_PAGE, "web_site": WEB_SITE,
     "call_center": CALL_CENTER, "warehouse": WAREHOUSE,
-    "inventory": INVENTORY, "reason": REASON,
+    "inventory": INVENTORY, "reason": REASON, "ship_mode": SHIP_MODE,
+    "web_page": WEB_PAGE, "income_band": INCOME_BAND,
 }

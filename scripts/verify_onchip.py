@@ -1,0 +1,55 @@
+"""On-chip verify drive: a small end-to-end query through the public API
+on the real TPU, checked against a hand-computed oracle.
+
+Run from /root/repo with the ambient env (JAX_PLATFORMS=axon), one jax
+process at a time:  timeout 600 python scripts/verify_onchip.py
+
+Exit 0 prints VERIFY-ONCHIP-OK; any mismatch raises.  Floats compare with
+tolerance: the axon backend emulates f64 as an f32 pair (~49-bit
+mantissa), so doubles can move ~4e-16 rel per transfer.
+"""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+
+from spark_rapids_tpu.engine import TpuSession  # noqa: E402
+from spark_rapids_tpu.plan.logical import col, functions as F  # noqa: E402
+
+
+def main():
+    platform = jax.devices()[0].platform
+    print(f"platform: {platform}")
+    s = TpuSession({"spark.rapids.sql.variableFloatAgg.enabled": "true"})
+    n = 10_000
+    data = {
+        "k": [i % 7 for i in range(n)],
+        "v": [float(i) for i in range(n)],
+        "w": [i % 3 for i in range(n)],
+    }
+    df = s.from_pydict(data)
+    got = dict(
+        (r[0], (r[1], r[2]))
+        for r in (df.filter(col("w") != 0)
+                  .group_by(col("k"))
+                  .agg(F.sum(col("v")).alias("s"),
+                       F.count(col("v")).alias("c"))
+                  .collect()))
+    # hand-computed oracle
+    want = {}
+    for i in range(n):
+        if i % 3 == 0:
+            continue
+        sm, c = want.get(i % 7, (0.0, 0))
+        want[i % 7] = (sm + float(i), c + 1)
+    assert set(got) == set(want), (sorted(got), sorted(want))
+    for k, (sm, c) in want.items():
+        gs, gc = got[k]
+        assert gc == c, (k, gc, c)
+        assert abs(gs - sm) <= 1e-9 * max(1.0, abs(sm)), (k, gs, sm)
+    print(f"VERIFY-ONCHIP-OK platform={platform} groups={len(got)}")
+
+
+if __name__ == "__main__":
+    main()

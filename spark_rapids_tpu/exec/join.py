@@ -91,8 +91,9 @@ class TpuHashJoinExec(TpuExec):
                  condition: Optional[E.Expression], out_schema: Schema,
                  using_drop: Optional[List[int]] = None):
         super().__init__(left, right)
-        # canonical names so kernels only ever see "left"
-        self.join_type = {"left_outer": "left"}.get(join_type, join_type)
+        # canonical names so kernels only ever see "left"/"full"
+        self.join_type = {"left_outer": "left",
+                          "full_outer": "full"}.get(join_type, join_type)
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
         self.condition = condition
@@ -141,10 +142,41 @@ class TpuHashJoinExec(TpuExec):
         width = jnp.where(lbatch.sel, hi - lo, 0)
         return lo, hi, jnp.max(width)
 
+    @staticmethod
+    def _joined_fields(lschema: Schema, rschema: Schema):
+        """Joined-output fields: left fields as-is, right fields renamed
+        `name_r` on collision.  The ONE definition shared by the pair-
+        condition view, the gather output, and the full-outer tail — the
+        three must agree or the condition sees a different schema than
+        the output rows."""
+        lfields = list(lschema.fields)
+        rfields = [StructField(f.name + "_r"
+                               if f.name in lschema.names else f.name,
+                               f.dtype) for f in rschema]
+        return lfields, rfields
+
+    def _pair_condition_ok(self, lbatch: ColumnarBatch,
+                           build: ColumnarBatch, bidx):
+        """Residual-condition mask for candidate pairs (left row i, build
+        row bidx[i]): gathers build columns at bidx into a joined-schema
+        view and evaluates the condition vectorized.  Beyond the
+        reference's inner-only conditional joins (GpuHashJoin tagJoin):
+        evaluating inside the candidate walk gives conditional
+        left_semi/left_anti exact per-pair semantics."""
+        lcols = list(lbatch.columns)
+        rcols = [c.take(bidx) for c in build.columns]
+        lfields, rfields = self._joined_fields(lbatch.schema, build.schema)
+        pair = ColumnarBatch(lcols + rcols, lbatch.sel,
+                             Schema(lfields + rfields))
+        cond = self.condition.eval(pair)
+        return cond.valid & cond.data.astype(jnp.bool_)
+
     def _count_kernel(self, max_dup: int, lbatch: ColumnarBatch,
                       build: ColumnarBatch, bkeys, lo, hi,
                       vary_axes: tuple = ()):
-        """Verified match count per stream row + prefix starts + total."""
+        """Verified match count per stream row + prefix starts + total.
+        The residual condition (when present) participates in the count,
+        so semi/anti membership and the inner pair count are exact."""
         lkeys = [e.eval(lbatch) for e in self.left_keys]
         cap_b = build.capacity
         live = lbatch.sel
@@ -155,12 +187,14 @@ class TpuHashJoinExec(TpuExec):
             ok = live & ((lo + d) < hi) & jnp.take(blive, bidx, mode="clip")
             for lk, bk in zip(lkeys, bkeys):
                 ok &= _row_equal(lk, bk, bidx)
+            if self.condition is not None:
+                ok &= self._pair_condition_ok(lbatch, build, bidx)
             return cnt + ok.astype(jnp.int32)
 
         counts = jax.lax.fori_loop(
             0, max_dup, body,
             _pvary(jnp.zeros(lbatch.capacity, jnp.int32), vary_axes))
-        if self.join_type == "left":
+        if self.join_type in ("left", "full"):
             counts = jnp.where(live & (counts == 0), 1, counts)
         starts = jnp.cumsum(counts) - counts
         return counts, starts, jnp.sum(counts)
@@ -179,24 +213,33 @@ class TpuHashJoinExec(TpuExec):
         l_idx = _pvary(jnp.zeros(out_cap, jnp.int32), vary_axes)
         b_idx = _pvary(jnp.zeros(out_cap, jnp.int32), vary_axes)
         matched = _pvary(jnp.zeros(out_cap, jnp.bool_), vary_axes)
+        b_hit = _pvary(jnp.zeros(cap_b, jnp.bool_), vary_axes)
         rows = jnp.arange(lbatch.capacity, dtype=jnp.int32)
 
         def body(d, carry):
-            l_out, b_out, m_out, rank = carry
+            l_out, b_out, m_out, bh, rank = carry
             bidx = jnp.clip(lo + d, 0, cap_b - 1)
             ok = live & ((lo + d) < hi) & jnp.take(blive, bidx, mode="clip")
             for lk, bk in zip(lkeys, bkeys):
                 ok &= _row_equal(lk, bk, bidx)
+            if self.condition is not None:
+                # the SAME condition the count kernel applied: slots are
+                # allocated from condition-aware counts, so the scatter
+                # must see an identical match set
+                ok &= self._pair_condition_ok(lbatch, build, bidx)
             slot = jnp.where(ok, starts + rank, out_cap)  # out_cap = dropped
             l_out = l_out.at[slot].set(rows, mode="drop")
             b_out = b_out.at[slot].set(bidx, mode="drop")
             m_out = m_out.at[slot].set(True, mode="drop")
-            return l_out, b_out, m_out, rank + ok.astype(jnp.int32)
+            # full join: remember which BUILD rows ever matched, so the
+            # stream driver can emit the never-matched remainder
+            bh = bh.at[jnp.where(ok, bidx, cap_b)].set(True, mode="drop")
+            return l_out, b_out, m_out, bh, rank + ok.astype(jnp.int32)
 
         zero_rank = _pvary(jnp.zeros(lbatch.capacity, jnp.int32), vary_axes)
-        l_idx, b_idx, matched, _ = jax.lax.fori_loop(
-            0, max_dup, body, (l_idx, b_idx, matched, zero_rank))
-        if self.join_type == "left":
+        l_idx, b_idx, matched, b_hit, _ = jax.lax.fori_loop(
+            0, max_dup, body, (l_idx, b_idx, matched, b_hit, zero_rank))
+        if self.join_type in ("left", "full"):
             # unmatched live rows were forced to counts==1; their slot
             # (starts[i]) was never written by the match loop, so fill it
             # with the left row and leave `matched` False (right side null)
@@ -213,16 +256,32 @@ class TpuHashJoinExec(TpuExec):
             taken = c.take(b_idx)
             rcols.append(taken.with_valid(taken.valid & matched)
                          .mask_invalid())
-        lfields = list(lbatch.schema.fields)
-        rfields = [StructField(f.name + "_r"
-                               if f.name in lbatch.schema.names else f.name,
-                               f.dtype) for f in build.schema]
+        lfields, rfields = self._joined_fields(lbatch.schema, build.schema)
         joined = ColumnarBatch(lcols + rcols, sel,
                                Schema(lfields + rfields))
-        if self.condition is not None:
-            cond = self.condition.eval(joined)
-            keep = cond.valid & cond.data.astype(jnp.bool_)
-            joined = joined.filter(keep)
+        # no post-filter: the residual condition (if any) was already
+        # applied pair-wise in the count/gather walk, so slots and counts
+        # agree by construction
+        if self.using_drop:
+            keep_idx = [i for i in range(joined.num_cols)
+                        if i not in self.using_drop]
+            joined = joined.select_columns(keep_idx)
+        out = ColumnarBatch(joined.columns, joined.sel, self._schema)
+        if self.join_type == "full":
+            return out, b_hit
+        return out
+
+    def _full_remainder(self, build: ColumnarBatch, b_hit) -> ColumnarBatch:
+        """FULL OUTER tail: build rows no stream row ever matched, with
+        the left side all-null (emitted once, after the whole stream)."""
+        lschema = self.children[0].schema
+        lcols = [Column.all_null(f.dtype, build.capacity)
+                 for f in lschema]
+        rcols = list(build.columns)
+        sel = build.sel & ~b_hit
+        lfields, rfields = self._joined_fields(lschema, build.schema)
+        joined = ColumnarBatch(lcols + rcols, sel,
+                               Schema(lfields + rfields))
         if self.using_drop:
             keep_idx = [i for i in range(joined.num_cols)
                         if i not in self.using_drop]
@@ -258,6 +317,7 @@ class TpuHashJoinExec(TpuExec):
         with self.metrics.timer("buildTime"), named_range("join_build"):
             build, bkeys, h1s = build_fn(rbatch)
 
+        b_hit_accum = None  # full join: OR of per-batch build-hit masks
         for lbatch in lbatches:
             with self.metrics.timer("joinTime"), named_range("join_stream"):
                 lo, hi, max_dup_t = window_fn(lbatch, h1s)
@@ -284,9 +344,24 @@ class TpuHashJoinExec(TpuExec):
                                                   max_dup, out_cap))
                     out = gather_fn(lbatch, build, bkeys, lo, hi,
                                     counts, starts, total_t)
+                    if self.join_type == "full":
+                        out, b_hit = out
+                        b_hit_accum = b_hit if b_hit_accum is None \
+                            else b_hit_accum | b_hit
             self.metrics.add("numOutputBatches", 1)
             self.metrics.add("numOutputRows", out.num_rows_host())
             yield out
+        if self.join_type == "full":
+            if b_hit_accum is None:
+                b_hit_accum = jnp.zeros(build.capacity, jnp.bool_)
+            with self.metrics.timer("joinTime"), \
+                    named_range("join_full_tail"):
+                tail = self._full_remainder(build, b_hit_accum)
+            n = tail.num_rows_host()
+            if n:
+                self.metrics.add("numOutputBatches", 1)
+                self.metrics.add("numOutputRows", n)
+                yield tail
 
 
 def _empty_batch(schema: Schema) -> ColumnarBatch:
@@ -319,8 +394,17 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
                 lex.execute_partitions(ctx), rex.execute_partitions(ctx)):
             assert lp == rp
             if lbatch is None:
-                # no left rows in this partition: inner/left/semi/anti all
-                # produce nothing from it
+                if self.join_type != "full" or rbatch is None:
+                    # no left rows in this partition: inner/left/semi/anti
+                    # produce nothing from it — but FULL OUTER must still
+                    # emit this partition's build rows with left nulls
+                    continue
+                tail = self._full_remainder(
+                    rbatch, jnp.zeros(rbatch.capacity, jnp.bool_))
+                if tail.num_rows_host():
+                    produced = True
+                    self.metrics.add("numOutputBatches", 1)
+                    yield tail
                 continue
             if rbatch is None:
                 rbatch = _empty_batch(rex.schema)

@@ -95,7 +95,12 @@ class ColumnExpr:
     def alias(self, name: str) -> "ColumnExpr":
         return ColumnExpr(self.op, self.args, alias=name)
 
-    def cast(self, to: DataType) -> "ColumnExpr":
+    def cast(self, to) -> "ColumnExpr":
+        if isinstance(to, str):  # Spark accepts type names: .cast("double")
+            from ..types import _canonical_type
+            to = _canonical_type({"bigint": "long", "integer": "int",
+                                  "smallint": "short",
+                                  "tinyint": "byte"}.get(to, to))
         return ColumnExpr("Cast", (self, to))
 
     def isin(self, *items) -> "ColumnExpr":

@@ -44,7 +44,11 @@ def convert(meta: PlanMeta) -> ExecNode:
                 using_drop.append(lw + rs.index_of(name))
         if on_tpu:
             from ..exec.join import TpuHashJoinExec
-            if _should_broadcast_build(plan, meta.conf):
+            if (_should_broadcast_build(plan, meta.conf)
+                    and plan.join_type not in ("full", "full_outer")):
+                # full outer never broadcasts: the never-matched-build
+                # tail is emitted once per probe STREAM, so a replicated
+                # build would duplicate it under any parallel probe
                 from ..exec.broadcast import (TpuBroadcastExchangeExec,
                                               TpuBroadcastHashJoinExec)
                 return TpuBroadcastHashJoinExec(

@@ -16,7 +16,8 @@ from . import logical as L
 from .analysis import resolve
 from .overrides import ExprMeta, PlanMeta, expr_conf_key, plan_schema
 
-_TPU_JOIN_TYPES = {"inner", "left", "left_outer", "left_semi", "left_anti"}
+_TPU_JOIN_TYPES = {"inner", "left", "left_outer", "left_semi", "left_anti",
+                   "full", "full_outer"}
 
 
 
@@ -166,7 +167,15 @@ def _tag_join(meta: PlanMeta):
     if plan.join_type not in _TPU_JOIN_TYPES:
         meta.will_not_work(
             f"{plan.join_type} joins are not supported on TPU "
-            "(Inner/Left/LeftSemi/LeftAnti only, like the reference)")
+            "(Inner/Left/Full/LeftSemi/LeftAnti; the reference stops at "
+            "Inner/Left/LeftSemi/LeftAnti — device FULL OUTER goes "
+            "beyond it)")
+    if plan.join_type in ("full", "full_outer") and plan.using:
+        # USING full joins coalesce the key columns of BOTH sides into
+        # one output column; the device kernels carry left-or-null keys
+        # only, so Spark's coalesced-key contract needs the CPU path
+        meta.will_not_work("full outer USING joins (coalesced keys) are "
+                           "not supported on TPU")
     ls = plan_schema(plan.children[0], meta.conf)
     rs = plan_schema(plan.children[1], meta.conf)
     lkeys, rkeys, cond = [], [], None
@@ -186,13 +195,16 @@ def _tag_join(meta: PlanMeta):
             lkeys.append(lk)
             rkeys.append(rk)
         if residual is not None:
-            if plan.join_type != "inner":
-                # post-filtering is only equivalent to a join condition for
-                # inner joins (reference: GpuHashJoin tagJoin restricts
-                # conditional joins the same way)
+            if plan.join_type not in ("inner", "left_semi", "left_anti"):
+                # the device join applies the residual pair-wise inside
+                # the candidate walk, which is exact for inner and for
+                # semi/anti EXISTS semantics; outer joins would need
+                # matched-row bookkeeping the kernels do not carry
+                # (reference: GpuHashJoin tagJoin allows inner ONLY —
+                # device semi/anti conditionals go beyond it)
                 meta.will_not_work(
                     f"conditional {plan.join_type} joins are not supported "
-                    "on TPU (inner only)")
+                    "on TPU (inner/semi/anti only)")
             joined = _joined_schema(ls, rs)
             cond = resolve(residual, joined)
             meta.expr_metas.append(ExprMeta(cond, meta.conf))

@@ -125,9 +125,13 @@ def distribute(node: ExecNode, conf: TpuConf) -> ExecNode:
     allgather = conf.get(C.MESH_USE_ALLGATHER)
 
     def walk(n: ExecNode) -> ExecNode:
-        if isinstance(n, TpuShuffledHashJoinExec):
+        if isinstance(n, TpuShuffledHashJoinExec) \
+                and n.join_type != "full":
             # the mesh all-to-all IS the exchange: unwrap the planner-
-            # inserted single-chip exchanges and join their inputs SPMD
+            # inserted single-chip exchanges and join their inputs SPMD.
+            # FULL joins stay single-chip: their never-matched-build tail
+            # is emitted once per probe stream, which per-chunk
+            # concatenation cannot compose.
             left = n.children[0].children[0]
             right = n.children[1].children[0]
             out = TpuDistributedJoinExec(
@@ -153,7 +157,7 @@ def distribute(node: ExecNode, conf: TpuConf) -> ExecNode:
             return TpuDistributedAggregateExec(
                 n.grouping, n.group_names, n.aggregates, n.children[0],
                 mesh, allgather)
-        if type(n) is TpuHashJoinExec:
+        if type(n) is TpuHashJoinExec and n.join_type != "full":
             return TpuDistributedJoinExec(
                 n.children[0], n.children[1], n.join_type, n.left_keys,
                 n.right_keys, n.condition, n.schema, n.using_drop, mesh,

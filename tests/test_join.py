@@ -158,6 +158,72 @@ def test_join_with_residual_condition():
     assert_tpu_and_cpu_are_equal(q)
 
 
+@pytest.mark.parametrize("how", ["left_semi", "left_anti"])
+def test_conditional_semi_anti_on_device(how):
+    """Equi keys + residual for EXISTS semantics run ON DEVICE: the
+    condition participates in the candidate-walk counts (beyond the
+    reference's inner-only conditional joins, GpuHashJoin tagJoin)."""
+    from spark_rapids_tpu.engine import TpuSession
+
+    def q(s):
+        left = keyed_df(s, 117, 200, extra={"a": T.IntegerType})
+        right = keyed_df(s, 217, 200, extra={"b": T.IntegerType}) \
+            .select(col("k").alias("kr"), col("b"))
+        return left.join(right,
+                         (col("k") == col("kr")) & (col("a") > col("b")),
+                         how)
+
+    s = TpuSession({})
+    text = q(s).explain()
+    assert "!SortMergeJoinExec" not in text, text
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_conditional_semi_self_inequality():
+    """q16/q94's EXISTS shape: same order, DIFFERENT warehouse — the
+    residual references both sides of a self semi-join."""
+    def q(s):
+        rows = keyed_df(s, 118, 300, key_range=40,
+                        extra={"w": T.IntegerType})
+        other = rows.select(col("k").alias("k2"), col("w").alias("w2"))
+        return rows.join(other, (col("k") == col("k2"))
+                         & (col("w") != col("w2")), "left_semi")
+    _check(q)
+
+
+def test_full_join_partitioned_empty_left_partition():
+    """Partitioned FULL OUTER: a partition with build rows but NO probe
+    rows must still emit its build rows with left nulls (regression: the
+    empty-left-partition skip dropped them)."""
+    def q(s):
+        import spark_rapids_tpu.types as T2
+        left = s.from_pydict(
+            {"k": [1, 2], "a": [10, 20]},
+            T2.Schema([T2.StructField("k", T2.LongType),
+                       T2.StructField("a", T2.LongType)]))
+        right = s.from_pydict(
+            {"kr": [1, 5, 6, 7, 8], "b": [100, 500, 600, 700, 800]},
+            T2.Schema([T2.StructField("kr", T2.LongType),
+                       T2.StructField("b", T2.LongType)]))
+        return left.join(right, col("k") == col("kr"), "full")
+    _check(q, conf={
+        "spark.rapids.sql.tpu.join.partitioned.enabled": "true",
+        "spark.rapids.sql.tpu.join.partitioned.threshold": "1",
+        "spark.rapids.sql.tpu.shuffle.partitions": "4"})
+
+
+def test_cast_accepts_spark_type_names():
+    """col.cast('integer')/'int'/'bigint'/'double' all resolve (Spark's
+    string type-name surface)."""
+    def q(s):
+        df = keyed_df(s, 119, 50, extra={"a": T.IntegerType})
+        return df.select(col("a").cast("bigint").alias("l"),
+                         col("a").cast("double").alias("d"),
+                         col("a").cast("int").alias("i"),
+                         col("a").cast("integer").alias("i2"))
+    _check(q)
+
+
 def test_conditional_left_join_falls_back():
     """Conditional non-inner joins must fall back to CPU (and be right)."""
     from spark_rapids_tpu.engine import TpuSession
@@ -176,7 +242,25 @@ def test_conditional_left_join_falls_back():
     assert_tpu_and_cpu_are_equal(q)
 
 
-def test_full_join_falls_back():
+def test_full_join_on_device():
+    """Expression-keyed FULL OUTER runs on device (never-matched build
+    rows surface as a left-null tail batch); USING full joins still fall
+    back for Spark's coalesced-key contract."""
+    from spark_rapids_tpu.engine import TpuSession
+
+    def q(s):
+        left = keyed_df(s, 109, 100, extra={"a": T.IntegerType})
+        right = keyed_df(s, 209, 100, extra={"b": T.IntegerType}) \
+            .select(col("k").alias("kr"), col("b"))
+        return left.join(right, col("k") == col("kr"), "full")
+
+    s = TpuSession({})
+    text = q(s).explain()
+    assert "!SortMergeJoinExec" not in text, text
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_full_join_using_falls_back():
     from spark_rapids_tpu.engine import TpuSession
 
     def q(s):

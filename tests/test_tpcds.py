@@ -26,10 +26,11 @@ def test_tpcds_query(qnum):
     cpu = run_query(qnum, {"spark.rapids.sql.enabled": "false"})
     tpu = run_query(qnum, {})
     assert len(cpu) > 0 or qnum in (19,), f"q{qnum} selected nothing"
-    if qnum in (38, 87, 92, 96):
+    if qnum in (38, 87, 92, 96, 16, 94, 95, 23, 32):
         # single-row global aggregates: a zero/null result would make the
         # oracle comparison vacuous — the generator plants omni-channel
-        # overlap (q38/q87) and a meaningful discount window (q92)
+        # overlap (q38/q87), a meaningful discount window (q92/q32), and
+        # multi-line catalog/web orders (q16/q94/q95)
         assert cpu[0][0] not in (0, None), f"q{qnum} trivial: {cpu}"
     assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=True)
 
