@@ -523,18 +523,38 @@ def main():
         "tpcds_sf": TPCDS_SF,
         "aborted": dev.get("aborted", False),
     }
-    try:
-        with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
-            json.dump({"dev": dev, "cpu": cpu, "extra": extra}, f, indent=1)
-    except OSError:
-        pass
-    print(json.dumps({
+    result = {
         "metric": f"tpch_q6_like_{N_ROWS // 1_000_000}M_rows_device_throughput",
         "value": round(N_ROWS / q6_t / 1e6, 3),
         "unit": f"Mrows/s[{platform}]",
         "vs_baseline": round(vs, 3),
         "extra": extra,
-    }))
+    }
+    onchip_path = os.path.join(REPO, "BENCH_ONCHIP.json")
+    if platform.startswith("tpu") and not mismatch:
+        # persist real-chip evidence: the lease can be down for hours
+        # (three rounds lost to it), so a later fallback run must not be
+        # the only record
+        try:
+            with open(onchip_path, "w") as f:
+                json.dump({"recorded_unix": int(time.time()), **result}, f,
+                          indent=1)
+        except OSError:
+            pass
+    elif os.path.exists(onchip_path):
+        # chip unavailable THIS run: point at the last real on-chip
+        # record (clearly labeled; the headline metric stays this run's)
+        try:
+            with open(onchip_path) as f:
+                extra["last_onchip"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+    try:
+        with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
+            json.dump({"dev": dev, "cpu": cpu, "extra": extra}, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -401,9 +401,11 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
                     continue
                 tail = self._full_remainder(
                     rbatch, jnp.zeros(rbatch.capacity, jnp.bool_))
-                if tail.num_rows_host():
+                n = tail.num_rows_host()
+                if n:
                     produced = True
                     self.metrics.add("numOutputBatches", 1)
+                    self.metrics.add("numOutputRows", n)
                     yield tail
                 continue
             if rbatch is None:

@@ -16,8 +16,9 @@ window covering widths up to 64), STRING (DIRECT_V2 length+blob gather
 and DICTIONARY_V2 index+dictionary gather through the unsigned RLEv2
 path), BOOLEAN, and TIMESTAMP (2015-epoch seconds + trailing-zero
 compressed nanos combined in-kernel).  All four RLEv2 sub-encodings
-decode (SHORT_REPEAT/DIRECT/DELTA/PATCHED_BASE — patched runs are rare
-outlier forms and decode on host within the run walk).  Char/varchar/
+decode (SHORT_REPEAT/DIRECT/DELTA/PATCHED_BASE — patched payloads
+bit-extract on DEVICE like DIRECT, with run base + patch high-bits
+folded into a per-value additive base).  Char/varchar/
 decimal/binary and nested types fall back to the pyarrow stripe reader
 COLUMN-granularly, exactly like the parquet decoder's
 unsupported-encoding fallback.
@@ -496,17 +497,20 @@ def rlev2_runs(body: bytes, n_values: int, signed: bool = True):
     """Walk the RLEv2 run headers.
 
     Returns (host_vals int64[n_values] with SR/DELTA positions filled,
-    direct_runs [(width, byte_offset, count, out_offset)]).  `signed`
-    selects zigzag decode for SR/DIRECT values (value streams) vs raw
-    unsigned (LENGTH / dictionary-index streams; DELTA's first delta stays
-    zigzag either way, per the spec).  All four RLEv2 sub-encodings
-    decode: SR/DELTA/PATCHED_BASE values land in host_vals during this
-    walk (PATCHED_BASE is the rare outlier encoding; resolving its patch
-    list costs only the header walk already being paid), and DIRECT runs
-    return as descriptors for the device bit-extraction kernel, whose
-    9-byte window covers widths up to 64 bits."""
+    direct_runs [(width, byte_offset, count, out_offset)],
+    based_runs [(width, payload_offset, count, out_offset, base,
+    [(rel_pos, add)...])]).  `signed` selects zigzag decode for SR/DIRECT
+    values (value streams) vs raw unsigned (LENGTH / dictionary-index
+    streams; DELTA's first delta stays zigzag either way, per the spec).
+    All four RLEv2 sub-encodings decode: SR/DELTA values land in
+    host_vals during this walk; DIRECT and PATCHED_BASE payloads return
+    as descriptors for the device bit-extraction kernel (9-byte window,
+    widths up to 64 bits) — PATCHED_BASE extracts raw (no zigzag) with a
+    per-value additive base carrying both the run base and the patch
+    high-bits (OR == ADD above the packed width)."""
     host_vals = np.zeros(n_values, np.int64)
     direct = []
+    based = []  # PATCHED_BASE runs: device-extracted like DIRECT + base
     pos = out = 0
     while out < n_values and pos < len(body):
         h = body[pos]
@@ -569,25 +573,27 @@ def rlev2_runs(body: bytes, n_values: int, signed: bool = True):
             msb = 1 << (bw * 8 - 1)
             if base & msb:                    # sign-magnitude base
                 base = -(base & (msb - 1))
-            pos += bw
-            deltas = _unpack_bits_host(body, pos * 8, ln,
-                                       width).astype(object)
-            pos += (ln * width + 7) // 8
+            payload_off = pos + bw
+            pos = payload_off + (ln * width + 7) // 8
             pw_total = next(w for w in _W5 if w >= pgw + pw)
             patches = _unpack_bits_host(body, pos * 8, pll, pw_total)
             pos += (pll * pw_total + 7) // 8
+            # a patch ORs bits ABOVE `width` into the packed delta; the
+            # delta is < 2^width, so OR == ADD — patches fold into the
+            # per-value additive base the device kernel applies
+            adds = []
             gap_pos = 0
             for pe in patches.tolist():
                 gap_pos += int(pe) >> pw
                 pval = int(pe) & ((1 << pw) - 1)
                 if pval:
-                    deltas[gap_pos] = int(deltas[gap_pos]) | (pval << width)
-            host_vals[out:out + ln] = base + deltas.astype(np.int64)
+                    adds.append((gap_pos, pval << width))
+            based.append((width, payload_off, ln, out, base, adds))
             out += ln
     if out != n_values:
         raise OrcDeviceUnsupported(
             f"RLEv2 stream decoded {out} of {n_values} values")
-    return host_vals, direct
+    return host_vals, direct, based
 
 
 def _rlev2_device_values(data_raw: bytes, count: int, out_cap: int,
@@ -605,18 +611,31 @@ def _rlev2_device_values(data_raw: bytes, count: int, out_cap: int,
     from ..columnar.batch import bucket_rows
     from ..utils.kernel_cache import cached_kernel
 
-    host_vals, direct = rlev2_runs(data_raw, count, signed)
-    n_direct = sum(ln for (_w, _o, ln, _d) in direct)
+    host_vals, direct, based = rlev2_runs(data_raw, count, signed)
+    n_direct = sum(ln for (_w, _o, ln, _d) in direct) \
+        + sum(r[2] for r in based)
     dbucket = bucket_rows(max(n_direct, 1))
     bitpos = np.zeros(dbucket, np.int64)
     widths = np.zeros(dbucket, np.int64)
     dests = np.full(dbucket, out_cap, np.int64)
+    bases = np.zeros(dbucket, np.int64)
+    nozig = np.zeros(dbucket, bool)
     pos = 0
     for (width, off, ln, out_off) in direct:
         bitpos[pos:pos + ln] = off * 8 \
             + np.arange(ln, dtype=np.int64) * width
         widths[pos:pos + ln] = width
         dests[pos:pos + ln] = out_off + np.arange(ln, dtype=np.int64)
+        pos += ln
+    for (width, off, ln, out_off, base, adds) in based:
+        bitpos[pos:pos + ln] = off * 8 \
+            + np.arange(ln, dtype=np.int64) * width
+        widths[pos:pos + ln] = width
+        dests[pos:pos + ln] = out_off + np.arange(ln, dtype=np.int64)
+        bases[pos:pos + ln] = base
+        nozig[pos:pos + ln] = True
+        for rel, add in adds:
+            bases[pos + rel] += add
         pos += ln
     pbucket = bucket_rows(max(len(data_raw), 1))
     packed = np.zeros(pbucket, np.uint8)
@@ -625,7 +644,8 @@ def _rlev2_device_values(data_raw: bytes, count: int, out_cap: int,
     compact[:count] = host_vals
 
     def build():
-        def k(packed_v, compact_v, bitpos_v, widths_v, dests_v):
+        def k(packed_v, compact_v, bitpos_v, widths_v, dests_v,
+              bases_v, nozig_v):
             # big-endian 9-byte window starting at the value's byte: a
             # 64-bit hi word + one spill byte covers any bit offset (0-7)
             # with widths up to the full 64
@@ -653,18 +673,23 @@ def _rlev2_device_values(data_raw: bytes, count: int, out_cap: int,
                 - jnp.uint64(1))
             u = raw & mask
             if signed:
-                v = (u >> jnp.uint64(1)).astype(jnp.int64) \
+                zz = (u >> jnp.uint64(1)).astype(jnp.int64) \
                     * jnp.where((u & jnp.uint64(1)) > 0, -1, 1) \
                     - jnp.where((u & jnp.uint64(1)) > 0, 1, 0)
+                # PATCHED_BASE payloads are raw unsigned even in signed
+                # streams; their value is base + raw (patches pre-folded
+                # into bases_v as additive high bits)
+                v = jnp.where(nozig_v, u.astype(jnp.int64), zz) + bases_v
             else:
-                v = u.astype(jnp.int64)
+                v = u.astype(jnp.int64) + bases_v
             return compact_v.at[dests_v].set(v, mode="drop")
         return k
 
-    fn = cached_kernel(("rlev2_vals", out_cap, pbucket, dbucket, signed),
+    fn = cached_kernel(("rlev2_vals2", out_cap, pbucket, dbucket, signed),
                        build)
     return fn(jnp.asarray(packed), jnp.asarray(compact),
-              jnp.asarray(bitpos), jnp.asarray(widths), jnp.asarray(dests))
+              jnp.asarray(bitpos), jnp.asarray(widths), jnp.asarray(dests),
+              jnp.asarray(bases), jnp.asarray(nozig))
 
 
 def decode_int_column(info: OrcFileInfo, si: int, name: str, dtype,

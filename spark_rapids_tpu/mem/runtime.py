@@ -112,12 +112,30 @@ class TpuRuntime:
 
     # ---- spillable batch registry ------------------------------------------
 
+    @property
+    def _debug_on(self) -> bool:
+        return self.event_handler.debug in ("STDOUT", "STDERR")
+
+    def _debug_log(self, msg: str) -> None:
+        """Allocation forensics stream (reference:
+        spark.rapids.memory.gpu.debug=stdout|stderr RMM logging,
+        RapidsConf.scala:227-234).  Callers guard on _debug_on so the
+        disabled (default) path formats nothing and takes no store lock."""
+        mode = self.event_handler.debug
+        print(f"[tpu-mem] {msg}",
+              file=sys.stdout if mode == "STDOUT" else sys.stderr)
+
     def add_batch(self, batch: ColumnarBatch,
                   spill_priority: float = SpillPriorities.DEFAULT_PRIORITY
                   ) -> int:
         """Register a device batch as spillable; returns its buffer id."""
-        self.reserve(batch.device_size_bytes())
-        return self.device_store.add_batch(batch, spill_priority).id
+        nbytes = batch.device_size_bytes()
+        self.reserve(nbytes)
+        bid = self.device_store.add_batch(batch, spill_priority).id
+        if self._debug_on:
+            self._debug_log(f"alloc id={bid} {nbytes}B "
+                            f"pool={self.device_store.current_size}B")
+        return bid
 
     def get_batch(self, buffer_id: int) -> ColumnarBatch:
         """Materialize a registered batch on device, from whatever tier it
@@ -154,6 +172,9 @@ class TpuRuntime:
     def free_batch(self, buffer_id: int) -> None:
         buf = self.catalog.remove(buffer_id)
         if buf is None:
+            if self._debug_on:
+                self._debug_log(f"free id={buffer_id} DOUBLE-FREE "
+                                "(already removed)")
             return
         for store in (self.device_store, self.host_store, self.disk_store):
             store.untrack(buf)
@@ -161,6 +182,9 @@ class TpuRuntime:
             self.disk_store.delete_file(buf)
         buf.device_batch = None
         buf.host_leaves = None
+        if self._debug_on:
+            self._debug_log(f"free id={buffer_id} {buf.size_bytes}B "
+                            f"pool={self.device_store.current_size}B")
 
     def update_priority(self, buffer_id: int, priority: float) -> None:
         buf = self.catalog.acquire(buffer_id)

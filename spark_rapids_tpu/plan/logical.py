@@ -96,11 +96,16 @@ class ColumnExpr:
         return ColumnExpr(self.op, self.args, alias=name)
 
     def cast(self, to) -> "ColumnExpr":
-        if isinstance(to, str):  # Spark accepts type names: .cast("double")
-            from ..types import _canonical_type
-            to = _canonical_type({"bigint": "long", "integer": "int",
-                                  "smallint": "short",
-                                  "tinyint": "byte"}.get(to, to))
+        if isinstance(to, str):  # Spark accepts type names: .cast("BIGINT")
+            from ..types import _TYPES_BY_NAME
+            name = to.strip().lower()
+            name = {"bigint": "long", "integer": "int",
+                    "smallint": "short", "tinyint": "byte"}.get(name, name)
+            if name not in _TYPES_BY_NAME:
+                raise ValueError(
+                    f"cast target type {to!r} is not supported "
+                    f"(supported: {sorted(_TYPES_BY_NAME)})")
+            to = _TYPES_BY_NAME[name]
         return ColumnExpr("Cast", (self, to))
 
     def isin(self, *items) -> "ColumnExpr":

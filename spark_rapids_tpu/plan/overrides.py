@@ -403,3 +403,128 @@ def _compute_schema(plan: L.LogicalPlan, conf: TpuConf) -> Schema:
             fields.append(StructField(ce.output_name, wf.dtype))
         return Schema(fields)
     raise NotImplementedError(f"schema of {type(plan).__name__}")
+
+
+# --------------------------------------------------------------------------
+# generated supported-ops documentation
+# --------------------------------------------------------------------------
+
+_EXEC_DOC_ROWS = [
+    ("ProjectExec", "expression projection; row-local stages fuse into one "
+     "compiled kernel"),
+    ("FilterExec", "predicates AND into the selection mask (no gather "
+     "until a shape-changing op needs one)"),
+    ("HashAggregateExec", "sort-based segmented reduction; ROLLUP/CUBE via "
+     "ExpandExec; single-distinct; whole-stage vmapped path"),
+    ("SortMergeJoinExec", "replaced by the device hash join: "
+     "inner/left/full outer/left semi/left anti; conditional joins for "
+     "inner/semi/anti (residual evaluated pair-wise in the candidate "
+     "walk); broadcast and partitioned (EnsureRequirements) variants"),
+    ("SortExec", "order-preserving integer key encoding, one lexsort; "
+     "external (partitioned) sort above the in-memory threshold"),
+    ("WindowExec", "sort-once segmented-scan windows; external window"),
+    ("ExpandExec", "grouping-set projections"),
+    ("GenerateExec", "explode/posexplode"),
+    ("UnionExec", "batch interleave"),
+    ("CollectLimitExec", "device head-N"),
+    ("ShuffleExchangeExec", "hash (murmur3 Spark-parity)/range/round-robin/"
+     "single partitioners; device-resident shuffle"),
+    ("DataWritingCommandExec", "parquet and ORC encode ON DEVICE "
+     "(snappy/uncompressed parquet); CSV and dynamic partitions via the "
+     "host arrow writer (the reference's GPU write formats are parquet/"
+     "ORC only; CSV is read-only there too)"),
+    ("FileSourceScanExec", "parquet/ORC device decode (see formats "
+     "below); pushdown + schema evolution"),
+    ("BatchScanExec", "CSV device parse (native quote-aware tokenizer + "
+     "device gather/Horner kernels)"),
+    ("LocalTableScanExec", "arrow/pydict ingestion"),
+    ("BroadcastExchangeExec", "device broadcast for hash joins under the "
+     "size threshold/hint"),
+]
+
+
+def supported_ops_doc() -> str:
+    """docs/supported-ops.md content: execs, expression rules, formats —
+    generated from the live rule registry (the reference generates its
+    docs/supported_ops.md from GpuOverrides the same way)."""
+    from ..types import SUPPORTED_TYPES
+    lines = [
+        "# Supported operators and expressions",
+        "",
+        "Generated from the rule registry "
+        "(`python -m spark_rapids_tpu.plan.overrides`); do not edit.",
+        "Counterpart: the reference's generated docs/supported_ops.md.",
+        "",
+        "## Types",
+        "",
+        "On-device columns: "
+        + ", ".join(sorted(t.name for t in SUPPORTED_TYPES)) + ".",
+        "Decimal/binary/calendar-interval/nested types keep the plan on "
+        "the CPU executor (the reference's isSupportedType gate).",
+        "",
+        "## Execs",
+        "",
+        "Every exec has a kill-switch conf "
+        "`spark.rapids.sql.exec.<name>`.",
+        "",
+        "| Exec | Device support |",
+        "|---|---|",
+    ]
+    for name, note in _EXEC_DOC_ROWS:
+        lines.append(f"| {name} | {note} |")
+    lines += [
+        "",
+        "## Expressions",
+        "",
+        f"{len(_EXPR_RULES)} expression rules.  Every expression has a "
+        "kill-switch conf `spark.rapids.sql.expr.<name>`.  Rules marked "
+        "*conditional* run on device only for supported argument shapes "
+        "(literal patterns, in-range pad widths, ...) and tag the plan "
+        "back to CPU otherwise, with the reason shown by explain().",
+        "",
+        "| Expression | Device support |",
+        "|---|---|",
+    ]
+    for name in sorted(_EXPR_RULES):
+        tagger = _EXPR_RULES[name]
+        if tagger is None:
+            note = "supported"
+        else:
+            doc = (tagger.__doc__ or "").strip().split("\n")[0]
+            note = f"conditional — {doc}" if doc else "conditional"
+        lines.append(f"| {name} | {note} |")
+    lines += [
+        "",
+        "## File formats",
+        "",
+        "| Format | Read | Write |",
+        "|---|---|---|",
+        "| Parquet | device decode: PLAIN, RLE/PLAIN_DICTIONARY (incl. "
+        "strings), DELTA_BINARY_PACKED, DELTA_LENGTH_BYTE_ARRAY, "
+        "BYTE_STREAM_SPLIT, PLAIN BYTE_ARRAY strings; page v1/v2; "
+        "row-group pruning | device encode (snappy/uncompressed) |",
+        "| ORC | device decode: full RLEv2 (SHORT_REPEAT/DIRECT/DELTA/"
+        "PATCHED_BASE on device), strings (DIRECT_V2 + DICTIONARY_V2), "
+        "timestamps, booleans; stripe pruning from footer statistics | "
+        "device encode (uncompressed, RLEv1/DIRECT) |",
+        "| CSV | device parse (native tokenizer incl. quoted fields and "
+        "CRLF; device gather + Horner numeric kernels) | host arrow "
+        "writer (reference parity: GPU CSV is read-only there) |",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def write_supported_ops_docs(path: str = None) -> str:
+    import os
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "docs", "supported-ops.md")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(supported_ops_doc())
+    return path
+
+
+if __name__ == "__main__":  # python -m spark_rapids_tpu.plan.overrides
+    print(write_supported_ops_docs())

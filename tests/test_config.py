@@ -31,6 +31,35 @@ def test_doc_generation_covers_registry():
     assert "spark.rapids.sql.test.enabled" in C.help_doc(include_internal=True)
 
 
+def test_supported_ops_doc_matches_registry():
+    """docs/supported-ops.md is generated; fail if it drifts from the
+    live rule registry (same contract as the configs.md drift test)."""
+    from pathlib import Path
+
+    from spark_rapids_tpu.plan.overrides import (_DISPLAY_NAMES,
+                                                 _EXEC_DOC_ROWS,
+                                                 _EXPR_RULES,
+                                                 supported_ops_doc)
+    doc = supported_ops_doc()
+    for name in _EXPR_RULES:
+        assert f"| {name} |" in doc, name
+    # every plannable exec name must be documented, and every doc row
+    # must correspond to a real exec name (catches _EXEC_DOC_ROWS drift
+    # against the display-name registry the planner actually uses)
+    exec_names = set(_DISPLAY_NAMES.values()) | {
+        "BatchScanExec", "LocalTableScanExec", "BroadcastExchangeExec",
+        "SortMergeJoinExec", "FileSourceScanExec"}
+    exec_names.discard("ShuffleQueryStageExec")  # internal placeholder
+    doc_names = {name for name, _ in _EXEC_DOC_ROWS}
+    assert exec_names <= doc_names, sorted(exec_names - doc_names)
+    assert doc_names <= exec_names, sorted(doc_names - exec_names)
+    on_disk = (Path(__file__).resolve().parent.parent / "docs"
+               / "supported-ops.md").read_text()
+    assert on_disk == doc, (
+        "docs/supported-ops.md is stale; regenerate with "
+        "`python -m spark_rapids_tpu.plan.overrides`")
+
+
 def test_op_kill_switch():
     conf = C.TpuConf({"spark.rapids.sql.expr.Add": "false"}, use_env=False)
     assert conf.is_op_enabled("spark.rapids.sql.expr.Add") is False

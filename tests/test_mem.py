@@ -82,6 +82,20 @@ class TestSpillFramework:
         conf = TpuConf({"spark.rapids.memory.host.spillStorageSize": host})
         return TpuRuntime(conf, pool_limit_bytes=pool, spill_dir=tmpdir)
 
+    def test_alloc_debug_logging(self, capsys):
+        """spark.rapids.memory.tpu.debug=STDOUT logs every alloc/free and
+        flags double-frees (reference: RMM allocation logging via
+        spark.rapids.memory.gpu.debug, RapidsConf.scala:227-234)."""
+        conf = TpuConf({"spark.rapids.memory.tpu.debug": "STDOUT"})
+        rt = TpuRuntime(conf, pool_limit_bytes=1 << 20)
+        bid = rt.add_batch(make_batch())
+        rt.free_batch(bid)
+        rt.free_batch(bid)  # double free: logged, not fatal
+        out = capsys.readouterr().out
+        assert f"alloc id={bid}" in out
+        assert f"free id={bid}" in out
+        assert "DOUBLE-FREE" in out
+
     def test_add_get_roundtrip(self):
         rt = self.runtime()
         b = make_batch()
