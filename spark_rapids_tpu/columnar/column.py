@@ -115,26 +115,34 @@ class Column:
 
     # ---- host materialization ---------------------------------------------
 
-    def _host_rows(self, rows):
+    def _host_rows(self, rows, n=None):
         """D2H the column, restricted to live rows.
 
-        `rows` is either an int n (prefix-dense: take [:n]) or an np.ndarray
-        of row indices (sparse selection)."""
-        def pick(buf):
-            a = np.asarray(buf)
-            return a[:rows] if isinstance(rows, int) else a[rows]
+        `rows` is an int n (prefix-dense: take [:n]), an np.ndarray of row
+        indices (sparse selection), or a DEVICE index array (bucket-padded
+        int32, see ColumnarBatch._live_rows): then the gather runs on
+        device and only the compacted rows are materialized."""
+        if not isinstance(rows, (int, np.ndarray)):
+            import jax.numpy as jnp
+
+            def pick(buf):
+                return np.asarray(jnp.take(buf, rows, axis=0))[:n]
+        else:
+            def pick(buf):
+                a = np.asarray(buf)
+                return a[:rows] if isinstance(rows, int) else a[rows]
         valid = pick(self.valid)
         data = pick(self.data)
         lens = pick(self.lengths) if self.dtype.is_string else None
         return data, valid, lens
 
-    def to_pylist(self, rows):
+    def to_pylist(self, rows, n=None):
         """Materialize live rows as Python values (None=null).
 
         `rows`: int prefix length or index array (see _host_rows).
         Vectorized: one D2H per buffer, C-speed ndarray.tolist(), and a None
         splice only when nulls exist (no per-row .item() calls)."""
-        data, valid, lens = self._host_rows(rows)
+        data, valid, lens = self._host_rows(rows, n)
         n = len(valid)
         all_valid = bool(valid.all()) if n else True
         if self.dtype.is_string:
@@ -152,7 +160,7 @@ class Column:
             return out
         return [v if ok else None for v, ok in zip(out, valid)]
 
-    def to_arrow(self, rows, arrow_type=None):
+    def to_arrow(self, rows, arrow_type=None, n=None):
         """Materialize live rows as a pyarrow Array.
 
         `rows`: int prefix length or index array (see _host_rows).
@@ -164,7 +172,7 @@ class Column:
         import pyarrow as pa
         from ..types import to_arrow as _to_arrow_type
         at = arrow_type if arrow_type is not None else _to_arrow_type(self.dtype)
-        data, valid, lens = self._host_rows(rows)
+        data, valid, lens = self._host_rows(rows, n)
         n = len(valid)
         if n == 0:
             return pa.nulls(0, type=at)

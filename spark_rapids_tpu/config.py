@@ -182,6 +182,13 @@ AGG_MERGE_FAN_IN = _conf(
     "Number of per-batch partial aggregate states buffered before one "
     "K-way concat+merge; larger values amortize merge-kernel dispatches "
     "and host syncs across more input batches.", int)
+AGG_BUCKET_GROUPS = _conf(
+    "spark.rapids.sql.tpu.agg.bucketGroups", True,
+    "Low-cardinality grouped-aggregate fast path: rows scatter into "
+    "hash buckets and per-bucket states replace the per-batch sort when "
+    "every bucket holds one distinct key (checked exactly per batch; "
+    "dirty batches fall back to the sort path).  Applies to "
+    "sum/count/avg and non-string min/max without distinct.", _to_bool)
 
 CLUSTER_EXECUTORS = _conf(
     "spark.rapids.sql.tpu.cluster.executors", 1,

@@ -41,6 +41,60 @@ from .base import ExecContext, ExecNode, TpuExec
 _I64_MAX = np.int64(2**63 - 1)
 _I64_MIN = np.int64(-(2**63))
 
+# kernel keys whose bucket fast-path probe came back dirty (cardinality
+# above the bucket count): skip the probe for them from then on
+_BUCKET_DIRTY_KEYS: set = set()
+
+
+def _flatten_stacked(partials: ColumnarBatch, state_schema) -> ColumnarBatch:
+    """vmapped per-batch partial states [k, pcap, ...] -> one [k*pcap]
+    merge input (shared by the sort and bucket whole-stage programs)."""
+    cols = []
+    for c in partials.columns:
+        data = c.data.reshape((-1,) + c.data.shape[2:])
+        valid = c.valid.reshape(-1)
+        lengths = c.lengths.reshape(-1) if c.lengths is not None else None
+        cols.append(Column(data, valid, c.dtype, lengths))
+    return ColumnarBatch(cols, partials.sel.reshape(-1), state_schema)
+
+
+def _type_max(dt):
+    """Identity element for Min over dtype dt (largest value)."""
+    j = dt.jnp_dtype
+    if dt.is_floating:
+        return jnp.asarray(jnp.inf, j)
+    return jnp.asarray(jnp.iinfo(j).max if dt.name != "boolean" else True,
+                       j)
+
+
+def _type_min(dt):
+    """Identity element for Max over dtype dt (smallest value)."""
+    j = dt.jnp_dtype
+    if dt.is_floating:
+        return jnp.asarray(-jnp.inf, j)
+    return jnp.asarray(jnp.iinfo(j).min if dt.name != "boolean" else False,
+                       j)
+
+
+def _key_equal_at(c: Column, idx):
+    """Row i's key value-equals the key at row idx[i] (Spark grouping
+    equality: nulls equal, NaN equal, -0.0 == 0.0 — the same contract as
+    _col_differs_from_prev, against an arbitrary gathered row)."""
+    from ..ops.hashing import _normalize_bits
+    vg = jnp.take(c.valid, idx)
+    both_null = (~c.valid) & (~vg)
+    valid_mismatch = c.valid != vg
+    if c.dtype.is_string:
+        dg = jnp.take(c.data, idx, axis=0)
+        lg = jnp.take(c.lengths, idx)
+        dd = jnp.all(c.data == dg, axis=1) & (c.lengths == lg)
+    else:
+        bits = _normalize_bits(c)
+        dd = bits == jnp.take(bits, idx)
+    return jnp.where(both_null, True,
+                     jnp.where(valid_mismatch, False,
+                               jnp.where(c.valid, dd, True)))
+
 
 def group_rows(key_cols: Sequence[Column], live, value_cols=None):
     """-> (order, gid_sorted, boundary_sorted, num_groups).
@@ -432,6 +486,127 @@ class TpuHashAggregateExec(TpuExec):
                       if not c.dtype.is_string else c for c in state_cols]
         return ColumnarBatch(state_cols, sel, self._state_schema)
 
+    # ---- low-cardinality bucket fast path ---------------------------------
+
+    _BUCKETS = 1024
+
+    def _bucketable(self) -> bool:
+        """Aggregate set eligible for the bucket fast path: mergeable
+        scatter-computable states (sum/count/avg, non-string min/max),
+        no distinct dedup, no arrival-order state."""
+        if not self.grouping:
+            return False
+        for a in self.aggregates:
+            if a.distinct or a.func in ("First", "Last"):
+                return False
+            if a.func in ("Min", "Max") and a.child.dtype.is_string:
+                return False
+            if a.func not in ("Count", "Sum", "Average", "Min", "Max"):
+                return False
+        return True
+
+    def _bucket_update_kernel(self, batch: ColumnarBatch):
+        """-> (clean: bool[], state batch at capacity _BUCKETS).
+
+        The sort-free grouped update: rows scatter into h1-hash buckets;
+        `clean` is an EXACT per-batch check that every live row's key
+        VALUE-equals its bucket representative's (so each occupied bucket
+        holds one distinct group, with Spark key semantics: nulls equal,
+        NaN equal, -0.0 == 0.0).  When clean, per-bucket segment
+        reductions are the partial state — same schema as the sort path,
+        so the merge/finalize kernels take either.  More distinct groups
+        than buckets forces a collision, so high-cardinality batches
+        fail the check and take the sort path; no cardinality estimate
+        is needed.  XLA lowers the segment ops to scatter-adds; on TPU
+        the alternative one-hot-matmul formulation rides the MXU, but
+        scatter keeps the state layout identical across backends."""
+        B = self._BUCKETS
+        keys = [g.eval(batch) for g in self.grouping]
+        live = batch.sel
+        cap = batch.capacity
+        h1, _h2 = hash_columns_double(keys, live)
+        ids = (h1 & jnp.uint64(B - 1)).astype(jnp.int32)
+        sid = jnp.where(live, ids, B)  # B = trash bucket for dead rows
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        rep = jnp.zeros(B, jnp.int32).at[sid].set(iota, mode="drop")
+        occ = jnp.zeros(B, jnp.bool_).at[sid].set(True, mode="drop")
+        rep_of_row = jnp.take(rep, ids)
+        eq = jnp.ones(cap, jnp.bool_)
+        for k in keys:
+            eq &= _key_equal_at(k, rep_of_row)
+        clean = jnp.all(jnp.where(live, eq, True))
+
+        def seg(vals, mask, reducer, fill):
+            full = jnp.where(mask, vals, fill)
+            return reducer(full, sid, num_segments=B + 1)[:B]
+
+        state_cols: List[Column] = []
+        for k in keys:
+            kk = k.take(rep)
+            state_cols.append(kk)
+        for a in self.aggregates:
+            col = a.child.eval(batch) if a.child is not None else None
+            f = a.func
+            if f == "Count":
+                contribute = live if col is None else live & col.valid
+                cnt = seg(contribute.astype(jnp.int64), live,
+                          jax.ops.segment_sum, jnp.int64(0))
+                state_cols.append(Column(cnt, jnp.ones(B, jnp.bool_),
+                                         LongType))
+                continue
+            contribute = live & col.valid
+            nvalid = seg(contribute.astype(jnp.int64), live,
+                         jax.ops.segment_sum, jnp.int64(0))
+            if f in ("Sum", "Average"):
+                out_t = DoubleType if f == "Average" else a.dtype
+                v = col.data.astype(out_t.jnp_dtype)
+                s = seg(v, contribute, jax.ops.segment_sum,
+                        jnp.zeros((), out_t.jnp_dtype))
+                state_cols.append(Column(s, nvalid > 0, out_t)
+                                  .mask_invalid())
+                if f == "Average":
+                    state_cols.append(Column(nvalid,
+                                             jnp.ones(B, jnp.bool_),
+                                             LongType))
+            else:  # Min / Max (numeric)
+                dt = a.child.dtype
+                v = col.data
+                if dt.is_floating:
+                    # Spark float ordering: NaN greatest, -0.0 == 0.0
+                    # (the sort path's [nan_flag, value] key, as direct
+                    # reductions: no f64 bitcasts — unimplemented on the
+                    # emulated-f64 TPU backend)
+                    isnan = jnp.isnan(v)
+                    v = jnp.where(v == 0.0, jnp.zeros((), v.dtype), v)
+                    nn_mask = contribute & ~isnan
+                    n_nonnan = seg(nn_mask.astype(jnp.int64), live,
+                                   jax.ops.segment_sum, jnp.int64(0))
+                    if f == "Min":
+                        m = seg(v, nn_mask, jax.ops.segment_min,
+                                _type_max(dt))
+                        # all-NaN group: min is NaN
+                        m = jnp.where((nvalid > 0) & (n_nonnan == 0),
+                                      jnp.asarray(jnp.nan, v.dtype), m)
+                    else:
+                        m = seg(v, nn_mask, jax.ops.segment_max,
+                                _type_min(dt))
+                        # any NaN in group: max is NaN (NaN greatest)
+                        m = jnp.where(nvalid > n_nonnan,
+                                      jnp.asarray(jnp.nan, v.dtype), m)
+                else:
+                    if f == "Min":
+                        m = seg(v, contribute, jax.ops.segment_min,
+                                _type_max(dt))
+                    else:
+                        m = seg(v, contribute, jax.ops.segment_max,
+                                _type_min(dt))
+                state_cols.append(Column(m, nvalid > 0, dt)
+                                  .mask_invalid())
+        sel = occ
+        state_cols = [c.with_valid(c.valid & sel).mask_invalid()
+                      if not c.dtype.is_string else c for c in state_cols]
+        return clean, ColumnarBatch(state_cols, sel, self._state_schema)
+
     def _merge_kernel(self, state: ColumnarBatch) -> ColumnarBatch:
         """state batch (concat of partials) -> merged state batch."""
         cap = state.capacity
@@ -712,26 +887,48 @@ class TpuHashAggregateExec(TpuExec):
                         b = pre(b)
                     return update(b)
                 partials = jax.vmap(one)(stacked)   # leaves [k, pcap, ...]
-                # flatten the batch axis into one merge input
-                cols = []
-                for c in partials.columns:
-                    data = c.data.reshape((-1,) + c.data.shape[2:])
-                    valid = c.valid.reshape(-1)
-                    lengths = c.lengths.reshape(-1) \
-                        if c.lengths is not None else None
-                    cols.append(Column(data, valid, c.dtype, lengths))
-                sel = partials.sel.reshape(-1)
-                both = ColumnarBatch(cols, sel, state_schema)
+                both = _flatten_stacked(partials, state_schema)
                 return finalize(merge(both))
             return whole
 
+        def build_bucket():
+            bupdate = self._bucket_update_kernel
+
+            def whole_bucket(stacked: ColumnarBatch):
+                pre = pre_builder() if pre_builder is not None else None
+
+                def one(b):
+                    if pre is not None:
+                        b = pre(b)
+                    return bupdate(b)
+                cleans, partials = jax.vmap(one)(stacked)
+                both = _flatten_stacked(partials, state_schema)
+                return jnp.all(cleans), finalize(merge(both))
+            return whole_bucket
+
         key = (("whole_stage", k, cap, pre_key) + self.kernel_key())
-        fn = cached_kernel(key, build)
         flat0, treedef = jax.tree_util.tree_flatten(batches[0])
         flats = [jax.tree_util.tree_flatten(b)[0] for b in batches]
         stacked = jax.tree_util.tree_unflatten(
             treedef, [jnp.stack([f[i] for f in flats])
                       for i in range(len(flat0))])
+        if grouped and self._bucketable() \
+                and ctx.conf.get(C.AGG_BUCKET_GROUPS) \
+                and key not in _BUCKET_DIRTY_KEYS:
+            # sort-free program first: per-batch bucket states + an exact
+            # all-clean check; only the k*_BUCKETS-row merge sorts.  A
+            # dirty batch (high cardinality / bucket collision) falls
+            # through to the sort-based program below and latches the
+            # key dirty so later executions skip the probe.
+            fnb = cached_kernel(key + ("bucket",), build_bucket)
+            with self.metrics.timer("computeAggTime"), \
+                    named_range("agg_whole_stage_bucket"):
+                all_clean, out = fnb(stacked)
+            if bool(all_clean):
+                self.metrics.add("numOutputBatches", 1)
+                return out, None
+            _BUCKET_DIRTY_KEYS.add(key)
+        fn = cached_kernel(key, build)
         with self.metrics.timer("computeAggTime"), \
                 named_range("agg_whole_stage"):
             out = fn(stacked)
@@ -800,14 +997,41 @@ class TpuHashAggregateExec(TpuExec):
         else:
             input_iter = self.children[0].execute(ctx)
 
+        bucket_fn = None
+        if self._bucketable() and not needs_off \
+                and ctx.conf.get(C.AGG_BUCKET_GROUPS) \
+                and key not in _BUCKET_DIRTY_KEYS:
+            # needs_off excluded: the bucket kernel evaluates expressions
+            # outside eval_with_row_offset, so a row-offset expression
+            # would silently restart at 0 every batch
+            bucket_fn = cached_kernel(key + ("bucket",),
+                                      lambda: self._bucket_update_kernel)
         state = None
         pending: list = []
         offset = 0
         for batch in input_iter:
+            # the update kernel sorts at batch CAPACITY: a selective
+            # upstream filter leaves mostly-dead batches, so shrink first
+            # (capacity check is static: dense small batches skip the
+            # num_rows_host device sync entirely)
+            if batch.capacity >= 8192:
+                batch = batch.maybe_shrink(batch.num_rows_host())
             with self.metrics.timer("computeAggTime"), \
                     named_range("agg_update"):
-                partial = update(batch, jnp.int64(offset)) if needs_off \
-                    else update(batch)
+                partial = None
+                if bucket_fn is not None:
+                    clean, bstate = bucket_fn(batch)
+                    if bool(clean):  # host sync: pick the sort-free state
+                        partial = bstate
+                    else:
+                        # dirty latch: a high-cardinality shape stays
+                        # dirty — stop probing it (this query AND this
+                        # kernel key process-wide)
+                        bucket_fn = None
+                        _BUCKET_DIRTY_KEYS.add(key)
+                if partial is None:
+                    partial = update(batch, jnp.int64(offset)) \
+                        if needs_off else update(batch)
             if needs_off:
                 offset += batch.num_rows_host()
             pending.append(partial)

@@ -76,8 +76,33 @@ def _row_equal(lcol: Column, bcol: Column, bidx):
     return ok
 
 
+class TpuReorderColumnsExec(TpuExec):
+    """Column permutation pass-through: the right-outer join runs as a
+    side-swapped left join, and this puts the output columns back in the
+    logical plan's order (names come from the final schema)."""
+
+    def __init__(self, child: ExecNode, perm: Sequence[int],
+                 out_schema: Schema):
+        super().__init__(child)
+        self.perm = list(perm)
+        self._schema = out_schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"TpuReorderColumnsExec[{len(self.perm)} cols]"
+
+    def execute(self, ctx):
+        for b in self.children[0].execute(ctx):
+            sb = b.select_columns(self.perm)
+            yield ColumnarBatch(sb.columns, sb.sel, self._schema)
+
+
 class TpuHashJoinExec(TpuExec):
-    """Equi hash join: inner / left / left_semi / left_anti.
+    """Equi hash join: inner / left / full / left_semi / left_anti
+    (right outer joins arrive side-swapped under TpuReorderColumnsExec).
 
     Streams the LEFT side against a single sorted build batch of the RIGHT
     side (reference builds right for these join types too,
@@ -300,6 +325,9 @@ class TpuHashJoinExec(TpuExec):
         if rbatches:
             rbatch = rbatches[0] if len(rbatches) == 1 \
                 else concat_batches(rbatches)
+            # filtered build sides ride their input capacity otherwise —
+            # the build sort and every probe window pay for dead rows
+            rbatch = rbatch.maybe_shrink(rbatch.num_rows_host())
         else:
             rbatch = _empty_batch(self.children[1].schema)
         yield from self._join_stream(rbatch, self.children[0].execute(ctx))

@@ -179,6 +179,9 @@ class TpuSortExec(TpuExec):
                 yield out
             return
         batch = batches[0] if len(batches) == 1 else concat_batches(batches)
+        # a mostly-dead input (post-filter, post-aggregate) sorts at its
+        # full capacity otherwise — shrink first (batch.shrink_to)
+        batch = batch.maybe_shrink(batch.num_rows_host())
         with self.metrics.timer("sortTime"):
             out = fn(batch)
         self.metrics.add("numOutputBatches", 1)

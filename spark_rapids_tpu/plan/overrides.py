@@ -417,9 +417,12 @@ _EXEC_DOC_ROWS = [
     ("HashAggregateExec", "sort-based segmented reduction; ROLLUP/CUBE via "
      "ExpandExec; single-distinct; whole-stage vmapped path"),
     ("SortMergeJoinExec", "replaced by the device hash join: "
-     "inner/left/full outer/left semi/left anti; conditional joins for "
+     "inner/left/right/full outer/left semi/left anti (right runs "
+     "side-swapped under a column reorder); conditional joins for "
      "inner/semi/anti (residual evaluated pair-wise in the candidate "
-     "walk); broadcast and partitioned (EnsureRequirements) variants"),
+     "walk); broadcast and partitioned (EnsureRequirements) variants; "
+     "USING right/full joins fall back for Spark's coalesced-key "
+     "contract"),
     ("SortExec", "order-preserving integer key encoding, one lexsort; "
      "external (partitioned) sort above the in-memory threshold"),
     ("WindowExec", "sort-once segmented-scan windows; external window"),

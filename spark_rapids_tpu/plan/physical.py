@@ -44,18 +44,46 @@ def convert(meta: PlanMeta) -> ExecNode:
                 using_drop.append(lw + rs.index_of(name))
         if on_tpu:
             from ..exec.join import TpuHashJoinExec
-            if (_should_broadcast_build(plan, meta.conf)
-                    and plan.join_type not in ("full", "full_outer")):
+            jt = plan.join_type
+            lc, rc = children[0], children[1]
+            lkeys, rkeys = r["left_keys"], r["right_keys"]
+            cond = r["condition"]
+            build_plan = plan.children[1]
+            join_schema = out_schema
+            reorder = None
+            if jt in ("right", "right_outer"):
+                # right outer == left outer with the sides swapped BEFORE
+                # the variant dispatch (so broadcast/partitioned apply),
+                # columns reordered back afterwards (the reference has no
+                # right-outer device join, GpuHashJoin.scala:31-32;
+                # tagging admits only the residual-free, non-USING case)
+                jt = "left"
+                lc, rc = rc, lc
+                lkeys, rkeys = rkeys, lkeys
+                cond = None
+                build_plan = plan.children[0]
+                n_l = len(plan_schema(plan.children[0], meta.conf))
+                n_r = len(plan_schema(plan.children[1], meta.conf))
+                join_schema = _swapped_join_schema(plan, meta.conf)
+                reorder = list(range(n_r, n_r + n_l)) + list(range(n_r))
+
+            def wrap(node):
+                if reorder is None:
+                    return node
+                from ..exec.join import TpuReorderColumnsExec
+                return TpuReorderColumnsExec(node, reorder, out_schema)
+
+            if (_should_broadcast_build(plan, meta.conf, build_plan)
+                    and jt != "full"):
                 # full outer never broadcasts: the never-matched-build
                 # tail is emitted once per probe STREAM, so a replicated
                 # build would duplicate it under any parallel probe
                 from ..exec.broadcast import (TpuBroadcastExchangeExec,
                                               TpuBroadcastHashJoinExec)
-                return TpuBroadcastHashJoinExec(
-                    children[0], TpuBroadcastExchangeExec(children[1]),
-                    plan.join_type, r["left_keys"], r["right_keys"],
-                    r["condition"], out_schema, using_drop)
-            if _should_partition_join(plan, meta.conf):
+                return wrap(TpuBroadcastHashJoinExec(
+                    lc, TpuBroadcastExchangeExec(rc), jt, lkeys, rkeys,
+                    cond, join_schema, using_drop))
+            if _should_partition_join(plan, meta.conf, build_plan):
                 # EnsureRequirements analogue: hash-partition BOTH sides on
                 # the join keys so the single-build-batch requirement holds
                 # per partition (reference GpuShuffledHashJoinExec.scala:83-87)
@@ -63,16 +91,13 @@ def convert(meta: PlanMeta) -> ExecNode:
                 from ..exec.exchange import TpuShuffleExchangeExec
                 from ..exec.join import TpuShuffledHashJoinExec
                 n = meta.conf.get(C.SHUFFLE_PARTITIONS)
-                lex = TpuShuffleExchangeExec("hash", r["left_keys"], n,
-                                             children[0])
-                rex = TpuShuffleExchangeExec("hash", r["right_keys"], n,
-                                             children[1])
-                return TpuShuffledHashJoinExec(
-                    lex, rex, plan.join_type, r["left_keys"],
-                    r["right_keys"], r["condition"], out_schema, using_drop)
-            return TpuHashJoinExec(children[0], children[1], plan.join_type,
-                                   r["left_keys"], r["right_keys"],
-                                   r["condition"], out_schema, using_drop)
+                lex = TpuShuffleExchangeExec("hash", lkeys, n, lc)
+                rex = TpuShuffleExchangeExec("hash", rkeys, n, rc)
+                return wrap(TpuShuffledHashJoinExec(
+                    lex, rex, jt, lkeys, rkeys, cond, join_schema,
+                    using_drop))
+            return wrap(TpuHashJoinExec(lc, rc, jt, lkeys, rkeys, cond,
+                                        join_schema, using_drop))
         return CR.CpuJoinExec(children[0], children[1], plan.join_type,
                               r["left_keys"], r["right_keys"],
                               r["condition"], out_schema, using_drop)
@@ -216,28 +241,48 @@ def _estimate_plan_bytes(plan: L.LogicalPlan, conf):
     return rows * _schema_row_bytes(schema)
 
 
-def _should_partition_join(plan: "L.LogicalJoin", conf) -> bool:
+def _should_partition_join(plan: "L.LogicalJoin", conf,
+                           build_plan=None) -> bool:
     """Partition a non-broadcast join when the build side is too big for
-    (or of unknown size relative to) one bounded build batch."""
+    (or of unknown size relative to) one bounded build batch.
+    `build_plan` overrides the default right child (side-swapped right
+    outer joins build the original LEFT)."""
     from .. import config as C
     if not conf.get(C.PARTITIONED_JOIN_ENABLED):
         return False
-    est = _estimate_plan_bytes(plan.children[1], conf)
+    est = _estimate_plan_bytes(
+        build_plan if build_plan is not None else plan.children[1], conf)
     threshold = conf.get(C.PARTITIONED_JOIN_THRESHOLD)
     return est is None or est > int(threshold)
 
 
-def _should_broadcast_build(plan: "L.LogicalJoin", conf) -> bool:
-    """Broadcast the build (right) side when hinted or when its estimated
-    size is under spark.sql.autoBroadcastJoinThreshold (Spark planning
-    behavior; reference: GpuBroadcastHashJoinExec replaces Spark's
-    BroadcastHashJoinExec when Spark already chose broadcast)."""
+def _should_broadcast_build(plan: "L.LogicalJoin", conf,
+                            build_plan=None) -> bool:
+    """Broadcast the build side when hinted or when its estimated size is
+    under spark.sql.autoBroadcastJoinThreshold (Spark planning behavior;
+    reference: GpuBroadcastHashJoinExec replaces Spark's
+    BroadcastHashJoinExec when Spark already chose broadcast).
+    `build_plan` overrides the default right child (side-swapped right
+    outer joins build the original LEFT)."""
     from .. import config as C
-    right = plan.children[1]
-    if "broadcast" in getattr(right, "_hints", ()):
+    build = build_plan if build_plan is not None else plan.children[1]
+    if "broadcast" in getattr(build, "_hints", ()):
         return True
     threshold = conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
     if threshold is None or int(threshold) < 0:
         return False
-    est = _estimate_plan_bytes(right, conf)
+    est = _estimate_plan_bytes(build, conf)
     return est is not None and est <= int(threshold)
+
+
+def _swapped_join_schema(plan, conf):
+    """Output schema of the side-swapped right-outer inner join: the
+    original RIGHT fields first, original LEFT fields renamed on
+    collision — the same rename rule the join kernels apply, from the
+    swapped perspective."""
+    from ..exec.join import TpuHashJoinExec
+    from ..types import Schema
+    ls = plan_schema(plan.children[0], conf)
+    rs = plan_schema(plan.children[1], conf)
+    lf, rf = TpuHashJoinExec._joined_fields(rs, ls)
+    return Schema(lf + rf)

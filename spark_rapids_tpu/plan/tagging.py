@@ -17,7 +17,7 @@ from .analysis import resolve
 from .overrides import ExprMeta, PlanMeta, expr_conf_key, plan_schema
 
 _TPU_JOIN_TYPES = {"inner", "left", "left_outer", "left_semi", "left_anti",
-                   "full", "full_outer"}
+                   "full", "full_outer", "right", "right_outer"}
 
 
 
@@ -167,15 +167,16 @@ def _tag_join(meta: PlanMeta):
     if plan.join_type not in _TPU_JOIN_TYPES:
         meta.will_not_work(
             f"{plan.join_type} joins are not supported on TPU "
-            "(Inner/Left/Full/LeftSemi/LeftAnti; the reference stops at "
-            "Inner/Left/LeftSemi/LeftAnti — device FULL OUTER goes "
-            "beyond it)")
-    if plan.join_type in ("full", "full_outer") and plan.using:
-        # USING full joins coalesce the key columns of BOTH sides into
-        # one output column; the device kernels carry left-or-null keys
-        # only, so Spark's coalesced-key contract needs the CPU path
-        meta.will_not_work("full outer USING joins (coalesced keys) are "
-                           "not supported on TPU")
+            "(Inner/Left/Right/Full/LeftSemi/LeftAnti; the reference "
+            "stops at Inner/Left/LeftSemi/LeftAnti — device RIGHT and "
+            "FULL OUTER go beyond it)")
+    if plan.join_type in ("full", "full_outer", "right",
+                          "right_outer") and plan.using:
+        # USING full/right joins surface the key from the preserved
+        # side(s); the device kernels carry left-side keys only, so
+        # Spark's coalesced-key contract needs the CPU path
+        meta.will_not_work(f"{plan.join_type} USING joins (coalesced "
+                           "keys) are not supported on TPU")
     ls = plan_schema(plan.children[0], meta.conf)
     rs = plan_schema(plan.children[1], meta.conf)
     lkeys, rkeys, cond = [], [], None

@@ -260,6 +260,38 @@ def test_full_join_on_device():
     assert_tpu_and_cpu_are_equal(q)
 
 
+def test_right_join_on_device():
+    """Expression-keyed RIGHT OUTER runs on device as a side-swapped left
+    join under a column-reorder pass-through (the reference has no device
+    right join, GpuHashJoin.scala:31-32)."""
+    from spark_rapids_tpu.engine import TpuSession
+
+    def q(s):
+        left = keyed_df(s, 120, 90, extra={"a": T.IntegerType})
+        right = keyed_df(s, 220, 140, extra={"b": T.IntegerType}) \
+            .select(col("k").alias("kr"), col("b"))
+        return left.join(right, col("k") == col("kr"), "right")
+
+    s = TpuSession({})
+    text = q(s).explain()
+    assert "!SortMergeJoinExec" not in text, text
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_right_join_using_falls_back():
+    from spark_rapids_tpu.engine import TpuSession
+
+    def q(s):
+        left = keyed_df(s, 121, 60, extra={"a": T.IntegerType})
+        right = keyed_df(s, 221, 90, extra={"b": T.IntegerType})
+        return left.join(right, "k", "right")
+
+    s = TpuSession({})
+    text = q(s).explain()
+    assert "!SortMergeJoinExec" in text
+    assert_tpu_and_cpu_are_equal(q)
+
+
 def test_full_join_using_falls_back():
     from spark_rapids_tpu.engine import TpuSession
 
