@@ -222,7 +222,16 @@ def child_main(mode: str) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     except Exception:
         pass  # cache is an optimization, never a dependency
-    platform = jax.devices()[0].platform
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:
+        # round-4 postmortem: this exact failure (axon backend UNAVAILABLE)
+        # escaped as a traceback on the SHARED stderr and, because TPU
+        # children are abandoned, landed in the driver's combined capture
+        # AFTER the parent's headline line — erasing the round artifact.
+        # A backend that cannot init is a reportable stage, not a crash.
+        emit("backend_error", error=repr(e)[:300], t=time.time() - t0)
+        sys.exit(0)
     emit("backend", platform=platform, t=time.time() - t0)
     checkpoint("backend")
 
@@ -314,10 +323,16 @@ class StageReader:
         if mode in ("cpu", "oracle"):
             env["JAX_PLATFORMS"] = "cpu"
         env["BENCH_CHILD_DEADLINE_S"] = str(max(deadline_s, 5.0))
+        # per-child stderr LOG FILE, never the shared stderr: an abandoned
+        # TPU child that dies after the parent exits must not be able to
+        # append anything to the driver's combined capture (round-4
+        # postmortem: a late child traceback after the headline line made
+        # the artifact unparseable)
+        self._errlog = open(f"/tmp/bench_{label}.stderr.log", "a")
         self.proc = subprocess.Popen(
             [sys.executable, "-u", os.path.abspath(__file__),
              f"--child={mode}"],
-            stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env,
+            stdout=subprocess.PIPE, stderr=self._errlog, text=True, env=env,
             # own session: a driver-level process-group SIGKILL must not
             # hit a TPU-attached child (lease poisoning, round-3 memory)
             start_new_session=self.tpu)
@@ -378,6 +393,10 @@ class StageReader:
             self.proc.kill()
         except OSError:
             pass
+        try:
+            self._errlog.close()
+        except OSError:
+            pass
 
 
 _PARTIAL: dict = {"stages": []}
@@ -400,7 +419,7 @@ def collect(r: "StageReader", end_at: float,
     unavailable chip is abandoned with enough budget left for a fallback
     child."""
     out = {"platform": None, "runs": {}, "warmup": {}, "values": {},
-           "transfer": None, "aborted": False}
+           "transfer": None, "aborted": False, "backend_error": None}
     first = True
     try:
         while True:
@@ -416,6 +435,9 @@ def collect(r: "StageReader", end_at: float,
                 break
             first = False
             st = rec.get("stage")
+            if st == "backend_error":
+                out["backend_error"] = rec.get("error")
+                break
             if st == "backend":
                 out["platform"] = rec.get("platform")
             elif st == "warmup":
@@ -442,8 +464,33 @@ def main():
         child_main(sys.argv[1].split("=", 1)[1])
         return
 
+    # The headline line is emitted UNCONDITIONALLY (round-4 postmortem:
+    # parsed=null after a 554-turn round).  Whatever _run() manages — or
+    # doesn't — the last stdout act of this process is one JSON line, also
+    # mirrored to BENCH_HEADLINE.json.
+    result = {"metric": "tpch_q6_like_device_throughput", "value": 0.0,
+              "unit": "Mrows/s[none]", "vs_baseline": 0.0}
+    try:
+        result = _run() or result
+    except SystemExit:
+        pass
+    except BaseException as e:  # noqa: BLE001 — report, never crash out
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result.setdefault("extra", {})["fatal"] = repr(e)[:500]
+    finally:
+        line = json.dumps(result)
+        try:
+            with open(os.path.join(REPO, "BENCH_HEADLINE.json"), "w") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
+        print(line, flush=True)
+
+
+def _run():
     end_at = T0 + GLOBAL_BUDGET_S
-    want_tpu = os.environ.get("JAX_PLATFORMS", "") not in ("cpu", "")
+    want_tpu = os.environ.get("JAX_PLATFORMS", "axon") != "cpu"
 
     # 1. start the TPU child FIRST: it spends its opening minutes blocked in
     # backend init (tunnel lease), which overlaps for free with the oracle;
@@ -460,17 +507,28 @@ def main():
                   min(end_at, T0 + 210))
     if not cpu["runs"].get("q6") and not cpu["warmup"].get("q6"):
         log("FATAL: CPU oracle produced no q6 runs")
-        print(json.dumps({"metric": "tpch_q6_like_device_throughput",
-                          "value": 0.0, "unit": "Mrows/s[none]",
-                          "vs_baseline": 0.0}))
-        return
+        return {"metric": "tpch_q6_like_device_throughput", "value": 0.0,
+                "unit": "Mrows/s[none]", "vs_baseline": 0.0,
+                "extra": {"fatal": "cpu oracle produced no q6 runs"}}
     # the oracle has no warmup effects: fold warmup times in as runs
     for q, t in cpu["warmup"].items():
         cpu["runs"].setdefault(q, []).append(t)
 
-    # 3. consume the device child (already running), fall back to CPU engine
+    # 3. consume the device child (already running); if the chip reported
+    # UNAVAILABLE quickly, the lease may free up — retry while the budget
+    # still leaves room for the CPU-engine fallback child
     dev = (collect(tpu_reader, end_at, reserve_s=130.0)
            if tpu_reader else {"runs": {}, "warmup": {}})
+    while (want_tpu and not dev["runs"].get("q6")
+           and not dev.get("warmup", {}).get("q6")
+           and dev.get("backend_error")
+           and end_at - time.time() > 200.0):
+        log(f"TPU backend error ({dev['backend_error'][:80]}); "
+            f"retrying in 20s")
+        time.sleep(20)
+        dev = collect(StageReader("device", "tpu",
+                                  end_at - time.time() - 5),
+                      end_at, reserve_s=130.0)
     unit_note = ""
     if not dev["runs"].get("q6") and dev.get("warmup", {}).get("q6"):
         # deadline landed between warmup and run 1: the warmup time
@@ -534,7 +592,24 @@ def main():
     if platform.startswith("tpu") and not mismatch:
         # persist real-chip evidence: the lease can be down for hours
         # (three rounds lost to it), so a later fallback run must not be
-        # the only record
+        # the only record.  MERGE with the previous on-chip record: a
+        # partial suite (deadline mid-run) must never erase queries an
+        # earlier lease window did capture — stale entries are marked.
+        now = int(time.time())
+        for e in extra["per_query"].values():
+            if e.get("dev_s") is not None:
+                e["recorded_unix"] = now
+        try:
+            with open(onchip_path) as f:
+                oldpq = json.load(f).get("extra", {}).get("per_query", {})
+            for q, e in oldpq.items():
+                cur = extra["per_query"].get(q, {})
+                if cur.get("dev_s") is None and e.get("dev_s") is not None:
+                    # carry the earlier window's number (with its own
+                    # recorded_unix) so partial windows accumulate
+                    extra["per_query"][q] = {**e, "stale": True}
+        except (OSError, ValueError):
+            pass
         try:
             with open(onchip_path, "w") as f:
                 json.dump({"recorded_unix": int(time.time()), **result}, f,
@@ -554,7 +629,7 @@ def main():
             json.dump({"dev": dev, "cpu": cpu, "extra": extra}, f, indent=1)
     except OSError:
         pass
-    print(json.dumps(result))
+    return result
 
 
 if __name__ == "__main__":

@@ -518,8 +518,7 @@ def generate(sf: float = 0.001, seed: int = 7):
 
 
 def load_tables(session, sf: float = 0.001, seed: int = 7):
-    """{name: DataFrame} on the given session."""
+    """{name: DataFrame} on the given session (cached arrow tables)."""
     from .schema import SCHEMAS
-    data = generate(sf, seed)
-    return {name: session.from_pydict(data[name], SCHEMAS[name])
-            for name in SCHEMAS}
+    from .._cache import cached_load
+    return cached_load("tpcds", generate, SCHEMAS, session, sf, seed)

@@ -1,0 +1,42 @@
+#!/bin/bash
+# On-chip bench capture loop: run the full bench suite against the real TPU
+# whenever the machine-wide lease grants a window.  Each iteration runs
+# bench.py with a generous TPU probe budget; bench.py merges any on-chip
+# per-query timings into BENCH_ONCHIP.json (partial windows accumulate).
+# Stops once all five queries have non-null dev_s, or after MAX_ITERS.
+#
+# Usage: nohup bash scripts/onchip_capture.sh > /tmp/onchip_capture.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+MAX_ITERS=${MAX_ITERS:-12}
+# entries recorded after the loop started count as fresh — bench.py merges
+# earlier windows forward (stale flag) with their original recorded_unix,
+# so coverage ACCUMULATES across partial lease windows
+export CAPTURE_START=${CAPTURE_START:-$(date +%s)}
+for i in $(seq 1 "$MAX_ITERS"); do
+  echo "=== capture iteration $i $(date -u +%H:%M:%S) ==="
+  complete=$(python - <<'EOF'
+import json, os
+try:
+    start = int(os.environ.get("CAPTURE_START", 0))
+    pq = json.load(open("BENCH_ONCHIP.json"))["extra"]["per_query"]
+    want = ["q1", "q6", "q6_scan", "tpcds_q5", "tpcxbb_q5"]
+    fresh = [q for q in want
+             if pq.get(q, {}).get("dev_s") is not None
+             and int(pq.get(q, {}).get("recorded_unix", 0)) >= start]
+    print("yes" if len(fresh) == len(want) else "no", len(fresh))
+except Exception:
+    print("no", 0)
+EOF
+)
+  echo "onchip completeness: $complete"
+  if [[ "$complete" == yes* ]]; then
+    echo "all five queries captured on chip; exiting"
+    exit 0
+  fi
+  BENCH_GLOBAL_S=${BENCH_GLOBAL_S:-2800} BENCH_TPU_PROBE_S=${BENCH_TPU_PROBE_S:-2000} \
+    timeout -k 5 3300 python bench.py
+  echo "--- iteration $i done rc=$? ---"
+  sleep 30
+done
+echo "capture loop exhausted $MAX_ITERS iterations"

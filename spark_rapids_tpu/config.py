@@ -177,6 +177,14 @@ WHOLE_STAGE_ENABLED = _conf(
     "work vmapped, partials merged in-program) — the TPU analogue of "
     "whole-stage codegen; one dispatch instead of O(batches), which is "
     "what high host-link latency punishes.", _to_bool)
+COMPILATION_CACHE_DIR = _conf(
+    "spark.rapids.sql.tpu.compilationCache.dir",
+    "/tmp/spark_rapids_tpu_xla_cache",
+    "Persistent XLA compilation cache directory shared across processes; "
+    "a fresh session replays compiled programs from disk instead of "
+    "paying tens of seconds per query shape (the reference has zero "
+    "query-time compile cost; this is the TPU equivalent).  Empty string "
+    "disables.", str)
 AGG_MERGE_FAN_IN = _conf(
     "spark.rapids.sql.tpu.agg.mergeFanIn", 8,
     "Number of per-batch partial aggregate states buffered before one "

@@ -23,10 +23,39 @@ from .plan import transitions as T
 from .types import Schema, StructField, from_arrow
 
 
+_COMPILE_CACHE_SET = False
+
+
+def _enable_compilation_cache(path: str) -> None:
+    """Point jax's persistent compilation cache at `path` (idempotent,
+    best-effort).  Keyed by HLO hash, shared across processes: a second
+    session replays every kernel this one compiled."""
+    global _COMPILE_CACHE_SET
+    if _COMPILE_CACHE_SET or not path:
+        return
+    _COMPILE_CACHE_SET = True
+    try:
+        import os
+        import jax
+        # TPU-backed processes only: compiles there cost tens of seconds
+        # and replay byte-identically.  XLA:CPU AOT replay warns about
+        # machine-feature mismatches (SIGILL risk) and the CPU test env
+        # already fights compile-cache memory pressure — not worth it.
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+                or jax.config.jax_platforms == "cpu":
+            return
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass  # an optimization, never a dependency
+
+
 class TpuSession:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = TpuConf(conf)
         self._runtime = None
+        _enable_compilation_cache(self.conf.get(C.COMPILATION_CACHE_DIR))
 
     # -- data sources -------------------------------------------------------
     def from_arrow(self, table) -> "DataFrame":

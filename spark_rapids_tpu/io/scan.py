@@ -710,26 +710,35 @@ def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
                             f.dtype, rgm.num_rows, max_def,
                             bucket_rows(max(rgm.num_rows, 1))),
                             rgm.num_rows))
-                    if f.dtype.is_string:
-                        width = max(c.max_len for c, _ in rg_cols)
-                        rg_cols = [(c.pad_strings_to(width), nr)
-                                   for c, nr in rg_cols]
-                        data = jnp.zeros((cap, width), dtype=jnp.uint8)
-                        lengths = jnp.zeros(cap, dtype=jnp.int32)
+                    if len(rg_cols) == 1 \
+                            and int(rg_cols[0][0].data.shape[0]) == cap:
+                        # single-row-group chunk at matching capacity (the
+                        # common layout: writer row groups ~= reader chunk
+                        # budget): the decoded column IS the batch column —
+                        # skip the zero-init + 2-3 range-copy dispatches
+                        out_cols[f.name] = rg_cols[0][0]
                     else:
-                        data = jnp.zeros(cap,
-                                         dtype=rg_cols[0][0].data.dtype)
-                        lengths = None
-                    valid = jnp.zeros(cap, dtype=jnp.bool_)
-                    off = 0
-                    for col, nr in rg_cols:
-                        data = _copy_range(data, col.data, off, nr)
-                        valid = _copy_range(valid, col.valid, off, nr)
-                        if lengths is not None:
-                            lengths = _copy_range(lengths, col.lengths,
-                                                  off, nr)
-                        off += nr
-                    out_cols[f.name] = Column(data, valid, f.dtype, lengths)
+                        if f.dtype.is_string:
+                            width = max(c.max_len for c, _ in rg_cols)
+                            rg_cols = [(c.pad_strings_to(width), nr)
+                                       for c, nr in rg_cols]
+                            data = jnp.zeros((cap, width), dtype=jnp.uint8)
+                            lengths = jnp.zeros(cap, dtype=jnp.int32)
+                        else:
+                            data = jnp.zeros(cap,
+                                             dtype=rg_cols[0][0].data.dtype)
+                            lengths = None
+                        valid = jnp.zeros(cap, dtype=jnp.bool_)
+                        off = 0
+                        for col, nr in rg_cols:
+                            data = _copy_range(data, col.data, off, nr)
+                            valid = _copy_range(valid, col.valid, off, nr)
+                            if lengths is not None:
+                                lengths = _copy_range(lengths, col.lengths,
+                                                      off, nr)
+                            off += nr
+                        out_cols[f.name] = Column(data, valid, f.dtype,
+                                                  lengths)
                     if metrics is not None:
                         metrics.add("numDeviceDecodedColumns", 1)
                 except DeviceDecodeUnsupported:

@@ -85,6 +85,18 @@ def get_lib():
         lib.pq_byte_array_scan.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                            ctypes.c_int64, ctypes.c_void_p,
                                            ctypes.c_void_p]
+        lib.pq_rle_decode.restype = ctypes.c_int64
+        lib.pq_rle_decode.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_int32, ctypes.c_int64,
+                                      ctypes.c_void_p]
+        lib.pq_page_walk.restype = ctypes.c_int64
+        lib.pq_page_walk.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_int64, ctypes.c_int64] \
+            + [ctypes.c_void_p] * 11
+        lib.pq_def_levels.restype = ctypes.c_int64
+        lib.pq_def_levels.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_int32, ctypes.c_int64,
+                                      ctypes.c_int32, ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -251,6 +263,67 @@ def csv_tokenize(data: np.ndarray, sep: int):
     if nf < 0:
         return None
     return starts[:nf], lens[:nf], flags[:nf], int(nf)
+
+
+def pq_rle_decode(payload: bytes, bit_width: int, n_values: int,
+                  out: np.ndarray, base: int) -> bool:
+    """Parquet hybrid RLE/bit-packed stream (AFTER the bit-width byte) ->
+    int32 values written into out[base:base+n_values].  Returns False when
+    the native library is unavailable or the stream is malformed/out of
+    scope (bit width > 24) — the caller runs the python walk instead."""
+    lib = get_lib()
+    if lib is None or out.dtype != np.int32 or not out.flags.c_contiguous:
+        return False
+    if base < 0 or base + n_values > out.size:
+        return False
+    consumed = lib.pq_rle_decode(payload, len(payload), bit_width, n_values,
+                                 out.ctypes.data + 4 * base)
+    return consumed >= 0
+
+
+_PAGE_WALK_FIELDS = ("ptype", "data_off", "comp_size", "uncomp_size",
+                     "n_vals", "enc", "dl_enc", "dl_len", "rl_len",
+                     "comp_flag", "dict_n")
+
+
+def pq_page_walk(raw: bytes, target_values: int):
+    """Parse every parquet page header in a column chunk natively.
+
+    Returns {field: np.ndarray[n_pages]} (see _PAGE_WALK_FIELDS; data_off
+    is int64, the rest int32), or None when the native library is
+    unavailable or the chunk doesn't parse (caller walks in python)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    cap = max(64, target_values // 500)
+    while True:
+        arrs = {f: np.empty(cap, np.int64 if f == "data_off" else np.int32)
+                for f in _PAGE_WALK_FIELDS}
+        n = lib.pq_page_walk(raw, len(raw), target_values, cap,
+                             *(arrs[f].ctypes.data
+                               for f in _PAGE_WALK_FIELDS))
+        if n == -2:
+            cap *= 4
+            continue
+        if n < 0:
+            return None
+        return {f: a[:n] for f, a in arrs.items()}
+
+
+def pq_def_levels(payload: bytes, bit_width: int, n_values: int,
+                  max_def: int, valid_out: np.ndarray, base: int):
+    """Decode definition levels into valid bytes
+    (valid_out[base:base+n_values]) and return the non-null count, or None
+    (caller decodes in python).  valid_out must be uint8/bool contiguous."""
+    lib = get_lib()
+    if lib is None or not valid_out.flags.c_contiguous \
+            or valid_out.dtype.itemsize != 1:
+        return None
+    if base < 0 or base + n_values > valid_out.size:
+        return None
+    nn = lib.pq_def_levels(payload, len(payload), bit_width, n_values,
+                           max_def, valid_out.ctypes.data + base)
+    return None if nn < 0 else int(nn)
 
 
 def pq_byte_array_scan(data: np.ndarray, n_values: int):
