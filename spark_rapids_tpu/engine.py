@@ -40,9 +40,13 @@ def _enable_compilation_cache(path: str) -> None:
         # TPU-backed processes only: compiles there cost tens of seconds
         # and replay byte-identically.  XLA:CPU AOT replay warns about
         # machine-feature mismatches (SIGILL risk) and the CPU test env
-        # already fights compile-cache memory pressure — not worth it.
-        if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
-                or jax.config.jax_platforms == "cpu":
+        # already fights compile-cache memory pressure — so the cache is
+        # strictly OPT-IN via an explicitly named non-cpu platform (a
+        # CPU-only machine with JAX_PLATFORMS unset auto-selects cpu and
+        # must stay uncached).
+        platforms = jax.config.jax_platforms \
+            or os.environ.get("JAX_PLATFORMS", "")
+        if not platforms or platforms == "cpu":
             return
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -384,7 +384,12 @@ def _plain_decode(raw: bytes, n_values: int, phys: str, cap: int):
     and on the emulated-f64 chip the u64->f64 bit-field rebuild via ldexp
     was the single hottest kernel of the q6 scan.)  Encodings that
     actually expand (dictionary, bit-pack, delta) still decode on device."""
-    vals = np.frombuffer(raw, dtype=_PLAIN_NP[phys], count=n_values)
+    dt = np.dtype(_PLAIN_NP[phys])
+    if len(raw) < n_values * dt.itemsize:
+        raise DeviceDecodeUnsupported(
+            f"truncated PLAIN page ({len(raw)} bytes for {n_values} "
+            f"{phys})")
+    vals = np.frombuffer(raw, dtype=dt, count=n_values)
     if n_values < cap:
         out = np.zeros(cap, dtype=vals.dtype)
         out[:n_values] = vals
@@ -961,8 +966,11 @@ def decode_column_chunk(path: str, col_meta, phys: str, dtype: DataType,
             # decompressed page, never via a device round trip
             if dict_raw is None or phys not in _PLAIN_NP:
                 return None
-            return np.frombuffer(dict_raw[0], _PLAIN_NP[phys],
-                                 count=dict_raw[1])
+            data_b, n_dict = dict_raw
+            dt = np.dtype(_PLAIN_NP[phys])
+            if len(data_b) < n_dict * dt.itemsize:
+                raise DeviceDecodeUnsupported("truncated dictionary page")
+            return np.frombuffer(data_b, dt, count=n_dict)
 
         return _assemble_chunk(value_pieces, valid_np, get_dict,
                                get_dict_np, phys, dtype, num_rows, cap)
@@ -1082,6 +1090,8 @@ def _assemble_numeric_host(value_pieces, valid_np, valid_host, get_dict_np,
         if nonnull == 0:
             continue
         if kind == "plain":
+            if len(payload) < nonnull * np.dtype(np_dt).itemsize:
+                raise DeviceDecodeUnsupported("truncated PLAIN page")
             out_np[off:off + nonnull] = np.frombuffer(payload, np_dt,
                                                       count=nonnull)
         else:

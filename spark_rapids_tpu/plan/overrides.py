@@ -421,7 +421,7 @@ _EXEC_DOC_ROWS = [
      "side-swapped under a column reorder); conditional joins for "
      "inner/semi/anti (residual evaluated pair-wise in the candidate "
      "walk); broadcast and partitioned (EnsureRequirements) variants; "
-     "USING right/full joins fall back for Spark's coalesced-key "
+     "USING full joins fall back for Spark's coalesced-key "
      "contract"),
     ("SortExec", "order-preserving integer key encoding, one lexsort; "
      "external (partitioned) sort above the in-memory threshold"),

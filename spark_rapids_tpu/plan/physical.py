@@ -56,16 +56,32 @@ def convert(meta: PlanMeta) -> ExecNode:
                 # the variant dispatch (so broadcast/partitioned apply),
                 # columns reordered back afterwards (the reference has no
                 # right-outer device join, GpuHashJoin.scala:31-32;
-                # tagging admits only the residual-free, non-USING case)
+                # tagging admits only the residual-free case)
                 jt = "left"
                 lc, rc = rc, lc
                 lkeys, rkeys = rkeys, lkeys
                 cond = None
                 build_plan = plan.children[0]
-                n_l = len(plan_schema(plan.children[0], meta.conf))
-                n_r = len(plan_schema(plan.children[1], meta.conf))
+                ls_f = plan_schema(plan.children[0], meta.conf)
+                rs_f = plan_schema(plan.children[1], meta.conf)
+                n_l, n_r = len(ls_f), len(rs_f)
                 join_schema = _swapped_join_schema(plan, meta.conf)
-                reorder = list(range(n_r, n_r + n_l)) + list(range(n_r))
+                if plan.using:
+                    # Spark's coalesced-key contract for right USING: the
+                    # key column surfaces the RIGHT side's value (every
+                    # output row preserves a right row).  The swapped exec
+                    # emits [R..., L...]; select key cols from the R block
+                    # into the left key positions and drop the rest of R's
+                    # using cols — the exec itself drops nothing.
+                    using_drop = []
+                    reorder = [rs_f.index_of(f.name) if f.name in plan.using
+                               else n_r + i
+                               for i, f in enumerate(ls_f)]
+                    reorder += [i for i, f in enumerate(rs_f)
+                                if f.name not in plan.using]
+                else:
+                    reorder = (list(range(n_r, n_r + n_l))
+                               + list(range(n_r)))
 
             def wrap(node):
                 if reorder is None:

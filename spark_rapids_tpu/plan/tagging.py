@@ -170,11 +170,12 @@ def _tag_join(meta: PlanMeta):
             "(Inner/Left/Right/Full/LeftSemi/LeftAnti; the reference "
             "stops at Inner/Left/LeftSemi/LeftAnti — device RIGHT and "
             "FULL OUTER go beyond it)")
-    if plan.join_type in ("full", "full_outer", "right",
-                          "right_outer") and plan.using:
-        # USING full/right joins surface the key from the preserved
-        # side(s); the device kernels carry left-side keys only, so
-        # Spark's coalesced-key contract needs the CPU path
+    if plan.join_type in ("full", "full_outer") and plan.using:
+        # USING full joins coalesce the key across BOTH preserved sides
+        # per row; the device kernels carry one side's keys, so Spark's
+        # coalesced-key contract needs the CPU path.  (Right USING joins
+        # ARE supported: every output row preserves a right row, so the
+        # key surfaces from the right block via the post-join reorder.)
         meta.will_not_work(f"{plan.join_type} USING joins (coalesced "
                            "keys) are not supported on TPU")
     ls = plan_schema(plan.children[0], meta.conf)

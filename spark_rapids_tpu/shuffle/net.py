@@ -23,6 +23,7 @@ plane (shuffle/worker.py) — the analogue of UCX's management port.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
@@ -40,6 +41,9 @@ OP_META, OP_META_RESP = 1, 2
 OP_LAYOUT, OP_LAYOUT_RESP = 3, 4
 OP_FETCH, OP_DATA, OP_END = 5, 6, 7
 OP_DONE, OP_ACK = 8, 9
+OP_FETCH_SHM = 10
+# same-host segment path prefix; the server refuses to open anything else
+SHM_PREFIX = "/dev/shm/srtpu_shm_"
 OP_RPC, OP_RPC_RESP, OP_RPC_ERR = 20, 21, 22
 
 _HDR = struct.Struct(">IB")
@@ -145,6 +149,9 @@ class ShuffleSocketServer:
                 elif op == OP_FETCH:
                     (bid,) = struct.unpack(">Q", payload)
                     self._stream_buffer(conn, bid)
+                elif op == OP_FETCH_SHM:
+                    bid, shm_name = pickle.loads(payload)
+                    self._fill_shm(conn, bid, shm_name)
                 elif op == OP_DONE:
                     (bid,) = struct.unpack(">Q", payload)
                     self.server_obj.done_serving(bid)
@@ -183,6 +190,49 @@ class ShuffleSocketServer:
                 off += length
                 self.transport.count("bytes_sent", length)
         send_frame(conn, OP_END, b"")
+
+    def _fill_shm(self, conn: socket.socket, bid: int,
+                  shm_path: str) -> None:
+        """Same-host fast path: copy each leaf ONCE into the client-owned
+        /dev/shm segment instead of chunking through bounce buffers and
+        the socket (the local-peer analogue of the reference's UCX
+        zero-copy RDMA).  The socket carries only the END ack.  A plain
+        tmpfs file + mmap, NOT multiprocessing.shared_memory — the stdlib
+        resource tracker logs a KeyError per cross-process segment on
+        this python version."""
+        import mmap
+        if not shm_path.startswith(SHM_PREFIX):
+            send_frame(conn, OP_RPC_ERR,
+                       pickle.dumps(f"bad shm path {shm_path!r}"))
+            return
+        try:
+            fd = os.open(shm_path, os.O_RDWR)
+            try:
+                mm = mmap.mmap(fd, 0)
+            finally:
+                os.close(fd)
+        except OSError as e:
+            send_frame(conn, OP_RPC_ERR, pickle.dumps(f"shm open: {e!r}"))
+            return
+        try:
+            layout, _meta = self.server_obj.buffer_layout(bid)
+            off = 0
+            for leaf_idx, (_shape, _dtype, nbytes) in enumerate(layout):
+                view = np.frombuffer(mm, np.uint8, count=nbytes,
+                                     offset=off)
+                try:
+                    self.server_obj.copy_leaf_chunk(bid, leaf_idx, 0,
+                                                    nbytes, view)
+                finally:
+                    # the view exports the mmap; it must die before
+                    # mm.close() (BufferError otherwise)
+                    del view
+                off += nbytes
+            self.transport.count("bytes_sent", off)
+            self.transport.count("shm_fills")
+            send_frame(conn, OP_END, b"")
+        finally:
+            mm.close()
 
     def _handle_rpc(self, conn: socket.socket, payload: bytes) -> None:
         if self.rpc_handler is None:
@@ -245,6 +295,55 @@ class SocketClient(ShuffleTransportClient):
         self.transport.count("metadata_fetched")
         return pickle.loads(resp)
 
+    def _fetch_buffer_shm(self, layout, meta, buffer_id: int, total: int):
+        """Local-peer fetch through a client-owned /dev/shm segment: one
+        server-side copy per leaf, no socket data frames.  Returns
+        (leaves, meta) or None when shm is unavailable (caller streams)."""
+        import mmap
+        import tempfile
+        try:
+            fd, path = tempfile.mkstemp(prefix=os.path.basename(SHM_PREFIX),
+                                        dir=os.path.dirname(SHM_PREFIX))
+        except OSError:
+            return None
+        mm = None
+        try:
+            os.ftruncate(fd, max(total, 1))
+            mm = mmap.mmap(fd, max(total, 1))
+            with self._lock:
+                sock = self._conn()
+                send_frame(sock, OP_FETCH_SHM,
+                           pickle.dumps((buffer_id, path)))
+                op, _length = recv_frame(sock)
+            if op != OP_END:
+                return None
+            # copy out of the segment: a zero-copy variant (arrays
+            # viewing the mmap with finalizer-managed lifetime) measured
+            # no faster on loopback and leaked one fd per fetch — one
+            # bounded memcpy per leaf is the honest cost
+            out: List[np.ndarray] = []
+            off = 0
+            for (shape, dtype_str, nbytes) in layout:
+                a = np.empty(nbytes, dtype=np.uint8)
+                src = np.frombuffer(mm, np.uint8, count=nbytes,
+                                    offset=off)
+                try:
+                    a[:] = src
+                finally:
+                    del src  # release the mmap export before mm.close()
+                out.append(a.view(np.dtype(dtype_str)).reshape(shape))
+                off += nbytes
+            self.transport.count("bytes_received", off)
+            return out, meta
+        finally:
+            if mm is not None:
+                mm.close()
+            os.close(fd)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
     def fetch_buffer(self, buffer_id: int):
         with self._lock:
             resp = self._request(OP_LAYOUT,
@@ -254,6 +353,12 @@ class SocketClient(ShuffleTransportClient):
         total = sum(nb for _, _, nb in layout)
         self.transport.throttle.acquire(total)
         try:
+            if self.addr[0] in ("127.0.0.1", "localhost", "::1") \
+                    and self.transport.shm_local:
+                got = self._fetch_buffer_shm(layout, meta, buffer_id,
+                                             total)
+                if got is not None:
+                    return got
             with self._lock:
                 sock = self._conn()
                 send_frame(sock, OP_FETCH, struct.pack(">Q", buffer_id))
@@ -314,7 +419,14 @@ class SocketTransport(ShuffleTransport):
     def __init__(self, pool_size: int = 8 << 20, chunk_size: int = 1 << 20,
                  max_inflight_bytes: int = 4 << 20,
                  host: str = "127.0.0.1", port: int = 0,
-                 rpc_handler: Optional[Callable] = None):
+                 rpc_handler: Optional[Callable] = None,
+                 shm_local: bool = False):
+        # measured on 128MB partitions (BENCH_WIRE.json): the pipelined
+        # chunked stream does ~1.05 GB/s on loopback while the serial
+        # fill-then-copy shm path does ~0.7 GB/s — so the stream is the
+        # default and shm stays an option for CPU-constrained hosts
+        # (2 copies + no socket syscalls vs 3 copies through the kernel)
+        self.shm_local = shm_local
         self.pool = BounceBufferPool(pool_size, chunk_size)
         self.chunk_size = chunk_size
         self.throttle = InflightThrottle(max_inflight_bytes)

@@ -278,7 +278,10 @@ def test_right_join_on_device():
     assert_tpu_and_cpu_are_equal(q)
 
 
-def test_right_join_using_falls_back():
+def test_right_join_using_on_device():
+    """Right USING joins run on device: the key surfaces from the RIGHT
+    block via the post-join reorder (Spark's coalesced-key contract for a
+    right-preserving join), in both broadcast and shuffled variants."""
     from spark_rapids_tpu.engine import TpuSession
 
     def q(s):
@@ -288,8 +291,10 @@ def test_right_join_using_falls_back():
 
     s = TpuSession({})
     text = q(s).explain()
-    assert "!SortMergeJoinExec" in text
+    assert "!SortMergeJoinExec" not in text, text
     assert_tpu_and_cpu_are_equal(q)
+    assert_tpu_and_cpu_are_equal(
+        q, conf={"spark.sql.autoBroadcastJoinThreshold": "-1"})
 
 
 def test_full_join_using_falls_back():
