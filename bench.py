@@ -305,6 +305,23 @@ def child_main(mode: str) -> None:
     emit("tpcxbb_datagen", sf=TPCDS_SF, t=time.time() - t0)
     checkpoint("tpcxbb_datagen")
     timed("tpcxbb_q5", lambda: checksum(xbb_q5(xbb).collect()), heavy_runs)
+
+    # SF1 scale tier (opt-in: BENCH_SF1=1): ~2.88M-row store_sales
+    # (1.2GB of tables), streamed through the multi-batch path.  The
+    # capture loop enables this so lease windows record on-chip SF1
+    # numbers (VERDICT r4 item 6; the reference's chart is SF10k on a
+    # cluster, README.md:7-15 — this is the one-chip scale point).
+    if os.environ.get("BENCH_SF1") == "1":
+        from benchmarks.tpcds.queries import QUERIES as DSQ
+        t0 = time.time()
+        ds1 = ds_load(session, sf=1.0)
+        emit("tpcds_sf1_datagen", t=time.time() - t0)
+        checkpoint("tpcds_sf1_datagen")
+        for name, qn in (("sf1_q5", 5), ("sf1_q3", 3), ("sf1_q7", 7),
+                         ("sf1_q19", 19)):
+            timed(name,
+                  lambda qn=qn: checksum(DSQ[qn](ds1).collect()),
+                  heavy_runs)
     emit("done", t=time.time() - (_DEADLINE[0] - float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))))
 
@@ -501,18 +518,42 @@ def _run():
                                  end_at - time.time() - 5)
 
     # 2. CPU oracle (forced-CPU child, drops the axon plugin factories, so
-    # it cannot block on the device lease)
-    cpu = collect(StageReader("cpu-oracle", "oracle",
-                              min(end_at, T0 + 210) - time.time()),
-                  min(end_at, T0 + 210))
-    if not cpu["runs"].get("q6") and not cpu["warmup"].get("q6"):
-        log("FATAL: CPU oracle produced no q6 runs")
-        return {"metric": "tpch_q6_like_device_throughput", "value": 0.0,
-                "unit": "Mrows/s[none]", "vs_baseline": 0.0,
-                "extra": {"fatal": "cpu oracle produced no q6 runs"}}
-    # the oracle has no warmup effects: fold warmup times in as runs
-    for q, t in cpu["warmup"].items():
-        cpu["runs"].setdefault(q, []).append(t)
+    # it cannot block on the device lease).  The oracle is deterministic
+    # in (N_ROWS, TPCDS_SF); BENCH_ORACLE_CACHE=1 lets capture loops that
+    # rerun the bench for TPU lease windows skip the ~3min oracle replay.
+    cache_path = f"/tmp/bench_oracle_{N_ROWS}_{TPCDS_SF}.json"
+    cpu = None
+    if os.environ.get("BENCH_ORACLE_CACHE") == "1" \
+            and os.path.exists(cache_path):
+        try:
+            with open(cache_path) as f:
+                cpu = json.load(f)
+            log(f"oracle loaded from {cache_path}")
+        except (OSError, ValueError):
+            cpu = None
+    if cpu is None or not cpu.get("runs", {}).get("q6"):
+        # SF1 adds ~40s datagen + 4 scale queries to the oracle's budget
+        oracle_cap = 600 if os.environ.get("BENCH_SF1") == "1" else 210
+        cpu = collect(StageReader("cpu-oracle", "oracle",
+                                  min(end_at, T0 + oracle_cap)
+                                  - time.time()),
+                      min(end_at, T0 + oracle_cap))
+        if not cpu["runs"].get("q6") and not cpu["warmup"].get("q6"):
+            log("FATAL: CPU oracle produced no q6 runs")
+            return {"metric": "tpch_q6_like_device_throughput",
+                    "value": 0.0, "unit": "Mrows/s[none]",
+                    "vs_baseline": 0.0,
+                    "extra": {"fatal": "cpu oracle produced no q6 runs"}}
+        # the oracle has no warmup effects: fold warmup times in as runs
+        for q, t in cpu["warmup"].items():
+            cpu["runs"].setdefault(q, []).append(t)
+        if os.environ.get("BENCH_ORACLE_CACHE") == "1" \
+                and len(cpu["runs"]) >= 5 and not cpu.get("aborted"):
+            try:
+                with open(cache_path, "w") as f:
+                    json.dump(cpu, f)
+            except OSError:
+                pass
 
     # 3. consume the device child (already running); if the chip reported
     # UNAVAILABLE quickly, the lease may free up — retry while the budget

@@ -1,0 +1,140 @@
+"""Pallas-vs-XLA microbench: settle `spark.rapids.sql.tpu.pallas.enabled`
+with measured data (VERDICT r4 item 7).
+
+Benchmarks, on the ambient backend (meant for the real chip; prints the
+platform so CPU-backend runs are self-labeling):
+  1. cumsum        — ops/pallas_kernels.cumsum_1d vs jnp.cumsum (the
+                     segmented-aggregation inner primitive, _masked_cumsum)
+  2. seg_sum       — exec/aggregate._seg_sum (cumsum + 2 searchsorted
+                     gathers) with the pallas cumsum vs the XLA cumsum
+  3. bit_unpack    — io/parquet_device._bitpacked_unpack (XLA gather/
+                     shift/mask), timed in GB/s to decide whether a
+                     pallas rival is worth writing at all
+  4. sort_encode   — exec/sort key-encode + argsort (XLA), same question
+
+Writes BENCH_PALLAS.json at the repo root:
+  {platform, results: [{name, n, dtype, xla_ms, pallas_ms, speedup}...],
+   verdict: "..."}
+
+Run: timeout 900 python benchmarks/pallas_micro.py   (ambient env; one
+jax process at a time — this touches the TPU lease)."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    # CPU self-test: the ambient env pins the axon plugin in every
+    # process, so the factories must drop BEFORE first backend use
+    from spark_rapids_tpu.utils.cpu_backend import force_cpu_backend
+    force_cpu_backend()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def timeit(fn, *args, n_runs: int = 10) -> float:
+    """Median ms of a jitted fn (blocked)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(n_runs):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append((time.perf_counter() - t0) * 1e3)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def main() -> None:
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # lease down: report and leave evidence
+        print(json.dumps({"platform": None, "error": repr(e)[:200]}))
+        return
+    results = []
+    rng = np.random.RandomState(7)
+
+    # 1/2. cumsum + seg_sum
+    from spark_rapids_tpu.exec import aggregate as agg
+    from spark_rapids_tpu.ops.pallas_kernels import cumsum_1d
+    for n in (1 << 20, 1 << 23):
+        for dt in (jnp.int32, jnp.float32):
+            v = jnp.asarray(rng.randint(0, 100, n), dtype=dt)
+            xla_ms = timeit(jax.jit(jnp.cumsum), v)
+            try:
+                pal_ms = timeit(jax.jit(cumsum_1d), v)
+            except Exception as e:
+                pal_ms = None
+                print(f"pallas cumsum failed n={n} {dt.__name__}: "
+                      f"{e!r}"[:160], file=sys.stderr)
+            results.append({
+                "name": "cumsum", "n": n, "dtype": dt.__name__,
+                "xla_ms": round(xla_ms, 3),
+                "pallas_ms": round(pal_ms, 3) if pal_ms else None,
+                "speedup": round(xla_ms / pal_ms, 2) if pal_ms else None})
+
+    n = 1 << 22
+    gid = jnp.asarray(np.sort(rng.randint(0, 1024, n)).astype(np.int32))
+    vals = jnp.asarray(rng.randint(0, 1000, n).astype(np.int32))
+    contribute = jnp.asarray(rng.rand(n) < 0.9)
+
+    def seg(v, g, c):
+        return agg._seg_sum(v, g, c, 1024)
+    for mode in ("xla", "pallas"):
+        agg.set_pallas_cumsum(mode == "pallas")
+        try:
+            ms = timeit(jax.jit(seg), vals, gid, contribute)
+        except Exception as e:
+            ms = None
+            print(f"seg_sum {mode} failed: {e!r}"[:160], file=sys.stderr)
+        results.append({"name": f"seg_sum[{mode}]", "n": n,
+                        "dtype": "int32",
+                        "ms": round(ms, 3) if ms else None})
+    agg.set_pallas_cumsum(False)
+
+    # 3. parquet bit-unpack (XLA): GB/s of unpacked output
+    from spark_rapids_tpu.io.parquet_device import _bitpacked_unpack
+    for bw in (3, 11, 20):
+        count = 1 << 21
+        packed = rng.randint(0, 256, (count * bw + 7) // 8 + 8,
+                             dtype=np.uint8).tobytes()
+
+        def unpack(bw=bw, count=count, packed=packed):
+            return _bitpacked_unpack(packed, bw, count, count)
+        ms = timeit(lambda: unpack())
+        results.append({"name": "bit_unpack_xla", "n": count,
+                        "bit_width": bw, "ms": round(ms, 3),
+                        "out_gb_s": round(count * 4 / ms / 1e6, 2)})
+
+    # 4. sort key-encode + argsort (XLA)
+    keys = jnp.asarray(rng.randint(-10**9, 10**9, 1 << 21)
+                       .astype(np.int64))
+    ms = timeit(jax.jit(jnp.argsort), keys)
+    results.append({"name": "argsort_xla", "n": 1 << 21,
+                    "dtype": "int64", "ms": round(ms, 3)})
+
+    cs = [r for r in results if r["name"] == "cumsum"
+          and r.get("speedup") is not None]
+    wins = [r for r in cs if r["speedup"] > 1.1]
+    verdict = (
+        f"pallas cumsum wins {len(wins)}/{len(cs)} shapes on {platform}"
+        if cs else f"pallas cumsum unmeasurable on {platform}")
+    out = {"platform": platform, "recorded_unix": int(time.time()),
+           "results": results, "verdict": verdict}
+    with open(os.path.join(REPO, "BENCH_PALLAS.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"platform": platform, "verdict": verdict,
+                      "n_results": len(results)}))
+
+
+if __name__ == "__main__":
+    main()

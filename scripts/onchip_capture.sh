@@ -35,7 +35,7 @@ EOF
     exit 0
   fi
   BENCH_GLOBAL_S=${BENCH_GLOBAL_S:-2800} BENCH_TPU_PROBE_S=${BENCH_TPU_PROBE_S:-2000} \
-    timeout -k 5 3300 python bench.py
+    BENCH_ORACLE_CACHE=1 BENCH_SF1=1 timeout -k 5 3300 python bench.py
   echo "--- iteration $i done rc=$? ---"
   sleep 30
 done

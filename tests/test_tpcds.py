@@ -21,7 +21,18 @@ def run_query(qnum: int, conf: dict):
     return QUERIES[qnum](tables).collect()
 
 
-@pytest.mark.parametrize("qnum", sorted(QUERIES))
+# fast-tier representatives: every operator family the 99-query tier
+# exercises (3-channel union+rollup q5, scan-heavy q3/q6-alikes, semi/anti
+# q16/q94/q95, distinct-union q38/q87, windows q51/q67, set-ops q8/q14,
+# self-join q1/q32, inventory q21/q72, count-distinct-ish q96); the other
+# ~85 run in the slow tier (VERDICT r4 item 10: fast tier under a CI
+# budget — the full oracle tier was ~20 min of the 23-min fast run)
+_FAST_QS = {1, 3, 5, 8, 14, 16, 21, 32, 38, 51, 67, 72, 87, 94, 95, 96}
+
+
+@pytest.mark.parametrize(
+    "qnum", [q if q in _FAST_QS else pytest.param(q, marks=pytest.mark.slow)
+             for q in sorted(QUERIES)])
 def test_tpcds_query(qnum):
     cpu = run_query(qnum, {"spark.rapids.sql.enabled": "false"})
     tpu = run_query(qnum, {})
