@@ -1,32 +1,49 @@
-"""Per-stage TPU profiling harness (round-3 diagnosis of the 16s/run Q6).
+"""Per-stage TPU profiling harness (VERDICT r4 item 3: where does the
+roofline gap go — H2D? dispatch? f64 emulation? compile?).
 
-Measures, each under its own stderr-logged timer:
+Measures, each under its own timer, and writes PROFILE_ONCHIP.json:
   1. H2D bandwidth: device_put of numpy arrays, various sizes/dtypes
   2. dispatch+sync latency: tiny jitted op round trip
   3. compile time: Q6-shaped kernel
-  4. steady-state kernel time on device-resident data
+  4. steady-state kernel time on device-resident data (f64, f32/i32,
+     bf16 variants — the emulated-f64 cost shows up as the f64/f32 gap)
   5. D2H scalar fetch
 
-Run: JAX_PLATFORMS=<tpu|cpu> python benchmarks/profile_device.py
-"""
+Run: timeout 1200 python benchmarks/profile_device.py   (ambient env;
+one jax process at a time).  --cpu forces the CPU backend (self-test)."""
+import json
+import os
 import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 T0 = time.time()
+
+if "--cpu" in sys.argv:
+    from spark_rapids_tpu.utils.cpu_backend import force_cpu_backend
+    force_cpu_backend()
 
 
 def log(msg):
-    print(f"[prof +{time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+    print(f"[prof +{time.time() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
 
 
 def main():
     import jax
     import jax.numpy as jnp
     jax.config.update("jax_enable_x64", True)
-    dev = jax.devices()[0]
+    try:
+        dev = jax.devices()[0]
+    except Exception as e:
+        print(json.dumps({"platform": None, "error": repr(e)[:200]}))
+        return
     log(f"platform={dev.platform} device={dev}")
+    out = {"platform": dev.platform, "recorded_unix": int(time.time()),
+           "h2d": [], "kernels": {}}
 
     # 1. H2D bandwidth
     for mb, dtype in [(1, np.float32), (8, np.float64), (48, np.float64),
@@ -39,6 +56,9 @@ def main():
         dt = time.perf_counter() - t
         log(f"H2D {mb}MB {np.dtype(dtype).name}: {dt:.3f}s "
             f"({mb / dt:.1f} MB/s)")
+        out["h2d"].append({"mb": mb, "dtype": np.dtype(dtype).name,
+                           "s": round(dt, 4),
+                           "mb_s": round(mb / dt, 1)})
 
     # 2. dispatch+sync latency
     f = jax.jit(lambda x: x + 1)
@@ -51,8 +71,10 @@ def main():
         ts.append(time.perf_counter() - t)
     log(f"dispatch+sync latency: min={min(ts)*1e3:.1f}ms "
         f"median={sorted(ts)[5]*1e3:.1f}ms")
+    out["dispatch_ms"] = {"min": round(min(ts) * 1e3, 2),
+                          "median": round(sorted(ts)[5] * 1e3, 2)}
 
-    # 3+4. Q6-shaped kernel: filter + project + masked sum over 6M f64 rows
+    # 3+4. Q6-shaped kernel: filter + project + masked sum over 6M rows
     n = 6_000_000
     cap = 1 << 23
     rng = np.random.RandomState(42)
@@ -71,43 +93,64 @@ def main():
     dsel = jax.device_put(sel, dev)
     for v in dcols.values():
         v.block_until_ready()
-    log(f"H2D 6M-row 4-col table ({sum(v.nbytes for v in cols.values())/2**20:.0f}MB): "
-        f"{time.perf_counter() - t:.3f}s")
+    table_s = time.perf_counter() - t
+    table_mb = sum(v.nbytes for v in cols.values()) / 2**20
+    log(f"H2D 6M-row 4-col table ({table_mb:.0f}MB): {table_s:.3f}s")
+    out["h2d_table"] = {"mb": round(table_mb), "s": round(table_s, 3),
+                        "mb_s": round(table_mb / table_s, 1)}
 
     def q6(c, s):
         keep = (s & (c["ship"] >= 8766) & (c["ship"] < 9131)
-                & (c["disc"] >= 0.05) & (c["disc"] <= 0.07) & (c["qty"] < 24))
+                & (c["disc"] >= 0.05) & (c["disc"] <= 0.07)
+                & (c["qty"] < 24))
         return jnp.sum(jnp.where(keep, c["price"] * c["disc"], 0.0))
 
-    jq6 = jax.jit(q6)
-    t = time.perf_counter()
-    r = jq6(dcols, dsel).block_until_ready()
-    log(f"Q6 kernel compile+run: {time.perf_counter() - t:.3f}s")
-    ts = []
-    for _ in range(5):
+    def steady(name, fn, *args, bytes_per_row=32):
+        jfn = jax.jit(fn)
         t = time.perf_counter()
-        jq6(dcols, dsel).block_until_ready()
-        ts.append(time.perf_counter() - t)
-    log(f"Q6 kernel steady-state: min={min(ts)*1e3:.1f}ms -> "
-        f"{n / min(ts) / 1e6:.0f} Mrows/s")
+        r = jfn(*args)
+        jax.block_until_ready(r)
+        compile_s = time.perf_counter() - t
+        ts = []
+        for _ in range(5):
+            t = time.perf_counter()
+            jax.block_until_ready(jfn(*args))
+            ts.append(time.perf_counter() - t)
+        ms = min(ts) * 1e3
+        gb_s = n * bytes_per_row / (ms / 1e3) / 1e9
+        log(f"{name}: compile {compile_s:.2f}s steady {ms:.1f}ms -> "
+            f"{n / (ms / 1e3) / 1e6:.0f} Mrows/s, {gb_s:.1f} GB/s eff")
+        out["kernels"][name] = {"compile_s": round(compile_s, 2),
+                                "steady_ms": round(ms, 2),
+                                "mrows_s": round(n / (ms / 1e3) / 1e6, 1),
+                                "eff_gb_s": round(gb_s, 2)}
+        return r
 
-    # f32 variant (TPU-native dtype)
-    dcols32 = {k: v.astype(jnp.float32) if v.dtype == jnp.float64 else
-               v.astype(jnp.int32) for k, v in dcols.items()}
-    jq6_32 = jax.jit(q6)
-    jq6_32(dcols32, dsel).block_until_ready()
-    ts = []
-    for _ in range(5):
-        t = time.perf_counter()
-        jq6_32(dcols32, dsel).block_until_ready()
-        ts.append(time.perf_counter() - t)
-    log(f"Q6 kernel f32/i32: min={min(ts)*1e3:.1f}ms -> "
-        f"{n / min(ts) / 1e6:.0f} Mrows/s")
+    r = steady("q6_f64", q6, dcols, dsel)
+
+    dcols32 = {k: (v.astype(jnp.float32) if v.dtype == jnp.float64
+                   else v.astype(jnp.int32)) for k, v in dcols.items()}
+    for v in dcols32.values():
+        v.block_until_ready()
+    steady("q6_f32_i32", q6, dcols32, dsel, bytes_per_row=16)
+
+    dcols16 = {k: (v.astype(jnp.bfloat16) if v.dtype == jnp.float64
+                   else v.astype(jnp.int32)) for k, v in dcols.items()}
+    for v in dcols16.values():
+        v.block_until_ready()
+    steady("q6_bf16_i32", q6, dcols16, dsel, bytes_per_row=12)
 
     # 5. D2H scalar
     t = time.perf_counter()
     float(r)
-    log(f"D2H scalar: {(time.perf_counter() - t)*1e3:.1f}ms")
+    d2h_ms = (time.perf_counter() - t) * 1e3
+    log(f"D2H scalar: {d2h_ms:.1f}ms")
+    out["d2h_scalar_ms"] = round(d2h_ms, 2)
+
+    with open(os.path.join(REPO, "PROFILE_ONCHIP.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"platform": dev.platform,
+                      "kernels": out["kernels"]}))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,88 @@
+#!/bin/bash
+# Chip worker: whenever the machine-wide TPU lease grants a window, spend
+# it on the round's full on-chip evidence list, in priority order:
+#   1. bench.py          -> BENCH_ONCHIP.json (5 core + 4 SF1 queries)
+#   2. pallas_micro.py   -> BENCH_PALLAS.json (settle pallas.enabled)
+#   3. profile_device.py -> PROFILE_ONCHIP.json (roofline-gap profile)
+#   4. pressure_onchip   -> BENCH_PRESSURE.json (spill cascade on chip)
+# Each stage is bounded; a stage that can't get the chip exits cleanly and
+# the loop retries.  Stages 2-4 only run after stage 1 has succeeded at
+# least once this round (the lease is clearly grantable then).
+#
+# Usage: nohup bash scripts/chip_worker.sh > /tmp/chip_worker.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+MAX_ITERS=${MAX_ITERS:-12}
+export CAPTURE_START=${CAPTURE_START:-$(date +%s)}
+
+fresh() {  # fresh() FILE -> 0 when the artifact is from this round
+  python - "$1" <<'EOF'
+import json, os, sys
+try:
+    d = json.load(open(sys.argv[1]))
+    start = int(os.environ.get("CAPTURE_START", 0))
+    ok = int(d.get("recorded_unix", 0)) >= start and (
+        d.get("platform") is None or "tpu" in str(d.get("platform", "")))
+    sys.exit(0 if ok and d.get("platform") else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
+bench_fresh() {
+  python - <<'EOF'
+import json, os, sys
+try:
+    start = int(os.environ.get("CAPTURE_START", 0))
+    pq = json.load(open("BENCH_ONCHIP.json"))["extra"]["per_query"]
+    want = ["q1", "q6", "q6_scan", "tpcds_q5", "tpcxbb_q5"]
+    fresh = [q for q in want
+             if pq.get(q, {}).get("dev_s") is not None
+             and int(pq.get(q, {}).get("recorded_unix", 0)) >= start]
+    print(len(fresh), flush=True)
+    sys.exit(0 if len(fresh) == len(want) else 1)
+except Exception:
+    print(0, flush=True)
+    sys.exit(1)
+EOF
+}
+
+for i in $(seq 1 "$MAX_ITERS"); do
+  echo "=== chip worker iteration $i $(date -u +%H:%M:%S) ==="
+  if n=$(bench_fresh); then
+    echo "bench suite complete on chip ($n/5 fresh)"
+  else
+    echo "bench incomplete ($n/5 fresh); running bench.py"
+    BENCH_GLOBAL_S=${BENCH_GLOBAL_S:-2800} BENCH_TPU_PROBE_S=${BENCH_TPU_PROBE_S:-2000} \
+      BENCH_ORACLE_CACHE=1 BENCH_SF1=1 timeout -k 5 3300 python bench.py
+    echo "--- bench rc=$? ---"
+    if ! n=$(bench_fresh); then
+      echo "still incomplete ($n/5); retrying next iteration"
+      sleep 30
+      continue
+    fi
+  fi
+  # lease is grantable: spend the window on the remaining evidence
+  if ! fresh BENCH_PALLAS.json; then
+    echo "running pallas_micro"
+    timeout -k 5 1200 python benchmarks/pallas_micro.py
+    echo "--- pallas rc=$? ---"
+  fi
+  if ! fresh PROFILE_ONCHIP.json; then
+    echo "running profile_device"
+    timeout -k 5 1200 python benchmarks/profile_device.py
+    echo "--- profile rc=$? ---"
+  fi
+  if ! fresh BENCH_PRESSURE.json; then
+    echo "running pressure_onchip"
+    timeout -k 5 1800 python scripts/pressure_onchip.py
+    echo "--- pressure rc=$? ---"
+  fi
+  if fresh BENCH_PALLAS.json && fresh PROFILE_ONCHIP.json \
+      && fresh BENCH_PRESSURE.json; then
+    echo "all on-chip evidence captured; exiting"
+    exit 0
+  fi
+  sleep 30
+done
+echo "chip worker exhausted $MAX_ITERS iterations"
