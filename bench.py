@@ -440,7 +440,7 @@ def collect(r: "StageReader", end_at: float,
     first = True
     try:
         while True:
-            budget = min(TPU_PROBE_S if first else 150.0,
+            budget = min(TPU_PROBE_S if first else 240.0,
                          end_at - time.time())
             if first and reserve_s:
                 budget = min(budget,

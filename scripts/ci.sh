@@ -19,6 +19,7 @@ else
 fi
 
 echo "== tests (fast tier) =="
+T_TESTS=$SECONDS
 MARK="not slow"
 if [[ "${1:-}" == "--slow" ]]; then MARK=""; fi
 if [[ -n "$MARK" ]]; then
@@ -26,7 +27,10 @@ if [[ -n "$MARK" ]]; then
 else
     python -m pytest tests/ -q
 fi
+echo "== fast tier took $((SECONDS - T_TESTS))s =="
 
 echo "== multichip dryrun =="
+T_DRY=$SECONDS
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+echo "== dryrun took $((SECONDS - T_DRY))s; total $((SECONDS))s =="
 echo "CI OK"

@@ -83,6 +83,37 @@ def convert(meta: PlanMeta) -> ExecNode:
                     reorder = (list(range(n_r, n_r + n_l))
                                + list(range(n_r)))
 
+            if jt == "inner" and reorder is None and cond is None:
+                # build-side selection (Spark's planner picks the smaller
+                # side to build; the kernels here always build the RIGHT
+                # child): when the left side is clearly smaller, swap the
+                # children and reorder columns back afterwards.  Without
+                # this, dim.join(fact) builds the FACT side — at SF1 that
+                # pushed q19 through a 2.88M-row partitioned exchange
+                # instead of a small broadcast build.
+                lb = _estimate_plan_bytes(plan.children[0], meta.conf)
+                rb = _estimate_plan_bytes(plan.children[1], meta.conf)
+                if lb is not None and rb is not None and lb * 2 < rb:
+                    ls_f = plan_schema(plan.children[0], meta.conf)
+                    rs_f = plan_schema(plan.children[1], meta.conf)
+                    n_l, n_r = len(ls_f), len(rs_f)
+                    lc, rc = rc, lc
+                    lkeys, rkeys = rkeys, lkeys
+                    build_plan = plan.children[0]
+                    join_schema = _swapped_join_schema(plan, meta.conf)
+                    if plan.using:
+                        # swapped exec emits [R..., L...]; every output
+                        # left field (keys included — inner join, values
+                        # equal across sides) selects from the L block,
+                        # right non-using fields from the R block
+                        using_drop = []
+                        reorder = [n_r + i for i in range(n_l)] \
+                            + [i for i, f in enumerate(rs_f)
+                               if f.name not in plan.using]
+                    else:
+                        reorder = (list(range(n_r, n_r + n_l))
+                                   + list(range(n_r)))
+
             def wrap(node):
                 if reorder is None:
                     return node
