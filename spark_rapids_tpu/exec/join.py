@@ -77,9 +77,11 @@ def _row_equal(lcol: Column, bcol: Column, bidx):
 
 
 class TpuReorderColumnsExec(TpuExec):
-    """Column permutation pass-through: the right-outer join runs as a
-    side-swapped left join, and this puts the output columns back in the
-    logical plan's order (names come from the final schema)."""
+    """Column selection pass-through: side-swapped joins (right outer as
+    a swapped left join; build-side-selected inner joins) emit
+    [R..., L...], and this selects/reorders the output columns back to
+    the logical plan's order — for USING joins it also drops the
+    duplicated key columns (names come from the final schema)."""
 
     def __init__(self, child: ExecNode, perm: Sequence[int],
                  out_schema: Schema):

@@ -51,68 +51,47 @@ def convert(meta: PlanMeta) -> ExecNode:
             build_plan = plan.children[1]
             join_schema = out_schema
             reorder = None
+            build_bytes = None   # precomputed estimate, threaded below
             if jt in ("right", "right_outer"):
                 # right outer == left outer with the sides swapped BEFORE
                 # the variant dispatch (so broadcast/partitioned apply),
                 # columns reordered back afterwards (the reference has no
                 # right-outer device join, GpuHashJoin.scala:31-32;
-                # tagging admits only the residual-free case)
+                # tagging admits only the residual-free case).  USING key
+                # columns surface the RIGHT side's values (Spark's
+                # coalesced-key contract for a right-preserving join).
                 jt = "left"
                 lc, rc = rc, lc
                 lkeys, rkeys = rkeys, lkeys
                 cond = None
-                build_plan = plan.children[0]
-                ls_f = plan_schema(plan.children[0], meta.conf)
-                rs_f = plan_schema(plan.children[1], meta.conf)
-                n_l, n_r = len(ls_f), len(rs_f)
-                join_schema = _swapped_join_schema(plan, meta.conf)
-                if plan.using:
-                    # Spark's coalesced-key contract for right USING: the
-                    # key column surfaces the RIGHT side's value (every
-                    # output row preserves a right row).  The swapped exec
-                    # emits [R..., L...]; select key cols from the R block
-                    # into the left key positions and drop the rest of R's
-                    # using cols — the exec itself drops nothing.
-                    using_drop = []
-                    reorder = [rs_f.index_of(f.name) if f.name in plan.using
-                               else n_r + i
-                               for i, f in enumerate(ls_f)]
-                    reorder += [i for i, f in enumerate(rs_f)
-                                if f.name not in plan.using]
-                else:
-                    reorder = (list(range(n_r, n_r + n_l))
-                               + list(range(n_r)))
-
-            if jt == "inner" and reorder is None and cond is None:
+                build_plan, join_schema, using_drop, reorder = _swap_sides(
+                    plan, meta.conf, key_from_right=True)
+            elif jt == "inner" and cond is None \
+                    and "broadcast" not in getattr(plan.children[1],
+                                                   "_hints", ()):
                 # build-side selection (Spark's planner picks the smaller
                 # side to build; the kernels here always build the RIGHT
-                # child): when the left side is clearly smaller, swap the
-                # children and reorder columns back afterwards.  Without
-                # this, dim.join(fact) builds the FACT side — at SF1 that
-                # pushed q19 through a 2.88M-row partitioned exchange
-                # instead of a small broadcast build.
+                # child): when the left side is clearly smaller — or the
+                # user hinted broadcast on it — swap the children and
+                # reorder columns back afterwards.  Without this,
+                # dim.join(fact) builds the FACT side: at SF1 that pushed
+                # q19 through a 2.88M-row partitioned exchange instead of
+                # a small broadcast build.  An explicit broadcast hint on
+                # the RIGHT child suppresses the swap (the user chose the
+                # build side).
+                lhint = "broadcast" in getattr(plan.children[0],
+                                               "_hints", ())
                 lb = _estimate_plan_bytes(plan.children[0], meta.conf)
                 rb = _estimate_plan_bytes(plan.children[1], meta.conf)
-                if lb is not None and rb is not None and lb * 2 < rb:
-                    ls_f = plan_schema(plan.children[0], meta.conf)
-                    rs_f = plan_schema(plan.children[1], meta.conf)
-                    n_l, n_r = len(ls_f), len(rs_f)
+                if lhint or (lb is not None and rb is not None
+                             and lb * 2 < rb):
                     lc, rc = rc, lc
                     lkeys, rkeys = rkeys, lkeys
-                    build_plan = plan.children[0]
-                    join_schema = _swapped_join_schema(plan, meta.conf)
-                    if plan.using:
-                        # swapped exec emits [R..., L...]; every output
-                        # left field (keys included — inner join, values
-                        # equal across sides) selects from the L block,
-                        # right non-using fields from the R block
-                        using_drop = []
-                        reorder = [n_r + i for i in range(n_l)] \
-                            + [i for i, f in enumerate(rs_f)
-                               if f.name not in plan.using]
-                    else:
-                        reorder = (list(range(n_r, n_r + n_l))
-                                   + list(range(n_r)))
+                    build_plan, join_schema, using_drop, reorder = \
+                        _swap_sides(plan, meta.conf, key_from_right=False)
+                    build_bytes = lb
+                else:
+                    build_bytes = rb
 
             def wrap(node):
                 if reorder is None:
@@ -120,7 +99,8 @@ def convert(meta: PlanMeta) -> ExecNode:
                 from ..exec.join import TpuReorderColumnsExec
                 return TpuReorderColumnsExec(node, reorder, out_schema)
 
-            if (_should_broadcast_build(plan, meta.conf, build_plan)
+            if (_should_broadcast_build(plan, meta.conf, build_plan,
+                                        build_bytes)
                     and jt != "full"):
                 # full outer never broadcasts: the never-matched-build
                 # tail is emitted once per probe STREAM, so a replicated
@@ -130,7 +110,8 @@ def convert(meta: PlanMeta) -> ExecNode:
                 return wrap(TpuBroadcastHashJoinExec(
                     lc, TpuBroadcastExchangeExec(rc), jt, lkeys, rkeys,
                     cond, join_schema, using_drop))
-            if _should_partition_join(plan, meta.conf, build_plan):
+            if _should_partition_join(plan, meta.conf, build_plan,
+                                      build_bytes):
                 # EnsureRequirements analogue: hash-partition BOTH sides on
                 # the join keys so the single-build-batch requirement holds
                 # per partition (reference GpuShuffledHashJoinExec.scala:83-87)
@@ -288,29 +269,31 @@ def _estimate_plan_bytes(plan: L.LogicalPlan, conf):
     return rows * _schema_row_bytes(schema)
 
 
-def _should_partition_join(plan: "L.LogicalJoin", conf,
-                           build_plan=None) -> bool:
+def _should_partition_join(plan: "L.LogicalJoin", conf, build_plan=None,
+                           build_bytes=None) -> bool:
     """Partition a non-broadcast join when the build side is too big for
     (or of unknown size relative to) one bounded build batch.
-    `build_plan` overrides the default right child (side-swapped right
-    outer joins build the original LEFT)."""
+    `build_plan` overrides the default right child (side-swapped joins —
+    right outer, small-left inner — build the original LEFT);
+    `build_bytes` passes an estimate the caller already computed."""
     from .. import config as C
     if not conf.get(C.PARTITIONED_JOIN_ENABLED):
         return False
-    est = _estimate_plan_bytes(
+    est = build_bytes if build_bytes is not None else _estimate_plan_bytes(
         build_plan if build_plan is not None else plan.children[1], conf)
     threshold = conf.get(C.PARTITIONED_JOIN_THRESHOLD)
     return est is None or est > int(threshold)
 
 
-def _should_broadcast_build(plan: "L.LogicalJoin", conf,
-                            build_plan=None) -> bool:
+def _should_broadcast_build(plan: "L.LogicalJoin", conf, build_plan=None,
+                            build_bytes=None) -> bool:
     """Broadcast the build side when hinted or when its estimated size is
     under spark.sql.autoBroadcastJoinThreshold (Spark planning behavior;
     reference: GpuBroadcastHashJoinExec replaces Spark's
     BroadcastHashJoinExec when Spark already chose broadcast).
-    `build_plan` overrides the default right child (side-swapped right
-    outer joins build the original LEFT)."""
+    `build_plan` overrides the default right child (side-swapped joins —
+    right outer, small-left inner — build the original LEFT);
+    `build_bytes` passes an estimate the caller already computed."""
     from .. import config as C
     build = build_plan if build_plan is not None else plan.children[1]
     if "broadcast" in getattr(build, "_hints", ()):
@@ -318,14 +301,44 @@ def _should_broadcast_build(plan: "L.LogicalJoin", conf,
     threshold = conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
     if threshold is None or int(threshold) < 0:
         return False
-    est = _estimate_plan_bytes(build, conf)
+    est = build_bytes if build_bytes is not None \
+        else _estimate_plan_bytes(build, conf)
     return est is not None and est <= int(threshold)
 
 
+def _swap_sides(plan, conf, key_from_right: bool):
+    """Column bookkeeping for running a join with its children swapped
+    (the kernels always build the RIGHT child): the swapped exec emits
+    [R..., L...]; the returned `reorder` selects the logical
+    [L..., R-minus-USING] output.  `key_from_right` picks which block a
+    USING key column surfaces from: right-preserving outer joins must
+    surface the right side's values (Spark's coalesced-key contract);
+    inner joins take the left block (values equal across sides, null
+    keys never match).  Returns
+    (build_plan, join_schema, using_drop, reorder)."""
+    ls_f = plan_schema(plan.children[0], conf)
+    rs_f = plan_schema(plan.children[1], conf)
+    n_l, n_r = len(ls_f), len(rs_f)
+    join_schema = _swapped_join_schema(plan, conf)
+    if plan.using:
+        # the exec itself drops nothing; the reorder both selects and
+        # drops the duplicated USING columns
+        if key_from_right:
+            reorder = [rs_f.index_of(f.name) if f.name in plan.using
+                       else n_r + i for i, f in enumerate(ls_f)]
+        else:
+            reorder = [n_r + i for i in range(n_l)]
+        reorder += [i for i, f in enumerate(rs_f)
+                    if f.name not in plan.using]
+    else:
+        reorder = list(range(n_r, n_r + n_l)) + list(range(n_r))
+    return plan.children[0], join_schema, [], reorder
+
+
 def _swapped_join_schema(plan, conf):
-    """Output schema of the side-swapped right-outer inner join: the
-    original RIGHT fields first, original LEFT fields renamed on
-    collision — the same rename rule the join kernels apply, from the
+    """Output schema of a side-swapped join (right outer, small-left
+    inner): the original RIGHT fields first, original LEFT fields renamed
+    on collision — the same rename rule the join kernels apply, from the
     swapped perspective."""
     from ..exec.join import TpuHashJoinExec
     from ..types import Schema
