@@ -122,32 +122,110 @@ class ProcCluster:
     """
 
     def __init__(self, n_workers: int, conf: Optional[dict] = None,
-                 cpu: bool = True, ready_timeout: float = 120.0):
+                 cpu: bool = True, ready_timeout: float = 120.0,
+                 max_task_retries: int = 1):
         from .shuffle.net import SocketTransport
         self.conf = dict(conf or {})
-        conf_env = json.dumps(self.conf)
+        self._conf_env = json.dumps(self.conf)
+        self._cpu = cpu
+        self._ready_timeout = ready_timeout
+        self.max_task_retries = max_task_retries
         self.workers: List[WorkerProc] = []
         try:
             for i in range(n_workers):
-                self.workers.append(WorkerProc(f"exec-{i}", conf_env, cpu,
-                                               ready_timeout))
+                self.workers.append(WorkerProc(f"exec-{i}", self._conf_env,
+                                               cpu, ready_timeout))
         except Exception:
             self.shutdown()
             raise
         # driver-side transport: client factory only (no server)
         self._transport = SocketTransport()
+        self._sid = 0
+        self._lock = threading.Lock()
+        self.task_retries = 0   # observability: recoveries this cluster
+        self._publish_peers()
+
+    def _publish_peers(self) -> None:
         peers = {w.executor_id: list(w.address) for w in self.workers}
         self._transport.set_peers(peers)
         for w in self.workers:
-            w.client = self._transport.make_client(w.executor_id)
+            if w.client is None:
+                w.client = self._transport.make_client(w.executor_id)
             w.rpc("set_peers", peers=peers)
-        self._sid = 0
-        self._lock = threading.Lock()
+
+    def _replace_worker(self, i: int) -> "WorkerProc":
+        """Executor-loss recovery (the Spark task-retry / lineage analogue:
+        the logical map fragment IS the lineage, recomputed on a fresh
+        worker).  Spawns a replacement under the SAME executor id, rewires
+        every peer map, and returns it."""
+        old = self.workers[i]
+        try:
+            old.stop(grace_s=1.0)
+        except Exception:  # noqa: BLE001 — it is already gone
+            pass
+        fresh = WorkerProc(old.executor_id, self._conf_env, self._cpu,
+                           self._ready_timeout)
+        self.workers[i] = fresh
+        # the dead worker's client holds a broken socket; drop it and
+        # re-point the peer map at the replacement BEFORE dialing
+        self._transport.drop_client(old.executor_id)
+        self._transport.set_peers(
+            {fresh.executor_id: list(fresh.address)})
+        fresh.client = self._transport.make_client(fresh.executor_id)
+        self._publish_peers()
+        self.task_retries += 1
+        return fresh
 
     def new_shuffle_id(self) -> int:
         with self._lock:
             self._sid += 1
             return self._sid
+
+    def _run_tasks_with_retry(self, stage: str, attempt, store,
+                              on_replace=None) -> None:
+        """Run task i on worker i for every worker, in parallel; on
+        failure, recover and retry up to `max_task_retries` times.
+
+        Recovery (Spark's task-retry + executor-loss handling, absorbed
+        into one mechanism): a DEAD worker is replaced by a fresh process
+        under the same executor id (peers rewired) and `on_replace(i)`
+        regenerates whatever worker-local state the stage depends on (the
+        reduce stage re-runs the lost map fragment — the logical plan is
+        the lineage); a worker that is alive but errored (e.g. its fetch
+        raced a peer's death) just re-runs its task after replacements
+        settle."""
+
+        def wave(indices):
+            errs = {}
+
+            def one(i):
+                try:
+                    store(i, attempt(i))
+                except Exception as e:  # noqa: BLE001 — retried/re-raised
+                    errs[i] = e
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in indices]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return errs
+
+        errs = wave(range(len(self.workers)))
+        tries = 0
+        while errs and tries < self.max_task_retries:
+            tries += 1
+            for i in sorted(errs):
+                if self.workers[i].proc.poll() is not None:
+                    self._replace_worker(i)
+                    if on_replace is not None:
+                        on_replace(i)
+            errs = wave(sorted(errs))
+        if errs:
+            i, e = next(iter(sorted(errs.items())))
+            raise RuntimeError(
+                f"{stage} task {i} failed after "
+                f"{self.max_task_retries} retries") from e
 
     def run_map_reduce(self, map_plans: Sequence, key_names: List[str],
                        n_parts: int, reduce_plan):
@@ -163,52 +241,38 @@ class ProcCluster:
             "one map fragment per worker"
         sid = self.new_shuffle_id()
         map_stats: List[dict] = [None] * len(self.workers)
-        errors: List[Exception] = []
 
-        def run_map(i: int, w: WorkerProc):
-            try:
-                map_stats[i] = w.rpc(
-                    "run_map", sid=sid,
-                    plan_blob=pickle.dumps(map_plans[i]),
-                    key_names=list(key_names), n_parts=n_parts)
-            except Exception as e:  # noqa: BLE001 — re-raised below
-                errors.append(e)
+        def _attempt_map(i: int) -> dict:
+            return self.workers[i].rpc(
+                "run_map", sid=sid,
+                plan_blob=pickle.dumps(map_plans[i]),
+                key_names=list(key_names), n_parts=n_parts)
 
-        threads = [threading.Thread(target=run_map, args=(i, w))
-                   for i, w in enumerate(self.workers)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise errors[0]
+        self._run_tasks_with_retry(
+            "map", _attempt_map,
+            lambda i, out: map_stats.__setitem__(i, out))
 
         reduce_blob = pickle.dumps(reduce_plan)
         results: List[Optional[bytes]] = [None] * len(self.workers)
 
-        def run_reduce(i: int, w: WorkerProc):
+        def _attempt_reduce(i: int) -> bytes:
             parts = [p for p in range(n_parts)
                      if p % len(self.workers) == i]
-            try:
-                results[i] = w.rpc("run_reduce", sid=sid,
-                                   partitions=parts,
-                                   plan_blob=reduce_blob)
-            except Exception as e:  # noqa: BLE001 — re-raised below
-                errors.append(e)
+            return self.workers[i].rpc("run_reduce", sid=sid,
+                                       partitions=parts,
+                                       plan_blob=reduce_blob)
 
-        threads = [threading.Thread(target=run_reduce, args=(i, w))
-                   for i, w in enumerate(self.workers)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        self._run_tasks_with_retry(
+            "reduce", _attempt_reduce,
+            lambda i, out: results.__setitem__(i, out),
+            # a replaced worker lost its map outputs with the process;
+            # the map fragment (the lineage) recomputes them first
+            on_replace=lambda i: map_stats.__setitem__(i, _attempt_map(i)))
         for w in self.workers:
             try:
                 w.rpc("remove_shuffle", sid=sid)
             except Exception:  # noqa: BLE001 — cleanup best-effort
                 pass
-        if errors:
-            raise errors[0]
 
         tables = []
         for blob in results:

@@ -450,8 +450,18 @@ class SocketTransport(ShuffleTransport):
         self._peers[executor_id] = self.address
 
     def set_peers(self, peers: Dict[str, Tuple[str, int]]) -> None:
+        stale = []
         with self._lock:
-            self._peers.update({k: tuple(v) for k, v in peers.items()})
+            for k, v in peers.items():
+                addr = tuple(v)
+                if self._peers.get(k) not in (None, addr):
+                    # peer re-addressed (executor-loss replacement): any
+                    # cached client holds a socket to the DEAD process
+                    stale.append(self._clients.pop(k, None))
+                self._peers[k] = addr
+        for client in stale:
+            if client is not None:
+                client.close()
 
     def make_client(self, peer_executor_id: str) -> SocketClient:
         with self._lock:
@@ -465,6 +475,15 @@ class SocketTransport(ShuffleTransport):
                 client = SocketClient(self, addr)
                 self._clients[peer_executor_id] = client
             return client
+
+    def drop_client(self, peer_executor_id: str) -> None:
+        """Forget a peer's cached client (executor-loss recovery: the
+        replacement worker listens on a NEW port; the stale client holds
+        a socket to the dead one)."""
+        with self._lock:
+            client = self._clients.pop(peer_executor_id, None)
+        if client is not None:
+            client.close()
 
     def shutdown(self) -> None:
         for c in list(self._clients.values()):

@@ -174,3 +174,112 @@ def test_proc_cluster_tpch_q1(tmp_path):
               "count_order"]:
         np.testing.assert_allclose(res[c].to_numpy(), exp[c].to_numpy(),
                                    rtol=1e-9)
+
+
+@pytest.mark.slow
+def test_proc_cluster_worker_loss_recovery(tmp_path):
+    """Executor-loss recovery (the Spark task-retry / lineage analogue,
+    SURVEY §5 failure detection): SIGKILL one worker BETWEEN stages; the
+    driver replaces it, re-runs its map fragment on the replacement (the
+    logical plan is the lineage), rewires every peer, and the query still
+    matches the oracle."""
+    from spark_rapids_tpu.cluster import ProcCluster
+    files, _ = _lineitem_files(str(tmp_path))
+    session = TpuSession()
+
+    def map_plan(my_files):
+        return (session.read.parquet(*my_files)
+                .filter(col("l_shipdate") <= D_19980902)
+                .select(*[col(c) for c in Q1_COLS])).plan
+
+    n_workers = 2
+    map_plans = [map_plan(files[i::n_workers]) for i in range(n_workers)]
+    map_schema = DataFrame(session, map_plans[0]).schema
+    reduce_plan = _q1_shape(
+        DataFrame(session, L.LogicalPlaceholder(map_schema))).plan
+
+    cluster = ProcCluster(n_workers, conf={}, cpu=True,
+                          max_task_retries=2)
+    try:
+        # run once cleanly so the workers have warm kernels, then KILL
+        # worker 0 (CPU worker: SIGKILL is safe) and run again
+        result0, _ = cluster.run_map_reduce(
+            map_plans, ["l_returnflag", "l_linestatus"], 4, reduce_plan)
+        cluster.workers[0].proc.kill()
+        cluster.workers[0].proc.wait(timeout=10)
+        result, map_stats = cluster.run_map_reduce(
+            map_plans, ["l_returnflag", "l_linestatus"], 4, reduce_plan)
+        assert cluster.task_retries >= 1, "no worker replacement happened"
+    finally:
+        cluster.shutdown()
+    assert all(s and s["written_rows"] for s in map_stats)
+
+    oracle = _q1_shape(
+        session.read.parquet(*files)
+        .filter(col("l_shipdate") <= D_19980902)
+        .select(*[col(c) for c in Q1_COLS])).to_arrow()
+    res = result.to_pandas().sort_values(
+        ["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    exp = oracle.to_pandas().sort_values(
+        ["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    assert len(res) == len(exp) and len(res) == 6
+    for c in ["sum_qty", "sum_disc_price", "sum_charge", "avg_disc",
+              "count_order"]:
+        np.testing.assert_allclose(res[c].to_numpy(), exp[c].to_numpy(),
+                                   rtol=1e-9)
+
+
+@pytest.mark.slow
+def test_proc_cluster_worker_loss_mid_reduce(tmp_path):
+    """Kill a worker AFTER its map completed but before reduce: the
+    reducers must refetch from the replacement, whose map outputs are
+    recomputed from the lineage (on_replace re-runs the map fragment)."""
+    from spark_rapids_tpu import cluster as cluster_mod
+    from spark_rapids_tpu.cluster import ProcCluster
+    files, _ = _lineitem_files(str(tmp_path))
+    session = TpuSession()
+
+    def map_plan(my_files):
+        return (session.read.parquet(*my_files)
+                .filter(col("l_shipdate") <= D_19980902)
+                .select(*[col(c) for c in Q1_COLS])).plan
+
+    n_workers = 2
+    map_plans = [map_plan(files[i::n_workers]) for i in range(n_workers)]
+    map_schema = DataFrame(session, map_plans[0]).schema
+    reduce_plan = _q1_shape(
+        DataFrame(session, L.LogicalPlaceholder(map_schema))).plan
+
+    cluster = ProcCluster(n_workers, conf={}, cpu=True,
+                          max_task_retries=2)
+    orig = ProcCluster._run_tasks_with_retry
+    state = {"killed": False}
+
+    def sabotage(self, stage, attempt, store, on_replace=None):
+        if stage == "reduce" and not state["killed"]:
+            state["killed"] = True
+            self.workers[1].proc.kill()
+            self.workers[1].proc.wait(timeout=10)
+        return orig(self, stage, attempt, store, on_replace)
+
+    cluster_mod.ProcCluster._run_tasks_with_retry = sabotage
+    try:
+        result, map_stats = cluster.run_map_reduce(
+            map_plans, ["l_returnflag", "l_linestatus"], 4, reduce_plan)
+        assert cluster.task_retries >= 1
+    finally:
+        cluster_mod.ProcCluster._run_tasks_with_retry = orig
+        cluster.shutdown()
+
+    oracle = _q1_shape(
+        session.read.parquet(*files)
+        .filter(col("l_shipdate") <= D_19980902)
+        .select(*[col(c) for c in Q1_COLS])).to_arrow()
+    res = result.to_pandas().sort_values(
+        ["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    exp = oracle.to_pandas().sort_values(
+        ["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    assert len(res) == len(exp)
+    for c in ["sum_qty", "count_order"]:
+        np.testing.assert_allclose(res[c].to_numpy(), exp[c].to_numpy(),
+                                   rtol=1e-9)
