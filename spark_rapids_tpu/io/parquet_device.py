@@ -774,6 +774,24 @@ def _decomp_pool():
     return _DECOMP_POOL
 
 
+_COLUMN_POOL = None
+
+
+def _column_pool():
+    """Thread pool for whole-COLUMN decode tasks.  Distinct from
+    _decomp_pool on purpose: a column task blocks on its decompression
+    range tasks, so sharing one pool would deadlock once every worker
+    holds a column task."""
+    global _COLUMN_POOL
+    if _COLUMN_POOL is None:
+        import os
+        from concurrent.futures import ThreadPoolExecutor
+        _COLUMN_POOL = ThreadPoolExecutor(
+            max_workers=min(8, os.cpu_count() or 1),
+            thread_name_prefix="pq-column")
+    return _COLUMN_POOL
+
+
 def _pages_from_table(raw: bytes, pages: dict, codec: str, num_rows: int,
                       max_def: int):
     """Native page table (native.pq_page_walk) -> (value_pieces,

@@ -696,9 +696,11 @@ def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
             cap = bucket_rows(max(num_rows, 1))
             out_cols: dict = {}
             host_names: List[str] = []
-            for f in schema:
-                if f.name in part_names or f.name not in name_to_leaf:
-                    continue
+
+            def _decode_field(f):
+                """-> (name, Column | None, 'unsupported'|'error'|None);
+                runs on the column pool — each column's host control
+                plane (header walk, decompress, RLE) is independent."""
                 ci = name_to_leaf[f.name]
                 max_def = pf.schema.column(ci).max_definition_level
                 try:
@@ -706,50 +708,67 @@ def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
                     for rg in chunk:
                         rgm = pf.metadata.row_group(rg)
                         rg_cols.append((decode_column_chunk(
-                            path, rgm.column(ci), rgm.column(ci).physical_type,
+                            path, rgm.column(ci),
+                            rgm.column(ci).physical_type,
                             f.dtype, rgm.num_rows, max_def,
                             bucket_rows(max(rgm.num_rows, 1))),
                             rgm.num_rows))
                     if len(rg_cols) == 1 \
                             and int(rg_cols[0][0].data.shape[0]) == cap:
-                        # single-row-group chunk at matching capacity (the
-                        # common layout: writer row groups ~= reader chunk
-                        # budget): the decoded column IS the batch column —
-                        # skip the zero-init + 2-3 range-copy dispatches
-                        out_cols[f.name] = rg_cols[0][0]
+                        # single-row-group chunk at matching capacity
+                        # (the common layout: writer row groups ~= reader
+                        # chunk budget): the decoded column IS the batch
+                        # column — skip the zero-init + range copies
+                        return f.name, rg_cols[0][0], None
+                    if f.dtype.is_string:
+                        width = max(c.max_len for c, _ in rg_cols)
+                        rg_cols = [(c.pad_strings_to(width), nr)
+                                   for c, nr in rg_cols]
+                        data = jnp.zeros((cap, width), dtype=jnp.uint8)
+                        lengths = jnp.zeros(cap, dtype=jnp.int32)
                     else:
-                        if f.dtype.is_string:
-                            width = max(c.max_len for c, _ in rg_cols)
-                            rg_cols = [(c.pad_strings_to(width), nr)
-                                       for c, nr in rg_cols]
-                            data = jnp.zeros((cap, width), dtype=jnp.uint8)
-                            lengths = jnp.zeros(cap, dtype=jnp.int32)
-                        else:
-                            data = jnp.zeros(cap,
-                                             dtype=rg_cols[0][0].data.dtype)
-                            lengths = None
-                        valid = jnp.zeros(cap, dtype=jnp.bool_)
-                        off = 0
-                        for col, nr in rg_cols:
-                            data = _copy_range(data, col.data, off, nr)
-                            valid = _copy_range(valid, col.valid, off, nr)
-                            if lengths is not None:
-                                lengths = _copy_range(lengths, col.lengths,
-                                                      off, nr)
-                            off += nr
-                        out_cols[f.name] = Column(data, valid, f.dtype,
-                                                  lengths)
-                    if metrics is not None:
-                        metrics.add("numDeviceDecodedColumns", 1)
+                        data = jnp.zeros(cap,
+                                         dtype=rg_cols[0][0].data.dtype)
+                        lengths = None
+                    valid = jnp.zeros(cap, dtype=jnp.bool_)
+                    off = 0
+                    for col, nr in rg_cols:
+                        data = _copy_range(data, col.data, off, nr)
+                        valid = _copy_range(valid, col.valid, off, nr)
+                        if lengths is not None:
+                            lengths = _copy_range(lengths, col.lengths,
+                                                  off, nr)
+                        off += nr
+                    return f.name, Column(data, valid, f.dtype,
+                                          lengths), None
                 except DeviceDecodeUnsupported:
-                    host_names.append(f.name)
+                    return f.name, None, "unsupported"
                 except Exception:
                     # the hand-rolled page/run parsers must never be able
                     # to fail a query the pyarrow path could read: ANY
                     # other error also falls back, column-granular
+                    return f.name, None, "error"
+
+            fields = [f for f in schema
+                      if f.name not in part_names and f.name in name_to_leaf]
+            if len(fields) > 1:
+                # column-parallel decode: the per-column host work
+                # (thrift walk, decompression dispatch, RLE) overlaps
+                # across the pool the way the reference's multithreaded
+                # reader overlaps per-column device decode
+                from .parquet_device import _column_pool
+                results = list(_column_pool().map(_decode_field, fields))
+            else:
+                results = [_decode_field(f) for f in fields]
+            for name, colv, err in results:
+                if colv is not None:
+                    out_cols[name] = colv
                     if metrics is not None:
+                        metrics.add("numDeviceDecodedColumns", 1)
+                else:
+                    if err == "error" and metrics is not None:
                         metrics.add("numDeviceDecodeErrors", 1)
-                    host_names.append(f.name)
+                    host_names.append(name)
             if host_names:
                 table = pf.read_row_groups(chunk, columns=host_names)
                 host_batch = ColumnarBatch.from_arrow(
