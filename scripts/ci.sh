@@ -29,7 +29,9 @@ if [[ "${1:-}" == "--parallel" ]]; then
     # per-process compiled-executable count far below the XLA:CPU
     # segfault threshold the conftest cache-clears guard against.
     N="${2:-6}"
-    mapfile -t FILES < <(ls tests/test_*.py)
+    # size-descending order before round-robin: file size tracks test
+    # count/cost well enough to spread the heavy suites across shards
+    mapfile -t FILES < <(ls -S tests/test_*.py)
     pids=()
     for ((i = 0; i < N; i++)); do
         shard=()
