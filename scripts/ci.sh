@@ -24,10 +24,13 @@ MARK="not slow"
 if [[ "${1:-}" == "--slow" ]]; then MARK=""; fi
 if [[ "${1:-}" == "--parallel" ]]; then
     # file-sharded concurrent pytest: the fast tier is XLA:CPU
-    # compile-bound (~30 CPU-minutes), so N processes cut wall clock
-    # ~N-fold.  Each shard holds ~1/N of the tests, which keeps the
-    # per-process compiled-executable count far below the XLA:CPU
-    # segfault threshold the conftest cache-clears guard against.
+    # compile-bound (~30 CPU-minutes), so on a multi-core host N
+    # processes cut wall clock ~N-fold.  (The round-5 build image
+    # exposes ONE core — os.cpu_count()==1 — so there this mode only
+    # interleaves; the ~30min floor is single-core compile time.)
+    # Each shard holds ~1/N of the tests, which keeps the per-process
+    # compiled-executable count far below the XLA:CPU segfault
+    # threshold the conftest cache-clears guard against.
     N="${2:-6}"
     # size-descending order before round-robin: file size tracks test
     # count/cost well enough to spread the heavy suites across shards
