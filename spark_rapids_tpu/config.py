@@ -177,6 +177,12 @@ WHOLE_STAGE_ENABLED = _conf(
     "work vmapped, partials merged in-program) — the TPU analogue of "
     "whole-stage codegen; one dispatch instead of O(batches), which is "
     "what high host-link latency punishes.", _to_bool)
+SCAN_PREFETCH_DEPTH = _conf(
+    "spark.rapids.sql.tpu.scan.prefetchDepth", 1,
+    "Chunks of device file-scan decode kept ready ahead of the consumer "
+    "by a background thread (the reference's MULTITHREADED reader mode): "
+    "chunk N+1's host control plane overlaps chunk N's H2D transfer. "
+    "0 disables.", int)
 COMPILATION_CACHE_DIR = _conf(
     "spark.rapids.sql.tpu.compilationCache.dir",
     "/tmp/spark_rapids_tpu_xla_cache",

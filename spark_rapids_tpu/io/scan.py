@@ -795,8 +795,8 @@ def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
                     out_cols[f.name] = Column.from_numpy(
                         vals, vd, f.dtype, capacity=cap)
             sel = jnp.arange(cap, dtype=jnp.int32) < num_rows
-            yield ColumnarBatch([out_cols[f.name] for f in schema], sel,
-                                schema)
+            yield (ColumnarBatch([out_cols[f.name] for f in schema], sel,
+                                 schema), num_rows)
 
 
 class TpuFileScanExec(TpuExec):
@@ -862,10 +862,20 @@ class TpuFileScanExec(TpuExec):
         if self.fmt == "parquet" \
                 and ctx.conf.get(C.PARQUET_DEVICE_DECODE) \
                 and not ctx.conf.get(C.PARQUET_DEBUG_DUMP_PREFIX):
-            for batch in _device_parquet_batches(
-                    self.files, self._schema, self.options, ctx.conf,
-                    self.metrics):
-                self.metrics.add("numOutputRows", batch.num_rows_host())
+            it = _device_parquet_batches(
+                self.files, self._schema, self.options, ctx.conf,
+                self.metrics)
+            depth = int(ctx.conf.get(C.SCAN_PREFETCH_DEPTH))
+            if depth > 0:
+                # decode chunk N+1's host control plane while the device
+                # consumes chunk N (the reference's MULTITHREADED reader;
+                # on a tunneled chip the H2D transfer dominates and
+                # pipelines against the next chunk's decode)
+                from ..utils.prefetch import PrefetchIterator
+                it = PrefetchIterator(it, depth)
+            for batch, nrows in it:
+                # nrows comes from file metadata — never a device sync
+                self.metrics.add("numOutputRows", nrows)
                 self.metrics.add("numOutputBatches", 1)
                 yield batch
             return

@@ -1,0 +1,56 @@
+"""Background-thread iterator prefetch (the reference's multithreaded
+reader, GpuParquetScan's MULTITHREADED/COALESCING reader modes, reduced
+to its TPU-relevant core): produce the NEXT chunk's host-side decode
+while the device consumes the current one.  On a tunneled chip the H2D
+transfer dominates the scan — overlapping it with the next chunk's
+control-plane work pipelines the two instead of summing them.
+
+jax is thread-compatible for this use: device_put/eager dispatches from
+the producer thread enqueue on the same stream the consumer later
+blocks on."""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, TypeVar
+
+T = TypeVar("T")
+
+_STOP = object()
+
+
+class PrefetchIterator:
+    """Wraps an iterator; a daemon thread keeps up to `depth` items
+    decoded ahead.  Exceptions re-raise at the consumer in order."""
+
+    def __init__(self, it: Iterator[T], depth: int = 1):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._consumed = False
+
+        def pump():
+            try:
+                for item in it:
+                    self._q.put((item, None))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                self._q.put((None, e))
+                return
+            self._q.put((_STOP, None))
+
+        self._thread = threading.Thread(target=pump, daemon=True,
+                                        name="scan-prefetch")
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> T:
+        if self._consumed:
+            raise StopIteration
+        item, err = self._q.get()
+        if err is not None:
+            self._consumed = True
+            raise err
+        if item is _STOP:
+            self._consumed = True
+            raise StopIteration
+        return item
