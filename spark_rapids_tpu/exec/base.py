@@ -21,10 +21,28 @@ class Metrics:
     """SQLMetric equivalent (reference: GpuExec.scala:24-41)."""
 
     def __init__(self):
-        self.values: Dict[str, float] = {}
+        self._values: Dict[str, float] = {}
+        self._lazy: Dict[str, list] = {}
 
     def add(self, name: str, v: float):
-        self.values[name] = self.values.get(name, 0) + v
+        self._values[name] = self._values.get(name, 0) + v
+
+    def add_lazy(self, name: str, traced_scalar):
+        """Accumulate a DEVICE scalar without syncing: row counts inside
+        streaming hot loops are data-dependent, and an int() per batch is
+        a device round trip (a tunnel RTT on chip).  Deferred scalars
+        resolve in one sweep when the metrics are read."""
+        self._lazy.setdefault(name, []).append(traced_scalar)
+
+    @property
+    def values(self) -> Dict[str, float]:
+        """Metric dict with every deferred device scalar folded in (the
+        fold syncs; readers are reporting paths, never hot loops)."""
+        for name, pend in self._lazy.items():
+            if pend:
+                self.add(name, float(sum(int(x) for x in pend)))
+                pend.clear()
+        return self._values
 
     def timer(self, name: str):
         return _Timer(self, name)

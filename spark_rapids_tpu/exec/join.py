@@ -379,7 +379,9 @@ class TpuHashJoinExec(TpuExec):
                         b_hit_accum = b_hit if b_hit_accum is None \
                             else b_hit_accum | b_hit
             self.metrics.add("numOutputBatches", 1)
-            self.metrics.add("numOutputRows", out.num_rows_host())
+            # deferred: an int() here is a device sync PER OUTPUT BATCH
+            # (a tunnel round trip on chip) in the join hot loop
+            self.metrics.add_lazy("numOutputRows", out.num_rows())
             yield out
         if self.join_type == "full":
             if b_hit_accum is None:
