@@ -358,12 +358,17 @@ class OrcFileInfo:
                 self.stream_body(si, cid, _DATA))
 
 
-def _null_expand(compact: np.ndarray, valid_cap: np.ndarray, cap: int):
+def _null_expand(compact: np.ndarray, valid_cap: np.ndarray, cap: int,
+                 no_nulls: bool = False):
     """Shared compact->row-position expansion (cumsum+gather, no scatter);
-    one cached kernel per (cap, dtype)."""
+    one cached kernel per (cap, dtype).  `no_nulls` skips the kernel when
+    every live row is valid (compact already IS the row layout)."""
     import jax.numpy as jnp
 
     from ..utils.kernel_cache import cached_kernel
+
+    if no_nulls:
+        return jnp.asarray(compact)
 
     def build():
         def k(compact_v, valid_v):
@@ -404,7 +409,7 @@ def decode_float_column(info: OrcFileInfo, si: int, name: str, dtype,
     compact[:nonnull] = vals
     valid_cap = np.zeros(cap, bool)
     valid_cap[:rows] = valid
-    data = _null_expand(compact, valid_cap, cap)
+    data = _null_expand(compact, valid_cap, cap, nonnull == rows)
     return Column(data.astype(dtype.jnp_dtype), jnp.asarray(valid_cap),
                   dtype)
 
@@ -606,10 +611,24 @@ def _rlev2_device_values(data_raw: bytes, count: int, out_cap: int,
     inputs are padded to power-of-two buckets so the compiled kernel is
     shared across stripes/files (padding rows carry width 0 -> value 0 and
     dest out_cap -> dropped by the scatter's OOB mode)."""
+    import jax
     import jax.numpy as jnp
 
     from ..columnar.batch import bucket_rows
     from ..utils.kernel_cache import cached_kernel
+
+    if jax.default_backend() == "cpu":
+        # host fast path: the native decoder produces the final int64
+        # values in one call — on the CPU backend the device
+        # bit-extraction kernel is just overhead.  On a real chip the
+        # device path stays the default: packed DIRECT payloads cross
+        # the link as bits, not 8B values.
+        from ..native import orc_rlev2_decode
+        vals = orc_rlev2_decode(data_raw, count, signed)
+        if vals is not None:
+            compact = np.zeros(out_cap, np.int64)
+            compact[:count] = vals
+            return jnp.asarray(compact)
 
     host_vals, direct, based = rlev2_runs(data_raw, count, signed)
     n_direct = sum(ln for (_w, _o, ln, _d) in direct) \
@@ -712,7 +731,7 @@ def decode_int_column(info: OrcFileInfo, si: int, name: str, dtype,
     compact = _rlev2_device_values(data_raw, nonnull, cap, signed=True)
     valid_cap = np.zeros(cap, bool)
     valid_cap[:rows] = valid
-    data = _null_expand(compact, valid_cap, cap)
+    data = _null_expand(compact, valid_cap, cap, nonnull == rows)
     return Column(data.astype(dtype.jnp_dtype), jnp.asarray(valid_cap),
                   dtype)
 
@@ -899,7 +918,7 @@ def decode_byte_column(info: OrcFileInfo, si: int, name: str, dtype,
     compact[:nonnull] = vals
     valid_cap = np.zeros(cap, bool)
     valid_cap[:rows] = valid
-    data = _null_expand(compact, valid_cap, cap)
+    data = _null_expand(compact, valid_cap, cap, nonnull == rows)
     return Column(data.astype(dtype.jnp_dtype), jnp.asarray(valid_cap),
                   dtype)
 
@@ -923,7 +942,7 @@ def decode_bool_column(info: OrcFileInfo, si: int, name: str, dtype,
     compact[:nonnull] = bits[:nonnull]
     valid_cap = np.zeros(cap, bool)
     valid_cap[:rows] = valid
-    data = _null_expand(compact, valid_cap, cap)
+    data = _null_expand(compact, valid_cap, cap, nonnull == rows)
     return Column(data, jnp.asarray(valid_cap), dtype)
 
 

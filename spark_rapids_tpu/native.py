@@ -97,6 +97,10 @@ def get_lib():
         lib.pq_def_levels.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                       ctypes.c_int32, ctypes.c_int64,
                                       ctypes.c_int32, ctypes.c_void_p]
+        lib.orc_rlev2_decode.restype = ctypes.c_int64
+        lib.orc_rlev2_decode.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_int64, ctypes.c_int32,
+                                         ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -324,6 +328,19 @@ def pq_def_levels(payload: bytes, bit_width: int, n_values: int,
     nn = lib.pq_def_levels(payload, len(payload), bit_width, n_values,
                            max_def, valid_out.ctypes.data + base)
     return None if nn < 0 else int(nn)
+
+
+def orc_rlev2_decode(body: bytes, n_values: int, signed: bool):
+    """ORC RLEv2 stream (all four sub-encodings) -> int64[n_values], or
+    None when the native library is unavailable or the stream is
+    malformed (caller runs the python walk)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = np.empty(n_values, np.int64)
+    consumed = lib.orc_rlev2_decode(body, len(body), n_values,
+                                    1 if signed else 0, out.ctypes.data)
+    return out if consumed >= 0 else None
 
 
 def pq_byte_array_scan(data: np.ndarray, n_values: int):
