@@ -148,14 +148,32 @@ def _random_join(rng, session, df, cols, seed):
         return df, cols
     key = rng.choice(keyable)
     ktype = cols[key]
+    if rng.random() < 0.33:
+        # USING join (shared column name), right included — exercises the
+        # coalesced-key reorder and the build-side swap paths
+        data, schema = gen_table(seed ^ 0x05ED, rng.randint(5, 80),
+                                 **{key: ktype, "jv": T.LongType})
+        dim = session.from_pydict(data, schema)
+        how = rng.choice(["inner", "left", "right", "left_semi",
+                          "left_anti"])
+        joined = df.join(dim, on=key, how=how)
+        if how in ("left_semi", "left_anti"):
+            return joined, cols
+        return joined, {**cols, "jv": T.LongType}
+    # FRESH column names per join: stacking two joins that both emit a
+    # literal "jk" produces a duplicate-name schema whose collect order
+    # is ambiguous — the engines legitimately disagree there, so the
+    # oracle comparison would be ill-defined (found by seed 130)
+    jk = _fresh(rng, cols, "jk")
+    jv = _fresh(rng, {**cols, jk: None}, "jv")
     data, schema = gen_table(seed ^ 0x5EED, rng.randint(5, 80),
-                             jk=ktype, jv=T.LongType)
+                             **{jk: ktype, jv: T.LongType})
     dim = session.from_pydict(data, schema)
-    how = rng.choice(["inner", "left", "left_semi", "left_anti"])
-    joined = df.join(dim, on=col(key) == col("jk"), how=how)
+    how = rng.choice(["inner", "left", "right", "left_semi", "left_anti"])
+    joined = df.join(dim, on=col(key) == col(jk), how=how)
     if how in ("left_semi", "left_anti"):
         return joined, cols
-    return joined, {**cols, "jk": ktype, "jv": T.LongType}
+    return joined, {**cols, jk: ktype, jv: T.LongType}
 
 
 def _build_query(session, seed: int):
