@@ -409,6 +409,10 @@ static void tr_skip(TR& t, int ft) {
       break;
     case 8: {
       uint64_t n = tr_varint(t);
+      if (n > (uint64_t)t.len) {  // unvalidated add could wrap pos
+        t.err = true;             // negative and defeat bounds checks
+        break;
+      }
       t.pos += (int64_t)n;
       break;
     }

@@ -151,7 +151,14 @@ class ProcCluster:
         for w in self.workers:
             if w.client is None:
                 w.client = self._transport.make_client(w.executor_id)
-            w.rpc("set_peers", peers=peers)
+            try:
+                w.rpc("set_peers", peers=peers)
+            except Exception:  # noqa: BLE001 — a peer that is ALSO dead
+                # (multi-worker loss) gets replaced by its own recovery
+                # iteration, which re-publishes to everyone; failing the
+                # whole recovery on ITS broken socket would burn the
+                # retry budget before the second replacement happens
+                pass
 
     def _replace_worker(self, i: int) -> "WorkerProc":
         """Executor-loss recovery (the Spark task-retry / lineage analogue:

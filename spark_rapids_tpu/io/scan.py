@@ -662,19 +662,18 @@ def _device_parquet_batches(files, schema: Schema, options: dict, conf,
     partitions = options.get("__partitions__") or {}
     part_names = {n for vals in partitions.values() for n in vals}
 
-    from ..ops.expressions import clear_input_file, publish_input_file
     files = list(files)
-    try:
-        yield from _device_parquet_files(
-            files, schema, options, conf, metrics, max_rows, max_bytes,
-            predicates, partitions, part_names, publish_input_file)
-    finally:
-        clear_input_file()
+    yield from _device_parquet_files(
+        files, schema, options, conf, metrics, max_rows, max_bytes,
+        predicates, partitions, part_names)
 
 
 def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
-                          max_bytes, predicates, partitions, part_names,
-                          publish_input_file):
+                          max_bytes, predicates, partitions, part_names):
+    """Yields (batch, num_rows, path).  The input-file provenance global
+    is NOT touched here: this generator may run on the prefetch thread,
+    and publish_input_file is process-global state the CONSUMER must
+    sequence with its own batch handling (scan.py _batches)."""
     import jax.numpy as jnp
     import pyarrow.parquet as pq
     from ..columnar import Column
@@ -687,7 +686,6 @@ def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
             continue
         name_to_leaf = _leaf_index_map(pf)
         pvals = partitions.get(path) or partitions.get(os.path.abspath(path))
-        publish_input_file(path)
 
         for chunk in _parquet_chunks(pf, max_rows, max_bytes, predicates,
                                      name_to_leaf, metrics):
@@ -796,7 +794,7 @@ def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
                         vals, vd, f.dtype, capacity=cap)
             sel = jnp.arange(cap, dtype=jnp.int32) < num_rows
             yield (ColumnarBatch([out_cols[f.name] for f in schema], sel,
-                                 schema), num_rows)
+                                 schema), num_rows, path)
 
 
 class TpuFileScanExec(TpuExec):
@@ -873,11 +871,23 @@ class TpuFileScanExec(TpuExec):
                 # pipelines against the next chunk's decode)
                 from ..utils.prefetch import PrefetchIterator
                 it = PrefetchIterator(it, depth)
-            for batch, nrows in it:
-                # nrows comes from file metadata — never a device sync
-                self.metrics.add("numOutputRows", nrows)
-                self.metrics.add("numOutputBatches", 1)
-                yield batch
+            from ..ops.expressions import (clear_input_file,
+                                           publish_input_file)
+            try:
+                for batch, nrows, path in it:
+                    # provenance publishes on the CONSUMER thread, in
+                    # batch order (the producer runs ahead of us);
+                    # nrows comes from file metadata — never a sync
+                    publish_input_file(path)
+                    self.metrics.add("numOutputRows", nrows)
+                    self.metrics.add("numOutputBatches", 1)
+                    yield batch
+            finally:
+                clear_input_file()
+                if hasattr(it, "close"):
+                    # an early-stopping consumer (LIMIT) must unpark the
+                    # prefetch thread and close the source generator
+                    it.close()
             return
         yield from self._host_batches(self.files, ctx)
 

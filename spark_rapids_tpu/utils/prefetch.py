@@ -21,20 +21,45 @@ _STOP = object()
 
 class PrefetchIterator:
     """Wraps an iterator; a daemon thread keeps up to `depth` items
-    decoded ahead.  Exceptions re-raise at the consumer in order."""
+    decoded ahead.  Exceptions re-raise at the consumer in order.
+
+    `close()` MUST be called when the consumer stops early (LIMIT,
+    exception): it unblocks the pump thread (otherwise parked forever in
+    a full-queue put, pinning the buffered batches and the source
+    generator) and runs the wrapped generator's finally blocks."""
 
     def __init__(self, it: Iterator[T], depth: int = 1):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._consumed = False
+        self._closed = False
+        self._it = it
+
+        def offer(entry) -> bool:
+            """put() that gives up once close() is called (a plain put
+            can park forever on a queue the consumer stopped draining)."""
+            while not self._closed:
+                try:
+                    self._q.put(entry, timeout=0.25)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def pump():
             try:
                 for item in it:
-                    self._q.put((item, None))
+                    if not offer((item, None)):
+                        break
             except BaseException as e:  # noqa: BLE001 — re-raised below
-                self._q.put((None, e))
+                offer((None, e))
                 return
-            self._q.put((_STOP, None))
+            finally:
+                if self._closed and hasattr(it, "close"):
+                    try:
+                        it.close()
+                    except Exception:  # noqa: BLE001 — teardown
+                        pass
+            offer((_STOP, None))
 
         self._thread = threading.Thread(target=pump, daemon=True,
                                         name="scan-prefetch")
@@ -54,3 +79,11 @@ class PrefetchIterator:
             self._consumed = True
             raise StopIteration
         return item
+
+    def close(self) -> None:
+        self._closed = True
+        try:  # drop buffered items so a parked put() finds space
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
