@@ -283,3 +283,52 @@ def test_proc_cluster_worker_loss_mid_reduce(tmp_path):
     for c in ["sum_qty", "count_order"]:
         np.testing.assert_allclose(res[c].to_numpy(), exp[c].to_numpy(),
                                    rtol=1e-9)
+
+
+@pytest.mark.slow
+def test_proc_cluster_two_workers_lost(tmp_path):
+    """BOTH workers SIGKILLed between queries: the first replacement's
+    peer broadcast must tolerate the second still-dead worker (best-effort
+    set_peers), and the second replacement re-publishes to everyone."""
+    from spark_rapids_tpu.cluster import ProcCluster
+    files, _ = _lineitem_files(str(tmp_path))
+    session = TpuSession()
+
+    def map_plan(my_files):
+        return (session.read.parquet(*my_files)
+                .filter(col("l_shipdate") <= D_19980902)
+                .select(*[col(c) for c in Q1_COLS])).plan
+
+    n_workers = 2
+    map_plans = [map_plan(files[i::n_workers]) for i in range(n_workers)]
+    map_schema = DataFrame(session, map_plans[0]).schema
+    reduce_plan = _q1_shape(
+        DataFrame(session, L.LogicalPlaceholder(map_schema))).plan
+
+    cluster = ProcCluster(n_workers, conf={}, cpu=True,
+                          max_task_retries=2)
+    try:
+        cluster.run_map_reduce(
+            map_plans, ["l_returnflag", "l_linestatus"], 4, reduce_plan)
+        for w in cluster.workers:
+            w.proc.kill()
+            w.proc.wait(timeout=10)
+        result, map_stats = cluster.run_map_reduce(
+            map_plans, ["l_returnflag", "l_linestatus"], 4, reduce_plan)
+        assert cluster.task_retries >= 2, cluster.task_retries
+    finally:
+        cluster.shutdown()
+    assert all(s and s["written_rows"] for s in map_stats)
+
+    oracle = _q1_shape(
+        session.read.parquet(*files)
+        .filter(col("l_shipdate") <= D_19980902)
+        .select(*[col(c) for c in Q1_COLS])).to_arrow()
+    res = result.to_pandas().sort_values(
+        ["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    exp = oracle.to_pandas().sort_values(
+        ["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    assert len(res) == len(exp) and len(res) == 6
+    for c in ["sum_qty", "count_order"]:
+        np.testing.assert_allclose(res[c].to_numpy(), exp[c].to_numpy(),
+                                   rtol=1e-9)
