@@ -198,6 +198,22 @@ class TpuHashJoinExec(TpuExec):
         cond = self.condition.eval(pair)
         return cond.valid & cond.data.astype(jnp.bool_)
 
+    def _probe_kernel(self, max_dup_guess: int, lbatch: ColumnarBatch,
+                      build: ColumnarBatch, bkeys, h1s):
+        """Fused window+count with a SPECULATIVE duplication bucket: one
+        dispatch computes the candidate windows AND the verified counts
+        for `max_dup_guess`; the counts are valid iff the true max
+        duplication fits the guess (the caller checks in the same scalar
+        fetch that reads the total — ONE host sync per probe batch
+        instead of the window/count pair's two, which on a tunneled chip
+        is one round trip instead of two).  XLA CSEs the key evaluation
+        shared by the window and count phases."""
+        lo, hi, md = self._window_kernel(lbatch, h1s)
+        counts, starts, total = self._count_kernel(
+            max_dup_guess, lbatch, build, bkeys, lo, hi)
+        return lo, hi, counts, starts, \
+            jnp.stack([md.astype(jnp.int64), total.astype(jnp.int64)])
+
     def _count_kernel(self, max_dup: int, lbatch: ColumnarBatch,
                       build: ColumnarBatch, bkeys, lo, hi,
                       vary_axes: tuple = ()):
@@ -342,38 +358,52 @@ class TpuHashJoinExec(TpuExec):
         key = self.kernel_key()
         build_fn = cached_kernel(key + ("build",),
                                  lambda: self._build_kernel)
-        window_fn = cached_kernel(key + ("window",),
-                                  lambda: self._window_kernel)
         with self.metrics.timer("buildTime"), named_range("join_build"):
             build, bkeys, h1s = build_fn(rbatch)
 
         b_hit_accum = None  # full join: OR of per-batch build-hit masks
         for lbatch in lbatches:
             with self.metrics.timer("joinTime"), named_range("join_stream"):
-                lo, hi, max_dup_t = window_fn(lbatch, h1s)
-                # power-of-two bucket: max_dup is a data-dependent integer
-                # that becomes part of the kernel-cache key — raw values
-                # would force a recompile per distinct build-side skew
-                max_dup = _pow2_bucket(int(max_dup_t))  # host sync #1
-                count_fn = cached_kernel(
-                    key + ("count", max_dup),
-                    lambda: functools.partial(self._count_kernel, max_dup))
-                counts, starts, total_t = count_fn(lbatch, build, bkeys,
-                                                   lo, hi)
+                # SPECULATIVE probe: window+count fuse into one dispatch
+                # using the previous batch's duplication bucket (stream
+                # skew is stable batch to batch); the single scalar fetch
+                # below reads the true max_dup AND the total together.
+                # Power-of-two buckets: raw data-dependent integers in
+                # the kernel-cache key would recompile per distinct skew.
+                guess = getattr(self, "_dup_guess", 8)
+                probe_fn = cached_kernel(
+                    key + ("probe", guess),
+                    lambda: functools.partial(self._probe_kernel, guess))
+                lo, hi, counts, starts, scalars_t = probe_fn(
+                    lbatch, build, bkeys, h1s)
+                md, total = (int(x) for x in np.asarray(scalars_t))
+                max_dup = _pow2_bucket(md)
+                self._dup_guess = max_dup
+                if max_dup > guess:
+                    # speculation failed (skew grew): recount with the
+                    # right bucket — one extra dispatch+sync, this batch
+                    count_fn = cached_kernel(
+                        key + ("count", max_dup),
+                        lambda: functools.partial(self._count_kernel,
+                                                  max_dup))
+                    counts, starts, total_t = count_fn(lbatch, build,
+                                                       bkeys, lo, hi)
+                    total = int(total_t)
+                else:
+                    max_dup = guess  # counts were computed at the guess
                 if self.join_type in ("left_semi", "left_anti"):
                     semi_fn = cached_kernel(key + ("semi",),
                                             lambda: self._semi_kernel)
                     out = semi_fn(lbatch, counts)
                     out = ColumnarBatch(out.columns, out.sel, self._schema)
                 else:
-                    total = int(total_t)  # host sync #2
                     out_cap = bucket_rows(max(total, 1))
                     gather_fn = cached_kernel(
                         key + ("gather", max_dup, out_cap),
                         lambda: functools.partial(self._gather_kernel,
                                                   max_dup, out_cap))
                     out = gather_fn(lbatch, build, bkeys, lo, hi,
-                                    counts, starts, total_t)
+                                    counts, starts, jnp.int64(total))
                     if self.join_type == "full":
                         out, b_hit = out
                         b_hit_accum = b_hit if b_hit_accum is None \
