@@ -37,12 +37,18 @@ def bucket_rows(n: int, minimum: int = 1024) -> int:
 class ColumnarBatch:
     """columns + selection mask. `schema` and `capacity` are static."""
 
-    __slots__ = ("columns", "sel", "schema")
+    __slots__ = ("columns", "sel", "schema", "known_rows")
 
     def __init__(self, columns: Sequence[Column], sel, schema: Schema):
         self.columns = tuple(columns)
         self.sel = sel
         self.schema = schema
+        # host-known live-row count, when the producer already holds it
+        # (scan chunk metadata, a join's fetched total): lets downstream
+        # adaptive decisions (maybe_shrink) skip a device sync.  NOT part
+        # of the pytree (values in the treedef would retrace per count);
+        # any structural transform drops it back to None.
+        self.known_rows = None
 
     def tree_flatten(self):
         return (self.columns, self.sel), self.schema
@@ -74,6 +80,8 @@ class ColumnarBatch:
         return jnp.sum(self.sel.astype(jnp.int32))
 
     def num_rows_host(self) -> int:
+        if self.known_rows is not None:
+            return self.known_rows
         return int(self.num_rows())
 
     def device_size_bytes(self) -> int:

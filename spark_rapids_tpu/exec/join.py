@@ -408,6 +408,9 @@ class TpuHashJoinExec(TpuExec):
                         out, b_hit = out
                         b_hit_accum = b_hit if b_hit_accum is None \
                             else b_hit_accum | b_hit
+                    # the fetched total IS the live-row count: hand it to
+                    # downstream adaptive shrinks so they skip their sync
+                    out.known_rows = total
             self.metrics.add("numOutputBatches", 1)
             # deferred: an int() here is a device sync PER OUTPUT BATCH
             # (a tunnel round trip on chip) in the join hot loop

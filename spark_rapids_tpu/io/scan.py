@@ -793,8 +793,10 @@ def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
                     out_cols[f.name] = Column.from_numpy(
                         vals, vd, f.dtype, capacity=cap)
             sel = jnp.arange(cap, dtype=jnp.int32) < num_rows
-            yield (ColumnarBatch([out_cols[f.name] for f in schema], sel,
-                                 schema), num_rows, path)
+            out_batch = ColumnarBatch([out_cols[f.name] for f in schema],
+                                      sel, schema)
+            out_batch.known_rows = num_rows  # from file metadata
+            yield (out_batch, num_rows, path)
 
 
 class TpuFileScanExec(TpuExec):
