@@ -140,6 +140,8 @@ class ProcCluster:
             raise
         # driver-side transport: client factory only (no server)
         self._transport = SocketTransport()
+        from .config import TpuConf
+        self._transport.configure(TpuConf(self.conf))
         self._sid = 0
         self._lock = threading.Lock()
         self.task_retries = 0   # observability: recoveries this cluster

@@ -161,6 +161,27 @@ PINNED_POOL_SIZE = _conf(
     "spark.rapids.memory.pinnedPool.size", 0,
     "Size of the pinned host staging pool used for H2D/D2H transfer.",
     to_bytes)
+OOM_RETRY_MAX = _conf(
+    "spark.rapids.memory.tpu.retry.maxRetries", 2,
+    "Same-size retries of an operator allocation attempt after an OOM "
+    "(each retry runs behind the synchronous spill cascade) before the "
+    "input is split (reference: withRetry over RmmSpark retry OOMs).", int)
+OOM_RETRY_SPLIT_DEPTH = _conf(
+    "spark.rapids.memory.tpu.retry.maxSplitDepth", 4,
+    "Maximum halving depth of split-and-retry: an input batch may be "
+    "split into at most 2^depth pieces before the block gives up "
+    "(RetryExhausted -> CPU fallback or query failure).", int)
+OOM_RETRY_CHECKPOINT = _conf(
+    "spark.rapids.memory.tpu.retry.checkpointInputs.enabled", True,
+    "Register retryable-block input batches as spillable buffers so the "
+    "OOM spill cascade can evict them between attempts (they are pinned "
+    "only while an attempt runs).", _to_bool)
+OOM_CPU_FALLBACK = _conf(
+    "spark.rapids.sql.tpu.cpuFallbackOnOom.enabled", True,
+    "When a device operator exhausts its OOM retries and split depth, "
+    "re-execute it through its CPU implementation instead of failing the "
+    "query; the downgrade is recorded in the operator's numCpuFallbacks "
+    "metric.", _to_bool)
 MEMORY_SCAN_CACHE_ENABLED = _conf(
     "spark.rapids.sql.tpu.memoryScanCache.enabled", True,
     "Keep device batches decoded from immutable in-memory tables "
@@ -353,6 +374,35 @@ SHUFFLE_DEVICE_RESIDENT = _conf(
     "spark.rapids.shuffle.deviceResident.enabled", True,
     "Keep shuffle partitions resident in HBM (spillable) instead of "
     "serializing to host between stages.", _to_bool)
+SHUFFLE_RETRY_ATTEMPTS = _conf(
+    "spark.rapids.shuffle.retry.maxAttempts", 4,
+    "Attempts per shuffle socket operation (connect, metadata, fetch) "
+    "before the error propagates; attempts after the first back off "
+    "exponentially with jitter.", int)
+SHUFFLE_RETRY_BACKOFF_BASE = _conf(
+    "spark.rapids.shuffle.retry.backoffBaseMs", 50,
+    "Base backoff in milliseconds between shuffle retries; attempt k "
+    "waits ~base*2^k (jittered, capped by backoffCapMs).", int)
+SHUFFLE_RETRY_BACKOFF_CAP = _conf(
+    "spark.rapids.shuffle.retry.backoffCapMs", 2000,
+    "Upper bound in milliseconds on the shuffle retry backoff.", int)
+SHUFFLE_CONNECT_TIMEOUT = _conf(
+    "spark.rapids.shuffle.connectTimeoutMs", 30000,
+    "Per-attempt TCP connect timeout for shuffle clients, in "
+    "milliseconds.", int)
+SHUFFLE_IO_TIMEOUT = _conf(
+    "spark.rapids.shuffle.ioTimeoutMs", 60000,
+    "Per-socket-operation I/O deadline for shuffle DATA-plane requests "
+    "(metadata, layout, fetch), in milliseconds; a dead peer surfaces as "
+    "a timeout within this bound instead of hanging.  0 disables.  "
+    "Control-plane RPCs (task dispatch) are exempt: they legitimately "
+    "block on first-query compilation at the peer.", int)
+SHUFFLE_TXN_TIMEOUT = _conf(
+    "spark.rapids.shuffle.transactionTimeoutMs", 600000,
+    "Overall deadline for one shuffle fetch transaction (layout + every "
+    "data frame + END) in milliseconds; past it the transaction is "
+    "CANCELLED and the error propagates without further retries.  "
+    "0 disables.", int)
 
 # --- joins ------------------------------------------------------------------
 def _to_bytes_or_disabled(v) -> int:
@@ -373,6 +423,24 @@ AUTO_BROADCAST_JOIN_THRESHOLD = _conf(
     "Maximum estimated size in bytes of a join build side that will be "
     "broadcast to every consumer instead of shuffled (Spark's conf key; "
     "-1 disables broadcast joins).", _to_bytes_or_disabled)
+
+# --- fault injection (test-only) --------------------------------------------
+TEST_INJECT_OOM = _conf(
+    "spark.rapids.tpu.test.injectOom", "",
+    "Deterministic OOM injection spec over the process-wide reserve() "
+    "counter: '3' fails reserve #3 once, '3x2' fails #3 and #4, "
+    "'split@5' raises SplitAndRetryOOM at #5, 'p=0.05' fails with that "
+    "probability (seeded by injectSeed).  Testing only.", str,
+    internal=True)
+TEST_INJECT_NET = _conf(
+    "spark.rapids.tpu.test.injectNetFault", "",
+    "Deterministic network-fault injection spec over the client-side "
+    "shuffle socket-op counter (same grammar as injectOom, minus "
+    "split@).  Testing only.", str, internal=True)
+TEST_INJECT_SEED = _conf(
+    "spark.rapids.tpu.test.injectSeed", 0,
+    "Seed for the probabilistic fault-injection mode.", int,
+    internal=True)
 
 # --- export -----------------------------------------------------------------
 EXPORT_COLUMNAR_RDD = _conf(

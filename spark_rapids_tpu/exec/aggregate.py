@@ -956,7 +956,21 @@ class TpuHashAggregateExec(TpuExec):
         self.metrics.add("numOutputBatches", 1)
         return out, None
 
+    def _cpu_twin(self):
+        """CPU re-execution plan for OOM fallback (exec/retryable.py):
+        the CPU aggregate over the device child bridged through D2H."""
+        from .basic import DeviceToHostExec
+        from .cpu_relational import CpuHashAggregateExec
+        return CpuHashAggregateExec(self.grouping, self.group_names,
+                                    self.aggregates,
+                                    DeviceToHostExec(self.children[0]))
+
     def execute(self, ctx: ExecContext):
+        from .retryable import execute_with_cpu_fallback
+        yield from execute_with_cpu_fallback(
+            self, ctx, self._execute_device(ctx), self._cpu_twin)
+
+    def _execute_device(self, ctx: ExecContext):
         from ..utils.kernel_cache import cached_kernel
         from .. import config as C
         set_pallas_cumsum(ctx.conf.get(C.PALLAS_ENABLED))
@@ -990,15 +1004,30 @@ class TpuHashAggregateExec(TpuExec):
         from ..config import AGG_MERGE_FAN_IN
         fan_in = max(2, ctx.conf.get(AGG_MERGE_FAN_IN))
 
+        from .retryable import run_retryable
+
         def fold(state, pending):
             parts = ([state] if state is not None else []) + pending
             if len(parts) == 1:
                 return parts[0]
-            with self.metrics.timer("concatTime"):
-                both = concat_batches(parts)
-            with self.metrics.timer("mergeAggTime"), \
-                    named_range("agg_merge"):
-                return merge(both)
+
+            def attempt_merge(_):
+                # merge allocates the K-way concat: reserve it so the
+                # spill cascade (and the fault injector) see the boundary
+                if ctx.runtime is not None:
+                    ctx.runtime.reserve(
+                        sum(p.device_size_bytes() for p in parts),
+                        site="agg.merge")
+                with self.metrics.timer("concatTime"):
+                    both = concat_batches(parts)
+                with self.metrics.timer("mergeAggTime"), \
+                        named_range("agg_merge"):
+                    return merge(both)
+            # retry-only: partial states are merge inputs, not splittable
+            # row ranges (splitting them would change nothing — the merge
+            # concat is the allocation)
+            return run_retryable(ctx, self.metrics, "aggMerge",
+                                 attempt_merge, [None])[0]
 
         # if the whole-stage probe already drained the source, stream the
         # materialized batches through the child's per-batch kernel instead
@@ -1029,7 +1058,43 @@ class TpuHashAggregateExec(TpuExec):
                                       lambda: self._bucket_update_kernel)
         state = None
         pending: list = []
-        offset = 0
+        hot = {"bucket_fn": bucket_fn, "offset": 0}
+        from ..mem.retry import split_batch_rows
+        # distinct dedup happens inside ONE update call (partial states
+        # are not mergeable across batches) — a row-range split would
+        # double-count values straddling the halves, so distinct shapes
+        # are retry-only (exhaustion -> CPU fallback)
+        update_split = (None if self._distinct_child() is not None
+                        else split_batch_rows)
+
+        def attempt_update(b):
+            """Retryable per-batch update: reserve the partial-state
+            footprint, then run the bucket probe / sort-based update.  A
+            split input re-enters here piece by piece IN ORDER, so the
+            row offset (First/Last tiebreaks) advances exactly as the
+            unsplit batch would have."""
+            if ctx.runtime is not None:
+                ctx.runtime.reserve(b.device_size_bytes(),
+                                    site="agg.update")
+            partial = None
+            bfn = hot["bucket_fn"]
+            if bfn is not None:
+                clean, bstate = bfn(b)
+                if bool(clean):  # host sync: pick the sort-free state
+                    partial = bstate
+                else:
+                    # dirty latch: a high-cardinality shape stays
+                    # dirty — stop probing it (this query AND this
+                    # kernel key process-wide)
+                    hot["bucket_fn"] = None
+                    _BUCKET_DIRTY_KEYS.add(key)
+            if partial is None:
+                partial = update(b, jnp.int64(hot["offset"])) \
+                    if needs_off else update(b)
+            if needs_off:
+                hot["offset"] += b.num_rows_host()
+            return partial
+
         for batch in input_iter:
             # the update kernel sorts at batch CAPACITY: a selective
             # upstream filter leaves mostly-dead batches, so shrink first
@@ -1039,23 +1104,10 @@ class TpuHashAggregateExec(TpuExec):
                 batch = batch.maybe_shrink(batch.num_rows_host())
             with self.metrics.timer("computeAggTime"), \
                     named_range("agg_update"):
-                partial = None
-                if bucket_fn is not None:
-                    clean, bstate = bucket_fn(batch)
-                    if bool(clean):  # host sync: pick the sort-free state
-                        partial = bstate
-                    else:
-                        # dirty latch: a high-cardinality shape stays
-                        # dirty — stop probing it (this query AND this
-                        # kernel key process-wide)
-                        bucket_fn = None
-                        _BUCKET_DIRTY_KEYS.add(key)
-                if partial is None:
-                    partial = update(batch, jnp.int64(offset)) \
-                        if needs_off else update(batch)
-            if needs_off:
-                offset += batch.num_rows_host()
-            pending.append(partial)
+                partials = run_retryable(ctx, self.metrics, "aggUpdate",
+                                         attempt_update, [batch],
+                                         split=update_split)
+            pending.extend(partials)
             if len(pending) >= fan_in:
                 state = fold(state, pending)
                 pending = []

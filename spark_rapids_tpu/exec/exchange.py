@@ -64,7 +64,32 @@ class TpuShuffleExchangeExec(TpuExec):
                                        self.nulls_first, bounds)
         raise AssertionError(self.mode)
 
+    def _cpu_twin(self):
+        """CPU re-execution plan for OOM fallback (exec/retryable.py):
+        the host executor is single-process, so repartitioning degrades
+        to a pass-through of the child's rows.
+
+        NOT available for RANGE exchanges: the external sort consumes
+        partition order AS global order, so a pass-through would yield a
+        silently unsorted result — and its _PrefetchedSource child drains
+        destructively, so a re-execution would also drop rows.  Returning
+        None propagates RetryExhausted to the SORT's own fallback, which
+        re-executes the original (re-runnable) child on CPU."""
+        from .sort import _PrefetchedSource
+        if self.mode == "range" \
+                or isinstance(self.children[0], _PrefetchedSource):
+            return None
+        from .basic import DeviceToHostExec
+        from .cpu_relational import CpuRepartitionExec
+        return CpuRepartitionExec(self.num_partitions,
+                                  DeviceToHostExec(self.children[0]))
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from .retryable import execute_with_cpu_fallback
+        yield from execute_with_cpu_fallback(
+            self, ctx, self._execute_device(ctx), self._cpu_twin)
+
+    def _execute_device(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         produced = False
         for _p, out in self.execute_partitions(ctx):
             if out is None:
@@ -106,6 +131,7 @@ class TpuShuffleExchangeExec(TpuExec):
                           env.write_partition(sid, map_id, p, sub))
 
         from ..config import SHUFFLE_ASYNC_FETCH
+        from .retryable import run_retryable
         try:
             with self.metrics.timer("shuffleReadTime"):
                 if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
@@ -114,9 +140,15 @@ class TpuShuffleExchangeExec(TpuExec):
                     yield from _drain_async(
                         env.fetch_partitions_async(sid, range(n)), n)
                 else:
+                    # retry-only: local catalog reads are idempotent, so a
+                    # reserve() OOM during re-materialization just refetches
+                    def fetch_one(p):
+                        return list(env.fetch_partition(sid, p))
                     for p in range(n):
-                        yield p, _coalesce_parts(
-                            list(env.fetch_partition(sid, p)))
+                        parts = run_retryable(ctx, self.metrics,
+                                              "exchangeFetch", fetch_one,
+                                              [p])[0]
+                        yield p, _coalesce_parts(parts)
         finally:
             env.remove_shuffle(sid)
 
@@ -142,14 +174,38 @@ class TpuShuffleExchangeExec(TpuExec):
                     yield b
             child_batches = _draining()
 
+        from .retryable import run_retryable, split_batch_rows
         num_writes = 0
         with self.metrics.timer("shuffleWriteTime"):
             for map_id, batch in enumerate(child_batches):
-                pids = self._partition_ids(batch, map_id, bounds)
-                for p, sub in split_by_partition(batch, pids, n):
-                    write(map_id, p, sub)
-                    num_writes += 1
+
+                def partition_one(b, map_id=map_id):
+                    """Retryable partition-id + split compute (no catalog
+                    writes inside, so a retry or a row-range split of the
+                    input never double-writes a partition)."""
+                    if ctx.runtime is not None:
+                        ctx.runtime.reserve(b.device_size_bytes(),
+                                            site="exchange.partition")
+                    pids = self._partition_ids(b, map_id, bounds)
+                    return list(split_by_partition(b, pids, n))
+
+                pieces = run_retryable(ctx, self.metrics,
+                                       "exchangePartition", partition_one,
+                                       [batch], split=split_batch_rows)
                 batch = None
+                for piece in pieces:
+                    for p, sub in piece:
+                        def write_one(sb, map_id=map_id, p=p):
+                            # write() reserves pool space (add_batch);
+                            # failure precedes registration, so the
+                            # attempt is idempotent.  Split halves land
+                            # as extra sub-batches of the same block —
+                            # the read side coalesces them.
+                            write(map_id, p, sb)
+                            return 1
+                        num_writes += sum(run_retryable(
+                            ctx, self.metrics, "exchangeWrite", write_one,
+                            [sub], split=split_batch_rows))
         self.metrics.add("numPartitionsWritten", num_writes)
 
     def _execute_partitions_cluster(self, ctx: ExecContext):
@@ -166,7 +222,8 @@ class TpuShuffleExchangeExec(TpuExec):
             owner = cluster.env_for(p)
             return owner, cluster.peer_ids(owner.executor_id)
 
-        from ..config import SHUFFLE_ASYNC_FETCH, SHUFFLE_MAX_RECV_INFLIGHT
+        from ..config import (OOM_RETRY_MAX, SHUFFLE_ASYNC_FETCH,
+                              SHUFFLE_MAX_RECV_INFLIGHT)
         try:
             with self.metrics.timer("shuffleReadTime"):
                 if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
@@ -176,12 +233,26 @@ class TpuShuffleExchangeExec(TpuExec):
                     yield from _drain_async(AsyncFetchIterator(
                         None, sid, range(n), None,
                         int(ctx.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
-                        route=_route), n)
+                        route=_route,
+                        oom_retries=int(ctx.conf.get(OOM_RETRY_MAX))), n)
                 else:
-                    for p in range(n):
+                    from .retryable import run_retryable
+
+                    def fetch_one(p):
                         owner, peers = _route(p)
-                        parts = list(owner.fetch_partition(
-                            sid, p, remote_peers=peers))
+                        mark = owner.received.snapshot(sid)
+                        try:
+                            return list(owner.fetch_partition(
+                                sid, p, remote_peers=peers))
+                        except MemoryError:
+                            # drop the failed attempt's remote buffers so
+                            # the retry doesn't duplicate them in the pool
+                            owner.rollback_received(sid, mark)
+                            raise
+                    for p in range(n):
+                        parts = run_retryable(ctx, self.metrics,
+                                              "exchangeFetch", fetch_one,
+                                              [p])[0]
                         yield p, _coalesce_parts(parts)
         finally:
             cluster.remove_shuffle(sid)

@@ -338,7 +338,23 @@ class TpuHashJoinExec(TpuExec):
 
     # ---- driver -----------------------------------------------------------
 
+    def _cpu_twin(self):
+        """CPU re-execution plan for OOM fallback (exec/retryable.py):
+        the CPU join over both device children bridged through D2H
+        (CpuJoinExec accepts the canonical left/full type names)."""
+        from .basic import DeviceToHostExec
+        from .cpu_relational import CpuJoinExec
+        return CpuJoinExec(DeviceToHostExec(self.children[0]),
+                           DeviceToHostExec(self.children[1]),
+                           self.join_type, self.left_keys, self.right_keys,
+                           self.condition, self._schema, self.using_drop)
+
     def execute(self, ctx: ExecContext):
+        from .retryable import execute_with_cpu_fallback
+        yield from execute_with_cpu_fallback(
+            self, ctx, self._execute_device(ctx), self._cpu_twin)
+
+    def _execute_device(self, ctx: ExecContext):
         rbatches = list(self.children[1].execute(ctx))
         if rbatches:
             rbatch = rbatches[0] if len(rbatches) == 1 \
@@ -348,74 +364,110 @@ class TpuHashJoinExec(TpuExec):
             rbatch = rbatch.maybe_shrink(rbatch.num_rows_host())
         else:
             rbatch = _empty_batch(self.children[1].schema)
-        yield from self._join_stream(rbatch, self.children[0].execute(ctx))
+        yield from self._join_stream(rbatch, self.children[0].execute(ctx),
+                                     ctx)
 
-    def _join_stream(self, rbatch: ColumnarBatch, lbatches):
+    def _join_stream(self, rbatch: ColumnarBatch, lbatches, ctx=None):
         """Build once from `rbatch`, stream left batches through the probe
         kernels.  Shared by the whole-build path (execute) and the
         per-partition path (TpuShuffledHashJoinExec)."""
         from ..utils.kernel_cache import cached_kernel
+        from .retryable import run_retryable, split_batch_rows
         key = self.kernel_key()
         build_fn = cached_kernel(key + ("build",),
                                  lambda: self._build_kernel)
+
+        def attempt_build(rb):
+            # retry-only: the single-build-batch contract forbids
+            # splitting the build side (exhaustion -> CPU fallback)
+            if ctx is not None and ctx.runtime is not None:
+                ctx.runtime.reserve(rb.device_size_bytes(),
+                                    site="join.build")
+            return build_fn(rb)
+
         with self.metrics.timer("buildTime"), named_range("join_build"):
-            build, bkeys, h1s = build_fn(rbatch)
+            if ctx is not None:
+                build, bkeys, h1s = run_retryable(
+                    ctx, self.metrics, "joinBuild", attempt_build,
+                    [rbatch])[0]
+            else:
+                build, bkeys, h1s = build_fn(rbatch)
+
+        def probe_one(lb):
+            """One stream batch through the probe kernels.  Retryable and
+            row-splittable: every supported join type is per-left-row
+            independent given the resident build side, so the outputs of
+            split pieces compose by concatenation (full-outer build-hit
+            masks OR together in the driver)."""
+            if ctx is not None and ctx.runtime is not None:
+                ctx.runtime.reserve(lb.device_size_bytes(),
+                                    site="join.probe")
+            # SPECULATIVE probe: window+count fuse into one dispatch
+            # using the previous batch's duplication bucket (stream
+            # skew is stable batch to batch); the single scalar fetch
+            # below reads the true max_dup AND the total together.
+            # Power-of-two buckets: raw data-dependent integers in
+            # the kernel-cache key would recompile per distinct skew.
+            guess = getattr(self, "_dup_guess", 8)
+            probe_fn = cached_kernel(
+                key + ("probe", guess),
+                lambda: functools.partial(self._probe_kernel, guess))
+            lo, hi, counts, starts, scalars_t = probe_fn(
+                lb, build, bkeys, h1s)
+            md, total = (int(x) for x in np.asarray(scalars_t))
+            max_dup = _pow2_bucket(md)
+            self._dup_guess = max_dup
+            if max_dup > guess:
+                # speculation failed (skew grew): recount with the
+                # right bucket — one extra dispatch+sync, this batch
+                count_fn = cached_kernel(
+                    key + ("count", max_dup),
+                    lambda: functools.partial(self._count_kernel,
+                                              max_dup))
+                counts, starts, total_t = count_fn(lb, build,
+                                                   bkeys, lo, hi)
+                total = int(total_t)
+            else:
+                max_dup = guess  # counts were computed at the guess
+            if self.join_type in ("left_semi", "left_anti"):
+                semi_fn = cached_kernel(key + ("semi",),
+                                        lambda: self._semi_kernel)
+                out = semi_fn(lb, counts)
+                out = ColumnarBatch(out.columns, out.sel, self._schema)
+                return out, None, total
+            out_cap = bucket_rows(max(total, 1))
+            gather_fn = cached_kernel(
+                key + ("gather", max_dup, out_cap),
+                lambda: functools.partial(self._gather_kernel,
+                                          max_dup, out_cap))
+            out = gather_fn(lb, build, bkeys, lo, hi,
+                            counts, starts, jnp.int64(total))
+            b_hit = None
+            if self.join_type == "full":
+                out, b_hit = out
+            # the fetched total IS the live-row count: hand it to
+            # downstream adaptive shrinks so they skip their sync
+            out.known_rows = total
+            return out, b_hit, total
 
         b_hit_accum = None  # full join: OR of per-batch build-hit masks
         for lbatch in lbatches:
             with self.metrics.timer("joinTime"), named_range("join_stream"):
-                # SPECULATIVE probe: window+count fuse into one dispatch
-                # using the previous batch's duplication bucket (stream
-                # skew is stable batch to batch); the single scalar fetch
-                # below reads the true max_dup AND the total together.
-                # Power-of-two buckets: raw data-dependent integers in
-                # the kernel-cache key would recompile per distinct skew.
-                guess = getattr(self, "_dup_guess", 8)
-                probe_fn = cached_kernel(
-                    key + ("probe", guess),
-                    lambda: functools.partial(self._probe_kernel, guess))
-                lo, hi, counts, starts, scalars_t = probe_fn(
-                    lbatch, build, bkeys, h1s)
-                md, total = (int(x) for x in np.asarray(scalars_t))
-                max_dup = _pow2_bucket(md)
-                self._dup_guess = max_dup
-                if max_dup > guess:
-                    # speculation failed (skew grew): recount with the
-                    # right bucket — one extra dispatch+sync, this batch
-                    count_fn = cached_kernel(
-                        key + ("count", max_dup),
-                        lambda: functools.partial(self._count_kernel,
-                                                  max_dup))
-                    counts, starts, total_t = count_fn(lbatch, build,
-                                                       bkeys, lo, hi)
-                    total = int(total_t)
+                if ctx is not None:
+                    results = run_retryable(ctx, self.metrics, "joinProbe",
+                                            probe_one, [lbatch],
+                                            split=split_batch_rows)
                 else:
-                    max_dup = guess  # counts were computed at the guess
-                if self.join_type in ("left_semi", "left_anti"):
-                    semi_fn = cached_kernel(key + ("semi",),
-                                            lambda: self._semi_kernel)
-                    out = semi_fn(lbatch, counts)
-                    out = ColumnarBatch(out.columns, out.sel, self._schema)
-                else:
-                    out_cap = bucket_rows(max(total, 1))
-                    gather_fn = cached_kernel(
-                        key + ("gather", max_dup, out_cap),
-                        lambda: functools.partial(self._gather_kernel,
-                                                  max_dup, out_cap))
-                    out = gather_fn(lbatch, build, bkeys, lo, hi,
-                                    counts, starts, jnp.int64(total))
-                    if self.join_type == "full":
-                        out, b_hit = out
-                        b_hit_accum = b_hit if b_hit_accum is None \
-                            else b_hit_accum | b_hit
-                    # the fetched total IS the live-row count: hand it to
-                    # downstream adaptive shrinks so they skip their sync
-                    out.known_rows = total
-            self.metrics.add("numOutputBatches", 1)
-            # deferred: an int() here is a device sync PER OUTPUT BATCH
-            # (a tunnel round trip on chip) in the join hot loop
-            self.metrics.add_lazy("numOutputRows", out.num_rows())
-            yield out
+                    results = [probe_one(lbatch)]
+            for out, b_hit, _total in results:
+                if b_hit is not None:
+                    b_hit_accum = b_hit if b_hit_accum is None \
+                        else b_hit_accum | b_hit
+                self.metrics.add("numOutputBatches", 1)
+                # deferred: an int() here is a device sync PER OUTPUT
+                # BATCH (a tunnel round trip on chip) in the join hot loop
+                self.metrics.add_lazy("numOutputRows", out.num_rows())
+                yield out
         if self.join_type == "full":
             if b_hit_accum is None:
                 b_hit_accum = jnp.zeros(build.capacity, jnp.bool_)
@@ -447,7 +499,7 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
         return (f"TpuShuffledHashJoinExec[{self.join_type}, "
                 f"keys={len(self.left_keys)}, partitions={n}]")
 
-    def execute(self, ctx: ExecContext):
+    def _execute_device(self, ctx: ExecContext):
         from .exchange import TpuShuffleExchangeExec
         lex, rex = self.children
         assert isinstance(lex, TpuShuffleExchangeExec) \
@@ -476,7 +528,7 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
             if rbatch is None:
                 rbatch = _empty_batch(rex.schema)
             produced = True
-            yield from self._join_stream(rbatch, [lbatch])
+            yield from self._join_stream(rbatch, [lbatch], ctx)
         if not produced:
             # downstream operators (e.g. a global aggregate) require at
             # least one batch to carry empty-input semantics

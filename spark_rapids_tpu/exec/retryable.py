@@ -1,0 +1,66 @@
+"""Retryable operator blocks: conf wiring for with_retry + CPU fallback.
+
+The exec-layer half of the retry framework (mem/retry.py): operators wrap
+their memory-hungry kernel calls in `run_retryable` (bounded same-size
+retries behind the spill cascade, then row-range split-and-retry), and
+their `execute` drivers in `execute_with_cpu_fallback`, which turns an
+exhausted retry block into a re-execution through the operator's CPU twin
+instead of a dead query (reference: Spark retries the whole task; here the
+downgrade is operator-local and recorded in `numCpuFallbacks`).
+
+The fallback only engages when the device generator has produced NOTHING
+yet — once batches were yielded downstream, re-running the operator on CPU
+would duplicate rows, so the error propagates instead.
+"""
+from __future__ import annotations
+
+import logging
+
+from .. import config as C
+from ..mem.retry import (RetryExhausted, split_batch_rows,  # noqa: F401
+                         with_retry)
+
+log = logging.getLogger("spark_rapids_tpu.retry")
+
+
+def run_retryable(ctx, metrics, name, fn, inputs, split=None):
+    """with_retry with knobs resolved from the session conf (cached on
+    the ExecContext — the exchange write path calls this once per
+    sub-batch, and the knobs are constant per query)."""
+    params = getattr(ctx, "_retry_params", None)
+    if params is None:
+        conf = ctx.conf
+        params = (int(conf.get(C.OOM_RETRY_MAX)),
+                  int(conf.get(C.OOM_RETRY_SPLIT_DEPTH)),
+                  bool(conf.get(C.OOM_RETRY_CHECKPOINT)))
+        ctx._retry_params = params
+    max_retries, max_split_depth, checkpoint = params
+    return with_retry(
+        fn, inputs, runtime=ctx.runtime, split=split,
+        max_retries=max_retries, max_split_depth=max_split_depth,
+        checkpoint=(ctx.runtime is not None and checkpoint),
+        metrics=metrics, name=name)
+
+
+def execute_with_cpu_fallback(op, ctx, device_gen, cpu_twin_factory):
+    """Drive `device_gen`; on RetryExhausted before the first yield, build
+    the operator's CPU twin and re-execute through it (results re-enter the
+    device plan via HostToDeviceExec)."""
+    produced = False
+    twin = None
+    try:
+        for out in device_gen:
+            produced = True
+            yield out
+        return
+    except RetryExhausted:
+        if produced or not bool(ctx.conf.get(C.OOM_CPU_FALLBACK)):
+            raise
+        twin = cpu_twin_factory()
+        if twin is None:
+            raise
+        op.metrics.add("numCpuFallbacks", 1)
+        log.warning("[tpu-retry] %s: OOM retries exhausted; "
+                    "re-executing on CPU", op.name)
+    from .basic import HostToDeviceExec
+    yield from HostToDeviceExec(twin).execute(ctx)

@@ -154,10 +154,33 @@ class TpuSortExec(TpuExec):
                            self.nulls_first)
         return batch.take(order)
 
+    def _cpu_twin(self):
+        """CPU re-execution plan for OOM fallback (exec/retryable.py)."""
+        from .basic import DeviceToHostExec
+        from .cpu_relational import CpuSortExec
+        return CpuSortExec(self.sort_exprs, self.ascending,
+                           self.nulls_first,
+                           DeviceToHostExec(self.children[0]))
+
     def execute(self, ctx: ExecContext):
+        from .retryable import execute_with_cpu_fallback
+        yield from execute_with_cpu_fallback(
+            self, ctx, self._execute_device(ctx), self._cpu_twin)
+
+    def _execute_device(self, ctx: ExecContext):
         from .. import config as C
         from ..utils.kernel_cache import cached_kernel
+        from .retryable import run_retryable
         fn = cached_kernel(self.kernel_key(), lambda: self._sort_kernel)
+
+        def attempt_sort(b):
+            # retry-only block: splitting a global sort batch would break
+            # total order; exhaustion falls back to the CPU sort instead.
+            # The reserve marks the lexsort's working-set boundary.
+            if ctx.runtime is not None:
+                ctx.runtime.reserve(b.device_size_bytes(), site="sort")
+            return fn(b)
+
         batches = list(self.children[0].execute(ctx))
         if not batches:
             return
@@ -174,7 +197,8 @@ class TpuSortExec(TpuExec):
             del batches  # the source owns (and drains) the only reference
             for part in ex.execute(ctx):
                 with self.metrics.timer("sortTime"):
-                    out = fn(part)
+                    out = run_retryable(ctx, self.metrics, "sort",
+                                        attempt_sort, [part])[0]
                 self.metrics.add("numOutputBatches", 1)
                 yield out
             return
@@ -183,7 +207,8 @@ class TpuSortExec(TpuExec):
         # full capacity otherwise — shrink first (batch.shrink_to)
         batch = batch.maybe_shrink(batch.num_rows_host())
         with self.metrics.timer("sortTime"):
-            out = fn(batch)
+            out = run_retryable(ctx, self.metrics, "sort",
+                                attempt_sort, [batch])[0]
         self.metrics.add("numOutputBatches", 1)
         yield out
 

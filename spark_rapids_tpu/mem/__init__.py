@@ -6,6 +6,8 @@ DeviceMemoryEventHandler / GpuSemaphore.
 """
 from .buffer import BatchMeta, SpillPriorities, StorageTier
 from .priority_queue import HashedPriorityQueue
+from .retry import (RetryExhausted, RetryOOM, RetryStateMachine,
+                    SplitAndRetryOOM, split_batch_rows, with_retry)
 from .runtime import DeviceMemoryEventHandler, TpuRuntime
 from .semaphore import TpuSemaphore
 from .stores import (BufferCatalog, DeviceMemoryStore, DiskStore,
@@ -16,4 +18,6 @@ __all__ = [
     "DeviceMemoryEventHandler", "TpuRuntime", "TpuSemaphore",
     "BufferCatalog", "DeviceMemoryStore", "DiskStore", "HostMemoryStore",
     "SpillableBuffer",
+    "RetryOOM", "SplitAndRetryOOM", "RetryExhausted", "RetryStateMachine",
+    "with_retry", "split_batch_rows",
 ]

@@ -1,0 +1,222 @@
+"""OOM retry / split-and-retry framework.
+
+TPU-native analogue of the reference's retryable-block machinery
+(RmmRapidsRetryIterator.scala — `withRetry`/`withRetryNoSplit` blocks over
+spillable inputs; GpuOutOfCoreSortIterator and friends supply splitters —
+plus the typed RetryOOM/SplitAndRetryOOM contract RmmSpark raises from the
+allocator).  The shape here:
+
+  * `reserve()` (mem/runtime.py) is the allocation boundary; on pressure it
+    spills synchronously and, when the pool still cannot admit the request,
+    raises `RetryOOM` — a MemoryError subclass, so legacy callers keep
+    working.
+  * `with_retry(fn, inputs, split=...)` drives the attempt loop: each input
+    is optionally CHECKPOINTED as a spillable buffer (pinned during the
+    attempt, spillable between attempts, re-materialized from whatever tier
+    it landed in), same-size retries are bounded, and exhaustion escalates
+    to the operator-supplied splitter which halves the input and retries
+    each half (depth-bounded).  `SplitAndRetryOOM` escalates immediately.
+  * When splitting is impossible or the depth budget is spent,
+    `RetryExhausted` surfaces — the signal exec-layer fallbacks
+    (exec/retryable.py) turn into a CPU re-execution instead of a dead
+    query.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+
+class RetryOOM(MemoryError):
+    """Allocation failed; the same-size attempt may succeed after a spill
+    (reference: com.nvidia.spark.rapids.jni.RetryOOM)."""
+
+    def __init__(self, msg: str, nbytes: int = 0, injected: bool = False):
+        super().__init__(msg)
+        self.nbytes = nbytes
+        self.injected = injected
+
+
+class SplitAndRetryOOM(MemoryError):
+    """Allocation failed and same-size retries are pointless; the caller
+    must shrink the attempt (reference: jni.SplitAndRetryOOM)."""
+
+    def __init__(self, msg: str, nbytes: int = 0, injected: bool = False):
+        super().__init__(msg)
+        self.nbytes = nbytes
+        self.injected = injected
+
+
+class RetryExhausted(MemoryError):
+    """A retryable block ran out of retries and split depth."""
+
+    def __init__(self, msg: str, cause: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.cause = cause
+
+
+class RetryStateMachine:
+    """Attempt bookkeeping for ONE work item: bounded same-size retries,
+    then escalate to split, then fail."""
+
+    RETRY, SPLIT, FAIL = "retry", "split", "fail"
+
+    def __init__(self, max_retries: int, max_split_depth: int,
+                 depth: int, can_split: bool):
+        self.max_retries = max_retries
+        self.max_split_depth = max_split_depth
+        self.depth = depth
+        self.can_split = can_split
+        self.attempts = 0
+
+    def _split_or_fail(self) -> str:
+        if self.can_split and self.depth < self.max_split_depth:
+            return self.SPLIT
+        return self.FAIL
+
+    def next_action(self, exc: BaseException) -> str:
+        if isinstance(exc, SplitAndRetryOOM):
+            return self._split_or_fail()
+        self.attempts += 1
+        if self.attempts <= self.max_retries:
+            return self.RETRY
+        return self._split_or_fail()
+
+
+class SpillableCheckpoint:
+    """Registers one input batch in the device store so the OOM->spill
+    cascade can evict it BETWEEN attempts; `acquire()` pins it for the
+    duration of an attempt (the reference's SpillableColumnarBatch around
+    withRetry inputs).
+
+    The caller (with_retry) holds the ORIGINAL batch object alive for the
+    splitter, so a post-spill acquire re-promotes the ACCOUNTING to the
+    device tier and hands back that original — never `_materialize`, which
+    would build a second device copy of data the caller still pins (under
+    genuine pressure that would double the very allocation being
+    retried).  For the same reason the checkpoint is NOT pinned while the
+    attempt runs: eviction mid-attempt only drops the tracked accounting
+    (the kernel computes on the caller's arrays regardless), so the spill
+    cascade inside the attempt's own reserve() may take it — without
+    this, a registered-but-pinned input would make every same-size retry
+    need strictly MORE accounted headroom than the first attempt."""
+
+    def __init__(self, runtime, batch):
+        self._rt = runtime
+        self._batch = batch
+        self._buf = runtime.device_store.add_batch(batch)
+
+    def acquire(self):
+        from .buffer import StorageTier
+        buf = self._rt.catalog.acquire(self._buf.id)
+        try:
+            with buf.lock:
+                if buf.tier != StorageTier.DEVICE:
+                    # spilled between attempts: re-admit the bytes (may
+                    # spill others or raise RetryOOM into the retry loop)
+                    self._rt.reserve(buf.size_bytes, site="checkpoint")
+                    for store in (self._rt.host_store, self._rt.disk_store):
+                        store.untrack(buf)
+                    if buf.disk_path:
+                        self._rt.disk_store.delete_file(buf)
+                    buf.host_leaves = None
+                    buf.device_batch = self._batch
+                    self._rt.device_store.track(buf)
+        finally:
+            self._rt.catalog.release(buf)
+        return self._batch
+
+    def release(self) -> None:
+        """No pin to drop (see class docstring); kept for the attempt
+        loop's symmetry."""
+
+    def close(self) -> None:
+        self._rt.free_batch(self._buf.id)
+
+
+def split_batch_rows(batch):
+    """Row-range split policy: the first half of the live rows and the
+    rest, each compacted into its own (smaller-capacity) batch.  Order is
+    preserved — piece 1's rows all precede piece 2's — so order-sensitive
+    consumers (First/Last offsets, sort-free concat) stay correct.
+    Returns None when the batch cannot be split further."""
+    import jax.numpy as jnp
+    from ..columnar.batch import bucket_rows
+    n = batch.num_rows_host()
+    if n < 2:
+        return None
+    half = n // 2
+    pos = jnp.cumsum(batch.sel.astype(jnp.int32)) - 1
+    first = batch.filter(pos < half).shrink_to(bucket_rows(max(half, 1)))
+    rest = batch.filter(pos >= half).shrink_to(
+        bucket_rows(max(n - half, 1)))
+    first.known_rows = half
+    rest.known_rows = n - half
+    return [first, rest]
+
+
+def with_retry(fn: Callable, inputs: Sequence, *, runtime=None,
+               split: Optional[Callable] = None, max_retries: int = 2,
+               max_split_depth: int = 4, checkpoint: bool = False,
+               metrics=None, name: str = "retryBlock") -> List:
+    """Run `fn(x)` for every input with OOM retry / split-and-retry.
+
+    Returns the list of results in input order; a split input contributes
+    one result per final piece (callers must tolerate >= len(inputs)
+    results — partial aggregates, shuffle sub-batches and probe outputs
+    all do).  `split(x)` returns a list of pieces or None when unsplittable.
+    `checkpoint=True` registers ColumnarBatch inputs as spillable buffers
+    between attempts (needs `runtime`) — LAZILY, on the first failure:
+    the fault-free fast path never registers anything (registration would
+    double-count the input against the accounting pool while it is
+    pinned), but once an attempt OOMs the input becomes evictable for the
+    spill cascade between the retries that follow."""
+    from ..columnar import ColumnarBatch
+    results: List = []
+    stack = [(x, 0) for x in reversed(list(inputs))]
+    while stack:
+        x, depth = stack.pop()
+        handle = None
+        sm = RetryStateMachine(max_retries, max_split_depth, depth,
+                               can_split=split is not None)
+        try:
+            while True:
+                try:
+                    arg = handle.acquire() if handle is not None else x
+                    try:
+                        results.append(fn(arg))
+                    finally:
+                        if handle is not None:
+                            handle.release()
+                    break
+                except RetryExhausted:
+                    # a NESTED retryable block (e.g. an async fetch inside
+                    # the attempt) already proved itself exhausted —
+                    # re-running it maxRetries more times would burn work
+                    # on a terminal signal; propagate to the CPU fallback
+                    raise
+                except MemoryError as e:
+                    action = sm.next_action(e)
+                    if action == RetryStateMachine.RETRY:
+                        if handle is None and checkpoint \
+                                and runtime is not None \
+                                and isinstance(x, ColumnarBatch):
+                            handle = SpillableCheckpoint(runtime, x)
+                        if metrics is not None:
+                            metrics.add(f"{name}Retries", 1)
+                        continue
+                    if action == RetryStateMachine.SPLIT:
+                        pieces = split(x)
+                        if pieces:
+                            if metrics is not None:
+                                metrics.add(f"{name}Splits", 1)
+                            stack.extend((p, depth + 1)
+                                         for p in reversed(pieces))
+                            break
+                    raise RetryExhausted(
+                        f"{name}: OOM retries exhausted "
+                        f"(attempts={sm.attempts}, depth={depth}): {e}",
+                        cause=e) from e
+        finally:
+            if handle is not None:
+                handle.close()
+    return results

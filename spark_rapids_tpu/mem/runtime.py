@@ -23,7 +23,9 @@ from ..columnar import ColumnarBatch
 from ..config import (CONCURRENT_TPU_TASKS, HOST_SPILL_STORAGE_SIZE,
                       TPU_ALLOC_FRACTION, TPU_DEBUG, TPU_OOM_SPILL_ENABLED,
                       TpuConf)
+from ..utils import faults
 from .buffer import SpillPriorities, StorageTier, host_to_batch, read_leaves
+from .retry import RetryOOM
 from .semaphore import TpuSemaphore
 from .stores import (BufferCatalog, DeviceMemoryStore, DiskStore,
                      HostMemoryStore, SpillableBuffer)
@@ -45,11 +47,18 @@ def _detect_hbm_bytes() -> int:
 
 
 class DeviceMemoryEventHandler:
-    """OOM->spill hook (DeviceMemoryEventHandler.scala:38-90)."""
+    """OOM->spill hook (DeviceMemoryEventHandler.scala:38-90).
 
-    def __init__(self, device_store: DeviceMemoryStore, debug: str = "NONE"):
+    `retry_count` is the spill-retry count of the CURRENT allocation
+    attempt (reset by `reserve()` per attempt); cumulative figures flow
+    into the runtime `metrics` so retries and spilled bytes are observable
+    from `pool_stats()`."""
+
+    def __init__(self, device_store: DeviceMemoryStore, debug: str = "NONE",
+                 metrics=None):
         self.device_store = device_store
         self.debug = debug
+        self.metrics = metrics
         self.retry_count = 0
 
     def on_alloc_failure(self, alloc_size: int) -> bool:
@@ -63,6 +72,9 @@ class DeviceMemoryEventHandler:
             print(f"[tpu-mem] alloc failure of {alloc_size}B: spilled "
                   f"{spilled}B from device store", file=out)
         self.retry_count += 1
+        if self.metrics is not None:
+            self.metrics.add("oomSpillRetries", 1)
+            self.metrics.add("oomSpillBytes", spilled)
         return spilled > 0
 
 
@@ -73,9 +85,12 @@ class TpuRuntime:
                  pool_limit_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None):
         self.conf = conf or TpuConf()
+        faults.INJECTOR.configure_from_conf(self.conf)
         frac = float(self.conf.get(TPU_ALLOC_FRACTION))
         self.pool_limit = (pool_limit_bytes if pool_limit_bytes is not None
                            else int(_detect_hbm_bytes() * frac))
+        from ..exec.base import Metrics
+        self.metrics = Metrics()
         self.catalog = BufferCatalog()
         self.device_store = DeviceMemoryStore(self.catalog)
         self.host_store = HostMemoryStore(
@@ -84,7 +99,8 @@ class TpuRuntime:
         self.device_store.spill_store = self.host_store
         self.host_store.spill_store = self.disk_store
         self.event_handler = DeviceMemoryEventHandler(
-            self.device_store, str(self.conf.get(TPU_DEBUG)).upper())
+            self.device_store, str(self.conf.get(TPU_DEBUG)).upper(),
+            self.metrics)
         self.oom_spill = bool(self.conf.get(TPU_OOM_SPILL_ENABLED))
         self.semaphore = TpuSemaphore(
             int(self.conf.get(CONCURRENT_TPU_TASKS)))
@@ -92,11 +108,16 @@ class TpuRuntime:
 
     # ---- allocation boundary ----------------------------------------------
 
-    def reserve(self, nbytes: int) -> None:
+    def reserve(self, nbytes: int, site: str = "reserve") -> None:
         """Account for an upcoming device allocation; spill if over budget.
 
-        Raises MemoryError when the pool cannot be brought under budget
-        (mirrors RMM throwing after the event handler declines to retry)."""
+        Raises RetryOOM (a MemoryError) when the pool cannot be brought
+        under budget (mirrors RMM throwing after the event handler declines
+        to retry); retryable blocks (mem/retry.py with_retry) catch it,
+        re-spill/split and re-enter here.  `site` labels the call for the
+        fault injector and test observability."""
+        faults.INJECTOR.on_reserve(site, nbytes)
+        self.event_handler.retry_count = 0  # fresh allocation attempt
         for _ in range(8):  # bounded retry loop
             used = self.device_store.current_size
             if used + nbytes <= self.pool_limit:
@@ -106,9 +127,11 @@ class TpuRuntime:
                 break
         used = self.device_store.current_size
         if used + nbytes > self.pool_limit:
-            raise MemoryError(
-                f"HBM pool exhausted: need {nbytes}B, used {used}B of "
-                f"{self.pool_limit}B and nothing left to spill")
+            self.metrics.add("oomAllocFailures", 1)
+            raise RetryOOM(
+                f"HBM pool exhausted at {site}: need {nbytes}B, used "
+                f"{used}B of {self.pool_limit}B and nothing left to spill",
+                nbytes=nbytes)
 
     # ---- spillable batch registry ------------------------------------------
 
@@ -130,7 +153,7 @@ class TpuRuntime:
                   ) -> int:
         """Register a device batch as spillable; returns its buffer id."""
         nbytes = batch.device_size_bytes()
-        self.reserve(nbytes)
+        self.reserve(nbytes, site="add_batch")
         bid = self.device_store.add_batch(batch, spill_priority).id
         if self._debug_on:
             self._debug_log(f"alloc id={bid} {nbytes}B "
@@ -159,7 +182,7 @@ class TpuRuntime:
             else:
                 leaves, src = read_leaves(buf.disk_path, buf.meta), \
                     self.disk_store
-            self.reserve(buf.size_bytes)
+            self.reserve(buf.size_bytes, site="materialize")
             batch = host_to_batch(leaves, buf.meta)
             src.untrack(buf)
             if buf.disk_path:
@@ -200,9 +223,11 @@ class TpuRuntime:
     # ---- stats -------------------------------------------------------------
 
     def pool_stats(self) -> dict:
-        return {
+        stats = {
             "pool_limit": self.pool_limit,
             "device_used": self.device_store.current_size,
             "host_used": self.host_store.current_size,
             "disk_used": self.disk_store.current_size,
         }
+        stats.update(self.metrics.values)
+        return stats

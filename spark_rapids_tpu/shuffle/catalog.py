@@ -77,6 +77,23 @@ class ShuffleReceivedBufferCatalog:
         with self._lock:
             self._received.setdefault(shuffle_id, []).append(buffer_id)
 
+    def snapshot(self, shuffle_id: int) -> int:
+        """Mark for `drop_since`: the current receive count (retryable
+        fetches roll back to it so a failed attempt's registrations do
+        not accumulate across retries)."""
+        with self._lock:
+            return len(self._received.get(shuffle_id, []))
+
+    def drop_since(self, shuffle_id: int, mark: int) -> List[int]:
+        """Unregister (and return for freeing) every buffer received
+        after `mark`."""
+        with self._lock:
+            lst = self._received.get(shuffle_id, [])
+            new = lst[mark:]
+            if new:
+                self._received[shuffle_id] = lst[:mark]
+            return new
+
     def remove_shuffle(self, shuffle_id: int) -> List[int]:
         with self._lock:
             return self._received.pop(shuffle_id, [])

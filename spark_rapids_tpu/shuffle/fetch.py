@@ -17,6 +17,8 @@ import queue
 import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
+from ..mem.retry import RetryExhausted
+
 
 class AsyncFetchIterator:
     """Iterates (reduce_id, batch) across `reduce_ids` with prefetch.
@@ -29,7 +31,8 @@ class AsyncFetchIterator:
 
     def __init__(self, env, shuffle_id: int, reduce_ids: Sequence[int],
                  remote_peers: Optional[List[str]] = None,
-                 max_inflight_bytes: int = 1 << 30, route=None):
+                 max_inflight_bytes: int = 1 << 30, route=None,
+                 oom_retries: int = 2):
         self._env = env
         self._sid = shuffle_id
         self._rids = list(reduce_ids)
@@ -38,6 +41,10 @@ class AsyncFetchIterator:
         # executor per partition (exchange._execute_partitions_cluster)
         self._route = route
         self._max = max(int(max_inflight_bytes), 1)
+        # OOM retries per partition fetch; catalog reads are idempotent,
+        # so a refetch is safe as long as NOTHING of that partition was
+        # handed to the consumer yet (_produce enforces that)
+        self._oom_retries = max(int(oom_retries), 0)
         self._q: "queue.Queue" = queue.Queue()
         self._cv = threading.Condition()
         self._inflight = 0
@@ -67,11 +74,43 @@ class AsyncFetchIterator:
                 self.prefetched_partitions.append(rid)
                 env, peers = (self._route(rid) if self._route is not None
                               else (self._env, self._peers))
-                for batch in env.fetch_partition(self._sid, rid, peers):
-                    nb = batch.device_size_bytes()
-                    if not self._admit(nb):
-                        return
-                    self._q.put((rid, batch, nb))
+                enqueued = 0
+                attempt = 0
+                while True:
+                    mark = (env.received.snapshot(self._sid)
+                            if hasattr(env, "received") else None)
+                    try:
+                        for batch in env.fetch_partition(self._sid, rid,
+                                                         peers):
+                            nb = batch.device_size_bytes()
+                            if not self._admit(nb):
+                                return
+                            self._q.put((rid, batch, nb))
+                            enqueued += 1
+                        break
+                    except MemoryError as e:
+                        # free the failed attempt's remote registrations
+                        # (a retry would re-fetch and duplicate them in
+                        # the pool exactly while memory is tightest)
+                        if mark is not None \
+                                and hasattr(env, "rollback_received"):
+                            env.rollback_received(self._sid, mark)
+                        # retry the whole partition ONLY while none of it
+                        # reached the consumer (a partial refetch would
+                        # duplicate rows); the spill cascade already ran
+                        # inside reserve()
+                        attempt += 1
+                        if enqueued or attempt > self._oom_retries:
+                            if isinstance(e, RetryExhausted):
+                                raise
+                            # typed exhaustion so the exchange's CPU
+                            # fallback (exec/retryable.py) engages on
+                            # this (default) read path too
+                            raise RetryExhausted(
+                                f"shuffle fetch of partition {rid} "
+                                f"exhausted OOM retries "
+                                f"(attempts={attempt}): {e}",
+                                cause=e) from e
             self._q.put(self._DONE)
         except BaseException as ex:  # surfaced in the consumer
             self._q.put(ex)

@@ -152,7 +152,11 @@ class ShuffleEnv:
         pinned = int(self.conf.get(PINNED_POOL_SIZE))
         if pinned > 0:
             kwargs["pool_size"] = pinned
-        return cls(**kwargs)
+        transport = cls(**kwargs)
+        if hasattr(transport, "configure"):
+            # retry/backoff/deadline knobs + fault-injection arming
+            transport.configure(self.conf)
+        return transport
 
     def baseline_leaves(self, buffer_id: int):
         with self._lock:
@@ -164,6 +168,14 @@ class ShuffleEnv:
         with self._lock:
             self._shuffle_counter[0] += 1
             return self._shuffle_counter[0]
+
+    def rollback_received(self, shuffle_id: int, mark: int) -> None:
+        """Free every remote buffer registered after `mark` (a failed
+        fetch attempt's partial registrations — a retry would otherwise
+        re-fetch and duplicate them in the pool while memory is
+        tightest)."""
+        for bid in self.received.drop_since(shuffle_id, mark):
+            self.runtime.free_batch(bid)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         for bid in self.catalog.remove_shuffle(shuffle_id):
@@ -207,7 +219,8 @@ class ShuffleEnv:
                 baseline = self.baseline_leaves(bid)
                 if baseline is not None:
                     leaves, meta = baseline
-                    self.runtime.reserve(meta.size_bytes)
+                    self.runtime.reserve(meta.size_bytes,
+                                         site="fetch_baseline")
                     yield host_to_batch(leaves, meta)
                 else:
                     yield self.runtime.get_batch(bid)
@@ -219,10 +232,12 @@ class ShuffleEnv:
         """Pipelined multi-partition read: fetch of partition k+1 overlaps
         consumption of partition k, bounded by maxReceiveInflightBytes
         (shuffle/fetch.py; reference RapidsShuffleIterator.scala:17-258)."""
+        from ..config import OOM_RETRY_MAX
         from .fetch import AsyncFetchIterator
         return AsyncFetchIterator(
             self, shuffle_id, reduce_ids, remote_peers,
-            int(self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)))
+            int(self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
+            oom_retries=int(self.conf.get(OOM_RETRY_MAX)))
 
     def _fetch_remote(self, peer: str, shuffle_id: int, reduce_id: int
                       ) -> Iterator[ColumnarBatch]:

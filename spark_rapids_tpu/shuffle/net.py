@@ -23,18 +23,25 @@ plane (shuffle/worker.py) — the analogue of UCX's management port.
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import faults
 from .transport import (BounceBufferPool, InflightThrottle, MetadataRequest,
                         MetadataResponse, ShuffleTransport,
-                        ShuffleTransportClient)
+                        ShuffleTransportClient, Transaction,
+                        TransactionCancelled, TransactionStatus)
+
+log = logging.getLogger("spark_rapids_tpu.shuffle")
 
 # opcodes
 OP_META, OP_META_RESP = 1, 2
@@ -121,18 +128,50 @@ class ShuffleSocketServer:
         self._threads.append(t)
 
     def _accept_loop(self) -> None:
+        consecutive_errors = 0
         while not self._closing:
             try:
                 conn, _ = self._listener.accept()
-            except OSError:
-                return
+            except OSError as e:
+                if self._closing:
+                    return
+                # transient accept failures (ECONNABORTED from a client
+                # abort, EMFILE during an fd burst) must not kill the
+                # server while the executor lives on looking healthy —
+                # count, log, and keep accepting; only a persistently
+                # broken listener stops the loop
+                self.transport.count("accept_errors")
+                consecutive_errors += 1
+                # generous tolerance: reconnect-per-retry clients churn
+                # connections during fault storms, and an fd burst
+                # (EMFILE) can persist for seconds — an executor that
+                # stops accepting while "looking healthy" costs every
+                # peer ioTimeout * maxAttempts per fetch until restart
+                if consecutive_errors > 20 or self._listener.fileno() < 0:
+                    log.error("shuffle server %s stopping after repeated "
+                              "accept failures: %r", self.address, e)
+                    return
+                log.warning("shuffle server %s accept failed "
+                            "(%d consecutive): %r", self.address,
+                            consecutive_errors, e)
+                time.sleep(min(1.0, 0.05 * consecutive_errors))
+                continue
+            consecutive_errors = 0
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True, name="shuffle-serve")
             t.start()
+            # prune finished handlers: reconnect-per-retry clients churn
+            # connections, and retaining every dead Thread forever is an
+            # unbounded leak in exactly the fault-heavy regime
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
+        try:
+            peer = conn.getpeername()
+        except OSError:
+            peer = "<unknown>"
         try:
             while True:
                 op, payload = recv_frame(conn)
@@ -160,13 +199,18 @@ class ShuffleSocketServer:
                     self._handle_rpc(conn, payload)
                 else:
                     raise ValueError(f"bad opcode {op}")
-        except (ConnectionError, OSError):
-            pass  # peer went away; its requests die with the connection
+        except (ConnectionError, OSError) as e:
+            # peer went away; its requests die with the connection — but
+            # the event is counted and logged with the peer address, not
+            # silently dropped (a flapping peer shows up in the counters)
+            self.transport.count("peer_disconnects")
+            if not self._closing:
+                log.info("shuffle peer %s disconnected: %r", peer, e)
         finally:
             try:
                 conn.close()
-            except OSError:
-                pass
+            except OSError as e:
+                log.debug("closing connection from %s: %r", peer, e)
 
     def _stream_buffer(self, conn: socket.socket, bid: int) -> None:
         """Send every leaf of a buffer as bounce-buffer-sized DATA frames,
@@ -245,21 +289,51 @@ class ShuffleSocketServer:
             send_frame(conn, OP_RPC_RESP, pickle.dumps(result))
         except Exception as e:  # noqa: BLE001 — crosses the wire
             import traceback
+            # counted and logged server-side too: the client may be gone
+            # by the time the error frame would reach it
+            self.transport.count("rpc_errors")
+            log.warning("shuffle rpc failed server-side: %r", e)
             send_frame(conn, OP_RPC_ERR,
                        pickle.dumps(f"{e!r}\n{traceback.format_exc()}"))
 
     def close(self) -> None:
         self._closing = True
+        # shutdown() BEFORE close(): on Linux, close() does not wake a
+        # thread blocked in accept() — the kernel keeps the listening
+        # socket alive for the in-flight syscall and KEEPS ACCEPTING,
+        # so a "closed" server would silently serve forever.  shutdown
+        # forces the blocked accept to return.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # not connected / already gone — nothing to wake
         try:
             self._listener.close()
-        except OSError:
-            pass
+        except OSError as e:
+            log.debug("closing shuffle listener %s: %r", self.address, e)
 
 
 class SocketClient(ShuffleTransportClient):
     """Fetch path to one remote executor over its TCP port.  One socket,
     requests serialized under a lock (the reference serializes per-endpoint
-    through UCX's tag space)."""
+    through UCX's tag space).
+
+    Robustness contract (reference: UCX endpoint error handler + the
+    RapidsShuffleClient retry/reissue path):
+
+      * every DATA-plane operation (metadata, layout, fetch, done) runs
+        under a per-op I/O deadline (`spark.rapids.shuffle.ioTimeoutMs`),
+        so a dead peer surfaces as a timeout instead of a hang;
+      * failed operations reconnect and retry with exponential backoff +
+        deterministic jitter, up to `spark.rapids.shuffle.retry.maxAttempts`
+        (requests restart from scratch on a FRESH socket — a half-read
+        frame poisons the stream);
+      * a whole fetch runs as a Transaction with an overall deadline
+        (`transactionTimeoutMs`); past it the transaction is CANCELLED and
+        no further retries are attempted;
+      * control-plane RPCs are exempt from the I/O deadline: task dispatch
+        legitimately blocks on the peer's first-query compilation.
+    """
 
     def __init__(self, transport: "SocketTransport",
                  addr: Tuple[str, int]):
@@ -267,16 +341,81 @@ class SocketClient(ShuffleTransportClient):
         self.addr = tuple(addr)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # deterministic jitter: seeded per peer address, not wall clock
+        self._rng = random.Random(f"shuffle-retry:{self.addr}")
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
-            s = socket.create_connection(self.addr, timeout=30)
-            # the 30s bound is for CONNECT only; requests block as long as
-            # the peer needs (first-query compiles exceed fixed timeouts)
-            s.settimeout(None)
+            t = self.transport
+            s = socket.create_connection(self.addr,
+                                         timeout=t.connect_timeout)
+            # the connect bound above is per-attempt; steady-state requests
+            # run under the (configurable) I/O deadline so a peer that dies
+            # mid-request raises instead of blocking forever
+            s.settimeout(t.io_timeout if t.io_timeout > 0 else None)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
         return self._sock
+
+    def _drop_socket(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError as e:
+                log.debug("closing shuffle socket to %s: %r", self.addr, e)
+            self._sock = None
+
+    def _backoff(self, attempt: int) -> float:
+        t = self.transport
+        raw = min(t.backoff_cap, t.backoff_base * (2 ** attempt))
+        return raw * (0.5 + self._rng.random() / 2)  # jittered
+
+    def _retrying(self, label: str, body, deadline: Optional[float] = None,
+                  txn: Optional[Transaction] = None):
+        """Run `body(sock)` with reconnect-and-retry.  Takes self._lock
+        per ATTEMPT and sleeps the backoff unlocked, so a concurrent
+        control-plane rpc() or close() to the same peer fails/finishes
+        fast instead of stalling behind the backoff series.  `deadline`
+        (monotonic) bounds the WHOLE operation including retries;
+        crossing it cancels the transaction."""
+        attempts = max(1, self.transport.max_attempts)
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            if deadline is not None and time.monotonic() > deadline:
+                with self._lock:
+                    self._drop_socket()
+                raise (txn.cancel(f"{label} to {self.addr} exceeded "
+                                  "the transaction deadline") if txn
+                       else TransactionCancelled(
+                           f"{label} to {self.addr} exceeded deadline"))
+            try:
+                with self._lock:
+                    faults.INJECTOR.on_net_op(label)
+                    return body(self._conn())
+            except TransactionCancelled:
+                with self._lock:
+                    self._drop_socket()  # the stream is poisoned mid-frame
+                raise
+            except (TimeoutError, ConnectionError, OSError) as e:
+                # socket.timeout is a TimeoutError (itself an OSError);
+                # injected faults are ConnectionErrors.  All of them tear
+                # the socket down so the next attempt starts clean.
+                with self._lock:
+                    self._drop_socket()
+                last = e
+                self.transport.count("net_op_failures")
+                log.warning("shuffle %s to %s failed "
+                            "(attempt %d/%d): %r", label, self.addr,
+                            attempt + 1, attempts, e)
+                if attempt + 1 >= attempts:
+                    break
+                self.transport.count("net_op_retries")
+                time.sleep(self._backoff(attempt))
+        if txn is not None:
+            txn.fail(repr(last))
+        raise ConnectionError(
+            f"shuffle {label} to {self.addr} failed after "
+            f"{attempts} attempts: {last!r}") from last
 
     def _request(self, op: int, payload, expect: int) -> bytes:
         sock = self._conn()
@@ -289,9 +428,10 @@ class SocketClient(ShuffleTransportClient):
         return resp
 
     def fetch_metadata(self, request: MetadataRequest) -> MetadataResponse:
-        with self._lock:
-            resp = self._request(OP_META, pickle.dumps(request),
-                                 OP_META_RESP)
+        blob = pickle.dumps(request)
+        resp = self._retrying(
+            "metadata", lambda _s: self._request(OP_META, blob,
+                                                 OP_META_RESP))
         self.transport.count("metadata_fetched")
         return pickle.loads(resp)
 
@@ -304,17 +444,31 @@ class SocketClient(ShuffleTransportClient):
         try:
             fd, path = tempfile.mkstemp(prefix=os.path.basename(SHM_PREFIX),
                                         dir=os.path.dirname(SHM_PREFIX))
-        except OSError:
+        except OSError as e:
+            log.info("shm fetch unavailable (%r); falling back to the "
+                     "socket stream", e)
+            self.transport.count("shm_unavailable")
             return None
         mm = None
         try:
             os.ftruncate(fd, max(total, 1))
             mm = mmap.mmap(fd, max(total, 1))
-            with self._lock:
-                sock = self._conn()
-                send_frame(sock, OP_FETCH_SHM,
-                           pickle.dumps((buffer_id, path)))
-                op, _length = recv_frame(sock)
+            try:
+                with self._lock:
+                    faults.INJECTOR.on_net_op("fetch_shm")
+                    sock = self._conn()
+                    send_frame(sock, OP_FETCH_SHM,
+                               pickle.dumps((buffer_id, path)))
+                    op, _length = recv_frame(sock)
+            except (TimeoutError, ConnectionError, OSError) as e:
+                # single attempt: the caller streams over the socket
+                # instead (which carries the full retry machinery)
+                log.warning("shm fetch of buffer %d from %s failed: %r",
+                            buffer_id, self.addr, e)
+                self.transport.count("net_op_failures")
+                with self._lock:
+                    self._drop_socket()
+                return None
             if op != OP_END:
                 return None
             # copy out of the segment: a zero-copy variant (arrays
@@ -341,14 +495,22 @@ class SocketClient(ShuffleTransportClient):
             os.close(fd)
             try:
                 os.unlink(path)
-            except OSError:
-                pass
+            except OSError as e:
+                log.debug("unlinking shm segment %s: %r", path, e)
 
     def fetch_buffer(self, buffer_id: int):
-        with self._lock:
-            resp = self._request(OP_LAYOUT,
-                                 struct.pack(">Q", buffer_id),
-                                 OP_LAYOUT_RESP)
+        # one fetch == one Transaction: layout + every data frame + END
+        # under a single overall deadline, so a peer that dies mid-stream
+        # cancels the transaction instead of hanging the reduce task
+        txn = self.transport.next_txn()
+        deadline = (time.monotonic() + self.transport.txn_timeout
+                    if self.transport.txn_timeout > 0 else None)
+        resp = self._retrying(
+            "layout",
+            lambda _s: self._request(OP_LAYOUT,
+                                     struct.pack(">Q", buffer_id),
+                                     OP_LAYOUT_RESP),
+            deadline=deadline, txn=txn)
         layout, meta = pickle.loads(resp)
         total = sum(nb for _, _, nb in layout)
         self.transport.throttle.acquire(total)
@@ -358,15 +520,21 @@ class SocketClient(ShuffleTransportClient):
                 got = self._fetch_buffer_shm(layout, meta, buffer_id,
                                              total)
                 if got is not None:
+                    txn.complete(total)
                     return got
-            with self._lock:
-                sock = self._conn()
+
+            def stream(sock) -> List[np.ndarray]:
                 send_frame(sock, OP_FETCH, struct.pack(">Q", buffer_id))
                 out: List[np.ndarray] = []
                 for (shape, dtype_str, nbytes) in layout:
                     dest = np.empty(nbytes, dtype=np.uint8)
                     off = 0
                     while off < nbytes:
+                        if deadline is not None \
+                                and time.monotonic() > deadline:
+                            raise txn.cancel(
+                                f"fetch of buffer {buffer_id} from "
+                                f"{self.addr} mid-stream at {off}/{nbytes}")
                         op, length = recv_frame_into(sock, dest, off)
                         if op != OP_DATA:
                             raise ConnectionError(
@@ -378,20 +546,50 @@ class SocketClient(ShuffleTransportClient):
                 op, _ = recv_frame(sock)
                 if op != OP_END:
                     raise ConnectionError(f"expected END, got {op}")
+                return out
+
+            out = self._retrying("fetch", stream, deadline=deadline,
+                                 txn=txn)
+            txn.complete(total)
             return out, meta
         finally:
             self.transport.throttle.release(total)
 
     def release_buffer(self, buffer_id: int) -> None:
-        with self._lock:
-            self._request(OP_DONE, struct.pack(">Q", buffer_id), OP_ACK)
+        # done_serving is idempotent at the server, so the retry is safe
+        self._retrying(
+            "done", lambda _s: self._request(
+                OP_DONE, struct.pack(">Q", buffer_id), OP_ACK))
 
     def rpc(self, method: str, **kwargs):
-        """Control-plane call (worker management; UCX mgmt-port analogue)."""
+        """Control-plane call (worker management; UCX mgmt-port analogue).
+
+        Deliberately NOT retried (run_map/run_reduce are not idempotent)
+        and exempt from the data-plane I/O deadline: the first dispatch of
+        a plan fragment blocks on the PEER's query compilation, which can
+        legitimately exceed any fixed bound."""
         with self._lock:
-            sock = self._conn()
-            send_frame(sock, OP_RPC, pickle.dumps((method, kwargs)))
-            op, resp = recv_frame(sock)
+            faults.INJECTOR.on_net_op("rpc")
+            try:
+                sock = self._conn()
+                sock.settimeout(None)  # compile-friendly: no I/O deadline
+                try:
+                    send_frame(sock, OP_RPC, pickle.dumps((method, kwargs)))
+                    op, resp = recv_frame(sock)
+                finally:
+                    if self._sock is not None:
+                        try:
+                            self._sock.settimeout(
+                                self.transport.io_timeout
+                                if self.transport.io_timeout > 0 else None)
+                        except OSError:
+                            self._drop_socket()  # broken mid-rpc
+            except (TimeoutError, ConnectionError, OSError) as e:
+                self._drop_socket()
+                self.transport.count("net_op_failures")
+                log.warning("shuffle rpc %s to %s failed: %r", method,
+                            self.addr, e)
+                raise
         if op == OP_RPC_ERR:
             raise RuntimeError(f"worker rpc {method} failed: "
                                f"{pickle.loads(resp)}")
@@ -401,12 +599,7 @@ class SocketClient(ShuffleTransportClient):
 
     def close(self) -> None:
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+            self._drop_socket()
 
 
 class SocketTransport(ShuffleTransport):
@@ -420,7 +613,10 @@ class SocketTransport(ShuffleTransport):
                  max_inflight_bytes: int = 4 << 20,
                  host: str = "127.0.0.1", port: int = 0,
                  rpc_handler: Optional[Callable] = None,
-                 shm_local: bool = False):
+                 shm_local: bool = False,
+                 connect_timeout: float = 30.0, io_timeout: float = 60.0,
+                 max_attempts: int = 4, backoff_base: float = 0.05,
+                 backoff_cap: float = 2.0, txn_timeout: float = 600.0):
         # measured on 128MB partitions (BENCH_WIRE.json): the pipelined
         # chunked stream does ~1.05 GB/s on loopback while the serial
         # fill-then-copy shm path does ~0.7 GB/s — so the stream is the
@@ -432,12 +628,39 @@ class SocketTransport(ShuffleTransport):
         self.throttle = InflightThrottle(max_inflight_bytes)
         self._host, self._port = host, port
         self.rpc_handler = rpc_handler
+        # retry/deadline policy (seconds); configure(conf) overrides from
+        # the spark.rapids.shuffle.* knobs
+        self.connect_timeout = connect_timeout
+        self.io_timeout = io_timeout
+        self.max_attempts = max_attempts
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.txn_timeout = txn_timeout
         self._peers: Dict[str, Tuple[str, int]] = {}
         self._clients: Dict[str, SocketClient] = {}
         self._server: Optional[ShuffleSocketServer] = None
         self.address: Optional[Tuple[str, int]] = None
         self._lock = threading.Lock()
+        self._txn_counter = 0
         self.counters: Dict[str, int] = {}
+
+    def configure(self, conf) -> None:
+        """Adopt retry/deadline knobs from a TpuConf (and arm the fault
+        injector from its test confs)."""
+        from .. import config as C
+        faults.INJECTOR.configure_from_conf(conf)
+        self.connect_timeout = int(conf.get(C.SHUFFLE_CONNECT_TIMEOUT)) / 1e3
+        self.io_timeout = int(conf.get(C.SHUFFLE_IO_TIMEOUT)) / 1e3
+        self.max_attempts = int(conf.get(C.SHUFFLE_RETRY_ATTEMPTS))
+        self.backoff_base = int(conf.get(C.SHUFFLE_RETRY_BACKOFF_BASE)) / 1e3
+        self.backoff_cap = int(conf.get(C.SHUFFLE_RETRY_BACKOFF_CAP)) / 1e3
+        self.txn_timeout = int(conf.get(C.SHUFFLE_TXN_TIMEOUT)) / 1e3
+
+    def next_txn(self) -> Transaction:
+        with self._lock:
+            self._txn_counter += 1
+            return Transaction(self._txn_counter,
+                               TransactionStatus.IN_PROGRESS)
 
     def count(self, key: str, n: int = 1) -> None:
         with self._lock:

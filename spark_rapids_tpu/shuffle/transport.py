@@ -38,6 +38,12 @@ class TransactionStatus(enum.Enum):
     CANCELLED = 4
 
 
+class TransactionCancelled(RuntimeError):
+    """A shuffle transaction blew its overall deadline and was cancelled.
+    Deliberately NOT an OSError: the per-op retry loop must not retry a
+    cancelled transaction (the deadline already covered the retries)."""
+
+
 @dataclass
 class Transaction:
     txn_id: int
@@ -52,6 +58,14 @@ class Transaction:
     def fail(self, msg: str) -> None:
         self.status = TransactionStatus.ERROR
         self.error_message = msg
+
+    def cancel(self, msg: str) -> "TransactionCancelled":
+        """Mark cancelled and build the error to raise (the caller
+        raises, so tracebacks point at the cancelling site)."""
+        self.status = TransactionStatus.CANCELLED
+        self.error_message = msg
+        return TransactionCancelled(
+            f"shuffle transaction {self.txn_id} cancelled: {msg}")
 
 
 # ---- control messages (the .fbs schemas, as dataclasses) -------------------

@@ -63,6 +63,7 @@ class WorkerHandler:
         if pinned > 0:
             kwargs["pool_size"] = pinned
         self.transport = SocketTransport(**kwargs)
+        self.transport.configure(self.session.conf)
         self.env = ShuffleEnv(self.runtime, self.session.conf, executor_id,
                               self.transport)
         # exchange execs resolve the env through the runtime singleton

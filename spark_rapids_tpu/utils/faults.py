@@ -1,0 +1,167 @@
+"""Deterministic fault injection for retry-path testing.
+
+TPU-native analogue of RmmSpark's OOM injection points (the reference
+forces `RetryOOM`/`SplitAndRetryOOM` at the Nth allocation from test
+hooks, spark-rapids-jni RmmSpark.forceRetryOOM/forceSplitAndRetryOOM) plus
+a network-side twin for the shuffle wire.  Everything is conf-driven so
+tier-1 tests exercise every retry path on CPU with zero real pressure:
+
+  spark.rapids.tpu.test.injectOom       fail the Nth `reserve()` call
+  spark.rapids.tpu.test.injectNetFault  fail the Nth client socket op
+  spark.rapids.tpu.test.injectSeed      seed for the probabilistic mode
+
+Spec grammar (comma-separated items, 1-based ordinals over the process-wide
+op counter of that category):
+
+  "3"          fail op #3 once (RetryOOM / ConnectionError)
+  "3x2"        fail ops #3 and #4 (a window: exhausts same-size retries)
+  "split@5"    fail op #5 with SplitAndRetryOOM (OOM category only)
+  "p=0.05"     fail each op with probability 0.05, seeded by injectSeed
+
+The injector is process-global, thread-safe, and counts every observed op
+per site label, so a test can run fault-free once to DISCOVER the reserve
+sites of a query and then replay with each ordinal forced to fail.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class InjectedNetFault(ConnectionError):
+    """A network fault forced by the injector (distinguishable from real
+    socket errors in tests)."""
+
+
+class _Plan:
+    """Parsed failure plan for one fault category."""
+
+    def __init__(self, spec: str = "", seed: int = 0):
+        self.spec = spec
+        self.ordinals: Dict[int, str] = {}  # ordinal -> kind
+        self.prob = 0.0
+        self.rng = random.Random(seed)
+        for raw in (spec or "").split(","):
+            item = raw.strip()
+            if not item:
+                continue
+            if item.startswith("p="):
+                self.prob = float(item[2:])
+                continue
+            kind = "retry"
+            if "@" in item:
+                kind, item = item.split("@", 1)
+            if "x" in item:
+                start_s, rep_s = item.split("x", 1)
+                start, rep = int(start_s), int(rep_s)
+            else:
+                start, rep = int(item), 1
+            for o in range(start, start + rep):
+                self.ordinals[o] = kind
+
+    def check(self, n: int) -> Optional[str]:
+        """Kind of fault to force at op #n, or None."""
+        kind = self.ordinals.get(n)
+        if kind is not None:
+            return kind
+        if self.prob > 0 and self.rng.random() < self.prob:
+            return "retry"
+        return None
+
+
+class FaultInjector:
+    """Process-global deterministic fault source (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._configured: Optional[Tuple[str, str, int]] = None
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._oom = _Plan()
+            self._net = _Plan()
+            self._oom_count = 0
+            self._net_count = 0
+            self._configured = None
+            self.site_counts: Dict[str, int] = {}
+            self.injected_log: List[Tuple[str, int, str]] = []
+
+    def configure(self, oom_spec: str = "", net_spec: str = "",
+                  seed: int = 0) -> None:
+        """(Re)arm the injector.  Counters reset only when the spec actually
+        changes, so every runtime/transport bring-up in one query can call
+        this without restarting the op count mid-flight."""
+        key = (oom_spec or "", net_spec or "", int(seed))
+        with self._lock:
+            if self._configured == key:
+                return
+            self._configured = key
+            self._oom = _Plan(key[0], seed=key[2])
+            self._net = _Plan(key[1], seed=key[2] + 1)
+            self._oom_count = 0
+            self._net_count = 0
+            self.site_counts = {}
+            self.injected_log = []
+
+    def configure_from_conf(self, conf) -> None:
+        from .. import config as C
+        self.configure(str(conf.get(C.TEST_INJECT_OOM) or ""),
+                       str(conf.get(C.TEST_INJECT_NET) or ""),
+                       int(conf.get(C.TEST_INJECT_SEED) or 0))
+
+    # ---- stats (test observability) ----------------------------------------
+
+    @property
+    def oom_ops(self) -> int:
+        with self._lock:
+            return self._oom_count
+
+    @property
+    def net_ops(self) -> int:
+        with self._lock:
+            return self._net_count
+
+    # ---- hooks -------------------------------------------------------------
+
+    def on_reserve(self, site: str, nbytes: int) -> None:
+        """Called at the top of every `TpuRuntime.reserve()`.  Raises the
+        planned OOM kind for this ordinal.
+
+        Counting stays on even when no spec is armed: tests DISCOVER a
+        query's reserve sites from a fault-free baseline run before
+        replaying with each ordinal forced.  The cost is one uncontended
+        lock + two dict ops per reserve(), which guards whole-batch
+        device work — never a per-row path."""
+        with self._lock:
+            self._oom_count += 1
+            n = self._oom_count
+            self.site_counts[site] = self.site_counts.get(site, 0) + 1
+            kind = self._oom.check(n)
+            if kind is not None:
+                self.injected_log.append(("oom", n, site))
+        if kind is not None:
+            from ..mem.retry import RetryOOM, SplitAndRetryOOM
+            cls = SplitAndRetryOOM if kind == "split" else RetryOOM
+            raise cls(f"[fault-injection] forced OOM at reserve #{n} "
+                      f"(site={site}, {nbytes}B)", nbytes=nbytes,
+                      injected=True)
+
+    def on_net_op(self, site: str) -> None:
+        """Called before every client-side shuffle socket operation."""
+        with self._lock:
+            self._net_count += 1
+            n = self._net_count
+            key = f"net:{site}"
+            self.site_counts[key] = self.site_counts.get(key, 0) + 1
+            kind = self._net.check(n)
+            if kind is not None:
+                self.injected_log.append(("net", n, site))
+        if kind is not None:
+            raise InjectedNetFault(
+                f"[fault-injection] forced net fault at op #{n} "
+                f"(site={site})")
+
+
+INJECTOR = FaultInjector()

@@ -38,3 +38,14 @@ def _bound_xla_code_memory():
     _test_count["n"] += 1
     if _test_count["n"] % _TESTS_PER_CACHE_CLEAR == 0:
         jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_injector():
+    """Disarm + zero the process-global fault injector around every test so
+    the `faultinject` tier's ordinals are deterministic and no armed spec
+    leaks into unrelated tests."""
+    from spark_rapids_tpu.utils import faults
+    faults.INJECTOR.reset()
+    yield
+    faults.INJECTOR.reset()

@@ -1,0 +1,592 @@
+"""OOM retry / split-and-retry framework + deterministic fault injection.
+
+Three layers, all on CPU (ISSUE 1 acceptance):
+
+  * unit: with_retry / RetryStateMachine / split_batch_rows /
+    SpillableCheckpoint semantics, injector spec parsing + determinism;
+  * OOM end-to-end: a TPC-H-slice query (partitioned join -> grouped agg ->
+    sort) with `spark.rapids.tpu.test.injectOom` forcing a failure at EVERY
+    reserve site, one at a time — results must equal the fault-free run
+    (via spill-retry, split-and-retry, or recorded CPU fallback);
+  * net end-to-end: a loopback SocketTransport shuffle with injected
+    socket faults (backoff + retry succeeds), a dead peer (bounded-time
+    cancellation instead of a hang), and a transaction deadline.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.engine import TpuSession
+from spark_rapids_tpu.mem.retry import (RetryExhausted, RetryOOM,
+                                        RetryStateMachine, SplitAndRetryOOM,
+                                        split_batch_rows, with_retry)
+from spark_rapids_tpu.plan.logical import col, functions as F, lit
+from spark_rapids_tpu.utils import faults
+
+pytestmark = pytest.mark.faultinject
+
+
+# --------------------------------------------------------------------------
+# unit: with_retry / state machine / splitter
+# --------------------------------------------------------------------------
+
+def test_with_retry_passthrough():
+    assert with_retry(lambda x: x * 2, [1, 2, 3]) == [2, 4, 6]
+
+
+def test_with_retry_transient_oom_retries():
+    calls = {"n": 0}
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RetryOOM("transient", nbytes=64)
+        return x
+
+    assert with_retry(flaky, ["ok"], max_retries=2) == ["ok"]
+    assert calls["n"] == 2
+
+
+def test_with_retry_split_and_retry():
+    """A persistently-failing input is halved until pieces succeed; piece
+    results come back in input order."""
+    def fn(x):
+        if len(x) > 2:
+            raise RetryOOM("too big", nbytes=len(x))
+        return list(x)
+
+    def split(x):
+        if len(x) < 2:
+            return None
+        h = len(x) // 2
+        return [x[:h], x[h:]]
+
+    out = with_retry(fn, [[1, 2, 3, 4, 5, 6, 7, 8]], split=split,
+                     max_retries=0, max_split_depth=4)
+    assert [v for piece in out for v in piece] == [1, 2, 3, 4, 5, 6, 7, 8]
+    assert all(len(p) <= 2 for p in out)
+
+
+def test_with_retry_split_oom_escalates_immediately():
+    calls = {"n": 0}
+
+    def fn(x):
+        calls["n"] += 1
+        if len(x) > 2:
+            raise SplitAndRetryOOM("split me", nbytes=len(x))
+        return x
+
+    with_retry(fn, [[1, 2, 3, 4]], split=lambda x: [x[:2], x[2:]],
+               max_retries=5)
+    # no same-size retries happened: 1 failing call + 2 piece calls
+    assert calls["n"] == 3
+
+
+def test_with_retry_exhaustion_raises():
+    def fn(_):
+        raise RetryOOM("always", nbytes=1)
+    with pytest.raises(RetryExhausted):
+        with_retry(fn, [1], max_retries=1)  # no splitter
+    with pytest.raises(RetryExhausted):
+        with_retry(fn, [[1]], split=lambda x: None, max_retries=1)
+
+
+def test_retry_state_machine_transitions():
+    sm = RetryStateMachine(max_retries=2, max_split_depth=3, depth=0,
+                           can_split=True)
+    oom = RetryOOM("x")
+    assert sm.next_action(oom) == RetryStateMachine.RETRY
+    assert sm.next_action(oom) == RetryStateMachine.RETRY
+    assert sm.next_action(oom) == RetryStateMachine.SPLIT
+    assert sm.next_action(SplitAndRetryOOM("y")) == RetryStateMachine.SPLIT
+    deep = RetryStateMachine(2, 3, depth=3, can_split=True)
+    deep.attempts = 2
+    assert deep.next_action(oom) == RetryStateMachine.FAIL
+
+
+def test_split_batch_rows_preserves_order_and_values():
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    table = pa.table({"a": list(range(100)),
+                      "b": [float(i) * 1.5 for i in range(100)]})
+    batch = ColumnarBatch.from_arrow(table)
+    pieces = split_batch_rows(batch)
+    assert len(pieces) == 2
+    got = [r for p in pieces for r in p.to_pylist()]
+    assert got == batch.to_pylist()
+    assert pieces[0].capacity < batch.capacity or batch.capacity == 1024
+    # a 1-row batch cannot split
+    one = ColumnarBatch.from_arrow(pa.table({"a": [7]}))
+    assert split_batch_rows(one) is None
+
+
+def test_spillable_checkpoint_restores_after_spill():
+    """An input registered by the retry block survives a spill between
+    attempts and re-materializes row-identical."""
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.mem.retry import SpillableCheckpoint
+    from spark_rapids_tpu.mem.runtime import TpuRuntime
+    rt = TpuRuntime(TpuConf(), pool_limit_bytes=64 << 20)
+    table = pa.table({"a": list(range(50)), "s": [f"r{i}" for i in
+                                                  range(50)]})
+    batch = ColumnarBatch.from_arrow(table)
+    cp = SpillableCheckpoint(rt, batch)
+    first = cp.acquire()
+    assert first.to_pylist() == batch.to_pylist()
+    cp.release()
+    # evict everything between attempts (the OOM hook's job)
+    rt.device_store.synchronous_spill(0)
+    assert rt.device_store.current_size == 0
+    again = cp.acquire()
+    assert again.to_pylist() == batch.to_pylist()
+    cp.release()
+    cp.close()
+    assert rt.device_store.current_size == 0
+
+
+# --------------------------------------------------------------------------
+# unit: injector determinism
+# --------------------------------------------------------------------------
+
+def test_injector_ordinal_specs():
+    inj = faults.FaultInjector()
+    inj.configure(oom_spec="2,4x2,split@7")
+    hits = []
+    for i in range(1, 9):
+        try:
+            inj.on_reserve("t", 8)
+        except SplitAndRetryOOM:
+            hits.append((i, "split"))
+        except RetryOOM:
+            hits.append((i, "retry"))
+    assert hits == [(2, "retry"), (4, "retry"), (5, "retry"),
+                    (7, "split")]
+    assert inj.oom_ops == 8
+    assert inj.site_counts["t"] == 8
+
+
+def test_injector_probabilistic_mode_is_seeded():
+    def run(seed):
+        inj = faults.FaultInjector()
+        inj.configure(oom_spec="p=0.3", seed=seed)
+        out = []
+        for _ in range(50):
+            try:
+                inj.on_reserve("t", 1)
+                out.append(0)
+            except MemoryError:
+                out.append(1)
+        return out
+    assert run(7) == run(7)
+    assert run(7) != run(8)
+    assert sum(run(7)) > 0
+
+
+def test_injector_thread_safety_counts_every_op():
+    inj = faults.FaultInjector()
+    inj.configure(net_spec="")  # armed but never firing
+
+    def worker():
+        for _ in range(500):
+            inj.on_net_op("x")
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert inj.net_ops == 4000
+
+
+def test_injector_reconfigure_same_spec_keeps_counter():
+    inj = faults.FaultInjector()
+    inj.configure(oom_spec="99")
+    inj.on_reserve("a", 1)
+    inj.configure(oom_spec="99")  # same spec: second runtime bring-up
+    assert inj.oom_ops == 1
+    inj.configure(oom_spec="98")  # new spec: fresh counter
+    assert inj.oom_ops == 0
+
+
+# --------------------------------------------------------------------------
+# end-to-end: OOM injection at every reserve site of a TPC-H-slice query
+# --------------------------------------------------------------------------
+
+# partitioned join + grouped agg + global sort, streaming (non-whole-stage)
+# so every operator reserve site is live
+_SLICE_CONF = {
+    "spark.rapids.sql.tpu.wholeStage.enabled": "false",
+    "spark.rapids.sql.tpu.join.partitioned.threshold": "1",
+    "spark.sql.autoBroadcastJoinThreshold": "-1",
+    "spark.rapids.sql.tpu.shuffle.partitions": "4",
+    "spark.rapids.sql.variableFloatAgg.enabled": "true",
+}
+
+
+def _slice_query(extra_conf=None):
+    faults.INJECTOR.reset()
+    conf = dict(_SLICE_CONF)
+    conf.update(extra_conf or {})
+    s = TpuSession(conf)
+    n = 400
+    fact = s.from_pydict({
+        "k": [i % 7 for i in range(n)],
+        "v": [float(i) for i in range(n)],
+        "q": [i % 3 for i in range(n)],
+    })
+    dim = s.from_pydict({"k": list(range(7)),
+                         "name": [f"g{j}" for j in range(7)]})
+    return (fact.join(dim, on="k")
+            .filter(col("q") < 2)
+            .group_by(col("name"))
+            .agg(F.sum(col("v")).alias("sv"),
+                 F.count(lit(1)).alias("c"))
+            .order_by(col("name"))
+            .collect())
+
+
+def test_oom_injection_every_reserve_site_identical_results():
+    baseline = _slice_query()
+    n_ops = faults.INJECTOR.oom_ops
+    sites = dict(faults.INJECTOR.site_counts)
+    assert n_ops > 5, f"query exposed too few reserve sites: {sites}"
+    # every operator layer is represented among the reserve sites
+    for expected in ("agg.update", "join.build", "join.probe",
+                     "exchange.partition", "add_batch", "sort"):
+        assert expected in sites, (expected, sites)
+    for ordinal in range(1, n_ops + 1):
+        out = _slice_query({"spark.rapids.tpu.test.injectOom":
+                            str(ordinal)})
+        assert out == baseline, f"ordinal {ordinal} changed the result"
+        assert faults.INJECTOR.injected_log, \
+            f"ordinal {ordinal} never fired"
+
+
+def test_oom_split_and_retry_window_identical_results():
+    """A multi-failure window exhausts same-size retries and forces the
+    row-range split; results still match."""
+    baseline = _slice_query()
+    out = _slice_query({
+        "spark.rapids.tpu.test.injectOom": "1x3,9x3",
+        "spark.rapids.memory.tpu.retry.maxRetries": "1",
+    })
+    assert out == baseline
+    assert len(faults.INJECTOR.injected_log) >= 4
+
+
+def test_oom_distinct_agg_never_splits_the_update_batch():
+    """Distinct partial states are not mergeable across batches, so the
+    retry block must NOT row-split a distinct update — a failure window
+    wide enough to force splits elsewhere still returns exact distinct
+    counts (retry or CPU fallback only)."""
+    def q(extra=None):
+        faults.INJECTOR.reset()
+        conf = dict(_SLICE_CONF)
+        conf.update(extra or {})
+        s = TpuSession(conf)
+        n = 300
+        df = s.from_pydict({"k": [i % 4 for i in range(n)],
+                            "v": [i % 11 for i in range(n)]})
+        return (df.group_by(col("k"))
+                .agg(F.count_distinct(col("v")).alias("cd"))
+                .order_by(col("k")).collect())
+    baseline = q()
+    out = q({"spark.rapids.tpu.test.injectOom": "1x3",
+             "spark.rapids.memory.tpu.retry.maxRetries": "1"})
+    assert out == baseline
+
+
+def test_oom_cpu_fallback_identical_results(caplog):
+    """Zero retry budget + zero split depth: the operator that owns the
+    first reserve site downgrades to its CPU path; results still match."""
+    import logging
+    baseline = _slice_query()
+    with caplog.at_level(logging.WARNING, logger="spark_rapids_tpu.retry"):
+        out = _slice_query({
+            "spark.rapids.tpu.test.injectOom": "1x200",
+            "spark.rapids.memory.tpu.retry.maxRetries": "0",
+            "spark.rapids.memory.tpu.retry.maxSplitDepth": "0",
+        })
+    assert out == baseline
+    assert any("[tpu-retry]" in r.message for r in caplog.records)
+
+
+def test_oom_fallback_disabled_fails_query():
+    with pytest.raises(MemoryError):
+        _slice_query({
+            "spark.rapids.tpu.test.injectOom": "1x200",
+            "spark.rapids.memory.tpu.retry.maxRetries": "0",
+            "spark.rapids.memory.tpu.retry.maxSplitDepth": "0",
+            "spark.rapids.sql.tpu.cpuFallbackOnOom.enabled": "false",
+        })
+
+
+def test_range_exchange_never_falls_back_to_passthrough():
+    """An external (range-exchanged) sort under exhausted retries must
+    stay globally ordered: the range exchange refuses the pass-through
+    CPU twin and the SORT's own fallback re-executes the child."""
+    def q(extra=None):
+        faults.INJECTOR.reset()
+        conf = {"spark.rapids.sql.batchSizeBytes": "4096",
+                "spark.rapids.sql.tpu.wholeStage.enabled": "false"}
+        conf.update(extra or {})
+        s = TpuSession(conf)
+        n = 3000
+        df = s.from_pydict({"k": [(i * 37) % 1000 for i in range(n)],
+                            "v": [float(i) for i in range(n)]})
+        # repartition makes the sort input multi-batch -> external path
+        return df.repartition(4).order_by(col("k"), col("v")).collect()
+    baseline = q()
+    assert baseline == sorted(baseline)
+    out = q({"spark.rapids.tpu.test.injectOom": "1x500",
+             "spark.rapids.memory.tpu.retry.maxRetries": "0",
+             "spark.rapids.memory.tpu.retry.maxSplitDepth": "0"})
+    assert out == baseline  # ordered AND complete, not silently truncated
+
+
+def test_fatal_shuffle_fetch_recovers_via_cpu_fallback():
+    """A shuffle read path that OOMs on EVERY attempt still completes the
+    query through the operator CPU fallback (fallback on by default),
+    with correct rows."""
+    from spark_rapids_tpu.shuffle.manager import get_shuffle_env
+    s = TpuSession({"spark.rapids.sql.tpu.join.partitioned.threshold": "0",
+                    "spark.sql.autoBroadcastJoinThreshold": "-1"})
+    a = s.from_pydict({"k": list(range(50))})
+    b = s.from_pydict({"k": list(range(0, 100, 2))})
+    df = a.join(b, on="k")
+    env = get_shuffle_env(s.runtime, s.conf)
+    orig = env.fetch_partition
+
+    def boom(*args, **kw):
+        raise MemoryError("fetch death")
+    env.fetch_partition = boom
+    try:
+        got = sorted(df.collect())
+    finally:
+        env.fetch_partition = orig
+    assert got == [(k,) for k in range(0, 50, 2)]
+
+
+def test_async_fetch_honors_retry_conf():
+    """The pipelined shuffle read's per-partition OOM retry budget comes
+    from spark.rapids.memory.tpu.retry.maxRetries, not a hardcoded 2."""
+    from spark_rapids_tpu.shuffle.fetch import AsyncFetchIterator
+
+    class _FlakyEnv:
+        def __init__(self, fail_times):
+            self.fails = fail_times
+            from spark_rapids_tpu.columnar import ColumnarBatch
+            self.batch = ColumnarBatch.from_arrow(pa.table({"a": [1, 2]}))
+
+        def fetch_partition(self, sid, rid, peers):
+            if self.fails > 0:
+                self.fails -= 1
+                raise MemoryError("flaky fetch")
+            yield self.batch
+
+    got = list(AsyncFetchIterator(_FlakyEnv(2), 1, [0], oom_retries=2))
+    assert len(got) == 1
+    with pytest.raises(MemoryError):
+        list(AsyncFetchIterator(_FlakyEnv(1), 1, [0], oom_retries=0))
+
+
+def test_retry_metrics_surface_in_pool_stats():
+    """Satellite: DeviceMemoryEventHandler retries + spill bytes are
+    observable (and retry_count resets per allocation attempt)."""
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.mem.runtime import TpuRuntime
+    rt = TpuRuntime(TpuConf(), pool_limit_bytes=64 << 10)
+    big = ColumnarBatch.from_arrow(pa.table(
+        {"a": np.arange(4096, dtype=np.int64)}))
+    rt.add_batch(big)
+    # second add must spill the first (32KB each against a 64KB pool)
+    rt.add_batch(ColumnarBatch.from_arrow(pa.table(
+        {"a": np.arange(4096, dtype=np.int64)})))
+    stats = rt.pool_stats()
+    assert stats.get("oomSpillRetries", 0) >= 1
+    assert stats.get("oomSpillBytes", 0) > 0
+    assert rt.event_handler.retry_count <= 1  # reset per attempt, not ever-growing
+
+
+# --------------------------------------------------------------------------
+# end-to-end: network faults over a loopback socket shuffle
+# --------------------------------------------------------------------------
+
+def _make_env(executor_id, conf=None):
+    from spark_rapids_tpu.mem.runtime import TpuRuntime
+    from spark_rapids_tpu.shuffle.manager import ShuffleEnv
+    from spark_rapids_tpu.shuffle.net import SocketTransport
+    conf = TpuConf(conf)
+    runtime = TpuRuntime(conf)
+    transport = SocketTransport(chunk_size=64 << 10,
+                                max_inflight_bytes=256 << 10)
+    transport.configure(conf)
+    env = ShuffleEnv(runtime, conf, executor_id, transport)
+    return env, transport
+
+
+def _write_test_partition(env, rows=2000):
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    rng = np.random.RandomState(3)
+    table = pa.table({"k": rng.randint(0, 50, rows).astype(np.int64),
+                      "v": rng.uniform(0, 1, rows)})
+    env.write_partition(shuffle_id=5, map_id=0, reduce_id=1,
+                        batch=ColumnarBatch.from_arrow(table))
+    return table
+
+
+def test_net_fault_injection_retries_with_backoff():
+    """An injected socket fault mid-shuffle is retried (with backoff) and
+    the fetch completes with the right rows."""
+    conf = {"spark.rapids.shuffle.retry.backoffBaseMs": "1",
+            "spark.rapids.shuffle.retry.backoffCapMs": "5",
+            "spark.rapids.tpu.test.injectNetFault": "2"}
+    env_a, tr_a = _make_env("ra", conf)
+    env_b, tr_b = _make_env("rb", conf)
+    try:
+        tr_b.set_peers({"ra": tr_a.address})
+        table = _write_test_partition(env_a)
+        got = list(env_b.fetch_partition(5, 1, remote_peers=["ra"]))
+        fetched = pa.concat_tables([b.to_arrow() for b in got])
+        assert fetched.num_rows == table.num_rows
+        assert np.allclose(np.sort(fetched["v"].to_numpy()),
+                           np.sort(table["v"].to_numpy()))
+        assert tr_b.counters.get("net_op_retries", 0) >= 1
+        assert any(cat == "net" for cat, _n, _s in
+                   faults.INJECTOR.injected_log)
+    finally:
+        tr_a.shutdown()
+        tr_b.shutdown()
+
+
+def test_net_fault_exhaustion_propagates():
+    """Every attempt of one op failing surfaces a ConnectionError (counted),
+    not a silent pass."""
+    conf = {"spark.rapids.shuffle.retry.maxAttempts": "2",
+            "spark.rapids.shuffle.retry.backoffBaseMs": "1",
+            "spark.rapids.shuffle.retry.backoffCapMs": "2",
+            "spark.rapids.tpu.test.injectNetFault": "1x10"}
+    env_a, tr_a = _make_env("xa", conf)
+    env_b, tr_b = _make_env("xb", conf)
+    try:
+        tr_b.set_peers({"xa": tr_a.address})
+        _write_test_partition(env_a)
+        with pytest.raises(ConnectionError):
+            list(env_b.fetch_partition(5, 1, remote_peers=["xa"]))
+        assert tr_b.counters.get("net_op_failures", 0) >= 2
+    finally:
+        tr_a.shutdown()
+        tr_b.shutdown()
+
+
+class _SilentServer:
+    """Accepts connections and never answers — the dead-peer shape that
+    used to hang forever on the settimeout(None) socket."""
+
+    def __init__(self):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(4)
+        self.address = self._listener.getsockname()
+        self._conns = []
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)  # hold open, say nothing
+
+    def close(self):
+        self._listener.close()
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def test_dead_peer_times_out_within_deadline_instead_of_hanging():
+    from spark_rapids_tpu.shuffle.net import SocketClient, SocketTransport
+    from spark_rapids_tpu.shuffle.transport import MetadataRequest
+    server = _SilentServer()
+    transport = SocketTransport()
+    transport.io_timeout = 0.2
+    transport.max_attempts = 2
+    transport.backoff_base = 0.01
+    transport.backoff_cap = 0.02
+    try:
+        client = SocketClient(transport, server.address)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            client.fetch_metadata(MetadataRequest(shuffle_id=1,
+                                                  reduce_id=0))
+        elapsed = time.monotonic() - t0
+        # 2 attempts x 0.2s io deadline + backoff, with slack
+        assert elapsed < 5.0, f"dead peer hung for {elapsed:.1f}s"
+        assert transport.counters.get("net_op_failures", 0) >= 2
+    finally:
+        server.close()
+        transport.shutdown()
+
+
+def test_transaction_deadline_cancels_fetch():
+    from spark_rapids_tpu.shuffle.net import SocketClient, SocketTransport
+    from spark_rapids_tpu.shuffle.transport import TransactionCancelled
+    server = _SilentServer()
+    transport = SocketTransport()
+    transport.io_timeout = 0.15
+    transport.max_attempts = 10          # deadline must cut these short
+    transport.backoff_base = 0.01
+    transport.backoff_cap = 0.02
+    transport.txn_timeout = 0.2
+    try:
+        client = SocketClient(transport, server.address)
+        t0 = time.monotonic()
+        with pytest.raises(TransactionCancelled):
+            client.fetch_buffer(42)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        server.close()
+        transport.shutdown()
+
+
+def test_peer_death_mid_stream_cancels():
+    """Kill the serving side after the fetch starts: the client errors out
+    in bounded time (retries against a dead port fail fast) instead of
+    blocking on a half-open socket."""
+    conf = {"spark.rapids.shuffle.retry.maxAttempts": "2",
+            "spark.rapids.shuffle.retry.backoffBaseMs": "1",
+            "spark.rapids.shuffle.retry.backoffCapMs": "2",
+            "spark.rapids.shuffle.ioTimeoutMs": "500"}
+    env_a, tr_a = _make_env("da", conf)
+    env_b, tr_b = _make_env("db", conf)
+    try:
+        tr_b.set_peers({"da": tr_a.address})
+        _write_test_partition(env_a)
+        client = tr_b.make_client("da")
+        # metadata round-trip works, then the peer dies
+        from spark_rapids_tpu.shuffle.transport import MetadataRequest
+        resp = client.fetch_metadata(MetadataRequest(shuffle_id=5,
+                                                     reduce_id=1))
+        bid = resp.block_metas[0].buffer_ids[0]
+        # peer process dies: its listener closes AND the established
+        # connection goes away (shutdown only closes the listener, so
+        # drop the cached client socket to model the process exit)
+        tr_a.shutdown()
+        client.close()
+        t0 = time.monotonic()
+        with pytest.raises((ConnectionError, OSError)):
+            client.fetch_buffer(bid)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        tr_a.shutdown()
+        tr_b.shutdown()

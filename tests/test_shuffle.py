@@ -474,7 +474,13 @@ class TestTaskScopeCleanup:
 
         s = TpuSession({"spark.rapids.sql.tpu.join.partitioned.threshold":
                         "0",
-                        "spark.sql.autoBroadcastJoinThreshold": "-1"})
+                        "spark.sql.autoBroadcastJoinThreshold": "-1",
+                        # keep the sabotaged fetch FATAL: with the OOM
+                        # retry framework's CPU fallback on (default),
+                        # the query would recover and collect() would
+                        # succeed — this test is about cleanup-on-failure
+                        "spark.rapids.sql.tpu.cpuFallbackOnOom.enabled":
+                        "false"})
         a = s.from_pydict({"k": list(range(100))})
         b = s.from_pydict({"k": list(range(100))})
         df = a.join(b, on="k")
