@@ -325,6 +325,16 @@ def child_main(mode: str) -> None:
             timed(name,
                   lambda qn=qn: checksum(DSQ[qn](ds1).collect()),
                   heavy_runs)
+
+    # observability rollup: the session-cumulative retry/spill/fallback/
+    # wire counters ride along in the BENCH_* artifacts so a perf number
+    # is never read without knowing how hard the memory/retry machinery
+    # worked to produce it (docs/monitoring.md)
+    try:
+        from spark_rapids_tpu.metrics.export import session_observability
+        emit("observability", **session_observability(session))
+    except Exception as e:  # the rollup must never sink the bench
+        emit("observability", error=repr(e)[:200])
     emit("done", t=time.time() - (_DEADLINE[0] - float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))))
 
@@ -439,7 +449,8 @@ def collect(r: "StageReader", end_at: float,
     unavailable chip is abandoned with enough budget left for a fallback
     child."""
     out = {"platform": None, "runs": {}, "warmup": {}, "values": {},
-           "transfer": None, "aborted": False, "backend_error": None}
+           "transfer": None, "aborted": False, "backend_error": None,
+           "observability": None}
     first = True
     try:
         while True:
@@ -469,6 +480,9 @@ def collect(r: "StageReader", end_at: float,
             elif st == "transfer":
                 out["transfer"] = {k: v for k, v in rec.items()
                                    if k != "stage"}
+            elif st == "observability":
+                out["observability"] = {k: v for k, v in rec.items()
+                                        if k != "stage"}
             elif st == "abort":
                 out["aborted"] = True
                 break
@@ -619,6 +633,7 @@ def _run():
     extra = {
         "per_query": per_query,
         "transfer": dev.get("transfer"),
+        "observability": dev.get("observability"),
         "q6_effective_gb_s": round(eff_gb_s, 2),
         "hbm_roofline_note": "v5e HBM ~819 GB/s; q6 reads 32 B/row",
         "vs_ref_headline": round(vs / 19.8, 4),

@@ -18,6 +18,17 @@ else
         __graft_entry__.py
 fi
 
+echo "== metric-name lint =="
+# every metrics.add/add_lazy/timer call site must use a name registered in
+# spark_rapids_tpu/metrics/names.py (catches typo'd keys like numOutputRow)
+JAX_PLATFORMS=cpu python -m spark_rapids_tpu.metrics --lint
+
+echo "== observability tier =="
+T_OBS=$SECONDS
+python -m pytest tests/test_metrics.py tests/test_observability_e2e.py \
+    -q -m "not slow" -p no:cacheprovider
+echo "== observability tier took $((SECONDS - T_OBS))s =="
+
 echo "== tests (fast tier) =="
 T_TESTS=$SECONDS
 MARK="not slow"

@@ -300,6 +300,17 @@ class ProcCluster:
         return {w.executor_id: w.rpc("transport_counters")
                 for w in self.workers}
 
+    def pool_stats(self) -> Dict[str, dict]:
+        """Per-worker runtime pool/retry/spill stats over the control RPC
+        (the cluster half of docs/monitoring.md's aggregation story)."""
+        return {w.executor_id: w.rpc("pool_stats") for w in self.workers}
+
+    def observability_snapshot(self) -> Dict[str, dict]:
+        """{executor_id: {"transport": ..., "pool": ...}} — one RPC sweep,
+        also reachable via metrics.export.cluster_snapshot(cluster)."""
+        from .metrics.export import cluster_snapshot
+        return cluster_snapshot(self)
+
     def shutdown(self) -> None:
         for w in self.workers:
             w.stop()

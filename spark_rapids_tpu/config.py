@@ -96,7 +96,9 @@ INCOMPATIBLE_OPS = _conf(
 EXPLAIN = _conf(
     "spark.rapids.sql.explain", "NONE",
     "Explain why parts of a query were or were not placed on the TPU. "
-    "NONE|ALL|NOT_ON_TPU.", str)
+    "NONE|ALL|NOT_ON_TPU; METRICS additionally prints the executed plan "
+    "tree with each node's accumulated metrics after every query "
+    "(EXPLAIN-with-metrics, docs/monitoring.md).", str)
 HAS_NANS = _conf(
     "spark.rapids.sql.hasNans", True,
     "Assume floating point data may contain NaNs (affects eligibility of some "
@@ -441,6 +443,31 @@ TEST_INJECT_SEED = _conf(
     "spark.rapids.tpu.test.injectSeed", 0,
     "Seed for the probabilistic fault-injection mode.", int,
     internal=True)
+
+# --- observability -----------------------------------------------------------
+def _to_metrics_level(v) -> str:
+    s = str(v).strip().upper()
+    if s not in ("ESSENTIAL", "MODERATE", "DEBUG"):
+        raise ValueError(
+            f"not a metrics level: {v!r} (ESSENTIAL|MODERATE|DEBUG)")
+    return s
+
+
+METRICS_LEVEL = _conf(
+    "spark.rapids.sql.tpu.metrics.level", "MODERATE",
+    "How many operator metrics to record (reference: "
+    "spark.rapids.sql.metrics.level).  ESSENTIAL keeps only free host-side "
+    "counters; MODERATE (default) adds timers and lazily folded device row "
+    "counts; DEBUG adds per-batch device-sync metrics (exact row counts, "
+    "peakDevMemory) with measurable overhead.  See docs/monitoring.md.",
+    _to_metrics_level)
+METRICS_JOURNAL_DIR = _conf(
+    "spark.rapids.sql.tpu.metrics.journal.dir", "",
+    "Directory for per-query structured event journals (JSON-lines spans: "
+    "query/operator/retry/spill/fetch events with monotonic timestamps and "
+    "parent links; one query-<id>.jsonl per query).  Empty disables the "
+    "file journal; at metrics.level=DEBUG an in-memory journal is kept "
+    "regardless and is reachable via session.last_execution.journal.", str)
 
 # --- export -----------------------------------------------------------------
 EXPORT_COLUMNAR_RDD = _conf(

@@ -59,7 +59,40 @@ class TpuSession:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = TpuConf(conf)
         self._runtime = None
+        # observability surface (docs/monitoring.md): the last query's
+        # QueryExecution (explain_with_metrics / prometheus / journal) and
+        # session-cumulative counters for bench/export rollups
+        self.last_execution = None
+        self.query_metrics_total: Dict[str, float] = {}
+        self.queries_executed = 0
         _enable_compilation_cache(self.conf.get(C.COMPILATION_CACHE_DIR))
+
+    def _begin_execution(self, physical: ExecNode, runtime=None):
+        """Open the per-query observability scope (metrics levels, event
+        journal, operator spans) around an about-to-run physical tree."""
+        from .metrics.query import QueryExecution
+        return QueryExecution(self.conf, physical,
+                              runtime=runtime or self._runtime)
+
+    def _finish_execution(self, qe, error=None) -> None:
+        # runs in every execution finally-block: a failure in the
+        # observability path (journal write on a full disk, metric fold on
+        # an exhausted device) must neither fail a successful query nor
+        # mask the real error — and the journal must come off the active
+        # stack regardless (QueryExecution.finish guarantees that part)
+        try:
+            qe.finish(error)
+            self.last_execution = qe
+            self.queries_executed += 1
+            for k, v in qe.aggregate().items():
+                self.query_metrics_total[k] = \
+                    self.query_metrics_total.get(k, 0) + v
+            if self.conf.explain == "METRICS" and error is None:
+                print(qe.explain_with_metrics(), file=sys.stderr)
+        except Exception:  # pragma: no cover - reporting is best-effort
+            import logging
+            logging.getLogger("spark_rapids_tpu.metrics").warning(
+                "observability finish failed", exc_info=True)
 
     # -- data sources -------------------------------------------------------
     def from_arrow(self, table) -> "DataFrame":
@@ -377,11 +410,15 @@ class DataFrame:
         import pyarrow as pa
         physical = self.session.plan(self.plan)
         runtime = self.session.runtime
+        on_device = isinstance(physical, TpuExec)
+        if on_device:
+            physical = B.DeviceToHostExec(physical)
+        qe = self.session._begin_execution(physical, runtime)
         ctx = ExecContext(self.session.conf, runtime=runtime,
-                          cluster=self.session.cluster)
+                          cluster=self.session.cluster, journal=qe.journal)
+        error = None
         try:
-            if isinstance(physical, TpuExec):
-                physical = B.DeviceToHostExec(physical)
+            if on_device:
                 # device semaphore: this "task" holds a device slot for the
                 # duration of its device work (reference:
                 # GpuSemaphore.acquireIfNecessary, released on task
@@ -390,11 +427,15 @@ class DataFrame:
                     tables = list(physical.execute_cpu(ctx))
             else:
                 tables = list(physical.execute_cpu(ctx))
+        except BaseException as e:
+            error = e
+            raise
         finally:
             # task-completion cleanup, success or failure: releases
             # resources operators registered (e.g. shuffle partitions
             # orphaned by a mid-write error)
             ctx.run_cleanups()
+            self.session._finish_execution(qe, error)
         if not tables:
             from .types import to_arrow
             return pa.table({f.name: pa.array([], type=to_arrow(f.dtype))
@@ -428,8 +469,10 @@ class DataFrame:
                 "columnar data")
         physical = self.session.plan(self.plan)
         runtime = self.session.runtime
+        qe = self.session._begin_execution(physical, runtime)
         ctx = ExecContext(self.session.conf, runtime=runtime,
-                          cluster=self.session.cluster)
+                          cluster=self.session.cluster, journal=qe.journal)
+        error = None
         try:
             if isinstance(physical, TpuExec):
                 runtime.semaphore.acquire_if_necessary()
@@ -441,8 +484,12 @@ class DataFrame:
                 for table in physical.execute_cpu(ctx):
                     from .columnar import ColumnarBatch
                     yield ColumnarBatch.from_arrow(table)
+        except BaseException as e:
+            error = e
+            raise
         finally:
             ctx.run_cleanups()
+            self.session._finish_execution(qe, error)
 
 
 class GroupedData:
@@ -583,8 +630,11 @@ class DataFrameWriter:
                               self._partition_by)
         physical = self.df.session.plan(plan)
         runtime = self.df.session.runtime
+        qe = self.df.session._begin_execution(physical, runtime)
         ctx = ExecContext(self.df.session.conf, runtime=runtime,
-                          cluster=self.df.session.cluster)
+                          cluster=self.df.session.cluster,
+                          journal=qe.journal)
+        error = None
         try:
             if isinstance(physical, TpuExec):
                 with runtime.semaphore.held():
@@ -593,5 +643,9 @@ class DataFrameWriter:
             else:
                 for _ in physical.execute_cpu(ctx):
                     pass
+        except BaseException as e:
+            error = e
+            raise
         finally:
             ctx.run_cleanups()
+            self.df.session._finish_execution(qe, error)

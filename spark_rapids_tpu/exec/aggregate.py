@@ -36,7 +36,8 @@ from ..ops.aggregates import AggregateExpression
 from ..ops.hashing import hash_columns_double
 from ..types import (DoubleType, LongType, Schema, StructField)
 from ..utils.tracing import named_range
-from .base import ExecContext, ExecNode, TpuExec
+from .base import ExecContext, ExecNode, TpuExec, record_output_batch
+from ..metrics import names as MN
 
 _I64_MAX = np.int64(2**63 - 1)
 _I64_MIN = np.int64(-(2**63))
@@ -942,18 +943,18 @@ class TpuHashAggregateExec(TpuExec):
             # through to the sort-based program below and latches the
             # key dirty so later executions skip the probe.
             fnb = cached_kernel(key + ("bucket",), build_bucket)
-            with self.metrics.timer("computeAggTime"), \
+            with self.metrics.timer(MN.COMPUTE_AGG_TIME), \
                     named_range("agg_whole_stage_bucket"):
                 all_clean, out = fnb(*all_leaves)
             if bool(all_clean):
-                self.metrics.add("numOutputBatches", 1)
+                record_output_batch(self.metrics, out, ctx.runtime)
                 return out, None
             _BUCKET_DIRTY_KEYS.add(key)
         fn = cached_kernel(key, build)
-        with self.metrics.timer("computeAggTime"), \
+        with self.metrics.timer(MN.COMPUTE_AGG_TIME), \
                 named_range("agg_whole_stage"):
             out = fn(*all_leaves)
-        self.metrics.add("numOutputBatches", 1)
+        record_output_batch(self.metrics, out, ctx.runtime)
         return out, None
 
     def _cpu_twin(self):
@@ -1018,9 +1019,9 @@ class TpuHashAggregateExec(TpuExec):
                     ctx.runtime.reserve(
                         sum(p.device_size_bytes() for p in parts),
                         site="agg.merge")
-                with self.metrics.timer("concatTime"):
+                with self.metrics.timer(MN.CONCAT_TIME):
                     both = concat_batches(parts)
-                with self.metrics.timer("mergeAggTime"), \
+                with self.metrics.timer(MN.MERGE_AGG_TIME), \
                         named_range("agg_merge"):
                     return merge(both)
             # retry-only: partial states are merge inputs, not splittable
@@ -1102,7 +1103,7 @@ class TpuHashAggregateExec(TpuExec):
             # num_rows_host device sync entirely)
             if batch.capacity >= 8192:
                 batch = batch.maybe_shrink(batch.num_rows_host())
-            with self.metrics.timer("computeAggTime"), \
+            with self.metrics.timer(MN.COMPUTE_AGG_TIME), \
                     named_range("agg_update"):
                 partials = run_retryable(ctx, self.metrics, "aggUpdate",
                                          attempt_update, [batch],
@@ -1122,8 +1123,9 @@ class TpuHashAggregateExec(TpuExec):
             data = {f.name: [] for f in child_schema}
             dead = ColumnarBatch.from_pydict(data, child_schema)
             state = update(dead, jnp.int64(0)) if needs_off else update(dead)
-        self.metrics.add("numOutputBatches", 1)
-        yield finalize(state)
+        out = finalize(state)
+        record_output_batch(self.metrics, out, ctx.runtime)
+        yield out
 
 
 def _scalar_col(value, valid, dtype, cap):

@@ -9,70 +9,58 @@ the pair gives the CPU-vs-TPU comparison oracle the test suite uses.
 """
 from __future__ import annotations
 
-import time
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence
 
 from ..columnar import ColumnarBatch
 from ..config import TpuConf
+from ..metrics import names as MN
+# Metrics moved to the observability package (level gating + batched lazy
+# fold + journal integration); re-exported here because mem/runtime.py and
+# half the test suite import it from exec.base
+from ..metrics.registry import Metrics  # noqa: F401
 from ..types import Schema
 
 
-class Metrics:
-    """SQLMetric equivalent (reference: GpuExec.scala:24-41)."""
+def record_output_batch(metrics: Metrics, batch, runtime=None) -> None:
+    """Standard per-output-batch bookkeeping for device operators.
 
-    def __init__(self):
-        self._values: Dict[str, float] = {}
-        self._lazy: Dict[str, list] = {}
-
-    def add(self, name: str, v: float):
-        self._values[name] = self._values.get(name, 0) + v
-
-    def add_lazy(self, name: str, traced_scalar):
-        """Accumulate a DEVICE scalar without syncing: row counts inside
-        streaming hot loops are data-dependent, and an int() per batch is
-        a device round trip (a tunnel RTT on chip).  Deferred scalars
-        resolve in one sweep when the metrics are read."""
-        self._lazy.setdefault(name, []).append(traced_scalar)
-
-    @property
-    def values(self) -> Dict[str, float]:
-        """Metric dict with every deferred device scalar folded in (the
-        fold syncs; readers are reporting paths, never hot loops)."""
-        for name, pend in self._lazy.items():
-            if pend:
-                self.add(name, float(sum(int(x) for x in pend)))
-                pend.clear()
-        return self._values
-
-    def timer(self, name: str):
-        return _Timer(self, name)
-
-    def __repr__(self):
-        return repr(self.values)
-
-
-class _Timer:
-    def __init__(self, m: Metrics, name: str):
-        self.m, self.name = m, name
-
-    def __enter__(self):
-        self.t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *a):
-        self.m.add(self.name, time.perf_counter() - self.t0)
+    * always: numOutputBatches, and numOutputRows whenever the count is
+      host-known (both ESSENTIAL: free host-side increments);
+    * DEBUG: exact numOutputRows resolved EAGERLY (one device sync per
+      batch, counted against metrics.registry.DEVICE_SYNCS) plus a
+      peakDevMemory sample of the accounting pool;
+    * MODERATE: data-dependent numOutputRows accumulated as a LAZY device
+      scalar (one device reduction per batch, folded into a single host
+      transfer when the metrics are read — never a per-batch sync);
+    * ESSENTIAL: data-dependent row counting skipped entirely (the count
+      of a filtered batch would cost device work)."""
+    metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
+    if batch.known_rows is not None:  # host-known: free at every level
+        metrics.add(MN.NUM_OUTPUT_ROWS, batch.known_rows)
+        if metrics.debug_active and runtime is not None:
+            metrics.set_max(MN.PEAK_DEV_MEMORY,
+                            runtime.device_store.current_size)
+    elif metrics.debug_active:
+        metrics.add_sync(MN.NUM_OUTPUT_ROWS, batch.num_rows_host)
+        if runtime is not None:
+            metrics.set_max(MN.PEAK_DEV_MEMORY,
+                            runtime.device_store.current_size)
+    elif metrics.level >= MN.MODERATE:
+        metrics.add_lazy(MN.NUM_OUTPUT_ROWS, batch.num_rows())
 
 
 class ExecContext:
     """Per-query execution context: conf, partition id, runtime services."""
 
     def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
-                 num_partitions: int = 1, runtime=None, cluster=None):
+                 num_partitions: int = 1, runtime=None, cluster=None,
+                 journal=None):
         self.conf = conf or TpuConf()
         self.partition_id = partition_id
         self.num_partitions = num_partitions
         self.runtime = runtime  # mem.runtime.TpuRuntime when active
         self.cluster = cluster  # plugin.TpuCluster in multi-executor mode
+        self.journal = journal  # metrics.journal.EventJournal per query
         # task-scoped cleanup callbacks (reference: task-completion
         # listeners releasing GPU resources, GpuSemaphore.scala:27-161 /
         # RapidsBufferCatalog task cleanup).  Operators register IDEMPOTENT
@@ -95,7 +83,7 @@ class ExecContext:
 
     def with_partition(self, pid: int, nparts: int) -> "ExecContext":
         ctx = ExecContext(self.conf, pid, nparts, self.runtime,
-                          self.cluster)
+                          self.cluster, self.journal)
         ctx.cleanups = self.cleanups  # share the task scope
         return ctx
 

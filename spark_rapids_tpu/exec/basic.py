@@ -22,10 +22,12 @@ import jax.numpy as jnp
 from ..columnar import ColumnarBatch, Column, bucket_rows, concat_batches
 from ..config import MAX_READER_BATCH_SIZE_ROWS
 from ..ops import expressions as E
+from ..metrics import names as MN
 from ..ops.cpu_eval import (cpu_cols_to_table, cpu_eval, table_to_cpu_cols)
 from ..types import BooleanType, Schema, StructField
 from ..utils.tracing import named_range
-from .base import CpuExec, ExecContext, ExecNode, TpuExec
+from .base import (CpuExec, ExecContext, ExecNode, TpuExec,
+                   record_output_batch)
 
 
 def _pred_keep(col: Column):
@@ -65,8 +67,8 @@ class TpuScanMemoryExec(TpuExec):
             cached = MEMORY_SCAN_CACHE.get(self._cache_table, names, limit)
             if cached is not None:
                 for batch, nrows in cached:
-                    self.metrics.add("numOutputRows", nrows)
-                    self.metrics.add("numOutputBatches", 1)
+                    self.metrics.add(MN.NUM_OUTPUT_ROWS, nrows)
+                    self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
                     yield batch
                 return
         produced = []
@@ -74,10 +76,10 @@ class TpuScanMemoryExec(TpuExec):
         off = 0
         while off < rows or (rows == 0 and off == 0):
             chunk = self.table.slice(off, limit)
-            with self.metrics.timer("scanTime"):
+            with self.metrics.timer(MN.SCAN_TIME):
                 batch = ColumnarBatch.from_arrow(chunk)
-            self.metrics.add("numOutputRows", chunk.num_rows)
-            self.metrics.add("numOutputBatches", 1)
+            self.metrics.add(MN.NUM_OUTPUT_ROWS, chunk.num_rows)
+            self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
             if use_cache:
                 produced.append((batch, chunk.num_rows))
                 produced_bytes += batch.device_size_bytes()
@@ -139,11 +141,11 @@ class RowLocalExec(TpuExec):
                     fkey,
                     lambda: functools.partial(E.eval_with_row_offset,
                                               self.batch_fn()))
-                with self.metrics.timer("totalTime"), \
+                with self.metrics.timer(MN.TOTAL_TIME), \
                         named_range(self.name):
                     out = fn(batch, jnp.int64(offset))
                 offset += batch.num_rows_host()
-                self.metrics.add("numOutputBatches", 1)
+                record_output_batch(self.metrics, out, ctx.runtime)
                 yield out
             return
         if needs_file:
@@ -155,16 +157,17 @@ class RowLocalExec(TpuExec):
             for batch in self.children[0].execute(ctx):
                 fn = cached_kernel(key + (E.current_input_file(),),
                                    self.batch_fn)
-                with self.metrics.timer("totalTime"), named_range(self.name):
+                with self.metrics.timer(MN.TOTAL_TIME), \
+                        named_range(self.name):
                     out = fn(batch)
-                self.metrics.add("numOutputBatches", 1)
+                record_output_batch(self.metrics, out, ctx.runtime)
                 yield out
             return
         fn = cached_kernel(key, self.batch_fn)
         for batch in self.children[0].execute(ctx):
-            with self.metrics.timer("totalTime"), named_range(self.name):
+            with self.metrics.timer(MN.TOTAL_TIME), named_range(self.name):
                 out = fn(batch)
-            self.metrics.add("numOutputBatches", 1)
+            record_output_batch(self.metrics, out, ctx.runtime)
             yield out
 
 
@@ -288,12 +291,12 @@ class TpuCoalesceBatchesExec(TpuExec):
             yield self._flush(pending)
 
     def _flush(self, pending):
-        with self.metrics.timer("concatTime"):
+        with self.metrics.timer(MN.CONCAT_TIME):
             if len(pending) == 1:
                 out = pending[0].compact()
             else:
                 out = concat_batches(pending)
-        self.metrics.add("numOutputBatches", 1)
+        record_output_batch(self.metrics, out)
         return out
 
     def describe(self):
@@ -336,6 +339,8 @@ class TpuLocalLimitExec(TpuExec):
                 batch = batch.with_sel(sel)
                 count = remaining
             remaining -= count
+            self.metrics.add(MN.NUM_OUTPUT_ROWS, count)  # host-known: free
+            self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
             yield batch
 
     def describe(self):
@@ -424,8 +429,11 @@ class HostToDeviceExec(TpuExec):
 
     def execute(self, ctx):
         for table in self.children[0].execute_cpu(ctx):
-            with self.metrics.timer("h2dTime"):
-                yield ColumnarBatch.from_arrow(table)
+            with self.metrics.timer(MN.H2D_TIME):
+                batch = ColumnarBatch.from_arrow(table)
+            self.metrics.add(MN.NUM_OUTPUT_ROWS, table.num_rows)
+            self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
+            yield batch
 
 
 class DeviceToHostExec(CpuExec):
@@ -440,8 +448,11 @@ class DeviceToHostExec(CpuExec):
 
     def execute_cpu(self, ctx):
         for batch in self.children[0].execute(ctx):
-            with self.metrics.timer("d2hTime"):
-                yield batch.to_arrow()
+            with self.metrics.timer(MN.D2H_TIME):
+                table = batch.to_arrow()
+            self.metrics.add(MN.NUM_OUTPUT_ROWS, table.num_rows)
+            self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
+            yield table
 
 
 # --------------------------------------------------------------------------

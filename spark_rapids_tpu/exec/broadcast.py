@@ -19,6 +19,7 @@ from ..columnar import ColumnarBatch, concat_batches
 from ..mem.buffer import SpillPriorities, batch_to_host, host_to_batch
 from .base import CpuExec, ExecContext, ExecNode, TpuExec
 from .join import TpuHashJoinExec
+from ..metrics import names as MN
 
 
 class TpuBroadcastExchangeExec(TpuExec):
@@ -40,9 +41,9 @@ class TpuBroadcastExchangeExec(TpuExec):
     def _collect(self, ctx: ExecContext):
         """The async driver job of the reference (collect + serialize),
         run once (GpuBroadcastExchangeExec.scala:215-391)."""
-        with self.metrics.timer("collectTime"):
+        with self.metrics.timer(MN.COLLECT_TIME):
             batches = list(self.children[0].execute(ctx))
-        with self.metrics.timer("buildTime"):
+        with self.metrics.timer(MN.BUILD_TIME):
             if batches:
                 batch = batches[0] if len(batches) == 1 \
                     else concat_batches(batches)
@@ -50,7 +51,7 @@ class TpuBroadcastExchangeExec(TpuExec):
                 from .join import _empty_batch
                 batch = _empty_batch(self.schema)
             leaves, meta = batch_to_host(batch)
-        self.metrics.add("dataSize", meta.size_bytes)
+        self.metrics.add(MN.DATA_SIZE, meta.size_bytes)
         return leaves, meta
 
     def broadcast_batch(self, ctx: ExecContext) -> ColumnarBatch:

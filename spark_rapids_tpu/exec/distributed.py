@@ -38,9 +38,10 @@ from ..parallel.distributed import (run_distributed_aggregate,
                                     run_distributed_sort)
 from ..utils.tracing import named_range
 from .aggregate import TpuHashAggregateExec
-from .base import ExecContext
+from .base import ExecContext, record_output_batch
 from .join import TpuHashJoinExec, _empty_batch
 from .sort import TpuSortExec
+from ..metrics import names as MN
 
 
 def resolve_mesh(conf) -> Optional["jax.sharding.Mesh"]:
@@ -127,7 +128,7 @@ class TpuDistributedAggregateExec(TpuHashAggregateExec):
         chunk_rows = max(int(ctx.conf.get(C.MESH_INPUT_CHUNK_ROWS)), n)
         chunks = _sharded_chunks(self.children[0], ctx, self.mesh, n,
                                  chunk_rows)
-        with self.metrics.timer("distributedAggTime"), \
+        with self.metrics.timer(MN.DISTRIBUTED_AGG_TIME), \
                 named_range("dist_agg"):
             out = run_distributed_aggregate_streaming(
                 self, self.mesh, chunks, use_allgather=self.use_allgather,
@@ -136,7 +137,7 @@ class TpuDistributedAggregateExec(TpuHashAggregateExec):
             # delegate empty-input semantics (global 1-row / grouped none)
             yield from super().execute(ctx)
             return
-        self.metrics.add("numOutputBatches", 1)
+        record_output_batch(self.metrics, out, ctx.runtime)
         yield out
 
 
@@ -167,7 +168,7 @@ class TpuDistributedJoinExec(TpuHashJoinExec):
             yield from super().execute(ctx)
             return
         produced = False
-        with self.metrics.timer("distributedJoinTime"), \
+        with self.metrics.timer(MN.DISTRIBUTED_JOIN_TIME), \
                 named_range("dist_join"):
             # stream the probe side: every supported join type
             # (inner/left/left_semi/left_anti) is per-left-row independent,
@@ -179,7 +180,7 @@ class TpuDistributedJoinExec(TpuHashJoinExec):
                     right, use_allgather=self.use_allgather,
                     cache_key=("dist",) + self.kernel_key()):
                 produced = True
-                self.metrics.add("numOutputBatches", 1)
+                record_output_batch(self.metrics, out, ctx.runtime)
                 yield out
         if not produced:
             yield _empty_batch(self.schema)
@@ -205,11 +206,11 @@ class TpuDistributedSortExec(TpuSortExec):
         batch = _drain_to_sharded(self.children[0], ctx, self.mesh, n)
         if batch is None:
             return
-        with self.metrics.timer("distributedSortTime"), \
+        with self.metrics.timer(MN.DISTRIBUTED_SORT_TIME), \
                 named_range("dist_sort"):
             out = run_distributed_sort(
                 self.sort_exprs, self.ascending, self.nulls_first,
                 self.mesh, batch, use_allgather=self.use_allgather,
                 cache_key=("dist",) + self.kernel_key())
-        self.metrics.add("numOutputBatches", 1)
+        record_output_batch(self.metrics, out, ctx.runtime)
         yield out

@@ -20,7 +20,8 @@ from ..shuffle.partition import (hash_partition_ids, range_partition_ids,
                                  round_robin_partition_ids,
                                  sample_range_bounds, single_partition_ids,
                                  split_by_partition)
-from .base import ExecContext, ExecNode, TpuExec
+from .base import ExecContext, ExecNode, TpuExec, record_output_batch
+from ..metrics import names as MN
 
 
 class TpuShuffleExchangeExec(TpuExec):
@@ -95,7 +96,7 @@ class TpuShuffleExchangeExec(TpuExec):
             if out is None:
                 continue
             produced = True
-            self.metrics.add("numOutputBatches", 1)
+            record_output_batch(self.metrics, out, ctx.runtime)
             yield out
         if not produced:
             # keep the one-batch-minimum contract for downstream operators
@@ -133,7 +134,7 @@ class TpuShuffleExchangeExec(TpuExec):
         from ..config import SHUFFLE_ASYNC_FETCH
         from .retryable import run_retryable
         try:
-            with self.metrics.timer("shuffleReadTime"):
+            with self.metrics.timer(MN.SHUFFLE_READ_TIME):
                 if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
                     # pipelined: the producer thread fetches partition k+1
                     # while the consumer is still on k
@@ -176,7 +177,7 @@ class TpuShuffleExchangeExec(TpuExec):
 
         from .retryable import run_retryable, split_batch_rows
         num_writes = 0
-        with self.metrics.timer("shuffleWriteTime"):
+        with self.metrics.timer(MN.SHUFFLE_WRITE_TIME):
             for map_id, batch in enumerate(child_batches):
 
                 def partition_one(b, map_id=map_id):
@@ -206,7 +207,7 @@ class TpuShuffleExchangeExec(TpuExec):
                         num_writes += sum(run_retryable(
                             ctx, self.metrics, "exchangeWrite", write_one,
                             [sub], split=split_batch_rows))
-        self.metrics.add("numPartitionsWritten", num_writes)
+        self.metrics.add(MN.NUM_PARTITIONS_WRITTEN, num_writes)
 
     def _execute_partitions_cluster(self, ctx: ExecContext):
         """Multi-executor read/write (see execute_partitions docstring)."""
@@ -225,7 +226,7 @@ class TpuShuffleExchangeExec(TpuExec):
         from ..config import (OOM_RETRY_MAX, SHUFFLE_ASYNC_FETCH,
                               SHUFFLE_MAX_RECV_INFLIGHT)
         try:
-            with self.metrics.timer("shuffleReadTime"):
+            with self.metrics.timer(MN.SHUFFLE_READ_TIME):
                 if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
                     # same pipelining as the single-executor path: remote
                     # transport round-trips overlap consumption

@@ -16,7 +16,9 @@ import numpy as np
 
 from ..columnar import Column, ColumnarBatch, bucket_rows
 from ..types import DataType, IntegerType, Schema, StructField
-from .base import CpuExec, ExecContext, ExecNode, TpuExec
+from .base import (CpuExec, ExecContext, ExecNode, TpuExec,
+                   record_output_batch)
+from ..metrics import names as MN
 
 
 class TpuGenerateExec(TpuExec):
@@ -81,9 +83,9 @@ class TpuGenerateExec(TpuExec):
         from ..utils.kernel_cache import cached_kernel
         fn = cached_kernel(self.kernel_key(), lambda: self._kernel)
         for batch in self.children[0].execute(ctx):
-            with self.metrics.timer("generateTime"):
+            with self.metrics.timer(MN.GENERATE_TIME):
                 out = fn(batch)
-            self.metrics.add("numOutputBatches", 1)
+            record_output_batch(self.metrics, out, ctx.runtime)
             yield out
 
 

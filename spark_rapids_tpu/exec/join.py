@@ -45,6 +45,7 @@ from ..ops import expressions as E
 from ..ops.hashing import _normalize_bits, hash_columns_double
 from ..types import Schema, StructField
 from .base import ExecContext, ExecNode, TpuExec
+from ..metrics import names as MN
 
 
 def _pvary(x, axes):
@@ -385,7 +386,7 @@ class TpuHashJoinExec(TpuExec):
                                     site="join.build")
             return build_fn(rb)
 
-        with self.metrics.timer("buildTime"), named_range("join_build"):
+        with self.metrics.timer(MN.BUILD_TIME), named_range("join_build"):
             if ctx is not None:
                 build, bkeys, h1s = run_retryable(
                     ctx, self.metrics, "joinBuild", attempt_build,
@@ -452,7 +453,7 @@ class TpuHashJoinExec(TpuExec):
 
         b_hit_accum = None  # full join: OR of per-batch build-hit masks
         for lbatch in lbatches:
-            with self.metrics.timer("joinTime"), named_range("join_stream"):
+            with self.metrics.timer(MN.JOIN_TIME), named_range("join_stream"):
                 if ctx is not None:
                     results = run_retryable(ctx, self.metrics, "joinProbe",
                                             probe_one, [lbatch],
@@ -463,21 +464,21 @@ class TpuHashJoinExec(TpuExec):
                 if b_hit is not None:
                     b_hit_accum = b_hit if b_hit_accum is None \
                         else b_hit_accum | b_hit
-                self.metrics.add("numOutputBatches", 1)
+                self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
                 # deferred: an int() here is a device sync PER OUTPUT
                 # BATCH (a tunnel round trip on chip) in the join hot loop
-                self.metrics.add_lazy("numOutputRows", out.num_rows())
+                self.metrics.add_lazy(MN.NUM_OUTPUT_ROWS, out.num_rows())
                 yield out
         if self.join_type == "full":
             if b_hit_accum is None:
                 b_hit_accum = jnp.zeros(build.capacity, jnp.bool_)
-            with self.metrics.timer("joinTime"), \
+            with self.metrics.timer(MN.JOIN_TIME), \
                     named_range("join_full_tail"):
                 tail = self._full_remainder(build, b_hit_accum)
             n = tail.num_rows_host()
             if n:
-                self.metrics.add("numOutputBatches", 1)
-                self.metrics.add("numOutputRows", n)
+                self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
+                self.metrics.add(MN.NUM_OUTPUT_ROWS, n)
                 yield tail
 
 
@@ -521,8 +522,8 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
                 n = tail.num_rows_host()
                 if n:
                     produced = True
-                    self.metrics.add("numOutputBatches", 1)
-                    self.metrics.add("numOutputRows", n)
+                    self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
+                    self.metrics.add(MN.NUM_OUTPUT_ROWS, n)
                     yield tail
                 continue
             if rbatch is None:

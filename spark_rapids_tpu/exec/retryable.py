@@ -19,6 +19,7 @@ import logging
 from .. import config as C
 from ..mem.retry import (RetryExhausted, split_batch_rows,  # noqa: F401
                          with_retry)
+from ..metrics import names as MN
 
 log = logging.getLogger("spark_rapids_tpu.retry")
 
@@ -59,7 +60,9 @@ def execute_with_cpu_fallback(op, ctx, device_gen, cpu_twin_factory):
         twin = cpu_twin_factory()
         if twin is None:
             raise
-        op.metrics.add("numCpuFallbacks", 1)
+        op.metrics.add(MN.NUM_CPU_FALLBACKS, 1)
+        from ..metrics.journal import journal_event
+        journal_event("fallback", op.name, reason="retry_exhausted")
         log.warning("[tpu-retry] %s: OOM retries exhausted; "
                     "re-executing on CPU", op.name)
     from .basic import HostToDeviceExec

@@ -26,7 +26,8 @@ import numpy as np
 
 from ..columnar import Column, ColumnarBatch, concat_batches
 from ..ops import expressions as E
-from .base import ExecContext, ExecNode, TpuExec
+from .base import ExecContext, ExecNode, TpuExec, record_output_batch
+from ..metrics import names as MN
 
 _I64_MIN = np.int64(-(2**63))
 _NAN_BITS = np.int64(0x7FF8000000000000)
@@ -196,20 +197,20 @@ class TpuSortExec(TpuExec):
                 ascending=self.ascending, nulls_first=self.nulls_first)
             del batches  # the source owns (and drains) the only reference
             for part in ex.execute(ctx):
-                with self.metrics.timer("sortTime"):
+                with self.metrics.timer(MN.SORT_TIME):
                     out = run_retryable(ctx, self.metrics, "sort",
                                         attempt_sort, [part])[0]
-                self.metrics.add("numOutputBatches", 1)
+                record_output_batch(self.metrics, out, ctx.runtime)
                 yield out
             return
         batch = batches[0] if len(batches) == 1 else concat_batches(batches)
         # a mostly-dead input (post-filter, post-aggregate) sorts at its
         # full capacity otherwise — shrink first (batch.shrink_to)
         batch = batch.maybe_shrink(batch.num_rows_host())
-        with self.metrics.timer("sortTime"):
+        with self.metrics.timer(MN.SORT_TIME):
             out = run_retryable(ctx, self.metrics, "sort",
                                 attempt_sort, [batch])[0]
-        self.metrics.add("numOutputBatches", 1)
+        record_output_batch(self.metrics, out, ctx.runtime)
         yield out
 
     def describe(self):

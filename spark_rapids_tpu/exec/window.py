@@ -23,8 +23,10 @@ from ..ops import expressions as E
 from ..ops.windows import (UNBOUNDED, WindowFunc, eval_window_func,
                            segment_flags)
 from ..types import Schema, StructField
-from .base import CpuExec, ExecContext, ExecNode, TpuExec
+from .base import (CpuExec, ExecContext, ExecNode, TpuExec,
+                   record_output_batch)
 from .sort import sort_order
+from ..metrics import names as MN
 
 
 class TpuWindowExec(TpuExec):
@@ -117,15 +119,15 @@ class TpuWindowExec(TpuExec):
                 _PrefetchedSource(batches, self.children[0].schema))
             del batches  # the source owns (and drains) the only reference
             for part in ex.execute(ctx):
-                with self.metrics.timer("windowTime"):
+                with self.metrics.timer(MN.WINDOW_TIME):
                     out = fn(part)
-                self.metrics.add("numOutputBatches", 1)
+                record_output_batch(self.metrics, out, ctx.runtime)
                 yield out
             return
         batch = batches[0] if len(batches) == 1 else concat_batches(batches)
-        with self.metrics.timer("windowTime"):
+        with self.metrics.timer(MN.WINDOW_TIME):
             out = fn(batch)
-        self.metrics.add("numOutputBatches", 1)
+        record_output_batch(self.metrics, out, ctx.runtime)
         yield out
 
 

@@ -41,6 +41,7 @@ from ..columnar import Column, ColumnarBatch
 from ..columnar.batch import bucket_rows
 from ..columnar.column import bucket_strlen
 from ..types import Schema, StringType
+from ..metrics import names as MN
 
 _NL = 0x0A
 _CR = 0x0D
@@ -251,7 +252,7 @@ def device_csv_batches(files, schema: Schema, options: dict, conf,
                 hi = min(off + max_rows, rows)
                 qchunk = quoted[off:hi] if quoted is not None else None
                 if metrics is not None:
-                    with metrics.timer("scanTime"):
+                    with metrics.timer(MN.SCAN_TIME):
                         batch = _decode_chunk(raw_dev, starts[off:hi],
                                               lengths[off:hi], schema,
                                               conf, qchunk)

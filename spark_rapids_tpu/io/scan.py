@@ -27,6 +27,7 @@ from ..columnar import ColumnarBatch
 from ..exec.base import CpuExec, ExecContext, TpuExec
 from ..types import Schema, StructField, from_arrow, to_arrow
 from ..plan import logical as L
+from ..metrics import names as MN
 
 
 # --------------------------------------------------------------------------
@@ -320,10 +321,10 @@ def _parquet_chunks(pf, max_rows: int, max_bytes: int, predicates,
     for rg in range(pf.metadata.num_row_groups):
         meta = pf.metadata.row_group(rg)
         if metrics is not None:
-            metrics.add("numRowGroups", 1)
+            metrics.add(MN.NUM_ROW_GROUPS, 1)
         if predicates and not _rg_can_match(meta, name_to_leaf, predicates):
             if metrics is not None:
-                metrics.add("numRowGroupsSkipped", 1)
+                metrics.add(MN.NUM_ROW_GROUPS_SKIPPED, 1)
             continue
         if chunk and (rows + meta.num_rows > max_rows
                       or bytes_ + meta.total_byte_size > max_bytes):
@@ -446,7 +447,7 @@ def _iter_orc(files, max_rows: int, max_bytes: int,
         for s in range(n):
             if pred_cols:
                 if metrics is not None:
-                    metrics.add("numStripes", 1)
+                    metrics.add(MN.NUM_STRIPES, 1)
                 if stats is not None and s < len(stats):
                     alive = _orc_stats_can_match(stats[s], cols_map,
                                                  predicates)
@@ -455,7 +456,7 @@ def _iter_orc(files, max_rows: int, max_bytes: int,
                         of.read_stripe(s, columns=pred_cols), predicates)
                 if not alive:
                     if metrics is not None:
-                        metrics.add("numStripesSkipped", 1)
+                        metrics.add(MN.NUM_STRIPES_SKIPPED, 1)
                     continue
             stripe = of.read_stripe(s, columns=cols)
             if chunk and (rows + stripe.num_rows > max_rows
@@ -585,7 +586,7 @@ def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
         for si in range(len(info.stripes)):
             if pred_cols:
                 if metrics is not None:
-                    metrics.add("numStripes", 1)
+                    metrics.add(MN.NUM_STRIPES, 1)
                 if stats is not None and si < len(stats):
                     alive = _orc_stats_can_match(stats[si], info.columns,
                                                  predicates)
@@ -594,7 +595,7 @@ def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
                         of.read_stripe(si, columns=pred_cols), predicates)
                 if not alive:
                     if metrics is not None:
-                        metrics.add("numStripesSkipped", 1)
+                        metrics.add(MN.NUM_STRIPES_SKIPPED, 1)
                     continue
             rows = info.stripes[si]["numberOfRows"]
             cap = bucket_rows(max(rows, 1))
@@ -606,12 +607,12 @@ def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
                     continue
                 try:
                     from contextlib import nullcontext
-                    with metrics.timer("scanTime") if metrics is not None \
+                    with metrics.timer(MN.SCAN_TIME) if metrics is not None \
                             else nullcontext():
                         out_cols[f.name] = decode_column(
                             info, si, f.name, f.dtype, cap)
                     if metrics is not None:
-                        metrics.add("numDeviceDecodedColumns", 1)
+                        metrics.add(MN.NUM_DEVICE_DECODED_COLUMNS, 1)
                 except OrcDeviceUnsupported:
                     host_names.append(f.name)  # expected scope fallback
                 except Exception:
@@ -620,7 +621,7 @@ def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
                     # surprise error falls back too but is COUNTED so a
                     # regression disabling the device path stays visible
                     if metrics is not None:
-                        metrics.add("numDeviceDecodeErrors", 1)
+                        metrics.add(MN.NUM_DEVICE_DECODE_ERRORS, 1)
                     host_names.append(f.name)
             if host_names:
                 table = of.read_stripe(
@@ -633,8 +634,8 @@ def _device_orc_batches(path: str, schema: Schema, options: dict, conf,
                     out_cols[n] = c
             sel = jnp.arange(cap, dtype=jnp.int32) < rows
             if metrics is not None:
-                metrics.add("numOutputRows", rows)
-                metrics.add("numOutputBatches", 1)
+                metrics.add(MN.NUM_OUTPUT_ROWS, rows)
+                metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
             yield ColumnarBatch([out_cols[f.name] for f in schema], sel,
                                 schema)
     finally:
@@ -762,10 +763,10 @@ def _device_parquet_files(files, schema, options, conf, metrics, max_rows,
                 if colv is not None:
                     out_cols[name] = colv
                     if metrics is not None:
-                        metrics.add("numDeviceDecodedColumns", 1)
+                        metrics.add(MN.NUM_DEVICE_DECODED_COLUMNS, 1)
                 else:
                     if err == "error" and metrics is not None:
-                        metrics.add("numDeviceDecodeErrors", 1)
+                        metrics.add(MN.NUM_DEVICE_DECODE_ERRORS, 1)
                     host_names.append(name)
             if host_names:
                 table = pf.read_row_groups(chunk, columns=host_names)
@@ -823,10 +824,10 @@ class TpuFileScanExec(TpuExec):
         branch shares)."""
         for table in _host_chunks(self.fmt, paths, self._schema,
                                   self.options, ctx.conf, self.metrics):
-            with self.metrics.timer("scanTime"):
+            with self.metrics.timer(MN.SCAN_TIME):
                 batch = ColumnarBatch.from_arrow(table)
-            self.metrics.add("numOutputRows", table.num_rows)
-            self.metrics.add("numOutputBatches", 1)
+            self.metrics.add(MN.NUM_OUTPUT_ROWS, table.num_rows)
+            self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
             yield batch
 
     def _batches(self, ctx) -> Iterator[ColumnarBatch]:
@@ -851,9 +852,9 @@ class TpuFileScanExec(TpuExec):
                     for batch, nrows in device_csv_batches(
                             [path], self._schema, self.options, ctx.conf,
                             self.metrics):
-                        self.metrics.add("numOutputRows", nrows)
-                        self.metrics.add("numOutputBatches", 1)
-                        self.metrics.add("numDeviceDecodedColumns",
+                        self.metrics.add(MN.NUM_OUTPUT_ROWS, nrows)
+                        self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
+                        self.metrics.add(MN.NUM_DEVICE_DECODED_COLUMNS,
                                          len(self._schema))
                         yield batch
                 except CsvDeviceUnsupported:
@@ -881,8 +882,8 @@ class TpuFileScanExec(TpuExec):
                     # batch order (the producer runs ahead of us);
                     # nrows comes from file metadata — never a sync
                     publish_input_file(path)
-                    self.metrics.add("numOutputRows", nrows)
-                    self.metrics.add("numOutputBatches", 1)
+                    self.metrics.add(MN.NUM_OUTPUT_ROWS, nrows)
+                    self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
                     yield batch
             finally:
                 clear_input_file()

@@ -25,6 +25,7 @@ from ..columnar import ColumnarBatch
 from ..exec.base import CpuExec, ExecContext, ExecNode, TpuExec
 from ..plan import logical as L
 from ..types import Schema
+from ..metrics import names as MN
 
 
 def _write_table(table, path: str, fmt: str, options: dict):
@@ -100,7 +101,7 @@ class _WriterCore:
             if sub not in self._parts_seen:
                 self._parts_seen.add(sub)
                 # BasicColumnarWriteStatsTracker.newPartition analogue
-                self.metrics.add("numParts", 1)
+                self.metrics.add(MN.NUM_PARTS, 1)
             self._write_one(part, os.path.join(self.path, sub))
             start = i
 
@@ -111,9 +112,9 @@ class _WriterCore:
         self.file_seq += 1
         nbytes = _write_table(table, os.path.join(directory, name),
                               self.fmt, self.options)
-        self.metrics.add("numFiles", 1)
-        self.metrics.add("numOutputRows", table.num_rows)
-        self.metrics.add("numOutputBytes", nbytes)
+        self.metrics.add(MN.NUM_FILES, 1)
+        self.metrics.add(MN.NUM_OUTPUT_ROWS, table.num_rows)
+        self.metrics.add(MN.NUM_OUTPUT_BYTES, nbytes)
 
     def write_encoded(self, data: bytes, num_rows: int):
         """Write an already-encoded (device path) file image."""
@@ -123,9 +124,9 @@ class _WriterCore:
         self.file_seq += 1
         with open(os.path.join(self.path, name), "wb") as f:
             f.write(data)
-        self.metrics.add("numFiles", 1)
-        self.metrics.add("numOutputRows", num_rows)
-        self.metrics.add("numOutputBytes", len(data))
+        self.metrics.add(MN.NUM_FILES, 1)
+        self.metrics.add(MN.NUM_OUTPUT_ROWS, num_rows)
+        self.metrics.add(MN.NUM_OUTPUT_BYTES, len(data))
 
 
 class TpuDataWritingExec(TpuExec):
@@ -175,7 +176,7 @@ class TpuDataWritingExec(TpuExec):
         device_encode = self._device_encode_ok(ctx)
         wrote = False
         for batch in self.children[0].execute(ctx):
-            with self.metrics.timer("writeTime"):
+            with self.metrics.timer(MN.WRITE_TIME):
                 if device_encode:
                     # reference shape: encode on device, stream host
                     # buffers out (GpuParquetFileFormat.scala:192-214,
@@ -190,7 +191,7 @@ class TpuDataWritingExec(TpuExec):
                             encode_parquet_file)
                         data = encode_parquet_file(batch, self._codec())
                     core.write_encoded(data, batch.num_rows_host())
-                    self.metrics.add("numDeviceEncodedFiles", 1)
+                    self.metrics.add(MN.NUM_DEVICE_ENCODED_FILES, 1)
                 else:
                     core.write(batch.to_arrow())
             wrote = True

@@ -171,6 +171,7 @@ def with_retry(fn: Callable, inputs: Sequence, *, runtime=None,
     pinned), but once an attempt OOMs the input becomes evictable for the
     spill cascade between the retries that follow."""
     from ..columnar import ColumnarBatch
+    from ..metrics.journal import journal_event
     results: List = []
     stack = [(x, 0) for x in reversed(list(inputs))]
     while stack:
@@ -203,15 +204,23 @@ def with_retry(fn: Callable, inputs: Sequence, *, runtime=None,
                             handle = SpillableCheckpoint(runtime, x)
                         if metrics is not None:
                             metrics.add(f"{name}Retries", 1)
+                        journal_event("retry", name, action="retry",
+                                      attempt=sm.attempts, depth=depth,
+                                      oom_bytes=getattr(e, "nbytes", 0))
                         continue
                     if action == RetryStateMachine.SPLIT:
                         pieces = split(x)
                         if pieces:
                             if metrics is not None:
                                 metrics.add(f"{name}Splits", 1)
+                            journal_event("retry", name, action="split",
+                                          depth=depth + 1,
+                                          pieces=len(pieces))
                             stack.extend((p, depth + 1)
                                          for p in reversed(pieces))
                             break
+                    journal_event("retry", name, action="exhausted",
+                                  attempts=sm.attempts, depth=depth)
                     raise RetryExhausted(
                         f"{name}: OOM retries exhausted "
                         f"(attempts={sm.attempts}, depth={depth}): {e}",

@@ -23,6 +23,8 @@ from ..columnar import ColumnarBatch
 from ..config import (CONCURRENT_TPU_TASKS, HOST_SPILL_STORAGE_SIZE,
                       TPU_ALLOC_FRACTION, TPU_DEBUG, TPU_OOM_SPILL_ENABLED,
                       TpuConf)
+from ..metrics import names as MN
+from ..metrics.journal import journal_event
 from ..utils import faults
 from .buffer import SpillPriorities, StorageTier, host_to_batch, read_leaves
 from .retry import RetryOOM
@@ -73,8 +75,10 @@ class DeviceMemoryEventHandler:
                   f"{spilled}B from device store", file=out)
         self.retry_count += 1
         if self.metrics is not None:
-            self.metrics.add("oomSpillRetries", 1)
-            self.metrics.add("oomSpillBytes", spilled)
+            self.metrics.add(MN.OOM_SPILL_RETRIES, 1)
+            self.metrics.add(MN.OOM_SPILL_BYTES, spilled)
+        journal_event("spill", "oomSpill", alloc_size=alloc_size,
+                      spilled_bytes=spilled, store_size=store_size)
         return spilled > 0
 
 
@@ -103,7 +107,7 @@ class TpuRuntime:
             self.metrics)
         self.oom_spill = bool(self.conf.get(TPU_OOM_SPILL_ENABLED))
         self.semaphore = TpuSemaphore(
-            int(self.conf.get(CONCURRENT_TPU_TASKS)))
+            int(self.conf.get(CONCURRENT_TPU_TASKS)), metrics=self.metrics)
         self._lock = threading.Lock()
 
     # ---- allocation boundary ----------------------------------------------
@@ -127,7 +131,7 @@ class TpuRuntime:
                 break
         used = self.device_store.current_size
         if used + nbytes > self.pool_limit:
-            self.metrics.add("oomAllocFailures", 1)
+            self.metrics.add(MN.OOM_ALLOC_FAILURES, 1)
             raise RetryOOM(
                 f"HBM pool exhausted at {site}: need {nbytes}B, used "
                 f"{used}B of {self.pool_limit}B and nothing left to spill",

@@ -17,9 +17,10 @@ from typing import Dict
 
 
 class TpuSemaphore:
-    def __init__(self, max_concurrent: int):
+    def __init__(self, max_concurrent: int, metrics=None):
         assert max_concurrent > 0
         self.max_concurrent = max_concurrent
+        self.metrics = metrics  # runtime Metrics: semaphoreWaitTime
         self._cond = threading.Condition()
         self._holders: Dict[int, int] = {}   # task id -> acquire depth
 
@@ -28,15 +29,26 @@ class TpuSemaphore:
 
     def acquire_if_necessary(self, task_id=None) -> None:
         """Block until this task holds a device slot; re-entrant per task
-        (GpuSemaphore.acquireIfNecessary)."""
+        (GpuSemaphore.acquireIfNecessary).  Time spent BLOCKED (slot
+        contention, never the fast path) accumulates into the runtime's
+        semaphoreWaitTime metric — the reference's semaphore-wait
+        SQLMetric."""
         key = self._key(task_id)
+        waited = None
         with self._cond:
             while True:
                 depth = self._holders.get(key, 0)
                 if depth > 0 or len(self._holders) < self.max_concurrent:
                     self._holders[key] = depth + 1
-                    return
+                    break
+                if waited is None:
+                    import time
+                    waited = time.perf_counter()
                 self._cond.wait()
+        if waited is not None and self.metrics is not None:
+            import time
+            self.metrics.add("semaphoreWaitTime",
+                             time.perf_counter() - waited)
 
     def release_if_necessary(self, task_id=None) -> None:
         """Give the slot back (e.g. while the task does host-side I/O)."""

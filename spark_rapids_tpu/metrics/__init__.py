@@ -1,0 +1,19 @@
+"""Unified query-level observability.
+
+One subsystem, four surfaces (see docs/monitoring.md):
+
+  * `names` — the metric catalog: every operator metric name, its kind
+    (counter/gauge/timer) and its level (ESSENTIAL/MODERATE/DEBUG);
+  * `registry.Metrics` — the level-gated per-operator metric set every
+    ExecNode owns (exec/base.py re-exports it);
+  * `journal.EventJournal` — the per-query structured JSON-lines span
+    journal operators/retry-blocks/spill/fetch events append to;
+  * `query.QueryExecution` — per-query instrumentation + reporting
+    (EXPLAIN-with-metrics, Prometheus dump, aggregation);
+  * `export` — Prometheus text format + cluster-wide aggregation.
+"""
+from . import names  # noqa: F401
+from .journal import EventJournal, journal_event, read_journal  # noqa: F401
+from .registry import (DEVICE_SYNCS, Metrics, UNREGISTERED_SEEN,  # noqa: F401
+                       parse_level)
+from .query import QueryExecution  # noqa: F401

@@ -1,0 +1,199 @@
+"""Metric exporters: Prometheus text format + cluster-wide aggregation.
+
+`prometheus_dump(query_execution)` renders every node metric of an
+executed query (plus the runtime pool/retry counters) in the Prometheus
+text exposition format, ready to drop behind any textfile collector;
+`parse_prometheus` is the inverse the tests round-trip through.
+
+`cluster_snapshot` pulls `transport_counters` and `pool_stats` from every
+worker of a running cluster — over the control RPC for the multi-process
+`cluster.ProcCluster`, directly for the in-process `plugin.TpuCluster` —
+and `prometheus_cluster_dump` renders the union with per-executor labels,
+the cluster-wide rollup the reference gets from the Spark metrics sink.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import names as N
+
+_PREFIX = "spark_rapids_tpu_"
+_CAMEL = re.compile(r"(?<=[a-z0-9])(?=[A-Z])")
+
+
+def prom_name(metric: str) -> str:
+    """camelCase SQLMetric name -> prometheus_snake_case with the
+    subsystem prefix; timers gain the conventional _seconds suffix."""
+    snake = _CAMEL.sub("_", metric).lower()
+    spec = N.METRICS.get(metric)
+    if spec is not None and spec.kind == N.TIMER:
+        snake += "_seconds"
+    return _PREFIX + snake
+
+
+def _prom_type(metric: str) -> str:
+    spec = N.METRICS.get(metric)
+    if spec is None:
+        return "untyped"
+    return "gauge" if spec.kind in (N.GAUGE, N.TIMER) else "counter"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"')
+
+
+def _sample(name: str, labels: Dict[str, str], value: float) -> str:
+    body = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{body}}} {float(value):g}"
+
+
+def prometheus_dump(qe) -> str:
+    """Prometheus text-format dump of one executed query
+    (metrics/query.QueryExecution)."""
+    by_metric: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    qlabel = str(qe.query_id)
+    for row in qe.node_metrics():
+        labels = {"query": qlabel, "node": str(row["node"]),
+                  "op": row["op"]}
+        for k, v in row["metrics"].items():
+            by_metric.setdefault(k, []).append((labels, v))
+    for k, v in qe.runtime_delta().items():
+        by_metric.setdefault(k, []).append(
+            ({"query": qlabel, "scope": "runtime"}, v))
+    lines: List[str] = []
+    for metric in sorted(by_metric):
+        pname = prom_name(metric)
+        spec = N.METRICS.get(metric)
+        help_text = spec.doc if spec is not None else metric
+        lines.append(f"# HELP {pname} {help_text}")
+        lines.append(f"# TYPE {pname} {_prom_type(metric)}")
+        for labels, value in by_metric[metric]:
+            lines.append(_sample(pname, labels, value))
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)\{([^}]*)\}\s+([^\s]+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> Dict[Tuple[str, frozenset], float]:
+    """Inverse of prometheus_dump (test helper): {(metric_name,
+    frozenset(label items)): value}.  Raises on malformed sample lines."""
+    out: Dict[Tuple[str, frozenset], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"malformed prometheus sample: {line!r}")
+        name, labelstr, value = m.groups()
+        labels = frozenset((k, v.replace(r'\"', '"').replace(r"\\", "\\"))
+                           for k, v in _LABEL_RE.findall(labelstr))
+        out[(name, labels)] = float(value)
+    return out
+
+
+# -- cluster-wide aggregation ------------------------------------------------
+
+def cluster_snapshot(cluster) -> Dict[str, dict]:
+    """{executor_id: {"transport": {...}, "pool": {...}}} pulled from every
+    worker: over the control RPC for cluster.ProcCluster, in-process for
+    plugin.TpuCluster."""
+    out: Dict[str, dict] = {}
+    if hasattr(cluster, "workers"):  # cluster.ProcCluster (rpc path)
+        for w in cluster.workers:
+            out[w.executor_id] = {
+                "transport": w.rpc("transport_counters"),
+                "pool": w.rpc("pool_stats"),
+            }
+    elif hasattr(cluster, "executors"):  # plugin.TpuCluster (in-process)
+        transport = getattr(cluster, "transport", None)
+        shared = dict(getattr(transport, "counters", {}) or {})
+        for e in cluster.executors:
+            out[e.executor_id] = {
+                "transport": shared,  # one loopback wire is shared
+                "pool": e.runtime.pool_stats(),
+            }
+    else:
+        raise TypeError(f"not a cluster: {type(cluster).__name__}")
+    return out
+
+
+def prometheus_cluster_dump(cluster) -> str:
+    """Cluster rollup in Prometheus text format with executor labels."""
+    snap = cluster_snapshot(cluster)
+    lines: List[str] = []
+    emitted_header = set()
+
+    def emit(metric: str, labels: Dict[str, str], value, help_text: str,
+             mtype: str):
+        pname = _PREFIX + metric
+        if pname not in emitted_header:
+            lines.append(f"# HELP {pname} {help_text}")
+            lines.append(f"# TYPE {pname} {mtype}")
+            emitted_header.add(pname)
+        lines.append(_sample(pname, labels, value))
+
+    for exec_id in sorted(snap):
+        labels = {"executor": exec_id}
+        for k, v in sorted(snap[exec_id].get("transport", {}).items()):
+            emit(k, labels, v,
+                 N.TRANSPORT_COUNTERS.get(k, k), "counter")
+        for k, v in sorted(snap[exec_id].get("pool", {}).items()):
+            if k in N.POOL_GAUGES:
+                emit(k, labels, v, N.POOL_GAUGES[k], "gauge")
+            else:  # runtime Metrics counters (oomSpillRetries, ...)
+                spec = N.METRICS.get(k)
+                # prom_name keeps the series name identical to the
+                # per-query dump's (same snake-casing, same _seconds
+                # suffix on timers) so dashboards key on ONE name
+                emit(prom_name(k)[len(_PREFIX):], labels, v,
+                     spec.doc if spec else k, _prom_type(k))
+    return "\n".join(lines) + "\n"
+
+
+# -- bench/session rollup ----------------------------------------------------
+
+def session_observability(session) -> dict:
+    """One flat dict of the counters a benchmark row should carry
+    (bench.py `observability` block): CPU fallbacks, retry/split totals,
+    spill/pool figures, and wire bytes when a cluster is attached."""
+    totals = dict(getattr(session, "query_metrics_total", {}) or {})
+    out = {
+        "numCpuFallbacks": int(totals.get(N.NUM_CPU_FALLBACKS, 0)),
+        "retries": int(sum(totals.get(f"{b}Retries", 0)
+                           for b in N.RETRY_BLOCKS)),
+        "splits": int(sum(totals.get(f"{b}Splits", 0)
+                          for b in N.RETRY_BLOCKS)),
+        "queries": int(getattr(session, "queries_executed", 0)),
+    }
+    if session._runtime is not None:
+        pool = session.runtime.pool_stats()
+        out["oomSpillRetries"] = int(pool.get(N.OOM_SPILL_RETRIES, 0))
+        out["oomAllocFailures"] = int(pool.get(N.OOM_ALLOC_FAILURES, 0))
+        out["spill_bytes"] = int(pool.get(N.OOM_SPILL_BYTES, 0))
+        out["device_used"] = int(pool.get("device_used", 0))
+        out["host_spill_used"] = int(pool.get("host_used", 0))
+        out["disk_spill_used"] = int(pool.get("disk_used", 0))
+    cluster = getattr(session, "_cluster", None) or None
+    wire_sent = wire_recv = 0
+    if cluster:
+        try:
+            snap = cluster_snapshot(cluster)
+            seen = set()
+            for rec in snap.values():
+                t = rec.get("transport", {})
+                key = id(t) if isinstance(t, dict) else None
+                if key in seen:
+                    continue  # TpuCluster shares one wire's counters
+                seen.add(key)
+                wire_sent += int(t.get("bytes_sent", 0))
+                wire_recv += int(t.get("bytes_received", 0))
+        except Exception:  # noqa: BLE001 — observability must not throw
+            pass
+    out["wire_bytes_sent"] = wire_sent
+    out["wire_bytes_received"] = wire_recv
+    return out

@@ -1,0 +1,222 @@
+"""Per-query structured event journal.
+
+JSON-lines spans and instant events with monotonic timestamps, stable ids
+and parent links — the machine-readable twin of the Spark SQL UI timeline.
+The engine opens one journal per query (QueryExecution); operators, retry
+blocks, the spill cascade and the shuffle transport append to whichever
+journal is ACTIVE (module-scoped stack, so deep layers like
+mem/runtime.py's event handler need no plumbed-through handle).
+
+Record schema (one JSON object per line):
+
+  ts     monotonic nanoseconds (time.monotonic_ns; per-process clock)
+  ev     "B" (span begin) | "E" (span end) | "I" (instant event)
+  kind   query|stage|operator|retry|spill|fetch|metric|fallback
+  name   human label (operator describe(), retry block name, ...)
+  id     span/event id, unique within the journal, increasing
+  parent parent span id or null (operator spans parent to the enclosing
+         operator's span; top-level spans parent to the query span)
+  span   (E records only) the id of the B record being closed
+  attrs  everything else: node ids, byte counts, metric dumps, ...
+
+The journal is either file-backed (`spark.rapids.sql.tpu.metrics.journal
+.dir`, one file per query) or in-memory (DEBUG level with no dir
+configured); `events()` parses it back either way, and `validate_events`
+is the schema check the round-trip tests run.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
+               "metric", "fallback")
+
+
+class EventJournal:
+    def __init__(self, path: Optional[str] = None,
+                 query_id: Optional[str] = None):
+        self.path = path
+        self.query_id = query_id
+        self._lines: List[str] = []   # in-memory mirror when path is None
+        self._file = None
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._file = open(path, "w")
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._open_spans: Dict[int, dict] = {}
+        self.closed = False
+
+    # -- writing -------------------------------------------------------------
+
+    def _emit(self, rec: dict) -> None:
+        line = json.dumps(rec, separators=(",", ":"), default=str)
+        if self._file is not None:
+            self._file.write(line + "\n")
+            self._file.flush()
+        else:
+            self._lines.append(line)
+
+    def _record(self, ev: str, kind: str, name: str,
+                parent: Optional[int], attrs: dict) -> int:
+        with self._lock:
+            if self.closed:
+                return -1
+            self._next_id += 1
+            rid = self._next_id
+            rec = {"ts": time.monotonic_ns(), "ev": ev, "kind": kind,
+                   "name": name, "id": rid, "parent": parent}
+            if attrs:
+                rec.update(attrs)
+            if ev == "B":
+                self._open_spans[rid] = rec
+            self._emit(rec)
+            return rid
+
+    def begin(self, kind: str, name: str, parent: Optional[int] = None,
+              **attrs) -> int:
+        """Open a span; returns the span id to close with `end()`."""
+        return self._record("B", kind, name, parent, attrs)
+
+    def end(self, span_id: int, **attrs) -> None:
+        with self._lock:
+            if self.closed or span_id not in self._open_spans:
+                return  # idempotent: double-close / close-after-finish
+            opened = self._open_spans.pop(span_id)
+            self._next_id += 1
+            rec = {"ts": time.monotonic_ns(), "ev": "E",
+                   "kind": opened["kind"], "name": opened["name"],
+                   "id": self._next_id, "parent": opened["parent"],
+                   "span": span_id}
+            if attrs:
+                rec.update(attrs)
+            self._emit(rec)
+
+    def instant(self, kind: str, name: str, parent: Optional[int] = None,
+                **attrs) -> int:
+        return self._record("I", kind, name, parent, attrs)
+
+    @contextlib.contextmanager
+    def span(self, kind: str, name: str, parent: Optional[int] = None,
+             **attrs):
+        sid = self.begin(kind, name, parent, **attrs)
+        try:
+            yield sid
+        finally:
+            self.end(sid)
+
+    def close(self) -> None:
+        """Close any dangling spans (abandoned generators) and the file."""
+        with self._lock:
+            for sid in sorted(self._open_spans):
+                opened = self._open_spans[sid]
+                self._next_id += 1
+                self._emit({"ts": time.monotonic_ns(), "ev": "E",
+                            "kind": opened["kind"], "name": opened["name"],
+                            "id": self._next_id, "parent": opened["parent"],
+                            "span": sid, "dangling": True})
+            self._open_spans.clear()
+            self.closed = True
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    # -- reading -------------------------------------------------------------
+
+    def events(self) -> List[dict]:
+        if self.path is not None:
+            return read_journal(self.path)
+        with self._lock:
+            return [json.loads(ln) for ln in self._lines]
+
+
+def read_journal(path: str) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def validate_events(events: List[dict]) -> List[str]:
+    """Schema check; returns a list of problems (empty = valid)."""
+    errors: List[str] = []
+    seen_ids = set()
+    begun: Dict[int, dict] = {}
+    last_ts = None
+    for i, e in enumerate(events):
+        where = f"event {i}"
+        for field in ("ts", "ev", "kind", "name", "id"):
+            if field not in e:
+                errors.append(f"{where}: missing field {field!r}")
+        if e.get("ev") not in ("B", "E", "I"):
+            errors.append(f"{where}: bad ev {e.get('ev')!r}")
+        if e.get("kind") not in EVENT_KINDS:
+            errors.append(f"{where}: unknown kind {e.get('kind')!r}")
+        eid = e.get("id")
+        if eid in seen_ids:
+            errors.append(f"{where}: duplicate id {eid}")
+        seen_ids.add(eid)
+        ts = e.get("ts")
+        if last_ts is not None and isinstance(ts, int) and ts < last_ts:
+            errors.append(f"{where}: timestamp went backwards")
+        if isinstance(ts, int):
+            last_ts = ts
+        parent = e.get("parent")
+        if parent is not None and parent not in seen_ids:
+            errors.append(f"{where}: parent {parent} not seen before it")
+        if e.get("ev") == "B":
+            begun[eid] = e
+        elif e.get("ev") == "E":
+            sid = e.get("span")
+            if sid not in begun:
+                errors.append(f"{where}: E for unknown span {sid}")
+            else:
+                del begun[sid]
+    for sid in begun:
+        errors.append(f"span {sid} never closed")
+    return errors
+
+
+# -- active-journal plumbing -------------------------------------------------
+# Deep layers (the spill event handler, socket fetch loops, retry blocks)
+# observe whichever query journal is active without threading a handle
+# through every signature.  A stack supports nested queries (a CPU-fallback
+# re-execution inside a parent query keeps appending to the parent's
+# journal once its own finishes).
+
+_ACTIVE: List[EventJournal] = []
+_ACTIVE_LOCK = threading.Lock()
+
+
+def push_active(journal: Optional[EventJournal]) -> None:
+    if journal is not None:
+        with _ACTIVE_LOCK:
+            _ACTIVE.append(journal)
+
+
+def pop_active(journal: Optional[EventJournal]) -> None:
+    if journal is not None:
+        with _ACTIVE_LOCK:
+            if journal in _ACTIVE:
+                _ACTIVE.remove(journal)
+
+
+def active_journal() -> Optional[EventJournal]:
+    with _ACTIVE_LOCK:
+        return _ACTIVE[-1] if _ACTIVE else None
+
+
+def journal_event(kind: str, name: str, **attrs) -> None:
+    """Fire-and-forget instant event into the active journal (no-op when
+    no query journal is open) — the hook deep layers call."""
+    j = active_journal()
+    if j is not None:
+        j.instant(kind, name, **attrs)

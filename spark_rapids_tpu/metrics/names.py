@@ -1,0 +1,224 @@
+"""Central metric-name catalog.
+
+Reference analogue: the metric-name constants and metric-level machinery in
+GpuExec.scala (NUM_OUTPUT_ROWS/NUM_OUTPUT_BATCHES/TOTAL_TIME/... plus
+MetricsLevel gating via spark.rapids.sql.metrics.level) — every operator
+emits only names registered here, and each name carries a level so
+expensive diagnostics can be compiled out of the hot path.
+
+Levels (ordered): ESSENTIAL < MODERATE < DEBUG.  A metric is recorded when
+its registered level is <= the session's configured level
+(`spark.rapids.sql.tpu.metrics.level`):
+
+  * ESSENTIAL — correctness-adjacent counts that are free to maintain
+    (host-side increments only; the Spark UI always shows these);
+  * MODERATE  — wall-clock timers and lazily folded device row counts (one
+    extra device op per batch at most, never a sync);
+  * DEBUG     — anything that forces a per-batch device sync or other
+    measurable overhead (eager row counts, peak-memory sampling).
+
+The lint tier (tests/test_metrics.py + `python -m spark_rapids_tpu.metrics
+--lint`) asserts every `metrics.add/add_lazy/timer` call site in the tree
+uses a registered name, so a typo'd key (`numOutputRow`) fails CI instead
+of silently splitting a counter.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+ESSENTIAL = 1
+MODERATE = 2
+DEBUG = 3
+
+LEVEL_NAMES = {ESSENTIAL: "ESSENTIAL", MODERATE: "MODERATE", DEBUG: "DEBUG"}
+
+# metric kinds (drive the Prometheus TYPE line and the journal/export
+# formatting; timers are seconds)
+COUNTER = "counter"
+GAUGE = "gauge"
+TIMER = "timer"
+
+
+class MetricSpec(NamedTuple):
+    name: str
+    kind: str
+    level: int
+    doc: str
+
+
+METRICS: Dict[str, MetricSpec] = {}
+
+
+def register_metric(name: str, kind: str, level: int, doc: str) -> str:
+    """Register a metric name; returns the name so constants read cleanly."""
+    if name in METRICS:
+        raise ValueError(f"duplicate metric name {name}")
+    if kind not in (COUNTER, GAUGE, TIMER):
+        raise ValueError(f"unknown metric kind {kind!r}")
+    if level not in LEVEL_NAMES:
+        raise ValueError(f"unknown metric level {level!r}")
+    METRICS[name] = MetricSpec(name, kind, level, doc)
+    return name
+
+
+def is_registered(name: str) -> bool:
+    return name in METRICS
+
+
+def metric_level(name: str) -> int:
+    """Level gate for a name; unregistered names are treated as ESSENTIAL
+    (always recorded) but remembered by the registry for the lint tier."""
+    spec = METRICS.get(name)
+    return spec.level if spec is not None else ESSENTIAL
+
+
+# --- standard per-operator metrics (GpuExec.scala:24-41 analogues) ----------
+NUM_OUTPUT_ROWS = register_metric(
+    "numOutputRows", COUNTER, ESSENTIAL, "rows produced by the operator")
+NUM_OUTPUT_BATCHES = register_metric(
+    "numOutputBatches", COUNTER, ESSENTIAL,
+    "columnar batches produced by the operator")
+NUM_OUTPUT_BYTES = register_metric(
+    "numOutputBytes", COUNTER, ESSENTIAL, "bytes written by a write command")
+NUM_FILES = register_metric(
+    "numFiles", COUNTER, ESSENTIAL, "files read by a scan / written by a write")
+NUM_PARTS = register_metric(
+    "numParts", COUNTER, ESSENTIAL, "partitions produced by an exchange")
+DATA_SIZE = register_metric(
+    "dataSize", COUNTER, ESSENTIAL, "bytes of a broadcast/exchanged payload")
+NUM_CPU_FALLBACKS = register_metric(
+    "numCpuFallbacks", COUNTER, ESSENTIAL,
+    "times an exhausted device operator re-executed on its CPU twin")
+NUM_PARTITIONS_WRITTEN = register_metric(
+    "numPartitionsWritten", COUNTER, ESSENTIAL,
+    "shuffle partition sub-batches written by the map side")
+
+TOTAL_TIME = register_metric(
+    "totalTime", TIMER, MODERATE, "operator wall-clock time")
+SCAN_TIME = register_metric(
+    "scanTime", TIMER, MODERATE, "scan decode + H2D time")
+CONCAT_TIME = register_metric(
+    "concatTime", TIMER, MODERATE, "batch coalesce/concat time")
+SORT_TIME = register_metric(
+    "sortTime", TIMER, MODERATE, "device sort time")
+JOIN_TIME = register_metric(
+    "joinTime", TIMER, MODERATE, "join probe/stream time")
+BUILD_TIME = register_metric(
+    "buildTime", TIMER, MODERATE, "join build-side time")
+COMPUTE_AGG_TIME = register_metric(
+    "computeAggTime", TIMER, MODERATE, "per-batch partial aggregation time")
+MERGE_AGG_TIME = register_metric(
+    "mergeAggTime", TIMER, MODERATE, "partial-aggregate merge time")
+WINDOW_TIME = register_metric(
+    "windowTime", TIMER, MODERATE, "window function time")
+GENERATE_TIME = register_metric(
+    "generateTime", TIMER, MODERATE, "generator (explode) time")
+COLLECT_TIME = register_metric(
+    "collectTime", TIMER, MODERATE, "broadcast build-side collect time")
+WRITE_TIME = register_metric(
+    "writeTime", TIMER, MODERATE, "file write/encode time")
+SHUFFLE_READ_TIME = register_metric(
+    "shuffleReadTime", TIMER, MODERATE, "shuffle fetch/read time")
+SHUFFLE_WRITE_TIME = register_metric(
+    "shuffleWriteTime", TIMER, MODERATE, "shuffle partition/write time")
+H2D_TIME = register_metric(
+    "h2dTime", TIMER, MODERATE, "host->device adoption time")
+D2H_TIME = register_metric(
+    "d2hTime", TIMER, MODERATE, "device->host materialization time")
+DISTRIBUTED_AGG_TIME = register_metric(
+    "distributedAggTime", TIMER, MODERATE, "SPMD distributed aggregate time")
+DISTRIBUTED_JOIN_TIME = register_metric(
+    "distributedJoinTime", TIMER, MODERATE, "SPMD distributed join time")
+DISTRIBUTED_SORT_TIME = register_metric(
+    "distributedSortTime", TIMER, MODERATE, "SPMD distributed sort time")
+SEMAPHORE_WAIT_TIME = register_metric(
+    "semaphoreWaitTime", TIMER, MODERATE,
+    "time blocked acquiring the device task semaphore")
+
+# --- scan/write internals ---------------------------------------------------
+NUM_STRIPES = register_metric(
+    "numStripes", COUNTER, MODERATE, "ORC stripes read")
+NUM_STRIPES_SKIPPED = register_metric(
+    "numStripesSkipped", COUNTER, MODERATE,
+    "ORC stripes pruned by footer statistics")
+NUM_ROW_GROUPS = register_metric(
+    "numRowGroups", COUNTER, MODERATE, "parquet row groups read")
+NUM_ROW_GROUPS_SKIPPED = register_metric(
+    "numRowGroupsSkipped", COUNTER, MODERATE,
+    "parquet row groups pruned by predicate pushdown")
+NUM_DEVICE_DECODED_COLUMNS = register_metric(
+    "numDeviceDecodedColumns", COUNTER, MODERATE,
+    "columns decoded by device kernels (vs host fallback)")
+NUM_DEVICE_DECODE_ERRORS = register_metric(
+    "numDeviceDecodeErrors", COUNTER, MODERATE,
+    "columns that fell back to the host reader after a device decode error")
+NUM_DEVICE_ENCODED_FILES = register_metric(
+    "numDeviceEncodedFiles", COUNTER, MODERATE,
+    "files encoded by device write kernels")
+
+# --- memory / retry (mem/runtime.py + mem/retry.py) -------------------------
+OOM_SPILL_RETRIES = register_metric(
+    "oomSpillRetries", COUNTER, ESSENTIAL,
+    "allocation attempts retried behind a synchronous spill")
+OOM_SPILL_BYTES = register_metric(
+    "oomSpillBytes", COUNTER, ESSENTIAL,
+    "bytes spilled out of the device store by the OOM cascade")
+OOM_ALLOC_FAILURES = register_metric(
+    "oomAllocFailures", COUNTER, ESSENTIAL,
+    "reserve() calls that raised RetryOOM after the spill cascade")
+PEAK_DEV_MEMORY = register_metric(
+    "peakDevMemory", GAUGE, DEBUG,
+    "high-water mark of accounted device-store bytes sampled per batch")
+
+# retry-block counters: each `run_retryable(ctx, metrics, <block>)` call
+# site emits `<block>Retries` / `<block>Splits` (mem/retry.py with_retry)
+RETRY_BLOCKS = ("sort", "aggUpdate", "aggMerge", "joinBuild", "joinProbe",
+                "exchangePartition", "exchangeWrite", "exchangeFetch",
+                "retryBlock")
+for _b in RETRY_BLOCKS:
+    register_metric(f"{_b}Retries", COUNTER, ESSENTIAL,
+                    f"same-size OOM retries of the {_b} retryable block")
+    register_metric(f"{_b}Splits", COUNTER, ESSENTIAL,
+                    f"split-and-retry escalations of the {_b} retryable block")
+
+
+def retry_metric_names(block: str) -> tuple:
+    return (f"{block}Retries", f"{block}Splits")
+
+
+# --- shuffle transport wire counters (shuffle/net.py count()) ---------------
+# Not SQLMetrics — a separate snake_case namespace owned by the transport —
+# but registered here so the Prometheus exporter and the cluster aggregation
+# share one catalog of everything observable.
+TRANSPORT_COUNTERS = {
+    "bytes_sent": "payload bytes written to peer sockets",
+    "bytes_received": "payload bytes read from peer sockets",
+    "metadata_fetched": "shuffle metadata round trips issued",
+    "metadata_served": "shuffle metadata round trips answered",
+    "net_op_retries": "socket operations retried after a transient error",
+    "net_op_failures": "socket operations that exhausted their retries",
+    "peer_disconnects": "peer connections dropped mid-stream",
+    "accept_errors": "transient server accept() errors survived",
+    "rpc_errors": "control-plane RPC failures",
+    "shm_fills": "local-partition reads served via shared memory",
+    "shm_unavailable": "shared-memory reads that fell back to the stream",
+}
+
+# --- runtime pool gauges (mem/runtime.py pool_stats()) ----------------------
+POOL_GAUGES = {
+    "pool_limit": "accounted HBM pool budget in bytes",
+    "device_used": "bytes currently tracked in the device store",
+    "host_used": "bytes currently tracked in the host spill store",
+    "disk_used": "bytes currently tracked in the disk spill store",
+}
+
+
+def catalog_rows():
+    """(name, kind, level, doc) rows for docs/monitoring.md generation."""
+    rows = [(s.name, s.kind, LEVEL_NAMES[s.level], s.doc)
+            for s in sorted(METRICS.values())]
+    rows += [(k, COUNTER, "ESSENTIAL", v + " (transport counter)")
+             for k, v in sorted(TRANSPORT_COUNTERS.items())]
+    rows += [(k, GAUGE, "ESSENTIAL", v + " (runtime pool gauge)")
+             for k, v in sorted(POOL_GAUGES.items())]
+    return rows

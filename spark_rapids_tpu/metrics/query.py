@@ -1,0 +1,237 @@
+"""Per-query observability driver.
+
+`QueryExecution` wraps one executed physical plan: it assigns stable node
+ids, pins every operator's metrics to the session's configured level,
+opens the per-query event journal (file-backed under
+`spark.rapids.sql.tpu.metrics.journal.dir`, in-memory at DEBUG level
+otherwise), and instruments every node's execute/execute_cpu so operator
+spans land in the journal with parent links that mirror the plan tree.
+
+After the query runs, the same object is the reporting surface:
+
+  * `explain_with_metrics()` — the plan tree annotated with each node's
+    accumulated metrics (the Spark SQL UI analogue; printed automatically
+    when `spark.rapids.sql.explain=METRICS`);
+  * `prometheus()` — Prometheus text-format dump of every node metric plus
+    the runtime pool/retry counters (export.py);
+  * `node_metrics()` / `aggregate()` — structured access for bench.py and
+    the tests.
+
+Instrumentation notes: `execute` wrappers are plain functions that emit
+the span-begin eagerly at CALL time and delegate to the original
+generator, so a parent operator's span always opens before the child's
+(operators call `child.execute(ctx)` from inside their own body).  Spans
+close when the generator is exhausted or closed; `finish()` force-closes
+anything a short-circuiting consumer (limit) left dangling.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import names as N
+from .journal import EventJournal, pop_active, push_active
+from .registry import Metrics, parse_level
+
+_QUERY_IDS = itertools.count(1)
+_QUERY_ID_LOCK = threading.Lock()
+
+
+def _next_query_id() -> int:
+    with _QUERY_ID_LOCK:
+        return next(_QUERY_IDS)
+
+
+class QueryExecution:
+    def __init__(self, conf, physical, runtime=None):
+        from .. import config as C
+        self.query_id = _next_query_id()
+        self.physical = physical
+        self.runtime = runtime
+        self.level = parse_level(conf.get(C.METRICS_LEVEL))
+        jdir = str(conf.get(C.METRICS_JOURNAL_DIR) or "")
+        self.journal: Optional[EventJournal] = None
+        if jdir or self.level >= N.DEBUG:
+            path = (os.path.join(jdir, f"query-{self.query_id}.jsonl")
+                    if jdir else None)
+            self.journal = EventJournal(path, query_id=self.query_id)
+        # preorder walk: node ids, parent links, per-query metrics level
+        self.nodes: List = []
+        self._parent_of: Dict[int, Optional[int]] = {}
+        self._assign_ids(physical, None)
+        for node in self.nodes:
+            node.metrics.configure(self.level)
+        self._span_of: Dict[int, int] = {}  # node id -> open span id
+        self._runtime_before = (dict(runtime.metrics.snapshot())
+                                if runtime is not None else {})
+        self.started_at = time.perf_counter()
+        self.duration = None
+        self.error = None
+        self.finished = False
+        if self.journal is not None:
+            self._query_span = self.journal.begin(
+                "query", f"query-{self.query_id}", level=self.level,
+                root=type(physical).__name__)
+            for node in self.nodes:
+                self._instrument(node)
+            push_active(self.journal)
+
+    # -- tree bookkeeping ----------------------------------------------------
+
+    def _assign_ids(self, node, parent_id) -> None:
+        nid = len(self.nodes)
+        node._node_id = nid
+        self.nodes.append(node)
+        self._parent_of[nid] = parent_id
+        for c in node.children:
+            self._assign_ids(c, nid)
+
+    def _parent_span(self, nid: int) -> int:
+        pid = self._parent_of.get(nid)
+        while pid is not None:
+            sid = self._span_of.get(pid)
+            if sid is not None:
+                return sid
+            pid = self._parent_of.get(pid)
+        return self._query_span
+
+    def _instrument(self, node) -> None:
+        journal = self.journal
+        nid = node._node_id
+
+        def wrap(orig, mode):
+            def wrapped(ctx, _orig=orig, _nid=nid, _node=node):
+                sid = journal.begin(
+                    "operator", _node.name, parent=self._parent_span(_nid),
+                    node=_nid, mode=mode)
+                self._span_of[_nid] = sid
+
+                def drive(gen):
+                    try:
+                        yield from gen
+                    finally:
+                        journal.end(sid)
+                        if self._span_of.get(_nid) == sid:
+                            del self._span_of[_nid]
+                return drive(_orig(ctx))
+            return wrapped
+
+        # instance-attribute shadowing: per-query plan trees are fresh
+        # objects, so the wrap never leaks across queries.  Exchanges are
+        # additionally driven through execute_partitions (a shuffled hash
+        # join pulls both children partition-wise, never calling execute),
+        # so that entry point gets its own span wrapper too.
+        try:
+            node.execute = wrap(node.execute, "device")
+            node.execute_cpu = wrap(node.execute_cpu, "cpu")
+            if hasattr(node, "execute_partitions"):
+                node.execute_partitions = wrap(node.execute_partitions,
+                                               "partitions")
+        except AttributeError:  # pragma: no cover - exotic nodes
+            pass
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def finish(self, error: Optional[BaseException] = None
+               ) -> "QueryExecution":
+        if self.finished:
+            return self
+        self.finished = True
+        self.duration = time.perf_counter() - self.started_at
+        self.error = error
+        if self.journal is not None:
+            try:
+                # final per-node metric dump: the journal carries the SAME
+                # numbers explain_with_metrics and the Prometheus dump
+                # render, so the three surfaces agree by construction
+                for node in self.nodes:
+                    vals = node.metrics.snapshot()
+                    if vals:
+                        self.journal.instant(
+                            "metric", node.name, parent=self._query_span,
+                            node=node._node_id, metrics=vals)
+                delta = self.runtime_delta()
+                if delta:
+                    self.journal.instant(
+                        "metric", "runtime", parent=self._query_span,
+                        metrics=delta)
+                self.journal.end(
+                    self._query_span,
+                    error=repr(error)[:200] if error else None,
+                    duration_s=round(self.duration, 6))
+            finally:
+                # whatever the metric dump did, the journal must come off
+                # the active stack (or later queries' events misroute into
+                # it) and release its file handle
+                pop_active(self.journal)
+                self.journal.close()
+        return self
+
+    # -- reporting -----------------------------------------------------------
+
+    def runtime_delta(self) -> Dict[str, float]:
+        """Runtime (pool/retry/spill) counter movement during this query."""
+        if self.runtime is None:
+            return {}
+        after = self.runtime.metrics.snapshot()
+        out = {}
+        for k, v in after.items():
+            d = v - self._runtime_before.get(k, 0)
+            if d:
+                out[k] = d
+        return out
+
+    def node_metrics(self) -> List[dict]:
+        return [{"node": n._node_id, "op": type(n).__name__,
+                 "name": n.describe(), "metrics": n.metrics.snapshot()}
+                for n in self.nodes]
+
+    def aggregate(self) -> Dict[str, float]:
+        """Counters summed across every node (timers too — a coarse
+        'time in operators' figure), plus the runtime delta."""
+        out: Dict[str, float] = {}
+        for n in self.nodes:
+            for k, v in n.metrics.snapshot().items():
+                out[k] = out.get(k, 0) + v
+        for k, v in self.runtime_delta().items():
+            out[k] = out.get(k, 0) + v
+        return out
+
+    def _render(self, node, indent: int, lines: List[str]) -> None:
+        vals = node.metrics.snapshot()
+        parts = []
+        for k in sorted(vals):
+            v = vals[k]
+            spec = N.METRICS.get(k)
+            if spec is not None and spec.kind == N.TIMER:
+                parts.append(f"{k}: {v * 1e3:.1f}ms")
+            elif float(v) == int(v):
+                parts.append(f"{k}: {int(v)}")
+            else:
+                parts.append(f"{k}: {v:.3f}")
+        suffix = f" [{', '.join(parts)}]" if parts else ""
+        lines.append(" " * indent + node.describe() + suffix)
+        for c in node.children:
+            self._render(c, indent + 2, lines)
+
+    def explain_with_metrics(self) -> str:
+        """The executed plan tree with each node's accumulated metrics —
+        what the reference surfaces per-node in the Spark SQL UI."""
+        lines = [f"== Query {self.query_id} "
+                 f"({N.LEVEL_NAMES[self.level]}"
+                 + (f", {self.duration:.3f}s" if self.duration is not None
+                    else "") + ") =="]
+        self._render(self.physical, 0, lines)
+        delta = self.runtime_delta()
+        if delta:
+            parts = ", ".join(f"{k}: {int(v) if v == int(v) else v}"
+                              for k, v in sorted(delta.items()))
+            lines.append(f"runtime: [{parts}]")
+        return "\n".join(lines)
+
+    def prometheus(self) -> str:
+        from .export import prometheus_dump
+        return prometheus_dump(self)

@@ -214,6 +214,10 @@ class ShuffleEnv:
                         remote_peers: Optional[List[str]] = None
                         ) -> Iterator[ColumnarBatch]:
         """Local blocks from the catalog; remote blocks via transport."""
+        from ..metrics.journal import journal_event
+        journal_event("fetch", "fetchPartition", shuffle=shuffle_id,
+                      reduce=reduce_id, executor=self.executor_id,
+                      remote_peers=len(remote_peers or []))
         for block in self.catalog.blocks_for_reduce(shuffle_id, reduce_id):
             for bid in self.catalog.buffers_for(block):
                 baseline = self.baseline_leaves(bid)
@@ -245,17 +249,25 @@ class ShuffleEnv:
         request discovers the peer's blocks for this reduce partition, then
         per-buffer receives register spillable buffers locally.  Everything
         goes through the transport SPI — no peer-object introspection."""
+        from ..metrics.journal import journal_event
         client = self.transport.make_client(peer)
         resp = client.fetch_metadata(MetadataRequest(
             shuffle_id=shuffle_id, reduce_id=reduce_id))
+        fetched_bytes = 0
+        n_buffers = 0
         for bm in resp.block_metas:
             for bid in bm.buffer_ids:
                 leaves, meta = client.fetch_buffer(bid)
                 client.release_buffer(bid)
                 batch = host_to_batch(leaves, meta)
+                fetched_bytes += meta.size_bytes
+                n_buffers += 1
                 rid = self.runtime.add_batch(batch)
                 self.received.add(shuffle_id, rid)
                 yield self.runtime.get_batch(rid)
+        journal_event("fetch", "fetchRemote", peer=peer,
+                      shuffle=shuffle_id, reduce=reduce_id,
+                      buffers=n_buffers, bytes=fetched_bytes)
 
 
 def get_shuffle_env(runtime: TpuRuntime, conf: TpuConf) -> ShuffleEnv:

@@ -192,6 +192,11 @@ class WorkerHandler:
     def rpc_transport_counters(self):
         return dict(self.transport.counters)
 
+    def rpc_pool_stats(self):
+        """Runtime pool/retry/spill figures for cluster-wide observability
+        (metrics/export.cluster_snapshot pulls this from every worker)."""
+        return dict(self.runtime.pool_stats())
+
     def rpc_remove_shuffle(self, sid: int):
         self.env.remove_shuffle(sid)
         return True

@@ -28,12 +28,66 @@ def named_range(name: str, metrics=None, metric_name: str = None):
 
 
 @contextlib.contextmanager
-def profile_trace(log_dir: str):
+def profile_trace(log_dir: str, journal=None):
     """Capture a device trace for the enclosed block (the Nsight-capture
-    equivalent; open with TensorBoard's profile plugin)."""
+    equivalent; open with TensorBoard's profile plugin).  Pass a query
+    `journal` (metrics.journal.EventJournal) to also emit its spans as a
+    Chrome trace-event file in `log_dir`, so the engine's
+    operator/retry/spill/fetch timeline sits next to the XLA device
+    timeline in the same viewer."""
     import jax
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+        if journal is not None:
+            import os
+            write_chrome_trace(journal.events(),
+                               os.path.join(log_dir, "journal_trace.json"))
+
+
+def journal_to_trace_events(events) -> list:
+    """metrics.journal event records -> Chrome trace-event format (the
+    XLA trace viewer / Perfetto / chrome://tracing input format).  B/E
+    spans map to ph B/E duration events on a per-kind 'thread'; instant
+    events map to ph i."""
+    kinds = sorted({e.get("kind", "?") for e in events})
+    tid_of = {k: i + 1 for i, k in enumerate(kinds)}
+    out = [{"name": "process_name", "ph": "M", "pid": 1,
+            "args": {"name": "spark_rapids_tpu journal"}}]
+    for k, tid in tid_of.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                    "args": {"name": k}})
+    for e in events:
+        ts_us = e.get("ts", 0) / 1e3  # monotonic ns -> us
+        rec = {"name": e.get("name", "?"), "pid": 1,
+               "tid": tid_of.get(e.get("kind", "?"), 0), "ts": ts_us,
+               "cat": e.get("kind", "?")}
+        args = {k: v for k, v in e.items()
+                if k not in ("ts", "ev", "kind", "name")}
+        if args:
+            rec["args"] = args
+        ev = e.get("ev")
+        if ev == "B":
+            rec["ph"] = "B"
+        elif ev == "E":
+            rec["ph"] = "E"
+        elif ev == "I":
+            rec["ph"] = "i"
+            rec["s"] = "t"  # thread-scoped instant
+        else:
+            continue
+        out.append(rec)
+    return out
+
+
+def write_chrome_trace(events, path: str) -> str:
+    """Write journal events as a Chrome trace-event JSON file."""
+    import json
+    import os
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": journal_to_trace_events(events),
+                   "displayTimeUnit": "ms"}, f)
+    return path
