@@ -335,6 +335,15 @@ def child_main(mode: str) -> None:
         emit("observability", **session_observability(session))
     except Exception as e:  # the rollup must never sink the bench
         emit("observability", error=repr(e)[:200])
+    # adaptive-execution rollup (PR-3): coalesce/skew/strategy-change
+    # counts and stage re-plan latency next to the observability block,
+    # so a perf number is never read without knowing whether AQE rewrote
+    # the plan that produced it
+    try:
+        from spark_rapids_tpu.metrics.export import session_adaptive
+        emit("adaptive", **session_adaptive(session))
+    except Exception as e:
+        emit("adaptive", error=repr(e)[:200])
     emit("done", t=time.time() - (_DEADLINE[0] - float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))))
 
@@ -450,7 +459,7 @@ def collect(r: "StageReader", end_at: float,
     child."""
     out = {"platform": None, "runs": {}, "warmup": {}, "values": {},
            "transfer": None, "aborted": False, "backend_error": None,
-           "observability": None}
+           "observability": None, "adaptive": None}
     first = True
     try:
         while True:
@@ -483,6 +492,9 @@ def collect(r: "StageReader", end_at: float,
             elif st == "observability":
                 out["observability"] = {k: v for k, v in rec.items()
                                         if k != "stage"}
+            elif st == "adaptive":
+                out["adaptive"] = {k: v for k, v in rec.items()
+                                   if k != "stage"}
             elif st == "abort":
                 out["aborted"] = True
                 break
@@ -634,6 +646,7 @@ def _run():
         "per_query": per_query,
         "transfer": dev.get("transfer"),
         "observability": dev.get("observability"),
+        "adaptive": dev.get("adaptive"),
         "q6_effective_gb_s": round(eff_gb_s, 2),
         "hbm_roofline_note": "v5e HBM ~819 GB/s; q6 reads 32 B/row",
         "vs_ref_headline": round(vs / 19.8, 4),

@@ -29,6 +29,13 @@ python -m pytest tests/test_metrics.py tests/test_observability_e2e.py \
     -q -m "not slow" -p no:cacheprovider
 echo "== observability tier took $((SECONDS - T_OBS))s =="
 
+echo "== adaptive tier =="
+# adaptive query execution (ISSUE 3): AQE-on must match AQE-off while the
+# coalesce/skew/strategy rules demonstrably fire and land in the journal
+T_AQE=$SECONDS
+python -m pytest tests/test_adaptive.py -q -m "not slow" -p no:cacheprovider
+echo "== adaptive tier took $((SECONDS - T_AQE))s =="
+
 echo "== tests (fast tier) =="
 T_TESTS=$SECONDS
 MARK="not slow"

@@ -1,0 +1,32 @@
+"""Adaptive query execution: runtime re-planning from shuffle map statistics.
+
+The query runs as a DAG of stages split at `TpuShuffleExchangeExec`
+boundaries: map stages are materialized first, their OBSERVED per-partition
+output sizes (stats.py) replace the planner's schema-width guesses, and the
+reduce side is re-planned (rules.py) before it is instantiated
+(executor.py).  Reference analogue: Spark 3 AQE driving
+GpuShuffleExchangeExec + GpuCustomShuffleReaderExec.
+
+Submodule imports stay lazy: exec/ imports `adaptive.stats` for the
+partition-spec types, and an eager package __init__ would cycle back into
+exec/ through executor.py.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "CoalescedPartitionSpec", "PartialReducerPartitionSpec",
+    "MapOutputStatistics", "MapOutputTracker", "merge_cluster_stats",
+    "TpuAdaptivePlanExec", "maybe_wrap_adaptive",
+]
+
+
+def __getattr__(name):
+    if name in ("CoalescedPartitionSpec", "PartialReducerPartitionSpec",
+                "MapOutputStatistics", "MapOutputTracker",
+                "merge_cluster_stats"):
+        from . import stats
+        return getattr(stats, name)
+    if name in ("TpuAdaptivePlanExec", "maybe_wrap_adaptive"):
+        from . import executor
+        return getattr(executor, name)
+    raise AttributeError(name)

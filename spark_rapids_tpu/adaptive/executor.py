@@ -1,0 +1,159 @@
+"""Adaptive stage-graph executor.
+
+`TpuAdaptivePlanExec` wraps an about-to-run physical tree (the engine
+inserts it at execution time, never in `physical_plan()` output): its
+`execute` walks the tree bottom-up, MATERIALIZES each shuffle exchange's
+map stage (write phase; `TpuShuffleExchangeExec.materialize`), then applies
+the re-planning rules (rules.py) over the observed `MapOutputStatistics`
+before the reduce side is instantiated — Spark AQE's
+query-stage-by-query-stage loop collapsed into one recursive pass, because
+stage dependencies here ARE the tree structure: materializing an exchange
+executes its (already adapted) subtree.
+
+The rewritten tree is re-registered with the live QueryExecution
+(`QueryExecution.adopt`) so EXPLAIN METRICS, the journal's per-node metric
+dump and the Prometheus export all show the FINAL (re-planned) plan.
+
+Failure containment: if a stage materialization exhausts its OOM retries,
+the node is left un-adapted and normal execution — with its operator-local
+CPU fallback machinery (exec/retryable.py) — takes over.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from .. import config as C
+from ..columnar import ColumnarBatch
+from ..exec.base import ExecContext, ExecNode, TpuExec
+from ..metrics import names as MN
+
+
+class TpuAdaptivePlanExec(TpuExec):
+    """AQE driver node (AdaptiveSparkPlanExec analogue): re-plans its
+    subtree from runtime statistics at execute time, then delegates."""
+
+    def __init__(self, child: ExecNode):
+        super().__init__(child)
+        self._replanned = False
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        suffix = "final" if self._replanned else "initial"
+        return f"TpuAdaptivePlanExec[{suffix}]"
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        final = self._replan(ctx)
+        yield from final.execute(ctx)
+
+    # ---- re-planning -------------------------------------------------------
+
+    def _replan(self, ctx: ExecContext) -> ExecNode:
+        if self._replanned or not ctx.conf.get(C.ADAPTIVE_ENABLED):
+            return self.children[0]
+        new_root = self._adapt(self.children[0], ctx)
+        self._replanned = True
+        self.children = [new_root]
+        qe = getattr(ctx, "query_execution", None)
+        if qe is not None:
+            # EXPLAIN METRICS / journal / prometheus must show the FINAL
+            # stage plan: register any nodes the rules created
+            qe.adopt(self)
+        return new_root
+
+    def _adapt(self, node: ExecNode, ctx: ExecContext) -> ExecNode:
+        from ..exec.broadcast import (TpuBroadcastExchangeExec,
+                                      TpuBroadcastHashJoinExec)
+        from ..exec.exchange import TpuShuffleExchangeExec
+        from ..exec.join import TpuShuffledHashJoinExec
+        from ..exec.shuffle_reader import TpuCoalescedShuffleReaderExec
+        from ..mem.retry import RetryExhausted
+        from . import rules
+
+        if isinstance(node, TpuCoalescedShuffleReaderExec):
+            # already re-planned in an earlier pass of this walk (a
+            # demoted broadcast's replacement join re-walks its adapted
+            # probe subtree): re-entering the exchange below would re-fire
+            # the coalesce rule on the same cached stats and nest a second
+            # reader around the first
+            return node
+
+        if isinstance(node, TpuShuffledHashJoinExec) \
+                and all(isinstance(c, TpuShuffleExchangeExec)
+                        for c in node.children):
+            lex, rex = node.children
+            lex.children = [self._adapt(lex.children[0], ctx)]
+            rex.children = [self._adapt(rex.children[0], ctx)]
+            try:
+                lex.materialize(ctx)
+                rex.materialize(ctx)
+                with self.metrics.timer(MN.REPLAN_TIME):
+                    return rules.replan_shuffled_join(node, ctx,
+                                                      self.metrics)
+            except RetryExhausted:
+                return node  # normal execution owns the fallback path
+
+        if isinstance(node, TpuBroadcastHashJoinExec) \
+                and isinstance(node.children[1], TpuBroadcastExchangeExec):
+            bx = node.children[1]
+            probe = self._adapt(node.children[0], ctx)
+            bx.children = [self._adapt(bx.children[0], ctx)]
+            node.children = [probe, bx]
+            thr = ctx.conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
+            if not ctx.conf.get(C.ADAPTIVE_JOIN_STRATEGY_ENABLED) \
+                    or thr is None or int(thr) < 0:
+                return node
+            try:
+                # collect the build once, OUTSIDE the replan timer (a kept
+                # broadcast reuses the cached collect at probe time); the
+                # demotion check then reads its observed size
+                bx.materialize_host(ctx)
+                with self.metrics.timer(MN.REPLAN_TIME):
+                    new = rules.demote_broadcast_join(node, ctx,
+                                                      self.metrics)
+            except RetryExhausted:
+                return node
+            if new is not node:
+                return self._adapt(new, ctx)  # adapt the replacement join
+            return node
+
+        node.children = [self._adapt(c, ctx) for c in node.children]
+        if isinstance(node, TpuShuffleExchangeExec) \
+                and node.num_partitions > 1 and node.mode != "single":
+            try:
+                node.materialize(ctx)
+                with self.metrics.timer(MN.REPLAN_TIME):
+                    return rules.replan_exchange(node, ctx, self.metrics)
+            except RetryExhausted:
+                return node
+        return node
+
+
+def has_adaptive_target(node: ExecNode) -> bool:
+    """Anything in the tree adaptive execution could improve?"""
+    from ..exec.broadcast import TpuBroadcastHashJoinExec
+    from ..exec.exchange import TpuShuffleExchangeExec
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (TpuShuffleExchangeExec,
+                          TpuBroadcastHashJoinExec)):
+            return True
+        stack.extend(n.children)
+    return False
+
+
+def maybe_wrap_adaptive(physical: ExecNode, conf) -> ExecNode:
+    """Engine hook (engine.py to_arrow/_write/to_device_batches): wrap a
+    device tree in the AQE driver when enabled and worthwhile.  Applied at
+    EXECUTE time only, so `DataFrame.physical_plan()` keeps showing the
+    static plan the planner chose."""
+    if not conf.get(C.ADAPTIVE_ENABLED):
+        return physical
+    if not isinstance(physical, TpuExec):
+        return physical
+    if not has_adaptive_target(physical):
+        return physical
+    return TpuAdaptivePlanExec(physical)

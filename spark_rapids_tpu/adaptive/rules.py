@@ -1,0 +1,273 @@
+"""Adaptive re-planning rules: what changes between map stage and reduce
+side once observed sizes replace estimates.
+
+Three rules, mirroring Spark AQE's reduce-side optimizations over
+GpuShuffleExchangeExec / GpuCustomShuffleReaderExec:
+
+  * coalesce small partitions — merge contiguous reduce partitions up to
+    `spark.rapids.sql.tpu.adaptive.advisoryPartitionSizeBytes`, served by
+    one TpuCoalescedShuffleReaderExec spec per merged range;
+  * skew-join split — a stream-side partition larger than
+    `skewedPartitionFactor x median` (and the size floor) is split into
+    map-id-range slices, each paired with a replicated read of the full
+    build-side partition;
+  * dynamic join strategy — a partitioned join whose OBSERVED build side
+    fits under spark.sql.autoBroadcastJoinThreshold is promoted to a
+    single-build join; a planned broadcast whose observed collect blew
+    past the threshold is demoted to a partitioned join over the
+    already-collected build (overriding the static
+    `_should_broadcast_build` choice, plan/physical.py).
+
+Every decision appends a `replan` journal event and bumps the adaptive
+metric counters (numCoalescedPartitions / numSkewSplits /
+numJoinStrategyChanges), so EXPLAIN METRICS, the event journal and the
+Prometheus export all show what actually ran.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from .. import config as C
+from ..metrics import names as MN
+from ..metrics.journal import journal_event
+from .stats import (CoalescedPartitionSpec, PartialReducerPartitionSpec,
+                    is_identity)
+
+# stream-side row slices compose by concatenation for these join types
+# (each left row's matches depend only on the resident build side).  FULL
+# outer stays whole: its never-matched-build tail is emitted once per
+# probe stream, so slicing would duplicate it per slice.
+SKEW_SPLITTABLE_JOINS = ("inner", "left", "left_semi", "left_anti")
+
+
+def coalesce_specs(n: int, size_lists: List[List[int]],
+                   bounds: List[int]) -> List[CoalescedPartitionSpec]:
+    """Greedy contiguous merge: partitions accumulate into one spec while
+    EVERY tracked size sum stays within its bound (a join tracks the
+    combined l+r bytes against the advisory size AND the build side
+    against the partitioned-join threshold, so coalescing never un-bounds
+    the single-build-batch contract the exchange was inserted for)."""
+    specs: List[CoalescedPartitionSpec] = []
+    start = 0
+    accs = [0] * len(size_lists)
+    for p in range(n):
+        cur = [sl[p] for sl in size_lists]
+        if p > start and any(a + c > b
+                             for a, c, b in zip(accs, cur, bounds)):
+            specs.append(CoalescedPartitionSpec(start, p))
+            start = p
+            accs = [0] * len(size_lists)
+        accs = [a + c for a, c in zip(accs, cur)]
+    if n > 0:
+        specs.append(CoalescedPartitionSpec(start, n))
+    return specs
+
+
+def detect_skew(sizes: List[int], factor: float,
+                threshold: int) -> Set[int]:
+    """Partitions whose bytes exceed max(factor x median non-empty size,
+    threshold floor)."""
+    nonzero = sorted(s for s in sizes if s > 0)
+    if not nonzero:
+        return set()
+    median = nonzero[len(nonzero) // 2]
+    bound = max(median * factor, threshold)
+    return {p for p, s in enumerate(sizes) if s > bound}
+
+
+def map_range_slices(map_bytes: Dict[int, int],
+                     target: int) -> List[Tuple[int, int]]:
+    """Split one partition's per-map-task sizes into contiguous map-id
+    ranges of roughly `target` bytes.  A single-map partition returns one
+    slice (unsplittable — the map output is one block)."""
+    if not map_bytes:
+        return []
+    mids = sorted(map_bytes)
+    slices: List[Tuple[int, int]] = []
+    lo = 0  # cover from map 0: unseen low ids wrote nothing, cost nothing
+    acc = 0
+    for m in mids:
+        b = map_bytes[m]
+        if acc > 0 and acc + b > target:
+            slices.append((lo, m))
+            lo = m
+            acc = 0
+        acc += b
+    slices.append((lo, mids[-1] + 1))
+    return slices
+
+
+def replan_shuffled_join(join, ctx, adaptive_metrics):
+    """Re-plan one TpuShuffledHashJoinExec whose exchanges are already
+    materialized; returns the node to execute (possibly a different join
+    operator, possibly the same node re-wired onto paired readers,
+    possibly untouched)."""
+    from ..exec.join import TpuHashJoinExec
+    from ..exec.shuffle_reader import TpuCoalescedShuffleReaderExec
+    conf = ctx.conf
+    am = adaptive_metrics
+    lex, rex = join.children
+    lh, rh = lex._handle, rex._handle
+    n = lh.num_partitions
+    lst, rst = lh.stats(), rh.stats()
+    lbytes, rbytes = lst.bytes_by_partition, rst.bytes_by_partition
+    advisory = int(conf.get(C.ADAPTIVE_ADVISORY_PARTITION_SIZE))
+    coalesce_on = bool(conf.get(C.ADAPTIVE_COALESCE_ENABLED))
+
+    # --- dynamic join strategy: promote to a single-build join ----------
+    thr = conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
+    if bool(conf.get(C.ADAPTIVE_JOIN_STRATEGY_ENABLED)) \
+            and join.join_type != "full" \
+            and not getattr(join, "_adaptive_no_promote", False) \
+            and thr is not None and int(thr) >= 0 \
+            and rst.total_bytes <= int(thr):
+        am.add(MN.NUM_JOIN_STRATEGY_CHANGES, 1)
+        journal_event("replan", "promoteToBroadcast",
+                      shuffle=rh.sid, build_bytes=rst.total_bytes,
+                      threshold=int(thr))
+        if coalesce_on:
+            lspecs = coalesce_specs(n, [lbytes], [advisory])
+            merged = n - len(lspecs)
+            if merged:
+                am.add(MN.NUM_COALESCED_PARTITIONS, merged)
+        else:
+            from .stats import identity_specs
+            lspecs = identity_specs(n)
+        left = TpuCoalescedShuffleReaderExec(lex, lspecs, kind="coalesced")
+        right = TpuCoalescedShuffleReaderExec(
+            rex, [CoalescedPartitionSpec(0, n)], kind="build")
+        return TpuHashJoinExec(left, right, join.join_type,
+                               join.left_keys, join.right_keys,
+                               join.condition, join.schema,
+                               join.using_drop)
+
+    # --- paired skew split + coalesce -----------------------------------
+    skew_on = bool(conf.get(C.ADAPTIVE_SKEW_ENABLED)) \
+        and join.join_type in SKEW_SPLITTABLE_JOINS
+    skewed: Set[int] = set()
+    if skew_on:
+        skewed = detect_skew(
+            lbytes, float(conf.get(C.ADAPTIVE_SKEW_FACTOR)),
+            int(conf.get(C.ADAPTIVE_SKEW_THRESHOLD)))
+    build_bound = int(conf.get(C.PARTITIONED_JOIN_THRESHOLD))
+
+    pairs: List[tuple] = []
+    n_coal = 0
+    n_skew = 0
+    cur_start = None
+    acc_comb = acc_build = 0
+
+    def flush(end: int) -> None:
+        nonlocal cur_start, n_coal
+        if cur_start is None:
+            return
+        spec = CoalescedPartitionSpec(cur_start, end)
+        pairs.append((spec, spec))
+        n_coal += (end - cur_start) - 1
+        cur_start = None
+
+    for p in range(n):
+        if p in skewed:
+            slices = map_range_slices(lst.map_bytes_by_partition[p],
+                                      advisory)
+            if len(slices) > 1:
+                flush(p)
+                for mlo, mhi in slices:
+                    pairs.append((PartialReducerPartitionSpec(p, mlo, mhi),
+                                  CoalescedPartitionSpec(p, p + 1)))
+                n_skew += len(slices) - 1
+                journal_event("replan", "skewSplit", shuffle=lh.sid,
+                              partition=p, slices=len(slices),
+                              bytes=lbytes[p])
+                continue
+            # one map block holds the whole partition: unsplittable
+        combined = lbytes[p] + rbytes[p]
+        if cur_start is None:
+            cur_start, acc_comb, acc_build = p, combined, rbytes[p]
+        elif (not coalesce_on) or acc_comb + combined > advisory \
+                or acc_build + rbytes[p] > build_bound:
+            flush(p)
+            cur_start, acc_comb, acc_build = p, combined, rbytes[p]
+        else:
+            acc_comb += combined
+            acc_build += rbytes[p]
+    flush(n)
+
+    if not n_skew and is_identity([a for a, _ in pairs], n):
+        return join  # nothing observed that the static plan got wrong
+
+    if n_coal:
+        am.add(MN.NUM_COALESCED_PARTITIONS, n_coal)
+        journal_event("replan", "coalescePartitions", shuffle=lh.sid,
+                      before=n, after=len(pairs), merged=n_coal)
+    if n_skew:
+        am.add(MN.NUM_SKEW_SPLITS, n_skew)
+    kind = "skew" if n_skew else "coalesced"
+    join.children = [
+        TpuCoalescedShuffleReaderExec(lex, [a for a, _ in pairs], kind),
+        TpuCoalescedShuffleReaderExec(rex, [b for _, b in pairs], kind)]
+    return join
+
+
+def replan_exchange(exch, ctx, adaptive_metrics):
+    """Coalesce a standalone (non-join) exchange's reduce partitions;
+    returns a reader over the merged ranges, or the exchange untouched.
+    Contiguous merges preserve partition order, so RANGE exchanges (whose
+    partition order IS the global sort order) stay correct."""
+    from ..exec.shuffle_reader import TpuCoalescedShuffleReaderExec
+    conf = ctx.conf
+    if not bool(conf.get(C.ADAPTIVE_COALESCE_ENABLED)):
+        return exch
+    h = exch._handle
+    st = h.stats()
+    advisory = int(conf.get(C.ADAPTIVE_ADVISORY_PARTITION_SIZE))
+    specs = coalesce_specs(h.num_partitions, [st.bytes_by_partition],
+                           [advisory])
+    if is_identity(specs, h.num_partitions):
+        return exch
+    merged = h.num_partitions - len(specs)
+    adaptive_metrics.add(MN.NUM_COALESCED_PARTITIONS, merged)
+    journal_event("replan", "coalescePartitions", shuffle=h.sid,
+                  before=h.num_partitions, after=len(specs), merged=merged)
+    return TpuCoalescedShuffleReaderExec(exch, specs)
+
+
+def demote_broadcast_join(join, ctx, adaptive_metrics):
+    """TpuBroadcastHashJoinExec whose OBSERVED build side exceeds the
+    broadcast threshold: replace with a partitioned join fed by the
+    already-collected build (never re-executes the build subtree).
+    Threshold -1 (broadcast disabled) means the plan got here via an
+    explicit hint — the user's choice stands."""
+    from ..exec.broadcast import TpuBroadcastExchangeExec
+    conf = ctx.conf
+    if not bool(conf.get(C.ADAPTIVE_JOIN_STRATEGY_ENABLED)):
+        return join
+    thr = conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
+    if thr is None or int(thr) < 0:
+        return join
+    bx = join.children[1]
+    if not isinstance(bx, TpuBroadcastExchangeExec):
+        return join
+    leaves, meta = bx.materialize_host(ctx)
+    if meta.size_bytes <= int(thr):
+        return join
+    from ..exec.exchange import TpuShuffleExchangeExec
+    from ..exec.join import TpuShuffledHashJoinExec
+    from ..exec.shuffle_reader import TpuHostCollectedSource
+    adaptive_metrics.add(MN.NUM_JOIN_STRATEGY_CHANGES, 1)
+    journal_event("replan", "demoteBroadcastJoin",
+                  observed_bytes=meta.size_bytes, threshold=int(thr))
+    n = int(conf.get(C.SHUFFLE_PARTITIONS))
+    src = TpuHostCollectedSource(bx.schema, leaves, meta)
+    lex = TpuShuffleExchangeExec("hash", join.left_keys, n,
+                                 join.children[0])
+    rex = TpuShuffleExchangeExec("hash", join.right_keys, n, src)
+    new = TpuShuffledHashJoinExec(lex, rex, join.join_type,
+                                  join.left_keys, join.right_keys,
+                                  join.condition, join.schema,
+                                  join.using_drop)
+    # the observed build is ALREADY past the broadcast threshold: without
+    # this mark, the promote rule could read the (selection-aware, often
+    # smaller) data-byte stats and flip the join straight back
+    new._adaptive_no_promote = True
+    return new

@@ -1,0 +1,175 @@
+"""Map-output statistics: the runtime numbers adaptive re-planning runs on.
+
+Reference analogue: Spark's MapOutputStatistics / MapOutputTrackerMaster —
+every shuffle map task reports per-reduce-partition output sizes, and AQE
+(GpuCustomShuffleReaderExec's planning side) reads the aggregated view to
+coalesce small partitions, split skewed ones, and re-pick join strategies.
+
+Here the tracker lives on each `ShuffleEnv` (one per executor) and is
+populated synchronously at `write_partition` time: the write path already
+holds the sub-batch's host-known row count (shuffle/partition.py
+split_by_partition stamps it), so recording costs two dict updates — no
+device sync.  Cluster-wide aggregation merges per-executor snapshots:
+in-process for `plugin.TpuCluster`, over the control RPC
+(`rpc_map_output_stats`, alongside `rpc_pool_stats`) for
+`cluster.ProcCluster`.
+
+Partition specs (the reduce-side re-planning vocabulary, Spark's
+ShufflePartitionSpec family) also live here so exec/ and adaptive/ can
+share them without import cycles:
+
+  * `CoalescedPartitionSpec(start, end)` — serve reduce partitions
+    [start, end) as ONE coalesced batch;
+  * `PartialReducerPartitionSpec(reduce_id, map_lo, map_hi)` — serve only
+    the blocks of `reduce_id` written by map tasks in [map_lo, map_hi)
+    (a skew slice; the other join side replicates the full partition).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class CoalescedPartitionSpec:
+    """Reduce partitions [start, end) read as one coalesced batch."""
+    start: int
+    end: int
+
+    def units(self) -> List[Tuple[int, Optional[Tuple[int, int]]]]:
+        return [(p, None) for p in range(self.start, self.end)]
+
+    def describe(self) -> str:
+        if self.end == self.start + 1:
+            return str(self.start)
+        return f"{self.start}..{self.end - 1}"
+
+
+@dataclass(frozen=True)
+class PartialReducerPartitionSpec:
+    """One reduce partition restricted to map ids [map_lo, map_hi) — a
+    skew-join slice of the stream side."""
+    reduce_id: int
+    map_lo: int
+    map_hi: int
+
+    def units(self) -> List[Tuple[int, Optional[Tuple[int, int]]]]:
+        return [(self.reduce_id, (self.map_lo, self.map_hi))]
+
+    def describe(self) -> str:
+        return f"{self.reduce_id}[m{self.map_lo}:m{self.map_hi}]"
+
+
+def identity_specs(n: int) -> List[CoalescedPartitionSpec]:
+    """The no-op re-plan: one spec per reduce partition."""
+    return [CoalescedPartitionSpec(p, p + 1) for p in range(n)]
+
+
+def is_identity(specs, n: int) -> bool:
+    return (len(specs) == n
+            and all(isinstance(s, CoalescedPartitionSpec)
+                    and s.start == i and s.end == i + 1
+                    for i, s in enumerate(specs)))
+
+
+class MapOutputStatistics:
+    """Aggregated per-reduce-partition sizes of one materialized shuffle."""
+
+    __slots__ = ("shuffle_id", "num_partitions", "bytes_by_partition",
+                 "rows_by_partition", "map_bytes_by_partition",
+                 "num_map_tasks")
+
+    def __init__(self, shuffle_id: int, num_partitions: int):
+        self.shuffle_id = shuffle_id
+        self.num_partitions = num_partitions
+        self.bytes_by_partition = [0] * num_partitions
+        self.rows_by_partition = [0] * num_partitions
+        # per-partition {map_id: bytes} — what the skew rule slices on
+        self.map_bytes_by_partition: List[Dict[int, int]] = \
+            [dict() for _ in range(num_partitions)]
+        self.num_map_tasks = 0
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.bytes_by_partition)
+
+    @property
+    def total_rows(self) -> int:
+        return sum(self.rows_by_partition)
+
+    def merge_snapshot(self, snap: dict) -> None:
+        """Fold one executor's tracker snapshot (see
+        MapOutputTracker.snapshot) into this aggregate."""
+        maps_seen = set()
+        for rid_s, rec in snap.items():
+            rid = int(rid_s)
+            if not 0 <= rid < self.num_partitions:
+                continue  # stale/foreign record; never index out of range
+            self.bytes_by_partition[rid] += int(rec["bytes"])
+            self.rows_by_partition[rid] += int(rec["rows"])
+            per_map = self.map_bytes_by_partition[rid]
+            for mid_s, b in rec["maps"].items():
+                mid = int(mid_s)
+                per_map[mid] = per_map.get(mid, 0) + int(b)
+                maps_seen.add(mid)
+        if maps_seen:
+            self.num_map_tasks = max(self.num_map_tasks,
+                                     max(maps_seen) + 1)
+
+
+class MapOutputTracker:
+    """Per-executor record of map-output sizes, keyed by shuffle id.
+
+    `remove_shuffle` MUST be called when the shuffle's buffers are dropped
+    (ShuffleEnv.remove_shuffle does) or statistics accumulate forever in a
+    long-lived session — the regression tests pin this down."""
+
+    def __init__(self):
+        self._by_shuffle: Dict[int, Dict[int, dict]] = {}
+        self._lock = threading.Lock()
+
+    def record(self, shuffle_id: int, map_id: int, reduce_id: int,
+               nbytes: int, nrows: int) -> None:
+        with self._lock:
+            shuffle = self._by_shuffle.setdefault(shuffle_id, {})
+            rec = shuffle.get(reduce_id)
+            if rec is None:
+                rec = shuffle[reduce_id] = \
+                    {"bytes": 0, "rows": 0, "maps": {}}
+            rec["bytes"] += int(nbytes)
+            rec["rows"] += int(nrows)
+            rec["maps"][map_id] = rec["maps"].get(map_id, 0) + int(nbytes)
+
+    def snapshot(self, shuffle_id: int) -> dict:
+        """JSON-safe {reduce_id: {bytes, rows, maps:{map_id: bytes}}} —
+        the payload `rpc_map_output_stats` ships driver-ward."""
+        with self._lock:
+            shuffle = self._by_shuffle.get(shuffle_id, {})
+            return {rid: {"bytes": rec["bytes"], "rows": rec["rows"],
+                          "maps": dict(rec["maps"])}
+                    for rid, rec in shuffle.items()}
+
+    def stats(self, shuffle_id: int,
+              num_partitions: int) -> MapOutputStatistics:
+        st = MapOutputStatistics(shuffle_id, num_partitions)
+        st.merge_snapshot(self.snapshot(shuffle_id))
+        return st
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._by_shuffle.pop(shuffle_id, None)
+
+    def tracked_shuffles(self) -> List[int]:
+        with self._lock:
+            return sorted(self._by_shuffle)
+
+
+def merge_cluster_stats(shuffle_id: int, num_partitions: int,
+                        snapshots) -> MapOutputStatistics:
+    """Aggregate per-executor snapshots into one cluster-wide view (the
+    MapOutputTrackerMaster step)."""
+    st = MapOutputStatistics(shuffle_id, num_partitions)
+    for snap in snapshots:
+        st.merge_snapshot(snap or {})
+    return st

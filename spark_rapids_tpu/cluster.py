@@ -305,6 +305,16 @@ class ProcCluster:
         (the cluster half of docs/monitoring.md's aggregation story)."""
         return {w.executor_id: w.rpc("pool_stats") for w in self.workers}
 
+    def map_output_stats(self, sid: int, num_partitions: int):
+        """Cluster-wide MapOutputStatistics for one shuffle, aggregated
+        over the control RPC (rpc_map_output_stats, alongside
+        rpc_pool_stats) — what adaptive re-planning reads after a
+        distributed map stage."""
+        from .adaptive.stats import merge_cluster_stats
+        return merge_cluster_stats(
+            sid, num_partitions,
+            (w.rpc("map_output_stats", sid=sid) for w in self.workers))
+
     def observability_snapshot(self) -> Dict[str, dict]:
         """{executor_id: {"transport": ..., "pool": ...}} — one RPC sweep,
         also reachable via metrics.export.cluster_snapshot(cluster)."""

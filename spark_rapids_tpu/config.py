@@ -426,6 +426,51 @@ AUTO_BROADCAST_JOIN_THRESHOLD = _conf(
     "broadcast to every consumer instead of shuffled (Spark's conf key; "
     "-1 disables broadcast joins).", _to_bytes_or_disabled)
 
+# --- adaptive query execution -----------------------------------------------
+ADAPTIVE_ENABLED = _conf(
+    "spark.rapids.sql.tpu.adaptive.enabled", True,
+    "Re-plan queries at shuffle-stage boundaries from OBSERVED map-output "
+    "sizes (Spark 3 AQE analogue; reference: GpuShuffleExchangeExec + "
+    "GpuCustomShuffleReaderExec).  Map stages are materialized first, then "
+    "the reduce side is instantiated with coalesced small partitions, "
+    "split skewed partitions, and possibly a different join strategy "
+    "(adaptive/).", _to_bool)
+ADAPTIVE_ADVISORY_PARTITION_SIZE = _conf(
+    "spark.rapids.sql.tpu.adaptive.advisoryPartitionSizeBytes", 64 << 20,
+    "Target size of a shuffle partition after adaptive re-planning: "
+    "contiguous partitions smaller than this are merged by the coalesce "
+    "rule, and skewed partitions are split into slices of roughly this "
+    "size (spark.sql.adaptive.advisoryPartitionSizeInBytes analogue).",
+    to_bytes)
+ADAPTIVE_COALESCE_ENABLED = _conf(
+    "spark.rapids.sql.tpu.adaptive.coalescePartitions.enabled", True,
+    "Enable the AQE rule that merges contiguous small reduce partitions "
+    "up to advisoryPartitionSizeBytes (served by "
+    "TpuCoalescedShuffleReaderExec).", _to_bool)
+ADAPTIVE_SKEW_ENABLED = _conf(
+    "spark.rapids.sql.tpu.adaptive.skewJoin.enabled", True,
+    "Enable the AQE skew-join rule: a stream-side partition larger than "
+    "skewedPartitionFactor x the median partition size is split into "
+    "map-range slices, each joined against a replicated copy of the "
+    "build-side partition.", _to_bool)
+ADAPTIVE_SKEW_FACTOR = _conf(
+    "spark.rapids.sql.tpu.adaptive.skewJoin.skewedPartitionFactor", 5.0,
+    "A partition is skew-split when its observed bytes exceed this factor "
+    "times the median non-empty partition size (and the size floor "
+    "skewedPartitionThresholdInBytes).", float)
+ADAPTIVE_SKEW_THRESHOLD = _conf(
+    "spark.rapids.sql.tpu.adaptive.skewJoin.skewedPartitionThresholdInBytes",
+    256 << 20,
+    "Size floor below which a partition is never considered skewed, "
+    "whatever the factor test says.", to_bytes)
+ADAPTIVE_JOIN_STRATEGY_ENABLED = _conf(
+    "spark.rapids.sql.tpu.adaptive.joinStrategy.enabled", True,
+    "Enable AQE join-strategy switching: a partitioned join whose "
+    "observed build side fits under spark.sql.autoBroadcastJoinThreshold "
+    "is promoted to a single-build (broadcast-style) join, and a planned "
+    "broadcast whose observed build side exceeds the threshold is demoted "
+    "to a partitioned join.", _to_bool)
+
 # --- fault injection (test-only) --------------------------------------------
 TEST_INJECT_OOM = _conf(
     "spark.rapids.tpu.test.injectOom", "",

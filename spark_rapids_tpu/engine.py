@@ -411,11 +411,17 @@ class DataFrame:
         physical = self.session.plan(self.plan)
         runtime = self.session.runtime
         on_device = isinstance(physical, TpuExec)
+        # adaptive execution wraps at EXECUTE time (never in
+        # physical_plan()): map stages materialize first and the reduce
+        # side re-plans from observed sizes (adaptive/executor.py)
+        from .adaptive.executor import maybe_wrap_adaptive
+        physical = maybe_wrap_adaptive(physical, self.session.conf)
         if on_device:
             physical = B.DeviceToHostExec(physical)
         qe = self.session._begin_execution(physical, runtime)
         ctx = ExecContext(self.session.conf, runtime=runtime,
-                          cluster=self.session.cluster, journal=qe.journal)
+                          cluster=self.session.cluster, journal=qe.journal,
+                          query_execution=qe)
         error = None
         try:
             if on_device:
@@ -469,9 +475,12 @@ class DataFrame:
                 "columnar data")
         physical = self.session.plan(self.plan)
         runtime = self.session.runtime
+        from .adaptive.executor import maybe_wrap_adaptive
+        physical = maybe_wrap_adaptive(physical, self.session.conf)
         qe = self.session._begin_execution(physical, runtime)
         ctx = ExecContext(self.session.conf, runtime=runtime,
-                          cluster=self.session.cluster, journal=qe.journal)
+                          cluster=self.session.cluster, journal=qe.journal,
+                          query_execution=qe)
         error = None
         try:
             if isinstance(physical, TpuExec):
@@ -630,10 +639,12 @@ class DataFrameWriter:
                               self._partition_by)
         physical = self.df.session.plan(plan)
         runtime = self.df.session.runtime
+        from .adaptive.executor import maybe_wrap_adaptive
+        physical = maybe_wrap_adaptive(physical, self.df.session.conf)
         qe = self.df.session._begin_execution(physical, runtime)
         ctx = ExecContext(self.df.session.conf, runtime=runtime,
                           cluster=self.df.session.cluster,
-                          journal=qe.journal)
+                          journal=qe.journal, query_execution=qe)
         error = None
         try:
             if isinstance(physical, TpuExec):

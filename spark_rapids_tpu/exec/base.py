@@ -54,13 +54,17 @@ class ExecContext:
 
     def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
                  num_partitions: int = 1, runtime=None, cluster=None,
-                 journal=None):
+                 journal=None, query_execution=None):
         self.conf = conf or TpuConf()
         self.partition_id = partition_id
         self.num_partitions = num_partitions
         self.runtime = runtime  # mem.runtime.TpuRuntime when active
         self.cluster = cluster  # plugin.TpuCluster in multi-executor mode
         self.journal = journal  # metrics.journal.EventJournal per query
+        # metrics.query.QueryExecution of the running query: adaptive
+        # re-planning registers rewritten plan nodes through it so
+        # EXPLAIN METRICS shows the final stage plan
+        self.query_execution = query_execution
         # task-scoped cleanup callbacks (reference: task-completion
         # listeners releasing GPU resources, GpuSemaphore.scala:27-161 /
         # RapidsBufferCatalog task cleanup).  Operators register IDEMPOTENT
@@ -83,7 +87,8 @@ class ExecContext:
 
     def with_partition(self, pid: int, nparts: int) -> "ExecContext":
         ctx = ExecContext(self.conf, pid, nparts, self.runtime,
-                          self.cluster, self.journal)
+                          self.cluster, self.journal,
+                          self.query_execution)
         ctx.cleanups = self.cleanups  # share the task scope
         return ctx
 

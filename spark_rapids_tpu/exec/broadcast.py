@@ -54,6 +54,16 @@ class TpuBroadcastExchangeExec(TpuExec):
         self.metrics.add(MN.DATA_SIZE, meta.size_bytes)
         return leaves, meta
 
+    def materialize_host(self, ctx: ExecContext):
+        """Collect the child ONCE and return the host form (leaves, meta)
+        — the adaptive demotion check reads `meta.size_bytes` here BEFORE
+        the join instantiates, and a kept broadcast reuses the same
+        cached collect through `broadcast_batch`."""
+        with self._lock:
+            if self._host_form is None:
+                self._host_form = self._collect(ctx)
+            return self._host_form
+
     def broadcast_batch(self, ctx: ExecContext) -> ColumnarBatch:
         """Device view of the broadcast value; lazy re-upload, spillable."""
         with self._lock:

@@ -8,6 +8,12 @@ sync), and each partition slice is cached in the device-resident shuffle
 store (spillable) until the read side drains it.
 
 The CPU fallback half lives in exec/cpu_relational.CpuRepartitionExec.
+
+PR-3 (adaptive execution) split the exchange into an explicit
+MATERIALIZE step (the map stage: write phase + observed MapOutputStatistics
+capture) and a spec-driven READ step, so the reduce side can be re-planned
+from runtime sizes between the two (adaptive/executor.py; reference:
+Spark 3 AQE over GpuShuffleExchangeExec).
 """
 from __future__ import annotations
 
@@ -22,6 +28,67 @@ from ..shuffle.partition import (hash_partition_ids, range_partition_ids,
                                  split_by_partition)
 from .base import ExecContext, ExecNode, TpuExec, record_output_batch
 from ..metrics import names as MN
+
+
+class _ShuffleHandle:
+    """A materialized shuffle stage: the write side ran, blocks sit in the
+    executor catalog(s), and observed map-output statistics are available
+    for adaptive re-planning (adaptive/).  Unifies the single-executor and
+    multi-executor (plugin.TpuCluster) read paths behind one route/fetch
+    surface."""
+
+    def __init__(self, sid: int, num_partitions: int, env=None,
+                 cluster=None):
+        self.sid = sid
+        self.num_partitions = num_partitions
+        self.env = env
+        self.cluster = cluster
+        self._stats = None
+        self._released = False
+
+    def route(self, p: int):
+        """(serving env, remote peer ids) for one reduce partition."""
+        if self.cluster is not None:
+            owner = self.cluster.env_for(p)
+            return owner, self.cluster.peer_ids(owner.executor_id)
+        return self.env, None
+
+    def stats(self):
+        """Cluster-wide MapOutputStatistics of this shuffle, computed
+        once and cached: the map side is immutable after materialize, and
+        every rule reading the same handle would otherwise re-run the
+        per-executor aggregation sweep."""
+        if self._stats is None:
+            if self.cluster is not None:
+                self._stats = self.cluster.map_output_stats(
+                    self.sid, self.num_partitions)
+            else:
+                self._stats = self.env.map_stats.stats(
+                    self.sid, self.num_partitions)
+        return self._stats
+
+    def fetch(self, p: int, map_range=None):
+        """One partition (or map-range skew slice) as a batch list, with
+        received-buffer rollback on OOM so a retry does not duplicate the
+        failed attempt's remote registrations in the pool."""
+        env, peers = self.route(p)
+        mark = env.received.snapshot(self.sid)
+        try:
+            return list(env.fetch_partition(self.sid, p,
+                                            remote_peers=peers,
+                                            map_range=map_range))
+        except MemoryError:
+            env.rollback_received(self.sid, mark)
+            raise
+
+    def release(self):
+        if self._released:
+            return
+        self._released = True
+        if self.cluster is not None:
+            self.cluster.remove_shuffle(self.sid)
+        else:
+            self.env.remove_shuffle(self.sid)
 
 
 class TpuShuffleExchangeExec(TpuExec):
@@ -40,6 +107,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.num_partitions = max(1, int(num_partitions))
         self.ascending = ascending or [True] * len(self.keys)
         self.nulls_first = nulls_first or [True] * len(self.keys)
+        self._handle: Optional[_ShuffleHandle] = None
 
     @property
     def schema(self):
@@ -103,55 +171,130 @@ class TpuShuffleExchangeExec(TpuExec):
             from .join import _empty_batch
             yield _empty_batch(self.schema)
 
-    def execute_partitions(self, ctx: ExecContext):
-        """Yield (partition_id, coalesced batch | None) for every partition
+    def materialize(self, ctx: ExecContext) -> _ShuffleHandle:
+        """Run the WRITE phase once (idempotent per plan instance): the
+        map stage of this exchange.  After this returns, observed
+        per-partition sizes are available via `handle.stats()` and the
+        reduce side can be re-planned (adaptive/executor.py) before any
+        read starts — the Spark AQE stage-materialization point.
+
+        Multi-executor mode (plugin.TpuCluster): map task m writes to
+        executor (m % N)'s catalog; reads later serve local blocks and
+        pull the rest through the transport client/server (bounce
+        buffers + throttle), like the reference's RapidsCachingReader
+        local/remote split."""
+        if self._handle is not None:
+            return self._handle
+        from ..metrics.journal import journal_event
+        n = self.num_partitions
+        if ctx.cluster is not None:
+            cluster = ctx.cluster
+            sid = cluster.new_shuffle_id()
+            ctx.add_cleanup(lambda: cluster.remove_shuffle(sid))
+            self._write_phase(ctx, n, lambda map_id, p, sub:
+                              cluster.env_for(map_id).write_partition(
+                                  sid, map_id, p, sub))
+            h = _ShuffleHandle(sid, n, cluster=cluster)
+        else:
+            env = get_shuffle_env(ctx.runtime, ctx.conf) \
+                if ctx.runtime else None
+            if env is None:
+                from ..mem.runtime import TpuRuntime
+                ctx.runtime = TpuRuntime(ctx.conf)
+                env = get_shuffle_env(ctx.runtime, ctx.conf)
+            sid = env.new_shuffle_id()
+            # a query dying mid-WRITE would orphan the partitions already
+            # in the catalog (the read-phase try/finally never runs);
+            # remove_shuffle is idempotent, so register it with the task
+            # scope
+            ctx.add_cleanup(lambda: env.remove_shuffle(sid))
+            self._write_phase(ctx, n, lambda map_id, p, sub:
+                              env.write_partition(sid, map_id, p, sub))
+            h = _ShuffleHandle(sid, n, env=env)
+        st = h.stats()
+        self.metrics.add(MN.MAP_OUTPUT_BYTES, st.total_bytes)
+        journal_event("stage", "mapStage", shuffle=h.sid, partitions=n,
+                      bytes=st.total_bytes, rows=st.total_rows,
+                      maps=st.num_map_tasks)
+        self._handle = h
+        return h
+
+    def execute_partitions(self, ctx: ExecContext, specs=None):
+        """Yield (index, coalesced batch | None) for every partition spec
         in order.  The partition-aligned form TpuShuffledHashJoinExec zips
         to pair build/stream sides (reference: EnsureRequirements places
         matching HashPartitionings under GpuShuffledHashJoinExec).
 
-        Multi-executor mode (plugin.TpuCluster): map task m writes to
-        executor (m % N)'s catalog; reduce task p runs on executor
-        (p % N), serving local blocks and pulling the rest through the
-        transport client/server (bounce buffers + throttle), like the
-        reference's RapidsCachingReader local/remote split."""
-        if ctx.cluster is not None:
-            yield from self._execute_partitions_cluster(ctx)
-            return
-        env = get_shuffle_env(ctx.runtime, ctx.conf) if ctx.runtime else None
-        if env is None:
-            from ..mem.runtime import TpuRuntime
-            ctx.runtime = TpuRuntime(ctx.conf)
-            env = get_shuffle_env(ctx.runtime, ctx.conf)
-        sid = env.new_shuffle_id()
-        # a query dying mid-WRITE would orphan the partitions already in
-        # the catalog (the read-phase try/finally below never runs);
-        # remove_shuffle is idempotent, so register it with the task scope
-        ctx.add_cleanup(lambda: env.remove_shuffle(sid))
-        n = self.num_partitions
-        self._write_phase(ctx, n, lambda map_id, p, sub:
-                          env.write_partition(sid, map_id, p, sub))
-
+        Default specs are one per reduce partition 0..n-1 (the static
+        plan).  Adaptive execution passes re-planned specs
+        (adaptive/stats.py): coalesced ranges ride the pipelined
+        fetch_partitions_async path; skew slices use ranged catalog
+        fetches."""
+        h = self.materialize(ctx)
+        from ..adaptive.stats import CoalescedPartitionSpec, identity_specs
+        if specs is None:
+            specs = identity_specs(h.num_partitions)
         from ..config import SHUFFLE_ASYNC_FETCH
-        from .retryable import run_retryable
+        # the async producer emits partitions in request order; folding
+        # them back into specs needs contiguous coalesced ranges covering
+        # [0, n) — exactly what the coalesce rule produces (skew slices
+        # re-read partitions, so they stay on the sync path)
+        async_ok = ctx.conf.get(SHUFFLE_ASYNC_FETCH) \
+            and all(isinstance(s, CoalescedPartitionSpec) for s in specs) \
+            and specs and specs[0].start == 0 \
+            and specs[-1].end == h.num_partitions \
+            and all(specs[i].start == specs[i - 1].end
+                    for i in range(1, len(specs)))
         try:
             with self.metrics.timer(MN.SHUFFLE_READ_TIME):
-                if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
-                    # pipelined: the producer thread fetches partition k+1
-                    # while the consumer is still on k
-                    yield from _drain_async(
-                        env.fetch_partitions_async(sid, range(n)), n)
+                if async_ok:
+                    yield from self._read_specs_async(ctx, h, specs)
                 else:
-                    # retry-only: local catalog reads are idempotent, so a
-                    # reserve() OOM during re-materialization just refetches
-                    def fetch_one(p):
-                        return list(env.fetch_partition(sid, p))
-                    for p in range(n):
-                        parts = run_retryable(ctx, self.metrics,
-                                              "exchangeFetch", fetch_one,
-                                              [p])[0]
-                        yield p, _coalesce_parts(parts)
+                    yield from self._read_specs_sync(ctx, h, specs)
         finally:
-            env.remove_shuffle(sid)
+            h.release()
+
+    def _read_specs_async(self, ctx: ExecContext, h: _ShuffleHandle,
+                          specs):
+        """Pipelined read: the producer thread fetches partition k+1 while
+        the consumer is still on k; `_drain_async` pads every partition
+        (empty ones included) so contiguous spec ranges fold back by
+        position."""
+        from ..config import OOM_RETRY_MAX, SHUFFLE_MAX_RECV_INFLIGHT
+        n = h.num_partitions
+        if h.cluster is not None:
+            from ..shuffle.fetch import AsyncFetchIterator
+            it = AsyncFetchIterator(
+                None, h.sid, range(n), None,
+                int(ctx.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
+                route=h.route,
+                oom_retries=int(ctx.conf.get(OOM_RETRY_MAX)))
+        else:
+            it = h.env.fetch_partitions_async(h.sid, range(n))
+        drained = _drain_async(it, n)
+        for i, spec in enumerate(specs):
+            parts = []
+            for _ in range(spec.start, spec.end):
+                _p, b = next(drained)
+                if b is not None:
+                    parts.append(b)
+            yield i, (parts[0] if len(parts) == 1
+                      else concat_batches(parts) if parts else None)
+
+    def _read_specs_sync(self, ctx: ExecContext, h: _ShuffleHandle, specs):
+        """Retry-only read: catalog fetches are idempotent per unit (one
+        reduce partition or one map-range slice), so a reserve() OOM
+        during re-materialization just refetches that unit."""
+        from .retryable import run_retryable
+        for i, spec in enumerate(specs):
+            parts = []
+            for p, map_range in spec.units():
+                def fetch_unit(pp, _mr=map_range):
+                    return h.fetch(pp, map_range=_mr)
+                parts.extend(run_retryable(ctx, self.metrics,
+                                           "exchangeFetch", fetch_unit,
+                                           [p])[0])
+            yield i, _coalesce_parts(parts)
 
     def _write_phase(self, ctx: ExecContext, n: int, write) -> None:
         """Shared write side: drain the child, compute partition ids, split,
@@ -208,55 +351,6 @@ class TpuShuffleExchangeExec(TpuExec):
                             ctx, self.metrics, "exchangeWrite", write_one,
                             [sub], split=split_batch_rows))
         self.metrics.add(MN.NUM_PARTITIONS_WRITTEN, num_writes)
-
-    def _execute_partitions_cluster(self, ctx: ExecContext):
-        """Multi-executor read/write (see execute_partitions docstring)."""
-        cluster = ctx.cluster
-        sid = cluster.new_shuffle_id()
-        ctx.add_cleanup(lambda: cluster.remove_shuffle(sid))
-        n = self.num_partitions
-        self._write_phase(ctx, n, lambda map_id, p, sub:
-                          cluster.env_for(map_id).write_partition(
-                              sid, map_id, p, sub))
-
-        def _route(p):
-            owner = cluster.env_for(p)
-            return owner, cluster.peer_ids(owner.executor_id)
-
-        from ..config import (OOM_RETRY_MAX, SHUFFLE_ASYNC_FETCH,
-                              SHUFFLE_MAX_RECV_INFLIGHT)
-        try:
-            with self.metrics.timer(MN.SHUFFLE_READ_TIME):
-                if ctx.conf.get(SHUFFLE_ASYNC_FETCH):
-                    # same pipelining as the single-executor path: remote
-                    # transport round-trips overlap consumption
-                    from ..shuffle.fetch import AsyncFetchIterator
-                    yield from _drain_async(AsyncFetchIterator(
-                        None, sid, range(n), None,
-                        int(ctx.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
-                        route=_route,
-                        oom_retries=int(ctx.conf.get(OOM_RETRY_MAX))), n)
-                else:
-                    from .retryable import run_retryable
-
-                    def fetch_one(p):
-                        owner, peers = _route(p)
-                        mark = owner.received.snapshot(sid)
-                        try:
-                            return list(owner.fetch_partition(
-                                sid, p, remote_peers=peers))
-                        except MemoryError:
-                            # drop the failed attempt's remote buffers so
-                            # the retry doesn't duplicate them in the pool
-                            owner.rollback_received(sid, mark)
-                            raise
-                    for p in range(n):
-                        parts = run_retryable(ctx, self.metrics,
-                                              "exchangeFetch", fetch_one,
-                                              [p])[0]
-                        yield p, _coalesce_parts(parts)
-        finally:
-            cluster.remove_shuffle(sid)
 
 
 def _drain_async(it, n: int):

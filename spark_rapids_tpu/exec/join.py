@@ -502,9 +502,17 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
 
     def _execute_device(self, ctx: ExecContext):
         from .exchange import TpuShuffleExchangeExec
+        from .shuffle_reader import TpuCoalescedShuffleReaderExec
         lex, rex = self.children
-        assert isinstance(lex, TpuShuffleExchangeExec) \
-            and isinstance(rex, TpuShuffleExchangeExec) \
+        # children are either the planner's aligned hash exchanges, or —
+        # after adaptive re-planning — paired shuffle readers holding
+        # spec lists of identical length (coalesced ranges merged the
+        # same way on both sides; skew slices paired with replicated
+        # build partitions)
+        assert isinstance(lex, (TpuShuffleExchangeExec,
+                                TpuCoalescedShuffleReaderExec)) \
+            and isinstance(rex, (TpuShuffleExchangeExec,
+                                 TpuCoalescedShuffleReaderExec)) \
             and lex.num_partitions == rex.num_partitions, \
             "shuffled join requires aligned hash exchanges on both sides"
         produced = False

@@ -1,0 +1,117 @@
+"""Adaptive shuffle readers: re-planned reduce-side access to a
+materialized exchange.
+
+TPU-native analogue of GpuCustomShuffleReaderExec (the reference wraps a
+shuffle query stage and serves AQE's ShufflePartitionSpecs — coalesced
+ranges and skew slices — instead of the static one-reader-per-partition
+layout).  `TpuCoalescedShuffleReaderExec` holds the spec list the adaptive
+rules computed (adaptive/rules.py) and delegates the actual fetching to
+`TpuShuffleExchangeExec.execute_partitions(ctx, specs)`, which rides the
+existing pipelined `fetch_partitions_async` path for coalesced ranges and
+ranged catalog fetches for skew slices.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+from ..columnar import ColumnarBatch
+from ..mem.buffer import host_to_batch
+from ..metrics import names as MN
+from .base import ExecContext, ExecNode, TpuExec, record_output_batch
+
+
+class TpuCoalescedShuffleReaderExec(TpuExec):
+    """Serves a re-planned partition-spec list from its child exchange.
+
+    `kind` is display-only provenance: "coalesced" (small-partition
+    merges), "skew" (paired skew slices), or "build" (a whole shuffle read
+    as one broadcast-style build batch after a strategy promotion)."""
+
+    coalesce_after = False  # specs already target the advisory batch size
+
+    def __init__(self, exchange: ExecNode, specs: Sequence,
+                 kind: str = "coalesced"):
+        super().__init__(exchange)
+        self.specs = list(specs)
+        self.kind = kind
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    @property
+    def num_partitions(self) -> int:
+        """Output partition count AFTER re-planning (what a shuffled join
+        zips on)."""
+        return len(self.specs)
+
+    def describe(self):
+        from ..adaptive.stats import PartialReducerPartitionSpec
+        n_skew = sum(1 for s in self.specs
+                     if isinstance(s, PartialReducerPartitionSpec))
+        detail = f", skewSlices={n_skew}" if n_skew else ""
+        return (f"TpuCoalescedShuffleReaderExec[{self.kind}, "
+                f"{self.children[0].num_partitions}->"
+                f"{len(self.specs)}{detail}]")
+
+    def execute_partitions(self, ctx: ExecContext):
+        """(index, batch | None) per spec — the aligned form the shuffled
+        hash join zips against its paired reader."""
+        yield from self.children[0].execute_partitions(ctx, self.specs)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        # same OOM-exhaustion downgrade the exchange's own execute path
+        # has: the CPU twin re-executes the exchange's child from scratch
+        # (exec/retryable.py engages it only before the first yield)
+        from .retryable import execute_with_cpu_fallback
+        yield from execute_with_cpu_fallback(
+            self, ctx, self._execute_device(ctx),
+            lambda: self.children[0]._cpu_twin())
+
+    def _execute_device(self, ctx: ExecContext):
+        produced = False
+        for _i, out in self.execute_partitions(ctx):
+            if out is None:
+                continue
+            produced = True
+            record_output_batch(self.metrics, out, ctx.runtime)
+            yield out
+        if not produced:
+            # keep the one-batch-minimum contract for downstream operators
+            from .join import _empty_batch
+            yield _empty_batch(self.schema)
+
+
+class TpuHostCollectedSource(TpuExec):
+    """Exec wrapper over an already-collected broadcast value (host
+    leaves + meta): the build side of a DEMOTED broadcast join.
+
+    When adaptive execution demotes a planned broadcast (the observed
+    build side blew past the threshold the static estimate promised it
+    would fit), the child was already collected by the broadcast
+    exchange's materialization — re-executing it could double work or, for
+    destructive sources, drop rows.  This node re-serves the collected
+    host form as the input of the replacement partitioned join's build
+    exchange."""
+
+    def __init__(self, schema, leaves: List, meta):
+        super().__init__()
+        self._schema = schema
+        self._leaves = leaves
+        self._meta = meta
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"TpuHostCollectedSource[{self._meta.size_bytes}B]")
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        with self.metrics.timer(MN.H2D_TIME):
+            if ctx.runtime is not None:
+                ctx.runtime.reserve(self._meta.size_bytes,
+                                    site="adaptive.demotedBuild")
+            batch = host_to_batch(self._leaves, self._meta)
+        record_output_batch(self.metrics, batch, ctx.runtime)
+        yield batch

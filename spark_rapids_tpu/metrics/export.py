@@ -197,3 +197,21 @@ def session_observability(session) -> dict:
     out["wire_bytes_sent"] = wire_sent
     out["wire_bytes_received"] = wire_recv
     return out
+
+
+def session_adaptive(session) -> dict:
+    """Adaptive-execution rollup for bench.py's `adaptive` stage (rides
+    next to the `observability` block in the BENCH_* artifacts):
+    coalesce/skew/strategy-change counts, observed map-output bytes, and
+    stage re-plan latency accumulated across the session's queries."""
+    totals = dict(getattr(session, "query_metrics_total", {}) or {})
+    return {
+        "numCoalescedPartitions":
+            int(totals.get(N.NUM_COALESCED_PARTITIONS, 0)),
+        "numSkewSplits": int(totals.get(N.NUM_SKEW_SPLITS, 0)),
+        "numJoinStrategyChanges":
+            int(totals.get(N.NUM_JOIN_STRATEGY_CHANGES, 0)),
+        "mapOutputBytes": int(totals.get(N.MAP_OUTPUT_BYTES, 0)),
+        "replan_time_s": float(totals.get(N.REPLAN_TIME, 0.0)),
+        "queries": int(getattr(session, "queries_executed", 0)),
+    }

@@ -11,7 +11,7 @@ Record schema (one JSON object per line):
 
   ts     monotonic nanoseconds (time.monotonic_ns; per-process clock)
   ev     "B" (span begin) | "E" (span end) | "I" (instant event)
-  kind   query|stage|operator|retry|spill|fetch|metric|fallback
+  kind   query|stage|operator|retry|spill|fetch|metric|fallback|replan
   name   human label (operator describe(), retry block name, ...)
   id     span/event id, unique within the journal, increasing
   parent parent span id or null (operator spans parent to the enclosing
@@ -34,7 +34,7 @@ import time
 from typing import Dict, List, Optional
 
 EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
-               "metric", "fallback")
+               "metric", "fallback", "replan")
 
 
 class EventJournal:

@@ -170,6 +170,25 @@ PEAK_DEV_MEMORY = register_metric(
     "peakDevMemory", GAUGE, DEBUG,
     "high-water mark of accounted device-store bytes sampled per batch")
 
+# --- adaptive query execution (adaptive/) -----------------------------------
+NUM_COALESCED_PARTITIONS = register_metric(
+    "numCoalescedPartitions", COUNTER, ESSENTIAL,
+    "shuffle partitions merged away by the adaptive coalesce rule")
+NUM_SKEW_SPLITS = register_metric(
+    "numSkewSplits", COUNTER, ESSENTIAL,
+    "extra stream-side slices created by the adaptive skew-join split rule")
+NUM_JOIN_STRATEGY_CHANGES = register_metric(
+    "numJoinStrategyChanges", COUNTER, ESSENTIAL,
+    "joins whose strategy adaptive execution changed from the static plan "
+    "(broadcast promotions + demotions)")
+MAP_OUTPUT_BYTES = register_metric(
+    "mapOutputBytes", COUNTER, ESSENTIAL,
+    "observed map-output bytes of materialized shuffle stages")
+REPLAN_TIME = register_metric(
+    "replanTime", TIMER, MODERATE,
+    "time spent applying adaptive re-planning rules between stages "
+    "(excludes the map-stage writes themselves)")
+
 # retry-block counters: each `run_retryable(ctx, metrics, <block>)` call
 # site emits `<block>Retries` / `<block>Splits` (mem/retry.py with_retry)
 RETRY_BLOCKS = ("sort", "aggUpdate", "aggMerge", "joinBuild", "joinProbe",

@@ -103,7 +103,10 @@ class QueryExecution:
         nid = node._node_id
 
         def wrap(orig, mode):
-            def wrapped(ctx, _orig=orig, _nid=nid, _node=node):
+            # *args/**kwargs pass through: the adaptive shuffle reader
+            # calls execute_partitions(ctx, specs)
+            def wrapped(ctx, *args, _orig=orig, _nid=nid, _node=node,
+                        **kwargs):
                 sid = journal.begin(
                     "operator", _node.name, parent=self._parent_span(_nid),
                     node=_nid, mode=mode)
@@ -116,7 +119,7 @@ class QueryExecution:
                         journal.end(sid)
                         if self._span_of.get(_nid) == sid:
                             del self._span_of[_nid]
-                return drive(_orig(ctx))
+                return drive(_orig(ctx, *args, **kwargs))
             return wrapped
 
         # instance-attribute shadowing: per-query plan trees are fresh
@@ -132,6 +135,32 @@ class QueryExecution:
                                                "partitions")
         except AttributeError:  # pragma: no cover - exotic nodes
             pass
+
+    def adopt(self, root=None) -> None:
+        """Register plan nodes added by adaptive re-planning
+        (adaptive/executor.py): assign node ids, pin metric levels,
+        refresh parent links for moved nodes, and instrument fresh nodes
+        so EXPLAIN METRICS, the journal metric dump and the Prometheus
+        export all describe the FINAL (re-planned) stage plan."""
+        start = root if root is not None else self.physical
+        fresh: List = []
+
+        def walk(node, parent_id):
+            nid = getattr(node, "_node_id", None)
+            if nid is None:
+                nid = len(self.nodes)
+                node._node_id = nid
+                self.nodes.append(node)
+                node.metrics.configure(self.level)
+                fresh.append(node)
+            self._parent_of[nid] = parent_id
+            for c in node.children:
+                walk(c, nid)
+
+        walk(start, self._parent_of.get(getattr(start, "_node_id", 0)))
+        if self.journal is not None:
+            for node in fresh:
+                self._instrument(node)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -106,6 +106,15 @@ class TpuCluster:
         return [e.executor_id for e in self.executors
                 if e.executor_id != excluding]
 
+    def map_output_stats(self, sid: int, num_partitions: int):
+        """Cluster-wide MapOutputStatistics for one shuffle: every
+        executor's tracker snapshot merged (the MapOutputTrackerMaster
+        aggregation; ProcCluster does the same over rpc_map_output_stats)."""
+        from .adaptive.stats import merge_cluster_stats
+        return merge_cluster_stats(
+            sid, num_partitions,
+            (e.env.map_stats.snapshot(sid) for e in self.executors))
+
     def remove_shuffle(self, sid: int) -> None:
         for e in self.executors:
             e.env.remove_shuffle(sid)

@@ -50,6 +50,12 @@ class ShuffleServer:
         if blocks is None:  # wildcard discovery for one reduce partition
             blocks = self.env.catalog.blocks_for_reduce(
                 request.shuffle_id, request.reduce_id)
+            if request.map_lo is not None or request.map_hi is not None:
+                # skew-slice discovery: only the requested map-id range
+                lo = request.map_lo if request.map_lo is not None else 0
+                hi = request.map_hi if request.map_hi is not None \
+                    else float("inf")
+                blocks = [b for b in blocks if lo <= b.map_id < hi]
         out: List[BlockMeta] = []
         for block in blocks:
             buffer_ids = self.env.catalog.buffers_for(block)
@@ -126,6 +132,10 @@ class ShuffleEnv:
         self.device_resident = bool(self.conf.get(SHUFFLE_DEVICE_RESIDENT))
         self.catalog = ShuffleBufferCatalog()
         self.received = ShuffleReceivedBufferCatalog()
+        # observed per-reduce-partition output sizes, recorded at write
+        # time — what adaptive re-planning (adaptive/) runs on
+        from ..adaptive.stats import MapOutputTracker
+        self.map_stats = MapOutputTracker()
         if transport is None:
             transport = self._resolve_transport()
         self.transport = transport
@@ -178,6 +188,10 @@ class ShuffleEnv:
             self.runtime.free_batch(bid)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
+        # the shuffle's map statistics go with its buffers — a long-lived
+        # session would otherwise accumulate stats for every query it
+        # ever ran (regression-tested in tests/test_adaptive.py)
+        self.map_stats.remove_shuffle(shuffle_id)
         for bid in self.catalog.remove_shuffle(shuffle_id):
             with self._lock:
                 if self._baseline_buffers.pop(bid, None) is not None:
@@ -191,6 +205,16 @@ class ShuffleEnv:
     def write_partition(self, shuffle_id: int, map_id: int, reduce_id: int,
                         batch: ColumnarBatch) -> None:
         block = ShuffleBlockId(shuffle_id, map_id, reduce_id)
+        # map-output statistics: DATA bytes (live-row-proportional, so a
+        # mostly-dead bucketed capacity does not read as a fat partition)
+        # and rows.  split_by_partition stamps known_rows on every
+        # sub-batch, so the common write path records without a device
+        # sync; direct writers without the stamp pay one.  Recorded only
+        # AFTER the buffer registers below — an OOM mid-write retries the
+        # whole call, and recording first would double-count the attempt.
+        nrows = batch.num_rows_host()
+        cap = max(batch.capacity, 1)
+        nbytes = int(batch.device_size_bytes() * min(nrows, cap) / cap)
         if self.device_resident:
             with self._lock:
                 self._write_seq[0] += 1
@@ -207,18 +231,27 @@ class ShuffleEnv:
             with self._lock:
                 self._baseline_buffers[bid] = (leaves, meta)
             self.catalog.add_buffer(block, bid)
+        self.map_stats.record(shuffle_id, map_id, reduce_id, nbytes, nrows)
 
     # ---- read path (RapidsCachingReader.read) ------------------------------
 
     def fetch_partition(self, shuffle_id: int, reduce_id: int,
-                        remote_peers: Optional[List[str]] = None
+                        remote_peers: Optional[List[str]] = None,
+                        map_range: Optional[tuple] = None
                         ) -> Iterator[ColumnarBatch]:
-        """Local blocks from the catalog; remote blocks via transport."""
+        """Local blocks from the catalog; remote blocks via transport.
+        `map_range=(lo, hi)` restricts the read to blocks written by map
+        ids in [lo, hi) — the skew-join slice fetch
+        (PartialReducerPartitionSpec, adaptive/stats.py)."""
         from ..metrics.journal import journal_event
         journal_event("fetch", "fetchPartition", shuffle=shuffle_id,
                       reduce=reduce_id, executor=self.executor_id,
-                      remote_peers=len(remote_peers or []))
+                      remote_peers=len(remote_peers or []),
+                      map_range=list(map_range) if map_range else None)
         for block in self.catalog.blocks_for_reduce(shuffle_id, reduce_id):
+            if map_range is not None \
+                    and not map_range[0] <= block.map_id < map_range[1]:
+                continue
             for bid in self.catalog.buffers_for(block):
                 baseline = self.baseline_leaves(bid)
                 if baseline is not None:
@@ -229,7 +262,8 @@ class ShuffleEnv:
                 else:
                     yield self.runtime.get_batch(bid)
         for peer in remote_peers or []:
-            yield from self._fetch_remote(peer, shuffle_id, reduce_id)
+            yield from self._fetch_remote(peer, shuffle_id, reduce_id,
+                                          map_range)
 
     def fetch_partitions_async(self, shuffle_id: int, reduce_ids,
                                remote_peers: Optional[List[str]] = None):
@@ -243,7 +277,8 @@ class ShuffleEnv:
             int(self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
             oom_retries=int(self.conf.get(OOM_RETRY_MAX)))
 
-    def _fetch_remote(self, peer: str, shuffle_id: int, reduce_id: int
+    def _fetch_remote(self, peer: str, shuffle_id: int, reduce_id: int,
+                      map_range: Optional[tuple] = None
                       ) -> Iterator[ColumnarBatch]:
         """doFetch (RapidsShuffleClient.scala:350-770): wildcard metadata
         request discovers the peer's blocks for this reduce partition, then
@@ -252,7 +287,9 @@ class ShuffleEnv:
         from ..metrics.journal import journal_event
         client = self.transport.make_client(peer)
         resp = client.fetch_metadata(MetadataRequest(
-            shuffle_id=shuffle_id, reduce_id=reduce_id))
+            shuffle_id=shuffle_id, reduce_id=reduce_id,
+            map_lo=map_range[0] if map_range else None,
+            map_hi=map_range[1] if map_range else None))
         fetched_bytes = 0
         n_buffers = 0
         for bm in resp.block_metas:

@@ -166,6 +166,11 @@ def split_by_partition(batch: ColumnarBatch, pids: jnp.ndarray, n: int,
         pcap = bucket_rows(cnt, min_bucket)
         idx = off + jnp.arange(pcap, dtype=jnp.int32)
         sel = jnp.arange(pcap, dtype=jnp.int32) < cnt
-        out.append((p, sorted_batch.take(idx, sel=sel)))
+        sub = sorted_batch.take(idx, sel=sel)
+        # the count is already host-known here: stamping it lets the
+        # shuffle write path record map-output statistics (and the worker
+        # report MapStatus rows) without a device sync per sub-batch
+        sub.known_rows = cnt
+        out.append((p, sub))
         off += cnt
     return out

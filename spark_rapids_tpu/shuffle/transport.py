@@ -78,6 +78,10 @@ class MetadataRequest:
     blocks: Optional[List[ShuffleBlockId]] = None
     shuffle_id: Optional[int] = None
     reduce_id: Optional[int] = None
+    # wildcard restricted to map ids [map_lo, map_hi) — the skew-join
+    # slice fetch (adaptive/stats.py PartialReducerPartitionSpec)
+    map_lo: Optional[int] = None
+    map_hi: Optional[int] = None
 
 
 @dataclass

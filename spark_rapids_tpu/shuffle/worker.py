@@ -197,6 +197,13 @@ class WorkerHandler:
         (metrics/export.cluster_snapshot pulls this from every worker)."""
         return dict(self.runtime.pool_stats())
 
+    def rpc_map_output_stats(self, sid: int):
+        """This worker's observed map-output sizes for one shuffle
+        ({reduce_id: {bytes, rows, maps}}) — the driver merges every
+        worker's snapshot into cluster-wide MapOutputStatistics for
+        adaptive re-planning (adaptive/stats.merge_cluster_stats)."""
+        return self.env.map_stats.snapshot(sid)
+
     def rpc_remove_shuffle(self, sid: int):
         self.env.remove_shuffle(sid)
         return True

@@ -44,7 +44,8 @@ def _bound_xla_code_memory():
 def _reset_fault_injector():
     """Disarm + zero the process-global fault injector around every test so
     the `faultinject` tier's ordinals are deterministic and no armed spec
-    leaks into unrelated tests."""
+    leaks into unrelated tests (the `adaptive` tier's discover-then-replay
+    OOM tests rely on the same reset)."""
     from spark_rapids_tpu.utils import faults
     faults.INJECTOR.reset()
     yield

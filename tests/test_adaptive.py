@@ -1,0 +1,396 @@
+"""Adaptive query execution tier (ISSUE 3): runtime re-planning from
+shuffle map statistics.
+
+Covers the three rules (coalesce small partitions, skew-join split,
+dynamic join strategy switch) end to end — AQE-on must match AQE-off
+bit-for-bit while the adaptive counters fire and every decision lands in
+the journal/Prometheus surfaces — plus the stats plumbing (MapOutputTracker
+lifecycle, cluster-wide merge) and composition with OOM fault injection.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.adaptive.rules import (coalesce_specs, detect_skew,
+                                             map_range_slices)
+from spark_rapids_tpu.adaptive.stats import (CoalescedPartitionSpec,
+                                             MapOutputTracker,
+                                             PartialReducerPartitionSpec,
+                                             identity_specs, is_identity,
+                                             merge_cluster_stats)
+from spark_rapids_tpu.engine import TpuSession
+from spark_rapids_tpu.plan.logical import col, functions as F, lit
+from spark_rapids_tpu.utils import faults
+
+pytestmark = pytest.mark.adaptive
+
+
+# --------------------------------------------------------------------------
+# rule unit tests
+# --------------------------------------------------------------------------
+
+def test_coalesce_specs_merges_under_bound():
+    specs = coalesce_specs(6, [[10, 10, 10, 100, 10, 10]], [35])
+    assert specs == [CoalescedPartitionSpec(0, 3),
+                     CoalescedPartitionSpec(3, 4),
+                     CoalescedPartitionSpec(4, 6)]
+    # every partition covered exactly once
+    assert [p for s in specs for p in range(s.start, s.end)] == list(range(6))
+
+
+def test_coalesce_specs_second_bound_caps_build_side():
+    # combined bytes would merge everything; the build-side bound splits
+    specs = coalesce_specs(4, [[1, 1, 1, 1], [30, 30, 30, 30]], [1000, 60])
+    assert specs == [CoalescedPartitionSpec(0, 2),
+                     CoalescedPartitionSpec(2, 4)]
+
+
+def test_coalesce_specs_identity_detection():
+    assert is_identity(identity_specs(5), 5)
+    assert not is_identity([CoalescedPartitionSpec(0, 2)], 2)
+
+
+def test_detect_skew_uses_median_and_floor():
+    sizes = [10, 12, 11, 500, 0, 9]
+    assert detect_skew(sizes, factor=3.0, threshold=1) == {3}
+    # the floor suppresses skew below it whatever the factor says
+    assert detect_skew(sizes, factor=3.0, threshold=10_000) == set()
+    assert detect_skew([0, 0], 3.0, 1) == set()
+
+
+def test_map_range_slices_split_and_unsplittable():
+    slices = map_range_slices({0: 40, 1: 40, 2: 40, 3: 40}, target=90)
+    assert len(slices) >= 2
+    # contiguous cover of [0, max_map+1)
+    assert slices[0][0] == 0 and slices[-1][1] == 4
+    for (a, b), (c, _d) in zip(slices, slices[1:]):
+        assert b == c and a < b
+    # a single map block cannot be split
+    assert map_range_slices({2: 1000}, target=10) == [(0, 3)]
+    assert map_range_slices({}, target=10) == []
+
+
+# --------------------------------------------------------------------------
+# map-output statistics plumbing
+# --------------------------------------------------------------------------
+
+def test_map_output_tracker_record_and_remove():
+    t = MapOutputTracker()
+    t.record(1, map_id=0, reduce_id=2, nbytes=100, nrows=10)
+    t.record(1, map_id=1, reduce_id=2, nbytes=50, nrows=5)
+    t.record(1, map_id=0, reduce_id=0, nbytes=7, nrows=1)
+    st = t.stats(1, num_partitions=4)
+    assert st.bytes_by_partition == [7, 0, 150, 0]
+    assert st.rows_by_partition == [1, 0, 15, 0]
+    assert st.map_bytes_by_partition[2] == {0: 100, 1: 50}
+    assert st.num_map_tasks == 2
+    assert st.total_bytes == 157 and st.total_rows == 16
+    t.remove_shuffle(1)
+    assert t.tracked_shuffles() == []
+    assert t.stats(1, 4).total_bytes == 0
+
+
+def test_merge_cluster_stats_sums_executor_snapshots():
+    a, b = MapOutputTracker(), MapOutputTracker()
+    a.record(5, 0, 1, 100, 10)
+    b.record(5, 1, 1, 40, 4)
+    b.record(5, 1, 3, 8, 2)
+    st = merge_cluster_stats(5, 4, [a.snapshot(5), b.snapshot(5), None])
+    assert st.bytes_by_partition == [0, 140, 0, 8]
+    assert st.map_bytes_by_partition[1] == {0: 100, 1: 40}
+    assert st.num_map_tasks == 2
+
+
+def test_tpu_cluster_map_output_stats_merges_executors():
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.plugin import TpuCluster
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.types import LongType, Schema, StructField
+    conf = TpuConf({"spark.rapids.sql.tpu.cluster.executors": 2})
+    cluster = TpuCluster(conf, 2)
+    try:
+        schema = Schema([StructField("x", LongType)])
+        batch = ColumnarBatch.from_pydict({"x": [1, 2, 3]}, schema)
+        sid = cluster.new_shuffle_id()
+        cluster.env_for(0).write_partition(sid, 0, 1, batch)
+        cluster.env_for(1).write_partition(sid, 1, 1, batch)
+        st = cluster.map_output_stats(sid, 4)
+        assert st.rows_by_partition == [0, 6, 0, 0]
+        assert st.num_map_tasks == 2
+        assert set(st.map_bytes_by_partition[1]) == {0, 1}
+        cluster.remove_shuffle(sid)
+        assert cluster.map_output_stats(sid, 4).total_bytes == 0
+    finally:
+        cluster.shutdown()
+
+
+def test_map_stats_do_not_accumulate_across_queries():
+    """Shuffle lifecycle regression (satellite): remove_shuffle must drop
+    the shuffle's statistics, so a long-lived session's tracker stays
+    empty between queries."""
+    s = TpuSession({
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.rapids.sql.tpu.join.partitioned.threshold": "0",
+        "spark.rapids.sql.tpu.shuffle.partitions": "4",
+    })
+    left = s.from_pydict({"k": [i % 5 for i in range(200)],
+                          "v": [float(i) for i in range(200)]})
+    right = s.from_pydict({"k": list(range(5)),
+                           "w": [float(i) for i in range(5)]})
+    for _ in range(2):
+        left.join(right, on="k").agg(F.count(lit(1)).alias("c")).collect()
+    env = getattr(s.runtime, "_shuffle_env", None)
+    assert env is not None
+    assert env.map_stats.tracked_shuffles() == [], \
+        "map-output statistics leaked across queries"
+
+
+# --------------------------------------------------------------------------
+# end-to-end: AQE-on == AQE-off while the rules demonstrably fire
+# --------------------------------------------------------------------------
+
+_SKEW_CONF = {
+    # force the partitioned-join path (no static broadcast) so the
+    # coalesce/skew rules own the join
+    "spark.sql.autoBroadcastJoinThreshold": "-1",
+    "spark.rapids.sql.tpu.join.partitioned.threshold": "0",
+    "spark.rapids.sql.tpu.shuffle.partitions": "8",
+    "spark.rapids.sql.tpu.adaptive.advisoryPartitionSizeBytes": "16k",
+    "spark.rapids.sql.tpu.adaptive.skewJoin.skewedPartitionFactor": "3",
+    "spark.rapids.sql.tpu.adaptive.skewJoin."
+    "skewedPartitionThresholdInBytes": "1k",
+    "spark.rapids.sql.tpu.metrics.level": "DEBUG",  # in-memory journal
+}
+
+
+def _skewed_query(session):
+    """join + agg + sort slice over a hot-key dataset; repartition(4)
+    upstream gives the join's map side multiple map tasks, which is what
+    the skew rule slices on."""
+    rng = np.random.RandomState(0)
+    keys = [7] * 3000 + [int(k) for k in rng.randint(0, 10, 3000)
+                         if k != 7]
+    left = session.from_pydict(
+        {"k": keys, "v": [float(i % 13) for i in range(len(keys))]})
+    right = session.from_pydict(
+        {"k": list(range(10)), "name": [f"dim{i}" for i in range(10)]})
+    return (left.repartition(4)
+            .join(right, on="k")
+            .group_by("name")
+            .agg(F.sum(col("v")).alias("sv"),
+                 F.count(col("v")).alias("cv"))
+            .order_by("name"))
+
+
+def _run_skewed(adaptive, extra=None):
+    conf = dict(_SKEW_CONF)
+    conf["spark.rapids.sql.tpu.adaptive.enabled"] = str(adaptive).lower()
+    conf.update(extra or {})
+    s = TpuSession(conf)
+    return s, _skewed_query(s).to_arrow()
+
+
+def test_aqe_on_off_identical_on_skewed_join():
+    _s_off, t_off = _run_skewed(False)
+    s_on, t_on = _run_skewed(True)
+    # bit-for-bit: same arrow table (schema, order, values)
+    assert t_on.equals(t_off)
+
+    tot = s_on.query_metrics_total
+    assert tot.get("numSkewSplits", 0) > 0
+    assert tot.get("numCoalescedPartitions", 0) > 0
+    assert tot.get("mapOutputBytes", 0) > 0
+
+    qe = s_on.last_execution
+    # the counters appear in the Prometheus export (acceptance criterion)
+    prom = qe.prometheus()
+    assert "spark_rapids_tpu_num_skew_splits" in prom
+    assert "spark_rapids_tpu_num_coalesced_partitions" in prom
+    # every adaptive decision journaled with the replan kind
+    names = [e["name"] for e in qe.journal.events()
+             if e["kind"] == "replan"]
+    assert "skewSplit" in names and "coalescePartitions" in names
+    # map stages journaled with observed sizes
+    stages = [e for e in qe.journal.events() if e["kind"] == "stage"]
+    assert stages and all(e["bytes"] >= 0 for e in stages)
+    # EXPLAIN METRICS shows the FINAL (re-planned) stage plan
+    text = qe.explain_with_metrics()
+    assert "TpuAdaptivePlanExec[final]" in text
+    assert "TpuCoalescedShuffleReaderExec" in text
+
+
+def test_aqe_off_plans_contain_no_adaptive_nodes():
+    s_off, _ = _run_skewed(False)
+    text = s_off.last_execution.explain_with_metrics()
+    assert "TpuAdaptivePlanExec" not in text
+    assert "TpuCoalescedShuffleReaderExec" not in text
+
+
+def test_coalesce_rule_fires_on_many_tiny_partitions():
+    def q(session):
+        df = session.from_pydict(
+            {"k": [i % 50 for i in range(2000)],
+             "v": [float(i) for i in range(2000)]})
+        return (df.repartition(32)
+                .group_by("k").agg(F.sum(col("v")).alias("sv"))
+                .order_by("k"))
+
+    def run(adaptive):
+        s = TpuSession({
+            "spark.rapids.sql.tpu.adaptive.enabled": str(adaptive).lower(),
+            "spark.rapids.sql.tpu.adaptive.advisoryPartitionSizeBytes":
+                "1m",
+            "spark.rapids.sql.tpu.metrics.level": "DEBUG",
+        })
+        return s, q(s).to_arrow()
+
+    _s_off, t_off = run(False)
+    s_on, t_on = run(True)
+    assert t_on.equals(t_off)
+    assert s_on.query_metrics_total.get("numCoalescedPartitions", 0) > 0
+    names = [e["name"] for e in s_on.last_execution.journal.events()
+             if e["kind"] == "replan"]
+    assert "coalescePartitions" in names
+
+
+def test_promote_partitioned_join_to_broadcast():
+    """Observed build side tiny though the static estimate said big (the
+    filter keeps its child's upper-bound estimate): the strategy rule
+    promotes to a single-build join."""
+    def q(session):
+        big = session.from_pydict(
+            {"k": list(range(50000)),
+             "v": [float(i % 7) for i in range(50000)]})
+        dim = big.filter(col("k") < 100).select(
+            col("k"), (col("v") * 2).alias("w"))
+        return (big.join(dim, on="k")
+                .group_by().agg(F.count(col("w")).alias("c")))
+
+    def run(adaptive):
+        s = TpuSession({
+            "spark.sql.autoBroadcastJoinThreshold": "20k",
+            "spark.rapids.sql.tpu.join.partitioned.threshold": "0",
+            "spark.rapids.sql.tpu.shuffle.partitions": "4",
+            "spark.rapids.sql.tpu.metrics.level": "DEBUG",
+            "spark.rapids.sql.tpu.adaptive.enabled": str(adaptive).lower(),
+        })
+        return s, q(s).to_arrow()
+
+    _s_off, t_off = run(False)
+    s_on, t_on = run(True)
+    assert t_on.equals(t_off)
+    assert s_on.query_metrics_total.get("numJoinStrategyChanges", 0) == 1
+    names = [e["name"] for e in s_on.last_execution.journal.events()
+             if e["kind"] == "replan"]
+    assert "promoteToBroadcast" in names
+
+
+def test_demote_broadcast_join_when_static_estimate_forced_wrong():
+    """Acceptance criterion: the static estimate is forced wrong via
+    config — a self-join fan-out keeps the max(l, r) row estimate, so the
+    threshold sits between estimated and observed size; the planner picks
+    broadcast, adaptive demotes it, and the demotion is journaled."""
+    def q(session):
+        t1 = session.from_pydict(
+            {"k": [i % 100 for i in range(1000)],
+             "v": [float(i) for i in range(1000)]})
+        fan = t1.join(t1.select(col("k"), col("v").alias("w")), on="k")
+        probe = session.from_pydict(
+            {"k": [i % 100 for i in range(2000)],
+             "z": [float(i % 5) for i in range(2000)]})
+        return (probe.join(fan, on="k")
+                .group_by().agg(F.count(col("w")).alias("c")))
+
+    def run(adaptive):
+        s = TpuSession({
+            "spark.sql.autoBroadcastJoinThreshold": "64k",
+            "spark.rapids.sql.tpu.shuffle.partitions": "4",
+            "spark.rapids.sql.tpu.metrics.level": "DEBUG",
+            "spark.rapids.sql.tpu.adaptive.enabled": str(adaptive).lower(),
+        })
+        return s, q(s).to_arrow()
+
+    s_off, t_off = run(False)
+    s_on, t_on = run(True)
+    assert t_on.equals(t_off)
+    # the STATIC plan chose broadcast for the fan-out build on both runs
+    assert "TpuBroadcastHashJoinExec" in \
+        s_off.last_execution.explain_with_metrics()
+    assert s_on.query_metrics_total.get("numJoinStrategyChanges", 0) >= 1
+    events = [e for e in s_on.last_execution.journal.events()
+              if e["kind"] == "replan"]
+    demotes = [e for e in events if e["name"] == "demoteBroadcastJoin"]
+    assert demotes, events
+    assert demotes[0]["observed_bytes"] > demotes[0]["threshold"]
+    # the final plan runs the partitioned replacement join
+    assert "TpuShuffledHashJoinExec" in \
+        s_on.last_execution.explain_with_metrics()
+
+
+def test_demote_with_already_coalesced_probe_subtree():
+    """Regression (code review): a demoted broadcast's replacement join
+    re-walks its ALREADY-ADAPTED probe subtree.  When that subtree holds
+    an exchange the first pass coalesced into a reader, the re-walk must
+    not nest a second reader around it (which crashed at execution) nor
+    double-count numCoalescedPartitions."""
+    def q(session):
+        t1 = session.from_pydict(
+            {"k": [i % 100 for i in range(1000)],
+             "v": [float(i) for i in range(1000)]})
+        fan = t1.join(t1.select(col("k"), col("v").alias("w")), on="k")
+        probe = session.from_pydict(
+            {"k": [i % 100 for i in range(2000)],
+             "z": [float(i % 5) for i in range(2000)]})
+        # the repartition exchange under the probe side coalesces in the
+        # first adaptive pass; the demotion re-walk must leave it alone
+        return (probe.repartition(8, col("k"))
+                .join(fan, on="k")
+                .group_by().agg(F.count(col("w")).alias("c")))
+
+    def run(adaptive):
+        s = TpuSession({
+            "spark.sql.autoBroadcastJoinThreshold": "64k",
+            "spark.rapids.sql.tpu.shuffle.partitions": "4",
+            "spark.rapids.sql.tpu.metrics.level": "DEBUG",
+            "spark.rapids.sql.tpu.adaptive.enabled": str(adaptive).lower(),
+        })
+        return s, q(s).to_arrow()
+
+    _s_off, t_off = run(False)
+    s_on, t_on = run(True)
+    assert t_on.equals(t_off)
+    events = [e["name"] for e in s_on.last_execution.journal.events()
+              if e["kind"] == "replan"]
+    assert "demoteBroadcastJoin" in events
+    # the probe's coalesce decision fired exactly once, not per walk
+    text = s_on.last_execution.explain_with_metrics()
+    assert "TpuCoalescedShuffleReaderExec[coalesced" in text
+
+
+# --------------------------------------------------------------------------
+# composition with OOM fault injection (utils/faults.py)
+# --------------------------------------------------------------------------
+
+def test_oom_injection_composes_with_skew_split():
+    """Deterministic OOM at reserve sites of the skewed join must retry
+    inside the skew-split read blocks and still produce identical
+    results (the discover-then-replay pattern from tests/test_retry.py,
+    sampled to keep the tier fast)."""
+    faults.INJECTOR.reset()
+    s0, baseline = _run_skewed(True)
+    assert s0.query_metrics_total.get("numSkewSplits", 0) > 0
+    n_ops = faults.INJECTOR.oom_ops
+    assert n_ops > 5, dict(faults.INJECTOR.site_counts)
+    # sample ordinals across the whole query (first, the fetch-heavy
+    # middle, last) instead of all of them — each run re-executes the
+    # full slice
+    ordinals = sorted({1, n_ops // 3, n_ops // 2, 2 * n_ops // 3, n_ops})
+    for ordinal in ordinals:
+        faults.INJECTOR.reset()
+        s, out = _run_skewed(True, {
+            "spark.rapids.tpu.test.injectOom": str(ordinal)})
+        assert faults.INJECTOR.injected_log, \
+            f"ordinal {ordinal} never fired"
+        assert out.equals(baseline), \
+            f"ordinal {ordinal} changed the result"
+        assert s.query_metrics_total.get("numSkewSplits", 0) > 0, \
+            f"ordinal {ordinal} suppressed the skew split"

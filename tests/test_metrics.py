@@ -119,7 +119,10 @@ def test_parse_level():
 def test_every_emitted_metric_name_is_registered():
     from spark_rapids_tpu.metrics.__main__ import scan_emitted_names
     sites = scan_emitted_names()
-    assert len(sites) >= 20, "lint scanner found suspiciously few sites"
+    # floor = a sanity check that the scanner still finds literal-name
+    # sites at all (PR-3 unified the exchange read paths, dropping one
+    # duplicated "exchangeFetch" retry-block site)
+    assert len(sites) >= 18, "lint scanner found suspiciously few sites"
     bad = [(p, i, name) for p, i, name in sites
            if not N.is_registered(name)]
     assert not bad, f"unregistered metric names: {bad}"

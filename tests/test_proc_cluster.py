@@ -332,3 +332,37 @@ def test_proc_cluster_two_workers_lost(tmp_path):
     for c in ["sum_qty", "count_order"]:
         np.testing.assert_allclose(res[c].to_numpy(), exp[c].to_numpy(),
                                    rtol=1e-9)
+
+
+@pytest.mark.slow
+@pytest.mark.adaptive
+def test_proc_cluster_map_output_stats_rpc():
+    """The MapOutputStatistics control plane over real process boundaries
+    (PR-3): rpc_map_output_stats snapshots each worker's tracker, the
+    driver merges them (alongside rpc_pool_stats in the doctor sweep),
+    and remove_shuffle drops the stats with the buffers."""
+    import pickle
+
+    from spark_rapids_tpu.cluster import ProcCluster
+    session = TpuSession()
+    table = pa.table({"k": [i % 16 for i in range(200)],
+                      "v": [float(i) for i in range(200)]})
+    plan = session.from_arrow(table).plan
+    cluster = ProcCluster(2, conf={}, cpu=True)
+    try:
+        sid = cluster.new_shuffle_id()
+        blob = pickle.dumps(plan)
+        for w in cluster.workers:
+            out = w.rpc("run_map", sid=sid, plan_blob=blob,
+                        key_names=["k"], n_parts=4)
+            assert sum(out["written_rows"].values()) == 200
+        st = cluster.map_output_stats(sid, 4)
+        assert st.total_rows == 400  # both workers' snapshots merged
+        assert st.total_bytes > 0
+        assert sum(1 for b in st.bytes_by_partition if b > 0) == 4
+        for w in cluster.workers:
+            w.rpc("remove_shuffle", sid=sid)
+        # lifecycle: stats drop with the shuffle's buffers
+        assert cluster.map_output_stats(sid, 4).total_rows == 0
+    finally:
+        cluster.shutdown()

@@ -294,6 +294,48 @@ class TestRepartitionE2E:
         assert "ShuffleExchangeExec" in text
         assert "!" not in text.split("ShuffleExchangeExec")[0].splitlines()[-1]
 
+    def test_make_repartition_exec_no_keys_falls_back_round_robin(self):
+        """Direct unit test (PR-3 satellite): a hash repartition with no
+        keys degrades to round robin — the coalesced reader builds on the
+        exchange this helper constructs."""
+        from spark_rapids_tpu.exec.exchange import (TpuShuffleExchangeExec,
+                                                    make_repartition_exec)
+        from spark_rapids_tpu.plan import logical as L
+        from spark_rapids_tpu.exec import basic as B
+        from spark_rapids_tpu.types import LongType, Schema, StructField
+        import pyarrow as pa
+        schema = Schema([StructField("k", LongType)])
+        child = B.TpuScanMemoryExec(pa.table({"k": [1, 2, 3]}), schema)
+        plan = L.LogicalRepartition(4, [], None, "hash")
+        exch = make_repartition_exec(plan, [], child, True)
+        assert isinstance(exch, TpuShuffleExchangeExec)
+        assert exch.mode == "round_robin"
+        assert exch.num_partitions == 4
+        # keys present: stays hash
+        from spark_rapids_tpu.ops import expressions as E
+        ref = E.BoundReference(0, LongType, "k")
+        plan2 = L.LogicalRepartition(4, [ref], None, "hash")
+        assert make_repartition_exec(plan2, [ref], child, True).mode \
+            == "hash"
+
+    def test_drain_async_pads_empty_partitions(self):
+        """Direct unit test (PR-3 satellite): _drain_async must emit every
+        partition 0..n-1 exactly once, None for the empty ones — the
+        coalesced reader's positional spec folding depends on it."""
+        from spark_rapids_tpu.exec.exchange import _drain_async
+        b = make_batch(n=5)
+        out = list(_drain_async(iter([(2, b), (2, b), (4, b)]), 6))
+        assert [p for p, _ in out] == [0, 1, 2, 3, 4, 5]
+        assert out[0][1] is None and out[1][1] is None
+        assert out[2][1] is not None  # two sub-batches coalesced
+        assert int(out[2][1].num_rows_host()) == 10
+        assert out[3][1] is None
+        assert int(out[4][1].num_rows_host()) == 5
+        assert out[5][1] is None
+        # fully empty stream still pads every partition
+        assert list(_drain_async(iter([]), 3)) == [(0, None), (1, None),
+                                                   (2, None)]
+
     def test_remote_fetch_baseline_path(self):
         """Baseline (host-serialized) blocks must also be remotely
         fetchable through the metadata control plane."""
