@@ -36,6 +36,19 @@ T_AQE=$SECONDS
 python -m pytest tests/test_adaptive.py -q -m "not slow" -p no:cacheprovider
 echo "== adaptive tier took $((SECONDS - T_AQE))s =="
 
+echo "== integrity tier =="
+# shuffle/spill data integrity (ISSUE 4): injected single-bit corruption
+# at every transfer/spill path must be detected, classified
+# (writer/wire/reader) and recovered — refetch for transient faults,
+# map-fragment recompute for persistent ones.  The in-process suite runs
+# fast; the -m integrity sweep adds the multi-process ProcCluster
+# corruption-recovery tests (slow-marked, so tier-1 skips them).
+T_INT=$SECONDS
+python -m pytest tests/test_integrity.py -q -p no:cacheprovider
+python -m pytest tests/test_proc_cluster.py -q -m integrity \
+    -p no:cacheprovider
+echo "== integrity tier took $((SECONDS - T_INT))s =="
+
 echo "== tests (fast tier) =="
 T_TESTS=$SECONDS
 MARK="not slow"

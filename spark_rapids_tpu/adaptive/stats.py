@@ -128,6 +128,11 @@ class MapOutputTracker:
     def __init__(self):
         self._by_shuffle: Dict[int, Dict[int, dict]] = {}
         self._lock = threading.Lock()
+        # bumped whenever previously-recorded map output is invalidated
+        # (lost to corruption / a dead peer).  Stats consumers (the
+        # exchange's _ShuffleHandle cache) compare epochs so AQE re-plan
+        # rules never act on statistics from a dead map stage.
+        self._epoch = 0
 
     def record(self, shuffle_id: int, map_id: int, reduce_id: int,
                nbytes: int, nrows: int) -> None:
@@ -155,6 +160,36 @@ class MapOutputTracker:
         st = MapOutputStatistics(shuffle_id, num_partitions)
         st.merge_snapshot(self.snapshot(shuffle_id))
         return st
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def bump_epoch(self) -> int:
+        """Invalidate every captured statistics view (cheap: consumers
+        re-aggregate lazily on their next stats() read)."""
+        with self._lock:
+            self._epoch += 1
+            return self._epoch
+
+    def mark_lost(self, shuffle_id: int,
+                  map_id: Optional[int] = None) -> None:
+        """Drop the records of a lost map output (one map task's, or the
+        whole shuffle's) and bump the epoch: the recompute repopulates
+        them via `record`, and stale AQE stats can never be read in
+        between."""
+        with self._lock:
+            shuffle = self._by_shuffle.get(shuffle_id)
+            if shuffle is not None:
+                if map_id is None:
+                    self._by_shuffle.pop(shuffle_id, None)
+                else:
+                    for rec in shuffle.values():
+                        dropped = rec["maps"].pop(map_id, None)
+                        if dropped is not None:
+                            rec["bytes"] -= int(dropped)
+            self._epoch += 1
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         with self._lock:

@@ -18,8 +18,10 @@ for tests and one-host runs; `ProcCluster` is its multi-process twin.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
+import re
 import subprocess
 import sys
 import threading
@@ -27,6 +29,32 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from .config import TpuConf
+
+log = logging.getLogger("spark_rapids_tpu.cluster")
+
+# the control RPC flattens worker-side exceptions to strings; FetchFailed's
+# repr deliberately carries this machine-parseable peer marker so the
+# driver can identify WHICH peer served garbage even through two layers of
+# wrapping (mem/integrity.FetchFailed.__repr__)
+_FETCH_FAILED_RE = re.compile(r"FetchFailed\(peer='([^']+)'")
+
+
+def _fetch_failed_peer(err: BaseException) -> Optional[str]:
+    """Executor id of the peer a (possibly rpc-flattened) FetchFailed
+    blames, scanning the exception chain; None when no FetchFailed is
+    involved."""
+    seen = set()
+    e: Optional[BaseException] = err
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        peer = getattr(e, "peer", None)
+        if peer is not None and type(e).__name__ == "FetchFailed":
+            return str(peer)
+        m = _FETCH_FAILED_RE.search(str(e))
+        if m:
+            return m.group(1)
+        e = e.__cause__ or e.__context__
+    return None
 
 
 class WorkerProc:
@@ -145,6 +173,11 @@ class ProcCluster:
         self._sid = 0
         self._lock = threading.Lock()
         self.task_retries = 0   # observability: recoveries this cluster
+        self.lost_map_outputs = 0  # FetchFailed-driven recompute count
+        # bumped on every worker replacement: statistics consumers
+        # (exec/exchange._ShuffleHandle) treat a bump as "a map stage
+        # died" and re-aggregate instead of re-planning on dead stats
+        self.map_epoch = 0
         self._publish_peers()
 
     def _publish_peers(self) -> None:
@@ -155,12 +188,18 @@ class ProcCluster:
                 w.client = self._transport.make_client(w.executor_id)
             try:
                 w.rpc("set_peers", peers=peers)
-            except Exception:  # noqa: BLE001 — a peer that is ALSO dead
-                # (multi-worker loss) gets replaced by its own recovery
-                # iteration, which re-publishes to everyone; failing the
-                # whole recovery on ITS broken socket would burn the
-                # retry budget before the second replacement happens
-                pass
+            except Exception as e:  # noqa: BLE001 — a peer that is ALSO
+                # dead (multi-worker loss) gets replaced by its own
+                # recovery iteration, which re-publishes to everyone;
+                # failing the whole recovery on ITS broken socket would
+                # burn the retry budget before the second replacement
+                # happens.  But never SILENTLY: a survivor that missed a
+                # replacement's address dials a dead port on its next
+                # remote fetch, and without this log + counter that
+                # failure mode is indistinguishable from a network fault.
+                self._transport.count("peer_publish_failures")
+                log.warning("peer-map publish to %s failed (it may still "
+                            "hold stale addresses): %r", w.executor_id, e)
 
     def _replace_worker(self, i: int) -> "WorkerProc":
         """Executor-loss recovery (the Spark task-retry / lineage analogue:
@@ -183,6 +222,7 @@ class ProcCluster:
         fresh.client = self._transport.make_client(fresh.executor_id)
         self._publish_peers()
         self.task_retries += 1
+        self.map_epoch += 1  # its old map outputs died with the process
         return fresh
 
     def new_shuffle_id(self) -> int:
@@ -202,7 +242,15 @@ class ProcCluster:
         reduce stage re-runs the lost map fragment — the logical plan is
         the lineage); a worker that is alive but errored (e.g. its fetch
         raced a peer's death) just re-runs its task after replacements
-        settle."""
+        settle.
+
+        FetchFailed handling (data-integrity escalation): a reduce task
+        that raises FetchFailed names the PEER whose map output is
+        unservable — dead socket, vanished buffer, or persistently
+        corrupt data.  That peer is replaced EVEN IF ITS PROCESS IS
+        STILL ALIVE (a live executor serving garbage is as lost as a
+        dead one) and its map fragment is recomputed from the lineage
+        before the failed reduce task retries."""
 
         def wave(indices):
             errs = {}
@@ -224,11 +272,35 @@ class ProcCluster:
         tries = 0
         while errs and tries < self.max_task_retries:
             tries += 1
+            replaced = set()
             for i in sorted(errs):
                 if self.workers[i].proc.poll() is not None:
-                    self._replace_worker(i)
-                    if on_replace is not None:
-                        on_replace(i)
+                    if i not in replaced:
+                        self._replace_worker(i)
+                        replaced.add(i)
+                        if on_replace is not None:
+                            on_replace(i)
+                    continue
+                # typed FetchFailed escalation: the error names the peer
+                # whose map output is lost (corrupt/gone), which may be a
+                # DIFFERENT worker than the one whose task failed — and
+                # one whose process is perfectly alive, just serving
+                # garbage.  Replace the blamed peer and recompute ITS map
+                # fragment; the failing task re-runs in the next wave.
+                peer = _fetch_failed_peer(errs[i])
+                if peer is not None:
+                    j = next((k for k, w in enumerate(self.workers)
+                              if w.executor_id == peer), None)
+                    if j is not None and j not in replaced:
+                        self.lost_map_outputs += 1
+                        log.warning(
+                            "%s task %d lost map output at %s; replacing "
+                            "it and recomputing the fragment", stage, i,
+                            peer)
+                        self._replace_worker(j)
+                        replaced.add(j)
+                        if on_replace is not None:
+                            on_replace(j)
             errs = wave(sorted(errs))
         if errs:
             i, e = next(iter(sorted(errs.items())))

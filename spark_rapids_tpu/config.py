@@ -163,6 +163,13 @@ PINNED_POOL_SIZE = _conf(
     "spark.rapids.memory.pinnedPool.size", 0,
     "Size of the pinned host staging pool used for H2D/D2H transfer.",
     to_bytes)
+SPILL_CHECKSUM_ENABLED = _conf(
+    "spark.rapids.memory.spill.checksum.enabled", True,
+    "Checksum device buffers as they spill to the host tier and verify "
+    "on every subsequent movement (host->disk write, disk read, "
+    "host/disk->device unspill), so a flipped bit in spilled bytes "
+    "surfaces as a typed CorruptBuffer instead of silently wrong query "
+    "results.  Uses spark.rapids.shuffle.checksum.algorithm.", _to_bool)
 OOM_RETRY_MAX = _conf(
     "spark.rapids.memory.tpu.retry.maxRetries", 2,
     "Same-size retries of an operator allocation attempt after an OOM "
@@ -405,6 +412,35 @@ SHUFFLE_TXN_TIMEOUT = _conf(
     "data frame + END) in milliseconds; past it the transaction is "
     "CANCELLED and the error propagates without further retries.  "
     "0 disables.", int)
+SHUFFLE_CHECKSUM_ENABLED = _conf(
+    "spark.rapids.shuffle.checksum.enabled", True,
+    "Checksum every shuffle buffer leaf at its first device->host "
+    "materialization and verify before fetched bytes become a columnar "
+    "batch (streamed, shared-memory and loopback fetch paths).  On "
+    "mismatch the reader refetches up to maxRefetchAttempts and runs a "
+    "writer-side diagnosis to classify the corruption site "
+    "(SPARK-35275/36206 analogue; docs/tuning-guide.md, Data integrity).",
+    _to_bool)
+SHUFFLE_CHECKSUM_ALGO = _conf(
+    "spark.rapids.shuffle.checksum.algorithm", "crc32c",
+    "Checksum algorithm for shuffle and spill integrity: crc32c "
+    "(hardware CRC32C when the google_crc32c C library is importable, "
+    "~10 GB/s; falls back to xxhash then zlib crc32), xxhash (xxh3_64), "
+    "crc32, adler32, or none.", str)
+SHUFFLE_CHECKSUM_VERIFY_LOCAL = _conf(
+    "spark.rapids.shuffle.checksum.verifyOnLocalRead", False,
+    "Also verify checksums when a reduce task reads blocks from its OWN "
+    "executor's catalog (host-serialized baseline buffers and "
+    "host/disk-tier spilled buffers).  Off by default: local reads never "
+    "cross a wire, so this only guards against host-memory rot at extra "
+    "read cost.", _to_bool)
+SHUFFLE_MAX_REFETCH = _conf(
+    "spark.rapids.shuffle.maxRefetchAttempts", 2,
+    "Refetch attempts for a shuffle buffer whose checksum verification "
+    "failed at the reader (transient wire/reader corruption).  Exhausting "
+    "them — or a writer-side diagnosis (the peer's stored data no longer "
+    "matches its recorded checksum) — escalates to FetchFailed, marking "
+    "the map output lost so the map fragment is recomputed.", int)
 
 # --- joins ------------------------------------------------------------------
 def _to_bytes_or_disabled(v) -> int:
@@ -484,6 +520,17 @@ TEST_INJECT_NET = _conf(
     "Deterministic network-fault injection spec over the client-side "
     "shuffle socket-op counter (same grammar as injectOom, minus "
     "split@).  Testing only.", str, internal=True)
+TEST_INJECT_CORRUPTION = _conf(
+    "spark.rapids.tpu.test.injectCorruption", "",
+    "Deterministic single-bit corruption injection over the transfer/"
+    "spill paths.  Items are site-scoped ordinals: 'wire@3' flips a bit "
+    "in the 3rd chunk staged for a socket send, 'shm@1' in the 1st "
+    "shared-memory leaf fill, 'loopback@2' in the 2nd loopback bounce "
+    "chunk, 'spill@1' in the 1st device->host spilled leaf, 'disk@1' in "
+    "the 1st host->disk image, 'writer@1x9' in the writer's served "
+    "leaves (persistent: window of 9).  A bare ordinal ('5') counts "
+    "across all sites; 'p=0.01' corrupts probabilistically (seeded by "
+    "injectSeed).  Testing only.", str, internal=True)
 TEST_INJECT_SEED = _conf(
     "spark.rapids.tpu.test.injectSeed", 0,
     "Seed for the probabilistic fault-injection mode.", int,

@@ -44,6 +44,7 @@ class _ShuffleHandle:
         self.env = env
         self.cluster = cluster
         self._stats = None
+        self._stats_epoch = None
         self._released = False
 
     def route(self, p: int):
@@ -53,18 +54,31 @@ class _ShuffleHandle:
             return owner, self.cluster.peer_ids(owner.executor_id)
         return self.env, None
 
+    def map_epoch(self) -> int:
+        """Current lost-map-output epoch of whoever tracks this shuffle's
+        statistics; a bump since capture means a map output died and any
+        cached view is of a dead map stage."""
+        if self.cluster is not None:
+            return int(getattr(self.cluster, "map_epoch", 0))
+        return self.env.map_stats.epoch
+
     def stats(self):
         """Cluster-wide MapOutputStatistics of this shuffle, computed
         once and cached: the map side is immutable after materialize, and
         every rule reading the same handle would otherwise re-run the
-        per-executor aggregation sweep."""
-        if self._stats is None:
+        per-executor aggregation sweep.  The cache is EPOCH-GUARDED: a
+        map output declared lost (corruption / dead peer) bumps the
+        tracker epoch, and the next read re-aggregates instead of handing
+        AQE rules statistics from a dead map stage."""
+        epoch = self.map_epoch()
+        if self._stats is None or self._stats_epoch != epoch:
             if self.cluster is not None:
                 self._stats = self.cluster.map_output_stats(
                     self.sid, self.num_partitions)
             else:
                 self._stats = self.env.map_stats.stats(
                     self.sid, self.num_partitions)
+            self._stats_epoch = epoch
         return self._stats
 
     def fetch(self, p: int, map_range=None):

@@ -157,6 +157,7 @@ def write_leaves(path: str, leaves: List[np.ndarray]) -> int:
     contiguous native pwrite (native/src/host_runtime.cpp spill_write;
     python fallback without a toolchain)."""
     from ..native import spill_write
+    from ..utils import faults
     total = sum(a.nbytes for a in leaves)
     flat = np.empty(total, dtype=np.uint8)
     off = 0
@@ -164,6 +165,10 @@ def write_leaves(path: str, leaves: List[np.ndarray]) -> int:
         b = np.ascontiguousarray(a).view(np.uint8).reshape(-1)
         flat[off:off + b.nbytes] = b
         off += b.nbytes
+    # corruption injection point for the DISK tier: a bit flipped here
+    # lands in the file after the host-tier verify, so only the
+    # disk-read/unspill verification can catch it
+    faults.INJECTOR.on_corruptible("disk", flat)
     return spill_write(path, flat)
 
 

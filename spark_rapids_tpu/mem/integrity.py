@@ -1,0 +1,285 @@
+"""Data-integrity primitives: block checksums + typed corruption errors.
+
+TPU-native analogue of Spark's shuffle checksum support (SPARK-35275:
+per-partition checksums written next to shuffle blocks; SPARK-36206:
+on mismatch, re-hash at the writer to diagnose WHERE the corruption
+happened — disk/writer vs network vs reader).  Every host-side movement of
+columnar bytes — the shuffle wire (streamed, shm, loopback), the spill
+tiers (device->host->disk and back), and optionally local catalog reads —
+carries a per-leaf checksum established at the FIRST device->host
+materialization and verified before the bytes ever become a
+ColumnarBatch again.
+
+Algorithm selection (`spark.rapids.shuffle.checksum.algorithm`):
+
+  crc32c   hardware CRC32C via google_crc32c when importable (~10 GB/s,
+           fed read-only ndarray views so no staging copy); falls back to
+           xxhash's xxh3 and finally zlib.crc32 when the C library is
+           absent (the fallback is logged once — zlib.crc32 is ~1 GB/s
+           and may be visible on a fast wire)
+  xxhash   xxh3_64 (xxhash C module), ~8 GB/s
+  crc32    zlib.crc32
+  adler32  zlib.adler32 (~3 GB/s, weakest mixing)
+  none     disable checksumming entirely
+
+This module lives in mem/ (not shuffle/) because the spill stores verify
+through it too and mem must not import shuffle.
+"""
+from __future__ import annotations
+
+import logging
+import zlib
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger("spark_rapids_tpu.integrity")
+
+DEFAULT_ALGORITHM = "crc32c"
+
+
+# ---- typed errors -----------------------------------------------------------
+
+class CorruptBuffer(RuntimeError):
+    """Checksum mismatch on a spilled/stored buffer (host or disk tier).
+
+    Carries enough context for the journal/diagnosis paths: which buffer,
+    which leaf, where it was detected, and the two digests."""
+
+    def __init__(self, msg: str, *, buffer_id: Optional[int] = None,
+                 leaf: Optional[int] = None, site: str = "unknown",
+                 expected: Optional[int] = None,
+                 computed: Optional[int] = None):
+        super().__init__(msg)
+        self.buffer_id = buffer_id
+        self.leaf = leaf
+        self.site = site
+        self.expected = expected
+        self.computed = computed
+
+
+class CorruptShuffleBlock(CorruptBuffer):
+    """A fetched shuffle buffer failed verification at the reader.
+
+    Deliberately NOT an OSError: the transport's reconnect-retry loop must
+    not burn socket-retry attempts on it — the refetch/diagnosis ladder in
+    ShuffleEnv._fetch_remote owns the recovery (SPARK-36206 analogue)."""
+
+
+class BufferGone(RuntimeError):
+    """The peer reports the requested buffer no longer exists (its shuffle
+    was removed while the fetch was in flight).  A refetch cannot succeed;
+    the fetch path escalates straight to FetchFailed."""
+
+
+class FetchFailed(ConnectionError):
+    """A shuffle fetch failed unrecoverably: the peer is dead, the buffer
+    is gone, or its data is persistently corrupt (writer-side rot or
+    refetch attempts exhausted).  The map output must be treated as LOST
+    and the map fragment recomputed (Spark's FetchFailedException ->
+    resubmit-map-stage path; here ProcCluster._replace_worker/on_replace).
+
+    A ConnectionError subclass on purpose: it is raised ABOVE the
+    transport's socket-retry loop (which already exhausted itself), and
+    callers that treat a dead peer as a connection failure keep working —
+    but it now carries the peer/shuffle/classification the driver's
+    recovery needs.  repr() carries a machine-parseable `peer=` marker
+    because the control RPC flattens exceptions to strings on the way
+    back to the driver."""
+
+    def __init__(self, msg: str, *, peer: Optional[str] = None,
+                 shuffle_id: Optional[int] = None,
+                 reduce_id: Optional[int] = None,
+                 classification: str = "unknown"):
+        super().__init__(msg)
+        self.peer = peer
+        self.shuffle_id = shuffle_id
+        self.reduce_id = reduce_id
+        self.classification = classification
+
+    def __repr__(self):
+        return (f"FetchFailed(peer={self.peer!r}, "
+                f"shuffle={self.shuffle_id}, reduce={self.reduce_id}, "
+                f"classification={self.classification!r}, "
+                f"msg={str(self)!r})")
+
+
+# ---- hashing backends -------------------------------------------------------
+
+def _ro_u8(a: np.ndarray) -> np.ndarray:
+    """Flat read-only uint8 alias of an array (no copy when contiguous).
+    Read-only matters: google_crc32c's C entry point refuses writable
+    buffers, and a frozen view is free."""
+    flat = np.ascontiguousarray(a).view(np.uint8).reshape(-1)
+    ro = flat.view()
+    ro.setflags(write=False)
+    return ro
+
+
+class StreamHasher:
+    """Incremental digest over chunk arrivals; digest() must equal the
+    one-shot hash of the concatenated bytes (wire verification hashes
+    each chunk as it lands, overlapped with the next recv)."""
+
+    __slots__ = ("_update", "_digest")
+
+    def __init__(self, update: Callable, digest: Callable):
+        self._update = update
+        self._digest = digest
+
+    def update(self, a: np.ndarray) -> None:
+        self._update(_ro_u8(a))
+
+    def digest(self) -> int:
+        return self._digest()
+
+
+def _make_crc32c() -> Optional[Tuple[Callable, Callable]]:
+    try:
+        import google_crc32c
+        if google_crc32c.implementation != "c":
+            # the pure-python table fallback is ~MB/s — worse than zlib
+            return None
+
+        def crc32c(a: np.ndarray) -> int:
+            return int(google_crc32c.value(_ro_u8(a)))
+
+        def crc32c_stream() -> StreamHasher:
+            state = [0]
+
+            def update(u8):
+                state[0] = google_crc32c.extend(state[0], u8)
+            return StreamHasher(update, lambda: int(state[0]))
+        return crc32c, crc32c_stream
+    except ImportError:
+        return None
+
+
+def _make_xxhash() -> Optional[Tuple[Callable, Callable]]:
+    try:
+        import xxhash
+
+        def xxh3(a: np.ndarray) -> int:
+            return int(xxhash.xxh3_64_intdigest(_ro_u8(a)))
+
+        def xxh3_stream() -> StreamHasher:
+            h = xxhash.xxh3_64()
+            return StreamHasher(h.update, lambda: int(h.intdigest()))
+        return xxh3, xxh3_stream
+    except ImportError:
+        return None
+
+
+def _zlib_fns(fn) -> Tuple[Callable, Callable]:
+    def digest(a: np.ndarray) -> int:
+        return int(fn(memoryview(_ro_u8(a))) & 0xFFFFFFFF)
+
+    def stream() -> StreamHasher:
+        state = [0 if fn is zlib.crc32 else 1]
+
+        def update(u8):
+            state[0] = fn(memoryview(u8), state[0])
+        return StreamHasher(update,
+                            lambda: int(state[0] & 0xFFFFFFFF))
+    return digest, stream
+
+
+_FALLBACK_WARNED = set()
+
+
+def resolve_hasher(algorithm: str
+                   ) -> Tuple[str, Optional[Callable], Optional[Callable]]:
+    """(effective_name, fn(ndarray) -> int, stream_factory) for a conf
+    algorithm name; (name, None, None) for 'none'.  Unknown names raise
+    ValueError so a typo'd conf fails loudly instead of silently
+    disabling integrity."""
+    algo = (algorithm or "").strip().lower()
+    if algo in ("none", "off", ""):
+        return "none", None, None
+    if algo == "crc32c":
+        fns = _make_crc32c()
+        if fns is not None:
+            return ("crc32c",) + fns
+        fns = _make_xxhash()
+        eff = ("xxhash",) + fns if fns is not None \
+            else ("crc32",) + _zlib_fns(zlib.crc32)
+        if algo not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(algo)
+            log.warning("crc32c library unavailable; falling back to %s "
+                        "for shuffle/spill checksums", eff[0])
+        return eff
+    if algo == "xxhash":
+        fns = _make_xxhash()
+        if fns is not None:
+            return ("xxhash",) + fns
+        if algo not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(algo)
+            log.warning("xxhash unavailable; falling back to crc32")
+        return ("crc32",) + _zlib_fns(zlib.crc32)
+    if algo == "crc32":
+        return ("crc32",) + _zlib_fns(zlib.crc32)
+    if algo == "adler32":
+        return ("adler32",) + _zlib_fns(zlib.adler32)
+    raise ValueError(f"unknown checksum algorithm {algorithm!r} "
+                     "(crc32c|xxhash|crc32|adler32|none)")
+
+
+class ChecksumPolicy:
+    """Resolved integrity configuration one subsystem carries around:
+    the effective algorithm + hasher, shared by the shuffle env, the
+    spill stores, and the transport clients."""
+
+    __slots__ = ("enabled", "algorithm", "_fn", "_stream", "metrics")
+
+    def __init__(self, enabled: bool = True,
+                 algorithm: str = DEFAULT_ALGORITHM, metrics=None):
+        self.algorithm, self._fn, self._stream = resolve_hasher(
+            algorithm if enabled else "none")
+        self.enabled = enabled and self._fn is not None
+        self.metrics = metrics  # runtime-level Metrics (checksumTime)
+
+    def checksum_leaves(self, leaves: Sequence[np.ndarray]) -> List[int]:
+        assert self._fn is not None
+        if self.metrics is not None:
+            from ..metrics import names as MN
+            with self.metrics.timer(MN.CHECKSUM_TIME):
+                return [self._fn(a) for a in leaves]
+        return [self._fn(a) for a in leaves]
+
+    def checksum_one(self, a: np.ndarray) -> int:
+        assert self._fn is not None
+        return self._fn(a)
+
+    def hasher(self) -> StreamHasher:
+        """Fresh incremental hasher whose digest over sequential chunks
+        equals checksum_one over the whole buffer."""
+        assert self._stream is not None
+        return self._stream()
+
+    def verify_leaves(self, leaves: Sequence[np.ndarray],
+                      expected: Sequence[int]) -> Optional[Tuple[int, int, int]]:
+        """First mismatch as (leaf_index, expected, computed), or None
+        when every leaf matches."""
+        if self.metrics is not None:
+            from ..metrics import names as MN
+            with self.metrics.timer(MN.CHECKSUM_TIME):
+                return self._verify(leaves, expected)
+        return self._verify(leaves, expected)
+
+    def _verify(self, leaves, expected):
+        assert self._fn is not None
+        for i, (a, want) in enumerate(zip(leaves, expected)):
+            got = self._fn(a)
+            if got != int(want):
+                return i, int(want), got
+        return None
+
+
+def policy_from_conf(conf, metrics=None,
+                     enabled_entry=None, algo_entry=None) -> ChecksumPolicy:
+    """Build a ChecksumPolicy from a TpuConf (shuffle or spill flavor)."""
+    from .. import config as C
+    enabled_entry = enabled_entry or C.SHUFFLE_CHECKSUM_ENABLED
+    algo_entry = algo_entry or C.SHUFFLE_CHECKSUM_ALGO
+    return ChecksumPolicy(bool(conf.get(enabled_entry)),
+                          str(conf.get(algo_entry)), metrics=metrics)

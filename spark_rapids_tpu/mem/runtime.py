@@ -96,6 +96,15 @@ class TpuRuntime:
         from ..exec.base import Metrics
         self.metrics = Metrics()
         self.catalog = BufferCatalog()
+        # spill-path integrity: the stores digest host leaves at spill
+        # time and every later movement verifies through the catalog's
+        # policy (mem/integrity.py; conf spark.rapids.memory.spill.*)
+        from ..config import SHUFFLE_CHECKSUM_ALGO, SPILL_CHECKSUM_ENABLED
+        from .integrity import ChecksumPolicy
+        self.catalog.integrity = ChecksumPolicy(
+            bool(self.conf.get(SPILL_CHECKSUM_ENABLED)),
+            str(self.conf.get(SHUFFLE_CHECKSUM_ALGO)),
+            metrics=self.metrics)
         self.device_store = DeviceMemoryStore(self.catalog)
         self.host_store = HostMemoryStore(
             self.catalog, int(self.conf.get(HOST_SPILL_STORAGE_SIZE)))
@@ -178,20 +187,26 @@ class TpuRuntime:
         device tier so the HBM pool keeps accounting for exactly one copy
         (unlike the reference, which hands out an untracked transient device
         copy — RMM tracks that copy for it; our accounting pool must)."""
+        from .stores import verify_buffer_leaves
         with buf.lock:
             if buf.tier == StorageTier.DEVICE:
                 return buf.device_batch
             if buf.tier == StorageTier.HOST:
                 leaves, src = buf.host_leaves, self.host_store
+                verify_buffer_leaves(self.catalog, buf, leaves,
+                                     site="unspill_host")
             else:
                 leaves, src = read_leaves(buf.disk_path, buf.meta), \
                     self.disk_store
+                verify_buffer_leaves(self.catalog, buf, leaves,
+                                     site="unspill_disk")
             self.reserve(buf.size_bytes, site="materialize")
             batch = host_to_batch(leaves, buf.meta)
             src.untrack(buf)
             if buf.disk_path:
                 self.disk_store.delete_file(buf)
             buf.host_leaves = None
+            buf.host_checksums = None  # stale once the device copy is live
             buf.device_batch = batch
             self.device_store.track(buf)
             return batch

@@ -23,10 +23,39 @@ import threading
 from typing import Dict, List, Optional
 
 from ..columnar import ColumnarBatch
+from ..utils import faults
 from .buffer import (BatchMeta, SpillPriorities, StorageTier, batch_to_host,
                      fresh_buffer_id, host_leaves_nbytes, host_to_batch,
                      read_leaves, write_leaves)
+from .integrity import CorruptBuffer
 from .priority_queue import HashedPriorityQueue
+
+
+def verify_buffer_leaves(catalog: "BufferCatalog", buf: "SpillableBuffer",
+                         leaves, site: str) -> None:
+    """Verify a buffer's host-form leaves against the checksums recorded
+    at spill time; raises a typed CorruptBuffer (journaled + counted) on
+    the first mismatching leaf.  No-op when the catalog carries no
+    integrity policy or the buffer was never checksummed."""
+    policy = getattr(catalog, "integrity", None)
+    if policy is None or not policy.enabled or buf.host_checksums is None:
+        return
+    bad = policy.verify_leaves(leaves, buf.host_checksums)
+    if bad is None:
+        return
+    leaf, want, got = bad
+    if policy.metrics is not None:
+        from ..metrics import names as MN
+        policy.metrics.add(MN.NUM_CHECKSUM_MISMATCHES, 1)
+    from ..metrics.journal import journal_event
+    journal_event("corruption", "spillChecksumMismatch", buffer=buf.id,
+                  leaf=leaf, site=site, algorithm=policy.algorithm,
+                  expected=want, computed=got)
+    raise CorruptBuffer(
+        f"buffer {buf.id} leaf {leaf} failed {policy.algorithm} "
+        f"verification at {site}: expected {want:#x}, computed {got:#x}",
+        buffer_id=buf.id, leaf=leaf, site=site, expected=want,
+        computed=got)
 
 
 class SpillableBuffer:
@@ -52,6 +81,10 @@ class SpillableBuffer:
         self.device_batch: Optional[ColumnarBatch] = None
         self.host_leaves = None
         self.disk_path: Optional[str] = None
+        # per-leaf digests recorded at device->host spill time; verified
+        # on every later movement of the host/disk form (stores.py
+        # verify_buffer_leaves) and cleared on re-promotion to device
+        self.host_checksums = None
 
     @property
     def size_bytes(self) -> int:
@@ -193,6 +226,17 @@ class DeviceMemoryStore(BufferStore):
         meta.size_bytes = host_leaves_nbytes(leaves)
         buf.meta = meta
         buf.host_leaves = leaves
+        policy = getattr(self.catalog, "integrity", None)
+        if policy is not None and policy.enabled:
+            # digest the host form the moment it exists: everything the
+            # bytes do from here (host tier, disk file, unspill, being
+            # served over the shuffle wire) verifies against this record
+            buf.host_checksums = policy.checksum_leaves(leaves)
+        if leaves and faults.INJECTOR.on_corruptible("spill"):
+            # injected SPILL-path corruption (after the digest: models
+            # host-memory rot between spill and unspill); the leaves are
+            # read-only device_get views, so the flip is a copy-swap
+            leaves[0] = faults.flip_bit(leaves[0])
         buf.device_batch = None  # drop the jnp refs -> XLA can reuse HBM
 
 
@@ -216,6 +260,11 @@ class HostMemoryStore(BufferStore):
     def _release_payload_to(self, buf: SpillableBuffer,
                             dest: BufferStore) -> None:
         assert isinstance(dest, DiskStore)
+        # catch host-tier rot BEFORE it is persisted as ground truth: a
+        # corrupted leaf written to disk would verify "clean" against a
+        # re-read of the same corrupted bytes
+        verify_buffer_leaves(self.catalog, buf, buf.host_leaves,
+                             site="host_to_disk")
         path = dest.path_for(buf.id)
         write_leaves(path, buf.host_leaves)
         buf.disk_path = path
@@ -248,6 +297,10 @@ class DiskStore(BufferStore):
 class BufferCatalog:
     """id -> buffer registry with ref-counted acquire
     (RapidsBufferCatalog.scala:30-52)."""
+
+    # spill-path ChecksumPolicy (mem/integrity.py), installed by
+    # TpuRuntime; None = no spill checksumming (bare-store unit tests)
+    integrity = None
 
     def __init__(self):
         self._buffers: Dict[int, SpillableBuffer] = {}

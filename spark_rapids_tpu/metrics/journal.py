@@ -34,7 +34,12 @@ import time
 from typing import Dict, List, Optional
 
 EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
-               "metric", "fallback", "replan")
+               "metric", "fallback", "replan",
+               # data-integrity recovery ladder (docs/tuning-guide.md):
+               # corruption = a checksum mismatch (with its writer-side
+               # classification), refetch = a transient-corruption retry,
+               # recompute = a lost map output being rebuilt from lineage
+               "corruption", "refetch", "recompute")
 
 
 class EventJournal:

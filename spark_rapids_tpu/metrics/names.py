@@ -170,6 +170,23 @@ PEAK_DEV_MEMORY = register_metric(
     "peakDevMemory", GAUGE, DEBUG,
     "high-water mark of accounted device-store bytes sampled per batch")
 
+# --- data integrity (mem/integrity.py + shuffle fetch/spill verify) ---------
+NUM_CHECKSUM_MISMATCHES = register_metric(
+    "numChecksumMismatches", COUNTER, ESSENTIAL,
+    "buffer leaves whose checksum verification failed (wire fetch, "
+    "spill/unspill, disk read, or verified local read)")
+NUM_CORRUPTION_REFETCHES = register_metric(
+    "numCorruptionRefetches", COUNTER, ESSENTIAL,
+    "shuffle buffer refetches issued after a checksum mismatch "
+    "classified as transient (wire/reader-side corruption)")
+NUM_LOST_MAP_OUTPUTS = register_metric(
+    "numLostMapOutputs", COUNTER, ESSENTIAL,
+    "map outputs declared lost after persistent corruption, a vanished "
+    "buffer, or a dead peer (FetchFailed -> map-fragment recompute)")
+CHECKSUM_TIME = register_metric(
+    "checksumTime", TIMER, MODERATE,
+    "time spent computing and verifying shuffle/spill checksums")
+
 # --- adaptive query execution (adaptive/) -----------------------------------
 NUM_COALESCED_PARTITIONS = register_metric(
     "numCoalescedPartitions", COUNTER, ESSENTIAL,
@@ -221,6 +238,15 @@ TRANSPORT_COUNTERS = {
     "rpc_errors": "control-plane RPC failures",
     "shm_fills": "local-partition reads served via shared memory",
     "shm_unavailable": "shared-memory reads that fell back to the stream",
+    "peer_publish_failures":
+        "set_peers broadcasts a worker failed to acknowledge (a survivor "
+        "that never learned a replacement's address)",
+    "buffer_gone": "typed buffer-gone frames served for fetches that "
+                   "raced a shuffle removal",
+    "checksum_mismatches": "fetched buffers whose checksum verification "
+                           "failed at this transport's clients",
+    "corruption_diagnoses": "writer-side re-hash diagnosis round trips "
+                            "served after a reader checksum mismatch",
 }
 
 # --- runtime pool gauges (mem/runtime.py pool_stats()) ----------------------

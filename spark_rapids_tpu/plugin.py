@@ -106,6 +106,13 @@ class TpuCluster:
         return [e.executor_id for e in self.executors
                 if e.executor_id != excluding]
 
+    @property
+    def map_epoch(self) -> int:
+        """Cluster lost-map-output epoch: any executor marking map output
+        lost bumps its tracker epoch, and the sum invalidates every
+        cached MapOutputStatistics view (exec/exchange._ShuffleHandle)."""
+        return sum(e.env.map_stats.epoch for e in self.executors)
+
     def map_output_stats(self, sid: int, num_partitions: int):
         """Cluster-wide MapOutputStatistics for one shuffle: every
         executor's tracker snapshot merged (the MapOutputTrackerMaster

@@ -6,6 +6,8 @@ columnar shuffle + RapidsShuffleManager with UCX transport become a
 spillable device-resident block store with a loopback wire for host-driven
 mode and XLA all_to_all over ICI for SPMD mesh mode.
 """
+from ..mem.integrity import (BufferGone, ChecksumPolicy, CorruptBuffer,
+                             CorruptShuffleBlock, FetchFailed)
 from .catalog import (ShuffleBlockId, ShuffleBufferCatalog,
                       ShuffleReceivedBufferCatalog)
 from .manager import ShuffleEnv, ShuffleServer, get_shuffle_env
@@ -24,4 +26,6 @@ __all__ = [
     "BounceBufferPool", "InflightThrottle", "LoopbackTransport",
     "MetadataRequest", "MetadataResponse", "ShuffleTransport",
     "Transaction", "TransactionStatus",
+    "BufferGone", "ChecksumPolicy", "CorruptBuffer", "CorruptShuffleBlock",
+    "FetchFailed",
 ]

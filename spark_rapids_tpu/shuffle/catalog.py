@@ -7,12 +7,16 @@ ShuffleReceivedBufferCatalog.scala): the writer side maps each
 batches; the reader side registers buffers received from peers.  Both sit on
 top of the mem.BufferCatalog, so shuffle data participates in
 device->host->disk spill like everything else.
+The writer-side catalog also records each buffer's per-leaf checksums
+(established at its first device->host materialization), the canonical
+digests the fetch paths verify against and the corruption-diagnosis RPC
+re-hashes the writer's live data against (SPARK-35275/36206 analogue).
 """
 from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 @dataclass(frozen=True, order=True)
@@ -28,6 +32,11 @@ class ShuffleBufferCatalog:
     def __init__(self):
         self._blocks: Dict[ShuffleBlockId, List[int]] = {}
         self._by_shuffle: Dict[int, List[ShuffleBlockId]] = {}
+        # buffer id -> (algorithm, per-leaf digests); populated at the
+        # buffer's first host materialization (baseline write, spill, or
+        # first serve) and dropped with the shuffle
+        self._checksums: Dict[int, Tuple[str, Tuple[int, ...]]] = {}
+        self._block_of: Dict[int, ShuffleBlockId] = {}
         self._lock = threading.Lock()
 
     def add_buffer(self, block: ShuffleBlockId, buffer_id: int) -> None:
@@ -36,6 +45,14 @@ class ShuffleBufferCatalog:
                 self._blocks[block] = []
                 self._by_shuffle.setdefault(block.shuffle_id, []).append(block)
             self._blocks[block].append(buffer_id)
+            self._block_of[buffer_id] = block
+
+    def block_for_buffer(self, buffer_id: int) -> Optional[ShuffleBlockId]:
+        """Reverse lookup: which block a buffer belongs to (the serve
+        path uses it to mark the right map output lost when a buffer's
+        stored bytes fail verification)."""
+        with self._lock:
+            return self._block_of.get(buffer_id)
 
     def buffers_for(self, block: ShuffleBlockId) -> List[int]:
         with self._lock:
@@ -47,6 +64,19 @@ class ShuffleBufferCatalog:
             return sorted(b for b in self._by_shuffle.get(shuffle_id, [])
                           if b.reduce_id == reduce_id)
 
+    def set_checksums(self, buffer_id: int, algorithm: str,
+                      leaf_sums) -> None:
+        with self._lock:
+            self._checksums[buffer_id] = (algorithm,
+                                          tuple(int(s) for s in leaf_sums))
+
+    def checksums_for(self, buffer_id: int
+                      ) -> Optional[Tuple[str, Tuple[int, ...]]]:
+        """(algorithm, per-leaf digests) or None when the buffer has not
+        been host-materialized yet (still HBM-resident, never served)."""
+        with self._lock:
+            return self._checksums.get(buffer_id)
+
     def remove_shuffle(self, shuffle_id: int) -> List[int]:
         """Unregister every block of a shuffle; returns the buffer ids to
         free."""
@@ -55,6 +85,9 @@ class ShuffleBufferCatalog:
             freed: List[int] = []
             for blk in blocks:
                 freed.extend(self._blocks.pop(blk, []))
+            for bid in freed:
+                self._checksums.pop(bid, None)
+                self._block_of.pop(bid, None)
             return freed
 
     def has_shuffle(self, shuffle_id: int) -> bool:

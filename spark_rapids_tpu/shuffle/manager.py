@@ -21,17 +21,27 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+import logging
+
 from ..columnar import ColumnarBatch
-from ..config import (PINNED_POOL_SIZE, SHUFFLE_DEVICE_RESIDENT,
-                      SHUFFLE_MAX_RECV_INFLIGHT, SHUFFLE_TRANSPORT_CLASS,
+from ..config import (PINNED_POOL_SIZE, SHUFFLE_CHECKSUM_VERIFY_LOCAL,
+                      SHUFFLE_DEVICE_RESIDENT, SHUFFLE_MAX_RECV_INFLIGHT,
+                      SHUFFLE_MAX_REFETCH, SHUFFLE_TRANSPORT_CLASS,
                       TpuConf)
 from ..mem.buffer import (SpillPriorities, StorageTier, batch_to_host,
                           host_to_batch, read_leaves)
+from ..mem.integrity import (BufferGone, CorruptBuffer, CorruptShuffleBlock,
+                             FetchFailed, policy_from_conf)
 from ..mem.runtime import TpuRuntime
+from ..mem.stores import verify_buffer_leaves
+from ..metrics import names as MN
+from ..utils import faults
 from .catalog import (ShuffleBlockId, ShuffleBufferCatalog,
                       ShuffleReceivedBufferCatalog)
 from .transport import (LoopbackTransport, MetadataRequest, MetadataResponse,
                         BlockMeta, ShuffleTransport)
+
+log = logging.getLogger("spark_rapids_tpu.shuffle")
 
 
 class ShuffleServer:
@@ -59,8 +69,9 @@ class ShuffleServer:
         out: List[BlockMeta] = []
         for block in blocks:
             buffer_ids = self.env.catalog.buffers_for(block)
-            metas, sizes = [], []
+            metas, sizes, sums = [], [], []
             for bid in buffer_ids:
+                sums.append(self.env.catalog.checksums_for(bid))
                 baseline = self.env.baseline_leaves(bid)
                 if baseline is not None:
                     metas.append(baseline[1])
@@ -72,16 +83,25 @@ class ShuffleServer:
                     sizes.append(buf.size_bytes)
                 finally:
                     self.env.runtime.catalog.release(buf)
-            out.append(BlockMeta(block, buffer_ids, metas, sizes))
+            out.append(BlockMeta(block, buffer_ids, metas, sizes,
+                                 checksums=sums))
         return MetadataResponse(out)
 
     def _leaves(self, buffer_id: int):
         """Host-side leaves of a buffer, whatever its tier (no promotion —
-        serving a spilled buffer must not re-inflate HBM)."""
+        serving a spilled buffer must not re-inflate HBM).
+
+        Integrity duties on the serve path: a spilled buffer's host/disk
+        form is verified against its spill-time digests before serving
+        (so the server never knowingly streams rotted bytes — the typed
+        corrupt frame tells the reader to recompute, not refetch), and the
+        buffer's canonical checksums are recorded in the writer catalog at
+        its FIRST host materialization."""
         with self._lock:
             hit = self._cache.get(buffer_id)
             if hit is not None:
                 return hit
+        buf = None
         baseline = self.env.baseline_leaves(buffer_id)
         if baseline is not None:
             leaves, meta = baseline
@@ -96,8 +116,41 @@ class ShuffleServer:
                     else:
                         leaves, meta = read_leaves(buf.disk_path, buf.meta), \
                             buf.meta
+                    if buf.tier != StorageTier.DEVICE:
+                        try:
+                            # raises a typed CorruptBuffer ->
+                            # OP_GONE(corrupt) at the socket server
+                            verify_buffer_leaves(self.env.runtime.catalog,
+                                                 buf, leaves, site="serve")
+                        except CorruptBuffer:
+                            # the OWNER just learned its own stored copy
+                            # rotted: drop that map output's statistics
+                            # (and bump the epoch) so AQE never re-plans
+                            # on sizes this buffer can no longer back
+                            blk = self.env.catalog.block_for_buffer(
+                                buffer_id)
+                            if blk is not None:
+                                self.env.map_stats.mark_lost(
+                                    blk.shuffle_id, blk.map_id)
+                            raise
             finally:
                 self.env.runtime.catalog.release(buf)
+        policy = self.env.integrity
+        if policy.enabled \
+                and self.env.catalog.checksums_for(buffer_id) is None:
+            if buf is not None and buf.host_checksums is not None:
+                sums = buf.host_checksums  # spill already digested them
+            else:
+                sums = policy.checksum_leaves(leaves)
+            self.env.catalog.set_checksums(buffer_id, policy.algorithm,
+                                           sums)
+        if leaves and faults.INJECTOR.on_corruptible("writer"):
+            # injected WRITER-side rot: the flip lands in the copy this
+            # server will keep serving, AFTER its digests were recorded —
+            # refetches keep failing until the reader escalates to a map
+            # recompute.  Copy-swap: host leaves are read-only views.
+            leaves = list(leaves)
+            leaves[0] = faults.flip_bit(leaves[0])
         with self._lock:
             if len(self._cache) >= 4:  # bounded serving cache
                 self._cache.pop(next(iter(self._cache)))
@@ -109,6 +162,39 @@ class ShuffleServer:
         layout = [(a.shape, a.dtype.str, a.nbytes) for a in leaves]
         return layout, meta
 
+    def buffer_checksums(self, buffer_id: int):
+        """(algorithm, per-leaf digests) for a served buffer; populated by
+        the _leaves call every layout request makes first."""
+        return self.env.catalog.checksums_for(buffer_id)
+
+    def diagnose_buffer(self, buffer_id: int):
+        """Writer-side half of the corruption-site diagnosis
+        (SPARK-36206): re-hash the LIVE copy a refetch would serve and
+        compare with the recorded digests.  writer_ok=False means the
+        writer's own data rotted — the reader must recompute the map
+        fragment, not refetch."""
+        policy = self.env.integrity
+        rec = self.env.catalog.checksums_for(buffer_id)
+        if not policy.enabled or rec is None:
+            return None
+        algo, recorded = rec
+        if algo != policy.algorithm:
+            return None
+        try:
+            leaves, _meta = self._leaves(buffer_id)
+        except CorruptBuffer:
+            # the serve-time verify itself tripped while re-reading the
+            # buffer: the writer's stored copy is rotted, full stop
+            return {"algorithm": algo,
+                    "recorded": [int(s) for s in recorded],
+                    "recomputed": None, "writer_ok": False}
+        recomputed = policy.checksum_leaves(leaves)
+        return {"algorithm": algo,
+                "recorded": [int(s) for s in recorded],
+                "recomputed": [int(s) for s in recomputed],
+                "writer_ok": [int(s) for s in recomputed]
+                             == [int(s) for s in recorded]}
+
     def copy_leaf_chunk(self, buffer_id: int, leaf_idx: int, offset: int,
                         length: int, dest: np.ndarray) -> None:
         leaves, _ = self._leaves(buffer_id)
@@ -118,6 +204,15 @@ class ShuffleServer:
     def done_serving(self, buffer_id: int) -> None:
         with self._lock:
             self._cache.pop(buffer_id, None)
+
+    def invalidate(self, buffer_ids) -> None:
+        """Drop serving-cache entries for removed buffers: a fetch racing
+        `remove_shuffle` must hit the catalog (and get the typed
+        buffer-gone error), not a stale cache copy that silently outlives
+        the shuffle."""
+        with self._lock:
+            for bid in buffer_ids:
+                self._cache.pop(bid, None)
 
 
 class ShuffleEnv:
@@ -132,6 +227,14 @@ class ShuffleEnv:
         self.device_resident = bool(self.conf.get(SHUFFLE_DEVICE_RESIDENT))
         self.catalog = ShuffleBufferCatalog()
         self.received = ShuffleReceivedBufferCatalog()
+        # end-to-end integrity policy (mem/integrity.py): write paths
+        # digest, every fetch/serve path verifies, mismatches run the
+        # refetch/diagnose/recompute ladder in _fetch_remote
+        self.integrity = policy_from_conf(self.conf,
+                                          metrics=runtime.metrics)
+        self.max_refetch = max(0, int(self.conf.get(SHUFFLE_MAX_REFETCH)))
+        self.verify_local = bool(
+            self.conf.get(SHUFFLE_CHECKSUM_VERIFY_LOCAL))
         # observed per-reduce-partition output sizes, recorded at write
         # time — what adaptive re-planning (adaptive/) runs on
         from ..adaptive.stats import MapOutputTracker
@@ -192,7 +295,13 @@ class ShuffleEnv:
         # session would otherwise accumulate stats for every query it
         # ever ran (regression-tested in tests/test_adaptive.py)
         self.map_stats.remove_shuffle(shuffle_id)
-        for bid in self.catalog.remove_shuffle(shuffle_id):
+        freed = self.catalog.remove_shuffle(shuffle_id)
+        # evict serving-cache copies FIRST: a peer mid-stream on this
+        # shuffle must fall through to the catalog and get the typed
+        # buffer-gone frame, not keep streaming from a cache entry that
+        # outlives the shuffle
+        self.server.invalidate(freed)
+        for bid in freed:
             with self._lock:
                 if self._baseline_buffers.pop(bid, None) is not None:
                     continue
@@ -231,6 +340,14 @@ class ShuffleEnv:
             with self._lock:
                 self._baseline_buffers[bid] = (leaves, meta)
             self.catalog.add_buffer(block, bid)
+            if self.integrity.enabled:
+                # host-serialized path: the host form exists right now,
+                # so the per-block digest is established at WRITE time
+                # (the device-resident path digests at first host
+                # materialization instead — spill or first serve)
+                self.catalog.set_checksums(
+                    bid, self.integrity.algorithm,
+                    self.integrity.checksum_leaves(leaves))
         self.map_stats.record(shuffle_id, map_id, reduce_id, nbytes, nrows)
 
     # ---- read path (RapidsCachingReader.read) ------------------------------
@@ -256,14 +373,42 @@ class ShuffleEnv:
                 baseline = self.baseline_leaves(bid)
                 if baseline is not None:
                     leaves, meta = baseline
+                    if self.verify_local:
+                        self._verify_local_read(bid, leaves)
                     self.runtime.reserve(meta.size_bytes,
                                          site="fetch_baseline")
                     yield host_to_batch(leaves, meta)
                 else:
+                    # spilled tiers verify inside the runtime's
+                    # materialize path (mem/runtime.py) under the spill
+                    # policy; device-resident batches never left HBM
                     yield self.runtime.get_batch(bid)
         for peer in remote_peers or []:
             yield from self._fetch_remote(peer, shuffle_id, reduce_id,
                                           map_range)
+
+    def _verify_local_read(self, bid: int, leaves) -> None:
+        """verifyOnLocalRead: check a local baseline buffer against its
+        write-time digest (a local read never crossed a wire, so a
+        mismatch is this executor's own memory — classified `reader`)."""
+        from ..metrics.journal import journal_event
+        rec = self.catalog.checksums_for(bid)
+        if not self.integrity.enabled or rec is None \
+                or rec[0] != self.integrity.algorithm:
+            return
+        bad = self.integrity.verify_leaves(leaves, rec[1])
+        if bad is None:
+            return
+        leaf, want, got = bad
+        self.runtime.metrics.add(MN.NUM_CHECKSUM_MISMATCHES, 1)
+        journal_event("corruption", "localReadMismatch", buffer=bid,
+                      leaf=leaf, classification="reader",
+                      expected=want, computed=got)
+        raise CorruptShuffleBlock(
+            f"local read of buffer {bid} leaf {leaf} failed "
+            f"{self.integrity.algorithm} verification",
+            buffer_id=bid, leaf=leaf, site="reader", expected=want,
+            computed=got)
 
     def fetch_partitions_async(self, shuffle_id: int, reduce_ids,
                                remote_peers: Optional[List[str]] = None):
@@ -283,19 +428,37 @@ class ShuffleEnv:
         """doFetch (RapidsShuffleClient.scala:350-770): wildcard metadata
         request discovers the peer's blocks for this reduce partition, then
         per-buffer receives register spillable buffers locally.  Everything
-        goes through the transport SPI — no peer-object introspection."""
+        goes through the transport SPI — no peer-object introspection.
+
+        Integrity escalation ladder (SPARK-35275/36206 analogue, see
+        docs/tuning-guide.md): a checksum mismatch runs the writer-side
+        diagnosis and, for transit corruption, refetches up to
+        `maxRefetchAttempts`; writer-side rot, a vanished buffer, or a
+        dead/exhausted peer raises a typed FetchFailed that marks the map
+        output lost so the cluster recomputes the fragment."""
         from ..metrics.journal import journal_event
-        client = self.transport.make_client(peer)
-        resp = client.fetch_metadata(MetadataRequest(
-            shuffle_id=shuffle_id, reduce_id=reduce_id,
-            map_lo=map_range[0] if map_range else None,
-            map_hi=map_range[1] if map_range else None))
+        try:
+            client = self.transport.make_client(peer)
+            resp = client.fetch_metadata(MetadataRequest(
+                shuffle_id=shuffle_id, reduce_id=reduce_id,
+                map_lo=map_range[0] if map_range else None,
+                map_hi=map_range[1] if map_range else None))
+        except (ConnectionError, OSError, KeyError) as e:
+            raise self._map_output_lost(peer, shuffle_id, reduce_id,
+                                        "peer", e)
         fetched_bytes = 0
         n_buffers = 0
         for bm in resp.block_metas:
             for bid in bm.buffer_ids:
-                leaves, meta = client.fetch_buffer(bid)
-                client.release_buffer(bid)
+                leaves, meta = self._fetch_buffer_verified(
+                    client, peer, shuffle_id, reduce_id, bid)
+                try:
+                    client.release_buffer(bid)
+                except (ConnectionError, OSError) as e:
+                    # the data already arrived verified; a failed release
+                    # only delays the peer's cache eviction
+                    log.info("release of buffer %d at %s failed: %r",
+                             bid, peer, e)
                 batch = host_to_batch(leaves, meta)
                 fetched_bytes += meta.size_bytes
                 n_buffers += 1
@@ -305,6 +468,83 @@ class ShuffleEnv:
         journal_event("fetch", "fetchRemote", peer=peer,
                       shuffle=shuffle_id, reduce=reduce_id,
                       buffers=n_buffers, bytes=fetched_bytes)
+
+    def _fetch_buffer_verified(self, client, peer: str, shuffle_id: int,
+                               reduce_id: int, bid: int):
+        """One buffer through the corruption-recovery ladder."""
+        from ..metrics.journal import journal_event
+        attempts = self.max_refetch + 1
+        for attempt in range(attempts):
+            try:
+                return client.fetch_buffer(bid)
+            except BufferGone as e:
+                raise self._map_output_lost(peer, shuffle_id, reduce_id,
+                                            "gone", e)
+            except CorruptShuffleBlock as e:
+                self.runtime.metrics.add(MN.NUM_CHECKSUM_MISMATCHES, 1)
+                classification = e.site if e.site in ("writer", "reader") \
+                    else self._diagnose(client, bid)
+                journal_event("corruption", "checksumMismatch", peer=peer,
+                              shuffle=shuffle_id, reduce=reduce_id,
+                              buffer=bid, leaf=e.leaf, path=e.site,
+                              classification=classification,
+                              expected=e.expected, computed=e.computed)
+                log.warning(
+                    "corrupt shuffle block from %s (buffer %d leaf %s, "
+                    "classified %s, attempt %d/%d): %s", peer, bid,
+                    e.leaf, classification, attempt + 1, attempts, e)
+                if classification == "writer" or attempt + 1 >= attempts:
+                    raise self._map_output_lost(peer, shuffle_id,
+                                                reduce_id, classification,
+                                                e)
+                self.runtime.metrics.add(MN.NUM_CORRUPTION_REFETCHES, 1)
+                journal_event("refetch", "corruptionRefetch", peer=peer,
+                              buffer=bid, attempt=attempt + 1,
+                              classification=classification)
+            except (ConnectionError, OSError) as e:
+                # the transport already burned its own socket retries; a
+                # peer that still cannot serve is as good as dead
+                raise self._map_output_lost(peer, shuffle_id, reduce_id,
+                                            "peer", e)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _diagnose(self, client, bid: int) -> str:
+        """Classify a reader-detected mismatch with the writer-side
+        re-hash: writer (its live data no longer matches its recorded
+        digest) vs wire (writer data fine -> corruption was in transit)."""
+        diag = getattr(client, "diagnose_buffer", None)
+        result = diag(bid) if diag is not None else None
+        if result is None:
+            return "wire"  # no writer evidence; transit is the default
+        return "wire" if result.get("writer_ok", True) else "writer"
+
+    def _map_output_lost(self, peer: str, shuffle_id: int, reduce_id: int,
+                         classification: str, cause) -> FetchFailed:
+        """Mark a peer's map output lost and build the typed FetchFailed:
+        bumps the tracker epoch so any AQE statistics captured from the
+        pre-loss map stage are invalidated (re-plan rules never act on a
+        dead map stage), counts numLostMapOutputs, and journals the
+        recompute trigger.
+
+        Epoch-only on THIS tracker by design: the lost records live in
+        the PEER's tracker, which the reader cannot reach through the
+        transport SPI — ProcCluster recovery replaces the peer process
+        (its tracker dies with it, so post-recompute re-aggregation is
+        clean), and an owner that detects its OWN rot drops the records
+        itself via `mark_lost` on the serve path (_leaves)."""
+        from ..metrics.journal import journal_event
+        self.runtime.metrics.add(MN.NUM_LOST_MAP_OUTPUTS, 1)
+        self.map_stats.bump_epoch()
+        journal_event("recompute", "mapOutputLost", peer=peer,
+                      shuffle=shuffle_id, reduce=reduce_id,
+                      classification=classification, cause=repr(cause))
+        log.error("map output lost: shuffle %d reduce %d at %s (%s): %r",
+                  shuffle_id, reduce_id, peer, classification, cause)
+        return FetchFailed(
+            f"shuffle {shuffle_id} reduce {reduce_id} fetch from {peer} "
+            f"failed unrecoverably ({classification}): {cause}",
+            peer=peer, shuffle_id=shuffle_id, reduce_id=reduce_id,
+            classification=classification)
 
 
 def get_shuffle_env(runtime: TpuRuntime, conf: TpuConf) -> ShuffleEnv:

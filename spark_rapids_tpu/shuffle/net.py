@@ -35,11 +35,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..mem.integrity import (BufferGone, CorruptBuffer, CorruptShuffleBlock)
 from ..utils import faults
-from .transport import (BounceBufferPool, InflightThrottle, MetadataRequest,
-                        MetadataResponse, ShuffleTransport,
-                        ShuffleTransportClient, Transaction,
-                        TransactionCancelled, TransactionStatus)
+from .transport import (AsyncLeafVerifier, BounceBufferPool, ChecksumPolicy,
+                        InflightThrottle, MetadataRequest, MetadataResponse,
+                        ShuffleTransport, ShuffleTransportClient, Transaction,
+                        TransactionCancelled, TransactionStatus,
+                        verify_fetched_leaf)
 
 log = logging.getLogger("spark_rapids_tpu.shuffle")
 
@@ -49,6 +51,15 @@ OP_LAYOUT, OP_LAYOUT_RESP = 3, 4
 OP_FETCH, OP_DATA, OP_END = 5, 6, 7
 OP_DONE, OP_ACK = 8, 9
 OP_FETCH_SHM = 10
+# typed "this buffer no longer exists / cannot be served" frame: legal at
+# any point a serving opcode's response or stream is expected, so a fetch
+# racing remove_shuffle gets a clean error instead of a hang or a
+# poisoned half-frame (payload: pickled {"reason": "gone"|"corrupt",
+# "msg": str})
+OP_GONE = 11
+# writer-side corruption diagnosis (SPARK-36206): re-hash the live buffer
+# against its recorded digests
+OP_DIAG, OP_DIAG_RESP = 12, 13
 # same-host segment path prefix; the server refuses to open anything else
 SHM_PREFIX = "/dev/shm/srtpu_shm_"
 OP_RPC, OP_RPC_RESP, OP_RPC_ERR = 20, 21, 22
@@ -83,14 +94,16 @@ def recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
 
 
 def recv_frame_into(sock: socket.socket, dest: np.ndarray, offset: int
-                    ) -> Tuple[int, int]:
+                    ) -> Tuple[int, int, Optional[bytes]]:
     """Receive one frame; DATA payload lands directly in dest[offset:].
-    Returns (opcode, payload_length)."""
+    Returns (opcode, payload_length, payload) — payload is None for DATA
+    frames (it went into dest) and the raw bytes otherwise (an OP_GONE
+    mid-stream carries its typed reason there)."""
     hdr = _recv_exact(sock, _HDR.size)
     length, op = _HDR.unpack(hdr)
     if op != OP_DATA:
-        payload = _recv_exact(sock, length) if length else b""
-        return op, len(payload)
+        payload = bytes(_recv_exact(sock, length)) if length else b""
+        return op, len(payload), payload
     view = memoryview(dest)[offset:offset + length]
     got = 0
     while got < length:
@@ -98,7 +111,22 @@ def recv_frame_into(sock: socket.socket, dest: np.ndarray, offset: int
         if r == 0:
             raise ConnectionError("peer closed mid-data")
         got += r
-    return op, length
+    return op, length, None
+
+
+def _raise_gone(payload: bytes, buffer_id: int) -> None:
+    """Decode an OP_GONE frame into its typed error."""
+    try:
+        rec = pickle.loads(payload) if payload else {}
+    except Exception:  # noqa: BLE001 — a garbled reason is still "gone"
+        rec = {}
+    reason = rec.get("reason", "gone")
+    msg = rec.get("msg", f"buffer {buffer_id} gone at the peer")
+    if reason == "corrupt":
+        # the PEER found its own stored copy failing verification while
+        # serving: writer-site corruption, refetching cannot help
+        raise CorruptShuffleBlock(msg, buffer_id=buffer_id, site="writer")
+    raise BufferGone(msg)
 
 
 class ShuffleSocketServer:
@@ -182,15 +210,23 @@ class ShuffleSocketServer:
                     send_frame(conn, OP_META_RESP, pickle.dumps(resp))
                 elif op == OP_LAYOUT:
                     (bid,) = struct.unpack(">Q", payload)
-                    layout, meta = self.server_obj.buffer_layout(bid)
+                    try:
+                        layout, meta = self.server_obj.buffer_layout(bid)
+                        sums = self._checksums_of(bid)
+                    except (KeyError, CorruptBuffer) as e:
+                        self._send_gone(conn, bid, e)
+                        continue
                     send_frame(conn, OP_LAYOUT_RESP,
-                               pickle.dumps((layout, meta)))
+                               pickle.dumps((layout, meta, sums)))
                 elif op == OP_FETCH:
                     (bid,) = struct.unpack(">Q", payload)
                     self._stream_buffer(conn, bid)
                 elif op == OP_FETCH_SHM:
                     bid, shm_name = pickle.loads(payload)
                     self._fill_shm(conn, bid, shm_name)
+                elif op == OP_DIAG:
+                    (bid,) = struct.unpack(">Q", payload)
+                    self._handle_diag(conn, bid)
                 elif op == OP_DONE:
                     (bid,) = struct.unpack(">Q", payload)
                     self.server_obj.done_serving(bid)
@@ -212,11 +248,53 @@ class ShuffleSocketServer:
             except OSError as e:
                 log.debug("closing connection from %s: %r", peer, e)
 
+    def _checksums_of(self, bid: int):
+        """The server's recorded (algorithm, per-leaf digests) for a
+        buffer, or None for servers without integrity support (the wire
+        benchmark's bare fixture)."""
+        get = getattr(self.server_obj, "buffer_checksums", None)
+        return get(bid) if get is not None else None
+
+    def _send_gone(self, conn: socket.socket, bid: int,
+                   err: Exception) -> None:
+        """Typed buffer-gone/corrupt frame for a serve that raced
+        remove_shuffle (or found its own copy corrupt at serve time)."""
+        reason = "corrupt" if isinstance(err, CorruptBuffer) else "gone"
+        self.transport.count("buffer_gone")
+        log.info("shuffle buffer %d unservable (%s): %r", bid, reason, err)
+        send_frame(conn, OP_GONE,
+                   pickle.dumps({"reason": reason,
+                                 "msg": f"buffer {bid}: {err}"}))
+
+    def _handle_diag(self, conn: socket.socket, bid: int) -> None:
+        diag = getattr(self.server_obj, "diagnose_buffer", None)
+        try:
+            result = diag(bid) if diag is not None else None
+        except KeyError:
+            result = None
+        except CorruptBuffer:
+            # re-hashing tripped the serve-time verify: conclusive
+            # writer-side evidence (and the connection must survive to
+            # carry the verdict — a crashed handler would misclassify
+            # this as a wire fault after client timeouts)
+            result = {"writer_ok": False}
+        self.transport.count("corruption_diagnoses")
+        send_frame(conn, OP_DIAG_RESP, pickle.dumps(result))
+
     def _stream_buffer(self, conn: socket.socket, bid: int) -> None:
         """Send every leaf of a buffer as bounce-buffer-sized DATA frames,
         in leaf order, then END (BufferSendState: acquire buffer from any
-        tier -> stage through send bounce buffers -> tagged sends)."""
-        layout, _meta = self.server_obj.buffer_layout(bid)
+        tier -> stage through send bounce buffers -> tagged sends).
+
+        A KeyError from the server object mid-stream (the buffer's shuffle
+        was removed while we were serving it) becomes a typed OP_GONE
+        frame — the client sees a clean `BufferGone` instead of a
+        half-frame crash or a hang."""
+        try:
+            layout, _meta = self.server_obj.buffer_layout(bid)
+        except (KeyError, CorruptBuffer) as e:
+            self._send_gone(conn, bid, e)
+            return
         pool = self.transport.pool
         chunk = self.transport.chunk_size
         for leaf_idx, (_shape, _dtype, nbytes) in enumerate(layout):
@@ -226,8 +304,16 @@ class ShuffleSocketServer:
                 addr = pool.acquire(length)
                 try:
                     view = pool.view(addr, length)
-                    self.server_obj.copy_leaf_chunk(bid, leaf_idx, off,
-                                                    length, view)
+                    try:
+                        self.server_obj.copy_leaf_chunk(bid, leaf_idx, off,
+                                                        length, view)
+                    except (KeyError, CorruptBuffer) as e:
+                        self._send_gone(conn, bid, e)
+                        return
+                    # corruption injection point: the staged chunk IS the
+                    # wire payload (anything flipped here crosses the
+                    # socket and must be caught by the reader's verify)
+                    faults.INJECTOR.on_corruptible("wire", view[:length])
                     send_frame(conn, OP_DATA, memoryview(view))
                 finally:
                     pool.release(addr)
@@ -259,14 +345,25 @@ class ShuffleSocketServer:
             send_frame(conn, OP_RPC_ERR, pickle.dumps(f"shm open: {e!r}"))
             return
         try:
-            layout, _meta = self.server_obj.buffer_layout(bid)
+            try:
+                layout, _meta = self.server_obj.buffer_layout(bid)
+            except (KeyError, CorruptBuffer) as e:
+                self._send_gone(conn, bid, e)
+                return
             off = 0
             for leaf_idx, (_shape, _dtype, nbytes) in enumerate(layout):
                 view = np.frombuffer(mm, np.uint8, count=nbytes,
                                      offset=off)
                 try:
-                    self.server_obj.copy_leaf_chunk(bid, leaf_idx, 0,
-                                                    nbytes, view)
+                    try:
+                        self.server_obj.copy_leaf_chunk(bid, leaf_idx, 0,
+                                                        nbytes, view)
+                    except (KeyError, CorruptBuffer) as e:
+                        self._send_gone(conn, bid, e)
+                        return
+                    # corruption injection point for the shared-memory
+                    # leaf fill (the same-host zero-copy "wire")
+                    faults.INJECTOR.on_corruptible("shm", view)
                 finally:
                     # the view exports the mmap; it must die before
                     # mm.close() (BufferError otherwise)
@@ -417,12 +514,15 @@ class SocketClient(ShuffleTransportClient):
             f"shuffle {label} to {self.addr} failed after "
             f"{attempts} attempts: {last!r}") from last
 
-    def _request(self, op: int, payload, expect: int) -> bytes:
+    def _request(self, op: int, payload, expect: int,
+                 buffer_id: int = -1) -> bytes:
         sock = self._conn()
         send_frame(sock, op, payload)
         got, resp = recv_frame(sock)
         if got == OP_RPC_ERR:
             raise RuntimeError(f"remote error: {pickle.loads(resp)}")
+        if got == OP_GONE:
+            _raise_gone(resp, buffer_id)
         if got != expect:
             raise ConnectionError(f"expected opcode {expect}, got {got}")
         return resp
@@ -435,7 +535,8 @@ class SocketClient(ShuffleTransportClient):
         self.transport.count("metadata_fetched")
         return pickle.loads(resp)
 
-    def _fetch_buffer_shm(self, layout, meta, buffer_id: int, total: int):
+    def _fetch_buffer_shm(self, layout, meta, buffer_id: int, total: int,
+                          sums=None):
         """Local-peer fetch through a client-owned /dev/shm segment: one
         server-side copy per leaf, no socket data frames.  Returns
         (leaves, meta) or None when shm is unavailable (caller streams)."""
@@ -459,7 +560,7 @@ class SocketClient(ShuffleTransportClient):
                     sock = self._conn()
                     send_frame(sock, OP_FETCH_SHM,
                                pickle.dumps((buffer_id, path)))
-                    op, _length = recv_frame(sock)
+                    op, resp = recv_frame(sock)
             except (TimeoutError, ConnectionError, OSError) as e:
                 # single attempt: the caller streams over the socket
                 # instead (which carries the full retry machinery)
@@ -469,6 +570,8 @@ class SocketClient(ShuffleTransportClient):
                 with self._lock:
                     self._drop_socket()
                 return None
+            if op == OP_GONE:
+                _raise_gone(resp, buffer_id)
             if op != OP_END:
                 return None
             # copy out of the segment: a zero-copy variant (arrays
@@ -477,7 +580,7 @@ class SocketClient(ShuffleTransportClient):
             # bounded memcpy per leaf is the honest cost
             out: List[np.ndarray] = []
             off = 0
-            for (shape, dtype_str, nbytes) in layout:
+            for leaf_idx, (shape, dtype_str, nbytes) in enumerate(layout):
                 a = np.empty(nbytes, dtype=np.uint8)
                 src = np.frombuffer(mm, np.uint8, count=nbytes,
                                     offset=off)
@@ -485,6 +588,12 @@ class SocketClient(ShuffleTransportClient):
                     a[:] = src
                 finally:
                     del src  # release the mmap export before mm.close()
+                if sums is not None:
+                    # a mismatch propagates to fetch_buffer's outer
+                    # handler (counted + socket dropped there)
+                    verify_fetched_leaf(self.transport.integrity, a,
+                                        sums[leaf_idx], buffer_id,
+                                        leaf_idx, "shm")
                 out.append(a.view(np.dtype(dtype_str)).reshape(shape))
                 off += nbytes
             self.transport.count("bytes_received", off)
@@ -505,61 +614,128 @@ class SocketClient(ShuffleTransportClient):
         txn = self.transport.next_txn()
         deadline = (time.monotonic() + self.transport.txn_timeout
                     if self.transport.txn_timeout > 0 else None)
-        resp = self._retrying(
-            "layout",
-            lambda _s: self._request(OP_LAYOUT,
-                                     struct.pack(">Q", buffer_id),
-                                     OP_LAYOUT_RESP),
-            deadline=deadline, txn=txn)
-        layout, meta = pickle.loads(resp)
-        total = sum(nb for _, _, nb in layout)
-        self.transport.throttle.acquire(total)
         try:
-            if self.addr[0] in ("127.0.0.1", "localhost", "::1") \
-                    and self.transport.shm_local:
-                got = self._fetch_buffer_shm(layout, meta, buffer_id,
-                                             total)
-                if got is not None:
-                    txn.complete(total)
-                    return got
+            resp = self._retrying(
+                "layout",
+                lambda _s: self._request(OP_LAYOUT,
+                                         struct.pack(">Q", buffer_id),
+                                         OP_LAYOUT_RESP, buffer_id),
+                deadline=deadline, txn=txn)
+            unpacked = pickle.loads(resp)
+            layout, meta = unpacked[0], unpacked[1]
+            # pre-integrity peers answer with a 2-tuple — no digests, no
+            # verification, same data plane
+            rec = unpacked[2] if len(unpacked) > 2 else None
+            policy = self.transport.integrity
+            sums = None
+            if policy is not None and policy.enabled and rec is not None \
+                    and rec[0] == policy.algorithm:
+                sums = rec[1]
+            total = sum(nb for _, _, nb in layout)
+            self.transport.throttle.acquire(total)
+            try:
+                if self.addr[0] in ("127.0.0.1", "localhost", "::1") \
+                        and self.transport.shm_local:
+                    got = self._fetch_buffer_shm(layout, meta, buffer_id,
+                                                 total, sums)
+                    if got is not None:
+                        txn.complete(total)
+                        return got
 
-            def stream(sock) -> List[np.ndarray]:
-                send_frame(sock, OP_FETCH, struct.pack(">Q", buffer_id))
-                out: List[np.ndarray] = []
-                for (shape, dtype_str, nbytes) in layout:
-                    dest = np.empty(nbytes, dtype=np.uint8)
-                    off = 0
-                    while off < nbytes:
-                        if deadline is not None \
-                                and time.monotonic() > deadline:
-                            raise txn.cancel(
-                                f"fetch of buffer {buffer_id} from "
-                                f"{self.addr} mid-stream at {off}/{nbytes}")
-                        op, length = recv_frame_into(sock, dest, off)
-                        if op != OP_DATA:
+                def stream(sock) -> List[np.ndarray]:
+                    send_frame(sock, OP_FETCH,
+                               struct.pack(">Q", buffer_id))
+                    out: List[np.ndarray] = []
+                    # chunk hashing rides a side thread, overlapped with
+                    # the recv loop (AsyncLeafVerifier) — verification
+                    # still completes BEFORE the bytes become a batch
+                    # (finish() below), it just never serializes behind
+                    # the wire
+                    verifier = (AsyncLeafVerifier(policy, sums, buffer_id,
+                                                  "wire")
+                                if sums is not None else None)
+                    try:
+                        for leaf_idx, (shape, dtype_str, nbytes) \
+                                in enumerate(layout):
+                            dest = np.empty(nbytes, dtype=np.uint8)
+                            off = 0
+                            while off < nbytes:
+                                if deadline is not None \
+                                        and time.monotonic() > deadline:
+                                    raise txn.cancel(
+                                        f"fetch of buffer {buffer_id} "
+                                        f"from {self.addr} mid-stream at "
+                                        f"{off}/{nbytes}")
+                                op, length, payload = recv_frame_into(
+                                    sock, dest, off)
+                                if op == OP_GONE:
+                                    _raise_gone(payload, buffer_id)
+                                if op != OP_DATA:
+                                    raise ConnectionError(
+                                        f"short buffer stream (op {op} "
+                                        f"at {off}/{nbytes})")
+                                if verifier is not None:
+                                    verifier.feed(leaf_idx,
+                                                  dest[off:off + length])
+                                off += length
+                                self.transport.count("bytes_received",
+                                                     length)
+                            if verifier is not None:
+                                verifier.leaf_done(leaf_idx, dest)
+                            out.append(dest.view(np.dtype(dtype_str))
+                                       .reshape(shape))
+                        op, _ = recv_frame(sock)
+                        if op != OP_END:
                             raise ConnectionError(
-                                f"short buffer stream (op {op} at "
-                                f"{off}/{nbytes})")
-                        off += length
-                        self.transport.count("bytes_received", length)
-                    out.append(dest.view(np.dtype(dtype_str)).reshape(shape))
-                op, _ = recv_frame(sock)
-                if op != OP_END:
-                    raise ConnectionError(f"expected END, got {op}")
-                return out
+                                f"expected END, got {op}")
+                        if verifier is not None:
+                            verifier.finish()  # raises on mismatch
+                            verifier = None
+                        return out
+                    finally:
+                        if verifier is not None:
+                            verifier.abort()
 
-            out = self._retrying("fetch", stream, deadline=deadline,
-                                 txn=txn)
-            txn.complete(total)
-            return out, meta
-        finally:
-            self.transport.throttle.release(total)
+                out = self._retrying("fetch", stream, deadline=deadline,
+                                     txn=txn)
+                txn.complete(total)
+                return out, meta
+            finally:
+                self.transport.throttle.release(total)
+        except CorruptShuffleBlock as e:
+            # remaining stream frames are unread: the socket is poisoned
+            # for any next request — tear it down before escalating to
+            # the refetch/diagnosis ladder (manager._fetch_remote)
+            self.transport.count("checksum_mismatches")
+            txn.fail(repr(e))
+            with self._lock:
+                self._drop_socket()
+            raise
+        except BufferGone as e:
+            txn.fail(repr(e))
+            raise
 
     def release_buffer(self, buffer_id: int) -> None:
         # done_serving is idempotent at the server, so the retry is safe
         self._retrying(
             "done", lambda _s: self._request(
                 OP_DONE, struct.pack(">Q", buffer_id), OP_ACK))
+
+    def diagnose_buffer(self, buffer_id: int):
+        """Writer-side corruption diagnosis (SPARK-36206): the peer
+        re-hashes its live copy against its recorded digests.  Returns the
+        diagnosis dict or None — never raises; a peer too broken to answer
+        is classified by the caller from the absence of evidence."""
+        try:
+            resp = self._retrying(
+                "diag", lambda _s: self._request(
+                    OP_DIAG, struct.pack(">Q", buffer_id), OP_DIAG_RESP,
+                    buffer_id))
+            return pickle.loads(resp)
+        except (ConnectionError, OSError, RuntimeError) as e:
+            log.warning("corruption diagnosis of buffer %d at %s "
+                        "unavailable: %r", buffer_id, self.addr, e)
+            return None
 
     def rpc(self, method: str, **kwargs):
         """Control-plane call (worker management; UCX mgmt-port analogue).
@@ -643,11 +819,16 @@ class SocketTransport(ShuffleTransport):
         self._lock = threading.Lock()
         self._txn_counter = 0
         self.counters: Dict[str, int] = {}
+        # end-to-end wire integrity (mem/integrity.py): the client
+        # verifies every received leaf against the digests the layout
+        # response carries; configure() adopts the session's conf
+        self.integrity = ChecksumPolicy()
 
     def configure(self, conf) -> None:
         """Adopt retry/deadline knobs from a TpuConf (and arm the fault
         injector from its test confs)."""
         from .. import config as C
+        from ..mem.integrity import policy_from_conf
         faults.INJECTOR.configure_from_conf(conf)
         self.connect_timeout = int(conf.get(C.SHUFFLE_CONNECT_TIMEOUT)) / 1e3
         self.io_timeout = int(conf.get(C.SHUFFLE_IO_TIMEOUT)) / 1e3
@@ -655,6 +836,7 @@ class SocketTransport(ShuffleTransport):
         self.backoff_base = int(conf.get(C.SHUFFLE_RETRY_BACKOFF_BASE)) / 1e3
         self.backoff_cap = int(conf.get(C.SHUFFLE_RETRY_BACKOFF_CAP)) / 1e3
         self.txn_timeout = int(conf.get(C.SHUFFLE_TXN_TIMEOUT)) / 1e3
+        self.integrity = policy_from_conf(conf)
 
     def next_txn(self) -> Transaction:
         with self._lock:

@@ -92,6 +92,12 @@ class WorkerHandler:
         self.peers = [p for p in peers if p != self.executor_id]
         return sorted(peers)
 
+    def rpc_get_peers(self):
+        """This worker's CURRENT peer address map — what its next remote
+        fetch will actually dial (test observability for the
+        replacement-republish path)."""
+        return {k: list(v) for k, v in self.transport._peers.items()}
+
     def rpc_run_map(self, sid: int, plan_blob: bytes,
                     key_names: List[str], n_parts: int):
         """Execute the fragment, hash-partition on the keys, write all

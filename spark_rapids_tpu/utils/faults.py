@@ -6,9 +6,11 @@ hooks, spark-rapids-jni RmmSpark.forceRetryOOM/forceSplitAndRetryOOM) plus
 a network-side twin for the shuffle wire.  Everything is conf-driven so
 tier-1 tests exercise every retry path on CPU with zero real pressure:
 
-  spark.rapids.tpu.test.injectOom       fail the Nth `reserve()` call
-  spark.rapids.tpu.test.injectNetFault  fail the Nth client socket op
-  spark.rapids.tpu.test.injectSeed      seed for the probabilistic mode
+  spark.rapids.tpu.test.injectOom         fail the Nth `reserve()` call
+  spark.rapids.tpu.test.injectNetFault    fail the Nth client socket op
+  spark.rapids.tpu.test.injectCorruption  flip a bit in the Nth
+                                          transferred chunk / spilled leaf
+  spark.rapids.tpu.test.injectSeed        seed for the probabilistic mode
 
 Spec grammar (comma-separated items, 1-based ordinals over the process-wide
 op counter of that category):
@@ -18,6 +20,16 @@ op counter of that category):
   "split@5"    fail op #5 with SplitAndRetryOOM (OOM category only)
   "p=0.05"     fail each op with probability 0.05, seeded by injectSeed
 
+The corruption category reads the @-prefix as a SITE instead of a kind:
+"wire@3" flips a bit in the 3rd corruptible op AT SITE `wire` (per-site
+ordinals, because the interesting question is always "the Nth chunk of
+THIS path"); a bare "3" counts across all sites.  Sites instrumented:
+wire (socket send staging), shm (shared-memory leaf fill), loopback
+(loopback bounce chunk), spill (device->host spill leaves), disk
+(host->disk flat image), writer (the shuffle server's served leaves —
+corrupting these after their checksum is recorded models writer-side rot
+that refetching can never fix).
+
 The injector is process-global, thread-safe, and counts every observed op
 per site label, so a test can run fault-free once to DISCOVER the reserve
 sites of a query and then replay with each ordinal forced to fail.
@@ -26,6 +38,7 @@ from __future__ import annotations
 
 import random
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 
@@ -70,46 +83,108 @@ class _Plan:
         return None
 
 
+class _CorruptPlan:
+    """Parsed corruption plan: @-prefixes are SITE names with per-site
+    ordinals ('wire@3' = 3rd corruptible op at site wire); bare ordinals
+    count across every site; 'p=' corrupts probabilistically."""
+
+    def __init__(self, spec: str = "", seed: int = 0):
+        self.spec = spec
+        self.global_ordinals: Dict[int, bool] = {}
+        self.site_ordinals: Dict[str, Dict[int, bool]] = {}
+        self.prob = 0.0
+        self.rng = random.Random(seed)
+        for raw in (spec or "").split(","):
+            item = raw.strip()
+            if not item:
+                continue
+            if item.startswith("p="):
+                self.prob = float(item[2:])
+                continue
+            site = None
+            if "@" in item:
+                site, item = item.split("@", 1)
+            if "x" in item:
+                start_s, rep_s = item.split("x", 1)
+                start, rep = int(start_s), int(rep_s)
+            else:
+                start, rep = int(item), 1
+            dest = (self.global_ordinals if site is None
+                    else self.site_ordinals.setdefault(site, {}))
+            for o in range(start, start + rep):
+                dest[o] = True
+
+    def check(self, n_global: int, site: str, n_site: int) -> bool:
+        if self.global_ordinals.get(n_global):
+            return True
+        if self.site_ordinals.get(site, {}).get(n_site):
+            return True
+        return self.prob > 0 and self.rng.random() < self.prob
+
+
+#: hard cap on the injected-events log: probabilistic specs on long runs
+#: would otherwise append one tuple per injected fault forever (a real
+#: leak in exactly the soak-test regime that uses p= specs); overflow is
+#: counted in `injected_log_dropped` instead of silently truncated
+INJECTED_LOG_CAP = 4096
+
+
 class FaultInjector:
     """Process-global deterministic fault source (thread-safe)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._configured: Optional[Tuple[str, str, int]] = None
+        self._configured: Optional[Tuple[str, str, str, int]] = None
         self.reset()
 
     def reset(self) -> None:
         with self._lock:
             self._oom = _Plan()
             self._net = _Plan()
+            self._corrupt = _CorruptPlan()
             self._oom_count = 0
             self._net_count = 0
+            self._corrupt_count = 0
             self._configured = None
             self.site_counts: Dict[str, int] = {}
-            self.injected_log: List[Tuple[str, int, str]] = []
+            self.injected_log: "deque" = deque(maxlen=INJECTED_LOG_CAP)
+            self.injected_log_dropped = 0
+
+    def _log_injected(self, rec: Tuple[str, int, str]) -> None:
+        # caller holds self._lock; the deque evicts the OLDEST entry at
+        # cap (recent faults matter most for post-mortems) and the drop
+        # counter keeps the loss visible
+        if len(self.injected_log) >= INJECTED_LOG_CAP:
+            self.injected_log_dropped += 1
+        self.injected_log.append(rec)
 
     def configure(self, oom_spec: str = "", net_spec: str = "",
-                  seed: int = 0) -> None:
+                  seed: int = 0, corrupt_spec: str = "") -> None:
         """(Re)arm the injector.  Counters reset only when the spec actually
         changes, so every runtime/transport bring-up in one query can call
         this without restarting the op count mid-flight."""
-        key = (oom_spec or "", net_spec or "", int(seed))
+        key = (oom_spec or "", net_spec or "", corrupt_spec or "",
+               int(seed))
         with self._lock:
             if self._configured == key:
                 return
             self._configured = key
-            self._oom = _Plan(key[0], seed=key[2])
-            self._net = _Plan(key[1], seed=key[2] + 1)
+            self._oom = _Plan(key[0], seed=key[3])
+            self._net = _Plan(key[1], seed=key[3] + 1)
+            self._corrupt = _CorruptPlan(key[2], seed=key[3] + 2)
             self._oom_count = 0
             self._net_count = 0
+            self._corrupt_count = 0
             self.site_counts = {}
-            self.injected_log = []
+            self.injected_log = deque(maxlen=INJECTED_LOG_CAP)
+            self.injected_log_dropped = 0
 
     def configure_from_conf(self, conf) -> None:
         from .. import config as C
         self.configure(str(conf.get(C.TEST_INJECT_OOM) or ""),
                        str(conf.get(C.TEST_INJECT_NET) or ""),
-                       int(conf.get(C.TEST_INJECT_SEED) or 0))
+                       int(conf.get(C.TEST_INJECT_SEED) or 0),
+                       str(conf.get(C.TEST_INJECT_CORRUPTION) or ""))
 
     # ---- stats (test observability) ----------------------------------------
 
@@ -140,7 +215,7 @@ class FaultInjector:
             self.site_counts[site] = self.site_counts.get(site, 0) + 1
             kind = self._oom.check(n)
             if kind is not None:
-                self.injected_log.append(("oom", n, site))
+                self._log_injected(("oom", n, site))
         if kind is not None:
             from ..mem.retry import RetryOOM, SplitAndRetryOOM
             cls = SplitAndRetryOOM if kind == "split" else RetryOOM
@@ -157,11 +232,51 @@ class FaultInjector:
             self.site_counts[key] = self.site_counts.get(key, 0) + 1
             kind = self._net.check(n)
             if kind is not None:
-                self.injected_log.append(("net", n, site))
+                self._log_injected(("net", n, site))
         if kind is not None:
             raise InjectedNetFault(
                 f"[fault-injection] forced net fault at op #{n} "
                 f"(site={site})")
+
+    @property
+    def corrupt_ops(self) -> int:
+        with self._lock:
+            return self._corrupt_count
+
+    def on_corruptible(self, site: str, view=None) -> bool:
+        """Called wherever columnar bytes sit in a host staging form (a
+        bounce-buffer slice, a spilled leaf, a disk image).  When the
+        armed plan selects this op, ONE bit of the middle byte is flipped
+        in place — the minimal corruption the checksum layer must catch.
+
+        `view` must be a writable 1-D uint8 ndarray/memoryview, or None
+        when the caller's bytes are read-only (host leaves pulled from the
+        device are immutable numpy views): then the flip is the CALLER's
+        job via `flip_bit` on a True return."""
+        with self._lock:
+            self._corrupt_count += 1
+            n = self._corrupt_count
+            key = f"corrupt:{site}"
+            n_site = self.site_counts.get(key, 0) + 1
+            self.site_counts[key] = n_site
+            hit = self._corrupt.check(n, site, n_site)
+            if hit:
+                self._log_injected(("corrupt", n, site))
+        if hit and view is not None and len(view):
+            view[len(view) // 2] ^= 0x01
+        return hit
+
+
+def flip_bit(arr):
+    """Copy of `arr` with one bit of its middle byte flipped — the
+    injected corruption for sites whose storage is a read-only numpy view
+    (the caller swaps the copy in where the original lived)."""
+    import numpy as np
+    flat = np.array(arr, copy=True)
+    u8 = flat.reshape(-1).view(np.uint8)
+    if len(u8):
+        u8[len(u8) // 2] ^= 0x01
+    return flat
 
 
 INJECTOR = FaultInjector()

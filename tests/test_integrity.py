@@ -1,0 +1,657 @@
+"""End-to-end shuffle & spill data integrity (ISSUE 4).
+
+Every transfer/spill path — loopback bounce chunks, socket stream, shm
+fill, device->host spill, host->disk tier — carries per-leaf checksums
+established at the first host materialization; a single flipped bit is
+detected, classified (writer/wire/reader, the SPARK-36206 analogue),
+and recovered: refetch for transit corruption, typed FetchFailed +
+map-fragment recompute for writer-side rot, vanished buffers, and dead
+peers.  The corruption injector (`spark.rapids.tpu.test.injectCorruption`)
+makes every path deterministic on CPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.columnar import ColumnarBatch
+from spark_rapids_tpu.mem import StorageTier, TpuRuntime
+from spark_rapids_tpu.mem.integrity import (BufferGone, ChecksumPolicy,
+                                            CorruptBuffer,
+                                            CorruptShuffleBlock,
+                                            FetchFailed, resolve_hasher)
+from spark_rapids_tpu.metrics import names as MN
+from spark_rapids_tpu.metrics.journal import EventJournal, pop_active, \
+    push_active, validate_events
+from spark_rapids_tpu.shuffle import LoopbackTransport, ShuffleEnv
+from spark_rapids_tpu.types import (DoubleType, LongType, Schema, StringType,
+                                    StructField)
+from spark_rapids_tpu.utils import faults
+
+pytestmark = pytest.mark.integrity
+
+
+def make_batch(n=200, cap=1024, seed=0, with_strings=False):
+    rng = np.random.RandomState(seed)
+    fields = [StructField("k", LongType), StructField("v", DoubleType)]
+    data = {"k": rng.randint(-100, 100, n).tolist(),
+            "v": rng.uniform(-5, 5, n).tolist()}
+    if with_strings:
+        fields.append(StructField("s", StringType))
+        data["s"] = [None if i % 7 == 0 else f"row{i}" for i in range(n)]
+    schema = Schema(fields)
+    return ColumnarBatch.from_pydict(data, schema, capacity=cap)
+
+
+def make_env(conf=None, pool=64 << 20, executor_id="exec-0",
+             transport=None, spill_dir=None):
+    conf = TpuConf(dict(conf or {}))
+    rt = TpuRuntime(conf, pool_limit_bytes=pool, spill_dir=spill_dir)
+    return ShuffleEnv(rt, conf, executor_id, transport)
+
+
+def arm(spec: str, seed: int = 0) -> None:
+    """Direct injector arming for unit tests that create no runtimes
+    (every TpuRuntime/transport bring-up re-arms from ITS conf, so
+    integration tests pass the spec via `corrupt_conf` instead)."""
+    faults.INJECTOR.reset()
+    faults.INJECTOR.configure(corrupt_spec=spec, seed=seed)
+
+
+def corrupt_conf(spec: str) -> dict:
+    return {"spark.rapids.tpu.test.injectCorruption": spec}
+
+
+# --------------------------------------------------------------------------
+# checksum core
+# --------------------------------------------------------------------------
+
+class TestChecksumCore:
+    def test_algorithms_detect_single_bit_flip(self):
+        data = np.arange(1 << 16, dtype=np.uint8)
+        for algo in ("crc32c", "xxhash", "crc32", "adler32"):
+            name, fn, stream = resolve_hasher(algo)
+            clean = fn(data)
+            assert fn(data) == clean  # deterministic
+            h = stream()
+            h.update(data[:1000])
+            h.update(data[1000:])
+            assert h.digest() == clean, f"{name} stream != one-shot"
+            flipped = data.copy()
+            flipped[12345] ^= 0x01
+            assert fn(flipped) != clean, f"{name} missed a bit flip"
+
+    def test_unknown_algorithm_raises(self):
+        with pytest.raises(ValueError, match="unknown checksum"):
+            resolve_hasher("md5000")
+
+    def test_none_disables(self):
+        name, fn, _stream = resolve_hasher("none")
+        assert fn is None
+        assert not ChecksumPolicy(True, "none").enabled
+        assert not ChecksumPolicy(False, "crc32c").enabled
+
+    def test_policy_verify_reports_leaf_and_digests(self):
+        policy = ChecksumPolicy(True, "crc32c")
+        leaves = [np.arange(100, dtype=np.uint8),
+                  np.arange(64, dtype=np.int64)]
+        sums = policy.checksum_leaves(leaves)
+        assert policy.verify_leaves(leaves, sums) is None
+        leaves[1].view(np.uint8)[3] ^= 0x10
+        bad = policy.verify_leaves(leaves, sums)
+        assert bad is not None
+        leaf, want, got = bad
+        assert leaf == 1 and want != got
+
+    def test_typed_dtypes_hash_same_as_bytes(self):
+        policy = ChecksumPolicy(True, "crc32c")
+        a = np.arange(1000, dtype=np.float64)
+        as_u8 = a.view(np.uint8)
+        assert policy.checksum_one(a) == policy.checksum_one(as_u8)
+
+
+# --------------------------------------------------------------------------
+# corruption injector
+# --------------------------------------------------------------------------
+
+class TestCorruptionInjector:
+    def test_site_scoped_ordinals(self):
+        arm("wire@2,spill@1")
+        a = np.zeros(16, dtype=np.uint8)
+        faults.INJECTOR.on_corruptible("wire", a)      # wire #1: clean
+        assert not a.any()
+        faults.INJECTOR.on_corruptible("spill", a)     # spill #1: flip
+        assert a.sum() == 1
+        a[:] = 0
+        faults.INJECTOR.on_corruptible("wire", a)      # wire #2: flip
+        assert a.sum() == 1
+        assert faults.INJECTOR.corrupt_ops == 3
+        assert [r[0] for r in faults.INJECTOR.injected_log] \
+            == ["corrupt", "corrupt"]
+
+    def test_global_ordinal_counts_across_sites(self):
+        arm("2")
+        a = np.zeros(8, dtype=np.uint8)
+        faults.INJECTOR.on_corruptible("wire", a)
+        assert not a.any()
+        faults.INJECTOR.on_corruptible("disk", a)
+        assert a.sum() == 1
+
+    def test_flip_is_one_bit_in_place(self):
+        arm("writer@1")
+        a = np.arange(64, dtype=np.uint8)
+        want = a.copy()
+        faults.INJECTOR.on_corruptible("writer", a)
+        diff = a ^ want
+        assert int(np.unpackbits(diff).sum()) == 1
+
+    def test_injected_log_bounded_with_drop_counter(self):
+        """Satellite: probabilistic specs on long runs must not grow the
+        log forever — capped deque + visible drop counter."""
+        arm(f"1x{faults.INJECTED_LOG_CAP + 50}")
+        a = np.zeros(4, dtype=np.uint8)
+        for _ in range(faults.INJECTED_LOG_CAP + 50):
+            faults.INJECTOR.on_corruptible("wire", a)
+        assert len(faults.INJECTOR.injected_log) == faults.INJECTED_LOG_CAP
+        assert faults.INJECTOR.injected_log_dropped == 50
+
+
+# --------------------------------------------------------------------------
+# spill tiers: device -> host -> disk round trips
+# --------------------------------------------------------------------------
+
+class TestSpillIntegrity:
+    def _spilled_env(self, tmp_path, to_disk=False, spec=""):
+        conf = {"spark.rapids.memory.host.spillStorageSize":
+                1 if to_disk else str(1 << 30)}
+        if spec:
+            conf.update(corrupt_conf(spec))
+        env = make_env(conf, spill_dir=str(tmp_path))
+        b = make_batch(seed=3, with_strings=True)
+        sid = env.new_shuffle_id()
+        env.write_partition(sid, 0, 0, b)
+        return env, sid
+
+    def test_clean_spill_unspill_roundtrip_verifies(self, tmp_path):
+        env, sid = self._spilled_env(tmp_path, to_disk=True)
+        want = [r for p in env.fetch_partition(sid, 0)
+                for r in p.to_pylist()]
+        rt = env.runtime
+        rt.device_store.synchronous_spill(0)
+        rt.host_store.synchronous_spill(0)
+        bids = env.catalog.buffers_for(
+            env.catalog.blocks_for_reduce(sid, 0)[0])
+        assert rt.catalog.lookup_tier(bids[0]) == StorageTier.DISK
+        got = [r for p in env.fetch_partition(sid, 0)
+               for r in p.to_pylist()]
+        assert got == want
+        assert rt.metrics.values.get(MN.CHECKSUM_TIME, 0) >= 0
+
+    def test_spill_corruption_detected_at_unspill(self, tmp_path):
+        env, sid = self._spilled_env(tmp_path, spec="spill@1")
+        env.runtime.device_store.synchronous_spill(0)  # digest, then flip
+        with pytest.raises(CorruptBuffer) as ei:
+            list(env.fetch_partition(sid, 0))
+        assert ei.value.site == "unspill_host"
+        assert env.runtime.metrics.values.get(
+            MN.NUM_CHECKSUM_MISMATCHES, 0) >= 1
+
+    def test_disk_corruption_detected_at_read(self, tmp_path):
+        env, sid = self._spilled_env(tmp_path, to_disk=True,
+                                     spec="disk@1")
+        rt = env.runtime
+        rt.device_store.synchronous_spill(0)
+        rt.host_store.synchronous_spill(0)   # flat image flipped on write
+        with pytest.raises(CorruptBuffer) as ei:
+            list(env.fetch_partition(sid, 0))
+        assert ei.value.site == "unspill_disk"
+
+    def test_spill_checksum_off_restores_old_behavior(self, tmp_path):
+        conf = {"spark.rapids.memory.spill.checksum.enabled": "false",
+                "spark.rapids.shuffle.checksum.enabled": "false",
+                **corrupt_conf("spill@1")}
+        env = make_env(conf, spill_dir=str(tmp_path))
+        b = make_batch(seed=3)
+        sid = env.new_shuffle_id()
+        env.write_partition(sid, 0, 0, b)
+        env.runtime.device_store.synchronous_spill(0)
+        # corruption armed but verification off: the flip sails through
+        # undetected (exactly the pre-integrity behavior the conf buys
+        # back) — the data comes back, silently different
+        got = list(env.fetch_partition(sid, 0))
+        assert got
+
+
+# --------------------------------------------------------------------------
+# loopback wire: detect -> diagnose -> refetch / escalate
+# --------------------------------------------------------------------------
+
+def _loopback_pair(conf=None, spec=""):
+    conf = dict(conf or {})
+    if spec:
+        conf.update(corrupt_conf(spec))
+    wire = LoopbackTransport(pool_size=1 << 20, chunk_size=1 << 14)
+    wire.configure(TpuConf(conf))
+    writer = make_env(conf, executor_id="exec-A", transport=wire)
+    reader = make_env(conf, executor_id="exec-B", transport=wire)
+    return wire, writer, reader
+
+
+class TestLoopbackCorruption:
+    def test_transient_corruption_refetches_and_matches(self):
+        journal = EventJournal()
+        push_active(journal)
+        try:
+            wire, writer, reader = _loopback_pair(spec="loopback@1")
+            b = make_batch(seed=9, with_strings=True)
+            want = b.to_pylist()
+            writer.write_partition(41, 0, 1, b)
+            got = [r for p in reader.fetch_partition(
+                41, 1, remote_peers=["exec-A"]) for r in p.to_pylist()]
+            assert got == want, "recovered rows differ from the originals"
+            m = reader.runtime.metrics.values
+            assert m.get(MN.NUM_CHECKSUM_MISMATCHES) == 1
+            assert m.get(MN.NUM_CORRUPTION_REFETCHES) == 1
+            assert m.get(MN.NUM_LOST_MAP_OUTPUTS) is None
+            assert wire.counters.get("checksum_mismatches") == 1
+        finally:
+            pop_active(journal)
+            journal.close()
+        events = journal.events()
+        assert validate_events(events) == []
+        kinds = {}
+        for e in events:
+            kinds.setdefault(e["kind"], []).append(e)
+        assert kinds.get("corruption"), "no corruption event journaled"
+        assert kinds["corruption"][0]["classification"] == "wire"
+        assert kinds.get("refetch"), "no refetch event journaled"
+
+    def test_writer_rot_classified_and_escalates(self):
+        """The peer is ALIVE but its stored copy rotted after its digest
+        was recorded: the diagnosis re-hash blames the writer, refetching
+        is skipped, and the typed FetchFailed marks the map output lost
+        (epoch bump -> stale AQE stats invalidated)."""
+        journal = EventJournal()
+        push_active(journal)
+        try:
+            wire, writer, reader = _loopback_pair(spec="writer@1x9")
+            b = make_batch(seed=10)
+            writer.write_partition(42, 0, 0, b)
+            epoch0 = reader.map_stats.epoch
+            with pytest.raises(FetchFailed) as ei:
+                list(reader.fetch_partition(42, 0,
+                                            remote_peers=["exec-A"]))
+            assert ei.value.classification == "writer"
+            assert ei.value.peer == "exec-A"
+            assert "peer='exec-A'" in repr(ei.value)
+            m = reader.runtime.metrics.values
+            assert m.get(MN.NUM_CHECKSUM_MISMATCHES) == 1
+            assert not m.get(MN.NUM_CORRUPTION_REFETCHES)
+            assert m.get(MN.NUM_LOST_MAP_OUTPUTS) == 1
+            assert reader.map_stats.epoch == epoch0 + 1
+        finally:
+            pop_active(journal)
+            journal.close()
+        events = journal.events()
+        cors = [e for e in events if e["kind"] == "corruption"]
+        assert cors and cors[0]["classification"] == "writer"
+        rec = [e for e in events if e["kind"] == "recompute"]
+        assert rec and rec[0]["classification"] == "writer"
+
+    def test_refetch_exhaustion_escalates(self):
+        """Transit corruption on EVERY attempt: the refetch budget runs
+        out and the fetch escalates instead of looping forever."""
+        conf = {"spark.rapids.shuffle.maxRefetchAttempts": "2"}
+        wire, writer, reader = _loopback_pair(conf, spec="loopback@1x50")
+        b = make_batch(seed=11)
+        writer.write_partition(43, 0, 0, b)
+        with pytest.raises(FetchFailed) as ei:
+            list(reader.fetch_partition(43, 0, remote_peers=["exec-A"]))
+        assert ei.value.classification == "wire"
+        m = reader.runtime.metrics.values
+        assert m.get(MN.NUM_CORRUPTION_REFETCHES) == 2  # budget honored
+        assert m.get(MN.NUM_CHECKSUM_MISMATCHES) == 3
+
+    def test_spilled_writer_rot_escalates_over_loopback(self):
+        """Serve-time verify failure on the LOOPBACK path must enter the
+        same typed ladder as the socket's OP_GONE(corrupt) frame:
+        FetchFailed(writer), and the OWNER drops the rotted map output's
+        statistics (mark_lost) so AQE never re-plans on them."""
+        wire, writer, reader = _loopback_pair(spec="spill@1")
+        b = make_batch(seed=22)
+        writer.write_partition(53, 0, 0, b)
+        assert writer.map_stats.stats(53, 1).total_rows > 0
+        owner_epoch0 = writer.map_stats.epoch
+        writer.runtime.device_store.synchronous_spill(0)  # digest + flip
+        with pytest.raises(FetchFailed) as ei:
+            list(reader.fetch_partition(53, 0, remote_peers=["exec-A"]))
+        assert ei.value.classification == "writer"
+        # the owner marked its own rotted map output lost
+        assert writer.map_stats.epoch > owner_epoch0
+        assert writer.map_stats.stats(53, 1).total_bytes == 0
+
+    def test_checksums_off_no_verification(self):
+        conf = {"spark.rapids.shuffle.checksum.enabled": "false",
+                "spark.rapids.memory.spill.checksum.enabled": "false"}
+        wire, writer, reader = _loopback_pair(conf, spec="loopback@1")
+        b = make_batch(seed=12)
+        writer.write_partition(44, 0, 0, b)
+        # flips sail through silently: baseline behavior restored
+        got = list(reader.fetch_partition(44, 0, remote_peers=["exec-A"]))
+        assert got
+        assert not wire.counters.get("checksum_mismatches")
+        assert not reader.runtime.metrics.values.get(
+            MN.NUM_CHECKSUM_MISMATCHES)
+
+
+# --------------------------------------------------------------------------
+# serve-after-remove race: typed buffer-gone, never a hang (satellite)
+# --------------------------------------------------------------------------
+
+class _StallingServer:
+    """Proxy around a ShuffleServer that parks mid-stream so the test can
+    remove the shuffle UNDER a fetch (the stalled-reader race)."""
+
+    def __init__(self, inner, stall_after_chunks=1):
+        import threading
+        self._inner = inner
+        self._chunks = 0
+        self._stall_after = stall_after_chunks
+        self.stalled = threading.Event()
+        self.resume = threading.Event()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def copy_leaf_chunk(self, *a, **kw):
+        self._chunks += 1
+        if self._chunks == self._stall_after + 1:
+            self.stalled.set()
+            assert self.resume.wait(timeout=30), "test deadlock"
+        return self._inner.copy_leaf_chunk(*a, **kw)
+
+
+class TestServeAfterRemoveRace:
+    def test_loopback_stalled_reader_gets_typed_gone(self):
+        import threading
+        wire, writer, reader = _loopback_pair()
+        b = make_batch(seed=13, n=4000, cap=4096, with_strings=True)
+        writer.write_partition(45, 0, 0, b)
+        stalling = _StallingServer(writer.server)
+        wire.register_server("exec-A", stalling)  # re-point the registry
+        result = {}
+
+        def fetch():
+            try:
+                result["got"] = list(reader.fetch_partition(
+                    45, 0, remote_peers=["exec-A"]))
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                result["err"] = e
+        t = threading.Thread(target=fetch, daemon=True)
+        t.start()
+        assert stalling.stalled.wait(timeout=30), \
+            "fetch never reached the stream"
+        writer.remove_shuffle(45)  # frees buffers + invalidates the cache
+        stalling.resume.set()
+        t.join(timeout=30)
+        assert not t.is_alive(), "fetch hung after remove_shuffle"
+        err = result.get("err")
+        assert isinstance(err, FetchFailed), f"got {result!r}"
+        assert err.classification == "gone"
+
+    def test_socket_fetch_after_remove_typed_gone(self):
+        from spark_rapids_tpu.shuffle.net import SocketTransport
+        conf = TpuConf({"spark.rapids.shuffle.retry.maxAttempts": "2",
+                        "spark.rapids.shuffle.retry.backoffBaseMs": "1",
+                        "spark.rapids.shuffle.retry.backoffCapMs": "2"})
+        tr_a = SocketTransport(chunk_size=1 << 14)
+        tr_b = SocketTransport(chunk_size=1 << 14)
+        tr_a.configure(conf)
+        tr_b.configure(conf)
+        rt_a = TpuRuntime(conf, pool_limit_bytes=64 << 20)
+        rt_b = TpuRuntime(conf, pool_limit_bytes=64 << 20)
+        env_a = ShuffleEnv(rt_a, conf, "net-a", tr_a)
+        env_b = ShuffleEnv(rt_b, conf, "net-b", tr_b)
+        try:
+            tr_b.set_peers({"net-a": tr_a.address})
+            b = make_batch(seed=14)
+            env_a.write_partition(46, 0, 0, b)
+            from spark_rapids_tpu.shuffle.transport import MetadataRequest
+            client = tr_b.make_client("net-a")
+            resp = client.fetch_metadata(
+                MetadataRequest(shuffle_id=46, reduce_id=0))
+            bid = resp.block_metas[0].buffer_ids[0]
+            env_a.remove_shuffle(46)   # the race: buffer gone mid-fetch
+            with pytest.raises(BufferGone):
+                client.fetch_buffer(bid)
+            assert tr_a.counters.get("buffer_gone", 0) >= 1
+            # a FRESH wildcard discovery after the removal legitimately
+            # finds nothing (no error): only an in-flight fetch races
+            assert not list(env_b.fetch_partition(
+                46, 0, remote_peers=["net-a"]))
+        finally:
+            tr_a.shutdown()
+            tr_b.shutdown()
+
+
+# --------------------------------------------------------------------------
+# socket wire + shm: corruption detect/refetch over real TCP
+# --------------------------------------------------------------------------
+
+def _socket_pair(conf=None, shm=False, spec=""):
+    from spark_rapids_tpu.shuffle.net import SocketTransport
+    cc = {"spark.rapids.shuffle.retry.maxAttempts": "2",
+          "spark.rapids.shuffle.retry.backoffBaseMs": "1",
+          "spark.rapids.shuffle.retry.backoffCapMs": "2"}
+    cc.update(conf or {})
+    if spec:
+        cc.update(corrupt_conf(spec))
+    tconf = TpuConf(cc)
+    tr_a = SocketTransport(chunk_size=1 << 14, shm_local=shm)
+    tr_b = SocketTransport(chunk_size=1 << 14, shm_local=shm)
+    tr_a.configure(tconf)
+    tr_b.configure(tconf)
+    env_a = ShuffleEnv(TpuRuntime(tconf, pool_limit_bytes=64 << 20),
+                       tconf, "sock-a", tr_a)
+    env_b = ShuffleEnv(TpuRuntime(tconf, pool_limit_bytes=64 << 20),
+                       tconf, "sock-b", tr_b)
+    tr_b.set_peers({"sock-a": tr_a.address})
+    return (tr_a, tr_b), (env_a, env_b)
+
+
+class TestSocketCorruption:
+    def test_stream_corruption_refetches_and_matches(self):
+        (tr_a, tr_b), (env_a, env_b) = _socket_pair(spec="wire@1")
+        try:
+            b = make_batch(seed=15, with_strings=True)
+            want = b.to_pylist()
+            env_a.write_partition(47, 0, 2, b)
+            got = [r for p in env_b.fetch_partition(
+                47, 2, remote_peers=["sock-a"]) for r in p.to_pylist()]
+            assert got == want
+            m = env_b.runtime.metrics.values
+            assert m.get(MN.NUM_CHECKSUM_MISMATCHES) == 1
+            assert m.get(MN.NUM_CORRUPTION_REFETCHES) == 1
+            assert tr_b.counters.get("checksum_mismatches") == 1
+            assert tr_a.counters.get("corruption_diagnoses", 0) >= 1
+        finally:
+            tr_a.shutdown()
+            tr_b.shutdown()
+
+    def test_shm_corruption_refetches_and_matches(self):
+        (tr_a, tr_b), (env_a, env_b) = _socket_pair(shm=True,
+                                                    spec="shm@1")
+        try:
+            b = make_batch(seed=16, with_strings=True)
+            want = b.to_pylist()
+            env_a.write_partition(48, 0, 0, b)
+            got = [r for p in env_b.fetch_partition(
+                48, 0, remote_peers=["sock-a"]) for r in p.to_pylist()]
+            assert got == want
+            assert tr_a.counters.get("shm_fills", 0) >= 2  # bad + refetch
+            m = env_b.runtime.metrics.values
+            assert m.get(MN.NUM_CHECKSUM_MISMATCHES) == 1
+            assert m.get(MN.NUM_CORRUPTION_REFETCHES) == 1
+        finally:
+            tr_a.shutdown()
+            tr_b.shutdown()
+
+    def test_writer_rot_over_socket_escalates(self):
+        (tr_a, tr_b), (env_a, env_b) = _socket_pair(spec="writer@1x9")
+        try:
+            b = make_batch(seed=17)
+            env_a.write_partition(49, 0, 0, b)
+            with pytest.raises(FetchFailed) as ei:
+                list(env_b.fetch_partition(49, 0,
+                                           remote_peers=["sock-a"]))
+            assert ei.value.classification == "writer"
+            assert ei.value.peer == "sock-a"
+        finally:
+            tr_a.shutdown()
+            tr_b.shutdown()
+
+    def test_spilled_writer_buffer_served_corrupt_is_typed(self):
+        """Writer-side rot in a SPILLED buffer is caught by the server's
+        own serve-time verify and crosses the wire as a typed corrupt
+        frame -> FetchFailed(writer), never silently-wrong bytes."""
+        (tr_a, tr_b), (env_a, env_b) = _socket_pair(spec="spill@1")
+        try:
+            b = make_batch(seed=18)
+            env_a.write_partition(50, 0, 0, b)
+            env_a.runtime.device_store.synchronous_spill(0)  # digest+flip
+            with pytest.raises(FetchFailed) as ei:
+                list(env_b.fetch_partition(50, 0,
+                                           remote_peers=["sock-a"]))
+            assert ei.value.classification == "writer"
+        finally:
+            tr_a.shutdown()
+            tr_b.shutdown()
+
+
+# --------------------------------------------------------------------------
+# verifyOnLocalRead
+# --------------------------------------------------------------------------
+
+class TestVerifyOnLocalRead:
+    def _env(self):
+        return make_env({
+            "spark.rapids.shuffle.deviceResident.enabled": "false",
+            "spark.rapids.shuffle.checksum.verifyOnLocalRead": "true"})
+
+    def test_clean_local_read_passes(self):
+        env = self._env()
+        b = make_batch(seed=19)
+        want = b.to_pylist()
+        env.write_partition(51, 0, 0, b)
+        got = [r for p in env.fetch_partition(51, 0)
+               for r in p.to_pylist()]
+        assert got == want
+
+    def test_rotted_local_read_classified_reader(self):
+        env = self._env()
+        b = make_batch(seed=20)
+        env.write_partition(52, 0, 0, b)
+        # rot the stored baseline leaves in place (this executor's own
+        # memory going bad — no wire involved)
+        block = env.catalog.blocks_for_reduce(52, 0)[0]
+        bid = env.catalog.buffers_for(block)[0]
+        leaves, _meta = env.baseline_leaves(bid)
+        leaves[0] = faults.flip_bit(leaves[0])
+        with pytest.raises(CorruptShuffleBlock) as ei:
+            list(env.fetch_partition(52, 0))
+        assert ei.value.site == "reader"
+
+
+# --------------------------------------------------------------------------
+# AQE statistics invalidation on lost map outputs
+# --------------------------------------------------------------------------
+
+class TestEpochInvalidation:
+    def test_mark_lost_bumps_epoch_and_drops_map(self):
+        from spark_rapids_tpu.adaptive.stats import MapOutputTracker
+        t = MapOutputTracker()
+        t.record(1, map_id=0, reduce_id=0, nbytes=100, nrows=10)
+        t.record(1, map_id=1, reduce_id=0, nbytes=50, nrows=5)
+        e0 = t.epoch
+        t.mark_lost(1, map_id=1)
+        assert t.epoch == e0 + 1
+        st = t.stats(1, 1)
+        assert st.map_bytes_by_partition[0] == {0: 100}
+        t.mark_lost(1)
+        assert t.epoch == e0 + 2
+        assert t.stats(1, 1).total_bytes == 0
+
+    def test_shuffle_handle_stats_refresh_after_epoch_bump(self):
+        """The exchange's cached MapOutputStatistics must never survive a
+        lost-map-output epoch bump — AQE rules would otherwise re-plan on
+        a dead map stage's sizes."""
+        from spark_rapids_tpu.exec.exchange import _ShuffleHandle
+        env = make_env()
+        b = make_batch(seed=21)
+        sid = env.new_shuffle_id()
+        env.write_partition(sid, 0, 0, b)
+        h = _ShuffleHandle(sid, 1, env=env)
+        st1 = h.stats()
+        assert st1.total_rows > 0
+        assert h.stats() is st1  # cached while the epoch stands still
+        env.map_stats.mark_lost(sid)
+        st2 = h.stats()
+        assert st2 is not st1, "stale stats served after map-output loss"
+        assert st2.total_rows == 0
+        # recompute repopulates; the next read sees the fresh sizes
+        env.write_partition(sid, 0, 0, make_batch(seed=21))
+        env.map_stats.bump_epoch()
+        assert h.stats().total_rows == st1.total_rows
+
+
+# --------------------------------------------------------------------------
+# AQE-on == AQE-off under corruption injection (in-process cluster)
+# --------------------------------------------------------------------------
+
+@pytest.mark.adaptive
+def test_aqe_on_off_identical_under_corruption():
+    """Acceptance: with transient corruption injected on the in-process
+    cluster's loopback wire, the recovery ladder refetches and the final
+    table is bit-for-bit what the fault-free AQE-off run produces."""
+    from spark_rapids_tpu.engine import TpuSession
+    from spark_rapids_tpu.plan.logical import col, functions as F
+
+    base = {
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.rapids.sql.tpu.join.partitioned.threshold": "0",
+        "spark.rapids.sql.tpu.shuffle.partitions": "4",
+        "spark.rapids.sql.tpu.cluster.executors": "2",
+    }
+
+    def q(s):
+        rng = np.random.RandomState(2)
+        left = s.from_pydict(
+            {"k": [int(k) for k in rng.randint(0, 10, 2000)],
+             "v": [float(i % 13) for i in range(2000)]})
+        right = s.from_pydict(
+            {"k": list(range(10)), "name": [f"d{i}" for i in range(10)]})
+        return (left.join(right, on="k").group_by("name")
+                .agg(F.sum(col("v")).alias("sv"),
+                     F.count(col("v")).alias("cv"))
+                .order_by("name"))
+
+    faults.INJECTOR.reset()
+    s_off = TpuSession({**base,
+                        "spark.rapids.sql.tpu.adaptive.enabled": "false"})
+    t_off = q(s_off).to_arrow()
+
+    faults.INJECTOR.reset()
+    s_on = TpuSession({**base,
+                       "spark.rapids.sql.tpu.adaptive.enabled": "true",
+                       "spark.rapids.tpu.test.injectCorruption":
+                       "loopback@1,loopback@3"})
+    t_on = q(s_on).to_arrow()
+    assert t_on.equals(t_off), \
+        "AQE-on under corruption differs from fault-free AQE-off"
+    m = s_on.runtime.metrics.values
+    total = sum(e.env.runtime.metrics.values.get(
+        MN.NUM_CHECKSUM_MISMATCHES, 0)
+        for e in s_on.cluster.executors) \
+        + m.get(MN.NUM_CHECKSUM_MISMATCHES, 0)
+    assert total >= 1, "corruption was never detected (vacuous recovery)"

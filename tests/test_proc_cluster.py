@@ -16,6 +16,7 @@ Reference counterpart: shuffle-plugin UCX transport
 """
 from __future__ import annotations
 
+import json
 import os
 
 import numpy as np
@@ -332,6 +333,162 @@ def test_proc_cluster_two_workers_lost(tmp_path):
     for c in ["sum_qty", "count_order"]:
         np.testing.assert_allclose(res[c].to_numpy(), exp[c].to_numpy(),
                                    rtol=1e-9)
+
+
+def _kv_map_reduce_plans(session, n_workers=2, rows=400):
+    """Tiny deterministic map/reduce pair: per-worker slices of one k/v
+    table, group-by-k sum(v) on the reduce side."""
+    table = pa.table({"k": [i % 16 for i in range(rows)],
+                      "v": [float(i) for i in range(rows)]})
+    step = (rows + n_workers - 1) // n_workers
+    map_plans = [session.from_arrow(table.slice(i * step, step)).plan
+                 for i in range(n_workers)]
+    map_schema = DataFrame(session, map_plans[0]).schema
+    reduce_plan = (DataFrame(session, L.LogicalPlaceholder(map_schema))
+                   .group_by(col("k"))
+                   .agg(F.sum(col("v")).alias("sv"))).plan
+    return map_plans, reduce_plan
+
+
+@pytest.mark.slow
+@pytest.mark.integrity
+def test_proc_cluster_wire_corruption_refetches_bit_for_bit():
+    """Acceptance (tentpole): single-bit corruption injected into each
+    worker's first socket-stream chunk is detected at the reducers,
+    refetched, and the query result is BIT-FOR-BIT identical to the
+    fault-free run of the same cluster."""
+    from spark_rapids_tpu.cluster import ProcCluster
+    session = TpuSession()
+    map_plans, reduce_plan = _kv_map_reduce_plans(session)
+    cluster = ProcCluster(
+        2, conf={"spark.rapids.tpu.test.injectCorruption": "wire@1",
+                 "spark.rapids.shuffle.retry.backoffBaseMs": "1"},
+        cpu=True, max_task_retries=2)
+    try:
+        corrupted, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                              reduce_plan)
+        counters = cluster.transport_counters()
+        mismatches = sum(c.get("checksum_mismatches", 0)
+                         for c in counters.values())
+        assert mismatches >= 1, \
+            f"corruption never detected (vacuous recovery): {counters}"
+        assert cluster.lost_map_outputs == 0, \
+            "transient corruption must refetch, not recompute"
+        # second run on the SAME cluster: the injected ordinal is spent,
+        # so this is the fault-free reference
+        clean, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                          reduce_plan)
+    finally:
+        cluster.shutdown()
+    assert corrupted.sort_by("k").equals(clean.sort_by("k")), \
+        "recovered result differs bit-for-bit from the fault-free run"
+
+
+@pytest.mark.slow
+@pytest.mark.integrity
+def test_proc_cluster_writer_rot_replaces_live_peer():
+    """Acceptance (tentpole): a worker whose STORED shuffle data rots
+    (writer-site corruption — its process is alive, just serving garbage)
+    is diagnosed via the writer-side re-hash, its FetchFailed names it,
+    and the driver replaces the LIVE peer and recomputes its map fragment
+    from the lineage; the result matches the fault-free run."""
+    from spark_rapids_tpu import cluster as cluster_mod
+    from spark_rapids_tpu.cluster import ProcCluster
+    session = TpuSession()
+    map_plans, reduce_plan = _kv_map_reduce_plans(session)
+    cluster = ProcCluster(
+        2, conf={"spark.rapids.tpu.test.injectCorruption": "writer@1x999",
+                 "spark.rapids.shuffle.retry.backoffBaseMs": "1"},
+        cpu=True, max_task_retries=2)
+    try:
+        # replacements spawn healthy: the rot lives in the ORIGINAL
+        # processes' memory, not in the lineage being recomputed
+        cluster._conf_env = json.dumps(
+            {"spark.rapids.shuffle.retry.backoffBaseMs": "1"})
+        rotted, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                           reduce_plan)
+        assert cluster.lost_map_outputs >= 1, \
+            "writer rot never escalated to a map recompute"
+        assert cluster.task_retries >= 1, "no live-peer replacement"
+        epoch_after = cluster.map_epoch
+        assert epoch_after >= 1, "lost map outputs must bump the epoch"
+        clean, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                          reduce_plan)
+    finally:
+        cluster.shutdown()
+    assert rotted.sort_by("k").equals(clean.sort_by("k")), \
+        "post-recompute result differs from the fault-free run"
+
+
+@pytest.mark.slow
+@pytest.mark.integrity
+def test_replace_worker_republishes_peers_to_survivors():
+    """Satellite: `_replace_worker` must re-publish the peer map to ALL
+    surviving workers, and a survivor's next remote fetch must dial the
+    REPLACEMENT's address (previously only implicitly covered by the
+    map/reduce retry tests)."""
+    import pickle
+
+    from spark_rapids_tpu.cluster import ProcCluster
+    session = TpuSession()
+    cluster = ProcCluster(2, conf={}, cpu=True, max_task_retries=1)
+    try:
+        old_addr = tuple(cluster.workers[0].address)
+        fresh = cluster._replace_worker(0)
+        new_addr = tuple(fresh.address)
+        assert new_addr != old_addr, "replacement reused the old port"
+        # direct contract: the survivor's live peer map holds the NEW
+        # address under the same executor id
+        survivor_peers = cluster.workers[1].rpc("get_peers")
+        assert tuple(survivor_peers["exec-0"]) == new_addr
+        # and its next remote fetch genuinely dials the replacement:
+        # write map output only on the replacement, reduce on the survivor
+        table = pa.table({"k": [1] * 50, "v": [float(i) for i in range(50)]})
+        blob = pickle.dumps(session.from_arrow(table).plan)
+        sid = cluster.new_shuffle_id()
+        out = cluster.workers[0].rpc("run_map", sid=sid, plan_blob=blob,
+                                     key_names=["k"], n_parts=2)
+        assert sum(out["written_rows"].values()) == 50
+        map_schema = DataFrame(session,
+                               session.from_arrow(table).plan).schema
+        reduce_plan = (DataFrame(session,
+                                 L.LogicalPlaceholder(map_schema))
+                       .group_by(col("k"))
+                       .agg(F.count(lit(1)).alias("c"))).plan
+        blob_r = pickle.dumps(reduce_plan)
+        res = cluster.workers[1].rpc("run_reduce", sid=sid,
+                                     partitions=[0, 1],
+                                     plan_blob=blob_r)
+        assert res is not None
+        with pa.ipc.open_stream(res) as r:
+            t = r.read_all()
+        assert t.to_pydict()["c"] == [50]
+        recv = cluster.workers[1].rpc("transport_counters") \
+            .get("bytes_received", 0)
+        assert recv > 0, "survivor never fetched from the replacement"
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.integrity
+def test_publish_peers_failure_counted_not_silent():
+    """Satellite: a set_peers broadcast that a worker never acknowledges
+    must be logged and counted (peer_publish_failures), not swallowed —
+    a survivor with a stale peer map is otherwise undiagnosable."""
+    from spark_rapids_tpu.cluster import ProcCluster
+    cluster = ProcCluster(2, conf={}, cpu=True)
+    try:
+        assert cluster._transport.counters.get(
+            "peer_publish_failures", 0) == 0
+        cluster.workers[1].proc.kill()
+        cluster.workers[1].proc.wait(timeout=10)
+        cluster._transport.drop_client(cluster.workers[1].executor_id)
+        cluster._publish_peers()
+        assert cluster._transport.counters.get(
+            "peer_publish_failures", 0) >= 1
+    finally:
+        cluster.shutdown()
 
 
 @pytest.mark.slow
