@@ -283,6 +283,85 @@ def integrity_microbench(session) -> dict:
     }
 
 
+def compress_microbench() -> dict:
+    """Spill write/read delta per codec (the ISSUE-5 acceptance number):
+    the host->disk spill path timed with compression off and on, same
+    leaves, same disk.  `none` is the current raw path — the on/off delta
+    is the codec tax (or win) at the spill tier; the wire-side per-codec
+    numbers live in BENCH_WIRE.json (tests/test_wire_throughput.py)."""
+    import tempfile
+
+    import numpy as np
+    from spark_rapids_tpu.compress import (CompressionPolicy,
+                                           available_codecs, resolve_codec)
+    from spark_rapids_tpu.mem.buffer import read_leaves, write_leaves
+    from spark_rapids_tpu.mem.buffer import BatchMeta, ColumnLeafMeta
+
+    rng = np.random.RandomState(42)
+    n = 2_000_000  # ~48MB of typical columnar leaves
+    leaves = [
+        np.cumsum(rng.randint(0, 10, n)).astype(np.int64),  # sorted-ish
+        rng.uniform(900.0, 105000.0, n),                    # prices
+        np.ones(n, dtype=np.bool_),                          # validity
+    ]
+    meta = BatchMeta(
+        schema=None, capacity=n,
+        leaf_meta=[ColumnLeafMeta(str(a.dtype), [a.shape], [a.dtype.str])
+                   for a in leaves[:-1]],
+        sel_shape=leaves[-1].shape,
+        size_bytes=sum(a.nbytes for a in leaves))
+    raw_total = sum(a.nbytes for a in leaves)
+    out = {"nbytes": raw_total, "codecs": {}}
+    with tempfile.TemporaryDirectory(prefix="bench_spill_") as d:
+        for codec_name in ["none"] + [c for c in ("lz4", "zstd")
+                                      if c in available_codecs()]:
+            pol = CompressionPolicy(codec_name, min_size=0)
+            path = os.path.join(d, f"spill_{codec_name}.bin")
+            t0 = time.time()
+            if pol.enabled:
+                frames = pol.compress_leaves(leaves)
+                write_leaves(path, frames)
+                disk_bytes = sum(f.nbytes for f in frames)
+            else:
+                write_leaves(path, leaves)
+                disk_bytes = raw_total
+            w_t = time.time() - t0
+            t0 = time.time()
+            if pol.enabled:
+                from spark_rapids_tpu.native import spill_read
+                raw = spill_read(path, disk_bytes)
+                codec = resolve_codec(codec_name)
+                off = 0
+                back = []
+                for f in frames:
+                    frame = np.frombuffer(raw, np.uint8, count=f.nbytes,
+                                          offset=off)
+                    back.append(pol.decompress_one(frame, codec))
+                    off += f.nbytes
+                assert sum(b.nbytes for b in back) == raw_total
+            else:
+                back = read_leaves(path, meta)
+            r_t = time.time() - t0
+            out["codecs"][codec_name] = {
+                "write_mb_s": round(raw_total / w_t / 1e6, 1),
+                "read_mb_s": round(raw_total / r_t / 1e6, 1),
+                "disk_bytes": disk_bytes,
+                "ratio": round(raw_total / disk_bytes, 2),
+            }
+    base = out["codecs"].get("none", {})
+    for name, rec in out["codecs"].items():
+        if name != "none" and base.get("write_mb_s"):
+            rec["write_delta_pct"] = round(
+                (rec["write_mb_s"] - base["write_mb_s"])
+                / base["write_mb_s"] * 100, 1)
+            rec["read_delta_pct"] = round(
+                (rec["read_mb_s"] - base["read_mb_s"])
+                / base["read_mb_s"] * 100, 1)
+    out["host_cpus"] = os.cpu_count() or 1
+    out["available_codecs"] = available_codecs()
+    return out
+
+
 def child_main(mode: str) -> None:
     _DEADLINE[0] = time.time() + float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))
@@ -438,6 +517,14 @@ def child_main(mode: str) -> None:
         emit("integrity", **integrity_microbench(session))
     except Exception as e:
         emit("integrity", error=repr(e)[:200])
+    # compression rollup (ISSUE 5): spill write/read delta per codec
+    # (codec none == the pre-compression raw path; the deltas say what a
+    # codec costs/buys at the spill tier on THIS host), next to the wire
+    # per-codec numbers BENCH_WIRE.json carries
+    try:
+        emit("compress", **compress_microbench())
+    except Exception as e:
+        emit("compress", error=repr(e)[:200])
     emit("done", t=time.time() - (_DEADLINE[0] - float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))))
 
@@ -553,7 +640,8 @@ def collect(r: "StageReader", end_at: float,
     child."""
     out = {"platform": None, "runs": {}, "warmup": {}, "values": {},
            "transfer": None, "aborted": False, "backend_error": None,
-           "observability": None, "adaptive": None, "integrity": None}
+           "observability": None, "adaptive": None, "integrity": None,
+           "compress": None}
     first = True
     try:
         while True:
@@ -592,6 +680,9 @@ def collect(r: "StageReader", end_at: float,
             elif st == "integrity":
                 out["integrity"] = {k: v for k, v in rec.items()
                                     if k != "stage"}
+            elif st == "compress":
+                out["compress"] = {k: v for k, v in rec.items()
+                                   if k != "stage"}
             elif st == "abort":
                 out["aborted"] = True
                 break
@@ -745,6 +836,7 @@ def _run():
         "observability": dev.get("observability"),
         "adaptive": dev.get("adaptive"),
         "integrity": dev.get("integrity"),
+        "compress": dev.get("compress"),
         "q6_effective_gb_s": round(eff_gb_s, 2),
         "hbm_roofline_note": "v5e HBM ~819 GB/s; q6 reads 32 B/row",
         "vs_ref_headline": round(vs / 19.8, 4),

@@ -49,6 +49,15 @@ python -m pytest tests/test_proc_cluster.py -q -m integrity \
     -p no:cacheprovider
 echo "== integrity tier took $((SECONDS - T_INT))s =="
 
+echo "== compress tier =="
+# shuffle/spill compression (ISSUE 5): framed codec round-trip fuzz,
+# bit-for-bit wire/spill integration per codec, negotiation fallback,
+# and corruption injection with compression on (flipped compressed
+# bytes must fail the frame digest before any decompressor runs)
+T_CMP=$SECONDS
+python -m pytest tests/test_compress.py -q -p no:cacheprovider
+echo "== compress tier took $((SECONDS - T_CMP))s =="
+
 echo "== tests (fast tier) =="
 T_TESTS=$SECONDS
 MARK="not slow"

@@ -1,0 +1,22 @@
+"""Shuffle & spill buffer compression: chunked codec subsystem.
+
+Layering: this package is pure (numpy + pyarrow + stdlib) so both
+`shuffle/` (wire compression) and `mem/` (spill compression) can import
+it without cycles — the same reason `mem/integrity.py` lives below the
+shuffle stack.
+"""
+from .codec import (ArrowCodec, Codec, CodecError, CopyCodec,
+                    available_codecs, codec_names, is_codec_available,
+                    resolve_codec)
+from .framed import (FLAG_RAW, CompressionPolicy, compression_from_conf,
+                     frame_chunk_flags, frame_compress, frame_decompress,
+                     frame_uncompressed_size)
+from .serving import CompressedServe, CompressedServeCache
+
+__all__ = [
+    "ArrowCodec", "Codec", "CodecError", "CopyCodec", "available_codecs",
+    "codec_names", "is_codec_available", "resolve_codec", "FLAG_RAW",
+    "CompressionPolicy", "compression_from_conf", "frame_chunk_flags",
+    "frame_compress", "frame_decompress", "frame_uncompressed_size",
+    "CompressedServe", "CompressedServeCache",
+]

@@ -1,0 +1,136 @@
+"""Codec SPI for shuffle/spill buffer compression.
+
+TPU-native analogue of the reference's TableCompressionCodec SPI
+(sql-plugin/.../rapids/TableCompressionCodec.scala — pluggable
+lz4/zstd/copy codecs selected by `spark.rapids.shuffle.compression.codec`;
+GpuCompressedColumnVector carries the codec id in the table meta).  The
+reference compresses on-GPU with nvcomp; there is no TPU-side nvcomp, so
+the honest placement is the HOST boundary every shuffle/spill byte
+already crosses (batch_to_host / the bounce-buffer staging), using
+pyarrow's C++ codecs — the same GIL-releasing entry points the parquet
+reader already trusts (io/parquet_device.py _decompress), so chunk
+(de)compression parallelizes on a thread pool.
+
+A `Codec` is a one-shot block transform; the chunked *framed* container
+that makes large leaves parallel and streamable lives in framed.py.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+log = logging.getLogger("spark_rapids_tpu.compress")
+
+
+class CodecError(RuntimeError):
+    """A codec failed to round-trip bytes it was handed.  When the input
+    already passed checksum verification this means a codec/version bug,
+    not data corruption; when verification is disabled it is the typed
+    surface corrupt compressed bytes raise through."""
+
+
+class Codec:
+    """One-shot block codec (TableCompressionCodec analogue)."""
+
+    name: str = "?"
+
+    def compress(self, data) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, data, uncompressed_size: int) -> bytes:
+        raise NotImplementedError
+
+
+class CopyCodec(Codec):
+    """The `none` codec: a passthrough copy, so every conf/negotiation
+    path has a real object to talk to (reference: CopyCompressionCodec)."""
+
+    name = "none"
+
+    def compress(self, data) -> bytes:
+        return bytes(data)
+
+    def decompress(self, data, uncompressed_size: int) -> bytes:
+        out = bytes(data)
+        if len(out) != uncompressed_size:
+            raise CodecError(
+                f"copy codec size mismatch: {len(out)} != "
+                f"{uncompressed_size}")
+        return out
+
+
+class ArrowCodec(Codec):
+    """lz4/zstd/snappy through pyarrow's C++ codecs.  The codec calls
+    release the GIL (proven by the parquet reader's decompression pool),
+    which is what lets framed.py overlap chunk compression with socket
+    send/recv on a side thread pool."""
+
+    def __init__(self, name: str, arrow_name: Optional[str] = None,
+                 level: Optional[int] = None):
+        import pyarrow as pa
+        self.name = name
+        self._codec = pa.Codec(arrow_name or name, compression_level=level)
+
+    def compress(self, data) -> bytes:
+        return self._codec.compress(data, asbytes=True)
+
+    def decompress(self, data, uncompressed_size: int) -> bytes:
+        try:
+            return self._codec.decompress(
+                data, decompressed_size=uncompressed_size, asbytes=True)
+        except Exception as e:  # noqa: BLE001 — arrow raises several types
+            raise CodecError(
+                f"{self.name} decompress of {len(data)}B -> "
+                f"{uncompressed_size}B failed: {e!r}") from e
+
+
+# ---- registry ---------------------------------------------------------------
+
+# conf/wire name -> factory; instances are cached (codecs are stateless)
+_FACTORIES = {
+    "none": CopyCodec,
+    "copy": CopyCodec,  # the reference's name for the passthrough codec
+    "lz4": lambda: ArrowCodec("lz4"),
+    "zstd": lambda: ArrowCodec("zstd"),
+    "snappy": lambda: ArrowCodec("snappy"),
+}
+_INSTANCES: Dict[str, Codec] = {}
+
+
+def codec_names() -> List[str]:
+    return sorted(set(_FACTORIES) - {"copy"})
+
+
+def is_codec_available(name: str) -> bool:
+    """Can this process actually construct the named codec?  (The image
+    may lack a compression library; negotiation must know, not assume.)"""
+    try:
+        resolve_codec(name)
+        return True
+    except (ValueError, ImportError, OSError):
+        return False
+    except Exception:  # noqa: BLE001 — an unbuildable codec is unavailable
+        return False
+
+
+def available_codecs() -> List[str]:
+    """The codec names this host can serve/decode — recorded in bench
+    artifacts and answered during peer negotiation."""
+    return [n for n in codec_names() if is_codec_available(n)]
+
+
+def resolve_codec(name: str) -> Codec:
+    """Named codec instance.  Unknown names raise ValueError so a typo'd
+    conf fails loudly (mirrors integrity.resolve_hasher)."""
+    key = (name or "none").strip().lower()
+    if key in ("", "off"):
+        key = "none"
+    codec = _INSTANCES.get(key)
+    if codec is None:
+        factory = _FACTORIES.get(key)
+        if factory is None:
+            raise ValueError(
+                f"unknown compression codec {name!r} "
+                f"({'|'.join(codec_names())})")
+        codec = _INSTANCES[key] = factory()
+    return codec

@@ -1,0 +1,134 @@
+"""Serve-side compressed-leaf cache for the shuffle transports.
+
+The wire protocol streams a buffer's leaves in bounce-buffer-sized
+chunks; with compression negotiated, those chunks come out of the leaf's
+FRAMED COMPRESSED form instead of the raw bytes.  Compressing per bounce
+chunk would re-run the codec for every 1MB slice of every retry, so the
+server compresses each (buffer, codec) ONCE and serves every chunk/shm
+fill/refetch from the cached frames — the analogue of the reference's
+BufferSendState staging compressed tables through send bounce buffers.
+
+Checksums over the COMPRESSED frames are established here, at the
+compression boundary, and travel in the layout response: the reader
+verifies frames before its decompressor ever sees them, extending the
+PR-4 integrity ladder rather than bypassing it.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .codec import is_codec_available, resolve_codec
+from .framed import CompressionPolicy
+
+
+@dataclass
+class CompressedServe:
+    """One buffer's leaves, framed with one codec, ready to stream."""
+    codec: str
+    leaves: List[np.ndarray]          # framed compressed forms, flat u8
+    sizes: List[int]                  # per-leaf framed nbytes
+    checksums: Optional[Tuple[int, ...]]  # digests over the FRAMES
+    algorithm: Optional[str]
+    raw_bytes: int
+    comp_bytes: int
+
+    def descriptor(self) -> dict:
+        """The layout-response record the reader negotiates on."""
+        return {"codec": self.codec, "sizes": list(self.sizes),
+                "checksums": (list(self.checksums)
+                              if self.checksums is not None else None),
+                "algorithm": self.algorithm}
+
+
+class CompressedServeCache:
+    """Bounded (buffer_id, codec) -> CompressedServe cache, mirroring the
+    raw serving cache in shuffle/manager.ShuffleServer."""
+
+    def __init__(self, policy: CompressionPolicy, integrity=None,
+                 capacity: int = 16):
+        from collections import OrderedDict
+        self.policy = policy
+        self.integrity = integrity    # ChecksumPolicy or None
+        # LRU, not FIFO: the serve loop calls get() once per BOUNCE
+        # CHUNK of a stream, so the entry a stream is mid-way through
+        # must be the last thing evicted — FIFO under > capacity
+        # concurrent streams would recompress the whole buffer per chunk
+        self.capacity = capacity
+        self._cache: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def peek(self, buffer_id: int,
+             codec_name: Optional[str]) -> Optional[CompressedServe]:
+        """Cached entry or None — never compresses (metadata responses
+        report framed sizes only where a serve already built them)."""
+        with self._lock:
+            return self._cache.get((buffer_id, codec_name))
+
+    def get(self, buffer_id: int, codec_name: str,
+            leaves: List[np.ndarray]) -> Optional[CompressedServe]:
+        """Framed form of `leaves` under the REQUESTED codec, or None
+        when this process cannot encode it (the caller answers raw and
+        counts the fallback — the typed negotiation miss)."""
+        key = (buffer_id, codec_name)
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                self._cache.move_to_end(key)
+                return hit
+        if codec_name == "none" or not is_codec_available(codec_name):
+            return None
+        codec = resolve_codec(codec_name)
+        raw_bytes = int(sum(a.nbytes for a in leaves))
+        if self.policy.metrics is not None:
+            from ..metrics import names as MN
+            with self.policy.metrics.timer(MN.COMPRESSION_TIME):
+                frames = [_frame(self.policy, codec, a) for a in leaves]
+        else:
+            frames = [_frame(self.policy, codec, a) for a in leaves]
+        sums = None
+        algo = None
+        if self.integrity is not None and self.integrity.enabled:
+            sums = tuple(int(s)
+                         for s in self.integrity.checksum_leaves(frames))
+            algo = self.integrity.algorithm
+        entry = CompressedServe(
+            codec=codec_name, leaves=frames,
+            sizes=[f.nbytes for f in frames], checksums=sums,
+            algorithm=algo, raw_bytes=raw_bytes,
+            comp_bytes=int(sum(f.nbytes for f in frames)))
+        self.policy.record_ratio(entry.raw_bytes, entry.comp_bytes)
+        if self.policy.metrics is not None:
+            from ..metrics import names as MN
+            self.policy.metrics.add(MN.COMPRESSED_SHUFFLE_BYTES_WRITTEN,
+                                    entry.comp_bytes)
+        from ..metrics.journal import journal_event
+        journal_event("compress", "serveCompress", buffer=buffer_id,
+                      codec=codec_name, raw_bytes=entry.raw_bytes,
+                      comp_bytes=entry.comp_bytes,
+                      ratio=round(entry.raw_bytes
+                                  / max(1, entry.comp_bytes), 3))
+        with self._lock:
+            while len(self._cache) >= self.capacity:
+                self._cache.popitem(last=False)  # least recently served
+            self._cache[key] = entry
+        return entry
+
+    def drop(self, buffer_id: int) -> None:
+        with self._lock:
+            for key in [k for k in self._cache if k[0] == buffer_id]:
+                self._cache.pop(key, None)
+
+    def invalidate(self, buffer_ids) -> None:
+        ids = set(buffer_ids)
+        with self._lock:
+            for key in [k for k in self._cache if k[0] in ids]:
+                self._cache.pop(key, None)
+
+
+def _frame(policy: CompressionPolicy, codec, a: np.ndarray) -> np.ndarray:
+    from .framed import frame_compress
+    return frame_compress(codec, a, policy.chunk_size, policy.min_size)

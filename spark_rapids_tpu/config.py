@@ -434,6 +434,50 @@ SHUFFLE_CHECKSUM_VERIFY_LOCAL = _conf(
     "host/disk-tier spilled buffers).  Off by default: local reads never "
     "cross a wire, so this only guards against host-memory rot at extra "
     "read cost.", _to_bool)
+SHUFFLE_COMPRESSION_CODEC = _conf(
+    "spark.rapids.shuffle.compression.codec", "none",
+    "Codec for shuffle buffers crossing the wire or served from spill "
+    "tiers: lz4, zstd, snappy, or none (reference: "
+    "spark.rapids.shuffle.compression.codec / TableCompressionCodec).  "
+    "Leaves are compressed into a chunked framed format so chunks "
+    "(de)compress in parallel on a side thread pool overlapped with "
+    "socket send/recv; incompressible chunks are stored raw.  The codec "
+    "is negotiated per fetch: a peer that cannot encode the requested "
+    "codec answers raw (counted in numCompressionFallbacks).  Checksums "
+    "cover the compressed frames, so corrupt bytes are detected before "
+    "they reach a decompressor.  `none` keeps today's raw wire path.",
+    str)
+SHUFFLE_COMPRESSION_CHUNK_SIZE = _conf(
+    "spark.rapids.shuffle.compression.chunkSizeBytes", 1 << 20,
+    "Chunk size of the framed compression container (shuffle AND spill "
+    "tiers).  Smaller chunks parallelize better across the codec thread "
+    "pool and bound the raw-escape granularity; larger chunks compress "
+    "slightly better.", to_bytes)
+SHUFFLE_COMPRESSION_MIN_SIZE = _conf(
+    "spark.rapids.shuffle.compression.minSizeBytes", 1 << 10,
+    "Leaves smaller than this skip the codec entirely (framed with raw "
+    "chunks): below it the per-call codec overhead outweighs any wire/"
+    "disk savings.", to_bytes)
+SPILL_COMPRESSION_CODEC = _conf(
+    "spark.rapids.memory.spill.compression.codec", "none",
+    "Codec for host->disk spill files: lz4, zstd, snappy, or none.  "
+    "Conf'd independently of the shuffle wire codec; shares "
+    "spark.rapids.shuffle.compression.{chunkSizeBytes,minSizeBytes}.  "
+    "Spill-time checksums are recorded over BOTH forms: the compressed "
+    "disk image is verified before decompression at disk-read/unspill, "
+    "and the decompressed leaves are verified against the original "
+    "spill digests after.", str)
+SHUFFLE_BOUNCE_POOL_SIZE = _conf(
+    "spark.rapids.shuffle.bounce.poolSizeBytes", 8 << 20,
+    "Size of the pre-allocated host bounce-buffer staging pool every "
+    "shuffle transport sub-allocates transfer slices from "
+    "(BounceBufferManager analogue).  "
+    "spark.rapids.memory.pinnedPool.size, when set, overrides this.",
+    to_bytes)
+SHUFFLE_BOUNCE_CHUNK_SIZE = _conf(
+    "spark.rapids.shuffle.bounce.chunkSizeBytes", 1 << 20,
+    "Size of one bounce-buffer transfer slice: shuffle data frames "
+    "cross the wire in chunks of at most this many bytes.", to_bytes)
 SHUFFLE_MAX_REFETCH = _conf(
     "spark.rapids.shuffle.maxRefetchAttempts", 2,
     "Refetch attempts for a shuffle buffer whose checksum verification "

@@ -172,22 +172,38 @@ def write_leaves(path: str, leaves: List[np.ndarray]) -> int:
     return spill_write(path, flat)
 
 
+def leaf_shapes(meta: BatchMeta) -> List[Tuple[Tuple[int, ...], str]]:
+    """(shape, numpy dtype str) per leaf, in flat-image order (each
+    column's data/valid[/lengths], then the sel leaf) — the one place the
+    leaf walk order is defined, shared by the raw and compressed disk
+    readers."""
+    out: List[Tuple[Tuple[int, ...], str]] = []
+    for lm in meta.leaf_meta:
+        out.extend(zip(lm.shapes, lm.np_dtypes))
+    out.append((meta.sel_shape, np.dtype(np.bool_).str))
+    return out
+
+
+def shape_leaves(flats: List[np.ndarray],
+                 meta: BatchMeta) -> List[np.ndarray]:
+    """Per-leaf flat uint8 buffers -> typed, shaped leaf arrays (the
+    reconstruction half of the BatchMeta recipe)."""
+    leaves: List[np.ndarray] = []
+    for flat, (shape, ds) in zip(flats, leaf_shapes(meta)):
+        leaves.append(np.ascontiguousarray(flat).view(
+            np.dtype(ds)).reshape(shape))
+    return leaves
+
+
 def read_leaves(path: str, meta: BatchMeta) -> List[np.ndarray]:
     from ..native import spill_read
     leaves: List[np.ndarray] = []
     raw = spill_read(path, meta.size_bytes)
     off = 0
-    for lm in meta.leaf_meta:
-        for shape, ds in zip(lm.shapes, lm.np_dtypes):
-            dt = np.dtype(ds)
-            n = int(np.prod(shape)) if shape else 1
-            nb = n * dt.itemsize
-            leaves.append(np.frombuffer(raw, dtype=dt, count=n,
-                                        offset=off).reshape(shape))
-            off += nb
-    # sel leaf
-    dt = np.dtype(np.bool_)
-    n = int(np.prod(meta.sel_shape))
-    leaves.append(np.frombuffer(raw, dtype=dt, count=n,
-                                offset=off).reshape(meta.sel_shape))
+    for shape, ds in leaf_shapes(meta):
+        dt = np.dtype(ds)
+        n = int(np.prod(shape)) if shape else 1
+        leaves.append(np.frombuffer(raw, dtype=dt, count=n,
+                                    offset=off).reshape(shape))
+        off += n * dt.itemsize
     return leaves

@@ -26,7 +26,7 @@ from ..config import (CONCURRENT_TPU_TASKS, HOST_SPILL_STORAGE_SIZE,
 from ..metrics import names as MN
 from ..metrics.journal import journal_event
 from ..utils import faults
-from .buffer import SpillPriorities, StorageTier, host_to_batch, read_leaves
+from .buffer import SpillPriorities, StorageTier, host_to_batch
 from .retry import RetryOOM
 from .semaphore import TpuSemaphore
 from .stores import (BufferCatalog, DeviceMemoryStore, DiskStore,
@@ -105,6 +105,14 @@ class TpuRuntime:
             bool(self.conf.get(SPILL_CHECKSUM_ENABLED)),
             str(self.conf.get(SHUFFLE_CHECKSUM_ALGO)),
             metrics=self.metrics)
+        # spill compression (compress/): host->disk writes run through a
+        # codec when spark.rapids.memory.spill.compression.codec says so,
+        # independently of the shuffle wire codec
+        from ..compress import compression_from_conf
+        from ..config import SPILL_COMPRESSION_CODEC
+        self.catalog.compression = compression_from_conf(
+            self.conf, metrics=self.metrics,
+            codec_entry=SPILL_COMPRESSION_CODEC)
         self.device_store = DeviceMemoryStore(self.catalog)
         self.host_store = HostMemoryStore(
             self.catalog, int(self.conf.get(HOST_SPILL_STORAGE_SIZE)))
@@ -187,7 +195,7 @@ class TpuRuntime:
         device tier so the HBM pool keeps accounting for exactly one copy
         (unlike the reference, which hands out an untracked transient device
         copy — RMM tracks that copy for it; our accounting pool must)."""
-        from .stores import verify_buffer_leaves
+        from .stores import read_spilled_leaves, verify_buffer_leaves
         with buf.lock:
             if buf.tier == StorageTier.DEVICE:
                 return buf.device_batch
@@ -196,7 +204,10 @@ class TpuRuntime:
                 verify_buffer_leaves(self.catalog, buf, leaves,
                                      site="unspill_host")
             else:
-                leaves, src = read_leaves(buf.disk_path, buf.meta), \
+                # read_spilled_leaves verifies a COMPRESSED image before
+                # decompressing; the decompressed (or raw) leaves then
+                # re-verify against the original spill digests here
+                leaves, src = read_spilled_leaves(self.catalog, buf), \
                     self.disk_store
                 verify_buffer_leaves(self.catalog, buf, leaves,
                                      site="unspill_disk")

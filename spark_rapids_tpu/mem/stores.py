@@ -58,6 +58,63 @@ def verify_buffer_leaves(catalog: "BufferCatalog", buf: "SpillableBuffer",
         computed=got)
 
 
+def read_spilled_leaves(catalog: "BufferCatalog",
+                        buf: "SpillableBuffer") -> List:
+    """Disk-tier leaves of a buffer, decompressing when the spill file
+    was written through a codec (HostMemoryStore spill compression).
+
+    Ladder order matters: the COMPRESSED image verifies against its
+    spill-time digests FIRST — a flipped bit in the file raises a typed
+    CorruptBuffer (site `disk_read`) and never reaches a decompressor —
+    and only then do the decompressed leaves go back to the caller, whose
+    existing verify_buffer_leaves pass re-checks them against the
+    original (uncompressed) spill digests."""
+    import numpy as np
+
+    from .buffer import read_leaves, shape_leaves
+    if buf.disk_codec is None:
+        return read_leaves(buf.disk_path, buf.meta)
+    from ..compress import resolve_codec
+    from ..native import spill_read
+    sizes = buf.disk_comp_sizes
+    raw = spill_read(buf.disk_path, sum(sizes))
+    frames = []
+    off = 0
+    for nb in sizes:
+        frames.append(np.frombuffer(raw, np.uint8, count=nb, offset=off))
+        off += nb
+    policy = getattr(catalog, "integrity", None)
+    if policy is not None and policy.enabled \
+            and buf.disk_checksums is not None:
+        bad = policy.verify_leaves(frames, buf.disk_checksums)
+        if bad is not None:
+            leaf, want, got = bad
+            if policy.metrics is not None:
+                from ..metrics import names as MN
+                policy.metrics.add(MN.NUM_CHECKSUM_MISMATCHES, 1)
+            from ..metrics.journal import journal_event
+            journal_event("corruption", "spillChecksumMismatch",
+                          buffer=buf.id, leaf=leaf, site="disk_read",
+                          algorithm=policy.algorithm, expected=want,
+                          computed=got, codec=buf.disk_codec)
+            raise CorruptBuffer(
+                f"buffer {buf.id} compressed spill leaf {leaf} failed "
+                f"{policy.algorithm} verification at disk_read: expected "
+                f"{want:#x}, computed {got:#x}", buffer_id=buf.id,
+                leaf=leaf, site="disk_read", expected=want, computed=got)
+    cpol = getattr(catalog, "compression", None)
+    codec = resolve_codec(buf.disk_codec)
+    if cpol is not None:
+        flats = cpol.decompress_leaves(frames, codec)
+        if cpol.metrics is not None:
+            from ..metrics import names as MN
+            cpol.metrics.add(MN.COMPRESSED_SPILL_BYTES_READ, sum(sizes))
+    else:
+        from ..compress import frame_decompress
+        flats = [frame_decompress(codec, f) for f in frames]
+    return shape_leaves(flats, buf.meta)
+
+
 class SpillableBuffer:
     """A registered, spillable columnar batch.
 
@@ -85,6 +142,13 @@ class SpillableBuffer:
         # on every later movement of the host/disk form (stores.py
         # verify_buffer_leaves) and cleared on re-promotion to device
         self.host_checksums = None
+        # spill compression (compress/): when the host->disk write ran
+        # through a codec, the file holds FRAMED leaves — codec name,
+        # per-leaf framed sizes (the file layout), and digests over the
+        # compressed image verified at disk read BEFORE decompression
+        self.disk_codec: Optional[str] = None
+        self.disk_comp_sizes: Optional[List[int]] = None
+        self.disk_checksums = None
 
     @property
     def size_bytes(self) -> int:
@@ -266,7 +330,35 @@ class HostMemoryStore(BufferStore):
         verify_buffer_leaves(self.catalog, buf, buf.host_leaves,
                              site="host_to_disk")
         path = dest.path_for(buf.id)
-        write_leaves(path, buf.host_leaves)
+        cpol = getattr(self.catalog, "compression", None)
+        if cpol is not None and cpol.enabled:
+            # spill compression: the disk image holds FRAMED leaves.
+            # Digests over the compressed form are recorded here (before
+            # write_leaves' disk injection point), so rot in the file is
+            # caught at read time before any decompressor sees it; the
+            # original host_checksums still verify the decompressed
+            # leaves after, closing the loop end to end.
+            frames = cpol.compress_leaves(buf.host_leaves)
+            policy = getattr(self.catalog, "integrity", None)
+            if policy is not None and policy.enabled:
+                buf.disk_checksums = tuple(policy.checksum_leaves(frames))
+            buf.disk_codec = cpol.codec_name
+            buf.disk_comp_sizes = [f.nbytes for f in frames]
+            raw_total = host_leaves_nbytes(buf.host_leaves)
+            comp_total = sum(buf.disk_comp_sizes)
+            cpol.record_ratio(raw_total, comp_total)
+            if cpol.metrics is not None:
+                from ..metrics import names as MN
+                cpol.metrics.add(MN.COMPRESSED_SPILL_BYTES_WRITTEN,
+                                 comp_total)
+            from ..metrics.journal import journal_event
+            journal_event("compress", "spillCompress", buffer=buf.id,
+                          codec=cpol.codec_name, raw_bytes=raw_total,
+                          comp_bytes=comp_total,
+                          ratio=round(raw_total / max(1, comp_total), 3))
+            write_leaves(path, frames)
+        else:
+            write_leaves(path, buf.host_leaves)
         buf.disk_path = path
         buf.host_leaves = None
 
@@ -292,6 +384,9 @@ class DiskStore(BufferStore):
         if buf.disk_path and os.path.exists(buf.disk_path):
             os.unlink(buf.disk_path)
         buf.disk_path = None
+        buf.disk_codec = None
+        buf.disk_comp_sizes = None
+        buf.disk_checksums = None
 
 
 class BufferCatalog:
@@ -301,6 +396,9 @@ class BufferCatalog:
     # spill-path ChecksumPolicy (mem/integrity.py), installed by
     # TpuRuntime; None = no spill checksumming (bare-store unit tests)
     integrity = None
+    # spill-path CompressionPolicy (compress/), installed by TpuRuntime;
+    # None = uncompressed spill files (bare-store unit tests)
+    compression = None
 
     def __init__(self):
         self._buffers: Dict[int, SpillableBuffer] = {}

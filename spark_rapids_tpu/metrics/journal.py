@@ -11,7 +11,8 @@ Record schema (one JSON object per line):
 
   ts     monotonic nanoseconds (time.monotonic_ns; per-process clock)
   ev     "B" (span begin) | "E" (span end) | "I" (instant event)
-  kind   query|stage|operator|retry|spill|fetch|metric|fallback|replan
+  kind   query|stage|operator|retry|spill|fetch|metric|fallback|replan|
+         corruption|refetch|recompute|compress
   name   human label (operator describe(), retry block name, ...)
   id     span/event id, unique within the journal, increasing
   parent parent span id or null (operator spans parent to the enclosing
@@ -39,7 +40,10 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # corruption = a checksum mismatch (with its writer-side
                # classification), refetch = a transient-corruption retry,
                # recompute = a lost map output being rebuilt from lineage
-               "corruption", "refetch", "recompute")
+               "corruption", "refetch", "recompute",
+               # compress = a buffer (de)compressed at the shuffle-serve
+               # or spill boundary, with codec + raw/physical bytes
+               "compress")
 
 
 class EventJournal:

@@ -187,6 +187,38 @@ CHECKSUM_TIME = register_metric(
     "checksumTime", TIMER, MODERATE,
     "time spent computing and verifying shuffle/spill checksums")
 
+# --- shuffle/spill compression (compress/) -----------------------------------
+COMPRESSED_SHUFFLE_BYTES_WRITTEN = register_metric(
+    "compressedShuffleBytesWritten", COUNTER, ESSENTIAL,
+    "physical (compressed) bytes of shuffle buffers served to peers; "
+    "compare with bytes_sent for the wire-level view — AQE map statistics "
+    "deliberately keep LOGICAL (uncompressed) sizes so re-planning is "
+    "codec-invariant")
+COMPRESSED_SHUFFLE_BYTES_READ = register_metric(
+    "compressedShuffleBytesRead", COUNTER, ESSENTIAL,
+    "physical (compressed) bytes of shuffle buffers fetched from peers "
+    "before decompression")
+COMPRESSED_SPILL_BYTES_WRITTEN = register_metric(
+    "compressedSpillBytesWritten", COUNTER, ESSENTIAL,
+    "physical (compressed) bytes written to disk by the spill tier")
+COMPRESSED_SPILL_BYTES_READ = register_metric(
+    "compressedSpillBytesRead", COUNTER, ESSENTIAL,
+    "physical (compressed) bytes read back from compressed spill files")
+NUM_COMPRESSION_FALLBACKS = register_metric(
+    "numCompressionFallbacks", COUNTER, ESSENTIAL,
+    "fetches that negotiated DOWN to the raw wire format because the "
+    "peer could not serve the requested codec")
+COMPRESSION_TIME = register_metric(
+    "compressionTime", TIMER, MODERATE,
+    "time spent compressing shuffle/spill leaves into framed chunks")
+DECOMPRESSION_TIME = register_metric(
+    "decompressionTime", TIMER, MODERATE,
+    "time spent decompressing framed shuffle/spill leaves")
+COMPRESSION_RATIO = register_metric(
+    "compressionRatio", GAUGE, MODERATE,
+    "best observed raw:compressed ratio of a compressed buffer "
+    "(high-water gauge, like peakDevMemory)")
+
 # --- adaptive query execution (adaptive/) -----------------------------------
 NUM_COALESCED_PARTITIONS = register_metric(
     "numCoalescedPartitions", COUNTER, ESSENTIAL,
@@ -247,6 +279,13 @@ TRANSPORT_COUNTERS = {
                            "failed at this transport's clients",
     "corruption_diagnoses": "writer-side re-hash diagnosis round trips "
                             "served after a reader checksum mismatch",
+    "compressed_bytes_sent": "payload bytes sent that rode a negotiated "
+                             "compression codec (physical, post-codec)",
+    "compressed_bytes_received": "payload bytes received that rode a "
+                                 "negotiated compression codec (physical, "
+                                 "pre-decompress)",
+    "compression_fallbacks": "fetches the peer answered RAW after this "
+                             "side requested a codec it could not serve",
 }
 
 # --- runtime pool gauges (mem/runtime.py pool_stats()) ----------------------

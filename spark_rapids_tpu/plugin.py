@@ -76,8 +76,18 @@ class TpuCluster:
                      else conf.get(C.CLUSTER_EXECUTORS))
         self.driver = TpuDriverPlugin(conf)
         self.driver.init()
+        pinned = int(conf.get(C.PINNED_POOL_SIZE))
         self.transport = IciShuffleTransport(
-            max_inflight_bytes=int(conf.get(C.SHUFFLE_MAX_RECV_INFLIGHT)))
+            max_inflight_bytes=int(conf.get(C.SHUFFLE_MAX_RECV_INFLIGHT)),
+            # same staging-pool rule as every other transport bring-up:
+            # bounce confs are the source of truth, pinned pool overrides
+            pool_size=pinned if pinned > 0
+            else int(conf.get(C.SHUFFLE_BOUNCE_POOL_SIZE)),
+            chunk_size=int(conf.get(C.SHUFFLE_BOUNCE_CHUNK_SIZE)))
+        # adopt the session conf on the shared wire: checksum algorithm
+        # and the negotiated compression codec (compress/) — without this
+        # the cluster transport would silently keep the defaults
+        self.transport.configure(conf)
         # N executors share ONE device WITH the driving session's compute
         # pool (engine.TpuSession.runtime, which halves itself in cluster
         # mode): the executors split one half of the allocFraction budget,

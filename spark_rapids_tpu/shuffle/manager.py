@@ -29,7 +29,7 @@ from ..config import (PINNED_POOL_SIZE, SHUFFLE_CHECKSUM_VERIFY_LOCAL,
                       SHUFFLE_MAX_REFETCH, SHUFFLE_TRANSPORT_CLASS,
                       TpuConf)
 from ..mem.buffer import (SpillPriorities, StorageTier, batch_to_host,
-                          host_to_batch, read_leaves)
+                          host_to_batch)
 from ..mem.integrity import (BufferGone, CorruptBuffer, CorruptShuffleBlock,
                              FetchFailed, policy_from_conf)
 from ..mem.runtime import TpuRuntime
@@ -50,12 +50,27 @@ class ShuffleServer:
     possibly-spilled buffers and streams them through bounce buffers)."""
 
     def __init__(self, env: "ShuffleEnv"):
+        from ..compress import CompressedServeCache
         self.env = env
         self._cache: Dict[int, Tuple[list, object]] = {}
+        # framed compressed forms per (buffer, codec), compressed ONCE at
+        # first serve and re-served for every chunk/shm fill/refetch; the
+        # compressed-frame digests the reader pre-verifies come from here
+        self._comp_cache = CompressedServeCache(env.compression,
+                                                integrity=env.integrity)
         self._lock = threading.Lock()
 
     def handle_metadata_request(self, request: MetadataRequest
                                 ) -> MetadataResponse:
+        # codec negotiation opener: the reader names its preferred codec
+        # and every BlockMeta answers with what THIS server will actually
+        # frame the block's buffers with (the requested codec when the
+        # library is available here, raw otherwise); the layout response
+        # at fetch time confirms with per-leaf framed sizes + digests
+        from ..compress import is_codec_available
+        req_codec = getattr(request, "codec", None)
+        negotiated = (req_codec if req_codec not in (None, "none")
+                      and is_codec_available(req_codec) else None)
         blocks = request.blocks
         if blocks is None:  # wildcard discovery for one reduce partition
             blocks = self.env.catalog.blocks_for_reduce(
@@ -83,8 +98,13 @@ class ShuffleServer:
                     sizes.append(buf.size_bytes)
                 finally:
                     self.env.runtime.catalog.release(buf)
+            comp_sizes = [
+                (e.sizes if (e := self._comp_cache.peek(bid, negotiated))
+                 is not None else None)
+                for bid in buffer_ids] if negotiated else None
             out.append(BlockMeta(block, buffer_ids, metas, sizes,
-                                 checksums=sums))
+                                 checksums=sums, codec=negotiated,
+                                 compressed_sizes=comp_sizes))
         return MetadataResponse(out)
 
     def _leaves(self, buffer_id: int):
@@ -114,8 +134,11 @@ class ShuffleServer:
                     elif buf.tier == StorageTier.HOST:
                         leaves, meta = buf.host_leaves, buf.meta
                     else:
-                        leaves, meta = read_leaves(buf.disk_path, buf.meta), \
-                            buf.meta
+                        # decompresses a codec-spilled file, verifying
+                        # the compressed image first (read_spilled_leaves)
+                        from ..mem.stores import read_spilled_leaves
+                        leaves, meta = read_spilled_leaves(
+                            self.env.runtime.catalog, buf), buf.meta
                     if buf.tier != StorageTier.DEVICE:
                         try:
                             # raises a typed CorruptBuffer ->
@@ -167,12 +190,46 @@ class ShuffleServer:
         the _leaves call every layout request makes first."""
         return self.env.catalog.checksums_for(buffer_id)
 
+    def compressed_layout(self, buffer_id: int,
+                          codec_name: str) -> Optional[dict]:
+        """Frame a buffer's leaves with the READER-requested codec and
+        answer the negotiated wire contract: {codec, sizes, checksums,
+        algorithm} — digests over the COMPRESSED frames, established
+        right here at the compression boundary.  None when this process
+        cannot encode the codec (the reader falls back to raw, counted):
+        the typed negotiation miss, never an error."""
+        leaves, _meta = self._leaves(buffer_id)
+        entry = self._comp_cache.get(buffer_id, codec_name, leaves)
+        return entry.descriptor() if entry is not None else None
+
+    def copy_compressed_chunk(self, buffer_id: int, leaf_idx: int,
+                              offset: int, length: int, dest: np.ndarray,
+                              codec_name: str) -> None:
+        """Stage one bounce-buffer chunk of a leaf's FRAMED form (the
+        compressed analogue of copy_leaf_chunk)."""
+        leaves, _ = self._leaves(buffer_id)
+        entry = self._comp_cache.get(buffer_id, codec_name, leaves)
+        if entry is None:
+            # negotiation raced a codec going away (cannot happen in
+            # practice: availability is static per process) — typed, so
+            # the reader's ladder sees a clean buffer-gone
+            raise KeyError(f"buffer {buffer_id} has no {codec_name} "
+                           "compressed form")
+        dest[:length] = entry.leaves[leaf_idx][offset:offset + length]
+
     def diagnose_buffer(self, buffer_id: int):
         """Writer-side half of the corruption-site diagnosis
         (SPARK-36206): re-hash the LIVE copy a refetch would serve and
         compare with the recorded digests.  writer_ok=False means the
         writer's own data rotted — the reader must recompute the map
         fragment, not refetch."""
+        # a reader only asks for a diagnosis after ITS verify failed: if
+        # the rot lives in our cached compressed frames (digested at
+        # build time, so every re-serve fails identically), dropping the
+        # entries here lets the refetch recompress from the raw leaves
+        # and recover in ONE round instead of burning every refetch
+        # attempt into a map-fragment recompute
+        self._comp_cache.drop(buffer_id)
         policy = self.env.integrity
         rec = self.env.catalog.checksums_for(buffer_id)
         if not policy.enabled or rec is None:
@@ -204,6 +261,7 @@ class ShuffleServer:
     def done_serving(self, buffer_id: int) -> None:
         with self._lock:
             self._cache.pop(buffer_id, None)
+        self._comp_cache.drop(buffer_id)
 
     def invalidate(self, buffer_ids) -> None:
         """Drop serving-cache entries for removed buffers: a fetch racing
@@ -213,6 +271,7 @@ class ShuffleServer:
         with self._lock:
             for bid in buffer_ids:
                 self._cache.pop(bid, None)
+        self._comp_cache.invalidate(buffer_ids)
 
 
 class ShuffleEnv:
@@ -232,6 +291,12 @@ class ShuffleEnv:
         # refetch/diagnose/recompute ladder in _fetch_remote
         self.integrity = policy_from_conf(self.conf,
                                           metrics=runtime.metrics)
+        # wire compression policy (compress/): what this env's READS ask
+        # peers for, and the chunk/min-size parameters its SERVER frames
+        # with; spill compression is conf'd independently on the runtime
+        from ..compress import compression_from_conf
+        self.compression = compression_from_conf(self.conf,
+                                                 metrics=runtime.metrics)
         self.max_refetch = max(0, int(self.conf.get(SHUFFLE_MAX_REFETCH)))
         self.verify_local = bool(
             self.conf.get(SHUFFLE_CHECKSUM_VERIFY_LOCAL))
@@ -242,6 +307,12 @@ class ShuffleEnv:
         if transport is None:
             transport = self._resolve_transport()
         self.transport = transport
+        # the transport's fetch-side compression/decompression metrics
+        # land on this runtime's Metrics (shared transports aggregate
+        # across their envs, exactly like transport counters do)
+        tcomp = getattr(transport, "compression", None)
+        if tcomp is not None and tcomp.metrics is None:
+            tcomp.metrics = runtime.metrics
         self.server = ShuffleServer(self)
         transport.register_server(executor_id, self.server)
         # baseline (host-serialized) buffers share the buffer-id space with
@@ -257,11 +328,20 @@ class ShuffleEnv:
         RapidsConf.scala:505-510 + UCXShuffleTransport loading).  The pinned
         host pool conf sizes the transport's bounce-buffer staging area."""
         import importlib
+
+        from ..config import (SHUFFLE_BOUNCE_CHUNK_SIZE,
+                              SHUFFLE_BOUNCE_POOL_SIZE)
         name = str(self.conf.get(SHUFFLE_TRANSPORT_CLASS))
         mod_name, _, cls_name = name.rpartition(".")
         cls = getattr(importlib.import_module(mod_name), cls_name)
+        # bounce geometry comes from the conf registry (single source of
+        # truth, spark.rapids.shuffle.bounce.*); a configured pinned pool
+        # still overrides the staging-pool size as before
         kwargs = {"max_inflight_bytes":
-                  int(self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT))}
+                  int(self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
+                  "pool_size": int(self.conf.get(SHUFFLE_BOUNCE_POOL_SIZE)),
+                  "chunk_size":
+                  int(self.conf.get(SHUFFLE_BOUNCE_CHUNK_SIZE))}
         pinned = int(self.conf.get(PINNED_POOL_SIZE))
         if pinned > 0:
             kwargs["pool_size"] = pinned
@@ -438,11 +518,14 @@ class ShuffleEnv:
         output lost so the cluster recomputes the fragment."""
         from ..metrics.journal import journal_event
         try:
+            tcomp = getattr(self.transport, "compression", None)
             client = self.transport.make_client(peer)
             resp = client.fetch_metadata(MetadataRequest(
                 shuffle_id=shuffle_id, reduce_id=reduce_id,
                 map_lo=map_range[0] if map_range else None,
-                map_hi=map_range[1] if map_range else None))
+                map_hi=map_range[1] if map_range else None,
+                codec=tcomp.codec_name
+                if tcomp is not None and tcomp.enabled else None))
         except (ConnectionError, OSError, KeyError) as e:
             raise self._map_output_lost(peer, shuffle_id, reduce_id,
                                         "peer", e)

@@ -35,13 +35,15 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..compress import CompressionPolicy, resolve_codec
 from ..mem.integrity import (BufferGone, CorruptBuffer, CorruptShuffleBlock)
 from ..utils import faults
-from .transport import (AsyncLeafVerifier, BounceBufferPool, ChecksumPolicy,
+from .transport import (AsyncFramedReader, AsyncLeafVerifier,
+                        BounceBufferPool, ChecksumPolicy,
                         InflightThrottle, MetadataRequest, MetadataResponse,
                         ShuffleTransport, ShuffleTransportClient, Transaction,
                         TransactionCancelled, TransactionStatus,
-                        verify_fetched_leaf)
+                        decode_compressed_leaves, verify_fetched_leaf)
 
 log = logging.getLogger("spark_rapids_tpu.shuffle")
 
@@ -112,6 +114,22 @@ def recv_frame_into(sock: socket.socket, dest: np.ndarray, offset: int
             raise ConnectionError("peer closed mid-data")
         got += r
     return op, length, None
+
+
+def _unpack_fetch(payload: bytes) -> Tuple[int, Optional[str]]:
+    """OP_LAYOUT/OP_FETCH payload: a bare big-endian u64 buffer id (the
+    raw wire format, and what pre-compression peers send) or a pickled
+    (buffer_id, codec_name) pair asking for framed compressed leaves."""
+    if len(payload) == 8:
+        return struct.unpack(">Q", payload)[0], None
+    bid, codec = pickle.loads(payload)
+    return int(bid), codec
+
+
+def _pack_fetch(buffer_id: int, codec: Optional[str]) -> bytes:
+    if codec in (None, "none"):
+        return struct.pack(">Q", buffer_id)
+    return pickle.dumps((buffer_id, codec))
 
 
 def _raise_gone(payload: bytes, buffer_id: int) -> None:
@@ -209,21 +227,24 @@ class ShuffleSocketServer:
                     self.transport.count("metadata_served")
                     send_frame(conn, OP_META_RESP, pickle.dumps(resp))
                 elif op == OP_LAYOUT:
-                    (bid,) = struct.unpack(">Q", payload)
+                    bid, codec = _unpack_fetch(payload)
                     try:
                         layout, meta = self.server_obj.buffer_layout(bid)
                         sums = self._checksums_of(bid)
+                        comp = self._compressed_of(bid, codec)
                     except (KeyError, CorruptBuffer) as e:
                         self._send_gone(conn, bid, e)
                         continue
                     send_frame(conn, OP_LAYOUT_RESP,
-                               pickle.dumps((layout, meta, sums)))
+                               pickle.dumps((layout, meta, sums, comp)))
                 elif op == OP_FETCH:
-                    (bid,) = struct.unpack(">Q", payload)
-                    self._stream_buffer(conn, bid)
+                    bid, codec = _unpack_fetch(payload)
+                    self._stream_buffer(conn, bid, codec)
                 elif op == OP_FETCH_SHM:
-                    bid, shm_name = pickle.loads(payload)
-                    self._fill_shm(conn, bid, shm_name)
+                    rec = pickle.loads(payload)
+                    bid, shm_name = rec[0], rec[1]
+                    codec = rec[2] if len(rec) > 2 else None
+                    self._fill_shm(conn, bid, shm_name, codec)
                 elif op == OP_DIAG:
                     (bid,) = struct.unpack(">Q", payload)
                     self._handle_diag(conn, bid)
@@ -255,6 +276,19 @@ class ShuffleSocketServer:
         get = getattr(self.server_obj, "buffer_checksums", None)
         return get(bid) if get is not None else None
 
+    def _compressed_of(self, bid: int, codec: Optional[str]):
+        """Negotiation answer: the framed-compression descriptor
+        ({codec, sizes, checksums, algorithm}) when the reader asked for
+        a codec this server can encode, else None — the reader falls
+        back to the raw wire format and counts the miss."""
+        if codec in (None, "none"):
+            return None
+        get = getattr(self.server_obj, "compressed_layout", None)
+        # the fallback is counted by the CLIENT (the side whose request
+        # went unmet, matching the counter's documented semantics) —
+        # counting here too would double cluster-wide rollups
+        return get(bid, codec) if get is not None else None
+
     def _send_gone(self, conn: socket.socket, bid: int,
                    err: Exception) -> None:
         """Typed buffer-gone/corrupt frame for a serve that raced
@@ -281,10 +315,15 @@ class ShuffleSocketServer:
         self.transport.count("corruption_diagnoses")
         send_frame(conn, OP_DIAG_RESP, pickle.dumps(result))
 
-    def _stream_buffer(self, conn: socket.socket, bid: int) -> None:
+    def _stream_buffer(self, conn: socket.socket, bid: int,
+                       codec: Optional[str] = None) -> None:
         """Send every leaf of a buffer as bounce-buffer-sized DATA frames,
         in leaf order, then END (BufferSendState: acquire buffer from any
-        tier -> stage through send bounce buffers -> tagged sends).
+        tier -> stage through send bounce buffers -> tagged sends).  With
+        a negotiated codec the staged chunks come out of each leaf's
+        FRAMED COMPRESSED form (built once per buffer+codec, served for
+        every chunk and refetch) — the layout response already told the
+        reader the framed sizes and frame digests.
 
         A KeyError from the server object mid-stream (the buffer's shuffle
         was removed while we were serving it) becomes a typed OP_GONE
@@ -292,12 +331,26 @@ class ShuffleSocketServer:
         half-frame crash or a hang."""
         try:
             layout, _meta = self.server_obj.buffer_layout(bid)
+            comp = self._compressed_of(bid, codec)
         except (KeyError, CorruptBuffer) as e:
             self._send_gone(conn, bid, e)
             return
+        if comp is not None:
+            wire_sizes = comp["sizes"]
+
+            def copy_chunk(leaf_idx, off, length, view):
+                self.server_obj.copy_compressed_chunk(
+                    bid, leaf_idx, off, length, view, comp["codec"])
+        else:
+            wire_sizes = [nbytes for _shape, _dtype, nbytes in layout]
+
+            def copy_chunk(leaf_idx, off, length, view):
+                self.server_obj.copy_leaf_chunk(bid, leaf_idx, off,
+                                                length, view)
         pool = self.transport.pool
         chunk = self.transport.chunk_size
-        for leaf_idx, (_shape, _dtype, nbytes) in enumerate(layout):
+        sent = 0
+        for leaf_idx, nbytes in enumerate(wire_sizes):
             off = 0
             while off < nbytes:
                 length = min(chunk, nbytes - off)
@@ -305,24 +358,28 @@ class ShuffleSocketServer:
                 try:
                     view = pool.view(addr, length)
                     try:
-                        self.server_obj.copy_leaf_chunk(bid, leaf_idx, off,
-                                                        length, view)
+                        copy_chunk(leaf_idx, off, length, view)
                     except (KeyError, CorruptBuffer) as e:
                         self._send_gone(conn, bid, e)
                         return
                     # corruption injection point: the staged chunk IS the
                     # wire payload (anything flipped here crosses the
-                    # socket and must be caught by the reader's verify)
+                    # socket and must be caught by the reader's verify —
+                    # with compression on, a flipped COMPRESSED byte must
+                    # fail the frame digest before any decompressor)
                     faults.INJECTOR.on_corruptible("wire", view[:length])
                     send_frame(conn, OP_DATA, memoryview(view))
                 finally:
                     pool.release(addr)
                 off += length
+                sent += length
                 self.transport.count("bytes_sent", length)
+        if comp is not None:
+            self.transport.count("compressed_bytes_sent", sent)
         send_frame(conn, OP_END, b"")
 
     def _fill_shm(self, conn: socket.socket, bid: int,
-                  shm_path: str) -> None:
+                  shm_path: str, codec: Optional[str] = None) -> None:
         """Same-host fast path: copy each leaf ONCE into the client-owned
         /dev/shm segment instead of chunking through bounce buffers and
         the socket (the local-peer analogue of the reference's UCX
@@ -347,17 +404,29 @@ class ShuffleSocketServer:
         try:
             try:
                 layout, _meta = self.server_obj.buffer_layout(bid)
+                comp = self._compressed_of(bid, codec)
             except (KeyError, CorruptBuffer) as e:
                 self._send_gone(conn, bid, e)
                 return
+            if comp is not None:
+                wire_sizes = comp["sizes"]
+
+                def copy_leaf(leaf_idx, nbytes, view):
+                    self.server_obj.copy_compressed_chunk(
+                        bid, leaf_idx, 0, nbytes, view, comp["codec"])
+            else:
+                wire_sizes = [nb for _shape, _dtype, nb in layout]
+
+                def copy_leaf(leaf_idx, nbytes, view):
+                    self.server_obj.copy_leaf_chunk(bid, leaf_idx, 0,
+                                                    nbytes, view)
             off = 0
-            for leaf_idx, (_shape, _dtype, nbytes) in enumerate(layout):
+            for leaf_idx, nbytes in enumerate(wire_sizes):
                 view = np.frombuffer(mm, np.uint8, count=nbytes,
                                      offset=off)
                 try:
                     try:
-                        self.server_obj.copy_leaf_chunk(bid, leaf_idx, 0,
-                                                        nbytes, view)
+                        copy_leaf(leaf_idx, nbytes, view)
                     except (KeyError, CorruptBuffer) as e:
                         self._send_gone(conn, bid, e)
                         return
@@ -370,6 +439,8 @@ class ShuffleSocketServer:
                     del view
                 off += nbytes
             self.transport.count("bytes_sent", off)
+            if comp is not None:
+                self.transport.count("compressed_bytes_sent", off)
             self.transport.count("shm_fills")
             send_frame(conn, OP_END, b"")
         finally:
@@ -536,9 +607,13 @@ class SocketClient(ShuffleTransportClient):
         return pickle.loads(resp)
 
     def _fetch_buffer_shm(self, layout, meta, buffer_id: int, total: int,
-                          sums=None):
+                          sums=None, comp=None, comp_sums=None):
         """Local-peer fetch through a client-owned /dev/shm segment: one
-        server-side copy per leaf, no socket data frames.  Returns
+        server-side copy per leaf, no socket data frames.  With a
+        negotiated codec the segment holds FRAMED COMPRESSED leaves
+        (`total` is the framed size); frames verify against their
+        compression-boundary digests BEFORE decompression, and the
+        decompressed bytes against the canonical digests after.  Returns
         (leaves, meta) or None when shm is unavailable (caller streams)."""
         import mmap
         import tempfile
@@ -559,7 +634,10 @@ class SocketClient(ShuffleTransportClient):
                     faults.INJECTOR.on_net_op("fetch_shm")
                     sock = self._conn()
                     send_frame(sock, OP_FETCH_SHM,
-                               pickle.dumps((buffer_id, path)))
+                               pickle.dumps(
+                                   (buffer_id, path, comp["codec"])
+                                   if comp is not None
+                                   else (buffer_id, path)))
                     op, resp = recv_frame(sock)
             except (TimeoutError, ConnectionError, OSError) as e:
                 # single attempt: the caller streams over the socket
@@ -574,13 +652,15 @@ class SocketClient(ShuffleTransportClient):
                 _raise_gone(resp, buffer_id)
             if op != OP_END:
                 return None
+            wire_sizes = (comp["sizes"] if comp is not None
+                          else [nb for _, _, nb in layout])
             # copy out of the segment: a zero-copy variant (arrays
             # viewing the mmap with finalizer-managed lifetime) measured
             # no faster on loopback and leaked one fd per fetch — one
             # bounded memcpy per leaf is the honest cost
-            out: List[np.ndarray] = []
+            flats: List[np.ndarray] = []
             off = 0
-            for leaf_idx, (shape, dtype_str, nbytes) in enumerate(layout):
+            for leaf_idx, nbytes in enumerate(wire_sizes):
                 a = np.empty(nbytes, dtype=np.uint8)
                 src = np.frombuffer(mm, np.uint8, count=nbytes,
                                     offset=off)
@@ -588,15 +668,34 @@ class SocketClient(ShuffleTransportClient):
                     a[:] = src
                 finally:
                     del src  # release the mmap export before mm.close()
+                flats.append(a)
+                off += nbytes
+            self.transport.count("bytes_received", off)
+            policy = self.transport.integrity
+            out: List[np.ndarray] = []
+            if comp is not None:
+                # mismatches propagate to fetch_buffer's outer handler
+                # (counted + socket dropped there); a corrupt frame
+                # never reaches the decompressor
+                out = decode_compressed_leaves(
+                    flats, layout, resolve_codec(comp["codec"]),
+                    comp_sums, sums, policy, self.transport.compression,
+                    buffer_id, "shm")
+                self.transport.count("compressed_bytes_received", off)
+                cmetrics = self.transport.compression.metrics
+                if cmetrics is not None:
+                    from ..metrics import names as MN
+                    cmetrics.add(MN.COMPRESSED_SHUFFLE_BYTES_READ, off)
+                return out, meta
+            for leaf_idx, (shape, dtype_str, nbytes) in enumerate(layout):
+                a = flats[leaf_idx]
                 if sums is not None:
                     # a mismatch propagates to fetch_buffer's outer
                     # handler (counted + socket dropped there)
-                    verify_fetched_leaf(self.transport.integrity, a,
+                    verify_fetched_leaf(policy, a,
                                         sums[leaf_idx], buffer_id,
                                         leaf_idx, "shm")
                 out.append(a.view(np.dtype(dtype_str)).reshape(shape))
-                off += nbytes
-            self.transport.count("bytes_received", off)
             return out, meta
         finally:
             if mm is not None:
@@ -614,11 +713,14 @@ class SocketClient(ShuffleTransportClient):
         txn = self.transport.next_txn()
         deadline = (time.monotonic() + self.transport.txn_timeout
                     if self.transport.txn_timeout > 0 else None)
+        cpol = getattr(self.transport, "compression", None)
+        req_codec = (cpol.codec_name
+                     if cpol is not None and cpol.enabled else None)
         try:
             resp = self._retrying(
                 "layout",
                 lambda _s: self._request(OP_LAYOUT,
-                                         struct.pack(">Q", buffer_id),
+                                         _pack_fetch(buffer_id, req_codec),
                                          OP_LAYOUT_RESP, buffer_id),
                 deadline=deadline, txn=txn)
             unpacked = pickle.loads(resp)
@@ -631,32 +733,63 @@ class SocketClient(ShuffleTransportClient):
             if policy is not None and policy.enabled and rec is not None \
                     and rec[0] == policy.algorithm:
                 sums = rec[1]
-            total = sum(nb for _, _, nb in layout)
+            # codec negotiation outcome: the peer either confirmed our
+            # requested codec with framed sizes + frame digests, or it
+            # cannot encode it (no compress support / missing library)
+            # and we ride the raw wire format — typed fallback, counted
+            comp = unpacked[3] if len(unpacked) > 3 else None
+            if comp is not None and comp.get("codec") in (None, "none"):
+                comp = None
+            if req_codec is not None and comp is None:
+                self.transport.count("compression_fallbacks")
+                if cpol.metrics is not None:
+                    from ..metrics import names as MN
+                    cpol.metrics.add(MN.NUM_COMPRESSION_FALLBACKS, 1)
+            comp_sums = None
+            if comp is not None and policy is not None and policy.enabled \
+                    and comp.get("checksums") is not None \
+                    and comp.get("algorithm") == policy.algorithm:
+                comp_sums = comp["checksums"]
+            wire_sizes = (comp["sizes"] if comp is not None
+                          else [nb for _, _, nb in layout])
+            # inflight accounting covers what actually crosses the wire:
+            # framed (compressed) bytes when a codec was negotiated
+            total = sum(wire_sizes)
             self.transport.throttle.acquire(total)
             try:
                 if self.addr[0] in ("127.0.0.1", "localhost", "::1") \
                         and self.transport.shm_local:
                     got = self._fetch_buffer_shm(layout, meta, buffer_id,
-                                                 total, sums)
+                                                 total, sums, comp,
+                                                 comp_sums)
                     if got is not None:
                         txn.complete(total)
                         return got
 
                 def stream(sock) -> List[np.ndarray]:
                     send_frame(sock, OP_FETCH,
-                               struct.pack(">Q", buffer_id))
-                    out: List[np.ndarray] = []
-                    # chunk hashing rides a side thread, overlapped with
-                    # the recv loop (AsyncLeafVerifier) — verification
-                    # still completes BEFORE the bytes become a batch
-                    # (finish() below), it just never serializes behind
-                    # the wire
-                    verifier = (AsyncLeafVerifier(policy, sums, buffer_id,
-                                                  "wire")
-                                if sums is not None else None)
+                               _pack_fetch(buffer_id,
+                                           comp["codec"]
+                                           if comp is not None else None))
+                    # chunk hashing (and, with a codec, per-leaf verify +
+                    # decompress) rides a side thread, overlapped with
+                    # the recv loop — verification still completes BEFORE
+                    # the bytes become a batch (finish() below), it just
+                    # never serializes behind the wire; a corrupt frame
+                    # is rejected before any decompressor touches it
+                    if comp is not None:
+                        sink = AsyncFramedReader(
+                            policy, comp_sums, sums,
+                            resolve_codec(comp["codec"]), buffer_id,
+                            "wire")
+                    elif sums is not None:
+                        sink = AsyncLeafVerifier(policy, sums, buffer_id,
+                                                 "wire")
+                    else:
+                        sink = None
+                    dests: List[np.ndarray] = []
                     try:
-                        for leaf_idx, (shape, dtype_str, nbytes) \
-                                in enumerate(layout):
+                        for leaf_idx, nbytes in enumerate(wire_sizes):
                             dest = np.empty(nbytes, dtype=np.uint8)
                             off = 0
                             while off < nbytes:
@@ -674,27 +807,42 @@ class SocketClient(ShuffleTransportClient):
                                     raise ConnectionError(
                                         f"short buffer stream (op {op} "
                                         f"at {off}/{nbytes})")
-                                if verifier is not None:
-                                    verifier.feed(leaf_idx,
-                                                  dest[off:off + length])
+                                if sink is not None:
+                                    sink.feed(leaf_idx,
+                                              dest[off:off + length])
                                 off += length
                                 self.transport.count("bytes_received",
                                                      length)
-                            if verifier is not None:
-                                verifier.leaf_done(leaf_idx, dest)
-                            out.append(dest.view(np.dtype(dtype_str))
-                                       .reshape(shape))
+                            if sink is not None:
+                                sink.leaf_done(leaf_idx, dest)
+                            dests.append(dest)
                         op, _ = recv_frame(sock)
                         if op != OP_END:
                             raise ConnectionError(
                                 f"expected END, got {op}")
-                        if verifier is not None:
-                            verifier.finish()  # raises on mismatch
-                            verifier = None
-                        return out
+                        if comp is not None:
+                            flats = sink.finish()  # raises on mismatch
+                            sink = None
+                            self.transport.count(
+                                "compressed_bytes_received", total)
+                            if cpol.metrics is not None:
+                                from ..metrics import names as MN
+                                cpol.metrics.add(
+                                    MN.COMPRESSED_SHUFFLE_BYTES_READ,
+                                    total)
+                            return [flats[i].view(np.dtype(ds))
+                                    .reshape(sh)
+                                    for i, (sh, ds, _nb)
+                                    in enumerate(layout)]
+                        if sink is not None:
+                            sink.finish()  # raises on mismatch
+                            sink = None
+                        return [d.view(np.dtype(ds)).reshape(sh)
+                                for d, (sh, ds, _nb)
+                                in zip(dests, layout)]
                     finally:
-                        if verifier is not None:
-                            verifier.abort()
+                        if sink is not None:
+                            sink.abort()
 
                 out = self._retrying("fetch", stream, deadline=deadline,
                                      txn=txn)
@@ -785,7 +933,8 @@ class SocketTransport(ShuffleTransport):
     (host, port)) distributed by the cluster driver — the role MapStatus /
     the UCX management handshake plays for the reference."""
 
-    def __init__(self, pool_size: int = 8 << 20, chunk_size: int = 1 << 20,
+    def __init__(self, pool_size: Optional[int] = None,
+                 chunk_size: Optional[int] = None,
                  max_inflight_bytes: int = 4 << 20,
                  host: str = "127.0.0.1", port: int = 0,
                  rpc_handler: Optional[Callable] = None,
@@ -793,6 +942,14 @@ class SocketTransport(ShuffleTransport):
                  connect_timeout: float = 30.0, io_timeout: float = 60.0,
                  max_attempts: int = 4, backoff_base: float = 0.05,
                  backoff_cap: float = 2.0, txn_timeout: float = 600.0):
+        # bounce-pool geometry: ONE source of truth, the conf registry
+        # (spark.rapids.shuffle.bounce.poolSizeBytes/chunkSizeBytes);
+        # explicit arguments (tests, pinned-pool override) still win
+        from .. import config as C
+        if pool_size is None:
+            pool_size = int(C.SHUFFLE_BOUNCE_POOL_SIZE.default)
+        if chunk_size is None:
+            chunk_size = int(C.SHUFFLE_BOUNCE_CHUNK_SIZE.default)
         # measured on 128MB partitions (BENCH_WIRE.json): the pipelined
         # chunked stream does ~1.05 GB/s on loopback while the serial
         # fill-then-copy shm path does ~0.7 GB/s — so the stream is the
@@ -823,11 +980,16 @@ class SocketTransport(ShuffleTransport):
         # verifies every received leaf against the digests the layout
         # response carries; configure() adopts the session's conf
         self.integrity = ChecksumPolicy()
+        # wire compression (compress/): what this side's fetches request
+        # from peers; default none, configure() adopts
+        # spark.rapids.shuffle.compression.codec
+        self.compression = CompressionPolicy()
 
     def configure(self, conf) -> None:
         """Adopt retry/deadline knobs from a TpuConf (and arm the fault
         injector from its test confs)."""
         from .. import config as C
+        from ..compress import compression_from_conf
         from ..mem.integrity import policy_from_conf
         faults.INJECTOR.configure_from_conf(conf)
         self.connect_timeout = int(conf.get(C.SHUFFLE_CONNECT_TIMEOUT)) / 1e3
@@ -837,6 +999,8 @@ class SocketTransport(ShuffleTransport):
         self.backoff_cap = int(conf.get(C.SHUFFLE_RETRY_BACKOFF_CAP)) / 1e3
         self.txn_timeout = int(conf.get(C.SHUFFLE_TXN_TIMEOUT)) / 1e3
         self.integrity = policy_from_conf(conf)
+        self.compression = compression_from_conf(
+            conf, metrics=self.compression.metrics)
 
     def next_txn(self) -> Transaction:
         with self._lock:

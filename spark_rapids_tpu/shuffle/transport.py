@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..compress import CodecError, CompressionPolicy, frame_decompress
 from ..mem.address_space import AddressSpaceAllocator
 from ..mem.buffer import BatchMeta
 from ..mem.integrity import (BufferGone, ChecksumPolicy, CorruptBuffer,
@@ -85,6 +86,11 @@ class MetadataRequest:
     # slice fetch (adaptive/stats.py PartialReducerPartitionSpec)
     map_lo: Optional[int] = None
     map_hi: Optional[int] = None
+    # the codec this reader wants buffers framed with (compress/) — the
+    # negotiation opener; the peer answers with what it can actually
+    # serve per block (BlockMeta.codec) and confirms per fetch in the
+    # layout response.  None/"none" = raw.
+    codec: Optional[str] = None
 
 
 @dataclass
@@ -101,6 +107,15 @@ class BlockMeta:
     # fetch time is the authoritative source (it exists by then, the
     # server's _leaves call having just established it).
     checksums: Optional[List[Optional[tuple]]] = None
+    # negotiated compression: the codec the SERVER will frame these
+    # buffers with for this reader (None/"none" = raw — either nobody
+    # asked or the server cannot encode the requested codec), plus the
+    # per-buffer framed sizes where already known (compressed forms are
+    # built lazily at first serve, so sizes may be None until then).
+    # Like `checksums`, informational: the layout response at fetch time
+    # is the authoritative wire contract.
+    codec: Optional[str] = None
+    compressed_sizes: Optional[List[Optional[List[int]]]] = None
 
 
 @dataclass
@@ -208,6 +223,76 @@ def verify_fetched_leaf(policy: ChecksumPolicy, arr: np.ndarray,
         site=site, expected=want, computed=got)
 
 
+def decompress_verified_leaf(cpol, codec, frame: np.ndarray,
+                             policy: Optional[ChecksumPolicy], raw_sum,
+                             buffer_id: int, leaf_idx: int,
+                             path: str, frame_verified: bool
+                             ) -> np.ndarray:
+    """Decompress one ALREADY-digest-checked frame and verify the result
+    against the canonical (uncompressed) digest — the shared tail of the
+    compressed-fetch ladder (socket stream, shm fill, loopback chunks).
+
+    Error typing is the point: a frame that verified clean but will not
+    decode (or decodes to the wrong bytes) is conclusive WRITER-side rot
+    — the corruption predates the compression boundary, refetching
+    cannot help.  An UNVERIFIED frame (integrity off / algorithm
+    mismatch) that fails to decode gets the transit classification so a
+    refetch is at least attempted; either way the error is a typed
+    CorruptShuffleBlock the recovery ladder owns, never a bare
+    CodecError crash."""
+    try:
+        flat = (cpol.decompress_leaves([frame], codec)[0]
+                if cpol is not None else frame_decompress(codec, frame))
+    except CodecError as e:
+        raise CorruptShuffleBlock(
+            f"buffer {buffer_id} leaf {leaf_idx} failed to decompress: "
+            f"{e}", buffer_id=buffer_id, leaf=leaf_idx,
+            site="writer" if frame_verified else path) from e
+    if policy is not None and policy.enabled and raw_sum is not None:
+        got = policy.checksum_one(flat)
+        want = int(raw_sum)
+        if got != want:
+            # verified frame + wrong payload = rot predates compression
+            # (writer).  Unverified frame = the flip may have happened in
+            # transit and still decoded — transit classification, so the
+            # ladder refetches before escalating.
+            raise CorruptShuffleBlock(
+                f"buffer {buffer_id} leaf {leaf_idx} decompressed to "
+                f"bytes failing {policy.algorithm} verification"
+                + (" (frame was clean): writer-side corruption "
+                   "predating compression" if frame_verified else ""),
+                buffer_id=buffer_id, leaf=leaf_idx,
+                site="writer" if frame_verified else path,
+                expected=want, computed=got)
+    return flat
+
+
+def decode_compressed_leaves(frames, layout, codec, comp_sums, sums,
+                             policy: Optional[ChecksumPolicy], cpol,
+                             buffer_id: int, path: str
+                             ) -> List[np.ndarray]:
+    """Verify + decompress + reshape a fetched buffer's framed leaves —
+    the shared synchronous tail of the shm and loopback compressed fetch
+    paths (the socket stream runs the identical ladder asynchronously in
+    AsyncFramedReader).  Frame digests are checked BEFORE decompression,
+    so a corrupt frame never reaches a decompressor.  Byte/mismatch
+    counters stay at the call sites: the socket client counts mismatches
+    in its outer fetch handler, the loopback client locally."""
+    out: List[np.ndarray] = []
+    for leaf_idx, (shape, dtype_str, _raw_nbytes) in enumerate(layout):
+        frame = frames[leaf_idx]
+        if comp_sums is not None:
+            verify_fetched_leaf(policy, frame, comp_sums[leaf_idx],
+                                buffer_id, leaf_idx, path)
+        flat = decompress_verified_leaf(
+            cpol, codec, frame, policy,
+            sums[leaf_idx] if sums is not None else None,
+            buffer_id, leaf_idx, path,
+            frame_verified=comp_sums is not None)
+        out.append(flat.view(np.dtype(dtype_str)).reshape(shape))
+    return out
+
+
 class AsyncLeafVerifier:
     """Pipelined wire verification: received chunks are hashed on a side
     thread while the socket keeps receiving the next ones, so checksum
@@ -294,6 +379,129 @@ class AsyncLeafVerifier:
                 site=site, expected=want, computed=got)
 
 
+class AsyncFramedReader:
+    """Pipelined reader for COMPRESSED leaf streams: the same
+    feed/leaf_done/finish/abort protocol as AsyncLeafVerifier, but over
+    framed compressed bytes (compress/framed.py).  The side thread
+
+      1. hashes compressed chunks as they arrive (overlapped with the
+         recv loop),
+      2. verifies each leaf's COMPRESSED digest the moment the leaf
+         completes — a corrupt frame is recorded as CorruptShuffleBlock
+         and NEVER reaches the decompressor (the acceptance contract of
+         the integrity ladder),
+      3. decompresses the verified frame (chunks parallel on the shared
+         codec pool, overlapped with the next leaf's recv), and
+      4. verifies the decompressed bytes against the CANONICAL
+         (uncompressed) digests — frames that verify clean but decode to
+         the wrong bytes mean the corruption predates compression, i.e.
+         writer-side rot (classified `writer`, so the recovery ladder
+         recomputes instead of refetching forever).
+
+    `finish()` joins the pipeline, raises the first recorded mismatch,
+    and returns {leaf_idx: flat uint8 decompressed leaf}."""
+
+    _END = object()
+
+    def __init__(self, policy: Optional[ChecksumPolicy], comp_sums,
+                 raw_sums, codec, buffer_id: int, path: str):
+        import queue
+        self._policy = policy if policy is not None and policy.enabled \
+            else None
+        self._comp_sums = comp_sums if self._policy is not None else None
+        self._raw_sums = raw_sums if self._policy is not None else None
+        self._codec = codec
+        self._buffer_id = buffer_id
+        self._path = path
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._frames: Dict[int, np.ndarray] = {}   # retained for fallback
+        self._out: Dict[int, np.ndarray] = {}
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="shuffle-decompress")
+        self._thread.start()
+
+    # -- protocol ------------------------------------------------------------
+
+    def feed(self, leaf_idx: int, chunk: np.ndarray) -> None:
+        self._q.put(("chunk", leaf_idx, chunk))
+
+    def leaf_done(self, leaf_idx: int, frame: np.ndarray) -> None:
+        self._frames[leaf_idx] = frame
+        self._q.put(("done", leaf_idx, frame))
+
+    def abort(self) -> None:
+        self._q.put(self._END)
+
+    def finish(self) -> Dict[int, np.ndarray]:
+        self._q.put(self._END)
+        self._thread.join(timeout=120)
+        if self._thread.is_alive():
+            # pipeline starved (single busy core, slow codec): NEVER skip
+            # verification — run the whole ladder synchronously over the
+            # retained frames, and stop reading state the thread still
+            # mutates
+            out: Dict[int, np.ndarray] = {}
+            for leaf_idx, frame in sorted(self._frames.items()):
+                out[leaf_idx] = self._one_leaf(leaf_idx, frame,
+                                               hasher_digest=None)
+            return out
+        if self._error is not None:
+            raise self._error
+        return self._out
+
+    # -- side thread ---------------------------------------------------------
+
+    def _run(self) -> None:
+        hashers: Dict[int, object] = {}
+        while True:
+            item = self._q.get()
+            if item is self._END:
+                return
+            tag, leaf_idx = item[0], item[1]
+            if tag == "chunk":
+                if self._comp_sums is not None:
+                    h = hashers.get(leaf_idx)
+                    if h is None:
+                        h = hashers[leaf_idx] = self._policy.hasher()
+                    h.update(item[2])
+                continue
+            # "done": verify this frame, then decompress it
+            if self._error is not None:
+                continue  # drain; first error wins
+            h = hashers.pop(leaf_idx, None)
+            try:
+                self._out[leaf_idx] = self._one_leaf(
+                    leaf_idx, item[2],
+                    hasher_digest=h.digest() if h is not None else None)
+            except BaseException as e:  # noqa: BLE001 — finish() raises it
+                self._error = e
+
+    def _one_leaf(self, leaf_idx: int, frame: np.ndarray,
+                  hasher_digest: Optional[int]) -> np.ndarray:
+        verified = False
+        if self._comp_sums is not None:
+            got = hasher_digest if hasher_digest is not None \
+                else self._policy.checksum_one(frame)
+            want = int(self._comp_sums[leaf_idx])
+            if got != want:
+                # double-hash classification, as verify_fetched_leaf: an
+                # unstable re-digest means the reader's own memory flaked
+                second = self._policy.checksum_one(frame)
+                site = "reader" if second != got else self._path
+                raise CorruptShuffleBlock(
+                    f"buffer {self._buffer_id} leaf {leaf_idx} compressed "
+                    f"frame failed {self._policy.algorithm} verification "
+                    f"on the {self._path} path: expected {want:#x}, "
+                    f"computed {got:#x}", buffer_id=self._buffer_id,
+                    leaf=leaf_idx, site=site, expected=want, computed=got)
+            verified = True
+        return decompress_verified_leaf(
+            None, self._codec, frame, self._policy,
+            self._raw_sums[leaf_idx] if self._raw_sums is not None
+            else None, self._buffer_id, leaf_idx, self._path, verified)
+
+
 # ---- SPI -------------------------------------------------------------------
 
 class ShuffleTransportClient:
@@ -340,8 +548,17 @@ class LoopbackTransport(ShuffleTransport):
     under the inflight throttle, so flow control and reassembly are
     exercised exactly as a wire transport would."""
 
-    def __init__(self, pool_size: int = 8 << 20, chunk_size: int = 1 << 20,
+    def __init__(self, pool_size: Optional[int] = None,
+                 chunk_size: Optional[int] = None,
                  max_inflight_bytes: int = 4 << 20):
+        # bounce-pool geometry defaults live in ONE place — the conf
+        # registry (spark.rapids.shuffle.bounce.*); explicit arguments
+        # still win for tests that shrink the pool
+        from .. import config as C
+        if pool_size is None:
+            pool_size = int(C.SHUFFLE_BOUNCE_POOL_SIZE.default)
+        if chunk_size is None:
+            chunk_size = int(C.SHUFFLE_BOUNCE_CHUNK_SIZE.default)
         self._servers: Dict[str, object] = {}
         self.pool = BounceBufferPool(pool_size, chunk_size)
         self.chunk_size = chunk_size
@@ -351,12 +568,18 @@ class LoopbackTransport(ShuffleTransport):
         # default-on verification with the default algorithm; configure()
         # adopts the session's conf when an env constructs the transport
         self.integrity = ChecksumPolicy()
+        # wire compression (compress/): default none; configure() adopts
+        # spark.rapids.shuffle.compression.codec
+        self.compression = CompressionPolicy()
         self.counters: Dict[str, int] = {}
 
     def configure(self, conf) -> None:
+        from ..compress import compression_from_conf
         from ..mem.integrity import policy_from_conf
         faults.INJECTOR.configure_from_conf(conf)
         self.integrity = policy_from_conf(conf)
+        self.compression = compression_from_conf(
+            conf, metrics=self.compression.metrics)
 
     def count(self, key: str, n: int = 1) -> None:
         with self._lock:
@@ -411,12 +634,95 @@ class LoopbackClient(ShuffleTransportClient):
             # conclusive writer-side evidence
             return {"writer_ok": False}
 
+    def _pull_leaf(self, buffer_id: int, leaf_idx: int, nbytes: int,
+                   txn: Transaction, copy_chunk) -> np.ndarray:
+        """One leaf (raw or framed) through bounce-buffer chunks:
+        `copy_chunk(leaf_idx, off, length, view)` is the server-side
+        'send', the copy out of the bounce slice is the 'recv', and the
+        staged slice is the corruption-injection point (the loopback
+        'wire')."""
+        pool = self.transport.pool
+        chunk = self.transport.chunk_size
+        dest = np.empty(nbytes, dtype=np.uint8)
+        off = 0
+        while off < nbytes:
+            length = min(chunk, nbytes - off)
+            addr = pool.acquire(length)
+            try:
+                try:
+                    copy_chunk(leaf_idx, off, length,
+                               pool.view(addr, length))
+                except KeyError as e:
+                    raise BufferGone(
+                        f"buffer {buffer_id} vanished mid-fetch "
+                        f"at leaf {leaf_idx}+{off}: {e}") from e
+                except CorruptShuffleBlock:
+                    raise
+                except CorruptBuffer as e:
+                    raise CorruptShuffleBlock(
+                        f"buffer {buffer_id} corrupt at the "
+                        f"peer mid-fetch: {e}",
+                        buffer_id=buffer_id, leaf=leaf_idx,
+                        site="writer") from e
+                faults.INJECTOR.on_corruptible(
+                    "loopback", pool.view(addr, length))
+                dest[off:off + length] = pool.view(addr, length)
+            finally:
+                pool.release(addr)
+            off += length
+            txn.bytes_transferred += length
+        return dest
+
+    def _fetch_buffer_compressed(self, buffer_id: int, layout, meta,
+                                 sums, comp: dict, txn: Transaction
+                                 ) -> Tuple[List[np.ndarray], BatchMeta]:
+        """Negotiated-codec fetch: framed compressed leaves cross the
+        bounce pool, frames verify BEFORE decompression (transit faults),
+        decompressed bytes verify against the canonical digests after
+        (writer rot) — the same ladder the socket stream runs."""
+        from ..compress import resolve_codec
+        policy = self.transport.integrity
+        cpol = self.transport.compression
+        codec = resolve_codec(comp["codec"])
+        sizes = comp["sizes"]
+        comp_sums = None
+        if policy is not None and policy.enabled \
+                and comp.get("checksums") is not None \
+                and comp.get("algorithm") == policy.algorithm:
+            comp_sums = comp["checksums"]
+        total = sum(sizes)
+        self.transport.throttle.acquire(total)
+        try:
+            frames = [
+                self._pull_leaf(
+                    buffer_id, leaf_idx, sizes[leaf_idx], txn,
+                    lambda li, off, length, view: self.server
+                    .copy_compressed_chunk(buffer_id, li, off, length,
+                                           view, comp["codec"]))
+                for leaf_idx in range(len(layout))]
+            try:
+                out = decode_compressed_leaves(
+                    frames, layout, codec, comp_sums, sums, policy,
+                    cpol, buffer_id, "loopback")
+            except CorruptShuffleBlock:
+                self.transport.count("checksum_mismatches")
+                raise
+            self.transport.count("compressed_bytes_received", total)
+            if cpol.metrics is not None:
+                from ..metrics import names as MN
+                cpol.metrics.add(MN.COMPRESSED_SHUFFLE_BYTES_READ, total)
+            txn.status = TransactionStatus.SUCCESS
+            return out, meta
+        except Exception as e:  # noqa: BLE001
+            txn.fail(str(e))
+            raise
+        finally:
+            self.transport.throttle.release(total)
+
     def fetch_buffer(self, buffer_id: int
                      ) -> Tuple[List[np.ndarray], BatchMeta]:
         """Pull one buffer's leaves through bounce-buffer chunks."""
         txn = self.transport.next_txn()
-        pool = self.transport.pool
-        chunk = self.transport.chunk_size
         try:
             leaves_meta = self.server.buffer_layout(buffer_id)
         except KeyError as e:
@@ -442,45 +748,50 @@ class LoopbackClient(ShuffleTransportClient):
             rec = get_sums(buffer_id) if get_sums is not None else None
             if rec is not None and rec[0] == policy.algorithm:
                 sums = rec[1]
+        # codec negotiation: ask the peer to frame the leaves with our
+        # configured codec; a peer without compression support (or the
+        # codec library) answers None and we fall back to the raw wire
+        # format, counted — never an error (typed graceful degradation)
+        cpol = getattr(self.transport, "compression", None)
+        if cpol is not None and cpol.enabled:
+            get_comp = getattr(self.server, "compressed_layout", None)
+            comp = None
+            if get_comp is not None:
+                try:
+                    comp = get_comp(buffer_id, cpol.codec_name)
+                except KeyError as e:
+                    txn.fail(str(e))
+                    raise BufferGone(
+                        f"buffer {buffer_id} gone at the peer "
+                        f"(shuffle removed mid-fetch): {e}") from e
+                except CorruptShuffleBlock:
+                    raise
+                except CorruptBuffer as e:
+                    # the peer's serve-time verify tripped while
+                    # re-reading the buffer to compress it: writer-site
+                    # rot, same translation the raw path performs
+                    txn.fail(str(e))
+                    raise CorruptShuffleBlock(
+                        f"buffer {buffer_id} corrupt at the peer: {e}",
+                        buffer_id=buffer_id, site="writer") from e
+            if comp is not None:
+                return self._fetch_buffer_compressed(
+                    buffer_id, leaves_meta[0], leaves_meta[1], sums,
+                    comp, txn)
+            self.transport.count("compression_fallbacks")
+            if cpol.metrics is not None:
+                from ..metrics import names as MN
+                cpol.metrics.add(MN.NUM_COMPRESSION_FALLBACKS, 1)
         total = sum(nb for _, _, nb in leaves_meta[0])
         self.transport.throttle.acquire(total)
         try:
             out: List[np.ndarray] = []
             for leaf_idx, (shape, dtype_str, nbytes) \
                     in enumerate(leaves_meta[0]):
-                dest = np.empty(nbytes, dtype=np.uint8)
-                off = 0
-                while off < nbytes:
-                    length = min(chunk, nbytes - off)
-                    addr = pool.acquire(length)
-                    try:
-                        # "send": server copies into the bounce slice
-                        try:
-                            self.server.copy_leaf_chunk(
-                                buffer_id, leaf_idx, off, length,
-                                pool.view(addr, length))
-                        except KeyError as e:
-                            raise BufferGone(
-                                f"buffer {buffer_id} vanished mid-fetch "
-                                f"at leaf {leaf_idx}+{off}: {e}") from e
-                        except CorruptShuffleBlock:
-                            raise
-                        except CorruptBuffer as e:
-                            raise CorruptShuffleBlock(
-                                f"buffer {buffer_id} corrupt at the "
-                                f"peer mid-fetch: {e}",
-                                buffer_id=buffer_id, leaf=leaf_idx,
-                                site="writer") from e
-                        # corruption injection point: the staged chunk is
-                        # the loopback "wire"
-                        faults.INJECTOR.on_corruptible(
-                            "loopback", pool.view(addr, length))
-                        # "recv": copy out of the bounce slice
-                        dest[off:off + length] = pool.view(addr, length)
-                    finally:
-                        pool.release(addr)
-                    off += length
-                    txn.bytes_transferred += length
+                dest = self._pull_leaf(
+                    buffer_id, leaf_idx, nbytes, txn,
+                    lambda li, off, length, view: self.server
+                    .copy_leaf_chunk(buffer_id, li, off, length, view))
                 if sums is not None:
                     try:
                         verify_fetched_leaf(policy, dest, sums[leaf_idx],

@@ -50,14 +50,22 @@ class WorkerHandler:
 
     def __init__(self, executor_id: str, conf_dict: Dict):
         from ..engine import TpuSession
-        from ..config import (PINNED_POOL_SIZE, SHUFFLE_MAX_RECV_INFLIGHT)
+        from ..config import (PINNED_POOL_SIZE, SHUFFLE_BOUNCE_CHUNK_SIZE,
+                              SHUFFLE_BOUNCE_POOL_SIZE,
+                              SHUFFLE_MAX_RECV_INFLIGHT)
         from .manager import ShuffleEnv
         from .net import SocketTransport
         self.executor_id = executor_id
         self.session = TpuSession(conf_dict)
         self.runtime = self.session.runtime
+        # bounce geometry from the conf registry (single source of truth,
+        # spark.rapids.shuffle.bounce.*); pinned pool still overrides
         kwargs = {"max_inflight_bytes":
                   int(self.session.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
+                  "pool_size":
+                  int(self.session.conf.get(SHUFFLE_BOUNCE_POOL_SIZE)),
+                  "chunk_size":
+                  int(self.session.conf.get(SHUFFLE_BOUNCE_CHUNK_SIZE)),
                   "rpc_handler": self.dispatch}
         pinned = int(self.session.conf.get(PINNED_POOL_SIZE))
         if pinned > 0:
