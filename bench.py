@@ -362,6 +362,106 @@ def compress_microbench() -> dict:
     return out
 
 
+def fusion_microbench() -> dict:
+    """Whole-stage fusion on/off deltas (the ISSUE-6 acceptance numbers):
+    a q1-shaped pipeline (scan -> filter -> project -> partial agg) and an
+    exchange-bucketing pipeline, each run on a fresh session with cleared
+    kernel caches, recording per-query jit-compile count, per-batch
+    dispatch count, and warmup seconds — so the compile-count claim
+    (>= 2x fewer programs with fusion ON) is measured, not asserted."""
+    import jax
+    from spark_rapids_tpu.engine import TpuSession
+    from spark_rapids_tpu.plan.logical import col, functions as F, lit
+    from spark_rapids_tpu.utils import kernel_cache as KC
+
+    # ground truth for compile counts: jax fires one
+    # /jax/compilation_cache/compile_requests_use_cache per compiled
+    # computation, EAGER primitives included — so the count also sees the
+    # per-op dispatch programs fusion eliminates (our kernel_cache
+    # counters only see whole programs built through the exec layer)
+    xla_compiles = [0]
+    try:
+        jax.monitoring.register_event_listener(
+            lambda name, **kw: xla_compiles.__setitem__(
+                0, xla_compiles[0]
+                + (name == "/jax/compilation_cache/"
+                           "compile_requests_use_cache")))
+    except Exception:
+        pass
+
+    n = 200_000
+    base_conf = {
+        "spark.rapids.sql.variableFloatAgg.enabled": "true",
+        # several reader batches so per-batch dispatch counts mean
+        # something (one giant batch would make every mode look fused)
+        "spark.rapids.sql.reader.batchSizeRows": str(n // 4),
+        "spark.rapids.sql.tpu.memoryScanCache.enabled": "false",
+    }
+
+    def q1_shape(s, df):
+        return (df.filter(col("l_shipdate") <= D_19980902)
+                .select(col("l_returnflag"), col("l_linestatus"),
+                        (col("l_extendedprice")
+                         * (lit(1.0) - col("l_discount"))).alias("disc"))
+                .group_by(col("l_returnflag"), col("l_linestatus"))
+                .agg(F.sum(col("disc")).alias("s"),
+                     F.count(lit(1)).alias("c")))
+
+    def exchange_shape(s, df):
+        return (df.filter(col("l_discount") >= 0.02)
+                .select(col("l_shipdate"), col("l_quantity"))
+                .repartition(4, col("l_shipdate")))
+
+    table = make_lineitem(n)
+    out = {"rows": n, "queries": {}}
+    for qname, build in (("q1_shape", q1_shape),
+                         ("exchange_shape", exchange_shape)):
+        rec = {}
+        for label, fusion in (("fusion_off", "false"), ("fusion_on", "true")):
+            conf = dict(base_conf)
+            conf["spark.rapids.sql.tpu.fusion.enabled"] = fusion
+            KC.clear()
+            jax.clear_caches()
+            before = KC.stats()
+            xla0 = xla_compiles[0]
+            s = TpuSession(conf)
+            df = s.from_arrow(table)
+            t0 = time.time()
+            r1 = checksum(build(s, df).collect())
+            warmup_s = time.time() - t0
+            after_compile = KC.stats()
+            xla1 = xla_compiles[0]
+            t0 = time.time()
+            r2 = checksum(build(s, df).collect())
+            steady_s = time.time() - t0
+            after = KC.stats()
+            rec[label] = {
+                "jit_compiles": (after_compile["builds"]
+                                 - before["builds"]
+                                 + after_compile["stage_compiles"]
+                                 - before["stage_compiles"]),
+                "xla_compiles": xla1 - xla0,
+                "dispatches_warm_run": (after["dispatches"]
+                                        - after_compile["dispatches"]),
+                "warmup_s": round(warmup_s, 3),
+                "steady_s": round(steady_s, 4),
+                "value": r1,
+            }
+            assert abs(r1 - r2) <= 1e-6 * max(1.0, abs(r1))
+        off, on = rec["fusion_off"], rec["fusion_on"]
+        rec["match"] = bool(abs(off["value"] - on["value"])
+                            <= 1e-4 * max(1.0, abs(off["value"])))
+        # xla_compiles is the ground truth, but if the monitoring event
+        # never fired (older jax without the hook) fall back to the
+        # exec-layer program count rather than reporting 0/0 = no change
+        src = ("xla_compiles" if off["xla_compiles"] or on["xla_compiles"]
+               else "jit_compiles")
+        rec["compile_reduction"] = round(
+            off[src] / max(1, on[src]), 2)
+        out["queries"][qname] = rec
+    return out
+
+
 def child_main(mode: str) -> None:
     _DEADLINE[0] = time.time() + float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))
@@ -378,16 +478,13 @@ def child_main(mode: str) -> None:
     # persistent compilation cache: the q1/q5 whole-stage programs cost
     # 40s+ to compile on the tunneled chip; caching them on disk makes
     # every bench rerun (including the driver's end-of-round run) start
-    # from warm compiles
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                           "/tmp/jax_bench_cache"))
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-    except Exception:
-        pass  # cache is an optimization, never a dependency
+    # from warm compiles.  Same idempotent helper the engine and the
+    # executor worker bootstrap use (utils/compile_cache.py), forced on
+    # because the bench wants warm compiles on every backend it measures.
+    from spark_rapids_tpu.utils.compile_cache import enable_compilation_cache
+    enable_compilation_cache(
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_bench_cache"),
+        force=True)
     try:
         platform = jax.devices()[0].platform
     except Exception as e:
@@ -525,6 +622,13 @@ def child_main(mode: str) -> None:
         emit("compress", **compress_microbench())
     except Exception as e:
         emit("compress", error=repr(e)[:200])
+    # fusion rollup (ISSUE 6): per-query jit-compile count, per-batch
+    # dispatch count and warmup seconds with whole-stage fusion on vs
+    # off, so the >= 2x compile-count acceptance is a measured artifact
+    try:
+        emit("fusion", **fusion_microbench())
+    except Exception as e:
+        emit("fusion", error=repr(e)[:200])
     emit("done", t=time.time() - (_DEADLINE[0] - float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))))
 
@@ -641,7 +745,7 @@ def collect(r: "StageReader", end_at: float,
     out = {"platform": None, "runs": {}, "warmup": {}, "values": {},
            "transfer": None, "aborted": False, "backend_error": None,
            "observability": None, "adaptive": None, "integrity": None,
-           "compress": None}
+           "compress": None, "fusion": None}
     first = True
     try:
         while True:
@@ -683,6 +787,9 @@ def collect(r: "StageReader", end_at: float,
             elif st == "compress":
                 out["compress"] = {k: v for k, v in rec.items()
                                    if k != "stage"}
+            elif st == "fusion":
+                out["fusion"] = {k: v for k, v in rec.items()
+                                 if k != "stage"}
             elif st == "abort":
                 out["aborted"] = True
                 break
@@ -837,6 +944,7 @@ def _run():
         "adaptive": dev.get("adaptive"),
         "integrity": dev.get("integrity"),
         "compress": dev.get("compress"),
+        "fusion": dev.get("fusion"),
         "q6_effective_gb_s": round(eff_gb_s, 2),
         "hbm_roofline_note": "v5e HBM ~819 GB/s; q6 reads 32 B/row",
         "vs_ref_headline": round(vs / 19.8, 4),

@@ -58,6 +58,16 @@ T_CMP=$SECONDS
 python -m pytest tests/test_compress.py -q -p no:cacheprovider
 echo "== compress tier took $((SECONDS - T_CMP))s =="
 
+echo "== fusion tier =="
+# whole-stage fusion (ISSUE 6): fused == unfused bit-for-bit across the
+# dtype surface and around every fusion boundary, the stage-level OOM
+# ladder (split-retry -> operator-at-a-time -> per-op CPU fallback),
+# AQE-on fused reduce stages, *(N) EXPLAIN rendering, and the >=2x
+# compile-count reduction acceptance
+T_FUS=$SECONDS
+python -m pytest tests/test_fusion.py -q -p no:cacheprovider
+echo "== fusion tier took $((SECONDS - T_FUS))s =="
+
 echo "== tests (fast tier) =="
 T_TESTS=$SECONDS
 MARK="not slow"

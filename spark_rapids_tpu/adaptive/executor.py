@@ -54,6 +54,16 @@ class TpuAdaptivePlanExec(TpuExec):
         if self._replanned or not ctx.conf.get(C.ADAPTIVE_ENABLED):
             return self.children[0]
         new_root = self._adapt(self.children[0], ctx)
+        if ctx.conf.get(C.FUSION_ENABLED):
+            # re-planned reduce sides fuse too: the pass is idempotent on
+            # already-fused subtrees (identity preserved, plan/fusion.py),
+            # so only chains the rules introduced become new stages; fresh
+            # stages get *(N) ids above the existing numbering
+            from ..plan import fusion as F
+            new_root = F._fuse(new_root,
+                               max(1, int(ctx.conf.get(C.FUSION_MAX_OPS))))
+            F.number_stages(new_root,
+                            start=F.max_stage_id(new_root) + 1)
         self._replanned = True
         self.children = [new_root]
         qe = getattr(ctx, "query_execution", None)

@@ -221,6 +221,27 @@ COMPILATION_CACHE_DIR = _conf(
     "paying tens of seconds per query shape (the reference has zero "
     "query-time compile cost; this is the TPU equivalent).  Empty string "
     "disables.", str)
+FUSION_ENABLED = _conf(
+    "spark.rapids.sql.tpu.fusion.enabled", True,
+    "Whole-stage fusion kill switch: after planning, maximal chains of "
+    "row-local device operators (project/filter/expand over scan-decode "
+    "output) compile into ONE jitted XLA stage per batch shape "
+    "(TpuWholeStageExec), the hash-partition bucketing of a shuffle "
+    "exchange fuses into its child stage's program, and grouped "
+    "aggregation absorbs the chain into its whole-stage program.  A "
+    "stage materializes exactly one ColumnarBatch at its fusion boundary "
+    "(exchange, join build, sort, full aggregation) instead of one per "
+    "operator; OOM retry runs at stage granularity (split-retry the "
+    "stage input, then operator-at-a-time, then per-operator CPU "
+    "fallback).  false disables the ENTIRE compiled-stage family — "
+    "per-operator dispatch with the legacy FusedPipelineExec chain "
+    "fusion only, aggregate whole-stage absorption off too (toggle that "
+    "alone via wholeStage.enabled while fusion stays on).", _to_bool)
+FUSION_MAX_OPS = _conf(
+    "spark.rapids.sql.tpu.fusion.maxOpsPerStage", 16,
+    "Upper bound on row-local operators fused into one whole-stage "
+    "program; longer chains split into consecutive stages (bounds the "
+    "size/compile time of any single XLA program).", int)
 AGG_MERGE_FAN_IN = _conf(
     "spark.rapids.sql.tpu.agg.mergeFanIn", 8,
     "Number of per-batch partial aggregate states buffered before one "

@@ -23,36 +23,16 @@ from .plan import transitions as T
 from .types import Schema, StructField, from_arrow
 
 
-_COMPILE_CACHE_SET = False
+# one shared owner of the jax persistent-cache config dance: engine,
+# bench.py children and the executor worker bootstrap all call this, so
+# the cache knobs cannot drift between entry points
+from .utils.compile_cache import enable_compilation_cache  # noqa: E402
 
 
 def _enable_compilation_cache(path: str) -> None:
-    """Point jax's persistent compilation cache at `path` (idempotent,
-    best-effort).  Keyed by HLO hash, shared across processes: a second
-    session replays every kernel this one compiled."""
-    global _COMPILE_CACHE_SET
-    if _COMPILE_CACHE_SET or not path:
-        return
-    _COMPILE_CACHE_SET = True
-    try:
-        import os
-        import jax
-        # TPU-backed processes only: compiles there cost tens of seconds
-        # and replay byte-identically.  XLA:CPU AOT replay warns about
-        # machine-feature mismatches (SIGILL risk) and the CPU test env
-        # already fights compile-cache memory pressure — so the cache is
-        # strictly OPT-IN via an explicitly named non-cpu platform (a
-        # CPU-only machine with JAX_PLATFORMS unset auto-selects cpu and
-        # must stay uncached).
-        platforms = jax.config.jax_platforms \
-            or os.environ.get("JAX_PLATFORMS", "")
-        if not platforms or platforms == "cpu":
-            return
-        jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-    except Exception:
-        pass  # an optimization, never a dependency
+    """Back-compat alias (platform-gated: TPU-backed processes only;
+    see utils/compile_cache.py for the rationale)."""
+    enable_compilation_cache(path, force=False)
 
 
 class TpuSession:

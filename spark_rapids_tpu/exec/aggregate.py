@@ -830,7 +830,12 @@ class TpuHashAggregateExec(TpuExec):
         from .. import config as C
         from ..utils.kernel_cache import cached_kernel
         from .basic import RowLocalExec
-        if not ctx.conf.get(C.WHOLE_STAGE_ENABLED) or self._needs_offset():
+        # FUSION_ENABLED is the master whole-stage kill switch (plan/
+        # fusion.py); WHOLE_STAGE_ENABLED remains the aggregate-specific
+        # knob for this absorption path
+        if not ctx.conf.get(C.WHOLE_STAGE_ENABLED) \
+                or not ctx.conf.get(C.FUSION_ENABLED) \
+                or self._needs_offset():
             return None, None
         child = self.children[0]
         if isinstance(child, RowLocalExec):
@@ -945,15 +950,21 @@ class TpuHashAggregateExec(TpuExec):
             fnb = cached_kernel(key + ("bucket",), build_bucket)
             with self.metrics.timer(MN.COMPUTE_AGG_TIME), \
                     named_range("agg_whole_stage_bucket"):
+                from ..utils.kernel_cache import record_dispatch
+                record_dispatch()
                 all_clean, out = fnb(*all_leaves)
             if bool(all_clean):
+                self.metrics.add(MN.NUM_FUSED_STAGES, 1)
                 record_output_batch(self.metrics, out, ctx.runtime)
                 return out, None
             _BUCKET_DIRTY_KEYS.add(key)
         fn = cached_kernel(key, build)
         with self.metrics.timer(MN.COMPUTE_AGG_TIME), \
                 named_range("agg_whole_stage"):
+            from ..utils.kernel_cache import record_dispatch
+            record_dispatch()
             out = fn(*all_leaves)
+        self.metrics.add(MN.NUM_FUSED_STAGES, 1)
         record_output_batch(self.metrics, out, ctx.runtime)
         return out, None
 

@@ -122,8 +122,14 @@ class RowLocalExec(TpuExec):
     def _needs_input_file(self) -> bool:
         return any(E.tree_needs_input_file(e) for e in self.expressions())
 
+    def cpu_twin(self, child: ExecNode) -> ExecNode:
+        """CPU twin of THIS operator over `child` — the per-operator
+        fallback unit the whole-stage retry ladder degrades to
+        (exec/whole_stage.py)."""
+        raise NotImplementedError(self.name)
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
-        from ..utils.kernel_cache import cached_kernel
+        from ..utils.kernel_cache import cached_kernel, record_dispatch
         key = self.kernel_key()
         needs_file = self._needs_input_file()
         if self._needs_row_offset():
@@ -143,6 +149,7 @@ class RowLocalExec(TpuExec):
                                               self.batch_fn()))
                 with self.metrics.timer(MN.TOTAL_TIME), \
                         named_range(self.name):
+                    record_dispatch()
                     out = fn(batch, jnp.int64(offset))
                 offset += batch.num_rows_host()
                 record_output_batch(self.metrics, out, ctx.runtime)
@@ -159,6 +166,7 @@ class RowLocalExec(TpuExec):
                                    self.batch_fn)
                 with self.metrics.timer(MN.TOTAL_TIME), \
                         named_range(self.name):
+                    record_dispatch()
                     out = fn(batch)
                 record_output_batch(self.metrics, out, ctx.runtime)
                 yield out
@@ -166,6 +174,7 @@ class RowLocalExec(TpuExec):
         fn = cached_kernel(key, self.batch_fn)
         for batch in self.children[0].execute(ctx):
             with self.metrics.timer(MN.TOTAL_TIME), named_range(self.name):
+                record_dispatch()
                 out = fn(batch)
             record_output_batch(self.metrics, out, ctx.runtime)
             yield out
@@ -198,6 +207,9 @@ class TpuProjectExec(RowLocalExec):
         from ..utils.kernel_cache import schema_key
         return super().kernel_key() + (schema_key(self._schema),)
 
+    def cpu_twin(self, child):
+        return CpuProjectExec(self.exprs, self._schema.names, child)
+
     def describe(self):
         return f"TpuProjectExec[{', '.join(map(repr, self.exprs))}]"
 
@@ -221,6 +233,9 @@ class TpuFilterExec(RowLocalExec):
 
     def expressions(self):
         return [self.condition]
+
+    def cpu_twin(self, child):
+        return CpuFilterExec(self.condition, child)
 
     def describe(self):
         return f"TpuFilterExec[{self.condition!r}]"
@@ -256,6 +271,11 @@ class FusedPipelineExec(RowLocalExec):
     def kernel_key(self):
         return ("FusedPipelineExec",
                 tuple(s.kernel_key() for s in self.stages))
+
+    def cpu_twin(self, child):
+        for s in self.stages:
+            child = s.cpu_twin(child)
+        return child
 
     def describe(self):
         inner = " -> ".join(s.name for s in self.stages)
@@ -407,6 +427,9 @@ class TpuExpandExec(RowLocalExec):
         return super().kernel_key() + (
             tuple(len(p) for p in self.projections),
             schema_key(self._schema))
+
+    def cpu_twin(self, child):
+        return CpuExpandExec(self.projections, self._schema.names, child)
 
     def describe(self):
         return f"TpuExpandExec[{len(self.projections)} projections]"

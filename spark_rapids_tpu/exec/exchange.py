@@ -310,13 +310,67 @@ class TpuShuffleExchangeExec(TpuExec):
                                            [p])[0])
             yield i, _coalesce_parts(parts)
 
+    def _fused_stage_child(self, ctx: ExecContext):
+        """The whole-stage child to fuse the hash-partition bucketing
+        into, or None.  Eligible when fusion is on and the partition-id
+        compute is row-local (hash/round_robin/single — range needs a
+        bounds-sampling pass over the materialized child output): the
+        chain AND the bucketing then trace into ONE program per map
+        batch, so the stage's only materialization is the partitioned
+        output at the shuffle boundary."""
+        from .. import config as C
+        from .whole_stage import TpuWholeStageExec
+        child = self.children[0]
+        if not isinstance(child, TpuWholeStageExec):
+            return None
+        if not ctx.conf.get(C.FUSION_ENABLED):
+            return None
+        if self.mode == "range" and self.num_partitions > 1:
+            return None
+        if child._needs_row_offset() or child._needs_input_file():
+            return None
+        return child
+
+    def _fused_partition_fn(self, stage):
+        """Builder of the fused (chain + partition-ids) program:
+        batch -> (chain output batch, per-row partition ids).  `start` is
+        the round-robin offset, traced so every map task shares one
+        compiled program."""
+        n = self.num_partitions
+        mode = self.mode
+        keys = self.keys
+
+        def build():
+            pre = stage.batch_fn()
+
+            def fn(b, start):
+                ob = pre(b)
+                if n == 1 or mode == "single":
+                    pids = single_partition_ids(ob.capacity)
+                elif mode == "hash":
+                    pids = hash_partition_ids([e.eval(ob) for e in keys], n)
+                else:  # round_robin
+                    pids = round_robin_partition_ids(ob.capacity, n, start)
+                return ob, pids
+            return fn
+        return build
+
     def _write_phase(self, ctx: ExecContext, n: int, write) -> None:
         """Shared write side: drain the child, compute partition ids, split,
         hand each piece to `write(map_id, p, sub)`.  Range mode samples
         bounds over a materialized list, then DROPS each batch reference as
         written so peak memory is the spillable partition store, not store
-        plus pinned inputs."""
-        child_batches = self.children[0].execute(ctx)
+        plus pinned inputs.
+
+        When the child is a fused whole-stage (plan/fusion.py), the
+        row-local chain and the partition-id compute run as ONE compiled
+        program over the stage's SOURCE batches (the bucketing step joins
+        the stage instead of dispatching per operator)."""
+        fused_stage = self._fused_stage_child(ctx)
+        if fused_stage is not None:
+            child_batches = fused_stage.children[0].execute(ctx)
+        else:
+            child_batches = self.children[0].execute(ctx)
         bounds = None
         if self.mode == "range" and n > 1:
             # range bounds need a pass over the data (reference reservoir-
@@ -332,8 +386,24 @@ class TpuShuffleExchangeExec(TpuExec):
                     yield b
             child_batches = _draining()
 
+        from ..mem.retry import RetryExhausted
         from .retryable import run_retryable, split_batch_rows
         num_writes = 0
+        part_split = split_batch_rows
+        fused_key = None
+        fused_build = None
+        if fused_stage is not None:
+            import jax.numpy as jnp
+            from ..metrics import names as MNN
+            from ..utils.kernel_cache import (expr_key, record_dispatch,
+                                              stage_executable)
+            fused_key = ("exchange_fused", self.mode, n,
+                         fused_stage.kernel_key(),
+                         tuple(expr_key(k) for k in self.keys))
+            fused_build = self._fused_partition_fn(fused_stage)
+            fused_stage.metrics.add(MNN.NUM_FUSED_STAGES, 1)
+            if not fused_stage._can_split():
+                part_split = None
         with self.metrics.timer(MN.SHUFFLE_WRITE_TIME):
             for map_id, batch in enumerate(child_batches):
 
@@ -341,15 +411,61 @@ class TpuShuffleExchangeExec(TpuExec):
                     """Retryable partition-id + split compute (no catalog
                     writes inside, so a retry or a row-range split of the
                     input never double-writes a partition)."""
+                    if fused_stage is not None:
+                        if ctx.runtime is not None:
+                            ctx.runtime.reserve(
+                                fused_stage._reserve_estimate(b),
+                                site="exchange.partition")
+                        fn = stage_executable(
+                            fused_key, fused_build,
+                            (b, jnp.int32(map_id)),
+                            metrics=fused_stage.metrics,
+                            name=f"exchangeStage-"
+                                 f"{fused_stage.stage_id}")
+                        record_dispatch()
+                        ob, pids = fn(b, jnp.int32(map_id))
+                        record_output_batch(fused_stage.metrics, ob,
+                                            ctx.runtime)
+                        return list(split_by_partition(ob, pids, n))
                     if ctx.runtime is not None:
                         ctx.runtime.reserve(b.device_size_bytes(),
                                             site="exchange.partition")
                     pids = self._partition_ids(b, map_id, bounds)
                     return list(split_by_partition(b, pids, n))
 
-                pieces = run_retryable(ctx, self.metrics,
-                                       "exchangePartition", partition_one,
-                                       [batch], split=split_batch_rows)
+                try:
+                    pieces = run_retryable(ctx, self.metrics,
+                                           "exchangePartition",
+                                           partition_one, [batch],
+                                           split=part_split)
+                except RetryExhausted:
+                    if fused_stage is None:
+                        raise
+                    # fused-stage ladder, middle rung: de-fuse — run the
+                    # chain operator-at-a-time (each op in its own retry
+                    # block, per-op CPU fallback), then bucket the chain
+                    # output with the eager partition-id path.  Only an
+                    # exhaustion HERE escalates to the exchange's own
+                    # CPU twin (exec/retryable.py).
+                    from ..metrics import names as MNN
+                    from ..metrics.journal import journal_event
+                    fused_stage.metrics.add(MNN.NUM_FUSION_FALLBACKS, 1)
+                    journal_event("fallback", fused_stage.name,
+                                  reason="stage_retry_exhausted",
+                                  stage=fused_stage.stage_id)
+                    outs = fused_stage._run_ops_one_at_a_time(ctx, batch)
+                    pieces = []
+                    for ob in outs:
+                        def bucket_one(b2, map_id=map_id):
+                            if ctx.runtime is not None:
+                                ctx.runtime.reserve(
+                                    b2.device_size_bytes(),
+                                    site="exchange.partition")
+                            pids = self._partition_ids(b2, map_id, bounds)
+                            return list(split_by_partition(b2, pids, n))
+                        pieces.extend(run_retryable(
+                            ctx, self.metrics, "exchangePartition",
+                            bucket_one, [ob], split=split_batch_rows))
                 batch = None
                 for piece in pieces:
                     for p, sub in piece:

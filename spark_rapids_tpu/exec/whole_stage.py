@@ -1,0 +1,236 @@
+"""Whole-stage fused execution.
+
+`TpuWholeStageExec` is the fusion unit the stage-fusion pass
+(plan/fusion.py) creates: a maximal chain of row-local device operators
+(project/filter/expand over scan-decode output) compiled as ONE XLA
+program per batch shape and executed with STAGE-granularity OOM handling.
+Reference analogue: Spark's WholeStageCodegenExec (`*(N)` operators in
+EXPLAIN); the TPU twist is that "codegen" is jax tracing + XLA
+compilation, so fusing a chain also collapses the number of distinct
+compiled programs a query pays warmup for.
+
+Execution contract per input batch:
+
+  * the fused chain runs inside `with_retry` with the STAGE's input batch
+    as the spillable checkpoint — one retry block for the whole chain
+    instead of none at all (bare RowLocalExec has no retry);
+  * `RetryOOM` escalation splits the input by row range and re-invokes
+    the SAME compiled stage on each half; split pieces land in
+    power-of-two capacity buckets (mem/retry.split_batch_rows ->
+    columnar.bucket_rows), so recompiles stay bounded;
+  * `RetryExhausted` falls back to executing the constituent operators
+    ONE AT A TIME (each in its own retry block), and an operator that
+    exhausts ITS retries falls back to its CPU twin for that batch —
+    preserving the PR-1 ladder (spill-retry -> split -> CPU) at finer
+    granularity;
+  * exactly one ColumnarBatch materializes at the stage's fusion
+    boundary (exchange, join build, sort, full aggregation).
+
+Programs are AOT-compiled through `kernel_cache.stage_executable`, which
+makes compile count and the trace-vs-compile time split observable
+(numStageCompiles / stageCompileTime / journal kind `compile`).
+
+Stages that thread per-batch state (monotonically_increasing_id row
+offsets) or bake per-file constants (input_file_name) take the inherited
+RowLocalExec path instead: still one fused program per batch, without the
+stage-retry upgrades (the offset/file key cannot be re-threaded through
+an arbitrary split).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ..columnar import ColumnarBatch
+from ..metrics import names as MN
+from ..metrics.journal import journal_event
+from ..utils.tracing import named_range
+from .base import ExecContext, ExecNode, record_output_batch
+from .basic import FusedPipelineExec, RowLocalExec, TpuExpandExec
+
+
+class TpuWholeStageExec(FusedPipelineExec):
+    """A fused stage of row-local operators with stage-level retry.
+
+    Subclasses FusedPipelineExec so every consumer that fuses with a
+    row-local child (the aggregate's whole-stage absorption, the
+    exchange's bucketing fusion, the streaming-agg pre-kernel) composes
+    with a whole stage exactly as it does with a legacy fused chain:
+    `batch_fn()` is the composed chain, `children[0]` is the source.
+    """
+
+    def __init__(self, stages: List[RowLocalExec], child: ExecNode):
+        super().__init__(stages, child)
+        self.stage_id = 0  # assigned by plan/fusion.number_stages
+        self._folded_batches = 0
+        self._folded_rows = 0.0
+
+    def describe(self):
+        inner = " -> ".join(s.name for s in self.stages)
+        return f"*({self.stage_id}) TpuWholeStageExec[{inner}]"
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = [" " * indent + self.describe()]
+        for desc, _m in self.op_rows():
+            lines.append(" " * (indent + 2) + desc)
+        lines.append(self.children[0].tree_string(indent + 2))
+        return "\n".join(lines)
+
+    # ---- per-operator attribution (lazy) -----------------------------------
+
+    def op_rows(self):
+        """[(describe, metrics)] for the constituent operators, outermost
+        first, with stage-level counts folded into each operator's own
+        metrics LAZILY (at render time, never per batch) — the
+        EXPLAIN-with-metrics surface for operators that no longer
+        dispatch individually."""
+        self._fold_op_attribution()
+        return [(f"*({self.stage_id}) {s.describe()}", s.metrics)
+                for s in reversed(self.stages)]
+
+    def _fold_op_attribution(self) -> None:
+        vals = self.metrics.snapshot()
+        batches = vals.get(MN.NUM_OUTPUT_BATCHES, 0)
+        d_batches = batches - self._folded_batches
+        if d_batches > 0:
+            self._folded_batches = batches
+            for s in self.stages:
+                s.metrics.add(MN.NUM_OUTPUT_BATCHES, d_batches)
+        rows = vals.get(MN.NUM_OUTPUT_ROWS, 0.0)
+        d_rows = rows - self._folded_rows
+        if d_rows > 0 and self.stages:
+            # only the stage BOUNDARY row count is known (intermediate
+            # batches never materialize): attribute it to the last op
+            self._folded_rows = rows
+            self.stages[-1].metrics.add(MN.NUM_OUTPUT_ROWS, d_rows)
+
+    # ---- execution ---------------------------------------------------------
+
+    def _can_split(self) -> bool:
+        """Row-range splitting re-runs the chain per piece and
+        concatenates outputs in order; an Expand's projection fan-out
+        interleaves rows differently when split, so stages containing one
+        stay retry-only (exhaustion -> operator-at-a-time)."""
+        return not any(isinstance(s, TpuExpandExec) for s in self.stages)
+
+    def _reserve_estimate(self, batch: ColumnarBatch) -> int:
+        nbytes = batch.device_size_bytes()
+        out = nbytes
+        for s in self.stages:
+            if isinstance(s, TpuExpandExec):
+                out *= max(1, len(s.projections))
+        return max(nbytes, out)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        if self._needs_row_offset() or self._needs_input_file():
+            yield from RowLocalExec.execute(self, ctx)
+            return
+        from ..utils.kernel_cache import record_dispatch, stage_executable
+        from .retryable import run_retryable
+        from ..mem.retry import RetryExhausted, split_batch_rows
+        key = self.kernel_key() + ("whole_stage_exec",)
+        split = split_batch_rows if self._can_split() else None
+        self.metrics.add(MN.NUM_FUSED_STAGES, 1)
+        n_batches = 0
+
+        def attempt(b):
+            if ctx.runtime is not None:
+                ctx.runtime.reserve(self._reserve_estimate(b),
+                                    site="wholeStage")
+            fn = stage_executable(key, self.batch_fn, (b,),
+                                  metrics=self.metrics,
+                                  name=f"wholeStage-{self.stage_id}")
+            record_dispatch()
+            return fn(b)
+
+        for batch in self.children[0].execute(ctx):
+            n_batches += 1
+            with self.metrics.timer(MN.TOTAL_TIME), \
+                    named_range(f"whole_stage_{self.stage_id}"):
+                try:
+                    outs = run_retryable(ctx, self.metrics, "wholeStage",
+                                         attempt, [batch], split=split)
+                except RetryExhausted:
+                    self.metrics.add(MN.NUM_FUSION_FALLBACKS, 1)
+                    journal_event("fallback", self.name,
+                                  reason="stage_retry_exhausted",
+                                  stage=self.stage_id)
+                    outs = self._run_ops_one_at_a_time(ctx, batch)
+            for out in outs:
+                record_output_batch(self.metrics, out, ctx.runtime)
+                yield out
+        journal_event("stage", f"wholeStage-{self.stage_id}",
+                      ops=[s.name for s in self.stages],
+                      batches=n_batches)
+
+    # ---- fallback ladder ---------------------------------------------------
+
+    def _run_ops_one_at_a_time(self, ctx: ExecContext,
+                               batch: ColumnarBatch) -> List[ColumnarBatch]:
+        """De-fused execution of ONE input batch: each constituent
+        operator's kernel in its own retry block; an operator that
+        exhausts its retries runs on its CPU twin for that batch (gated
+        by the PR-1 cpuFallbackOnOom conf).  Split pieces flow through
+        the remaining operators independently."""
+        from .. import config as C
+        from ..utils.kernel_cache import cached_kernel, record_dispatch
+        from .retryable import run_retryable
+        from ..mem.retry import RetryExhausted, split_batch_rows
+        cpu_ok = bool(ctx.conf.get(C.OOM_CPU_FALLBACK))
+        batches = [batch]
+        for op in self.stages:
+            # plain kernel key: byte-identical to the program
+            # RowLocalExec.execute caches, so a de-fuse under memory
+            # pressure reuses any already-compiled per-op kernel
+            fn = cached_kernel(op.kernel_key(), op.batch_fn)
+            pre = op.metrics.snapshot()
+            op_split = (split_batch_rows
+                        if not isinstance(op, TpuExpandExec) else None)
+
+            def attempt(b, _fn=fn):
+                if ctx.runtime is not None:
+                    ctx.runtime.reserve(b.device_size_bytes(),
+                                        site="wholeStage.op")
+                record_dispatch()
+                return _fn(b)
+
+            outs: List[ColumnarBatch] = []
+            for b in batches:
+                try:
+                    outs.extend(run_retryable(ctx, op.metrics,
+                                              "wholeStageOp", attempt,
+                                              [b], split=op_split))
+                except RetryExhausted:
+                    if not cpu_ok:
+                        raise
+                    # on the op (EXPLAIN's per-op rows) AND the stage node
+                    # (the tree-walk aggregation only sees plan nodes)
+                    op.metrics.add(MN.NUM_CPU_FALLBACKS, 1)
+                    self.metrics.add(MN.NUM_CPU_FALLBACKS, 1)
+                    journal_event("fallback", op.name,
+                                  reason="stage_op_retry_exhausted",
+                                  stage=self.stage_id)
+                    outs.append(_cpu_apply(op, b, ctx))
+            # mirror the op-level retry/split counts onto the STAGE node
+            # (like numCpuFallbacks above): ops are not plan nodes, so
+            # counts recorded only on op.metrics would never reach
+            # QueryExecution.aggregate()/prometheus
+            post = op.metrics.snapshot()
+            for mk in ("wholeStageOpRetries", "wholeStageOpSplits"):
+                d = post.get(mk, 0) - pre.get(mk, 0)
+                if d > 0:
+                    self.metrics.add(mk, d)
+            batches = outs
+        return batches
+
+
+def _cpu_apply(op: RowLocalExec, batch: ColumnarBatch,
+               ctx: ExecContext) -> ColumnarBatch:
+    """Run one row-local operator on the CPU for one batch: D2H, the
+    operator's CPU twin over a one-table source, H2D."""
+    import pyarrow as pa
+    from .basic import CpuScanMemoryExec
+    table = batch.to_arrow()
+    twin = op.cpu_twin(CpuScanMemoryExec(table, batch.schema))
+    tables = list(twin.execute_cpu(ctx))
+    out = tables[0] if len(tables) == 1 else pa.concat_tables(tables)
+    return ColumnarBatch.from_arrow(out)

@@ -43,7 +43,11 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                "corruption", "refetch", "recompute",
                # compress = a buffer (de)compressed at the shuffle-serve
                # or spill boundary, with codec + raw/physical bytes
-               "compress")
+               "compress",
+               # compile = a whole-stage XLA program was built for a new
+               # (stage, batch-shape) pair, with the trace-vs-compile
+               # time split (exec/whole_stage.py stage_executable)
+               "compile")
 
 
 class EventJournal:

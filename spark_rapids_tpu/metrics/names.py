@@ -219,6 +219,26 @@ COMPRESSION_RATIO = register_metric(
     "best observed raw:compressed ratio of a compressed buffer "
     "(high-water gauge, like peakDevMemory)")
 
+# --- whole-stage fusion (plan/fusion.py + exec/whole_stage.py) ---------------
+NUM_FUSED_STAGES = register_metric(
+    "numFusedStages", COUNTER, ESSENTIAL,
+    "whole-stage fused blocks executed as a single jitted XLA program "
+    "(TpuWholeStageExec runs, exchange bucketing fused into its child "
+    "stage, aggregate whole-stage absorptions)")
+NUM_STAGE_COMPILES = register_metric(
+    "numStageCompiles", COUNTER, ESSENTIAL,
+    "distinct (stage, batch-shape) XLA programs traced+compiled for "
+    "whole-stage fusion; shapes are bucketed to powers of two so this "
+    "stays bounded under split-and-retry")
+STAGE_COMPILE_TIME = register_metric(
+    "stageCompileTime", TIMER, MODERATE,
+    "wall-clock time spent tracing and compiling whole-stage programs "
+    "(the warmup cost fusion amortizes across batches and queries)")
+NUM_FUSION_FALLBACKS = register_metric(
+    "numFusionFallbacks", COUNTER, ESSENTIAL,
+    "fused stages that exhausted stage-level OOM retries and fell back "
+    "to executing their constituent operators one at a time")
+
 # --- adaptive query execution (adaptive/) -----------------------------------
 NUM_COALESCED_PARTITIONS = register_metric(
     "numCoalescedPartitions", COUNTER, ESSENTIAL,
@@ -242,7 +262,7 @@ REPLAN_TIME = register_metric(
 # site emits `<block>Retries` / `<block>Splits` (mem/retry.py with_retry)
 RETRY_BLOCKS = ("sort", "aggUpdate", "aggMerge", "joinBuild", "joinProbe",
                 "exchangePartition", "exchangeWrite", "exchangeFetch",
-                "retryBlock")
+                "wholeStage", "wholeStageOp", "retryBlock")
 for _b in RETRY_BLOCKS:
     register_metric(f"{_b}Retries", COUNTER, ESSENTIAL,
                     f"same-size OOM retries of the {_b} retryable block")

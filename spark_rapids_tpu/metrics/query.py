@@ -229,8 +229,8 @@ class QueryExecution:
             out[k] = out.get(k, 0) + v
         return out
 
-    def _render(self, node, indent: int, lines: List[str]) -> None:
-        vals = node.metrics.snapshot()
+    @staticmethod
+    def _fmt_metrics(vals: Dict[str, float]) -> str:
         parts = []
         for k in sorted(vals):
             v = vals[k]
@@ -241,8 +241,18 @@ class QueryExecution:
                 parts.append(f"{k}: {int(v)}")
             else:
                 parts.append(f"{k}: {v:.3f}")
-        suffix = f" [{', '.join(parts)}]" if parts else ""
-        lines.append(" " * indent + node.describe() + suffix)
+        return f" [{', '.join(parts)}]" if parts else ""
+
+    def _render(self, node, indent: int, lines: List[str]) -> None:
+        lines.append(" " * indent + node.describe()
+                     + self._fmt_metrics(node.metrics.snapshot()))
+        if hasattr(node, "op_rows"):
+            # whole-stage fused node: render the constituent operators
+            # with their *(N) prefix and the stage-level counts folded
+            # into each lazily (exec/whole_stage.TpuWholeStageExec)
+            for desc, m in node.op_rows():
+                lines.append(" " * (indent + 2) + desc
+                             + self._fmt_metrics(m.snapshot()))
         for c in node.children:
             self._render(c, indent + 2, lines)
 

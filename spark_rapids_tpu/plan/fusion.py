@@ -1,0 +1,116 @@
+"""Whole-stage fusion pass.
+
+Runs LAST in plan/transitions.finalize: walks the physical tree and
+greedily groups maximal chains of row-local device operators
+(exec/basic.RowLocalExec — project/filter/expand, including legacy
+FusedPipelineExec chains, over scan-decode output) into
+`TpuWholeStageExec` nodes, then numbers every stage for Spark-style
+`*(N)` EXPLAIN rendering.  Chains longer than
+`spark.rapids.sql.tpu.fusion.maxOpsPerStage` split into consecutive
+stages so no single XLA program grows unboundedly.
+
+Fusion BOUNDARIES are simply the non-row-local operators: exchange, join
+build, sort, full aggregation, coalesce, limit — a stage always produces
+exactly one materialized ColumnarBatch where one of those consumes it.
+Two further fusions happen at the boundary itself, outside this pass:
+`TpuHashAggregateExec` absorbs a whole-stage child into its own
+update/merge/finalize program (exec/aggregate._try_whole_stage), and
+`TpuShuffleExchangeExec` fuses its child stage's chain with the
+hash-partition bucketing compute into one program per map batch
+(exec/exchange._write_phase).
+
+With `spark.rapids.sql.tpu.fusion.enabled=false` the pass degrades to
+the legacy `fuse_row_local` behavior (FusedPipelineExec chain fusion, no
+stage-level retry, no *(N) numbering).  The kill switch disables the
+ENTIRE compiled-stage family — including the aggregate's whole-stage
+absorption and the exchange bucketing fusion — so `false` is strictly
+per-operator dispatch; use `wholeStage.enabled` to toggle the aggregate
+absorption alone while fusion stays on.
+
+The pass is idempotent on already-fused trees: a lone TpuWholeStageExec
+chain is returned unchanged (identity preserved, so QueryExecution node
+ids survive), which lets adaptive execution re-run it over re-planned
+reduce sides (adaptive/executor.py) and fuse only the nodes the rules
+introduced.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .. import config as C
+from ..config import TpuConf
+from ..exec import basic as B
+from ..exec.base import ExecNode
+from ..exec.whole_stage import TpuWholeStageExec
+
+
+def fuse_stages(node: ExecNode, conf: TpuConf) -> ExecNode:
+    """Entry point: whole-stage fusion + stage numbering (or the legacy
+    chain fusion when disabled)."""
+    from .transitions import fuse_row_local
+    if not conf.get(C.FUSION_ENABLED):
+        return fuse_row_local(node)
+    max_ops = max(1, int(conf.get(C.FUSION_MAX_OPS)))
+    node = _fuse(node, max_ops)
+    number_stages(node)
+    return node
+
+
+def _fuse(node: ExecNode, max_ops: int) -> ExecNode:
+    node.children = [_fuse(c, max_ops) for c in node.children]
+    if not isinstance(node, B.RowLocalExec):
+        return node
+    # collect the maximal chain, outermost first, flattening through
+    # already-fused nodes (FusedPipelineExec and TpuWholeStageExec both
+    # expose .stages)
+    chain: List[B.RowLocalExec] = []
+    cur: ExecNode = node
+    while isinstance(cur, B.RowLocalExec):
+        chain.append(cur)
+        cur = cur.children[0]
+    if all(isinstance(n, TpuWholeStageExec) and len(n.stages) <= max_ops
+           for n in chain):
+        # already fused (incl. chains CHUNKED by maxOpsPerStage into
+        # stacked stages): keep identity, so node metrics/ids and *(N)
+        # numbering survive AQE re-runs of the pass
+        return node
+    stages: List[B.RowLocalExec] = []  # execution order
+    for n in reversed(chain):
+        if isinstance(n, B.FusedPipelineExec):
+            stages.extend(n.stages)
+        else:
+            stages.append(n)
+    out = cur  # the source under the chain
+    for i in range(0, len(stages), max_ops):
+        out = TpuWholeStageExec(stages[i:i + max_ops], out)
+    return out
+
+
+def number_stages(node: ExecNode, start: int = 1) -> int:
+    """Assign Spark-style `*(N)` stage ids preorder over UNNUMBERED
+    stages (stage_id 0); already-numbered stages keep their id, so
+    re-running after adaptive re-planning numbers only the fresh ones.
+    Returns the next unassigned id."""
+    counter = [start]
+
+    def walk(n: ExecNode) -> None:
+        if isinstance(n, TpuWholeStageExec) and n.stage_id == 0:
+            n.stage_id = counter[0]
+            counter[0] += 1
+        for c in n.children:
+            walk(c)
+
+    walk(node)
+    return counter[0]
+
+
+def max_stage_id(node: ExecNode) -> int:
+    """Highest stage id already assigned in a tree (0 when none)."""
+    best = 0
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, TpuWholeStageExec):
+            best = max(best, n.stage_id)
+        stack.extend(n.children)
+    return best

@@ -172,11 +172,15 @@ def distribute(node: ExecNode, conf: TpuConf) -> ExecNode:
 
 
 def finalize(node: ExecNode, conf: TpuConf) -> ExecNode:
+    from .fusion import fuse_stages
     node = distribute(node, conf)
     node = insert_transitions(node)
     node = optimize_transitions(node)
     node = insert_coalesce(node, conf)
-    node = fuse_row_local(node)
+    # whole-stage fusion (plan/fusion.py): maximal row-local chains ->
+    # TpuWholeStageExec with *(N) ids; falls back to fuse_row_local when
+    # spark.rapids.sql.tpu.fusion.enabled=false
+    node = fuse_stages(node, conf)
     if conf.is_test_enabled:
         assert_on_tpu(node, conf)
     return node

@@ -56,6 +56,13 @@ class WorkerHandler:
         from .manager import ShuffleEnv
         from .net import SocketTransport
         self.executor_id = executor_id
+        # worker bootstrap shares the engine's persistent-compile-cache
+        # setup (utils/compile_cache.py): every executor process replays
+        # the same on-disk XLA cache instead of re-paying compile time
+        from ..config import COMPILATION_CACHE_DIR, TpuConf
+        from ..utils.compile_cache import enable_compilation_cache
+        enable_compilation_cache(
+            TpuConf(conf_dict).get(COMPILATION_CACHE_DIR))
         self.session = TpuSession(conf_dict)
         self.runtime = self.session.runtime
         # bounce geometry from the conf registry (single source of truth,

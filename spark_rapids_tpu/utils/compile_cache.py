@@ -1,0 +1,45 @@
+"""Shared persistent-XLA-compilation-cache setup.
+
+ONE idempotent helper owns the `jax_compilation_cache_dir` /
+`jax_persistent_cache_*` config dance so the knobs cannot drift between
+call sites: `engine.TpuSession` (platform-gated), the executor worker
+bootstrap (shuffle/worker.py), and bench.py's children (force=True —
+the bench explicitly wants warm compiles on every backend it measures,
+including its CPU oracle).
+
+Platform gate rationale (force=False): compiles on a TPU backend cost
+tens of seconds and replay byte-identically, but XLA:CPU AOT replay
+warns about machine-feature mismatches (SIGILL risk) and the CPU test
+environment already fights compile-cache memory pressure — so on a
+CPU-only process the cache stays off unless the caller forces it.
+"""
+from __future__ import annotations
+
+_CACHE_SET = [False]
+
+
+def enable_compilation_cache(path: str, force: bool = False) -> bool:
+    """Point jax's persistent compilation cache at `path` (idempotent,
+    best-effort; returns True when the cache was enabled by THIS call).
+    Keyed by HLO hash, shared across processes: a second session replays
+    every kernel this one compiled."""
+    if _CACHE_SET[0] or not path:
+        return False
+    try:
+        import os
+
+        import jax
+        if not force:
+            platforms = jax.config.jax_platforms \
+                or os.environ.get("JAX_PLATFORMS", "")
+            if not platforms or platforms == "cpu":
+                # NOT latched: a later force=True call (bench child) may
+                # still enable the cache in this process
+                return False
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        _CACHE_SET[0] = True
+        return True
+    except Exception:
+        return False  # an optimization, never a dependency

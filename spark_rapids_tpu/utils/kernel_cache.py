@@ -12,12 +12,107 @@ jit itself underneath one cache entry.
 """
 from __future__ import annotations
 
+import time
+from collections import OrderedDict
 from typing import Callable, Dict, Tuple
 
 import jax
 
 
 _CACHE: Dict[tuple, Callable] = {}
+
+# whole-stage AOT executables, keyed (stage key, input signature): the
+# fused-stage path compiles per exact shape bucket so compile COUNT and
+# trace-vs-compile time are first-class observables (exec/whole_stage.py).
+# Bounded LRU: compiled executables are NOT dropped by jax.clear_caches(),
+# so an unbounded dict would defeat the conftest's periodic cache clears
+# that keep XLA:CPU's live-executable count under its segfault threshold.
+_STAGE_EXECUTABLES: "OrderedDict[tuple, Callable]" = OrderedDict()
+_STAGE_EXECUTABLES_MAX = 512
+
+# process-wide counters bench.py's fusion stage reads (stats()):
+# builds = distinct jitted programs constructed through cached_kernel,
+# stage_compiles = AOT whole-stage programs compiled,
+# dispatches = per-batch device program invocations through this layer
+_COUNTERS = {"builds": 0, "stage_compiles": 0, "dispatches": 0}
+
+
+def record_dispatch(n: int = 1) -> None:
+    _COUNTERS["dispatches"] += n
+
+
+def stats() -> Dict[str, int]:
+    return dict(_COUNTERS, cached_kernels=len(_CACHE),
+                stage_executables=len(_STAGE_EXECUTABLES))
+
+
+def input_signature(args) -> tuple:
+    """Static (shape, dtype) signature of a pytree of arguments — the
+    shape-bucket key of a whole-stage executable."""
+    leaves = jax.tree_util.tree_flatten(args)[0]
+    return tuple((tuple(getattr(x, "shape", ())),
+                  str(getattr(x, "dtype", type(x).__name__)))
+                 for x in leaves)
+
+
+def stage_executable(key: tuple, builder: Callable[[], Callable],
+                     args: tuple, metrics=None, name: str = "stage"):
+    """AOT-compiled whole-stage program for (key, signature-of-args).
+
+    On a cache miss the program is traced, lowered and compiled EXPLICITLY
+    (jax AOT API) so the build is observable: numStageCompiles /
+    stageCompileTime on `metrics` and a `compile` journal event with the
+    trace-vs-compile time split.  Falls back to a plain jitted function if
+    the AOT API is unavailable.  Returns a callable taking *args."""
+    k = (key, input_signature(args))
+    fn = _STAGE_EXECUTABLES.get(k)
+    if fn is not None:
+        _STAGE_EXECUTABLES.move_to_end(k)
+        return fn
+    from ..metrics import names as MN
+    from ..metrics.journal import journal_event
+    timer = (metrics.timer(MN.STAGE_COMPILE_TIME) if metrics is not None
+             else None)
+    jfn = jax.jit(builder())
+    t0 = time.perf_counter()
+    if timer is not None:
+        timer.__enter__()
+    try:
+        try:
+            traced = jfn.trace(*args)
+            t_traced = time.perf_counter()
+            lowered = traced.lower()
+        except AttributeError:  # older jax: lower() traces internally
+            lowered = jfn.lower(*args)
+            t_traced = time.perf_counter()
+        t_lowered = time.perf_counter()
+        fn = lowered.compile()
+        t_compiled = time.perf_counter()
+    except Exception:
+        # AOT path unavailable for this program/backend: the jitted
+        # function is the executable (compile happens on first call,
+        # folded into the timer by the caller's first dispatch)
+        fn = jfn
+        t_traced = t_lowered = t_compiled = time.perf_counter()
+    finally:
+        if timer is not None:
+            timer.__exit__(None, None, None)
+    _COUNTERS["stage_compiles"] += 1
+    if metrics is not None:
+        metrics.add(MN.NUM_STAGE_COMPILES, 1)
+    journal_event("compile", name,
+                  trace_s=round(t_lowered - t0, 6),
+                  compile_s=round(t_compiled - t_lowered, 6),
+                  trace_only_s=round(t_traced - t0, 6),
+                  signature_leaves=len(k[1]))
+    _STAGE_EXECUTABLES[k] = fn
+    while len(_STAGE_EXECUTABLES) > _STAGE_EXECUTABLES_MAX:
+        _STAGE_EXECUTABLES.popitem(last=False)
+    return fn
+
+
+def clear_stage_executables() -> None:
+    _STAGE_EXECUTABLES.clear()
 
 
 def expr_key(e) -> tuple:
@@ -64,6 +159,7 @@ def cached_kernel(key: tuple, builder: Callable[[], Callable],
     if fn is None:
         fn = jax.jit(builder(), **jit_kw)
         _CACHE[key] = fn
+        _COUNTERS["builds"] += 1
     return fn
 
 
@@ -73,3 +169,4 @@ def cache_info() -> Tuple[int, list]:
 
 def clear():
     _CACHE.clear()
+    _STAGE_EXECUTABLES.clear()

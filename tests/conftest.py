@@ -38,6 +38,10 @@ def _bound_xla_code_memory():
     _test_count["n"] += 1
     if _test_count["n"] % _TESTS_PER_CACHE_CLEAR == 0:
         jax.clear_caches()
+        # whole-stage AOT executables live OUTSIDE jax's caches (they
+        # would survive clear_caches and defeat this bound)
+        from spark_rapids_tpu.utils import kernel_cache
+        kernel_cache.clear_stage_executables()
 
 
 @pytest.fixture(autouse=True)

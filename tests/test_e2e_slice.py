@@ -147,7 +147,10 @@ def test_fused_pipeline_created():
         .filter(col("b") < 90)
     plan = df.physical_plan()
     text = plan.tree_string()
-    assert "FusedPipelineExec" in text
+    # whole-stage fusion (default ON) renders *(N) TpuWholeStageExec;
+    # the kill switch restores the legacy FusedPipelineExec chain
+    assert "TpuWholeStageExec" in text or "FusedPipelineExec" in text
+    assert "*(1)" in text or "FusedPipelineExec" in text
     assert df.collect() == [(30,), (40,), (50,), (60,), (70,), (80,)]
 
 

@@ -1,0 +1,410 @@
+"""Whole-stage fusion tier (ISSUE 6).
+
+Coverage:
+  * fused == unfused bit-for-bit across every column dtype (nullable and
+    var-length strings included) — the kill switch
+    `spark.rapids.sql.tpu.fusion.enabled=false` is the oracle;
+  * fusion-boundary correctness around exchange / join / sort / limit;
+  * OOM injection inside a fused stage: spill-retry, split-and-retry of
+    the stage input, operator-at-a-time de-fusion, per-operator CPU
+    fallback — results identical to the fault-free run at every rung;
+  * AQE-on fused reduce stages (re-planned plans keep/renumber stages);
+  * EXPLAIN `*(N)` stage rendering with lazy per-operator attribution;
+  * the compile-count acceptance: a q1-shaped pipeline compiles >= 2x
+    fewer distinct XLA programs with fusion ON than OFF.
+"""
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.engine import TpuSession
+from spark_rapids_tpu.plan.logical import col, functions as F, lit
+from spark_rapids_tpu.utils import faults
+from spark_rapids_tpu.utils import kernel_cache as KC
+
+from compare import assert_rows_equal, assert_tpu_and_cpu_are_equal
+from data_gen import gen_df, gen_table
+
+pytestmark = pytest.mark.fusion
+
+FUSION_OFF = {"spark.rapids.sql.tpu.fusion.enabled": "false"}
+
+
+def _run(build_query, conf=None):
+    s = TpuSession(dict(conf or {}))
+    return build_query(s).collect()
+
+
+def _fused_vs_unfused(build_query, conf=None, **kw):
+    base = dict(conf or {})
+    off = dict(base)
+    off.update(FUSION_OFF)
+    tpu = _run(build_query, base)
+    oracle = _run(build_query, off)
+    assert_rows_equal(oracle, tpu, **kw)
+    return tpu
+
+
+# --------------------------------------------------------------------------
+# planning: stage creation, numbering, kill switch
+# --------------------------------------------------------------------------
+
+def _chain_df(s):
+    df = s.from_pydict({"a": list(range(20)),
+                        "b": [float(i) for i in range(20)]})
+    return (df.filter(col("a") > 2)
+            .select((col("a") * 10).alias("x"), col("b"))
+            .filter(col("x") < 150))
+
+
+def test_plan_contains_whole_stage_with_star_ids():
+    s = TpuSession()
+    text = _chain_df(s).physical_plan().tree_string()
+    assert "TpuWholeStageExec" in text
+    assert "*(1)" in text
+    # constituent ops render under the stage with the same *(N) prefix
+    assert re.search(r"\*\(1\) TpuFilterExec", text)
+    assert re.search(r"\*\(1\) TpuProjectExec", text)
+
+
+def test_kill_switch_restores_legacy_chain_fusion():
+    s = TpuSession(FUSION_OFF)
+    text = _chain_df(s).physical_plan().tree_string()
+    assert "TpuWholeStageExec" not in text
+    assert "FusedPipelineExec" in text
+    assert _chain_df(TpuSession(FUSION_OFF)).collect() \
+        == _chain_df(TpuSession()).collect()
+
+
+def test_multiple_stages_numbered_uniquely():
+    s = TpuSession()
+    df = s.from_pydict({"k": [i % 3 for i in range(30)],
+                        "v": [float(i) for i in range(30)]})
+    q = (df.filter(col("v") >= 0).select(col("k"), (col("v") + 1).alias("v"))
+         .repartition(4, col("k"))
+         .filter(col("v") < 100).select(col("k"), (col("v") * 2).alias("w")))
+    text = q.physical_plan().tree_string()
+    ids = sorted(set(int(m) for m in
+                     re.findall(r"\*\((\d+)\) TpuWholeStageExec", text)))
+    assert ids == [1, 2], text
+
+
+def test_max_ops_per_stage_chunks_chain():
+    s = TpuSession({"spark.rapids.sql.tpu.fusion.maxOpsPerStage": "2"})
+    df = s.from_pydict({"a": list(range(10))})
+    q = df.filter(col("a") > 0).select((col("a") + 1).alias("a")) \
+          .filter(col("a") > 1).select((col("a") * 2).alias("a"))
+    text = q.physical_plan().tree_string()
+    assert len(re.findall(r"\*\(\d+\) TpuWholeStageExec", text)) == 2
+    assert q.collect() == _run(
+        lambda s2: s2.from_pydict({"a": list(range(10))})
+        .filter(col("a") > 0).select((col("a") + 1).alias("a"))
+        .filter(col("a") > 1).select((col("a") * 2).alias("a")), FUSION_OFF)
+
+
+# --------------------------------------------------------------------------
+# fused == unfused across the type surface
+# --------------------------------------------------------------------------
+
+ALL_DTYPES = [T.IntegerType, T.LongType, T.ShortType, T.ByteType,
+              T.DoubleType, T.FloatType, T.BooleanType, T.StringType,
+              T.DateType, T.TimestampType]
+
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES,
+                         ids=lambda d: d.name)
+def test_fused_equals_unfused_every_dtype(dtype):
+    """Nullable columns of every supported dtype (var-length strings
+    included) flow through a fused filter->project stage bit-for-bit."""
+    data, schema = gen_table(seed=7, n=200, sel=(T.LongType, False),
+                             v=dtype)
+
+    def q(s):
+        df = s.from_pydict(data, schema)
+        return (df.filter(col("sel") % 3 != 0)
+                .select(col("v"), (col("sel") * 2).alias("s2"))
+                .filter(col("s2") % 5 != 1))
+
+    _fused_vs_unfused(q, ignore_order=False, approx_float=False)
+
+
+def test_fused_matches_cpu_oracle():
+    """Fusion ON against the pure-CPU executors (the PR-wide oracle)."""
+    def q(s):
+        df = gen_df(s, seed=11, n=300, a=T.LongType, b=T.DoubleType,
+                    s=T.StringType)
+        return (df.filter((col("a") % 7 != 0) & col("b").is_not_null())
+                .select((col("a") + 1).alias("a1"), col("b"), col("s")))
+    assert_tpu_and_cpu_are_equal(q)
+
+
+# --------------------------------------------------------------------------
+# fusion boundaries: exchange / join / sort / limit
+# --------------------------------------------------------------------------
+
+def test_boundary_exchange_hash_and_round_robin():
+    def q_hash(s):
+        df = gen_df(s, seed=3, n=250, k=T.LongType, v=T.DoubleType)
+        return (df.filter(col("k").is_not_null())
+                .select(col("k"), (col("v") * 2).alias("w"))
+                .repartition(5, col("k")))
+    _fused_vs_unfused(q_hash)
+
+    def q_rr(s):
+        df = gen_df(s, seed=4, n=120, k=T.LongType, v=T.DoubleType)
+        return (df.filter(col("k").is_not_null())
+                .select(col("k"), col("v")).repartition(3))
+    _fused_vs_unfused(q_rr)
+
+
+def test_boundary_join_sort_limit():
+    def q(s):
+        n = 200
+        fact = s.from_pydict({
+            "k": [i % 11 for i in range(n)],
+            "v": [float(i) for i in range(n)],
+            "q": [i % 5 for i in range(n)]})
+        dim = s.from_pydict({"k": list(range(11)),
+                             "name": [f"g{j}" for j in range(11)]})
+        return (fact.filter(col("q") < 4)
+                .select(col("k"), (col("v") + 0.5).alias("v"))
+                .join(dim, on="k")
+                .filter(col("v") > 1.0)
+                .select(col("name"), col("v"))
+                .order_by(col("v"))
+                .limit(50))
+    _fused_vs_unfused(q, ignore_order=False)
+
+
+def test_boundary_aggregate_absorbs_stage():
+    """A grouped aggregate over a fused chain (the q1 shape): the agg's
+    whole-stage program absorbs the chain; results match unfused AND the
+    numFusedStages metric fires."""
+    def q(s):
+        df = s.from_pydict({"k": [i % 4 for i in range(400)],
+                            "v": [float(i % 97) for i in range(400)]})
+        return (df.filter(col("v") < 90)
+                .select(col("k"), (col("v") * 2).alias("w"))
+                .group_by(col("k"))
+                .agg(F.sum(col("w")).alias("sw"),
+                     F.count(lit(1)).alias("c"))
+                .order_by(col("k")))
+    _fused_vs_unfused(q, ignore_order=False)
+    s = TpuSession({"spark.rapids.sql.tpu.metrics.level": "MODERATE"})
+    q(s).collect()
+    agg = s.last_execution.aggregate()
+    assert agg.get("numFusedStages", 0) >= 1, agg
+
+
+def test_boundary_expand_rollup():
+    """Expand (rollup) inside a stage: fusion must not reorder or
+    duplicate the projection fan-out."""
+    def q(s):
+        df = s.from_pydict({"a": [i % 3 for i in range(60)],
+                            "b": [i % 2 for i in range(60)],
+                            "v": [float(i) for i in range(60)]})
+        return (df.rollup(col("a"), col("b"))
+                .agg(F.sum(col("v")).alias("sv"))
+                .order_by(col("a"), col("b")))
+    _fused_vs_unfused(q, ignore_order=False)
+
+
+# --------------------------------------------------------------------------
+# OOM injection inside fused stages
+# --------------------------------------------------------------------------
+
+_RETRY_CONF = {"spark.rapids.sql.tpu.metrics.level": "MODERATE"}
+
+
+def _fused_query(extra=None):
+    faults.INJECTOR.reset()
+    conf = dict(_RETRY_CONF)
+    conf.update(extra or {})
+    s = TpuSession(conf)
+    n = 300
+    df = s.from_pydict({"a": list(range(n)),
+                        "b": [float(i % 13) for i in range(n)],
+                        "s": [f"r{i % 7}" for i in range(n)]})
+    out = (df.filter(col("a") % 3 != 0)
+           .select((col("a") * 2).alias("x"), col("b"), col("s"))
+           .filter(col("b") < 12.0)
+           .repartition(4, col("x"))
+           .collect())
+    return sorted(out), s
+
+
+def test_oom_every_fused_site_identical_results():
+    baseline, _ = _fused_query()
+    n_ops = faults.INJECTOR.oom_ops
+    sites = dict(faults.INJECTOR.site_counts)
+    assert "wholeStage" in sites or "exchange.partition" in sites, sites
+    for ordinal in range(1, n_ops + 1):
+        out, _ = _fused_query({"spark.rapids.tpu.test.injectOom":
+                               str(ordinal)})
+        assert out == baseline, f"ordinal {ordinal} changed the result"
+        assert faults.INJECTOR.injected_log, \
+            f"ordinal {ordinal} never fired"
+
+
+def test_oom_split_retry_reinvokes_same_stage():
+    """A failure window forces the stage input to split by row range; the
+    split pieces re-enter the SAME compiled stage (power-of-two buckets
+    keep recompiles bounded) and the result is identical."""
+    baseline, _ = _fused_query()
+    out, s = _fused_query({
+        "spark.rapids.tpu.test.injectOom": "1x3",
+        "spark.rapids.memory.tpu.retry.maxRetries": "1"})
+    assert out == baseline
+    agg = s.last_execution.aggregate()
+    splits = sum(v for k, v in agg.items() if k.endswith("Splits"))
+    assert splits >= 1, agg
+
+
+def test_oom_exhaustion_defuses_then_cpu_falls_back():
+    """Retries and split depth exhausted: the stage de-fuses to
+    operator-at-a-time, and operators that still cannot allocate run on
+    their CPU twins — result still identical."""
+    baseline, _ = _fused_query()
+    out, s = _fused_query({
+        "spark.rapids.tpu.test.injectOom": "1x200",
+        "spark.rapids.memory.tpu.retry.maxRetries": "0",
+        "spark.rapids.memory.tpu.retry.maxSplitDepth": "0"})
+    assert out == baseline
+    agg = s.last_execution.aggregate()
+    assert agg.get("numFusionFallbacks", 0) >= 1, agg
+    assert agg.get("numCpuFallbacks", 0) >= 1, agg
+
+
+def test_oom_agg_absorbed_stage_identical_results():
+    """OOM inside the aggregate-absorbed stage shape (q1-like)."""
+    def q(extra=None):
+        faults.INJECTOR.reset()
+        conf = dict(extra or {})
+        s = TpuSession(conf)
+        df = s.from_pydict({"k": [i % 5 for i in range(300)],
+                            "v": [float(i % 31) for i in range(300)]})
+        return (df.filter(col("v") < 29)
+                .select(col("k"), (col("v") + 1.0).alias("w"))
+                .group_by(col("k"))
+                .agg(F.sum(col("w")).alias("sw"))
+                .order_by(col("k")).collect())
+    baseline = q()
+    n_ops = faults.INJECTOR.oom_ops
+    for ordinal in range(1, n_ops + 1):
+        assert q({"spark.rapids.tpu.test.injectOom": str(ordinal)}) \
+            == baseline, f"ordinal {ordinal} changed the result"
+
+
+# --------------------------------------------------------------------------
+# AQE: re-planned reduce sides fuse too
+# --------------------------------------------------------------------------
+
+def _skewed_join(s):
+    n = 600
+    fact = s.from_pydict({
+        "k": [0 if i % 3 == 0 else i % 37 for i in range(n)],
+        "v": [float(i) for i in range(n)]})
+    dim = s.from_pydict({"k": list(range(37)),
+                         "w": [float(j) * 2 for j in range(37)]})
+    return (fact.join(dim, on="k")
+            .filter(col("v") >= 0)
+            .select(col("k"), (col("v") + col("w")).alias("z"))
+            .group_by(col("k")).agg(F.sum(col("z")).alias("sz"))
+            .order_by(col("k")))
+
+
+def test_aqe_on_fused_reduce_stages_match():
+    conf_on = {"spark.rapids.sql.tpu.adaptive.enabled": "true",
+               "spark.rapids.sql.tpu.metrics.level": "MODERATE"}
+    conf_off = {"spark.rapids.sql.tpu.adaptive.enabled": "false"}
+    s_on = TpuSession(conf_on)
+    rows_on = _skewed_join(s_on).collect()
+    rows_off = _run(_skewed_join, conf_off)
+    assert_rows_equal(rows_off, rows_on, ignore_order=False)
+    # the FINAL (re-planned) registered plan still carries fused stages
+    # with unique ids: adopt() registered them for observability
+    from spark_rapids_tpu.exec.whole_stage import TpuWholeStageExec
+    qe = s_on.last_execution
+    stages = [n for n in qe.nodes if isinstance(n, TpuWholeStageExec)]
+    assert stages, "no fused stages registered in the executed plan"
+    ids = [n.stage_id for n in stages if n.stage_id]
+    assert len(ids) == len(set(ids)), f"duplicate stage ids {ids}"
+    # AQE + fusion + OOM injection compose
+    faults.INJECTOR.reset()
+    s_inj = TpuSession({**conf_on,
+                        "spark.rapids.tpu.test.injectOom": "2x2"})
+    rows_inj = _skewed_join(s_inj).collect()
+    faults.INJECTOR.reset()
+    assert_rows_equal(rows_off, rows_inj, ignore_order=False)
+
+
+# --------------------------------------------------------------------------
+# EXPLAIN rendering + compile observability
+# --------------------------------------------------------------------------
+
+def test_explain_with_metrics_star_prefix_and_attribution():
+    s = TpuSession({"spark.rapids.sql.tpu.metrics.level": "MODERATE"})
+    _chain_df(s).collect()
+    text = s.last_execution.explain_with_metrics()
+    m = re.search(r"\*\(1\) TpuWholeStageExec\[[^\]]*\] \[(.*)\]", text)
+    assert m, text
+    assert "numFusedStages" in m.group(1)
+    # per-op attribution rows folded lazily, stage counts on each op
+    op_lines = [ln for ln in text.splitlines()
+                if re.match(r"\s*\*\(1\) Tpu(Filter|Project)Exec", ln)]
+    assert len(op_lines) >= 2, text
+    assert any("numOutputBatches" in ln for ln in op_lines), op_lines
+
+
+def test_compile_journal_kind_with_trace_split():
+    from spark_rapids_tpu.metrics.journal import validate_events
+    s = TpuSession({"spark.rapids.sql.tpu.metrics.level": "DEBUG"})
+    KC.clear_stage_executables()
+    _chain_df(s).collect()
+    events = s.last_execution.journal.events()
+    assert validate_events(events) == []
+    compiles = [e for e in events if e["kind"] == "compile"]
+    assert compiles, "no compile events journaled"
+    for e in compiles:
+        assert "trace_s" in e and "compile_s" in e, e
+
+
+def test_q1_shaped_pipeline_compile_count_halved():
+    """Acceptance: the q1 shape (scan -> filter -> project -> partial agg)
+    compiles >= 2x fewer distinct XLA programs with fusion ON, and runs
+    as <= 2 fused stage programs."""
+    def q(s):
+        df = s.from_pydict({"k": [i % 3 for i in range(500)],
+                            "v": [float(i % 53) for i in range(500)]})
+        return (df.filter(col("v") < 50)
+                .select(col("k"), (col("v") * 1.5).alias("w"))
+                .group_by(col("k"))
+                .agg(F.sum(col("w")).alias("sw"),
+                     F.avg(col("w")).alias("aw"),
+                     F.count(lit(1)).alias("c")))
+
+    # double sums need variableFloatAgg on the device (the bench sets the
+    # same conf for its TPC-H runs) — without it the agg plans on CPU and
+    # there is nothing to compile on either side
+    base = {"spark.rapids.sql.variableFloatAgg.enabled": "true"}
+
+    def compile_count(conf):
+        import jax
+        KC.clear()
+        jax.clear_caches()
+        before = KC.stats()
+        out = sorted(_run(q, {**base, **conf}))
+        after = KC.stats()
+        n = (after["builds"] - before["builds"]) \
+            + (after["stage_compiles"] - before["stage_compiles"])
+        return n, out
+
+    n_off, rows_off = compile_count(FUSION_OFF)
+    n_on, rows_on = compile_count({})
+    assert rows_on == rows_off
+    assert n_on * 2 <= n_off, f"fusion ON compiled {n_on} programs, " \
+        f"OFF compiled {n_off} — expected >= 2x reduction"
+    assert n_on <= 2, f"q1-shaped pipeline took {n_on} fused programs"
