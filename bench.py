@@ -462,6 +462,68 @@ def fusion_microbench() -> dict:
     return out
 
 
+def tracing_microbench() -> dict:
+    """Distributed-tracing overhead (the ISSUE-7 <5% acceptance gate):
+    the q1 pipeline on fresh sessions with tracing + a file journal ON
+    vs OFF (same table, kernels warm after each session's own warmup
+    run), plus the live-heartbeat rpc cost measured against a real
+    worker process — so 'tracing is cheap' is a recorded artifact, not
+    an assertion."""
+    import tempfile
+
+    from spark_rapids_tpu.engine import TpuSession
+
+    n = 200_000
+    table = make_lineitem(n)
+
+    def measure(conf):
+        s = TpuSession({"spark.rapids.sql.variableFloatAgg.enabled":
+                        "true", **conf})
+        df = s.from_arrow(table)
+        checksum(q1(df).collect())          # warmup: compile + caches
+        runs = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            checksum(q1(df).collect())
+            runs.append(time.perf_counter() - t0)
+        return min(runs)
+
+    off_s = measure({"spark.rapids.sql.tpu.trace.enabled": "false"})
+    jdir = tempfile.mkdtemp(prefix="bench_trace_")
+    on_s = measure({"spark.rapids.sql.tpu.trace.enabled": "true",
+                    "spark.rapids.sql.tpu.metrics.journal.dir": jdir})
+    overhead_pct = (on_s - off_s) / off_s * 100.0 if off_s > 0 else 0.0
+    out = {"rows": n, "q1_trace_off_s": round(off_s, 4),
+           "q1_trace_on_s": round(on_s, 4),
+           "overhead_pct": round(overhead_pct, 2),
+           # the acceptance gate: tracing must cost <5% on q1
+           "gate_ok": bool(overhead_pct < 5.0)}
+
+    # heartbeat cost: round-trip latency of rpc_heartbeat against a live
+    # worker process (the monitor polls on DEDICATED connections, so this
+    # latency is the whole cost — it never blocks the query path)
+    try:
+        from spark_rapids_tpu.cluster import ProcCluster
+        cluster = ProcCluster(
+            1, conf={"spark.rapids.sql.tpu.trace."
+                     "heartbeatIntervalMs": "0"}, cpu=True)
+        try:
+            w = cluster.workers[0]
+            w.rpc("heartbeat")              # connection warmup
+            t0 = time.perf_counter()
+            n_polls = 20
+            for _ in range(n_polls):
+                hb = w.rpc("heartbeat")
+            out["heartbeat_rpc_ms"] = round(
+                (time.perf_counter() - t0) / n_polls * 1e3, 3)
+            out["heartbeat_fields"] = sorted(hb.keys())
+        finally:
+            cluster.shutdown()
+    except Exception as e:  # the worker probe must never sink the bench
+        out["heartbeat_error"] = repr(e)[:200]
+    return out
+
+
 def child_main(mode: str) -> None:
     _DEADLINE[0] = time.time() + float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))
@@ -629,6 +691,13 @@ def child_main(mode: str) -> None:
         emit("fusion", **fusion_microbench())
     except Exception as e:
         emit("fusion", error=repr(e)[:200])
+    # tracing rollup (ISSUE 7): q1 with distributed tracing + journal on
+    # vs off (<5% acceptance gate) and the heartbeat rpc round-trip cost,
+    # so the observability tax is a measured BENCH_* artifact
+    try:
+        emit("tracing", **tracing_microbench())
+    except Exception as e:
+        emit("tracing", error=repr(e)[:200])
     emit("done", t=time.time() - (_DEADLINE[0] - float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))))
 
@@ -745,7 +814,7 @@ def collect(r: "StageReader", end_at: float,
     out = {"platform": None, "runs": {}, "warmup": {}, "values": {},
            "transfer": None, "aborted": False, "backend_error": None,
            "observability": None, "adaptive": None, "integrity": None,
-           "compress": None, "fusion": None}
+           "compress": None, "fusion": None, "tracing": None}
     first = True
     try:
         while True:
@@ -790,6 +859,9 @@ def collect(r: "StageReader", end_at: float,
             elif st == "fusion":
                 out["fusion"] = {k: v for k, v in rec.items()
                                  if k != "stage"}
+            elif st == "tracing":
+                out["tracing"] = {k: v for k, v in rec.items()
+                                  if k != "stage"}
             elif st == "abort":
                 out["aborted"] = True
                 break
@@ -945,6 +1017,7 @@ def _run():
         "integrity": dev.get("integrity"),
         "compress": dev.get("compress"),
         "fusion": dev.get("fusion"),
+        "tracing": dev.get("tracing"),
         "q6_effective_gb_s": round(eff_gb_s, 2),
         "hbm_roofline_note": "v5e HBM ~819 GB/s; q6 reads 32 B/row",
         "vs_ref_headline": round(vs / 19.8, 4),

@@ -68,6 +68,19 @@ T_FUS=$SECONDS
 python -m pytest tests/test_fusion.py -q -p no:cacheprovider
 echo "== fusion tier took $((SECONDS - T_FUS))s =="
 
+echo "== tracing tier =="
+# distributed tracing (ISSUE 7): trace-context wire propagation, journal
+# shard merge + wall-clock/probe alignment, critical-path + straggler
+# analysis, torn-line-free concurrent journal writes, chrome flow
+# events.  The fast subset runs here; -m "tracing and slow" adds the
+# 3-executor ProcCluster acceptance (merged timeline from every worker,
+# fetch<->serve flow links, injected-straggler flagging, monotonic
+# session.progress(), hung-task watchdog).
+T_TRC=$SECONDS
+python -m pytest tests/test_tracing.py -q -m "not slow" \
+    -p no:cacheprovider
+echo "== tracing tier took $((SECONDS - T_TRC))s =="
+
 echo "== tests (fast tier) =="
 T_TESTS=$SECONDS
 MARK="not slow"

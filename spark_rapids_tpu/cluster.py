@@ -26,11 +26,238 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 from .config import TpuConf
 
 log = logging.getLogger("spark_rapids_tpu.cluster")
+
+
+class HeartbeatMonitor:
+    """Driver-side live progress: polls every worker's `rpc_heartbeat`
+    on an interval over DEDICATED SocketClients — a long-running task rpc
+    holds its own client's lock for the whole call, so liveness must ride
+    separate sockets (the worker server threads answer concurrently).
+
+    What one heartbeat buys:
+      * progress: monotonic cluster totals (tasks completed, rows
+        written, wire bytes) accumulated restart-aware, surfaced as
+        `cluster.progress()` / `session.progress()`;
+      * liveness: per-worker heartbeat lag (`heartbeatLag`) + missed-poll
+        counting (`numMissedHeartbeats`);
+      * the hung-task watchdog: a task active past
+        `spark.rapids.sql.tpu.trace.hungTaskTimeoutMs` in successive
+        snapshots is logged once and counted (`numHungTasks`);
+      * clock probes: every round trip is an NTP-style sample
+        (local-before, worker wall, local-after) feeding the merged
+        timeline's per-worker offset estimation (metrics/timeline.py).
+    """
+
+    def __init__(self, cluster: "ProcCluster", interval_s: float,
+                 hung_timeout_s: float):
+        self.cluster = cluster
+        self.interval_s = max(float(interval_s), 0.05)
+        self.hung_timeout_s = float(hung_timeout_s)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._clients: Dict[str, tuple] = {}
+        self.latest: Dict[str, dict] = {}
+        self.last_ok_mono: Dict[str, float] = {}
+        self.clock_probes: Dict[str, deque] = {}
+        self._last_seen: Dict[str, dict] = {}
+        self._warned_hung = set()
+        self.started_mono = time.monotonic()
+        self.missed_heartbeats = 0
+        self.hung_tasks = 0
+        self.max_lag_s = 0.0
+        self.totals = {"heartbeats": 0, "tasks_completed": 0,
+                       "tasks_failed": 0, "rows_written": 0,
+                       "wire_bytes": 0}
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="heartbeat-monitor")
+        self._thread.start()
+
+    # -- polling -------------------------------------------------------------
+
+    def _client_for(self, worker):
+        from .shuffle.net import SocketClient
+        addr = tuple(worker.address)
+        stale = None
+        with self._lock:
+            if self._stop.is_set():
+                # stop() already closed + cleared the clients; never
+                # re-create one behind its back (fd leak on shutdown)
+                return None
+            cur = self._clients.get(worker.executor_id)
+            if cur is not None and cur[0] == addr:
+                return cur[1]
+            stale = cur[1] if cur is not None else None
+            # inject_faults=False: liveness polls must not consume the
+            # deterministic net-fault ordinals a test armed for the data
+            # plane.  The connect bound mirrors the poll's rpc timeout —
+            # one blackholed worker must not starve the other workers'
+            # heartbeats behind the transport's 30s data-plane default.
+            client = SocketClient(self.cluster._transport, addr,
+                                  inject_faults=False,
+                                  connect_timeout=max(
+                                      self.interval_s * 2, 2.0))
+            self._clients[worker.executor_id] = (addr, client)
+        if stale is not None:
+            stale.close()  # worker was replaced on a new port
+        return client
+
+    def poll_once(self) -> None:
+        for worker in list(self.cluster.workers):
+            if self._stop.is_set():
+                return
+            try:
+                client = self._client_for(worker)
+                if client is None:
+                    return
+                t0 = time.time_ns()
+                hb = client.rpc(
+                    "heartbeat",
+                    _rpc_timeout=max(self.interval_s * 2, 2.0))
+                t1 = time.time_ns()
+            except Exception as e:  # noqa: BLE001 — liveness, not control
+                with self._lock:
+                    self.missed_heartbeats += 1
+                    stale = self._clients.pop(worker.executor_id, None)
+                if stale is not None:
+                    try:
+                        stale[1].close()
+                    except Exception:  # noqa: BLE001 — already broken
+                        pass
+                log.debug("heartbeat poll of %s failed: %r",
+                          worker.executor_id, e)
+                continue
+            self._ingest(worker.executor_id, hb, t0, t1)
+
+    def _ingest(self, executor: str, hb: dict, t0: int, t1: int) -> None:
+        with self._lock:
+            self.latest[executor] = hb
+            self.last_ok_mono[executor] = time.monotonic()
+            self.clock_probes.setdefault(executor, deque(maxlen=64)) \
+                .append((t0, hb.get("wall_ns", t0), t1))
+            # restart-aware monotonic accumulation: a replaced worker's
+            # counters reset to zero — its full new value is the delta,
+            # so cluster totals NEVER go backwards (progress() contract)
+            last = self._last_seen.get(executor)
+            fresh = last is None or last.get("pid") != hb.get("pid")
+
+            def delta(field, new):
+                return new if fresh else max(0, new - last.get(field, 0))
+
+            counters = hb.get("counters", {}) or {}
+            wire = (int(counters.get("bytes_sent", 0))
+                    + int(counters.get("bytes_received", 0)))
+            self.totals["heartbeats"] += 1
+            self.totals["tasks_completed"] += delta(
+                "tasks_completed", int(hb.get("tasks_completed", 0)))
+            self.totals["tasks_failed"] += delta(
+                "tasks_failed", int(hb.get("tasks_failed", 0)))
+            self.totals["rows_written"] += delta(
+                "rows_written", int(hb.get("rows_written", 0)))
+            self.totals["wire_bytes"] += delta("wire_bytes", wire)
+            self._last_seen[executor] = {
+                "pid": hb.get("pid"),
+                "tasks_completed": int(hb.get("tasks_completed", 0)),
+                "tasks_failed": int(hb.get("tasks_failed", 0)),
+                "rows_written": int(hb.get("rows_written", 0)),
+                "wire_bytes": wire}
+            if self.hung_timeout_s > 0:
+                for task in hb.get("active_tasks", []) or []:
+                    if task.get("elapsed_s", 0) <= self.hung_timeout_s:
+                        continue
+                    key = (executor, hb.get("pid"), task.get("span"),
+                           task.get("name"))
+                    if key in self._warned_hung:
+                        continue
+                    self._warned_hung.add(key)
+                    self.hung_tasks += 1
+                    log.warning(
+                        "hung-task watchdog: %s task %r (stage %s) "
+                        "active for %.1fs (> %.1fs)", executor,
+                        task.get("name"), task.get("stage"),
+                        task.get("elapsed_s", 0), self.hung_timeout_s)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+                # fold the current per-worker lag into the heartbeatLag
+                # high-water every sweep — an outage must register even
+                # if nobody calls progress() while it lasts
+                self.lag_s()
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                log.debug("heartbeat poll sweep failed", exc_info=True)
+
+    # -- surfaces ------------------------------------------------------------
+
+    def lag_s(self) -> Dict[str, float]:
+        """Seconds since each worker was last heard from (workers never
+        heard from count from monitor start)."""
+        now = time.monotonic()
+        with self._lock:
+            out = {w.executor_id:
+                   now - self.last_ok_mono.get(w.executor_id,
+                                               self.started_mono)
+                   for w in self.cluster.workers}
+            if out:
+                self.max_lag_s = max(self.max_lag_s, max(out.values()))
+        return out
+
+    def probes(self) -> Dict[str, list]:
+        with self._lock:
+            return {ex: list(dq) for ex, dq in self.clock_probes.items()}
+
+    def progress(self) -> dict:
+        lag = self.lag_s()
+        with self._lock:
+            active = [dict(t, executor=ex)
+                      for ex, hb in self.latest.items()
+                      for t in (hb.get("active_tasks") or [])]
+            totals = dict(self.totals)
+            out = {
+                **totals,
+                "workers": len(self.cluster.workers),
+                "active_tasks": active,
+                "heartbeat_lag_s": max(lag.values()) if lag else 0.0,
+                "missed_heartbeats": self.missed_heartbeats,
+                "hung_tasks": self.hung_tasks,
+                # single monotonic figure for "is the query advancing?":
+                # every component is a cluster-lifetime high-water total
+                # of WORK (heartbeats deliberately excluded — a fully
+                # hung cluster keeps answering polls, and liveness is
+                # already surfaced as heartbeat_lag_s)
+                "score": (totals["tasks_completed"]
+                          + totals["rows_written"]
+                          + totals["wire_bytes"]),
+            }
+        return out
+
+    def metrics(self) -> dict:
+        """The lint-checked metric names this monitor owns
+        (docs/monitoring.md): folded into observability rollups."""
+        from .metrics import names as MN
+        return {MN.HEARTBEAT_LAG: self.max_lag_s,
+                MN.NUM_HUNG_TASKS: self.hung_tasks,
+                MN.NUM_MISSED_HEARTBEATS: self.missed_heartbeats}
+
+    def stop(self) -> None:
+        self._stop.set()
+        # let an in-flight poll finish (bounded by its rpc timeout) so it
+        # cannot re-create clients after the close/clear below
+        self._thread.join(timeout=5.0)
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for _addr, client in clients:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
 
 # the control RPC flattens worker-side exceptions to strings; FetchFailed's
 # repr deliberately carries this machine-parseable peer marker so the
@@ -151,7 +378,7 @@ class ProcCluster:
 
     def __init__(self, n_workers: int, conf: Optional[dict] = None,
                  cpu: bool = True, ready_timeout: float = 120.0,
-                 max_task_retries: int = 1):
+                 max_task_retries: int = 1, session=None):
         from .shuffle.net import SocketTransport
         self.conf = dict(conf or {})
         self._conf_env = json.dumps(self.conf)
@@ -168,8 +395,10 @@ class ProcCluster:
             raise
         # driver-side transport: client factory only (no server)
         self._transport = SocketTransport()
+        from . import config as C
         from .config import TpuConf
-        self._transport.configure(TpuConf(self.conf))
+        tconf = TpuConf(self.conf)
+        self._transport.configure(tconf)
         self._sid = 0
         self._lock = threading.Lock()
         self.task_retries = 0   # observability: recoveries this cluster
@@ -179,6 +408,25 @@ class ProcCluster:
         # died" and re-aggregate instead of re-planning on dead stats
         self.map_epoch = 0
         self._publish_peers()
+        # distributed tracing + live heartbeats (docs/monitoring.md):
+        # accumulated worker journal drains, straggler conf, and the
+        # heartbeat monitor on its dedicated connections
+        self.trace_enabled = bool(tconf.get(C.TRACE_ENABLED))
+        self.straggler_factor = float(tconf.get(C.TRACE_STRAGGLER_FACTOR))
+        # accumulated shard drains, keyed (executor_id, shard pid) so a
+        # replaced worker's restarted journal never aliases its
+        # predecessor's span ids (drain_journals)
+        self._drained: Dict[tuple, dict] = {}
+        self._query_counter = 0
+        self.monitor: Optional[HeartbeatMonitor] = None
+        interval_ms = int(tconf.get(C.TRACE_HEARTBEAT_INTERVAL))
+        if self.trace_enabled and interval_ms > 0:
+            self.monitor = HeartbeatMonitor(
+                self, interval_ms / 1e3,
+                int(tconf.get(C.TRACE_HUNG_TASK_TIMEOUT)) / 1e3)
+        # session attachment: session.progress() delegates here
+        if session is not None:
+            session._proc_cluster = self
 
     def _publish_peers(self) -> None:
         peers = {w.executor_id: list(w.address) for w in self.workers}
@@ -309,25 +557,38 @@ class ProcCluster:
                 f"{self.max_task_retries} retries") from e
 
     def run_map_reduce(self, map_plans: Sequence, key_names: List[str],
-                       n_parts: int, reduce_plan):
+                       n_parts: int, reduce_plan,
+                       trace_query: Optional[str] = None):
         """One full distributed stage:
           map_plans[i] — logical fragment worker i executes (its input
                          slice), hash-partitioned on key_names;
           reduce_plan  — logical fragment with a LogicalPlaceholder where
                          the fetched partition rows attach.
         Returns the concatenated arrow table of every partition's reduce
-        output, plus map statuses."""
+        output, plus map statuses.
+
+        `trace_query` names the query in the distributed trace (defaults
+        to a driver-unique id): every task rpc carries a {query, stage}
+        trace context, so the merged timeline groups the map and reduce
+        stages of ONE query across workers (metrics/timeline.py)."""
         import pyarrow as pa
         assert len(map_plans) == len(self.workers), \
             "one map fragment per worker"
         sid = self.new_shuffle_id()
+        if trace_query is None:
+            with self._lock:
+                self._query_counter += 1
+                trace_query = f"mr-{os.getpid()}-{self._query_counter}"
+        map_trace = {"query": trace_query, "stage": f"s{sid}.map"}
+        reduce_trace = {"query": trace_query, "stage": f"s{sid}.reduce"}
         map_stats: List[dict] = [None] * len(self.workers)
 
         def _attempt_map(i: int) -> dict:
             return self.workers[i].rpc(
                 "run_map", sid=sid,
                 plan_blob=pickle.dumps(map_plans[i]),
-                key_names=list(key_names), n_parts=n_parts)
+                key_names=list(key_names), n_parts=n_parts,
+                trace=map_trace)
 
         self._run_tasks_with_retry(
             "map", _attempt_map,
@@ -341,7 +602,8 @@ class ProcCluster:
                      if p % len(self.workers) == i]
             return self.workers[i].rpc("run_reduce", sid=sid,
                                        partitions=parts,
-                                       plan_blob=reduce_blob)
+                                       plan_blob=reduce_blob,
+                                       trace=reduce_trace)
 
         self._run_tasks_with_retry(
             "reduce", _attempt_reduce,
@@ -393,7 +655,95 @@ class ProcCluster:
         from .metrics.export import cluster_snapshot
         return cluster_snapshot(self)
 
+    # -- distributed tracing / live progress ---------------------------------
+
+    def progress(self) -> dict:
+        """Live, monotonically advancing progress snapshot (heartbeat
+        totals + recovery counters).  The `score` field never decreases
+        while work is happening — the serving tier's admission signal and
+        what `session.progress()` surfaces."""
+        if self.monitor is not None:
+            out = self.monitor.progress()
+        else:
+            out = {"heartbeats": 0, "tasks_completed": 0,
+                   "tasks_failed": 0, "rows_written": 0, "wire_bytes": 0,
+                   "workers": len(self.workers), "active_tasks": [],
+                   "heartbeat_lag_s": 0.0, "missed_heartbeats": 0,
+                   "hung_tasks": 0, "score": 0}
+        out["task_retries"] = self.task_retries
+        out["lost_map_outputs"] = self.lost_map_outputs
+        return out
+
+    def drain_journals(self) -> Dict[tuple, dict]:
+        """Pull every worker's undrained trace-shard events
+        (rpc_drain_journal) and fold them into the cluster-lifetime
+        accumulation — repeated drains compose, a dead worker keeps its
+        previously drained history.
+
+        Accumulation is keyed per shard EPOCH (executor id + the anchor's
+        pid): a replaced worker restarts its journal, so its span ids —
+        and its wall-clock anchor — collide with the dead process's.
+        Folding both under one label would re-pair old B records with new
+        E records and mis-aim flow links; instead the replacement gets a
+        suffixed timeline label (`exec-1#r2`) and its own anchor."""
+        for w in self.workers:
+            try:
+                rec = w.rpc("drain_journal")
+            except Exception as e:  # noqa: BLE001 — a dead worker keeps
+                log.debug("journal drain of %s failed: %r",  # its history
+                          w.executor_id, e)
+                continue
+            if not rec:
+                continue
+            ex = rec.get("executor_id", w.executor_id)
+            pid = (rec.get("anchor") or {}).get("pid")
+            key = (ex, pid)
+            if key not in self._drained:
+                n_epochs = sum(1 for (e2, _p) in self._drained
+                               if e2 == ex)
+                label = ex if n_epochs == 0 else f"{ex}#r{n_epochs + 1}"
+                self._drained[key] = {"label": label, "anchor": None,
+                                      "events": [], "dropped": 0}
+            acc = self._drained[key]
+            if rec.get("anchor"):
+                acc["anchor"] = rec["anchor"]
+            acc["events"].extend(rec.get("events") or [])
+            # the shard's dropped counter is cumulative over ITS lifetime
+            acc["dropped"] = int(rec.get("dropped") or 0)
+        return self._drained
+
+    def merged_timeline(self, extra_shards: Optional[List[dict]] = None):
+        """Drain every worker shard and merge into ONE wall-clock-aligned
+        Timeline, clock-corrected from the heartbeat monitor's probe
+        samples.  `extra_shards` adds driver-side journals (e.g. the
+        session's last query journal events under a 'driver' label)."""
+        from .metrics.timeline import merge_shards
+        self.drain_journals()
+        shards = [dict(rec) for rec in self._drained.values()]
+        shards.extend(extra_shards or [])
+        probes = self.monitor.probes() if self.monitor is not None else None
+        if probes:
+            # probe samples are keyed by executor id; restarted shard
+            # epochs carry suffixed labels (exec-1#r2) — hand each epoch
+            # its executor's samples under its timeline label
+            probes = dict(probes, **{
+                rec["label"]: probes[ex]
+                for (ex, _pid), rec in self._drained.items()
+                if ex in probes})
+        return merge_shards(shards, probes)
+
+    def timeline_report(self) -> dict:
+        """The merged timeline's analysis dict (critical path, per-task
+        overlap, stragglers, flow links) at the configured straggler
+        factor, plus the monitor's heartbeat metrics."""
+        rep = self.merged_timeline().report(self.straggler_factor)
+        if self.monitor is not None:
+            rep["metrics"].update(self.monitor.metrics())
+        return rep
+
     def shutdown(self) -> None:
+        if getattr(self, "monitor", None) is not None:
+            self.monitor.stop()
         for w in self.workers:
             w.stop()
         t = getattr(self, "_transport", None)

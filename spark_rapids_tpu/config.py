@@ -596,6 +596,16 @@ TEST_INJECT_CORRUPTION = _conf(
     "leaves (persistent: window of 9).  A bare ordinal ('5') counts "
     "across all sites; 'p=0.01' corrupts probabilistically (seeded by "
     "injectSeed).  Testing only.", str, internal=True)
+TEST_INJECT_DELAY = _conf(
+    "spark.rapids.tpu.test.injectDelay", "",
+    "Deterministic slowdown injection for straggler/watchdog testing.  "
+    "Comma-separated items 'site:ms' or 'scope/site:ms': the injector "
+    "sleeps that many milliseconds at every matching delay point "
+    "(worker task sites are 'map' and 'reduce').  A scope prefix "
+    "restricts the item to the process whose injector scope matches "
+    "(executor workers set their executor id as the scope), so "
+    "'exec-1/reduce:1500' slows ONLY exec-1's reduce tasks.  "
+    "Testing only.", str, internal=True)
 TEST_INJECT_SEED = _conf(
     "spark.rapids.tpu.test.injectSeed", 0,
     "Seed for the probabilistic fault-injection mode.", int,
@@ -624,7 +634,45 @@ METRICS_JOURNAL_DIR = _conf(
     "query/operator/retry/spill/fetch events with monotonic timestamps and "
     "parent links; one query-<id>.jsonl per query).  Empty disables the "
     "file journal; at metrics.level=DEBUG an in-memory journal is kept "
-    "regardless and is reachable via session.last_execution.journal.", str)
+    "regardless and is reachable via session.last_execution.journal.  "
+    "Executor worker processes additionally write one shard-<executor>"
+    ".jsonl trace shard each (docs/monitoring.md, Distributed tracing).",
+    str)
+
+# --- distributed tracing (metrics/timeline.py + shuffle wire trace) ----------
+TRACE_ENABLED = _conf(
+    "spark.rapids.sql.tpu.trace.enabled", True,
+    "Cluster-wide distributed tracing: every executor worker keeps a "
+    "process-lifetime journal shard (task/operator/fetch/serve spans with "
+    "a wall-clock anchor record), shuffle wire requests carry a "
+    "(query, stage, span, executor) trace context so a reducer's fetch "
+    "span flow-links to the mapper's serve span, and the driver can drain "
+    "+ merge every shard into ONE query timeline "
+    "(python -m spark_rapids_tpu.metrics --timeline; "
+    "cluster.merged_timeline()).  Off disables shard journaling, wire "
+    "trace stamping and the heartbeat monitor.", _to_bool)
+TRACE_STRAGGLER_FACTOR = _conf(
+    "spark.rapids.sql.tpu.trace.stragglerFactor", 3.0,
+    "A task whose duration exceeds this factor times the median duration "
+    "of its stage's tasks is flagged as a straggler by the merged-"
+    "timeline analysis (numStragglers; --timeline report).", float)
+TRACE_HEARTBEAT_INTERVAL = _conf(
+    "spark.rapids.sql.tpu.trace.heartbeatIntervalMs", 1000,
+    "Interval between live progress heartbeats pulled from every worker "
+    "over a DEDICATED control connection (counters, pool stats, active-"
+    "task snapshots -> session.progress() / cluster.progress()).  "
+    "0 disables the heartbeat monitor.", int)
+TRACE_HUNG_TASK_TIMEOUT = _conf(
+    "spark.rapids.sql.tpu.trace.hungTaskTimeoutMs", 600000,
+    "A task still active past this bound in a worker's heartbeat "
+    "snapshots is logged by the driver's hung-task watchdog and counted "
+    "(numHungTasks).  0 disables the watchdog.", int)
+TRACE_SHARD_MAX_EVENTS = _conf(
+    "spark.rapids.sql.tpu.trace.shard.maxEvents", 65536,
+    "Bound on undrained in-memory trace-shard events per worker; overflow "
+    "evicts the oldest events and is counted in the drain response "
+    "(a driver that never drains must not leak worker memory).", int,
+    internal=True)
 
 # --- export -----------------------------------------------------------------
 EXPORT_COLUMNAR_RDD = _conf(

@@ -45,6 +45,11 @@ class TpuSession:
         self.last_execution = None
         self.query_metrics_total: Dict[str, float] = {}
         self.queries_executed = 0
+        # live-progress surface (docs/monitoring.md): a ProcCluster
+        # constructed with session= attaches itself here and progress()
+        # delegates to its heartbeat monitor
+        self._proc_cluster = None
+        self._progress_high_water = 0
         _enable_compilation_cache(self.conf.get(C.COMPILATION_CACHE_DIR))
 
     def _begin_execution(self, physical: ExecNode, runtime=None):
@@ -133,6 +138,29 @@ class TpuSession:
     def set(self, key: str, value) -> "TpuSession":
         self.conf.set(key, value)
         return self
+
+    def progress(self) -> Dict:
+        """Live progress snapshot, advancing monotonically while work
+        happens.  With an attached ProcCluster (`ProcCluster(...,
+        session=session)`) this is the heartbeat monitor's cluster
+        rollup; for a local session it tracks executed queries, the
+        in-flight query's journal growth, and cumulative output rows.
+        `score` is the single never-decreasing figure."""
+        pc = self._proc_cluster
+        if pc is not None:
+            return pc.progress()
+        from .metrics.journal import active_journal
+        j = active_journal()
+        events = j.event_count() if j is not None else 0
+        rows = int(self.query_metrics_total.get("numOutputRows", 0))
+        raw = self.queries_executed + events + rows
+        # high-water: per-query journal ids restart, so the raw sum may
+        # dip between queries — the surfaced score never does
+        self._progress_high_water = max(self._progress_high_water, raw)
+        return {"queries": self.queries_executed,
+                "journal_events": events, "rows": rows,
+                "active_query": j is not None,
+                "score": self._progress_high_water}
 
     # -- planning -----------------------------------------------------------
     def plan(self, logical: L.LogicalPlan) -> ExecNode:

@@ -32,6 +32,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
@@ -47,15 +48,44 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # compile = a whole-stage XLA program was built for a new
                # (stage, batch-shape) pair, with the trace-vs-compile
                # time split (exec/whole_stage.py stage_executable)
-               "compile")
+               "compile",
+               # distributed tracing (metrics/timeline.py):
+               # task = one map/reduce fragment executed on a worker
+               # (attrs query/stage/executor), serve = this process served
+               # a shuffle buffer/metadata to a peer, carrying the
+               # REQUESTER's trace context (o_q/o_st/o_sp/o_ex) so the
+               # merged timeline flow-links it to the reducer's fetch
+               # span, heartbeat = a live progress snapshot
+               "task", "serve", "heartbeat")
 
 
 class EventJournal:
     def __init__(self, path: Optional[str] = None,
-                 query_id: Optional[str] = None):
+                 query_id: Optional[str] = None,
+                 anchor: bool = False, label: Optional[str] = None,
+                 mirror: bool = False, max_lines: Optional[int] = None,
+                 is_shard: bool = False):
+        """`anchor=True` writes one `{"ev":"A","wall_ns":...,"mono_ns":...}`
+        record at open so shards written by different processes (and even
+        before a driver ever connects) can be aligned on wall clock
+        offline.  `mirror=True` keeps an in-memory copy of every line even
+        when file-backed, bounded by `max_lines`, for `drain()` — the
+        incremental rpc_drain_journal feed.  `is_shard` marks a
+        process-lifetime worker trace shard: query executions ADOPT it
+        instead of opening their own journal (metrics/query.py)."""
         self.path = path
         self.query_id = query_id
-        self._lines: List[str] = []   # in-memory mirror when path is None
+        self.label = label
+        self.is_shard = is_shard
+        self._mirror = mirror or path is None
+        self._max_lines = max_lines
+        # in-memory mirror: the journal's readable copy when path is None,
+        # and the undrained drain() buffer for shards (bounded).  A deque
+        # so at-cap eviction is O(1) per event — a full 64k-line list
+        # would memmove its whole front on EVERY append, under the lock,
+        # on the per-batch instrumentation path
+        self._lines: "deque[str]" = deque()
+        self.dropped_lines = 0
         self._file = None
         if path is not None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -64,6 +94,19 @@ class EventJournal:
         self._next_id = 0
         self._open_spans: Dict[int, dict] = {}
         self.closed = False
+        self.anchor: Optional[dict] = None
+        if anchor:
+            # wall-clock anchor: maps this journal's monotonic timestamps
+            # to wall time (wall_ns + (ts - mono_ns)); sampled as one
+            # atomic pair so the mapping error is bounded by the gap
+            # between the two clock reads
+            self.anchor = {"ev": "A", "wall_ns": time.time_ns(),
+                           "mono_ns": time.monotonic_ns(),
+                           "pid": os.getpid()}
+            if label is not None:
+                self.anchor["label"] = label
+            with self._lock:
+                self._emit(self.anchor)
 
     # -- writing -------------------------------------------------------------
 
@@ -72,7 +115,13 @@ class EventJournal:
         if self._file is not None:
             self._file.write(line + "\n")
             self._file.flush()
-        else:
+        if self._mirror or self._file is None:
+            if self._max_lines is not None \
+                    and len(self._lines) >= self._max_lines:
+                # bound undrained shard memory: evict oldest, count loss
+                while len(self._lines) >= self._max_lines:
+                    self._lines.popleft()
+                    self.dropped_lines += 1
             self._lines.append(line)
 
     def _record(self, ev: str, kind: str, name: str,
@@ -147,6 +196,28 @@ class EventJournal:
         with self._lock:
             return [json.loads(ln) for ln in self._lines]
 
+    def event_count(self) -> int:
+        """Records written over this journal's lifetime (span begins and
+        instants; unaffected by mirror eviction or drains) — a cheap
+        monotonic activity signal (engine.TpuSession.progress)."""
+        with self._lock:
+            return self._next_id
+
+    def drain(self) -> dict:
+        """Take (and clear) the undrained in-memory mirror — the
+        incremental feed the driver pulls over rpc_drain_journal.  Always
+        carries the anchor so the first drain of a shard is alignable;
+        `dropped` counts events evicted by the memory bound since open."""
+        with self._lock:
+            lines, self._lines = self._lines, deque()
+            dropped = self.dropped_lines
+        events = [json.loads(ln) for ln in lines]
+        # the anchor rides every drain response (it is also the first
+        # mirrored line of the first drain; consumers dedup on "ev"=="A")
+        return {"anchor": self.anchor, "label": self.label,
+                "events": [e for e in events if e.get("ev") != "A"],
+                "dropped": dropped}
+
 
 def read_journal(path: str) -> List[dict]:
     out = []
@@ -166,6 +237,13 @@ def validate_events(events: List[dict]) -> List[str]:
     last_ts = None
     for i, e in enumerate(events):
         where = f"event {i}"
+        if e.get("ev") == "A":
+            # wall-clock anchor record (shard alignment): no id/kind/name,
+            # just the wall<->monotonic clock pair sampled at journal open
+            for field in ("wall_ns", "mono_ns"):
+                if field not in e:
+                    errors.append(f"{where}: anchor missing {field!r}")
+            continue
         for field in ("ts", "ev", "kind", "name", "id"):
             if field not in e:
                 errors.append(f"{where}: missing field {field!r}")
@@ -233,3 +311,109 @@ def journal_event(kind: str, name: str, **attrs) -> None:
     j = active_journal()
     if j is not None:
         j.instant(kind, name, **attrs)
+
+
+@contextlib.contextmanager
+def journal_span(kind: str, name: str, **attrs):
+    """Span in the active journal (yields the span id, or None when no
+    journal is open) — the deep-layer twin of journal_event for
+    operations whose DURATION matters to the timeline (remote fetches,
+    buffer serves)."""
+    j = active_journal()
+    if j is None:
+        yield None
+        return
+    sid = j.begin(kind, name, **attrs)
+    try:
+        yield sid
+    finally:
+        j.end(sid)
+
+
+# -- distributed trace context ------------------------------------------------
+# The (query, stage, span, executor) tuple stamped on shuffle wire requests
+# so the SERVING side can journal who it served (metrics/timeline.py
+# flow-links the reducer's fetch span to the mapper's serve span).  Kept in
+# a thread-local: the worker's task dispatch sets (query, stage), the
+# fetch path narrows `span` to its own fetch-span id for the duration of
+# the wire ops.  Tuple layout on the wire: (query, stage, span, executor).
+
+_TRACE = threading.local()
+
+
+def current_trace() -> Optional[tuple]:
+    return getattr(_TRACE, "ctx", None)
+
+
+@contextlib.contextmanager
+def trace_context(query=None, stage=None, span=None, executor=None):
+    """Install a trace context for the calling thread, inheriting unset
+    fields from the enclosing context."""
+    prev = current_trace()
+    base = prev or (None, None, None, None)
+    ctx = (query if query is not None else base[0],
+           stage if stage is not None else base[1],
+           span if span is not None else base[2],
+           executor if executor is not None else base[3])
+    _TRACE.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _TRACE.ctx = prev
+
+
+def trace_attrs(trace: Optional[tuple], prefix: str = "o_") -> dict:
+    """Journal attrs for a wire-carried trace context: o_q/o_st/o_sp/o_ex
+    (origin query/stage/span/executor) — what serve events record."""
+    if not trace:
+        return {}
+    q, st, sp, ex = (tuple(trace) + (None,) * 4)[:4]
+    out = {}
+    if q is not None:
+        out[prefix + "q"] = q
+    if st is not None:
+        out[prefix + "st"] = st
+    if sp is not None:
+        out[prefix + "sp"] = sp
+    if ex is not None:
+        out[prefix + "ex"] = ex
+    return out
+
+
+# -- worker trace shard -------------------------------------------------------
+# One process-lifetime journal per executor worker: task spans, fetch/serve
+# spans and deep-layer events all land here (query executions ADOPT it, so
+# operator spans do too), and the driver drains it incrementally over
+# rpc_drain_journal into the merged cluster timeline.
+
+_SHARD: List[Optional[EventJournal]] = [None]
+
+
+def open_shard(executor_id: str, path: Optional[str] = None,
+               max_events: int = 65536) -> EventJournal:
+    """Open (or return) this process's trace shard and push it as the
+    bottom-of-stack active journal so every deep-layer event has a home
+    even outside query execution (serve threads, idle heartbeats)."""
+    if _SHARD[0] is not None:
+        return _SHARD[0]
+    shard = EventJournal(path, anchor=True, label=executor_id,
+                         mirror=True, max_lines=max_events, is_shard=True)
+    _SHARD[0] = shard
+    with _ACTIVE_LOCK:
+        _ACTIVE.insert(0, shard)
+    return shard
+
+
+def process_shard() -> Optional[EventJournal]:
+    return _SHARD[0]
+
+
+def close_shard() -> None:
+    """Tear down the process shard (tests; workers die with theirs)."""
+    shard = _SHARD[0]
+    _SHARD[0] = None
+    if shard is not None:
+        with _ACTIVE_LOCK:
+            if shard in _ACTIVE:
+                _ACTIVE.remove(shard)
+        shard.close()

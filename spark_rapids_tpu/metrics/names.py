@@ -239,6 +239,32 @@ NUM_FUSION_FALLBACKS = register_metric(
     "fused stages that exhausted stage-level OOM retries and fell back "
     "to executing their constituent operators one at a time")
 
+# --- distributed tracing / heartbeats (metrics/timeline.py, cluster.py) ------
+HEARTBEAT_LAG = register_metric(
+    "heartbeatLag", GAUGE, ESSENTIAL,
+    "seconds since the driver's heartbeat monitor last heard from the "
+    "slowest worker (high-water over the monitor's lifetime); a growing "
+    "lag means a worker stopped answering its dedicated control "
+    "connection")
+NUM_STRAGGLERS = register_metric(
+    "numStragglers", COUNTER, ESSENTIAL,
+    "tasks the merged-timeline analysis flagged as stragglers (duration "
+    "> spark.rapids.sql.tpu.trace.stragglerFactor x the stage median)")
+TRACED_FETCH_LINKS = register_metric(
+    "tracedFetchLinks", COUNTER, ESSENTIAL,
+    "reducer fetch spans flow-linked to the serving mapper's serve "
+    "record in the merged timeline (the cross-worker trace propagation "
+    "working end to end)")
+NUM_HUNG_TASKS = register_metric(
+    "numHungTasks", COUNTER, ESSENTIAL,
+    "tasks the hung-task watchdog saw active past "
+    "spark.rapids.sql.tpu.trace.hungTaskTimeoutMs in a worker's "
+    "heartbeat snapshots (each task is counted once)")
+NUM_MISSED_HEARTBEATS = register_metric(
+    "numMissedHeartbeats", COUNTER, ESSENTIAL,
+    "heartbeat polls that failed or timed out on a worker's dedicated "
+    "control connection")
+
 # --- adaptive query execution (adaptive/) -----------------------------------
 NUM_COALESCED_PARTITIONS = register_metric(
     "numCoalescedPartitions", COUNTER, ESSENTIAL,

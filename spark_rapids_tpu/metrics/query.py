@@ -33,7 +33,8 @@ import time
 from typing import Dict, List, Optional
 
 from . import names as N
-from .journal import EventJournal, pop_active, push_active
+from .journal import (EventJournal, active_journal, pop_active,
+                      push_active, trace_context)
 from .registry import Metrics, parse_level
 
 _QUERY_IDS = itertools.count(1)
@@ -54,10 +55,26 @@ class QueryExecution:
         self.level = parse_level(conf.get(C.METRICS_LEVEL))
         jdir = str(conf.get(C.METRICS_JOURNAL_DIR) or "")
         self.journal: Optional[EventJournal] = None
-        if jdir or self.level >= N.DEBUG:
+        self._owns_journal = True
+        # executor worker processes keep ONE process-lifetime trace shard
+        # (journal.open_shard); a query executed there adopts it so
+        # operator spans land in the shard the driver drains — and worker
+        # processes never open per-query files whose names would collide
+        # across processes under a shared journal.dir.  Adopted journals
+        # are never closed by finish().
+        shared = active_journal()
+        if shared is not None and shared.is_shard:
+            self.journal = shared
+            self._owns_journal = False
+        elif jdir or self.level >= N.DEBUG:
             path = (os.path.join(jdir, f"query-{self.query_id}.jsonl")
                     if jdir else None)
-            self.journal = EventJournal(path, query_id=self.query_id)
+            # file-backed journals carry a wall-clock anchor record so the
+            # driver's query spans align with worker trace shards offline
+            # (metrics/timeline.py)
+            self.journal = EventJournal(path, query_id=self.query_id,
+                                        anchor=path is not None,
+                                        label="driver")
         # preorder walk: node ids, parent links, per-query metrics level
         self.nodes: List = []
         self._parent_of: Dict[int, Optional[int]] = {}
@@ -71,6 +88,7 @@ class QueryExecution:
         self.duration = None
         self.error = None
         self.finished = False
+        self._trace_cm = None
         if self.journal is not None:
             self._query_span = self.journal.begin(
                 "query", f"query-{self.query_id}", level=self.level,
@@ -78,6 +96,16 @@ class QueryExecution:
             for node in self.nodes:
                 self._instrument(node)
             push_active(self.journal)
+            if self._owns_journal:
+                # driver-side trace context: loopback/in-process serve
+                # events record which query's fetch they answered.  On a
+                # worker (adopted shard) the task dispatch already set the
+                # DRIVER's trace context — never clobber it with the
+                # worker-local query id.
+                self._trace_cm = trace_context(
+                    query=f"q{self.query_id}", span=self._query_span,
+                    executor="driver")
+                self._trace_cm.__enter__()
 
     # -- tree bookkeeping ----------------------------------------------------
 
@@ -194,9 +222,18 @@ class QueryExecution:
             finally:
                 # whatever the metric dump did, the journal must come off
                 # the active stack (or later queries' events misroute into
-                # it) and release its file handle
+                # it) and release its file handle.  An adopted worker
+                # trace shard outlives every query: popped (it was pushed
+                # a second time above), never closed.
+                if self._trace_cm is not None:
+                    try:
+                        self._trace_cm.__exit__(None, None, None)
+                    except Exception:  # pragma: no cover - thread moved
+                        pass
+                    self._trace_cm = None
                 pop_active(self.journal)
-                self.journal.close()
+                if self._owns_journal:
+                    self.journal.close()
         return self
 
     # -- reporting -----------------------------------------------------------

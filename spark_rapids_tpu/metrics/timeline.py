@@ -1,0 +1,500 @@
+"""Cluster-wide query timeline: merge per-worker journal shards into one
+wall-clock-aligned span timeline and analyze it.
+
+A ProcCluster query produces N+1 journal shards — one per worker process
+(shuffle/worker.py opens it via `journal.open_shard`) plus the driver's
+per-query journal — each timestamped with its OWN process's monotonic
+clock.  This module makes them comparable:
+
+  * every shard carries a wall-clock ANCHOR record (`{"ev":"A","wall_ns":
+    ...,"mono_ns":...}`, written at journal open), so an event's wall time
+    is `anchor.wall_ns + (ts - anchor.mono_ns)` — alignable offline, even
+    for shards written before any driver connected;
+  * when the driver is live, its heartbeat round trips double as NTP-style
+    clock probes: each sample `(local_before, remote_wall, local_after)`
+    estimates the remote wall clock's offset as `remote - midpoint`, and
+    the minimum-RTT sample wins (`estimate_clock_offset`) — correcting for
+    hosts whose wall clocks disagree;
+  * `merge_shards` builds a `Timeline`: spans (B/E pairs re-joined),
+    instants, and the cross-worker FLOW LINKS — every `serve` event a
+    mapper journaled carries the requesting reducer's trace context
+    (o_ex/o_sp), which names the reducer's fetch span exactly.
+
+Analysis on the merged timeline (the `--timeline` CLI report and the
+acceptance surface of docs/tuning-guide.md, Distributed tracing):
+
+  * per-stage critical path: the longest task of each stage, chained in
+    stage order — where the query's wall time actually went;
+  * per-task overlap breakdown: fetch vs compute vs decompress vs idle,
+    with the fraction of fetch time hidden under compute (was the reduce
+    side waiting on fetch, decompress, or compute?);
+  * straggler flagging: task duration > stragglerFactor x the stage
+    median (`spark.rapids.sql.tpu.trace.stragglerFactor`).
+"""
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .journal import read_journal
+
+
+@dataclass
+class TimelineSpan:
+    executor: str
+    span_id: int
+    kind: str
+    name: str
+    t0_ns: int
+    t1_ns: Optional[int]          # None = still open at drain time
+    parent: Optional[int] = None
+    attrs: Dict = field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> float:
+        if self.t1_ns is None:
+            return 0.0
+        return (self.t1_ns - self.t0_ns) / 1e9
+
+
+def estimate_clock_offset(samples) -> Tuple[int, int]:
+    """NTP-style offset estimate from `(local_before_ns, remote_wall_ns,
+    local_after_ns)` samples: offset = remote - midpoint(local), taking
+    the minimum-round-trip sample (its midpoint bounds the error by
+    rtt/2).  Returns (offset_ns, rtt_ns); offset is what to SUBTRACT from
+    remote wall timestamps to land on the local clock."""
+    best: Optional[Tuple[int, int]] = None
+    for t0, remote, t1 in samples:
+        rtt = int(t1) - int(t0)
+        off = int(remote) - (int(t0) + int(t1)) // 2
+        if best is None or rtt < best[1]:
+            best = (off, rtt)
+    if best is None:
+        return 0, -1
+    return best
+
+
+def _interval_union(intervals: List[Tuple[int, int]]) -> int:
+    """Total covered length of possibly-overlapping [a, b) intervals."""
+    return sum(b - a for a, b in _merge_runs(intervals))
+
+
+def _merge_runs(intervals: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    """Collapse possibly-overlapping [a, b) intervals into sorted disjoint
+    runs (so intersection math never double-counts an overlap)."""
+    runs: List[Tuple[int, int]] = []
+    for a, b in sorted(intervals):
+        if b <= a:
+            continue
+        if runs and a <= runs[-1][1]:
+            if b > runs[-1][1]:
+                runs[-1] = (runs[-1][0], b)
+        else:
+            runs.append((a, b))
+    return runs
+
+
+def _intersect_len(xs: List[Tuple[int, int]],
+                   ys: List[Tuple[int, int]]) -> int:
+    """Length of union(xs) ∩ union(ys) (two-pointer over merged runs)."""
+    rx, ry = _merge_runs(xs), _merge_runs(ys)
+    total = 0
+    i = j = 0
+    while i < len(rx) and j < len(ry):
+        lo = max(rx[i][0], ry[j][0])
+        hi = min(rx[i][1], ry[j][1])
+        if hi > lo:
+            total += hi - lo
+        if rx[i][1] <= ry[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+class Timeline:
+    """Merged, wall-clock-aligned view over every shard's events."""
+
+    def __init__(self):
+        self.spans: List[TimelineSpan] = []
+        self.instants: List[dict] = []      # normalized instant events
+        self.anchors: Dict[str, dict] = {}
+        self.offsets_ns: Dict[str, int] = {}
+        self.dropped: Dict[str, int] = {}
+        self.unanchored: List[str] = []
+        self._by_id: Dict[Tuple[str, int], TimelineSpan] = {}
+        # base-executor index: wire trace contexts carry the PLAIN
+        # executor id, but a shard's timeline label may be qualified — a
+        # replaced worker's epoch (`exec-1#r2`, span ids restart per
+        # process) or a driver query journal (`driver/query-1`).  Links
+        # resolve through this index, disambiguating by serve time.
+        self._by_base: Dict[Tuple[str, int], List[TimelineSpan]] = {}
+
+    # -- construction --------------------------------------------------------
+
+    def add_shard(self, executor: str, events: List[dict],
+                  anchor: Optional[dict] = None,
+                  offset_ns: int = 0, dropped: int = 0,
+                  base: Optional[str] = None) -> None:
+        base_executor = (base if base is not None
+                         else executor.split("#", 1)[0])
+        if anchor is None:
+            anchor = next((e for e in events if e.get("ev") == "A"), None)
+        if anchor is not None:
+            self.anchors[executor] = anchor
+            clock_base_ns = (int(anchor["wall_ns"])
+                             - int(anchor["mono_ns"]))
+        else:
+            # degraded: no wall anchor — monotonic timestamps pass
+            # through unaligned (still internally ordered per shard)
+            self.unanchored.append(executor)
+            clock_base_ns = 0
+        self.offsets_ns[executor] = offset_ns
+        self.dropped[executor] = self.dropped.get(executor, 0) + dropped
+        open_spans: Dict[int, TimelineSpan] = {}
+        for e in events:
+            ev = e.get("ev")
+            if ev == "A":
+                continue
+            wall = int(e.get("ts", 0)) + clock_base_ns - offset_ns
+            attrs = {k: v for k, v in e.items()
+                     if k not in ("ts", "ev", "kind", "name", "id",
+                                  "parent", "span")}
+            if ev == "B":
+                sp = TimelineSpan(executor, e["id"], e.get("kind", "?"),
+                                  e.get("name", "?"), wall, None,
+                                  e.get("parent"), attrs)
+                open_spans[e["id"]] = sp
+                self.spans.append(sp)
+                self._by_id[(executor, e["id"])] = sp
+                self._by_base.setdefault(
+                    (base_executor, e["id"]), []).append(sp)
+            elif ev == "E":
+                sp = open_spans.pop(e.get("span"), None)
+                if sp is None:
+                    # E for a span whose B was evicted by the shard
+                    # memory bound — drop it rather than invent a span
+                    continue
+                sp.t1_ns = wall
+                sp.attrs.update(attrs)
+            elif ev == "I":
+                self.instants.append(
+                    {"executor": executor, "wall_ns": wall,
+                     "kind": e.get("kind", "?"), "name": e.get("name", "?"),
+                     "attrs": attrs})
+
+    def span_by_id(self, executor: str, span_id) -> Optional[TimelineSpan]:
+        try:
+            return self._by_id.get((executor, int(span_id)))
+        except (TypeError, ValueError):
+            return None
+
+    def _resolve_fetch(self, o_ex, o_sp,
+                       at_ns: int) -> Optional[TimelineSpan]:
+        """Fetch span a serve record's carried trace (o_ex, o_sp) names.
+        o_ex is the plain executor id; candidate spans may live under
+        qualified shard labels (restart epochs, driver query journals)
+        and span ids RESTART per process — when several epochs carry the
+        same id, the span whose window covers (or is nearest) the serve
+        time wins."""
+        try:
+            cands = self._by_base.get((str(o_ex), int(o_sp))) or []
+        except (TypeError, ValueError):
+            return None
+        cands = [s for s in cands if s.kind == "fetch"]
+        if not cands:
+            return None
+        if len(cands) == 1:
+            return cands[0]
+
+        def distance(s: TimelineSpan) -> int:
+            t1 = s.t1_ns if s.t1_ns is not None else s.t0_ns
+            if s.t0_ns <= at_ns <= t1:
+                return 0
+            return min(abs(at_ns - s.t0_ns), abs(at_ns - t1))
+
+        return min(cands, key=distance)
+
+    # -- structure -----------------------------------------------------------
+
+    def executors(self) -> List[str]:
+        seen = dict.fromkeys(s.executor for s in self.spans)
+        for i in self.instants:
+            seen.setdefault(i["executor"], None)
+        for ex in self.anchors:
+            seen.setdefault(ex, None)
+        return list(seen)
+
+    def tasks(self) -> List[TimelineSpan]:
+        return [s for s in self.spans if s.kind == "task"]
+
+    def fetch_spans(self) -> List[TimelineSpan]:
+        return [s for s in self.spans
+                if s.kind == "fetch" and s.t1_ns is not None]
+
+    def links(self) -> List[dict]:
+        """Cross-worker flow links: every serve record whose carried trace
+        context (o_ex, o_sp) resolves to a fetch span in the merged
+        timeline — the reducer-fetch <-> mapper-serve pairing."""
+        out = []
+        serves = ([{"executor": s.executor, "wall_ns": s.t0_ns,
+                    "end_ns": s.t1_ns, "name": s.name, "attrs": s.attrs}
+                   for s in self.spans if s.kind == "serve"]
+                  + [{"executor": i["executor"], "wall_ns": i["wall_ns"],
+                      "end_ns": None, "name": i["name"],
+                      "attrs": i["attrs"]}
+                     for i in self.instants if i["kind"] == "serve"])
+        for srv in serves:
+            o_ex, o_sp = srv["attrs"].get("o_ex"), srv["attrs"].get("o_sp")
+            if o_ex is None or o_sp is None:
+                continue
+            fetch = self._resolve_fetch(o_ex, o_sp, srv["wall_ns"])
+            if fetch is not None:
+                out.append({"fetch": fetch, "serve": srv})
+        return out
+
+    # -- analysis ------------------------------------------------------------
+
+    def task_breakdown(self) -> List[dict]:
+        """Per-task overlap accounting: where each task's wall time went.
+
+        fetch_s       union of the task's shuffle-fetch spans
+        compute_s     union of operator/query spans under the task (when
+                      the worker instrumented them), else duration - fetch
+        decompress_s  summed codec time journaled by the fetch path
+        overlap_s     fetch time hidden under concurrent compute
+        idle_s        task time covered by NEITHER fetch nor compute
+        """
+        out = []
+        for t in self.tasks():
+            if t.t1_ns is None:
+                continue
+            t0, t1 = t.t0_ns, t.t1_ns
+
+            def clip(sp):
+                return (max(sp.t0_ns, t0),
+                        min(sp.t1_ns if sp.t1_ns is not None else t1, t1))
+
+            fetch = [clip(s) for s in self.spans
+                     if s.executor == t.executor and s.kind == "fetch"
+                     and s.t0_ns < t1
+                     and (s.t1_ns is None or s.t1_ns > t0)]
+            compute = [clip(s) for s in self.spans
+                       if s.executor == t.executor
+                       and s.kind in ("operator", "query")
+                       and s.t0_ns < t1
+                       and (s.t1_ns is None or s.t1_ns > t0)]
+            decomp_s = sum(
+                float(i["attrs"].get("seconds", 0.0))
+                for i in self.instants
+                if i["executor"] == t.executor and i["kind"] == "compress"
+                and i["name"].startswith("decompress")
+                and t0 <= i["wall_ns"] <= t1)
+            dur = t1 - t0
+            fetch_len = _interval_union(fetch)
+            comp_len = _interval_union(compute)
+            busy = _interval_union(fetch + compute)
+            overlap = _intersect_len(fetch, compute)
+            rec = {"executor": t.executor, "name": t.name,
+                   "query": t.attrs.get("query"),
+                   "stage": t.attrs.get("stage"),
+                   "start_ns": t0, "duration_s": dur / 1e9,
+                   "fetch_s": fetch_len / 1e9,
+                   "decompress_s": decomp_s,
+                   "overlap_s": overlap / 1e9,
+                   "idle_s": max(dur - busy, 0) / 1e9 if compute
+                   else 0.0,
+                   "compute_s": comp_len / 1e9 if compute
+                   else max(dur - fetch_len, 0) / 1e9,
+                   # fraction of fetch wall time hidden under compute —
+                   # 1.0 means the wire never blocked the task
+                   "overlap_efficiency":
+                       (overlap / fetch_len) if fetch_len else 1.0}
+            out.append(rec)
+        return out
+
+    def critical_path(self) -> Dict[Optional[str], dict]:
+        """Per query: the longest task of each stage chained in stage
+        order — the lower bound a perfect scheduler could not beat."""
+        by_query: Dict[Optional[str], Dict[str, List[TimelineSpan]]] = {}
+        for t in self.tasks():
+            if t.t1_ns is None:
+                continue
+            q = t.attrs.get("query")
+            st = str(t.attrs.get("stage"))
+            by_query.setdefault(q, {}).setdefault(st, []).append(t)
+        out: Dict[Optional[str], dict] = {}
+        for q, stages in by_query.items():
+            ordered = sorted(stages.items(),
+                             key=lambda kv: min(t.t0_ns for t in kv[1]))
+            path = []
+            for st, ts in ordered:
+                longest = max(ts, key=lambda t: t.duration_s)
+                path.append({"stage": st, "executor": longest.executor,
+                             "name": longest.name,
+                             "duration_s": longest.duration_s,
+                             "tasks": len(ts)})
+            all_ts = [t for ts in stages.values() for t in ts]
+            wall = (max(t.t1_ns for t in all_ts)
+                    - min(t.t0_ns for t in all_ts)) / 1e9
+            total = sum(p["duration_s"] for p in path)
+            out[q] = {"path": path, "critical_path_s": total,
+                      "wall_s": wall,
+                      # how much of the wall clock the critical path
+                      # explains; the rest is scheduling/driver gaps
+                      "coverage": (total / wall) if wall > 0 else 1.0}
+        return out
+
+    def stragglers(self, factor: float = 3.0) -> List[dict]:
+        """Tasks slower than `factor` x their stage's median duration."""
+        by_stage: Dict[Tuple, List[TimelineSpan]] = {}
+        for t in self.tasks():
+            if t.t1_ns is None:
+                continue
+            key = (t.attrs.get("query"), str(t.attrs.get("stage")))
+            by_stage.setdefault(key, []).append(t)
+        out = []
+        for (q, st), ts in by_stage.items():
+            if len(ts) < 2:
+                continue
+            durs = sorted(t.duration_s for t in ts)
+            # LOWER median: with few tasks the straggler itself drags any
+            # average-inclusive median up — the upper median of a 2-task
+            # stage IS the slowest task (can never exceed factor x
+            # itself), and even the true median makes a 2-task straggler
+            # mathematically unflaggable for factor >= 2
+            median = durs[(len(durs) - 1) // 2]
+            if median <= 0:
+                continue
+            for t in ts:
+                if t.duration_s > factor * median:
+                    out.append({"query": q, "stage": st,
+                                "executor": t.executor, "name": t.name,
+                                "duration_s": t.duration_s,
+                                "median_s": median,
+                                "factor": t.duration_s / median})
+        return out
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self, straggler_factor: float = 3.0) -> dict:
+        links = self.links()
+        fetches = self.fetch_spans()
+        stragglers = self.stragglers(straggler_factor)
+        linked_ids = {(lk["fetch"].executor, lk["fetch"].span_id)
+                      for lk in links}
+        per_exec = {}
+        for ex in self.executors():
+            per_exec[ex] = {
+                "spans": sum(1 for s in self.spans if s.executor == ex),
+                "instants": sum(1 for i in self.instants
+                                if i["executor"] == ex),
+                "offset_ns": self.offsets_ns.get(ex, 0),
+                "dropped": self.dropped.get(ex, 0),
+            }
+        return {
+            "executors": per_exec,
+            "tasks": self.task_breakdown(),
+            "critical_path": self.critical_path(),
+            "stragglers": stragglers,
+            "links": len(links),
+            "fetch_spans": len(fetches),
+            "unlinked_fetches": sum(
+                1 for f in fetches
+                if (f.executor, f.span_id) not in linked_ids),
+            # the lint-checked metric names the analysis feeds
+            # (docs/monitoring.md): counted here, surfaced by
+            # cluster.merged_timeline / the --timeline CLI
+            "metrics": {"numStragglers": len(stragglers),
+                        "tracedFetchLinks": len(links)},
+        }
+
+    def render(self, straggler_factor: float = 3.0) -> str:
+        rep = self.report(straggler_factor)
+        lines = ["== merged cluster timeline =="]
+        for ex, info in sorted(rep["executors"].items()):
+            off = info["offset_ns"] / 1e6
+            lines.append(
+                f"  {ex}: {info['spans']} spans, {info['instants']} "
+                f"instants, clock offset {off:+.3f}ms"
+                + (f", {info['dropped']} dropped" if info["dropped"]
+                   else ""))
+        lines.append(f"flow links: {rep['links']} fetch<->serve pairs "
+                     f"({rep['unlinked_fetches']} unlinked of "
+                     f"{rep['fetch_spans']} fetch spans)")
+        for q, cp in sorted(rep["critical_path"].items(),
+                            key=lambda kv: str(kv[0])):
+            lines.append(f"critical path [query {q}]: "
+                         f"{cp['critical_path_s']:.3f}s of "
+                         f"{cp['wall_s']:.3f}s wall "
+                         f"({cp['coverage'] * 100:.0f}%)")
+            for p in cp["path"]:
+                lines.append(f"    stage {p['stage']}: {p['name']} on "
+                             f"{p['executor']} {p['duration_s']:.3f}s "
+                             f"({p['tasks']} tasks)")
+        if rep["tasks"]:
+            lines.append("per-task overlap (fetch/compute/decompress/"
+                         "idle, seconds):")
+            for t in sorted(rep["tasks"],
+                            key=lambda t: (str(t["stage"]), t["executor"])):
+                lines.append(
+                    f"    {t['executor']} {t['name']} "
+                    f"[stage {t['stage']}]: {t['duration_s']:.3f}s = "
+                    f"fetch {t['fetch_s']:.3f} / compute "
+                    f"{t['compute_s']:.3f} / decompress "
+                    f"{t['decompress_s']:.3f} / idle {t['idle_s']:.3f} "
+                    f"(overlap {t['overlap_efficiency'] * 100:.0f}%)")
+        if rep["stragglers"]:
+            lines.append(f"stragglers (> {straggler_factor:g}x stage "
+                         "median):")
+            for s in rep["stragglers"]:
+                lines.append(
+                    f"    {s['executor']} {s['name']} [stage "
+                    f"{s['stage']}]: {s['duration_s']:.3f}s = "
+                    f"{s['factor']:.1f}x median {s['median_s']:.3f}s")
+        else:
+            lines.append("stragglers: none")
+        return "\n".join(lines)
+
+
+def merge_shards(shards: List[dict],
+                 probes: Optional[Dict[str, list]] = None) -> Timeline:
+    """Build a Timeline from drained shard dicts (`{"label"/"executor",
+    "anchor", "events", "dropped"}` — the rpc_drain_journal response
+    shape, also what `load_journal_dir` reconstructs from files).
+    `probes[executor]` is a list of `(local_before_ns, remote_wall_ns,
+    local_after_ns)` clock samples (the heartbeat round trips); without
+    probes, anchors alone align the shards (assumes NTP-close hosts)."""
+    tl = Timeline()
+    for shard in shards:
+        executor = shard.get("label") or shard.get("executor") or "?"
+        offset = 0
+        if probes and probes.get(executor):
+            offset, _rtt = estimate_clock_offset(probes[executor])
+        tl.add_shard(executor, shard.get("events") or [],
+                     anchor=shard.get("anchor"),
+                     offset_ns=offset,
+                     dropped=int(shard.get("dropped") or 0),
+                     base=shard.get("base"))
+    return tl
+
+
+def load_journal_dir(path: str) -> List[dict]:
+    """Reconstruct shard dicts from a journal directory: every
+    shard-<executor>.jsonl worker shard plus the driver's
+    query-<id>.jsonl journals (offline --timeline input)."""
+    out = []
+    for f in sorted(glob.glob(os.path.join(path, "shard-*.jsonl"))):
+        label = os.path.basename(f)[len("shard-"):-len(".jsonl")]
+        out.append({"label": label, "events": read_journal(f)})
+    for f in sorted(glob.glob(os.path.join(path, "query-*.jsonl"))):
+        # one lane per driver query journal: span ids restart per file,
+        # so sharing one label would alias them in the merged index —
+        # but serve records name the plain 'driver' executor, so that is
+        # the base the link resolution matches on
+        label = "driver/" + os.path.basename(f)[:-len(".jsonl")]
+        out.append({"label": label, "base": "driver",
+                    "events": read_journal(f)})
+    return out

@@ -515,42 +515,71 @@ class ShuffleEnv:
         diagnosis and, for transit corruption, refetches up to
         `maxRefetchAttempts`; writer-side rot, a vanished buffer, or a
         dead/exhausted peer raises a typed FetchFailed that marks the map
-        output lost so the cluster recomputes the fragment."""
-        from ..metrics.journal import journal_event
-        try:
-            tcomp = getattr(self.transport, "compression", None)
-            client = self.transport.make_client(peer)
-            resp = client.fetch_metadata(MetadataRequest(
-                shuffle_id=shuffle_id, reduce_id=reduce_id,
-                map_lo=map_range[0] if map_range else None,
-                map_hi=map_range[1] if map_range else None,
-                codec=tcomp.codec_name
-                if tcomp is not None and tcomp.enabled else None))
-        except (ConnectionError, OSError, KeyError) as e:
-            raise self._map_output_lost(peer, shuffle_id, reduce_id,
-                                        "peer", e)
+        output lost so the cluster recomputes the fragment.
+
+        Tracing: the whole remote read runs inside a `fetch` SPAN named
+        fetchRemote, and that span's id becomes the `span` field of the
+        trace context stamped on every wire request it issues — so the
+        peer's serve record names THIS fetch span exactly and the merged
+        timeline can flow-link the two (metrics/timeline.py)."""
+        from ..metrics.journal import (active_journal, current_trace,
+                                       trace_context)
+        journal = active_journal()
+        span_id = None
+        if journal is not None:
+            base = current_trace() or (None, None, None, None)
+            span_id = journal.begin(
+                "fetch", "fetchRemote", peer=peer, shuffle=shuffle_id,
+                reduce=reduce_id, executor=self.executor_id,
+                query=base[0], stage=base[1],
+                map_range=list(map_range) if map_range else None)
         fetched_bytes = 0
         n_buffers = 0
-        for bm in resp.block_metas:
-            for bid in bm.buffer_ids:
-                leaves, meta = self._fetch_buffer_verified(
-                    client, peer, shuffle_id, reduce_id, bid)
-                try:
-                    client.release_buffer(bid)
-                except (ConnectionError, OSError) as e:
-                    # the data already arrived verified; a failed release
-                    # only delays the peer's cache eviction
-                    log.info("release of buffer %d at %s failed: %r",
-                             bid, peer, e)
-                batch = host_to_batch(leaves, meta)
-                fetched_bytes += meta.size_bytes
-                n_buffers += 1
-                rid = self.runtime.add_batch(batch)
-                self.received.add(shuffle_id, rid)
-                yield self.runtime.get_batch(rid)
-        journal_event("fetch", "fetchRemote", peer=peer,
-                      shuffle=shuffle_id, reduce=reduce_id,
-                      buffers=n_buffers, bytes=fetched_bytes)
+
+        def on_wire(fn):
+            # trace installed ONLY around non-yielding wire calls: a
+            # with-block spanning a generator's yields would leak the
+            # context into whatever the consumer runs between pulls
+            with trace_context(span=span_id, executor=self.executor_id):
+                return fn()
+
+        try:
+            try:
+                tcomp = getattr(self.transport, "compression", None)
+                client = self.transport.make_client(peer)
+                resp = on_wire(lambda: client.fetch_metadata(
+                    MetadataRequest(
+                        shuffle_id=shuffle_id, reduce_id=reduce_id,
+                        map_lo=map_range[0] if map_range else None,
+                        map_hi=map_range[1] if map_range else None,
+                        codec=tcomp.codec_name
+                        if tcomp is not None and tcomp.enabled
+                        else None)))
+            except (ConnectionError, OSError, KeyError) as e:
+                raise self._map_output_lost(peer, shuffle_id,
+                                            reduce_id, "peer", e)
+            for bm in resp.block_metas:
+                for bid in bm.buffer_ids:
+                    leaves, meta = on_wire(
+                        lambda b=bid: self._fetch_buffer_verified(
+                            client, peer, shuffle_id, reduce_id, b))
+                    try:
+                        on_wire(lambda b=bid: client.release_buffer(b))
+                    except (ConnectionError, OSError) as e:
+                        # the data already arrived verified; a failed
+                        # release only delays the peer's cache eviction
+                        log.info("release of buffer %d at %s failed: %r",
+                                 bid, peer, e)
+                    batch = host_to_batch(leaves, meta)
+                    fetched_bytes += meta.size_bytes
+                    n_buffers += 1
+                    rid = self.runtime.add_batch(batch)
+                    self.received.add(shuffle_id, rid)
+                    yield self.runtime.get_batch(rid)
+        finally:
+            if journal is not None:
+                journal.end(span_id, buffers=n_buffers,
+                            bytes=fetched_bytes)
 
     def _fetch_buffer_verified(self, client, peer: str, shuffle_id: int,
                                reduce_id: int, bid: int):

@@ -116,20 +116,29 @@ def recv_frame_into(sock: socket.socket, dest: np.ndarray, offset: int
     return op, length, None
 
 
-def _unpack_fetch(payload: bytes) -> Tuple[int, Optional[str]]:
-    """OP_LAYOUT/OP_FETCH payload: a bare big-endian u64 buffer id (the
-    raw wire format, and what pre-compression peers send) or a pickled
-    (buffer_id, codec_name) pair asking for framed compressed leaves."""
+def _unpack_fetch(payload: bytes
+                  ) -> Tuple[int, Optional[str], Optional[tuple]]:
+    """OP_LAYOUT/OP_FETCH/OP_DIAG payload: a bare big-endian u64 buffer
+    id (the raw wire format, and what pre-compression peers send), a
+    pickled (buffer_id, codec_name) pair (pre-trace peers), or a pickled
+    (buffer_id, codec_name, trace) triple carrying the requesting task's
+    distributed-trace context — parsed back-compat like PR 5's codec
+    field."""
     if len(payload) == 8:
-        return struct.unpack(">Q", payload)[0], None
-    bid, codec = pickle.loads(payload)
-    return int(bid), codec
+        return struct.unpack(">Q", payload)[0], None, None
+    rec = pickle.loads(payload)
+    bid, codec = rec[0], rec[1]
+    trace = rec[2] if len(rec) > 2 else None
+    return int(bid), codec, trace
 
 
-def _pack_fetch(buffer_id: int, codec: Optional[str]) -> bytes:
-    if codec in (None, "none"):
+def _pack_fetch(buffer_id: int, codec: Optional[str],
+                trace: Optional[tuple] = None) -> bytes:
+    if codec in (None, "none") and trace is None:
         return struct.pack(">Q", buffer_id)
-    return pickle.dumps((buffer_id, codec))
+    if trace is None:
+        return pickle.dumps((buffer_id, codec))
+    return pickle.dumps((buffer_id, codec, tuple(trace)))
 
 
 def _raise_gone(payload: bytes, buffer_id: int) -> None:
@@ -224,10 +233,18 @@ class ShuffleSocketServer:
                 if op == OP_META:
                     req: MetadataRequest = pickle.loads(payload)
                     resp = self.server_obj.handle_metadata_request(req)
+                    # advertise trace capability: the client stamps trace
+                    # context on later per-buffer ops only after seeing
+                    # this (back-compat with pre-trace peers both ways)
+                    resp.traced = True
                     self.transport.count("metadata_served")
+                    self._journal_serve("serveMetadata",
+                                        getattr(req, "trace", None),
+                                        shuffle=req.shuffle_id,
+                                        reduce=req.reduce_id)
                     send_frame(conn, OP_META_RESP, pickle.dumps(resp))
                 elif op == OP_LAYOUT:
-                    bid, codec = _unpack_fetch(payload)
+                    bid, codec, _trace = _unpack_fetch(payload)
                     try:
                         layout, meta = self.server_obj.buffer_layout(bid)
                         sums = self._checksums_of(bid)
@@ -238,15 +255,18 @@ class ShuffleSocketServer:
                     send_frame(conn, OP_LAYOUT_RESP,
                                pickle.dumps((layout, meta, sums, comp)))
                 elif op == OP_FETCH:
-                    bid, codec = _unpack_fetch(payload)
-                    self._stream_buffer(conn, bid, codec)
+                    bid, codec, trace = _unpack_fetch(payload)
+                    self._stream_buffer(conn, bid, codec, trace)
                 elif op == OP_FETCH_SHM:
                     rec = pickle.loads(payload)
                     bid, shm_name = rec[0], rec[1]
                     codec = rec[2] if len(rec) > 2 else None
-                    self._fill_shm(conn, bid, shm_name, codec)
+                    trace = rec[3] if len(rec) > 3 else None
+                    self._fill_shm(conn, bid, shm_name, codec, trace)
                 elif op == OP_DIAG:
-                    (bid,) = struct.unpack(">Q", payload)
+                    bid, _codec, trace = _unpack_fetch(payload)
+                    self._journal_serve("serveDiagnosis", trace,
+                                        buffer=bid)
                     self._handle_diag(conn, bid)
                 elif op == OP_DONE:
                     (bid,) = struct.unpack(">Q", payload)
@@ -268,6 +288,19 @@ class ShuffleSocketServer:
                 conn.close()
             except OSError as e:
                 log.debug("closing connection from %s: %r", peer, e)
+
+    def _server_executor(self) -> str:
+        env = getattr(self.server_obj, "env", None)
+        return getattr(env, "executor_id", "?")
+
+    def _journal_serve(self, name: str, trace, **attrs) -> None:
+        """Instant serve record carrying the REQUESTER's wire trace
+        context (o_q/o_st/o_sp/o_ex) — the mapper-side half of the
+        fetch<->serve flow link (metrics/timeline.py)."""
+        from ..metrics.journal import journal_event, trace_attrs
+        journal_event("serve", name, executor=self._server_executor(),
+                      **{k: v for k, v in attrs.items() if v is not None},
+                      **trace_attrs(trace))
 
     def _checksums_of(self, bid: int):
         """The server's recorded (algorithm, per-leaf digests) for a
@@ -316,7 +349,8 @@ class ShuffleSocketServer:
         send_frame(conn, OP_DIAG_RESP, pickle.dumps(result))
 
     def _stream_buffer(self, conn: socket.socket, bid: int,
-                       codec: Optional[str] = None) -> None:
+                       codec: Optional[str] = None,
+                       trace: Optional[tuple] = None) -> None:
         """Send every leaf of a buffer as bounce-buffer-sized DATA frames,
         in leaf order, then END (BufferSendState: acquire buffer from any
         tier -> stage through send bounce buffers -> tagged sends).  With
@@ -329,12 +363,19 @@ class ShuffleSocketServer:
         was removed while we were serving it) becomes a typed OP_GONE
         frame — the client sees a clean `BufferGone` instead of a
         half-frame crash or a hang."""
+        from ..metrics.journal import journal_span, trace_attrs
         try:
             layout, _meta = self.server_obj.buffer_layout(bid)
             comp = self._compressed_of(bid, codec)
         except (KeyError, CorruptBuffer) as e:
             self._send_gone(conn, bid, e)
             return
+        with journal_span("serve", "serveBuffer",
+                          executor=self._server_executor(), buffer=bid,
+                          **trace_attrs(trace)):
+            self._stream_buffer_body(conn, bid, layout, comp)
+
+    def _stream_buffer_body(self, conn, bid, layout, comp) -> None:
         if comp is not None:
             wire_sizes = comp["sizes"]
 
@@ -379,7 +420,8 @@ class ShuffleSocketServer:
         send_frame(conn, OP_END, b"")
 
     def _fill_shm(self, conn: socket.socket, bid: int,
-                  shm_path: str, codec: Optional[str] = None) -> None:
+                  shm_path: str, codec: Optional[str] = None,
+                  trace: Optional[tuple] = None) -> None:
         """Same-host fast path: copy each leaf ONCE into the client-owned
         /dev/shm segment instead of chunking through bounce buffers and
         the socket (the local-peer analogue of the reference's UCX
@@ -388,6 +430,8 @@ class ShuffleSocketServer:
         resource tracker logs a KeyError per cross-process segment on
         this python version."""
         import mmap
+
+        from ..metrics.journal import journal_span, trace_attrs
         if not shm_path.startswith(SHM_PREFIX):
             send_frame(conn, OP_RPC_ERR,
                        pickle.dumps(f"bad shm path {shm_path!r}"))
@@ -421,23 +465,27 @@ class ShuffleSocketServer:
                     self.server_obj.copy_leaf_chunk(bid, leaf_idx, 0,
                                                     nbytes, view)
             off = 0
-            for leaf_idx, nbytes in enumerate(wire_sizes):
-                view = np.frombuffer(mm, np.uint8, count=nbytes,
-                                     offset=off)
-                try:
+            with journal_span("serve", "serveBuffer",
+                              executor=self._server_executor(),
+                              buffer=bid, path="shm",
+                              **trace_attrs(trace)):
+                for leaf_idx, nbytes in enumerate(wire_sizes):
+                    view = np.frombuffer(mm, np.uint8, count=nbytes,
+                                         offset=off)
                     try:
-                        copy_leaf(leaf_idx, nbytes, view)
-                    except (KeyError, CorruptBuffer) as e:
-                        self._send_gone(conn, bid, e)
-                        return
-                    # corruption injection point for the shared-memory
-                    # leaf fill (the same-host zero-copy "wire")
-                    faults.INJECTOR.on_corruptible("shm", view)
-                finally:
-                    # the view exports the mmap; it must die before
-                    # mm.close() (BufferError otherwise)
-                    del view
-                off += nbytes
+                        try:
+                            copy_leaf(leaf_idx, nbytes, view)
+                        except (KeyError, CorruptBuffer) as e:
+                            self._send_gone(conn, bid, e)
+                            return
+                        # corruption injection point for the shared-memory
+                        # leaf fill (the same-host zero-copy "wire")
+                        faults.INJECTOR.on_corruptible("shm", view)
+                    finally:
+                        # the view exports the mmap; it must die before
+                        # mm.close() (BufferError otherwise)
+                        del view
+                    off += nbytes
             self.transport.count("bytes_sent", off)
             if comp is not None:
                 self.transport.count("compressed_bytes_sent", off)
@@ -504,19 +552,37 @@ class SocketClient(ShuffleTransportClient):
     """
 
     def __init__(self, transport: "SocketTransport",
-                 addr: Tuple[str, int]):
+                 addr: Tuple[str, int], inject_faults: bool = True,
+                 connect_timeout: Optional[float] = None):
         self.transport = transport
         self.addr = tuple(addr)
+        # inject_faults=False exempts this client from the deterministic
+        # net-fault injector: background pollers (the heartbeat monitor)
+        # must not consume test-armed ordinals out from under the
+        # data-plane ops the test aimed them at
+        self.inject_faults = inject_faults
+        # per-client connect bound override: liveness pollers cannot
+        # afford the transport's data-plane default (30s) — one
+        # blackholed worker would starve every other worker's heartbeat
+        self.connect_timeout = connect_timeout
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # trace capability of the peer, learned from the metadata
+        # handshake (MetadataResponse.traced): until a trace-aware server
+        # confirms, per-buffer ops ride the pre-trace wire shapes — a
+        # pre-trace peer cannot parse the pickled trace triple
+        self._peer_traced = False
         # deterministic jitter: seeded per peer address, not wall clock
         self._rng = random.Random(f"shuffle-retry:{self.addr}")
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
             t = self.transport
-            s = socket.create_connection(self.addr,
-                                         timeout=t.connect_timeout)
+            s = socket.create_connection(
+                self.addr,
+                timeout=(self.connect_timeout
+                         if self.connect_timeout is not None
+                         else t.connect_timeout))
             # the connect bound above is per-attempt; steady-state requests
             # run under the (configurable) I/O deadline so a peer that dies
             # mid-request raises instead of blocking forever
@@ -558,7 +624,8 @@ class SocketClient(ShuffleTransportClient):
                            f"{label} to {self.addr} exceeded deadline"))
             try:
                 with self._lock:
-                    faults.INJECTOR.on_net_op(label)
+                    if self.inject_faults:
+                        faults.INJECTOR.on_net_op(label)
                     return body(self._conn())
             except TransactionCancelled:
                 with self._lock:
@@ -599,12 +666,27 @@ class SocketClient(ShuffleTransportClient):
         return resp
 
     def fetch_metadata(self, request: MetadataRequest) -> MetadataResponse:
+        if getattr(request, "trace", None) is None \
+                and getattr(self.transport, "trace_enabled", True):
+            from ..metrics.journal import current_trace
+            request.trace = current_trace()
         blob = pickle.dumps(request)
         resp = self._retrying(
             "metadata", lambda _s: self._request(OP_META, blob,
                                                  OP_META_RESP))
         self.transport.count("metadata_fetched")
-        return pickle.loads(resp)
+        meta = pickle.loads(resp)
+        self._peer_traced = bool(getattr(meta, "traced", False))
+        return meta
+
+    def _wire_trace(self):
+        """Trace context to stamp on per-buffer ops: only once the peer
+        advertised trace support through the metadata handshake."""
+        if not self._peer_traced \
+                or not getattr(self.transport, "trace_enabled", True):
+            return None
+        from ..metrics.journal import current_trace
+        return current_trace()
 
     def _fetch_buffer_shm(self, layout, meta, buffer_id: int, total: int,
                           sums=None, comp=None, comp_sums=None):
@@ -629,15 +711,20 @@ class SocketClient(ShuffleTransportClient):
         try:
             os.ftruncate(fd, max(total, 1))
             mm = mmap.mmap(fd, max(total, 1))
+            trace = self._wire_trace()
             try:
                 with self._lock:
                     faults.INJECTOR.on_net_op("fetch_shm")
                     sock = self._conn()
                     send_frame(sock, OP_FETCH_SHM,
                                pickle.dumps(
-                                   (buffer_id, path, comp["codec"])
-                                   if comp is not None
-                                   else (buffer_id, path)))
+                                   (buffer_id, path,
+                                    comp["codec"] if comp is not None
+                                    else None, trace)
+                                   if trace is not None
+                                   else ((buffer_id, path, comp["codec"])
+                                         if comp is not None
+                                         else (buffer_id, path))))
                     op, resp = recv_frame(sock)
             except (TimeoutError, ConnectionError, OSError) as e:
                 # single attempt: the caller streams over the socket
@@ -716,11 +803,16 @@ class SocketClient(ShuffleTransportClient):
         cpol = getattr(self.transport, "compression", None)
         req_codec = (cpol.codec_name
                      if cpol is not None and cpol.enabled else None)
+        # trace context of the requesting task: rides the layout + fetch
+        # payloads (once the metadata handshake confirmed the peer parses
+        # them) so the peer's serve span links back to our fetch span
+        trace = self._wire_trace()
         try:
             resp = self._retrying(
                 "layout",
                 lambda _s: self._request(OP_LAYOUT,
-                                         _pack_fetch(buffer_id, req_codec),
+                                         _pack_fetch(buffer_id, req_codec,
+                                                     trace),
                                          OP_LAYOUT_RESP, buffer_id),
                 deadline=deadline, txn=txn)
             unpacked = pickle.loads(resp)
@@ -770,7 +862,8 @@ class SocketClient(ShuffleTransportClient):
                     send_frame(sock, OP_FETCH,
                                _pack_fetch(buffer_id,
                                            comp["codec"]
-                                           if comp is not None else None))
+                                           if comp is not None else None,
+                                           trace))
                     # chunk hashing (and, with a codec, per-leaf verify +
                     # decompress) rides a side thread, overlapped with
                     # the recv loop — verification still completes BEFORE
@@ -877,26 +970,32 @@ class SocketClient(ShuffleTransportClient):
         try:
             resp = self._retrying(
                 "diag", lambda _s: self._request(
-                    OP_DIAG, struct.pack(">Q", buffer_id), OP_DIAG_RESP,
-                    buffer_id))
+                    OP_DIAG,
+                    _pack_fetch(buffer_id, None, self._wire_trace()),
+                    OP_DIAG_RESP, buffer_id))
             return pickle.loads(resp)
         except (ConnectionError, OSError, RuntimeError) as e:
             log.warning("corruption diagnosis of buffer %d at %s "
                         "unavailable: %r", buffer_id, self.addr, e)
             return None
 
-    def rpc(self, method: str, **kwargs):
+    def rpc(self, method: str, _rpc_timeout: Optional[float] = None,
+            **kwargs):
         """Control-plane call (worker management; UCX mgmt-port analogue).
 
         Deliberately NOT retried (run_map/run_reduce are not idempotent)
         and exempt from the data-plane I/O deadline: the first dispatch of
         a plan fragment blocks on the PEER's query compilation, which can
-        legitimately exceed any fixed bound."""
+        legitimately exceed any fixed bound.  `_rpc_timeout` opts back
+        INTO a deadline for calls that must never hang — the heartbeat
+        monitor's liveness polls ride a dedicated client with one."""
         with self._lock:
-            faults.INJECTOR.on_net_op("rpc")
+            if self.inject_faults:
+                faults.INJECTOR.on_net_op("rpc")
             try:
                 sock = self._conn()
-                sock.settimeout(None)  # compile-friendly: no I/O deadline
+                # compile-friendly: no I/O deadline unless opted in
+                sock.settimeout(_rpc_timeout)
                 try:
                     send_frame(sock, OP_RPC, pickle.dumps((method, kwargs)))
                     op, resp = recv_frame(sock)
@@ -984,6 +1083,10 @@ class SocketTransport(ShuffleTransport):
         # from peers; default none, configure() adopts
         # spark.rapids.shuffle.compression.codec
         self.compression = CompressionPolicy()
+        # distributed-trace wire stamping (spark.rapids.sql.tpu.trace.
+        # enabled): clients attach the current trace context to fetch
+        # requests; configure() adopts the conf
+        self.trace_enabled = True
 
     def configure(self, conf) -> None:
         """Adopt retry/deadline knobs from a TpuConf (and arm the fault
@@ -1001,6 +1104,7 @@ class SocketTransport(ShuffleTransport):
         self.integrity = policy_from_conf(conf)
         self.compression = compression_from_conf(
             conf, metrics=self.compression.metrics)
+        self.trace_enabled = bool(conf.get(C.TRACE_ENABLED))
 
     def next_txn(self) -> Transaction:
         with self._lock:

@@ -91,6 +91,12 @@ class MetadataRequest:
     # serve per block (BlockMeta.codec) and confirms per fetch in the
     # layout response.  None/"none" = raw.
     codec: Optional[str] = None
+    # distributed-trace context of the requesting task, (query, stage,
+    # span, executor) — the serving side journals it on its serve record
+    # so the merged timeline links this reader's fetch span to the
+    # mapper's serve span (metrics/timeline.py).  Back-compat: a peer
+    # running pre-trace code simply never reads it (dataclass default).
+    trace: Optional[tuple] = None
 
 
 @dataclass
@@ -121,6 +127,14 @@ class BlockMeta:
 @dataclass
 class MetadataResponse:
     block_metas: List[BlockMeta]
+    # trace capability advertisement: servers running trace-aware code set
+    # this True, and ONLY then does the client stamp its trace context on
+    # the per-buffer wire ops (layout/fetch/shm/diag) — a pre-trace peer
+    # would crash unpacking the pickled triple, so like PR 5's codec the
+    # capability is negotiated through the metadata handshake (pre-trace
+    # servers leave the dataclass default False; pre-trace clients simply
+    # never read it).
+    traced: bool = False
 
 
 @dataclass
@@ -278,7 +292,12 @@ def decode_compressed_leaves(frames, layout, codec, comp_sums, sums,
     so a corrupt frame never reaches a decompressor.  Byte/mismatch
     counters stay at the call sites: the socket client counts mismatches
     in its outer fetch handler, the loopback client locally."""
+    import time as _time
+
+    from ..metrics.journal import journal_event
     out: List[np.ndarray] = []
+    t0 = _time.perf_counter()
+    nbytes = 0
     for leaf_idx, (shape, dtype_str, _raw_nbytes) in enumerate(layout):
         frame = frames[leaf_idx]
         if comp_sums is not None:
@@ -289,7 +308,12 @@ def decode_compressed_leaves(frames, layout, codec, comp_sums, sums,
             sums[leaf_idx] if sums is not None else None,
             buffer_id, leaf_idx, path,
             frame_verified=comp_sums is not None)
+        nbytes += int(flat.nbytes)
         out.append(flat.view(np.dtype(dtype_str)).reshape(shape))
+    # decode-side codec time for the timeline's per-task overlap
+    # breakdown (metrics/timeline.py task_breakdown: decompress_s)
+    journal_event("compress", "decompress", buffer=buffer_id, path=path,
+                  bytes=nbytes, seconds=_time.perf_counter() - t0)
     return out
 
 
@@ -608,6 +632,10 @@ class LoopbackClient(ShuffleTransportClient):
         self.transport = transport
         self.server = server
 
+    def _server_executor(self) -> str:
+        env = getattr(self.server, "env", None)
+        return getattr(env, "executor_id", "?")
+
     def fetch_metadata(self, request: MetadataRequest) -> MetadataResponse:
         txn = self.transport.next_txn()
         try:
@@ -712,6 +740,7 @@ class LoopbackClient(ShuffleTransportClient):
                 from ..metrics import names as MN
                 cpol.metrics.add(MN.COMPRESSED_SHUFFLE_BYTES_READ, total)
             txn.status = TransactionStatus.SUCCESS
+            self._journal_serve(buffer_id, total)
             return out, meta
         except Exception as e:  # noqa: BLE001
             txn.fail(str(e))
@@ -802,9 +831,21 @@ class LoopbackClient(ShuffleTransportClient):
                         raise
                 out.append(dest.view(np.dtype(dtype_str)).reshape(shape))
             txn.status = TransactionStatus.SUCCESS
+            self._journal_serve(buffer_id, total)
             return out, leaves_meta[1]
         except Exception as e:  # noqa: BLE001
             txn.fail(str(e))
             raise
         finally:
             self.transport.throttle.release(total)
+
+    def _journal_serve(self, buffer_id: int, nbytes: int) -> None:
+        """Serve record for an in-process fetch: reader and server share
+        one thread, so the reader's CURRENT trace context is exactly what
+        a socket peer would have carried on the wire — journaled with the
+        same o_* attrs so the merged timeline links it identically."""
+        from ..metrics.journal import (current_trace, journal_event,
+                                      trace_attrs)
+        journal_event("serve", "serveBuffer",
+                      executor=self._server_executor(), buffer=buffer_id,
+                      bytes=nbytes, **trace_attrs(current_trace()))

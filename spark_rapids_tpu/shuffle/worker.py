@@ -23,11 +23,13 @@ Spark's task dispatch + the UCX management-port handshake.
 """
 from __future__ import annotations
 
+import contextlib
 import copy
 import json
 import os
 import sys
 import threading
+import time
 from typing import Dict, List, Optional
 
 from ..plan import logical as L
@@ -85,6 +87,34 @@ class WorkerHandler:
         self.runtime._shuffle_env = self.env
         self.peers: List[str] = []
         self.shutdown_event = threading.Event()
+        # distributed tracing: one process-lifetime journal shard (task/
+        # fetch/serve spans + a wall-clock anchor) the driver drains over
+        # rpc_drain_journal; file-backed under the journal dir when one is
+        # configured so offline --timeline analysis works too
+        from ..config import (METRICS_JOURNAL_DIR, TRACE_ENABLED,
+                              TRACE_SHARD_MAX_EVENTS)
+        from ..metrics import journal as J
+        self.shard = None
+        if bool(self.session.conf.get(TRACE_ENABLED)):
+            jdir = str(self.session.conf.get(METRICS_JOURNAL_DIR) or "")
+            path = (os.path.join(jdir, f"shard-{executor_id}.jsonl")
+                    if jdir else None)
+            self.shard = J.open_shard(
+                executor_id, path,
+                max_events=int(self.session.conf.get(
+                    TRACE_SHARD_MAX_EVENTS)))
+        # slowdown injection scope: 'exec-1/reduce:500' delay specs match
+        # only the worker whose executor id equals the scope
+        from ..utils import faults
+        faults.INJECTOR.set_scope(executor_id)
+        # live-progress bookkeeping the heartbeat reports
+        self._hb_lock = threading.Lock()
+        self._hb_seq = 0
+        self._active_tasks: Dict[int, dict] = {}
+        self._task_counter = 0
+        self.tasks_completed = 0
+        self.tasks_failed = 0
+        self.rows_written = 0
 
     # ---- rpc methods -------------------------------------------------------
 
@@ -113,11 +143,57 @@ class WorkerHandler:
         replacement-republish path)."""
         return {k: list(v) for k, v in self.transport._peers.items()}
 
+    @contextlib.contextmanager
+    def _task(self, name: str, trace: Optional[Dict], sid: int):
+        """Task scope: a `task` span in the trace shard, the DRIVER's
+        trace context installed on this thread (so every wire request the
+        task issues carries it), the task registered for heartbeat
+        active-task snapshots, and the straggler-test delay hook."""
+        from ..metrics import journal as J
+        from ..utils import faults
+        query = (trace or {}).get("query")
+        stage = (trace or {}).get("stage") or f"s{sid}.{name}"
+        span = None
+        if self.shard is not None:
+            span = self.shard.begin("task", name, query=query,
+                                    stage=stage, shuffle=sid,
+                                    executor=self.executor_id)
+        with self._hb_lock:
+            self._task_counter += 1
+            tid = self._task_counter
+            self._active_tasks[tid] = {
+                "name": name, "stage": stage, "query": query,
+                "span": span, "start_mono": time.monotonic()}
+        ok = False
+        try:
+            with J.trace_context(query=query, stage=stage, span=span,
+                                 executor=self.executor_id):
+                faults.INJECTOR.on_delay(name)
+                yield
+            ok = True
+        finally:
+            with self._hb_lock:
+                self._active_tasks.pop(tid, None)
+                # a raised task is NOT completed work — a fail/retry loop
+                # must not look like advancing progress to the driver
+                if ok:
+                    self.tasks_completed += 1
+                else:
+                    self.tasks_failed += 1
+            if self.shard is not None:
+                self.shard.end(span, ok=ok)
+
     def rpc_run_map(self, sid: int, plan_blob: bytes,
-                    key_names: List[str], n_parts: int):
+                    key_names: List[str], n_parts: int,
+                    trace: Optional[Dict] = None):
         """Execute the fragment, hash-partition on the keys, write all
         partitions to the local catalog.  Returns per-partition row
         counts (the MapStatus analogue)."""
+        with self._task("map", trace, sid):
+            return self._run_map(sid, plan_blob, key_names, n_parts)
+
+    def _run_map(self, sid: int, plan_blob: bytes,
+                 key_names: List[str], n_parts: int):
         import pickle
 
         from ..columnar import ColumnarBatch
@@ -162,12 +238,19 @@ class WorkerHandler:
                     self.runtime.semaphore.task_done()
         finally:
             ctx.run_cleanups()
+        with self._hb_lock:
+            self.rows_written += sum(written.values())
         return {"written_rows": written}
 
     def rpc_run_reduce(self, sid: int, partitions: List[int],
-                       plan_blob: bytes):
+                       plan_blob: bytes, trace: Optional[Dict] = None):
         """Fetch owned partitions (local + every peer over the wire), run
         the reduce fragment per partition, return arrow IPC bytes."""
+        with self._task("reduce", trace, sid):
+            return self._run_reduce(sid, partitions, plan_blob)
+
+    def _run_reduce(self, sid: int, partitions: List[int],
+                    plan_blob: bytes):
         import pickle
 
         import pyarrow as pa
@@ -217,6 +300,55 @@ class WorkerHandler:
         """Runtime pool/retry/spill figures for cluster-wide observability
         (metrics/export.cluster_snapshot pulls this from every worker)."""
         return dict(self.runtime.pool_stats())
+
+    def rpc_heartbeat(self):
+        """Live progress snapshot for the driver's heartbeat monitor
+        (cluster.HeartbeatMonitor, polled over a DEDICATED connection so
+        a long-running task rpc never blocks it): monotonic counters,
+        pool stats, and the active-task snapshot the hung-task watchdog
+        inspects.  Also a clock probe — wall_ns against the driver's
+        send/receive times estimates this worker's clock offset for the
+        merged timeline."""
+        with self._hb_lock:
+            self._hb_seq += 1
+            seq = self._hb_seq
+            now = time.monotonic()
+            active = [{"name": t["name"], "stage": t["stage"],
+                       "query": t["query"], "span": t["span"],
+                       "elapsed_s": now - t["start_mono"]}
+                      for t in self._active_tasks.values()]
+            completed = self.tasks_completed
+            failed = self.tasks_failed
+            rows = self.rows_written
+        try:
+            pool = dict(self.runtime.pool_stats())
+        except Exception:  # noqa: BLE001 — a heartbeat must never fail
+            pool = {}
+        if self.shard is not None:
+            self.shard.instant("heartbeat", "heartbeat", seq=seq,
+                               active=len(active))
+        return {"executor_id": self.executor_id, "seq": seq,
+                "pid": os.getpid(), "wall_ns": time.time_ns(),
+                "mono_ns": time.monotonic_ns(),
+                "tasks_completed": completed, "tasks_failed": failed,
+                "rows_written": rows, "active_tasks": active,
+                "counters": dict(self.transport.counters), "pool": pool}
+
+    def rpc_clock_probe(self):
+        """Bare wall/monotonic clock sample (NTP-style offset estimation
+        without the heartbeat payload)."""
+        return {"wall_ns": time.time_ns(), "mono_ns": time.monotonic_ns()}
+
+    def rpc_drain_journal(self):
+        """Incremental trace-shard drain: events journaled since the last
+        drain plus the shard's wall-clock anchor (metrics/timeline.py
+        merges every worker's drains into ONE query timeline).  None when
+        tracing is disabled."""
+        if self.shard is None:
+            return None
+        out = self.shard.drain()
+        out["executor_id"] = self.executor_id
+        return out
 
     def rpc_map_output_stats(self, sid: int):
         """This worker's observed map-output sizes for one shuffle

@@ -129,12 +129,38 @@ class _CorruptPlan:
 INJECTED_LOG_CAP = 4096
 
 
+class _DelayPlan:
+    """Parsed slowdown plan: comma items 'site:ms' or 'scope/site:ms'.
+    A scoped item applies only in the process whose injector scope (set
+    via `set_scope`, e.g. the worker's executor id) matches — so a
+    cluster-wide conf can slow exactly ONE worker's reduce tasks
+    ('exec-1/reduce:1500', the straggler-flagging test)."""
+
+    def __init__(self, spec: str = ""):
+        self.spec = spec
+        self.items: List[Tuple[Optional[str], str, float]] = []
+        for raw in (spec or "").split(","):
+            item = raw.strip()
+            if not item:
+                continue
+            scope = None
+            if "/" in item:
+                scope, item = item.split("/", 1)
+            site, ms = item.rsplit(":", 1)
+            self.items.append((scope, site, float(ms) / 1e3))
+
+    def seconds_for(self, site: str, scope: Optional[str]) -> float:
+        return sum(s for sc, st, s in self.items
+                   if st == site and (sc is None or sc == scope))
+
+
 class FaultInjector:
     """Process-global deterministic fault source (thread-safe)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._configured: Optional[Tuple[str, str, str, int]] = None
+        self._configured: Optional[Tuple[str, str, str, int, str]] = None
+        self.scope: Optional[str] = None
         self.reset()
 
     def reset(self) -> None:
@@ -142,6 +168,7 @@ class FaultInjector:
             self._oom = _Plan()
             self._net = _Plan()
             self._corrupt = _CorruptPlan()
+            self._delay = _DelayPlan()
             self._oom_count = 0
             self._net_count = 0
             self._corrupt_count = 0
@@ -149,6 +176,11 @@ class FaultInjector:
             self.site_counts: Dict[str, int] = {}
             self.injected_log: "deque" = deque(maxlen=INJECTED_LOG_CAP)
             self.injected_log_dropped = 0
+
+    def set_scope(self, scope: Optional[str]) -> None:
+        """Name this process for scoped delay specs (worker executor id).
+        Deliberately survives reset(): identity is not a fault plan."""
+        self.scope = scope
 
     def _log_injected(self, rec: Tuple[str, int, str]) -> None:
         # caller holds self._lock; the deque evicts the OLDEST entry at
@@ -159,19 +191,30 @@ class FaultInjector:
         self.injected_log.append(rec)
 
     def configure(self, oom_spec: str = "", net_spec: str = "",
-                  seed: int = 0, corrupt_spec: str = "") -> None:
+                  seed: int = 0, corrupt_spec: str = "",
+                  delay_spec: str = "") -> None:
         """(Re)arm the injector.  Counters reset only when the spec actually
         changes, so every runtime/transport bring-up in one query can call
         this without restarting the op count mid-flight."""
         key = (oom_spec or "", net_spec or "", corrupt_spec or "",
-               int(seed))
+               int(seed), delay_spec or "")
         with self._lock:
             if self._configured == key:
                 return
+            # parse every plan BEFORE committing anything: a malformed
+            # spec must raise with the injector fully in its previous
+            # state, not half-replaced with `_configured` already stamped
+            # (the next identical configure() would early-exit and leave
+            # it armed wrong forever)
+            oom = _Plan(key[0], seed=key[3])
+            net = _Plan(key[1], seed=key[3] + 1)
+            corrupt = _CorruptPlan(key[2], seed=key[3] + 2)
+            delay = _DelayPlan(key[4])
             self._configured = key
-            self._oom = _Plan(key[0], seed=key[3])
-            self._net = _Plan(key[1], seed=key[3] + 1)
-            self._corrupt = _CorruptPlan(key[2], seed=key[3] + 2)
+            self._oom = oom
+            self._net = net
+            self._corrupt = corrupt
+            self._delay = delay
             self._oom_count = 0
             self._net_count = 0
             self._corrupt_count = 0
@@ -184,7 +227,8 @@ class FaultInjector:
         self.configure(str(conf.get(C.TEST_INJECT_OOM) or ""),
                        str(conf.get(C.TEST_INJECT_NET) or ""),
                        int(conf.get(C.TEST_INJECT_SEED) or 0),
-                       str(conf.get(C.TEST_INJECT_CORRUPTION) or ""))
+                       str(conf.get(C.TEST_INJECT_CORRUPTION) or ""),
+                       str(conf.get(C.TEST_INJECT_DELAY) or ""))
 
     # ---- stats (test observability) ----------------------------------------
 
@@ -237,6 +281,22 @@ class FaultInjector:
             raise InjectedNetFault(
                 f"[fault-injection] forced net fault at op #{n} "
                 f"(site={site})")
+
+    def on_delay(self, site: str) -> float:
+        """Called at conf-declared slowdown points (worker task entry,
+        sites 'map'/'reduce').  Sleeps the summed matching delay and
+        returns the seconds slept (0.0 when nothing matched) — the
+        deterministic straggler for timeline/watchdog tests."""
+        with self._lock:
+            seconds = self._delay.seconds_for(site, self.scope)
+            if seconds > 0:
+                key = f"delay:{site}"
+                self.site_counts[key] = self.site_counts.get(key, 0) + 1
+                self._log_injected(("delay", int(seconds * 1e3), site))
+        if seconds > 0:
+            import time
+            time.sleep(seconds)
+        return seconds
 
     @property
     def corrupt_ops(self) -> int:

@@ -91,3 +91,65 @@ def write_chrome_trace(events, path: str) -> str:
         json.dump({"traceEvents": journal_to_trace_events(events),
                    "displayTimeUnit": "ms"}, f)
     return path
+
+
+def timeline_to_trace_events(timeline) -> list:
+    """Merged cluster timeline (metrics.timeline.Timeline) -> Chrome
+    trace events: ONE PID LANE PER WORKER (process_name = executor id),
+    a thread per span kind inside each lane, wall-clock-aligned
+    timestamps, and FLOW events (ph s/f) tying every reducer fetch span
+    to the mapper's serve record — so a multi-process shuffle reads as
+    one picture in Perfetto / chrome://tracing / the XLA trace viewer."""
+    executors = sorted(timeline.executors())
+    pid_of = {ex: i + 1 for i, ex in enumerate(executors)}
+    kinds = sorted({s.kind for s in timeline.spans}
+                   | {i["kind"] for i in timeline.instants})
+    tid_of = {k: i + 1 for i, k in enumerate(kinds)}
+    out = []
+    for ex, pid in pid_of.items():
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": ex}})
+        for k, tid in tid_of.items():
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid, "args": {"name": k}})
+    for sp in timeline.spans:
+        rec = {"name": sp.name, "cat": sp.kind, "ph": "X",
+               "pid": pid_of[sp.executor], "tid": tid_of[sp.kind],
+               "ts": sp.t0_ns / 1e3,
+               "dur": ((sp.t1_ns - sp.t0_ns) / 1e3
+                       if sp.t1_ns is not None else 0)}
+        if sp.attrs:
+            rec["args"] = dict(sp.attrs)
+        out.append(rec)
+    for i in timeline.instants:
+        rec = {"name": i["name"], "cat": i["kind"], "ph": "i", "s": "t",
+               "pid": pid_of[i["executor"]], "tid": tid_of[i["kind"]],
+               "ts": i["wall_ns"] / 1e3}
+        if i["attrs"]:
+            rec["args"] = dict(i["attrs"])
+        out.append(rec)
+    for idx, link in enumerate(timeline.links()):
+        fetch, serve = link["fetch"], link["serve"]
+        common = {"name": "shuffleFetch", "cat": "fetch-serve",
+                  "id": idx}
+        out.append({**common, "ph": "s",
+                    "pid": pid_of[fetch.executor],
+                    "tid": tid_of[fetch.kind], "ts": fetch.t0_ns / 1e3})
+        out.append({**common, "ph": "f", "bp": "e",
+                    "pid": pid_of[serve["executor"]]
+                    if serve["executor"] in pid_of
+                    else pid_of[fetch.executor],
+                    "tid": tid_of.get("serve", 1),
+                    "ts": serve["wall_ns"] / 1e3})
+    return out
+
+
+def write_cluster_chrome_trace(timeline, path: str) -> str:
+    """Write a merged cluster timeline as a multi-pid Chrome trace."""
+    import json
+    import os
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": timeline_to_trace_events(timeline),
+                   "displayTimeUnit": "ms"}, f)
+    return path

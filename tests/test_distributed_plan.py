@@ -20,6 +20,8 @@ from spark_rapids_tpu.plan.logical import col, functions as f, lit  # noqa: E402
 
 MESH_CONF = {"spark.rapids.sql.tpu.mesh.devices": "8"}
 
+from conftest import needs_pcast  # noqa: E402 — shared capability gate
+
 
 def _plan_str(session, df):
     node = session.plan(df.plan)
@@ -107,6 +109,7 @@ class TestDistributedExecution:
                     .group_by("k").agg(f.sum(col("v2")).alias("s")))
         assert_tpu_and_cpu_are_equal(q, conf=MESH_CONF)
 
+    @needs_pcast
     @pytest.mark.parametrize("how", ["inner", "left", "left_semi",
                                      "left_anti"])
     def test_join_types(self, how):
@@ -118,6 +121,7 @@ class TestDistributedExecution:
             q, conf={**MESH_CONF,
                      "spark.sql.autoBroadcastJoinThreshold": "-1"})
 
+    @needs_pcast
     def test_join_then_agg_distributed(self):
         def q(s):
             a = gen_df(s, seed=16, n=1000, k=T.IntegerType, v=T.LongType)
@@ -161,6 +165,7 @@ class TestDistributedExecution:
         tpu = run(dict(MESH_CONF))
         assert_rows_equal(cpu, tpu, ignore_order=True, approx_float=True)
 
+    @needs_pcast
     def test_tpch_q3_on_mesh(self):
         """Joins + aggregate + sort through the mesh planner."""
         from benchmarks.tpch import QUERIES, load_tables

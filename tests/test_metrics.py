@@ -225,8 +225,13 @@ def test_journal_dir_conf_writes_file(tmp_path):
     assert len(files) == 1
     events = read_journal(files[0])
     assert validate_events(events) == []
-    assert events[0]["kind"] == "query" and events[0]["ev"] == "B"
-    assert any(e["kind"] == "operator" for e in events)
+    # file journals open with a wall-clock anchor record so driver query
+    # spans align with worker trace shards offline (metrics/timeline.py)
+    assert events[0]["ev"] == "A"
+    assert events[0]["wall_ns"] > 0 and events[0]["mono_ns"] > 0
+    spans = [e for e in events if e["ev"] != "A"]
+    assert spans[0]["kind"] == "query" and spans[0]["ev"] == "B"
+    assert any(e["kind"] == "operator" for e in spans)
 
 
 # --------------------------------------------------------------------------

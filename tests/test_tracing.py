@@ -1,0 +1,677 @@
+"""Distributed tracing (ISSUE 7): cross-worker trace propagation, merged
+cluster timeline, critical-path/straggler analysis, live heartbeats.
+
+Fast tier: clock-offset estimation, interval math, wall-clock anchors,
+shard drain/eviction, torn-line-free concurrent journal writes, trace
+context semantics, wire trace propagation over a real socket pair in one
+process, chrome-trace flow events, local session.progress().
+
+Slow tier (-m slow): the 3-executor ProcCluster acceptance — merged
+timeline spans from every worker, fetch<->serve flow links, critical
+path + per-task overlap via --timeline, an injected slow worker flagged
+as a straggler, monotonic session.progress(), hung-task watchdog.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.metrics import journal as J
+from spark_rapids_tpu.metrics.journal import (EventJournal, current_trace,
+                                              journal_event, pop_active,
+                                              push_active, read_journal,
+                                              trace_attrs, trace_context,
+                                              validate_events)
+from spark_rapids_tpu.metrics.timeline import (Timeline, _intersect_len,
+                                               _interval_union,
+                                               estimate_clock_offset,
+                                               load_journal_dir,
+                                               merge_shards)
+
+pytestmark = pytest.mark.tracing
+
+
+# --------------------------------------------------------------------------
+# clock-offset estimation + interval math
+# --------------------------------------------------------------------------
+
+def test_estimate_clock_offset_min_rtt_wins():
+    # remote clock runs 500us ahead; the tight round trip nails it, the
+    # noisy one (asymmetric delay) would be off by 400us
+    tight = (1_000_000, 2_000_000 + 500_000, 3_000_000)
+    noisy = (10_000_000, 11_800_000 + 500_000, 13_000_000)
+    off, rtt = estimate_clock_offset([noisy, tight])
+    assert rtt == 2_000_000
+    assert off == 500_000
+    assert estimate_clock_offset([]) == (0, -1)
+
+
+def test_interval_union_and_intersection():
+    assert _interval_union([(0, 10), (5, 15), (20, 25)]) == 20
+    assert _interval_union([(3, 3), (5, 2)]) == 0
+    # regression: overlapping intervals on EITHER side must not
+    # double-count the intersection (overlap_efficiency > 100% bug)
+    xs = [(0, 10), (2, 8)]          # union = [0, 10)
+    ys = [(5, 15), (6, 12)]         # union = [5, 15)
+    assert _intersect_len(xs, ys) == 5
+    assert _intersect_len([(0, 4)], [(6, 9)]) == 0
+    assert _intersect_len([], [(0, 5)]) == 0
+
+
+# --------------------------------------------------------------------------
+# wall-clock anchor (satellite) + shard drain/eviction
+# --------------------------------------------------------------------------
+
+def test_anchor_record_written_at_open(tmp_path):
+    path = str(tmp_path / "shard-x.jsonl")
+    j = EventJournal(path, anchor=True, label="x")
+    sid = j.begin("task", "t1")
+    j.end(sid)
+    j.close()
+    events = read_journal(path)
+    assert events[0]["ev"] == "A"
+    assert events[0]["label"] == "x"
+    assert 0 < events[0]["mono_ns"]
+    # the anchor's wall/mono pair is self-consistent: wall is real epoch
+    # time (after 2020), mono is the monotonic clock
+    assert events[0]["wall_ns"] > 1_577_000_000 * 10**9
+    assert validate_events(events) == []
+
+
+def test_shard_drain_incremental_and_bounded(tmp_path):
+    j = EventJournal(None, anchor=True, label="w", mirror=True,
+                     max_lines=16, is_shard=True)
+    for i in range(8):
+        j.instant("heartbeat", "heartbeat", seq=i)
+    d1 = j.drain()
+    assert d1["anchor"]["ev"] == "A"
+    assert [e["seq"] for e in d1["events"]] == list(range(8))
+    assert d1["dropped"] == 0
+    # drain cleared the buffer; new events only on the next drain
+    assert j.drain()["events"] == []
+    for i in range(40):  # overflow the 16-line bound
+        j.instant("heartbeat", "heartbeat", seq=100 + i)
+    d2 = j.drain()
+    assert len(d2["events"]) == 16
+    assert d2["events"][-1]["seq"] == 139   # newest kept, oldest evicted
+    assert d2["dropped"] == 24
+    # the anchor still rides every drain (first-drain-after-restart case)
+    assert d2["anchor"]["ev"] == "A"
+    j.close()
+
+
+def test_concurrent_writers_no_torn_lines(tmp_path):
+    """Satellite: retry/spill/fetch hooks append from side threads —
+    a file-backed journal must never interleave or tear JSON lines."""
+    path = str(tmp_path / "q.jsonl")
+    j = EventJournal(path, anchor=True, label="t")
+    push_active(j)
+    n_threads, n_events = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def writer(t):
+        barrier.wait()
+        for i in range(n_events):
+            if i % 3 == 0:
+                sid = j.begin("fetch", f"span-{t}-{i}", thread=t,
+                              payload="x" * 200)
+                j.end(sid, bytes=i)
+            else:
+                journal_event("spill", f"ev-{t}-{i}", thread=t,
+                              payload="y" * 200)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    pop_active(j)
+    j.close()
+    # every line is intact JSON (read_journal would raise on a torn one)
+    events = read_journal(path)
+    spans = sum(1 for e in events if e.get("ev") == "B")
+    instants = sum(1 for e in events if e.get("ev") == "I")
+    per_thread = n_events - (n_events + 2) // 3
+    assert spans == n_threads * ((n_events + 2) // 3)
+    assert instants == n_threads * per_thread
+    assert validate_events(events) == []
+
+
+def test_open_shard_is_active_and_adopted(tmp_path):
+    assert J.process_shard() is None
+    try:
+        shard = J.open_shard("exec-t", str(tmp_path / "shard-exec-t.jsonl"))
+        assert J.open_shard("exec-t") is shard    # idempotent
+        assert J.active_journal() is shard        # bottom-of-stack home
+        journal_event("serve", "serveBuffer", buffer=1)
+        # a per-query journal stacked on top routes events to ITSELF,
+        # and popping it re-exposes the shard
+        q = EventJournal(None)
+        push_active(q)
+        journal_event("fetch", "fetchRemote")
+        pop_active(q)
+        assert any(e["name"] == "fetchRemote" for e in q.events())
+        assert not any(e.get("name") == "fetchRemote"
+                       for e in shard.events())
+        assert any(e.get("name") == "serveBuffer"
+                   for e in shard.events())
+    finally:
+        J.close_shard()
+    assert J.process_shard() is None
+
+
+# --------------------------------------------------------------------------
+# trace context
+# --------------------------------------------------------------------------
+
+def test_trace_context_inherits_and_restores():
+    assert current_trace() is None
+    with trace_context(query="q1", stage="s1", executor="e0"):
+        assert current_trace() == ("q1", "s1", None, "e0")
+        with trace_context(span=42):
+            assert current_trace() == ("q1", "s1", 42, "e0")
+        assert current_trace() == ("q1", "s1", None, "e0")
+    assert current_trace() is None
+
+
+def test_trace_context_is_thread_local():
+    seen = {}
+
+    def other():
+        seen["other"] = current_trace()
+
+    with trace_context(query="q9"):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen["other"] is None
+
+
+def test_trace_attrs_wire_shape():
+    assert trace_attrs(("q1", "s1.map", 7, "exec-2")) == {
+        "o_q": "q1", "o_st": "s1.map", "o_sp": 7, "o_ex": "exec-2"}
+    assert trace_attrs(None) == {}
+    assert trace_attrs(("q1", None, None, None)) == {"o_q": "q1"}
+
+
+# --------------------------------------------------------------------------
+# merge + analysis on synthetic shards
+# --------------------------------------------------------------------------
+
+def _shard(label, wall0, mono0, events):
+    return {"label": label,
+            "anchor": {"ev": "A", "wall_ns": wall0, "mono_ns": mono0},
+            "events": events}
+
+
+def _span(sid, kind, name, t0, t1, **attrs):
+    return [{"ev": "B", "id": sid, "kind": kind, "name": name, "ts": t0,
+             **attrs},
+            {"ev": "E", "span": sid, "ts": t1, "id": sid + 10_000,
+             "kind": kind, "name": name}]
+
+
+MS = 10**6
+
+
+def test_merge_aligns_disjoint_monotonic_clocks():
+    # two workers whose monotonic clocks start at wildly different
+    # values; anchors place both on the same wall axis
+    a = _shard("exec-0", wall0=1_000_000 * MS, mono0=5 * MS,
+               events=_span(1, "task", "map", 10 * MS, 30 * MS,
+                            query="q", stage="s1"))
+    b = _shard("exec-1", wall0=1_000_000 * MS, mono0=900_000 * MS,
+               events=_span(1, "task", "map", 900_020 * MS, 900_039 * MS,
+                            query="q", stage="s1"))
+    tl = merge_shards([a, b])
+    t0s = {s.executor: s.t0_ns for s in tl.tasks()}
+    # exec-0's task started 5ms after ITS anchor, exec-1's 20ms after —
+    # on the shared wall axis they are 15ms apart
+    assert t0s["exec-1"] - t0s["exec-0"] == 15 * MS
+    assert set(tl.executors()) == {"exec-0", "exec-1"}
+
+
+def test_merge_applies_probe_offsets():
+    # exec-1's WALL clock is 100ms ahead (bad NTP); heartbeat probes see
+    # it and the merge subtracts the estimated offset
+    a = _shard("exec-0", wall0=0, mono0=0,
+               events=_span(1, "task", "t", 0, 10 * MS))
+    b = _shard("exec-1", wall0=100 * MS, mono0=0,
+               events=_span(1, "task", "t", 0, 10 * MS))
+    probes = {"exec-1": [(0, 100 * MS + 1 * MS, 2 * MS)]}  # off=+100ms
+    tl = merge_shards([a, b], probes)
+    t0s = {s.executor: s.t0_ns for s in tl.tasks()}
+    assert abs(t0s["exec-1"] - t0s["exec-0"]) == 0
+    assert tl.offsets_ns["exec-1"] == 100 * MS
+
+
+def test_flow_links_and_straggler_analysis():
+    fetch = _span(7, "fetch", "fetchRemote", 10 * MS, 20 * MS,
+                  peer="exec-1", executor="exec-0")
+    tasks0 = _span(1, "task", "reduce", 0, 30 * MS, query="q", stage="r")
+    serve = [{"ev": "I", "id": 3, "kind": "serve", "name": "serveBuffer",
+              "ts": 12 * MS, "o_ex": "exec-0", "o_sp": 7, "o_q": "q"}]
+    tasks1 = _span(1, "task", "reduce", 0, 200 * MS, query="q",
+                   stage="r")
+    extra = _span(2, "task", "reduce", 0, 28 * MS, query="q", stage="r")
+    tl = merge_shards([
+        _shard("exec-0", 0, 0, tasks0 + fetch),
+        _shard("exec-1", 0, 0, serve + tasks1 + extra)])
+    links = tl.links()
+    assert len(links) == 1
+    assert links[0]["fetch"].executor == "exec-0"
+    assert links[0]["fetch"].span_id == 7
+    assert links[0]["serve"]["executor"] == "exec-1"
+    # straggler: 200ms vs median ~29-30ms at factor 3
+    st = tl.stragglers(3.0)
+    assert len(st) == 1 and st[0]["executor"] == "exec-1"
+    assert st[0]["factor"] > 3
+    rep = tl.report(3.0)
+    assert rep["metrics"]["tracedFetchLinks"] == 1
+    assert rep["metrics"]["numStragglers"] == 1
+    assert rep["unlinked_fetches"] == 0
+    # the report renders without error and names the straggler
+    text = tl.render(3.0)
+    assert "stragglers" in text and "exec-1" in text
+
+
+def test_straggler_flagged_in_two_task_stage():
+    # lower-median regression: a 2-task stage's straggler must be
+    # flaggable (an average-inclusive median is dragged up by the
+    # straggler itself and can never exceed factor x it)
+    tl = merge_shards([
+        _shard("exec-0", 0, 0,
+               _span(1, "task", "map", 0, 10 * MS, query="q", stage="m")),
+        _shard("exec-1", 0, 0,
+               _span(1, "task", "map", 0, 100 * MS, query="q",
+                     stage="m"))])
+    st = tl.stragglers(3.0)
+    assert len(st) == 1 and st[0]["executor"] == "exec-1"
+
+
+def test_links_resolve_across_restart_epochs():
+    # a replaced worker's shard rides a suffixed label (exec-1#r2) and
+    # its span ids restart; a serve record naming (exec-1, span 7) must
+    # resolve to the epoch whose fetch covers the serve time — never the
+    # dead epoch's same-id span
+    old = _span(7, "fetch", "fetchRemote", 10 * MS, 20 * MS)
+    new = _span(7, "fetch", "fetchRemote", 500 * MS, 520 * MS)
+    serve = [{"ev": "I", "id": 1, "kind": "serve", "name": "serveBuffer",
+              "ts": 510 * MS, "o_ex": "exec-1", "o_sp": 7}]
+    tl = merge_shards([_shard("exec-1", 0, 0, old),
+                       _shard("exec-1#r2", 0, 0, new),
+                       _shard("exec-0", 0, 0, serve)])
+    (link,) = tl.links()
+    assert link["fetch"].executor == "exec-1#r2"
+
+
+def test_offline_driver_journal_links(tmp_path):
+    # the --timeline CLI path: a driver query journal's own fetch+serve
+    # records (in-process LoopbackClient serves carry o_ex='driver')
+    # must link even though the file's lane label is driver/query-1
+    j = EventJournal(str(tmp_path / "query-1.jsonl"), anchor=True,
+                     label="driver")
+    sid = j.begin("fetch", "fetchRemote")
+    j.instant("serve", "serveBuffer", o_ex="driver", o_sp=sid)
+    j.end(sid)
+    j.close()
+    tl = merge_shards(load_journal_dir(str(tmp_path)))
+    assert [s["label"] for s in load_journal_dir(str(tmp_path))] \
+        == ["driver/query-1"]
+    assert len(tl.links()) == 1
+
+
+def test_task_breakdown_overlap_accounting():
+    # task 0-100ms with one fetch 0-40ms and compute 20-100ms:
+    # overlap 20ms, idle 0, efficiency 0.5
+    task = _span(1, "task", "reduce", 0, 100 * MS, query="q", stage="r")
+    fetch = _span(2, "fetch", "fetchRemote", 0, 40 * MS)
+    op = _span(3, "operator", "agg", 20 * MS, 100 * MS)
+    tl = merge_shards([_shard("exec-0", 0, 0, task + fetch + op)])
+    (b,) = tl.task_breakdown()
+    assert b["duration_s"] == pytest.approx(0.1)
+    assert b["fetch_s"] == pytest.approx(0.04)
+    assert b["compute_s"] == pytest.approx(0.08)
+    assert b["overlap_s"] == pytest.approx(0.02)
+    assert b["idle_s"] == pytest.approx(0.0)
+    assert b["overlap_efficiency"] == pytest.approx(0.5)
+
+
+def test_critical_path_chains_stage_maxima():
+    ev0 = (_span(1, "task", "map", 0, 50 * MS, query="q", stage="m")
+           + _span(2, "task", "reduce", 60 * MS, 90 * MS, query="q",
+                   stage="r"))
+    ev1 = (_span(1, "task", "map", 0, 70 * MS, query="q", stage="m")
+           + _span(2, "task", "reduce", 75 * MS, 95 * MS, query="q",
+                   stage="r"))
+    tl = merge_shards([_shard("exec-0", 0, 0, ev0),
+                       _shard("exec-1", 0, 0, ev1)])
+    cp = tl.critical_path()["q"]
+    assert [p["stage"] for p in cp["path"]] == ["m", "r"]
+    assert cp["path"][0]["executor"] == "exec-1"  # 70ms map
+    assert cp["critical_path_s"] == pytest.approx(0.1)
+    assert cp["wall_s"] == pytest.approx(0.095)
+
+
+def test_unanchored_shard_degrades_not_crashes():
+    tl = merge_shards([{"label": "w", "events":
+                        _span(1, "task", "t", 0, MS)}])
+    assert tl.unanchored == ["w"]
+    assert len(tl.tasks()) == 1
+
+
+# --------------------------------------------------------------------------
+# chrome trace: pid lanes + flow events
+# --------------------------------------------------------------------------
+
+def test_cluster_chrome_trace_lanes_and_flows(tmp_path):
+    from spark_rapids_tpu.utils.tracing import write_cluster_chrome_trace
+    fetch = _span(7, "fetch", "fetchRemote", 10 * MS, 20 * MS)
+    serve = [{"ev": "I", "id": 3, "kind": "serve", "name": "serveBuffer",
+              "ts": 12 * MS, "o_ex": "exec-0", "o_sp": 7}]
+    tl = merge_shards([_shard("exec-0", 0, 0, fetch),
+                       _shard("exec-1", 0, 0, serve)])
+    out = write_cluster_chrome_trace(tl, str(tmp_path / "t.json"))
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    names = {e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    assert names == {"exec-0", "exec-1"}  # one pid lane per worker
+    pids = {e["args"]["name"]: e["pid"] for e in events
+            if e.get("name") == "process_name"}
+    flows = [e for e in events if e.get("ph") in ("s", "f")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    start = next(e for e in flows if e["ph"] == "s")
+    fin = next(e for e in flows if e["ph"] == "f")
+    assert start["pid"] == pids["exec-0"]   # fetch side
+    assert fin["pid"] == pids["exec-1"]     # serve side
+    assert start["id"] == fin["id"]
+
+
+# --------------------------------------------------------------------------
+# wire trace propagation: real socket pair, one process
+# --------------------------------------------------------------------------
+
+def _make_env(executor_id):
+    from spark_rapids_tpu.mem.runtime import TpuRuntime
+    from spark_rapids_tpu.shuffle.manager import ShuffleEnv
+    from spark_rapids_tpu.shuffle.net import SocketTransport
+    conf = TpuConf()
+    runtime = TpuRuntime(conf)
+    transport = SocketTransport(chunk_size=64 << 10,
+                                max_inflight_bytes=256 << 10)
+    env = ShuffleEnv(runtime, conf, executor_id, transport)
+    return env, transport
+
+
+def test_socket_fetch_carries_trace_and_links():
+    """A fetch over a REAL localhost socket: the reducer's fetch span id
+    rides the wire, the server journals a serve record carrying it, and
+    the merged timeline links the two."""
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    env_a, tr_a = _make_env("wire-a")
+    env_b, tr_b = _make_env("wire-b")
+    journal = EventJournal(None)
+    push_active(journal)
+    try:
+        tr_b.set_peers({"wire-a": tr_a.address})
+        rng = np.random.RandomState(0)
+        table = pa.table({"k": rng.randint(0, 100, 4000).astype(np.int64),
+                          "v": rng.uniform(0, 1, 4000)})
+        env_a.write_partition(shuffle_id=5, map_id=0, reduce_id=1,
+                              batch=ColumnarBatch.from_arrow(table))
+        with trace_context(query="qw", stage="sw", executor="wire-b"):
+            got = list(env_b.fetch_partition(5, 1,
+                                             remote_peers=["wire-a"]))
+        assert got and sum(b.to_arrow().num_rows for b in got) == 4000
+    finally:
+        pop_active(journal)
+        tr_a.shutdown()
+        tr_b.shutdown()
+    events = journal.events()
+    fetch_b = [e for e in events if e.get("ev") == "B"
+               and e.get("kind") == "fetch"]
+    assert len(fetch_b) == 1
+    fetch_id = fetch_b[0]["id"]
+    assert fetch_b[0]["query"] == "qw" and fetch_b[0]["stage"] == "sw"
+    serves = [e for e in events if e.get("kind") == "serve"
+              and e.get("ev") in ("B", "I")]
+    assert serves, "server journaled no serve records"
+    # at least one serve record names the fetch span that asked:
+    # cross-WORKER propagation through the socket payload
+    linked = [e for e in serves
+              if e.get("o_ex") == "wire-b" and e.get("o_sp") == fetch_id]
+    assert linked, (fetch_id, serves)
+    assert all(e.get("executor") == "wire-a" for e in serves)
+    # and the timeline merge resolves the link end-to-end
+    tl = merge_shards([
+        {"label": "wire-b",
+         "anchor": {"ev": "A", "wall_ns": 0, "mono_ns": 0},
+         "events": [e for e in events if e.get("kind") == "fetch"]},
+        {"label": "wire-a",
+         "anchor": {"ev": "A", "wall_ns": 0, "mono_ns": 0},
+         "events": [dict(e, o_ex="wire-b") for e in events
+                    if e.get("kind") == "serve"]}])
+    assert len(tl.links()) >= 1
+
+
+def test_trace_disabled_sends_bare_payload():
+    """trace.enabled=false: requests go out WITHOUT a trace tuple (the
+    pre-trace wire shape — back-compat both ways)."""
+    from spark_rapids_tpu.shuffle.net import _pack_fetch, _unpack_fetch
+    assert _pack_fetch(7, None) == (7).to_bytes(8, "big")
+    bid, codec, trace = _unpack_fetch(_pack_fetch(7, None))
+    assert (bid, codec, trace) == (7, None, None)
+    # pre-trace peers' pickled (bid, codec) pairs still parse
+    import pickle
+    bid, codec, trace = _unpack_fetch(pickle.dumps((9, "lz4")))
+    assert (bid, codec, trace) == (9, "lz4", None)
+    bid, codec, trace = _unpack_fetch(
+        _pack_fetch(9, "lz4", ("q", "s", 3, "e")))
+    assert (bid, codec, trace) == (9, "lz4", ("q", "s", 3, "e"))
+
+
+# --------------------------------------------------------------------------
+# delay injector (faults.py satellite)
+# --------------------------------------------------------------------------
+
+def test_delay_injector_scoped():
+    from spark_rapids_tpu.utils import faults
+    inj = faults.FaultInjector()
+    inj.configure(delay_spec="exec-1/reduce:5,map:1")
+    inj.set_scope("exec-0")
+    t0 = time.monotonic()
+    assert inj.on_delay("reduce") == 0.0          # scope mismatch
+    assert inj.on_delay("map") == pytest.approx(0.001)  # unscoped
+    inj.set_scope("exec-1")
+    assert inj.on_delay("reduce") == pytest.approx(0.005)
+    assert time.monotonic() - t0 < 1.0
+    assert inj.site_counts.get("delay:reduce") == 1
+    assert inj.site_counts.get("delay:map") == 1
+    assert any(k == "delay" for k, _ms, _s in inj.injected_log)
+
+
+# --------------------------------------------------------------------------
+# session.progress() — local path
+# --------------------------------------------------------------------------
+
+def test_session_progress_local_monotonic():
+    from spark_rapids_tpu.engine import TpuSession
+    session = TpuSession()
+    scores = [session.progress()["score"]]
+    table = pa.table({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    for _ in range(3):
+        session.from_arrow(table).select("k", "v").to_arrow()
+        scores.append(session.progress()["score"])
+    assert scores == sorted(scores)
+    assert scores[-1] > scores[0]
+    assert session.progress()["queries"] == 3
+
+
+# --------------------------------------------------------------------------
+# 3-executor ProcCluster acceptance (slow tier)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_proc_cluster_distributed_trace_acceptance(tmp_path):
+    """ISSUE-7 acceptance: on a 3-executor ProcCluster shuffled-join
+    query, the merged timeline holds spans from every worker, every
+    reducer fetch span is flow-linked to its mapper serve span, the
+    report carries a critical path + per-task overlap breakdown, an
+    injected slow worker is flagged as a straggler, the hung-task
+    watchdog fires on it, and session.progress() advances monotonically
+    during execution."""
+    from spark_rapids_tpu.cluster import ProcCluster
+    from spark_rapids_tpu.engine import DataFrame, TpuSession
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.logical import col, functions as F, lit
+
+    jdir = str(tmp_path / "journal")
+    session = TpuSession()
+    rows, n_workers = 600, 3
+    table = pa.table({"k": [i % 16 for i in range(rows)],
+                      "v": [float(i) for i in range(rows)]})
+    dim = pa.table({"k": list(range(16)),
+                    "name": [f"k{i}" for i in range(16)]})
+    step = (rows + n_workers - 1) // n_workers
+    map_plans = [session.from_arrow(table.slice(i * step, step)).plan
+                 for i in range(n_workers)]
+    map_schema = DataFrame(session, map_plans[0]).schema
+    reduce_plan = (DataFrame(session, L.LogicalPlaceholder(map_schema))
+                   .join(session.from_arrow(dim), on="k", how="inner")
+                   .group_by(col("k"))
+                   .agg(F.sum(col("v")).alias("sv"),
+                        F.count(lit(1)).alias("c"))).plan
+
+    cluster = ProcCluster(
+        n_workers,
+        conf={"spark.rapids.sql.tpu.metrics.journal.dir": jdir,
+              "spark.rapids.sql.tpu.trace.heartbeatIntervalMs": "100",
+              "spark.rapids.sql.tpu.trace.hungTaskTimeoutMs": "500",
+              "spark.rapids.tpu.test.injectDelay": "exec-1/reduce:1200"},
+        cpu=True, session=session)
+    try:
+        p0 = session.progress()["score"]
+        # warm-up run compiles the kernels so the traced run's task
+        # durations are dominated by real work + the injected delay
+        cluster.run_map_reduce(map_plans, ["k"], 6, reduce_plan,
+                               trace_query="warmup-q")
+        p1 = session.progress()["score"]
+        assert p1 > p0, "progress did not advance across the warmup run"
+        result, _stats = cluster.run_map_reduce(
+            map_plans, ["k"], 6, reduce_plan, trace_query="traced-q")
+        # heartbeat totals are eventually consistent (poll interval
+        # 100ms): wait for the final task completions to be sampled
+        deadline = time.monotonic() + 10
+        while (cluster.progress()["tasks_completed"] < 2 * n_workers * 2
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        p2 = session.progress()["score"]
+        assert p2 > p1, "progress did not advance across the traced run"
+        progress = cluster.progress()
+        assert progress["tasks_completed"] >= 2 * n_workers * 2
+        assert progress["heartbeats"] > 0
+
+        tl = cluster.merged_timeline()
+        rep = cluster.timeline_report()
+    finally:
+        cluster.shutdown()
+
+    # result correctness rides along
+    res = result.to_pydict()
+    assert sorted(res["k"]) == list(range(16))
+    assert sum(res["c"]) == rows
+
+    # spans from EVERY worker
+    assert {"exec-0", "exec-1", "exec-2"} <= set(tl.executors())
+    # every reducer fetch span flow-links to its mapper serve record
+    assert rep["fetch_spans"] > 0
+    assert rep["unlinked_fetches"] == 0, tl.render()
+    assert rep["links"] > 0
+    assert rep["metrics"]["tracedFetchLinks"] == rep["links"]
+    # critical path covers both stages of both queries
+    for q in ("warmup-q", "traced-q"):
+        cp = rep["critical_path"][q]
+        assert len(cp["path"]) == 2 and cp["critical_path_s"] > 0
+    # per-task overlap breakdown exists for every task
+    assert len(rep["tasks"]) >= 2 * n_workers * 2
+    assert all(t["duration_s"] > 0 for t in rep["tasks"])
+    # the injected slow worker is flagged as a straggler on the warm run
+    st = [s for s in rep["stragglers"] if s["query"] == "traced-q"]
+    assert st and all(s["executor"] == "exec-1" for s in st), \
+        rep["stragglers"]
+    assert rep["metrics"]["numStragglers"] >= 1
+    # the watchdog saw the 1.2s-delayed task exceed its 500ms bound
+    assert rep["metrics"]["numHungTasks"] >= 1
+    assert rep["metrics"]["heartbeatLag"] >= 0
+
+    # offline: the worker shard FILES alone reproduce the analysis
+    # through the --timeline CLI (with a chrome trace)
+    assert sorted(os.path.basename(p) for p in
+                  __import__("glob").glob(os.path.join(jdir, "shard-*"))
+                  ) == [f"shard-exec-{i}.jsonl" for i in range(3)]
+    chrome = str(tmp_path / "cluster-trace.json")
+    cp = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.metrics", "--timeline",
+         jdir, "--chrome", chrome],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert cp.returncode == 0, cp.stderr
+    assert "critical path" in cp.stdout
+    assert "per-task overlap" in cp.stdout
+    with open(chrome) as f:
+        trace = json.load(f)["traceEvents"]
+    lanes = {e["args"]["name"] for e in trace
+             if e.get("name") == "process_name"}
+    assert {"exec-0", "exec-1", "exec-2"} <= lanes
+    assert any(e.get("ph") == "s" for e in trace)
+    assert any(e.get("ph") == "f" for e in trace)
+
+
+@pytest.mark.slow
+def test_heartbeat_monitor_restart_aware_totals(tmp_path):
+    """A replaced worker restarts its counters at zero; the monitor's
+    cluster totals must NEVER go backwards (the progress() contract)."""
+    from spark_rapids_tpu.cluster import HeartbeatMonitor, ProcCluster
+    cluster = ProcCluster(
+        2, conf={"spark.rapids.sql.tpu.trace.heartbeatIntervalMs": "0"},
+        cpu=True)
+    try:
+        mon = HeartbeatMonitor(cluster, interval_s=3600,
+                               hung_timeout_s=0)
+        try:
+            hb = {"pid": 100, "tasks_completed": 10, "rows_written": 50,
+                  "counters": {"bytes_sent": 1000}, "active_tasks": [],
+                  "wall_ns": time.time_ns()}
+            mon._ingest("exec-0", dict(hb), 0, 1)
+            s1 = mon.progress()["score"]
+            # same worker advances
+            hb2 = dict(hb, tasks_completed=12, rows_written=60)
+            mon._ingest("exec-0", hb2, 2, 3)
+            s2 = mon.progress()["score"]
+            assert s2 > s1
+            # replacement: NEW pid, counters reset to small values —
+            # totals still only grow
+            hb3 = {"pid": 200, "tasks_completed": 1, "rows_written": 5,
+                   "counters": {"bytes_sent": 10}, "active_tasks": [],
+                   "wall_ns": time.time_ns()}
+            mon._ingest("exec-0", hb3, 4, 5)
+            s3 = mon.progress()["score"]
+            assert s3 > s2
+            assert mon.totals["tasks_completed"] == 13
+            assert mon.totals["rows_written"] == 65
+        finally:
+            mon.stop()
+    finally:
+        cluster.shutdown()
