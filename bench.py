@@ -524,6 +524,194 @@ def tracing_microbench() -> dict:
     return out
 
 
+def pressure_microbench(write_artifact: bool = True) -> dict:
+    """Memory-budget sweep (the ISSUE-8 acceptance artifact, and the
+    BENCH_PRESSURE stage ROADMAP item 4 asks for): the spill-cascade
+    slice (partitioned join -> grouped agg -> sort) run at accounted-pool
+    budgets of 100/75/50/25% of its measured working set, with the
+    memory ledger's breakdown (spill bytes, churn ratio, victim quality,
+    retry counts, headroom) recorded per budget — so the data-movement
+    scheduler PR has a reproducible baseline to beat.  Also measures the
+    ledger's own cost: q1 with the ledger (and a file journal) on vs off
+    at MODERATE level, gated <5% like the tracing stage."""
+    import shutil
+    import tempfile
+
+    from spark_rapids_tpu.engine import TpuSession
+    from spark_rapids_tpu.metrics import names as MN
+    from spark_rapids_tpu.metrics.memledger import analyze_shards
+    from spark_rapids_tpu.metrics.timeline import load_journal_dir
+    from spark_rapids_tpu.plan.logical import col, functions as F, lit
+
+    n = int(os.environ.get("BENCH_PRESSURE_ROWS", 120_000))
+    base_conf = {
+        "spark.rapids.sql.variableFloatAgg.enabled": "true",
+        "spark.rapids.memory.host.spillStorageSize": str(1 << 20),
+        "spark.rapids.sql.batchSizeBytes": str(512 << 10),
+        "spark.rapids.sql.reader.batchSizeRows": "16384",
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.rapids.sql.tpu.join.partitioned.threshold": "1",
+        "spark.rapids.sql.tpu.shuffle.partitions": "8",
+        "spark.rapids.sql.tpu.memoryScanCache.enabled": "false",
+    }
+
+    def slice_query(s):
+        fact = s.from_pydict({
+            "k": [i % 7 for i in range(n)],
+            "v": [float(i) for i in range(n)],
+            "q": [i % 3 for i in range(n)]})
+        dim = s.from_pydict({"k": list(range(7)),
+                             "name": [f"g{j}" for j in range(7)]})
+        return checksum(
+            fact.join(dim, on="k").filter(col("q") < 2)
+            .group_by(col("name"))
+            .agg(F.sum(col("v")).alias("sv"), F.count(lit(1)).alias("c"))
+            .order_by(col("name")).collect())
+
+    def run(pool_bytes=0, jdir=None):
+        """One measured slice run.  The warmup query shares the session
+        (compiles + H2D), so everything reported is a DELTA over the
+        timed run only: counter movement, and only the journal files the
+        timed query opened — otherwise every breakdown would double-count
+        the warmup's spills against one run's time_s."""
+        conf = dict(base_conf)
+        if pool_bytes:
+            conf["spark.rapids.memory.tpu.poolSizeBytes"] = str(pool_bytes)
+        if jdir:
+            conf["spark.rapids.sql.tpu.metrics.journal.dir"] = jdir
+        s = TpuSession(conf)
+        slice_query(s)                     # warmup: compiles + H2D
+        warm_files = set(os.listdir(jdir)) if jdir else set()
+        ps_before = dict(s.runtime.pool_stats())
+        tot_before = dict(getattr(s, "query_metrics_total", {}) or {})
+        t0 = time.perf_counter()
+        val = slice_query(s)
+        elapsed = time.perf_counter() - t0
+        ps_after = s.runtime.pool_stats()
+        counters = {k: int(ps_after.get(k, 0)) - int(ps_before.get(k, 0))
+                    for k in (MN.OOM_SPILL_RETRIES, MN.OOM_ALLOC_FAILURES)}
+        tot_after = dict(getattr(s, "query_metrics_total", {}) or {})
+        totals = {k: tot_after.get(k, 0) - tot_before.get(k, 0)
+                  for k in tot_after}
+        new_shards = []
+        if jdir:
+            fresh = set(os.listdir(jdir)) - warm_files
+
+            def shard_files(label):
+                # invert load_journal_dir's labeling: 'driver/query-N'
+                # came from query-N.jsonl, a worker label 'exec-K' from
+                # shard-exec-K.jsonl (process-lifetime: only counted
+                # when the file itself is fresh)
+                base = label.rsplit("/", 1)[-1]
+                return {base + ".jsonl", "shard-" + base + ".jsonl"}
+
+            new_shards = [sh for sh in load_journal_dir(jdir)
+                          if shard_files(sh["label"]) & fresh]
+        return elapsed, val, ps_after, counters, totals, new_shards
+
+    # 1. unconstrained run: the measured working set is the 100% budget.
+    # The baseline gets a journal dir too, so slowdown_vs_unconstrained
+    # isolates BUDGET pressure rather than folding in journal-write cost
+    jdir0 = tempfile.mkdtemp(prefix="bench_pressure_base_")
+    try:
+        el0, val0, ps0, _c0, _t0, _sh0 = run(jdir=jdir0)
+    finally:
+        shutil.rmtree(jdir0, ignore_errors=True)
+    working_set = int(ps0.get("device_peak", 0)) or 1
+
+    budgets = {}
+    for pct in (100, 75, 50, 25):
+        pool = max(1 << 16, working_set * pct // 100)
+        jdir = tempfile.mkdtemp(prefix=f"bench_pressure_{pct}_")
+        try:
+            el, val, _ps, counters, totals, shards = run(pool, jdir)
+            rep = analyze_shards(shards)
+        finally:
+            shutil.rmtree(jdir, ignore_errors=True)
+        t = rep["totals"]
+        budgets[str(pct)] = {
+            "pool_bytes": pool,
+            "time_s": round(el, 4),
+            "slowdown_vs_unconstrained": round(el / el0, 3) if el0 else None,
+            "match": bool(abs(val - val0) <= 1e-6 * max(1.0, abs(val0))),
+            # ledger-derived breakdown (metrics/memledger.py)
+            "spill_bytes": t["spilled_bytes"],
+            "respill_bytes": t["respill_bytes"],
+            "churn_ratio": rep["churn"]["churn_ratio"],
+            "victim_quality": rep["victim_quality"]["quality"],
+            "headroom_bytes": rep["headroom"]["bytes"],
+            "cascades": len(rep["cascades"]),
+            "oom_spills": t["oom_spills"],
+            "oom_fails": t["oom_fails"],
+            "ledger_events": t["events"],
+            # runtime/retry view of the same run (timed-run deltas)
+            "oomSpillRetries": counters[MN.OOM_SPILL_RETRIES],
+            "oomAllocFailures": counters[MN.OOM_ALLOC_FAILURES],
+            "retries": int(sum(totals.get(f"{b}Retries", 0)
+                               for b in MN.RETRY_BLOCKS)),
+            "splits": int(sum(totals.get(f"{b}Splits", 0)
+                              for b in MN.RETRY_BLOCKS)),
+        }
+
+    # 2. ledger overhead gate (<5% on q1 at MODERATE, journal on — the
+    # ISSUE-8 twin of the tracing stage's gate)
+    table = make_lineitem(200_000)
+
+    def measure_q1(ledger_on):
+        jdir = tempfile.mkdtemp(prefix="bench_pressure_ovh_")
+        try:
+            s = TpuSession({
+                "spark.rapids.sql.variableFloatAgg.enabled": "true",
+                "spark.rapids.sql.tpu.metrics.journal.dir": jdir,
+                "spark.rapids.sql.tpu.memory.ledger.enabled":
+                    "true" if ledger_on else "false"})
+            df = s.from_arrow(table)
+            checksum(q1(df).collect())      # warmup
+            runs = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                checksum(q1(df).collect())
+                runs.append(time.perf_counter() - t0)
+            return min(runs)
+        finally:
+            shutil.rmtree(jdir, ignore_errors=True)
+
+    off_s = measure_q1(False)
+    on_s = measure_q1(True)
+    overhead_pct = (on_s - off_s) / off_s * 100.0 if off_s > 0 else 0.0
+
+    rec = {
+        "recorded_unix": int(time.time()),
+        "rows": n,
+        "working_set_bytes": working_set,
+        "unconstrained_time_s": round(el0, 4),
+        "conf": {k: v for k, v in base_conf.items()
+                 if "variableFloat" not in k},
+        "budgets": budgets,
+        "ledger_overhead": {
+            "q1_ledger_off_s": round(off_s, 4),
+            "q1_ledger_on_s": round(on_s, 4),
+            "overhead_pct": round(overhead_pct, 2),
+            "gate_ok": bool(overhead_pct < 5.0)},
+        "note": ("join->agg->sort spill-cascade slice at 25/50/75/100% "
+                 "of measured working set; breakdowns reconstructed "
+                 "offline from the memory ledger journal "
+                 "(python -m spark_rapids_tpu.metrics --memory)"),
+    }
+    try:
+        import jax
+        rec["platform"] = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        rec["platform"] = "unknown"
+    if write_artifact:
+        try:
+            with open(os.path.join(REPO, "BENCH_PRESSURE.json"), "w") as f:
+                json.dump(rec, f, indent=1)
+        except OSError:
+            pass
+    return rec
+
+
 def child_main(mode: str) -> None:
     _DEADLINE[0] = time.time() + float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))
@@ -698,6 +886,13 @@ def child_main(mode: str) -> None:
         emit("tracing", **tracing_microbench())
     except Exception as e:
         emit("tracing", error=repr(e)[:200])
+    # pressure rollup (ISSUE 8): the memory-budget sweep at 25/50/75/100%
+    # of measured working set with ledger-derived breakdowns, plus the
+    # ledger's own <5% overhead gate; also writes BENCH_PRESSURE.json
+    try:
+        emit("pressure", **pressure_microbench())
+    except Exception as e:
+        emit("pressure", error=repr(e)[:200])
     emit("done", t=time.time() - (_DEADLINE[0] - float(
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))))
 
@@ -814,7 +1009,8 @@ def collect(r: "StageReader", end_at: float,
     out = {"platform": None, "runs": {}, "warmup": {}, "values": {},
            "transfer": None, "aborted": False, "backend_error": None,
            "observability": None, "adaptive": None, "integrity": None,
-           "compress": None, "fusion": None, "tracing": None}
+           "compress": None, "fusion": None, "tracing": None,
+           "pressure": None}
     first = True
     try:
         while True:
@@ -862,6 +1058,9 @@ def collect(r: "StageReader", end_at: float,
             elif st == "tracing":
                 out["tracing"] = {k: v for k, v in rec.items()
                                   if k != "stage"}
+            elif st == "pressure":
+                out["pressure"] = {k: v for k, v in rec.items()
+                                   if k != "stage"}
             elif st == "abort":
                 out["aborted"] = True
                 break
@@ -875,6 +1074,12 @@ def collect(r: "StageReader", end_at: float,
 def main():
     if len(sys.argv) > 1 and sys.argv[1].startswith("--child="):
         child_main(sys.argv[1].split("=", 1)[1])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--pressure":
+        # standalone memory-budget sweep: regenerate BENCH_PRESSURE.json
+        # without the full suite (runs on whatever backend is available;
+        # set JAX_PLATFORMS=cpu to keep it off a leased chip)
+        print(json.dumps(pressure_microbench(), indent=1))
         return
 
     # The headline line is emitted UNCONDITIONALLY (round-4 postmortem:
@@ -1018,6 +1223,7 @@ def _run():
         "compress": dev.get("compress"),
         "fusion": dev.get("fusion"),
         "tracing": dev.get("tracing"),
+        "pressure": dev.get("pressure"),
         "q6_effective_gb_s": round(eff_gb_s, 2),
         "hbm_roofline_note": "v5e HBM ~819 GB/s; q6 reads 32 B/row",
         "vs_ref_headline": round(vs / 19.8, 4),

@@ -81,6 +81,19 @@ python -m pytest tests/test_tracing.py -q -m "not slow" \
     -p no:cacheprovider
 echo "== tracing tier took $((SECONDS - T_TRC))s =="
 
+echo "== memledger tier =="
+# memory-pressure observability (ISSUE 8): the allocation ledger's
+# causal chains (reserve -> oomSpill -> victim buffer ids), watermark
+# monotonicity, churn/victim-quality analysis, the --memory CLI offline
+# from journal files, and the heartbeat peak roll-up.  -m "memledger and
+# slow" adds the 2-worker ProcCluster acceptance (worker-side mem events
+# stamped with the driver query, cluster peak_memory over real
+# heartbeats).
+T_MEM=$SECONDS
+python -m pytest tests/test_memledger.py -q -m "not slow" \
+    -p no:cacheprovider
+echo "== memledger tier took $((SECONDS - T_MEM))s =="
+
 echo "== tests (fast tier) =="
 T_TESTS=$SECONDS
 MARK="not slow"

@@ -74,6 +74,11 @@ class HeartbeatMonitor:
         self.totals = {"heartbeats": 0, "tasks_completed": 0,
                        "tasks_failed": 0, "rows_written": 0,
                        "wire_bytes": 0}
+        # per-executor memory high-waters from heartbeat pool stats,
+        # accumulated max-monotonic across restarts: a replaced worker's
+        # reset peaks never regress the cluster roll-up (same contract
+        # as the monotonic counter totals above)
+        self._peak_seen: Dict[str, Dict[str, int]] = {}
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="heartbeat-monitor")
         self._thread.start()
@@ -166,6 +171,12 @@ class HeartbeatMonitor:
                 "tasks_failed": int(hb.get("tasks_failed", 0)),
                 "rows_written": int(hb.get("rows_written", 0)),
                 "wire_bytes": wire}
+            pool = hb.get("pool", {}) or {}
+            peaks = self._peak_seen.setdefault(executor, {})
+            for field in ("device_peak", "host_peak", "disk_peak"):
+                v = int(pool.get(field, 0) or 0)
+                if v > peaks.get(field, 0):
+                    peaks[field] = v
             if self.hung_timeout_s > 0:
                 for task in hb.get("active_tasks", []) or []:
                     if task.get("elapsed_s", 0) <= self.hung_timeout_s:
@@ -212,6 +223,19 @@ class HeartbeatMonitor:
         with self._lock:
             return {ex: list(dq) for ex, dq in self.clock_probes.items()}
 
+    def peak_memory(self) -> dict:
+        """Cluster peak memory from heartbeat pool stats: per-executor
+        restart-aware high-waters (max over every epoch of that executor
+        id) plus the cluster sum per tier.  Monotonic: values never
+        decrease over the monitor's lifetime."""
+        with self._lock:
+            per_worker = {ex: dict(p) for ex, p in self._peak_seen.items()}
+        return {
+            "per_worker": per_worker,
+            **{f: sum(p.get(f, 0) for p in per_worker.values())
+               for f in ("device_peak", "host_peak", "disk_peak")},
+        }
+
     def progress(self) -> dict:
         lag = self.lag_s()
         with self._lock:
@@ -235,6 +259,9 @@ class HeartbeatMonitor:
                           + totals["rows_written"]
                           + totals["wire_bytes"]),
             }
+        # cluster peak memory (restart-aware max roll-up of each worker's
+        # pool_stats high-waters; peak_memory() takes the lock itself)
+        out["peak_memory"] = self.peak_memory()
         return out
 
     def metrics(self) -> dict:
@@ -669,7 +696,9 @@ class ProcCluster:
                    "tasks_failed": 0, "rows_written": 0, "wire_bytes": 0,
                    "workers": len(self.workers), "active_tasks": [],
                    "heartbeat_lag_s": 0.0, "missed_heartbeats": 0,
-                   "hung_tasks": 0, "score": 0}
+                   "hung_tasks": 0, "score": 0,
+                   "peak_memory": {"per_worker": {}, "device_peak": 0,
+                                   "host_peak": 0, "disk_peak": 0}}
         out["task_retries"] = self.task_retries
         out["lost_map_outputs"] = self.lost_map_outputs
         return out

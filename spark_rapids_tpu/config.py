@@ -144,6 +144,13 @@ MIN_BUCKET_ROWS = _conf(
 TPU_ALLOC_FRACTION = _conf(
     "spark.rapids.memory.tpu.allocFraction", 0.9,
     "Fraction of usable HBM to reserve for the columnar batch pool.", float)
+TPU_POOL_SIZE = _conf(
+    "spark.rapids.memory.tpu.poolSizeBytes", 0,
+    "Absolute accounted HBM pool budget in bytes; overrides allocFraction "
+    "when > 0.  The knob memory-budget sweeps (bench.py pressure stage) "
+    "and the serving tier's per-query budgets are expressed in — an exact "
+    "byte budget is reproducible across hosts where a fraction of "
+    "detected HBM is not.", to_bytes)
 HOST_SPILL_STORAGE_SIZE = _conf(
     "spark.rapids.memory.host.spillStorageSize", 1 << 30,
     "Bytes of host memory to use for spilled device buffers before spilling "
@@ -673,6 +680,28 @@ TRACE_SHARD_MAX_EVENTS = _conf(
     "evicts the oldest events and is counted in the drain response "
     "(a driver that never drains must not leak worker memory).", int,
     internal=True)
+
+# --- memory ledger (mem/ledger.py + metrics/memledger.py) --------------------
+MEM_LEDGER_ENABLED = _conf(
+    "spark.rapids.sql.tpu.memory.ledger.enabled", True,
+    "Memory-pressure ledger: journal every allocation-boundary event of "
+    "the spill framework (alloc/free/spill/unspill/oomSpill, journal kind "
+    "'mem') stamped with the active trace context and causally linked — "
+    "an oomSpill record names the triggering reservation site and the "
+    "exact victim buffer ids, so spill cascades are traversable chains.  "
+    "Events land in the active query journal / worker trace shard; "
+    "`python -m spark_rapids_tpu.metrics --memory <journal-dir>` "
+    "reconstructs peak attribution, spill churn, victim quality and a "
+    "headroom estimate offline.  At metrics.level=DEBUG every reserve() "
+    "is additionally journaled; below DEBUG only pressured reservations "
+    "are (docs/tuning-guide.md, Memory observability).", _to_bool)
+MEM_LEDGER_SAMPLE_MS = _conf(
+    "spark.rapids.sql.tpu.memory.ledger.sampleIntervalMs", 100,
+    "Minimum milliseconds between sampled memory-pressure records "
+    "(ledger 'pressure' instants carrying per-tier used bytes + the pool "
+    "limit — the per-worker memory lane of the Chrome trace / merged "
+    "timeline).  OOM events always force a sample.  0 samples on every "
+    "ledger event.", int)
 
 # --- export -----------------------------------------------------------------
 EXPORT_COLUMNAR_RDD = _conf(

@@ -113,13 +113,13 @@ class TpuSession:
             limit = None
             if int(self.conf.get(C.CLUSTER_EXECUTORS)) > 1:
                 # cluster mode: the N executor pools already claim half of
-                # the allocFraction budget (plugin.TpuCluster); the driving
+                # the session budget (plugin.TpuCluster); the driving
                 # session's compute pool takes the other half so combined
-                # accounting reflects ONE physical device, not two
-                from .mem.runtime import _detect_hbm_bytes
-                limit = int(_detect_hbm_bytes()
-                            * float(self.conf.get(C.TPU_ALLOC_FRACTION))
-                            ) // 2
+                # accounting reflects ONE physical device, not two.
+                # configured_pool_bytes honors an explicit poolSizeBytes
+                # before falling back to allocFraction of detected HBM.
+                from .mem.runtime import configured_pool_bytes
+                limit = configured_pool_bytes(self.conf) // 2
             self._runtime = TpuRuntime(self.conf, pool_limit_bytes=limit)
         return self._runtime
 
@@ -157,10 +157,19 @@ class TpuSession:
         # high-water: per-query journal ids restart, so the raw sum may
         # dip between queries — the surfaced score never does
         self._progress_high_water = max(self._progress_high_water, raw)
-        return {"queries": self.queries_executed,
-                "journal_events": events, "rows": rows,
-                "active_query": j is not None,
-                "score": self._progress_high_water}
+        out = {"queries": self.queries_executed,
+               "journal_events": events, "rows": rows,
+               "active_query": j is not None,
+               "score": self._progress_high_water}
+        if self._runtime is not None:
+            # local-session twin of the cluster roll-up: the runtime's
+            # store high-waters (pool_stats device_peak/host_peak/
+            # disk_peak are store-tracked and monotonic until reset)
+            ps = self._runtime.pool_stats()
+            out["peak_memory"] = {
+                f: int(ps.get(f, 0))
+                for f in ("device_peak", "host_peak", "disk_peak")}
+        return out
 
     # -- planning -----------------------------------------------------------
     def plan(self, logical: L.LogicalPlan) -> ExecNode:

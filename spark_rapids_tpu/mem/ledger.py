@@ -1,0 +1,294 @@
+"""Memory-pressure ledger: the allocation-boundary event stream.
+
+Every movement the spill framework makes — device allocation, free,
+tier-migration (spill/unspill), OOM-driven synchronous spill, failed
+reservation — is journaled as ONE structured record (journal kind `mem`)
+into whichever journal is active: the driver's per-query journal or a
+worker's process-lifetime trace shard.  That makes memory pressure a
+first-class part of the SAME timeline operators, retries and fetches
+already live in, and lets `python -m spark_rapids_tpu.metrics --memory`
+reconstruct the whole story offline from journal shards alone
+(metrics/memledger.py: peak attribution, spill cascades, churn, victim
+quality, headroom).
+
+Design constraints (this is a hot-ish path — reserve() guards every
+whole-batch device allocation):
+
+  * CAUSALITY over counters: spills do not just increment a number; each
+    spill record carries `cause` = the id of the reservation that forced
+    it, and each oomSpill record lists the exact victim buffer ids that
+    round of `synchronous_spill` evicted.  A cascade (device->host spill
+    overflowing the host tier into disk) shares one cause id, so the
+    chain is traversable.
+  * Trace stamping: records carry the active distributed trace context
+    (query/stage/executor from metrics.journal.current_trace()), so a
+    worker's mem events attribute to the driver's query.
+  * Level gating (like the metric catalog): with the ledger enabled,
+    alloc/free/spill/unspill/oom records are always emitted; per-reserve
+    records only at metrics.level=DEBUG (below DEBUG a reservation is
+    journaled lazily, the moment it first causes pressure).  With no
+    active journal, journal_event() is a no-op and the ledger costs two
+    dict ops + a lock per event.
+  * Pressure timeline: per-tier used bytes are sampled into `pressure`
+    records at a bounded rate (sampleIntervalMs), forced around OOM
+    events — the per-worker memory lane of the Chrome trace.
+
+The ledger is installed on the BufferCatalog (like the integrity and
+compression policies) so the stores can reach it without plumbing; bare
+stores built by unit tests simply have `catalog.ledger is None`.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..metrics import names as MN
+from ..metrics.journal import active_journal, current_trace, journal_event
+from .buffer import StorageTier
+
+
+def _tier_name(tier) -> Optional[str]:
+    if tier is None:
+        return None
+    return tier.name if isinstance(tier, StorageTier) else str(tier)
+
+
+class _Reservation:
+    """One in-flight reserve() attempt: the causal anchor spill records
+    point at.  `rid` is unique per ledger; `victims` accumulates the
+    buffer ids evicted while this reservation is innermost; `mark` slices
+    per-round victims for repeated on_alloc_failure rounds."""
+
+    __slots__ = ("rid", "site", "nbytes", "victims", "mark", "emitted")
+
+    def __init__(self, rid: int, site: str, nbytes: int):
+        self.rid = rid
+        self.site = site
+        self.nbytes = nbytes
+        self.victims: List[int] = []
+        self.mark = 0
+        self.emitted = False
+
+
+class MemoryLedger:
+    """Per-runtime allocation ledger (one per TpuRuntime/process)."""
+
+    def __init__(self, enabled: bool = True, debug: bool = False,
+                 sample_interval_ms: int = 100, metrics=None,
+                 pools: Optional[Callable[[], dict]] = None):
+        self.enabled = enabled
+        self.debug = debug          # journal EVERY reserve, not just OOMs
+        self.metrics = metrics
+        self.pools = pools          # () -> {limit, device, host, disk}
+        self._sample_interval_ns = max(0, int(sample_interval_ms)) * 1_000_000
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_sample_ns = 0
+        self._tls = threading.local()
+        # per-buffer device-spill count for live churn detection: a buffer
+        # spilled AGAIN after having been brought back is thrash
+        # (numBufferRespills); entries die with the buffer (on_free)
+        self._spill_counts: Dict[int, int] = {}
+
+    # -- internals -----------------------------------------------------------
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def _stack(self) -> List[_Reservation]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current_reservation(self) -> Optional[_Reservation]:
+        st = getattr(self._tls, "stack", None)
+        return st[-1] if st else None
+
+    def _trace_attrs(self) -> dict:
+        ctx = current_trace()
+        if not ctx:
+            return {}
+        q, stg, _sp, ex = (tuple(ctx) + (None,) * 4)[:4]
+        out = {}
+        if q is not None:
+            out["q"] = q
+        if stg is not None:
+            out["st"] = stg
+        if ex is not None:
+            out["ex"] = ex
+        return out
+
+    def _emit(self, name: str, _force_sample: bool = False,
+              **attrs) -> None:
+        """One ledger record into the active journal, trace-stamped.
+        `_force_sample` bypasses the sampler's rate limit (OOM events) —
+        folded in here so an event takes AT MOST one pressure sample.
+        With no journal active the record has nowhere to land: skip
+        entirely, so memLedgerEvents counts exactly the records a
+        `--memory` replay will find (and the pools() sampling cost is
+        never paid on journal-less sessions)."""
+        if active_journal() is None:
+            return
+        attrs.update(self._trace_attrs())
+        journal_event("mem", name, **attrs)
+        if self.metrics is not None:
+            self.metrics.add(MN.MEM_LEDGER_EVENTS, 1)
+        self._maybe_sample(force=_force_sample)
+
+    def _maybe_sample(self, force: bool = False) -> None:
+        """Rate-limited per-tier pressure sample (the memory lane)."""
+        if self.pools is None:
+            return
+        now = time.monotonic_ns()
+        with self._lock:
+            if not force and self._sample_interval_ns \
+                    and now - self._last_sample_ns < self._sample_interval_ns:
+                return
+            self._last_sample_ns = now  # forced samples reset the window
+        try:
+            p = self.pools()
+        except Exception:  # noqa: BLE001 — sampling must never raise
+            return
+        journal_event("mem", "pressure", **p, **self._trace_attrs())
+        if self.metrics is not None:
+            self.metrics.add(MN.MEM_LEDGER_EVENTS, 1)
+
+    # -- reservation scope (reserve() wraps its attempt loop in this) --------
+
+    @contextlib.contextmanager
+    def reservation(self, site: str, nbytes: int):
+        """Install a reservation as the causal anchor for any spill the
+        enclosed allocation attempt forces.  Nested reservations (a spill
+        cascade re-entering reserve via checkpoint re-promotion) stack;
+        spill records attach to the innermost one."""
+        if not self.enabled:
+            yield None
+            return
+        res = _Reservation(self._next_seq(), site, nbytes)
+        if self.debug:
+            self._emit("reserve", rid=res.rid, site=site, bytes=nbytes)
+            res.emitted = True
+        stack = self._stack()
+        stack.append(res)
+        try:
+            yield res
+        finally:
+            stack.pop()
+
+    def _ensure_reservation_emitted(self, res: _Reservation) -> None:
+        """Lazy reserve record: below DEBUG the reservation is journaled
+        the moment it first causes pressure, so every oomSpill's `cause`
+        id resolves to a record in the same journal."""
+        if not res.emitted:
+            res.emitted = True
+            self._emit("reserve", rid=res.rid, site=res.site,
+                       bytes=res.nbytes, pressured=True)
+
+    # -- event hooks ---------------------------------------------------------
+
+    def on_alloc(self, buffer_id: int, nbytes: int,
+                 site: Optional[str] = None) -> None:
+        """A batch was registered in the device store.  `site` is the
+        registration path ("add_batch", "checkpoint"); the reservation
+        that admitted the bytes has already closed by the time the store
+        registers them, so callers pass it explicitly and the enclosing
+        reservation (if any) is only the fallback."""
+        if not self.enabled:
+            return
+        if site is None:
+            res = self.current_reservation()
+            site = res.site if res is not None else None
+        self._emit("alloc", buffer=buffer_id, bytes=nbytes, site=site)
+
+    def on_free(self, buffer_id: int, nbytes: int, tier) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._spill_counts.pop(buffer_id, None)
+        self._emit("free", buffer=buffer_id, bytes=nbytes,
+                   tier=_tier_name(tier))
+
+    def on_spill(self, buffer_id: int, nbytes: int, src, dst) -> None:
+        """One buffer migrated DOWN a tier (stores._spill_one).  Links to
+        the innermost in-flight reservation (the cause) and detects
+        live churn: a device buffer spilled again after an unspill."""
+        if not self.enabled:
+            return
+        respill = False
+        if src == StorageTier.DEVICE:
+            with self._lock:
+                n = self._spill_counts.get(buffer_id, 0) + 1
+                self._spill_counts[buffer_id] = n
+                respill = n > 1
+            if respill and self.metrics is not None:
+                self.metrics.add(MN.NUM_BUFFER_RESPILLS, 1)
+        res = self.current_reservation()
+        attrs = dict(buffer=buffer_id, bytes=nbytes,
+                     src=_tier_name(src), dst=_tier_name(dst))
+        if respill:
+            attrs["respill"] = True
+        if res is not None:
+            self._ensure_reservation_emitted(res)
+            if src == StorageTier.DEVICE:
+                # only DEVICE evictions are this round's victims; a host
+                # tier overflowing to disk under the same reservation is
+                # a downstream leg of the cascade (linked by `cause`),
+                # not a victim synchronous_spill chose
+                res.victims.append(buffer_id)
+            attrs["cause"] = res.rid
+            attrs["cause_site"] = res.site
+        self._emit("spill", **attrs)
+
+    def on_unspill(self, buffer_id: int, nbytes: int, src,
+                   promote: bool = False) -> None:
+        """A buffer came back to the device tier — a real read-back
+        (`_materialize`) or an accounting re-promotion of a checkpoint
+        the caller still held (`promote=True`).  Either way the earlier
+        spill of these bytes bought nothing: victim-quality analysis
+        counts re-touches (metrics/memledger.py)."""
+        if not self.enabled:
+            return
+        attrs = dict(buffer=buffer_id, bytes=nbytes, src=_tier_name(src))
+        if promote:
+            attrs["promote"] = True
+        self._emit("unspill", **attrs)
+
+    def on_oom_spill(self, alloc_size: int, spilled: int, store_size: int,
+                     limit: Optional[int] = None) -> dict:
+        """One on_alloc_failure round finished its synchronous spill.
+        Returns the attrs journaled (site, cause rid, per-round victim
+        ids) so the event handler can reuse them."""
+        res = self.current_reservation() if self.enabled else None
+        attrs = dict(alloc_size=alloc_size, spilled_bytes=spilled,
+                     store_size=store_size)
+        if limit is not None:
+            attrs["limit"] = limit
+        if res is not None:
+            self._ensure_reservation_emitted(res)
+            victims = res.victims[res.mark:]
+            res.mark = len(res.victims)
+            attrs.update(site=res.site, cause=res.rid, victims=victims)
+        if self.enabled:
+            self._emit("oomSpill", _force_sample=True, **attrs)
+        return attrs
+
+    def on_oom_fail(self, site: str, nbytes: int, used: int,
+                    limit: int) -> None:
+        """reserve() is about to raise RetryOOM: the pool could not be
+        brought under budget.  `used + nbytes - limit` is the headroom
+        this failure needed — what the offline analyzer's headroom
+        estimate folds over."""
+        if not self.enabled:
+            return
+        res = self.current_reservation()
+        attrs = dict(site=site, bytes=nbytes, used=used, limit=limit,
+                     shortfall=max(0, used + nbytes - limit))
+        if res is not None:
+            self._ensure_reservation_emitted(res)
+            attrs["cause"] = res.rid
+        self._emit("oomFail", _force_sample=True, **attrs)

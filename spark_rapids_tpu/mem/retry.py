@@ -103,7 +103,8 @@ class SpillableCheckpoint:
     def __init__(self, runtime, batch):
         self._rt = runtime
         self._batch = batch
-        self._buf = runtime.device_store.add_batch(batch)
+        self._buf = runtime.device_store.add_batch(batch,
+                                                   site="checkpoint")
 
     def acquire(self):
         from .buffer import StorageTier
@@ -113,6 +114,7 @@ class SpillableCheckpoint:
                 if buf.tier != StorageTier.DEVICE:
                     # spilled between attempts: re-admit the bytes (may
                     # spill others or raise RetryOOM into the retry loop)
+                    from_tier = buf.tier
                     self._rt.reserve(buf.size_bytes, site="checkpoint")
                     for store in (self._rt.host_store, self._rt.disk_store):
                         store.untrack(buf)
@@ -121,6 +123,12 @@ class SpillableCheckpoint:
                     buf.host_leaves = None
                     buf.device_batch = self._batch
                     self._rt.device_store.track(buf)
+                    # ledger: an accounting re-promotion is still a
+                    # re-touch of spilled bytes — the victim-quality
+                    # analysis counts it (promote=True marks that no
+                    # disk/host read-back happened)
+                    self._rt.ledger.on_unspill(buf.id, buf.size_bytes,
+                                               from_tier, promote=True)
         finally:
             self._rt.catalog.release(buf)
         return self._batch

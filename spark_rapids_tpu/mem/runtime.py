@@ -21,8 +21,7 @@ from typing import Optional
 
 from ..columnar import ColumnarBatch
 from ..config import (CONCURRENT_TPU_TASKS, HOST_SPILL_STORAGE_SIZE,
-                      TPU_ALLOC_FRACTION, TPU_DEBUG, TPU_OOM_SPILL_ENABLED,
-                      TpuConf)
+                      TPU_DEBUG, TPU_OOM_SPILL_ENABLED, TpuConf)
 from ..metrics import names as MN
 from ..metrics.journal import journal_event
 from ..utils import faults
@@ -48,6 +47,20 @@ def _detect_hbm_bytes() -> int:
     return 16 << 30  # v5e-class default when stats are unavailable
 
 
+def configured_pool_bytes(conf) -> int:
+    """Session-level accounted pool budget: the absolute
+    spark.rapids.memory.tpu.poolSizeBytes when set (> 0), else
+    allocFraction of detected HBM.  The ONE rule every construction site
+    derives from — the engine's cluster-mode halving and TpuCluster's
+    per-executor split divide THIS figure, so an explicit byte budget
+    stays authoritative in multi-executor deployments too."""
+    from ..config import TPU_ALLOC_FRACTION, TPU_POOL_SIZE
+    explicit = int(conf.get(TPU_POOL_SIZE))
+    if explicit > 0:
+        return explicit
+    return int(_detect_hbm_bytes() * float(conf.get(TPU_ALLOC_FRACTION)))
+
+
 class DeviceMemoryEventHandler:
     """OOM->spill hook (DeviceMemoryEventHandler.scala:38-90).
 
@@ -57,15 +70,21 @@ class DeviceMemoryEventHandler:
     from `pool_stats()`."""
 
     def __init__(self, device_store: DeviceMemoryStore, debug: str = "NONE",
-                 metrics=None):
+                 metrics=None, ledger=None):
         self.device_store = device_store
         self.debug = debug
         self.metrics = metrics
+        self.ledger = ledger
         self.retry_count = 0
 
-    def on_alloc_failure(self, alloc_size: int) -> bool:
+    def on_alloc_failure(self, alloc_size: int,
+                         site: Optional[str] = None,
+                         limit: Optional[int] = None) -> bool:
         """Spill the device store down by `alloc_size`; True = retry the
-        allocation."""
+        allocation.  `site` is the reservation label reserve() already
+        knows — journaled so OOM-driven spills are site-attributable —
+        and the ledger adds the causal reservation id + the exact victim
+        buffer ids this round's synchronous_spill evicted."""
         store_size = self.device_store.current_size
         target = max(0, store_size - alloc_size)
         spilled = self.device_store.synchronous_spill(target)
@@ -77,8 +96,18 @@ class DeviceMemoryEventHandler:
         if self.metrics is not None:
             self.metrics.add(MN.OOM_SPILL_RETRIES, 1)
             self.metrics.add(MN.OOM_SPILL_BYTES, spilled)
+        extra = {}
+        if self.ledger is not None:
+            # the ledger record carries the causal chain (reservation id
+            # + victim buffer ids); the legacy spill record mirrors the
+            # site/victims so both views of the event agree
+            extra = self.ledger.on_oom_spill(alloc_size, spilled,
+                                             store_size, limit=limit)
         journal_event("spill", "oomSpill", alloc_size=alloc_size,
-                      spilled_bytes=spilled, store_size=store_size)
+                      spilled_bytes=spilled, store_size=store_size,
+                      site=site if site is not None else extra.get("site"),
+                      **{k: v for k, v in extra.items()
+                         if k in ("cause", "victims")})
         return spilled > 0
 
 
@@ -90,9 +119,8 @@ class TpuRuntime:
                  spill_dir: Optional[str] = None):
         self.conf = conf or TpuConf()
         faults.INJECTOR.configure_from_conf(self.conf)
-        frac = float(self.conf.get(TPU_ALLOC_FRACTION))
         self.pool_limit = (pool_limit_bytes if pool_limit_bytes is not None
-                           else int(_detect_hbm_bytes() * frac))
+                           else configured_pool_bytes(self.conf))
         from ..exec.base import Metrics
         self.metrics = Metrics()
         self.catalog = BufferCatalog()
@@ -119,9 +147,21 @@ class TpuRuntime:
         self.disk_store = DiskStore(self.catalog, spill_dir)
         self.device_store.spill_store = self.host_store
         self.host_store.spill_store = self.disk_store
+        # memory-pressure ledger (mem/ledger.py): the catalog carries it
+        # (like integrity/compression) so the stores' spill path can
+        # append causally-linked records without plumbing
+        from ..config import (MEM_LEDGER_ENABLED, MEM_LEDGER_SAMPLE_MS,
+                              METRICS_LEVEL)
+        from .ledger import MemoryLedger
+        self.ledger = MemoryLedger(
+            enabled=bool(self.conf.get(MEM_LEDGER_ENABLED)),
+            debug=str(self.conf.get(METRICS_LEVEL)).upper() == "DEBUG",
+            sample_interval_ms=int(self.conf.get(MEM_LEDGER_SAMPLE_MS)),
+            metrics=self.metrics, pools=self._pressure_sample)
+        self.catalog.ledger = self.ledger
         self.event_handler = DeviceMemoryEventHandler(
             self.device_store, str(self.conf.get(TPU_DEBUG)).upper(),
-            self.metrics)
+            self.metrics, ledger=self.ledger)
         self.oom_spill = bool(self.conf.get(TPU_OOM_SPILL_ENABLED))
         self.semaphore = TpuSemaphore(
             int(self.conf.get(CONCURRENT_TPU_TASKS)), metrics=self.metrics)
@@ -139,20 +179,24 @@ class TpuRuntime:
         fault injector and test observability."""
         faults.INJECTOR.on_reserve(site, nbytes)
         self.event_handler.retry_count = 0  # fresh allocation attempt
-        for _ in range(8):  # bounded retry loop
+        with self.ledger.reservation(site, nbytes):
+            for _ in range(8):  # bounded retry loop
+                used = self.device_store.current_size
+                if used + nbytes <= self.pool_limit:
+                    return
+                if not (self.oom_spill
+                        and self.event_handler.on_alloc_failure(
+                            nbytes, site=site, limit=self.pool_limit)):
+                    break
             used = self.device_store.current_size
-            if used + nbytes <= self.pool_limit:
-                return
-            if not (self.oom_spill
-                    and self.event_handler.on_alloc_failure(nbytes)):
-                break
-        used = self.device_store.current_size
-        if used + nbytes > self.pool_limit:
-            self.metrics.add(MN.OOM_ALLOC_FAILURES, 1)
-            raise RetryOOM(
-                f"HBM pool exhausted at {site}: need {nbytes}B, used "
-                f"{used}B of {self.pool_limit}B and nothing left to spill",
-                nbytes=nbytes)
+            if used + nbytes > self.pool_limit:
+                self.metrics.add(MN.OOM_ALLOC_FAILURES, 1)
+                self.ledger.on_oom_fail(site, nbytes, used,
+                                        self.pool_limit)
+                raise RetryOOM(
+                    f"HBM pool exhausted at {site}: need {nbytes}B, used "
+                    f"{used}B of {self.pool_limit}B and nothing left to "
+                    f"spill", nbytes=nbytes)
 
     # ---- spillable batch registry ------------------------------------------
 
@@ -175,7 +219,8 @@ class TpuRuntime:
         """Register a device batch as spillable; returns its buffer id."""
         nbytes = batch.device_size_bytes()
         self.reserve(nbytes, site="add_batch")
-        bid = self.device_store.add_batch(batch, spill_priority).id
+        bid = self.device_store.add_batch(batch, spill_priority,
+                                          site="add_batch").id
         if self._debug_on:
             self._debug_log(f"alloc id={bid} {nbytes}B "
                             f"pool={self.device_store.current_size}B")
@@ -211,6 +256,7 @@ class TpuRuntime:
                     self.disk_store
                 verify_buffer_leaves(self.catalog, buf, leaves,
                                      site="unspill_disk")
+            from_tier = buf.tier
             self.reserve(buf.size_bytes, site="materialize")
             batch = host_to_batch(leaves, buf.meta)
             src.untrack(buf)
@@ -220,6 +266,7 @@ class TpuRuntime:
             buf.host_checksums = None  # stale once the device copy is live
             buf.device_batch = batch
             self.device_store.track(buf)
+            self.ledger.on_unspill(buf.id, buf.size_bytes, from_tier)
             return batch
 
     def free_batch(self, buffer_id: int) -> None:
@@ -229,6 +276,7 @@ class TpuRuntime:
                 self._debug_log(f"free id={buffer_id} DOUBLE-FREE "
                                 "(already removed)")
             return
+        self.ledger.on_free(buf.id, buf.size_bytes, buf.tier)
         for store in (self.device_store, self.host_store, self.disk_store):
             store.untrack(buf)
         if buf.disk_path:
@@ -252,12 +300,34 @@ class TpuRuntime:
 
     # ---- stats -------------------------------------------------------------
 
+    def _pressure_sample(self) -> dict:
+        """Per-tier snapshot the ledger samples into `pressure` records
+        (the memory lane): cheap — four lock-guarded int reads."""
+        return {
+            "limit": self.pool_limit,
+            "device": self.device_store.current_size,
+            "host": self.host_store.current_size,
+            "disk": self.disk_store.current_size,
+        }
+
     def pool_stats(self) -> dict:
         stats = {
             "pool_limit": self.pool_limit,
             "device_used": self.device_store.current_size,
             "host_used": self.host_store.current_size,
             "disk_used": self.disk_store.current_size,
+            # per-tier high-water marks (reset-aware via reset_peaks):
+            # what the heartbeat monitor rolls up into cluster peak memory
+            "device_peak": self.device_store.peak_size,
+            "host_peak": self.host_store.peak_size,
+            "disk_peak": self.disk_store.peak_size,
         }
         stats.update(self.metrics.values)
         return stats
+
+    def reset_peaks(self) -> None:
+        """Rebase every store's high-water mark to its CURRENT usage —
+        per-interval peak tracking (a monitoring scrape that wants
+        peak-since-last-scrape resets after reading pool_stats())."""
+        for store in (self.device_store, self.host_store, self.disk_store):
+            store.reset_peak()

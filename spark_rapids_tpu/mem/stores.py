@@ -173,6 +173,7 @@ class BufferStore:
         self._queue: HashedPriorityQueue[int] = HashedPriorityQueue(
             self._priority_of)
         self._size = 0
+        self._peak = 0
         self._lock = threading.RLock()
 
     def _priority_of(self, buffer_id: int) -> float:
@@ -184,11 +185,26 @@ class BufferStore:
         with self._lock:
             return self._size
 
+    @property
+    def peak_size(self) -> int:
+        """High-water mark of tracked bytes since construction (or the
+        last reset_peak) — pool_stats() device_peak/host_peak/disk_peak."""
+        with self._lock:
+            return self._peak
+
+    def reset_peak(self) -> None:
+        """Rebase the high-water mark to current usage (reset-aware
+        peak tracking for interval scrapes)."""
+        with self._lock:
+            self._peak = self._size
+
     def track(self, buf: SpillableBuffer) -> None:
         with self._lock:
             self._buffers[buf.id] = buf
             self._queue.offer(buf.id)
             self._size += buf.size_bytes
+            if self._size > self._peak:
+                self._peak = self._size
             buf.tier = self.tier
 
     def untrack(self, buf: SpillableBuffer) -> None:
@@ -260,6 +276,15 @@ class BufferStore:
             f"{type(self).__name__} has no spill target"
         self._release_payload_to(buf, self.spill_store)
         self.spill_store.track(buf)
+        ledger = getattr(self.catalog, "ledger", None)
+        if ledger is not None:
+            # causal spill record: the ledger links this eviction to the
+            # reservation that forced it (mem/ledger.py) and detects
+            # spill churn (the same buffer spilled again after coming
+            # back).  Emitted AFTER the migration so the record only
+            # ever describes a spill that actually happened.
+            ledger.on_spill(buf.id, buf.size_bytes, self.tier,
+                            self.spill_store.tier)
 
     def _release_payload_to(self, buf: SpillableBuffer,
                             dest: "BufferStore") -> None:
@@ -273,7 +298,8 @@ class DeviceMemoryStore(BufferStore):
 
     def add_batch(self, batch: ColumnarBatch,
                   spill_priority: float = SpillPriorities.DEFAULT_PRIORITY,
-                  buffer_id: Optional[int] = None) -> SpillableBuffer:
+                  buffer_id: Optional[int] = None,
+                  site: Optional[str] = None) -> SpillableBuffer:
         leaves_size = batch.device_size_bytes()
         bid = buffer_id if buffer_id is not None else fresh_buffer_id()
         meta = BatchMeta(batch.schema, batch.capacity, [], (batch.capacity,),
@@ -282,6 +308,12 @@ class DeviceMemoryStore(BufferStore):
         buf.device_batch = batch
         self.track(buf)
         self.catalog.register(buf)
+        ledger = getattr(self.catalog, "ledger", None)
+        if ledger is not None:
+            # `site` labels the registration path (runtime.add_batch vs
+            # a retry-block checkpoint) — the admitting reserve() has
+            # already returned, so the label must ride in explicitly
+            ledger.on_alloc(bid, leaves_size, site=site)
         return buf
 
     def _release_payload_to(self, buf: SpillableBuffer,
@@ -399,6 +431,9 @@ class BufferCatalog:
     # spill-path CompressionPolicy (compress/), installed by TpuRuntime;
     # None = uncompressed spill files (bare-store unit tests)
     compression = None
+    # memory-pressure ledger (mem/ledger.py), installed by TpuRuntime;
+    # None = no allocation/spill event stream (bare-store unit tests)
+    ledger = None
 
     def __init__(self):
         self._buffers: Dict[int, SpillableBuffer] = {}

@@ -178,6 +178,14 @@ def session_observability(session) -> dict:
         out["device_used"] = int(pool.get("device_used", 0))
         out["host_spill_used"] = int(pool.get("host_used", 0))
         out["disk_spill_used"] = int(pool.get("disk_used", 0))
+        # memory ledger (ISSUE 8): store high-waters + churn signal, so
+        # a bench row carries the peak footprint that produced it
+        out["device_peak"] = int(pool.get("device_peak", 0))
+        out["host_spill_peak"] = int(pool.get("host_peak", 0))
+        out["disk_spill_peak"] = int(pool.get("disk_peak", 0))
+        out["numBufferRespills"] = int(
+            pool.get(N.NUM_BUFFER_RESPILLS, 0))
+        out["memLedgerEvents"] = int(pool.get(N.MEM_LEDGER_EVENTS, 0))
     cluster = getattr(session, "_cluster", None) or None
     wire_sent = wire_recv = 0
     if cluster:

@@ -56,7 +56,13 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # REQUESTER's trace context (o_q/o_st/o_sp/o_ex) so the
                # merged timeline flow-links it to the reducer's fetch
                # span, heartbeat = a live progress snapshot
-               "task", "serve", "heartbeat")
+               "task", "serve", "heartbeat",
+               # mem = one memory-ledger record (mem/ledger.py): an
+               # allocation-boundary event (reserve/alloc/free/spill/
+               # unspill/oomSpill/oomFail) causally linked by reservation
+               # id, or a sampled per-tier 'pressure' snapshot — the
+               # input of `python -m spark_rapids_tpu.metrics --memory`
+               "mem")
 
 
 class EventJournal:

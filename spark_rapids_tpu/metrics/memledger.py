@@ -1,0 +1,348 @@
+"""Offline memory-ledger analysis: reconstruct the memory-pressure story
+of a run from journal shards alone.
+
+Input: the shard dicts `load_journal_dir` (metrics/timeline.py) returns —
+worker trace shards and/or driver query journals — whose `mem`-kind
+records the runtime's MemoryLedger wrote (mem/ledger.py).  No live
+cluster, no pickles: JSON lines in, analysis out, which is what makes
+`python -m spark_rapids_tpu.metrics --memory <journal-dir>` usable on a
+journal directory scraped off a dead cluster.
+
+What the replay computes (the acceptance surface of the ROADMAP-4
+data-movement-scheduler PR — its victim-selection policy is judged
+against these numbers):
+
+  * peak attribution — replay alloc/spill/unspill/free per executor,
+    tracking live device bytes per trace query and per allocation site;
+    report each query's peak concurrent device footprint and where the
+    bytes came from;
+  * spill cascades — every `oomSpill` record names its triggering
+    reservation (`cause` id + site) and the exact victim buffer ids;
+    downstream migrations (host tier overflowing to disk under the same
+    reservation) chain by the shared cause id;
+  * churn — a buffer spilled again after an earlier spill+unspill round
+    trip bought nothing with its first eviction; `churn_ratio` is the
+    fraction of spilled bytes that were re-spills;
+  * victim quality — bytes spilled that were re-touched (unspilled or
+    checkpoint-promoted) within `retouch_window` subsequent ledger
+    events: evicting them was the wrong call;
+  * headroom — the largest shortfall any OOM event observed
+    (`store_size + alloc_size - limit`): "this run would not have
+    spilled with X more bytes of pool".
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+#: how many subsequent ledger events an unspill may trail its spill by
+#: and still count as "the victim was re-touched" (victim quality)
+DEFAULT_RETOUCH_WINDOW = 64
+
+
+def mem_events(events: List[dict]) -> List[dict]:
+    """The `mem`-kind instant records of one shard, in journal order."""
+    return [e for e in events
+            if e.get("kind") == "mem" and e.get("ev") == "I"]
+
+
+def analyze_shards(shards: List[dict],
+                   retouch_window: int = DEFAULT_RETOUCH_WINDOW) -> dict:
+    """Full memory analysis over drained/loaded shard dicts
+    (`{"label"/"executor", "events"}` — the load_journal_dir /
+    drain_journals shape)."""
+    per_exec: Dict[str, dict] = {}
+    cascades: List[dict] = []
+    churn_buffers: List[dict] = []
+    tot = {"events": 0, "allocs": 0, "frees": 0, "spills": 0,
+           "unspills": 0, "oom_spills": 0, "oom_fails": 0,
+           "spilled_bytes": 0, "device_spilled_bytes": 0,
+           "respill_bytes": 0, "unspilled_bytes": 0}
+    vq = {"window": int(retouch_window), "spills": 0, "retouched": 0,
+          "spilled_bytes": 0, "retouched_bytes": 0}
+    peak_by_query: Dict[str, int] = {}
+    alloc_by_site: Dict[str, int] = {}
+    oom_by_site: Dict[str, dict] = {}
+    headroom = 0
+    headroom_by_query: Dict[str, int] = {}
+
+    for shard in shards:
+        executor = shard.get("label") or shard.get("executor") or "?"
+        ev = mem_events(shard.get("events") or [])
+        if not ev:
+            continue
+        # -- replay state per executor process --------------------------------
+        live: Dict[int, dict] = {}       # bid -> {bytes, query, tier}
+        cur_by_query: Dict[str, int] = {}
+        exec_peak_q: Dict[str, int] = {}
+        device_cur = 0
+        device_peak = 0
+        # bid -> [(event idx, bytes)] per device spill: sizes differ
+        # between spills of ONE buffer (meta rebases to host-leaf bytes
+        # after the first spill), so each spill keeps its own size
+        spills_of: Dict[int, List[tuple]] = {}
+        unspills_of: Dict[int, List[int]] = {}
+        pressure = {"samples": 0, "max_device": 0, "max_host": 0,
+                    "max_disk": 0, "limit": None}
+        open_cascades: Dict[int, dict] = {}    # cause rid -> chain record
+        # downstream (host->disk) legs keyed by cause, collected
+        # independently of chain creation: the victims' spill records are
+        # journaled BEFORE the oomSpill record that opens the chain
+        # (synchronous_spill runs first), so order cannot be relied on
+        downstream_by_cause: Dict[int, List[dict]] = {}
+
+        def _q(e) -> str:
+            return str(e.get("q")) if e.get("q") is not None else "?"
+
+        def _dev_delta(bid: int, delta: int, query: Optional[str]) -> None:
+            nonlocal device_cur, device_peak
+            device_cur = max(0, device_cur + delta)
+            if device_cur > device_peak:
+                device_peak = device_cur
+            if query is not None:
+                cur = max(0, cur_by_query.get(query, 0) + delta)
+                cur_by_query[query] = cur
+                if cur > exec_peak_q.get(query, 0):
+                    exec_peak_q[query] = cur
+
+        for i, e in enumerate(ev):
+            tot["events"] += 1
+            name = e.get("name")
+            bid = e.get("buffer")
+            nbytes = int(e.get("bytes") or 0)
+            if name == "alloc":
+                tot["allocs"] += 1
+                q = _q(e)
+                live[bid] = {"bytes": nbytes, "query": q, "tier": "DEVICE"}
+                site = e.get("site")
+                if site:
+                    alloc_by_site[site] = \
+                        alloc_by_site.get(site, 0) + nbytes
+                _dev_delta(bid, nbytes, q)
+            elif name == "free":
+                tot["frees"] += 1
+                rec = live.pop(bid, None)
+                if rec is not None and rec["tier"] == "DEVICE":
+                    _dev_delta(bid, -rec["bytes"], rec["query"])
+            elif name == "spill":
+                tot["spills"] += 1
+                tot["spilled_bytes"] += nbytes
+                rec = live.get(bid)
+                if e.get("src") == "DEVICE":
+                    if rec is not None and rec["tier"] == "DEVICE":
+                        _dev_delta(bid, -rec["bytes"], rec["query"])
+                    tot["device_spilled_bytes"] += nbytes
+                    prior = spills_of.setdefault(bid, [])
+                    if prior:  # spilled again after an earlier spill
+                        tot["respill_bytes"] += nbytes
+                    prior.append((i, nbytes))
+                    vq["spills"] += 1
+                    vq["spilled_bytes"] += nbytes
+                if rec is not None:
+                    rec["tier"] = e.get("dst") or "?"
+                cause = e.get("cause")
+                if cause is not None and e.get("src") != "DEVICE":
+                    # host tier overflowing to disk under the same
+                    # reservation: the cascade's downstream leg
+                    downstream_by_cause.setdefault(cause, []).append(
+                        {"buffer": bid, "bytes": nbytes,
+                         "src": e.get("src"), "dst": e.get("dst")})
+            elif name == "unspill":
+                tot["unspills"] += 1
+                tot["unspilled_bytes"] += nbytes
+                rec = live.get(bid)
+                q = rec["query"] if rec is not None else _q(e)
+                if rec is None:
+                    # buffer allocated before this journal opened (the
+                    # runtime outlives per-query journals): register it
+                    # now, so the later spill/free can subtract these
+                    # bytes back out instead of inflating peaks forever
+                    live[bid] = {"bytes": nbytes, "query": q,
+                                 "tier": "DEVICE"}
+                else:
+                    rec["tier"] = "DEVICE"
+                    # rebase to THIS record's size: spilling rebased the
+                    # buffer's meta to host-leaf bytes, so device size
+                    # and host-leaf size legitimately differ — the next
+                    # spill/free must subtract what this unspill added
+                    rec["bytes"] = nbytes
+                _dev_delta(bid, nbytes, q)
+                unspills_of.setdefault(bid, []).append(i)
+            elif name == "oomSpill":
+                tot["oom_spills"] += 1
+                rid = e.get("cause")
+                site = e.get("site") or "?"
+                st = oom_by_site.setdefault(
+                    site, {"oom_spills": 0, "spilled_bytes": 0})
+                st["oom_spills"] += 1
+                st["spilled_bytes"] += int(e.get("spilled_bytes") or 0)
+                limit = e.get("limit")
+                if limit is not None:
+                    short = (int(e.get("store_size") or 0)
+                             + int(e.get("alloc_size") or 0) - int(limit))
+                    if short > 0:
+                        headroom = max(headroom, short)
+                        q = _q(e)
+                        headroom_by_query[q] = max(
+                            headroom_by_query.get(q, 0), short)
+                if rid is None:
+                    continue
+                chain = open_cascades.get(rid)
+                if chain is None:
+                    chain = open_cascades[rid] = {
+                        "executor": executor, "cause": rid, "site": site,
+                        "query": _q(e), "rounds": 0, "alloc_size": 0,
+                        "spilled_bytes": 0, "victims": [],
+                        "downstream": []}
+                    cascades.append(chain)
+                chain["rounds"] += 1
+                chain["alloc_size"] = int(e.get("alloc_size") or 0)
+                chain["spilled_bytes"] += int(e.get("spilled_bytes") or 0)
+                chain["victims"].extend(e.get("victims") or [])
+            elif name == "oomFail":
+                tot["oom_fails"] += 1
+                short = int(e.get("shortfall") or 0)
+                if short > 0:
+                    headroom = max(headroom, short)
+                    q = _q(e)
+                    headroom_by_query[q] = max(
+                        headroom_by_query.get(q, 0), short)
+            elif name == "pressure":
+                pressure["samples"] += 1
+                pressure["max_device"] = max(pressure["max_device"],
+                                             int(e.get("device") or 0))
+                pressure["max_host"] = max(pressure["max_host"],
+                                           int(e.get("host") or 0))
+                pressure["max_disk"] = max(pressure["max_disk"],
+                                           int(e.get("disk") or 0))
+                if e.get("limit") is not None:
+                    pressure["limit"] = int(e["limit"])
+
+        # attach downstream legs to their chains now that both sides of
+        # each (spill records first, oomSpill record after) were seen
+        for rid, legs in downstream_by_cause.items():
+            chain = open_cascades.get(rid)
+            if chain is not None:
+                chain["downstream"] = legs
+
+        # victim quality: a spill whose buffer is unspilled within the
+        # retouch window was a bad eviction (weighted by THAT spill's
+        # own size, not the buffer's latest)
+        for bid, spills in spills_of.items():
+            uidxs = unspills_of.get(bid, [])
+            for si, sbytes in spills:
+                if any(si < ui <= si + retouch_window for ui in uidxs):
+                    vq["retouched"] += 1
+                    vq["retouched_bytes"] += sbytes
+        # churn detail: buffers that thrashed (>= 2 device spills)
+        for bid, spills in spills_of.items():
+            if len(spills) >= 2:
+                churn_buffers.append(
+                    {"executor": executor, "buffer": bid,
+                     "spills": len(spills),
+                     "unspills": len(unspills_of.get(bid, [])),
+                     "bytes": sum(b for _i, b in spills)})
+        for q, p in exec_peak_q.items():
+            # per-query peak across executors: the maximum CONCURRENT
+            # footprint any one pool saw (pools are per-process, so the
+            # cluster figure for a query is the max, not the sum)
+            peak_by_query[q] = max(peak_by_query.get(q, 0), p)
+        per_exec[executor] = {
+            "events": len(ev), "device_peak": device_peak,
+            "peak_by_query": exec_peak_q, "pressure": pressure}
+
+    # churn is a DEVICE-eviction quality signal: the denominator is
+    # device spills only, matching victim-quality — counting host->disk
+    # migration legs would deflate the ratio exactly when cascades run
+    # deepest (the tightest budgets), corrupting cross-budget comparison
+    churn_ratio = (tot["respill_bytes"] / tot["device_spilled_bytes"]
+                   if tot["device_spilled_bytes"] else 0.0)
+    quality = (1.0 - vq["retouched_bytes"] / vq["spilled_bytes"]
+               if vq["spilled_bytes"] else 1.0)
+    return {
+        "totals": tot,
+        "executors": per_exec,
+        "peak_by_query": peak_by_query,
+        "alloc_by_site": alloc_by_site,
+        "oom_by_site": oom_by_site,
+        "cascades": cascades,
+        "churn": {"respilled_buffers": churn_buffers,
+                  "spilled_bytes": tot["device_spilled_bytes"],
+                  "respill_bytes": tot["respill_bytes"],
+                  "churn_ratio": round(churn_ratio, 4)},
+        "victim_quality": dict(vq, quality=round(quality, 4)),
+        "headroom": {"bytes": headroom,
+                     "by_query": headroom_by_query},
+    }
+
+
+def _mb(n) -> str:
+    return f"{n / 1e6:.2f}MB" if n >= 1e6 else f"{n / 1e3:.1f}KB"
+
+
+def render(rep: dict) -> str:
+    """Human text report of analyze_shards() (the --memory CLI body)."""
+    t = rep["totals"]
+    lines = ["== memory ledger analysis =="]
+    lines.append(
+        f"  {t['events']} ledger events: {t['allocs']} allocs / "
+        f"{t['frees']} frees / {t['spills']} spills "
+        f"({_mb(t['spilled_bytes'])}) / {t['unspills']} unspills / "
+        f"{t['oom_spills']} oomSpills / {t['oom_fails']} oomFails")
+    for ex, info in sorted(rep["executors"].items()):
+        pr = info["pressure"]
+        lines.append(
+            f"  {ex}: {info['events']} events, device peak "
+            f"{_mb(info['device_peak'])}, {pr['samples']} pressure "
+            f"samples (max device {_mb(pr['max_device'])}, host "
+            f"{_mb(pr['max_host'])}, disk {_mb(pr['max_disk'])}"
+            + (f", limit {_mb(pr['limit'])}" if pr["limit"] else "") + ")")
+    if rep["peak_by_query"]:
+        lines.append("peak device footprint by query:")
+        for q, p in sorted(rep["peak_by_query"].items(),
+                           key=lambda kv: -kv[1]):
+            lines.append(f"    {q}: {_mb(p)}")
+    if rep["alloc_by_site"]:
+        lines.append("allocated bytes by site:")
+        for s, b in sorted(rep["alloc_by_site"].items(),
+                           key=lambda kv: -kv[1]):
+            lines.append(f"    {s}: {_mb(b)}")
+    if rep["oom_by_site"]:
+        lines.append("OOM-driven spills by reservation site:")
+        for s, st in sorted(rep["oom_by_site"].items(),
+                            key=lambda kv: -kv[1]["spilled_bytes"]):
+            lines.append(f"    {s}: {st['oom_spills']} rounds, "
+                         f"{_mb(st['spilled_bytes'])} spilled")
+    if rep["cascades"]:
+        lines.append(f"spill cascades ({len(rep['cascades'])}):")
+        for c in rep["cascades"][:20]:
+            lines.append(
+                f"    [{c['executor']}] reserve #{c['cause']} at "
+                f"{c['site']} (query {c['query']}, "
+                f"{_mb(c['alloc_size'])} ask) -> {c['rounds']} round(s), "
+                f"victims {c['victims']}, {_mb(c['spilled_bytes'])} "
+                f"spilled"
+                + (f", {len(c['downstream'])} downstream host->disk"
+                   if c["downstream"] else ""))
+        if len(rep["cascades"]) > 20:
+            lines.append(f"    ... {len(rep['cascades']) - 20} more")
+    ch = rep["churn"]
+    lines.append(
+        f"churn: {_mb(ch['respill_bytes'])} of {_mb(ch['spilled_bytes'])} "
+        f"device-spilled bytes were RE-spills "
+        f"(ratio {ch['churn_ratio']:.2%}); "
+        f"{len(ch['respilled_buffers'])} thrashing buffer(s)")
+    vq = rep["victim_quality"]
+    lines.append(
+        f"victim quality: {vq['retouched']} of {vq['spills']} spills "
+        f"re-touched within {vq['window']} events "
+        f"({_mb(vq['retouched_bytes'])} of {_mb(vq['spilled_bytes'])}; "
+        f"quality {vq['quality']:.2%})")
+    hr = rep["headroom"]
+    if hr["bytes"] > 0:
+        lines.append(
+            f"headroom: the pool fell {_mb(hr['bytes'])} short at its "
+            f"worst — this run would not have hit that OOM with "
+            f"{_mb(hr['bytes'])} more bytes of budget")
+    else:
+        lines.append("headroom: no OOM event recorded a shortfall")
+    return "\n".join(lines)

@@ -170,6 +170,18 @@ PEAK_DEV_MEMORY = register_metric(
     "peakDevMemory", GAUGE, DEBUG,
     "high-water mark of accounted device-store bytes sampled per batch")
 
+# --- memory ledger (mem/ledger.py + metrics/memledger.py) --------------------
+MEM_LEDGER_EVENTS = register_metric(
+    "memLedgerEvents", COUNTER, MODERATE,
+    "records the memory-pressure ledger journaled (alloc/free/spill/"
+    "unspill/oomSpill/oomFail, journal kind 'mem'); the raw material of "
+    "python -m spark_rapids_tpu.metrics --memory")
+NUM_BUFFER_RESPILLS = register_metric(
+    "numBufferRespills", COUNTER, ESSENTIAL,
+    "device buffers spilled AGAIN after an earlier spill+unspill round "
+    "trip — spill churn (thrash): the victim-selection quality signal "
+    "the data-movement scheduler is judged against")
+
 # --- data integrity (mem/integrity.py + shuffle fetch/spill verify) ---------
 NUM_CHECKSUM_MISMATCHES = register_metric(
     "numChecksumMismatches", COUNTER, ESSENTIAL,
@@ -340,6 +352,11 @@ POOL_GAUGES = {
     "device_used": "bytes currently tracked in the device store",
     "host_used": "bytes currently tracked in the host spill store",
     "disk_used": "bytes currently tracked in the disk spill store",
+    "device_peak": "high-water bytes ever tracked in the device store "
+                   "(reset-aware: TpuRuntime.reset_peaks() rebases to "
+                   "current usage)",
+    "host_peak": "high-water bytes ever tracked in the host spill store",
+    "disk_peak": "high-water bytes ever tracked in the disk spill store",
 }
 
 

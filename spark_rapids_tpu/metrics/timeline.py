@@ -347,6 +347,49 @@ class Timeline:
                       "coverage": (total / wall) if wall > 0 else 1.0}
         return out
 
+    def memory_lane(self) -> Dict[str, List[dict]]:
+        """Per-executor sampled memory-pressure timeline: the ledger's
+        `pressure` records (journal kind `mem`), wall-aligned — the
+        per-worker memory lane the Chrome trace renders as counter
+        tracks (utils/tracing.timeline_to_trace_events)."""
+        out: Dict[str, List[dict]] = {}
+        for i in self.instants:
+            if i["kind"] != "mem" or i["name"] != "pressure":
+                continue
+            out.setdefault(i["executor"], []).append(
+                {"wall_ns": i["wall_ns"],
+                 "device": int(i["attrs"].get("device") or 0),
+                 "host": int(i["attrs"].get("host") or 0),
+                 "disk": int(i["attrs"].get("disk") or 0),
+                 "limit": i["attrs"].get("limit")})
+        for samples in out.values():
+            samples.sort(key=lambda s: s["wall_ns"])
+        return out
+
+    def memory_summary(self) -> Dict[str, dict]:
+        """Per-executor peak of the sampled pressure timeline plus OOM
+        event counts — the report()'s memory section."""
+        out: Dict[str, dict] = {}
+        for ex, samples in self.memory_lane().items():
+            out[ex] = {
+                "samples": len(samples),
+                "max_device": max(s["device"] for s in samples),
+                "max_host": max(s["host"] for s in samples),
+                "max_disk": max(s["disk"] for s in samples),
+                "limit": next((s["limit"] for s in samples
+                               if s["limit"] is not None), None),
+                "oom_spills": 0,
+            }
+        for i in self.instants:
+            if i["kind"] == "mem" and i["name"] == "oomSpill":
+                out.setdefault(i["executor"], {"samples": 0,
+                                               "max_device": 0,
+                                               "max_host": 0, "max_disk": 0,
+                                               "limit": None,
+                                               "oom_spills": 0})
+                out[i["executor"]]["oom_spills"] += 1
+        return out
+
     def stragglers(self, factor: float = 3.0) -> List[dict]:
         """Tasks slower than `factor` x their stage's median duration."""
         by_stage: Dict[Tuple, List[TimelineSpan]] = {}
@@ -398,6 +441,7 @@ class Timeline:
             "executors": per_exec,
             "tasks": self.task_breakdown(),
             "critical_path": self.critical_path(),
+            "memory": self.memory_summary(),
             "stragglers": stragglers,
             "links": len(links),
             "fetch_spans": len(fetches),
@@ -446,6 +490,19 @@ class Timeline:
                     f"{t['compute_s']:.3f} / decompress "
                     f"{t['decompress_s']:.3f} / idle {t['idle_s']:.3f} "
                     f"(overlap {t['overlap_efficiency'] * 100:.0f}%)")
+        if rep["memory"]:
+            lines.append("memory pressure (sampled ledger lane, peak "
+                         "bytes):")
+            for ex, m in sorted(rep["memory"].items()):
+                lines.append(
+                    f"    {ex}: device {m['max_device'] / 1e6:.2f}MB / "
+                    f"host {m['max_host'] / 1e6:.2f}MB / disk "
+                    f"{m['max_disk'] / 1e6:.2f}MB over {m['samples']} "
+                    f"samples"
+                    + (f", limit {m['limit'] / 1e6:.2f}MB"
+                       if m.get("limit") else "")
+                    + (f", {m['oom_spills']} oomSpills"
+                       if m.get("oom_spills") else ""))
         if rep["stragglers"]:
             lines.append(f"stragglers (> {straggler_factor:g}x stage "
                          "median):")

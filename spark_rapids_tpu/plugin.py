@@ -90,11 +90,11 @@ class TpuCluster:
         self.transport.configure(conf)
         # N executors share ONE device WITH the driving session's compute
         # pool (engine.TpuSession.runtime, which halves itself in cluster
-        # mode): the executors split one half of the allocFraction budget,
-        # so session + executors together account for physical HBM once
-        from .mem.runtime import _detect_hbm_bytes
-        total_pool = int(_detect_hbm_bytes()
-                         * float(conf.get(C.TPU_ALLOC_FRACTION))) // 2
+        # mode): the executors split one half of the session budget —
+        # an explicit poolSizeBytes when set, else allocFraction of
+        # detected HBM — so session + executors account for HBM once
+        from .mem.runtime import configured_pool_bytes
+        total_pool = configured_pool_bytes(conf) // 2
         per_executor = max(total_pool // self.n, 1)
         self.executors: List[TpuExecutorPlugin] = [
             TpuExecutorPlugin(f"exec-{i}", conf, self.transport,

@@ -61,6 +61,15 @@ def journal_to_trace_events(events) -> list:
                     "args": {"name": k}})
     for e in events:
         ts_us = e.get("ts", 0) / 1e3  # monotonic ns -> us
+        if e.get("kind") == "mem" and e.get("name") == "pressure":
+            # memory lane: sampled per-tier pool usage renders as a
+            # Chrome COUNTER track (stacked area) instead of an instant
+            out.append({"name": "memory", "ph": "C", "pid": 1,
+                        "ts": ts_us, "cat": "mem",
+                        "args": {"device": e.get("device", 0),
+                                 "host": e.get("host", 0),
+                                 "disk": e.get("disk", 0)}})
+            continue
         rec = {"name": e.get("name", "?"), "pid": 1,
                "tid": tid_of.get(e.get("kind", "?"), 0), "ts": ts_us,
                "cat": e.get("kind", "?")}
@@ -122,6 +131,18 @@ def timeline_to_trace_events(timeline) -> list:
             rec["args"] = dict(sp.attrs)
         out.append(rec)
     for i in timeline.instants:
+        if i["kind"] == "mem" and i["name"] == "pressure":
+            # per-worker memory lane: one counter track per executor pid
+            # so each worker's pool pressure renders as its own stacked
+            # area under its span lanes
+            out.append({"name": "memory", "ph": "C", "cat": "mem",
+                        "pid": pid_of[i["executor"]],
+                        "ts": i["wall_ns"] / 1e3,
+                        "args": {
+                            "device": i["attrs"].get("device", 0),
+                            "host": i["attrs"].get("host", 0),
+                            "disk": i["attrs"].get("disk", 0)}})
+            continue
         rec = {"name": i["name"], "cat": i["kind"], "ph": "i", "s": "t",
                "pid": pid_of[i["executor"]], "tid": tid_of[i["kind"]],
                "ts": i["wall_ns"] / 1e3}
