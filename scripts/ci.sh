@@ -18,9 +18,27 @@ else
         __graft_entry__.py
 fi
 
-echo "== metric-name lint =="
+echo "== tpulint (ISSUE 9: project contract gate) =="
+# AST static analysis over the whole tree — host-sync hazards (TPU001),
+# jit purity (TPU002), conf hygiene (TPU003), metric/journal contracts
+# (TPU004), retry-site sweep coverage (TPU005), exception hygiene
+# (TPU006), lock order (TPU007).  Runs BEFORE the test tiers so a
+# contract break fails in seconds, not after a 30-minute compile-bound
+# suite.  docs/lint.md documents every rule and the suppression/baseline
+# mechanics.
+T_LINT=$SECONDS
+JAX_PLATFORMS=cpu python -m spark_rapids_tpu.lint
+# generated docs must match their registries (the TPU003 doc half)
+JAX_PLATFORMS=cpu python -m spark_rapids_tpu.lint --check-docs
+# fixture tests: every pass proves a true positive + clean negative,
+# suppressions and the baseline silence what they claim to
+python -m pytest tests/test_lint.py -q -m "not slow" -p no:cacheprovider
+echo "== tpulint tier took $((SECONDS - T_LINT))s =="
+
+echo "== metric-name lint (back-compat alias) =="
 # every metrics.add/add_lazy/timer call site must use a name registered in
-# spark_rapids_tpu/metrics/names.py (catches typo'd keys like numOutputRow)
+# spark_rapids_tpu/metrics/names.py (catches typo'd keys like numOutputRow);
+# delegates to tpulint TPU004 — kept as the documented entry point
 JAX_PLATFORMS=cpu python -m spark_rapids_tpu.metrics --lint
 
 echo "== observability tier =="

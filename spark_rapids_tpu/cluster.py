@@ -30,6 +30,7 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 from .config import TpuConf
+from .metrics.registry import count_swallowed
 
 log = logging.getLogger("spark_rapids_tpu.cluster")
 
@@ -133,7 +134,7 @@ class HeartbeatMonitor:
                     try:
                         stale[1].close()
                     except Exception:  # noqa: BLE001 — already broken
-                        pass
+                        pass  # tpulint: disable=TPU006 closing an already-broken heartbeat client; the poll failure itself is logged+counted just below
                 log.debug("heartbeat poll of %s failed: %r",
                           worker.executor_id, e)
                 continue
@@ -284,7 +285,7 @@ class HeartbeatMonitor:
             try:
                 client.close()
             except Exception:  # noqa: BLE001 — teardown best-effort
-                pass
+                pass  # tpulint: disable=TPU006 driver shutdown close of a possibly-dead control client; nothing actionable remains
 
 # the control RPC flattens worker-side exceptions to strings; FetchFailed's
 # repr deliberately carries this machine-parseable peer marker so the
@@ -363,7 +364,14 @@ class WorkerProc:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
-                continue  # library banner noise
+                # library banner noise is normal; a FLOOD of it means the
+                # worker is dying before it ever announces — keep each
+                # skipped line visible at debug and counted
+                count_swallowed("numWorkerStdoutNoise",
+                                "spark_rapids_tpu.cluster",
+                                "worker %s stdout noise before ready: %r",
+                                executor_id, line[:200])
+                continue
             if rec.get("ready"):
                 self.address = (rec["host"], rec["port"])
         self.client = None  # set by ProcCluster (needs its transport)
@@ -375,11 +383,11 @@ class WorkerProc:
         try:
             self.rpc("shutdown")
         except Exception:  # noqa: BLE001 — already dead is fine
-            pass
+            pass  # tpulint: disable=TPU006 shutdown RPC to a worker that may already have exited; both outcomes are the goal state
         try:
             self.proc.stdin.close()  # workers also exit on stdin EOF
         except OSError:
-            pass
+            pass  # tpulint: disable=TPU006 stdin already closed means the EOF signal was already delivered
         deadline = time.time() + grace_s
         while self.proc.poll() is None and time.time() < deadline:
             time.sleep(0.05)
@@ -485,7 +493,7 @@ class ProcCluster:
         try:
             old.stop(grace_s=1.0)
         except Exception:  # noqa: BLE001 — it is already gone
-            pass
+            pass  # tpulint: disable=TPU006 stopping the worker being REPLACED for unresponsiveness; its death is the point
         fresh = WorkerProc(old.executor_id, self._conf_env, self._cpu,
                            self._ready_timeout)
         self.workers[i] = fresh
@@ -642,7 +650,7 @@ class ProcCluster:
             try:
                 w.rpc("remove_shuffle", sid=sid)
             except Exception:  # noqa: BLE001 — cleanup best-effort
-                pass
+                pass  # tpulint: disable=TPU006 remove_shuffle on a worker that may have died; the shuffle dies with it either way
 
         tables = []
         for blob in results:

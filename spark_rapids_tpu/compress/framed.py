@@ -63,7 +63,10 @@ def codec_pool():
 
 
 def _as_flat_u8(data) -> np.ndarray:
-    a = np.asarray(data)
+    # codec framing runs on host-staged leaves: the bytes already left the
+    # device at the spill/serve boundary (mem/buffer.py), so this asarray
+    # is a view/copy of host memory, never a device pull
+    a = np.asarray(data)  # tpulint: disable=TPU001 host-staged leaf bytes at the codec boundary, not a device pull
     return np.ascontiguousarray(a).view(np.uint8).reshape(-1)
 
 

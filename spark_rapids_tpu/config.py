@@ -524,7 +524,7 @@ def _to_bytes_or_disabled(v) -> int:
         if n < 0:
             return n
     except ValueError:
-        pass
+        pass  # tpulint: disable=TPU006 parse fallthrough: not a bare int, try the byte-suffix grammar next
     return to_bytes(v)
 
 

@@ -219,8 +219,13 @@ def _masked_cumsum(v):
         from ..ops.pallas_kernels import cumsum_1d
         try:
             return cumsum_1d(v)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — any pallas failure falls back
+            # a silent fallback here means "pallas on" quietly runs the
+            # XLA lowering forever; count it so perf triage can see it
+            from ..metrics.registry import count_swallowed
+            count_swallowed("numPallasFallbacks", "spark_rapids_tpu.pallas",
+                            "pallas cumsum_1d failed (%r); using XLA "
+                            "cumsum", e)
     return jnp.cumsum(v)
 
 

@@ -82,8 +82,13 @@ class ExecContext:
             cb = self.cleanups.pop()
             try:
                 cb()
-            except Exception:  # pragma: no cover - best-effort teardown
-                pass
+            except Exception as e:  # noqa: BLE001 — the rest must still run
+                # a dropped cleanup is a potential buffer/file-handle
+                # leak; keep teardown going but leave a trace + count
+                from ..metrics.registry import count_swallowed
+                count_swallowed("numCleanupErrors", "spark_rapids_tpu.exec",
+                                "execution cleanup callback %r failed: %r",
+                                cb, e, warn=True)
 
     def with_partition(self, pid: int, nparts: int) -> "ExecContext":
         ctx = ExecContext(self.conf, pid, nparts, self.runtime,

@@ -142,7 +142,7 @@ def _infer_partition_type(raws):
             return IntegerType
         return LongType
     except ValueError:
-        pass
+        pass  # tpulint: disable=TPU006 type-inference fallthrough: not all ints, try float next
     try:
         for v in vals:
             float(v)
@@ -280,7 +280,7 @@ def _rg_can_match(rg_meta, name_to_idx: dict, predicates) -> bool:
             if op == "GreaterThanOrEqual" and not (hi >= value):
                 return False
         except TypeError:
-            continue  # incomparable literal vs file stats: keep the group
+            continue  # incomparable literal vs file stats: keep the group  # tpulint: disable=TPU006 conservative keep IS the handling; comparability is a static property of the query, not an anomaly
     return True
 
 
@@ -393,7 +393,13 @@ def _orc_stripe_can_match(stripe, predicates) -> bool:
         try:
             mm = pc.min_max(col)
             lo, hi = mm["min"].as_py(), mm["max"].as_py()
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — keep the stripe on any error
+            # conservatively keeping the stripe is correct, but silent
+            # stat failures degrade pruning to a full scan — count them
+            from ..metrics.registry import count_swallowed
+            count_swallowed("numScanPruneStatErrors", "spark_rapids_tpu.io",
+                            "stripe min/max for predicate column %r failed "
+                            "(%r); keeping the stripe", name, e)
             continue
         if lo is None or hi is None:
             continue

@@ -1,0 +1,20 @@
+"""tpulint — project-wide AST static analysis.
+
+The compile-time discipline layer of the project (reference analogue: the
+GpuOverrides tagging + audit tooling that police the plugin's contract
+surfaces at build time rather than hoping runtime tests catch drift).
+Seven project-specific passes police the contract surfaces the engine has
+grown — host-sync hazards (TPU001), jit purity (TPU002), the conf
+registry (TPU003), the metric catalog + journal kinds (TPU004), the
+retry-site / injectOom-sweep contract (TPU005), exception hygiene
+(TPU006) and lock ordering (TPU007).
+
+Run it as `python -m spark_rapids_tpu.lint`; CI runs it before the test
+tiers (scripts/ci.sh), so a contract break fails in seconds.  Rules,
+suppressions and the baseline mechanism are documented in docs/lint.md.
+"""
+from __future__ import annotations
+
+from .core import (Baseline, FileContext, Finding, LintPass, Project,  # noqa: F401
+                   lint_paths, render_json, render_text, repo_root)
+from .passes import ALL_PASSES, pass_by_rule  # noqa: F401

@@ -1,0 +1,119 @@
+"""`python -m spark_rapids_tpu.lint` — run the tpulint static-analysis
+gate over the project (docs/lint.md documents every rule).
+
+  python -m spark_rapids_tpu.lint                 lint the default
+                                                  surface (package,
+                                                  tests, bench, scripts)
+  python -m spark_rapids_tpu.lint path [path...]  lint specific paths
+  --rules TPU001,TPU004    run a subset of passes
+  --json                   machine-readable output
+  --verbose                also print baselined/suppressed findings
+  --baseline FILE          alternate baseline (default lint/baseline.json)
+  --no-baseline            ignore the baseline (see every finding)
+  --list-rules             print the rule table and exit
+  --check-docs             regenerate docs/configs.md + docs/monitoring.md
+                           in memory and fail on drift (CI docs gate)
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from .core import (Baseline, lint_paths, render_json, render_text,
+                   repo_root)
+from .passes import ALL_PASSES
+
+
+def list_rules() -> str:
+    lines = ["tpulint rules:"]
+    for cls in ALL_PASSES:
+        lines.append(f"  {cls.rule_id}  {cls.name:<24} {cls.doc}")
+    return "\n".join(lines)
+
+
+def check_docs_drift(root: str) -> int:
+    """Regenerate the two generated docs in memory and diff against the
+    checked-in files — the docs half of TPU003, run as a CI gate so a
+    conf/metric change cannot land without its regenerated doc."""
+    from ..config import help_doc
+    from ..metrics.__main__ import monitoring_doc
+    rc = 0
+    for rel, fresh in (("docs/configs.md", help_doc()),
+                       ("docs/monitoring.md", monitoring_doc())):
+        path = os.path.join(root, rel)
+        try:
+            with open(path) as f:
+                current = f.read()
+        except OSError:
+            current = None
+        if current != fresh:
+            gen = ("python -m spark_rapids_tpu.config"
+                   if "configs" in rel else
+                   "python -m spark_rapids_tpu.metrics")
+            print(f"{rel}: stale — regenerate with `{gen}`",
+                  file=sys.stderr)
+            rc = 1
+    if rc == 0:
+        print("docs drift check OK (configs.md, monitoring.md)")
+    return rc
+
+
+def main(argv) -> int:
+    paths = []
+    rules = None
+    as_json = False
+    verbose = False
+    baseline_path = None
+    no_baseline = False
+    check_docs = False
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--rules":
+            if i + 1 >= len(argv):
+                print(__doc__, file=sys.stderr)
+                return 2
+            rules = [r.strip() for r in argv[i + 1].split(",") if r.strip()]
+            i += 2
+        elif arg == "--baseline":
+            if i + 1 >= len(argv):
+                print(__doc__, file=sys.stderr)
+                return 2
+            baseline_path = argv[i + 1]
+            i += 2
+        elif arg == "--json":
+            as_json, i = True, i + 1
+        elif arg == "--verbose":
+            verbose, i = True, i + 1
+        elif arg == "--no-baseline":
+            no_baseline, i = True, i + 1
+        elif arg == "--list-rules":
+            print(list_rules())
+            return 0
+        elif arg == "--check-docs":
+            check_docs, i = True, i + 1
+        elif arg.startswith("--"):
+            print(__doc__, file=sys.stderr)
+            return 2
+        else:
+            paths.append(arg)
+            i += 1
+    root = repo_root()
+    if check_docs:
+        return check_docs_drift(root)
+    try:
+        result = lint_paths(paths=paths or None, rules=rules,
+                            baseline=Baseline([]) if no_baseline else None,
+                            baseline_path=baseline_path, root=root)
+    except ValueError as e:  # unknown --rules id: usage error, not green
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+    print(render_json(result) if as_json
+          else render_text(result, verbose=verbose))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

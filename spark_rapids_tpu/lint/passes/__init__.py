@@ -1,0 +1,34 @@
+"""tpulint pass registry (the SPI surface new passes plug into).
+
+Adding a pass: subclass `spark_rapids_tpu.lint.core.LintPass`, give it
+the next free TPU0xx rule id, implement `check_file` (per-file AST) and/
+or `finalize` (cross-file), append the class here, and document the rule
+in docs/lint.md.  Fixture tests in tests/test_lint.py must prove one
+true positive and one clean negative per rule.
+"""
+from __future__ import annotations
+
+from .conf_hygiene import ConfHygienePass
+from .contracts import ContractsPass
+from .exceptions import ExceptionHygienePass
+from .host_sync import HostSyncPass
+from .jit_purity import JitPurityPass
+from .lock_order import LockOrderPass
+from .retry_sites import RetrySitesPass
+
+ALL_PASSES = [
+    HostSyncPass,        # TPU001
+    JitPurityPass,       # TPU002
+    ConfHygienePass,     # TPU003
+    ContractsPass,       # TPU004
+    RetrySitesPass,      # TPU005
+    ExceptionHygienePass,  # TPU006
+    LockOrderPass,       # TPU007
+]
+
+
+def pass_by_rule(rule_id: str):
+    for cls in ALL_PASSES:
+        if cls.rule_id == rule_id:
+            return cls
+    raise KeyError(f"unknown tpulint rule {rule_id!r}")

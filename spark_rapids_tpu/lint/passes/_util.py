@@ -1,0 +1,118 @@
+"""Shared AST helpers for tpulint passes."""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute chains over Names; None when the base is a
+    call/subscript/... (dynamic receivers can't be named statically)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def walk_calls(tree: ast.AST) -> Iterator[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def attach_parents(tree: ast.AST) -> None:
+    """Stamp `._tpulint_parent` on every node (docstring detection etc.)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._tpulint_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_tpulint_parent", None)
+
+
+def is_docstring(node: ast.Constant) -> bool:
+    """A string constant that is the bare expression statement of a
+    module/class/function body (prose, not a contract literal)."""
+    p = parent(node)
+    if not isinstance(p, ast.Expr):
+        return False
+    pp = parent(p)
+    return isinstance(pp, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                           ast.AsyncFunctionDef))
+
+
+def enclosing_class_and_func(tree: ast.AST
+                             ) -> Iterator[Tuple[Optional[str],
+                                                 ast.FunctionDef]]:
+    """(class name or None, function node) for every function in the
+    module, including nested ones (class name = nearest enclosing)."""
+    def visit(node: ast.AST, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield (cls, child)
+                yield from visit(child, cls)
+            else:
+                yield from visit(child, cls)
+    yield from visit(tree, None)
+
+
+def func_params(fn) -> set:
+    """Parameter names of a FunctionDef/Lambda."""
+    a = fn.args
+    names = [p.arg for p in
+             (a.posonlyargs if hasattr(a, "posonlyargs") else [])
+             + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def span_end(node: ast.AST) -> int:
+    return getattr(node, "end_lineno", None) or node.lineno
+
+
+#: receiver-name fragments that mark a journal object (metrics/query.py
+#: self.journal, shuffle/worker.py self.shard, local `journal` handles)
+JOURNAL_RECEIVERS = ("journal", "shard")
+JOURNAL_FUNCS = {"journal_event", "journal_span"}
+JOURNAL_METHODS = {"begin", "instant", "span"}
+
+
+def is_journal_call(call: ast.Call) -> bool:
+    """One shared definition of "this call writes to the event journal"
+    so TPU004 (kind contracts) and TPU007 (journal-under-lock) can never
+    silently disagree about what a journal write is."""
+    name = call_name(call) or ""
+    if name.rsplit(".", 1)[-1] in JOURNAL_FUNCS:
+        return True
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in JOURNAL_METHODS:
+        recv = (dotted_name(call.func.value) or "").lower()
+        return any(h in recv for h in JOURNAL_RECEIVERS)
+    return False

@@ -1,0 +1,89 @@
+"""TPU003 — conf hygiene.
+
+The conf registry (config.py `_REGISTRY`) is the single source of truth
+for every `spark.rapids.*` knob, and docs/configs.md is GENERATED from
+it.  Two drift classes are policed:
+
+  * a raw conf-key string literal anywhere in the project (package,
+    tests, bench) that does not resolve in the registry — a typo'd key
+    silently no-ops (TpuConf.get returns the raw-settings fallback), so
+    the test that "sets" it tests nothing;
+  * a registered, non-internal conf missing from docs/configs.md — the
+    generated doc went stale (scripts/ci.sh additionally fails on any
+    regeneration diff via `python -m spark_rapids_tpu.lint --check-docs`).
+
+Keys derived per-operator at runtime (`spark.rapids.sql.exec.<Name>`,
+`spark.rapids.sql.expr.<Name>`, plan/overrides.py) and prefix literals
+(trailing '.') are recognized and skipped.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable
+
+from ..core import FileContext, Finding, LintPass, Project
+from . import _util as U
+
+_KEY_RE = re.compile(r"^spark\.(rapids|sql)\.[A-Za-z0-9_.]+$")
+#: runtime-derived kill-switch namespaces (plan/overrides.py)
+_DERIVED_PREFIXES = ("spark.rapids.sql.exec.", "spark.rapids.sql.expr.",
+                    "spark.rapids.sql.scan.", "spark.rapids.sql.partitioning.")
+
+
+def _registry_keys() -> set:
+    from ... import config
+    return set(config._REGISTRY)
+
+
+class ConfHygienePass(LintPass):
+    rule_id = "TPU003"
+    name = "conf-hygiene"
+    doc = ("spark.rapids.* string keys must resolve in config.py's "
+           "registry; registered confs must appear in docs/configs.md")
+    scopes = ("package", "aux")
+
+    def __init__(self):
+        self._keys = _registry_keys()
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        U.attach_parents(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            s = node.value
+            if not _KEY_RE.match(s) or U.is_docstring(node):
+                continue
+            if s.endswith(".") or any(s.startswith(p)
+                                      for p in _DERIVED_PREFIXES):
+                continue
+            if s not in self._keys:
+                yield Finding(
+                    self.rule_id, ctx.rel_path, node.lineno,
+                    f"conf key {s!r} is not in config.py's registry — "
+                    "typo'd keys silently no-op; register it or fix the "
+                    "spelling",
+                    span_end=U.span_end(node))
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        from ... import config
+        doc_path = os.path.join(project.root, "docs", "configs.md")
+        try:
+            with open(doc_path) as f:
+                doc = f.read()
+        except OSError:
+            yield Finding(self.rule_id, "docs/configs.md", 1,
+                          "docs/configs.md missing — regenerate with "
+                          "`python -m spark_rapids_tpu.config`")
+            return
+        for entry in config.registered_entries():
+            if entry.internal:
+                continue
+            if entry.key not in doc:
+                yield Finding(
+                    self.rule_id, "docs/configs.md", 1,
+                    f"registered conf {entry.key!r} missing from "
+                    "docs/configs.md — regenerate with `python -m "
+                    "spark_rapids_tpu.config`")

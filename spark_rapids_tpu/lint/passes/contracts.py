@@ -1,0 +1,103 @@
+"""TPU004 — metric-catalog and journal-kind contracts.
+
+Folds the standing `python -m spark_rapids_tpu.metrics --lint` check into
+the framework (the CLI now delegates here) and extends it to the journal:
+
+  * every `metrics.add/add_lazy/add_sync/set_max/timer("name")` literal
+    must be registered in metrics/names.py — a typo'd key silently
+    splits a counter;
+  * `run_retryable(ctx, metrics, "block")` and
+    `with_retry(..., metrics=..., name="block")` derive
+    `{block}Retries`/`{block}Splits` metric names (mem/retry.py), which
+    must be registered too;
+  * every `journal_event("kind", ...)` / `journal_span("kind", ...)` /
+    `<journal|shard>.begin/instant/span("kind", ...)` literal must be a
+    member of metrics/journal.py EVENT_KINDS — an unknown kind fails
+    `validate_events` and is dropped by every timeline/ledger consumer.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from ..core import FileContext, Finding, LintPass
+from . import _util as U
+
+_EMIT_METHODS = {"add", "add_lazy", "add_sync", "set_max", "timer"}
+_NAME_RE = re.compile(r"^[A-Za-z0-9_]+$")
+
+
+def _retry_names(block: str):
+    from ...metrics import names as N
+    return N.retry_metric_names(block)
+
+
+class ContractsPass(LintPass):
+    rule_id = "TPU004"
+    name = "metric-journal-contracts"
+    doc = ("metric emission literals must be registered in "
+           "metrics/names.py; journal kind literals must be members of "
+           "EVENT_KINDS")
+    scopes = ("package",)
+
+    def __init__(self):
+        from ...metrics import names as N
+        from ...metrics.journal import EVENT_KINDS
+        self._registered = N.is_registered
+        self._kinds = set(EVENT_KINDS)
+        #: literal emission sites examined (registered or not) — the
+        #: "scanner still sees the tree" floor tests/test_metrics.py
+        #: asserts on
+        self.emission_sites = 0
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for call in U.walk_calls(ctx.tree):
+            name = U.call_name(call) or ""
+            tail = name.rsplit(".", 1)[-1]
+            # metric emissions: .add("x") / .timer("x") with a literal,
+            # plus the hygiene-counter helper count_swallowed("x", ...)
+            if call.args and (
+                    (isinstance(call.func, ast.Attribute)
+                     and call.func.attr in _EMIT_METHODS)
+                    or tail == "count_swallowed"):
+                lit = U.str_const(call.args[0])
+                if lit is not None and _NAME_RE.match(lit):
+                    self.emission_sites += 1
+                if lit is not None and _NAME_RE.match(lit) \
+                        and not self._registered(lit):
+                    yield Finding(
+                        self.rule_id, ctx.rel_path, call.lineno,
+                        f"unregistered metric name {lit!r} — add it to "
+                        "spark_rapids_tpu/metrics/names.py",
+                        span_end=U.span_end(call))
+            # retry blocks derive {block}Retries/{block}Splits
+            block = None
+            if tail == "run_retryable" and len(call.args) >= 3:
+                block = U.str_const(call.args[2])
+            elif tail == "with_retry" and U.kwarg(call, "metrics") \
+                    is not None:
+                kw = U.kwarg(call, "name")
+                block = U.str_const(kw) if kw is not None else None
+            if block is not None:
+                self.emission_sites += 1
+                for derived in _retry_names(block):
+                    if not self._registered(derived):
+                        yield Finding(
+                            self.rule_id, ctx.rel_path, call.lineno,
+                            f"retry block {block!r} derives metric "
+                            f"{derived!r} which is not registered in "
+                            "metrics/names.py",
+                            span_end=U.span_end(call))
+            # journal kinds (U.is_journal_call is the ONE definition
+            # shared with TPU007's journal-under-lock rule)
+            kind_lit = None
+            if call.args and U.is_journal_call(call):
+                kind_lit = U.str_const(call.args[0])
+            if kind_lit is not None and kind_lit not in self._kinds:
+                yield Finding(
+                    self.rule_id, ctx.rel_path, call.lineno,
+                    f"journal kind {kind_lit!r} is not a member of "
+                    "EVENT_KINDS (metrics/journal.py) — consumers drop "
+                    "unknown kinds",
+                    span_end=U.span_end(call))

@@ -1,0 +1,59 @@
+"""TPU006 — exception hygiene.
+
+A handler whose body is nothing but `pass`/`continue` swallows the
+failure with zero trace: no log line for the post-mortem, no counter for
+the dashboards, nothing for the fault-injection tiers to assert on.  In
+an engine whose whole fault story is "every failure is observable and
+counted" (retry journal events, corruption ladders, the memory ledger),
+a silent except is a hole in the observability contract.
+
+The fix shape used across the tree: a module-logger line plus a
+lint-registered process counter —
+
+    except OSError as e:
+        log.debug("...: %r", e)
+        ENGINE_COUNTERS.add("numListenerCloseErrors", 1)
+
+Genuine control-flow fallthroughs (a parse attempt falling back to the
+next format) stay silent BY DESIGN — suppress those inline with a
+reason, which is exactly the documentation they were missing.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import FileContext, Finding, LintPass
+from . import _util as U
+
+
+def _is_noop(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Continue)):
+        return True
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+        return True  # docstring / ellipsis
+    return False
+
+
+class ExceptionHygienePass(LintPass):
+    rule_id = "TPU006"
+    name = "exception-hygiene"
+    doc = ("except handlers must log + count (or re-raise), not "
+           "silently pass")
+    scopes = ("package",)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not all(_is_noop(s) for s in node.body):
+                continue
+            etype = ""
+            if node.type is not None:
+                etype = f" ({ast.unparse(node.type)})"
+            yield Finding(
+                self.rule_id, ctx.rel_path, node.lineno,
+                f"swallowed exception{etype}: log it and bump a "
+                "registered counter (metrics.registry.ENGINE_COUNTERS), "
+                "or suppress with the reason the silence is by design",
+                span_end=U.span_end(node))

@@ -1,0 +1,215 @@
+"""TPU002 — purity of functions handed to the XLA compiler.
+
+A function that reaches `jax.jit` / `kernel_cache.cached_kernel` /
+`kernel_cache.stage_executable` is traced ONCE per (shape, dtype) bucket
+and replayed from the compile cache forever after.  Two failure classes
+hide there:
+
+  * impure calls (`time.time`, `random.*`, `np.random.*`, `os.environ`,
+    `open`, `print`) execute at TRACE time only — the compiled program
+    bakes in whatever value the first trace saw, and ROADMAP item 2's
+    persistent compile cache makes that value survive process restarts;
+  * Python `if`/`while` over a traced array parameter raises a
+    ConcretizationTypeError at best, or — when the value is accidentally
+    concrete on CPU — silently specializes the program to the first
+    batch's data.
+
+The pass resolves the repo's jit idioms: direct `jax.jit(fn)`, decorator
+form, lambdas, and the builder pattern (`jax.jit(builder())` /
+`cached_kernel(key, builder)` / `stage_executable(key, builder, ...)`
+where `builder` is a local def returning the traced function).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Finding, LintPass
+from . import _util as U
+
+#: dotted-name prefixes that are impure inside a traced function
+BANNED_PREFIXES = (
+    "time.", "random.", "np.random.", "numpy.random.", "os.environ",
+    "os.urandom", "uuid.", "datetime.datetime.now", "datetime.now",
+    "secrets.",
+)
+BANNED_EXACT = {"open", "print", "input", "time", "random"}
+
+#: attribute accesses on a traced parameter that are STATIC under jit —
+#: branching on these is shape-polymorphism, not value-dependence
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+
+def _is_banned(name: str) -> Optional[str]:
+    if name in BANNED_EXACT:
+        return name
+    for p in BANNED_PREFIXES:
+        if name == p.rstrip(".") or name.startswith(p):
+            return name
+    return None
+
+
+class _Scope:
+    """Local defs of one function/module body, for resolving `jit(name)`
+    and the builder pattern without whole-program analysis."""
+
+    def __init__(self, body: List[ast.stmt]):
+        self.defs = {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[stmt.name] = stmt
+
+
+def _returned_functions(builder: ast.FunctionDef) -> List[ast.AST]:
+    """Functions a builder RETURNS: `return inner` (a local def) or
+    `return lambda ...` — the repo's cached_kernel/stage_executable shape."""
+    scope = _Scope(builder.body)
+    out: List[ast.AST] = []
+    for node in ast.walk(builder):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Lambda):
+                out.append(node.value)
+            elif isinstance(node.value, ast.Name) \
+                    and node.value.id in scope.defs:
+                out.append(scope.defs[node.value.id])
+    return out
+
+
+class JitPurityPass(LintPass):
+    rule_id = "TPU002"
+    name = "jit-purity"
+    doc = ("impure calls or Python branching on traced values inside "
+           "functions handed to jax.jit / cached_kernel / "
+           "stage_executable")
+    scopes = ("package",)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        targets: List[Tuple[ast.AST, str]] = []  # (fn node, how-found)
+        seen: Set[int] = set()
+
+        def add(fn: Optional[ast.AST], how: str) -> None:
+            if fn is not None and id(fn) not in seen:
+                seen.add(id(fn))
+                targets.append((fn, how))
+
+        # scope map: enclosing function body (or module) per node, for
+        # resolving Name arguments to local defs
+        scopes = {id(ctx.tree): _Scope(ctx.tree.body)}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes[id(node)] = _Scope(node.body)
+
+        def resolve(arg: ast.AST, enclosing: _Scope
+                    ) -> Tuple[Optional[ast.AST], bool]:
+            """(function node, is_builder_result)"""
+            if isinstance(arg, ast.Lambda):
+                return arg, False
+            if isinstance(arg, ast.Name):
+                return enclosing.defs.get(arg.id), False
+            if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name):
+                b = enclosing.defs.get(arg.func.id)
+                if b is not None:
+                    return b, True
+            return None, False
+
+        def nearest_scope(stack: List[ast.AST]) -> _Scope:
+            for n in reversed(stack):
+                if id(n) in scopes:
+                    return scopes[id(n)]
+            return scopes[id(ctx.tree)]
+
+        # walk with an ancestor stack so Name args resolve in the right
+        # function body
+        def visit(node: ast.AST, stack: List[ast.AST]) -> None:
+            if isinstance(node, ast.Call):
+                name = U.call_name(node) or ""
+                tail = name.rsplit(".", 1)[-1]
+                arg_ix = None
+                if name in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                    arg_ix = 0
+                elif tail in ("cached_kernel", "stage_executable"):
+                    arg_ix = 1
+                if arg_ix is not None and len(node.args) > arg_ix:
+                    fn, via_builder = resolve(node.args[arg_ix],
+                                              nearest_scope(stack))
+                    if via_builder and fn is not None:
+                        for inner in _returned_functions(fn):
+                            add(inner, name)
+                    else:
+                        add(fn, name)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dname = (U.dotted_name(dec) if not isinstance(
+                        dec, ast.Call) else U.call_name(dec)) or ""
+                    if dname in ("jax.jit", "jit", "pjit", "jax.pjit") or \
+                            (isinstance(dec, ast.Call) and dec.args and
+                             (U.dotted_name(dec.args[0]) or "")
+                             in ("jax.jit", "jit")):
+                        add(node, "decorator")
+            stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child, stack)
+            stack.pop()
+
+        visit(ctx.tree, [])
+
+        for fn, how in targets:
+            yield from self._check_traced_fn(ctx, fn, how)
+
+    def _check_traced_fn(self, ctx: FileContext, fn: ast.AST,
+                         how: str) -> Iterable[Finding]:
+        label = getattr(fn, "name", "<lambda>")
+        params = U.func_params(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    name = U.call_name(node)
+                    bad = _is_banned(name) if name else None
+                    if bad:
+                        yield Finding(
+                            self.rule_id, ctx.rel_path, node.lineno,
+                            f"impure call {bad}() inside traced function "
+                            f"{label!r} (reached via {how}): executes at "
+                            "trace time only and is baked into the "
+                            "compiled program",
+                            span_end=U.span_end(node))
+                elif isinstance(node, (ast.If, ast.While)):
+                    hit = self._traced_branch(node.test, params)
+                    if hit:
+                        yield Finding(
+                            self.rule_id, ctx.rel_path, node.lineno,
+                            f"Python branch on traced value {hit!r} "
+                            f"inside traced function {label!r}: use "
+                            "jnp.where/lax.cond, or mark the argument "
+                            "static",
+                            span_end=node.test.end_lineno
+                            or node.lineno)
+
+    @staticmethod
+    def _traced_branch(test: ast.expr, params: Set[str]
+                       ) -> Optional[str]:
+        """A test that touches a bare traced parameter by VALUE.  Static
+        SUBEXPRESSIONS — x.shape/x.dtype/x.ndim attribute chains, len(),
+        isinstance() and friends — are shape/type polymorphism and are
+        exempted subtree-by-subtree, so a mixed test like
+        `if v.ndim == 2 and v:` still flags the bare `v`."""
+        _STATIC_CALLS = ("len", "isinstance", "hasattr", "getattr",
+                         "callable")
+
+        def scan(node: ast.AST) -> Optional[str]:
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _STATIC_ATTRS:
+                return None  # static subtree: don't descend to its base
+            if isinstance(node, ast.Call) \
+                    and U.call_name(node) in _STATIC_CALLS:
+                return None
+            if isinstance(node, ast.Name) and node.id in params:
+                return node.id
+            for child in ast.iter_child_nodes(node):
+                hit = scan(child)
+                if hit is not None:
+                    return hit
+            return None
+
+        return scan(test)

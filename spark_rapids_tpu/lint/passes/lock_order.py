@@ -1,0 +1,230 @@
+"""TPU007 — lock-order discipline.
+
+The engine is multi-threaded in every layer that matters: the cluster
+driver's heartbeat monitor, shuffle serve/fetch threads, the codec side
+pools, the async integrity verifier and the spill cascade all take locks
+owned by different subsystems.  Two standing rules keep that safe:
+
+  * the global lock-ACQUISITION graph (an edge A->B whenever code enters
+    lock B while holding lock A) must stay acyclic — a cycle is a
+    deadlock waiting for the right interleaving; a non-reentrant lock
+    re-entered by its own holder is a deadlock needing no interleaving
+    at all;
+  * no journal write under a store/catalog/buffer lock: the journal has
+    its own lock and (file-backed) does blocking I/O, so journaling from
+    inside the memory-accounting critical sections both inverts lock
+    order against the reporting threads and stretches the hottest locks
+    in the engine across a disk write.  (The stores therefore migrate
+    buffers OUTSIDE `_lock` and the ledger emits after releasing its
+    own — this pass keeps it that way.)
+
+Lock identity is heuristic but stable: `self._lock` resolves to
+`<ClassName>._lock`, a bare `<var>.lock` resolves through the receiver
+alias table (`buf`/`b`/`buffer`/`victim` -> SpillableBuffer), module
+globals to `<module>:<name>`.  `threading.RLock()` assignments mark a
+label reentrant, which legalizes self-edges.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Finding, LintPass, Project
+from . import _util as U
+
+#: receiver variable names that conventionally hold a SpillableBuffer
+_RECEIVER_ALIASES = {"buf": "SpillableBuffer", "b": "SpillableBuffer",
+                     "buffer": "SpillableBuffer",
+                     "victim": "SpillableBuffer",
+                     "catalog": "BufferCatalog"}
+
+
+def _is_store_lock(label: str) -> bool:
+    cls = label.split(".", 1)[0].split(":", 1)[-1]
+    return "Store" in cls or "Catalog" in cls \
+        or label.startswith("SpillableBuffer.")
+
+
+class LockOrderPass(LintPass):
+    rule_id = "TPU007"
+    name = "lock-order"
+    doc = ("the cross-module lock-acquisition graph must be acyclic; no "
+           "journal writes under store/catalog/buffer locks")
+    scopes = ("package",)
+
+    def __init__(self):
+        #: (from, to) -> (rel_path, line) of one witness acquisition
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.reentrant: Set[str] = {"SpillableBuffer.lock"}
+        #: non-reentrant self-edges found while a file was walked:
+        #: (label, rel_path, line)
+        self._self_edges: List[Tuple[str, str, int]] = []
+
+    # -- lock identity --------------------------------------------------------
+
+    def _lock_label(self, expr: ast.expr, cls: Optional[str],
+                    module: str) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+            base = U.dotted_name(expr.value)
+            if base == "self":
+                owner = cls or module
+                return f"{owner}.{expr.attr}"
+            if base is not None:
+                head = base.split(".")[-1]
+                owner = _RECEIVER_ALIASES.get(head, head)
+                return f"{owner}.{expr.attr}"
+            return f"<dynamic>.{expr.attr}"
+        if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+            # a DISTINCTIVELY named module-global lock keeps one label
+            # across modules (importing it must not fork its identity for
+            # cycle detection); generic `lock`/`_lock` globals stay
+            # module-scoped so unrelated same-named locks never alias
+            if expr.id in ("lock", "_lock"):
+                return f"{module}:{expr.id}"
+            return f"global:{expr.id}"
+        return None
+
+    # -- per-file -------------------------------------------------------------
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        module = os.path.splitext(os.path.basename(ctx.rel_path))[0]
+        findings: List[Finding] = []
+
+        # RLock discovery: self.X = threading.RLock() / X = threading.RLock()
+        for cls_name, fn in U.enclosing_class_and_func(ctx.tree):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and (U.call_name(node.value) or "").endswith(
+                            "RLock"):
+                    for tgt in node.targets:
+                        label = self._lock_label(tgt, cls_name, module)
+                        if label:
+                            self.reentrant.add(label)
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and (U.call_name(stmt.value) or "").endswith("RLock"):
+                for tgt in stmt.targets:
+                    label = self._lock_label(tgt, None, module)
+                    if label:
+                        self.reentrant.add(label)
+
+        def walk(node: ast.AST, stack: List[str],
+                 cls: Optional[str]) -> None:
+            if isinstance(node, ast.ClassDef):
+                for child in node.body:
+                    walk(child, [], node.name)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def does not RUN under the enclosing with —
+                # analyze it with a fresh stack
+                for child in node.body:
+                    walk(child, [], cls)
+                return
+            if isinstance(node, ast.With):
+                labels = []
+                for item in node.items:
+                    # the context expression EVALUATES under whatever is
+                    # already held (outer withs + earlier items of this
+                    # statement): `with self._lock: with journal_span(...)`
+                    # is the journal-write-under-lock shape too
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call):
+                            self._check_journal_call(sub, stack, ctx,
+                                                     findings)
+                    label = self._lock_label(item.context_expr, cls,
+                                             module)
+                    if label is None:
+                        continue
+                    if stack:
+                        held = stack[-1]
+                        if held == label:
+                            self._self_edges.append(
+                                (label, ctx.rel_path, node.lineno))
+                        else:
+                            self.edges.setdefault(
+                                (held, label),
+                                (ctx.rel_path, node.lineno))
+                    stack.append(label)
+                    labels.append(label)
+                for child in node.body:
+                    walk(child, stack, cls)
+                for _ in labels:
+                    stack.pop()
+                return
+            # journal write under a store lock?
+            if isinstance(node, ast.Call):
+                self._check_journal_call(node, stack, ctx, findings)
+            for child in ast.iter_child_nodes(node):
+                walk(child, stack, cls)
+
+        for top in ctx.tree.body:
+            walk(top, [], None)
+        return findings
+
+    def _check_journal_call(self, node: ast.Call, stack: List[str],
+                            ctx: FileContext,
+                            findings: List[Finding]) -> None:
+        if not any(_is_store_lock(s) for s in stack):
+            return
+        # U.is_journal_call is the ONE definition shared with TPU004's
+        # kind-contract rule
+        if U.is_journal_call(node):
+            name = U.call_name(node) or ""
+            held = next(s for s in stack if _is_store_lock(s))
+            findings.append(Finding(
+                self.rule_id, ctx.rel_path, node.lineno,
+                f"journal write ({name}) while holding store "
+                f"lock {held} — journaling takes the journal "
+                "lock and may block on file I/O; emit after "
+                "releasing the store lock",
+                span_end=U.span_end(node)))
+
+    # -- cross-file -----------------------------------------------------------
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        for label, path, line in self._self_edges:
+            if label not in self.reentrant:
+                yield Finding(
+                    self.rule_id, path, line,
+                    f"non-reentrant lock {label} re-acquired by its own "
+                    "holder — this deadlocks without any thread "
+                    "interleaving (make it an RLock or restructure)")
+        # cycle detection over the acquisition graph
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        seen: Set[str] = set()
+        reported: Set[frozenset] = set()
+
+        def dfs(node: str, stack: List[str], on_stack: Set[str]):
+            seen.add(node)
+            on_stack.add(node)
+            stack.append(node)
+            for nxt in adj.get(node, ()):
+                if nxt in on_stack:
+                    cycle = stack[stack.index(nxt):] + [nxt]
+                    key = frozenset(cycle)
+                    if key not in reported:
+                        reported.add(key)
+                        yield cycle
+                elif nxt not in seen:
+                    yield from dfs(nxt, stack, on_stack)
+            stack.pop()
+            on_stack.discard(node)
+
+        for start in sorted(adj):
+            if start not in seen:
+                for cycle in dfs(start, [], set()):
+                    edge = (cycle[0], cycle[1])
+                    path, line = self.edges.get(
+                        edge, self.edges.get((cycle[-2], cycle[-1]),
+                                             ("<graph>", 1)))
+                    yield Finding(
+                        self.rule_id, path, line,
+                        "lock-order cycle: "
+                        + " -> ".join(cycle)
+                        + " — two threads taking these in opposite "
+                        "order deadlock; pick one global order")

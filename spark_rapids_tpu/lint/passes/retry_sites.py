@@ -1,0 +1,106 @@
+"""TPU005 — retry-site coverage.
+
+The fault-injection contract (utils/faults.py + tests/test_retry.py):
+every `reserve(..., site="label")` call is an OOM-injectable point, and
+the injectOom sweep in tests/test_retry.py replays a slice query with
+EVERY discovered ordinal forced to fail.  That sweep is only as good as
+its site list, so this pass polices three invariants:
+
+  * every literal `site=` label on a reserve() call in the package must
+    appear in the `OOM_SWEEP_SITES` tuple tests/test_retry.py declares
+    (adding a reserve site without extending the sweep contract fails
+    lint, not a code reviewer's memory);
+  * the sweep list must not go stale: an entry with no remaining source
+    site is flagged;
+  * a site label must be unique to ONE module — two operators sharing a
+    label makes ledger cause-attribution and per-site injection specs
+    ambiguous.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from ..core import FileContext, Finding, LintPass, Project
+from . import _util as U
+
+SWEEP_DECL = "OOM_SWEEP_SITES"
+SWEEP_FILE = "tests/test_retry.py"
+
+
+class RetrySitesPass(LintPass):
+    rule_id = "TPU005"
+    name = "retry-site-coverage"
+    doc = ("reserve() site= labels must be unique per module and covered "
+           f"by {SWEEP_DECL} in {SWEEP_FILE}")
+    scopes = ("package", "aux")
+
+    def __init__(self):
+        # label -> [(rel_path, line)]
+        self.sites: Dict[str, List[Tuple[str, int]]] = {}
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.scope != "package":
+            return ()
+        for call in U.walk_calls(ctx.tree):
+            name = U.call_name(call) or ""
+            if name.rsplit(".", 1)[-1] != "reserve":
+                continue
+            kw = U.kwarg(call, "site")
+            lit = U.str_const(kw) if kw is not None else None
+            if lit is not None:
+                self.sites.setdefault(lit, []).append(
+                    (ctx.rel_path, call.lineno))
+        return ()
+
+    def _sweep_list(self, project: Project):
+        ctx = project.file(SWEEP_FILE)
+        if ctx is None:
+            return None, None
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == SWEEP_DECL
+                    for t in stmt.targets):
+                vals = []
+                if isinstance(stmt.value, (ast.Tuple, ast.List)):
+                    for el in stmt.value.elts:
+                        lit = U.str_const(el)
+                        if lit is not None:
+                            vals.append(lit)
+                return vals, stmt.lineno
+        return None, None
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        if project.file(SWEEP_FILE) is None and not self.sites:
+            return  # fixture runs that lint neither side of the contract
+        sweep, decl_line = self._sweep_list(project)
+        if sweep is None:
+            if project.file(SWEEP_FILE) is not None:
+                yield Finding(
+                    self.rule_id, SWEEP_FILE, 1,
+                    f"{SWEEP_DECL} tuple not found — the injectOom sweep "
+                    "contract must declare every reserve site label")
+            return
+        for label, where in sorted(self.sites.items()):
+            modules = {path for path, _ln in where}
+            if len(modules) > 1:
+                path, ln = where[0]
+                yield Finding(
+                    self.rule_id, path, ln,
+                    f"reserve site {label!r} is used in multiple modules "
+                    f"({', '.join(sorted(modules))}) — labels must be "
+                    "unique per module so injection specs and ledger "
+                    "cause-attribution stay unambiguous")
+            if label not in sweep:
+                path, ln = where[0]
+                yield Finding(
+                    self.rule_id, path, ln,
+                    f"reserve site {label!r} missing from {SWEEP_DECL} "
+                    f"in {SWEEP_FILE} — every site must be part of the "
+                    "injectOom sweep contract")
+        for label in sweep:
+            if label not in self.sites:
+                yield Finding(
+                    self.rule_id, SWEEP_FILE, decl_line or 1,
+                    f"{SWEEP_DECL} entry {label!r} matches no reserve "
+                    "site in the package — stale sweep entry")

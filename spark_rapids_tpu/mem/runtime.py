@@ -42,8 +42,15 @@ def _detect_hbm_bytes() -> int:
             for key in ("bytes_limit", "bytes_reservable_limit"):
                 if key in stats and stats[key]:
                     return int(stats[key])
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — any backend may lack stats
+        # on real hardware a silent 16GiB default mis-sizes the accounted
+        # pool against the actual chip: make the downgrade observable
+        from ..metrics.registry import count_swallowed
+        count_swallowed("numHbmDetectFallbacks", "spark_rapids_tpu.mem",
+                        "device memory_stats unavailable (%r); defaulting "
+                        "pool sizing to 16GiB — set "
+                        "spark.rapids.memory.tpu.poolSizeBytes explicitly "
+                        "on real hardware", e, warn=True)
     return 16 << 30  # v5e-class default when stats are unavailable
 
 

@@ -61,6 +61,12 @@ def prometheus_dump(qe) -> str:
     for k, v in qe.runtime_delta().items():
         by_metric.setdefault(k, []).append(
             ({"query": qlabel, "scope": "runtime"}, v))
+    # process-wide hygiene counters (TPU006 fix sites, docs/lint.md):
+    # cumulative over the process, labeled scope=engine so dashboards
+    # can tell a scrape failure from a genuinely idle wire
+    from .registry import ENGINE_COUNTERS
+    for k, v in ENGINE_COUNTERS.snapshot().items():
+        by_metric.setdefault(k, []).append(({"scope": "engine"}, v))
     lines: List[str] = []
     for metric in sorted(by_metric):
         pname = prom_name(metric)
@@ -200,10 +206,26 @@ def session_observability(session) -> dict:
                 seen.add(key)
                 wire_sent += int(t.get("bytes_sent", 0))
                 wire_recv += int(t.get("bytes_received", 0))
-        except Exception:  # noqa: BLE001 — observability must not throw
-            pass
+        except Exception as e:  # noqa: BLE001 — observability must not throw
+            # report the zeros, but not silently: a dashboard flatline
+            # caused by a scrape failure should be distinguishable from
+            # a genuinely idle wire
+            from .registry import count_swallowed
+            count_swallowed("numExportScrapeErrors",
+                            "spark_rapids_tpu.metrics",
+                            "cluster wire-counter scrape failed (%r); "
+                            "reporting 0", e)
     out["wire_bytes_sent"] = wire_sent
     out["wire_bytes_received"] = wire_recv
+    # process-wide hygiene counters (TPU006, docs/lint.md): swallowed-
+    # failure sites that logged + counted instead of passing silently.
+    # Snapshotted AFTER the wire scrape, so a scrape failure's own
+    # numExportScrapeErrors bump rides the very payload reporting the
+    # zeros.  Driver-process view only — worker-side bumps stay in
+    # worker logs.
+    from .registry import ENGINE_COUNTERS
+    out["engine_counters"] = {k: int(v) for k, v in
+                              ENGINE_COUNTERS.snapshot().items()}
     return out
 
 

@@ -296,6 +296,48 @@ REPLAN_TIME = register_metric(
     "time spent applying adaptive re-planning rules between stages "
     "(excludes the map-stage writes themselves)")
 
+# --- exception-hygiene counters (metrics/registry.py ENGINE_COUNTERS) -------
+# Process-wide counters for swallowed-failure sites that have no operator
+# Metrics object in scope; every TPU006 fix pairs a log line with one of
+# these so the silence is observable (docs/lint.md).
+NUM_PALLAS_FALLBACKS = register_metric(
+    "numPallasFallbacks", COUNTER, ESSENTIAL,
+    "pallas kernel BUILDS that raised at jit-trace time and compiled "
+    "the XLA lowering instead (exec/aggregate.py _masked_cumsum) — "
+    "counted once per compiled (shape, dtype) program, not per batch: "
+    "the fallback is baked into the cached program, so EVERY later "
+    "execution of that kernel replays it; any nonzero value on real "
+    "chips means the hand-written kernel is not actually running")
+NUM_NATIVE_TEARDOWN_ERRORS = register_metric(
+    "numNativeTeardownErrors", COUNTER, ESSENTIAL,
+    "native address-space allocator handles whose destroy failed at "
+    "finalization (native.py) — a leak of native tracking state")
+NUM_WORKER_STDOUT_NOISE = register_metric(
+    "numWorkerStdoutNoise", COUNTER, ESSENTIAL,
+    "non-JSON lines a worker printed on stdout before its ready "
+    "announcement (library banners are normal; a flood means the worker "
+    "is crashing before announcing)")
+NUM_HBM_DETECT_FALLBACKS = register_metric(
+    "numHbmDetectFallbacks", COUNTER, ESSENTIAL,
+    "runtimes that could not read device memory_stats and fell back to "
+    "the v5e-class 16GiB default pool size (mem/runtime.py) — on real "
+    "hardware this means the accounted pool is NOT sized to the chip")
+NUM_SCAN_PRUNE_STAT_ERRORS = register_metric(
+    "numScanPruneStatErrors", COUNTER, ESSENTIAL,
+    "predicate-pushdown stat computations that raised, keeping the row "
+    "group/stripe conservatively (io/scan.py); correctness is unaffected "
+    "but pruning silently degrades to a full scan")
+NUM_CLEANUP_ERRORS = register_metric(
+    "numCleanupErrors", COUNTER, ESSENTIAL,
+    "execution-context cleanup callbacks that raised during teardown "
+    "(exec/base.py run_cleanups) — each one is a potential buffer/file "
+    "handle leak")
+NUM_EXPORT_SCRAPE_ERRORS = register_metric(
+    "numExportScrapeErrors", COUNTER, ESSENTIAL,
+    "cluster observability scrapes that raised and reported zero wire "
+    "bytes instead (metrics/export.py) — dashboards silently flatline "
+    "when this moves")
+
 # retry-block counters: each `run_retryable(ctx, metrics, <block>)` call
 # site emits `<block>Retries` / `<block>Splits` (mem/retry.py with_retry)
 RETRY_BLOCKS = ("sort", "aggUpdate", "aggMerge", "joinBuild", "joinProbe",

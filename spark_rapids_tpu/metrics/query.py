@@ -162,7 +162,7 @@ class QueryExecution:
                 node.execute_partitions = wrap(node.execute_partitions,
                                                "partitions")
         except AttributeError:  # pragma: no cover - exotic nodes
-            pass
+            pass  # tpulint: disable=TPU006 a node without execute twins simply stays uninstrumented; metrics are additive
 
     def adopt(self, root=None) -> None:
         """Register plan nodes added by adaptive re-planning
@@ -229,7 +229,7 @@ class QueryExecution:
                     try:
                         self._trace_cm.__exit__(None, None, None)
                     except Exception:  # pragma: no cover - thread moved
-                        pass
+                        pass  # tpulint: disable=TPU006 trace-context exit after the owning thread moved on; the context is already unwound
                     self._trace_cm = None
                 pop_active(self.journal)
                 if self._owns_journal:

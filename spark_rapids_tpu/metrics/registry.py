@@ -58,6 +58,56 @@ class _SyncCounter:
 DEVICE_SYNCS = _SyncCounter()
 
 
+class EngineCounters:
+    """Process-wide named counters for engine-internal events that happen
+    OUTSIDE any operator's Metrics object — teardown paths, detection
+    fallbacks, swallowed-failure sites the exception-hygiene lint
+    (TPU006, docs/lint.md) requires to be counted.  Names go through the
+    same catalog as operator metrics, so a typo'd key fails TPU004 /
+    `python -m spark_rapids_tpu.metrics --lint` like any other emission
+    site."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = {}
+
+    def add(self, name: str, v: float = 1) -> None:
+        if not N.is_registered(name):
+            UNREGISTERED_SEEN.add(name)
+        with self._lock:
+            self._values[name] = self._values.get(name, 0) + v
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._values.get(name, 0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+
+#: the process-wide instance every hygiene site bumps
+ENGINE_COUNTERS = EngineCounters()
+
+
+def count_swallowed(name: str, logger_name: str, msg: str, *args,
+                    warn: bool = False) -> None:
+    """The canonical TPU006 fix shape in one call: a module-log line plus
+    a registered process counter (docs/lint.md).  `warn=True` for
+    downgrades an operator should act on (mis-sized pools, leaked
+    cleanups); the default debug level for teardown/fallback noise.
+    Counters are process-local — worker-side bumps surface in worker
+    logs, not the driver's scrape."""
+    import logging
+    log = logging.getLogger(logger_name)
+    (log.warning if warn else log.debug)(msg, *args)
+    ENGINE_COUNTERS.add(name, 1)
+
+
 def parse_level(value) -> int:
     s = str(value).strip().upper()
     for lvl, name in N.LEVEL_NAMES.items():
@@ -187,7 +237,7 @@ class Metrics:
             [jnp.sum(jnp.stack([jnp.asarray(x) for x in pend])
                      .astype(jnp.float64))
              for _name, pend in pending])
-        host = np.asarray(sums)  # the single device->host transfer
+        host = np.asarray(sums)  # tpulint: disable=TPU001 THE designed single device->host transfer of the lazy-metric fold; reporting paths sync once, hot loops never
         for (name, pend), v in zip(pending, host):
             self._values[name] = self._values.get(name, 0) + float(v)
             pend.clear()

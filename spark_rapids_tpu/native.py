@@ -148,8 +148,15 @@ class NativeAddressSpaceAllocator:
     def __del__(self):  # pragma: no cover
         try:
             self._lib.asalloc_destroy(self._h)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — finalizers must not raise
+            try:
+                from .metrics.registry import count_swallowed
+                count_swallowed("numNativeTeardownErrors",
+                                "spark_rapids_tpu.native",
+                                "asalloc_destroy failed for handle %r: %r",
+                                self._h, e)
+            except Exception:  # tpulint: disable=TPU006 interpreter may be tearing down; the counter itself is best-effort in __del__
+                pass
 
 
 def spill_write(path: str, data: np.ndarray) -> int:

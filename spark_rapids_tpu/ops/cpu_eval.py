@@ -604,7 +604,7 @@ def _cpu_cast(col: CpuCol, src: DataType, dst: DataType, n: int) -> CpuCol:
                                   .total_seconds() * 1_000_000)
                     valid[i] = True
             except (ValueError, OverflowError):
-                pass
+                pass  # tpulint: disable=TPU006 cast fallthrough: unparseable strings yield null by Spark semantics
         return vals, valid
     if dst is BooleanType:
         return v != 0, m

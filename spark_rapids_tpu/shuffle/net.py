@@ -522,7 +522,7 @@ class ShuffleSocketServer:
         try:
             self._listener.shutdown(socket.SHUT_RDWR)
         except OSError:
-            pass  # not connected / already gone — nothing to wake
+            pass  # not connected / already gone — nothing to wake  # tpulint: disable=TPU006 shutdown of an unconnected listener is the idle-server close path, not a failure
         try:
             self._listener.close()
         except OSError as e:

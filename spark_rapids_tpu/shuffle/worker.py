@@ -389,7 +389,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             sys.stdin.read()
         except Exception:  # noqa: BLE001
-            pass
+            pass  # tpulint: disable=TPU006 any stdin error IS the driver-death signal; the next line delivers it
         handler.shutdown_event.set()
 
     threading.Thread(target=stdin_watch, daemon=True).start()

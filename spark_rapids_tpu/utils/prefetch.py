@@ -42,7 +42,7 @@ class PrefetchIterator:
                     self._q.put(entry, timeout=0.25)
                     return True
                 except queue.Full:
-                    continue
+                    continue  # tpulint: disable=TPU006 bounded-put retry loop; the timeout exists to re-check _closed
             return False
 
         def pump():
@@ -58,7 +58,7 @@ class PrefetchIterator:
                     try:
                         it.close()
                     except Exception:  # noqa: BLE001 — teardown
-                        pass
+                        pass  # tpulint: disable=TPU006 close() of an abandoned source iterator after the consumer left
             offer((_STOP, None))
 
         self._thread = threading.Thread(target=pump, daemon=True,
@@ -86,4 +86,4 @@ class PrefetchIterator:
             while True:
                 self._q.get_nowait()
         except queue.Empty:
-            pass
+            pass  # tpulint: disable=TPU006 Empty is the drain loop's termination condition

@@ -117,15 +117,24 @@ def test_parse_level():
 # --------------------------------------------------------------------------
 
 def test_every_emitted_metric_name_is_registered():
-    from spark_rapids_tpu.metrics.__main__ import scan_emitted_names
-    sites = scan_emitted_names()
+    # migrated to the tpulint framework (TPU004): AST-based, so wrapped
+    # calls and journal kinds are covered too; `python -m
+    # spark_rapids_tpu.metrics --lint` delegates to the same pass
+    import os
+
+    import spark_rapids_tpu
+    from spark_rapids_tpu.lint.core import lint_paths
+    from spark_rapids_tpu.lint.passes.contracts import ContractsPass
+    pkg = os.path.dirname(spark_rapids_tpu.__file__)
+    cp = ContractsPass()
+    result = lint_paths(paths=[pkg], passes=[cp])
     # floor = a sanity check that the scanner still finds literal-name
     # sites at all (PR-3 unified the exchange read paths, dropping one
     # duplicated "exchangeFetch" retry-block site)
-    assert len(sites) >= 18, "lint scanner found suspiciously few sites"
-    bad = [(p, i, name) for p, i, name in sites
-           if not N.is_registered(name)]
-    assert not bad, f"unregistered metric names: {bad}"
+    assert cp.emission_sites >= 18, \
+        "lint scanner found suspiciously few emission sites"
+    assert not result.findings, \
+        f"metric/journal contract findings: {result.findings}"
 
 
 def test_no_unregistered_names_after_query_slice():

@@ -32,6 +32,29 @@ from spark_rapids_tpu.utils import faults
 
 pytestmark = pytest.mark.faultinject
 
+# The reserve-site sweep contract (tpulint TPU005, docs/lint.md): every
+# `site=` label a `reserve()` call in the package can emit.  The lint
+# cross-checks this tuple against the source tree in BOTH directions —
+# a new reserve site must be added here (so the injectOom sweeps know it
+# exists), and a removed site must be deleted (no stale coverage claims).
+# test_oom_injection_every_reserve_site_identical_results discovers the
+# subset a live slice query actually hits and replays each ordinal.
+OOM_SWEEP_SITES = (
+    "adaptive.demotedBuild",   # exec/shuffle_reader.py — AQE demoted build
+    "add_batch",               # mem/runtime.py — batch registration
+    "agg.merge",               # exec/aggregate.py — partial-state merge
+    "agg.update",              # exec/aggregate.py — per-batch update
+    "checkpoint",              # mem/retry.py — spillable input re-admit
+    "exchange.partition",      # exec/exchange.py — shuffle partitioning
+    "fetch_baseline",          # shuffle/manager.py — local baseline read
+    "join.build",              # exec/join.py — build side
+    "join.probe",              # exec/join.py — probe output
+    "materialize",             # mem/runtime.py — unspill re-admit
+    "sort",                    # exec/sort.py — device sort staging
+    "wholeStage",              # exec/whole_stage.py — fused stage
+    "wholeStage.op",           # exec/whole_stage.py — per-op fallback
+)
+
 
 # --------------------------------------------------------------------------
 # unit: with_retry / state machine / splitter
@@ -259,6 +282,10 @@ def test_oom_injection_every_reserve_site_identical_results():
     for expected in ("agg.update", "join.build", "join.probe",
                      "exchange.partition", "add_batch", "sort"):
         assert expected in sites, (expected, sites)
+    # and every discovered site is part of the sweep contract the lint
+    # (TPU005) checks against the source tree
+    unknown = set(sites) - set(OOM_SWEEP_SITES)
+    assert not unknown, f"reserve sites outside OOM_SWEEP_SITES: {unknown}"
     for ordinal in range(1, n_ops + 1):
         out = _slice_query({"spark.rapids.tpu.test.injectOom":
                             str(ordinal)})
