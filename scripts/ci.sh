@@ -112,6 +112,17 @@ python -m pytest tests/test_memledger.py -q -m "not slow" \
     -p no:cacheprovider
 echo "== memledger tier took $((SECONDS - T_MEM))s =="
 
+echo "== serve tier =="
+# serving tier (ISSUE 10): parameterized plan-cache hits must compile
+# nothing new on literal-variant re-submission, concurrent submissions
+# (including under OOM injection) must be bit-for-bit identical to
+# serial runs, per-query budgets must confine spill causality to the
+# over-budget query, and the scheduler's priority/admission/rejection
+# discipline + per-query semaphore attribution + journal routing hold
+T_SRV=$SECONDS
+python -m pytest tests/test_serve.py -q -m "not slow" -p no:cacheprovider
+echo "== serve tier took $((SECONDS - T_SRV))s =="
+
 echo "== tests (fast tier) =="
 T_TESTS=$SECONDS
 MARK="not slow"

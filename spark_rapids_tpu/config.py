@@ -703,6 +703,58 @@ MEM_LEDGER_SAMPLE_MS = _conf(
     "timeline).  OOM events always force a sample.  0 samples on every "
     "ledger event.", int)
 
+# --- serving tier (serve/: scheduler, admission, plan cache) -----------------
+SERVE_MAX_CONCURRENT = _conf(
+    "spark.rapids.sql.tpu.serve.maxConcurrentQueries", 4,
+    "Worker threads the session's QueryScheduler runs — the upper bound "
+    "on queries EXECUTING at once (TpuSession.submit).  Device occupancy "
+    "within an executing query is still bounded by "
+    "spark.rapids.sql.concurrentTpuTasks (the device semaphore); this "
+    "knob bounds how many queries overlap their host-side phases "
+    "(planning, scan decode, D2H) around it.", int)
+SERVE_QUEUE_CAPACITY = _conf(
+    "spark.rapids.sql.tpu.serve.queue.capacity", 256,
+    "Submitted-but-not-yet-admitted queries the scheduler will hold; a "
+    "submit() past this bound raises AdmissionRejected (counted in "
+    "numAdmissionRejections) instead of buffering without bound — "
+    "backpressure belongs at admission, not in the spill tier.", int)
+SERVE_ADMISSION_FRACTION = _conf(
+    "spark.rapids.sql.tpu.serve.admission.memoryFraction", 1.5,
+    "Fair-share admission bound: the sum of in-flight queries' declared/"
+    "estimated memory needs is kept under this fraction of the accounted "
+    "HBM pool (poolSizeBytes / allocFraction x detected HBM).  >1 "
+    "oversubscribes deliberately — estimates are peak, not resident, and "
+    "the spill tier absorbs overlap; <1 keeps headroom for unestimated "
+    "allocations.  A query whose need alone exceeds the bound is still "
+    "admitted when nothing else is in flight (progress over strictness).",
+    float)
+SERVE_DEFAULT_NEED = _conf(
+    "spark.rapids.sql.tpu.serve.defaultMemoryNeedBytes", 256 << 20,
+    "Memory need assumed for a submitted query when the caller declared "
+    "none and the planner's size estimate is unavailable (memory scans "
+    "of unknown size, exotic plans).", to_bytes)
+SERVE_QUERY_BUDGET = _conf(
+    "spark.rapids.sql.tpu.serve.queryBudgetBytes", 0,
+    "Per-query device-bytes budget enforced at reserve() time for "
+    "queries run through the scheduler: a query over its budget spills "
+    "its OWN buffers (never its neighbors'), then raises RetryOOM into "
+    "its own spill-retry/split/CPU-fallback ladder (numBudgetOoms).  "
+    "0 disables; size it ~poolSizeBytes / maxConcurrentQueries so "
+    "concurrent peaks cannot force cross-query eviction "
+    "(docs/tuning-guide.md, Concurrent serving).", to_bytes)
+SERVE_PLAN_CACHE_ENABLED = _conf(
+    "spark.rapids.sql.tpu.serve.planCache.enabled", True,
+    "Parameterized plan cache for scheduler-submitted queries "
+    "(serve/plan_cache.py): literals in row-local positions are lifted "
+    "into parameters, the normalized plan keys the cache, and parameter "
+    "values enter compiled whole-stage programs as runtime arguments — "
+    "so the 2nd..Nth literal-variant submission skips trace AND compile "
+    "(planCacheHits).  Blocking collect() paths are unaffected.",
+    _to_bool)
+SERVE_PLAN_CACHE_SIZE = _conf(
+    "spark.rapids.sql.tpu.serve.planCache.maxEntries", 128,
+    "LRU bound on distinct normalized plans the plan cache tracks.", int)
+
 # --- export -----------------------------------------------------------------
 EXPORT_COLUMNAR_RDD = _conf(
     "spark.rapids.sql.exportColumnarRdd", False,

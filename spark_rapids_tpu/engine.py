@@ -9,6 +9,7 @@ transitions) and executes the physical plan.
 from __future__ import annotations
 
 import sys
+import threading
 from typing import Dict, List, Optional, Sequence
 
 from . import config as C
@@ -50,6 +51,13 @@ class TpuSession:
         # delegates to its heartbeat monitor
         self._proc_cluster = None
         self._progress_high_water = 0
+        # serving tier (serve/scheduler.py): built lazily by submit();
+        # the locks make the lazy singletons and the session-cumulative
+        # counters safe under the scheduler's concurrent query threads
+        self._scheduler = None
+        self._serve_lock = threading.Lock()
+        self._finish_lock = threading.Lock()
+        self._lazy_lock = threading.RLock()  # runtime/cluster first touch
         _enable_compilation_cache(self.conf.get(C.COMPILATION_CACHE_DIR))
 
     def _begin_execution(self, physical: ExecNode, runtime=None):
@@ -67,11 +75,14 @@ class TpuSession:
         # stack regardless (QueryExecution.finish guarantees that part)
         try:
             qe.finish(error)
-            self.last_execution = qe
-            self.queries_executed += 1
-            for k, v in qe.aggregate().items():
-                self.query_metrics_total[k] = \
-                    self.query_metrics_total.get(k, 0) + v
+            with self._finish_lock:
+                # concurrent serving: N query threads finish at once;
+                # the read-modify-write counter folds must not race
+                self.last_execution = qe
+                self.queries_executed += 1
+                for k, v in qe.aggregate().items():
+                    self.query_metrics_total[k] = \
+                        self.query_metrics_total.get(k, 0) + v
             if self.conf.explain == "METRICS" and error is None:
                 print(qe.explain_with_metrics(), file=sys.stderr)
         except Exception:  # pragma: no cover - reporting is best-effort
@@ -109,30 +120,38 @@ class TpuSession:
     @property
     def runtime(self):
         if self._runtime is None:
-            from .mem.runtime import TpuRuntime
-            limit = None
-            if int(self.conf.get(C.CLUSTER_EXECUTORS)) > 1:
-                # cluster mode: the N executor pools already claim half of
-                # the session budget (plugin.TpuCluster); the driving
-                # session's compute pool takes the other half so combined
-                # accounting reflects ONE physical device, not two.
-                # configured_pool_bytes honors an explicit poolSizeBytes
-                # before falling back to allocFraction of detected HBM.
-                from .mem.runtime import configured_pool_bytes
-                limit = configured_pool_bytes(self.conf) // 2
-            self._runtime = TpuRuntime(self.conf, pool_limit_bytes=limit)
+            with self._lazy_lock:
+                if self._runtime is not None:
+                    return self._runtime
+                self._build_runtime()
         return self._runtime
+
+    def _build_runtime(self) -> None:
+        from .mem.runtime import TpuRuntime
+        limit = None
+        if int(self.conf.get(C.CLUSTER_EXECUTORS)) > 1:
+            # cluster mode: the N executor pools already claim half of
+            # the session budget (plugin.TpuCluster); the driving
+            # session's compute pool takes the other half so combined
+            # accounting reflects ONE physical device, not two.
+            # configured_pool_bytes honors an explicit poolSizeBytes
+            # before falling back to allocFraction of detected HBM.
+            from .mem.runtime import configured_pool_bytes
+            limit = configured_pool_bytes(self.conf) // 2
+        self._runtime = TpuRuntime(self.conf, pool_limit_bytes=limit)
 
     @property
     def cluster(self):
         """Multi-executor host-mode cluster, or None (plugin.TpuCluster;
         enabled by spark.rapids.sql.tpu.cluster.executors > 1)."""
         if getattr(self, "_cluster", None) is None:
-            if int(self.conf.get(C.CLUSTER_EXECUTORS)) > 1:
-                from .plugin import TpuCluster
-                self._cluster = TpuCluster(self.conf)
-            else:
-                self._cluster = False  # resolved: disabled
+            with self._lazy_lock:
+                if getattr(self, "_cluster", None) is None:
+                    if int(self.conf.get(C.CLUSTER_EXECUTORS)) > 1:
+                        from .plugin import TpuCluster
+                        self._cluster = TpuCluster(self.conf)
+                    else:
+                        self._cluster = False  # resolved: disabled
         return self._cluster or None
 
     def set(self, key: str, value) -> "TpuSession":
@@ -170,6 +189,98 @@ class TpuSession:
                 f: int(ps.get(f, 0))
                 for f in ("device_peak", "host_peak", "disk_peak")}
         return out
+
+    # -- serving tier (serve/) ----------------------------------------------
+    @property
+    def scheduler(self):
+        """The session's QueryScheduler, built on first submit() from the
+        spark.rapids.sql.tpu.serve.* confs; None before that."""
+        return self._scheduler
+
+    def submit(self, df, priority: int = 0,
+               memory_need: Optional[int] = None):
+        """Submit a DataFrame (or logical plan) for concurrent execution;
+        returns a serve.QueryFuture immediately.  Queries flow through
+        the priority queue, fair-share admission control, the
+        parameterized plan cache and a per-query memory budget
+        (docs/tuning-guide.md, Concurrent serving and plan caching);
+        the blocking collect() paths are unchanged."""
+        if self._scheduler is None:
+            with self._serve_lock:
+                if self._scheduler is None:
+                    from .serve.scheduler import QueryScheduler
+                    self._scheduler = QueryScheduler(self)
+        return self._scheduler.submit(df, priority=priority,
+                                      memory_need=memory_need)
+
+    def shutdown_serving(self, wait: bool = True) -> None:
+        """Stop the scheduler's workers (idempotent).  In-flight queries
+        finish; queued-but-never-admitted futures resolve with a
+        RuntimeError so nothing blocks forever in result()."""
+        with self._serve_lock:
+            sched = self._scheduler
+        if sched is not None:
+            sched.shutdown(wait=wait)
+
+    # -- execution core ------------------------------------------------------
+
+    def _collect_physical(self, physical, out_schema, *, budget_bytes=0,
+                          sched_attrs=None, future=None):
+        """Execute an already-planned physical tree to ONE pyarrow Table —
+        the shared body of DataFrame.to_arrow and the serving tier's
+        worker threads.  Installs the per-query observability scope, the
+        memory-ledger query scope (buffer ownership + optional budget)
+        and the device semaphore (wait time attributed to THIS query's
+        root-node metrics)."""
+        import pyarrow as pa
+        runtime = self.runtime
+        on_device = isinstance(physical, TpuExec)
+        # adaptive execution wraps at EXECUTE time (never in
+        # physical_plan()): map stages materialize first and the reduce
+        # side re-plans from observed sizes (adaptive/executor.py)
+        from .adaptive.executor import maybe_wrap_adaptive
+        physical = maybe_wrap_adaptive(physical, self.conf)
+        if on_device:
+            physical = B.DeviceToHostExec(physical)
+        qe = self._begin_execution(physical, runtime)
+        if future is not None:
+            future.query_id = qe.query_id
+        if sched_attrs and qe.journal is not None:
+            # the scheduling decision, journaled into THIS query's
+            # journal under its own trace context (kind `sched`)
+            qe.journal.instant("sched", "admitted", **sched_attrs)
+        ctx = ExecContext(self.conf, runtime=runtime,
+                          cluster=self.cluster, journal=qe.journal,
+                          query_execution=qe)
+        error = None
+        try:
+            with runtime.ledger.query_scope(f"q{qe.query_id}",
+                                            budget_bytes):
+                if on_device:
+                    # device semaphore: this "task" holds a device slot
+                    # for the duration of its device work (reference:
+                    # GpuSemaphore.acquireIfNecessary, released on task
+                    # completion).  Blocked-wait time lands on the
+                    # query's own root-node metrics, not the runtime
+                    # globals (per-query attribution under concurrency).
+                    with runtime.semaphore.held(metrics=physical.metrics):
+                        tables = list(physical.execute_cpu(ctx))
+                else:
+                    tables = list(physical.execute_cpu(ctx))
+        except BaseException as e:
+            error = e
+            raise
+        finally:
+            # task-completion cleanup, success or failure: releases
+            # resources operators registered (e.g. shuffle partitions
+            # orphaned by a mid-write error)
+            ctx.run_cleanups()
+            self._finish_execution(qe, error)
+        if not tables:
+            from .types import to_arrow
+            return pa.table({f.name: pa.array([], type=to_arrow(f.dtype))
+                             for f in out_schema})
+        return pa.concat_tables(tables)
 
     # -- planning -----------------------------------------------------------
     def plan(self, logical: L.LogicalPlan) -> ExecNode:
@@ -424,46 +535,8 @@ class DataFrame:
         return self.session.plan(self.plan)
 
     def to_arrow(self):
-        import pyarrow as pa
         physical = self.session.plan(self.plan)
-        runtime = self.session.runtime
-        on_device = isinstance(physical, TpuExec)
-        # adaptive execution wraps at EXECUTE time (never in
-        # physical_plan()): map stages materialize first and the reduce
-        # side re-plans from observed sizes (adaptive/executor.py)
-        from .adaptive.executor import maybe_wrap_adaptive
-        physical = maybe_wrap_adaptive(physical, self.session.conf)
-        if on_device:
-            physical = B.DeviceToHostExec(physical)
-        qe = self.session._begin_execution(physical, runtime)
-        ctx = ExecContext(self.session.conf, runtime=runtime,
-                          cluster=self.session.cluster, journal=qe.journal,
-                          query_execution=qe)
-        error = None
-        try:
-            if on_device:
-                # device semaphore: this "task" holds a device slot for the
-                # duration of its device work (reference:
-                # GpuSemaphore.acquireIfNecessary, released on task
-                # completion)
-                with runtime.semaphore.held():
-                    tables = list(physical.execute_cpu(ctx))
-            else:
-                tables = list(physical.execute_cpu(ctx))
-        except BaseException as e:
-            error = e
-            raise
-        finally:
-            # task-completion cleanup, success or failure: releases
-            # resources operators registered (e.g. shuffle partitions
-            # orphaned by a mid-write error)
-            ctx.run_cleanups()
-            self.session._finish_execution(qe, error)
-        if not tables:
-            from .types import to_arrow
-            return pa.table({f.name: pa.array([], type=to_arrow(f.dtype))
-                             for f in self.schema})
-        return pa.concat_tables(tables)
+        return self.session._collect_physical(physical, self.schema)
 
     def collect(self) -> List[tuple]:
         table = self.to_arrow()

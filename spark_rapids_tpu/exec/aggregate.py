@@ -852,10 +852,22 @@ class TpuHashAggregateExec(TpuExec):
                 # one vmapped program cannot vary across batches
                 return None, None
             pre_builder = child.batch_fn
-            pre_key = child.kernel_key()
+            pre_params = child.stage_params()
+            if pre_params:
+                # plan-cache parameters in the absorbed chain: value-free
+                # pre-key + the bound values as a leading traced argument
+                # of the whole-stage program, so literal-variant
+                # re-submissions replay this compiled program
+                from ..utils.kernel_cache import param_free_keys
+                with param_free_keys():
+                    pre_key = child.kernel_key()
+                pre_key += ("params", E.parameter_signature(pre_params))
+            else:
+                pre_key = child.kernel_key()
             source = child.children[0]
         else:
             pre_builder = None
+            pre_params = []
             pre_key = ()
             source = child
         # drain INCREMENTALLY: eligibility (leaf shapes, byte budget) is
@@ -908,6 +920,21 @@ class TpuHashAggregateExec(TpuExec):
             return jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *partial_list)
 
+        param_slots = [p.slot for p in pre_params]
+        pvals = E.parameter_values(pre_params) if pre_params else None
+
+        def _with_params(whole):
+            """Parameter-threaded twin: the bound values lead the leaf
+            arguments and install as the active binding while the program
+            traces (see exec/basic.bound_param_builder)."""
+            if not pre_params:
+                return whole
+
+            def whole_p(pv, *leaves):
+                with E.bound_params(dict(zip(param_slots, pv))):
+                    return whole(*leaves)
+            return whole_p
+
         def build():
             def whole(*leaves):
                 pre = pre_builder() if pre_builder is not None else None
@@ -919,7 +946,7 @@ class TpuHashAggregateExec(TpuExec):
                 partials = _unrolled(leaves, one)   # leaves [k, pcap, ...]
                 both = _flatten_stacked(partials, state_schema)
                 return finalize(merge(both))
-            return whole
+            return _with_params(whole)
 
         def build_bucket():
             bupdate = self._bucket_update_kernel
@@ -935,7 +962,7 @@ class TpuHashAggregateExec(TpuExec):
                 cleans, partials = outs
                 both = _flatten_stacked(partials, state_schema)
                 return jnp.all(cleans), finalize(merge(both))
-            return whole_bucket
+            return _with_params(whole_bucket)
 
         # treedef in the key: the per-batch structure is baked into the
         # compiled closure (tree_unflatten over bare leaves), so two
@@ -957,7 +984,8 @@ class TpuHashAggregateExec(TpuExec):
                     named_range("agg_whole_stage_bucket"):
                 from ..utils.kernel_cache import record_dispatch
                 record_dispatch()
-                all_clean, out = fnb(*all_leaves)
+                all_clean, out = (fnb(pvals, *all_leaves) if pre_params
+                                  else fnb(*all_leaves))
             if bool(all_clean):
                 self.metrics.add(MN.NUM_FUSED_STAGES, 1)
                 record_output_batch(self.metrics, out, ctx.runtime)
@@ -968,7 +996,7 @@ class TpuHashAggregateExec(TpuExec):
                 named_range("agg_whole_stage"):
             from ..utils.kernel_cache import record_dispatch
             record_dispatch()
-            out = fn(*all_leaves)
+            out = fn(pvals, *all_leaves) if pre_params else fn(*all_leaves)
         self.metrics.add(MN.NUM_FUSED_STAGES, 1)
         record_output_batch(self.metrics, out, ctx.runtime)
         return out, None
@@ -1057,7 +1085,9 @@ class TpuHashAggregateExec(TpuExec):
             child = self.children[0]
             if isinstance(child, RowLocalExec) \
                     and src_exec is child.children[0]:
-                child_fn = cached_kernel(child.kernel_key(), child.batch_fn)
+                # parameter-threaded like RowLocalExec.execute's plain
+                # path, so the replay shares the same compiled kernel
+                child_fn = child.parameterized_kernel()
                 input_iter = (child_fn(b) for b in upstream)
             else:
                 input_iter = upstream

@@ -35,6 +35,23 @@ def _pred_keep(col: Column):
     return jnp.logical_and(col.valid, col.data)
 
 
+def bound_param_builder(builder, slots):
+    """Wrap a batch_fn builder so the traced function takes the plan-cache
+    parameter values as ONE extra runtime argument (a tuple of device
+    scalars) and installs them as the active binding while the chain
+    traces — Parameter.eval then broadcasts tracers instead of baking
+    constants, so one compiled program serves every literal variant
+    (serve/plan_cache.py)."""
+    def build():
+        inner = builder()
+
+        def fn(batch, pvals):
+            with E.bound_params(dict(zip(slots, pvals))):
+                return inner(batch)
+        return fn
+    return build
+
+
 class TpuScanMemoryExec(TpuExec):
     """In-memory arrow table scan -> device batches (the H2D edge)."""
 
@@ -122,6 +139,37 @@ class RowLocalExec(TpuExec):
     def _needs_input_file(self) -> bool:
         return any(E.tree_needs_input_file(e) for e in self.expressions())
 
+    def stage_params(self) -> list:
+        """Plan-cache Parameters in this operator's expressions, slot
+        order (serve/plan_cache.py lifts literals into these)."""
+        return E.collect_parameters(self.expressions())
+
+    def parameterized_kernel(self, extra_key: tuple = ()):
+        """The cached jitted per-batch kernel as a batch->batch callable,
+        with plan-cache parameters threaded as runtime arguments when
+        present.  With parameters the cache key is VALUE-FREE (slot +
+        dtype) and the current bound values ride into every dispatch, so
+        a literal-variant re-submission reuses the compiled program; with
+        no parameters this is exactly `cached_kernel(kernel_key(),
+        batch_fn)`."""
+        from ..utils.kernel_cache import cached_kernel, param_free_keys
+        params = self.stage_params()
+        if not params:
+            return cached_kernel(self.kernel_key() + tuple(extra_key),
+                                 self.batch_fn)
+        with param_free_keys():
+            key = self.kernel_key()
+        key += tuple(extra_key) + (
+            "params", E.parameter_signature(params))
+        slots = [p.slot for p in params]
+        pvals = E.parameter_values(params)
+        inner = cached_kernel(key, bound_param_builder(self.batch_fn,
+                                                       slots))
+
+        def call(batch, _inner=inner, _pvals=pvals):
+            return _inner(batch, _pvals)
+        return call
+
     def cpu_twin(self, child: ExecNode) -> ExecNode:
         """CPU twin of THIS operator over `child` — the per-operator
         fallback unit the whole-stage retry ladder degrades to
@@ -171,7 +219,11 @@ class RowLocalExec(TpuExec):
                 record_output_batch(self.metrics, out, ctx.runtime)
                 yield out
             return
-        fn = cached_kernel(key, self.batch_fn)
+        # plain path: parameter-threaded when the plan cache lifted
+        # literals here (the row_offset / input_file paths above keep
+        # value-inclusive keys — their per-batch key composition already
+        # recompiles per constant, so baked Parameter values stay correct)
+        fn = self.parameterized_kernel()
         for batch in self.children[0].execute(ctx):
             with self.metrics.timer(MN.TOTAL_TIME), named_range(self.name):
                 record_dispatch()

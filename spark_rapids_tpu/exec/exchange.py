@@ -331,11 +331,13 @@ class TpuShuffleExchangeExec(TpuExec):
             return None
         return child
 
-    def _fused_partition_fn(self, stage):
+    def _fused_partition_fn(self, stage, param_slots=None):
         """Builder of the fused (chain + partition-ids) program:
         batch -> (chain output batch, per-row partition ids).  `start` is
         the round-robin offset, traced so every map task shares one
-        compiled program."""
+        compiled program.  With `param_slots` the program takes the
+        plan-cache parameter values as a trailing traced argument
+        (exec/basic.bound_param_builder rationale)."""
         n = self.num_partitions
         mode = self.mode
         keys = self.keys
@@ -352,7 +354,14 @@ class TpuShuffleExchangeExec(TpuExec):
                 else:  # round_robin
                     pids = round_robin_partition_ids(ob.capacity, n, start)
                 return ob, pids
-            return fn
+            if param_slots is None:
+                return fn
+            from ..ops import expressions as PE
+
+            def fn_p(b, start, pvals):
+                with PE.bound_params(dict(zip(param_slots, pvals))):
+                    return fn(b, start)
+            return fn_p
         return build
 
     def _write_phase(self, ctx: ExecContext, n: int, write) -> None:
@@ -392,15 +401,39 @@ class TpuShuffleExchangeExec(TpuExec):
         part_split = split_batch_rows
         fused_key = None
         fused_build = None
+        fused_pvals = None
         if fused_stage is not None:
             import jax.numpy as jnp
             from ..metrics import names as MNN
-            from ..utils.kernel_cache import (expr_key, record_dispatch,
+            from ..ops import expressions as PE
+            from ..utils.kernel_cache import (expr_key, param_free_keys,
+                                              record_dispatch,
                                               stage_executable)
-            fused_key = ("exchange_fused", self.mode, n,
-                         fused_stage.kernel_key(),
-                         tuple(expr_key(k) for k in self.keys))
-            fused_build = self._fused_partition_fn(fused_stage)
+            # parameters can live in the fused chain AND in the partition
+            # key expressions (a guard-lifted join-condition literal ends
+            # up in the exchange's hash keys): the value-free key below
+            # covers BOTH, so both must be in the traced binding — a
+            # key-expression parameter left out would bake the first
+            # submission's value into the replayed partition-id program
+            # and misroute rows on later variants
+            fused_params = PE.collect_parameters(
+                fused_stage.expressions() + list(self.keys))
+            if fused_params:
+                with param_free_keys():
+                    fused_key = ("exchange_fused", self.mode, n,
+                                 fused_stage.kernel_key(),
+                                 tuple(expr_key(k) for k in self.keys))
+                fused_key += ("params",
+                              PE.parameter_signature(fused_params))
+                fused_pvals = PE.parameter_values(fused_params)
+                fused_slots = [p.slot for p in fused_params]
+                fused_build = self._fused_partition_fn(
+                    fused_stage, param_slots=fused_slots)
+            else:
+                fused_key = ("exchange_fused", self.mode, n,
+                             fused_stage.kernel_key(),
+                             tuple(expr_key(k) for k in self.keys))
+                fused_build = self._fused_partition_fn(fused_stage)
             fused_stage.metrics.add(MNN.NUM_FUSED_STAGES, 1)
             if not fused_stage._can_split():
                 part_split = None
@@ -416,14 +449,16 @@ class TpuShuffleExchangeExec(TpuExec):
                             ctx.runtime.reserve(
                                 fused_stage._reserve_estimate(b),
                                 site="exchange.partition")
+                        args = (b, jnp.int32(map_id))
+                        if fused_pvals is not None:
+                            args += (fused_pvals,)
                         fn = stage_executable(
-                            fused_key, fused_build,
-                            (b, jnp.int32(map_id)),
+                            fused_key, fused_build, args,
                             metrics=fused_stage.metrics,
                             name=f"exchangeStage-"
                                  f"{fused_stage.stage_id}")
                         record_dispatch()
-                        ob, pids = fn(b, jnp.int32(map_id))
+                        ob, pids = fn(*args)
                         record_output_batch(fused_stage.metrics, ob,
                                             ctx.runtime)
                         return list(split_by_partition(ob, pids, n))

@@ -124,10 +124,27 @@ class TpuWholeStageExec(FusedPipelineExec):
         if self._needs_row_offset() or self._needs_input_file():
             yield from RowLocalExec.execute(self, ctx)
             return
-        from ..utils.kernel_cache import record_dispatch, stage_executable
+        from ..utils.kernel_cache import (param_free_keys, record_dispatch,
+                                          stage_executable)
         from .retryable import run_retryable
         from ..mem.retry import RetryExhausted, split_batch_rows
-        key = self.kernel_key() + ("whole_stage_exec",)
+        from ..ops import expressions as E
+        from .basic import bound_param_builder
+        params = self.stage_params()
+        if params:
+            # plan-cache parameters: value-free stage key + the bound
+            # values as a traced argument, so a literal-variant
+            # re-submission reuses this stage's compiled executable
+            with param_free_keys():
+                key = self.kernel_key() + ("whole_stage_exec",)
+            key += ("params", E.parameter_signature(params))
+            slots = [p.slot for p in params]
+            pvals = E.parameter_values(params)
+            builder = bound_param_builder(self.batch_fn, slots)
+        else:
+            key = self.kernel_key() + ("whole_stage_exec",)
+            pvals = None
+            builder = self.batch_fn
         split = split_batch_rows if self._can_split() else None
         self.metrics.add(MN.NUM_FUSED_STAGES, 1)
         n_batches = 0
@@ -136,11 +153,12 @@ class TpuWholeStageExec(FusedPipelineExec):
             if ctx.runtime is not None:
                 ctx.runtime.reserve(self._reserve_estimate(b),
                                     site="wholeStage")
-            fn = stage_executable(key, self.batch_fn, (b,),
+            args = (b,) if pvals is None else (b, pvals)
+            fn = stage_executable(key, builder, args,
                                   metrics=self.metrics,
                                   name=f"wholeStage-{self.stage_id}")
             record_dispatch()
-            return fn(b)
+            return fn(*args)
 
         for batch in self.children[0].execute(ctx):
             n_batches += 1
@@ -172,16 +190,17 @@ class TpuWholeStageExec(FusedPipelineExec):
         by the PR-1 cpuFallbackOnOom conf).  Split pieces flow through
         the remaining operators independently."""
         from .. import config as C
-        from ..utils.kernel_cache import cached_kernel, record_dispatch
+        from ..utils.kernel_cache import record_dispatch
         from .retryable import run_retryable
         from ..mem.retry import RetryExhausted, split_batch_rows
         cpu_ok = bool(ctx.conf.get(C.OOM_CPU_FALLBACK))
         batches = [batch]
         for op in self.stages:
-            # plain kernel key: byte-identical to the program
-            # RowLocalExec.execute caches, so a de-fuse under memory
-            # pressure reuses any already-compiled per-op kernel
-            fn = cached_kernel(op.kernel_key(), op.batch_fn)
+            # same kernel construction as RowLocalExec.execute's plain
+            # path (parameter-threaded when the plan cache lifted
+            # literals into this op), so a de-fuse under memory pressure
+            # reuses any already-compiled per-op kernel
+            fn = op.parameterized_kernel()
             pre = op.metrics.snapshot()
             op_split = (split_batch_rows
                         if not isinstance(op, TpuExpandExec) else None)

@@ -72,6 +72,24 @@ class _Reservation:
         self.emitted = False
 
 
+class QueryScope:
+    """One query's memory identity on this runtime (serving tier).
+
+    Installed thread-locally around a query's execution
+    (`ledger.query_scope(...)`): buffers registered while it is active
+    carry `owner=query`, and a non-zero `budget` makes `reserve()`
+    enforce a per-query device-bytes cap — over-budget reservations
+    first spill the query's OWN buffers, then raise RetryOOM into the
+    query's own retry ladder.  One hog spills itself, not its
+    neighbors."""
+
+    __slots__ = ("query", "budget")
+
+    def __init__(self, query: str, budget: int = 0):
+        self.query = query
+        self.budget = max(0, int(budget or 0))
+
+
 class MemoryLedger:
     """Per-runtime allocation ledger (one per TpuRuntime/process)."""
 
@@ -108,6 +126,36 @@ class MemoryLedger:
     def current_reservation(self) -> Optional[_Reservation]:
         st = getattr(self._tls, "stack", None)
         return st[-1] if st else None
+
+    # -- per-query scope (serving tier) --------------------------------------
+
+    @contextlib.contextmanager
+    def query_scope(self, query: str, budget: int = 0):
+        """Install `query` as the owning query for buffers this thread
+        registers (and, with budget > 0, the reserve()-enforced device
+        cap).  Nests: inner scopes shadow outer ones (a CPU-fallback
+        re-execution keeps the parent query's identity unless re-scoped).
+        Active even when the ledger is disabled — ownership accounting
+        is what budgets/admission are built on, journaling is not."""
+        prev = getattr(self._tls, "qscope", None)
+        self._tls.qscope = QueryScope(query, budget)
+        try:
+            yield self._tls.qscope
+        finally:
+            self._tls.qscope = prev
+
+    def current_query_scope(self) -> Optional[QueryScope]:
+        return getattr(self._tls, "qscope", None)
+
+    def current_query(self) -> Optional[str]:
+        """Owning query id for buffers registered by this thread: the
+        explicit query scope when one is installed, else the distributed
+        trace context's query (worker tasks carry the driver's)."""
+        scope = getattr(self._tls, "qscope", None)
+        if scope is not None:
+            return scope.query
+        ctx = current_trace()
+        return ctx[0] if ctx else None
 
     def _trace_attrs(self) -> dict:
         ctx = current_trace()
@@ -192,18 +240,23 @@ class MemoryLedger:
     # -- event hooks ---------------------------------------------------------
 
     def on_alloc(self, buffer_id: int, nbytes: int,
-                 site: Optional[str] = None) -> None:
+                 site: Optional[str] = None,
+                 owner: Optional[str] = None) -> None:
         """A batch was registered in the device store.  `site` is the
         registration path ("add_batch", "checkpoint"); the reservation
         that admitted the bytes has already closed by the time the store
         registers them, so callers pass it explicitly and the enclosing
-        reservation (if any) is only the fallback."""
+        reservation (if any) is only the fallback.  `owner` is the
+        registering query (serving tier per-query accounting)."""
         if not self.enabled:
             return
         if site is None:
             res = self.current_reservation()
             site = res.site if res is not None else None
-        self._emit("alloc", buffer=buffer_id, bytes=nbytes, site=site)
+        attrs = dict(buffer=buffer_id, bytes=nbytes, site=site)
+        if owner is not None:
+            attrs["owner"] = owner
+        self._emit("alloc", **attrs)
 
     def on_free(self, buffer_id: int, nbytes: int, tier) -> None:
         if not self.enabled:
@@ -213,10 +266,14 @@ class MemoryLedger:
         self._emit("free", buffer=buffer_id, bytes=nbytes,
                    tier=_tier_name(tier))
 
-    def on_spill(self, buffer_id: int, nbytes: int, src, dst) -> None:
+    def on_spill(self, buffer_id: int, nbytes: int, src, dst,
+                 owner: Optional[str] = None) -> None:
         """One buffer migrated DOWN a tier (stores._spill_one).  Links to
         the innermost in-flight reservation (the cause) and detects
-        live churn: a device buffer spilled again after an unspill."""
+        live churn: a device buffer spilled again after an unspill.
+        `owner` = the victim buffer's owning query, so budget-confined
+        spill causality is checkable offline (a spill's owner should
+        match its cause's query when per-query budgets are on)."""
         if not self.enabled:
             return
         respill = False
@@ -230,6 +287,8 @@ class MemoryLedger:
         res = self.current_reservation()
         attrs = dict(buffer=buffer_id, bytes=nbytes,
                      src=_tier_name(src), dst=_tier_name(dst))
+        if owner is not None:
+            attrs["owner"] = owner
         if respill:
             attrs["respill"] = True
         if res is not None:
@@ -259,15 +318,20 @@ class MemoryLedger:
         self._emit("unspill", **attrs)
 
     def on_oom_spill(self, alloc_size: int, spilled: int, store_size: int,
-                     limit: Optional[int] = None) -> dict:
+                     limit: Optional[int] = None,
+                     budget_owner: Optional[str] = None) -> dict:
         """One on_alloc_failure round finished its synchronous spill.
         Returns the attrs journaled (site, cause rid, per-round victim
-        ids) so the event handler can reuse them."""
+        ids) so the event handler can reuse them.  `budget_owner` marks
+        a PER-QUERY budget enforcement round (victims confined to that
+        query's buffers) as opposed to a global-pool round."""
         res = self.current_reservation() if self.enabled else None
         attrs = dict(alloc_size=alloc_size, spilled_bytes=spilled,
                      store_size=store_size)
         if limit is not None:
             attrs["limit"] = limit
+        if budget_owner is not None:
+            attrs["budget_owner"] = budget_owner
         if res is not None:
             self._ensure_reservation_emitted(res)
             victims = res.victims[res.mark:]
@@ -278,16 +342,20 @@ class MemoryLedger:
         return attrs
 
     def on_oom_fail(self, site: str, nbytes: int, used: int,
-                    limit: int) -> None:
+                    limit: int, budget_owner: Optional[str] = None
+                    ) -> None:
         """reserve() is about to raise RetryOOM: the pool could not be
         brought under budget.  `used + nbytes - limit` is the headroom
         this failure needed — what the offline analyzer's headroom
-        estimate folds over."""
+        estimate folds over.  `budget_owner` marks a PER-QUERY budget
+        failure (that query's device bytes, not the global pool)."""
         if not self.enabled:
             return
         res = self.current_reservation()
         attrs = dict(site=site, bytes=nbytes, used=used, limit=limit,
                      shortfall=max(0, used + nbytes - limit))
+        if budget_owner is not None:
+            attrs["budget_owner"] = budget_owner
         if res is not None:
             self._ensure_reservation_emitted(res)
             attrs["cause"] = res.rid

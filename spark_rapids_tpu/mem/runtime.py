@@ -187,6 +187,13 @@ class TpuRuntime:
         faults.INJECTOR.on_reserve(site, nbytes)
         self.event_handler.retry_count = 0  # fresh allocation attempt
         with self.ledger.reservation(site, nbytes):
+            # serving-tier per-query budget (mem/ledger.py QueryScope):
+            # enforced FIRST and confined to the query's own buffers, so
+            # a hog hits its cap and spills itself before it can push
+            # the shared pool into spilling its neighbors
+            scope = self.ledger.current_query_scope()
+            if scope is not None and scope.budget > 0:
+                self._enforce_query_budget(scope, nbytes, site)
             for _ in range(8):  # bounded retry loop
                 used = self.device_store.current_size
                 if used + nbytes <= self.pool_limit:
@@ -204,6 +211,44 @@ class TpuRuntime:
                     f"HBM pool exhausted at {site}: need {nbytes}B, used "
                     f"{used}B of {self.pool_limit}B and nothing left to "
                     f"spill", nbytes=nbytes)
+
+    def _enforce_query_budget(self, scope, nbytes: int, site: str) -> None:
+        """Per-query device-bytes cap (serving tier): spill the query's
+        OWN buffers down to budget, then raise RetryOOM into ITS retry
+        ladder (spill-retry -> split -> CPU fallback) — the existing
+        machinery, scoped to one query.  Victim selection never touches
+        other queries' buffers, so the ledger's spill causality chains
+        stay within the over-budget query (tests assert this)."""
+        owner, budget = scope.query, scope.budget
+        target = max(0, budget - nbytes)
+        for _ in range(8):  # bounded like the global loop below
+            used = self.device_store.owner_size(owner)
+            if used + nbytes <= budget:
+                return
+            if not self.oom_spill:
+                break
+            store_size = self.device_store.current_size
+            spilled = self.device_store.synchronous_spill(target,
+                                                          owner=owner)
+            extra = self.ledger.on_oom_spill(nbytes, spilled, store_size,
+                                             limit=budget,
+                                             budget_owner=owner)
+            journal_event("spill", "oomSpill", alloc_size=nbytes,
+                          spilled_bytes=spilled, store_size=store_size,
+                          site=site, budget_owner=owner,
+                          **{k: v for k, v in extra.items()
+                             if k in ("cause", "victims")})
+            if spilled <= 0:
+                break
+        used = self.device_store.owner_size(owner)
+        if used + nbytes > budget:
+            self.metrics.add(MN.NUM_BUDGET_OOMS, 1)
+            self.ledger.on_oom_fail(site, nbytes, used, budget,
+                                    budget_owner=owner)
+            raise RetryOOM(
+                f"per-query budget exhausted for {owner} at {site}: need "
+                f"{nbytes}B, query holds {used}B of its {budget}B budget "
+                "and has nothing of its own left to spill", nbytes=nbytes)
 
     # ---- spillable batch registry ------------------------------------------
 

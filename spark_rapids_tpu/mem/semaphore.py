@@ -27,12 +27,18 @@ class TpuSemaphore:
     def _key(self, task_id=None) -> int:
         return task_id if task_id is not None else threading.get_ident()
 
-    def acquire_if_necessary(self, task_id=None) -> None:
+    def acquire_if_necessary(self, task_id=None, metrics=None) -> None:
         """Block until this task holds a device slot; re-entrant per task
         (GpuSemaphore.acquireIfNecessary).  Time spent BLOCKED (slot
-        contention, never the fast path) accumulates into the runtime's
-        semaphoreWaitTime metric — the reference's semaphore-wait
-        SQLMetric."""
+        contention, never the fast path) accumulates into the
+        semaphoreWaitTime metric of the ACQUIRING query when the caller
+        passes its per-query `metrics` (the engine passes the executed
+        root node's) — under concurrent serving, a runtime-global timer
+        would charge one slow query's wait to every query.  Without a
+        per-query sink the runtime Metrics keeps the old behavior.  The
+        blocked wait is also journaled under the acquiring thread's
+        trace context, so the queue-vs-device-wait split is visible per
+        query in the timeline."""
         key = self._key(task_id)
         waited = None
         with self._cond:
@@ -45,10 +51,22 @@ class TpuSemaphore:
                     import time
                     waited = time.perf_counter()
                 self._cond.wait()
-        if waited is not None and self.metrics is not None:
+        if waited is not None:
             import time
-            self.metrics.add("semaphoreWaitTime",
-                             time.perf_counter() - waited)
+            elapsed = time.perf_counter() - waited
+            sink = metrics if metrics is not None else self.metrics
+            if sink is not None:
+                sink.add("semaphoreWaitTime", elapsed)
+            from ..metrics.journal import current_trace, journal_event
+            ctx = current_trace()
+            attrs = {"seconds": round(elapsed, 6)}
+            if ctx:
+                q, _st, _sp, ex = (tuple(ctx) + (None,) * 4)[:4]
+                if q is not None:
+                    attrs["q"] = q
+                if ex is not None:
+                    attrs["ex"] = ex
+            journal_event("metric", "semaphoreWait", **attrs)
 
     def release_if_necessary(self, task_id=None) -> None:
         """Give the slot back (e.g. while the task does host-side I/O)."""
@@ -76,11 +94,12 @@ class TpuSemaphore:
             return len(self._holders)
 
     class _Held:
-        def __init__(self, sem, task_id):
-            self.sem, self.task_id = sem, task_id
+        def __init__(self, sem, task_id, metrics=None):
+            self.sem, self.task_id, self.metrics = sem, task_id, metrics
 
         def __enter__(self):
-            self.sem.acquire_if_necessary(self.task_id)
+            self.sem.acquire_if_necessary(self.task_id,
+                                          metrics=self.metrics)
             return self
 
         def __exit__(self, *a):
@@ -88,5 +107,5 @@ class TpuSemaphore:
             # depth the task holds, silently releasing an enclosing held()
             self.sem.release_if_necessary(self.task_id)
 
-    def held(self, task_id=None) -> "_Held":
-        return TpuSemaphore._Held(self, task_id)
+    def held(self, task_id=None, metrics=None) -> "_Held":
+        return TpuSemaphore._Held(self, task_id, metrics=metrics)

@@ -128,6 +128,11 @@ class SpillableBuffer:
         self.meta = meta
         self.spill_priority = spill_priority
         self.tier = StorageTier.DEVICE
+        # owning query (serving tier, mem/ledger.py QueryScope): set at
+        # registration from the thread's active query scope; per-query
+        # budgets account and spill by this tag.  None = unowned
+        # (single-query sessions, helper threads).
+        self.owner = None
         self.ref_count = 0
         self.freed = False
         # guards ref_count and tier migration: spilling re-checks ref_count
@@ -174,6 +179,9 @@ class BufferStore:
             self._priority_of)
         self._size = 0
         self._peak = 0
+        # per-owning-query tracked bytes (serving-tier budgets); entries
+        # die when they reach zero, so idle sessions cost nothing
+        self._owner_sizes: Dict[str, int] = {}
         self._lock = threading.RLock()
 
     def _priority_of(self, buffer_id: int) -> float:
@@ -205,14 +213,39 @@ class BufferStore:
             self._size += buf.size_bytes
             if self._size > self._peak:
                 self._peak = self._size
+            if buf.owner is not None:
+                self._owner_sizes[buf.owner] = \
+                    self._owner_sizes.get(buf.owner, 0) + buf.size_bytes
             buf.tier = self.tier
 
     def untrack(self, buf: SpillableBuffer) -> None:
         with self._lock:
-            if buf.id in self._buffers:
-                del self._buffers[buf.id]
-                self._queue.remove(buf.id)
-                self._size -= buf.size_bytes
+            self._untrack_locked(buf)
+
+    def _untrack_locked(self, buf: SpillableBuffer) -> None:
+        """Drop a buffer from this store's tracking structures — the ONE
+        place size AND owner bookkeeping decrement, so every removal
+        path (untrack, synchronous_spill's victim pop) stays balanced
+        against track()'s increments."""
+        if buf.id in self._buffers:
+            del self._buffers[buf.id]
+            self._queue.remove(buf.id)
+            self._size -= buf.size_bytes
+            if buf.owner is not None:
+                left = self._owner_sizes.get(buf.owner, 0) \
+                    - buf.size_bytes
+                if left > 0:
+                    self._owner_sizes[buf.owner] = left
+                else:
+                    self._owner_sizes.pop(buf.owner, None)
+
+    def owner_size(self, owner: Optional[str]) -> int:
+        """Bytes this store tracks for one owning query (0 for None —
+        unowned buffers never count against a budget)."""
+        if owner is None:
+            return 0
+        with self._lock:
+            return self._owner_sizes.get(owner, 0)
 
     def update_priority(self, buf: SpillableBuffer, priority: float) -> None:
         with self._lock:
@@ -220,22 +253,27 @@ class BufferStore:
             if buf.id in self._buffers:
                 self._queue.update_priority(buf.id)
 
-    def synchronous_spill(self, target_size: int) -> int:
+    def synchronous_spill(self, target_size: int,
+                          owner: Optional[str] = None) -> int:
         """Migrate lowest-priority unreferenced buffers to the next tier
         until this store holds <= target_size bytes.  Returns bytes spilled
         (RapidsBufferStore.synchronousSpill, RapidsBufferStore.scala:141-241).
-        """
+        With `owner`, both the size bound and the victim pool are confined
+        to that query's buffers — per-query budget enforcement spills the
+        hog itself, never its neighbors (mem/ledger.py QueryScope)."""
         spilled = 0
         while True:
             with self._lock:
-                if self._size <= target_size:
+                cur = self._size if owner is None \
+                    else self._owner_sizes.get(owner, 0)
+                if cur <= target_size:
                     return spilled
-                victim = self._pick_victim()
+                victim = self._pick_victim(owner)
                 if victim is None:
                     return spilled  # nothing spillable (all referenced)
-                self._buffers.pop(victim.id)
-                self._queue.remove(victim.id)
-                self._size -= victim.size_bytes
+                # balanced removal (size AND owner bytes): the requeue
+                # paths below re-track(), which re-increments both
+                self._untrack_locked(victim)
             # migrate outside the store lock, pinned by the buffer lock; the
             # timeout bounds any cross-wait with a concurrent reader
             if not victim.lock.acquire(timeout=1.0):
@@ -252,8 +290,10 @@ class BufferStore:
             finally:
                 victim.lock.release()
 
-    def _pick_victim(self) -> Optional[SpillableBuffer]:
-        # scan from the head of the priority queue for an unreferenced buffer
+    def _pick_victim(self, owner: Optional[str] = None
+                     ) -> Optional[SpillableBuffer]:
+        # scan from the head of the priority queue for an unreferenced
+        # buffer (owned by `owner`, when confined)
         skipped: List[int] = []
         victim = None
         while True:
@@ -261,7 +301,7 @@ class BufferStore:
             if bid is None:
                 break
             b = self._buffers[bid]
-            if b.ref_count == 0:
+            if b.ref_count == 0 and (owner is None or b.owner == owner):
                 victim = b
                 break
             skipped.append(bid)
@@ -284,7 +324,7 @@ class BufferStore:
             # back).  Emitted AFTER the migration so the record only
             # ever describes a spill that actually happened.
             ledger.on_spill(buf.id, buf.size_bytes, self.tier,
-                            self.spill_store.tier)
+                            self.spill_store.tier, owner=buf.owner)
 
     def _release_payload_to(self, buf: SpillableBuffer,
                             dest: "BufferStore") -> None:
@@ -306,14 +346,18 @@ class DeviceMemoryStore(BufferStore):
                          leaves_size)
         buf = SpillableBuffer(bid, meta, spill_priority)
         buf.device_batch = batch
+        ledger = getattr(self.catalog, "ledger", None)
+        if ledger is not None:
+            # owning query (serving tier): the thread's active query
+            # scope — stamped BEFORE track() so owner accounting sees it
+            buf.owner = ledger.current_query()
         self.track(buf)
         self.catalog.register(buf)
-        ledger = getattr(self.catalog, "ledger", None)
         if ledger is not None:
             # `site` labels the registration path (runtime.add_batch vs
             # a retry-block checkpoint) — the admitting reserve() has
             # already returned, so the label must ride in explicitly
-            ledger.on_alloc(bid, leaves_size, site=site)
+            ledger.on_alloc(bid, leaves_size, site=site, owner=buf.owner)
         return buf
 
     def _release_payload_to(self, buf: SpillableBuffer,

@@ -226,6 +226,23 @@ def session_observability(session) -> dict:
     from .registry import ENGINE_COUNTERS
     out["engine_counters"] = {k: int(v) for k, v in
                               ENGINE_COUNTERS.snapshot().items()}
+    # serving tier (ISSUE 10): scheduler/admission/plan-cache rollup —
+    # present only when the session ever ran submit(); the queue/
+    # admission METRICS (queueTime, numAdmitted, planCacheHits, ...) live
+    # on the runtime Metrics and already ride pool_stats/prometheus
+    sched = getattr(session, "_scheduler", None)
+    if sched is not None:
+        out["scheduler"] = sched.stats()
+        if session._runtime is not None:
+            pool = session.runtime.pool_stats()
+            out["scheduler"]["queue_time_s"] = \
+                float(pool.get(N.QUEUE_TIME, 0.0))
+            out["scheduler"]["planCacheHits"] = \
+                int(pool.get(N.PLAN_CACHE_HITS, 0))
+            out["scheduler"]["planCacheMisses"] = \
+                int(pool.get(N.PLAN_CACHE_MISSES, 0))
+            out["scheduler"]["numBudgetOoms"] = \
+                int(pool.get(N.NUM_BUDGET_OOMS, 0))
     return out
 
 

@@ -62,7 +62,13 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # unspill/oomSpill/oomFail) causally linked by reservation
                # id, or a sampled per-tier 'pressure' snapshot — the
                # input of `python -m spark_rapids_tpu.metrics --memory`
-               "mem")
+               "mem",
+               # sched = one serving-tier scheduling decision for THIS
+               # query (serve/scheduler.py): the 'admitted' instant
+               # carries queue time, priority, declared memory need and
+               # the plan-cache outcome, journaled into the query's own
+               # journal under its trace context
+               "sched")
 
 
 class EventJournal:
@@ -288,27 +294,48 @@ def validate_events(events: List[dict]) -> List[str]:
 # through every signature.  A stack supports nested queries (a CPU-fallback
 # re-execution inside a parent query keeps appending to the parent's
 # journal once its own finishes).
+#
+# Thread routing (serving tier): with N queries in flight each pushes its
+# journal from its own worker thread, so "top of one global stack" would
+# interleave every query's deep-layer events into whichever journal was
+# pushed last.  Entries therefore remember their pushing thread:
+# active_journal() prefers the innermost journal pushed by the CALLING
+# thread, then the process trace shard (which serves every thread by
+# design), then — preserving the old behavior for helper threads that
+# journal on a query's behalf (codec pools, async verifiers) — the
+# newest entry overall.
 
-_ACTIVE: List[EventJournal] = []
+_ACTIVE: List[tuple] = []  # (pushing thread id, journal)
 _ACTIVE_LOCK = threading.Lock()
 
 
 def push_active(journal: Optional[EventJournal]) -> None:
     if journal is not None:
         with _ACTIVE_LOCK:
-            _ACTIVE.append(journal)
+            _ACTIVE.append((threading.get_ident(), journal))
 
 
 def pop_active(journal: Optional[EventJournal]) -> None:
     if journal is not None:
         with _ACTIVE_LOCK:
-            if journal in _ACTIVE:
-                _ACTIVE.remove(journal)
+            for i in range(len(_ACTIVE) - 1, -1, -1):
+                if _ACTIVE[i][1] is journal:
+                    del _ACTIVE[i]
+                    break
 
 
 def active_journal() -> Optional[EventJournal]:
+    tid = threading.get_ident()
     with _ACTIVE_LOCK:
-        return _ACTIVE[-1] if _ACTIVE else None
+        if not _ACTIVE:
+            return None
+        shard = None
+        for ent_tid, j in reversed(_ACTIVE):
+            if ent_tid == tid:
+                return j
+            if shard is None and j.is_shard:
+                shard = j
+        return shard if shard is not None else _ACTIVE[-1][1]
 
 
 def journal_event(kind: str, name: str, **attrs) -> None:
@@ -406,7 +433,8 @@ def open_shard(executor_id: str, path: Optional[str] = None,
                          mirror=True, max_lines=max_events, is_shard=True)
     _SHARD[0] = shard
     with _ACTIVE_LOCK:
-        _ACTIVE.insert(0, shard)
+        # bottom of stack; is_shard makes it reachable from EVERY thread
+        _ACTIVE.insert(0, (threading.get_ident(), shard))
     return shard
 
 
@@ -419,7 +447,5 @@ def close_shard() -> None:
     shard = _SHARD[0]
     _SHARD[0] = None
     if shard is not None:
-        with _ACTIVE_LOCK:
-            if shard in _ACTIVE:
-                _ACTIVE.remove(shard)
+        pop_active(shard)
         shard.close()

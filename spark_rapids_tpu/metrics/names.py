@@ -277,6 +277,40 @@ NUM_MISSED_HEARTBEATS = register_metric(
     "heartbeat polls that failed or timed out on a worker's dedicated "
     "control connection")
 
+# --- serving tier (serve/: scheduler, admission, plan cache) -----------------
+QUEUE_TIME = register_metric(
+    "queueTime", TIMER, ESSENTIAL,
+    "time submitted queries spent waiting in the scheduler's priority "
+    "queue before admission (host-side wall clock; free to maintain, so "
+    "ESSENTIAL unlike device timers)")
+NUM_ADMITTED = register_metric(
+    "numAdmitted", COUNTER, ESSENTIAL,
+    "queries the scheduler admitted for execution")
+NUM_QUEUED_QUERIES = register_metric(
+    "numQueuedQueries", GAUGE, ESSENTIAL,
+    "high-water mark of queries waiting in the scheduler queue (set_max "
+    "gauge, like peakDevMemory; the instantaneous depth is in "
+    "scheduler.stats()['queued'])")
+NUM_ADMISSION_REJECTIONS = register_metric(
+    "numAdmissionRejections", COUNTER, ESSENTIAL,
+    "submissions rejected because the scheduler queue was at "
+    "spark.rapids.sql.tpu.serve.queue.capacity — the serving tier's "
+    "backpressure signal")
+PLAN_CACHE_HITS = register_metric(
+    "planCacheHits", COUNTER, ESSENTIAL,
+    "scheduler submissions whose normalized (literal-lifted) plan was "
+    "already cached — these replay compiled whole-stage executables "
+    "instead of re-tracing and re-compiling")
+PLAN_CACHE_MISSES = register_metric(
+    "planCacheMisses", COUNTER, ESSENTIAL,
+    "scheduler submissions that created a new plan-cache entry (first "
+    "sighting of this plan shape under this conf)")
+NUM_BUDGET_OOMS = register_metric(
+    "numBudgetOoms", COUNTER, ESSENTIAL,
+    "reservations that exceeded a query's serve.queryBudgetBytes after "
+    "spilling the query's own buffers — the RetryOOM then drives that "
+    "query's (and only that query's) retry/split/CPU-fallback ladder")
+
 # --- adaptive query execution (adaptive/) -----------------------------------
 NUM_COALESCED_PARTITIONS = register_metric(
     "numCoalescedPartitions", COUNTER, ESSENTIAL,

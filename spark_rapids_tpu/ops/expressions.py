@@ -125,6 +125,115 @@ def lit(v, dtype=None) -> Literal:
 
 
 # --------------------------------------------------------------------------
+# plan-cache parameters (serve/plan_cache.py)
+# --------------------------------------------------------------------------
+# A Parameter is a literal the serving tier's plan cache lifted out of a
+# query so literal-variant re-submissions share one normalized plan — and,
+# on the threaded dispatch paths (RowLocalExec / whole-stage / aggregate
+# absorption / exchange bucketing), ONE compiled XLA program: the value
+# rides into the program as a runtime argument instead of a baked trace
+# constant.  The binding is a thread-local installed INSIDE the traced
+# function (so Parameter.eval sees tracers at trace time and the compiled
+# executable takes the values as real inputs); outside any binding the
+# Parameter evaluates exactly like the Literal it replaced (CPU twins,
+# un-threaded kernel paths — which key their caches on the value, so a
+# baked constant can never be replayed for a different binding).
+
+_PARAM_BINDING = None  # lazily built threading.local (import-cycle free)
+
+
+def _param_tls():
+    global _PARAM_BINDING
+    if _PARAM_BINDING is None:
+        import threading
+        _PARAM_BINDING = threading.local()
+    return _PARAM_BINDING
+
+
+def current_param(slot: int):
+    """Traced value bound for `slot`, or None when no binding is active."""
+    vals = getattr(_param_tls(), "values", None)
+    if vals is None:
+        return None
+    return vals.get(slot)
+
+
+class _BoundParams:
+    """Context manager installing a slot->array binding for this thread.
+    Plain class (not @contextmanager) so re-entry under jax tracing has
+    no generator machinery in the traced call stack."""
+
+    __slots__ = ("values", "_prev")
+
+    def __init__(self, values):
+        self.values = values
+
+    def __enter__(self):
+        tls = _param_tls()
+        self._prev = getattr(tls, "values", None)
+        tls.values = self.values
+        return self
+
+    def __exit__(self, *a):
+        _param_tls().values = self._prev
+
+
+def bound_params(values) -> _BoundParams:
+    return _BoundParams(values)
+
+
+class Parameter(Literal):
+    """A lifted literal with a plan-cache slot (see module comment above)."""
+
+    def __init__(self, slot: int, value: Any,
+                 dtype: Optional[DataType] = None):
+        super().__init__(value, dtype)
+        self.slot = slot
+
+    def eval(self, batch):
+        arr = current_param(self.slot)
+        if arr is None:
+            return super().eval(batch)  # baked path: behaves as a Literal
+        cap = batch.capacity
+        data = jnp.broadcast_to(
+            jnp.asarray(arr, dtype=self._dtype.jnp_dtype), (cap,))
+        return Column(data, jnp.ones(cap, dtype=jnp.bool_), self._dtype)
+
+    def __repr__(self):
+        return f"param({self.slot}:{self._dtype.name}={self.value!r})"
+
+
+def collect_parameters(exprs) -> list:
+    """Unique Parameters in the given expression trees, ordered by slot —
+    the argument order of a parameter-threaded compiled program."""
+    found = {}
+
+    def walk(e):
+        if isinstance(e, Parameter):
+            found.setdefault(e.slot, e)
+        for c in getattr(e, "children", ()):
+            walk(c)
+
+    for e in exprs:
+        walk(e)
+    return [found[s] for s in sorted(found)]
+
+
+def parameter_values(params) -> tuple:
+    """Device-scalar argument tuple for `params` (collect_parameters
+    order).  Committed jnp arrays, not Python scalars, so jit's argument
+    signature is (dtype, shape ()) — stable across values: a re-bound
+    literal re-dispatches the already-compiled program."""
+    return tuple(jnp.asarray(p.value, dtype=p._dtype.jnp_dtype)
+                 for p in params)
+
+
+def parameter_signature(params) -> tuple:
+    """Value-free cache-key component for a threaded program's params."""
+    return tuple((p.slot, p._dtype.name) for p in params)
+
+
+# --------------------------------------------------------------------------
 # scaffolding: unary / binary with standard null propagation
 # --------------------------------------------------------------------------
 

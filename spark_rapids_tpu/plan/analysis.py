@@ -88,6 +88,12 @@ def resolve(ce, schema: Schema, partition_id: int = 0) -> E.Expression:
         return E.BoundReference(idx, schema[idx].dtype, name)
     if op == "lit":
         return E.Literal(ce.args[0])
+    if op == "param":
+        # plan-cache parameter (serve/plan_cache.py): a lifted literal
+        # carrying (slot, dtype, current value) inline — resolves to a
+        # Parameter whose value re-binds per submission
+        slot, dtype, value = ce.args
+        return E.Parameter(slot, value, dtype)
     if op == "Cast":
         child = resolve(ce.args[0], schema, partition_id)
         to = ce.args[1]

@@ -165,6 +165,9 @@ class ColumnExpr:
             return f"col({self.args[0]!r})"
         if self.op == "lit":
             return f"lit({self.args[0]!r})"
+        if self.op == "param":
+            slot, dtype, value = self.args
+            return f"param({slot}:{dtype.name}={value!r})"
         return f"{self.op}({', '.join(map(repr, self.args))})"
 
     def __bool__(self):

@@ -118,6 +118,9 @@ for _n in ("BoundReference Literal Alias Add Subtract Multiply Divide "
            "LastDay Hour Minute Second DateAdd DateSub DateDiff "
            "UnixTimestamp ToUnixTimestamp FromUnixTime TimeAdd").split():
     _EXPR_RULES[_n] = None
+# plan-cache parameter (serve/plan_cache.py): evaluates like the Literal
+# it replaced (broadcast scalar), device-supported unconditionally
+_EXPR_RULES["Parameter"] = None
 _EXPR_RULES["Cast"] = _tag_cast
 _EXPR_RULES["AnsiCast"] = _tag_cast
 _EXPR_RULES["StartsWith"] = _tag_literal_pattern

@@ -62,6 +62,13 @@ def _literal_of(a):
     if isinstance(a, ColumnExpr):
         if a.op == "lit":
             return a.args[0], True
+        if a.op == "param":
+            # plan-cache parameter (serve/plan_cache.py): the CURRENT
+            # bound value rides inline as args[2], so footer-statistic
+            # row-group pruning still sees a concrete bound per
+            # submission — the pushed predicate is re-derived at plan
+            # time from the re-bound tree, never cached
+            return a.args[2], True
         return None, False
     if isinstance(a, SortOrder):
         return None, False

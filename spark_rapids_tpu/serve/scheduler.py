@@ -1,0 +1,370 @@
+"""Concurrent query scheduler + admission control over one TpuRuntime.
+
+The serving half of ROADMAP item 2.  One `QueryScheduler` per TpuSession
+multiplexes submitted queries over the session's single runtime:
+
+  * **Priority queue** — `submit(df, priority=N)` enqueues; higher
+    priority dispatches first, FIFO within a priority (Presto-style
+    queue discipline).
+  * **Admission control** — every query declares (or gets an estimated)
+    memory need; the scheduler keeps the sum of in-flight needs under
+    `admission.memoryFraction x` the accounted HBM pool, so a burst of
+    heavy queries queues instead of shredding the spill tier.  A full
+    queue rejects (`AdmissionRejected`, counted in
+    numAdmissionRejections) — backpressure, not unbounded buffering.
+    The device itself stays guarded one level down by the existing
+    `TpuSemaphore` (spark.rapids.sql.concurrentTpuTasks): admission
+    bounds MEMORY commitment, the semaphore bounds simultaneous device
+    occupancy.
+  * **Per-query budgets** — `serve.queryBudgetBytes` installs a
+    `MemoryLedger` query scope around each execution; `reserve()`
+    enforces the budget by spilling the query's OWN buffers first and
+    raising RetryOOM into the query's own retry ladder, so one hog
+    spills itself, not its neighbors (mem/runtime.py).
+  * **Plan cache** — submissions run through `PlanCache.lookup`, so a
+    literal variant of a seen query replays cached compiled stages
+    (plan_cache.py) and the persistent XLA compile cache
+    (utils/compile_cache.py) covers process restarts.
+
+Metrics (lint-checked catalog): queueTime, numAdmitted,
+numQueuedQueries, numAdmissionRejections, planCacheHits/Misses,
+numBudgetOoms — all on the runtime Metrics, so pool_stats()/prometheus
+and session_observability pick them up.  Each query's journal carries a
+kind-`sched` "admitted" instant (queue time, priority, need, cache
+state) under its own trace context.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import List, Optional
+
+from .. import config as C
+from ..metrics import names as MN
+from .plan_cache import PlanCache
+
+
+class AdmissionRejected(RuntimeError):
+    """The scheduler's queue is full; resubmit later (HTTP-429 moral)."""
+
+
+class QueryFuture:
+    """Handle for one submitted query (concurrent.futures shape, plus
+    scheduling observability: queue/plan timings, plan-cache state)."""
+
+    def __init__(self, priority: int, need_bytes: int):
+        self.priority = priority
+        self.need_bytes = need_bytes
+        self.submitted_ns = time.monotonic_ns()
+        self.admitted_ns: Optional[int] = None
+        self.finished_ns: Optional[int] = None
+        self.queue_seconds: Optional[float] = None
+        self.plan_seconds: Optional[float] = None
+        self.plan_cache: Optional[str] = None  # "hit" | "miss" | "off"
+        self.n_params = 0
+        self.query_id: Optional[int] = None
+        self._event = threading.Event()
+        self._table = None
+        self._error: Optional[BaseException] = None
+        self.cancelled = False
+
+    # -- completion (scheduler side) ----------------------------------------
+
+    def _set_result(self, table) -> None:
+        self._table = table
+        self.finished_ns = time.monotonic_ns()
+        self._event.set()
+
+    def _set_error(self, error: BaseException) -> None:
+        self._error = error
+        self.finished_ns = time.monotonic_ns()
+        self._event.set()
+
+    # -- consumer side -------------------------------------------------------
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """The query's pyarrow Table (raises the query's error)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("query still running")
+        if self._error is not None:
+            raise self._error
+        return self._table
+
+    def collect(self, timeout: Optional[float] = None) -> list:
+        """Row-tuple view of result(), like DataFrame.collect()."""
+        table = self.result(timeout)
+        return [tuple(r.values()) for r in table.to_pylist()]
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        if not self._event.wait(timeout):
+            raise TimeoutError("query still running")
+        return self._error
+
+    @property
+    def latency_seconds(self) -> Optional[float]:
+        if self.finished_ns is None:
+            return None
+        return (self.finished_ns - self.submitted_ns) / 1e9
+
+
+class _Item:
+    __slots__ = ("logical", "priority", "need", "future", "skips")
+
+    def __init__(self, logical, priority: int, need: int,
+                 future: QueryFuture):
+        self.logical = logical
+        self.priority = priority
+        self.need = need
+        self.future = future
+        self.skips = 0  # admission bypass count (starvation bound)
+
+
+# a queued query smaller items have leapfrogged this many times becomes a
+# BARRIER: nothing behind it is admitted until it fits.  Bounds starvation
+# of big-memory-need queries under a sustained stream of small ones.
+_MAX_ADMISSION_SKIPS = 64
+
+
+class QueryScheduler:
+    """Session-multiplexing scheduler (one per TpuSession; built lazily
+    by TpuSession.submit)."""
+
+    def __init__(self, session):
+        self.session = session
+        conf = session.conf
+        # resolve the lazy singletons BEFORE worker threads exist: their
+        # double-checked inits are not guarded against concurrent first
+        # touch from N query threads
+        self.runtime = session.runtime
+        session.cluster
+        self.max_concurrent = max(1, int(conf.get(C.SERVE_MAX_CONCURRENT)))
+        self.queue_capacity = max(1, int(conf.get(C.SERVE_QUEUE_CAPACITY)))
+        self.default_need = int(conf.get(C.SERVE_DEFAULT_NEED))
+        self.query_budget = int(conf.get(C.SERVE_QUERY_BUDGET))
+        from ..mem.runtime import configured_pool_bytes
+        frac = float(conf.get(C.SERVE_ADMISSION_FRACTION))
+        self.admission_budget = max(1, int(configured_pool_bytes(conf)
+                                           * frac))
+        self.plan_cache: Optional[PlanCache] = None
+        if bool(conf.get(C.SERVE_PLAN_CACHE_ENABLED)):
+            self.plan_cache = PlanCache(
+                int(conf.get(C.SERVE_PLAN_CACHE_SIZE)))
+        # serving path owns the persistent XLA compile-cache wiring: a
+        # restarted server replays kernels from disk (platform-gated
+        # helper; active_cache_dir() reports what actually took effect)
+        from ..utils.compile_cache import (active_cache_dir,
+                                           enable_compilation_cache)
+        enable_compilation_cache(str(conf.get(C.COMPILATION_CACHE_DIR)))
+        self.compile_cache_dir = active_cache_dir()
+        self._metrics = self.runtime.metrics
+        self._lock = threading.Condition()
+        self._queue: List[tuple] = []  # heap of (-priority, seq, _Item)
+        self._seq = 0
+        self._inflight_need = 0
+        self._running = 0
+        self._shutdown = False
+        self.admitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.failed = 0
+        # planning mutates no shared state by design, but logical nodes
+        # are shared between submissions of one DataFrame — serialize the
+        # (cheap, host-side) planning step rather than audit every pass
+        self._plan_lock = threading.Lock()
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"tpu-serve-{i}")
+            for i in range(self.max_concurrent)]
+        for w in self._workers:
+            w.start()
+
+    # -- submission ----------------------------------------------------------
+
+    def _estimate_need(self, logical) -> int:
+        try:
+            from ..plan.physical import _estimate_plan_bytes
+            est = _estimate_plan_bytes(logical, self.session.conf)
+        except Exception:  # noqa: BLE001 — estimation is best-effort
+            est = None
+        if est is None or est <= 0:
+            return self.default_need
+        return int(est)
+
+    def submit(self, logical, priority: int = 0,
+               memory_need: Optional[int] = None) -> QueryFuture:
+        """Enqueue a logical plan (or DataFrame via TpuSession.submit).
+        Raises AdmissionRejected when the queue is at capacity."""
+        if hasattr(logical, "plan") and hasattr(logical, "session"):
+            logical = logical.plan  # a DataFrame
+        need = int(memory_need) if memory_need else \
+            self._estimate_need(logical)
+        fut = QueryFuture(priority, need)
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("scheduler is shut down")
+            if len(self._queue) >= self.queue_capacity:
+                self.rejected += 1
+                self._metrics.add(MN.NUM_ADMISSION_REJECTIONS, 1)
+                raise AdmissionRejected(
+                    f"queue full ({self.queue_capacity} queries pending); "
+                    "resubmit later or raise "
+                    f"{C.SERVE_QUEUE_CAPACITY.key}")
+            self._seq += 1
+            heapq.heappush(self._queue,
+                           (-int(priority), self._seq,
+                            _Item(logical, int(priority), need, fut)))
+            self._metrics.set_max(MN.NUM_QUEUED_QUERIES, len(self._queue))
+            self._lock.notify()
+        return fut
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _pop_admissible_locked(self) -> Optional[_Item]:
+        """Highest-priority queued query whose declared need fits the
+        admission budget given in-flight commitments.  With nothing in
+        flight the head is admitted regardless (a query bigger than the
+        budget must still make progress — the budget shapes concurrency,
+        it is not a hard per-query cap; that is queryBudgetBytes).  An
+        over-budget query smaller items have leapfrogged
+        _MAX_ADMISSION_SKIPS times becomes a barrier: nothing behind it
+        admits until in-flight work drains enough for it to fit, so a
+        sustained stream of small queries cannot starve a big one."""
+        if not self._queue:
+            return None
+        skipped = []
+        picked = None
+        while self._queue:
+            ent = heapq.heappop(self._queue)
+            item = ent[2]
+            if self._running == 0 or \
+                    self._inflight_need + item.need <= self.admission_budget:
+                picked = item
+                break
+            skipped.append(ent)
+            if item.skips >= _MAX_ADMISSION_SKIPS:
+                break  # barrier: admit nothing behind this query
+            item.skips += 1
+        for ent in skipped:
+            heapq.heappush(self._queue, ent)
+        return picked
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._lock:
+                item = None
+                while not self._shutdown:
+                    item = self._pop_admissible_locked()
+                    if item is not None:
+                        break
+                    self._lock.wait()
+                if item is None:
+                    return  # shutdown
+                self._inflight_need += item.need
+                self._running += 1
+            try:
+                self._run_one(item)
+            finally:
+                with self._lock:
+                    self._inflight_need -= item.need
+                    self._running -= 1
+                    # a finished query frees admission budget: re-check
+                    # every waiter, not just one
+                    self._lock.notify_all()
+
+    def _run_one(self, item: _Item) -> None:
+        fut = item.future
+        fut.admitted_ns = time.monotonic_ns()
+        queue_s = (fut.admitted_ns - fut.submitted_ns) / 1e9
+        fut.queue_seconds = queue_s
+        self._metrics.add(MN.QUEUE_TIME, queue_s)
+        self._metrics.add(MN.NUM_ADMITTED, 1)
+        with self._lock:
+            self.admitted += 1
+        session = self.session
+        try:
+            logical = item.logical
+            cache_state = "off"
+            t0 = time.perf_counter()
+            # normalization + fingerprinting + planning all under the
+            # plan lock: logical nodes are SHARED between submissions of
+            # one DataFrame, and planning lazily writes into their
+            # __dict__ (plan_schema's _cached_schema) — fingerprinting
+            # vars() concurrently would race that first-touch insert
+            with self._plan_lock:
+                if self.plan_cache is not None:
+                    normalized, values, hit = self.plan_cache.lookup(
+                        logical, session.conf)
+                    self._metrics.add(
+                        MN.PLAN_CACHE_HITS if hit else
+                        MN.PLAN_CACHE_MISSES, 1)
+                    logical = normalized
+                    fut.n_params = len(values)
+                    cache_state = "hit" if hit else "miss"
+                fut.plan_cache = cache_state
+                from ..plan.overrides import plan_schema
+                out_schema = plan_schema(logical, session.conf)
+                physical = session.plan(logical)
+            fut.plan_seconds = time.perf_counter() - t0
+            sched_attrs = {
+                "queue_s": round(queue_s, 6),
+                "plan_s": round(fut.plan_seconds, 6),
+                "priority": item.priority,
+                "need_bytes": item.need,
+                "plan_cache": cache_state,
+                "params": fut.n_params,
+            }
+            table = session._collect_physical(
+                physical, out_schema, budget_bytes=self.query_budget,
+                sched_attrs=sched_attrs, future=fut)
+            fut._set_result(table)
+            with self._lock:
+                self.completed += 1
+        except BaseException as e:  # noqa: BLE001 — future carries it
+            fut._set_error(e)
+            with self._lock:
+                self.failed += 1
+
+    # -- lifecycle / observability -------------------------------------------
+
+    def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
+        """Stop the workers.  Queued-but-never-admitted queries resolve
+        with an error (a consumer blocked in result() must not hang
+        forever on a future no worker will ever run); in-flight queries
+        finish normally."""
+        with self._lock:
+            self._shutdown = True
+            abandoned = [ent[2].future for ent in self._queue]
+            self._queue.clear()
+            self._lock.notify_all()
+        for fut in abandoned:
+            fut.cancelled = True
+            fut._set_error(RuntimeError(
+                "scheduler shut down before this query was admitted"))
+        if wait:
+            deadline = time.monotonic() + timeout
+            for w in self._workers:
+                w.join(max(0.0, deadline - time.monotonic()))
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "max_concurrent": self.max_concurrent,
+                "queued": len(self._queue),
+                "running": self._running,
+                "inflight_need_bytes": self._inflight_need,
+                "admission_budget_bytes": self.admission_budget,
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+                "completed": self.completed,
+                "failed": self.failed,
+                "query_budget_bytes": self.query_budget,
+                "compile_cache_dir": self.compile_cache_dir,
+            }
+        if self.plan_cache is not None:
+            out["plan_cache"] = self.plan_cache.stats()
+        return out

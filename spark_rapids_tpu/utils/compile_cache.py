@@ -2,29 +2,43 @@
 
 ONE idempotent helper owns the `jax_compilation_cache_dir` /
 `jax_persistent_cache_*` config dance so the knobs cannot drift between
-call sites: `engine.TpuSession` (platform-gated), the executor worker
-bootstrap (shuffle/worker.py), and bench.py's children (force=True —
-the bench explicitly wants warm compiles on every backend it measures,
-including its CPU oracle).
+call sites: `engine.TpuSession` (platform-gated), the serving tier's
+QueryScheduler (a restarted server replays kernels from disk), the
+executor worker bootstrap (shuffle/worker.py), and bench.py's children
+(force=True — the bench explicitly wants warm compiles on every backend
+it measures, including its CPU oracle).
 
 Platform gate rationale (force=False): compiles on a TPU backend cost
 tens of seconds and replay byte-identically, but XLA:CPU AOT replay
 warns about machine-feature mismatches (SIGILL risk) and the CPU test
 environment already fights compile-cache memory pressure — so on a
 CPU-only process the cache stays off unless the caller forces it.
+
+Re-pointing: the active directory is re-pointable within a process — a
+server picking up a conf change (or a test pointing at a tmpdir) calls
+enable_compilation_cache with the new path and jax follows.  The old
+module-global latch made the first path sticky forever, which silently
+kept a stale directory; `active_cache_dir()` reports what is actually in
+effect and `reset_for_tests()` restores the pristine state.
 """
 from __future__ import annotations
 
-_CACHE_SET = [False]
+from typing import Optional
+
+# the path this process's jax config currently points at (None = cache
+# never enabled by this helper)
+_STATE = {"path": None}
 
 
 def enable_compilation_cache(path: str, force: bool = False) -> bool:
-    """Point jax's persistent compilation cache at `path` (idempotent,
-    best-effort; returns True when the cache was enabled by THIS call).
-    Keyed by HLO hash, shared across processes: a second session replays
-    every kernel this one compiled."""
-    if _CACHE_SET[0] or not path:
+    """Point jax's persistent compilation cache at `path` (idempotent
+    per path, best-effort; returns True when THIS call enabled or
+    re-pointed the cache).  Keyed by HLO hash, shared across processes:
+    a second session replays every kernel this one compiled."""
+    if not path:
         return False
+    if _STATE["path"] == path:
+        return False  # already in effect — idempotent fast path
     try:
         import os
 
@@ -39,7 +53,24 @@ def enable_compilation_cache(path: str, force: bool = False) -> bool:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-        _CACHE_SET[0] = True
+        _STATE["path"] = path
         return True
     except Exception:
         return False  # an optimization, never a dependency
+
+
+def active_cache_dir() -> Optional[str]:
+    """The directory this helper last pointed jax at, or None."""
+    return _STATE["path"]
+
+
+def reset_for_tests() -> None:
+    """Test-only: forget the active path and detach jax from it, so the
+    next enable_compilation_cache() call can re-point cleanly from a
+    known state."""
+    _STATE["path"] = None
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:  # pragma: no cover — jax may be torn down
+        pass  # tpulint: disable=TPU006 best-effort detach in test teardown; the latch above is already cleared

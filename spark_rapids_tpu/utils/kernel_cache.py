@@ -12,6 +12,8 @@ jit itself underneath one cache entry.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 import time
 from collections import OrderedDict
 from typing import Callable, Dict, Tuple
@@ -20,6 +22,10 @@ import jax
 
 
 _CACHE: Dict[tuple, Callable] = {}
+# guards the LRU bookkeeping below and _CACHE build races: the serving
+# tier dispatches kernels from several query threads at once, and
+# OrderedDict.move_to_end is not safe under concurrent mutation
+_CACHE_LOCK = threading.Lock()
 
 # whole-stage AOT executables, keyed (stage key, input signature): the
 # fused-stage path compiles per exact shape bucket so compile COUNT and
@@ -30,11 +36,14 @@ _CACHE: Dict[tuple, Callable] = {}
 _STAGE_EXECUTABLES: "OrderedDict[tuple, Callable]" = OrderedDict()
 _STAGE_EXECUTABLES_MAX = 512
 
-# process-wide counters bench.py's fusion stage reads (stats()):
+# process-wide counters bench.py's fusion/serve stages read (stats()):
 # builds = distinct jitted programs constructed through cached_kernel,
 # stage_compiles = AOT whole-stage programs compiled,
-# dispatches = per-batch device program invocations through this layer
-_COUNTERS = {"builds": 0, "stage_compiles": 0, "dispatches": 0}
+# dispatches = per-batch device program invocations through this layer,
+# kernel_hits/stage_hits = cache hits (a parameterized plan-cache hit
+# shows up here as stage/kernel hits instead of fresh builds)
+_COUNTERS = {"builds": 0, "stage_compiles": 0, "dispatches": 0,
+             "kernel_hits": 0, "stage_hits": 0}
 
 
 def record_dispatch(n: int = 1) -> None:
@@ -65,10 +74,12 @@ def stage_executable(key: tuple, builder: Callable[[], Callable],
     trace-vs-compile time split.  Falls back to a plain jitted function if
     the AOT API is unavailable.  Returns a callable taking *args."""
     k = (key, input_signature(args))
-    fn = _STAGE_EXECUTABLES.get(k)
-    if fn is not None:
-        _STAGE_EXECUTABLES.move_to_end(k)
-        return fn
+    with _CACHE_LOCK:
+        fn = _STAGE_EXECUTABLES.get(k)
+        if fn is not None:
+            _STAGE_EXECUTABLES.move_to_end(k)
+            _COUNTERS["stage_hits"] += 1
+            return fn
     from ..metrics import names as MN
     from ..metrics.journal import journal_event
     timer = (metrics.timer(MN.STAGE_COMPILE_TIME) if metrics is not None
@@ -105,21 +116,53 @@ def stage_executable(key: tuple, builder: Callable[[], Callable],
                   compile_s=round(t_compiled - t_lowered, 6),
                   trace_only_s=round(t_traced - t0, 6),
                   signature_leaves=len(k[1]))
-    _STAGE_EXECUTABLES[k] = fn
-    while len(_STAGE_EXECUTABLES) > _STAGE_EXECUTABLES_MAX:
-        _STAGE_EXECUTABLES.popitem(last=False)
+    with _CACHE_LOCK:
+        _STAGE_EXECUTABLES[k] = fn
+        while len(_STAGE_EXECUTABLES) > _STAGE_EXECUTABLES_MAX:
+            _STAGE_EXECUTABLES.popitem(last=False)
     return fn
 
 
 def clear_stage_executables() -> None:
-    _STAGE_EXECUTABLES.clear()
+    with _CACHE_LOCK:
+        _STAGE_EXECUTABLES.clear()
+
+
+# --- plan-cache parameter keying --------------------------------------------
+# Default: a Parameter keys like the Literal it replaced (value INCLUDED),
+# so any dispatch site that does not thread parameter values as runtime
+# arguments recompiles per value — always correct, merely slower.  The
+# threaded sites (RowLocalExec.execute, TpuWholeStageExec, the aggregate
+# whole-stage absorption, the exchange bucketing fusion) compute their keys
+# under `param_free_keys()` so literal-variant queries share ONE compiled
+# program and re-bind values per dispatch.
+
+_KEY_MODE = threading.local()
+
+
+@contextlib.contextmanager
+def param_free_keys():
+    """Within this scope, expr_key() omits Parameter VALUES (slot + dtype
+    only).  Use ONLY around key computation for a dispatch site that
+    passes the parameter values as traced runtime arguments."""
+    prev = getattr(_KEY_MODE, "free", False)
+    _KEY_MODE.free = True
+    try:
+        yield
+    finally:
+        _KEY_MODE.free = prev
 
 
 def expr_key(e) -> tuple:
     """Structural signature of an expression tree: class + every non-child
     constructor attribute + children, recursively.  Safer than repr (an
     expression whose repr omits a parameter would under-key the cache)."""
-    from ..ops.expressions import Expression
+    from ..ops.expressions import Expression, Parameter
+    if isinstance(e, Parameter):
+        key = ("Parameter", e.slot, e._dtype.name)
+        if not getattr(_KEY_MODE, "free", False):
+            key += (repr(e.value),)
+        return key
     attrs = []
     d = getattr(e, "__dict__", None)
     items = sorted(d.items()) if d else \
@@ -154,12 +197,20 @@ def schema_key(schema) -> tuple:
 
 def cached_kernel(key: tuple, builder: Callable[[], Callable],
                   **jit_kw) -> Callable:
-    """Return the jitted kernel for `key`, building it on first use."""
+    """Return the jitted kernel for `key`, building it on first use.
+    Concurrent misses on the same key may both build; last registration
+    wins — a benign duplicate trace, never a wrong program (the key fully
+    determines the closure)."""
     fn = _CACHE.get(key)
     if fn is None:
         fn = jax.jit(builder(), **jit_kw)
-        _CACHE[key] = fn
-        _COUNTERS["builds"] += 1
+        with _CACHE_LOCK:
+            if key in _CACHE:
+                return _CACHE[key]
+            _CACHE[key] = fn
+            _COUNTERS["builds"] += 1
+    else:
+        _COUNTERS["kernel_hits"] += 1
     return fn
 
 
@@ -168,5 +219,6 @@ def cache_info() -> Tuple[int, list]:
 
 
 def clear():
-    _CACHE.clear()
-    _STAGE_EXECUTABLES.clear()
+    with _CACHE_LOCK:
+        _CACHE.clear()
+        _STAGE_EXECUTABLES.clear()

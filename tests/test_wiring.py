@@ -58,9 +58,9 @@ def test_semaphore_acquired_during_device_execution():
     sem = s.runtime.semaphore
     orig = sem.acquire_if_necessary
 
-    def spy(task_id=None):
+    def spy(task_id=None, metrics=None):
         acquired.append(sem.active_tasks())
-        return orig(task_id)
+        return orig(task_id, metrics=metrics)
 
     sem.acquire_if_necessary = spy
     df = s.from_pydict({"a": [1, 2, 3]}).select((col("a") * 2).alias("b"))
