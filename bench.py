@@ -443,6 +443,13 @@ def fusion_microbench() -> dict:
                 "xla_compiles": xla1 - xla0,
                 "dispatches_warm_run": (after["dispatches"]
                                         - after_compile["dispatches"]),
+                # input buffers donated to compiled programs during the
+                # warm run (ISSUE 11): each one is an HBM copy the warm
+                # dispatch did NOT pay; 0 with fusion off (no stage
+                # programs) or donation disabled
+                "donated_copies_warm_run": (after["donated_buffers"]
+                                            - after_compile[
+                                                "donated_buffers"]),
                 "warmup_s": round(warmup_s, 3),
                 "steady_s": round(steady_s, 4),
                 "value": r1,
@@ -1312,6 +1319,14 @@ def main():
         # (plan-cache compile reduction + concurrency 1/4/16 mixed
         # workload) without the full suite
         print(json.dumps(serve_microbench(), indent=1))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--fusion":
+        # standalone whole-stage fusion/donation sweep (CPU backend:
+        # the stage is a CPU child in the full run too) — compile and
+        # dispatch counts plus donated_copies_warm_run per query shape
+        from spark_rapids_tpu.utils.cpu_backend import force_cpu_backend
+        force_cpu_backend()
+        print(json.dumps(fusion_microbench(), indent=1))
         return
 
     # The headline line is emitted UNCONDITIONALLY (round-4 postmortem:

@@ -123,6 +123,18 @@ T_SRV=$SECONDS
 python -m pytest tests/test_serve.py -q -m "not slow" -p no:cacheprovider
 echo "== serve tier took $((SECONDS - T_SRV))s =="
 
+echo "== pallas/donation tier =="
+# on-chip kernels + buffer donation (ISSUE 11): interpret-mode pallas
+# kernel tests (fused segmented aggregation, tiled bitonic sort, the
+# carry-pattern cumsum), the fused-dispatcher parity checks, the
+# packed-key argsort vs lexsort permutation equality, and the donation
+# parity sweep (donation ON vs OFF bit-for-bit across every dtype,
+# retry/checkpoint exclusion, multi-consumer pins)
+T_PAL=$SECONDS
+python -m pytest tests/test_pallas.py tests/test_donation.py -q \
+    -m "not slow" -p no:cacheprovider
+echo "== pallas/donation tier took $((SECONDS - T_PAL))s =="
+
 echo "== tests (fast tier) =="
 T_TESTS=$SECONDS
 MARK="not slow"

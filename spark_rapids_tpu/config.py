@@ -244,6 +244,31 @@ FUSION_ENABLED = _conf(
     "per-operator dispatch with the legacy FusedPipelineExec chain "
     "fusion only, aggregate whole-stage absorption off too (toggle that "
     "alone via wholeStage.enabled while fusion stays on).", _to_bool)
+DONATION_ENABLED = _conf(
+    "spark.rapids.sql.tpu.donation.enabled", True,
+    "Buffer donation through compiled stage programs: when the fusion "
+    "pass proves a stage is the LAST consumer of its input batches "
+    "(source is scan decode / host->device adoption / an upstream whole "
+    "stage) and the batch gained no second owner at runtime (spillable "
+    "registration, scan cache, retry checkpoint — mem/donation.py pins "
+    "those), the stage executable compiles with donate_argnums on the "
+    "batch-column leaves so XLA reuses input HBM for the outputs instead "
+    "of allocating a fresh copy per column per batch.  Results are "
+    "byte-identical either way; false restores the copying behavior "
+    "(numDonatedBuffers counts what warm runs saved).", _to_bool)
+SORT_PACKED_ENABLED = _conf(
+    "spark.rapids.sql.tpu.sort.packed.enabled", True,
+    "One-shot packed-key sort: fuse the order-preserving integer sort "
+    "keys (exec/sort.py encodings) into as few 64-bit words as their "
+    "static bit widths allow, embed the row id in the low bits, and "
+    "order rows with SINGLE-operand jax.lax.sort passes (one pass when "
+    "key+rowid bits fit 64, else a stable LSD radix over 64-bit chunks) "
+    "instead of the N-pass variadic lexsort.  Grouped aggregation's "
+    "(h1, h2) hash sort takes the same path.  The permutation is "
+    "bit-identical to lexsort (ties break by row id = stable); columns "
+    "whose keys are not order-preserving integers on this backend "
+    "(float sort keys on the emulated-f64 TPU backend) fall back to "
+    "lexsort.  false restores lexsort everywhere.", _to_bool)
 FUSION_MAX_OPS = _conf(
     "spark.rapids.sql.tpu.fusion.maxOpsPerStage", 16,
     "Upper bound on row-local operators fused into one whole-stage "

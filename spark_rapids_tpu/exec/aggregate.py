@@ -104,22 +104,40 @@ def group_rows(key_cols: Sequence[Column], live, value_cols=None):
     gid_sorted[i]: group id of sorted position i (garbage for dead rows).
     `value_cols`: optional minor sort keys — equal values land adjacent
     WITHIN each group (the distinct-aggregate dedup needs this)."""
+    from ..utils import packed_sort as PS
     cap = live.shape[0]
+    packed = PS.packed_enabled() and cap & (cap - 1) == 0
     if not key_cols and not value_cols:
         # one group — but the contract (dead rows LAST) must still hold:
         # merge states interleave live/dead rows, and the searchsorted
         # segmented reducers require gid sorted after the dead->cap-1 remap
-        order = jnp.lexsort(((~live).astype(jnp.int8),)).astype(jnp.int32)
+        if packed:
+            # single-operand packed sort (lexsort is variadic even for
+            # one key); identical stable permutation
+            order = PS.packed_argsort([((~live).astype(jnp.uint64), 1)],
+                                      cap)
+        else:
+            order = jnp.lexsort(((~live).astype(jnp.int8),)) \
+                .astype(jnp.int32)
         gid = jnp.zeros(cap, dtype=jnp.int32)
         live_s = jnp.take(live, order)
         boundary = jnp.zeros(cap, dtype=jnp.bool_).at[0].set(live_s[0])
         return order, gid, boundary, jnp.minimum(jnp.sum(live), 1)
     h1, h2 = hash_columns_double(key_cols, live) if key_cols else (
         jnp.zeros(cap, jnp.uint64), jnp.zeros(cap, jnp.uint64))
-    # stable lexsort: primary h1, secondary h2, tertiary original index
+    # stable sort: primary h1, secondary h2, tertiary original index —
+    # packed path runs it as an LSD radix of single-operand sorts (the
+    # variadic lexsort costs ~6x per pass on the CPU sort HLO; identical
+    # permutation either way)
     if value_cols:
         vh1, vh2 = hash_columns_double(value_cols, live)
-        order = jnp.lexsort((vh2, vh1, h2, h1)).astype(jnp.int32)
+        if packed:
+            order = PS.packed_argsort(
+                [(h1, 64), (h2, 64), (vh1, 64), (vh2, 64)], cap)
+        else:
+            order = jnp.lexsort((vh2, vh1, h2, h1)).astype(jnp.int32)
+    elif packed:
+        order = PS.packed_argsort([(h1, 64), (h2, 64)], cap)
     else:
         order = jnp.lexsort((h2, h1)).astype(jnp.int32)
     if not key_cols:
@@ -185,25 +203,11 @@ def _shift1_rows(m):
 # cancellation.  min/max have no invertible prefix form and keep
 # segment_min/max.
 
-def _seg_sum(vals, gid, contribute, cap):
-    v = jnp.where(contribute, vals, jnp.zeros((), vals.dtype))
-    if jnp.issubdtype(vals.dtype, jnp.floating):
-        return jax.ops.segment_sum(v, gid, num_segments=cap,
-                                   indices_are_sorted=True)
-    c = _masked_cumsum(v)
-    n = v.shape[0]  # rows; cap is the SEGMENT count (may be smaller: the
-    #                 global kernel reduces a whole batch to 1 segment)
-    seg = jnp.arange(cap, dtype=gid.dtype)
-    start = jnp.searchsorted(gid, seg, side="left")
-    end = jnp.searchsorted(gid, seg, side="right")
-    zero = jnp.zeros((), c.dtype)
-    total = jnp.where(end > 0, c[jnp.clip(end - 1, 0, n - 1)], zero)
-    prev = jnp.where(start > 0, c[jnp.clip(start - 1, 0, n - 1)], zero)
-    return jnp.where(end > start, total - prev,
-                     zero).astype(vals.dtype)
-
-
 _PALLAS_CUMSUM = [False]  # flipped by the conf via set_pallas_cumsum
+# test hook: route the fused segmented kernel through pallas INTERPRET
+# mode on the CPU backend so the full dispatcher (not just the kernel)
+# is exercised by tests/test_pallas.py
+_PALLAS_SEG_INTERPRET = [False]
 
 
 def set_pallas_cumsum(enabled: bool) -> None:
@@ -229,16 +233,119 @@ def _masked_cumsum(v):
     return jnp.cumsum(v)
 
 
+def _pallas_seg_mode():
+    """Which fused-kernel mode the dispatcher may use: 'tpu' (compiled,
+    BACKEND-gated — BENCH_PALLAS showed the pallas formulation slower
+    than XLA on the CPU backend, so the flag alone is not enough),
+    'interpret' (test hook), or None (XLA per-request reducers)."""
+    if _PALLAS_SEG_INTERPRET[0]:
+        return "interpret"
+    if _PALLAS_CUMSUM[0] and jax.default_backend() == "tpu":
+        return "tpu"
+    return None
+
+
+def _seg_multi(reqs, gid, cap):
+    """All requested segmented reductions over sorted `gid` in as few
+    HBM passes as the backend allows.
+
+    `reqs`: list of (op, vals, contribute, fill[, is_count]) with op in
+    'sum'|'min'|'max' — contribute masks rows out (sum: add 0; min/max:
+    compare fill), exactly the legacy _seg_sum/_seg_min/_seg_max
+    contracts.  Returns one [cap] array per request.
+
+    Fused path (TPU backend + pallas.enabled, or the interpret test
+    hook): ONE pallas pass (ops/pallas_kernels.seg_agg_1d) computes the
+    running segmented aggregate of every request at once, and a SHARED
+    searchsorted pair gathers each segment's last-row value — instead of
+    one scatter/prefix pass per aggregate.  64-bit requests stay on the
+    XLA reducers on real chips (emulated dtypes do not lower), except
+    counts (`is_count`: 0/1 values) which run in int32 and widen after.
+    XLA path: the prior per-request formulations verbatim — integer sums
+    via prefix-diff, float sums via scatter segment_sum (a restart-free
+    prefix would cancel catastrophically), min/max via segment_min/max —
+    sharing one searchsorted pair across every request."""
+    n = gid.shape[0]
+    results = [None] * len(reqs)
+    mode = _pallas_seg_mode()
+    # shared segment bounds (one searchsorted pair for ALL requests; the
+    # legacy path recomputed them per _seg_sum call)
+    seg = jnp.arange(cap, dtype=gid.dtype)
+    start = jnp.searchsorted(gid, seg, side="left")
+    end = jnp.searchsorted(gid, seg, side="right")
+    end_ix = jnp.clip(end - 1, 0, n - 1)
+    nonempty = end > start
+
+    fused: list = []  # (req index, kernel value array, out cast dtype)
+    if mode is not None:
+        for i, req in enumerate(reqs):
+            op, vals, contribute, fill = req[0], req[1], req[2], req[3]
+            is_count = bool(req[4]) if len(req) > 4 else False
+            dt = vals.dtype
+            if mode == "tpu" and dt.itemsize >= 8:
+                if not (is_count and op == "sum"):
+                    continue  # emulated 64-bit: XLA reducer below
+                vals, dt = vals.astype(jnp.int32), jnp.dtype(jnp.int32)
+            if op == "sum":
+                v = jnp.where(contribute, vals, jnp.zeros((), dt))
+            else:
+                v = jnp.where(contribute, vals, fill)
+            fused.append((i, v, reqs[i][1].dtype))
+    if fused:
+        from ..ops.pallas_kernels import seg_agg_1d
+        try:
+            running = seg_agg_1d(gid, [v for _, v, _ in fused],
+                                 [reqs[i][0] for i, _, _ in fused],
+                                 interpret=(mode == "interpret"))
+        except Exception as e:  # noqa: BLE001 — any pallas failure falls back
+            from ..metrics.registry import count_swallowed
+            count_swallowed("numPallasFallbacks", "spark_rapids_tpu.pallas",
+                            "pallas seg_agg_1d failed (%r); using XLA "
+                            "reducers", e)
+            running = None
+        if running is not None:
+            for (i, _v, out_dt), run in zip(fused, running):
+                op, fill = reqs[i][0], reqs[i][3]
+                ident = (jnp.zeros((), run.dtype) if op == "sum"
+                         else jnp.asarray(fill).astype(run.dtype))
+                out = jnp.where(nonempty, run[end_ix], ident)
+                results[i] = out.astype(out_dt)
+    for i, req in enumerate(reqs):
+        if results[i] is not None:
+            continue
+        op, vals, contribute, fill = req[0], req[1], req[2], req[3]
+        if op == "sum":
+            v = jnp.where(contribute, vals, jnp.zeros((), vals.dtype))
+            if jnp.issubdtype(vals.dtype, jnp.floating):
+                results[i] = jax.ops.segment_sum(
+                    v, gid, num_segments=cap, indices_are_sorted=True)
+                continue
+            c = _masked_cumsum(v)
+            zero = jnp.zeros((), c.dtype)
+            total = jnp.where(end > 0, c[end_ix], zero)
+            prev = jnp.where(start > 0, c[jnp.clip(start - 1, 0, n - 1)],
+                             zero)
+            results[i] = jnp.where(nonempty, total - prev,
+                                   zero).astype(vals.dtype)
+        else:
+            v = jnp.where(contribute, vals, fill)
+            reducer = (jax.ops.segment_min if op == "min"
+                       else jax.ops.segment_max)
+            results[i] = reducer(v, gid, num_segments=cap,
+                                 indices_are_sorted=True)
+    return results
+
+
+def _seg_sum(vals, gid, contribute, cap):
+    return _seg_multi([("sum", vals, contribute, 0)], gid, cap)[0]
+
+
 def _seg_min(vals, gid, contribute, cap, fill):
-    v = jnp.where(contribute, vals, fill)
-    return jax.ops.segment_min(v, gid, num_segments=cap,
-                               indices_are_sorted=True)
+    return _seg_multi([("min", vals, contribute, fill)], gid, cap)[0]
 
 
 def _seg_max(vals, gid, contribute, cap, fill):
-    v = jnp.where(contribute, vals, fill)
-    return jax.ops.segment_max(v, gid, num_segments=cap,
-                               indices_are_sorted=True)
+    return _seg_multi([("max", vals, contribute, fill)], gid, cap)[0]
 
 
 class _AggState:
@@ -274,7 +381,8 @@ def _update_one(agg: AggregateExpression, col, gid, live_s, cap,
             contribute = live_s & col.valid
         if agg.distinct and dedup is not None:
             contribute = contribute & dedup
-        cnt = _seg_sum(contribute.astype(jnp.int64), gid, live_s, cap)
+        cnt = _seg_multi([("sum", contribute.astype(jnp.int64), live_s,
+                           0, True)], gid, cap)[0]
         return [Column(cnt, jnp.ones(cap, jnp.bool_), LongType)]
     valid = col.valid
     contribute = live_s & valid
@@ -283,8 +391,11 @@ def _update_one(agg: AggregateExpression, col, gid, live_s, cap,
     if f in ("Sum", "Average"):
         out_t = DoubleType if f == "Average" else agg.dtype
         v = col.data.astype(out_t.jnp_dtype)
-        s = _seg_sum(v, gid, contribute, cap)
-        nvalid = _seg_sum(contribute.astype(jnp.int64), gid, live_s, cap)
+        # one fused segmented pass for the value sum AND its count
+        s, nvalid = _seg_multi(
+            [("sum", v, contribute, 0),
+             ("sum", contribute.astype(jnp.int64), live_s, 0, True)],
+            gid, cap)
         sum_col = Column(s, nvalid > 0, out_t).mask_invalid()
         if f == "Sum":
             return [sum_col]
@@ -338,36 +449,43 @@ def _minmax_string(f, scol: Column, gid, contribute, cap):
 
 
 def _minmax(f, dtype, vals, gid, contribute, cap):
+    ones = jnp.ones_like(contribute)
     if dtype.is_floating:
         v = vals.astype(jnp.float64)
         isnan = jnp.isnan(v)
-        has_nan = _seg_max((contribute & isnan).astype(jnp.int32), gid,
-                           jnp.ones_like(contribute), cap,
-                           jnp.int32(0)) > 0
-        nvalid = _seg_sum(contribute.astype(jnp.int64), gid,
-                          jnp.ones_like(contribute), cap)
+        # every reduction this aggregate needs, one fused segmented pass
         if f == "Min":
-            r = _seg_min(jnp.where(isnan, jnp.inf, v), gid, contribute, cap,
-                         jnp.float64(np.inf))
+            has_nan_i, nvalid, n_non_nan, r = _seg_multi(
+                [("max", (contribute & isnan).astype(jnp.int32), ones,
+                  jnp.int32(0)),
+                 ("sum", contribute.astype(jnp.int64), ones, 0, True),
+                 ("sum", (contribute & ~isnan).astype(jnp.int32), ones,
+                  0, True),
+                 ("min", jnp.where(isnan, jnp.inf, v), contribute,
+                  jnp.float64(np.inf))], gid, cap)
             # NaN only wins min when the group has NO non-NaN values
             # (min(+inf, NaN) is +inf: NaN is greatest)
-            n_non_nan = _seg_sum((contribute & ~isnan).astype(jnp.int32),
-                                 gid, jnp.ones_like(contribute), cap)
-            only_nan = has_nan & (n_non_nan == 0)
+            only_nan = (has_nan_i > 0) & (n_non_nan == 0)
             r = jnp.where(only_nan, jnp.nan, r)
         else:
-            r = _seg_max(jnp.where(isnan, -jnp.inf, v), gid, contribute, cap,
-                         jnp.float64(-np.inf))
-            r = jnp.where(has_nan, jnp.nan, r)  # NaN is greatest
+            has_nan_i, nvalid, r = _seg_multi(
+                [("max", (contribute & isnan).astype(jnp.int32), ones,
+                  jnp.int32(0)),
+                 ("sum", contribute.astype(jnp.int64), ones, 0, True),
+                 ("max", jnp.where(isnan, -jnp.inf, v), contribute,
+                  jnp.float64(-np.inf))], gid, cap)
+            r = jnp.where(has_nan_i > 0, jnp.nan, r)  # NaN is greatest
         out = r.astype(dtype.jnp_dtype)
         return Column(out, nvalid > 0, dtype).mask_invalid()
     v = vals.astype(jnp.int64)
-    nvalid = _seg_sum(contribute.astype(jnp.int64), gid,
-                      jnp.ones_like(contribute), cap)
     if f == "Min":
-        r = _seg_min(v, gid, contribute, cap, jnp.int64(_I64_MAX))
+        nvalid, r = _seg_multi(
+            [("sum", contribute.astype(jnp.int64), ones, 0, True),
+             ("min", v, contribute, jnp.int64(_I64_MAX))], gid, cap)
     else:
-        r = _seg_max(v, gid, contribute, cap, jnp.int64(_I64_MIN))
+        nvalid, r = _seg_multi(
+            [("sum", contribute.astype(jnp.int64), ones, 0, True),
+             ("max", v, contribute, jnp.int64(_I64_MIN))], gid, cap)
     return Column(r.astype(dtype.jnp_dtype), nvalid > 0, dtype) \
         .mask_invalid()
 
@@ -643,17 +761,22 @@ class TpuHashAggregateExec(TpuExec):
             elif f == "Sum":
                 scol = cols[0].take(order)
                 contribute = live_s & scol.valid
-                s = _seg_sum(scol.data, gid, contribute, cap)
-                nvalid = _seg_sum(contribute.astype(jnp.int64), gid, live_s,
-                                  cap)
+                s, nvalid = _seg_multi(
+                    [("sum", scol.data, contribute, 0),
+                     ("sum", contribute.astype(jnp.int64), live_s, 0,
+                      True)], gid, cap)
                 out_cols.append(Column(s, nvalid > 0, cols[0].dtype)
                                 .mask_invalid())
             elif f == "Average":
                 scol = cols[0].take(order)
                 ccol = cols[1].take(order)
                 contribute = live_s & scol.valid
-                s = _seg_sum(scol.data, gid, contribute, cap)
-                n = _seg_sum(ccol.data, gid, live_s & ccol.valid, cap)
+                # ccol holds per-partial COUNTS (not 0/1 flags): their
+                # sum is unbounded, so no int32 is_count narrowing
+                s, n = _seg_multi(
+                    [("sum", scol.data, contribute, 0),
+                     ("sum", ccol.data, live_s & ccol.valid, 0)],
+                    gid, cap)
                 out_cols.append(Column(s, n > 0, DoubleType).mask_invalid())
                 out_cols.append(Column(n, jnp.ones(cap, jnp.bool_),
                                        LongType))
@@ -811,9 +934,14 @@ class TpuHashAggregateExec(TpuExec):
 
     def kernel_key(self) -> tuple:
         from ..utils.kernel_cache import expr_key, schema_key
+        from ..utils import packed_sort as _PS
         return ("TpuHashAggregateExec",
-                # the pallas-cumsum flag changes the traced program
+                # the pallas/packed flags change the traced program (the
+                # packed kill switch must also bust cached kernels —
+                # "false restores lexsort" is a per-process contract)
                 ("pallas" if _PALLAS_CUMSUM[0] else "xla"),
+                (_pallas_seg_mode() or "none"),
+                ("packed" if _PS.packed_enabled() else "lex"),
                 tuple(expr_key(g) for g in self.grouping),
                 tuple(self.group_names),
                 tuple(expr_key(a) for a in self.aggregates),
@@ -971,6 +1099,24 @@ class TpuHashAggregateExec(TpuExec):
         key = (("whole_stage", k, cap, pre_key, str(treedef))
                + self.kernel_key())
         all_leaves = [leaf for f in flats for leaf in f]
+        # buffer donation for the FINAL whole-stage program (never the
+        # bucket probe — a dirty probe re-dispatches the same leaves):
+        # the drained source batches are dead after this one dispatch
+        # when the fusion-pass whitelist admits the source and no batch
+        # is pinned; leaf ids must also be globally unique (a buffer
+        # appearing twice cannot be donated once and read once)
+        donate_leaf_argnums: tuple = ()
+        from .. import config as _C
+        if bool(ctx.conf.get(_C.DONATION_ENABLED)):
+            from ..mem import donation as _donation
+            from ..plan.fusion import source_donatable
+            if source_donatable(source) \
+                    and all(_donation.donatable(b) for b in batches):
+                ids = [id(x) for x in all_leaves]
+                if len(set(ids)) == len(ids):
+                    base = 1 if pre_params else 0
+                    donate_leaf_argnums = tuple(
+                        base + i for i in range(len(all_leaves)))
         if grouped and self._bucketable() \
                 and ctx.conf.get(C.AGG_BUCKET_GROUPS) \
                 and key not in _BUCKET_DIRTY_KEYS:
@@ -991,11 +1137,17 @@ class TpuHashAggregateExec(TpuExec):
                 record_output_batch(self.metrics, out, ctx.runtime)
                 return out, None
             _BUCKET_DIRTY_KEYS.add(key)
-        fn = cached_kernel(key, build)
+        fn = cached_kernel(key, build,
+                           **({"donate_argnums": donate_leaf_argnums}
+                              if donate_leaf_argnums else {}))
         with self.metrics.timer(MN.COMPUTE_AGG_TIME), \
                 named_range("agg_whole_stage"):
             from ..utils.kernel_cache import record_dispatch
             record_dispatch()
+            if donate_leaf_argnums:
+                from ..mem import donation as _donation
+                _donation.record_donated_dispatch(
+                    len(donate_leaf_argnums), self.metrics)
             out = fn(pvals, *all_leaves) if pre_params else fn(*all_leaves)
         self.metrics.add(MN.NUM_FUSED_STAGES, 1)
         record_output_batch(self.metrics, out, ctx.runtime)
@@ -1066,6 +1218,7 @@ class TpuHashAggregateExec(TpuExec):
                 with self.metrics.timer(MN.CONCAT_TIME):
                     both = concat_batches(parts)
                 with self.metrics.timer(MN.MERGE_AGG_TIME), \
+                        self.metrics.timer(MN.SEG_AGG_TIME), \
                         named_range("agg_merge"):
                     return merge(both)
             # retry-only: partial states are merge inputs, not splittable
@@ -1124,20 +1277,21 @@ class TpuHashAggregateExec(TpuExec):
                 ctx.runtime.reserve(b.device_size_bytes(),
                                     site="agg.update")
             partial = None
-            bfn = hot["bucket_fn"]
-            if bfn is not None:
-                clean, bstate = bfn(b)
-                if bool(clean):  # host sync: pick the sort-free state
-                    partial = bstate
-                else:
-                    # dirty latch: a high-cardinality shape stays
-                    # dirty — stop probing it (this query AND this
-                    # kernel key process-wide)
-                    hot["bucket_fn"] = None
-                    _BUCKET_DIRTY_KEYS.add(key)
-            if partial is None:
-                partial = update(b, jnp.int64(hot["offset"])) \
-                    if needs_off else update(b)
+            with self.metrics.timer(MN.SEG_AGG_TIME):
+                bfn = hot["bucket_fn"]
+                if bfn is not None:
+                    clean, bstate = bfn(b)
+                    if bool(clean):  # host sync: pick the sort-free state
+                        partial = bstate
+                    else:
+                        # dirty latch: a high-cardinality shape stays
+                        # dirty — stop probing it (this query AND this
+                        # kernel key process-wide)
+                        hot["bucket_fn"] = None
+                        _BUCKET_DIRTY_KEYS.add(key)
+                if partial is None:
+                    partial = update(b, jnp.int64(hot["offset"])) \
+                        if needs_off else update(b)
             if needs_off:
                 hot["offset"] += b.num_rows_host()
             return partial

@@ -98,6 +98,11 @@ class TpuScanMemoryExec(TpuExec):
             self.metrics.add(MN.NUM_OUTPUT_ROWS, chunk.num_rows)
             self.metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
             if use_cache:
+                # pinned BEFORE the first consumer sees it: a cached
+                # batch is re-served to later queries, so a downstream
+                # whole-stage program must never donate its buffers
+                from ..mem.donation import pin
+                pin(batch)
                 produced.append((batch, chunk.num_rows))
                 produced_bytes += batch.device_size_bytes()
                 if produced_bytes > max_cache:
@@ -144,19 +149,27 @@ class RowLocalExec(TpuExec):
         order (serve/plan_cache.py lifts literals into these)."""
         return E.collect_parameters(self.expressions())
 
-    def parameterized_kernel(self, extra_key: tuple = ()):
+    def parameterized_kernel(self, extra_key: tuple = (),
+                             donate: bool = False):
         """The cached jitted per-batch kernel as a batch->batch callable,
         with plan-cache parameters threaded as runtime arguments when
         present.  With parameters the cache key is VALUE-FREE (slot +
         dtype) and the current bound values ride into every dispatch, so
         a literal-variant re-submission reuses the compiled program; with
         no parameters this is exactly `cached_kernel(kernel_key(),
-        batch_fn)`."""
+        batch_fn)`.
+
+        `donate=True` builds the variant that donates the input batch's
+        buffers to XLA (deleted after the call!) — callers must hold the
+        last-consumer proof (mem/donation.py) per dispatch and fall back
+        to the non-donated kernel otherwise; cached_kernel keys the two
+        variants apart."""
         from ..utils.kernel_cache import cached_kernel, param_free_keys
+        jit_kw = {"donate_argnums": (0,)} if donate else {}
         params = self.stage_params()
         if not params:
             return cached_kernel(self.kernel_key() + tuple(extra_key),
-                                 self.batch_fn)
+                                 self.batch_fn, **jit_kw)
         with param_free_keys():
             key = self.kernel_key()
         key += tuple(extra_key) + (
@@ -164,7 +177,7 @@ class RowLocalExec(TpuExec):
         slots = [p.slot for p in params]
         pvals = E.parameter_values(params)
         inner = cached_kernel(key, bound_param_builder(self.batch_fn,
-                                                       slots))
+                                                       slots), **jit_kw)
 
         def call(batch, _inner=inner, _pvals=pvals):
             return _inner(batch, _pvals)

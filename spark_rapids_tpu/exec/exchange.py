@@ -437,6 +437,10 @@ class TpuShuffleExchangeExec(TpuExec):
             fused_stage.metrics.add(MNN.NUM_FUSED_STAGES, 1)
             if not fused_stage._can_split():
                 part_split = None
+            from .. import config as CC
+            from ..mem import donation as _donation
+            fused_donate = bool(ctx.conf.get(CC.DONATION_ENABLED)) \
+                and fused_stage.donate_inputs
         with self.metrics.timer(MN.SHUFFLE_WRITE_TIME):
             for map_id, batch in enumerate(child_batches):
 
@@ -452,12 +456,20 @@ class TpuShuffleExchangeExec(TpuExec):
                         args = (b, jnp.int32(map_id))
                         if fused_pvals is not None:
                             args += (fused_pvals,)
+                        # donate the source batch (last consumer: the
+                        # partitioned output is the only thing written)
+                        # unless a retry checkpoint / cache pinned it
+                        don = fused_donate and _donation.donatable(b)
                         fn = stage_executable(
                             fused_key, fused_build, args,
                             metrics=fused_stage.metrics,
                             name=f"exchangeStage-"
-                                 f"{fused_stage.stage_id}")
+                                 f"{fused_stage.stage_id}",
+                            donate_argnums=(0,) if don else ())
                         record_dispatch()
+                        if don:
+                            _donation.record_donated_dispatch(
+                                b, fused_stage.metrics)
                         ob, pids = fn(*args)
                         record_output_batch(fused_stage.metrics, ob,
                                             ctx.runtime)

@@ -30,7 +30,9 @@ from .base import ExecContext, ExecNode, TpuExec, record_output_batch
 from ..metrics import names as MN
 
 _I64_MIN = np.int64(-(2**63))
+_I32_MIN = np.int32(-(2**31))
 _NAN_BITS = np.int64(0x7FF8000000000000)
+_NAN_BITS32 = np.int32(0x7FC00000)
 
 
 def float_sort_keys(data) -> List[jnp.ndarray]:
@@ -81,21 +83,172 @@ def column_sort_keys(c: Column, ascending: bool) -> List[jnp.ndarray]:
     return keys
 
 
+# --------------------------------------------------------------------------
+# packed-key components (ops-level twin of column_sort_keys: same order-
+# preserving encodings, but as (uint64 value < 2^width, width) pairs so
+# utils/packed_sort can fuse several columns into one 64-bit sort word)
+# --------------------------------------------------------------------------
+
+_INT_WIDTHS = {"boolean": 1, "byte": 8, "short": 16, "int": 32,
+               "date": 32, "long": 64, "timestamp": 64}
+
+
+def _biased(vals_i64, width: int):
+    """Signed int64 values known to fit `width` bits -> uint64 with the
+    same order under UNSIGNED compare (add 2^(width-1), i.e. flip the
+    sign bit of the width-bit representation)."""
+    if width == 64:
+        return vals_i64.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+    return (vals_i64.astype(jnp.int64)
+            + jnp.int64(1 << (width - 1))).astype(jnp.uint64)
+
+
+def _f32_key(data) -> jnp.ndarray:
+    """32-bit monotone integer key for float32 values with the same
+    Spark semantics as float_sort_keys (NaN above +inf, all NaN equal,
+    -0.0 == 0.0), via the IEEE bit transform on the NATIVE width —
+    half the key bits of the f64 route, same order."""
+    d = data.astype(jnp.float32)
+    nan = jnp.isnan(d)
+    bits = jax.lax.bitcast_convert_type(d, jnp.int32)
+    bits = jnp.where(bits == _I32_MIN, jnp.int32(0), bits)  # -0.0 -> 0.0
+    bits = jnp.where(nan, _NAN_BITS32, bits)
+    return jnp.where(bits >= 0, bits, ~bits + _I32_MIN).astype(jnp.int64)
+
+
+def column_key_components(c: Column, ascending: bool):
+    """Packed-sort components for one column, MSB-first, or None when
+    this column's keys are not order-preserving integers on this backend
+    (the emulated-f64 TPU backend compares floats in float —
+    float_sort_keys' device branch).  Null rows are zeroed (the caller's
+    null-rank component places them); descending inverts within the
+    component's width."""
+    from ..types import FloatType
+    comps = []  # (int64-or-uint64 values, width, already_unsigned)
+    if c.dtype.is_string:
+        cap, L = c.data.shape
+        assert L % 8 == 0, L
+        w = c.data.reshape(cap, L // 8, 8).astype(jnp.uint64)
+        shifts = jnp.arange(56, -8, -8, dtype=jnp.uint64)
+        words = jnp.sum(w << shifts, axis=2, dtype=jnp.uint64)
+        for j in range(L // 8):
+            comps.append((words[:, j], 64, True))
+        comps.append((c.lengths.astype(jnp.int64),
+                      max(1, int(L).bit_length()), True))
+    elif c.dtype.is_floating:
+        if jax.default_backend() != "cpu":
+            return None  # f64<->int bitcasts unimplemented (see above)
+        if c.dtype is FloatType:
+            comps.append((_f32_key(c.data), 32, False))
+        else:
+            comps.append((float_sort_keys(c.data)[0], 64, False))
+    else:
+        width = _INT_WIDTHS.get(c.dtype.name)
+        if width is None:
+            return None  # unknown device dtype: keep the lexsort path
+        # booleans are already unsigned 0/1; signed ints bias below
+        comps.append((c.data.astype(jnp.int64), width,
+                      c.dtype.name == "boolean"))
+    out = []
+    for vals, width, unsigned in comps:
+        u = (vals.astype(jnp.uint64) if unsigned
+             else _biased(vals, width))
+        u = jnp.where(c.valid, u, jnp.uint64(0))
+        if not ascending:
+            # complement within the width: reverses unsigned order
+            mask = jnp.uint64((1 << width) - 1 if width < 64
+                              else 0xFFFFFFFFFFFFFFFF)
+            u = (~u) & mask
+        out.append((u, width))
+    return out
+
+
+def packed_sort_components(batch: ColumnarBatch,
+                           cols: Sequence[Column],
+                           ascending: Sequence[bool],
+                           nulls_first: Sequence[bool]):
+    """All components of the full sort spec (live flag, per-column null
+    rank + keys), or None when any column is packed-ineligible."""
+    live = batch.sel
+    comps = [((~live).astype(jnp.uint64), 1)]
+    for c, asc, nf in zip(cols, ascending, nulls_first):
+        # one bit, not the lexsort path's 0/1/2 rank: per column only
+        # TWO of the three rank values ever occur (nulls before valids
+        # or after), and packed bits are precious
+        null_rank = jnp.where(c.valid,
+                              jnp.uint64(1) if nf else jnp.uint64(0),
+                              jnp.uint64(0) if nf else jnp.uint64(1))
+        comps.append((null_rank, 1))
+        ck = column_key_components(c, asc)
+        if ck is None:
+            return None
+        comps.extend(ck)
+    return comps
+
+
 def sort_order(batch: ColumnarBatch, exprs: Sequence[E.Expression],
-               ascending: Sequence[bool], nulls_first: Sequence[bool]):
+               ascending: Sequence[bool], nulls_first: Sequence[bool],
+               stats: dict = None):
     """Stable permutation ordering live rows by the sort spec, dead rows
     last.  `nulls_first` is the EFFECTIVE placement (already accounts for
-    direction, like SortOrder.effective_nulls_first)."""
+    direction, like SortOrder.effective_nulls_first).
+
+    Packed-key path (default; `spark.rapids.sql.tpu.sort.packed.enabled`
+    kill switch): the key components fuse into 64-bit words with the row
+    id embedded in the low bits, ordered by SINGLE-operand sort passes
+    (one pass when everything fits one word) — identical permutation to
+    the variadic lexsort below, minus its multi-operand comparator cost.
+    `stats`, when given, records which path the trace took (host-side,
+    trace-time: the exec's numPackedSorts counter reads it)."""
+    from ..utils import packed_sort as PS
     live = batch.sel
+    cols = [e.eval(batch) for e in exprs]
+    cap = batch.capacity
+    if PS.packed_enabled() and cap & (cap - 1) == 0:
+        comps = packed_sort_components(batch, cols, ascending, nulls_first)
+        if comps is not None:
+            total = sum(w for _, w in comps)
+            npasses = PS.plan_passes(total, batch.capacity)
+            # a very wide spec (many long string columns) can need more
+            # radix passes than the lexsort has keys — not a win there
+            if npasses <= max(8, len(comps)):
+                if stats is not None:
+                    stats["packed"] = True
+                    stats["passes"] = npasses
+                return PS.packed_argsort(comps, batch.capacity)
+    if stats is not None:
+        stats["packed"] = False
     major: List[jnp.ndarray] = [(~live).astype(jnp.int32)]
-    for e, asc, nf in zip(exprs, ascending, nulls_first):
-        c = e.eval(batch)
+    for c, asc, nf in zip(cols, ascending, nulls_first):
         null_rank = jnp.where(c.valid, jnp.int32(1),
                               jnp.int32(0) if nf else jnp.int32(2))
         major.append(null_rank)
         major.extend(column_sort_keys(c, asc))
     # lexsort: LAST key is primary -> pass minor-to-major
     return jnp.lexsort(tuple(reversed(major))).astype(jnp.int32)
+
+
+def _packed_or_argsort(key, width: int, cap: int):
+    """Stable argsort of one small NON-NEGATIVE integer key (values <
+    2^width) — the shuffle partition-split / bucketing shape.  Packed:
+    one single-operand sort with the row id embedded; fallback: the
+    legacy injective key*cap+iota variadic argsort (identical order)."""
+    from ..utils import packed_sort as PS
+    if PS.packed_enabled() and cap & (cap - 1) == 0:
+        return PS.packed_argsort([(key.astype(jnp.uint64), width)], cap)
+    iota = jnp.arange(cap, dtype=jnp.int64)
+    return jnp.argsort(key.astype(jnp.int64) * cap + iota).astype(jnp.int32)
+
+
+# which-path record per (sort kernel key, batch capacity), written at
+# TRACE time by the kernel closure (the decision is static per
+# key+shape — capacity drives both the power-of-two guard and the
+# radix-pass threshold, so two shapes under one key may take different
+# paths): lets the exec count numPackedSorts per dispatch even when the
+# compiled kernel came from another exec instance's earlier build.
+# Bounded: same cardinality as the jit shape cache, pruned defensively.
+_PACKED_BY_KEY: dict = {}
+_PACKED_BY_KEY_MAX = 4096
 
 
 class _PrefetchedSource(TpuExec):
@@ -146,14 +299,29 @@ class TpuSortExec(TpuExec):
 
     def kernel_key(self):
         from ..utils.kernel_cache import expr_key
+        from ..utils import packed_sort as PS
         return ("TpuSortExec",
+                # the packed/pallas flags change the traced program
+                ("packed" if PS.packed_enabled() else "lex"),
+                ("pallas" if PS._PALLAS_SORT[0] else "xla"),
                 tuple(expr_key(e) for e in self.sort_exprs),
                 tuple(self.ascending), tuple(self.nulls_first))
 
-    def _sort_kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
-        order = sort_order(batch, self.sort_exprs, self.ascending,
-                           self.nulls_first)
-        return batch.take(order)
+    def _make_sort_kernel(self, skey):
+        """Builder for the per-batch sort kernel; records (at trace
+        time, host-side) whether the packed-key path was taken for this
+        kernel key so the exec can count numPackedSorts per dispatch."""
+        exprs, asc, nf = self.sort_exprs, self.ascending, self.nulls_first
+
+        def kern(batch: ColumnarBatch) -> ColumnarBatch:
+            stats: dict = {}
+            order = sort_order(batch, exprs, asc, nf, stats=stats)
+            if len(_PACKED_BY_KEY) >= _PACKED_BY_KEY_MAX:
+                _PACKED_BY_KEY.clear()
+            _PACKED_BY_KEY[(skey, batch.capacity)] = stats.get("packed",
+                                                               False)
+            return batch.take(order)
+        return kern
 
     def _cpu_twin(self):
         """CPU re-execution plan for OOM fallback (exec/retryable.py)."""
@@ -170,17 +338,24 @@ class TpuSortExec(TpuExec):
 
     def _execute_device(self, ctx: ExecContext):
         from .. import config as C
+        from ..utils import packed_sort as PS
         from ..utils.kernel_cache import cached_kernel
         from .retryable import run_retryable
-        fn = cached_kernel(self.kernel_key(), lambda: self._sort_kernel)
+        PS.set_packed_enabled(ctx.conf.get(C.SORT_PACKED_ENABLED))
+        PS.set_pallas_sort(ctx.conf.get(C.PALLAS_ENABLED))
+        skey = self.kernel_key()
+        fn = cached_kernel(skey, lambda: self._make_sort_kernel(skey))
 
         def attempt_sort(b):
             # retry-only block: splitting a global sort batch would break
             # total order; exhaustion falls back to the CPU sort instead.
-            # The reserve marks the lexsort's working-set boundary.
+            # The reserve marks the sort's working-set boundary.
             if ctx.runtime is not None:
                 ctx.runtime.reserve(b.device_size_bytes(), site="sort")
-            return fn(b)
+            out = fn(b)
+            if _PACKED_BY_KEY.get((skey, b.capacity)):
+                self.metrics.add(MN.NUM_PACKED_SORTS, 1)
+            return out
 
         batches = list(self.children[0].execute(ctx))
         if not batches:

@@ -61,6 +61,10 @@ class TpuWholeStageExec(FusedPipelineExec):
     def __init__(self, stages: List[RowLocalExec], child: ExecNode):
         super().__init__(stages, child)
         self.stage_id = 0  # assigned by plan/fusion.number_stages
+        # set by plan/fusion's last-consumer analysis: True when this
+        # stage may donate its input batches' buffers to the compiled
+        # program (source yields fresh single-consumer device arrays)
+        self.donate_inputs = False
         self._folded_batches = 0
         self._folded_rows = 0.0
 
@@ -148,16 +152,27 @@ class TpuWholeStageExec(FusedPipelineExec):
         split = split_batch_rows if self._can_split() else None
         self.metrics.add(MN.NUM_FUSED_STAGES, 1)
         n_batches = 0
+        from .. import config as C
+        from ..mem import donation
+        donate_ok = bool(ctx.conf.get(C.DONATION_ENABLED)) \
+            and self.donate_inputs
 
         def attempt(b):
             if ctx.runtime is not None:
                 ctx.runtime.reserve(self._reserve_estimate(b),
                                     site="wholeStage")
             args = (b,) if pvals is None else (b, pvals)
+            # donation: decided per batch — a retry checkpoint or scan-
+            # cache registration pins the batch, flipping later attempts
+            # (and later batches) back to the copying executable
+            don = donate_ok and donation.donatable(b)
             fn = stage_executable(key, builder, args,
                                   metrics=self.metrics,
-                                  name=f"wholeStage-{self.stage_id}")
+                                  name=f"wholeStage-{self.stage_id}",
+                                  donate_argnums=(0,) if don else ())
             record_dispatch()
+            if don:
+                donation.record_donated_dispatch(b, self.metrics)
             return fn(*args)
 
         for batch in self.children[0].execute(ctx):
@@ -190,26 +205,37 @@ class TpuWholeStageExec(FusedPipelineExec):
         by the PR-1 cpuFallbackOnOom conf).  Split pieces flow through
         the remaining operators independently."""
         from .. import config as C
+        from ..mem import donation
         from ..utils.kernel_cache import record_dispatch
         from .retryable import run_retryable
         from ..mem.retry import RetryExhausted, split_batch_rows
         cpu_ok = bool(ctx.conf.get(C.OOM_CPU_FALLBACK))
+        donate_conf = bool(ctx.conf.get(C.DONATION_ENABLED))
         batches = [batch]
-        for op in self.stages:
+        for op_ix, op in enumerate(self.stages):
             # same kernel construction as RowLocalExec.execute's plain
             # path (parameter-threaded when the plan cache lifted
             # literals into this op), so a de-fuse under memory pressure
             # reuses any already-compiled per-op kernel
             fn = op.parameterized_kernel()
+            # the first op consumes the STAGE's input (donatable only
+            # when the fusion pass proved the source single-consumer);
+            # later ops consume the previous op's fresh output
+            op_donate = donate_conf and (op_ix > 0 or self.donate_inputs)
+            fn_don = (op.parameterized_kernel(donate=True) if op_donate
+                      else None)
             pre = op.metrics.snapshot()
             op_split = (split_batch_rows
                         if not isinstance(op, TpuExpandExec) else None)
 
-            def attempt(b, _fn=fn):
+            def attempt(b, _fn=fn, _fnd=fn_don):
                 if ctx.runtime is not None:
                     ctx.runtime.reserve(b.device_size_bytes(),
                                         site="wholeStage.op")
                 record_dispatch()
+                if _fnd is not None and donation.donatable(b):
+                    donation.record_donated_dispatch(b, self.metrics)
+                    return _fnd(b)
                 return _fn(b)
 
             outs: List[ColumnarBatch] = []

@@ -17,7 +17,11 @@ hide there:
 The pass resolves the repo's jit idioms: direct `jax.jit(fn)`, decorator
 form, lambdas, and the builder pattern (`jax.jit(builder())` /
 `cached_kernel(key, builder)` / `stage_executable(key, builder, ...)`
-where `builder` is a local def returning the traced function).
+where `builder` is a local def returning the traced function).  Pallas
+kernel bodies are traced the same way, so `pl.pallas_call(kernel, ...)`
+and `pl.pallas_call(make_kernel(...), ...)` resolve too (the kernel def
+may live at module scope — kernels usually do), keeping new hand-written
+kernels linted instead of baselined.
 """
 from __future__ import annotations
 
@@ -99,15 +103,21 @@ class JitPurityPass(LintPass):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 scopes[id(node)] = _Scope(node.body)
 
+        module_scope = scopes[id(ctx.tree)]
+
         def resolve(arg: ast.AST, enclosing: _Scope
                     ) -> Tuple[Optional[ast.AST], bool]:
-            """(function node, is_builder_result)"""
+            """(function node, is_builder_result).  Names fall back to
+            MODULE scope: pallas kernels (and their builders) are module-
+            level defs referenced from inside the wrapper function."""
             if isinstance(arg, ast.Lambda):
                 return arg, False
             if isinstance(arg, ast.Name):
-                return enclosing.defs.get(arg.id), False
+                return (enclosing.defs.get(arg.id)
+                        or module_scope.defs.get(arg.id)), False
             if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name):
-                b = enclosing.defs.get(arg.func.id)
+                b = enclosing.defs.get(arg.func.id) \
+                    or module_scope.defs.get(arg.func.id)
                 if b is not None:
                     return b, True
             return None, False
@@ -127,6 +137,8 @@ class JitPurityPass(LintPass):
                 arg_ix = None
                 if name in ("jax.jit", "jit", "pjit", "jax.pjit"):
                     arg_ix = 0
+                elif tail == "pallas_call":
+                    arg_ix = 0  # pl.pallas_call(kernel_or_builder(), ...)
                 elif tail in ("cached_kernel", "stage_executable"):
                     arg_ix = 1
                 if arg_ix is not None and len(node.args) > arg_ix:

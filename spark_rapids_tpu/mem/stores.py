@@ -346,6 +346,11 @@ class DeviceMemoryStore(BufferStore):
                          leaves_size)
         buf = SpillableBuffer(bid, meta, spill_priority)
         buf.device_batch = batch
+        # a registered batch has a second owner (this store: a later
+        # spill device_gets its arrays) — it must never be donated to a
+        # compiled program afterwards
+        from .donation import pin
+        pin(batch)
         ledger = getattr(self.catalog, "ledger", None)
         if ledger is not None:
             # owning query (serving tier): the thread's active query

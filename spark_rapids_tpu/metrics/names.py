@@ -250,6 +250,26 @@ NUM_FUSION_FALLBACKS = register_metric(
     "numFusionFallbacks", COUNTER, ESSENTIAL,
     "fused stages that exhausted stage-level OOM retries and fell back "
     "to executing their constituent operators one at a time")
+NUM_DONATED_BUFFERS = register_metric(
+    "numDonatedBuffers", COUNTER, ESSENTIAL,
+    "input column buffers donated to compiled stage programs "
+    "(donate_argnums input/output aliasing): each one is an HBM "
+    "allocation + copy a warm per-batch dispatch did NOT pay; zero "
+    "with spark.rapids.sql.tpu.donation.enabled=false or when every "
+    "input batch is pinned (scan cache, spillable registration, retry "
+    "checkpoint)")
+
+# --- on-chip kernels (exec/sort.py packed keys, aggregate seg-agg) -----------
+NUM_PACKED_SORTS = register_metric(
+    "numPackedSorts", COUNTER, ESSENTIAL,
+    "sort dispatches that took the packed-key path (sort keys fused "
+    "into 64-bit words + embedded row ids, single-operand sort passes) "
+    "instead of the N-pass variadic lexsort")
+SEG_AGG_TIME = register_metric(
+    "segAggTime", TIMER, MODERATE,
+    "segmented-aggregation kernel time inside grouped-aggregate "
+    "update/merge dispatches (the per-batch partial-state compute the "
+    "fused single-pass segmented reducers accelerate)")
 
 # --- distributed tracing / heartbeats (metrics/timeline.py, cluster.py) ------
 HEARTBEAT_LAG = register_metric(

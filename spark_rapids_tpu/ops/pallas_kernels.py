@@ -9,11 +9,27 @@ grids are sequential on TPU).  XLA's own cumsum is a log-depth scan of
 full-array passes; the fused single pass halves HBM traffic for long
 columns.
 
+`seg_agg_1d` generalizes the same carry pattern into a fused SEGMENTED
+scan: for sorted group ids it computes, in ONE pass over the rows, the
+running sum/min/max (restarting at every segment boundary) of ANY number
+of value columns at once — all requested aggregates of a group-by read
+gid and each value column exactly once, where the XLA formulation pays
+one full scatter/prefix pass per aggregate.  The per-segment results are
+the running values at each segment's last row (exec/aggregate.py gathers
+them with one shared searchsorted pair).
+
+`bitonic_sort_u64` is the tiled bitonic network behind the packed-key
+sort (utils/packed_sort): blocks sort locally in VMEM, cross-block merge
+substages are elementwise min/max between paired blocks (at distances >=
+a block the bitonic pairing lines up element offsets), sub-block tails
+run in-VMEM — O(log^2) passes but each one streams HBM linearly instead
+of the sort HLO's comparator loop.
+
 Gated by `spark.rapids.sql.tpu.pallas.enabled` (default off) and used
 opportunistically: any pallas failure (unsupported dtype — 64-bit types
 are emulated on current chips — or an interpret-less CPU backend) falls
-back to `jnp.cumsum` at the call site.  Tests exercise the kernel in
-interpret mode on the CPU backend (tests/test_pallas.py).
+back to the XLA lowering at the call site.  Tests exercise every kernel
+in interpret mode on the CPU backend (tests/test_pallas.py).
 """
 from __future__ import annotations
 
@@ -66,3 +82,222 @@ def cumsum_1d(v, interpret: bool = False):
         interpret=interpret,
     )(x)
     return out.reshape(n)
+
+
+# --------------------------------------------------------------------------
+# fused segmented scan (single-pass multi-aggregate group-by reducer)
+# --------------------------------------------------------------------------
+
+_COMBINE = {"sum": lambda a, b: a + b,
+            "min": jnp.minimum,
+            "max": jnp.maximum}
+
+
+def _make_seg_agg_kernel(ops):
+    """Kernel over one (8, 128) tile: segmented inclusive scan of every
+    value ref (restarting where gid changes), with a (last_gid, running
+    value per op) carry in SMEM threading segments that span tiles."""
+    from jax.experimental import pallas as pl
+
+    k = len(ops)
+
+    def kernel(*refs):
+        g_ref = refs[0]
+        v_refs = refs[1:1 + k]
+        o_refs = refs[1 + k:1 + 2 * k]
+        cg_ref = refs[1 + 2 * k]
+        cv_refs = refs[2 + 2 * k:]
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            cg_ref[0] = jnp.int32(-1)  # gid >= 0: never matches
+            for cv in cv_refs:
+                cv[0] = jnp.zeros((), cv.dtype)
+
+        g = g_ref[:]                                      # (8, 128)
+        lane = jax.lax.broadcasted_iota(jnp.int32, g.shape, 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, g.shape, 0)
+        gl = g[:, -1:]                                    # (8, 1) row-end gid
+        # row-level segmented-scan masks are shared by every value col
+        row1 = jax.lax.broadcasted_iota(jnp.int32, gl.shape, 0)
+        carry_g_tile = cg_ref[0]
+        for vi, op in enumerate(ops):
+            comb = _COMBINE[op]
+            v = v_refs[vi][:]
+            # 1) within-row segmented Hillis-Steele scan (log2(128) steps)
+            for d in (1, 2, 4, 8, 16, 32, 64):
+                ok = (lane >= d) & (jnp.roll(g, d, axis=1) == g)
+                v = jnp.where(ok, comb(v, jnp.roll(v, d, axis=1)), v)
+            # 2) row carries: segmented scan over the 8 row summaries
+            vl = v[:, -1:]
+            for d in (1, 2, 4):
+                ok = (row1 >= d) & (jnp.roll(gl, d, axis=0) == gl)
+                vl = jnp.where(ok, comb(vl, jnp.roll(vl, d, axis=0)), vl)
+            carry_g_rows = jnp.roll(gl, 1, axis=0)        # row r <- row r-1
+            carry_v_rows = jnp.roll(vl, 1, axis=0)
+            v = jnp.where((row >= 1) & (g == carry_g_rows),
+                          comb(v, carry_v_rows), v)
+            # 3) cross-tile carry from SMEM (the leading run of this tile
+            # continues the previous tile's trailing segment)
+            v = jnp.where(g == carry_g_tile,
+                          comb(v, cv_refs[vi][0]), v)
+            o_refs[vi][:] = v
+            cv_refs[vi][0] = v[-1, -1]
+        cg_ref[0] = g[-1, -1]
+    return kernel
+
+
+def seg_agg_1d(gid, vals, ops, interpret: bool = False):
+    """Fused segmented running aggregates.
+
+    `gid`: int32 [n], sorted ascending (n a multiple of 1024 — the
+    engine's capacity buckets guarantee it).  `vals`: sequence of [n]
+    value arrays (pre-masked: non-contributing rows already hold the
+    op's identity).  `ops`: matching 'sum'|'min'|'max' names.
+
+    Returns one [n] array per value: the INCLUSIVE running aggregate of
+    the segment containing each row, restarting at every boundary — so
+    the value at a segment's last row is that segment's full reduction
+    (exec/aggregate.py gathers those with one shared searchsorted pair).
+    All values stream through ONE kernel pass: gid and each column are
+    read exactly once."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = gid.shape[0]
+    if n % _BLOCK:
+        raise ValueError(f"length {n} not a multiple of {_BLOCK}")
+    if not vals or len(vals) != len(ops):
+        raise ValueError("vals/ops mismatch")
+    for op in ops:
+        if op not in _COMBINE:
+            raise ValueError(f"unknown op {op!r}")
+    rows = n // _LANES
+    g2 = gid.astype(jnp.int32).reshape(rows, _LANES)
+    vs2 = [v.reshape(rows, _LANES) for v in vals]
+    spec = pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0))
+    outs = pl.pallas_call(
+        _make_seg_agg_kernel(tuple(ops)),
+        out_shape=[jax.ShapeDtypeStruct(v.shape, v.dtype) for v in vs2],
+        grid=(n // _BLOCK,),
+        in_specs=[spec] * (1 + len(vs2)),
+        out_specs=[spec] * len(vs2),
+        scratch_shapes=([pltpu.SMEM((1,), jnp.int32)]
+                        + [pltpu.SMEM((1,), v.dtype) for v in vs2]),
+        interpret=interpret,
+    )(g2, *vs2)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return [o.reshape(n) for o in outs]
+
+
+# --------------------------------------------------------------------------
+# tiled bitonic sort (packed-key sort backend)
+# --------------------------------------------------------------------------
+
+def _xor_permute(v, d):
+    """v with positions XOR-shuffled by distance d inside one (8, 128)
+    tile (row-major index i -> i ^ d).  Built from reshape + flip only —
+    pallas kernels may not capture index-array constants, and an XOR
+    shuffle by a power of two is exactly a pairwise swap of d-wide
+    groups: reshape to (..., 2, d) and reverse the pair axis."""
+    r, c = v.shape
+    if d < _LANES:
+        x = v.reshape(r, c // (2 * d), 2, d)
+        return jnp.flip(x, axis=2).reshape(r, c)
+    dr = d // _LANES
+    x = v.reshape(r // (2 * dr), 2, dr, c)
+    return jnp.flip(x, axis=1).reshape(r, c)
+
+
+def _make_bitonic_local_kernel(k_lo, k_hi):
+    """Per-block kernel running stages k_lo..k_hi's sub-block substages
+    (d < 1024) in VMEM.  For the initial local sort (k_lo=1) directions
+    vary WITHIN the block; for a global stage's tail they are constant
+    per block — both fall out of the global-index direction bit."""
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        bi = pl.program_id(0)
+        v = x_ref[:]
+        lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+        local = row * _LANES + lane
+        gidx = bi * _BLOCK + local                        # global index
+        for k in range(k_lo, k_hi + 1):
+            d0 = min(1 << (k - 1), _BLOCK // 2)
+            d = d0
+            while d >= 1:
+                pv = _xor_permute(v, d)
+                lower = (gidx & d) == 0
+                asc = ((gidx >> k) & 1) == 0
+                take_min = lower == asc
+                v = jnp.where(take_min, jnp.minimum(v, pv),
+                              jnp.maximum(v, pv))
+                d //= 2
+        o_ref[:] = v
+    return kernel
+
+
+def _make_bitonic_merge_kernel(k, d):
+    """Cross-block substage: output block bi = elementwise min/max of
+    blocks bi and bi ^ (d/1024); at distances >= a block the bitonic
+    pairing lines up element offsets, so no shuffle is needed."""
+    from jax.experimental import pallas as pl
+    bd = d // _BLOCK
+
+    def kernel(a_ref, b_ref, o_ref):
+        bi = pl.program_id(0)
+        a = a_ref[:]
+        b = b_ref[:]
+        lower = (bi & bd) == 0
+        asc = (((bi * _BLOCK) >> k) & 1) == 0
+        take_min = lower == asc
+        o_ref[:] = jnp.where(take_min, jnp.minimum(a, b),
+                             jnp.maximum(a, b))
+    return kernel
+
+
+def bitonic_sort_u64(keys, interpret: bool = False):
+    """Ascending sort of a uint64 array whose length is a power of two
+    and a multiple of 1024 (utils/packed_sort feeds packed words at the
+    engine's capacity buckets).  Tiled bitonic network: one local-sort
+    pass, then per global stage its cross-block substages (elementwise
+    paired-block min/max) and one sub-block tail pass."""
+    from jax.experimental import pallas as pl
+
+    n = keys.shape[0]
+    if n % _BLOCK or n & (n - 1):
+        raise ValueError(f"length {n} not a power-of-two multiple "
+                         f"of {_BLOCK}")
+    rows = n // _LANES
+    x = keys.reshape(rows, _LANES)
+    nblocks = n // _BLOCK
+    block_log2 = _BLOCK.bit_length() - 1  # 10
+    spec = pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0))
+    shape = jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    # initial local sort: stages 1..10 entirely inside each block
+    x = pl.pallas_call(
+        _make_bitonic_local_kernel(1, min(block_log2, n.bit_length() - 1)),
+        out_shape=shape, grid=(nblocks,), in_specs=[spec],
+        out_specs=spec, interpret=interpret)(x)
+    # global stages: cross-block substages then the sub-block tail
+    for k in range(block_log2 + 1, n.bit_length()):
+        d = 1 << (k - 1)
+        while d >= _BLOCK:
+            bd = d // _BLOCK
+            x = pl.pallas_call(
+                _make_bitonic_merge_kernel(k, d),
+                out_shape=shape, grid=(nblocks,),
+                in_specs=[spec,
+                          pl.BlockSpec((_SUBLANES, _LANES),
+                                       lambda i, _bd=bd: (i ^ _bd, 0))],
+                out_specs=spec, interpret=interpret)(x, x)
+            d //= 2
+        x = pl.pallas_call(
+            _make_bitonic_local_kernel(k, k),
+            out_shape=shape, grid=(nblocks,), in_specs=[spec],
+            out_specs=spec, interpret=interpret)(x)
+    return x.reshape(n)

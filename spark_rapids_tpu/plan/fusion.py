@@ -82,8 +82,32 @@ def _fuse(node: ExecNode, max_ops: int) -> ExecNode:
             stages.append(n)
     out = cur  # the source under the chain
     for i in range(0, len(stages), max_ops):
-        out = TpuWholeStageExec(stages[i:i + max_ops], out)
+        ws = TpuWholeStageExec(stages[i:i + max_ops], out)
+        # last-consumer analysis for buffer donation: this stage is the
+        # only consumer of its source's batches exactly when the source
+        # yields fresh per-call device arrays (see source_donatable);
+        # chunked chains compose — stage i+1's source is stage i, whose
+        # outputs are fresh program outputs
+        ws.donate_inputs = source_donatable(out)
+        out = ws
     return out
+
+
+def source_donatable(source: ExecNode) -> bool:
+    """True when `source.execute()` yields batches this plan's consumer
+    is the LAST owner of: fresh device arrays built per call and
+    referenced nowhere else.  Scan decode (memory/file), host->device
+    adoption, coalesce (fresh concat/compact) and upstream whole stages
+    qualify; shuffle readers (fetched batches live in the received-buffer
+    catalog), joins/broadcasts (build batches are reused across probe
+    calls) and everything unknown do NOT.  Runtime pins (mem/donation.py)
+    still veto individual batches — the scan cache re-serves scan
+    batches, so a whitelisted source does not by itself prove donation
+    safe; this is the static half of the proof only."""
+    from ..io.scan import TpuFileScanExec
+    return isinstance(source, (B.TpuScanMemoryExec, B.HostToDeviceExec,
+                               B.TpuCoalesceBatchesExec, TpuWholeStageExec,
+                               TpuFileScanExec))
 
 
 def number_stages(node: ExecNode, start: int = 1) -> int:

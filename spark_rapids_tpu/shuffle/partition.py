@@ -152,8 +152,8 @@ def split_by_partition(batch: ColumnarBatch, pids: jnp.ndarray, n: int,
     cap = batch.capacity
     live = batch.sel
     key = jnp.where(live, pids.astype(jnp.int64), jnp.int64(n))
-    iota = jnp.arange(cap, dtype=jnp.int64)
-    order = jnp.argsort(key * cap + iota).astype(jnp.int32)
+    from ..exec.sort import _packed_or_argsort
+    order = _packed_or_argsort(key, max(1, int(n).bit_length()), cap)
     sorted_batch = batch.take(order)
     counts = np.asarray(jnp.bincount(
         jnp.where(live, pids, jnp.int32(n)), length=n + 1))[:n]

@@ -43,11 +43,18 @@ _STAGE_EXECUTABLES_MAX = 512
 # kernel_hits/stage_hits = cache hits (a parameterized plan-cache hit
 # shows up here as stage/kernel hits instead of fresh builds)
 _COUNTERS = {"builds": 0, "stage_compiles": 0, "dispatches": 0,
-             "kernel_hits": 0, "stage_hits": 0}
+             "kernel_hits": 0, "stage_hits": 0, "donated_buffers": 0}
 
 
 def record_dispatch(n: int = 1) -> None:
     _COUNTERS["dispatches"] += n
+
+
+def record_donated(n_buffers: int) -> None:
+    """Count input buffers donated to a compiled program (the HBM copies
+    a warm dispatch did not pay); bench.py reads this around warm runs
+    (donated_copies_warm_run) like it reads dispatches."""
+    _COUNTERS["donated_buffers"] += n_buffers
 
 
 def stats() -> Dict[str, int]:
@@ -65,14 +72,23 @@ def input_signature(args) -> tuple:
 
 
 def stage_executable(key: tuple, builder: Callable[[], Callable],
-                     args: tuple, metrics=None, name: str = "stage"):
+                     args: tuple, metrics=None, name: str = "stage",
+                     donate_argnums: tuple = ()):
     """AOT-compiled whole-stage program for (key, signature-of-args).
 
     On a cache miss the program is traced, lowered and compiled EXPLICITLY
     (jax AOT API) so the build is observable: numStageCompiles /
     stageCompileTime on `metrics` and a `compile` journal event with the
     trace-vs-compile time split.  Falls back to a plain jitted function if
-    the AOT API is unavailable.  Returns a callable taking *args."""
+    the AOT API is unavailable.  Returns a callable taking *args.
+
+    `donate_argnums` lowers the program with input/output buffer aliasing
+    on those argument positions (mem/donation.py owns the safety proof —
+    a donated executable ALWAYS deletes those inputs, so donated and
+    non-donated dispatches must resolve to distinct cache entries: the
+    argnums are part of the key)."""
+    if donate_argnums:
+        key = key + ("donate", tuple(donate_argnums))
     k = (key, input_signature(args))
     with _CACHE_LOCK:
         fn = _STAGE_EXECUTABLES.get(k)
@@ -84,7 +100,7 @@ def stage_executable(key: tuple, builder: Callable[[], Callable],
     from ..metrics.journal import journal_event
     timer = (metrics.timer(MN.STAGE_COMPILE_TIME) if metrics is not None
              else None)
-    jfn = jax.jit(builder())
+    jfn = jax.jit(builder(), donate_argnums=donate_argnums)
     t0 = time.perf_counter()
     if timer is not None:
         timer.__enter__()
@@ -200,7 +216,12 @@ def cached_kernel(key: tuple, builder: Callable[[], Callable],
     """Return the jitted kernel for `key`, building it on first use.
     Concurrent misses on the same key may both build; last registration
     wins — a benign duplicate trace, never a wrong program (the key fully
-    determines the closure)."""
+    determines the closure).  jit keywords (donate_argnums etc.) must be
+    reflected in the key by the caller: a donated kernel always deletes
+    its donated inputs, so it can never share an entry with the
+    non-donated variant."""
+    if jit_kw.get("donate_argnums"):
+        key = key + ("donate", tuple(jit_kw["donate_argnums"]))
     fn = _CACHE.get(key)
     if fn is None:
         fn = jax.jit(builder(), **jit_kw)

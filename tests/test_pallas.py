@@ -1,10 +1,14 @@
 """Pallas kernel tests (interpret mode on the CPU backend) + the
 gather-based segmented-sum rewrite they back (exec/aggregate.py
-_seg_sum)."""
+_seg_sum), the fused multi-aggregate segmented kernel + dispatcher
+(seg_agg_1d / _seg_multi), the tiled bitonic sort, and the packed-key
+argsort (utils/packed_sort) the sort/grouping paths ride."""
 import numpy as np
 import pytest
 
 import jax.numpy as jnp
+
+pytestmark = pytest.mark.pallas
 
 
 @pytest.mark.parametrize("dtype", [np.int32, np.float32, np.int64,
@@ -105,3 +109,205 @@ def test_seg_sum_fewer_segments_than_rows():
                               jnp.asarray(contribute), 1))
     want = int(vals[contribute].sum())
     assert got.tolist() == [want]
+
+
+# --------------------------------------------------------------------------
+# fused segmented aggregation (seg_agg_1d + the _seg_multi dispatcher)
+# --------------------------------------------------------------------------
+
+def _sorted_gid(rng, n, ngroups):
+    return np.sort(rng.randint(0, ngroups, n)).astype(np.int32)
+
+
+@pytest.mark.parametrize("ops", [("sum",), ("min",), ("max",),
+                                 ("sum", "min", "max")])
+def test_seg_agg_1d_interpret(ops):
+    """The fused kernel's running value at each segment's LAST row is
+    that segment's full reduction, for every op in one pass."""
+    from spark_rapids_tpu.ops.pallas_kernels import seg_agg_1d
+    rng = np.random.RandomState(11)
+    n = 4096
+    gid = _sorted_gid(rng, n, 60)
+    vals = [rng.randint(-1000, 1000, n).astype(np.int32) for _ in ops]
+    outs = seg_agg_1d(jnp.asarray(gid), [jnp.asarray(v) for v in vals],
+                      list(ops), interpret=True)
+    red = {"sum": np.sum, "min": np.min, "max": np.max}
+    for op, v, out in zip(ops, vals, outs):
+        got = np.asarray(out)
+        for seg in np.unique(gid):
+            idx = np.flatnonzero(gid == seg)
+            assert got[idx[-1]] == red[op](v[idx]), (op, seg)
+
+
+def test_seg_agg_1d_running_restarts_at_boundary():
+    from spark_rapids_tpu.ops.pallas_kernels import seg_agg_1d
+    n = 2048
+    gid = np.repeat(np.arange(n // 8), 8).astype(np.int32)
+    v = np.ones(n, np.int32)
+    out = np.asarray(seg_agg_1d(jnp.asarray(gid), [jnp.asarray(v)],
+                                ["sum"], interpret=True)[0])
+    # inclusive running count 1..8 within every segment
+    assert (out == np.tile(np.arange(1, 9), n // 8)).all()
+
+
+def test_seg_agg_1d_segment_spanning_tiles():
+    """One segment covering several (8,128) tiles exercises the SMEM
+    carry; a float column checks the cross-tile combine order is sane."""
+    from spark_rapids_tpu.ops.pallas_kernels import seg_agg_1d
+    n = 4096
+    gid = np.zeros(n, np.int32)
+    gid[3000:] = 1
+    v = np.random.RandomState(0).randn(n).astype(np.float32)
+    out = np.asarray(seg_agg_1d(jnp.asarray(gid), [jnp.asarray(v)],
+                                ["sum"], interpret=True)[0])
+    np.testing.assert_allclose(out[2999], v[:3000].astype(np.float64).sum(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(out[-1], v[3000:].astype(np.float64).sum(),
+                               rtol=1e-4)
+
+
+def test_seg_agg_1d_rejects_bad_args():
+    from spark_rapids_tpu.ops.pallas_kernels import seg_agg_1d
+    with pytest.raises(ValueError):
+        seg_agg_1d(jnp.zeros(1000, jnp.int32), [jnp.zeros(1000)],
+                   ["sum"], interpret=True)
+    with pytest.raises(ValueError):
+        seg_agg_1d(jnp.zeros(1024, jnp.int32), [jnp.zeros(1024)],
+                   ["median"], interpret=True)
+
+
+def test_seg_multi_dispatcher_parity_interpret():
+    """The FULL dispatcher (exec/aggregate._seg_multi) through the
+    interpret-mode fused kernel must match the XLA reducers on every
+    non-empty segment — sum/min/max, masked rows, int64 counts (narrowed
+    to int32 in-kernel), floats at tolerance."""
+    from spark_rapids_tpu.exec import aggregate as agg
+    rng = np.random.RandomState(5)
+    cap = 2048
+    gid = _sorted_gid(rng, cap, 40)
+    vals = rng.randint(-100, 100, cap).astype(np.int64)
+    fvals = rng.randn(cap)
+    contribute = rng.rand(cap) < 0.8
+    reqs = [("sum", jnp.asarray(vals), jnp.asarray(contribute), 0),
+            ("sum", jnp.asarray(contribute.astype(np.int64)),
+             jnp.asarray(np.ones(cap, bool)), 0, True),
+            ("min", jnp.asarray(vals), jnp.asarray(contribute),
+             jnp.int64(2**63 - 1)),
+            ("max", jnp.asarray(fvals), jnp.asarray(contribute),
+             jnp.float64(-np.inf))]
+    xla = [np.asarray(r) for r in agg._seg_multi(reqs, jnp.asarray(gid),
+                                                 cap)]
+    agg._PALLAS_SEG_INTERPRET[0] = True
+    try:
+        pal = [np.asarray(r) for r in agg._seg_multi(
+            reqs, jnp.asarray(gid), cap)]
+    finally:
+        agg._PALLAS_SEG_INTERPRET[0] = False
+    segs = np.unique(gid)
+    for i, (a, b) in enumerate(zip(xla, pal)):
+        assert a.dtype == b.dtype, i
+        if np.issubdtype(a.dtype, np.floating):
+            np.testing.assert_allclose(a[segs], b[segs], rtol=1e-9,
+                                       atol=1e-12)
+        else:
+            assert np.array_equal(a[segs], b[segs]), i
+
+
+def test_grouped_agg_through_interpret_kernel_matches():
+    """End to end: a grouped aggregate whose update/merge kernels run
+    the fused segmented kernel (interpret hook) matches the XLA run."""
+    from spark_rapids_tpu.engine import TpuSession
+    from spark_rapids_tpu.exec import aggregate as agg
+    from spark_rapids_tpu.plan.logical import col, functions as F
+    from spark_rapids_tpu.utils import kernel_cache as KC
+
+    def q():
+        s = TpuSession({"spark.rapids.sql.tpu.agg.bucketGroups": "false"})
+        df = s.from_pydict({"k": [i % 7 for i in range(600)],
+                            "v": [i % 41 for i in range(600)]})
+        return (df.group_by(col("k"))
+                .agg(F.sum(col("v")).alias("s"),
+                     F.count(col("v")).alias("c"),
+                     F.min(col("v")).alias("mn"),
+                     F.max(col("v")).alias("mx"))
+                .order_by(col("k")).collect())
+    baseline = q()
+    agg._PALLAS_SEG_INTERPRET[0] = True
+    KC.clear()
+    try:
+        assert q() == baseline
+    finally:
+        agg._PALLAS_SEG_INTERPRET[0] = False
+        KC.clear()
+
+
+# --------------------------------------------------------------------------
+# tiled bitonic sort
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1024, 2048, 8192])
+def test_bitonic_sort_u64_interpret(n):
+    from spark_rapids_tpu.ops.pallas_kernels import bitonic_sort_u64
+    rng = np.random.RandomState(n)
+    k = rng.randint(0, 2**63, n).astype(np.uint64)
+    got = np.asarray(bitonic_sort_u64(jnp.asarray(k), interpret=True))
+    assert np.array_equal(got, np.sort(k))
+
+
+def test_bitonic_sort_rejects_bad_length():
+    from spark_rapids_tpu.ops.pallas_kernels import bitonic_sort_u64
+    with pytest.raises(ValueError):
+        bitonic_sort_u64(jnp.zeros(3072, jnp.uint64), interpret=True)
+
+
+# --------------------------------------------------------------------------
+# packed-key argsort (utils/packed_sort)
+# --------------------------------------------------------------------------
+
+def test_packed_argsort_equals_lexsort():
+    """Identical permutation to jnp.lexsort over the same components —
+    including ties (stability via the embedded row id)."""
+    from spark_rapids_tpu.utils.packed_sort import packed_argsort
+    rng = np.random.RandomState(2)
+    cap = 4096
+    a = rng.randint(0, 50, cap).astype(np.uint64)     # many ties
+    b = rng.randint(0, 1 << 40, cap).astype(np.uint64)
+    got = np.asarray(packed_argsort(
+        [(jnp.asarray(a), 6), (jnp.asarray(b), 40)], cap))
+    want = np.asarray(jnp.lexsort((jnp.asarray(b), jnp.asarray(a))))
+    assert np.array_equal(got, want)
+
+
+def test_packed_argsort_multiword_radix():
+    """Total width far past one 64-bit word: the LSD radix pass
+    composition must still equal the one-shot ordering."""
+    from spark_rapids_tpu.utils.packed_sort import packed_argsort
+    rng = np.random.RandomState(3)
+    cap = 2048
+    comps = [(rng.randint(0, 2**60, cap).astype(np.uint64), 64)
+             for _ in range(3)]
+    got = np.asarray(packed_argsort(
+        [(jnp.asarray(c), w) for c, w in comps], cap))
+    want = np.asarray(jnp.lexsort(tuple(
+        jnp.asarray(c) for c, _ in reversed(comps))))
+    assert np.array_equal(got, want)
+
+
+def test_sort_exec_packed_vs_lexsort_conf():
+    """The sort exec's packed path vs the kill-switch lexsort: same rows
+    in the same order, and numPackedSorts counts on the packed run."""
+    from spark_rapids_tpu.engine import TpuSession
+    from spark_rapids_tpu.plan.logical import SortOrder, col
+
+    def q(conf):
+        s = TpuSession(dict(conf))
+        df = s.from_pydict({"a": [i % 17 for i in range(500)],
+                            "t": list(range(500))})
+        out = (df.order_by(SortOrder(col("a"), ascending=False),
+                           SortOrder(col("t"))).collect())
+        return out, s
+    packed, s_on = q({})
+    lex, _ = q({"spark.rapids.sql.tpu.sort.packed.enabled": "false"})
+    assert packed == lex
+    agg = s_on.last_execution.aggregate()
+    assert agg.get("numPackedSorts", 0) >= 1, agg
