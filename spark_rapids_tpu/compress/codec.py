@@ -17,6 +17,7 @@ that makes large leaves parallel and streamable lives in framed.py.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Dict, List, Optional
 
 log = logging.getLogger("spark_rapids_tpu.compress")
@@ -95,6 +96,9 @@ _FACTORIES = {
     "snappy": lambda: ArrowCodec("snappy"),
 }
 _INSTANCES: Dict[str, Codec] = {}
+# codec instances own worker-pool state (framed.py side pools): a racy
+# first-touch from two scheduler threads must not build two of them
+_INSTANCES_LOCK = threading.Lock()
 
 
 def codec_names() -> List[str]:
@@ -132,5 +136,8 @@ def resolve_codec(name: str) -> Codec:
             raise ValueError(
                 f"unknown compression codec {name!r} "
                 f"({'|'.join(codec_names())})")
-        codec = _INSTANCES[key] = factory()
+        with _INSTANCES_LOCK:
+            codec = _INSTANCES.get(key)
+            if codec is None:
+                codec = _INSTANCES[key] = factory()
     return codec

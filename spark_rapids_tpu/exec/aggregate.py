@@ -211,7 +211,7 @@ _PALLAS_SEG_INTERPRET = [False]
 
 
 def set_pallas_cumsum(enabled: bool) -> None:
-    _PALLAS_CUMSUM[0] = bool(enabled)
+    _PALLAS_CUMSUM[0] = bool(enabled)  # tpulint: disable=TPU009 per-session conf latch: atomic boolean store, same-value writers under one session conf
 
 
 def _masked_cumsum(v):

@@ -488,6 +488,11 @@ class TpuShuffleExchangeExec(TpuExec):
                 except RetryExhausted:
                     if fused_stage is None:
                         raise
+                    if _donation.consumed(batch):
+                        # the failed partition dispatch already donated
+                        # the batch's buffers (TPU008): de-fusing would
+                        # read freed device memory — terminal
+                        raise
                     # fused-stage ladder, middle rung: de-fuse — run the
                     # chain operator-at-a-time (each op in its own retry
                     # block, per-op CPU fallback), then bucket the chain

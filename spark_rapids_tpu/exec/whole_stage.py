@@ -183,6 +183,11 @@ class TpuWholeStageExec(FusedPipelineExec):
                     outs = run_retryable(ctx, self.metrics, "wholeStage",
                                          attempt, [batch], split=split)
                 except RetryExhausted:
+                    if donation.consumed(batch):
+                        # a failed dispatch already donated the input's
+                        # buffers: de-fusing would re-read freed device
+                        # memory (TPU008) — the exhaustion is terminal
+                        raise
                     self.metrics.add(MN.NUM_FUSION_FALLBACKS, 1)
                     journal_event("fallback", self.name,
                                   reason="stage_retry_exhausted",
@@ -245,7 +250,10 @@ class TpuWholeStageExec(FusedPipelineExec):
                                               "wholeStageOp", attempt,
                                               [b], split=op_split))
                 except RetryExhausted:
-                    if not cpu_ok:
+                    if not cpu_ok or donation.consumed(b):
+                        # consumed: a failed donating dispatch already
+                        # ate this batch's buffers — the CPU twin would
+                        # D2H freed memory (TPU008); propagate instead
                         raise
                     # on the op (EXPLAIN's per-op rows) AND the stage node
                     # (the tree-walk aggregation only sees plan nodes)

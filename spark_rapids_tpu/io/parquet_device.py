@@ -31,6 +31,7 @@ inherently sequential and fall back), data page v1/v2.
 from __future__ import annotations
 
 import struct
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -759,18 +760,23 @@ def _parse_byte_array_dict(data: bytes, n: int):
 
 _CODECS: dict = {}
 _DECOMP_POOL = None
+_POOL_INIT_LOCK = threading.Lock()
 
 
 def _decomp_pool():
     """Shared thread pool for page decompression: pyarrow's codecs release
-    the GIL, so snappy/zstd across a chunk's pages parallelizes."""
+    the GIL, so snappy/zstd across a chunk's pages parallelizes.  Built
+    under a lock: concurrent first-touch from scheduler worker threads
+    must not build (and leak) two executors (TPU009)."""
     global _DECOMP_POOL
     if _DECOMP_POOL is None:
         import os
         from concurrent.futures import ThreadPoolExecutor
-        _DECOMP_POOL = ThreadPoolExecutor(
-            max_workers=min(8, os.cpu_count() or 1),
-            thread_name_prefix="pq-decomp")
+        with _POOL_INIT_LOCK:
+            if _DECOMP_POOL is None:
+                _DECOMP_POOL = ThreadPoolExecutor(
+                    max_workers=min(8, os.cpu_count() or 1),
+                    thread_name_prefix="pq-decomp")
     return _DECOMP_POOL
 
 
@@ -786,9 +792,11 @@ def _column_pool():
     if _COLUMN_POOL is None:
         import os
         from concurrent.futures import ThreadPoolExecutor
-        _COLUMN_POOL = ThreadPoolExecutor(
-            max_workers=min(8, os.cpu_count() or 1),
-            thread_name_prefix="pq-column")
+        with _POOL_INIT_LOCK:
+            if _COLUMN_POOL is None:
+                _COLUMN_POOL = ThreadPoolExecutor(
+                    max_workers=min(8, os.cpu_count() or 1),
+                    thread_name_prefix="pq-column")
     return _COLUMN_POOL
 
 
@@ -922,7 +930,10 @@ def _decompress(codec: str, payload: bytes, uncompressed_size: int) -> bytes:
     if c is None:
         import pyarrow as pa
         try:
-            c = _CODECS[codec] = pa.Codec(codec.lower())
+            with _POOL_INIT_LOCK:
+                c = _CODECS.get(codec)
+                if c is None:
+                    c = _CODECS[codec] = pa.Codec(codec.lower())
         except Exception as ex:
             raise DeviceDecodeUnsupported(f"codec {codec}: {ex}")
     out = c.decompress(payload, uncompressed_size)

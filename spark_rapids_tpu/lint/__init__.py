@@ -3,15 +3,22 @@
 The compile-time discipline layer of the project (reference analogue: the
 GpuOverrides tagging + audit tooling that police the plugin's contract
 surfaces at build time rather than hoping runtime tests catch drift).
-Seven project-specific passes police the contract surfaces the engine has
-grown — host-sync hazards (TPU001), jit purity (TPU002), the conf
+Eleven project-specific passes police the contract surfaces the engine
+has grown — host-sync hazards (TPU001), jit purity (TPU002), the conf
 registry (TPU003), the metric catalog + journal kinds (TPU004), the
 retry-site / injectOom-sweep contract (TPU005), exception hygiene
-(TPU006) and lock ordering (TPU007).
+(TPU006), lock ordering (TPU007), and since ISSUE 12 a cross-module
+tier built on a linked project model (lint/model.py): buffer-donation
+use-after-donate dataflow (TPU008), the serving-tier shared-state /
+thread-local audit (TPU009), Pallas kernel contracts (TPU010) and
+metric/journal flow coverage (TPU011).
 
 Run it as `python -m spark_rapids_tpu.lint`; CI runs it before the test
-tiers (scripts/ci.sh), so a contract break fails in seconds.  Rules,
-suppressions and the baseline mechanism are documented in docs/lint.md.
+tiers (scripts/ci.sh) with the content-hash incremental cache
+(lint/cache.py, `.tpulint-cache/`) and a <60s cold-run budget, so a
+contract break fails in seconds.  Rules, suppressions, the baseline
+mechanism and the project-model architecture are documented in
+docs/lint.md (`--explain TPUxxx` prints one rule's section).
 """
 from __future__ import annotations
 

@@ -13,12 +13,18 @@ gate over the project (docs/lint.md documents every rule).
   --list-rules             print the rule table and exit
   --check-docs             regenerate docs/configs.md + docs/monitoring.md
                            in memory and fail on drift (CI docs gate)
+  --explain TPUxxx         print the rule's reference section from
+                           docs/lint.md (cite it in suppression reasons)
+  --no-cache               bypass the incremental cache (.tpulint-cache/)
+  --stats                  print cache hit/miss counts and the recorded
+                           full-tree cold vs warm run times
 
 Exit codes: 0 clean, 1 findings, 2 usage error.
 """
 from __future__ import annotations
 
 import os
+import re
 import sys
 
 from .core import (Baseline, lint_paths, render_json, render_text,
@@ -31,6 +37,44 @@ def list_rules() -> str:
     for cls in ALL_PASSES:
         lines.append(f"  {cls.rule_id}  {cls.name:<24} {cls.doc}")
     return "\n".join(lines)
+
+
+_SECTION_RE = re.compile(r"^###\s+(TPU\d{3})\b")
+
+
+def explain_rule(root: str, rule: str) -> int:
+    """Print docs/lint.md's section for `rule` — the reference text a
+    suppression reason should cite.  Exit 2 when the rule (or its doc
+    section) does not exist."""
+    known = {cls.rule_id for cls in ALL_PASSES} | {"TPU000"}
+    if rule not in known:
+        print(f"tpulint: unknown rule {rule!r}; known: "
+              f"{', '.join(sorted(known))}", file=sys.stderr)
+        return 2
+    path = os.path.join(root, "docs", "lint.md")
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        print(f"tpulint: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    out, capturing = [], False
+    for line in lines:
+        m = _SECTION_RE.match(line)
+        if m:
+            if capturing:
+                break
+            capturing = m.group(1) == rule
+        elif capturing and line.startswith("## "):
+            break
+        if capturing:
+            out.append(line)
+    if not out:
+        print(f"tpulint: no docs/lint.md section for {rule} — every "
+              "rule must be documented there", file=sys.stderr)
+        return 2
+    print("\n".join(out).strip())
+    return 0
 
 
 def check_docs_drift(root: str) -> int:
@@ -68,6 +112,9 @@ def main(argv) -> int:
     baseline_path = None
     no_baseline = False
     check_docs = False
+    use_cache = True
+    show_stats = False
+    explain = None
     i = 0
     while i < len(argv):
         arg = argv[i]
@@ -83,12 +130,22 @@ def main(argv) -> int:
                 return 2
             baseline_path = argv[i + 1]
             i += 2
+        elif arg == "--explain":
+            if i + 1 >= len(argv):
+                print(__doc__, file=sys.stderr)
+                return 2
+            explain = argv[i + 1]
+            i += 2
         elif arg == "--json":
             as_json, i = True, i + 1
         elif arg == "--verbose":
             verbose, i = True, i + 1
         elif arg == "--no-baseline":
             no_baseline, i = True, i + 1
+        elif arg == "--no-cache":
+            use_cache, i = False, i + 1
+        elif arg == "--stats":
+            show_stats, i = True, i + 1
         elif arg == "--list-rules":
             print(list_rules())
             return 0
@@ -101,17 +158,32 @@ def main(argv) -> int:
             paths.append(arg)
             i += 1
     root = repo_root()
+    if explain is not None:
+        return explain_rule(root, explain)
     if check_docs:
         return check_docs_drift(root)
+    if rules is not None or paths:
+        # subset runs must not poison the full-surface cache entries'
+        # pass-coverage (core would treat them as misses anyway; skip
+        # the write half too)
+        use_cache = False
     try:
         result = lint_paths(paths=paths or None, rules=rules,
                             baseline=Baseline([]) if no_baseline else None,
-                            baseline_path=baseline_path, root=root)
+                            baseline_path=baseline_path, root=root,
+                            use_cache=use_cache)
     except ValueError as e:  # unknown --rules id: usage error, not green
         print(f"tpulint: {e}", file=sys.stderr)
         return 2
     print(render_json(result) if as_json
           else render_text(result, verbose=verbose))
+    if show_stats:
+        from .cache import render_stats
+        for line in render_stats(root, result.cache_hits,
+                                 result.cache_misses, result.elapsed_s,
+                                 result.files_checked,
+                                 enabled=use_cache):
+            print(line)
     return result.exit_code
 
 

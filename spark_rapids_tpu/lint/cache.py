@@ -1,0 +1,205 @@
+"""tpulint incremental cache (ISSUE 12 satellite).
+
+The CI tier runs the full analyzer on every push; most pushes touch a
+handful of files.  This cache keys each source file by content hash and
+stores (a) the per-rule findings of every CACHEABLE pass (one whose
+check_file output is a pure function of the file's bytes, given the lint
+sources and contract files pinned in the salt) and (b) the file's
+project-model fragment plus each pass's cross-file fragment (TPU005
+reserve sites, TPU007 lock edges, ...), so a warm run skips both the
+parse and every per-file AST walk for unchanged files.  Cross-file
+finalizers always run fresh — they are cheap graph queries over the
+absorbed fragments.
+
+Invalidation is by construction, not bookkeeping: the cache key is
+  sha256(salt + file bytes)
+where `salt` hashes every lint-package source AND the contract files the
+per-file passes consult indirectly (config.py's registry for TPU003,
+metrics/names.py + metrics/journal.py for TPU004/TPU011, the sweep/test
+files for TPU005/TPU010).  Editing a pass or a contract surface changes
+the salt, which orphans every entry — stale entries are simply never
+read again and are pruned opportunistically.
+
+Layout: `<root>/.tpulint-cache/<sha>.pkl` holding
+  {"rules": {rule_id: {"findings": [...], "fragment": obj}},
+   "model": ModuleModel}
+plus `stats.json` recording the last cold/warm wall times for `--stats`.
+`--no-cache` (and library callers by default) bypass everything.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Dict, List, Optional
+
+CACHE_DIR_NAME = ".tpulint-cache"
+
+#: contract files whose CONTENT feeds per-file pass verdicts without
+#: being part of the checked file itself — they must invalidate entries
+_SALT_FILES = (
+    "spark_rapids_tpu/config.py",
+    "spark_rapids_tpu/metrics/names.py",
+    "spark_rapids_tpu/metrics/journal.py",
+    "spark_rapids_tpu/metrics/registry.py",
+    "tests/test_retry.py",
+    "tests/test_pallas.py",
+    "docs/lint.md",
+)
+
+
+def _hash_bytes(h, path: str) -> None:
+    try:
+        with open(path, "rb") as f:
+            h.update(f.read())
+    except OSError:
+        h.update(b"<missing>")
+
+
+def compute_salt(root: str) -> bytes:
+    """Digest of the analyzer itself + the contract surfaces it reads."""
+    h = hashlib.sha256()
+    lint_dir = os.path.dirname(os.path.abspath(__file__))
+    for dirpath, dirs, files in os.walk(lint_dir):
+        dirs[:] = [d for d in dirs if d != "__pycache__"
+                   and d != CACHE_DIR_NAME]
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                _hash_bytes(h, os.path.join(dirpath, fn))
+    for rel in _SALT_FILES:
+        _hash_bytes(h, os.path.join(root, rel))
+    return h.digest()
+
+
+class LintCache:
+    """Content-addressed per-file analysis cache."""
+
+    def __init__(self, root: str, enabled: bool = True):
+        self.root = root
+        self.enabled = enabled
+        self.dir = os.path.join(root, CACHE_DIR_NAME)
+        self.hits = 0
+        self.misses = 0
+        self._salt = compute_salt(root) if enabled else b""
+        self._live: set = set()
+        if enabled:
+            os.makedirs(self.dir, exist_ok=True)
+
+    def key_for(self, text: str, rel_path: str = "") -> str:
+        # rel_path is part of the key: findings and model fragments
+        # carry the file's PATH, so two byte-identical files (empty
+        # __init__.py twins, copied modules) must not share an entry —
+        # the second would replay the first's paths
+        h = hashlib.sha256(self._salt)
+        h.update(rel_path.encode("utf-8", "surrogatepass"))
+        h.update(b"\x00")
+        h.update(text.encode("utf-8", "surrogatepass"))
+        return h.hexdigest()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.pkl")
+
+    def load(self, key: str) -> Optional[dict]:
+        if not self.enabled:
+            return None
+        self._live.add(key)
+        try:
+            with open(self._path(key), "rb") as f:
+                entry = pickle.load(f)
+        except (OSError, pickle.PickleError, EOFError, AttributeError,
+                ImportError, IndexError):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return entry
+
+    def store(self, key: str, entry: dict) -> None:
+        if not self.enabled:
+            return
+        self._live.add(key)
+        tmp = self._path(key) + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(entry, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self._path(key))
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass  # tpulint: disable=TPU006 best-effort temp cleanup; the cache is an optimization, never a correctness surface
+
+    def prune(self) -> int:
+        """Drop entries no live file produced this run (renamed/removed
+        files and orphans from older salts)."""
+        if not self.enabled:
+            return 0
+        dropped = 0
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return 0
+        for fn in names:
+            if not fn.endswith(".pkl"):
+                continue
+            if fn[:-4] not in self._live:
+                try:
+                    os.unlink(os.path.join(self.dir, fn))
+                    dropped += 1
+                except OSError:
+                    pass  # tpulint: disable=TPU006 concurrent prune/removal loses the race benignly
+        return dropped
+
+    # -- --stats timing record ------------------------------------------------
+
+    def record_run(self, seconds: float, files: int) -> None:
+        if not self.enabled:
+            return
+        stats = self.read_stats()
+        kind = "warm" if self.hits >= max(1, files // 2) else "cold"
+        stats[f"last_{kind}_s"] = round(seconds, 3)
+        stats[f"last_{kind}_files"] = files
+        stats["updated"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        try:
+            with open(os.path.join(self.dir, "stats.json"), "w") as f:
+                json.dump(stats, f, indent=2)
+        except OSError:
+            pass  # tpulint: disable=TPU006 stats file is advisory output for --stats, never load-bearing
+
+    def read_stats(self) -> Dict:
+        return read_stats(self.root)
+
+
+def read_stats(root: str) -> Dict:
+    """The recorded cold/warm history, no LintCache (and no salt
+    computation) required — the `--stats` read path."""
+    try:
+        with open(os.path.join(root, CACHE_DIR_NAME, "stats.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def render_stats(root: str, hits: int, misses: int, seconds: float,
+                 files: int, enabled: bool = True) -> List[str]:
+    """The `--stats` lines: this run + the recorded cold/warm history."""
+    lines = [f"tpulint stats: {files} files in {seconds:.2f}s"]
+    if not enabled:
+        lines.append("tpulint stats: cache disabled (--no-cache)")
+        return lines
+    lines.append(
+        f"tpulint stats: cache {hits} hit(s), {misses} "
+        f"miss(es) under {CACHE_DIR_NAME}/")
+    hist = read_stats(root)
+    cold = hist.get("last_cold_s")
+    warm = hist.get("last_warm_s")
+    if cold is not None and warm is not None:
+        speed = f" ({cold / warm:.1f}x)" if warm else ""
+        lines.append(
+            f"tpulint stats: full-tree cold {cold:.2f}s vs warm "
+            f"{warm:.2f}s{speed}")
+    elif cold is not None:
+        lines.append(f"tpulint stats: full-tree cold {cold:.2f}s "
+                     "(no warm run recorded yet)")
+    return lines

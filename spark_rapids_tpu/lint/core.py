@@ -67,23 +67,35 @@ class Finding:
 
 
 class FileContext:
-    """One parsed source file handed to every pass."""
+    """One parsed source file handed to every pass.
+
+    `tree` may be handed in as None (incremental-cache hit): the property
+    parses LAZILY on first access, so a warm run whose per-file findings
+    all replay from the cache never pays the parse — only files a
+    cross-file finalizer actually inspects (the contract files) do."""
 
     def __init__(self, path: str, rel_path: str, text: str,
-                 tree: ast.Module, scope: str):
+                 tree: Optional[ast.Module], scope: str):
         self.path = path
         self.rel_path = rel_path
         self.text = text
         self.lines = text.splitlines()
-        self.tree = tree
+        self._tree = tree
         #: "package" for spark_rapids_tpu/ sources, "aux" for tests/,
         #: bench and scripts — passes pick the scopes they police
         self.scope = scope
         #: line -> set of rule ids suppressed there ({"all"} allowed)
         self.suppressions: Dict[int, Set[str]] = {}
-        #: suppressions missing a reason: honored NOT — reported instead
-        self.bad_suppressions: List[int] = []
+        #: (line, rule ids) of suppressions missing a reason: honored
+        #: NOT — reported instead, naming the nearest rule doc
+        self.bad_suppressions: List[Tuple[int, Tuple[str, ...]]] = []
         self._parse_suppressions()
+
+    @property
+    def tree(self) -> ast.Module:
+        if self._tree is None:
+            self._tree = ast.parse(self.text, filename=self.path)
+        return self._tree
 
     def _parse_suppressions(self) -> None:
         for i, line in enumerate(self.lines, start=1):
@@ -95,7 +107,7 @@ class FileContext:
             rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
             reason = m.group(2).strip()
             if not reason:
-                self.bad_suppressions.append(i)
+                self.bad_suppressions.append((i, tuple(sorted(rules))))
                 continue
             self.suppressions.setdefault(i, set()).update(rules)
 
@@ -112,16 +124,39 @@ class FileContext:
 class LintPass:
     """SPI: subclass, set rule_id/name/doc, implement check_file and/or
     finalize.  One instance lives for one lint run, so cross-file state
-    accumulated in check_file is readable in finalize."""
+    accumulated in check_file is readable in finalize.
+
+    Incremental-cache contract (lint/cache.py): a pass marked
+    `cacheable` promises its check_file findings are a pure function of
+    the file bytes (given the contract files pinned in the cache salt).
+    A pass that also accumulates per-file CROSS-file state returns it
+    from `file_fragment(ctx)` (picklable) and re-absorbs it on warm runs
+    via `absorb_fragment` — so a cache hit skips the AST walk but the
+    finalizer still sees every file's contribution.  `needs_model = True`
+    asks the runner to link the ProjectModel (lint/model.py) before
+    finalize; it is exposed as `project.model`."""
 
     rule_id: str = "TPU9XX"
     name: str = "unnamed"
     doc: str = ""
     #: which file scopes this pass polices
     scopes: Tuple[str, ...] = ("package",)
+    #: check_file findings + file_fragment are content-pure -> cacheable
+    cacheable: bool = False
+    #: runner must build/link the cross-module ProjectModel
+    needs_model: bool = False
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         return ()
+
+    def file_fragment(self, ctx: FileContext):
+        """Picklable per-file cross-file state (None = none).  Called
+        after check_file on cold files; the cache replays it into
+        absorb_fragment on warm runs."""
+        return None
+
+    def absorb_fragment(self, rel_path: str, fragment) -> None:
+        """Re-absorb a cached file_fragment (no-op default)."""
 
     def finalize(self, project: "Project") -> Iterable[Finding]:
         return ()
@@ -131,6 +166,9 @@ class LintPass:
 class Project:
     root: str
     files: List[FileContext] = field(default_factory=list)
+    #: linked cross-module model (lint/model.py), present when any
+    #: active pass sets needs_model
+    model: object = None
 
     def file(self, rel_path: str) -> Optional[FileContext]:
         for ctx in self.files:
@@ -176,13 +214,17 @@ class Baseline:
         return cls(data.get("entries", []), origin=rel)
 
     def apply(self, findings: List[Finding],
-              active_rules: Optional[Set[str]] = None
+              active_rules: Optional[Set[str]] = None,
+              present_paths: Optional[Set[str]] = None
               ) -> Tuple[List[Finding], List[Finding], List[str]]:
         """Split into (reported, baselined, stale-entry warnings): the
         first `count` findings per (rule, path) — in line order — are
         grandfathered, the excess is reported.  Staleness is only judged
         for rules in `active_rules` (None = all): a --rules subset run
-        must not claim grants for passes that never ran are unused."""
+        must not claim grants for passes that never ran are unused.
+        `present_paths` (the full-surface file set, when known) upgrades
+        the message for grants whose file is GONE — those entries are
+        dead weight and should be pruned outright."""
         by_key: Dict[Tuple[str, str], List[Finding]] = {}
         for f in findings:
             by_key.setdefault(f.key(), []).append(f)
@@ -201,9 +243,15 @@ class Baseline:
                 continue
             n = len(by_key.get(key, []))
             if n < grant:
-                stale.append(
-                    f"{key[1]}: baseline grants {grant} x {key[0]} but "
-                    f"only {n} remain — lower the entry")
+                if present_paths is not None \
+                        and key[1] not in present_paths:
+                    stale.append(
+                        f"{key[1]}: baseline grants {grant} x {key[0]} "
+                        "but the file no longer exists — prune the entry")
+                else:
+                    stale.append(
+                        f"{key[1]}: baseline grants {grant} x {key[0]} "
+                        f"but only {n} remain — lower the entry")
         return reported, baselined, stale
 
 
@@ -214,6 +262,9 @@ class LintResult:
     suppressed: List[Finding]
     stale_baseline: List[str]
     files_checked: int
+    cache_hits: int = 0
+    cache_misses: int = 0
+    elapsed_s: float = 0.0
 
     @property
     def exit_code(self) -> int:
@@ -268,10 +319,15 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
                baseline: Optional[Baseline] = None,
                baseline_path: Optional[str] = None,
                root: Optional[str] = None,
-               passes: Optional[Sequence[LintPass]] = None) -> LintResult:
-    """Run the framework: parse every file once, run each pass over it,
-    then the cross-file finalizers, then suppression + baseline filters."""
+               passes: Optional[Sequence[LintPass]] = None,
+               use_cache: bool = False) -> LintResult:
+    """Run the framework: parse every file once (or replay it from the
+    incremental cache when `use_cache` and the content hash matches), run
+    each pass over it, link the cross-module project model, run the
+    cross-file finalizers, then the suppression + baseline filters."""
+    import time as _time
     from .passes import ALL_PASSES
+    t0 = _time.perf_counter()
     root = root or repo_root()
     if rules is not None:
         known = {cls.rule_id for cls in ALL_PASSES}
@@ -290,7 +346,15 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
             else default_baseline_path()
         baseline = Baseline.load(bp) if bp and os.path.exists(bp) \
             else Baseline([])
+    cache = None
+    if use_cache:
+        from .cache import LintCache
+        cache = LintCache(root, enabled=True)
+    want_model = any(getattr(p, "needs_model", False) for p in passes)
+    cacheable_rules = {p.rule_id for p in passes
+                       if getattr(p, "cacheable", False)}
     project = Project(root=root)
+    fragments = []
     raw: List[Finding] = []
     raw.extend(baseline.errors)
     file_list = discover_files(paths or default_paths(root), root)
@@ -299,22 +363,75 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
         try:
             with open(path, encoding="utf-8") as f:
                 text = f.read()
+        except OSError as e:
+            raw.append(Finding(META_RULE, rel, 1, f"cannot parse: {e}"))
+            continue
+        entry = None
+        key = None
+        if cache is not None:
+            key = cache.key_for(text, rel)
+            entry = cache.load(key)
+            if entry is not None and not cacheable_rules <= set(
+                    entry.get("rules", ())):
+                # cached under a different pass subset: treat as a miss
+                cache.hits -= 1
+                cache.misses += 1
+                entry = None
+        if entry is not None:
+            # warm path: findings + fragments replay; the tree stays
+            # unparsed unless a finalizer asks for it
+            ctx = FileContext(path, rel, text, None, _scope_of(rel))
+            project.files.append(ctx)
+            _report_bad_suppressions(ctx, raw)
+            for p in passes:
+                if ctx.scope not in p.scopes:
+                    continue
+                rec = entry["rules"].get(p.rule_id) \
+                    if getattr(p, "cacheable", False) else None
+                if rec is not None:
+                    raw.extend(Finding(**d) for d in rec["findings"])
+                    if rec["fragment"] is not None:
+                        p.absorb_fragment(rel, rec["fragment"])
+                else:
+                    raw.extend(p.check_file(ctx))
+            if want_model:
+                fragments.append(entry["model"])
+            continue
+        try:
             tree = ast.parse(text, filename=path)
-        except (OSError, SyntaxError) as e:
+        except SyntaxError as e:
             raw.append(Finding(META_RULE, rel, getattr(e, "lineno", 1) or 1,
                                f"cannot parse: {e}"))
             continue
         ctx = FileContext(path, rel, text, tree, _scope_of(rel))
         project.files.append(ctx)
-        for ln in ctx.bad_suppressions:
-            raw.append(Finding(META_RULE, rel, ln,
-                               "tpulint suppression without a reason "
-                               "(write `# tpulint: disable=TPUxxx "
-                               "<why>`); not honored"))
+        _report_bad_suppressions(ctx, raw)
+        rules_rec: Dict[str, dict] = {}
         for p in passes:
-            if ctx.scope not in p.scopes:
-                continue
-            raw.extend(p.check_file(ctx))
+            file_findings: List[Finding] = []
+            if ctx.scope in p.scopes:
+                file_findings = list(p.check_file(ctx))
+                raw.extend(file_findings)
+            if getattr(p, "cacheable", False):
+                frag = p.file_fragment(ctx) if ctx.scope in p.scopes \
+                    else None
+                rules_rec[p.rule_id] = {
+                    "findings": [dict(rule=f.rule, path=f.path,
+                                      line=f.line, message=f.message,
+                                      span_end=f.span_end)
+                                 for f in file_findings],
+                    "fragment": frag}
+        model_frag = None
+        if want_model or cache is not None:
+            from .model import extract_module
+            model_frag = extract_module(rel, tree)
+        if want_model:
+            fragments.append(model_frag)
+        if cache is not None and key is not None:
+            cache.store(key, {"rules": rules_rec, "model": model_frag})
+    if want_model:
+        from .model import ProjectModel
+        project.model = ProjectModel.link(fragments)
     for p in passes:
         raw.extend(p.finalize(project))
     # suppression filter (line-window pragmas), then baseline filter
@@ -330,12 +447,39 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
         else:
             unsuppressed.append(f)
     active_rules = {p.rule_id for p in passes} | {META_RULE}
+    # only a full-surface run can distinguish "file removed" from "file
+    # outside the linted subset"
+    present = set(ctx_by_rel) if paths is None else None
     reported, baselined, stale = baseline.apply(unsuppressed,
-                                                active_rules=active_rules)
+                                                active_rules=active_rules,
+                                                present_paths=present)
     reported.sort(key=lambda f: (f.path, f.line, f.rule))
+    elapsed = _time.perf_counter() - t0
+    if cache is not None:
+        if paths is None:
+            # only a full-surface run may prune: a subset run's _live
+            # set would otherwise delete every other file's entry
+            cache.prune()
+        cache.record_run(elapsed, len(project.files))
     return LintResult(findings=reported, baselined=baselined,
                       suppressed=suppressed, stale_baseline=stale,
-                      files_checked=len(project.files))
+                      files_checked=len(project.files),
+                      cache_hits=cache.hits if cache else 0,
+                      cache_misses=cache.misses if cache else 0,
+                      elapsed_s=elapsed)
+
+
+def _report_bad_suppressions(ctx: FileContext, raw: List[Finding]) -> None:
+    for ln, rule_ids in ctx.bad_suppressions:
+        which = ", ".join(rule_ids) or "TPUxxx"
+        # `disable=all` has no rule id to cite: fall back to a real one
+        ref = next((r for r in rule_ids if r.startswith("TPU")), "TPU001")
+        raw.append(Finding(
+            META_RULE, ctx.rel_path, ln,
+            f"tpulint suppression of {which} without a reason (write "
+            f"`# tpulint: disable={which} <why>`); not honored — rule "
+            f"reference: docs/lint.md, or `python -m spark_rapids_tpu"
+            f".lint --explain {ref}`"))
 
 
 # -- rendering ---------------------------------------------------------------

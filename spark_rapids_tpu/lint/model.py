@@ -1,0 +1,745 @@
+"""tpulint project model — the cross-module analysis layer (ISSUE 12).
+
+PR 9's passes were per-file AST visitors; the invariants PRs 10-11 added
+(buffer-donation last-consumer proofs, thread-shared serving state,
+backend-gated Pallas constraints) are only checkable with whole-project
+structure.  This module builds that structure in two phases:
+
+  * **extraction** — one `ModuleModel` per source file: the module's
+    classes (with base names and methods), every function (including
+    nested defs) with its call sites, shared-state writes (and whether a
+    lock was lexically held), thread-spawn sites, metric-emission and
+    journal-kind sites, module-level integer/string constants, and the
+    pallas kernel wrappers it defines.  A `ModuleModel` is plain
+    picklable data, so the incremental cache (lint/cache.py) can persist
+    it per content hash and a warm run re-extracts only changed files;
+  * **linking** — `ProjectModel.link()` stitches the fragments into the
+    global views the cross-module passes query: the class hierarchy
+    (bases resolved by name across modules, ancestors + descendants),
+    the call graph with attribute-call resolution (`self.m()` resolves
+    through the receiver's class family, `mod.f()` through imports,
+    `obj.m()` falls back to every known method named `m` — a deliberate
+    over-approximation, so reachability queries err on the side of
+    "reachable"), and reachability closures from entry-point sets.
+
+The intraprocedural side lives in `branch_paths` / `may_follow` /
+`dominates`: statements get branch-path coordinates (which If-arm /
+except-handler / loop body they sit in) so a pass can ask "can this read
+execute after that donation?" without a full CFG — sibling If-arms are
+mutually exclusive, an except handler may follow its try body, a loop
+body may follow itself, and an arm that ends in return/raise never flows
+into the statements after its If.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# picklable per-file fragments
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class CallSite:
+    name: str           # callee as written: "self.batch_fn", "donation.pin"
+    line: int
+
+
+@dataclass
+class WriteSite:
+    kind: str           # "attr" (self.X) | "global" (module-level name)
+    target: str
+    line: int
+    under_lock: bool    # lexically inside `with <something lock-like>:`
+    in_init: bool       # written from __init__ (single-threaded setup)
+
+
+@dataclass
+class SpawnSite:
+    target: str         # dotted callable handed to the thread boundary
+    line: int
+    api: str            # "Thread" | "submit"
+
+
+@dataclass
+class EmissionSite:
+    metrics: Tuple[str, ...]  # resolved literal candidates (may be empty)
+    attr: Optional[str]       # unresolved `MN.X` tail, resolved at link time
+    line: int
+    method: str
+
+
+@dataclass
+class FuncInfo:
+    name: str
+    qual: str                       # "rel/path.py::Class.meth" or "::func"
+    module: str                     # rel_path of the defining file
+    cls: Optional[str]
+    line: int
+    end_line: int
+    params: Tuple[str, ...] = ()
+    public: bool = False
+    calls: List[CallSite] = field(default_factory=list)
+    writes: List[WriteSite] = field(default_factory=list)
+    spawns: List[SpawnSite] = field(default_factory=list)
+    emissions: List[EmissionSite] = field(default_factory=list)
+    journal_kinds: List[Tuple[str, int]] = field(default_factory=list)
+    retry_blocks: List[Tuple[str, int]] = field(default_factory=list)
+    #: thread-local state reads: (api name, line)
+    tl_reads: List[Tuple[str, int]] = field(default_factory=list)
+    #: installs a fresh thread-local scope (trace_context/push_active/...)
+    tl_installs: bool = False
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str
+    bases: Tuple[str, ...]
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> qual
+    line: int = 0
+    #: __init__ assigns a threading.Lock/RLock/Condition-valued attribute
+    owns_lock: bool = False
+
+
+@dataclass
+class ModuleModel:
+    rel_path: str
+    imports: Dict[str, str] = field(default_factory=dict)
+    constants: Dict[str, object] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+    #: module-level public functions whose body calls pl.pallas_call
+    kernel_wrappers: List[Tuple[str, int]] = field(default_factory=list)
+
+
+# the thread-local surfaces PR 7/10 route per-query state through; reading
+# one on a fresh thread without a re-install call observes another query's
+# (or no) context — docs/lint.md#TPU009
+TL_READ_APIS = frozenset({
+    "current_trace", "active_journal", "journal_event", "journal_span",
+    "current_query_scope"})
+TL_INSTALL_APIS = frozenset({
+    "trace_context", "push_active", "query_scope", "QueryExecution",
+    "install_trace"})
+
+_LOCK_FACTORY_TAILS = ("Lock", "RLock", "Condition", "Semaphore",
+                      "BoundedSemaphore")
+_EMIT_METHODS = frozenset({"add", "add_lazy", "add_sync", "set_max",
+                           "timer"})
+
+
+def _is_lockish(expr: ast.expr) -> bool:
+    """Heuristic lock identity for `with <expr>:` — mirrors TPU007."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr) or ""
+    tail = name.rsplit(".", 1)[-1].lower()
+    return "lock" in tail or "_cv" == tail or "cond" in tail
+
+
+def _literal_values(fn_node, var: str) -> Tuple[str, ...]:
+    """Possible string-literal bindings of `var` inside fn_node: plain
+    assignments and `for var in ("a", "b")` loop targets.  The tiny
+    lattice TPU011 needs to resolve `for mk in (...): metrics.add(mk, d)`."""
+    out: List[str] = []
+    nodes = []
+    for stmt in (fn_node.body if isinstance(fn_node.body, list)
+                 else [fn_node.body]):
+        nodes.extend(ast.walk(stmt))
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == var:
+                    if isinstance(node.value, ast.Constant) and \
+                            isinstance(node.value.value, str):
+                        out.append(node.value.value)
+        elif isinstance(node, ast.For) and isinstance(node.target,
+                                                      ast.Name) \
+                and node.target.id == var \
+                and isinstance(node.iter, (ast.Tuple, ast.List)):
+            for el in node.iter.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               str):
+                    out.append(el.value)
+    return tuple(out)
+
+
+def _journal_kind_of(call: ast.Call) -> Optional[str]:
+    """Literal journal kind of a call, or None (shares TPU004's shape)."""
+    name = dotted_name(call.func) or ""
+    tail = name.rsplit(".", 1)[-1]
+    is_journal = tail in ("journal_event", "journal_span")
+    if not is_journal and isinstance(call.func, ast.Attribute) \
+            and call.func.attr in ("begin", "instant", "span"):
+        recv = (dotted_name(call.func.value) or "").lower()
+        is_journal = any(h in recv for h in ("journal", "shard"))
+    if is_journal and call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def extract_module(rel_path: str, tree: ast.Module) -> ModuleModel:
+    """Phase 1: one file -> its picklable model fragment."""
+    mm = ModuleModel(rel_path=rel_path)
+
+    # imports ANYWHERE in the file: the repo's idiom is function-level
+    # imports (cycle avoidance), and call resolution must see them
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mm.imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                mm.imports[a.asname or a.name] = \
+                    f"{node.module or ''}.{a.name}"
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Constant) \
+                and isinstance(stmt.value.value, (int, str)):
+            mm.constants[stmt.targets[0].id] = stmt.value.value
+
+    def extract_fn(fn: ast.AST, qual: str, cls: Optional[str],
+                   name: str) -> FuncInfo:
+        fi = FuncInfo(
+            name=name, qual=qual, module=rel_path, cls=cls,
+            line=getattr(fn, "lineno", 1),
+            end_line=getattr(fn, "end_lineno", None)
+            or getattr(fn, "lineno", 1),
+            public=(not name.startswith("_")
+                    or (name.startswith("__") and name.endswith("__"))))
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = fn.args
+            fi.params = tuple(
+                p.arg for p in getattr(a, "posonlyargs", []) + a.args
+                + a.kwonlyargs)
+
+        lock_depth = [0]
+
+        def walk(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # nested defs get their own FuncInfo
+            if isinstance(node, ast.With):
+                locked = sum(1 for it in node.items
+                             if _is_lockish(it.context_expr))
+                for it in node.items:
+                    walk(it.context_expr)
+                lock_depth[0] += locked
+                for child in node.body:
+                    walk(child)
+                lock_depth[0] -= locked
+                return
+            if isinstance(node, ast.Call):
+                cname = dotted_name(node.func)
+                if cname:
+                    fi.calls.append(CallSite(cname, node.lineno))
+                    tail = cname.rsplit(".", 1)[-1]
+                    if tail in TL_READ_APIS:
+                        fi.tl_reads.append((tail, node.lineno))
+                    if tail in TL_INSTALL_APIS:
+                        fi.tl_installs = True
+                    # thread boundaries
+                    if tail == "Thread":
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                t = dotted_name(kw.value)
+                                if t:
+                                    fi.spawns.append(SpawnSite(
+                                        t, node.lineno, "Thread"))
+                    elif tail == "submit" and node.args:
+                        recv = (dotted_name(node.func.value) or "") \
+                            if isinstance(node.func, ast.Attribute) else ""
+                        if any(h in recv.lower()
+                               for h in ("pool", "executor")):
+                            t = dotted_name(node.args[0])
+                            if t:
+                                fi.spawns.append(SpawnSite(
+                                    t, node.lineno, "submit"))
+                    # metric emissions (TPU004 shape, resolution added)
+                    if isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in _EMIT_METHODS \
+                            and node.args:
+                        # a ternary arg emits either arm
+                        arms = [node.args[0]]
+                        if isinstance(node.args[0], ast.IfExp):
+                            arms = [node.args[0].body,
+                                    node.args[0].orelse]
+                        for arg in arms:
+                            if isinstance(arg, ast.Constant) \
+                                    and isinstance(arg.value, str):
+                                fi.emissions.append(EmissionSite(
+                                    (arg.value,), None, node.lineno,
+                                    node.func.attr))
+                            elif isinstance(arg, ast.Attribute):
+                                fi.emissions.append(EmissionSite(
+                                    (), arg.attr, node.lineno,
+                                    node.func.attr))
+                            elif isinstance(arg, ast.Name):
+                                fi.emissions.append(EmissionSite(
+                                    _literal_values(fn, arg.id), None,
+                                    node.lineno, node.func.attr))
+                    if cname.rsplit(".", 1)[-1] == "count_swallowed" \
+                            and node.args \
+                            and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        fi.emissions.append(EmissionSite(
+                            (node.args[0].value,), None, node.lineno,
+                            "count_swallowed"))
+                    # retry blocks derive {block}Retries/{block}Splits
+                    blk = None
+                    if tail == "run_retryable" and len(node.args) >= 3 \
+                            and isinstance(node.args[2], ast.Constant) \
+                            and isinstance(node.args[2].value, str):
+                        blk = node.args[2].value
+                    elif tail == "with_retry":
+                        blk = "retryBlock"  # with_retry's default name=
+                        for kw in node.keywords:
+                            if kw.arg == "name" \
+                                    and isinstance(kw.value, ast.Constant) \
+                                    and isinstance(kw.value.value, str):
+                                blk = kw.value.value
+                    if blk is not None:
+                        fi.retry_blocks.append((blk, node.lineno))
+                    kind = _journal_kind_of(node)
+                    if kind is not None:
+                        fi.journal_kinds.append((kind, node.lineno))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self":
+                        fi.writes.append(WriteSite(
+                            "attr", tgt.attr, tgt.lineno,
+                            lock_depth[0] > 0, name == "__init__"))
+                    elif isinstance(tgt, ast.Subscript):
+                        base = tgt.value
+                        if isinstance(base, ast.Name) \
+                                and base.id in module_globals:
+                            fi.writes.append(WriteSite(
+                                "global", base.id, tgt.lineno,
+                                lock_depth[0] > 0, name == "__init__"))
+                    elif isinstance(tgt, ast.Name) \
+                            and tgt.id in declared_globals.get(id(fn),
+                                                               set()):
+                        fi.writes.append(WriteSite(
+                            "global", tgt.id, tgt.lineno,
+                            lock_depth[0] > 0, name == "__init__"))
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            walk(stmt)
+        return fi
+
+    # module-global names (for subscript-write detection) and `global`
+    # declarations per function
+    module_globals: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    module_globals.add(tgt.id)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                            ast.Name):
+            module_globals.add(stmt.target.id)
+    declared_globals: Dict[int, Set[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            g: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Global):
+                    g.update(sub.names)
+            declared_globals[id(node)] = g
+
+    def visit_scope(body: Sequence[ast.stmt], cls: Optional[str],
+                    prefix: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                bases = tuple(b for b in
+                              (dotted_name(x) for x in stmt.bases) if b)
+                ci = ClassInfo(stmt.name, rel_path, bases,
+                               line=stmt.lineno)
+                mm.classes[stmt.name] = ci
+                visit_scope(stmt.body, stmt.name, f"{stmt.name}.")
+                # lock ownership: __init__ assigns a lock-factory value
+                for s in stmt.body:
+                    if isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                            and s.name == "__init__":
+                        for sub in ast.walk(s):
+                            if isinstance(sub, ast.Assign) \
+                                    and isinstance(sub.value, ast.Call):
+                                vname = dotted_name(sub.value.func) or ""
+                                if vname.rsplit(".", 1)[-1] in \
+                                        _LOCK_FACTORY_TAILS:
+                                    ci.owns_lock = True
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{rel_path}::{prefix}{stmt.name}"
+                fi = extract_fn(stmt, qual, cls, stmt.name)
+                mm.functions[qual] = fi
+                if cls is not None and prefix.count(".") == 1:
+                    mm.classes[cls].methods[stmt.name] = qual
+                if cls is None and prefix == "" \
+                        and not stmt.name.startswith("_") \
+                        and any((dotted_name(c.func) or "").rsplit(
+                                ".", 1)[-1] == "pallas_call"
+                                for c in ast.walk(stmt)
+                                if isinstance(c, ast.Call)):
+                    mm.kernel_wrappers.append((stmt.name, stmt.lineno))
+                # nested defs
+                visit_scope([s for s in ast.walk(stmt)
+                             if isinstance(s, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef))
+                             and s is not stmt
+                             and _direct_parent_fn(stmt, s)],
+                            cls, f"{prefix}{stmt.name}.<locals>.")
+
+    def _direct_parent_fn(outer: ast.AST, inner: ast.AST) -> bool:
+        """inner is defined directly under outer (not under a deeper def)."""
+        for node in ast.walk(outer):
+            if node is inner:
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not outer:
+                if any(sub is inner for sub in ast.walk(node)):
+                    return False
+        return True
+
+    visit_scope(tree.body, None, "")
+    # module-level code as a pseudo-function (reachability root; emission
+    # sites at import time count as reachable)
+    top = extract_fn(_ModuleBody(tree), f"{rel_path}::<module>", None,
+                     "<module>")
+    top.public = True
+    mm.functions[top.qual] = top
+    return mm
+
+
+class _ModuleBody:
+    """Adapter: module top-level statements as a function-like body."""
+
+    def __init__(self, tree: ast.Module):
+        self.body = [s for s in tree.body
+                     if not isinstance(s, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.ClassDef))]
+        self.lineno = 1
+        self.end_lineno = getattr(tree, "end_lineno", 1)
+
+
+# ---------------------------------------------------------------------------
+# linking: the global views
+# ---------------------------------------------------------------------------
+
+class ProjectModel:
+    """Linked whole-project model.  Build with `ProjectModel.link`."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleModel] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, List[ClassInfo]] = {}
+        self.methods_by_name: Dict[str, List[str]] = {}
+        self.funcs_by_name: Dict[str, List[str]] = {}
+        self._family: Dict[str, Set[str]] = {}
+        self._call_cache: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+
+    @classmethod
+    def link(cls, fragments: Iterable[ModuleModel]) -> "ProjectModel":
+        pm = cls()
+        for mm in fragments:
+            pm.modules[mm.rel_path] = mm
+            for qual, fi in mm.functions.items():
+                pm.funcs[qual] = fi
+                pm.funcs_by_name.setdefault(fi.name, []).append(qual)
+                if fi.cls is not None:
+                    pm.methods_by_name.setdefault(fi.name, []).append(qual)
+            for ci in mm.classes.values():
+                pm.classes.setdefault(ci.name, []).append(ci)
+        pm._link_hierarchy()
+        return pm
+
+    def _link_hierarchy(self) -> None:
+        """Class family = ancestors + descendants, resolved by SHORT base
+        name across the project (the repo imports classes unqualified)."""
+        parents: Dict[str, Set[str]] = {}
+        children: Dict[str, Set[str]] = {}
+        for name, infos in self.classes.items():
+            for ci in infos:
+                for base in ci.bases:
+                    short = base.rsplit(".", 1)[-1]
+                    if short in self.classes:
+                        parents.setdefault(name, set()).add(short)
+                        children.setdefault(short, set()).add(name)
+
+        def closure(start: str, edges: Dict[str, Set[str]]) -> Set[str]:
+            out, todo = set(), [start]
+            while todo:
+                n = todo.pop()
+                for nxt in edges.get(n, ()):
+                    if nxt not in out:
+                        out.add(nxt)
+                        todo.append(nxt)
+            return out
+
+        for name in self.classes:
+            self._family[name] = ({name} | closure(name, parents)
+                                  | closure(name, children))
+
+    def class_family(self, name: str) -> Set[str]:
+        return self._family.get(name, {name})
+
+    def owns_lock(self, cls_name: str) -> bool:
+        return any(ci.owns_lock for ci in self.classes.get(cls_name, ()))
+
+    # -- call resolution ------------------------------------------------------
+
+    def resolve_call(self, caller: FuncInfo, name: str) -> Tuple[str, ...]:
+        key = (caller.qual, name)
+        hit = self._call_cache.get(key)
+        if hit is not None:
+            return hit
+        out = self._resolve_call(caller, name)
+        self._call_cache[key] = out
+        return out
+
+    def _resolve_call(self, caller: FuncInfo, name: str
+                      ) -> Tuple[str, ...]:
+        head, _, rest = name.partition(".")
+        mm = self.modules.get(caller.module)
+        targets: List[str] = []
+        if not rest:
+            # bare name: nested def of this function, module function,
+            # imported function, or a class constructor
+            nested = f"{caller.qual}.<locals>.{name}"
+            if nested in self.funcs:
+                return (nested,)
+            mod_qual = f"{caller.module}::{name}"
+            if mod_qual in self.funcs:
+                return (mod_qual,)
+            if mm is not None and name in mm.imports:
+                short = mm.imports[name].rsplit(".", 1)[-1]
+                targets = [q for q in self.funcs_by_name.get(short, ())
+                           if self.funcs[q].cls is None]
+                if targets:
+                    return tuple(targets)
+                name = short  # imported class: fall through
+            if name in self.classes:
+                # constructor: __init__ of the class
+                for ci in self.classes[name]:
+                    q = ci.methods.get("__init__")
+                    if q:
+                        targets.append(q)
+                return tuple(targets)
+            return ()
+        meth = rest.rsplit(".", 1)[-1]
+        if head == "self" and caller.cls is not None and "." not in rest:
+            fam = self.class_family(caller.cls)
+            for c in fam:
+                for ci in self.classes.get(c, ()):
+                    q = ci.methods.get(meth)
+                    if q:
+                        targets.append(q)
+            if targets:
+                return tuple(dict.fromkeys(targets))
+            return ()
+        if head == "cls" or (mm is not None and head in mm.imports
+                             and mm.imports[head].rsplit(".", 1)[-1]
+                             in self.classes):
+            cname = head if head in self.classes else \
+                mm.imports[head].rsplit(".", 1)[-1]
+            for c in self.class_family(cname):
+                for ci in self.classes.get(c, ()):
+                    q = ci.methods.get(meth)
+                    if q:
+                        targets.append(q)
+            if targets:
+                return tuple(dict.fromkeys(targets))
+        if head in self.classes:
+            for c in self.class_family(head):
+                for ci in self.classes.get(c, ()):
+                    q = ci.methods.get(meth)
+                    if q:
+                        targets.append(q)
+            if targets:
+                return tuple(dict.fromkeys(targets))
+        # module alias: mod.f()
+        if mm is not None and head in mm.imports and "." not in rest:
+            targets = [q for q in self.funcs_by_name.get(meth, ())
+                       if self.funcs[q].cls is None]
+            if targets:
+                return tuple(targets)
+        # dynamic receiver: every known method of that name (deliberate
+        # over-approximation — reachability must not under-count)
+        return tuple(self.methods_by_name.get(meth, ()))
+
+    def resolve_target(self, caller: FuncInfo, name: str
+                       ) -> Tuple[str, ...]:
+        """Resolution for a callable passed by REFERENCE (thread target)."""
+        return self.resolve_call(caller, name)
+
+    # -- reachability ---------------------------------------------------------
+
+    def reachable(self, roots: Iterable[str]) -> Set[str]:
+        """Transitive closure of the call graph from `roots` (quals)."""
+        seen: Set[str] = set()
+        todo = [r for r in roots if r in self.funcs]
+        while todo:
+            q = todo.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            fi = self.funcs[q]
+            for cs in fi.calls:
+                for tgt in self.resolve_call(fi, cs.name):
+                    if tgt not in seen:
+                        todo.append(tgt)
+            for sp in fi.spawns:
+                for tgt in self.resolve_target(fi, sp.target):
+                    if tgt not in seen:
+                        todo.append(tgt)
+        return seen
+
+
+# ---------------------------------------------------------------------------
+# intraprocedural ordering: branch paths / may-follow / dominance
+# ---------------------------------------------------------------------------
+#
+# A "path" is a tuple of (id(branch-owner-node), arm index) pairs from the
+# function body down to the statement.  Two events can both execute in one
+# run unless they sit in sibling arms of the same If (arm indexes differ
+# for the same owner).  An except handler (arm >= 1 of a Try) MAY follow
+# its try body (arm 0) — that is the donation-hazard path.  Statements
+# whose enclosing If-arm terminates (return/raise/continue/break) do not
+# flow into statements after that If.
+
+
+def branch_paths(fn: ast.AST) -> Dict[int, Tuple]:
+    """id(node) -> branch path for every node in the function body."""
+    paths: Dict[int, Tuple] = {}
+
+    def mark(node: ast.AST, path: Tuple) -> None:
+        paths[id(node)] = path
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # nested defs are separate analysis units (mark is only ever
+            # called on children, so any def reached here is nested)
+            return
+        if isinstance(node, ast.If):
+            for child in node.test, :
+                mark(child, path)
+            for i, block in enumerate((node.body, node.orelse)):
+                for s in block:
+                    mark(s, path + ((id(node), i),))
+            return
+        if isinstance(node, ast.Try):
+            for s in node.body:
+                mark(s, path + ((id(node), 0),))
+            for hi, h in enumerate(node.handlers, start=1):
+                for s in h.body:
+                    mark(s, path + ((id(node), hi),))
+            for s in node.orelse:
+                mark(s, path + ((id(node), 0),))
+            for s in node.finalbody:
+                mark(s, path)
+            return
+        for child in ast.iter_child_nodes(node):
+            mark(child, path)
+
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        mark(stmt, ())
+    return paths
+
+
+def _ends_terminal(block) -> bool:
+    return bool(block) and isinstance(
+        block[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _arm_terminates(owner: ast.AST, arm: int) -> bool:
+    """No execution that entered this arm reaches the code AFTER the
+    owner statement.  For a Try's body (arm 0) that requires every
+    except handler to terminate too: an exception mid-body jumps to a
+    handler, and a handler that falls through continues after the Try —
+    the donation-then-`except: pass` shape must stay flagged."""
+    if isinstance(owner, ast.If):
+        block = owner.body if arm == 0 else owner.orelse
+        return _ends_terminal(block)
+    if isinstance(owner, ast.Try):
+        if arm == 0:
+            return _ends_terminal(owner.body) and all(
+                _ends_terminal(h.body) for h in owner.handlers)
+        if arm - 1 < len(owner.handlers):
+            return _ends_terminal(owner.handlers[arm - 1].body)
+    return False
+
+
+def may_follow(a_path: Tuple, a_line: int, b_path: Tuple, b_line: int,
+               nodes: Dict[int, ast.AST], in_loop_together: bool = False
+               ) -> bool:
+    """Can event B execute after event A in some run?  a/b paths come
+    from branch_paths; `nodes` maps id -> owner node for arm inspection."""
+    # common prefix
+    i = 0
+    while i < len(a_path) and i < len(b_path) and a_path[i] == b_path[i]:
+        i += 1
+    if i < len(a_path) and i < len(b_path) \
+            and a_path[i][0] == b_path[i][0]:
+        owner = nodes.get(a_path[i][0])
+        if isinstance(owner, ast.Try):
+            # try body -> except handler follows; handler -> handler no
+            return a_path[i][1] == 0 and b_path[i][1] >= 1
+        return False  # sibling If arms: mutually exclusive
+    if b_line > a_line:
+        # B after A textually: blocked only if some arm A sits in (below
+        # the divergence) terminates before reaching B
+        for owner_id, arm in a_path[i:]:
+            owner = nodes.get(owner_id)
+            if owner is not None and _arm_terminates(owner, arm):
+                # A's arm never falls through to code after its owner —
+                # unless B is still inside that same arm (handled above)
+                return False
+        return True
+    # B textually before A: only possible when both repeat in a loop
+    return in_loop_together
+
+
+def dominates(a_path: Tuple, a_line: int, b_path: Tuple, b_line: int
+              ) -> bool:
+    """A dominates B (approximation): A is textually earlier and B's
+    branch path extends A's (A sits at equal-or-shallower nesting on the
+    same arm chain)."""
+    if a_line > b_line:
+        return False
+    if len(a_path) > len(b_path):
+        return False
+    return all(a_path[i] == b_path[i] for i in range(len(a_path)))
+
+
+def node_index(fn: ast.AST) -> Dict[int, ast.AST]:
+    """id -> node for every node under fn (owner lookup for may_follow)."""
+    out: Dict[int, ast.AST] = {}
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            out[id(node)] = node
+    return out

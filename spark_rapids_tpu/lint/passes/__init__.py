@@ -8,12 +8,16 @@ true positive and one clean negative per rule.
 """
 from __future__ import annotations
 
+from .concurrency import ConcurrencyAuditPass
 from .conf_hygiene import ConfHygienePass
 from .contracts import ContractsPass
+from .donation_flow import DonationFlowPass
 from .exceptions import ExceptionHygienePass
+from .flow_coverage import FlowCoveragePass
 from .host_sync import HostSyncPass
 from .jit_purity import JitPurityPass
 from .lock_order import LockOrderPass
+from .pallas_contracts import PallasContractsPass
 from .retry_sites import RetrySitesPass
 
 ALL_PASSES = [
@@ -24,6 +28,10 @@ ALL_PASSES = [
     RetrySitesPass,      # TPU005
     ExceptionHygienePass,  # TPU006
     LockOrderPass,       # TPU007
+    DonationFlowPass,    # TPU008 (cross-module dataflow, ISSUE 12)
+    ConcurrencyAuditPass,  # TPU009
+    PallasContractsPass,  # TPU010
+    FlowCoveragePass,    # TPU011
 ]
 
 

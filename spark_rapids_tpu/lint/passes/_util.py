@@ -5,17 +5,11 @@ import ast
 from typing import Iterator, Optional, Tuple
 
 
-def dotted_name(node: ast.AST) -> Optional[str]:
-    """'jax.jit' for Attribute chains over Names; None when the base is a
-    call/subscript/... (dynamic receivers can't be named statically)."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+# ONE definition of "the dotted name of this expression" shared with the
+# project-model extraction layer (lint/model.py owns it; model imports
+# nothing from passes/, so this direction is cycle-free) — the per-file
+# and cross-module layers must never name calls differently
+from ..model import dotted_name  # noqa: F401,E402
 
 
 def call_name(call: ast.Call) -> Optional[str]:

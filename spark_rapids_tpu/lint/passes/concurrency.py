@@ -1,0 +1,143 @@
+"""TPU009 — serving-tier shared-state audit.
+
+PR 10 made the engine genuinely multi-threaded end to end: every
+function reachable from a `QueryScheduler` worker thread (or from
+`TpuSession.submit`) now runs with N peers concurrently.  Two rot
+classes are invisible to per-file passes and to tests that happen not to
+interleave:
+
+  * **unlocked shared-state writes** — a module-global counter bumped
+    without its lock (`_COUNTERS["x"] += n` is a read-modify-write; the
+    GIL does not make it atomic across the read and the store), or an
+    instance-attribute write in a lock-disciplined class (one that owns
+    a threading.Lock/RLock/Condition) that forgot the `with self._lock:`
+    some sibling method is careful about;
+  * **thread-local reads without a re-install** — the per-query trace
+    context, active journal stack, and ledger query scope are
+    thread-routed (metrics/journal.py); a `Thread(target=...)` or
+    executor-submitted worker that transitively calls `journal_event` /
+    `active_journal` / `current_trace` without re-installing
+    (`trace_context(...)`, `push_active`, `query_scope`, or constructing
+    a `QueryExecution`) journals into whichever query pushed last —
+    event misrouting that only shows under concurrency.
+
+The pass is finalize-only: it walks the linked ProjectModel
+(lint/model.py).  The write audit covers the union of every thread-spawn
+target's reachable set plus everything reachable from methods named
+`submit`; `__init__` writes are exempt (single-threaded construction),
+as are writes lexically under a lock acquisition.  The thread-local
+audit reports one finding per spawn site whose reachable set reads
+thread-local state with no installer anywhere in that set — helper
+threads that journal on a query's behalf BY DESIGN (the process trace
+shard serves every thread) suppress the finding at the spawn line with
+that reason.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Set, Tuple
+
+from ..core import Finding, LintPass, Project
+
+#: method names exempt from the write audit: construction/teardown
+#: (single-threaded by protocol) and one-shot wiring (`configure` runs
+#: before the serving threads exist — documented in docs/lint.md#TPU009)
+_EXEMPT_FUNCS = {"__init__", "__new__", "reset", "reset_for_tests",
+                 "clear", "close", "shutdown", "__del__", "__enter__",
+                 "__exit__", "main", "<module>", "configure"}
+
+
+def _is_package(rel_path: str) -> bool:
+    return rel_path.replace("\\", "/").startswith("spark_rapids_tpu")
+
+
+class ConcurrencyAuditPass(LintPass):
+    rule_id = "TPU009"
+    name = "serving-concurrency-audit"
+    needs_model = True
+    doc = ("shared-state writes reachable from scheduler worker threads "
+           "must hold a lock; thread targets reading thread-local "
+           "trace/journal state must re-install it")
+    scopes = ("package",)
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        pm = project.model
+        if pm is None:
+            return
+        # ---- audited set: spawn targets + submit entry points -------------
+        spawn_sites: List[Tuple[str, object, object]] = []  # (caller, fi, sp)
+        roots: Set[str] = set()
+        for qual, fi in pm.funcs.items():
+            if not _is_package(fi.module):
+                continue  # tests spawn threads to TEST interleavings
+            for sp in fi.spawns:
+                spawn_sites.append((qual, fi, sp))
+                for tgt in pm.resolve_target(fi, sp.target):
+                    roots.add(tgt)
+            if fi.name == "submit":
+                roots.add(qual)
+        if not roots:
+            return
+        audited = pm.reachable(roots)
+
+        # ---- A: unlocked shared-state writes ------------------------------
+        seen: Set[Tuple[str, int]] = set()
+        for qual in sorted(audited):
+            fi = pm.funcs[qual]
+            if fi.name in _EXEMPT_FUNCS or not _is_package(fi.module):
+                continue
+            if fi.name.endswith("_locked"):
+                continue  # convention: the caller holds the lock
+            for w in fi.writes:
+                if w.under_lock or w.in_init:
+                    continue
+                key = (fi.module, w.line)
+                if key in seen:
+                    continue
+                if w.kind == "global":
+                    seen.add(key)
+                    yield Finding(
+                        self.rule_id, fi.module, w.line,
+                        f"module-global {w.target!r} written without a "
+                        f"lock in {fi.name}(), which is reachable from "
+                        "scheduler worker threads — read-modify-write "
+                        "races lose updates; guard it "
+                        "(docs/lint.md#TPU009)")
+                elif w.kind == "attr" and fi.cls is not None \
+                        and pm.owns_lock(fi.cls):
+                    seen.add(key)
+                    yield Finding(
+                        self.rule_id, fi.module, w.line,
+                        f"instance attribute self.{w.target} written "
+                        f"outside any lock in {fi.cls}.{fi.name}() — "
+                        f"{fi.cls} is lock-disciplined and this method "
+                        "is reachable from scheduler worker threads; "
+                        "take the lock or document why the write is "
+                        "single-owner (docs/lint.md#TPU009)")
+
+        # ---- B: thread-local reads without a re-install -------------------
+        for caller_qual, fi, sp in spawn_sites:
+            targets = pm.resolve_target(fi, sp.target)
+            if not targets:
+                continue
+            closure = pm.reachable(targets)
+            installer = any(pm.funcs[q].tl_installs for q in closure)
+            if installer:
+                continue
+            witness = None
+            for q in sorted(closure):
+                if pm.funcs[q].tl_reads:
+                    api, line = pm.funcs[q].tl_reads[0]
+                    witness = (q, api, line)
+                    break
+            if witness is None:
+                continue
+            wq, api, wline = witness
+            yield Finding(
+                self.rule_id, fi.module, sp.line,
+                f"thread boundary ({sp.api} of {sp.target!r}) whose "
+                f"reachable code reads thread-local query state "
+                f"({api}() via {wq.split('::')[-1]}, "
+                f"{pm.funcs[wq].module}:{wline}) without re-installing "
+                "a trace_context/journal scope on the new thread — "
+                "under concurrent serving the events land on whichever "
+                "query pushed last (docs/lint.md#TPU009)")

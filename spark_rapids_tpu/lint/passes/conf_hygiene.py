@@ -39,6 +39,7 @@ def _registry_keys() -> set:
 
 class ConfHygienePass(LintPass):
     rule_id = "TPU003"
+    cacheable = True  # check_file is content-pure; config.py is salted
     name = "conf-hygiene"
     doc = ("spark.rapids.* string keys must resolve in config.py's "
            "registry; registered confs must appear in docs/configs.md")

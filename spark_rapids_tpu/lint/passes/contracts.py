@@ -35,6 +35,7 @@ def _retry_names(block: str):
 
 class ContractsPass(LintPass):
     rule_id = "TPU004"
+    cacheable = True  # names.py/journal.py are salted into the cache key
     name = "metric-journal-contracts"
     doc = ("metric emission literals must be registered in "
            "metrics/names.py; journal kind literals must be members of "
@@ -50,8 +51,22 @@ class ContractsPass(LintPass):
         #: "scanner still sees the tree" floor tests/test_metrics.py
         #: asserts on
         self.emission_sites = 0
+        self._last_sites = 0
+
+    def file_fragment(self, ctx: FileContext):
+        # emission_sites is the cross-file floor tests/test_metrics.py
+        # asserts on — a cache replay must keep counting it
+        return self._last_sites
+
+    def absorb_fragment(self, rel_path: str, fragment) -> None:
+        self.emission_sites += int(fragment or 0)
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        before = self.emission_sites
+        yield from self._check_file(ctx)
+        self._last_sites = self.emission_sites - before
+
+    def _check_file(self, ctx: FileContext) -> Iterable[Finding]:
         for call in U.walk_calls(ctx.tree):
             name = U.call_name(call) or ""
             tail = name.rsplit(".", 1)[-1]

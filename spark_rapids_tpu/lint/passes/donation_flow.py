@@ -1,0 +1,567 @@
+"""TPU008 — use-after-donate (buffer-donation aliasing safety).
+
+`jax.jit(donate_argnums=...)` DELETES the donated input buffers after the
+dispatch: the compiled program aliases them into its outputs.  The engine
+donates at exactly the sites where the fusion pass proved the dispatching
+operator is the batch's last consumer (plan/fusion.source_donatable), and
+mem/donation.py pins any batch that gained a second owner at runtime.
+The proof is dynamic per dispatch — which means a LATER read of the same
+Python variable is invisible to the type system and to every per-file
+TPU pass: the classic rot path is an error-path or retry branch added
+months later that re-reads a batch the happy path already donated.
+
+This pass runs an intraprocedural dataflow over each function (branch
+paths from lint/model.py — sibling If arms are exclusive, an except
+handler MAY follow its try body, a loop body follows itself) and flags:
+
+  * a read (load, return, journal/metric argument, re-dispatch) of a
+    value after it flowed into a donating dispatch, unless
+      - a `pin(x)` / `SpillableCheckpoint(..., x)` / `add_batch(x)` call
+        dominates the donation site (the registry would have refused the
+        donation), or
+      - the read is dominated by a `donation.consumed(x)`-guard whose
+        taken arm terminates (the post-ISSUE-12 idiom for de-fuse
+        ladders: bail out instead of reading freed buffers);
+  * a donating-callable CONSTRUCTION with no last-consumer proof in
+    scope: no `donatable(...)` / `source_donatable(...)` /
+    `.donate_inputs` guard anywhere in the enclosing function chain —
+    a new dispatch site skipping the mem/donation.py contract.
+
+Donating callables are recognized structurally: `cached_kernel` /
+`stage_executable` / `jax.jit` with a (possibly conditional) non-empty
+`donate_argnums` (keyword or **{"donate_argnums": ...} dict), and
+`<op>.parameterized_kernel(donate=True)`.  Values flow through tuple
+bindings (`args = (b,)` ... `fn(*args)`), closure captures, default-arg
+bindings (`def attempt(b, _fnd=fn_don)`), and the repo's retry
+combinators (`run_retryable(ctx, m, "blk", fn, [b])` / `with_retry(fn,
+[b])` donate the inputs when `fn` donates its first parameter).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import FileContext, Finding, LintPass
+from .. import model as M
+from . import _util as U
+
+#: factory tails that accept donate_argnums
+_DONATING_FACTORIES = {"cached_kernel", "stage_executable", "jit", "pjit"}
+#: last-consumer proof tokens: seeing one in the function chain means the
+#: site participates in the mem/donation.py protocol
+_PROOF_CALLS = {"donatable", "source_donatable"}
+_PROOF_ATTRS = {"donate_inputs"}
+#: pinning calls: dominating one makes later reads safe (the registry
+#: refuses to donate a pinned batch)
+_PIN_CALLS = {"pin", "SpillableCheckpoint", "add_batch"}
+_GUARD_CALLS = {"consumed"}
+
+
+def _donate_kwarg(call: ast.Call) -> Optional[ast.expr]:
+    """The donate_argnums value of a factory call, through the keyword
+    or the **{"donate_argnums": ...} spread; None when absent."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return kw.value
+        if kw.arg is None:
+            # **expr — search dict literals (incl. inside a ternary)
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Dict):
+                    for k, v in zip(sub.keys, sub.values):
+                        if isinstance(k, ast.Constant) \
+                                and k.value == "donate_argnums":
+                            return v
+    return None
+
+
+def _possibly_nonempty(expr: ast.expr) -> bool:
+    """False only for a PROVABLY empty donate_argnums (the `()` arm of a
+    guard is fine; `(0,) if don else ()` is possibly-donating)."""
+    if isinstance(expr, ast.Tuple) and not expr.elts:
+        return False
+    if isinstance(expr, ast.Constant) and expr.value in ((), None):
+        return False
+    return True
+
+
+def _is_param_plumbing(expr: ast.expr, params: Set[str]) -> bool:
+    """donate_argnums forwarded from the function's own parameter — the
+    kernel_cache plumbing shape; the proof obligation sits at the caller."""
+    names = {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+    return bool(names) and names <= params
+
+
+def _donating_factory_call(call: ast.Call) -> Optional[ast.expr]:
+    """Return the (possibly conditional) donate_argnums expr when `call`
+    constructs a donating callable; None otherwise."""
+    name = U.call_name(call) or ""
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _DONATING_FACTORIES:
+        v = _donate_kwarg(call)
+        if v is not None and _possibly_nonempty(v):
+            return v
+    if tail == "parameterized_kernel":
+        kw = U.kwarg(call, "donate")
+        if kw is not None and not (isinstance(kw, ast.Constant)
+                                   and kw.value in (False, None)):
+            return kw
+    return None
+
+
+def _names_in(expr: ast.expr) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+class _FnAnalysis:
+    """Per-function donation facts, computed lexically outer-to-inner so
+    closures/default args inherit the enclosing function's bindings."""
+
+    def __init__(self, fn: ast.AST, parent: Optional["_FnAnalysis"]):
+        self.fn = fn
+        self.parent = parent
+        self.params = U.func_params(fn) if not isinstance(fn, ast.Module) \
+            else set()
+        #: local names bound to a donating callable
+        self.donating_vars: Set[str] = set()
+        #: tuple-content tracking: name -> names its literal value holds
+        self.tuples: Dict[str, Set[str]] = {}
+        #: parameters of THIS function that get donated in its body
+        self.donating_params: Set[str] = set()
+        #: factory construction sites missing a proof token:
+        #: (line, span_end, factory name)
+        self.unproven_sites: List[Tuple[int, int, str]] = []
+        self.has_proof = False
+
+    def donating(self, name: str) -> bool:
+        if name in self.donating_vars:
+            return True
+        # closure capture: an enclosing function's donating binding is
+        # donating here too (unless shadowed by a local param)
+        if name not in self.params and self.parent is not None:
+            return self.parent.donating(name)
+        return False
+
+    def tuple_contents(self, name: str) -> Set[str]:
+        if name in self.tuples:
+            return self.tuples[name]
+        if name not in self.params and self.parent is not None:
+            return self.parent.tuple_contents(name)
+        return set()
+
+    def chain_has_proof(self) -> bool:
+        a: Optional[_FnAnalysis] = self
+        while a is not None:
+            if a.has_proof:
+                return True
+            a = a.parent
+        return False
+
+
+class DonationFlowPass(LintPass):
+    rule_id = "TPU008"
+    cacheable = True
+    name = "use-after-donate"
+    doc = ("values donated to a compiled program (donate_argnums / "
+           "parameterized_kernel(donate=True)) must not be read on any "
+           "path after the dispatch; donation sites need the "
+           "mem/donation.py last-consumer proof")
+    scopes = ("package",)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        rel = ctx.rel_path.replace("\\", "/")
+        if rel.endswith("mem/donation.py"):
+            return  # the registry itself defines the protocol
+        findings: List[Finding] = []
+        # lexical function tree, outer-to-inner
+        self._visit_scope(ctx, ctx.tree, None, findings)
+        yield from findings
+
+    # -- per-function analysis ------------------------------------------------
+
+    def _visit_scope(self, ctx: FileContext, owner: ast.AST,
+                     parent: Optional[_FnAnalysis],
+                     findings: List[Finding]) -> None:
+        body = owner.body if isinstance(owner.body, list) else [owner.body]
+        for fn in self._direct_defs(body):
+            ana = self._analyze_fn(ctx, fn, parent, findings)
+            self._visit_scope(ctx, fn, ana, findings)
+
+    @staticmethod
+    def _direct_defs(body: Sequence[ast.stmt]) -> List[ast.AST]:
+        """Function defs DIRECTLY under these statements (descending
+        through classes/ifs/loops but never into another def's body)."""
+        out: List[ast.AST] = []
+
+        def scan(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    out.append(child)
+                elif not isinstance(child, ast.Lambda):
+                    scan(child)
+
+        for s in body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(s)
+            else:
+                scan(s)
+        return out
+
+    def _analyze_fn(self, ctx: FileContext, fn: ast.AST,
+                    parent: Optional[_FnAnalysis],
+                    findings: List[Finding]) -> _FnAnalysis:
+        ana = _FnAnalysis(fn, parent)
+        # default-arg bindings inherit donating-ness from the enclosing
+        # scope: `def attempt(b, _fnd=fn_don)` — the repo's closure idiom
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and parent is not None:
+            a = fn.args
+            pos = list(getattr(a, "posonlyargs", [])) + list(a.args)
+            for param, default in zip(pos[len(pos) - len(a.defaults):],
+                                      a.defaults):
+                for name in _names_in(default):
+                    if parent.donating(name):
+                        ana.donating_vars.add(param.arg)
+            for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                if default is not None:
+                    for name in _names_in(default):
+                        if parent.donating(name):
+                            ana.donating_vars.add(param.arg)
+
+        paths = M.branch_paths(fn)
+        nodes = M.node_index(fn)
+        loops = self._loop_membership(fn)
+
+        #: (var, line, end_line, path, how)
+        donations: List[Tuple[str, int, int, Tuple, str]] = []
+        pins: List[Tuple[str, int, Tuple]] = []
+        guards: List[Tuple[str, int, Tuple]] = []
+        reads: List[Tuple[str, int, Tuple, ast.AST]] = []
+        #: Name nodes that are arguments of consumed()/pin()-style calls:
+        #: they identity-check the object without touching its buffers
+        safe_reads: Set[int] = set()
+        dispatch_lines: Set[int] = set()
+
+        own = self._own_statements(fn)
+        for node in own:
+            path = paths.get(id(node), ())
+            if isinstance(node, ast.Call):
+                self._scan_call(ctx, ana, node, path, donations, pins,
+                                guards, dispatch_lines, findings)
+                tail = (U.call_name(node) or "").rsplit(".", 1)[-1]
+                if tail in _GUARD_CALLS | _PIN_CALLS | {"is_pinned",
+                                                        "donatable"}:
+                    for arg in node.args:
+                        for sub in ast.walk(arg):
+                            if isinstance(sub, ast.Name):
+                                safe_reads.add(id(sub))
+            elif isinstance(node, ast.Assign):
+                self._scan_assign(ana, node)
+            elif isinstance(node, ast.If):
+                self._scan_guard(node, path, guards)
+        # proof tokens anywhere in this function's own statements
+        for node in own:
+            if isinstance(node, ast.Call):
+                tail = (U.call_name(node) or "").rsplit(".", 1)[-1]
+                if tail in _PROOF_CALLS:
+                    ana.has_proof = True
+            elif isinstance(node, ast.Attribute) \
+                    and node.attr in _PROOF_ATTRS:
+                ana.has_proof = True
+        # unproven construction sites fire only when no proof exists in
+        # the lexical chain
+        for line, span, factory in ana.unproven_sites:
+            if not ana.chain_has_proof():
+                findings.append(Finding(
+                    self.rule_id, ctx.rel_path, line,
+                    f"donating dispatch via {factory} without a "
+                    "last-consumer proof: no donatable()/"
+                    "source_donatable()/donate_inputs guard in scope — "
+                    "a donated buffer is DELETED after the call; route "
+                    "the decision through mem/donation.py "
+                    "(docs/lint.md#TPU008)",
+                    span_end=span))
+        if not donations:
+            return ana
+        # reads: every Name load of a donated var
+        for node in own:
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and id(node) not in safe_reads:
+                path = paths.get(id(node), ())
+                reads.append((node.id, node.lineno, path, node))
+        for var, dline, dend, dpath, how in donations:
+            # pin dominating the donation site disarms it
+            if any(pv == var and M.dominates(pp, pl, dpath, dline)
+                   for pv, pl, pp in pins):
+                continue
+            for rvar, rline, rpath, rnode in reads:
+                if rvar != var:
+                    continue
+                if dline <= rline <= dend:
+                    continue  # the donating statement itself
+                same_loop = bool(loops.get(id(rnode))
+                                 and loops.get(id(rnode))
+                                 == self._loop_of_line(loops, dline, own))
+                if not M.may_follow(dpath, dline, rpath, rline, nodes,
+                                    in_loop_together=same_loop
+                                    and not self._rebound_by_loop(
+                                        fn, var, loops.get(id(rnode)))):
+                    continue
+                if any(gv == var and M.may_follow(dpath, dline, gp, gl,
+                                                  nodes)
+                       and M.dominates(gp, gl, rpath, rline)
+                       for gv, gl, gp in guards):
+                    continue  # consumed()-guard bails out first
+                findings.append(Finding(
+                    self.rule_id, ctx.rel_path, rline,
+                    f"use-after-donate: {var!r} may have been donated "
+                    f"at line {dline} ({how}) and its buffers deleted; "
+                    "this read can observe freed device memory — pin "
+                    "the batch before donating, or guard this path "
+                    "with donation.consumed() (docs/lint.md#TPU008)",
+                    span_end=rline))
+                break  # one finding per (donation, var)
+        return ana
+
+    # -- scanning helpers -----------------------------------------------------
+
+    def _own_statements(self, fn: ast.AST) -> List[ast.AST]:
+        """Every node of fn EXCLUDING nested function bodies (they are
+        separate analysis units) but INCLUDING nested default-arg exprs."""
+        out: List[ast.AST] = []
+
+        def walk(node: ast.AST) -> None:
+            out.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            walk(stmt)
+        return out
+
+    def _loop_membership(self, fn: ast.AST) -> Dict[int, Optional[int]]:
+        """id(node) -> id(innermost enclosing loop) or None."""
+        out: Dict[int, Optional[int]] = {}
+
+        def walk(node: ast.AST, loop: Optional[int]) -> None:
+            out[id(node)] = loop
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            nxt = id(node) if isinstance(node, (ast.For, ast.While)) \
+                else loop
+            for child in ast.iter_child_nodes(node):
+                walk(child, nxt)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            walk(stmt, None)
+        return out
+
+    @staticmethod
+    def _loop_of_line(loops: Dict[int, Optional[int]], line: int,
+                      own: List[ast.AST]) -> Optional[int]:
+        for node in own:
+            if getattr(node, "lineno", None) == line \
+                    and isinstance(node, ast.Call):
+                return loops.get(id(node))
+        return None
+
+    @staticmethod
+    def _rebound_by_loop(fn: ast.AST, var: str,
+                         loop_id: Optional[int]) -> bool:
+        """The loop header re-binds `var` each iteration (`for var in
+        ...`), so an earlier-line read in the next iteration sees a
+        FRESH value, not the donated one."""
+        if loop_id is None:
+            return False
+        for node in ast.walk(fn):
+            if id(node) == loop_id and isinstance(node, ast.For):
+                return var in {n.id for n in ast.walk(node.target)
+                               if isinstance(n, ast.Name)}
+        return False
+
+    def _scan_assign(self, ana: _FnAnalysis, node: ast.Assign) -> None:
+        if len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            return
+        tgt = node.targets[0].id
+        val = node.value
+        # tuple-content tracking (through a ternary of tuples)
+        contents: Set[str] = set()
+        for cand in ([val.body, val.orelse]
+                     if isinstance(val, ast.IfExp) else [val]):
+            if isinstance(cand, (ast.Tuple, ast.List)):
+                contents |= _names_in(cand)
+        if contents:
+            ana.tuples[tgt] = contents
+        # donating-callable binding (possibly via ternary)
+        for cand in ([val.body, val.orelse]
+                     if isinstance(val, ast.IfExp) else [val]):
+            if isinstance(cand, ast.Call) \
+                    and _donating_factory_call(cand) is not None:
+                ana.donating_vars.add(tgt)
+
+    def _scan_guard(self, node: ast.If, path: Tuple,
+                    guards: List[Tuple[str, int, Tuple]]) -> None:
+        """`if donation.consumed(x): raise/return/...` (possibly inside
+        an or/and test) — the bail-out guard; statements after it are
+        safe for x because the consumed path never falls through."""
+        if not (node.body and isinstance(
+                node.body[-1],
+                (ast.Raise, ast.Return, ast.Continue, ast.Break))):
+            return
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Call):
+                tail = (U.call_name(sub) or "").rsplit(".", 1)[-1]
+                if tail in _GUARD_CALLS and sub.args \
+                        and isinstance(sub.args[0], ast.Name):
+                    guards.append((sub.args[0].id, node.lineno, path))
+
+    def _scan_call(self, ctx: FileContext, ana: _FnAnalysis,
+                   node: ast.Call, path: Tuple,
+                   donations: List[Tuple[str, int, Tuple, str]],
+                   pins: List[Tuple[str, int, Tuple]],
+                   guards: List[Tuple[str, int, Tuple]],
+                   dispatch_lines: Set[int],
+                   findings: List[Finding]) -> None:
+        name = U.call_name(node) or ""
+        tail = name.rsplit(".", 1)[-1]
+        # pinning
+        if tail in _PIN_CALLS and node.args:
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    pins.append((arg.id, node.lineno, path))
+        # proof presence tracked by caller via _PROOF_CALLS scan
+        # factory construction: proof check + plumbing exemption
+        donate_expr = _donating_factory_call(node)
+        if donate_expr is not None:
+            if not _is_param_plumbing(donate_expr, ana.params):
+                ana.unproven_sites.append(
+                    (node.lineno, U.span_end(node), tail))
+        # donating dispatch: call of a donating-callable name
+        if isinstance(node.func, ast.Name) \
+                and ana.donating(node.func.id):
+            dispatch_lines.add(node.lineno)
+            for arg in node.args:
+                if isinstance(arg, ast.Starred) \
+                        and isinstance(arg.value, ast.Name):
+                    for v in ana.tuple_contents(arg.value.id):
+                        donations.append(
+                            (v, node.lineno, U.span_end(node), path,
+                             f"dispatch of donating callable "
+                             f"{node.func.id!r}"))
+                    donations.append(
+                        (arg.value.id, node.lineno, U.span_end(node),
+                         path,
+                         f"dispatch of donating callable "
+                         f"{node.func.id!r}"))
+                elif isinstance(arg, ast.Name):
+                    donations.append(
+                        (arg.id, node.lineno, U.span_end(node), path,
+                         f"dispatch of donating callable "
+                         f"{node.func.id!r}"))
+        # retry combinators: run_retryable(ctx, m, "blk", fn, inputs) /
+        # with_retry(fn, inputs): inputs donate when fn donates param 0
+        fn_arg = inputs_arg = None
+        if tail == "run_retryable" and len(node.args) >= 5:
+            fn_arg, inputs_arg = node.args[3], node.args[4]
+        elif tail == "with_retry" and len(node.args) >= 2:
+            fn_arg, inputs_arg = node.args[0], node.args[1]
+        if fn_arg is not None and isinstance(fn_arg, ast.Name):
+            callee = self._local_def(ana.fn, fn_arg.id)
+            if callee is not None and self._donates_first_param(
+                    callee, ana):
+                for v in _names_in(inputs_arg):
+                    donations.append(
+                        (v, node.lineno, U.span_end(node), path,
+                         f"retry combinator over donating "
+                         f"{fn_arg.id!r}"))
+        # direct call of a local def with donating params
+        if isinstance(node.func, ast.Name):
+            callee = self._local_def(ana.fn, node.func.id)
+            if callee is not None:
+                donating_params = self._donating_param_set(callee, ana)
+                params = [p.arg for p in
+                          (getattr(callee.args, "posonlyargs", [])
+                           + callee.args.args)]
+                for i, arg in enumerate(node.args):
+                    if i < len(params) and params[i] in donating_params \
+                            and isinstance(arg, ast.Name):
+                        donations.append(
+                            (arg.id, node.lineno, U.span_end(node),
+                             path,
+                             f"call of {node.func.id!r} which donates "
+                             f"parameter {params[i]!r}"))
+
+    # -- nested-def donation summaries ---------------------------------------
+
+    @staticmethod
+    def _local_def(fn: ast.AST, name: str) -> Optional[ast.FunctionDef]:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node.name == name:
+                    return node
+        return None
+
+    def _donating_param_set(self, callee: ast.FunctionDef,
+                            enclosing: _FnAnalysis) -> Set[str]:
+        """Parameters of `callee` that reach a donating dispatch in its
+        body (closure bindings resolved against `enclosing`)."""
+        key = id(callee)
+        cache = getattr(self, "_param_cache", None)
+        if cache is None:
+            cache = self._param_cache = {}
+        if key in cache:
+            return cache[key]
+        cache[key] = set()  # cycle guard
+        sub = self._analyze_fn_quiet(callee, enclosing)
+        cache[key] = sub
+        return sub
+
+    def _analyze_fn_quiet(self, callee: ast.FunctionDef,
+                          enclosing: _FnAnalysis) -> Set[str]:
+        ana = _FnAnalysis(callee, enclosing)
+        a = callee.args
+        pos = list(getattr(a, "posonlyargs", [])) + list(a.args)
+        for param, default in zip(pos[len(pos) - len(a.defaults):],
+                                  a.defaults):
+            for name in _names_in(default):
+                if enclosing.donating(name):
+                    ana.donating_vars.add(param.arg)
+        out: Set[str] = set()
+        own = self._own_statements(callee)
+        for node in own:
+            if isinstance(node, ast.Assign):
+                self._scan_assign(ana, node)
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) \
+                    and ana.donating(node.func.id):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) \
+                            and arg.id in ana.params:
+                        out.add(arg.id)
+                    elif isinstance(arg, ast.Starred) \
+                            and isinstance(arg.value, ast.Name):
+                        for v in ana.tuple_contents(arg.value.id):
+                            if v in ana.params:
+                                out.add(v)
+        return out
+
+    def _donates_first_param(self, callee: ast.FunctionDef,
+                             enclosing: _FnAnalysis) -> bool:
+        params = [p.arg for p in (getattr(callee.args, "posonlyargs", [])
+                                  + callee.args.args)]
+        if not params:
+            return False
+        return params[0] in self._donating_param_set(callee, enclosing)

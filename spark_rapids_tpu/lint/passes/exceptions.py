@@ -37,6 +37,7 @@ def _is_noop(stmt: ast.stmt) -> bool:
 
 class ExceptionHygienePass(LintPass):
     rule_id = "TPU006"
+    cacheable = True
     name = "exception-hygiene"
     doc = ("except handlers must log + count (or re-raise), not "
            "silently pass")

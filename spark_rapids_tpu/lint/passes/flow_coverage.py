@@ -1,0 +1,244 @@
+"""TPU011 — metric/journal flow coverage.
+
+TPU004 answers "is every emitted name registered?"; this pass answers
+the converse questions that make a catalog trustworthy as a dashboard
+contract:
+
+  * **no dead metrics** — every counter/gauge/timer registered in
+    metrics/names.py must be incremented somewhere in the package: a
+    registered-but-never-emitted name is a dashboard panel that will
+    flatline forever (the usual cause: the emitting code was refactored
+    away and only the registration survived).  Registration is parsed
+    from the PROJECT TREE (direct `register_metric("x", ...)` literals
+    plus the `for _b in RETRY_BLOCKS:` f-string loop), so fixtures carry
+    their own catalog and the real run sees the real one;
+  * **no orphaned journal kinds** — every member of EVENT_KINDS
+    (metrics/journal.py) must have at least one emission site; consumers
+    special-case kinds, and a kind nothing emits is dead branch logic;
+  * **every emission site is reachable** — an increment in a function no
+    entry point can reach (not public, not an `execute`/`main`/module
+    body, not a thread target, and transitively uncalled) is dead code
+    wearing an observability costume; it makes coverage look better
+    than it is.
+
+Emission sites come from the project model's per-function summaries:
+literal names, `MN.CONSTANT` attribute references (resolved through the
+names.py constant map), names bound by literal loops (`for mk in (...)`),
+`count_swallowed`, and the `{block}Retries`/`{block}Splits` derivations
+at run_retryable/with_retry call sites.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import Finding, LintPass, Project
+
+NAMES_FILE = "spark_rapids_tpu/metrics/names.py"
+JOURNAL_FILE = "spark_rapids_tpu/metrics/journal.py"
+
+
+def _expand_fstring(js: ast.JoinedStr, env: Dict[str, List[str]]
+                    ) -> List[str]:
+    """All literal expansions of an f-string whose interpolations are
+    names bound in `env`; [] when any part is unresolvable."""
+    outs = [""]
+    for part in js.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            outs = [o + part.value for o in outs]
+        elif isinstance(part, ast.FormattedValue) \
+                and isinstance(part.value, ast.Name) \
+                and part.value.id in env:
+            outs = [o + v for o in outs for v in env[part.value.id]]
+        else:
+            return []
+    return outs
+
+
+def parse_catalog(tree: ast.Module) -> Dict[str, int]:
+    """name -> registration line, from register_metric literals and the
+    loop-over-literal-tuple f-string idiom."""
+    out: Dict[str, int] = {}
+    tuples: Dict[str, List[str]] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, (ast.Tuple, ast.List)):
+            vals = [el.value for el in stmt.value.elts
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)]
+            if vals:
+                tuples[stmt.targets[0].id] = vals
+
+    def scan(node: ast.AST, env: Dict[str, List[str]]) -> None:
+        if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            vals: List[str] = []
+            if isinstance(node.iter, (ast.Tuple, ast.List)):
+                vals = [el.value for el in node.iter.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)]
+            elif isinstance(node.iter, ast.Name):
+                vals = tuples.get(node.iter.id, [])
+            sub_env = dict(env)
+            if vals:
+                sub_env[node.target.id] = vals
+            for child in node.body:
+                scan(child, sub_env)
+            return
+        if isinstance(node, ast.Call):
+            name = node.func
+            tail = name.attr if isinstance(name, ast.Attribute) else \
+                name.id if isinstance(name, ast.Name) else ""
+            if tail == "register_metric" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    out.setdefault(arg.value, node.lineno)
+                elif isinstance(arg, ast.JoinedStr):
+                    for lit in _expand_fstring(arg, env):
+                        out.setdefault(lit, node.lineno)
+        for child in ast.iter_child_nodes(node):
+            scan(child, env)
+
+    for stmt in tree.body:
+        scan(stmt, {})
+    return out
+
+
+def parse_constants(tree: ast.Module) -> Dict[str, str]:
+    """CONSTANT -> metric literal for `X = register_metric("lit", ...)`."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Call):
+            fn = stmt.value.func
+            tail = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else ""
+            if tail == "register_metric" and stmt.value.args \
+                    and isinstance(stmt.value.args[0], ast.Constant):
+                out[stmt.targets[0].id] = stmt.value.args[0].value
+    return out
+
+
+def parse_event_kinds(tree: ast.Module) -> Tuple[Dict[str, int], int]:
+    """kind -> declaration line (all on the tuple), plus the tuple line."""
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "EVENT_KINDS"
+                for t in stmt.targets) \
+                and isinstance(stmt.value, (ast.Tuple, ast.List)):
+            kinds = {el.value: el.lineno for el in stmt.value.elts
+                     if isinstance(el, ast.Constant)
+                     and isinstance(el.value, str)}
+            return kinds, stmt.lineno
+    return {}, 0
+
+
+class FlowCoveragePass(LintPass):
+    rule_id = "TPU011"
+    name = "metric-journal-flow-coverage"
+    needs_model = True
+    doc = ("every registered metric must have a reachable increment "
+           "site; every EVENT_KINDS member must be emitted; emission "
+           "sites must be reachable from an entry point")
+    scopes = ("package", "aux")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        pm = project.model
+        if pm is None:
+            return
+        names_ctx = project.file(NAMES_FILE)
+        journal_ctx = project.file(JOURNAL_FILE)
+
+        # ---- gather emissions from package-scope model fragments ----------
+        pkg_funcs = [fi for q, fi in pm.funcs.items()
+                     if fi.module.replace("\\", "/").startswith(
+                         "spark_rapids_tpu")]
+        if not pkg_funcs:
+            pkg_funcs = list(pm.funcs.values())
+        consts = parse_constants(names_ctx.tree) if names_ctx else {}
+        emitted: Set[str] = set()
+        emitted_kinds: Set[str] = set()
+        for fi in pkg_funcs:
+            for em in fi.emissions:
+                emitted.update(em.metrics)
+                if em.attr is not None and em.attr in consts:
+                    emitted.add(consts[em.attr])
+            for blk, _line in fi.retry_blocks:
+                emitted.add(f"{blk}Retries")
+                emitted.add(f"{blk}Splits")
+            for kind, _line in fi.journal_kinds:
+                emitted_kinds.add(kind)
+
+        # ---- dead metrics --------------------------------------------------
+        # a name is credited by a resolved emission site, by its literal
+        # appearing anywhere else in the package (report-dict keys, the
+        # timeline analyzer's output fields), or by its registration
+        # CONSTANT being referenced (MN.HEARTBEAT_LAG used as a rollup
+        # key counts as an emission surface).  Dead = registered and
+        # mentioned NOWHERE else — deleting the last emitting line makes
+        # this fire.
+        if names_ctx is not None:
+            catalog = parse_catalog(names_ctx.tree)
+            const_of = {v: k for k, v in consts.items()}
+            pkg_texts = [(c.rel_path, c.text) for c in project.files
+                         if c.rel_path.replace("\\", "/").startswith(
+                             "spark_rapids_tpu")]
+            for name, line in sorted(catalog.items()):
+                if name in emitted:
+                    continue
+                const = const_of.get(name)
+                mentioned = False
+                for rel, text in pkg_texts:
+                    if rel == NAMES_FILE:
+                        continue
+                    if f'"{name}"' in text or f"'{name}'" in text \
+                            or (const is not None and const in text):
+                        mentioned = True
+                        break
+                if mentioned:
+                    continue
+                yield Finding(
+                    self.rule_id, NAMES_FILE, line,
+                    f"metric {name!r} is registered but no reachable "
+                    "code path increments it — a dashboard panel "
+                    "that will flatline forever; emit it or remove "
+                    "the registration (docs/lint.md#TPU011)")
+
+        # ---- orphaned journal kinds ---------------------------------------
+        if journal_ctx is not None:
+            kinds, decl_line = parse_event_kinds(journal_ctx.tree)
+            for kind, line in sorted(kinds.items()):
+                if kind not in emitted_kinds:
+                    yield Finding(
+                        self.rule_id, JOURNAL_FILE, line or decl_line,
+                        f"journal kind {kind!r} is declared in "
+                        "EVENT_KINDS but nothing emits it — consumers "
+                        "special-case kinds, so this is dead branch "
+                        "logic; emit it or drop the member")
+
+        # ---- emission-site reachability -----------------------------------
+        roots = [q for q, fi in pm.funcs.items()
+                 if fi.public
+                 or fi.name in ("execute", "execute_cpu", "main",
+                                "<module>")]
+        for q, fi in pm.funcs.items():
+            for sp in fi.spawns:
+                roots.extend(pm.resolve_target(fi, sp.target))
+        live = pm.reachable(roots)
+        for fi in sorted(pkg_funcs, key=lambda f: (f.module, f.line)):
+            if fi.qual in live:
+                continue
+            if not (fi.emissions or fi.journal_kinds or fi.retry_blocks):
+                continue
+            site_line = (fi.emissions[0].line if fi.emissions
+                         else fi.journal_kinds[0][1] if fi.journal_kinds
+                         else fi.retry_blocks[0][1])
+            yield Finding(
+                self.rule_id, fi.module, site_line,
+                f"emission site in {fi.qual.split('::')[-1]}() is "
+                "unreachable from every entry point (public API, "
+                "execute/main, module body, thread targets) — dead "
+                "code wearing an observability costume; wire it in or "
+                "delete it (docs/lint.md#TPU011)")

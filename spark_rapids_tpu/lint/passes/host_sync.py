@@ -54,6 +54,7 @@ def _mentions_device_api(node: ast.AST) -> bool:
 
 class HostSyncPass(LintPass):
     rule_id = "TPU001"
+    cacheable = True
     name = "host-sync-hazard"
     doc = ("device->host synchronization outside allowlisted host-boundary "
            "layers (.item(), np.asarray, device_get, int/float/bool over "

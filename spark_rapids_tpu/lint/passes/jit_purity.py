@@ -81,6 +81,7 @@ def _returned_functions(builder: ast.FunctionDef) -> List[ast.AST]:
 
 class JitPurityPass(LintPass):
     rule_id = "TPU002"
+    cacheable = True
     name = "jit-purity"
     doc = ("impure calls or Python branching on traced values inside "
            "functions handed to jax.jit / cached_kernel / "

@@ -48,6 +48,7 @@ def _is_store_lock(label: str) -> bool:
 
 class LockOrderPass(LintPass):
     rule_id = "TPU007"
+    cacheable = True
     name = "lock-order"
     doc = ("the cross-module lock-acquisition graph must be acyclic; no "
            "journal writes under store/catalog/buffer locks")
@@ -60,6 +61,19 @@ class LockOrderPass(LintPass):
         #: non-reentrant self-edges found while a file was walked:
         #: (label, rel_path, line)
         self._self_edges: List[Tuple[str, str, int]] = []
+        self._last: dict = {}
+
+    def file_fragment(self, ctx: FileContext):
+        return self._last
+
+    def absorb_fragment(self, rel_path: str, fragment) -> None:
+        if not fragment:
+            return
+        for (a, b), where in fragment.get("edges", ()):
+            self.edges.setdefault((a, b), tuple(where))
+        self.reentrant.update(fragment.get("reentrant", ()))
+        self._self_edges.extend(
+            tuple(e) for e in fragment.get("self_edges", ()))
 
     # -- lock identity --------------------------------------------------------
 
@@ -88,6 +102,23 @@ class LockOrderPass(LintPass):
     # -- per-file -------------------------------------------------------------
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        # delta-tracking for the incremental cache: whatever this file
+        # adds to the cross-file graph becomes its cached fragment
+        edges_before = set(self.edges)
+        reentrant_before = set(self.reentrant)
+        selfedges_before = len(self._self_edges)
+        try:
+            return self._check_file(ctx)
+        finally:
+            self._last = {
+                "edges": [((a, b), self.edges[(a, b)])
+                          for (a, b) in self.edges
+                          if (a, b) not in edges_before],
+                "reentrant": sorted(self.reentrant - reentrant_before),
+                "self_edges": self._self_edges[selfedges_before:],
+            }
+
+    def _check_file(self, ctx: FileContext) -> List[Finding]:
         module = os.path.splitext(os.path.basename(ctx.rel_path))[0]
         findings: List[Finding] = []
 

@@ -1,0 +1,268 @@
+"""TPU010 — Pallas kernel contracts.
+
+Hand-written kernels (ops/pallas_kernels.py, ROADMAP item 2 promises
+more) carry constraints the Python type system cannot see and the CPU
+interpreter will not enforce:
+
+  * **no 64-bit arithmetic in kernel bodies** — current TPU generations
+    emulate int64/uint64 on the VPU; a stray `astype(jnp.int64)` inside
+    a kernel silently runs at a fraction of VPU rate (the engine's
+    is_count pattern runs counts in int32 and widens OUTSIDE the
+    kernel, which is the one blessed shape);
+  * **(8, 128)-congruent tile shapes** — `pl.BlockSpec` block dims must
+    be multiples of the (sublane, lane) = (8, 128) float32 layout or
+    Mosaic pads/retiles every access (pallas guide: the last dim is
+    always 128);
+  * **no host syncs or impure calls inside kernels** — `.item()`,
+    `device_get`, `np.asarray`, `print`, `time.*` in a kernel body
+    either fail to lower or bake trace-time values into the compiled
+    binary (subsumes TPU002's kernel special-casing with the TPU001
+    sync forms added);
+  * **every kernel wrapper has an interpret-mode test** — the TPU005
+    pattern applied to kernels: each public module-level function that
+    issues a `pl.pallas_call` must be referenced from
+    tests/test_pallas.py, so CPU CI exercises the kernel in interpret
+    mode before it ever meets Mosaic.
+
+Kernel bodies are resolved like TPU002 resolves jit sinks: the first
+pallas_call argument as a local/module def, or a maker call
+(`_make_seg_agg_kernel(ops)`) whose returned inner defs are the kernels.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Finding, LintPass, Project
+from . import _util as U
+
+_SYNC_TAILS = {"item", "device_get", "asarray", "block_until_ready"}
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "os.environ")
+_IMPURE_EXACT = {"open", "print", "input"}
+_SUBLANES, _LANES = 8, 128
+
+TEST_FILE = "tests/test_pallas.py"
+
+
+def _returned_defs(maker: ast.FunctionDef) -> List[ast.FunctionDef]:
+    local = {s.name: s for s in ast.walk(maker)
+             if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    out = []
+    for node in ast.walk(maker):
+        if isinstance(node, ast.Return) and node.value is not None \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in local:
+            out.append(local[node.value.id])
+    return out
+
+
+class PallasContractsPass(LintPass):
+    rule_id = "TPU010"
+    cacheable = True  # tests/test_pallas.py is salted into the cache key
+    name = "pallas-kernel-contracts"
+    needs_model = True  # kernel-wrapper registry lives in model fragments
+    doc = ("pallas kernel bodies: no int64 ops (outside the is_count "
+           "widening), (8,128)-congruent tiles, no host sync/impure "
+           "calls; every kernel wrapper needs an interpret-mode test in "
+           + TEST_FILE)
+    scopes = ("package",)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        module_defs = {s.name: s for s in ctx.tree.body
+                       if isinstance(s, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+        # module-level int constants resolve BlockSpec shape names
+        consts: Dict[str, int] = {}
+        for s in ctx.tree.body:
+            if isinstance(s, ast.Assign) and len(s.targets) == 1 \
+                    and isinstance(s.targets[0], ast.Name) \
+                    and isinstance(s.value, ast.Constant) \
+                    and isinstance(s.value.value, int):
+                consts[s.targets[0].id] = s.value.value
+            elif isinstance(s, ast.Assign) \
+                    and isinstance(s.value, ast.Tuple) \
+                    and isinstance(s.targets[0], ast.Tuple):
+                for t, v in zip(s.targets[0].elts, s.value.elts):
+                    if isinstance(t, ast.Name) \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, int):
+                        consts[t.id] = v.value
+
+        seen_kernels: Set[int] = set()
+        for call in U.walk_calls(ctx.tree):
+            name = U.call_name(call) or ""
+            if name.rsplit(".", 1)[-1] != "pallas_call":
+                continue
+            yield from self._check_specs(ctx, call, consts)
+            if not call.args:
+                continue
+            for kern in self._resolve_kernels(ctx, call.args[0],
+                                              module_defs):
+                if id(kern) in seen_kernels:
+                    continue
+                seen_kernels.add(id(kern))
+                yield from self._check_kernel(ctx, kern)
+
+    # -- kernel resolution (the TPU002 shapes) -------------------------------
+
+    def _resolve_kernels(self, ctx: FileContext, arg: ast.expr,
+                         module_defs) -> List[ast.AST]:
+        if isinstance(arg, ast.Lambda):
+            return [arg]
+        if isinstance(arg, ast.Name):
+            fn = module_defs.get(arg.id)
+            if fn is None:
+                fn = self._enclosing_local_def(ctx, arg)
+            return [fn] if fn is not None else []
+        if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name):
+            maker = module_defs.get(arg.func.id)
+            if maker is not None:
+                return list(_returned_defs(maker))
+        return []
+
+    @staticmethod
+    def _enclosing_local_def(ctx: FileContext,
+                             arg: ast.Name) -> Optional[ast.AST]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if sub is arg:
+                        for s in node.body:
+                            for d in ast.walk(s):
+                                if isinstance(d, (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef)) \
+                                        and d.name == arg.id:
+                                    return d
+        return None
+
+    # -- kernel-body checks --------------------------------------------------
+
+    def _check_kernel(self, ctx: FileContext,
+                      kern: ast.AST) -> Iterable[Finding]:
+        label = getattr(kern, "name", "<lambda>")
+        body = kern.body if isinstance(kern.body, list) else [kern.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                # 64-bit ops (emulated on-chip) outside is_count widening
+                if isinstance(node, (ast.Attribute, ast.Name)):
+                    dn = U.dotted_name(node) or ""
+                    tail = dn.rsplit(".", 1)[-1]
+                    if tail in ("int64", "uint64", "float64") \
+                            and not self._under_is_count(kern, node):
+                        yield Finding(
+                            self.rule_id, ctx.rel_path, node.lineno,
+                            f"64-bit dtype {tail} inside pallas kernel "
+                            f"{label!r}: current TPUs emulate 64-bit "
+                            "lanes — keep kernels at <=32 bits and "
+                            "widen outside (the is_count pattern), or "
+                            "suppress with the measured justification",
+                            span_end=U.span_end(node))
+                if isinstance(node, ast.Call):
+                    name = U.call_name(node) or ""
+                    tail = name.rsplit(".", 1)[-1]
+                    if (isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _SYNC_TAILS
+                            and tail != "asarray") \
+                            or name in ("np.asarray", "numpy.asarray",
+                                        "jax.device_get", "device_get"):
+                        sync = tail or (node.func.attr if isinstance(
+                            node.func, ast.Attribute) else "?")
+                        yield Finding(
+                            self.rule_id, ctx.rel_path, node.lineno,
+                            f"host-sync call {sync}() inside pallas "
+                            f"kernel {label!r}: kernels run on-chip "
+                            "with no host round trip — this fails to "
+                            "lower (or silently traces)",
+                            span_end=U.span_end(node))
+                    elif name in _IMPURE_EXACT or any(
+                            name == p.rstrip(".") or name.startswith(p)
+                            for p in _IMPURE_PREFIXES):
+                        yield Finding(
+                            self.rule_id, ctx.rel_path, node.lineno,
+                            f"impure call {name}() inside pallas kernel "
+                            f"{label!r}: executes at trace time only "
+                            "and bakes its value into the compiled "
+                            "kernel",
+                            span_end=U.span_end(node))
+
+    @staticmethod
+    def _under_is_count(kern: ast.AST, target: ast.AST) -> bool:
+        """The 64-bit mention sits under an `is_count`-conditioned branch
+        (the blessed count-widening shape) — exempt."""
+        for node in ast.walk(kern):
+            if isinstance(node, ast.If) and any(
+                    isinstance(n, ast.Name) and "is_count" in n.id
+                    for n in ast.walk(node.test)):
+                if any(sub is target for sub in ast.walk(node)):
+                    return True
+        return False
+
+    # -- BlockSpec congruence ------------------------------------------------
+
+    def _check_specs(self, ctx: FileContext, call: ast.Call,
+                     consts: Dict[str, int]) -> Iterable[Finding]:
+        spec_exprs: List[ast.expr] = []
+        for kw in call.keywords:
+            if kw.arg in ("in_specs", "out_specs"):
+                if isinstance(kw.value, (ast.List, ast.Tuple)):
+                    spec_exprs.extend(kw.value.elts)
+                else:
+                    spec_exprs.append(kw.value)
+        for expr in spec_exprs:
+            for node in ast.walk(expr):
+                if not (isinstance(node, ast.Call)
+                        and (U.call_name(node) or "").rsplit(
+                            ".", 1)[-1] == "BlockSpec"):
+                    continue
+                if not node.args or not isinstance(node.args[0],
+                                                   ast.Tuple):
+                    continue
+                dims = []
+                for el in node.args[0].elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, int):
+                        dims.append(el.value)
+                    elif isinstance(el, ast.Name) \
+                            and el.id in consts:
+                        dims.append(consts[el.id])
+                    else:
+                        dims = None
+                        break
+                if not dims or len(dims) < 2:
+                    continue
+                sub, lane = dims[-2], dims[-1]
+                if sub % _SUBLANES or lane % _LANES:
+                    yield Finding(
+                        self.rule_id, ctx.rel_path, node.lineno,
+                        f"BlockSpec tile {tuple(dims)} is not congruent "
+                        f"to the ({_SUBLANES}, {_LANES}) sublane/lane "
+                        "layout — Mosaic pads or retiles every access; "
+                        "use multiples of (8, 128)",
+                        span_end=U.span_end(node))
+
+    # -- kernel-test registry (cross-file) -----------------------------------
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        test_ctx = project.file(TEST_FILE)
+        pm = project.model
+        if test_ctx is None or pm is None:
+            return  # fixture runs that lint neither side of the contract
+        referenced: Set[str] = set()
+        for node in ast.walk(test_ctx.tree):
+            if isinstance(node, ast.Name):
+                referenced.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                referenced.add(node.attr)
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                referenced.add(node.value)
+        for rel, mm in sorted(pm.modules.items()):
+            for fname, line in mm.kernel_wrappers:
+                if fname not in referenced:
+                    yield Finding(
+                        self.rule_id, rel, line,
+                        f"pallas kernel wrapper {fname}() has no "
+                        f"interpret-mode test: reference it from "
+                        f"{TEST_FILE} so CPU CI exercises the kernel "
+                        "before it meets the Mosaic compiler")

@@ -30,6 +30,7 @@ SWEEP_FILE = "tests/test_retry.py"
 
 class RetrySitesPass(LintPass):
     rule_id = "TPU005"
+    cacheable = True  # test_retry.py (the sweep contract) is salted
     name = "retry-site-coverage"
     doc = ("reserve() site= labels must be unique per module and covered "
            f"by {SWEEP_DECL} in {SWEEP_FILE}")
@@ -38,8 +39,17 @@ class RetrySitesPass(LintPass):
     def __init__(self):
         # label -> [(rel_path, line)]
         self.sites: Dict[str, List[Tuple[str, int]]] = {}
+        self._last: List[Tuple[str, int]] = []
+
+    def file_fragment(self, ctx: FileContext):
+        return self._last
+
+    def absorb_fragment(self, rel_path: str, fragment) -> None:
+        for label, line in fragment or ():
+            self.sites.setdefault(label, []).append((rel_path, line))
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        self._last = []
         if ctx.scope != "package":
             return ()
         for call in U.walk_calls(ctx.tree):
@@ -51,6 +61,7 @@ class RetrySitesPass(LintPass):
             if lit is not None:
                 self.sites.setdefault(lit, []).append(
                     (ctx.rel_path, call.lineno))
+                self._last.append((lit, call.lineno))
         return ()
 
     def _sweep_list(self, project: Project):

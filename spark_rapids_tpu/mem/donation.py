@@ -44,6 +44,12 @@ warnings.filterwarnings(
     category=UserWarning)
 
 _PINNED: "weakref.WeakSet" = weakref.WeakSet()
+# batches whose leaves were handed to a donating dispatch: the arrays are
+# DELETED (aliased into the program's outputs), so any later read —
+# retry, split, checkpoint registration, de-fuse, CPU fallback — is a
+# use-after-free.  Error paths consult consumed() before touching a
+# batch that a failed donating dispatch may have eaten (tpulint TPU008).
+_DONATED: "weakref.WeakSet" = weakref.WeakSet()
 _LOCK = threading.Lock()
 
 # process-wide counters (bench.py reads donated_buffers around warm runs;
@@ -66,12 +72,21 @@ def is_pinned(batch) -> bool:
         return batch in _PINNED
 
 
+def consumed(batch) -> bool:
+    """True when a donating dispatch already ran over `batch`'s leaves —
+    its device buffers are gone.  Error-path contract (TPU008): check
+    this BEFORE re-reading a batch whose dispatch may have donated."""
+    with _LOCK:
+        return batch in _DONATED
+
+
 def donatable(batch) -> bool:
-    """True when `batch` may be donated: unpinned AND its leaves are
-    distinct live jax arrays (duplicate leaves — e.g. one Column object
-    projected into two slots — would donate one buffer twice)."""
+    """True when `batch` may be donated: unpinned, not already consumed
+    by a previous donating dispatch, AND its leaves are distinct live
+    jax arrays (duplicate leaves — e.g. one Column object projected into
+    two slots — would donate one buffer twice)."""
     import jax
-    if is_pinned(batch):
+    if is_pinned(batch) or consumed(batch):
         return False
     leaves = jax.tree_util.tree_leaves(batch)
     seen = set()
@@ -102,6 +117,11 @@ def record_donated_dispatch(batch_or_count, metrics=None) -> int:
     else:
         import jax
         n = len(jax.tree_util.tree_leaves(batch_or_count))
+        try:
+            with _LOCK:
+                _DONATED.add(batch_or_count)
+        except TypeError:  # tpulint: disable=TPU006 non-weakref-able stand-in (host tables in tests); those are never jax-donated so the consumed() registry has nothing to guard
+            pass
     record_donation(n)
     from ..utils.kernel_cache import record_donated
     record_donated(n)
@@ -113,10 +133,14 @@ def record_donated_dispatch(batch_or_count, metrics=None) -> int:
 
 def stats() -> dict:
     with _LOCK:
-        return dict(_COUNTERS, live_pins=len(_PINNED))
+        return dict(_COUNTERS, live_pins=len(_PINNED),
+                    live_consumed=len(_DONATED))
 
 
 def reset_for_tests() -> None:
     with _LOCK:
         for k in _COUNTERS:
             _COUNTERS[k] = 0
+        # consumed-ness is a property of dead batch objects; clearing it
+        # between tests is safe (pins stay: pinning is monotonic)
+        _DONATED.clear()

@@ -204,6 +204,19 @@ def with_retry(fn: Callable, inputs: Sequence, *, runtime=None,
                     # on a terminal signal; propagate to the CPU fallback
                     raise
                 except MemoryError as e:
+                    # a failed attempt that had already DONATED its input
+                    # leaves the batch's buffers deleted: retrying,
+                    # splitting, or checkpoint-registering it would read
+                    # freed device memory — terminal, not retryable
+                    # (mem/donation.py consumed(); tpulint TPU008)
+                    from .donation import consumed
+                    if isinstance(x, ColumnarBatch) and consumed(x):
+                        journal_event("retry", name,
+                                      action="donated_abort", depth=depth)
+                        raise RetryExhausted(
+                            f"{name}: attempt failed after donating its "
+                            f"input buffers; the batch cannot be "
+                            f"re-read: {e}", cause=e) from e
                     action = sm.next_action(e)
                     if action == RetryStateMachine.RETRY:
                         if handle is None and checkpoint \

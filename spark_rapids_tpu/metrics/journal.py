@@ -118,11 +118,11 @@ class EventJournal:
             if label is not None:
                 self.anchor["label"] = label
             with self._lock:
-                self._emit(self.anchor)
+                self._emit_locked(self.anchor)
 
     # -- writing -------------------------------------------------------------
 
-    def _emit(self, rec: dict) -> None:
+    def _emit_locked(self, rec: dict) -> None:
         line = json.dumps(rec, separators=(",", ":"), default=str)
         if self._file is not None:
             self._file.write(line + "\n")
@@ -149,7 +149,7 @@ class EventJournal:
                 rec.update(attrs)
             if ev == "B":
                 self._open_spans[rid] = rec
-            self._emit(rec)
+            self._emit_locked(rec)
             return rid
 
     def begin(self, kind: str, name: str, parent: Optional[int] = None,
@@ -169,7 +169,7 @@ class EventJournal:
                    "span": span_id}
             if attrs:
                 rec.update(attrs)
-            self._emit(rec)
+            self._emit_locked(rec)
 
     def instant(self, kind: str, name: str, parent: Optional[int] = None,
                 **attrs) -> int:
@@ -190,7 +190,7 @@ class EventJournal:
             for sid in sorted(self._open_spans):
                 opened = self._open_spans[sid]
                 self._next_id += 1
-                self._emit({"ts": time.monotonic_ns(), "ev": "E",
+                self._emit_locked({"ts": time.monotonic_ns(), "ev": "E",
                             "kind": opened["kind"], "name": opened["name"],
                             "id": self._next_id, "parent": opened["parent"],
                             "span": sid, "dangling": True})

@@ -14,6 +14,7 @@ confs line up with the reference (reference: GpuOverrides.scala:453-1453).
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Type
 
 import jax.numpy as jnp
@@ -139,14 +140,13 @@ def lit(v, dtype=None) -> Literal:
 # un-threaded kernel paths — which key their caches on the value, so a
 # baked constant can never be replayed for a different binding).
 
-_PARAM_BINDING = None  # lazily built threading.local (import-cycle free)
+# built eagerly: the old lazy `global` init could race under concurrent
+# serving — two first-touch threads built two locals and one thread's
+# parameter bindings landed on the loser, vanishing mid-dispatch (TPU009)
+_PARAM_BINDING = threading.local()
 
 
 def _param_tls():
-    global _PARAM_BINDING
-    if _PARAM_BINDING is None:
-        import threading
-        _PARAM_BINDING = threading.local()
     return _PARAM_BINDING
 
 
@@ -839,19 +839,22 @@ class ShiftRightUnsigned(BinaryExpression):
 # executing operator sets a traced offset scalar around expression eval (a
 # trace-time context, so it compiles into the jitted per-batch program as an
 # ordinary argument).
-_ROW_OFFSET = [None]
+# thread-local, not a module slot: the serving tier evaluates N queries
+# on N worker threads at once, and a shared slot would hand one query's
+# partition offset to another query's trace (TPU009)
+_ROW_OFFSET = threading.local()
 
 
 def eval_with_row_offset(fn, batch, offset):
-    _ROW_OFFSET[0] = offset
+    _ROW_OFFSET.value = offset
     try:
         return fn(batch)
     finally:
-        _ROW_OFFSET[0] = None
+        _ROW_OFFSET.value = None
 
 
 def current_row_offset():
-    off = _ROW_OFFSET[0]
+    off = getattr(_ROW_OFFSET, "value", None)
     return jnp.int64(0) if off is None else off
 
 
@@ -870,11 +873,14 @@ def tree_needs_row_offset(expr: "Expression") -> bool:
 # a new file compiles a new constant program — see RowLocalExec.execute).
 # Like Spark, the value is only meaningful directly above a file scan;
 # elsewhere it is ("", -1, -1).
-_INPUT_FILE = [("", -1, -1)]
+# thread-local for the same reason as _ROW_OFFSET: concurrent scans on
+# scheduler worker threads publish different files at the same time
+_INPUT_FILE = threading.local()
+_NO_FILE = ("", -1, -1)
 
 
 def set_input_file(name: str, start: int, length: int) -> None:
-    _INPUT_FILE[0] = (name, start, length)
+    _INPUT_FILE.value = (name, start, length)
 
 
 def publish_input_file(path: str) -> None:
@@ -889,11 +895,11 @@ def publish_input_file(path: str) -> None:
 
 
 def clear_input_file() -> None:
-    _INPUT_FILE[0] = ("", -1, -1)
+    _INPUT_FILE.value = _NO_FILE
 
 
 def current_input_file():
-    return _INPUT_FILE[0]
+    return getattr(_INPUT_FILE, "value", _NO_FILE)
 
 
 def tree_needs_input_file(expr: "Expression") -> bool:

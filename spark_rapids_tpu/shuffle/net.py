@@ -575,7 +575,7 @@ class SocketClient(ShuffleTransportClient):
         # deterministic jitter: seeded per peer address, not wall clock
         self._rng = random.Random(f"shuffle-retry:{self.addr}")
 
-    def _conn(self) -> socket.socket:
+    def _conn_locked(self) -> socket.socket:
         if self._sock is None:
             t = self.transport
             s = socket.create_connection(
@@ -591,7 +591,7 @@ class SocketClient(ShuffleTransportClient):
             self._sock = s
         return self._sock
 
-    def _drop_socket(self) -> None:
+    def _drop_socket_locked(self) -> None:
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -617,7 +617,7 @@ class SocketClient(ShuffleTransportClient):
         for attempt in range(attempts):
             if deadline is not None and time.monotonic() > deadline:
                 with self._lock:
-                    self._drop_socket()
+                    self._drop_socket_locked()
                 raise (txn.cancel(f"{label} to {self.addr} exceeded "
                                   "the transaction deadline") if txn
                        else TransactionCancelled(
@@ -626,17 +626,17 @@ class SocketClient(ShuffleTransportClient):
                 with self._lock:
                     if self.inject_faults:
                         faults.INJECTOR.on_net_op(label)
-                    return body(self._conn())
+                    return body(self._conn_locked())
             except TransactionCancelled:
                 with self._lock:
-                    self._drop_socket()  # the stream is poisoned mid-frame
+                    self._drop_socket_locked()  # the stream is poisoned mid-frame
                 raise
             except (TimeoutError, ConnectionError, OSError) as e:
                 # socket.timeout is a TimeoutError (itself an OSError);
                 # injected faults are ConnectionErrors.  All of them tear
                 # the socket down so the next attempt starts clean.
                 with self._lock:
-                    self._drop_socket()
+                    self._drop_socket_locked()
                 last = e
                 self.transport.count("net_op_failures")
                 log.warning("shuffle %s to %s failed "
@@ -652,9 +652,9 @@ class SocketClient(ShuffleTransportClient):
             f"shuffle {label} to {self.addr} failed after "
             f"{attempts} attempts: {last!r}") from last
 
-    def _request(self, op: int, payload, expect: int,
+    def _request_locked(self, op: int, payload, expect: int,
                  buffer_id: int = -1) -> bytes:
-        sock = self._conn()
+        sock = self._conn_locked()
         send_frame(sock, op, payload)
         got, resp = recv_frame(sock)
         if got == OP_RPC_ERR:
@@ -672,11 +672,12 @@ class SocketClient(ShuffleTransportClient):
             request.trace = current_trace()
         blob = pickle.dumps(request)
         resp = self._retrying(
-            "metadata", lambda _s: self._request(OP_META, blob,
+            "metadata", lambda _s: self._request_locked(OP_META, blob,
                                                  OP_META_RESP))
         self.transport.count("metadata_fetched")
         meta = pickle.loads(resp)
-        self._peer_traced = bool(getattr(meta, "traced", False))
+        with self._lock:
+            self._peer_traced = bool(getattr(meta, "traced", False))
         return meta
 
     def _wire_trace(self):
@@ -715,7 +716,7 @@ class SocketClient(ShuffleTransportClient):
             try:
                 with self._lock:
                     faults.INJECTOR.on_net_op("fetch_shm")
-                    sock = self._conn()
+                    sock = self._conn_locked()
                     send_frame(sock, OP_FETCH_SHM,
                                pickle.dumps(
                                    (buffer_id, path,
@@ -733,7 +734,7 @@ class SocketClient(ShuffleTransportClient):
                             buffer_id, self.addr, e)
                 self.transport.count("net_op_failures")
                 with self._lock:
-                    self._drop_socket()
+                    self._drop_socket_locked()
                 return None
             if op == OP_GONE:
                 _raise_gone(resp, buffer_id)
@@ -810,7 +811,7 @@ class SocketClient(ShuffleTransportClient):
         try:
             resp = self._retrying(
                 "layout",
-                lambda _s: self._request(OP_LAYOUT,
+                lambda _s: self._request_locked(OP_LAYOUT,
                                          _pack_fetch(buffer_id, req_codec,
                                                      trace),
                                          OP_LAYOUT_RESP, buffer_id),
@@ -950,7 +951,7 @@ class SocketClient(ShuffleTransportClient):
             self.transport.count("checksum_mismatches")
             txn.fail(repr(e))
             with self._lock:
-                self._drop_socket()
+                self._drop_socket_locked()
             raise
         except BufferGone as e:
             txn.fail(repr(e))
@@ -959,7 +960,7 @@ class SocketClient(ShuffleTransportClient):
     def release_buffer(self, buffer_id: int) -> None:
         # done_serving is idempotent at the server, so the retry is safe
         self._retrying(
-            "done", lambda _s: self._request(
+            "done", lambda _s: self._request_locked(
                 OP_DONE, struct.pack(">Q", buffer_id), OP_ACK))
 
     def diagnose_buffer(self, buffer_id: int):
@@ -969,7 +970,7 @@ class SocketClient(ShuffleTransportClient):
         is classified by the caller from the absence of evidence."""
         try:
             resp = self._retrying(
-                "diag", lambda _s: self._request(
+                "diag", lambda _s: self._request_locked(
                     OP_DIAG,
                     _pack_fetch(buffer_id, None, self._wire_trace()),
                     OP_DIAG_RESP, buffer_id))
@@ -993,7 +994,7 @@ class SocketClient(ShuffleTransportClient):
             if self.inject_faults:
                 faults.INJECTOR.on_net_op("rpc")
             try:
-                sock = self._conn()
+                sock = self._conn_locked()
                 # compile-friendly: no I/O deadline unless opted in
                 sock.settimeout(_rpc_timeout)
                 try:
@@ -1006,9 +1007,9 @@ class SocketClient(ShuffleTransportClient):
                                 self.transport.io_timeout
                                 if self.transport.io_timeout > 0 else None)
                         except OSError:
-                            self._drop_socket()  # broken mid-rpc
+                            self._drop_socket_locked()  # broken mid-rpc
             except (TimeoutError, ConnectionError, OSError) as e:
-                self._drop_socket()
+                self._drop_socket_locked()
                 self.transport.count("net_op_failures")
                 log.warning("shuffle rpc %s to %s failed: %r", method,
                             self.addr, e)
@@ -1022,7 +1023,7 @@ class SocketClient(ShuffleTransportClient):
 
     def close(self) -> None:
         with self._lock:
-            self._drop_socket()
+            self._drop_socket_locked()
 
 
 class SocketTransport(ShuffleTransport):
@@ -1117,9 +1118,12 @@ class SocketTransport(ShuffleTransport):
             self.counters[key] = self.counters.get(key, 0) + n
 
     def register_server(self, executor_id: str, server) -> None:
+        # single-owner wiring: runs once at worker startup, before any
+        # serve/fetch thread exists (the server it builds STARTS them)
+        # tpulint: disable=TPU009 startup wiring precedes every thread that could race it
         self._server = ShuffleSocketServer(self, server, self.rpc_handler,
                                            self._host, self._port)
-        self.address = self._server.address
+        self.address = self._server.address  # tpulint: disable=TPU009 startup wiring precedes every thread that could race it
         self._peers[executor_id] = self.address
 
     def set_peers(self, peers: Dict[str, Tuple[str, int]]) -> None:

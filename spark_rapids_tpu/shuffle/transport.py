@@ -342,6 +342,7 @@ class AsyncLeafVerifier:
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._digests: Dict[int, int] = {}
         self._leaves: Dict[int, np.ndarray] = {}
+        # tpulint: disable=TPU009 helper thread journals on the query's behalf BY DESIGN: active_journal() routes helper threads to the process trace shard (metrics/journal.py thread-routing note)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="shuffle-verify")
         self._thread.start()
@@ -441,6 +442,7 @@ class AsyncFramedReader:
         self._frames: Dict[int, np.ndarray] = {}   # retained for fallback
         self._out: Dict[int, np.ndarray] = {}
         self._error: Optional[BaseException] = None
+        # tpulint: disable=TPU009 helper thread journals on the query's behalf BY DESIGN: active_journal() routes helper threads to the process trace shard (metrics/journal.py thread-routing note)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="shuffle-decompress")
         self._thread.start()

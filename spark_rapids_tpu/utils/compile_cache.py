@@ -23,11 +23,14 @@ effect and `reset_for_tests()` restores the pristine state.
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 # the path this process's jax config currently points at (None = cache
-# never enabled by this helper)
+# never enabled by this helper); the lock serializes concurrent enables
+# from scheduler construction vs. worker first-touch (TPU009)
 _STATE = {"path": None}
+_STATE_LOCK = threading.Lock()
 
 
 def enable_compilation_cache(path: str, force: bool = False) -> bool:
@@ -50,10 +53,15 @@ def enable_compilation_cache(path: str, force: bool = False) -> bool:
                 # NOT latched: a later force=True call (bench child) may
                 # still enable the cache in this process
                 return False
-        jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-        _STATE["path"] = path
+        with _STATE_LOCK:
+            if _STATE["path"] == path:
+                return False  # a concurrent enabler won the race
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", 0)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1)
+            _STATE["path"] = path
         return True
     except Exception:
         return False  # an optimization, never a dependency

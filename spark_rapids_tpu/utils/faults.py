@@ -182,7 +182,7 @@ class FaultInjector:
         Deliberately survives reset(): identity is not a fault plan."""
         self.scope = scope
 
-    def _log_injected(self, rec: Tuple[str, int, str]) -> None:
+    def _log_injected_locked(self, rec: Tuple[str, int, str]) -> None:
         # caller holds self._lock; the deque evicts the OLDEST entry at
         # cap (recent faults matter most for post-mortems) and the drop
         # counter keeps the loss visible
@@ -259,7 +259,7 @@ class FaultInjector:
             self.site_counts[site] = self.site_counts.get(site, 0) + 1
             kind = self._oom.check(n)
             if kind is not None:
-                self._log_injected(("oom", n, site))
+                self._log_injected_locked(("oom", n, site))
         if kind is not None:
             from ..mem.retry import RetryOOM, SplitAndRetryOOM
             cls = SplitAndRetryOOM if kind == "split" else RetryOOM
@@ -276,7 +276,7 @@ class FaultInjector:
             self.site_counts[key] = self.site_counts.get(key, 0) + 1
             kind = self._net.check(n)
             if kind is not None:
-                self._log_injected(("net", n, site))
+                self._log_injected_locked(("net", n, site))
         if kind is not None:
             raise InjectedNetFault(
                 f"[fault-injection] forced net fault at op #{n} "
@@ -292,7 +292,7 @@ class FaultInjector:
             if seconds > 0:
                 key = f"delay:{site}"
                 self.site_counts[key] = self.site_counts.get(key, 0) + 1
-                self._log_injected(("delay", int(seconds * 1e3), site))
+                self._log_injected_locked(("delay", int(seconds * 1e3), site))
         if seconds > 0:
             import time
             time.sleep(seconds)
@@ -321,7 +321,7 @@ class FaultInjector:
             self.site_counts[key] = n_site
             hit = self._corrupt.check(n, site, n_site)
             if hit:
-                self._log_injected(("corrupt", n, site))
+                self._log_injected_locked(("corrupt", n, site))
         if hit and view is not None and len(view):
             view[len(view) // 2] ^= 0x01
         return hit

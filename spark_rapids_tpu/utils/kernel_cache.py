@@ -47,19 +47,25 @@ _COUNTERS = {"builds": 0, "stage_compiles": 0, "dispatches": 0,
 
 
 def record_dispatch(n: int = 1) -> None:
-    _COUNTERS["dispatches"] += n
+    # dict[k] += n is a read-modify-write: under concurrent serving the
+    # scheduler's worker threads dispatch simultaneously and an unlocked
+    # fold silently loses counts (bench reads these as accept gates)
+    with _CACHE_LOCK:
+        _COUNTERS["dispatches"] += n
 
 
 def record_donated(n_buffers: int) -> None:
     """Count input buffers donated to a compiled program (the HBM copies
     a warm dispatch did not pay); bench.py reads this around warm runs
     (donated_copies_warm_run) like it reads dispatches."""
-    _COUNTERS["donated_buffers"] += n_buffers
+    with _CACHE_LOCK:
+        _COUNTERS["donated_buffers"] += n_buffers
 
 
 def stats() -> Dict[str, int]:
-    return dict(_COUNTERS, cached_kernels=len(_CACHE),
-                stage_executables=len(_STAGE_EXECUTABLES))
+    with _CACHE_LOCK:
+        return dict(_COUNTERS, cached_kernels=len(_CACHE),
+                    stage_executables=len(_STAGE_EXECUTABLES))
 
 
 def input_signature(args) -> tuple:
@@ -124,7 +130,8 @@ def stage_executable(key: tuple, builder: Callable[[], Callable],
     finally:
         if timer is not None:
             timer.__exit__(None, None, None)
-    _COUNTERS["stage_compiles"] += 1
+    with _CACHE_LOCK:
+        _COUNTERS["stage_compiles"] += 1
     if metrics is not None:
         metrics.add(MN.NUM_STAGE_COMPILES, 1)
     journal_event("compile", name,
@@ -231,7 +238,8 @@ def cached_kernel(key: tuple, builder: Callable[[], Callable],
             _CACHE[key] = fn
             _COUNTERS["builds"] += 1
     else:
-        _COUNTERS["kernel_hits"] += 1
+        with _CACHE_LOCK:
+            _COUNTERS["kernel_hits"] += 1
     return fn
 
 

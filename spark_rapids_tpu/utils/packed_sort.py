@@ -51,7 +51,7 @@ _PALLAS_SORT = [False]
 
 
 def set_packed_enabled(enabled: bool) -> None:
-    _PACKED[0] = bool(enabled)
+    _PACKED[0] = bool(enabled)  # tpulint: disable=TPU009 per-session conf latch: an atomic boolean store, and every concurrent query of one session writes the same session-conf value
 
 
 def packed_enabled() -> bool:
@@ -59,7 +59,7 @@ def packed_enabled() -> bool:
 
 
 def set_pallas_sort(enabled: bool) -> None:
-    _PALLAS_SORT[0] = bool(enabled)
+    _PALLAS_SORT[0] = bool(enabled)  # tpulint: disable=TPU009 per-session conf latch: atomic boolean store, same-value writers under one session conf
 
 
 def _u64(x: int):

@@ -21,6 +21,7 @@ Runs in the `pallas` ci.sh tier next to the interpret-mode kernel tests
 """
 from __future__ import annotations
 
+import pyarrow as pa
 import pytest
 
 import jax.numpy as jnp
@@ -238,3 +239,92 @@ def test_agg_absorption_donation():
                      .alias("c"))
                 .order_by(col("k")))
     _donation_on_vs_off(q, ignore_order=False, approx_float=True)
+
+
+# --------------------------------------------------------------------------
+# ISSUE 12: the consumed() registry + the de-fuse ladder donation guard
+# --------------------------------------------------------------------------
+
+def test_consumed_registry_tracks_donated_batches():
+    """record_donated_dispatch over a batch OBJECT marks it consumed, and
+    a consumed batch can never be donated again (its leaves are aliased
+    into a compiled program's outputs — they no longer exist)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.mem import donation
+    donation.reset_for_tests()
+    batch = ColumnarBatch.from_arrow(
+        pa.table({"a": pa.array([1.0, 2.0, 3.0, 4.0])}))
+    assert not donation.consumed(batch)
+    assert donation.donatable(batch)
+    n = donation.record_donated_dispatch(batch)
+    assert n >= 1
+    assert donation.consumed(batch)
+    assert not donation.donatable(batch), \
+        "a consumed batch must never be donated a second time"
+    # an int count (the aggregate whole-stage path) marks nothing
+    other = ColumnarBatch.from_arrow(pa.table({"a": pa.array([1.0])}))
+    donation.record_donated_dispatch(3)
+    assert not donation.consumed(other)
+    assert donation.stats()["live_consumed"] >= 1
+    del batch
+    import gc
+    gc.collect()
+    assert donation.stats()["live_consumed"] == 0, \
+        "the consumed registry must not keep dead batches alive"
+
+
+def test_retry_aborts_instead_of_rereading_donated_input():
+    """TPU008 regression (the de-fuse ladder's error path): an attempt
+    that fails AFTER donating its input must make the retry ladder
+    terminal — re-dispatching, splitting, or CPU-falling-back on the
+    batch would read freed device buffers.  with_retry must raise
+    RetryExhausted after ONE attempt, without retrying or splitting."""
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.mem import donation
+    from spark_rapids_tpu.mem.retry import (RetryExhausted, RetryOOM,
+                                            with_retry)
+    donation.reset_for_tests()
+    batch = ColumnarBatch.from_arrow(
+        pa.table({"a": pa.array([1.0, 2.0, 3.0, 4.0])}))
+    calls = []
+
+    def attempt(b):
+        calls.append(b)
+        # the dispatch donated the input's buffers, then failed
+        donation.record_donated_dispatch(b)
+        raise RetryOOM("device OOM mid-dispatch", nbytes=128)
+
+    splits = []
+
+    def split(b):
+        splits.append(b)
+        return None
+
+    with pytest.raises(RetryExhausted, match="donat"):
+        with_retry(attempt, [batch], split=split, max_retries=3)
+    assert len(calls) == 1, \
+        "a donated input must not be re-dispatched by the retry loop"
+    assert splits == [], \
+        "a donated input must not be handed to the splitter"
+
+
+def test_retry_still_retries_undonated_inputs():
+    """Control for the guard above: the same failure WITHOUT a donation
+    retries normally."""
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.mem import donation
+    from spark_rapids_tpu.mem.retry import RetryOOM, with_retry
+    donation.reset_for_tests()
+    batch = ColumnarBatch.from_arrow(
+        pa.table({"a": pa.array([1.0, 2.0])}))
+    calls = []
+
+    def attempt(b):
+        calls.append(b)
+        if len(calls) == 1:
+            raise RetryOOM("transient", nbytes=64)
+        return b
+
+    out = with_retry(attempt, [batch], max_retries=2)
+    assert len(calls) == 2 and out == [batch]

@@ -3,8 +3,10 @@
 Three layers:
   * framework mechanics: suppressions need reasons, the baseline grants
     exact counts with mandatory reasons, stale entries warn;
-  * per-rule fixtures: every pass TPU001..TPU007 proves one true
-    positive AND one clean negative on synthetic project trees;
+  * per-rule fixtures: every pass TPU001..TPU011 proves one true
+    positive AND one clean negative on synthetic project trees (the
+    ISSUE-12 cross-module passes get dataflow/call-graph fixtures plus
+    a project-model unit tier and incremental-cache replay tests);
   * the self-run: the real repo lints to ZERO unsuppressed findings
     (the acceptance gate every later PR inherits), and the back-compat
     `python -m spark_rapids_tpu.metrics --lint` alias still answers.
@@ -736,3 +738,713 @@ def test_cli_and_metrics_alias_exit_zero():
                             "--check-docs"], cwd=root, env=env,
                            capture_output=True, text=True, timeout=600)
     assert drift.returncode == 0, drift.stdout + drift.stderr
+
+
+# --------------------------------------------------------------------------
+# TPU008 — use-after-donate (ISSUE 12 cross-module dataflow)
+# --------------------------------------------------------------------------
+
+def test_tpu008_donated_then_read(tmp_path):
+    """The core true positive: a batch dispatched through a donating
+    executable and then re-read on a later line."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        from .kernel_cache import stage_executable
+        from .fusion import source_donatable
+
+        def run(key, builder, b, journal):
+            if source_donatable(b):
+                fn = stage_executable(key, builder, (b,),
+                                      donate_argnums=(0,))
+                out = fn(b)
+                journal(b)  # <- b's buffers were donated at the dispatch
+                return out
+    """}, rules=["TPU008"])
+    assert [f.rule for f in res.findings] == ["TPU008"]
+    assert "use-after-donate" in res.findings[0].message
+    assert "'b'" in res.findings[0].message
+
+
+def test_tpu008_defuse_ladder_error_path_read(tmp_path):
+    """The PR 11 dispatch-site regression the acceptance criteria names:
+    re-introducing a post-donation read at a retry-combinator site (the
+    whole-stage de-fuse ladder shape) is caught — the donation flows
+    through run_retryable into the nested attempt's donating dispatch,
+    and the read sits in the except handler."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        from .kernel_cache import stage_executable
+        from .retryable import run_retryable
+        from .retry import RetryExhausted
+        from .donation import donatable
+
+        class Stage:
+            def execute(self, ctx, batches, key, builder, cpu_apply):
+                def attempt(b):
+                    don = self.donate_inputs and donatable(b)
+                    fn = stage_executable(key, builder, (b,),
+                                          donate_argnums=(0,)
+                                          if don else ())
+                    return fn(b)
+                for batch in batches:
+                    try:
+                        yield run_retryable(ctx, self.metrics, "stage",
+                                            attempt, [batch])
+                    except RetryExhausted:
+                        yield cpu_apply(batch)  # reads the donated batch
+    """}, rules=["TPU008"])
+    assert [f.rule for f in res.findings] == ["TPU008"]
+    assert "'batch'" in res.findings[0].message
+    assert "retry combinator" in res.findings[0].message
+
+
+def test_tpu008_consumed_guard_negative(tmp_path):
+    """The blessed error-path shape: a donation.consumed() bail-out that
+    dominates the read silences the finding."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        from .kernel_cache import stage_executable
+        from .retryable import run_retryable
+        from .retry import RetryExhausted
+        from .donation import donatable, consumed
+
+        class Stage:
+            def execute(self, ctx, batches, key, builder, cpu_apply):
+                def attempt(b):
+                    don = self.donate_inputs and donatable(b)
+                    fn = stage_executable(key, builder, (b,),
+                                          donate_argnums=(0,)
+                                          if don else ())
+                    return fn(b)
+                for batch in batches:
+                    try:
+                        yield run_retryable(ctx, self.metrics, "stage",
+                                            attempt, [batch])
+                    except RetryExhausted:
+                        if consumed(batch):
+                            raise
+                        yield cpu_apply(batch)
+    """}, rules=["TPU008"])
+    assert res.findings == []
+
+
+def test_tpu008_pin_dominating_donation_negative(tmp_path):
+    """A pin() that dominates the donation site disarms it: the registry
+    refuses to donate a pinned batch, so later reads are safe."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        from .kernel_cache import stage_executable
+        from .donation import pin, donatable
+
+        def run(key, builder, b, journal):
+            pin(b)
+            don = donatable(b)
+            fn = stage_executable(key, builder, (b,),
+                                  donate_argnums=(0,) if don else ())
+            out = fn(b)
+            journal(b)
+            return out
+    """}, rules=["TPU008"])
+    assert res.findings == []
+
+
+def test_tpu008_unproven_dispatch_site(tmp_path):
+    """A NEW dispatch site that donates without any donatable()/
+    source_donatable()/donate_inputs proof in scope is flagged even
+    before any read goes wrong."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        from .kernel_cache import stage_executable
+
+        def run(key, builder, b):
+            fn = stage_executable(key, builder, (b,),
+                                  donate_argnums=(0,))
+            return fn(b)
+    """}, rules=["TPU008"])
+    assert any("last-consumer proof" in f.message for f in res.findings)
+
+
+def test_tpu008_plumbing_forward_not_flagged(tmp_path):
+    """kernel_cache's own shape — donate_argnums forwarded from the
+    function's parameter — is plumbing; the proof sits at the caller."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        import jax
+
+        def build(builder, donate_argnums=()):
+            return jax.jit(builder(), donate_argnums=donate_argnums)
+    """}, rules=["TPU008"])
+    assert res.findings == []
+
+
+def test_tpu008_exclusive_branches_negative(tmp_path):
+    """A read in the non-donating sibling arm (after a terminating
+    donation arm) can never observe the donation."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        from .kernel_cache import stage_executable
+        from .donation import donatable
+
+        def run(key, builder, b, fused, eager):
+            if fused and donatable(b):
+                fn = stage_executable(key, builder, (b,),
+                                      donate_argnums=(0,))
+                return fn(b)
+            return eager(b)
+    """}, rules=["TPU008"])
+    assert res.findings == []
+
+
+# --------------------------------------------------------------------------
+# TPU009 — serving-tier shared-state audit
+# --------------------------------------------------------------------------
+
+_TPU009_POS = """
+    import threading
+
+    _HITS = {"n": 0}
+
+    class Scheduler:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.completed = 0
+            self._workers = [
+                threading.Thread(target=self._worker_loop, daemon=True)]
+
+        def _worker_loop(self):
+            while True:
+                self._run_one()
+
+        def _run_one(self):
+            _HITS["n"] += 1          # global counter without the lock
+            self.completed += 1      # instance write without the lock
+"""
+
+
+def test_tpu009_unlocked_writes_from_worker_threads(tmp_path):
+    res = run_fixture(tmp_path,
+                      {"spark_rapids_tpu/m.py": _TPU009_POS},
+                      rules=["TPU009"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "_HITS" in msgs, msgs
+    assert "self.completed" in msgs, msgs
+
+
+def test_tpu009_locked_writes_negative(tmp_path):
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        import threading
+
+        _HITS = {"n": 0}
+        _HITS_LOCK = threading.Lock()
+
+        class Scheduler:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.completed = 0
+                self._workers = [
+                    threading.Thread(target=self._worker_loop,
+                                     daemon=True)]
+
+            def _worker_loop(self):
+                while True:
+                    self._run_one()
+
+            def _run_one(self):
+                with _HITS_LOCK:
+                    _HITS["n"] += 1
+                with self._lock:
+                    self.completed += 1
+
+            def _untrack_locked(self):
+                self.completed -= 1  # convention: caller holds the lock
+    """}, rules=["TPU009"])
+    assert res.findings == []
+
+
+def test_tpu009_thread_local_read_without_reinstall(tmp_path):
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        import threading
+
+        class Verifier:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+
+            def _run(self):
+                while True:
+                    self._verify_one()
+
+            def _verify_one(self):
+                from .journal import journal_event
+                journal_event("spill", "verified")
+    """}, rules=["TPU009"])
+    assert any("thread boundary" in f.message for f in res.findings)
+
+
+def test_tpu009_thread_local_reinstall_negative(tmp_path):
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+
+            def _run(self):
+                from .journal import journal_event, trace_context
+                with trace_context(query="q1"):
+                    journal_event("spill", "verified")
+    """}, rules=["TPU009"])
+    assert res.findings == []
+
+
+# --------------------------------------------------------------------------
+# TPU010 — pallas kernel contracts
+# --------------------------------------------------------------------------
+
+def test_tpu010_int64_in_kernel_and_bad_tile(tmp_path):
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _kern(x_ref, o_ref):
+            o_ref[:] = x_ref[:].astype(jnp.int64)
+
+        def wide_cumsum(x):
+            return pl.pallas_call(
+                _kern,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                in_specs=[pl.BlockSpec((7, 100), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            )(x)
+    """}, rules=["TPU010"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "64-bit dtype int64" in msgs
+    assert "(7, 100)" in msgs
+    # the congruent out_spec is NOT flagged
+    assert "(8, 128) is not congruent" not in msgs
+
+
+def test_tpu010_host_sync_in_kernel(tmp_path):
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        from jax.experimental import pallas as pl
+
+        def _kern(x_ref, o_ref):
+            n = x_ref[0].item()
+            print(n)
+            o_ref[:] = x_ref[:]
+
+        def bad(x, shape):
+            return pl.pallas_call(_kern, out_shape=shape)(x)
+    """}, rules=["TPU010"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "host-sync call item()" in msgs
+    assert "impure call print()" in msgs
+
+
+def test_tpu010_clean_kernel_negative(tmp_path):
+    """The real kernels' shape: int32 iota, (8,128) tiles via module
+    constants, is_count widening exempt."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        _SUBLANES = 8
+        _LANES = 128
+
+        def _make_kern(is_count):
+            def kern(x_ref, o_ref):
+                v = jnp.cumsum(x_ref[:], axis=1)
+                if is_count:
+                    v = v.astype(jnp.int64)  # blessed widening shape
+                o_ref[:] = v
+            return kern
+
+        def good(x, shape, ops):
+            spec = pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0))
+            return pl.pallas_call(
+                _make_kern(True), out_shape=shape,
+                in_specs=[spec], out_specs=spec)(x)
+    """}, rules=["TPU010"])
+    assert res.findings == []
+
+
+def test_tpu010_untested_kernel_wrapper(tmp_path):
+    """The registry half: a public wrapper with no reference from
+    tests/test_pallas.py is flagged; a referenced one is not."""
+    res = run_fixture(tmp_path, {
+        "spark_rapids_tpu/m.py": """
+            from jax.experimental import pallas as pl
+
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def tested_kernel(x, shape):
+                return pl.pallas_call(_kern, out_shape=shape)(x)
+
+            def untested_kernel(x, shape):
+                return pl.pallas_call(_kern, out_shape=shape)(x)
+        """,
+        "tests/test_pallas.py": """
+            from spark_rapids_tpu.m import tested_kernel
+
+            def test_tested_kernel_interpret():
+                assert tested_kernel is not None
+        """}, rules=["TPU010"])
+    names = " | ".join(f.message for f in res.findings)
+    assert "untested_kernel" in names
+    assert names.count("has no interpret-mode test") == 1
+
+
+# --------------------------------------------------------------------------
+# TPU011 — metric/journal flow coverage
+# --------------------------------------------------------------------------
+
+def test_tpu011_dead_metric_and_live_negative(tmp_path):
+    res = run_fixture(tmp_path, {
+        "spark_rapids_tpu/metrics/names.py": """
+            def register_metric(name, kind, level, doc):
+                return name
+
+            LIVE = register_metric("liveMetric", "counter", 1, "used")
+            DEAD = register_metric("deadMetric", "counter", 1, "unused")
+        """,
+        "spark_rapids_tpu/m.py": """
+            def execute(metrics):
+                metrics.add("liveMetric", 1)
+        """}, rules=["TPU011"])
+    msgs = [f.message for f in res.findings]
+    assert any("'deadMetric' is registered but" in m for m in msgs), msgs
+    assert not any("liveMetric" in m for m in msgs)
+
+
+def test_tpu011_orphan_kind_and_unreachable_emission(tmp_path):
+    res = run_fixture(tmp_path, {
+        "spark_rapids_tpu/metrics/journal.py": """
+            EVENT_KINDS = ("spill", "ghostkind")
+        """,
+        "spark_rapids_tpu/m.py": """
+            from .journal import journal_event
+
+            def execute(metrics):
+                journal_event("spill", "x")
+
+            def _forgotten(metrics):
+                metrics.add("numOutputRows", 1)
+        """}, rules=["TPU011"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "'ghostkind'" in msgs
+    assert "_forgotten" in msgs and "unreachable" in msgs
+
+
+def test_tpu011_retry_block_and_constant_emissions_credit(tmp_path):
+    """Derived {block}Retries/Splits names and MN.CONSTANT references
+    count as emissions — the real tree's idioms must not read as dead."""
+    res = run_fixture(tmp_path, {
+        "spark_rapids_tpu/metrics/names.py": """
+            def register_metric(name, kind, level, doc):
+                return name
+
+            QUEUE_TIME = register_metric("queueTime", "timer", 1, "t")
+            RETRY_BLOCKS = ("sort",)
+            for _b in RETRY_BLOCKS:
+                register_metric(f"{_b}Retries", "counter", 1, "r")
+                register_metric(f"{_b}Splits", "counter", 1, "s")
+        """,
+        "spark_rapids_tpu/m.py": """
+            from .metrics import names as MN
+
+            def execute(ctx, metrics, run_retryable):
+                metrics.add(MN.QUEUE_TIME, 1.0)
+                run_retryable(ctx, metrics, "sort", None, [])
+        """}, rules=["TPU011"])
+    assert res.findings == []
+
+
+# --------------------------------------------------------------------------
+# the project model: call-graph resolution unit tier
+# --------------------------------------------------------------------------
+
+def _linked_model(tmp_path, files):
+    import ast as _ast
+    from spark_rapids_tpu.lint.model import ProjectModel, extract_module
+    frags = []
+    for rel, text in files.items():
+        frags.append(extract_module(rel, _ast.parse(
+            textwrap.dedent(text))))
+    return ProjectModel.link(frags)
+
+
+def test_model_resolves_attribute_calls_through_hierarchy(tmp_path):
+    """`self.batch_fn()` in a base-class method resolves to every
+    override in the class family — the RowLocalExec shape."""
+    pm = _linked_model(tmp_path, {
+        "spark_rapids_tpu/base.py": """
+            class RowLocalExec:
+                def execute(self):
+                    return self.batch_fn()
+
+                def batch_fn(self):
+                    raise NotImplementedError
+        """,
+        "spark_rapids_tpu/filt.py": """
+            from .base import RowLocalExec
+
+            class TpuFilterExec(RowLocalExec):
+                def batch_fn(self):
+                    return 1
+        """})
+    execute = pm.funcs["spark_rapids_tpu/base.py::RowLocalExec.execute"]
+    targets = pm.resolve_call(execute, "self.batch_fn")
+    assert "spark_rapids_tpu/filt.py::TpuFilterExec.batch_fn" in targets
+    assert "spark_rapids_tpu/base.py::RowLocalExec.batch_fn" in targets
+
+
+def test_model_reachability_through_stores_and_imports(tmp_path):
+    """Function-level imports and subclass dispatch (the BufferStore
+    shape) both resolve; unreached helpers stay unreached."""
+    pm = _linked_model(tmp_path, {
+        "spark_rapids_tpu/stores.py": """
+            class BufferStore:
+                def spill(self):
+                    self.evict_one()
+
+                def evict_one(self):
+                    raise NotImplementedError
+
+            class DeviceMemoryStore(BufferStore):
+                def evict_one(self):
+                    from .ledger import on_spill
+                    on_spill()
+        """,
+        "spark_rapids_tpu/ledger.py": """
+            def on_spill():
+                pass
+
+            def _never_called():
+                pass
+        """})
+    reach = pm.reachable(
+        ["spark_rapids_tpu/stores.py::BufferStore.spill"])
+    assert "spark_rapids_tpu/ledger.py::on_spill" in reach
+    assert "spark_rapids_tpu/ledger.py::_never_called" not in reach
+
+
+def test_model_class_family_and_lock_ownership(tmp_path):
+    pm = _linked_model(tmp_path, {
+        "spark_rapids_tpu/m.py": """
+            import threading
+
+            class Base:
+                pass
+
+            class Mid(Base):
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+            class Leaf(Mid):
+                pass
+        """})
+    fam = pm.class_family("Mid")
+    assert fam == {"Base", "Mid", "Leaf"}
+    assert pm.owns_lock("Mid")
+    assert not pm.owns_lock("Base")
+
+
+# --------------------------------------------------------------------------
+# incremental cache (ISSUE 12 satellite)
+# --------------------------------------------------------------------------
+
+def test_cache_warm_run_replays_findings_and_fragments(tmp_path):
+    """A warm run must reproduce the cold run bit-for-bit: per-file
+    findings (TPU001), cross-file fragment state (TPU005's sweep
+    contract), everything."""
+    files = {
+        "spark_rapids_tpu/m.py": """
+            def f(x, rt):
+                rt.reserve(10, site="fixture.site")
+                return x.item()
+        """,
+        "tests/test_retry.py": "OOM_SWEEP_SITES = (\"other.site\",)\n",
+    }
+    root = str(tmp_path)
+    for rel, text in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(text))
+    docs = os.path.join(root, "docs", "configs.md")
+    os.makedirs(os.path.dirname(docs), exist_ok=True)
+    with open(docs, "w") as f:
+        f.write(help_doc())
+    from spark_rapids_tpu.lint.core import lint_paths as lp
+    cold = lp(paths=None, root=root, baseline=Baseline([]),
+              use_cache=True)
+    warm = lp(paths=None, root=root, baseline=Baseline([]),
+              use_cache=True)
+    assert cold.cache_misses > 0 and warm.cache_misses == 0
+    assert warm.cache_hits == warm.files_checked
+    assert ([f.to_json() for f in cold.findings]
+            == [f.to_json() for f in warm.findings])
+    # the TPU005 cross-file contract findings survived the cache replay
+    rules = {f.rule for f in warm.findings}
+    assert "TPU001" in rules and "TPU005" in rules
+    # editing a file invalidates ONLY it
+    with open(os.path.join(root, "spark_rapids_tpu", "m.py"), "a") as f:
+        f.write("\nX = 1\n")
+    third = lp(paths=None, root=root, baseline=Baseline([]),
+               use_cache=True)
+    assert third.cache_misses == 1
+    assert {f.rule for f in third.findings} == rules
+
+
+def test_cache_entries_prune_for_removed_files(tmp_path):
+    root = str(tmp_path)
+    target = os.path.join(root, "spark_rapids_tpu", "gone.py")
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    with open(target, "w") as f:
+        f.write("X = 1\n")
+    docs = os.path.join(root, "docs", "configs.md")
+    os.makedirs(os.path.dirname(docs), exist_ok=True)
+    with open(docs, "w") as f:
+        f.write(help_doc())
+    from spark_rapids_tpu.lint.cache import CACHE_DIR_NAME
+    from spark_rapids_tpu.lint.core import lint_paths as lp
+    lp(paths=None, root=root, baseline=Baseline([]), use_cache=True)
+    cache_dir = os.path.join(root, CACHE_DIR_NAME)
+    before = {f for f in os.listdir(cache_dir) if f.endswith(".pkl")}
+    os.unlink(target)
+    lp(paths=None, root=root, baseline=Baseline([]), use_cache=True)
+    after = {f for f in os.listdir(cache_dir) if f.endswith(".pkl")}
+    assert len(after) < len(before)
+
+
+def test_baseline_entry_for_removed_file_says_prune(tmp_path):
+    grant = Baseline([{"rule": "TPU001",
+                       "path": "spark_rapids_tpu/removed.py",
+                       "count": 2, "reason": "legacy syncs"}])
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": "X = 1\n"},
+                      rules=["TPU001"], baseline=grant)
+    # fixture runs pass explicit paths, so removal cannot be claimed
+    assert all("no longer exists" not in s for s in res.stale_baseline)
+    from spark_rapids_tpu.lint.core import lint_paths as lp
+    res2 = lp(paths=None, root=str(tmp_path), baseline=grant)
+    assert any("no longer exists" in s and "prune" in s
+               for s in res2.stale_baseline), res2.stale_baseline
+
+
+# --------------------------------------------------------------------------
+# --explain and the TPU000 rule-doc pointer
+# --------------------------------------------------------------------------
+
+def test_explain_prints_rule_section(capsys):
+    from spark_rapids_tpu.lint.__main__ import explain_rule
+    assert explain_rule(repo_root(), "TPU008") == 0
+    out = capsys.readouterr().out
+    assert "TPU008" in out and "donat" in out
+    assert explain_rule(repo_root(), "TPU999") == 2
+
+
+def test_tpu000_names_rule_reference(tmp_path):
+    src = ("def f(x):\n"
+           "    return x.item()  # tpulint: " "disable=TPU001\n")
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": src},
+                      rules=["TPU001"])
+    meta = [f for f in res.findings if f.rule == "TPU000"]
+    assert meta and "--explain TPU001" in meta[0].message
+
+
+def test_cache_distinguishes_identical_files(tmp_path):
+    """Review fix: two byte-identical files must NOT share a cache entry
+    — findings and model fragments carry the file's path, so sharing
+    would double-report under one path and blind the project model to
+    the other."""
+    src = "def f(x):\n    return x.item()\n"
+    root = str(tmp_path)
+    for rel in ("spark_rapids_tpu/a.py", "spark_rapids_tpu/b.py"):
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(src)
+    docs = os.path.join(root, "docs", "configs.md")
+    os.makedirs(os.path.dirname(docs), exist_ok=True)
+    with open(docs, "w") as f:
+        f.write(help_doc())
+    from spark_rapids_tpu.lint.core import lint_paths as lp
+    for _ in range(2):  # cold, then warm replay
+        res = lp(paths=None, root=root, baseline=Baseline([]),
+                 use_cache=True)
+        tpu001 = sorted(f.path for f in res.findings
+                        if f.rule == "TPU001")
+        assert tpu001 == ["spark_rapids_tpu/a.py",
+                          "spark_rapids_tpu/b.py"], tpu001
+
+
+def test_cache_subset_run_does_not_prune_full_surface(tmp_path):
+    """Review fix: a library caller linting a SUBSET with the cache on
+    must not delete the rest of the tree's entries."""
+    root = str(tmp_path)
+    for rel in ("spark_rapids_tpu/a.py", "spark_rapids_tpu/b.py"):
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(f"X_{rel[-4]} = 1\n")
+    docs = os.path.join(root, "docs", "configs.md")
+    os.makedirs(os.path.dirname(docs), exist_ok=True)
+    with open(docs, "w") as f:
+        f.write(help_doc())
+    from spark_rapids_tpu.lint.cache import CACHE_DIR_NAME
+    from spark_rapids_tpu.lint.core import lint_paths as lp
+    lp(paths=None, root=root, baseline=Baseline([]), use_cache=True)
+    cache_dir = os.path.join(root, CACHE_DIR_NAME)
+    full = {f for f in os.listdir(cache_dir) if f.endswith(".pkl")}
+    lp(paths=[os.path.join(root, "spark_rapids_tpu", "a.py")],
+       root=root, baseline=Baseline([]), use_cache=True)
+    kept = {f for f in os.listdir(cache_dir) if f.endswith(".pkl")}
+    assert full <= kept, "subset run pruned full-surface entries"
+
+
+def test_tpu008_fallthrough_handler_read_after_try(tmp_path):
+    """Review fix: a try body that RETURNS still reaches the code after
+    the Try when an except handler falls through — the donation-then-
+    `except: pass`-then-read shape must flag."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        from .kernel_cache import stage_executable
+        from .donation import donatable
+
+        def run(key, builder, b, cpu_apply):
+            don = donatable(b)
+            try:
+                fn = stage_executable(key, builder, (b,),
+                                      donate_argnums=(0,) if don else ())
+                return fn(b)
+            except MemoryError:
+                pass  # tpulint: disable=TPU006 fixture fallthrough
+            return cpu_apply(b)
+    """}, rules=["TPU008"])
+    assert any("use-after-donate" in f.message for f in res.findings)
+
+
+def test_tpu008_terminating_handlers_still_negative(tmp_path):
+    """Control: when the try body returns AND every handler terminates,
+    code after the Try really is unreachable post-donation."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        from .kernel_cache import stage_executable
+        from .donation import donatable
+
+        def run(key, builder, b, cpu_apply):
+            don = donatable(b)
+            try:
+                fn = stage_executable(key, builder, (b,),
+                                      donate_argnums=(0,) if don else ())
+                return fn(b)
+            except MemoryError:
+                raise
+            return cpu_apply(b)
+    """}, rules=["TPU008"])
+    assert res.findings == []
+
+
+def test_tpu000_disable_all_cites_a_real_rule(tmp_path):
+    src = ("def f(x):\n"
+           "    return x.item()  # tpulint: " "disable=all\n")
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": src},
+                      rules=["TPU001"])
+    meta = [f for f in res.findings if f.rule == "TPU000"]
+    assert meta and "--explain all" not in meta[0].message
+    assert "--explain TPU001" in meta[0].message

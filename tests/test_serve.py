@@ -659,3 +659,127 @@ def test_scheduler_observability_block():
         assert sched["planCacheHits"] + sched["planCacheMisses"] >= 1
     finally:
         s.shutdown_serving()
+
+
+# --------------------------------------------------------------------------
+# ISSUE 12 (tpulint TPU009) regressions: shared-state fixes under the
+# scheduler's worker-thread concurrency
+# --------------------------------------------------------------------------
+
+def test_kernel_cache_counters_exact_under_concurrency():
+    """record_dispatch/record_donated are read-modify-writes on a module
+    dict; before ISSUE 12 they ran unlocked and concurrent serving
+    threads lost increments (bench reads these as accept gates)."""
+    from spark_rapids_tpu.utils import kernel_cache as kc
+    base = kc.stats()["dispatches"]
+    base_don = kc.stats()["donated_buffers"]
+    n_threads, per = 8, 2000
+
+    def hammer():
+        for _ in range(per):
+            kc.record_dispatch()
+            kc.record_donated(1)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert kc.stats()["dispatches"] - base == n_threads * per
+    assert kc.stats()["donated_buffers"] - base_don == n_threads * per
+
+
+def test_param_bindings_are_thread_isolated():
+    """The plan-cache parameter binding rides a thread-local: one worker
+    thread's binding must be invisible to its neighbors (pre-ISSUE-12
+    the lazily-built local could be LOST in an init race)."""
+    from spark_rapids_tpu.ops import expressions as E
+    seen = {}
+    installed = threading.Event()
+    release = threading.Event()
+
+    def binder():
+        tls = E._param_tls()
+        tls.values = {0: "mine"}
+        installed.set()
+        release.wait(5)
+        seen["binder"] = E.current_param(0)
+        tls.values = None
+
+    def observer():
+        installed.wait(5)
+        seen["observer"] = E.current_param(0)
+        release.set()
+
+    ts = [threading.Thread(target=binder),
+          threading.Thread(target=observer)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert seen["binder"] == "mine"
+    assert seen["observer"] is None
+
+
+def test_row_offset_and_input_file_are_thread_local():
+    """Concurrent queries publish different row offsets / input files on
+    their own worker threads; a shared module slot (the pre-ISSUE-12
+    shape) handed one query's value to another's trace."""
+    from spark_rapids_tpu.ops import expressions as E
+    results = {}
+    barrier = threading.Barrier(2, timeout=5)
+
+    def worker(tag, path):
+        def probe(b):
+            barrier.wait()      # both threads are mid-eval together
+            time.sleep(0.02)
+            return E.current_input_file()[0]
+        E.set_input_file(path, 0, 100)
+        try:
+            results[tag] = E.eval_with_row_offset(probe, None, tag)
+        finally:
+            E.clear_input_file()
+
+    ts = [threading.Thread(target=worker, args=("a", "/data/a.parquet")),
+          threading.Thread(target=worker, args=("b", "/data/b.parquet"))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results == {"a": "/data/a.parquet", "b": "/data/b.parquet"}
+
+
+def test_codec_instances_race_free():
+    """resolve_codec builds codec instances (which own side pools)
+    exactly once per name, even under concurrent first touch."""
+    from spark_rapids_tpu.compress import codec as C
+    C._INSTANCES.pop("none", None)
+    got = []
+
+    def resolve():
+        got.append(C.resolve_codec("none"))
+
+    ts = [threading.Thread(target=resolve) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len({id(c) for c in got}) == 1
+
+
+def test_parquet_pools_single_instance_under_concurrency():
+    from spark_rapids_tpu.io import parquet_device as P
+    with P._POOL_INIT_LOCK:
+        pass  # the lock exists and is free
+    P._DECOMP_POOL = None
+    got = []
+
+    def touch():
+        got.append(P._decomp_pool())
+
+    ts = [threading.Thread(target=touch) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len({id(p) for p in got}) == 1
