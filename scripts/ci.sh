@@ -141,6 +141,18 @@ T_SRV=$SECONDS
 python -m pytest tests/test_serve.py -q -m "not slow" -p no:cacheprovider
 echo "== serve tier took $((SECONDS - T_SRV))s =="
 
+echo "== roofline tier =="
+# roofline-attribution profiler (ISSUE 13): cost-declaration coverage
+# (every plan node of the q1/q6 shapes names a bottleneck resource),
+# profile-tree invariants (op-row bytes never exceed the stage
+# declaration), the prometheus round-trip property (histogram buckets,
+# _sum/_count, escaped label values), SLO histogram percentiles,
+# scheduler fairness visibility, and the profiler-overhead ceiling
+T_ROOF=$SECONDS
+python -m pytest tests/test_roofline.py -q -m "not slow" \
+    -p no:cacheprovider
+echo "== roofline tier took $((SECONDS - T_ROOF))s =="
+
 echo "== pallas/donation tier =="
 # on-chip kernels + buffer donation (ISSUE 11): interpret-mode pallas
 # kernel tests (fused segmented aggregation, tiled bitonic sort, the
@@ -197,6 +209,17 @@ else
     python -m pytest tests/ -q
 fi
 echo "== fast tier took $((SECONDS - T_TESTS))s =="
+
+echo "== profile-regression gate =="
+# ISSUE 13: a fresh roofline capture (per-operator achieved-vs-peak
+# ledgers for q1/q6 + serving SLO phase p95s + the profiler's own
+# overhead) is diffed against the checked-in BASELINE_PROFILE.json at a
+# generous (5x) tolerance — catches an operator falling off its fused
+# path or a phase exploding, not single-digit noise.  After a
+# deliberate perf change: scripts/profile_regression.py --bless
+T_PROF=$SECONDS
+JAX_PLATFORMS=cpu python scripts/profile_regression.py
+echo "== profile-regression gate took $((SECONDS - T_PROF))s =="
 
 echo "== multichip dryrun =="
 T_DRY=$SECONDS

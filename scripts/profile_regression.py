@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Profile-regression gate: diff a fresh roofline capture against the
+checked-in baseline (ISSUE 13).
+
+A fresh BENCH_PROFILE.json capture (bench.profile_microbench: per-
+operator roofline ledgers for the representative query set + serving
+SLO phase histograms + the profiler overhead gate) is compared against
+BASELINE_PROFILE.json:
+
+  * structure — every baseline query present; every plan node names a
+    bottleneck resource; every operator class the baseline saw still
+    appears in the capture's ledger (a silently vanished cost
+    declaration is a coverage regression, not a perf one);
+  * achieved bandwidth — per query, the effective HBM rate (declared
+    hbm bytes / measured seconds) and each operator class's best
+    achieved rate on its bottleneck resource must not fall below
+    baseline / tolerance;
+  * phase latencies — each serving phase's per-priority p95 must not
+    exceed baseline x tolerance;
+  * the profiler's own overhead gate must hold (<5% on q1).
+
+Tolerance is deliberately generous (default 5x, --tolerance/-t or env
+PROFILE_TOLERANCE): CI hosts vary wildly, and this gate exists to catch
+order-of-magnitude regressions (an operator silently falling off its
+fused path, a phase exploding), not single-digit noise.
+
+Usage:
+  python scripts/profile_regression.py            # capture + compare
+  python scripts/profile_regression.py --bless    # update the baseline
+  python scripts/profile_regression.py --from-artifact   # reuse
+      BENCH_PROFILE.json instead of re-running the capture
+Exit: 0 ok, 1 regression, 2 usage/missing baseline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPTURE_PATH = os.path.join(REPO, "BENCH_PROFILE.json")
+BASELINE_PATH = os.path.join(REPO, "BASELINE_PROFILE.json")
+
+
+def capture(from_artifact: bool) -> dict:
+    if from_artifact:
+        with open(CAPTURE_PATH) as f:
+            return json.load(f)
+    sys.path.insert(0, REPO)
+    import bench
+    return bench.profile_microbench(write_artifact=True)
+
+
+def _per_op_best_rates(query_rec: dict) -> dict:
+    """{op: best achieved rate (GB/s or GFLOP/s) on its bottleneck}
+    over a query's ledger rows that have a measured utilization."""
+    out: dict = {}
+    for row in query_rec.get("ledger", []):
+        b = row.get("bottleneck")
+        if b in (None, "host"):
+            continue
+        rate = (row.get("achieved_gflops") if b == "flops"
+                else row.get("achieved_gb_s", {}).get(b))
+        if rate is None:
+            continue
+        op = row.get("op", "?")
+        if rate > out.get(op, 0.0):
+            out[op] = rate
+    return out
+
+
+def _effective_hbm_rate(query_rec: dict):
+    s = query_rec.get("summary", {})
+    secs = s.get("measured_seconds") or 0.0
+    hbm = s.get("cost_totals", {}).get("hbm", 0)
+    return (hbm / secs / 1e9) if secs > 0 and hbm else None
+
+
+def compare(base: dict, cur: dict, tolerance: float) -> list:
+    """List of regression strings (empty = gate passes)."""
+    problems = []
+    for qname, brec in sorted(base.get("queries", {}).items()):
+        crec = cur.get("queries", {}).get(qname)
+        if crec is None:
+            problems.append(f"{qname}: query missing from capture")
+            continue
+        if not crec.get("all_nodes_attributed", False):
+            problems.append(
+                f"{qname}: a plan node has no bottleneck attribution")
+        b_ops = {r.get("op") for r in brec.get("ledger", [])}
+        c_ops = {r.get("op") for r in crec.get("ledger", [])}
+        for op in sorted(b_ops - c_ops):
+            problems.append(
+                f"{qname}: operator {op} vanished from the ledger "
+                "(cost-declaration coverage regression)")
+        b_eff, c_eff = _effective_hbm_rate(brec), _effective_hbm_rate(crec)
+        if b_eff and c_eff is not None and c_eff < b_eff / tolerance:
+            problems.append(
+                f"{qname}: effective HBM rate {c_eff:.4f} GB/s < "
+                f"baseline {b_eff:.4f} / {tolerance:g}")
+        c_rates = _per_op_best_rates(crec)
+        for op, b_rate in sorted(_per_op_best_rates(brec).items()):
+            c_rate = c_rates.get(op)
+            if c_rate is not None and c_rate < b_rate / tolerance:
+                problems.append(
+                    f"{qname}/{op}: achieved {c_rate:.4f} < baseline "
+                    f"{b_rate:.4f} / {tolerance:g}")
+        b_t, c_t = brec.get("time_s"), crec.get("time_s")
+        if b_t and c_t and c_t > b_t * tolerance:
+            problems.append(f"{qname}: time_s {c_t:.3f} > baseline "
+                            f"{b_t:.3f} x {tolerance:g}")
+    # serving SLO phase latencies: per-(phase, priority) p95
+    for phase, by_prio in sorted(base.get("slo", {}).items()):
+        for prio, bh in sorted(by_prio.items()):
+            ch = cur.get("slo", {}).get(phase, {}).get(prio)
+            b95 = (bh or {}).get("p95_s")
+            c95 = (ch or {}).get("p95_s")
+            if ch is None or (ch.get("count", 0) or 0) == 0:
+                continue  # phase not exercised in this capture
+            if b95 and c95 is not None and c95 > b95 * tolerance:
+                problems.append(
+                    f"slo {phase}/p{prio}: p95 {c95:.4f}s > baseline "
+                    f"{b95:.4f}s x {tolerance:g}")
+    # the bench records the honest <5% target in gate_ok; the CI gate
+    # uses a noise-proof ceiling (shared hosts jitter single digits)
+    ovh = cur.get("profiler_overhead", {})
+    pct = ovh.get("overhead_pct")
+    if pct is not None and pct > 15.0:
+        problems.append(
+            f"profiler overhead {pct}% on q1 (>15% CI ceiling; "
+            "target <5%)")
+    return problems
+
+
+def main(argv) -> int:
+    bless = "--bless" in argv
+    from_artifact = "--from-artifact" in argv
+    tolerance = float(os.environ.get("PROFILE_TOLERANCE", 5.0))
+    for flag in ("--tolerance", "-t"):
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 >= len(argv):
+                print(__doc__, file=sys.stderr)
+                return 2
+            tolerance = float(argv[i + 1])
+    cur = capture(from_artifact)
+    if bless:
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(cur, f, indent=1)
+        print(f"blessed: {BASELINE_PATH} updated from "
+              f"{'artifact' if from_artifact else 'fresh capture'} "
+              f"({len(cur.get('queries', {}))} queries)")
+        return 0
+    if not os.path.exists(BASELINE_PATH):
+        print(f"no baseline at {BASELINE_PATH}; run with --bless to "
+              "create one", file=sys.stderr)
+        return 2
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    problems = compare(base, cur, tolerance)
+    if problems:
+        print(f"profile-regression gate FAILED ({len(problems)} "
+              f"problem(s), tolerance {tolerance:g}x):", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        print("intentional change? scripts/profile_regression.py "
+              "--bless updates the baseline", file=sys.stderr)
+        return 1
+    n_ops = sum(len(q.get("ledger", []))
+                for q in cur.get("queries", {}).values())
+    print(f"profile-regression gate OK: {len(cur.get('queries', {}))} "
+          f"queries, {n_ops} ledger rows, tolerance {tolerance:g}x, "
+          f"profiler overhead {cur.get('profiler_overhead', {}).get('overhead_pct')}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

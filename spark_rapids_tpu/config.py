@@ -671,6 +671,51 @@ METRICS_JOURNAL_DIR = _conf(
     ".jsonl trace shard each (docs/monitoring.md, Distributed tracing).",
     str)
 
+# --- roofline-attribution profiler (metrics/roofline.py) ---------------------
+ROOFLINE_ENABLED = _conf(
+    "spark.rapids.sql.tpu.roofline.enabled", True,
+    "Roofline ledger annotations in EXPLAIN METRICS: each plan node's "
+    "line gains its bottleneck resource (hbm / h2d / d2h / wire / flops "
+    "/ host), achieved rate, and utilization vs the resource's peak, "
+    "joined from the operators' cost declarations and measured span "
+    "durations.  The underlying cost COUNTERS (hbmBytesRead/Written, "
+    "h2dBytes, d2hBytes, wireBytes, estFlops) are ordinary MODERATE "
+    "metrics gated by metrics.level, not by this flag.  See "
+    "docs/monitoring.md, 'Reading the roofline ledger'.", _to_bool)
+ROOFLINE_COST_ENABLED = _conf(
+    "spark.rapids.sql.tpu.roofline.costAccounting.enabled", True,
+    "Per-operator roofline cost declarations (hbmBytesRead/Written, "
+    "h2dBytes, d2hBytes, wireBytes, estFlops — free host-side metadata "
+    "increments).  Off disables the declarations entirely (every ledger "
+    "node reads host-bound), which is the A/B the bench profile stage "
+    "and tests/test_roofline.py measure profiler overhead with.  "
+    "Latched per query like the packed-sort flag: the declarations are "
+    "observability-only, so a concurrent query with a different setting "
+    "at worst records (or skips) its own declarations.", _to_bool)
+ROOFLINE_PEAK_HBM = _conf(
+    "spark.rapids.sql.tpu.roofline.peakHbmGBs", 0.0,
+    "HBM bandwidth roofline in GB/s used as the ledger's denominator "
+    "for the 'hbm' resource.  0 (default) picks the platform nominal "
+    "(v5e-class 819 GB/s on TPU, a conservative 20 GB/s on the CPU "
+    "backend).  Set it to a measured STREAM-like figure for honest "
+    "utilization percentages on your hardware.", float)
+ROOFLINE_PEAK_LINK = _conf(
+    "spark.rapids.sql.tpu.roofline.peakLinkGBs", 0.0,
+    "Host<->device link roofline in GB/s ('h2d'/'d2h' resources).  "
+    "0 picks the platform nominal; on a tunneled dev chip the REAL link "
+    "is ~0.026 GB/s — setting this to the measured transfer_microbench "
+    "number makes host-detour nodes light up honestly.", float)
+ROOFLINE_PEAK_WIRE = _conf(
+    "spark.rapids.sql.tpu.roofline.peakWireGBs", 0.0,
+    "Socket shuffle-wire roofline in GB/s ('wire' resource).  0 picks "
+    "1 GB/s (the measured BENCH_WIRE loopback figure); set to your NIC "
+    "line rate on a real cluster.", float)
+ROOFLINE_PEAK_GFLOPS = _conf(
+    "spark.rapids.sql.tpu.roofline.peakGflops", 0.0,
+    "Compute roofline in GFLOP/s ('flops' resource).  0 picks the "
+    "platform nominal (98 TFLOP/s f32-class on TPU, 50 GFLOP/s on the "
+    "CPU backend).", float)
+
 # --- distributed tracing (metrics/timeline.py + shuffle wire trace) ----------
 TRACE_ENABLED = _conf(
     "spark.rapids.sql.tpu.trace.enabled", True,

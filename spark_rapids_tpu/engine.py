@@ -253,9 +253,10 @@ class TpuSession:
                           cluster=self.cluster, journal=qe.journal,
                           query_execution=qe)
         error = None
+        qscope = None
         try:
             with runtime.ledger.query_scope(f"q{qe.query_id}",
-                                            budget_bytes):
+                                            budget_bytes) as qscope:
                 if on_device:
                     # device semaphore: this "task" holds a device slot
                     # for the duration of its device work (reference:
@@ -276,6 +277,26 @@ class TpuSession:
             # orphaned by a mid-write error)
             ctx.run_cleanups()
             self._finish_execution(qe, error)
+            if future is not None:
+                # phase breakdown for the serving SLO histograms
+                # (metrics/slo.py): the scheduler observes these into
+                # the per-priority compile/execute/spill distributions
+                try:
+                    from .metrics import names as MN
+                    agg = qe.aggregate()
+                    # stageCompileTime is NODE-recorded, so the
+                    # aggregate is per-query even under concurrency;
+                    # spill time comes from THIS query's scope (the
+                    # runtime spillTime metric is shared — a delta
+                    # window would absorb concurrent neighbors' spills)
+                    future.compile_seconds = float(
+                        agg.get(MN.STAGE_COMPILE_TIME, 0.0))
+                    future.spill_seconds = float(
+                        qscope.spill_seconds if qscope is not None
+                        else 0.0)
+                    future.exec_seconds = float(qe.duration or 0.0)
+                except Exception:  # noqa: BLE001 — reporting only
+                    pass  # tpulint: disable=TPU006 phase metrics are best-effort; the future's result/error is already set by the caller
         if not tables:
             from .types import to_arrow
             return pa.table({f.name: pa.array([], type=to_arrow(f.dtype))

@@ -18,6 +18,7 @@ from ..metrics import names as MN
 # fold + journal integration); re-exported here because mem/runtime.py and
 # half the test suite import it from exec.base
 from ..metrics.registry import Metrics  # noqa: F401
+from ..metrics.roofline import cost_accounting_enabled
 from ..types import Schema
 
 
@@ -35,6 +36,12 @@ def record_output_batch(metrics: Metrics, batch, runtime=None) -> None:
     * ESSENTIAL: data-dependent row counting skipped entirely (the count
       of a filtered batch would cost device work)."""
     metrics.add(MN.NUM_OUTPUT_BATCHES, 1)
+    # roofline cost declaration (metrics/roofline.py): every produced
+    # batch is HBM the operator wrote.  device_size_bytes is a static
+    # METADATA bound (shapes x dtype widths, never a sync), and the
+    # metric is MODERATE-gated inside add(), so ESSENTIAL pays nothing.
+    if metrics.level >= MN.MODERATE and cost_accounting_enabled():
+        metrics.add(MN.HBM_BYTES_WRITTEN, batch.device_size_bytes())
     if batch.known_rows is not None:  # host-known: free at every level
         metrics.add(MN.NUM_OUTPUT_ROWS, batch.known_rows)
         if metrics.debug_active and runtime is not None:
@@ -47,6 +54,32 @@ def record_output_batch(metrics: Metrics, batch, runtime=None) -> None:
                             runtime.device_store.current_size)
     elif metrics.level >= MN.MODERATE:
         metrics.add_lazy(MN.NUM_OUTPUT_ROWS, batch.num_rows())
+
+
+def record_cost(metrics: Metrics, hbm_read: int = 0, hbm_written: int = 0,
+                h2d: int = 0, d2h: int = 0, wire: int = 0,
+                flops: float = 0) -> None:
+    """Roofline cost declaration for one dispatch (metrics/roofline.py):
+    bytes the operator moved per resource (HBM, host<->device link,
+    socket wire) plus an estimated op count.  All values must be host-
+    known metadata (batch capacities x dtype widths, expression-tree op
+    counts, wire byte totals) — never a device sync.  The ledger joins
+    these against measured span durations to name each plan node's
+    bottleneck resource."""
+    if metrics.level < MN.MODERATE or not cost_accounting_enabled():
+        return
+    if hbm_read:
+        metrics.add(MN.HBM_BYTES_READ, hbm_read)
+    if hbm_written:
+        metrics.add(MN.HBM_BYTES_WRITTEN, hbm_written)
+    if h2d:
+        metrics.add(MN.H2D_BYTES, h2d)
+    if d2h:
+        metrics.add(MN.D2H_BYTES, d2h)
+    if wire:
+        metrics.add(MN.WIRE_BYTES, wire)
+    if flops:
+        metrics.add(MN.EST_FLOPS, flops)
 
 
 class ExecContext:
@@ -65,6 +98,10 @@ class ExecContext:
         from .. import config as _C
         from ..utils import packed_sort as _PS
         _PS.set_packed_enabled(self.conf.get(_C.SORT_PACKED_ENABLED))
+        # roofline cost-accounting latch: same semantics as the packed
+        # flag — observability-only, so cross-query interleaving is safe
+        from ..metrics.roofline import set_cost_accounting
+        set_cost_accounting(self.conf.get(_C.ROOFLINE_COST_ENABLED))
         self.partition_id = partition_id
         self.num_partitions = num_partitions
         self.runtime = runtime  # mem.runtime.TpuRuntime when active

@@ -17,7 +17,7 @@ from typing import Iterator, Optional
 
 from ..columnar import ColumnarBatch, concat_batches
 from ..mem.buffer import SpillPriorities, batch_to_host, host_to_batch
-from .base import CpuExec, ExecContext, ExecNode, TpuExec
+from .base import CpuExec, ExecContext, ExecNode, TpuExec, record_cost
 from .join import TpuHashJoinExec
 from ..metrics import names as MN
 
@@ -52,6 +52,10 @@ class TpuBroadcastExchangeExec(TpuExec):
                 batch = _empty_batch(self.schema)
             leaves, meta = batch_to_host(batch)
         self.metrics.add(MN.DATA_SIZE, meta.size_bytes)
+        # roofline: the broadcast payload left the device (d2h) and is
+        # re-published to every executor over the wire
+        record_cost(self.metrics, d2h=meta.size_bytes,
+                    wire=meta.size_bytes)
         return leaves, meta
 
     def materialize_host(self, ctx: ExecContext):

@@ -26,7 +26,8 @@ from ..shuffle.partition import (hash_partition_ids, range_partition_ids,
                                  round_robin_partition_ids,
                                  sample_range_bounds, single_partition_ids,
                                  split_by_partition)
-from .base import ExecContext, ExecNode, TpuExec, record_output_batch
+from .base import (ExecContext, ExecNode, TpuExec, record_cost,
+                   record_output_batch)
 from ..metrics import names as MN
 
 
@@ -227,6 +228,14 @@ class TpuShuffleExchangeExec(TpuExec):
             h = _ShuffleHandle(sid, n, env=env)
         st = h.stats()
         self.metrics.add(MN.MAP_OUTPUT_BYTES, st.total_bytes)
+        # roofline: the map phase materialized every partition off the
+        # device (d2h) and declared it to the shuffle wire.  Wire
+        # declarations are LOGICAL (uncompressed) bytes on BOTH sides,
+        # like the codec-invariant AQE map stats — under a shuffle codec
+        # the physical traffic is smaller (transport counter
+        # compressed_bytes_sent has the actual figure)
+        record_cost(self.metrics, d2h=st.total_bytes,
+                    wire=st.total_bytes)
         journal_event("stage", "mapStage", shuffle=h.sid, partitions=n,
                       bytes=st.total_bytes, rows=st.total_rows,
                       maps=st.num_map_tasks)
@@ -259,12 +268,26 @@ class TpuShuffleExchangeExec(TpuExec):
             and specs[-1].end == h.num_partitions \
             and all(specs[i].start == specs[i - 1].end
                     for i in range(1, len(specs)))
+        def with_read_cost(pairs):
+            # roofline: every coalesced partition batch came OFF the
+            # shuffle wire and back over the host->device link.
+            # LOGICAL bytes, matching the map side's declaration (see
+            # materialize) — consistent under any shuffle codec
+            for p, out in pairs:
+                if out is not None:
+                    record_cost(self.metrics,
+                                wire=out.device_size_bytes(),
+                                h2d=out.device_size_bytes())
+                yield p, out
+
         try:
             with self.metrics.timer(MN.SHUFFLE_READ_TIME):
                 if async_ok:
-                    yield from self._read_specs_async(ctx, h, specs)
+                    yield from with_read_cost(
+                        self._read_specs_async(ctx, h, specs))
                 else:
-                    yield from self._read_specs_sync(ctx, h, specs)
+                    yield from with_read_cost(
+                        self._read_specs_sync(ctx, h, specs))
         finally:
             h.release()
 

@@ -26,7 +26,8 @@ import numpy as np
 
 from ..columnar import Column, ColumnarBatch, concat_batches
 from ..ops import expressions as E
-from .base import ExecContext, ExecNode, TpuExec, record_output_batch
+from .base import (ExecContext, ExecNode, TpuExec, record_cost,
+                   record_output_batch)
 from ..metrics import names as MN
 
 _I64_MIN = np.int64(-(2**63))
@@ -352,6 +353,12 @@ class TpuSortExec(TpuExec):
             # The reserve marks the sort's working-set boundary.
             if ctx.runtime is not None:
                 ctx.runtime.reserve(b.device_size_bytes(), site="sort")
+            # roofline: a device sort reads the batch and does ~n log n
+            # key comparisons per sort key (metrics/roofline.py)
+            cap = max(2, b.capacity)
+            record_cost(self.metrics, hbm_read=b.device_size_bytes(),
+                        flops=cap * max(1, cap.bit_length())
+                        * max(1, len(self.sort_exprs)))
             out = fn(b)
             if _PACKED_BY_KEY.get((skey, b.capacity)):
                 self.metrics.add(MN.NUM_PACKED_SORTS, 1)

@@ -83,11 +83,17 @@ class QueryScope:
     query's own retry ladder.  One hog spills itself, not its
     neighbors."""
 
-    __slots__ = ("query", "budget")
+    __slots__ = ("query", "budget", "spill_seconds")
 
     def __init__(self, query: str, budget: int = 0):
         self.query = query
         self.budget = max(0, int(budget or 0))
+        # wall seconds THIS query's reservations spent inside
+        # synchronous spill cascades (mem/runtime.py accumulates via the
+        # thread-local scope) — the per-query 'spill' SLO phase; the
+        # shared runtime spillTime metric cannot attribute per query
+        # under concurrency
+        self.spill_seconds = 0.0
 
 
 class MemoryLedger:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 from typing import Optional
 
 from ..columnar import ColumnarBatch
@@ -94,7 +95,20 @@ class DeviceMemoryEventHandler:
         buffer ids this round's synchronous_spill evicted."""
         store_size = self.device_store.current_size
         target = max(0, store_size - alloc_size)
+        # spillTime: the 'spill' phase of the serving SLO histograms and
+        # the roofline ledger's wait-vs-work split.  Also accumulated on
+        # the CALLING thread's query scope — the runtime metric is
+        # shared, so under concurrent serving only the scope can say
+        # WHICH query's reservation paid the cascade.
+        t0 = time.perf_counter()
         spilled = self.device_store.synchronous_spill(target)
+        dt = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.add(MN.SPILL_TIME, dt)
+        if self.ledger is not None:
+            scope = self.ledger.current_query_scope()
+            if scope is not None:
+                scope.spill_seconds += dt
         if self.debug in ("STDOUT", "STDERR"):
             out = sys.stdout if self.debug == "STDOUT" else sys.stderr
             print(f"[tpu-mem] alloc failure of {alloc_size}B: spilled "
@@ -228,8 +242,12 @@ class TpuRuntime:
             if not self.oom_spill:
                 break
             store_size = self.device_store.current_size
-            spilled = self.device_store.synchronous_spill(target,
-                                                          owner=owner)
+            t0 = time.perf_counter()
+            spilled = self.device_store.synchronous_spill(
+                target, owner=owner)
+            dt = time.perf_counter() - t0
+            self.metrics.add(MN.SPILL_TIME, dt)
+            scope.spill_seconds += dt
             extra = self.ledger.on_oom_spill(nbytes, spilled, store_size,
                                              limit=budget,
                                              budget_owner=owner)

@@ -40,7 +40,11 @@ def _prom_type(metric: str) -> str:
 
 
 def _escape(v: str) -> str:
-    return str(v).replace("\\", r"\\").replace('"', r'\"')
+    """Prometheus label-value escaping: backslash, quote, and newline
+    (the exposition-format spec's full escape set — a label value
+    carrying a raw newline would tear the sample line)."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
 
 
 def _sample(name: str, labels: Dict[str, str], value: float) -> str:
@@ -79,35 +83,116 @@ def prometheus_dump(qe) -> str:
     return "\n".join(lines) + "\n"
 
 
-_SAMPLE_RE = re.compile(
-    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)\{([^}]*)\}\s+([^\s]+)$')
-_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_NAME_RE = re.compile(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)')
+_LABEL_NAME_RE = re.compile(r'[a-zA-Z_][a-zA-Z0-9_]*')
+
+
+def _unescape(v: str) -> str:
+    """Inverse of _escape: a single left-to-right scan, so '\\\\n' stays
+    a backslash + n instead of becoming a newline (the ordering bug a
+    chained str.replace inverse has)."""
+    out = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt == "n":
+                out.append("\n")
+            elif nxt in ('"', "\\"):
+                out.append(nxt)
+            else:  # unknown escape: keep verbatim (prometheus behavior)
+                out.append(c)
+                out.append(nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(line: str, pos: int):
+    """Parse a `{k="v",...}` label block starting at line[pos] == '{';
+    returns (labels, index past '}').  Quote-aware, so escaped quotes
+    and literal '}' INSIDE a label value parse correctly — the cases a
+    naive [^}]* regex tears on (histogram le labels are fine either
+    way; operator describe() strings with braces are not)."""
+    labels = []
+    i = pos + 1
+    while True:
+        while i < len(line) and line[i] in ", ":
+            i += 1
+        if i < len(line) and line[i] == "}":
+            return frozenset(labels), i + 1
+        m = _LABEL_NAME_RE.match(line, i)
+        if m is None:
+            raise ValueError(f"malformed prometheus labels: {line!r}")
+        name = m.group(0)
+        i = m.end()
+        if line[i:i + 2] != '="':
+            raise ValueError(f"malformed prometheus labels: {line!r}")
+        i += 2
+        buf = []
+        while i < len(line):
+            c = line[i]
+            if c == "\\" and i + 1 < len(line):
+                buf.append(c + line[i + 1])
+                i += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            i += 1
+        if i >= len(line):
+            raise ValueError(f"unterminated label value: {line!r}")
+        labels.append((name, _unescape("".join(buf))))
+        i += 1  # past the closing quote
 
 
 def parse_prometheus(text: str) -> Dict[Tuple[str, frozenset], float]:
-    """Inverse of prometheus_dump (test helper): {(metric_name,
-    frozenset(label items)): value}.  Raises on malformed sample lines."""
+    """Inverse of prometheus_dump / prometheus_cluster_dump /
+    prometheus_serve_dump: {(metric_name, frozenset(label items)):
+    value}.  Parses everything the dumps emit — label-less samples,
+    histogram `_bucket`/`_sum`/`_count` lines, and escaped label values
+    (quotes, backslashes, newlines, braces) — and raises on malformed
+    sample lines (the property-style round-trip test's contract)."""
     out: Dict[Tuple[str, frozenset], float] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        m = _SAMPLE_RE.match(line)
+        m = _NAME_RE.match(line)
         if m is None:
             raise ValueError(f"malformed prometheus sample: {line!r}")
-        name, labelstr, value = m.groups()
-        labels = frozenset((k, v.replace(r'\"', '"').replace(r"\\", "\\"))
-                           for k, v in _LABEL_RE.findall(labelstr))
-        out[(name, labels)] = float(value)
+        name = m.group(1)
+        i = m.end()
+        if i < len(line) and line[i] == "{":
+            labels, i = _parse_labels(line, i)
+        else:
+            labels = frozenset()
+        value_s = line[i:].strip()
+        if not value_s or " " in value_s:
+            # a timestamp suffix would be a second token; the dumps
+            # never emit one, so treat it as malformed rather than
+            # silently misreading the value
+            raise ValueError(f"malformed prometheus sample: {line!r}")
+        try:
+            value = float(value_s)
+        except ValueError:
+            raise ValueError(f"malformed prometheus sample: {line!r}")
+        out[(name, labels)] = value
     return out
 
 
 # -- cluster-wide aggregation ------------------------------------------------
 
-def cluster_snapshot(cluster) -> Dict[str, dict]:
+def cluster_snapshot(cluster, scheduler=None) -> Dict[str, dict]:
     """{executor_id: {"transport": {...}, "pool": {...}}} pulled from every
     worker: over the control RPC for cluster.ProcCluster, in-process for
-    plugin.TpuCluster."""
+    plugin.TpuCluster.  With a serving-tier `scheduler` attached, a
+    `_serve` entry additionally carries the fair-share observability the
+    PR-10 scheduler implements but never exposed: per-priority-class
+    queue depth and admission/rejection counters."""
     out: Dict[str, dict] = {}
     if hasattr(cluster, "workers"):  # cluster.ProcCluster (rpc path)
         for w in cluster.workers:
@@ -125,11 +210,15 @@ def cluster_snapshot(cluster) -> Dict[str, dict]:
             }
     else:
         raise TypeError(f"not a cluster: {type(cluster).__name__}")
+    if scheduler is not None:
+        out["_serve"] = scheduler.fairness_snapshot()
     return out
 
 
-def prometheus_cluster_dump(cluster) -> str:
-    """Cluster rollup in Prometheus text format with executor labels."""
+def prometheus_cluster_dump(cluster, scheduler=None) -> str:
+    """Cluster rollup in Prometheus text format with executor labels;
+    with a `scheduler`, the serving-tier fairness gauges and per-phase
+    SLO histograms ride along (prometheus_serve_dump)."""
     snap = cluster_snapshot(cluster)
     lines: List[str] = []
     emitted_header = set()
@@ -158,6 +247,57 @@ def prometheus_cluster_dump(cluster) -> str:
                 # suffix on timers) so dashboards key on ONE name
                 emit(prom_name(k)[len(_PREFIX):], labels, v,
                      spec.doc if spec else k, _prom_type(k))
+    body = "\n".join(lines) + "\n"
+    if scheduler is not None:
+        body += prometheus_serve_dump(scheduler)
+    return body
+
+
+# -- serving-tier export (scheduler fairness + SLO histograms) ----------------
+
+def prometheus_serve_dump(scheduler) -> str:
+    """The serving tier in Prometheus text format: per-priority-class
+    queue depth / admitted / rejected (the PR-10 fair-share behavior
+    made observable) plus the per-(phase, priority) latency histograms
+    in the standard `_bucket`/`_sum`/`_count` exposition, which
+    parse_prometheus round-trips."""
+    lines: List[str] = []
+    fair = scheduler.fairness_snapshot()
+
+    def header(pname, help_text, mtype):
+        lines.append(f"# HELP {pname} {help_text}")
+        lines.append(f"# TYPE {pname} {mtype}")
+
+    gauges = (
+        ("serve_queue_depth", "queue_depth_by_priority", "gauge",
+         "queries currently waiting in the scheduler queue"),
+        ("serve_admitted_total", "admitted_by_priority", "counter",
+         "queries admitted for execution"),
+        ("serve_admission_rejections_total", "rejected_by_priority",
+         "counter", "submissions rejected at queue capacity"),
+    )
+    for suffix, field, mtype, help_text in gauges:
+        pname = _PREFIX + suffix
+        header(pname, help_text + " (per priority class)", mtype)
+        by_prio = fair.get(field, {}) or {}
+        if not by_prio:
+            lines.append(_sample(pname, {"priority": "all"}, 0))
+        for prio, v in sorted(by_prio.items()):
+            lines.append(_sample(pname, {"priority": str(prio)}, v))
+
+    slo = getattr(scheduler, "slo", None)
+    if slo is not None:
+        pname = _PREFIX + "serve_phase_seconds"
+        header(pname, "per-query phase latency histogram "
+               "(queue/plan/compile/execute/spill/total per priority "
+               "class; docs/monitoring.md)", "histogram")
+        for (phase, prio), h in sorted(slo.histograms().items()):
+            labels = {"phase": phase, "priority": prio}
+            for le, cum in h.cumulative_buckets():
+                lines.append(_sample(pname + "_bucket",
+                                     {**labels, "le": le}, cum))
+            lines.append(_sample(pname + "_sum", labels, h.sum))
+            lines.append(_sample(pname + "_count", labels, h.count))
     return "\n".join(lines) + "\n"
 
 

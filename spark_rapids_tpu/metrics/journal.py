@@ -68,7 +68,13 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # carries queue time, priority, declared memory need and
                # the plan-cache outcome, journaled into the query's own
                # journal under its trace context
-               "sched")
+               "sched",
+               # cost = a roofline cost declaration (metrics/roofline.py):
+               # a whole-stage program's XLA-HLO-derived flops/bytes (one
+               # instant per executed stage, attrs flops/hbm_bytes/source)
+               # joined offline against the operator spans by the
+               # `python -m spark_rapids_tpu.metrics roofline` report
+               "cost")
 
 
 class EventJournal:

@@ -331,6 +331,46 @@ NUM_BUDGET_OOMS = register_metric(
     "spilling the query's own buffers — the RetryOOM then drives that "
     "query's (and only that query's) retry/split/CPU-fallback ladder")
 
+# --- roofline cost declarations (metrics/roofline.py) ------------------------
+# Every device operator declares the bytes it moves per RESOURCE and an
+# estimated FLOP count; the roofline ledger joins these declarations
+# against measured span durations to compute achieved-vs-peak utilization
+# and name each plan node's bottleneck resource.  All are free host-side
+# increments computed from batch METADATA (capacity/dtype sizes — never a
+# device sync), gated MODERATE because they are only meaningful next to
+# the MODERATE timers they are divided by.
+HBM_BYTES_READ = register_metric(
+    "hbmBytesRead", COUNTER, MODERATE,
+    "declared bytes read from HBM by the operator's device kernels "
+    "(input batch footprints; whole-stage programs use XLA's cost "
+    "analysis on the compiled HLO minus the output share)")
+HBM_BYTES_WRITTEN = register_metric(
+    "hbmBytesWritten", COUNTER, MODERATE,
+    "declared bytes written to HBM (output batch footprints, recorded "
+    "with every record_output_batch)")
+H2D_BYTES = register_metric(
+    "h2dBytes", COUNTER, MODERATE,
+    "bytes moved host->device over the link (scan adoption, shuffle "
+    "read materialization, H2D transitions)")
+D2H_BYTES = register_metric(
+    "d2hBytes", COUNTER, MODERATE,
+    "bytes moved device->host over the link (result materialization, "
+    "CPU-fallback bridges)")
+WIRE_BYTES = register_metric(
+    "wireBytes", COUNTER, MODERATE,
+    "bytes this operator put on (or pulled off) the socket shuffle "
+    "wire — exchange map writes, shuffle reads, broadcast payloads")
+EST_FLOPS = register_metric(
+    "estFlops", COUNTER, MODERATE,
+    "estimated floating/integer operations executed by the operator's "
+    "device kernels; whole-stage programs report XLA's HLO cost "
+    "analysis, other operators an expression-tree estimate x rows")
+SPILL_TIME = register_metric(
+    "spillTime", TIMER, MODERATE,
+    "wall-clock time spent inside synchronous spill cascades (the "
+    "device->host->disk victim migrations an over-budget reservation "
+    "forces) — the 'spill' phase of the serving SLO histograms")
+
 # --- adaptive query execution (adaptive/) -----------------------------------
 NUM_COALESCED_PARTITIONS = register_metric(
     "numCoalescedPartitions", COUNTER, ESSENTIAL,

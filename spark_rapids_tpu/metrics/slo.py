@@ -1,0 +1,140 @@
+"""Serving-tier SLO histograms: per-query phase latencies per priority.
+
+The scheduler (serve/scheduler.py) serves many queries concurrently, so
+"how slow is a query" is a DISTRIBUTION question: a p99 queue wait that
+grows while p50 stays flat means admission pressure, not slow kernels.
+This module keeps one fixed-bucket histogram per (phase, priority class):
+
+  phases:  queue   — submit -> admission (the fair-share wait)
+           plan    — normalization + plan-cache lookup + planning
+           compile — whole-stage trace+compile inside the execution
+                     (stageCompileTime; ~0 on plan-cache hits)
+           execute — the physical execution wall clock
+           spill   — synchronous spill cascades THIS query's
+                     reservations paid (accumulated on its thread-local
+                     memory scope; the shared runtime spillTime metric
+                     cannot attribute per query under concurrency)
+           total   — submit -> result
+
+Buckets are log-spaced powers of two from 0.5ms to ~1000s (22 buckets +
++Inf), so p50/p95/p99 come from bucket interpolation with bounded error
+at every scale; the exact running sum and count ride along, matching
+the Prometheus histogram exposition (`export.prometheus_serve_dump`
+renders `_bucket`/`_sum`/`_count` samples the round-trip tests parse).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+PHASES = ("queue", "plan", "compile", "execute", "spill", "total")
+
+#: log-spaced upper bounds in seconds: 0.5ms * 2^k, k = 0..21 (~1048s)
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(
+    0.0005 * (2 ** k) for k in range(22))
+
+
+class PhaseHistogram:
+    """Fixed-bucket latency histogram (cumulative-bucket Prometheus
+    shape) with interpolated percentiles."""
+
+    __slots__ = ("counts", "count", "sum", "max")
+
+    def __init__(self):
+        self.counts = [0] * (len(BUCKET_BOUNDS) + 1)  # +1 = +Inf
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        seconds = max(0.0, float(seconds))
+        i = 0
+        for i, bound in enumerate(BUCKET_BOUNDS):
+            if seconds <= bound:
+                break
+        else:
+            i = len(BUCKET_BOUNDS)
+        self.counts[i] += 1
+        self.count += 1
+        self.sum += seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Interpolated p-quantile (0 < p <= 1); None when empty."""
+        if self.count == 0:
+            return None
+        rank = p * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            lo = BUCKET_BOUNDS[i - 1] if 0 < i <= len(BUCKET_BOUNDS) \
+                else 0.0
+            hi = BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS) else self.max
+            if seen + c >= rank:
+                frac = (rank - seen) / c
+                return lo + (max(hi, lo) - lo) * frac
+            seen += c
+        return self.max
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "sum_s": round(self.sum, 6),
+            "max_s": round(self.max, 6),
+            "p50_s": _round_opt(self.percentile(0.50)),
+            "p95_s": _round_opt(self.percentile(0.95)),
+            "p99_s": _round_opt(self.percentile(0.99)),
+        }
+
+    def cumulative_buckets(self) -> List[Tuple[str, int]]:
+        """Prometheus-shape cumulative (le, count) pairs, +Inf last."""
+        out = []
+        acc = 0
+        for bound, c in zip(BUCKET_BOUNDS, self.counts):
+            acc += c
+            out.append((repr(round(bound, 6)), acc))
+        out.append(("+Inf", acc + self.counts[-1]))
+        return out
+
+
+def _round_opt(v: Optional[float]) -> Optional[float]:
+    return round(v, 6) if v is not None else None
+
+
+class SloTracker:
+    """Thread-safe registry of (phase, priority-class) histograms — one
+    per QueryScheduler, fed by its worker threads and read by stats()/
+    prometheus/bench."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hist: Dict[Tuple[str, str], PhaseHistogram] = {}
+
+    def observe(self, phase: str, priority: str, seconds: float) -> None:
+        key = (phase, str(priority))
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = PhaseHistogram()
+            h.observe(seconds)
+
+    def observe_phases(self, priority, **phase_seconds) -> None:
+        """Observe several phases of one query at once; None values are
+        skipped (a failed query has no execute figure)."""
+        for phase, seconds in phase_seconds.items():
+            if seconds is not None:
+                self.observe(phase, priority, seconds)
+
+    def histograms(self) -> Dict[Tuple[str, str], PhaseHistogram]:
+        with self._lock:
+            return dict(self._hist)
+
+    def report(self) -> Dict[str, Dict[str, dict]]:
+        """{phase: {priority: {count, sum_s, p50_s, p95_s, p99_s}}} —
+        the SLO block of scheduler.stats() / session_observability."""
+        out: Dict[str, Dict[str, dict]] = {}
+        for (phase, prio), h in sorted(self.histograms().items()):
+            out.setdefault(phase, {})[prio] = h.snapshot()
+        return out

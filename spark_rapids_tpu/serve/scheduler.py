@@ -61,6 +61,13 @@ class QueryFuture:
         self.finished_ns: Optional[int] = None
         self.queue_seconds: Optional[float] = None
         self.plan_seconds: Optional[float] = None
+        # per-phase breakdown of the execution (engine._collect_physical
+        # fills these; the scheduler feeds them into the SLO histograms):
+        # whole-stage trace+compile, synchronous-spill cascades, and the
+        # physical execution wall clock
+        self.compile_seconds: Optional[float] = None
+        self.spill_seconds: Optional[float] = None
+        self.exec_seconds: Optional[float] = None
         self.plan_cache: Optional[str] = None  # "hit" | "miss" | "off"
         self.n_params = 0
         self.query_id: Optional[int] = None
@@ -172,6 +179,15 @@ class QueryScheduler:
         self.rejected = 0
         self.completed = 0
         self.failed = 0
+        # fair-share observability (guarded by self._lock): per-priority
+        # admission/rejection counters behind cluster_snapshot /
+        # prometheus_serve_dump — the PR-10 fairness behavior, observable
+        self.admitted_by_priority: dict = {}
+        self.rejected_by_priority: dict = {}
+        # per-(phase, priority) latency histograms (metrics/slo.py):
+        # queue/plan/compile/execute/spill/total, p50/p95/p99 each
+        from ..metrics.slo import SloTracker
+        self.slo = SloTracker()
         # planning mutates no shared state by design, but logical nodes
         # are shared between submissions of one DataFrame — serialize the
         # (cheap, host-side) planning step rather than audit every pass
@@ -209,6 +225,8 @@ class QueryScheduler:
                 raise RuntimeError("scheduler is shut down")
             if len(self._queue) >= self.queue_capacity:
                 self.rejected += 1
+                self.rejected_by_priority[int(priority)] = \
+                    self.rejected_by_priority.get(int(priority), 0) + 1
                 self._metrics.add(MN.NUM_ADMISSION_REJECTIONS, 1)
                 raise AdmissionRejected(
                     f"queue full ({self.queue_capacity} queries pending); "
@@ -285,6 +303,8 @@ class QueryScheduler:
         self._metrics.add(MN.NUM_ADMITTED, 1)
         with self._lock:
             self.admitted += 1
+            self.admitted_by_priority[item.priority] = \
+                self.admitted_by_priority.get(item.priority, 0) + 1
         session = self.session
         try:
             logical = item.logical
@@ -328,6 +348,18 @@ class QueryScheduler:
             fut._set_error(e)
             with self._lock:
                 self.failed += 1
+        finally:
+            # SLO histograms (metrics/slo.py): per-phase observations
+            # for this query's priority class — success or failure, so
+            # timeouts/errors still move the queue/total percentiles
+            self.slo.observe_phases(
+                item.priority,
+                queue=queue_s,
+                plan=fut.plan_seconds,
+                compile=fut.compile_seconds,
+                execute=fut.exec_seconds,
+                spill=fut.spill_seconds,
+                total=fut.latency_seconds)
 
     # -- lifecycle / observability -------------------------------------------
 
@@ -350,6 +382,32 @@ class QueryScheduler:
             for w in self._workers:
                 w.join(max(0.0, deadline - time.monotonic()))
 
+    def fairness_snapshot(self) -> dict:
+        """Per-priority-class fair-share observability: live queue depth
+        plus cumulative admitted/rejected counters — the block
+        cluster_snapshot/prometheus_serve_dump expose so the PR-10
+        fair-share behavior is observable, not just implemented."""
+        with self._lock:
+            depth: dict = {}
+            for ent in self._queue:
+                p = ent[2].priority
+                depth[p] = depth.get(p, 0) + 1
+            return {
+                "queue_depth_by_priority": dict(sorted(depth.items())),
+                "admitted_by_priority":
+                    dict(sorted(self.admitted_by_priority.items())),
+                "rejected_by_priority":
+                    dict(sorted(self.rejected_by_priority.items())),
+                "running": self._running,
+                "queued": sum(depth.values()),
+            }
+
+    def prometheus(self) -> str:
+        """Serving-tier Prometheus exposition: fairness gauges + the
+        per-phase SLO histograms (export.prometheus_serve_dump)."""
+        from ..metrics.export import prometheus_serve_dump
+        return prometheus_serve_dump(self)
+
     def stats(self) -> dict:
         with self._lock:
             out = {
@@ -367,4 +425,6 @@ class QueryScheduler:
             }
         if self.plan_cache is not None:
             out["plan_cache"] = self.plan_cache.stats()
+        out["fairness"] = self.fairness_snapshot()
+        out["slo"] = self.slo.report()
         return out

@@ -36,6 +36,13 @@ _CACHE_LOCK = threading.Lock()
 _STAGE_EXECUTABLES: "OrderedDict[tuple, Callable]" = OrderedDict()
 _STAGE_EXECUTABLES_MAX = 512
 
+# XLA cost analysis of each compiled whole-stage program, keyed like
+# _STAGE_EXECUTABLES (pruned with it): {"flops": float, "bytes": float,
+# "source": "hlo"} — the roofline ledger's per-stage cost declaration
+# (metrics/roofline.py).  Empty dict when the AOT path (and therefore
+# Compiled.cost_analysis) was unavailable for the program.
+_STAGE_COSTS: Dict[tuple, dict] = {}
+
 # process-wide counters bench.py's fusion/serve stages read (stats()):
 # builds = distinct jitted programs constructed through cached_kernel,
 # stage_compiles = AOT whole-stage programs compiled,
@@ -102,6 +109,7 @@ def stage_executable(key: tuple, builder: Callable[[], Callable],
             _STAGE_EXECUTABLES.move_to_end(k)
             _COUNTERS["stage_hits"] += 1
             return fn
+    aot = True
     from ..metrics import names as MN
     from ..metrics.journal import journal_event
     timer = (metrics.timer(MN.STAGE_COMPILE_TIME) if metrics is not None
@@ -126,10 +134,12 @@ def stage_executable(key: tuple, builder: Callable[[], Callable],
         # function is the executable (compile happens on first call,
         # folded into the timer by the caller's first dispatch)
         fn = jfn
+        aot = False
         t_traced = t_lowered = t_compiled = time.perf_counter()
     finally:
         if timer is not None:
             timer.__exit__(None, None, None)
+    cost = _extract_cost_analysis(fn) if aot else {}
     with _CACHE_LOCK:
         _COUNTERS["stage_compiles"] += 1
     if metrics is not None:
@@ -138,17 +148,56 @@ def stage_executable(key: tuple, builder: Callable[[], Callable],
                   trace_s=round(t_lowered - t0, 6),
                   compile_s=round(t_compiled - t_lowered, 6),
                   trace_only_s=round(t_traced - t0, 6),
-                  signature_leaves=len(k[1]))
+                  signature_leaves=len(k[1]),
+                  **({"hlo_flops": cost["flops"],
+                      "hlo_bytes": cost["bytes"]} if cost else {}))
     with _CACHE_LOCK:
         _STAGE_EXECUTABLES[k] = fn
+        _STAGE_COSTS[k] = cost
         while len(_STAGE_EXECUTABLES) > _STAGE_EXECUTABLES_MAX:
-            _STAGE_EXECUTABLES.popitem(last=False)
+            old, _ = _STAGE_EXECUTABLES.popitem(last=False)
+            _STAGE_COSTS.pop(old, None)
     return fn
+
+
+def _extract_cost_analysis(compiled) -> dict:
+    """XLA's cost analysis of a Compiled program, normalized to
+    {"flops", "bytes", "source"} (metrics/roofline.py consumes this as
+    the whole-stage cost declaration).  Returns {} when the backend does
+    not expose the analysis — callers fall back to the declared
+    batch-footprint estimate."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not ca:
+            return {}
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+        if flops <= 0.0 and nbytes <= 0.0:
+            return {}
+        return {"flops": flops, "bytes": nbytes, "source": "hlo"}
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        return {}
+
+
+def stage_cost(key: tuple, args: tuple,
+               donate_argnums: tuple = ()) -> dict:
+    """The XLA cost analysis recorded when stage_executable compiled the
+    program for (key, signature-of-args) — same key mangling, so a caller
+    that just dispatched can attribute the dispatch's HLO-derived cost.
+    {} when unknown (evicted, AOT-less backend, never compiled)."""
+    if donate_argnums:
+        key = key + ("donate", tuple(donate_argnums))
+    k = (key, input_signature(args))
+    with _CACHE_LOCK:
+        return _STAGE_COSTS.get(k, {})
 
 
 def clear_stage_executables() -> None:
     with _CACHE_LOCK:
         _STAGE_EXECUTABLES.clear()
+        _STAGE_COSTS.clear()
 
 
 # --- plan-cache parameter keying --------------------------------------------
@@ -251,3 +300,4 @@ def clear():
     with _CACHE_LOCK:
         _CACHE.clear()
         _STAGE_EXECUTABLES.clear()
+        _STAGE_COSTS.clear()
