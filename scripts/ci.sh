@@ -153,6 +153,21 @@ python -m pytest tests/test_roofline.py -q -m "not slow" \
     -p no:cacheprovider
 echo "== roofline tier took $((SECONDS - T_ROOF))s =="
 
+echo "== mesh exchange tier =="
+# mesh-native ICI shuffle (ISSUE 14): the generic exchange lowered into
+# jitted shard_map collectives must be bit-for-bit with the socket tier
+# across partitioning modes and the dtype surface, produce IDENTICAL
+# AQE map statistics, survive injectOom at every collective reserve
+# site, and de-lower to the socket tier on exhaustion.  The forced
+# host-device count makes the 4-device meshes real even outside the
+# conftest (tests force 8 virtual CPU devices themselves; the explicit
+# XLA_FLAGS keeps this tier honest if run standalone).
+T_MESH=$SECONDS
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_mesh_exchange.py -q -m "not slow" \
+    -p no:cacheprovider
+echo "== mesh exchange tier took $((SECONDS - T_MESH))s =="
+
 echo "== pallas/donation tier =="
 # on-chip kernels + buffer donation (ISSUE 11): interpret-mode pallas
 # kernel tests (fused segmented aggregation, tiled bitonic sort, the

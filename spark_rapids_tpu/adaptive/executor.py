@@ -54,6 +54,15 @@ class TpuAdaptivePlanExec(TpuExec):
         if self._replanned or not ctx.conf.get(C.ADAPTIVE_ENABLED):
             return self.children[0]
         new_root = self._adapt(self.children[0], ctx)
+        # ICI-lowering idempotence: exchanges the rules created (a
+        # demoted broadcast's replacement repartition) must get the same
+        # mesh-vs-socket decision as planner-built ones — re-run the
+        # (idempotent) marking pass over the re-planned tree
+        from ..exec.distributed import resolve_mesh
+        mesh = resolve_mesh(ctx.conf)
+        if mesh is not None:
+            from ..plan.transitions import mark_ici_exchanges
+            mark_ici_exchanges(new_root, mesh)
         if ctx.conf.get(C.FUSION_ENABLED):
             # re-planned reduce sides fuse too: the pass is idempotent on
             # already-fused subtrees (identity preserved, plan/fusion.py),

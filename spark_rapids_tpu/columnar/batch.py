@@ -266,6 +266,30 @@ class ColumnarBatch:
                 f"schema={self.schema!r})")
 
 
+def _normalize_devices(batches: Sequence[ColumnarBatch]
+                       ) -> Sequence[ColumnarBatch]:
+    """Move single-device batches committed to DIFFERENT devices onto
+    one device before eager concatenation: the mesh shuffle tier serves
+    reduce partition p as device p's shard of the exchanged chunks
+    (shuffle/mesh_exchange.py), so a coalesced read or a chunk staging
+    that concatenates across partitions mixes committed devices — which
+    eager dynamic_update_slice rejects.  device_put is jax's TRANSFER
+    path (D2D over ICI on a real mesh; bit-exact, unlike cross-shard
+    eager compute).  Mesh-SHARDED (multi-device) inputs are left
+    untouched — re-placing a global array would gather it."""
+    devs = []
+    for b in batches:
+        d = getattr(b.sel, "devices", None)
+        devs.append(d() if callable(d) else None)
+    if any(d is None or len(d) != 1 for d in devs):
+        return batches  # tracers / host arrays / sharded globals
+    if len(set().union(*devs)) <= 1:
+        return batches  # already co-located (the common case)
+    target = next(iter(devs[0]))
+    return [b if devs[i] == {target} else jax.device_put(b, target)
+            for i, b in enumerate(batches)]
+
+
 def concat_batches(batches: Sequence[ColumnarBatch],
                    capacity: Optional[int] = None) -> ColumnarBatch:
     """Concatenate batches (the coalesce primitive; reference:
@@ -275,6 +299,7 @@ def concat_batches(batches: Sequence[ColumnarBatch],
     bucket of the sum of capacities (or caller-provided)."""
     assert batches, "concat of nothing"
     schema = batches[0].schema
+    batches = _normalize_devices(batches)
     compacted = [b.compact() for b in batches]
     counts = [b.num_rows_host() for b in compacted]
     total = sum(counts)

@@ -330,6 +330,18 @@ MESH_USE_ALLGATHER = _conf(
     "Use the sel-mask all-gather exchange instead of the compact quota "
     "all-to-all in distributed operators (zero overflow risk, O(n) cost; "
     "debugging/safety knob).", _to_bool)
+ICI_SHUFFLE_ENABLED = _conf(
+    "spark.rapids.sql.tpu.shuffle.ici.enabled", True,
+    "Lower generic shuffle exchanges (TpuShuffleExchangeExec) into jitted "
+    "ICI collectives when the exchange's producer and consumer partitions "
+    "are co-resident on one device mesh (mesh.devices > 1, single "
+    "process, hash/round_robin/single partitioning): the fused chain, "
+    "partition-id compute and the all-to-all compile into ONE program and "
+    "the data never leaves HBM.  Off (or off-mesh: a cluster, a range "
+    "exchange, too few devices) the exchange takes the host socket tier "
+    "byte-identically to the pre-mesh behavior; RetryExhausted inside the "
+    "collective also de-lowers to the socket tier (counted in the "
+    "transport's socket_fallbacks).", _to_bool)
 MESH_INPUT_CHUNK_ROWS = _conf(
     "spark.rapids.sql.tpu.mesh.inputChunkRows", 1 << 20,
     "Row budget per SPMD input chunk.  Distributed aggregate/join STREAM "
@@ -715,6 +727,13 @@ ROOFLINE_PEAK_GFLOPS = _conf(
     "Compute roofline in GFLOP/s ('flops' resource).  0 picks the "
     "platform nominal (98 TFLOP/s f32-class on TPU, 50 GFLOP/s on the "
     "CPU backend).", float)
+ROOFLINE_PEAK_ICI = _conf(
+    "spark.rapids.sql.tpu.roofline.peakIciGBs", 0.0,
+    "Inter-chip-interconnect roofline in GB/s ('ici' resource): the "
+    "denominator for bytes moved by mesh-lowered exchange collectives "
+    "(iciBytesMoved).  0 picks the platform nominal (v5e-class ~100 GB/s "
+    "per-chip on TPU; memcpy-class 20 GB/s on the virtual-device CPU "
+    "backend, where the 'collective' is a compiled copy).", float)
 
 # --- distributed tracing (metrics/timeline.py + shuffle wire trace) ----------
 TRACE_ENABLED = _conf(

@@ -57,7 +57,7 @@ def record_output_batch(metrics: Metrics, batch, runtime=None) -> None:
 
 
 def record_cost(metrics: Metrics, hbm_read: int = 0, hbm_written: int = 0,
-                h2d: int = 0, d2h: int = 0, wire: int = 0,
+                h2d: int = 0, d2h: int = 0, wire: int = 0, ici: int = 0,
                 flops: float = 0) -> None:
     """Roofline cost declaration for one dispatch (metrics/roofline.py):
     bytes the operator moved per resource (HBM, host<->device link,
@@ -78,6 +78,8 @@ def record_cost(metrics: Metrics, hbm_read: int = 0, hbm_written: int = 0,
         metrics.add(MN.D2H_BYTES, d2h)
     if wire:
         metrics.add(MN.WIRE_BYTES, wire)
+    if ici:
+        metrics.add(MN.ICI_BYTES_MOVED, ici)
     if flops:
         metrics.add(MN.EST_FLOPS, flops)
 

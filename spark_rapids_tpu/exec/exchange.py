@@ -123,6 +123,11 @@ class TpuShuffleExchangeExec(TpuExec):
         self.ascending = ascending or [True] * len(self.keys)
         self.nulls_first = nulls_first or [True] * len(self.keys)
         self._handle: Optional[_ShuffleHandle] = None
+        # the device mesh the planner's distribute pass stamped for the
+        # ICI lowering (plan/transitions.mark_ici_exchanges), or None —
+        # shuffle/mesh_exchange.ici_mesh_for re-resolves from conf for
+        # exchanges AQE rules create after planning
+        self.ici_mesh = None
 
     @property
     def schema(self):
@@ -202,13 +207,40 @@ class TpuShuffleExchangeExec(TpuExec):
             return self._handle
         from ..metrics.journal import journal_event
         n = self.num_partitions
+        replay_batches = None
+        from ..shuffle import mesh_exchange as MX
+        mesh = MX.ici_mesh_for(self, ctx)
+        if mesh is not None:
+            if ctx.runtime is None:
+                from ..mem.runtime import TpuRuntime
+                ctx.runtime = TpuRuntime(ctx.conf)
+            h, replay_batches = MX.lower_exchange(self, ctx, mesh)
+            if h is not None:
+                st = h.stats()
+                self.metrics.add(MN.MAP_OUTPUT_BYTES, st.total_bytes)
+                self.metrics.add(MN.NUM_ICI_EXCHANGES, 1)
+                # roofline: the map phase moved every partition through
+                # the mesh collectives — LOGICAL bytes on the 'ici'
+                # resource (codec-invariant like the AQE map stats);
+                # nothing touched the host link or the socket wire
+                record_cost(self.metrics, ici=st.total_bytes)
+                ctx.add_cleanup(h.release)
+                journal_event("stage", "mapStage", shuffle=h.sid,
+                              partitions=n, bytes=st.total_bytes,
+                              rows=st.total_rows, maps=st.num_map_tasks,
+                              tier="ici")
+                self._handle = h
+                return h
+            # collective ladder exhausted: de-lowered — the socket tier
+            # below replays the already-drained child batches
         if ctx.cluster is not None:
             cluster = ctx.cluster
             sid = cluster.new_shuffle_id()
             ctx.add_cleanup(lambda: cluster.remove_shuffle(sid))
             self._write_phase(ctx, n, lambda map_id, p, sub:
                               cluster.env_for(map_id).write_partition(
-                                  sid, map_id, p, sub))
+                                  sid, map_id, p, sub),
+                              batches=replay_batches)
             h = _ShuffleHandle(sid, n, cluster=cluster)
         else:
             env = get_shuffle_env(ctx.runtime, ctx.conf) \
@@ -224,7 +256,8 @@ class TpuShuffleExchangeExec(TpuExec):
             # scope
             ctx.add_cleanup(lambda: env.remove_shuffle(sid))
             self._write_phase(ctx, n, lambda map_id, p, sub:
-                              env.write_partition(sid, map_id, p, sub))
+                              env.write_partition(sid, map_id, p, sub),
+                              batches=replay_batches)
             h = _ShuffleHandle(sid, n, env=env)
         st = h.stats()
         self.metrics.add(MN.MAP_OUTPUT_BYTES, st.total_bytes)
@@ -238,7 +271,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     wire=st.total_bytes)
         journal_event("stage", "mapStage", shuffle=h.sid, partitions=n,
                       bytes=st.total_bytes, rows=st.total_rows,
-                      maps=st.num_map_tasks)
+                      maps=st.num_map_tasks, tier="socket")
         self._handle = h
         return h
 
@@ -262,22 +295,30 @@ class TpuShuffleExchangeExec(TpuExec):
         # them back into specs needs contiguous coalesced ranges covering
         # [0, n) — exactly what the coalesce rule produces (skew slices
         # re-read partitions, so they stay on the sync path)
-        async_ok = ctx.conf.get(SHUFFLE_ASYNC_FETCH) \
+        is_mesh = getattr(h, "is_mesh", False)
+        async_ok = not is_mesh \
+            and ctx.conf.get(SHUFFLE_ASYNC_FETCH) \
             and all(isinstance(s, CoalescedPartitionSpec) for s in specs) \
             and specs and specs[0].start == 0 \
             and specs[-1].end == h.num_partitions \
             and all(specs[i].start == specs[i - 1].end
                     for i in range(1, len(specs)))
         def with_read_cost(pairs):
-            # roofline: every coalesced partition batch came OFF the
-            # shuffle wire and back over the host->device link.
-            # LOGICAL bytes, matching the map side's declaration (see
-            # materialize) — consistent under any shuffle codec
+            # roofline: on the socket tier every coalesced partition
+            # batch came OFF the shuffle wire and back over the
+            # host->device link; on the mesh tier the read is a device-
+            # local split of the exchanged chunks (HBM only — the
+            # movement itself was declared as 'ici' at materialize).
+            # LOGICAL bytes either way — consistent under any codec
             for p, out in pairs:
                 if out is not None:
-                    record_cost(self.metrics,
-                                wire=out.device_size_bytes(),
-                                h2d=out.device_size_bytes())
+                    if is_mesh:
+                        record_cost(self.metrics,
+                                    hbm_read=out.device_size_bytes())
+                    else:
+                        record_cost(self.metrics,
+                                    wire=out.device_size_bytes(),
+                                    h2d=out.device_size_bytes())
                 yield p, out
 
         try:
@@ -387,7 +428,8 @@ class TpuShuffleExchangeExec(TpuExec):
             return fn_p
         return build
 
-    def _write_phase(self, ctx: ExecContext, n: int, write) -> None:
+    def _write_phase(self, ctx: ExecContext, n: int, write,
+                     batches=None) -> None:
         """Shared write side: drain the child, compute partition ids, split,
         hand each piece to `write(map_id, p, sub)`.  Range mode samples
         bounds over a materialized list, then DROPS each batch reference as
@@ -397,9 +439,16 @@ class TpuShuffleExchangeExec(TpuExec):
         When the child is a fused whole-stage (plan/fusion.py), the
         row-local chain and the partition-id compute run as ONE compiled
         program over the stage's SOURCE batches (the bucketing step joins
-        the stage instead of dispatching per operator)."""
+        the stage instead of dispatching per operator).
+
+        `batches` replays a pre-drained child output instead of
+        re-executing the child — the mesh tier's de-lower path hands its
+        already-consumed source iterator back here (same batch sequence:
+        both tiers drain the fused stage's SOURCE when one is present)."""
         fused_stage = self._fused_stage_child(ctx)
-        if fused_stage is not None:
+        if batches is not None:
+            child_batches = batches
+        elif fused_stage is not None:
             child_batches = fused_stage.children[0].execute(ctx)
         else:
             child_batches = self.children[0].execute(ctx)

@@ -21,7 +21,11 @@ where `builder` is a local def returning the traced function).  Pallas
 kernel bodies are traced the same way, so `pl.pallas_call(kernel, ...)`
 and `pl.pallas_call(make_kernel(...), ...)` resolve too (the kernel def
 may live at module scope — kernels usually do), keeping new hand-written
-kernels linted instead of baselined.
+kernels linted instead of baselined.  `shard_map(step, mesh=...)`
+program bodies — the SPMD collective programs of parallel/distributed.py
+and the mesh-exchange lowering — are jit sinks exactly the same way
+(every shard_map here is wrapped in jit/stage_executable before
+dispatch), so collective kernels are linted, not baselined.
 """
 from __future__ import annotations
 
@@ -140,6 +144,8 @@ class JitPurityPass(LintPass):
                     arg_ix = 0
                 elif tail == "pallas_call":
                     arg_ix = 0  # pl.pallas_call(kernel_or_builder(), ...)
+                elif tail == "shard_map":
+                    arg_ix = 0  # shard_map(step, mesh=..., in_specs=...)
                 elif tail in ("cached_kernel", "stage_executable"):
                     arg_ix = 1
                 if arg_ix is not None and len(node.args) > arg_ix:

@@ -27,6 +27,16 @@ interpreter will not enforce:
 Kernel bodies are resolved like TPU002 resolves jit sinks: the first
 pallas_call argument as a local/module def, or a maker call
 (`_make_seg_agg_kernel(ops)`) whose returned inner defs are the kernels.
+
+`shard_map(step, mesh=...)` COLLECTIVE program bodies (the SPMD
+operators of parallel/distributed.py and the mesh-exchange lowering)
+resolve the same way and get the host-sync/impure-call half of the
+kernel checks: a collective program is compiled and replayed exactly
+like a kernel, so a `.item()`/`np.asarray`/`time.*` inside one bakes a
+trace-time value into every dispatch.  The 64-bit and tile rules do NOT
+apply to them — shard_map bodies legitimately compute in int64/float64
+on the row-sharded columns (XLA lowers them; only hand-written Mosaic
+kernels carry the 32-bit constraint).
 """
 from __future__ import annotations
 
@@ -92,7 +102,20 @@ class PallasContractsPass(LintPass):
         seen_kernels: Set[int] = set()
         for call in U.walk_calls(ctx.tree):
             name = U.call_name(call) or ""
-            if name.rsplit(".", 1)[-1] != "pallas_call":
+            tail = name.rsplit(".", 1)[-1]
+            if tail == "shard_map":
+                # collective program body: host-sync/impure checks only
+                # (module docstring — no 64-bit/tile rules here)
+                if call.args:
+                    for kern in self._resolve_kernels(ctx, call.args[0],
+                                                      module_defs):
+                        if id(kern) in seen_kernels:
+                            continue
+                        seen_kernels.add(id(kern))
+                        yield from self._check_kernel(
+                            ctx, kern, collective=True)
+                continue
+            if tail != "pallas_call":
                 continue
             yield from self._check_specs(ctx, call, consts)
             if not call.args:
@@ -138,14 +161,17 @@ class PallasContractsPass(LintPass):
 
     # -- kernel-body checks --------------------------------------------------
 
-    def _check_kernel(self, ctx: FileContext,
-                      kern: ast.AST) -> Iterable[Finding]:
+    def _check_kernel(self, ctx: FileContext, kern: ast.AST,
+                      collective: bool = False) -> Iterable[Finding]:
+        kind = "shard_map program" if collective else "pallas kernel"
         label = getattr(kern, "name", "<lambda>")
         body = kern.body if isinstance(kern.body, list) else [kern.body]
         for stmt in body:
             for node in ast.walk(stmt):
                 # 64-bit ops (emulated on-chip) outside is_count widening
-                if isinstance(node, (ast.Attribute, ast.Name)):
+                # — Mosaic kernels only; shard_map bodies lower via XLA
+                if not collective \
+                        and isinstance(node, (ast.Attribute, ast.Name)):
                     dn = U.dotted_name(node) or ""
                     tail = dn.rsplit(".", 1)[-1]
                     if tail in ("int64", "uint64", "float64") \
@@ -170,8 +196,8 @@ class PallasContractsPass(LintPass):
                             node.func, ast.Attribute) else "?")
                         yield Finding(
                             self.rule_id, ctx.rel_path, node.lineno,
-                            f"host-sync call {sync}() inside pallas "
-                            f"kernel {label!r}: kernels run on-chip "
+                            f"host-sync call {sync}() inside {kind} "
+                            f"{label!r}: the body runs on-chip "
                             "with no host round trip — this fails to "
                             "lower (or silently traces)",
                             span_end=U.span_end(node))
@@ -180,10 +206,10 @@ class PallasContractsPass(LintPass):
                             for p in _IMPURE_PREFIXES):
                         yield Finding(
                             self.rule_id, ctx.rel_path, node.lineno,
-                            f"impure call {name}() inside pallas kernel "
+                            f"impure call {name}() inside {kind} "
                             f"{label!r}: executes at trace time only "
                             "and bakes its value into the compiled "
-                            "kernel",
+                            "program",
                             span_end=U.span_end(node))
 
     @staticmethod

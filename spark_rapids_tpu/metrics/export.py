@@ -332,6 +332,16 @@ def session_observability(session) -> dict:
         out["numBufferRespills"] = int(
             pool.get(N.NUM_BUFFER_RESPILLS, 0))
         out["memLedgerEvents"] = int(pool.get(N.MEM_LEDGER_EVENTS, 0))
+    # shuffle tier selection (ISSUE 14): how many exchanges the mesh
+    # tier served as jitted ICI collectives vs de-lowered to the socket
+    # tier — read from the session transport's counters (shuffle/ici.py)
+    rt = session._runtime
+    env = getattr(rt, "_shuffle_env", None) if rt is not None else None
+    tcounters = getattr(getattr(env, "transport", None), "counters", {}) \
+        if env is not None else {}
+    out["ici_exchanges"] = int(tcounters.get("ici_exchanges", 0))
+    out["socket_fallbacks"] = int(tcounters.get("socket_fallbacks", 0))
+    out["numIciExchanges"] = int(totals.get(N.NUM_ICI_EXCHANGES, 0))
     cluster = getattr(session, "_cluster", None) or None
     wire_sent = wire_recv = 0
     if cluster:

@@ -12,7 +12,8 @@ Record schema (one JSON object per line):
   ts     monotonic nanoseconds (time.monotonic_ns; per-process clock)
   ev     "B" (span begin) | "E" (span end) | "I" (instant event)
   kind   query|stage|operator|retry|spill|fetch|metric|fallback|replan|
-         corruption|refetch|recompute|compress
+         corruption|refetch|recompute|compress|compile|collective|...
+         (EVENT_KINDS below is the authoritative list)
   name   human label (operator describe(), retry block name, ...)
   id     span/event id, unique within the journal, increasing
   parent parent span id or null (operator spans parent to the enclosing
@@ -49,6 +50,11 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # (stage, batch-shape) pair, with the trace-vs-compile
                # time split (exec/whole_stage.py stage_executable)
                "compile",
+               # collective = one mesh-exchange collective dispatch (the
+               # compiled shard_map all-to-all of a lowered shuffle
+               # exchange; attrs shuffle/map/devices/quota) — the mesh
+               # tier's twin of the socket tier's fetch/serve spans
+               "collective",
                # distributed tracing (metrics/timeline.py):
                # task = one map/reduce fragment executed on a worker
                # (attrs query/stage/executor), serve = this process served

@@ -131,6 +131,16 @@ DISTRIBUTED_JOIN_TIME = register_metric(
     "distributedJoinTime", TIMER, MODERATE, "SPMD distributed join time")
 DISTRIBUTED_SORT_TIME = register_metric(
     "distributedSortTime", TIMER, MODERATE, "SPMD distributed sort time")
+NUM_ICI_EXCHANGES = register_metric(
+    "numIciExchanges", COUNTER, ESSENTIAL,
+    "generic shuffle exchanges lowered into jitted ICI collectives over "
+    "the device mesh (shuffle/mesh_exchange.py): chain + partition-id "
+    "compute + all-to-all as one compiled program, data never leaving "
+    "HBM.  The socket tier's exchanges do not count here")
+COLLECTIVE_TIME = register_metric(
+    "collectiveTime", TIMER, MODERATE,
+    "wall-clock time inside mesh-exchange collective dispatches (the "
+    "compiled shard_map all-to-all programs, overflow retries included)")
 SEMAPHORE_WAIT_TIME = register_metric(
     "semaphoreWaitTime", TIMER, MODERATE,
     "time blocked acquiring the device task semaphore")
@@ -360,6 +370,12 @@ WIRE_BYTES = register_metric(
     "wireBytes", COUNTER, MODERATE,
     "bytes this operator put on (or pulled off) the socket shuffle "
     "wire — exchange map writes, shuffle reads, broadcast payloads")
+ICI_BYTES_MOVED = register_metric(
+    "iciBytesMoved", COUNTER, MODERATE,
+    "LOGICAL bytes routed through mesh-exchange collectives (the 'ici' "
+    "roofline resource) — the same codec-invariant figure the AQE map "
+    "statistics carry, so the mesh and socket tiers declare comparable "
+    "data movement for the same exchange")
 EST_FLOPS = register_metric(
     "estFlops", COUNTER, MODERATE,
     "estimated floating/integer operations executed by the operator's "
@@ -436,7 +452,8 @@ NUM_EXPORT_SCRAPE_ERRORS = register_metric(
 # site emits `<block>Retries` / `<block>Splits` (mem/retry.py with_retry)
 RETRY_BLOCKS = ("sort", "aggUpdate", "aggMerge", "joinBuild", "joinProbe",
                 "exchangePartition", "exchangeWrite", "exchangeFetch",
-                "wholeStage", "wholeStageOp", "retryBlock")
+                "exchangeCollective", "wholeStage", "wholeStageOp",
+                "retryBlock")
 for _b in RETRY_BLOCKS:
     register_metric(f"{_b}Retries", COUNTER, ESSENTIAL,
                     f"same-size OOM retries of the {_b} retryable block")
@@ -480,6 +497,12 @@ TRANSPORT_COUNTERS = {
                                  "pre-decompress)",
     "compression_fallbacks": "fetches the peer answered RAW after this "
                              "side requested a codec it could not serve",
+    "ici_exchanges": "shuffle exchanges served by the mesh tier (jitted "
+                     "ICI collectives; no bytes touched this transport's "
+                     "wire for them)",
+    "socket_fallbacks": "mesh-eligible exchanges de-lowered to the "
+                        "socket tier (collective retry ladder exhausted; "
+                        "results identical, movement paid on the wire)",
 }
 
 # --- runtime pool gauges (mem/runtime.py pool_stats()) ----------------------

@@ -45,7 +45,7 @@ from . import names as N
 
 #: resources a cost declaration can name; "host" is the fallback
 #: bottleneck label for nodes that declare no device cost at all
-RESOURCES = ("hbm", "h2d", "d2h", "wire", "flops")
+RESOURCES = ("hbm", "h2d", "d2h", "wire", "ici", "flops")
 HOST = "host"
 
 #: resource -> the catalog metric names whose sum is its declared cost
@@ -54,6 +54,7 @@ COST_METRICS: Dict[str, Tuple[str, ...]] = {
     "h2d": (N.H2D_BYTES,),
     "d2h": (N.D2H_BYTES,),
     "wire": (N.WIRE_BYTES,),
+    "ici": (N.ICI_BYTES_MOVED,),
     "flops": (N.EST_FLOPS,),
 }
 
@@ -83,9 +84,9 @@ def cost_accounting_enabled() -> bool:
 # the absolute utilization percentages are only as good as the peaks.
 _PLATFORM_PEAKS: Dict[str, Dict[str, float]] = {
     "tpu": {"hbm": 819e9, "h2d": 8e9, "d2h": 8e9, "wire": 1e9,
-            "flops": 98e12},
+            "ici": 100e9, "flops": 98e12},
     "cpu": {"hbm": 20e9, "h2d": 20e9, "d2h": 20e9, "wire": 1e9,
-            "flops": 50e9},
+            "ici": 20e9, "flops": 50e9},
 }
 
 
@@ -117,6 +118,7 @@ def platform_peaks(platform: Optional[str] = None,
             "h2d": float(conf.get(C.ROOFLINE_PEAK_LINK)) * 1e9,
             "d2h": float(conf.get(C.ROOFLINE_PEAK_LINK)) * 1e9,
             "wire": float(conf.get(C.ROOFLINE_PEAK_WIRE)) * 1e9,
+            "ici": float(conf.get(C.ROOFLINE_PEAK_ICI)) * 1e9,
             "flops": float(conf.get(C.ROOFLINE_PEAK_GFLOPS)) * 1e9,
         }
         for r, v in overrides.items():

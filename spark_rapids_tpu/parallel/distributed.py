@@ -79,8 +79,19 @@ def exchange_compact(batch: ColumnarBatch, bucket, quota: int,
     cap = batch.capacity
     live = batch.sel
     dest = jnp.where(live, bucket.astype(jnp.int32), n)
-    # group rows by destination (stable: preserves row order within a dest)
-    order = jnp.argsort(dest, stable=True).astype(jnp.int32)
+    # group rows by destination (stable: preserves row order within a
+    # dest).  Packed single-operand sort when the capacity allows it:
+    # jnp.argsort is a VARIADIC sort HLO (operand + iota) costing ~6x a
+    # single-operand sort on the CPU/TPU sort path (utils/packed_sort,
+    # PR-11 measurement), and this sort runs inside EVERY quota-block
+    # exchange dispatch — the permutation is bit-identical either way
+    from ..utils import packed_sort as PS
+    if PS.packed_enabled() and cap & (cap - 1) == 0:
+        order = PS.packed_argsort(
+            [(dest.astype(jnp.uint64), max(1, int(n).bit_length() + 1))],
+            cap)
+    else:
+        order = jnp.argsort(dest, stable=True).astype(jnp.int32)
     dsorted = jnp.take(dest, order)
     start_of = jnp.searchsorted(dsorted, jnp.arange(n, dtype=jnp.int32)
                                 ).astype(jnp.int32)
@@ -145,6 +156,81 @@ def key_buckets(key_cols: Sequence[Column], live, n: int):
         return jnp.zeros(live.shape, dtype=jnp.int32)
     h1, _ = hash_columns_double(key_cols, live)
     return (h1 % jnp.uint64(n)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# generic exchange (shuffle/mesh_exchange.py drives this)
+# ---------------------------------------------------------------------------
+
+def append_pid_column(batch: ColumnarBatch, pids) -> ColumnarBatch:
+    """Carry per-row partition ids through an exchange as a trailing
+    int32 column (the receiving side needs them to serve per-partition
+    reads; the exchange collectives move COLUMNS, so the ids ride as
+    one)."""
+    from ..types import IntegerType, Schema, StructField
+    pid_col = Column(pids.astype(jnp.int32),
+                     jnp.ones(batch.capacity, dtype=jnp.bool_),
+                     IntegerType)
+    schema = Schema(list(batch.schema) +
+                    [StructField("__ici_pid__", IntegerType)])
+    return ColumnarBatch(list(batch.columns) + [pid_col], batch.sel,
+                         schema)
+
+
+def exchange_partition_step(mesh: Mesh, num_partitions: int, pid_fn,
+                            quota: int, pre=None, param_slots=None,
+                            axis: str = DATA_AXIS,
+                            use_allgather: bool = False):
+    """The GENERIC-exchange collective (TpuShuffleExchangeExec's mesh
+    lowering, shuffle/mesh_exchange.py): per device, [optional fused
+    row-local chain `pre`] -> `pid_fn(local, global_start)` per-row
+    partition ids over `num_partitions` -> global per-partition live-row
+    counts (the AQE map statistics, computed DEVICE-side) -> ids carried
+    as a trailing column through ONE tiled all-to-all routed by owner
+    device `(pid * n) // num_partitions`.  Chain, partition-id compute
+    and collective land in one compiled program; the data never leaves
+    device memory.
+
+    Returns fn: (row-sharded batch, start[, param values]) ->
+    (exchanged batch + trailing ``__ici_pid__`` column, overflow scalar,
+    per-partition global live counts).  `start` is the map task's
+    round-robin offset (traced, so every map task shares one program);
+    `param_slots` threads plan-cache parameter values as a trailing
+    traced argument (exec/basic.bound_param_builder rationale).
+    overflow > 0 means the compact quota dropped rows — the driver must
+    retry with a doubled quota, exactly like every other quota-block
+    exchange in this module."""
+    n = mesh.shape[axis]
+
+    def step(local: ColumnarBatch, start):
+        if pre is not None:
+            local = pre(local)
+        base = jax.lax.axis_index(axis).astype(jnp.int32) \
+            * jnp.int32(local.capacity)
+        pids = pid_fn(local, start + base).astype(jnp.int32)
+        counts = jnp.bincount(
+            jnp.where(local.sel, pids, jnp.int32(num_partitions)),
+            length=num_partitions + 1)[:num_partitions]
+        counts = jax.lax.psum(counts, axis)
+        owner = (pids * jnp.int32(n)) // jnp.int32(num_partitions)
+        carried = append_pid_column(local, pids)
+        if use_allgather:
+            ex = exchange_by_bucket(carried, owner, axis)
+            return ex, jnp.int32(0), counts
+        ex, overflow = exchange_compact(carried, owner, quota, axis)
+        return ex, overflow, counts
+
+    if param_slots is None:
+        return shard_map(step, mesh=mesh, in_specs=(P(axis), P()),
+                         out_specs=(P(axis), P(), P()))
+    from ..ops import expressions as PE
+
+    def step_p(local: ColumnarBatch, start, pvals):
+        with PE.bound_params(dict(zip(param_slots, pvals))):
+            return step(local, start)
+
+    return shard_map(step_p, mesh=mesh, in_specs=(P(axis), P(), P()),
+                     out_specs=(P(axis), P(), P()))
 
 
 # ---------------------------------------------------------------------------

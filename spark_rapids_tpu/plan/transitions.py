@@ -106,6 +106,30 @@ def assert_on_tpu(node: ExecNode, conf: TpuConf):
     walk(node)
 
 
+def mark_ici_exchanges(node: ExecNode, mesh) -> ExecNode:
+    """Stamp the ICI-lowering decision on every generic shuffle exchange
+    of a mesh plan: an exchange carrying `ici_mesh` materializes its map
+    phase as jitted collectives over that mesh instead of the host
+    socket tier (shuffle/mesh_exchange.py), behind the
+    spark.rapids.sql.tpu.shuffle.ici.enabled kill switch and the
+    capability checks (no cluster, non-range partitioning).
+
+    IDEMPOTENT by construction (re-stamping the same mesh is a no-op),
+    so AQE's `_replan` re-runs it over re-planned trees — exchanges the
+    rules introduce (a demoted broadcast's replacement repartition) get
+    the same lowering decision as planner-built ones."""
+    from ..exec.exchange import TpuShuffleExchangeExec
+
+    def walk(n: ExecNode) -> None:
+        if isinstance(n, TpuShuffleExchangeExec):
+            n.ici_mesh = mesh
+        for c in n.children:
+            walk(c)
+
+    walk(node)
+    return node
+
+
 def distribute(node: ExecNode, conf: TpuConf) -> ExecNode:
     """Swap shuffle-shaped subtrees for SPMD mesh operators when
     spark.rapids.sql.tpu.mesh.devices > 1 (the planner integration of
@@ -168,7 +192,10 @@ def distribute(node: ExecNode, conf: TpuConf) -> ExecNode:
                 mesh, allgather)
         return n
 
-    return walk(node)
+    # generic exchanges the swap left behind (repartitions, full-join
+    # exchange pairs) lower their OWN write phase into collectives over
+    # the same mesh — the shuffle side of ROADMAP item 1
+    return mark_ici_exchanges(walk(node), mesh)
 
 
 def finalize(node: ExecNode, conf: TpuConf) -> ExecNode:

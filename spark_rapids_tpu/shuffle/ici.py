@@ -26,10 +26,29 @@ from .transport import LoopbackTransport
 
 class IciShuffleTransport(LoopbackTransport):
     """Block-fetch SPI for host-driven mode; mesh repartitions compile to
-    collectives instead of passing through a transport (module docstring)."""
+    collectives instead of passing through a transport (module docstring).
+
+    Tier-selection observability lives here: mesh-lowered exchanges move
+    no bytes through any transport, but WHICH tier served each exchange
+    is transport-level information — `ici_exchanges` counts collective-
+    served exchanges, `socket_fallbacks` counts mesh-eligible exchanges
+    de-lowered after a collective retry ladder exhausted.  Both ride the
+    standard `counters` dict into `transport_counters` RPCs and
+    `session_observability`."""
 
     def __init__(self, mesh=None, axis: Optional[str] = None, **kw):
         super().__init__(**kw)
         from ..parallel.mesh import DATA_AXIS
         self.mesh = mesh
         self.axis = axis or DATA_AXIS
+
+    def configure(self, conf) -> None:
+        """Adopt the session conf (integrity/compression/faults, like
+        every transport) and resolve the execution mesh ONCE: the conf
+        names the mesh geometry (spark.rapids.sql.tpu.mesh.devices), and
+        resolving it here means every exchange's tier check reads a
+        settled capability instead of re-deriving one per materialize."""
+        super().configure(conf)
+        if self.mesh is None:
+            from ..exec.distributed import resolve_mesh
+            self.mesh = resolve_mesh(conf)

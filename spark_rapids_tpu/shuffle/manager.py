@@ -402,8 +402,8 @@ class ShuffleEnv:
         # AFTER the buffer registers below — an OOM mid-write retries the
         # whole call, and recording first would double-count the attempt.
         nrows = batch.num_rows_host()
-        cap = max(batch.capacity, 1)
-        nbytes = int(batch.device_size_bytes() * min(nrows, cap) / cap)
+        nbytes = map_output_nbytes(batch.device_size_bytes(),
+                                   batch.capacity, nrows)
         if self.device_resident:
             with self._lock:
                 self._write_seq[0] += 1
@@ -657,6 +657,20 @@ class ShuffleEnv:
             f"failed unrecoverably ({classification}): {cause}",
             peer=peer, shuffle_id=shuffle_id, reduce_id=reduce_id,
             classification=classification)
+
+
+def map_output_nbytes(device_size_bytes: int, capacity: int,
+                      nrows: int) -> int:
+    """Map-output-statistics DATA bytes of one written sub-batch:
+    live-row-proportional, so a mostly-dead bucketed capacity does not
+    read as a fat partition.  ONE formula for both shuffle tiers — the
+    socket write path calls it with a real sub-batch's footprint, the
+    mesh tier (shuffle/mesh_exchange.py) with the synthetic footprint of
+    the sub-batch `split_by_partition` WOULD build — so AQE rules see
+    bit-identical statistics wherever the exchange ran (capacities are
+    power-of-two buckets, so the division is exact in float)."""
+    cap = max(capacity, 1)
+    return int(device_size_bytes * min(nrows, cap) / cap)
 
 
 def get_shuffle_env(runtime: TpuRuntime, conf: TpuConf) -> ShuffleEnv:

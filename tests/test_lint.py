@@ -241,6 +241,50 @@ def test_tpu002_mixed_static_and_value_branch_still_flags(tmp_path):
                for f in res.findings)
 
 
+def test_tpu002_shard_map_body_resolved(tmp_path):
+    """ISSUE 14: `shard_map(step, ...)` program bodies are jit sinks —
+    collective kernels get linted, not baselined."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        import time
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def exchange_step(mesh, axis):
+            def step(local, start):
+                t = time.time()
+                if start > 0:
+                    return local + t
+                return local
+            return shard_map(step, mesh=mesh, in_specs=(P(axis), P()),
+                             out_specs=P(axis))
+    """}, rules=["TPU002"])
+    msgs = [f.message for f in res.findings]
+    assert any("impure call time.time" in m for m in msgs)
+    assert any("branch on traced value 'start'" in m for m in msgs)
+
+
+def test_tpu002_clean_shard_map_negative(tmp_path):
+    """Closure-variable branches (quota knobs, mode switches) inside a
+    shard_map body are static trace-time dispatch, not traced-value
+    branching — the real collective programs' shape."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def exchange_step(mesh, axis, use_allgather, pre=None):
+            def step(local, start):
+                if pre is not None:
+                    local = pre(local)
+                if use_allgather:
+                    return local
+                return local + start
+            return shard_map(step, mesh=mesh, in_specs=(P(axis), P()),
+                             out_specs=P(axis))
+    """}, rules=["TPU002"])
+    assert res.findings == []
+
+
 def test_tpu002_clean_negative_shape_branch_ok(tmp_path):
     res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
         import time
@@ -1062,6 +1106,31 @@ def test_tpu010_clean_kernel_negative(tmp_path):
                 in_specs=[spec], out_specs=spec)(x)
     """}, rules=["TPU010"])
     assert res.findings == []
+
+
+def test_tpu010_shard_map_body_sync_flagged_64bit_exempt(tmp_path):
+    """ISSUE 14: shard_map collective bodies get the host-sync/impure
+    half of the kernel checks; the 64-bit and tile rules stay
+    Mosaic-only (collectives legitimately compute in int64/float64)."""
+    res = run_fixture(tmp_path, {"spark_rapids_tpu/m.py": """
+        import numpy as np
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def bad_step(mesh, axis):
+            def step(local):
+                key = local.astype(jnp.int64)  # fine in a collective
+                counts = np.asarray(key)       # host sync: flagged
+                print(counts)                  # impure: flagged
+                return key
+            return shard_map(step, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=P(axis))
+    """}, rules=["TPU010"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "host-sync call asarray() inside shard_map program" in msgs
+    assert "impure call print() inside shard_map program" in msgs
+    assert "int64" not in msgs
 
 
 def test_tpu010_untested_kernel_wrapper(tmp_path):

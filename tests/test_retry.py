@@ -45,6 +45,7 @@ OOM_SWEEP_SITES = (
     "agg.merge",               # exec/aggregate.py — partial-state merge
     "agg.update",              # exec/aggregate.py — per-batch update
     "checkpoint",              # mem/retry.py — spillable input re-admit
+    "exchange.collective",     # shuffle/mesh_exchange.py — ICI dispatch
     "exchange.partition",      # exec/exchange.py — shuffle partitioning
     "fetch_baseline",          # shuffle/manager.py — local baseline read
     "join.build",              # exec/join.py — build side
