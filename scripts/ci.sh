@@ -153,6 +153,20 @@ python -m pytest tests/test_roofline.py -q -m "not slow" \
     -p no:cacheprovider
 echo "== roofline tier took $((SECONDS - T_ROOF))s =="
 
+echo "== chaos tier =="
+# fault-recovery chaos (ISSUE 15): injectCrash grammar (site/scope
+# ordinals, seed-deterministic p=), injectNetFault per-site addressing,
+# the stale-spill-dir bootstrap sweep, attempt-id-guarded map-output
+# registration, and per-task retry-budget semantics.  The fast half runs
+# here; -m "chaos and slow" adds the 3-worker ProcCluster acceptance
+# (mid-task kills bit-for-bit, deadline abandonment + wedged-worker
+# eviction, speculation beating an injected straggler, graceful shrink,
+# and the seeded >=20-round chaos soak — CHAOS_ROUNDS/CHAOS_SEED env
+# knobs keep it deterministic and tunable).
+T_CHAOS=$SECONDS
+python -m pytest tests/test_chaos.py -q -m "not slow" -p no:cacheprovider
+echo "== chaos tier took $((SECONDS - T_CHAOS))s =="
+
 echo "== mesh exchange tier =="
 # mesh-native ICI shuffle (ISSUE 14): the generic exchange lowered into
 # jitted shard_map collectives must be bit-for-bit with the socket tier

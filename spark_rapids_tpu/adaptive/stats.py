@@ -145,6 +145,11 @@ class MapOutputTracker:
             rec["bytes"] += int(nbytes)
             rec["rows"] += int(nrows)
             rec["maps"][map_id] = rec["maps"].get(map_id, 0) + int(nbytes)
+            # per-map ROWS ride along internally (not in the snapshot
+            # wire shape) so mark_lost/remove_map_range keep the row
+            # totals exact, not just the byte totals
+            rows = rec.setdefault("map_rows", {})
+            rows[map_id] = rows.get(map_id, 0) + int(nrows)
 
     def snapshot(self, shuffle_id: int) -> dict:
         """JSON-safe {reduce_id: {bytes, rows, maps:{map_id: bytes}}} —
@@ -189,7 +194,32 @@ class MapOutputTracker:
                         dropped = rec["maps"].pop(map_id, None)
                         if dropped is not None:
                             rec["bytes"] -= int(dropped)
+                        rows = rec.get("map_rows", {}).pop(map_id, None)
+                        if rows is not None:
+                            rec["rows"] -= int(rows)
             self._epoch += 1
+
+    def remove_map_range(self, shuffle_id: int, map_lo: int,
+                         map_hi: int) -> None:
+        """Drop the records of every map id in [map_lo, map_hi) — the
+        statistics half of the attempt-id guard (ShuffleBufferCatalog
+        .remove_map_range): a superseded attempt's bytes must not stay in
+        the AQE view the winner's re-record will add to.  Bumps the epoch
+        once when anything was dropped (same contract as mark_lost)."""
+        with self._lock:
+            shuffle = self._by_shuffle.get(shuffle_id)
+            dropped_any = False
+            if shuffle is not None:
+                for rec in shuffle.values():
+                    for mid in [m for m in rec["maps"]
+                                if map_lo <= m < map_hi]:
+                        rec["bytes"] -= int(rec["maps"].pop(mid))
+                        rows = rec.get("map_rows", {}).pop(mid, None)
+                        if rows is not None:
+                            rec["rows"] -= int(rows)
+                        dropped_any = True
+            if dropped_any:
+                self._epoch += 1
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         with self._lock:

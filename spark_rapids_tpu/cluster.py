@@ -21,6 +21,7 @@ import json
 import logging
 import os
 import pickle
+import random
 import re
 import subprocess
 import sys
@@ -30,6 +31,7 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 from .config import TpuConf
+from .metrics.journal import journal_event
 from .metrics.registry import count_swallowed
 
 log = logging.getLogger("spark_rapids_tpu.cluster")
@@ -448,6 +450,25 @@ class ProcCluster:
         # heartbeat monitor on its dedicated connections
         self.trace_enabled = bool(tconf.get(C.TRACE_ENABLED))
         self.straggler_factor = float(tconf.get(C.TRACE_STRAGGLER_FACTOR))
+        # task deadlines / bounded retry / speculation (docs/tuning-guide
+        # .md, Fault tolerance, speculation, and chaos testing)
+        self._task_timeout_ms = int(tconf.get(C.TASK_TIMEOUT))
+        self._hung_timeout_ms = int(tconf.get(C.TRACE_HUNG_TASK_TIMEOUT))
+        self._task_backoff_s = int(tconf.get(C.TASK_RETRY_BACKOFF)) / 1e3
+        self._task_backoff_cap_s = int(tconf.get(C.TASK_MAX_BACKOFF)) / 1e3
+        self.speculation_enabled = bool(
+            tconf.get(C.TASK_SPECULATION_ENABLED))
+        self.max_worker_replacements = int(
+            tconf.get(C.TASK_MAX_WORKER_REPLACEMENTS))
+        self._replacements_used = 0  # reset per query (run_map_reduce)
+        # deterministic jitter for the inter-wave backoff (never wall
+        # clock: chaos rounds must replay identically under one seed)
+        self._backoff_rng = random.Random("task-retry-backoff")
+        self.speculative_tasks = 0
+        self.speculation_wins = 0
+        self.evicted_workers = 0
+        self.abandoned_tasks = 0
+        self.worker_shrinks = 0
         # accumulated shard drains, keyed (executor_id, shard pid) so a
         # replaced worker's restarted journal never aliases its
         # predecessor's span ids (drain_journals)
@@ -464,13 +485,15 @@ class ProcCluster:
             session._proc_cluster = self
 
     def _publish_peers(self) -> None:
+        # replace=True prunes peers that are GONE (a shrunk worker slot):
+        # survivors must stop dialing the dead address on remote fetches
         peers = {w.executor_id: list(w.address) for w in self.workers}
-        self._transport.set_peers(peers)
+        self._transport.set_peers(peers, replace=True)
         for w in self.workers:
             if w.client is None:
                 w.client = self._transport.make_client(w.executor_id)
             try:
-                w.rpc("set_peers", peers=peers)
+                w.rpc("set_peers", peers=peers, replace=True)
             except Exception as e:  # noqa: BLE001 — a peer that is ALSO
                 # dead (multi-worker loss) gets replaced by its own
                 # recovery iteration, which re-publishes to everyone;
@@ -508,88 +531,464 @@ class ProcCluster:
         self.map_epoch += 1  # its old map outputs died with the process
         return fresh
 
+    def _shrink_worker(self, i: int, cause: str) -> "WorkerProc":
+        """Graceful degradation: remove a worker SLOT instead of failing
+        the query — the replacement budget is exhausted or the spawn
+        itself failed.  Task assignments re-balance onto the survivors
+        (task i runs on workers[i % len(workers)]); the caller recomputes
+        any map fragments the dead slot homed via on_replace.  Returns
+        the adoptive survivor for the slot's tasks."""
+        w = self.workers[i]
+        if len(self.workers) <= 1:
+            raise RuntimeError(
+                f"cluster cannot shrink below one worker: last worker "
+                f"{w.executor_id} lost ({cause}) and no replacement "
+                f"could be spawned")
+        try:
+            w.stop(grace_s=0.5)
+        except Exception:  # noqa: BLE001 — it is already gone
+            pass  # tpulint: disable=TPU006 stopping the worker being shrunk away; its loss is already the subject
+        del self.workers[i]
+        self._transport.drop_client(w.executor_id)
+        self._transport.count("worker_shrinks")
+        self._count("worker_shrinks")
+        self.map_epoch += 1  # its map outputs died with the slot
+        self._publish_peers()  # prunes the dead address everywhere
+        journal_event("spec", "clusterShrunk", executor=w.executor_id,
+                      cause=cause, workers=len(self.workers))
+        log.warning(
+            "graceful degradation: worker %s shrunk away (%s); cluster "
+            "re-balanced onto %d surviving worker(s)", w.executor_id,
+            cause, len(self.workers))
+        return self.workers[i % len(self.workers)]
+
+    def _replace_or_shrink(self, worker: "WorkerProc",
+                           cause: str) -> "WorkerProc":
+        """Replace a lost/evicted worker, degrading to a cluster shrink
+        when the per-query replacement budget is exhausted or the spawn
+        fails.  Returns the worker now responsible for the slot (the
+        replacement, or the adoptive survivor)."""
+        i = next((k for k, w in enumerate(self.workers) if w is worker),
+                 None)
+        if i is None:
+            # already replaced/shrunk (e.g. two tasks blamed one peer in
+            # one wave): hand back the current holder of the executor id
+            return next((w for w in self.workers
+                         if w.executor_id == worker.executor_id),
+                        self.workers[0])
+        if self.max_worker_replacements < 0 \
+                or self._replacements_used < self.max_worker_replacements:
+            self._replacements_used += 1
+            try:
+                return self._replace_worker(i)
+            except Exception as e:  # noqa: BLE001 — degrade, not fail
+                log.error("replacement spawn for %s failed (%r); "
+                          "degrading to a cluster shrink",
+                          worker.executor_id, e)
+                return self._shrink_worker(i, f"spawn_failed:{cause}")
+        log.warning("worker replacement budget exhausted (%d used); "
+                    "degrading to a cluster shrink",
+                    self._replacements_used)
+        return self._shrink_worker(i, f"budget_exhausted:{cause}")
+
     def new_shuffle_id(self) -> int:
         with self._lock:
             self._sid += 1
             return self._sid
 
-    def _run_tasks_with_retry(self, stage: str, attempt, store,
-                              on_replace=None) -> None:
-        """Run task i on worker i for every worker, in parallel; on
-        failure, recover and retry up to `max_task_retries` times.
+    # -- task scheduling: deadlines, retry with backoff, speculation ---------
 
-        Recovery (Spark's task-retry + executor-loss handling, absorbed
-        into one mechanism): a DEAD worker is replaced by a fresh process
-        under the same executor id (peers rewired) and `on_replace(i)`
-        regenerates whatever worker-local state the stage depends on (the
-        reduce stage re-runs the lost map fragment — the logical plan is
-        the lineage); a worker that is alive but errored (e.g. its fetch
-        raced a peer's death) just re-runs its task after replacements
-        settle.
+    def _count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
 
-        FetchFailed handling (data-integrity escalation): a reduce task
-        that raises FetchFailed names the PEER whose map output is
-        unservable — dead socket, vanished buffer, or persistently
-        corrupt data.  That peer is replaced EVEN IF ITS PROCESS IS
-        STILL ALIVE (a live executor serving garbage is as lost as a
-        dead one) and its map fragment is recomputed from the lineage
-        before the failed reduce task retries."""
+    def _task_deadline_s(self) -> Optional[float]:
+        """Per-attempt task rpc deadline: task.timeoutMs, derived as
+        2 x trace.hungTaskTimeoutMs when unset (the watchdog WARNS at the
+        hung bound; the scheduler ACTS at twice it, so a task flagged
+        hung gets one watchdog interval of grace — and a test tuning the
+        watchdog alone does not change scheduling).  None = unbounded."""
+        if self._task_timeout_ms > 0:
+            return self._task_timeout_ms / 1e3
+        if self._hung_timeout_ms > 0:
+            return 2 * self._hung_timeout_ms / 1e3
+        return None
 
-        def wave(indices):
-            errs = {}
+    def _task_rpc(self, worker: "WorkerProc", method: str, **kw):
+        """Task rpc on a DEDICATED connection: a task that outlives its
+        deadline (or a speculation loser grinding on) must never hold the
+        worker's shared control client hostage — cleanup rpcs and later
+        waves dial fresh."""
+        from .shuffle.net import SocketClient
+        client = SocketClient(self._transport, tuple(worker.address))
+        try:
+            return client.rpc(method, **kw)
+        finally:
+            client.close()
 
-            def one(i):
+    def _probe_worker(self, worker: "WorkerProc") -> bool:
+        """Health-probe a worker whose task crossed its deadline, over
+        the heartbeat monitor's dedicated connection when the monitor is
+        running (the probe must never queue behind the wedged task rpc),
+        falling back to a FRESH dial when that fails — a stale monitor
+        socket must not misclassify a live worker as dead (the hung-vs-
+        dead attribution feeds numEvictedWorkers and the journal).
+        True = the process answers (wedged-but-alive); False = dead."""
+        try:
+            if self.monitor is not None:
+                client = self.monitor._client_for(worker)
+                if client is not None:
+                    client.rpc("heartbeat", _rpc_timeout=2.0)
+                    return True
+        except Exception:  # noqa: BLE001 — stale socket, not a verdict
+            pass  # tpulint: disable=TPU006 a broken monitor client is inconclusive; the fresh-dial probe below delivers the verdict
+        try:
+            from .shuffle.net import SocketClient
+            probe = SocketClient(self._transport, tuple(worker.address),
+                                 inject_faults=False, connect_timeout=2.0)
+            try:
+                probe.rpc("ping", _rpc_timeout=2.0)
+                return True
+            finally:
+                probe.close()
+        except Exception:  # noqa: BLE001 — the probe's answer IS the info
+            return False
+
+    def _speculation_candidates_locked(self, tasks: Dict[int, dict],
+                                       durations: List[float]):
+        """Straggler detection over the running wave (caller holds the
+        wave condition): tasks past stragglerFactor x the stage's median
+        successful-attempt duration (or past the hung-task bound) with no
+        copy yet.  Returns [(task, target worker, attempt id)] with the
+        target chosen least-loaded among healthy workers."""
+        if not self.speculation_enabled:
+            return []
+        med = sorted(durations)[len(durations) // 2] \
+            if len(durations) >= 2 else None
+        hung_s = self._hung_timeout_ms / 1e3 \
+            if self._hung_timeout_ms > 0 else None
+        if med is None and hung_s is None:
+            return []
+        now = time.monotonic()
+        load: Dict[str, int] = {}
+        for t in tasks.values():
+            for a in t["attempts"]:
+                if not a["done"]:
+                    ex = a["worker"].executor_id
+                    load[ex] = load.get(ex, 0) + 1
+        out = []
+        for i, t in sorted(tasks.items()):
+            if t["resolved"] or len(t["attempts"]) != 1:
+                continue  # already raced, or already settled
+            a = t["attempts"][0]
+            if a["done"]:
+                continue
+            elapsed = now - a["start"]
+            # the 250ms floor keeps speculation out of millisecond-task
+            # noise: a 60ms transient stall on a 20ms-median stage is not
+            # a straggler worth a copy (and possibly an eviction)
+            straggling = (med is not None and elapsed >= 0.25
+                          and elapsed > self.straggler_factor * med)
+            hung = hung_s is not None and elapsed > hung_s
+            if not (straggling or hung):
+                continue
+            healthy = [w for w in self.workers
+                       if w is not a["worker"] and w.proc.poll() is None]
+            if not healthy:
+                continue
+            target = min(healthy,
+                         key=lambda w: load.get(w.executor_id, 0))
+            load[target.executor_id] = \
+                load.get(target.executor_id, 0) + 1
+            out.append((i, target, len(t["attempts"]) + 1))
+        return out
+
+    def _run_task_round(self, stage: str, indices, attempt, store,
+                        durations: List[float], on_loser,
+                        on_replace=None) -> Dict[int, tuple]:
+        """One wave: launch every pending task on its assigned worker,
+        speculate on stragglers, resolve first-result-wins, clean up
+        losers.  Returns {task: (error, worker, all_failed_attempts)}
+        for unresolved tasks."""
+        cond = threading.Condition()
+        tasks: Dict[int, dict] = {
+            i: {"resolved": False, "stored": False, "winner": None,
+                "attempts": []}
+            for i in indices}
+
+        def launch(i: int, worker: "WorkerProc", attempt_id: int) -> None:
+            rec = {"id": attempt_id, "worker": worker, "done": False,
+                   "ok": False, "out": None, "start": time.monotonic(),
+                   "thread": None}
+
+            def run():
                 try:
-                    store(i, attempt(i))
-                except Exception as e:  # noqa: BLE001 — retried/re-raised
-                    errs[i] = e
-            threads = [threading.Thread(target=one, args=(i,))
-                       for i in indices]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            return errs
+                    res = attempt(i, worker=worker, attempt_id=attempt_id)
+                    ok = True
+                except Exception as e:  # noqa: BLE001 — classified below
+                    res, ok = e, False
+                dur = time.monotonic() - rec["start"]
+                if not ok and isinstance(res, TimeoutError):
+                    # the deadline cut this attempt off: abandoned, the
+                    # wave moves on (worker health handled in recovery)
+                    self._count("abandoned_tasks")
+                    journal_event("spec", "taskAbandoned", stage=stage,
+                                  task=i, attempt=attempt_id,
+                                  executor=worker.executor_id,
+                                  elapsed_s=round(dur, 3))
+                first = False
+                with cond:
+                    rec["done"], rec["ok"], rec["out"] = True, ok, res
+                    t = tasks[i]
+                    if ok and not t["resolved"]:
+                        t["resolved"], t["winner"] = True, rec
+                        durations.append(dur)
+                        first = True
+                    cond.notify_all()
+                if first:
+                    store(i, res, worker=worker)
+                    if attempt_id > 1:
+                        self._count("speculation_wins")
+                        journal_event("spec", "speculationWin",
+                                      stage=stage, task=i,
+                                      attempt=attempt_id,
+                                      executor=worker.executor_id)
+                    # `stored` gates the settle loop: the round must not
+                    # return while the winner's result is still being
+                    # written (results[i] would read None — silent row
+                    # loss in the reduce concat)
+                    with cond:
+                        tasks[i]["stored"] = True
+                        cond.notify_all()
 
-        errs = wave(range(len(self.workers)))
-        tries = 0
-        while errs and tries < self.max_task_retries:
-            tries += 1
-            replaced = set()
-            for i in sorted(errs):
-                if self.workers[i].proc.poll() is not None:
-                    if i not in replaced:
-                        self._replace_worker(i)
-                        replaced.add(i)
-                        if on_replace is not None:
-                            on_replace(i)
+            th = threading.Thread(target=run, daemon=True,  # tpulint: disable=TPU009 attempt threads journal spec recovery events on the DRIVING query's behalf by design (worker-side they land on the process shard; driver-side on the submitting query's journal)
+                                  name=f"task-{stage}-{i}-a{attempt_id}")
+            rec["thread"] = th
+            with cond:
+                tasks[i]["attempts"].append(rec)
+            th.start()
+
+        for i in indices:
+            launch(i, self._task_worker(i), 1)
+
+        while True:
+            with cond:
+                settled = all(
+                    t["stored"] if t["resolved"]
+                    else (t["attempts"] and all(a["done"]
+                                                for a in t["attempts"]))
+                    for t in tasks.values())
+                to_spec = [] if settled else \
+                    self._speculation_candidates_locked(tasks, durations)
+                if settled:
+                    break
+                if not to_spec:
+                    cond.wait(0.05)
+            for i, target, attempt_id in to_spec:
+                self._count("speculative_tasks")
+                self._transport.count("task_retries_speculation")
+                journal_event("spec", "speculativeLaunch", stage=stage,
+                              task=i, attempt=attempt_id,
+                              executor=target.executor_id)
+                log.warning("%s task %d flagged as a straggler; "
+                            "launching speculative copy on %s (attempt "
+                            "%d)", stage, i, target.executor_id,
+                            attempt_id)
+                launch(i, target, attempt_id)
+
+        # first result won; cancel/ignore the losers.  Side-effectful
+        # stages (on_loser set: the map stage) must ERASE the losing
+        # attempt's registrations before the reduce side can read a mix
+        # of attempts — result-only stages just ignore late results.
+        #
+        # Cleanup is SURGICAL FIRST: the worker's per-fragment lock
+        # serializes remove_map_range behind any still-running attempt
+        # of that fragment, so a merely-late loser is waited out (within
+        # the cleanup rpc's deadline) and cleaned without killing its
+        # worker; only a cleanup that FAILS (worker wedged past the
+        # bound, or dead) escalates to eviction inside on_loser
+        # (process death is total cleanup).
+        for i, t in sorted(tasks.items()):
+            if t["winner"] is None or on_loser is None:
+                continue
+            for a in t["attempts"]:
+                if a is t["winner"]:
                     continue
-                # typed FetchFailed escalation: the error names the peer
-                # whose map output is lost (corrupt/gone), which may be a
-                # DIFFERENT worker than the one whose task failed — and
-                # one whose process is perfectly alive, just serving
-                # garbage.  Replace the blamed peer and recompute ITS map
-                # fragment; the failing task re-runs in the next wave.
-                peer = _fetch_failed_peer(errs[i])
-                if peer is not None:
-                    j = next((k for k, w in enumerate(self.workers)
-                              if w.executor_id == peer), None)
-                    if j is not None and j not in replaced:
-                        self.lost_map_outputs += 1
-                        log.warning(
-                            "%s task %d lost map output at %s; replacing "
-                            "it and recomputing the fragment", stage, i,
-                            peer)
-                        self._replace_worker(j)
-                        replaced.add(j)
-                        if on_replace is not None:
-                            on_replace(j)
-            errs = wave(sorted(errs))
-        if errs:
-            i, e = next(iter(sorted(errs.items())))
-            raise RuntimeError(
-                f"{stage} task {i} failed after "
-                f"{self.max_task_retries} retries") from e
+                a["thread"].join(2.0)  # grace: most losers settle fast
+                w = a["worker"]
+                if any(x is w for x in self.workers):
+                    on_loser(i, w)
+
+        errs: Dict[int, tuple] = {}
+        for i, t in sorted(tasks.items()):
+            if t["resolved"]:
+                continue
+            fails = [a for a in t["attempts"] if not a["ok"]]
+            # prefer the error that names a blamable peer (FetchFailed);
+            # EVERY failed attempt rides along so recovery can handle
+            # the other attempts' workers too (a task whose original AND
+            # speculative copy both wedged must evict both)
+            pick = next((a for a in fails
+                         if _fetch_failed_peer(a["out"]) is not None),
+                        fails[0])
+            errs[i] = (pick["out"], pick["worker"],
+                       [(a["out"], a["worker"]) for a in fails])
+        return errs
+
+    def _task_worker(self, i: int) -> "WorkerProc":
+        """Worker assigned to task i: 1:1 while the cluster is at full
+        strength, re-balanced modulo the survivors after a shrink."""
+        return self.workers[i % len(self.workers)]
+
+    def _recover_task_failure(self, stage: str, i: int, err, worker,
+                              handled: set, on_replace) -> str:
+        """Classify one failed task and run its recovery.  Returns the
+        retry CAUSE ('dead' | 'timeout' | 'fetch_failed' | 'other') for
+        the per-cause transport counters."""
+        def lost(w, label):
+            if w.executor_id in handled:
+                return
+            handled.add(w.executor_id)
+            if not any(x is w for x in self.workers):
+                # already replaced/shrunk this wave (loser-cleanup
+                # escalation, or two attempts naming one worker): its
+                # fragments were recomputed then — replacing the
+                # innocent fresh process again would be pure churn
+                return
+            new = self._replace_or_shrink(w, label)
+            if on_replace is not None:
+                on_replace(w.executor_id, new)
+
+        if worker is not None and worker.proc.poll() is not None:
+            lost(worker, "dead")
+            return "dead"
+        if isinstance(err, TimeoutError):
+            # the attempt crossed its deadline: probe the worker over the
+            # monitor's dedicated connection — a wedged-but-alive worker
+            # is evicted exactly like a dead one (replace + lineage
+            # recompute); a dead one just failed to be noticed yet
+            present = worker is not None \
+                and any(x is worker for x in self.workers)
+            alive = present and self._probe_worker(worker)
+            if alive and worker.executor_id not in handled:
+                self._count("evicted_workers")
+                journal_event("spec", "workerEvicted",
+                              executor=worker.executor_id, stage=stage,
+                              task=i, cause="hung")
+                log.warning("%s task %d: worker %s wedged past the task "
+                            "deadline (alive on probe); evicting it",
+                            stage, i, worker.executor_id)
+            if worker is not None:
+                lost(worker, "hung" if alive else "dead")
+            return "timeout"
+        # typed FetchFailed escalation: the error names the peer whose
+        # map output is lost (corrupt/gone), which may be a DIFFERENT
+        # worker than the one whose task failed — and one whose process
+        # is perfectly alive, just serving garbage.  Replace the blamed
+        # peer and recompute ITS map fragments; the failing task re-runs
+        # in the next wave.
+        peer = _fetch_failed_peer(err)
+        if peer is not None:
+            if peer not in handled:
+                self.lost_map_outputs += 1
+                log.warning(
+                    "%s task %d lost map output at %s; replacing it and "
+                    "recomputing the fragment", stage, i, peer)
+                pw = next((w for w in self.workers
+                           if w.executor_id == peer), None)
+                if pw is not None:
+                    lost(pw, "fetch_failed")
+                else:
+                    # blamed peer already shrunk away: its fragments
+                    # still need a new home for the retry to fetch from
+                    handled.add(peer)
+                    if on_replace is not None:
+                        on_replace(peer, self._task_worker(i))
+            return "fetch_failed"
+        return "other"
+
+    def _run_tasks_with_retry(self, stage: str, attempt, store,
+                              on_replace=None, on_loser=None,
+                              n_tasks: Optional[int] = None) -> None:
+        """Run every task in parallel waves with per-attempt DEADLINES,
+        speculative re-execution of stragglers, and bounded PER-TASK
+        retry with jittered exponential backoff between waves.
+
+        Contract with the callers (run_map_reduce builds these):
+          attempt(i, worker=, attempt_id=) — run task i on `worker`;
+          store(i, out, worker=)           — first (winning) result only;
+          on_replace(executor_id, worker)  — map outputs homed on
+              `executor_id` are gone; recompute them on `worker` (the
+              logical plan is the lineage);
+          on_loser(i, worker)              — a losing speculative copy of
+              task i may have registered side effects on `worker`; erase
+              them (attempt-id-guarded map-output registration).
+
+        Recovery per failed task, classified and counted per cause
+        (task_retries_* transport counters): a DEAD worker is replaced
+        under the same executor id; an attempt past its deadline
+        (task.timeoutMs, derived from trace.hungTaskTimeoutMs) is
+        ABANDONED, its worker health-probed, and a wedged-but-alive
+        worker EVICTED exactly like a dead one; a typed FetchFailed
+        blames the peer whose map output is unservable and that peer is
+        replaced even if alive.  When the per-query replacement budget
+        (task.maxWorkerReplacements) is exhausted — or a spawn fails —
+        the slot is SHRUNK and tasks re-balance onto the survivors
+        instead of failing the query.  Failed waves back off
+        (task.retryBackoffMs doubling to task.maxBackoffMs, jittered)
+        instead of hammering a recovering peer."""
+        n_tasks = len(self.workers) if n_tasks is None else n_tasks
+        budget = {i: self.max_task_retries for i in range(n_tasks)}
+        durations: List[float] = []
+        pending = sorted(range(n_tasks))
+        round_no = 0
+        while pending:
+            errs = self._run_task_round(stage, pending, attempt, store,
+                                        durations, on_loser,
+                                        on_replace=on_replace)
+            if not errs:
+                return
+            round_no += 1
+            for i in sorted(errs):
+                if budget[i] <= 0:
+                    raise RuntimeError(
+                        f"{stage} task {i} failed after "
+                        f"{self.max_task_retries} retries") \
+                        from errs[i][0]
+                budget[i] -= 1
+            handled: set = set()
+            for i in sorted(errs):
+                err, worker, all_fails = errs[i]
+                cause = self._recover_task_failure(stage, i, err, worker,
+                                                   handled, on_replace)
+                self._transport.count(f"task_retries_{cause}")
+                # the OTHER failed attempts' workers get the same
+                # dead/wedged recovery (dedup'd through `handled`), but
+                # the task's retry is counted once, under the primary
+                # error's cause
+                for e2, w2 in all_fails:
+                    if w2 is worker:
+                        continue
+                    self._recover_task_failure(stage, i, e2, w2,
+                                               handled, on_replace)
+            if on_loser is not None:
+                # side-effectful stage: erase every failed attempt's
+                # possible partial registrations on SURVIVING workers
+                # before the retry wave — the re-run may land on a
+                # different worker (replacement, shrink re-balance), and
+                # its own attempt-id guard only cleans the worker it
+                # runs on.  The fragment lock serializes this behind a
+                # still-writing server task; failures escalate to
+                # eviction inside on_loser.
+                for i in sorted(errs):
+                    for _e2, w2 in errs[i][2]:
+                        if any(x is w2 for x in self.workers):
+                            on_loser(i, w2)
+            pending = sorted(errs)
+            if self._task_backoff_s > 0:
+                raw = min(self._task_backoff_cap_s,
+                          self._task_backoff_s * (2 ** (round_no - 1)))
+                time.sleep(raw * (0.5 + self._backoff_rng.random() / 2))
 
     def run_map_reduce(self, map_plans: Sequence, key_names: List[str],
                        n_parts: int, reduce_plan,
@@ -607,45 +1006,112 @@ class ProcCluster:
         trace context, so the merged timeline groups the map and reduce
         stages of ONE query across workers (metrics/timeline.py)."""
         import pyarrow as pa
-        assert len(map_plans) == len(self.workers), \
+
+        from .shuffle.catalog import MAP_ID_STRIDE
+        n_tasks = len(map_plans)
+        assert n_tasks == len(self.workers), \
             "one map fragment per worker"
         sid = self.new_shuffle_id()
+        with self._lock:
+            self._replacements_used = 0  # replacement budget is per query
         if trace_query is None:
             with self._lock:
                 self._query_counter += 1
                 trace_query = f"mr-{os.getpid()}-{self._query_counter}"
         map_trace = {"query": trace_query, "stage": f"s{sid}.map"}
         reduce_trace = {"query": trace_query, "stage": f"s{sid}.reduce"}
-        map_stats: List[dict] = [None] * len(self.workers)
+        map_stats: List[dict] = [None] * n_tasks
+        # which executor each map FRAGMENT's outputs live on (a fragment
+        # follows its winning attempt: speculation, shrink re-balancing
+        # and lineage recomputes can all move it off its home slot)
+        frag_home: Dict[int, str] = {}
+        deadline_s = self._task_deadline_s()
 
-        def _attempt_map(i: int) -> dict:
-            return self.workers[i].rpc(
-                "run_map", sid=sid,
+        def _attempt_map(i: int, worker=None, attempt_id: int = 1) -> dict:
+            w = worker if worker is not None else self._task_worker(i)
+            return self._task_rpc(
+                w, "run_map", sid=sid,
                 plan_blob=pickle.dumps(map_plans[i]),
                 key_names=list(key_names), n_parts=n_parts,
-                trace=map_trace)
+                trace=map_trace, map_id_base=i * MAP_ID_STRIDE,
+                attempt=attempt_id, _rpc_timeout=deadline_s)
 
-        self._run_tasks_with_retry(
-            "map", _attempt_map,
-            lambda i, out: map_stats.__setitem__(i, out))
+        def _store_map(i: int, out: dict, worker=None) -> None:
+            map_stats[i] = out
+            if worker is not None:
+                frag_home[i] = worker.executor_id
+
+        def _recompute_fragments(executor_id: str, worker) -> None:
+            # map outputs homed on `executor_id` died with it (process
+            # loss, eviction, or shrink): the map fragments (the logical
+            # lineage) recompute on `worker` — during the map stage this
+            # covers fragments a lost worker had already WON (its own
+            # pending task just re-runs in the wave); during the reduce
+            # stage it runs before failed reduce tasks retry their
+            # fetches
+            for i in sorted(frag_home):
+                if frag_home[i] != executor_id:
+                    continue
+                map_stats[i] = _attempt_map(i, worker=worker)
+                frag_home[i] = worker.executor_id
+
+        def _cleanup_map_loser(i: int, worker) -> None:
+            # a losing speculative map copy registered fragment i's
+            # blocks on a worker that also (rightly) holds other state:
+            # drop exactly that fragment's range.  If the surgical
+            # cleanup fails the bit-for-bit invariant is at stake —
+            # escalate to eviction (process death is total cleanup).
+            # The wait bound is the TASK deadline (the fragment lock
+            # serializes behind a still-running loser, and a loser that
+            # legitimately runs long on a heavy stage must not get its
+            # healthy worker killed over a hardcoded 30s).
+            try:
+                self._task_rpc(worker, "remove_map_range", sid=sid,
+                               lo=i * MAP_ID_STRIDE,
+                               hi=(i + 1) * MAP_ID_STRIDE,
+                               _rpc_timeout=deadline_s or 30.0)
+            except Exception as e:  # noqa: BLE001 — escalates, never silent
+                log.warning("speculation-loser cleanup of task %d at %s "
+                            "failed (%r); evicting the worker", i,
+                            worker.executor_id, e)
+                if any(x is worker for x in self.workers):
+                    self._count("evicted_workers")
+                    journal_event("spec", "workerEvicted",  # tpulint: disable=TPU011 reached through the on_loser callback parameter of _run_tasks_with_retry (closure indirection the call graph cannot resolve)
+                                  executor=worker.executor_id,
+                                  stage="map", task=i,
+                                  cause="loser_cleanup_failed")
+                    new = self._replace_or_shrink(worker,
+                                                  "loser_cleanup_failed")
+                    _recompute_fragments(worker.executor_id, new)
+
+        self._run_tasks_with_retry("map", _attempt_map, _store_map,
+                                   on_replace=_recompute_fragments,
+                                   on_loser=_cleanup_map_loser,
+                                   n_tasks=n_tasks)
 
         reduce_blob = pickle.dumps(reduce_plan)
-        results: List[Optional[bytes]] = [None] * len(self.workers)
+        results: List[Optional[bytes]] = [None] * n_tasks
 
-        def _attempt_reduce(i: int) -> bytes:
-            parts = [p for p in range(n_parts)
-                     if p % len(self.workers) == i]
-            return self.workers[i].rpc("run_reduce", sid=sid,
-                                       partitions=parts,
-                                       plan_blob=reduce_blob,
-                                       trace=reduce_trace)
+        def _attempt_reduce(i: int, worker=None,
+                            attempt_id: int = 1) -> bytes:
+            w = worker if worker is not None else self._task_worker(i)
+            # partition ownership is keyed by TASK index (fixed at stage
+            # entry), not worker count — a mid-stage shrink re-balances
+            # workers without re-slicing the partition space
+            parts = [p for p in range(n_parts) if p % n_tasks == i]
+            return self._task_rpc(w, "run_reduce", sid=sid,
+                                  partitions=parts, plan_blob=reduce_blob,
+                                  trace=reduce_trace, attempt=attempt_id,
+                                  _rpc_timeout=deadline_s)
+
+        def _store_reduce(i: int, out, worker=None) -> None:
+            results[i] = out
 
         self._run_tasks_with_retry(
-            "reduce", _attempt_reduce,
-            lambda i, out: results.__setitem__(i, out),
+            "reduce", _attempt_reduce, _store_reduce,
             # a replaced worker lost its map outputs with the process;
-            # the map fragment (the lineage) recomputes them first
-            on_replace=lambda i: map_stats.__setitem__(i, _attempt_map(i)))
+            # the map fragments (the lineage) recompute them first
+            on_replace=_recompute_fragments, n_tasks=n_tasks)
         for w in self.workers:
             try:
                 w.rpc("remove_shuffle", sid=sid)
@@ -665,9 +1131,14 @@ class ProcCluster:
     def transport_counters(self) -> Dict[str, dict]:
         """Per-worker wire counters (bytes_sent/received, metadata round
         trips) — observability + test assertions that bytes really crossed
-        process boundaries."""
-        return {w.executor_id: w.rpc("transport_counters")
-                for w in self.workers}
+        process boundaries.  The extra 'driver' entry carries the
+        DRIVER-side transport's counters: per-cause task retries
+        (task_retries_dead/timeout/fetch_failed/speculation/other),
+        worker_shrinks, peer_publish_failures."""
+        out = {w.executor_id: w.rpc("transport_counters")
+               for w in self.workers}
+        out["driver"] = dict(self._transport.counters)
+        return out
 
     def pool_stats(self) -> Dict[str, dict]:
         """Per-worker runtime pool/retry/spill stats over the control RPC
@@ -709,7 +1180,24 @@ class ProcCluster:
                                    "host_peak": 0, "disk_peak": 0}}
         out["task_retries"] = self.task_retries
         out["lost_map_outputs"] = self.lost_map_outputs
+        with self._lock:
+            out["speculative_tasks"] = self.speculative_tasks
+            out["speculation_wins"] = self.speculation_wins
+            out["evicted_workers"] = self.evicted_workers
+            out["abandoned_tasks"] = self.abandoned_tasks
+            out["worker_shrinks"] = self.worker_shrinks
         return out
+
+    def recovery_metrics(self) -> dict:
+        """The lint-checked metric names the task-recovery tier owns
+        (docs/monitoring.md): folded into timeline_report()['metrics']
+        and session_observability."""
+        from .metrics import names as MN
+        with self._lock:
+            return {MN.NUM_SPECULATIVE_TASKS: self.speculative_tasks,
+                    MN.NUM_SPECULATION_WINS: self.speculation_wins,
+                    MN.NUM_EVICTED_WORKERS: self.evicted_workers,
+                    MN.NUM_ABANDONED_TASKS: self.abandoned_tasks}
 
     def drain_journals(self) -> Dict[tuple, dict]:
         """Pull every worker's undrained trace-shard events
@@ -776,6 +1264,7 @@ class ProcCluster:
         rep = self.merged_timeline().report(self.straggler_factor)
         if self.monitor is not None:
             rep["metrics"].update(self.monitor.metrics())
+        rep["metrics"].update(self.recovery_metrics())
         return rep
 
     def shutdown(self) -> None:

@@ -628,7 +628,11 @@ TEST_INJECT_NET = _conf(
     "spark.rapids.tpu.test.injectNetFault", "",
     "Deterministic network-fault injection spec over the client-side "
     "shuffle socket-op counter (same grammar as injectOom, minus "
-    "split@).  Testing only.", str, internal=True)
+    "split@).  An @-prefixed item selects a per-SITE ordinal instead "
+    "('rpc:run_reduce@1' fails the 1st run_reduce control rpc; sites "
+    "are the on_net_op labels: metadata, layout, fetch, fetch_shm, "
+    "done, diag, rpc:<method>) — the cluster-rpc fault sweep's "
+    "addressing mode.  Testing only.", str, internal=True)
 TEST_INJECT_CORRUPTION = _conf(
     "spark.rapids.tpu.test.injectCorruption", "",
     "Deterministic single-bit corruption injection over the transfer/"
@@ -649,6 +653,17 @@ TEST_INJECT_DELAY = _conf(
     "restricts the item to the process whose injector scope matches "
     "(executor workers set their executor id as the scope), so "
     "'exec-1/reduce:1500' slows ONLY exec-1's reduce tasks.  "
+    "Testing only.", str, internal=True)
+TEST_INJECT_CRASH = _conf(
+    "spark.rapids.tpu.test.injectCrash", "",
+    "Deterministic worker-crash injection for chaos testing: the worker "
+    "process calls os._exit mid-task at the selected crash point.  Items "
+    "are site-scoped ordinals over the per-process crash-point counter "
+    "('map@2' = this process's 2nd map task, 'reduce@1'), bare ordinals "
+    "count across all sites, 'p=0.02' crashes probabilistically (seeded "
+    "by injectSeed), and a 'scope/' prefix restricts the item to the "
+    "process whose injector scope matches ('exec-1/map@1' kills only "
+    "exec-1, on its 1st map task) — the same scoping injectDelay uses.  "
     "Testing only.", str, internal=True)
 TEST_INJECT_SEED = _conf(
     "spark.rapids.tpu.test.injectSeed", 0,
@@ -769,6 +784,47 @@ TRACE_SHARD_MAX_EVENTS = _conf(
     "evicts the oldest events and is counted in the drain response "
     "(a driver that never drains must not leak worker memory).", int,
     internal=True)
+
+# --- distributed task scheduling: deadlines, backoff, speculation ------------
+TASK_TIMEOUT = _conf(
+    "spark.rapids.sql.tpu.task.timeoutMs", 0,
+    "Per-attempt deadline for a distributed task rpc (run_map/run_reduce "
+    "on a ProcCluster worker), in milliseconds.  A task past its deadline "
+    "is ABANDONED (counted in numAbandonedTasks), its worker is "
+    "health-probed over the heartbeat monitor's dedicated connection, and "
+    "a wedged-but-alive worker is evicted exactly like a dead one "
+    "(replaced, its map fragments recomputed from the lineage).  "
+    "0 (default) derives the deadline from "
+    "spark.rapids.sql.tpu.trace.hungTaskTimeoutMs; set both to 0 to run "
+    "task waves unbounded (the pre-deadline behavior).", int)
+TASK_RETRY_BACKOFF = _conf(
+    "spark.rapids.sql.tpu.task.retryBackoffMs", 200,
+    "Base backoff in milliseconds between distributed task retry waves; "
+    "wave k waits ~base*2^k with deterministic jitter, capped by "
+    "task.maxBackoffMs — a failed wave backs off instead of hammering a "
+    "recovering peer.  0 disables the inter-wave backoff.", int)
+TASK_MAX_BACKOFF = _conf(
+    "spark.rapids.sql.tpu.task.maxBackoffMs", 10000,
+    "Upper bound in milliseconds on the distributed task retry backoff.",
+    int)
+TASK_SPECULATION_ENABLED = _conf(
+    "spark.rapids.sql.tpu.task.speculation.enabled", True,
+    "Speculatively re-execute straggling distributed tasks: when a task "
+    "runs longer than spark.rapids.sql.tpu.trace.stragglerFactor x the "
+    "median task duration of its stage (or past the hung-task watchdog "
+    "bound), a second copy launches on the least-loaded healthy worker "
+    "under a distinct attempt id.  First result wins; the loser is "
+    "cancelled/ignored and map-output registration is attempt-id-guarded "
+    "so the reduce side never reads a mix of attempts "
+    "(numSpeculativeTasks / numSpeculationWins).", _to_bool)
+TASK_MAX_WORKER_REPLACEMENTS = _conf(
+    "spark.rapids.sql.tpu.task.maxWorkerReplacements", 8,
+    "Worker replacements allowed per query (run_map_reduce call) before "
+    "the cluster degrades gracefully: when the budget is exhausted — or "
+    "a replacement spawn itself fails — the dead worker's slot is "
+    "SHRUNK away and its task assignments re-balance onto the surviving "
+    "workers instead of failing the query (worker_shrinks counter, "
+    "journal kind 'spec').  Negative means unlimited.", int)
 
 # --- memory ledger (mem/ledger.py + metrics/memledger.py) --------------------
 MEM_LEDGER_ENABLED = _conf(

@@ -444,6 +444,56 @@ class HostMemoryStore(BufferStore):
         buf.host_leaves = None
 
 
+#: spill-dir naming: tpu_spill_<owner pid>_<random>.  The pid tag is what
+#: lets a LATER process tell an abandoned dir (its owner died without
+#: cleanup — a SIGKILLed/crashed executor worker leaks every shuffle
+#: buffer it ever spilled) from one a live process is still using.
+SPILL_DIR_PREFIX = "tpu_spill_"
+
+
+def sweep_stale_spill_dirs(parent: Optional[str] = None) -> int:
+    """Remove spill dirs whose owning process is dead — the worker
+    bootstrap hygiene sweep: a replaced worker's predecessor spilled
+    shuffle buffers into its own tpu_spill_<pid>_* dir and died without
+    `remove_shuffle` ever reaching it (the fresh process never knew the
+    sid), so the files leak until SOMEONE checks the owner pid.  Dirs
+    without a parseable pid tag (pre-tag naming) are left alone.
+    Returns the number of dirs removed."""
+    import shutil
+    parent = parent or tempfile.gettempdir()
+    removed = 0
+    try:
+        entries = os.listdir(parent)
+    except OSError:
+        return 0
+    for name in entries:
+        if not name.startswith(SPILL_DIR_PREFIX):
+            continue
+        tag = name[len(SPILL_DIR_PREFIX):].split("_", 1)[0]
+        if not tag.isdigit():
+            continue  # pre-pid-tag dir: owner unknowable, keep
+        pid = int(tag)
+        try:
+            os.kill(pid, 0)  # signal 0: existence probe only
+            continue  # owner alive (or pid reused): keep
+        except ProcessLookupError:
+            pass  # tpulint: disable=TPU006 ProcessLookupError IS the probe's answer (owner dead -> the dir is sweepable garbage)
+        except OSError:
+            continue  # tpulint: disable=TPU006 EPERM etc means the pid belongs to SOMEONE — conservatively keep the dir
+        path = os.path.join(parent, name)
+        if not os.path.isdir(path):
+            continue
+        try:
+            shutil.rmtree(path)
+            removed += 1
+        except OSError:
+            from ..metrics.registry import count_swallowed
+            count_swallowed("numCleanupErrors", "spark_rapids_tpu.mem",
+                            "stale spill dir %s could not be removed",
+                            path)
+    return removed
+
+
 class DiskStore(BufferStore):
     """Disk tier (RapidsDiskStore.scala + RapidsDiskBlockManager.scala):
     buffer id -> local spill file."""
@@ -453,7 +503,8 @@ class DiskStore(BufferStore):
     def __init__(self, catalog: "BufferCatalog",
                  spill_dir: Optional[str] = None):
         super().__init__(catalog)
-        self._dir = spill_dir or tempfile.mkdtemp(prefix="tpu_spill_")
+        self._dir = spill_dir or tempfile.mkdtemp(
+            prefix=f"{SPILL_DIR_PREFIX}{os.getpid()}_")
 
     def path_for(self, buffer_id: int) -> str:
         return os.path.join(self._dir, f"tpu_buffer_{buffer_id}.bin")

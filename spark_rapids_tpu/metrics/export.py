@@ -367,6 +367,17 @@ def session_observability(session) -> dict:
                             "reporting 0", e)
     out["wire_bytes_sent"] = wire_sent
     out["wire_bytes_received"] = wire_recv
+    # distributed task recovery (ISSUE 15): speculation races, deadline
+    # abandonments, wedged-worker evictions and graceful shrinks of an
+    # attached ProcCluster — the detect->act half the heartbeat/straggler
+    # sensors (PR 7) report into, next to the wire bytes they ride on
+    pc = getattr(session, "_proc_cluster", None)
+    if pc is not None:
+        rec = {"task_retries": int(pc.task_retries),
+               "lost_map_outputs": int(pc.lost_map_outputs),
+               "worker_shrinks": int(pc.worker_shrinks)}
+        rec.update({k: int(v) for k, v in pc.recovery_metrics().items()})
+        out["cluster_recovery"] = rec
     # process-wide hygiene counters (TPU006, docs/lint.md): swallowed-
     # failure sites that logged + counted instead of passing silently.
     # Snapshotted AFTER the wire scrape, so a scrape failure's own

@@ -75,6 +75,14 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # the plan-cache outcome, journaled into the query's own
                # journal under its trace context
                "sched",
+               # spec = one distributed task-recovery decision
+               # (cluster._run_tasks_with_retry): speculativeLaunch /
+               # speculationWin (straggler re-execution races),
+               # taskAbandoned (attempt past its deadline), workerEvicted
+               # (wedged-but-alive replacement), clusterShrunk (graceful
+               # degradation after the replacement budget) — attrs name
+               # the stage, task index, attempt id and executor
+               "spec",
                # cost = a roofline cost declaration (metrics/roofline.py):
                # a whole-stage program's XLA-HLO-derived flops/bytes (one
                # instant per executed stage, attrs flops/hbm_bytes/source)

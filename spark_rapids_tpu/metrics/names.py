@@ -307,6 +307,31 @@ NUM_MISSED_HEARTBEATS = register_metric(
     "heartbeat polls that failed or timed out on a worker's dedicated "
     "control connection")
 
+# --- speculative execution / task deadlines (cluster.py) ---------------------
+NUM_SPECULATIVE_TASKS = register_metric(
+    "numSpeculativeTasks", COUNTER, ESSENTIAL,
+    "speculative task copies launched on another worker after the "
+    "straggler detector (task > stragglerFactor x stage median, or the "
+    "hung-task watchdog bound) flagged the original attempt")
+NUM_SPECULATION_WINS = register_metric(
+    "numSpeculationWins", COUNTER, ESSENTIAL,
+    "speculative races the COPY won (the copy's result was stored and "
+    "the original attempt was cancelled/ignored); wins minus launches "
+    "says how often speculation paid for itself")
+NUM_EVICTED_WORKERS = register_metric(
+    "numEvictedWorkers", COUNTER, ESSENTIAL,
+    "workers evicted while their process was still ALIVE — wedged past "
+    "the task deadline (health probe answered but the task never "
+    "returned) or holding a speculation loser's side effects — and "
+    "replaced exactly like a dead worker, map fragments recomputed from "
+    "the lineage")
+NUM_ABANDONED_TASKS = register_metric(
+    "numAbandonedTasks", COUNTER, ESSENTIAL,
+    "task attempts abandoned past their deadline "
+    "(spark.rapids.sql.tpu.task.timeoutMs, derived from "
+    "trace.hungTaskTimeoutMs when unset): the rpc was cut off and the "
+    "task re-ran elsewhere instead of stalling the wave forever")
+
 # --- serving tier (serve/: scheduler, admission, plan cache) -----------------
 QUEUE_TIME = register_metric(
     "queueTime", TIMER, ESSENTIAL,
@@ -503,6 +528,27 @@ TRANSPORT_COUNTERS = {
     "socket_fallbacks": "mesh-eligible exchanges de-lowered to the "
                         "socket tier (collective retry ladder exhausted; "
                         "results identical, movement paid on the wire)",
+    # driver-side task-recovery accounting (cluster._run_tasks_with_retry;
+    # per-CAUSE so one flaky worker's retries are distinguishable from an
+    # unrelated late failure's — the per-task retry-budget satellite)
+    "task_retries_dead": "task re-runs caused by a dead worker process "
+                         "(replaced, lineage recomputed)",
+    "task_retries_timeout": "task re-runs caused by an attempt crossing "
+                            "its deadline (worker health-probed, wedged "
+                            "workers evicted)",
+    "task_retries_fetch_failed": "task re-runs caused by a typed "
+                                 "FetchFailed naming a peer whose map "
+                                 "output was lost",
+    "task_retries_speculation": "speculative task copies launched by the "
+                                "straggler detector (also "
+                                "numSpeculativeTasks)",
+    "task_retries_other": "task re-runs after an error that named no "
+                          "dead worker, deadline, or peer (transient rpc "
+                          "faults; re-run on the same worker)",
+    "worker_shrinks": "worker slots removed by graceful degradation: the "
+                      "replacement budget was exhausted (or the spawn "
+                      "itself failed) and the cluster re-balanced onto "
+                      "the survivors instead of failing the query",
 }
 
 # --- runtime pool gauges (mem/runtime.py pool_stats()) ----------------------

@@ -18,6 +18,14 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+#: map-id namespace stride per distributed map FRAGMENT: ProcCluster's
+#: map task i writes blocks with map_id in [i*STRIDE, (i+1)*STRIDE), so a
+#: worker holding its own fragment plus a speculative copy of another has
+#: disjoint ranges, and the attempt-id guard (`remove_map_range` before a
+#: re-run registers anything) can drop exactly one fragment's prior
+#: attempt without touching its neighbors.
+MAP_ID_STRIDE = 1 << 20
+
 
 @dataclass(frozen=True, order=True)
 class ShuffleBlockId:
@@ -84,6 +92,25 @@ class ShuffleBufferCatalog:
             blocks = self._by_shuffle.pop(shuffle_id, [])
             freed: List[int] = []
             for blk in blocks:
+                freed.extend(self._blocks.pop(blk, []))
+            for bid in freed:
+                self._checksums.pop(bid, None)
+                self._block_of.pop(bid, None)
+            return freed
+
+    def remove_map_range(self, shuffle_id: int, map_lo: int,
+                         map_hi: int) -> List[int]:
+        """Unregister every block of one shuffle whose map_id falls in
+        [map_lo, map_hi) — one map FRAGMENT's outputs (the attempt-id
+        guard: a task re-run or a speculation loser's cleanup drops the
+        prior attempt's registrations so the reduce side can never read a
+        mix of attempts).  Returns the buffer ids to free."""
+        with self._lock:
+            blocks = [b for b in self._by_shuffle.get(shuffle_id, [])
+                      if map_lo <= b.map_id < map_hi]
+            freed: List[int] = []
+            for blk in blocks:
+                self._by_shuffle[shuffle_id].remove(blk)
                 freed.extend(self._blocks.pop(blk, []))
             for bid in freed:
                 self._checksums.pop(bid, None)

@@ -370,6 +370,30 @@ class ShuffleEnv:
         for bid in self.received.drop_since(shuffle_id, mark):
             self.runtime.free_batch(bid)
 
+    def remove_map_outputs(self, shuffle_id: int, map_lo: int,
+                           map_hi: int) -> int:
+        """Attempt-id guard: drop this executor's registered outputs for
+        ONE map fragment (map ids in [map_lo, map_hi)) — buffers, serving
+        cache, checksums and AQE statistics.  Called before a task re-run
+        registers anything (so a retried or speculated attempt atomically
+        supersedes a prior partial attempt on the same worker) and for a
+        speculation loser's cleanup.  Returns the number of buffers
+        dropped."""
+        freed = self.catalog.remove_map_range(shuffle_id, map_lo, map_hi)
+        if not freed:
+            return 0
+        # serving-cache eviction FIRST, same ordering as remove_shuffle:
+        # a peer mid-stream must fall through to the catalog's typed
+        # buffer-gone, not keep streaming a superseded attempt's bytes
+        self.server.invalidate(freed)
+        for bid in freed:
+            with self._lock:
+                if self._baseline_buffers.pop(bid, None) is not None:
+                    continue
+            self.runtime.free_batch(bid)
+        self.map_stats.remove_map_range(shuffle_id, map_lo, map_hi)
+        return len(freed)
+
     def remove_shuffle(self, shuffle_id: int) -> None:
         # the shuffle's map statistics go with its buffers — a long-lived
         # session would otherwise accumulate stats for every query it

@@ -992,7 +992,9 @@ class SocketClient(ShuffleTransportClient):
         monitor's liveness polls ride a dedicated client with one."""
         with self._lock:
             if self.inject_faults:
-                faults.INJECTOR.on_net_op("rpc")
+                # method-qualified site so the injectNetFault sweep can
+                # aim at ONE control-plane rpc ('rpc:run_reduce@1')
+                faults.INJECTOR.on_net_op(f"rpc:{method}")
             try:
                 sock = self._conn_locked()
                 # compile-friendly: no I/O deadline unless opted in
@@ -1126,7 +1128,14 @@ class SocketTransport(ShuffleTransport):
         self.address = self._server.address  # tpulint: disable=TPU009 startup wiring precedes every thread that could race it
         self._peers[executor_id] = self.address
 
-    def set_peers(self, peers: Dict[str, Tuple[str, int]]) -> None:
+    def set_peers(self, peers: Dict[str, Tuple[str, int]],
+                  replace: bool = False) -> None:
+        """Adopt a peer address map.  `replace=True` additionally PRUNES
+        peers absent from the new map (a worker slot the driver shrunk
+        away under graceful degradation) — their cached clients close so
+        no future fetch dials the dead address.  The transport's OWN
+        entry survives a replace: the driver's full map always names
+        every live worker including the recipient."""
         stale = []
         with self._lock:
             for k, v in peers.items():
@@ -1136,6 +1145,10 @@ class SocketTransport(ShuffleTransport):
                     # cached client holds a socket to the DEAD process
                     stale.append(self._clients.pop(k, None))
                 self._peers[k] = addr
+            if replace:
+                for k in [k for k in self._peers if k not in peers]:
+                    del self._peers[k]
+                    stale.append(self._clients.pop(k, None))
         for client in stale:
             if client is not None:
                 client.close()

@@ -58,6 +58,16 @@ class WorkerHandler:
         from .manager import ShuffleEnv
         from .net import SocketTransport
         self.executor_id = executor_id
+        # bootstrap hygiene: reap spill dirs leaked by DEAD predecessors
+        # (a replaced worker's shuffle files on disk — the fresh process
+        # never knew the sid, so remove_shuffle can never reach them)
+        from ..mem.stores import sweep_stale_spill_dirs
+        swept = sweep_stale_spill_dirs()
+        if swept:
+            import logging
+            logging.getLogger("spark_rapids_tpu.shuffle").info(
+                "worker %s bootstrap swept %d stale spill dir(s) left by "
+                "dead processes", executor_id, swept)
         # worker bootstrap shares the engine's persistent-compile-cache
         # setup (utils/compile_cache.py): every executor process replays
         # the same on-disk XLA cache instead of re-paying compile time
@@ -107,6 +117,13 @@ class WorkerHandler:
         # only the worker whose executor id equals the scope
         from ..utils import faults
         faults.INJECTOR.set_scope(executor_id)
+        # per-(sid, fragment) attempt serialization: a re-run, a
+        # speculative copy's cleanup, and a still-running prior attempt
+        # of the SAME fragment must never interleave their registration
+        # surgery — remove_map_range waits for the in-flight writer, so
+        # once it returns nothing re-registers behind it
+        self._frag_locks: Dict[tuple, threading.Lock] = {}
+        self._frag_locks_guard = threading.Lock()
         # live-progress bookkeeping the heartbeat reports
         self._hb_lock = threading.Lock()
         self._hb_seq = 0
@@ -132,8 +149,9 @@ class WorkerHandler:
         import jax
         return jax.devices()[0].platform
 
-    def rpc_set_peers(self, peers: Dict[str, tuple]):
-        self.transport.set_peers(peers)
+    def rpc_set_peers(self, peers: Dict[str, tuple],
+                      replace: bool = False):
+        self.transport.set_peers(peers, replace=replace)
         self.peers = [p for p in peers if p != self.executor_id]
         return sorted(peers)
 
@@ -144,11 +162,14 @@ class WorkerHandler:
         return {k: list(v) for k, v in self.transport._peers.items()}
 
     @contextlib.contextmanager
-    def _task(self, name: str, trace: Optional[Dict], sid: int):
-        """Task scope: a `task` span in the trace shard, the DRIVER's
-        trace context installed on this thread (so every wire request the
-        task issues carries it), the task registered for heartbeat
-        active-task snapshots, and the straggler-test delay hook."""
+    def _task(self, name: str, trace: Optional[Dict], sid: int,
+              attempt: int = 0):
+        """Task scope: a `task` span in the trace shard (attempt-stamped,
+        so speculative copies are distinguishable on the timeline), the
+        DRIVER's trace context installed on this thread (so every wire
+        request the task issues carries it), the task registered for
+        heartbeat active-task snapshots, the straggler-test delay hook,
+        and the chaos tier's crash point (os._exit mid-task)."""
         from ..metrics import journal as J
         from ..utils import faults
         query = (trace or {}).get("query")
@@ -157,7 +178,8 @@ class WorkerHandler:
         if self.shard is not None:
             span = self.shard.begin("task", name, query=query,
                                     stage=stage, shuffle=sid,
-                                    executor=self.executor_id)
+                                    executor=self.executor_id,
+                                    attempt=attempt)
         with self._hb_lock:
             self._task_counter += 1
             tid = self._task_counter
@@ -169,6 +191,10 @@ class WorkerHandler:
             with J.trace_context(query=query, stage=stage, span=span,
                                  executor=self.executor_id):
                 faults.INJECTOR.on_delay(name)
+                # chaos crash point AFTER the delay hook: injectDelay +
+                # injectCrash compose into "die N ms INTO the task" —
+                # the rpc is in flight, partial side effects may exist
+                faults.INJECTOR.on_crash(name)
                 yield
             ok = True
         finally:
@@ -185,22 +211,54 @@ class WorkerHandler:
 
     def rpc_run_map(self, sid: int, plan_blob: bytes,
                     key_names: List[str], n_parts: int,
-                    trace: Optional[Dict] = None):
+                    trace: Optional[Dict] = None, map_id_base: int = 0,
+                    attempt: int = 0):
         """Execute the fragment, hash-partition on the keys, write all
         partitions to the local catalog.  Returns per-partition row
-        counts (the MapStatus analogue)."""
-        with self._task("map", trace, sid):
-            return self._run_map(sid, plan_blob, key_names, n_parts)
+        counts (the MapStatus analogue).
+
+        `map_id_base` namespaces this fragment's block map-ids
+        ([base, base + MAP_ID_STRIDE), catalog.MAP_ID_STRIDE), and the
+        ATTEMPT-ID GUARD below makes registration atomic per attempt:
+        any prior attempt's registrations for this fragment on THIS
+        worker (a retried rpc that half-ran, a superseded speculative
+        copy) are dropped before the new attempt writes its first block,
+        so the reduce side can never read a mix of attempts."""
+        with self._task("map", trace, sid, attempt=attempt):
+            return self._run_map(sid, plan_blob, key_names, n_parts,
+                                 map_id_base)
+
+    def _fragment_lock(self, sid: int, map_id_base: int):
+        key = (sid, map_id_base)
+        with self._frag_locks_guard:
+            lock = self._frag_locks.get(key)
+            if lock is None:
+                lock = self._frag_locks[key] = threading.Lock()
+            return lock
 
     def _run_map(self, sid: int, plan_blob: bytes,
-                 key_names: List[str], n_parts: int):
+                 key_names: List[str], n_parts: int,
+                 map_id_base: int = 0):
+        with self._fragment_lock(sid, map_id_base):
+            return self._run_map_locked(sid, plan_blob, key_names,
+                                        n_parts, map_id_base)
+
+    def _run_map_locked(self, sid: int, plan_blob: bytes,
+                        key_names: List[str], n_parts: int,
+                        map_id_base: int = 0):
         import pickle
 
         from ..columnar import ColumnarBatch
         from ..exec.base import ExecContext, TpuExec
         from ..ops import expressions as E
+        from .catalog import MAP_ID_STRIDE
         from .partition import hash_partition_ids, split_by_partition
 
+        # attempt-id guard: supersede any earlier attempt of THIS
+        # fragment before registering anything (idempotent re-runs; the
+        # fragment lock guarantees no prior attempt is still writing)
+        self.env.remove_map_outputs(sid, map_id_base,
+                                    map_id_base + MAP_ID_STRIDE)
         logical = pickle.loads(plan_blob)
         physical = self.session.plan(logical)
         schema = physical.schema
@@ -231,7 +289,8 @@ class WorkerHandler:
                         pids = round_robin_partition_ids(
                             batch.capacity, n_parts, map_id)
                     for p, sub in split_by_partition(batch, pids, n_parts):
-                        self.env.write_partition(sid, map_id, p, sub)
+                        self.env.write_partition(sid, map_id_base + map_id,
+                                                 p, sub)
                         written[p] = written.get(p, 0) + sub.num_rows_host()
             finally:
                 if on_tpu:
@@ -243,10 +302,11 @@ class WorkerHandler:
         return {"written_rows": written}
 
     def rpc_run_reduce(self, sid: int, partitions: List[int],
-                       plan_blob: bytes, trace: Optional[Dict] = None):
+                       plan_blob: bytes, trace: Optional[Dict] = None,
+                       attempt: int = 0):
         """Fetch owned partitions (local + every peer over the wire), run
         the reduce fragment per partition, return arrow IPC bytes."""
-        with self._task("reduce", trace, sid):
+        with self._task("reduce", trace, sid, attempt=attempt):
             return self._run_reduce(sid, partitions, plan_blob)
 
     def _run_reduce(self, sid: int, partitions: List[int],
@@ -359,6 +419,32 @@ class WorkerHandler:
 
     def rpc_remove_shuffle(self, sid: int):
         self.env.remove_shuffle(sid)
+        with self._frag_locks_guard:  # the locks die with the shuffle
+            for key in [k for k in self._frag_locks if k[0] == sid]:
+                del self._frag_locks[key]
+        return True
+
+    def rpc_remove_map_range(self, sid: int, lo: int, hi: int):
+        """Drop one map fragment's registered outputs (speculation-loser
+        cleanup / the driver-side half of the attempt-id guard).  Takes
+        the fragment lock, so a still-running attempt of the fragment is
+        WAITED OUT first — after this returns, nothing re-registers the
+        superseded attempt's blocks (the caller's rpc deadline bounds
+        the wait; a wedge past it escalates to eviction driver-side)."""
+        with self._fragment_lock(sid, lo):
+            return self.env.remove_map_outputs(sid, lo, hi)
+
+    def rpc_inject_faults(self, oom: str = "", net: str = "",
+                          corruption: str = "", delay: str = "",
+                          crash: str = "", seed: int = 0):
+        """(Re)arm this worker's process-global fault injector — the
+        chaos soak's per-round control plane: one long-lived cluster
+        cycles through kill/delay/corrupt plans without respawning
+        workers (replacements spawn from the base conf, i.e. healthy)."""
+        from ..utils import faults
+        faults.INJECTOR.configure(oom_spec=oom, net_spec=net, seed=seed,
+                                  corrupt_spec=corruption,
+                                  delay_spec=delay, crash_spec=crash)
         return True
 
     def rpc_shutdown(self):

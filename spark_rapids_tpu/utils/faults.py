@@ -10,6 +10,8 @@ tier-1 tests exercise every retry path on CPU with zero real pressure:
   spark.rapids.tpu.test.injectNetFault    fail the Nth client socket op
   spark.rapids.tpu.test.injectCorruption  flip a bit in the Nth
                                           transferred chunk / spilled leaf
+  spark.rapids.tpu.test.injectCrash       os._exit the worker process at
+                                          the Nth crash point (chaos tier)
   spark.rapids.tpu.test.injectSeed        seed for the probabilistic mode
 
 Spec grammar (comma-separated items, 1-based ordinals over the process-wide
@@ -84,14 +86,21 @@ class _Plan:
 
 
 class _CorruptPlan:
-    """Parsed corruption plan: @-prefixes are SITE names with per-site
-    ordinals ('wire@3' = 3rd corruptible op at site wire); bare ordinals
-    count across every site; 'p=' corrupts probabilistically."""
+    """Parsed site-addressed fault plan, shared by the corruption, net
+    and crash categories: @-prefixes are SITE names with per-site
+    ordinals ('wire@3' = 3rd corruptible op at site wire,
+    'rpc:run_reduce@1' = 1st run_reduce control rpc, 'map@2' = this
+    process's 2nd map task); bare ordinals count across every site;
+    'p=' fires probabilistically; an optional 'scope/' prefix (delay
+    grammar) restricts the item to the process whose injector scope
+    matches ('exec-1/map@1' — worker executor ids)."""
 
     def __init__(self, spec: str = "", seed: int = 0):
         self.spec = spec
         self.global_ordinals: Dict[int, bool] = {}
         self.site_ordinals: Dict[str, Dict[int, bool]] = {}
+        # scoped items: (scope, site or None, first ordinal, repeat)
+        self.scoped: List[Tuple[str, Optional[str], int, int]] = []
         self.prob = 0.0
         self.rng = random.Random(seed)
         for raw in (spec or "").split(","):
@@ -101,6 +110,9 @@ class _CorruptPlan:
             if item.startswith("p="):
                 self.prob = float(item[2:])
                 continue
+            scope = None
+            if "/" in item:
+                scope, item = item.split("/", 1)
             site = None
             if "@" in item:
                 site, item = item.split("@", 1)
@@ -109,16 +121,28 @@ class _CorruptPlan:
                 start, rep = int(start_s), int(rep_s)
             else:
                 start, rep = int(item), 1
+            if scope is not None:
+                self.scoped.append((scope, site, start, rep))
+                continue
             dest = (self.global_ordinals if site is None
                     else self.site_ordinals.setdefault(site, {}))
             for o in range(start, start + rep):
                 dest[o] = True
 
-    def check(self, n_global: int, site: str, n_site: int) -> bool:
+    def check(self, n_global: int, site: str, n_site: int,
+              scope: Optional[str] = None) -> bool:
         if self.global_ordinals.get(n_global):
             return True
         if self.site_ordinals.get(site, {}).get(n_site):
             return True
+        for sc, st, start, rep in self.scoped:
+            if sc != scope:
+                continue
+            if st is not None and st != site:
+                continue
+            n = n_global if st is None else n_site
+            if start <= n < start + rep:
+                return True
         return self.prob > 0 and self.rng.random() < self.prob
 
 
@@ -166,12 +190,14 @@ class FaultInjector:
     def reset(self) -> None:
         with self._lock:
             self._oom = _Plan()
-            self._net = _Plan()
+            self._net = _CorruptPlan()
             self._corrupt = _CorruptPlan()
             self._delay = _DelayPlan()
+            self._crash = _CorruptPlan()
             self._oom_count = 0
             self._net_count = 0
             self._corrupt_count = 0
+            self._crash_count = 0
             self._configured = None
             self.site_counts: Dict[str, int] = {}
             self.injected_log: "deque" = deque(maxlen=INJECTED_LOG_CAP)
@@ -192,12 +218,12 @@ class FaultInjector:
 
     def configure(self, oom_spec: str = "", net_spec: str = "",
                   seed: int = 0, corrupt_spec: str = "",
-                  delay_spec: str = "") -> None:
+                  delay_spec: str = "", crash_spec: str = "") -> None:
         """(Re)arm the injector.  Counters reset only when the spec actually
         changes, so every runtime/transport bring-up in one query can call
         this without restarting the op count mid-flight."""
         key = (oom_spec or "", net_spec or "", corrupt_spec or "",
-               int(seed), delay_spec or "")
+               int(seed), delay_spec or "", crash_spec or "")
         with self._lock:
             if self._configured == key:
                 return
@@ -207,17 +233,32 @@ class FaultInjector:
             # (the next identical configure() would early-exit and leave
             # it armed wrong forever)
             oom = _Plan(key[0], seed=key[3])
-            net = _Plan(key[1], seed=key[3] + 1)
+            # net faults ride the corruption-plan grammar: bare/windowed
+            # ordinals over the global socket-op counter plus @-prefixed
+            # per-SITE ordinals ('rpc:run_reduce@1'), so the cluster-rpc
+            # fault sweep can aim at one rpc method deterministically.
+            # Legacy compat: the pre-site grammar spelled the (only) net
+            # kind explicitly ('retry@2' = fail op #2) — strip it so an
+            # old spec keeps firing instead of parsing as an unknown
+            # site named 'retry' that never matches
+            net_spec = ",".join(
+                it.strip()[len("retry@"):]
+                if it.strip().startswith("retry@") else it.strip()
+                for it in key[1].split(","))
+            net = _CorruptPlan(net_spec, seed=key[3] + 1)
             corrupt = _CorruptPlan(key[2], seed=key[3] + 2)
             delay = _DelayPlan(key[4])
+            crash = _CorruptPlan(key[5], seed=key[3] + 3)
             self._configured = key
             self._oom = oom
             self._net = net
             self._corrupt = corrupt
             self._delay = delay
+            self._crash = crash
             self._oom_count = 0
             self._net_count = 0
             self._corrupt_count = 0
+            self._crash_count = 0
             self.site_counts = {}
             self.injected_log = deque(maxlen=INJECTED_LOG_CAP)
             self.injected_log_dropped = 0
@@ -228,7 +269,8 @@ class FaultInjector:
                        str(conf.get(C.TEST_INJECT_NET) or ""),
                        int(conf.get(C.TEST_INJECT_SEED) or 0),
                        str(conf.get(C.TEST_INJECT_CORRUPTION) or ""),
-                       str(conf.get(C.TEST_INJECT_DELAY) or ""))
+                       str(conf.get(C.TEST_INJECT_DELAY) or ""),
+                       str(conf.get(C.TEST_INJECT_CRASH) or ""))
 
     # ---- stats (test observability) ----------------------------------------
 
@@ -268,19 +310,57 @@ class FaultInjector:
                       injected=True)
 
     def on_net_op(self, site: str) -> None:
-        """Called before every client-side shuffle socket operation."""
+        """Called before every client-side shuffle socket operation.
+        Matches both global ordinals and per-site ordinals ('site@N' in
+        the spec fails the Nth op at THAT site only)."""
         with self._lock:
             self._net_count += 1
             n = self._net_count
             key = f"net:{site}"
-            self.site_counts[key] = self.site_counts.get(key, 0) + 1
-            kind = self._net.check(n)
-            if kind is not None:
+            n_site = self.site_counts.get(key, 0) + 1
+            self.site_counts[key] = n_site
+            hit = self._net.check(n, site, n_site, self.scope)
+            if hit:
                 self._log_injected_locked(("net", n, site))
-        if kind is not None:
+        if hit:
             raise InjectedNetFault(
                 f"[fault-injection] forced net fault at op #{n} "
                 f"(site={site})")
+
+    @property
+    def crash_ops(self) -> int:
+        with self._lock:
+            return self._crash_count
+
+    def on_crash(self, site: str) -> None:
+        """Called at worker crash points (task entry, after any injected
+        delay — 'mid-task' from the driver's perspective: the task rpc is
+        in flight and partial side effects may exist).  When the armed
+        plan selects this op the PROCESS DIES via os._exit — no cleanup,
+        no exception propagation: the honest analogue of a worker box
+        losing power, which is exactly what the chaos tier recovers
+        from."""
+        with self._lock:
+            self._crash_count += 1
+            n = self._crash_count
+            key = f"crash:{site}"
+            n_site = self.site_counts.get(key, 0) + 1
+            self.site_counts[key] = n_site
+            hit = self._crash.check(n, site, n_site, self.scope)
+            if hit:
+                self._log_injected_locked(("crash", n, site))
+        if hit:
+            import logging
+            import os
+            import sys
+            logging.getLogger("spark_rapids_tpu.faults").warning(
+                "[fault-injection] forced crash at op #%d (site=%s, "
+                "scope=%s): os._exit(17)", n, site, self.scope)
+            try:
+                sys.stderr.flush()
+            except Exception:  # noqa: BLE001 — dying anyway
+                pass  # tpulint: disable=TPU006 the process exits on the next line; a flush failure changes nothing
+            os._exit(17)
 
     def on_delay(self, site: str) -> float:
         """Called at conf-declared slowdown points (worker task entry,
@@ -319,7 +399,7 @@ class FaultInjector:
             key = f"corrupt:{site}"
             n_site = self.site_counts.get(key, 0) + 1
             self.site_counts[key] = n_site
-            hit = self._corrupt.check(n, site, n_site)
+            hit = self._corrupt.check(n, site, n_site, self.scope)
             if hit:
                 self._log_injected_locked(("corrupt", n, site))
         if hit and view is not None and len(view):

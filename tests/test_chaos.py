@@ -1,0 +1,584 @@
+"""Chaos tier (ISSUE 15): speculative execution, task deadlines with
+backoff, eviction of wedged workers, graceful degradation, and the
+randomized chaos soak.
+
+Fast half (not slow): fault-plan grammar units (injectCrash site/scope
+ordinals, injectNetFault per-site addressing), the stale-spill-dir
+bootstrap sweep, the attempt-id-guard catalog surgery, and the per-task
+retry-budget semantics against a live 2-worker cluster.
+
+Slow half (3-worker ProcCluster acceptance):
+  * injectCrash kills a worker MID-TASK (os._exit) — recovery replaces
+    it, recomputes the lineage, and the result is bit-for-bit;
+  * a conf-armed crash loop + an exhausted replacement budget degrades
+    gracefully: the slot shrinks, tasks re-balance, the query completes;
+  * a wedged (delay-injected, alive) worker is abandoned at the task
+    deadline, health-probed, EVICTED like a dead one — bounded wall
+    clock instead of an unbounded blocking join;
+  * an injected-delay straggler loses a speculative race: the copy on
+    the least-loaded healthy worker finishes first, the result is
+    identical, numSpeculationWins moves;
+  * the seeded chaos soak: >= 20 rounds of random kills / delays /
+    corruption on one long-lived 3-worker cluster, every round
+    bit-for-bit vs the oracle with bounded recovery time and zero hangs.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from collections import defaultdict
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.engine import DataFrame, TpuSession
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.logical import col, functions as F
+from spark_rapids_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+ROWS = 480
+N_KEYS = 16
+
+
+def _kv_table(rows: int = ROWS) -> pa.Table:
+    """Integer-valued k/v so grouped sums are order-invariant EXACTLY:
+    chaotic recovery (speculation, shrink re-balancing) legitimately
+    permutes float accumulation order; int64 keeps bit-for-bit honest."""
+    return pa.table({"k": pa.array([i % N_KEYS for i in range(rows)],
+                                   pa.int64()),
+                     "v": pa.array([3 * i + 1 for i in range(rows)],
+                                   pa.int64())})
+
+
+def _expected(table: pa.Table) -> dict:
+    agg = defaultdict(lambda: [0, 0])
+    for k, v in zip(table["k"].to_pylist(), table["v"].to_pylist()):
+        agg[k][0] += v
+        agg[k][1] += 1
+    return {k: tuple(x) for k, x in agg.items()}
+
+
+def _plans(session, table, n_workers):
+    step = (table.num_rows + n_workers - 1) // n_workers
+    map_plans = [session.from_arrow(table.slice(i * step, step)).plan
+                 for i in range(n_workers)]
+    map_schema = DataFrame(session, map_plans[0]).schema
+    reduce_plan = (DataFrame(session, L.LogicalPlaceholder(map_schema))
+                   .group_by(col("k"))
+                   .agg(F.sum(col("v")).alias("sv"),
+                        F.count(col("v")).alias("c"))).plan
+    return map_plans, reduce_plan
+
+
+def _check(result: pa.Table, expected: dict) -> None:
+    got = {k: (sv, c) for k, sv, c in
+           zip(result["k"].to_pylist(), result["sv"].to_pylist(),
+               result["c"].to_pylist())}
+    assert got == expected, f"result diverged: {got} != {expected}"
+
+
+# --------------------------------------------------------------------------
+# fast: fault-plan grammar
+# --------------------------------------------------------------------------
+
+def test_crash_plan_site_scope_and_window_grammar():
+    # ONE parser serves the corruption/net/crash categories
+    # (faults._CorruptPlan): sites, windows, scopes, bare ordinals
+    p = faults._CorruptPlan("exec-1/map@1, reduce@2x2, 7")
+    # scoped site ordinal: only the matching scope's 1st map op
+    assert p.check(99, "map", 1, "exec-1")
+    assert not p.check(99, "map", 1, "exec-0")
+    assert not p.check(99, "map", 2, "exec-1")
+    # unscoped site window: reduce ops 2 and 3 in ANY process
+    assert p.check(99, "reduce", 2, None)
+    assert p.check(99, "reduce", 3, "whoever")
+    assert not p.check(99, "reduce", 4, None)
+    # bare ordinal: the 7th crash point across all sites
+    assert p.check(7, "map", 5, None)
+    assert not p.check(8, "map", 5, None)
+
+
+def test_crash_plan_probabilistic_is_seed_deterministic():
+    a = faults._CorruptPlan("p=0.5", seed=7)
+    b = faults._CorruptPlan("p=0.5", seed=7)
+    draws_a = [a.check(i, "map", i, None) for i in range(64)]
+    draws_b = [b.check(i, "map", i, None) for i in range(64)]
+    assert draws_a == draws_b
+    assert any(draws_a) and not all(draws_a)
+
+
+def test_net_plan_per_site_ordinals():
+    """injectNetFault's new @-prefixed addressing: 'rpc:run_reduce@1'
+    must fire on the 1st run_reduce control rpc and nothing else."""
+    faults.INJECTOR.configure(net_spec="rpc:run_reduce@1")
+    inj = faults.INJECTOR
+    inj.on_net_op("rpc:run_map")            # different site: no fault
+    inj.on_net_op("metadata")
+    with pytest.raises(faults.InjectedNetFault):
+        inj.on_net_op("rpc:run_reduce")
+    inj.on_net_op("rpc:run_reduce")         # ordinal spent
+
+
+def test_inject_crash_conf_registered():
+    from spark_rapids_tpu import config as C
+    conf = C.TpuConf({"spark.rapids.tpu.test.injectCrash": "map@1"})
+    assert conf.get(C.TEST_INJECT_CRASH) == "map@1"
+    # configure_from_conf must arm the crash plan without error
+    faults.INJECTOR.configure_from_conf(conf)
+    assert faults.INJECTOR._crash.site_ordinals.get("map")
+
+
+# --------------------------------------------------------------------------
+# fast: stale spill-dir sweep (satellite: replaced-worker disk leak)
+# --------------------------------------------------------------------------
+
+def test_sweep_stale_spill_dirs(tmp_path):
+    from spark_rapids_tpu.mem.stores import (SPILL_DIR_PREFIX,
+                                             sweep_stale_spill_dirs)
+    parent = str(tmp_path)
+    # a DEAD owner's dir: spawn a real process, let it exit, use its pid
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait(timeout=30)
+    dead = os.path.join(parent, f"{SPILL_DIR_PREFIX}{proc.pid}_abc")
+    os.makedirs(dead)
+    with open(os.path.join(dead, "tpu_buffer_1.bin"), "wb") as f:
+        f.write(b"leaked shuffle bytes")
+    # a LIVE owner's dir (ours) and a legacy dir without a pid tag
+    live = os.path.join(parent, f"{SPILL_DIR_PREFIX}{os.getpid()}_def")
+    legacy = os.path.join(parent, f"{SPILL_DIR_PREFIX}ghi")
+    os.makedirs(live)
+    os.makedirs(legacy)
+    removed = sweep_stale_spill_dirs(parent)
+    assert removed == 1
+    assert not os.path.exists(dead), "dead owner's spill dir must go"
+    assert os.path.exists(live), "live owner's dir must survive"
+    assert os.path.exists(legacy), "untagged legacy dir must survive"
+    # idempotent
+    assert sweep_stale_spill_dirs(parent) == 0
+
+
+def test_disk_store_dir_carries_owner_pid():
+    from spark_rapids_tpu.mem.stores import (BufferCatalog, DiskStore,
+                                             SPILL_DIR_PREFIX)
+    store = DiskStore(BufferCatalog())
+    name = os.path.basename(store._dir)
+    assert name.startswith(f"{SPILL_DIR_PREFIX}{os.getpid()}_")
+
+
+# --------------------------------------------------------------------------
+# fast: attempt-id-guarded registration (catalog + tracker surgery)
+# --------------------------------------------------------------------------
+
+def test_catalog_remove_map_range():
+    from spark_rapids_tpu.shuffle.catalog import (ShuffleBlockId,
+                                                  ShuffleBufferCatalog)
+    cat = ShuffleBufferCatalog()
+    cat.add_buffer(ShuffleBlockId(5, 0, 0), 100)
+    cat.add_buffer(ShuffleBlockId(5, 0, 1), 101)
+    cat.add_buffer(ShuffleBlockId(5, 1 << 20, 0), 102)
+    freed = cat.remove_map_range(5, 0, 1 << 20)
+    assert sorted(freed) == [100, 101]
+    assert cat.buffers_for(ShuffleBlockId(5, 1 << 20, 0)) == [102]
+    assert cat.blocks_for_reduce(5, 0) == [ShuffleBlockId(5, 1 << 20, 0)]
+
+
+def test_tracker_remove_map_range_bumps_epoch_once():
+    from spark_rapids_tpu.adaptive.stats import MapOutputTracker
+    tr = MapOutputTracker()
+    tr.record(5, 0, 0, 100, 10)
+    tr.record(5, 0, 1, 50, 5)
+    tr.record(5, 1 << 20, 0, 70, 7)
+    e0 = tr.epoch
+    tr.remove_map_range(5, 0, 1 << 20)
+    snap = tr.snapshot(5)
+    assert snap[0]["maps"] == {1 << 20: 70}
+    assert snap[0]["bytes"] == 70
+    assert snap[1]["maps"] == {}
+    assert tr.epoch == e0 + 1
+    tr.remove_map_range(5, 0, 1 << 20)  # nothing left: no epoch churn
+    assert tr.epoch == e0 + 1
+
+
+def test_run_map_rerun_is_idempotent_via_attempt_guard():
+    """The attempt-id guard end to end, in process: a re-run of the SAME
+    map fragment (a retried rpc that half-ran) must supersede, not
+    duplicate, its earlier registrations."""
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.mem.runtime import TpuRuntime
+    from spark_rapids_tpu.shuffle.catalog import MAP_ID_STRIDE
+    from spark_rapids_tpu.shuffle.manager import ShuffleEnv
+    table = _kv_table(64)
+    conf = TpuConf()
+    env = ShuffleEnv(TpuRuntime(conf), conf, "guard-exec")
+    batch = ColumnarBatch.from_arrow(table)
+    for _attempt in range(2):  # write the SAME fragment twice
+        env.remove_map_outputs(7, 0, MAP_ID_STRIDE)
+        env.write_partition(7, 0, 0, batch)
+    got = list(env.fetch_partition(7, 0))
+    total = sum(b.num_rows_host() for b in got)
+    assert total == 64, f"duplicate attempt visible: {total} rows"
+    st = env.map_stats.stats(7, 1)
+    assert st.total_rows == 64
+
+
+# --------------------------------------------------------------------------
+# fast-ish: per-task retry budget semantics (satellite)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_retry_budget_is_per_task_not_global():
+    """One flaky task must not exhaust the budget for an unrelated late
+    failure: task 0 needs BOTH its retries while task 1 fails once —
+    under the old global wave counter this raised; per-task budgets
+    converge.  Causes land in the driver transport counters."""
+    from spark_rapids_tpu.cluster import ProcCluster
+    cluster = ProcCluster(
+        2, conf={"spark.rapids.sql.tpu.task.retryBackoffMs": "10"},
+        cpu=True, max_task_retries=2)
+    try:
+        fails = {0: 2, 1: 1}  # scripted failures per task
+        done = {}
+
+        def attempt(i, worker=None, attempt_id=1):
+            if fails[i] > 0:
+                fails[i] -= 1
+                raise RuntimeError(f"scripted transient failure task {i}")
+            return f"ok-{i}"
+
+        def store(i, out, worker=None):
+            done[i] = out
+
+        cluster._run_tasks_with_retry("synthetic", attempt, store,
+                                      n_tasks=2)
+        assert done == {0: "ok-0", 1: "ok-1"}
+        drv = cluster.transport_counters()["driver"]
+        assert drv.get("task_retries_other", 0) == 3
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_retry_budget_exhaustion_still_raises():
+    from spark_rapids_tpu.cluster import ProcCluster
+    cluster = ProcCluster(
+        1, conf={"spark.rapids.sql.tpu.task.retryBackoffMs": "10"},
+        cpu=True, max_task_retries=1)
+    try:
+        def attempt(i, worker=None, attempt_id=1):
+            raise RuntimeError("always fails")
+
+        with pytest.raises(RuntimeError, match="failed after 1 retries"):
+            cluster._run_tasks_with_retry("synthetic", attempt,
+                                          lambda i, out, worker=None: None,
+                                          n_tasks=1)
+    finally:
+        cluster.shutdown()
+
+
+# --------------------------------------------------------------------------
+# slow: ProcCluster chaos acceptance
+# --------------------------------------------------------------------------
+
+def _mk_cluster(n_workers, extra_conf=None, session=None, retries=2):
+    from spark_rapids_tpu.cluster import ProcCluster
+    conf = {"spark.rapids.sql.tpu.task.retryBackoffMs": "50",
+            "spark.rapids.sql.tpu.task.maxBackoffMs": "500",
+            "spark.rapids.shuffle.retry.backoffBaseMs": "5",
+            "spark.rapids.sql.tpu.trace.heartbeatIntervalMs": "200"}
+    conf.update(extra_conf or {})
+    return ProcCluster(n_workers, conf=conf, cpu=True,
+                       max_task_retries=retries, session=session)
+
+
+def _arm(cluster, executor_id, **specs):
+    """Arm ONE worker's injector at runtime (rpc_inject_faults): the
+    chaos control plane — replacements spawn from the base conf, i.e.
+    healthy, so a killed worker does not re-kill itself forever."""
+    w = next(w for w in cluster.workers if w.executor_id == executor_id)
+    w.rpc("inject_faults", **specs)
+
+
+@pytest.mark.slow
+def test_inject_crash_kills_worker_mid_task_and_recovers():
+    """injectCrash (worker-side os._exit mid-map) -> dead-worker
+    classification, replacement, lineage recompute, bit-for-bit result."""
+    session = TpuSession()
+    table = _kv_table()
+    expected = _expected(table)
+    cluster = _mk_cluster(3)
+    try:
+        map_plans, reduce_plan = _plans(session, table, 3)
+        # warm (also proves the workers healthy before the chaos round)
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 6,
+                                           reduce_plan)
+        _check(result, expected)
+        _arm(cluster, "exec-1", crash="map@1")
+        pid_before = cluster.workers[1].proc.pid
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 6,
+                                           reduce_plan)
+        _check(result, expected)
+        assert cluster.workers[1].proc.pid != pid_before, \
+            "crashed worker was never replaced"
+        assert cluster.task_retries >= 1
+        drv = cluster.transport_counters()["driver"]
+        assert drv.get("task_retries_dead", 0) >= 1
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_conf_armed_crash_loop_degrades_to_shrink():
+    """The conf-armed crash grammar end to end: 'exec-1/map@1' re-arms
+    in EVERY process under that executor id (replacements included), so
+    with the replacement budget at zero the only road to a result is
+    graceful degradation — shrink the slot, re-balance, finish."""
+    session = TpuSession()
+    table = _kv_table()
+    expected = _expected(table)
+    cluster = _mk_cluster(
+        2, {"spark.rapids.tpu.test.injectCrash": "exec-1/map@1",
+            "spark.rapids.sql.tpu.task.maxWorkerReplacements": "0"})
+    try:
+        map_plans, reduce_plan = _plans(session, table, 2)
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                           reduce_plan)
+        _check(result, expected)
+        assert len(cluster.workers) == 1, "crashing slot never shrunk"
+        assert cluster.worker_shrinks >= 1
+        drv = cluster.transport_counters()["driver"]
+        assert drv.get("worker_shrinks", 0) >= 1
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_task_deadline_abandons_and_evicts_wedged_worker():
+    """A hung (not dead) worker must not stall the wave forever: the
+    attempt is abandoned at the deadline, the worker health-probed over
+    the monitor's dedicated connection, found ALIVE, and evicted exactly
+    like a dead one — bounded recovery instead of an unbounded join."""
+    session = TpuSession()
+    table = _kv_table()
+    expected = _expected(table)
+    # deadline 8s: far under the 60s wedge, but wide enough that a
+    # loaded box's first-run XLA compile (observed >2.5s mid-suite)
+    # never reads as a hung task during the warm run
+    cluster = _mk_cluster(
+        2, {"spark.rapids.sql.tpu.task.timeoutMs": "8000",
+            "spark.rapids.sql.tpu.task.speculation.enabled": "false"})
+    try:
+        map_plans, reduce_plan = _plans(session, table, 2)
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                           reduce_plan)  # warm compile
+        _check(result, expected)
+        _arm(cluster, "exec-1", delay="reduce:60000")
+        t0 = time.monotonic()
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                           reduce_plan)
+        elapsed = time.monotonic() - t0
+        _check(result, expected)
+        assert elapsed < 40.0, \
+            f"wave not bounded by the task deadline ({elapsed:.1f}s)"
+        assert cluster.abandoned_tasks >= 1
+        assert cluster.evicted_workers >= 1, \
+            "wedged-but-alive worker was not evicted"
+        drv = cluster.transport_counters()["driver"]
+        assert drv.get("task_retries_timeout", 0) >= 1
+        assert cluster.recovery_metrics()["numAbandonedTasks"] >= 1
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_speculation_win_on_injected_delay_straggler():
+    """The acceptance's measured speculation win: an injected-delay
+    straggler's task is re-executed on the least-loaded healthy worker,
+    the COPY finishes first (well under the injected delay), the result
+    is identical, and numSpeculationWins moves."""
+    session = TpuSession()
+    table = _kv_table()
+    expected = _expected(table)
+    cluster = _mk_cluster(
+        3, {"spark.rapids.sql.tpu.task.timeoutMs": "60000"})
+    try:
+        map_plans, reduce_plan = _plans(session, table, 3)
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 6,
+                                           reduce_plan)  # warm compile
+        _check(result, expected)
+        _arm(cluster, "exec-1", delay="reduce:20000")
+        t0 = time.monotonic()
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 6,
+                                           reduce_plan)
+        elapsed = time.monotonic() - t0
+        _check(result, expected)
+        assert elapsed < 15.0, \
+            f"speculation never beat the {20}s straggler ({elapsed:.1f}s)"
+        assert cluster.speculative_tasks >= 1
+        assert cluster.speculation_wins >= 1
+        assert cluster.recovery_metrics()["numSpeculationWins"] >= 1
+        drv = cluster.transport_counters()["driver"]
+        assert drv.get("task_retries_speculation", 0) >= 1
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_heartbeat_monitor_redials_replacement_port():
+    """Satellite regression: after _replace_worker the monitor must dial
+    the replacement's FRESH port (same executor id, new address) instead
+    of counting missed heartbeats against the dead socket forever."""
+    cluster = _mk_cluster(2, {"spark.rapids.sql.tpu.trace."
+                              "heartbeatIntervalMs": "100"})
+    try:
+        mon = cluster.monitor
+        assert mon is not None
+        deadline = time.monotonic() + 10
+        while "exec-0" not in mon.latest and time.monotonic() < deadline:
+            time.sleep(0.05)
+        old_pid = mon.latest["exec-0"]["pid"]
+        fresh = cluster._replace_worker(0)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            hb = mon.latest.get("exec-0")
+            if hb and hb["pid"] != old_pid:
+                break
+            time.sleep(0.05)
+        hb = mon.latest.get("exec-0")
+        assert hb and hb["pid"] == fresh.proc.pid, \
+            "monitor still polling the dead predecessor's socket"
+        missed_at_redial = mon.missed_heartbeats
+        time.sleep(0.6)  # several poll intervals on the fresh socket
+        assert mon.missed_heartbeats == missed_at_redial, \
+            "monitor keeps missing heartbeats after the re-dial"
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_cluster_rpc_net_fault_sweep():
+    """Satellite: a socket fault injected at EACH cluster-rpc site must
+    leave the query bit-for-bit (transparent retry / best-effort
+    cleanup) or fail typed — previously only shuffle-fetch ops were
+    swept.  The driver-side injector addresses one method at a time via
+    the per-site ordinals ('rpc:run_map@1')."""
+    session = TpuSession()
+    table = _kv_table()
+    expected = _expected(table)
+    cluster = _mk_cluster(2)
+    try:
+        map_plans, reduce_plan = _plans(session, table, 2)
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                           reduce_plan)
+        _check(result, expected)
+        for site in ("rpc:run_map", "rpc:run_reduce",
+                     "rpc:remove_shuffle", "rpc:map_output_stats"):
+            faults.INJECTOR.reset()
+            faults.INJECTOR.configure(net_spec=f"{site}@1")
+            result, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                               reduce_plan)
+            _check(result, expected)
+            if site != "rpc:map_output_stats":  # armed-but-unvisited site
+                hits = [e for e in faults.INJECTOR.injected_log
+                        if e[0] == "net"]
+                assert hits, f"fault at {site} never fired (vacuous)"
+        # set_peers: fires on the recovery republish after a worker loss;
+        # the publish failure is counted, never silent, and recovery
+        # still converges
+        faults.INJECTOR.reset()
+        faults.INJECTOR.configure(net_spec="rpc:set_peers@1")
+        cluster.workers[1].proc.kill()
+        cluster.workers[1].proc.wait(timeout=10)
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 4,
+                                           reduce_plan)
+        _check(result, expected)
+        assert cluster._transport.counters.get(
+            "peer_publish_failures", 0) >= 1
+        # heartbeat: the monitor's dedicated clients are EXEMPT from
+        # injection by design (liveness polls must not consume armed
+        # ordinals) — armed heartbeat faults never fire
+        faults.INJECTOR.reset()
+        faults.INJECTOR.configure(net_spec="rpc:heartbeat@1x100")
+        hb0 = cluster.monitor.totals["heartbeats"]
+        deadline = time.monotonic() + 10
+        while cluster.monitor.totals["heartbeats"] <= hb0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert cluster.monitor.totals["heartbeats"] > hb0
+        assert not [e for e in faults.INJECTOR.injected_log
+                    if e[0] == "net"], \
+            "liveness poll consumed a test-armed net-fault ordinal"
+    finally:
+        faults.INJECTOR.reset()
+        cluster.shutdown()
+
+
+# --------------------------------------------------------------------------
+# the chaos soak (acceptance)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_soak_bit_for_bit_bounded_recovery():
+    """>= 20 seeded rounds on a 3-worker ProcCluster: every round runs
+    the representative query slice while a randomized (seed-replayable)
+    fault plan kills, delays, or corrupts workers — and every round must
+    come back bit-for-bit vs the oracle, inside a hard wall-clock bound
+    (every wave bounded by the task deadline: zero hangs)."""
+    import random
+    rounds = int(os.environ.get("CHAOS_ROUNDS", "20"))
+    seed = int(os.environ.get("CHAOS_SEED", "20260805"))
+    rng = random.Random(seed)
+    session = TpuSession()
+    table = _kv_table()
+    expected = _expected(table)
+    cluster = _mk_cluster(
+        3, {"spark.rapids.sql.tpu.task.timeoutMs": "20000",
+            "spark.rapids.sql.tpu.task.maxWorkerReplacements": "200"},
+        retries=3)
+    round_bound_s = 90.0
+    scenarios = ("none", "kill_map", "kill_reduce", "kill_two",
+                 "delay_reduce", "corrupt_wire")
+    history = []
+    try:
+        map_plans, reduce_plan = _plans(session, table, 3)
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 6,
+                                           reduce_plan)  # warm compile
+        _check(result, expected)
+        for rnd in range(rounds):
+            scenario = rng.choice(scenarios)
+            victims = rng.sample([w.executor_id for w in cluster.workers],
+                                 2 if scenario == "kill_two" else 1)
+            for w in cluster.workers:  # disarm everyone first
+                w.rpc("inject_faults")
+            if scenario in ("kill_map", "kill_two"):
+                for ex in victims:
+                    _arm(cluster, ex, crash="map@1")
+            elif scenario == "kill_reduce":
+                _arm(cluster, victims[0], crash="reduce@1")
+            elif scenario == "delay_reduce":
+                _arm(cluster, victims[0], delay="reduce:3000")
+            elif scenario == "corrupt_wire":
+                _arm(cluster, victims[0], corruption="wire@1")
+            t0 = time.monotonic()
+            result, _ = cluster.run_map_reduce(map_plans, ["k"], 6,
+                                               reduce_plan)
+            elapsed = time.monotonic() - t0
+            _check(result, expected)
+            assert elapsed < round_bound_s, \
+                (f"round {rnd} ({scenario}) took {elapsed:.1f}s — a "
+                 f"wave hung past the task deadline")
+            history.append((scenario, victims, round(elapsed, 2)))
+        # the soak must have actually exercised recovery, not idled
+        kills = sum(1 for s, _v, _t in history if s.startswith("kill"))
+        if kills:
+            assert cluster.task_retries + cluster.worker_shrinks >= 1, \
+                f"kill rounds recovered nothing: {history}"
+        prog = cluster.progress()
+        assert prog["tasks_completed"] > 0
+        assert prog["workers"] >= 1
+    finally:
+        cluster.shutdown()

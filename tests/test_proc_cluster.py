@@ -256,12 +256,12 @@ def test_proc_cluster_worker_loss_mid_reduce(tmp_path):
     orig = ProcCluster._run_tasks_with_retry
     state = {"killed": False}
 
-    def sabotage(self, stage, attempt, store, on_replace=None):
+    def sabotage(self, stage, attempt, store, on_replace=None, **kw):
         if stage == "reduce" and not state["killed"]:
             state["killed"] = True
             self.workers[1].proc.kill()
             self.workers[1].proc.wait(timeout=10)
-        return orig(self, stage, attempt, store, on_replace)
+        return orig(self, stage, attempt, store, on_replace, **kw)
 
     cluster_mod.ProcCluster._run_tasks_with_retry = sabotage
     try:

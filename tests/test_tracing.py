@@ -558,6 +558,13 @@ def test_proc_cluster_distributed_trace_acceptance(tmp_path):
         conf={"spark.rapids.sql.tpu.metrics.journal.dir": jdir,
               "spark.rapids.sql.tpu.trace.heartbeatIntervalMs": "100",
               "spark.rapids.sql.tpu.trace.hungTaskTimeoutMs": "500",
+              # observability-only test: the delayed task must RUN to
+              # completion and be FLAGGED (straggler + watchdog), not
+              # recovered — pin the scheduler's deadline high and turn
+              # speculation off so ISSUE-15's detect->act loop stays out
+              # of this acceptance (tests/test_chaos.py covers acting)
+              "spark.rapids.sql.tpu.task.timeoutMs": "120000",
+              "spark.rapids.sql.tpu.task.speculation.enabled": "false",
               "spark.rapids.tpu.test.injectDelay": "exec-1/reduce:1200"},
         cpu=True, session=session)
     try:
