@@ -1644,6 +1644,22 @@ def child_main(mode: str) -> None:
         emit("observability", **session_observability(session))
     except Exception as e:  # the rollup must never sink the bench
         emit("observability", error=repr(e)[:200])
+    # telemetry-plane rollup (ISSUE 17): flight-recorder/sampler state
+    # of the driving process, so an artifact records whether the
+    # always-on plane was live for the numbers above (its overhead is
+    # gated separately: scripts/obs_overhead.py -> BENCH_OBS.json)
+    try:
+        from spark_rapids_tpu.metrics.ring import get_telemetry
+        t = get_telemetry()
+        if t is None:
+            emit("telemetry", enabled=False)
+        else:
+            emit("telemetry", enabled=True, role=t.role,
+                 sampler_ticks=t.sampler.ticks,
+                 series=sorted(t.sampler.latest()),
+                 **t.recorder.stats())
+    except Exception as e:
+        emit("telemetry", error=repr(e)[:200])
     # adaptive-execution rollup (PR-3): coalesce/skew/strategy-change
     # counts and stage re-plan latency next to the observability block,
     # so a perf number is never read without knowing whether AQE rewrote

@@ -62,7 +62,26 @@ JAX_PLATFORMS=cpu python -m spark_rapids_tpu.metrics --lint
 echo "== observability tier =="
 T_OBS=$SECONDS
 python -m pytest tests/test_metrics.py tests/test_observability_e2e.py \
-    -q -m "not slow" -p no:cacheprovider
+    tests/test_telemetry.py -q -m "not slow" -p no:cacheprovider
+# post-mortem smoke (ISSUE 17): dump a diagnostics bundle from a live
+# session, then the CLI renderer must parse it back completely
+PM_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$PM_DIR" <<'EOF'
+import sys
+from spark_rapids_tpu.engine import TpuSession
+from spark_rapids_tpu.plan.logical import col
+s = TpuSession()
+assert len(s.from_pydict({"a": [1, 2, 3]}).filter(col("a") > 1)
+           .collect()) == 2
+print("bundle:", s.dump_diagnostics(out_dir=sys.argv[1] + "/smoke",
+                                    reason="ci-smoke"))
+EOF
+JAX_PLATFORMS=cpu python -m spark_rapids_tpu.metrics postmortem \
+    "$PM_DIR/smoke" > /dev/null
+rm -rf "$PM_DIR"
+# always-on ring+sampler overhead gate: <=2% wall time (or the absolute
+# noise floor) on the representative query slice; writes BENCH_OBS.json
+JAX_PLATFORMS=cpu python scripts/obs_overhead.py --reps 5
 echo "== observability tier took $((SECONDS - T_OBS))s =="
 
 echo "== adaptive tier =="

@@ -74,6 +74,11 @@ class HeartbeatMonitor:
         self.missed_heartbeats = 0
         self.hung_tasks = 0
         self.max_lag_s = 0.0
+        # watchdog hook: called with each newly-flagged hung task's
+        # snapshot AFTER the monitor lock is released (_ingest) — the
+        # post-mortem trigger behind it does rpc sweeps and must never
+        # run under (or deadlock against) the monitor's own lock
+        self.on_hung = None
         self.totals = {"heartbeats": 0, "tasks_completed": 0,
                        "tasks_failed": 0, "rows_written": 0,
                        "wire_bytes": 0}
@@ -143,6 +148,7 @@ class HeartbeatMonitor:
             self._ingest(worker.executor_id, hb, t0, t1)
 
     def _ingest(self, executor: str, hb: dict, t0: int, t1: int) -> None:
+        newly_hung: List[dict] = []
         with self._lock:
             self.latest[executor] = hb
             self.last_ok_mono[executor] = time.monotonic()
@@ -195,6 +201,17 @@ class HeartbeatMonitor:
                         "active for %.1fs (> %.1fs)", executor,
                         task.get("name"), task.get("stage"),
                         task.get("elapsed_s", 0), self.hung_timeout_s)
+                    newly_hung.append(dict(task, executor=executor))
+        # watchdog hook outside the lock: the post-mortem dump it
+        # triggers sweeps rpcs and must not serialize the monitor
+        if newly_hung and self.on_hung is not None:
+            for info in newly_hung:
+                try:
+                    self.on_hung(info)
+                except Exception as e:  # noqa: BLE001 — observability
+                    count_swallowed(
+                        "numPostmortemErrors", "spark_rapids_tpu.cluster",
+                        "hung-task postmortem hook failed (%r)", e)
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -333,6 +350,7 @@ class WorkerProc:
             stderr=sys.stderr, text=True, env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         self.address: Optional[tuple] = None
+        self.http_port: Optional[int] = None
         # reader thread: readline() itself can block forever on a silently
         # hung worker (e.g. TPU backend bring-up stuck on the tunnel
         # lease), so the deadline must bound the WAIT, not line arrivals
@@ -376,6 +394,9 @@ class WorkerProc:
                 continue
             if rec.get("ready"):
                 self.address = (rec["host"], rec["port"])
+                # telemetry endpoint, when the worker serves one
+                # (metrics/http.py): /metrics, /healthz, /debug
+                self.http_port = rec.get("http_port")
         self.client = None  # set by ProcCluster (needs its transport)
 
     def rpc(self, method: str, **kw):
@@ -474,15 +495,40 @@ class ProcCluster:
         # predecessor's span ids (drain_journals)
         self._drained: Dict[tuple, dict] = {}
         self._query_counter = 0
+        # session attachment: session.progress() delegates here, and the
+        # post-mortem triggers below reach the session's manager through
+        # a weakref (the cluster must never keep a dead session alive)
+        self._session_ref = None
+        if session is not None:
+            session._proc_cluster = self
+            import weakref
+            self._session_ref = weakref.ref(session)
         self.monitor: Optional[HeartbeatMonitor] = None
         interval_ms = int(tconf.get(C.TRACE_HEARTBEAT_INTERVAL))
         if self.trace_enabled and interval_ms > 0:
             self.monitor = HeartbeatMonitor(
                 self, interval_ms / 1e3,
                 int(tconf.get(C.TRACE_HUNG_TASK_TIMEOUT)) / 1e3)
-        # session attachment: session.progress() delegates here
-        if session is not None:
-            session._proc_cluster = self
+            # hung-task watchdog -> post-mortem bundle: fired OFF the
+            # monitor lock (see _ingest) and dumped asynchronously so a
+            # multi-second rpc sweep never stalls the heartbeat loop
+            self.monitor.on_hung = self._on_hung_task
+
+    def _on_hung_task(self, info: dict) -> None:
+        self._postmortem_trigger(
+            "hung-task",
+            error=RuntimeError(
+                "hung-task watchdog: %s task %r active for %.1fs"
+                % (info.get("executor"), info.get("name"),
+                   info.get("elapsed_s", 0.0))),
+            asynchronous=True)
+
+    def _postmortem_trigger(self, reason: str, error=None,
+                            asynchronous: bool = False) -> None:
+        s = self._session_ref() if self._session_ref is not None else None
+        pm = getattr(s, "_postmortem", None) if s is not None else None
+        if pm is not None:
+            pm.trigger(reason, error=error, asynchronous=asynchronous)
 
     def _publish_peers(self) -> None:
         # replace=True prunes peers that are GONE (a shrunk worker slot):
@@ -951,10 +997,17 @@ class ProcCluster:
             round_no += 1
             for i in sorted(errs):
                 if budget[i] <= 0:
-                    raise RuntimeError(
+                    exhausted = RuntimeError(
                         f"{stage} task {i} failed after "
-                        f"{self.max_task_retries} retries") \
-                        from errs[i][0]
+                        f"{self.max_task_retries} retries")
+                    exhausted.__cause__ = errs[i][0]
+                    # first-failure diagnostics BEFORE the raise unwinds
+                    # the wave: the dying stage's journals/rings are
+                    # still warm, and the query-failure trigger upstream
+                    # would only see the driver side of the story
+                    self._postmortem_trigger("retry-exhausted",
+                                             error=exhausted)
+                    raise exhausted
                 budget[i] -= 1
             handled: set = set()
             for i in sorted(errs):

@@ -785,6 +785,69 @@ TRACE_SHARD_MAX_EVENTS = _conf(
     "(a driver that never drains must not leak worker memory).", int,
     internal=True)
 
+# --- live telemetry plane (metrics/ring.py + bundle.py + http.py) ------------
+TELEMETRY_ENABLED = _conf(
+    "spark.rapids.sql.tpu.telemetry.enabled", True,
+    "Always-on flight recorder: every process (driver and each executor "
+    "worker) keeps a bounded in-memory ring of its last journal records "
+    "plus a background gauge-sampler thread snapshotting pool / "
+    "transport / scheduler gauges into fixed-interval time series.  The "
+    "ring and sampler feed the /metrics endpoint, the Chrome-trace "
+    "counter lanes, and post-mortem bundles; their measured overhead is "
+    "gated at <=2% wall time by scripts/obs_overhead.py (BENCH_OBS.json). "
+    " Off disables the ring tap, the sampler thread and the per-process "
+    "HTTP endpoints.", _to_bool)
+TELEMETRY_RING_MAX_EVENTS = _conf(
+    "spark.rapids.sql.tpu.telemetry.ring.maxEvents", 2048,
+    "Capacity of the per-process flight-recorder ring: the last N "
+    "journal records are mirrored in memory (oldest evicted first, "
+    "evictions counted) and land in post-mortem bundles as "
+    "ring-<process>.jsonl.  Sized so a bundle holds the final seconds "
+    "of every process at negligible resident cost.", int)
+TELEMETRY_SAMPLE_INTERVAL = _conf(
+    "spark.rapids.sql.tpu.telemetry.sampleIntervalMs", 250,
+    "Interval between gauge-sampler snapshots (pool bytes in use, "
+    "in-flight tasks, spill bytes, scheduler queue depths).  Each "
+    "snapshot appends one point per series to the in-memory time series "
+    "served by /metrics and, when a trace shard is open, one "
+    "gaugeSample journal instant that becomes a Chrome-trace counter "
+    "lane.  0 disables the sampler thread (the ring tap stays on).",
+    int)
+TELEMETRY_SAMPLE_MAX = _conf(
+    "spark.rapids.sql.tpu.telemetry.sample.maxSamples", 2400,
+    "Bound on retained points per sampled gauge series; overflow evicts "
+    "the oldest points (10 minutes of history at the default 250ms "
+    "interval).", int, internal=True)
+TELEMETRY_HTTP_ENABLED = _conf(
+    "spark.rapids.sql.tpu.telemetry.http.enabled", True,
+    "Per-process loopback HTTP endpoint serving /metrics (Prometheus "
+    "text of the sampler's current series, parse_prometheus-clean), "
+    "/healthz (liveness verdict) and /debug/observability "
+    "(session_observability + progress as JSON).  Workers announce "
+    "their port in the ready line; the driver's is in "
+    "session_observability['telemetry']['http_address'].", _to_bool)
+TELEMETRY_HTTP_PORT = _conf(
+    "spark.rapids.sql.tpu.telemetry.http.port", 0,
+    "Port for the driver telemetry HTTP endpoint (workers always bind "
+    "an ephemeral loopback port and announce it).  0 (default) binds an "
+    "ephemeral port.", int)
+TELEMETRY_POSTMORTEM_DIR = _conf(
+    "spark.rapids.sql.tpu.telemetry.postmortem.dir", "",
+    "Directory for automatic post-mortem diagnostic bundles.  When set, "
+    "a bundle (config, EXPLAIN with roofline, merged timeline, "
+    "memledger replay, SLO state, per-process ring dumps) is dumped on "
+    "query failure, hung-task watchdog fire, retry-budget exhaustion, "
+    "and SIGUSR1; render one with "
+    "`python -m spark_rapids_tpu.metrics postmortem <bundle>`.  "
+    "Empty (default) disables automatic dumps — "
+    "session.dump_diagnostics() stays available either way.", str)
+TELEMETRY_POSTMORTEM_MIN_INTERVAL = _conf(
+    "spark.rapids.sql.tpu.telemetry.postmortem.minIntervalMs", 30000,
+    "Rate limit between automatic post-mortem dumps: a trigger firing "
+    "within this window of the previous dump is counted "
+    "(numPostmortemSuppressed) instead of dumped, so a failure storm "
+    "cannot fill the disk.", int, internal=True)
+
 # --- distributed task scheduling: deadlines, backoff, speculation ------------
 TASK_TIMEOUT = _conf(
     "spark.rapids.sql.tpu.task.timeoutMs", 0,

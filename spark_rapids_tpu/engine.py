@@ -8,8 +8,11 @@ transitions) and executes the physical plan.
 """
 from __future__ import annotations
 
+import os
 import sys
 import threading
+import time
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 from . import config as C
@@ -59,6 +62,112 @@ class TpuSession:
         self._finish_lock = threading.Lock()
         self._lazy_lock = threading.RLock()  # runtime/cluster first touch
         _enable_compilation_cache(self.conf.get(C.COMPILATION_CACHE_DIR))
+        # post-mortem plane (metrics/bundle.py, docs/monitoring.md):
+        # armed only on the DRIVER (executor workers set ring.PROCESS_ROLE
+        # before building their session) and only when a bundle dir is
+        # configured.  _last_qe feeds the explain section of dumps whose
+        # trigger has no QueryExecution in hand (SIGUSR1, watchdog).
+        self._last_qe = None
+        self._postmortem = None
+        try:
+            from .metrics import bundle as _bundle, ring as _ring
+            pm_dir = str(self.conf.get(C.TELEMETRY_POSTMORTEM_DIR) or "")
+            if pm_dir and _ring.PROCESS_ROLE[0] == "driver":
+                self._postmortem = _bundle.PostmortemManager(
+                    self, pm_dir,
+                    int(self.conf.get(C.TELEMETRY_POSTMORTEM_MIN_INTERVAL)))
+                _bundle.install_sigusr1(self._postmortem)
+        except Exception:  # noqa: BLE001 — arming is observability-only
+            from .metrics.registry import count_swallowed
+            count_swallowed("numPostmortemErrors", "spark_rapids_tpu",
+                            "postmortem arming failed at session init")
+        # flight recorder + gauge sampler + /metrics endpoint: the
+        # per-process telemetry singleton (metrics/ring.py).  The LATEST
+        # session rebinds the driver gauge source and the endpoint
+        # payloads (weakref — telemetry must never keep a session alive)
+        try:
+            from .metrics import ring as _ring
+            t = _ring.init_telemetry(self.conf,
+                                     role=_ring.PROCESS_ROLE[0])
+            if t is not None and _ring.PROCESS_ROLE[0] == "driver":
+                self._wire_driver_telemetry(t)
+        except Exception:  # noqa: BLE001 — telemetry must never block
+            from .metrics.registry import count_swallowed
+            count_swallowed("numTelemetrySampleErrors", "spark_rapids_tpu",
+                            "driver telemetry wiring failed")
+
+    def _wire_driver_telemetry(self, t) -> None:
+        """Bind this session to the process telemetry: the driver gauge
+        source (pool / scheduler / spill figures the sampler snapshots)
+        and — once per process — the loopback HTTP endpoint."""
+        t.session_ref = weakref.ref(self)
+
+        def driver_gauges() -> Dict[str, float]:
+            s = t.session_ref()
+            if s is None:
+                return {}
+            out: Dict[str, float] = {}
+            rt = s._runtime  # never force a runtime build from a sampler
+            if rt is not None:
+                stats = rt.pool_stats()
+                out.update({k: float(v) for k, v in stats.items()
+                            if isinstance(v, (int, float))})
+                out["spill_bytes"] = float(stats.get("host_used", 0)
+                                           + stats.get("disk_used", 0))
+            sched = s._scheduler
+            out["in_flight_tasks"] = 0.0
+            out["queued_queries"] = 0.0
+            if sched is not None:
+                out.update(sched.telemetry_gauges())
+            return out
+
+        t.sampler.add_source("driver", driver_gauges)
+        t.sampler.start()
+        if t.http is None \
+                and bool(self.conf.get(C.TELEMETRY_HTTP_ENABLED)):
+            from .metrics.export import session_observability
+            from .metrics.http import serve_telemetry
+
+            def observability() -> Dict:
+                s = t.session_ref()
+                if s is None:
+                    return {}
+                return {"session_observability": session_observability(s),
+                        "progress": s.progress()}
+
+            def healthz():
+                s = t.session_ref()
+                payload = {"ok": s is not None, "role": "driver",
+                           "pid": os.getpid()}
+                pc = getattr(s, "_proc_cluster", None) if s else None
+                if pc is not None and pc.monitor is not None:
+                    lag = pc.monitor.lag_s()
+                    payload["heartbeat_lag_s"] = \
+                        max(lag.values()) if lag else 0.0
+                    payload["hung_tasks"] = pc.monitor.hung_tasks
+                    payload["workers"] = len(pc.workers)
+                return (200 if payload["ok"] else 503), payload
+
+            serve_telemetry(t, {"executor": "driver"}, healthz=healthz,
+                            observability=observability,
+                            port=int(self.conf.get(C.TELEMETRY_HTTP_PORT)))
+
+    def dump_diagnostics(self, out_dir: Optional[str] = None,
+                         reason: str = "manual") -> str:
+        """Write a post-mortem diagnostic bundle NOW (config, EXPLAIN
+        with roofline, merged timeline, memledger replay, SLO state,
+        per-process flight-recorder rings) and return its directory.
+        Render it with `python -m spark_rapids_tpu.metrics postmortem
+        <bundle>` (docs/monitoring.md, Post-mortem bundles)."""
+        from .metrics import bundle as _bundle
+        if out_dir is None:
+            base = str(self.conf.get(C.TELEMETRY_POSTMORTEM_DIR) or "") \
+                or "."
+            out_dir = os.path.join(
+                base, f"postmortem-{reason}-{os.getpid()}-"
+                      f"{time.time_ns() // 1_000_000}")
+        return _bundle.dump_diagnostics(out_dir, session=self,
+                                        reason=reason)
 
     def _begin_execution(self, physical: ExecNode, runtime=None):
         """Open the per-query observability scope (metrics levels, event
@@ -79,12 +188,18 @@ class TpuSession:
                 # concurrent serving: N query threads finish at once;
                 # the read-modify-write counter folds must not race
                 self.last_execution = qe
+                self._last_qe = qe
                 self.queries_executed += 1
                 for k, v in qe.aggregate().items():
                     self.query_metrics_total[k] = \
                         self.query_metrics_total.get(k, 0) + v
             if self.conf.explain == "METRICS" and error is None:
                 print(qe.explain_with_metrics(), file=sys.stderr)
+            if error is not None and self._postmortem is not None:
+                # first-failure diagnostics: the bundle is written while
+                # the dying query's journal/metrics are still warm
+                self._postmortem.trigger("query-failure", qe=qe,
+                                         error=error)
         except Exception:  # pragma: no cover - reporting is best-effort
             import logging
             logging.getLogger("spark_rapids_tpu.metrics").warning(
@@ -174,8 +289,11 @@ class TpuSession:
         rows = int(self.query_metrics_total.get("numOutputRows", 0))
         raw = self.queries_executed + events + rows
         # high-water: per-query journal ids restart, so the raw sum may
-        # dip between queries — the surfaced score never does
-        self._progress_high_water = max(self._progress_high_water, raw)
+        # dip between queries — the surfaced score never does.  The
+        # max() makes concurrent racing writes (watchdog/postmortem
+        # threads snapshotting progress) order-independent: the water
+        # mark only rises, so the lock would buy nothing.
+        self._progress_high_water = max(self._progress_high_water, raw)  # tpulint: disable=TPU009 monotonic max is race-tolerant by construction
         out = {"queries": self.queries_executed,
                "journal_events": events, "rows": rows,
                "active_query": j is not None,

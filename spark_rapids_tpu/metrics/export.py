@@ -186,20 +186,48 @@ def parse_prometheus(text: str) -> Dict[Tuple[str, frozenset], float]:
 
 # -- cluster-wide aggregation ------------------------------------------------
 
-def cluster_snapshot(cluster, scheduler=None) -> Dict[str, dict]:
+def cluster_snapshot(cluster, scheduler=None,
+                     rpc_timeout: float = 2.0) -> Dict[str, dict]:
     """{executor_id: {"transport": {...}, "pool": {...}}} pulled from every
     worker: over the control RPC for cluster.ProcCluster, in-process for
     plugin.TpuCluster.  With a serving-tier `scheduler` attached, a
     `_serve` entry additionally carries the fair-share observability the
     PR-10 scheduler implements but never exposed: per-priority-class
-    queue depth and admission/rejection counters."""
+    queue depth and admission/rejection counters.
+
+    Dead-worker tolerant: each ProcCluster worker is scraped over a
+    DEDICATED fresh dial with `rpc_timeout` (the shared control client
+    may be wedged behind the very task that killed the worker), and a
+    worker that cannot answer yields `{"transport": {}, "pool": {},
+    "stale": True}` instead of failing the whole scrape — a snapshot
+    taken MID-RECOVERY must report the survivors."""
     out: Dict[str, dict] = {}
     if hasattr(cluster, "workers"):  # cluster.ProcCluster (rpc path)
+        from ..shuffle.net import SocketClient
         for w in cluster.workers:
-            out[w.executor_id] = {
-                "transport": w.rpc("transport_counters"),
-                "pool": w.rpc("pool_stats"),
-            }
+            try:
+                client = SocketClient(cluster._transport,
+                                      tuple(w.address),
+                                      inject_faults=False,
+                                      connect_timeout=rpc_timeout)
+                try:
+                    out[w.executor_id] = {
+                        "transport": client.rpc(
+                            "transport_counters",
+                            _rpc_timeout=rpc_timeout),
+                        "pool": client.rpc("pool_stats",
+                                           _rpc_timeout=rpc_timeout),
+                    }
+                finally:
+                    client.close()
+            except Exception as e:  # noqa: BLE001 — partial beats none
+                from .registry import count_swallowed
+                count_swallowed("numExportScrapeErrors",
+                                "spark_rapids_tpu.metrics",
+                                "worker %s scrape failed (%r); marking "
+                                "stale", w.executor_id, e)
+                out[w.executor_id] = {"transport": {}, "pool": {},
+                                      "stale": True}
     elif hasattr(cluster, "executors"):  # plugin.TpuCluster (in-process)
         transport = getattr(cluster, "transport", None)
         shared = dict(getattr(transport, "counters", {}) or {})
@@ -215,11 +243,12 @@ def cluster_snapshot(cluster, scheduler=None) -> Dict[str, dict]:
     return out
 
 
-def prometheus_cluster_dump(cluster, scheduler=None) -> str:
+def prometheus_cluster_dump(cluster, scheduler=None,
+                            rpc_timeout: float = 2.0) -> str:
     """Cluster rollup in Prometheus text format with executor labels;
     with a `scheduler`, the serving-tier fairness gauges and per-phase
     SLO histograms ride along (prometheus_serve_dump)."""
-    snap = cluster_snapshot(cluster)
+    snap = cluster_snapshot(cluster, rpc_timeout=rpc_timeout)
     lines: List[str] = []
     emitted_header = set()
 
@@ -234,6 +263,15 @@ def prometheus_cluster_dump(cluster, scheduler=None) -> str:
 
     for exec_id in sorted(snap):
         labels = {"executor": exec_id}
+        if snap[exec_id].get("stale"):
+            # a dead/wedged worker still appears — with stale="true" on
+            # its (empty) series and executor_up 0, so one lost worker
+            # degrades the scrape instead of killing it
+            labels["stale"] = "true"
+        emit("executor_up", labels,
+             0 if snap[exec_id].get("stale") else 1,
+             "1 when the executor answered the scrape rpc within the "
+             "timeout, 0 when its series are stale", "gauge")
         for k, v in sorted(snap[exec_id].get("transport", {}).items()):
             emit(k, labels, v,
                  N.TRANSPORT_COUNTERS.get(k, k), "counter")
@@ -298,6 +336,55 @@ def prometheus_serve_dump(scheduler) -> str:
                                      {**labels, "le": le}, cum))
             lines.append(_sample(pname + "_sum", labels, h.sum))
             lines.append(_sample(pname + "_count", labels, h.count))
+    return "\n".join(lines) + "\n"
+
+
+# -- live telemetry endpoint body (metrics/http.py /metrics) ------------------
+
+def prometheus_gauge_dump(values: Dict[str, float],
+                          labels: Dict[str, str],
+                          include_engine: bool = True) -> str:
+    """Current gauge-sampler values (ring.GaugeSampler.latest()) in
+    Prometheus text format — the /metrics endpoint body.  Series names
+    come from the shared catalog: POOL_GAUGES / TRANSPORT_COUNTERS /
+    TELEMETRY_GAUGES keys keep their snake_case names (identical to
+    prometheus_cluster_dump's), registered camelCase metrics go through
+    prom_name, anything else is snake-cased untyped.  With
+    `include_engine`, the process-wide hygiene counters ride along
+    (scope=engine), so a scraper sees tap/sample/dump failures in the
+    same scrape that would be missing data because of them."""
+    lines: List[str] = []
+
+    def header(pname, help_text, mtype):
+        lines.append(f"# HELP {pname} {help_text}")
+        lines.append(f"# TYPE {pname} {mtype}")
+
+    for k in sorted(values):
+        v = values[k]
+        if k in N.POOL_GAUGES:
+            pname, help_text, mtype = \
+                _PREFIX + k, N.POOL_GAUGES[k], "gauge"
+        elif k in N.TELEMETRY_GAUGES:
+            pname, help_text, mtype = \
+                _PREFIX + k, N.TELEMETRY_GAUGES[k], "gauge"
+        elif k in N.TRANSPORT_COUNTERS:
+            pname, help_text, mtype = \
+                _PREFIX + k, N.TRANSPORT_COUNTERS[k], "counter"
+        elif k in N.METRICS:
+            pname = prom_name(k)
+            help_text, mtype = N.METRICS[k].doc, _prom_type(k)
+        else:
+            pname = _PREFIX + _CAMEL.sub("_", k).lower()
+            help_text, mtype = k, "untyped"
+        header(pname, help_text, mtype)
+        lines.append(_sample(pname, labels, v))
+    if include_engine:
+        from .registry import ENGINE_COUNTERS
+        for k, v in sorted(ENGINE_COUNTERS.snapshot().items()):
+            pname = prom_name(k)
+            spec = N.METRICS.get(k)
+            header(pname, spec.doc if spec else k, _prom_type(k))
+            lines.append(_sample(pname, {**labels, "scope": "engine"}, v))
     return "\n".join(lines) + "\n"
 
 

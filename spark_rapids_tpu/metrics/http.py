@@ -1,0 +1,144 @@
+"""Per-process telemetry HTTP endpoint (stdlib-only).
+
+A tiny loopback `ThreadingHTTPServer` each process (driver and every
+executor worker) brings up when `telemetry.http.enabled` is on:
+
+  /metrics              Prometheus text of the gauge sampler's current
+                        series (export.prometheus_gauge_dump — the same
+                        names prometheus_cluster_dump emits, so one
+                        dashboard keys both), parse_prometheus-clean.
+  /healthz              JSON liveness verdict (200 ok / 503 unhealthy):
+                        the worker's active/failed task counts, or the
+                        driver's heartbeat-monitor view.
+  /debug/observability  session_observability + progress as JSON
+                        (driver); ring/sampler stats (workers).
+
+The server binds 127.0.0.1 on an ephemeral port by default (workers
+announce theirs in the ready line; the driver's lands in
+session_observability).  Handlers never raise out: a failing route
+answers 500 and bumps numTelemetryHttpErrors, so a scraper's gap is
+visible in the very series it scrapes.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from .registry import count_swallowed
+
+# route -> () -> (status, content_type, body str)
+Route = Callable[[], Tuple[int, str, str]]
+
+
+class TelemetryServer:
+    """Loopback HTTP server over a dict of route callables."""
+
+    def __init__(self, routes: Dict[str, Route],
+                 host: str = "127.0.0.1", port: int = 0):
+        self.routes = dict(routes)
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                route = server.routes.get(path)
+                if route is None:
+                    self._answer(404, "text/plain; charset=utf-8",
+                                 f"no such route: {path}\n")
+                    return
+                try:
+                    status, ctype, body = route()
+                except Exception as e:  # noqa: BLE001 — answer, don't drop
+                    count_swallowed("numTelemetryHttpErrors", __name__,
+                                    "telemetry route %s failed (%r)",
+                                    path, e)
+                    status, ctype, body = (
+                        500, "text/plain; charset=utf-8",
+                        f"route {path} failed: {e!r}\n")
+                self._answer(status, ctype, body)
+
+            def _answer(self, status: int, ctype: str, body: str):
+                data = body.encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                try:
+                    self.wfile.write(data)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # tpulint: disable=TPU006 scraper hung up mid-response; nothing to recover
+
+            def log_message(self, fmt, *args):
+                pass  # tpulint: disable=TPU006 BaseHTTPRequestHandler access logging silenced by design
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.address: Tuple[str, int] = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="telemetry-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address[0]}:{self.address[1]}"
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception as e:  # noqa: BLE001 — teardown best-effort
+            count_swallowed("numTelemetryHttpErrors", __name__,
+                            "telemetry http close failed (%r)", e)
+        self._thread.join(timeout=5.0)
+
+
+def _json_route(fn: Callable[[], Tuple[int, dict]]) -> Route:
+    def route():
+        status, payload = fn()
+        return (status, "application/json",
+                json.dumps(payload, indent=2, default=str) + "\n")
+    return route
+
+
+def serve_telemetry(telemetry, labels: Dict[str, str],
+                    healthz: Optional[Callable[[], Tuple[int, dict]]] = None,
+                    observability: Optional[Callable[[], dict]] = None,
+                    host: str = "127.0.0.1",
+                    port: int = 0) -> TelemetryServer:
+    """Wire the standard three routes over a ring.Telemetry and attach
+    the server to it.  `healthz` returns (http status, payload);
+    `observability` returns the /debug/observability payload."""
+    from .export import prometheus_gauge_dump
+
+    def metrics_route():
+        body = prometheus_gauge_dump(telemetry.sampler.latest(), labels)
+        return (200, "text/plain; version=0.0.4; charset=utf-8", body)
+
+    def healthz_fn():
+        if healthz is not None:
+            return healthz()
+        return (200, {"ok": True, "role": telemetry.role})
+
+    def observability_fn():
+        out = {"telemetry": {"role": telemetry.role,
+                             **telemetry.recorder.stats(),
+                             "sampler_ticks": telemetry.sampler.ticks}}
+        if observability is not None:
+            out.update(observability())
+        return (200, out)
+
+    routes = {
+        "/metrics": metrics_route,
+        "/healthz": _json_route(healthz_fn),
+        "/debug/observability": _json_route(observability_fn),
+    }
+    server = TelemetryServer(routes, host=host, port=port)
+    telemetry.http = server
+    return server

@@ -90,6 +90,32 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # `python -m spark_rapids_tpu.metrics roofline` report
                "cost")
 
+# --- flight-recorder taps ----------------------------------------------------
+# Process-wide observers of EVERY journal record emitted by ANY journal in
+# this process (metrics/ring.py's FlightRecorder is the one registrant).
+# A tap runs UNDER the emitting journal's lock, so it must do nothing but
+# O(1) bookkeeping on its own structures (a deque append) — no journal
+# writes, no store-lock acquisition, no I/O.  Registration is list-swap
+# (copy-on-write) so the hot emit path reads one tuple with no lock.
+_TAPS: tuple = ()
+_TAPS_LOCK = threading.Lock()
+
+
+def add_tap(fn) -> None:
+    """Register fn(line: str) to observe every emitted journal line."""
+    global _TAPS
+    with _TAPS_LOCK:
+        if fn not in _TAPS:
+            _TAPS = _TAPS + (fn,)
+
+
+def remove_tap(fn) -> None:
+    global _TAPS
+    with _TAPS_LOCK:
+        # equality, not identity: a bound method is a fresh object per
+        # attribute access, but compares equal by (__self__, __func__)
+        _TAPS = tuple(t for t in _TAPS if t != fn)
+
 
 class EventJournal:
     def __init__(self, path: Optional[str] = None,
@@ -144,6 +170,13 @@ class EventJournal:
 
     def _emit_locked(self, rec: dict) -> None:
         line = json.dumps(rec, separators=(",", ":"), default=str)
+        for tap in _TAPS:
+            try:
+                tap(line)
+            except Exception:
+                from .registry import count_swallowed
+                count_swallowed("numTelemetryTapErrors", __name__,
+                                "journal tap failed")
         if self._file is not None:
             self._file.write(line + "\n")
             self._file.flush()

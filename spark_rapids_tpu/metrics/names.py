@@ -472,6 +472,34 @@ NUM_EXPORT_SCRAPE_ERRORS = register_metric(
     "cluster observability scrapes that raised and reported zero wire "
     "bytes instead (metrics/export.py) — dashboards silently flatline "
     "when this moves")
+NUM_TELEMETRY_TAP_ERRORS = register_metric(
+    "numTelemetryTapErrors", COUNTER, ESSENTIAL,
+    "flight-recorder journal taps that raised while observing an "
+    "emitted record (metrics/journal.py) — the ring may be missing "
+    "events a post-mortem bundle would have wanted")
+NUM_TELEMETRY_SAMPLE_ERRORS = register_metric(
+    "numTelemetrySampleErrors", COUNTER, ESSENTIAL,
+    "gauge-sampler source callbacks that raised during a sampling tick "
+    "(metrics/ring.py) — that series silently stops advancing")
+NUM_TELEMETRY_HTTP_ERRORS = register_metric(
+    "numTelemetryHttpErrors", COUNTER, ESSENTIAL,
+    "telemetry HTTP endpoint requests that raised and answered 500 "
+    "(metrics/http.py) — a scraper sees gaps where samples should be")
+NUM_POSTMORTEM_DUMPS = register_metric(
+    "numPostmortemDumps", COUNTER, ESSENTIAL,
+    "post-mortem diagnostic bundles written (metrics/bundle.py), "
+    "automatic or explicit — each one is a first-failure artifact "
+    "waiting in telemetry.postmortem.dir")
+NUM_POSTMORTEM_SUPPRESSED = register_metric(
+    "numPostmortemSuppressed", COUNTER, ESSENTIAL,
+    "automatic post-mortem triggers suppressed by the "
+    "telemetry.postmortem.minIntervalMs rate limit or a duplicate "
+    "in-flight dump — the failure storm a bundle was NOT written for")
+NUM_POSTMORTEM_ERRORS = register_metric(
+    "numPostmortemErrors", COUNTER, ESSENTIAL,
+    "post-mortem bundle sections or whole dumps that raised while being "
+    "assembled (metrics/bundle.py) — the bundle (or section) is missing "
+    "exactly when it was wanted most")
 
 # retry-block counters: each `run_retryable(ctx, metrics, <block>)` call
 # site emits `<block>Retries` / `<block>Splits` (mem/retry.py with_retry)
@@ -551,6 +579,29 @@ TRANSPORT_COUNTERS = {
                       "the survivors instead of failing the query",
 }
 
+# --- gauge-sampler series (metrics/ring.py GaugeSampler) ---------------------
+# Sampled at telemetry.sampleIntervalMs into bounded in-memory time series;
+# served live by /metrics and replayed as Chrome-trace counter lanes.  Pool
+# and transport series reuse the POOL_GAUGES / TRANSPORT_COUNTERS names
+# above; these are the sampler-only additions.
+TELEMETRY_GAUGES = {
+    "in_flight_tasks": "distributed tasks currently executing in this "
+                       "process (worker run_map/run_reduce in flight; "
+                       "driver: scheduler running count)",
+    "spill_bytes": "host + disk spill-store bytes currently tracked "
+                   "(host_used + disk_used at the sample instant)",
+    "queued_queries": "queries waiting in the serving-tier scheduler "
+                      "queue (driver only; 0 without a scheduler)",
+    "ring_events": "journal records currently held by this process's "
+                   "flight-recorder ring",
+    "ring_dropped": "journal records evicted from the flight-recorder "
+                    "ring since process start",
+    "cluster_device_used": "device-store bytes summed over an in-process "
+                           "TpuCluster's executor pools (plugin.py)",
+    "cluster_spill_bytes": "host + disk spill bytes summed over an "
+                           "in-process TpuCluster's executor pools",
+}
+
 # --- runtime pool gauges (mem/runtime.py pool_stats()) ----------------------
 POOL_GAUGES = {
     "pool_limit": "accounted HBM pool budget in bytes",
@@ -573,4 +624,6 @@ def catalog_rows():
              for k, v in sorted(TRANSPORT_COUNTERS.items())]
     rows += [(k, GAUGE, "ESSENTIAL", v + " (runtime pool gauge)")
              for k, v in sorted(POOL_GAUGES.items())]
+    rows += [(k, GAUGE, "ESSENTIAL", v + " (gauge-sampler series)")
+             for k, v in sorted(TELEMETRY_GAUGES.items())]
     return rows

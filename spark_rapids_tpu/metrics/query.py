@@ -65,10 +65,15 @@ class QueryExecution:
         # across processes under a shared journal.dir.  Adopted journals
         # are never closed by finish().
         shared = active_journal()
+        # a live flight recorder (metrics/ring.py) mirrors every emitted
+        # record, so an in-memory journal is worth opening even below
+        # DEBUG with no journal dir: the query's spans land in the ring
+        # and a post-mortem bundle can dump the driver's final seconds
+        from .ring import get_telemetry
         if shared is not None and shared.is_shard:
             self.journal = shared
             self._owns_journal = False
-        elif jdir or self.level >= N.DEBUG:
+        elif jdir or self.level >= N.DEBUG or get_telemetry() is not None:
             path = (os.path.join(jdir, f"query-{self.query_id}.jsonl")
                     if jdir else None)
             # file-backed journals carry a wall-clock anchor record so the

@@ -1,0 +1,299 @@
+"""Flight recorder + gauge sampler: the always-on telemetry core.
+
+Two bounded in-memory structures per process (driver AND every executor
+worker), cheap enough to leave on in production:
+
+  * `FlightRecorder` — a ring of the last-N journal records emitted by
+    ANY journal in this process, fed by a `journal.add_tap` observer.
+    When a query dies, wedges, or a SIGUSR1 arrives, the ring is what a
+    post-mortem bundle (metrics/bundle.py) dumps as ring-<process>.jsonl:
+    the final seconds of every process, even events whose journal was
+    never file-backed or was already drained.
+  * `GaugeSampler` — a daemon thread snapshotting registered gauge
+    sources (pool stats, transport counters, in-flight tasks, scheduler
+    queue depths) every `telemetry.sampleIntervalMs` into bounded
+    per-series time series.  `latest()` feeds the /metrics endpoint
+    (metrics/http.py); each tick additionally journals ONE `metric`-kind
+    `gaugeSample` instant so the series ride the ordinary drain/merge
+    path and become Chrome-trace counter lanes offline
+    (utils/tracing.py).
+
+Lock discipline (TPU007): the recorder's tap runs UNDER the emitting
+journal's lock, so it does nothing but a deque append under its own
+leaf-level lock.  The sampler calls its sources with NO lock held (each
+source does its own internal locking), then journals the tick — never
+under a store lock.
+
+`init_telemetry()` wires the per-process singleton from a config dict;
+`shutdown_telemetry()` tears it down (tests; workers die with theirs).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import journal as J
+from .registry import count_swallowed
+
+# which kind of process this is ("driver" | "worker"): flipped by
+# shuffle/worker.main() BEFORE the worker's TpuSession exists, so the
+# engine's driver-only arming (SIGUSR1 handler, automatic postmortem
+# triggers) stays off in executor processes
+PROCESS_ROLE: List[str] = ["driver"]
+
+
+class FlightRecorder:
+    """Bounded ring of the last-N journal lines emitted in this process."""
+
+    def __init__(self, max_events: int = 2048):
+        self.max_events = max(1, int(max_events))
+        self._lock = threading.Lock()
+        self._ring: "deque[str]" = deque(maxlen=self.max_events)
+        self.dropped = 0
+        self._installed = False
+
+    # the journal tap: runs under the EMITTING journal's lock, so it must
+    # stay O(1) on the recorder's own leaf lock — no journal writes, no
+    # store locks, no I/O (journal.add_tap contract)
+    def _tap(self, line: str) -> None:
+        with self._lock:
+            if len(self._ring) == self.max_events:
+                self.dropped += 1
+            self._ring.append(line)
+
+    def install(self) -> None:
+        if not self._installed:
+            J.add_tap(self._tap)
+            self._installed = True  # tpulint: disable=TPU009 single-owner: only init_telemetry/shutdown_telemetry (themselves serialized by _TELEMETRY_LOCK) flip this
+
+    def uninstall(self) -> None:
+        if self._installed:
+            J.remove_tap(self._tap)
+            self._installed = False  # tpulint: disable=TPU009 single-owner: only init_telemetry/shutdown_telemetry (themselves serialized by _TELEMETRY_LOCK) flip this
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"ring_events": len(self._ring),
+                    "ring_dropped": self.dropped}
+
+    def snapshot(self) -> dict:
+        """{"dropped": N, "events": [parsed records...]} — newest last."""
+        with self._lock:
+            lines = list(self._ring)
+            dropped = self.dropped
+        events = []
+        for ln in lines:
+            try:
+                events.append(json.loads(ln))
+            except ValueError:
+                # a line torn by interpreter shutdown parses as garbage;
+                # count it with the eviction loss rather than failing the
+                # whole ring dump
+                dropped += 1
+        return {"dropped": dropped, "events": events}
+
+    def record(self, line: str) -> None:
+        """Append one pre-serialized record directly (the sampler's
+        fallback when NO journal is active in this process — raw
+        map-reduce driving keeps the driver ring non-empty)."""
+        self._tap(line)
+
+    def dump_lines(self) -> Tuple[List[str], int]:
+        """(raw ring lines oldest-first, dropped count) — the
+        rpc_ring_dump payload (a non-consuming snapshot, unlike a
+        journal drain)."""
+        with self._lock:
+            return list(self._ring), self.dropped
+
+    def dump_jsonl(self) -> str:
+        """The ring as a JSON-lines blob (one bundle file's body)."""
+        lines, _dropped = self.dump_lines()
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+class GaugeSampler:
+    """Fixed-interval snapshots of registered gauge sources.
+
+    Sources are `(label, fn)` where `fn() -> {series_name: number}`;
+    series names come from names.py (POOL_GAUGES / TRANSPORT_COUNTERS /
+    TELEMETRY_GAUGES keys, or registered camelCase metrics) so /metrics
+    and the Chrome counter lanes share the catalog's vocabulary.
+    """
+
+    # the counter-lane subset: what a gaugeSample journal instant carries
+    # (utils/tracing.py turns exactly these into ph:"C" counter tracks)
+    LANE_KEYS = ("device_used", "in_flight_tasks", "spill_bytes")
+
+    def __init__(self, interval_ms: int = 250, max_samples: int = 2400):
+        self.interval_s = max(0.0, interval_ms / 1000.0)
+        self.max_samples = max(1, int(max_samples))
+        self._lock = threading.Lock()
+        self._sources: List[Tuple[str, Callable[[], Dict[str, float]]]] = []
+        self._series: Dict[str, "deque[Tuple[float, float]]"] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.ticks = 0
+        # ring fallback target for ticks when no journal is live
+        # (init_telemetry wires this to the process FlightRecorder)
+        self.recorder: Optional[FlightRecorder] = None
+
+    def add_source(self, label: str,
+                   fn: Callable[[], Dict[str, float]]) -> None:
+        """Register (or REPLACE) the gauge source named `label`.
+
+        Replacement semantics matter: the sampler is a process singleton
+        but sessions/clusters come and go (tests especially), so each
+        new owner of a label supersedes the stale closure instead of
+        accumulating next to it."""
+        with self._lock:
+            self._sources = [(l, f) for (l, f) in self._sources
+                             if l != label]
+            self._sources.append((label, fn))
+
+    # -- sampling -------------------------------------------------------------
+
+    def sample_once(self) -> Dict[str, float]:
+        """One tick: poll every source (no locks held across the calls),
+        append to the series, journal the counter-lane subset.  Returns
+        the tick's merged values (tests; /metrics uses latest())."""
+        with self._lock:
+            sources = list(self._sources)
+        now = time.monotonic()
+        tick: Dict[str, float] = {}
+        for label, fn in sources:
+            try:
+                vals = fn() or {}
+            except Exception:
+                count_swallowed("numTelemetrySampleErrors", __name__,
+                                "gauge source %s failed this tick", label)
+                continue
+            for k, v in vals.items():
+                try:
+                    tick[k] = float(v)
+                except (TypeError, ValueError):
+                    continue  # tpulint: disable=TPU006 a non-numeric gauge value is dropped by contract (sources return {name: number}); counting every tick would drown the hygiene counter
+        with self._lock:
+            for k, v in tick.items():
+                s = self._series.get(k)
+                if s is None:
+                    s = self._series[k] = deque(maxlen=self.max_samples)
+                s.append((now, v))
+            self.ticks += 1
+        lane = {k: tick[k] for k in self.LANE_KEYS if k in tick}
+        if lane:
+            aj = J.active_journal()
+            if aj is not None and aj.is_shard:
+                # worker process: one instant per tick into the
+                # process-lifetime trace shard — drains with the shards
+                # and renders offline as per-worker Chrome counter lanes
+                # (utils/tracing.py).  ONLY shards: from this daemon
+                # thread active_journal() would otherwise fall back to
+                # "newest journal", interleaving ticks into whichever
+                # driver query journal happens to be open.
+                J.journal_event("metric", "gaugeSample", **lane)
+            elif self.recorder is not None:
+                # driver / no shard: feed the ring directly so a
+                # post-mortem still shows this process's final seconds
+                # of gauge history
+                self.recorder.record(json.dumps(
+                    {"ts": time.monotonic_ns(), "ev": "I",
+                     "kind": "metric", "name": "gaugeSample", **lane},
+                    separators=(",", ":")))
+        return tick
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+    def start(self) -> None:
+        if self.interval_s <= 0:
+            return
+        with self._lock:
+            if self._thread is not None:
+                return
+            t = threading.Thread(  # tpulint: disable=TPU009 the sampler thread journals ONLY into the process trace shard (never a thread-local query journal: sample_once checks is_shard), so no trace_context re-install is needed
+                target=self._run, name="telemetry-sampler", daemon=True)
+            self._thread = t
+        t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    # -- reading --------------------------------------------------------------
+
+    def latest(self) -> Dict[str, float]:
+        """{series: newest value} — the /metrics scrape body."""
+        with self._lock:
+            return {k: s[-1][1] for k, s in self._series.items() if s}
+
+    def series_snapshot(self) -> Dict[str, List[Tuple[float, float]]]:
+        """Full retained history per series: [(monotonic_s, value)...]."""
+        with self._lock:
+            return {k: list(s) for k, s in self._series.items()}
+
+
+class Telemetry:
+    """The per-process telemetry plane: ring + sampler (+ http server,
+    attached by metrics/http.py's serve_telemetry)."""
+
+    def __init__(self, recorder: FlightRecorder, sampler: GaugeSampler,
+                 role: str = "driver"):
+        self.recorder = recorder
+        self.sampler = sampler
+        self.role = role
+        self.http = None  # metrics/http.TelemetryServer, when enabled
+
+    def close(self) -> None:
+        if self.http is not None:
+            self.http.close()
+            self.http = None
+        self.sampler.stop()
+        self.recorder.uninstall()
+
+
+_TELEMETRY: List[Optional[Telemetry]] = [None]
+_TELEMETRY_LOCK = threading.Lock()
+
+
+def init_telemetry(conf: Optional[dict] = None,
+                   role: str = "driver") -> Optional[Telemetry]:
+    """Bring up (or return) this process's telemetry singleton from a
+    config dict; returns None when telemetry.enabled is false.  The
+    caller wires sources/HTTP after: cluster.ProcCluster for the driver,
+    shuffle/worker.WorkerHandler for executors."""
+    from .. import config as C
+    if conf is None or isinstance(conf, dict):
+        conf = C.TpuConf(conf or {})
+    with _TELEMETRY_LOCK:
+        if _TELEMETRY[0] is not None:
+            return _TELEMETRY[0]
+        if not conf.get(C.TELEMETRY_ENABLED):
+            return None
+        rec = FlightRecorder(conf.get(C.TELEMETRY_RING_MAX_EVENTS))
+        rec.install()
+        sampler = GaugeSampler(conf.get(C.TELEMETRY_SAMPLE_INTERVAL),
+                               conf.get(C.TELEMETRY_SAMPLE_MAX))
+        sampler.recorder = rec
+        sampler.add_source("ring", rec.stats)
+        t = Telemetry(rec, sampler, role=role)
+        _TELEMETRY[0] = t
+        return t
+
+
+def get_telemetry() -> Optional[Telemetry]:
+    return _TELEMETRY[0]
+
+
+def shutdown_telemetry() -> None:
+    with _TELEMETRY_LOCK:
+        t = _TELEMETRY[0]
+        _TELEMETRY[0] = None
+    if t is not None:
+        t.close()

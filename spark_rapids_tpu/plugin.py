@@ -103,6 +103,25 @@ class TpuCluster:
         import threading
         self._sid = [0]
         self._sid_lock = threading.Lock()
+        # when the process telemetry plane is live, expose the executor
+        # pools' roll-up as one sampler source (label replacement in
+        # metrics/ring.py keeps re-created clusters from stacking stale
+        # closures)
+        from .metrics import ring as R
+        t = R.get_telemetry()
+        if t is not None:
+            t.sampler.add_source("cluster-pools", self.telemetry_gauges)
+
+    def telemetry_gauges(self) -> dict:
+        """Aggregate pool occupancy across the in-process executors, in
+        the sampler's series vocabulary (names.TELEMETRY_GAUGES)."""
+        dev = spill = 0.0
+        for e in self.executors:
+            stats = e.runtime.pool_stats()
+            dev += float(stats.get("device_used", 0) or 0)
+            spill += float((stats.get("host_used", 0) or 0)
+                           + (stats.get("disk_used", 0) or 0))
+        return {"cluster_device_used": dev, "cluster_spill_bytes": spill}
 
     def new_shuffle_id(self) -> int:
         with self._sid_lock:

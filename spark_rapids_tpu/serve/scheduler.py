@@ -402,6 +402,15 @@ class QueryScheduler:
                 "queued": sum(depth.values()),
             }
 
+    def telemetry_gauges(self) -> dict:
+        """The live gauge-sampler series this scheduler owns (the
+        driver source metrics/ring.GaugeSampler snapshots; names from
+        names.TELEMETRY_GAUGES): queries executing now and queries
+        waiting in the priority queue."""
+        fair = self.fairness_snapshot()
+        return {"in_flight_tasks": float(fair["running"]),
+                "queued_queries": float(fair["queued"])}
+
     def prometheus(self) -> str:
         """Serving-tier Prometheus exposition: fairness gauges + the
         per-phase SLO histograms (export.prometheus_serve_dump)."""

@@ -132,6 +132,52 @@ class WorkerHandler:
         self.tasks_completed = 0
         self.tasks_failed = 0
         self.rows_written = 0
+        # flight recorder + gauge sampler + /metrics endpoint (the
+        # always-on telemetry plane, docs/monitoring.md): the ring taps
+        # every journal in this process, the sampler snapshots the gauge
+        # sources below, and the loopback HTTP server is announced in
+        # the ready line so the driver (or a human with curl) can scrape
+        # a live worker
+        from ..config import TELEMETRY_HTTP_ENABLED
+        from ..metrics import ring as R
+        self.telemetry = R.init_telemetry(self.session.conf,
+                                          role="worker")
+        if self.telemetry is not None:
+            self.telemetry.sampler.add_source("pool", self._pool_gauges)
+            self.telemetry.sampler.add_source(
+                "transport", lambda: dict(self.transport.counters))
+            self.telemetry.sampler.add_source("tasks", self._task_gauges)
+            self.telemetry.sampler.start()
+            if bool(self.session.conf.get(TELEMETRY_HTTP_ENABLED)):
+                from ..metrics.http import serve_telemetry
+                serve_telemetry(self.telemetry,
+                                {"executor": executor_id},
+                                healthz=self._healthz)
+
+    def _pool_gauges(self) -> Dict[str, float]:
+        stats = self.runtime.pool_stats()
+        out = {k: float(v) for k, v in stats.items()
+               if isinstance(v, (int, float))}
+        out["spill_bytes"] = float(stats.get("host_used", 0)
+                                   + stats.get("disk_used", 0))
+        return out
+
+    def _task_gauges(self) -> Dict[str, float]:
+        with self._hb_lock:
+            return {"in_flight_tasks": float(len(self._active_tasks))}
+
+    def _healthz(self):
+        with self._hb_lock:
+            payload = {"ok": True, "role": "worker",
+                       "executor_id": self.executor_id,
+                       "pid": os.getpid(),
+                       "active_tasks": len(self._active_tasks),
+                       "tasks_completed": self.tasks_completed,
+                       "tasks_failed": self.tasks_failed,
+                       "shutting_down": self.shutdown_event.is_set()}
+        if payload["shutting_down"]:
+            payload["ok"] = False
+        return (200 if payload["ok"] else 503), payload
 
     # ---- rpc methods -------------------------------------------------------
 
@@ -447,6 +493,19 @@ class WorkerHandler:
                                   delay_spec=delay, crash_spec=crash)
         return True
 
+    def rpc_ring_dump(self):
+        """This worker's flight-recorder ring (the last-N journal lines,
+        metrics/ring.py) — what a post-mortem bundle fetches from every
+        SURVIVING worker (metrics/bundle.dump_diagnostics).  Unlike
+        rpc_drain_journal this is a non-consuming snapshot: it can be
+        read at any moment without perturbing the driver's incremental
+        drain accounting.  None when telemetry is disabled."""
+        if self.telemetry is None:
+            return None
+        lines, dropped = self.telemetry.recorder.dump_lines()
+        return {"executor_id": self.executor_id, "pid": os.getpid(),
+                "dropped": dropped, "lines": lines}
+
     def rpc_shutdown(self):
         self.shutdown_event.set()
         return True
@@ -463,12 +522,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         force_cpu_backend()
 
     conf = json.loads(os.environ.get("SPARK_RAPIDS_TPU_CONF", "{}"))
+    # mark this process as an executor BEFORE the session exists: the
+    # engine's driver-side postmortem arming (SIGUSR1, auto-dump
+    # triggers) must stay off in workers — the driver owns the bundle
+    from ..metrics import ring as R
+    R.PROCESS_ROLE[0] = "worker"
     handler = WorkerHandler(args.executor_id, conf)
-    # announce the data/control port on stdout for the driver
+    # announce the data/control port (and the telemetry endpoint's, when
+    # one is listening) on stdout for the driver
+    http = handler.telemetry.http if handler.telemetry is not None \
+        else None
     print(json.dumps({"ready": True,
                       "executor_id": args.executor_id,
                       "host": handler.transport.address[0],
-                      "port": handler.transport.address[1]}), flush=True)
+                      "port": handler.transport.address[1],
+                      "http_port": http.port if http else None}),
+          flush=True)
 
     # exit when the driver asks, or when it dies (stdin EOF)
     def stdin_watch():

@@ -70,6 +70,14 @@ def journal_to_trace_events(events) -> list:
                                  "host": e.get("host", 0),
                                  "disk": e.get("disk", 0)}})
             continue
+        if e.get("kind") == "metric" and e.get("name") == "gaugeSample":
+            # telemetry counter lanes: one counter track per sampled lane
+            for lane in ("device_used", "in_flight_tasks", "spill_bytes"):
+                if lane in e:
+                    out.append({"name": lane, "ph": "C", "pid": 1,
+                                "ts": ts_us, "cat": "telemetry",
+                                "args": {lane: e[lane]}})
+            continue
         rec = {"name": e.get("name", "?"), "pid": 1,
                "tid": tid_of.get(e.get("kind", "?"), 0), "ts": ts_us,
                "cat": e.get("kind", "?")}
@@ -142,6 +150,17 @@ def timeline_to_trace_events(timeline) -> list:
                             "device": i["attrs"].get("device", 0),
                             "host": i["attrs"].get("host", 0),
                             "disk": i["attrs"].get("disk", 0)}})
+            continue
+        if i["kind"] == "metric" and i["name"] == "gaugeSample":
+            # telemetry counter lanes (metrics/ring.GaugeSampler ticks):
+            # one counter track per worker per lane key, so pool bytes /
+            # in-flight tasks / spill bytes render as per-executor area
+            # charts alongside the span lanes
+            for lane, val in i["attrs"].items():
+                out.append({"name": lane, "ph": "C", "cat": "telemetry",
+                            "pid": pid_of[i["executor"]],
+                            "ts": i["wall_ns"] / 1e3,
+                            "args": {lane: val}})
             continue
         rec = {"name": i["name"], "cat": i["kind"], "ph": "i", "s": "t",
                "pid": pid_of[i["executor"]], "tid": tid_of[i["kind"]],

@@ -582,3 +582,102 @@ def test_chaos_soak_bit_for_bit_bounded_recovery():
         assert prog["workers"] >= 1
     finally:
         cluster.shutdown()
+
+
+# --------------------------------------------------------------------------
+# slow: post-mortem bundles under chaos (ISSUE 17 acceptance)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_worker_kill_round_auto_dumps_postmortem_bundle(tmp_path):
+    """A kill with a ZERO retry budget exhausts the task and must
+    auto-dump one diagnostics bundle (trigger: retry-exhausted) holding
+    ring events from every SURVIVING worker plus the driver; the
+    `postmortem` renderer parses it completely."""
+    from spark_rapids_tpu.metrics.bundle import load_bundle, render_bundle
+    session = TpuSession(
+        {"spark.rapids.sql.tpu.telemetry.postmortem.dir": str(tmp_path),
+         "spark.rapids.sql.tpu.telemetry.postmortem.minIntervalMs": "0"})
+    assert session._postmortem is not None, \
+        "postmortem.dir must arm the manager"
+    table = _kv_table()
+    expected = _expected(table)
+    cluster = _mk_cluster(3, session=session, retries=0)
+    try:
+        map_plans, reduce_plan = _plans(session, table, 3)
+        result, _ = cluster.run_map_reduce(map_plans, ["k"], 6,
+                                           reduce_plan)  # healthy warm-up
+        _check(result, expected)
+        victim = cluster.workers[1]
+        victim.proc.kill()
+        victim.proc.wait()
+        with pytest.raises(RuntimeError, match="failed after 0 retries"):
+            cluster.run_map_reduce(map_plans, ["k"], 6, reduce_plan)
+        bundles = sorted(p for p in os.listdir(str(tmp_path))
+                         if p.startswith("postmortem-"))
+        assert bundles, "no bundle auto-dumped on retry exhaustion"
+        bdir = os.path.join(str(tmp_path), bundles[0])
+        b = load_bundle(bdir)
+        assert b["manifest"]["reason"] == "retry-exhausted"
+        assert "failed after 0 retries" in (b["manifest"]["error"] or "")
+        # rings from the driver and every SURVIVING worker; the dead
+        # worker degrades to one error-status section, never a raise
+        assert b["rings"].get("driver"), "driver ring missing/empty"
+        survivors = [w.executor_id for w in cluster.workers
+                     if w is not victim]
+        for ex in survivors:
+            assert b["rings"].get(ex), f"surviving ring {ex} missing"
+        dead = b["manifest"]["sections"][f"ring-{victim.executor_id}"]
+        assert dead.startswith("error:")
+        report = render_bundle(bdir)
+        assert "retry-exhausted" in report
+        for ex in survivors:
+            assert f"ring {ex}:" in report
+        # the CLI renders the same bundle without error
+        proc = subprocess.run(
+            [sys.executable, "-m", "spark_rapids_tpu.metrics",
+             "postmortem", bdir], capture_output=True, text=True,
+            timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "retry-exhausted" in proc.stdout
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_sigusr1_dumps_bundle_on_live_cluster(tmp_path):
+    """SIGUSR1 on the driver of a live 3-worker cluster asynchronously
+    dumps a bundle with every worker's ring — the 'what is my wedged
+    driver doing' signal, fired while everything is healthy."""
+    import signal as _signal
+    from spark_rapids_tpu.metrics.bundle import load_bundle
+    prev = _signal.getsignal(_signal.SIGUSR1)
+    session = TpuSession(
+        {"spark.rapids.sql.tpu.telemetry.postmortem.dir": str(tmp_path),
+         "spark.rapids.sql.tpu.telemetry.postmortem.minIntervalMs": "0"})
+    table = _kv_table()
+    cluster = _mk_cluster(3, session=session, retries=2)
+    try:
+        map_plans, reduce_plan = _plans(session, table, 3)
+        cluster.run_map_reduce(map_plans, ["k"], 6, reduce_plan)
+        os.kill(os.getpid(), _signal.SIGUSR1)
+        deadline = time.monotonic() + 30
+        bundles = []
+        while time.monotonic() < deadline:
+            bundles = [p for p in os.listdir(str(tmp_path))
+                       if p.startswith("postmortem-")
+                       and "-sigusr1-" in p
+                       and os.path.isfile(os.path.join(
+                           str(tmp_path), p, "manifest.json"))]
+            if bundles:
+                break
+            time.sleep(0.2)
+        assert bundles, "SIGUSR1 never produced a bundle"
+        b = load_bundle(os.path.join(str(tmp_path), bundles[0]))
+        assert b["manifest"]["reason"] == "sigusr1"
+        for w in cluster.workers:
+            assert b["rings"].get(w.executor_id), \
+                f"worker ring {w.executor_id} missing from SIGUSR1 bundle"
+    finally:
+        cluster.shutdown()
+        _signal.signal(_signal.SIGUSR1, prev)

@@ -60,7 +60,15 @@ def test_essential_no_per_batch_device_syncs():
     assert R.DEVICE_SYNCS.count == before, \
         "ESSENTIAL level forced a per-batch device sync"
     assert len(rows) == 5
-    assert s.last_execution.journal is None  # no journal below DEBUG
+    # below DEBUG with no journal dir, a journal exists ONLY as the
+    # in-memory mirror feeding the flight-recorder ring (metrics/ring.py)
+    # — never a file
+    from spark_rapids_tpu.metrics.ring import get_telemetry
+    if get_telemetry() is None:
+        assert s.last_execution.journal is None
+    else:
+        assert s.last_execution.journal is None \
+            or s.last_execution.journal.path is None
 
 
 def test_moderate_no_per_batch_device_syncs_but_timers():
