@@ -575,13 +575,13 @@ def pressure_microbench(write_artifact: bool = True) -> dict:
             .agg(F.sum(col("v")).alias("sv"), F.count(lit(1)).alias("c"))
             .order_by(col("name")).collect())
 
-    def run(pool_bytes=0, jdir=None):
+    def run(pool_bytes=0, jdir=None, extra=None):
         """One measured slice run.  The warmup query shares the session
         (compiles + H2D), so everything reported is a DELTA over the
         timed run only: counter movement, and only the journal files the
         timed query opened — otherwise every breakdown would double-count
         the warmup's spills against one run's time_s."""
-        conf = dict(base_conf)
+        conf = dict(base_conf, **(extra or {}))
         if pool_bytes:
             conf["spark.rapids.memory.tpu.poolSizeBytes"] = str(pool_bytes)
         if jdir:
@@ -596,7 +596,11 @@ def pressure_microbench(write_artifact: bool = True) -> dict:
         elapsed = time.perf_counter() - t0
         ps_after = s.runtime.pool_stats()
         counters = {k: int(ps_after.get(k, 0)) - int(ps_before.get(k, 0))
-                    for k in (MN.OOM_SPILL_RETRIES, MN.OOM_ALLOC_FAILURES)}
+                    for k in (MN.OOM_SPILL_RETRIES, MN.OOM_ALLOC_FAILURES,
+                              MN.NUM_POLICY_VICTIM_PICKS,
+                              MN.NUM_POLICY_VICTIM_OVERRIDES,
+                              MN.NUM_POLICY_EARLY_RELEASES,
+                              MN.NUM_PROACTIVE_UNSPILLS)}
         tot_after = dict(getattr(s, "query_metrics_total", {}) or {})
         totals = {k: tot_after.get(k, 0) - tot_before.get(k, 0)
                   for k in tot_after}
@@ -626,17 +630,16 @@ def pressure_microbench(write_artifact: bool = True) -> dict:
         shutil.rmtree(jdir0, ignore_errors=True)
     working_set = int(ps0.get("device_peak", 0)) or 1
 
-    budgets = {}
-    for pct in (100, 75, 50, 25):
-        pool = max(1 << 16, working_set * pct // 100)
-        jdir = tempfile.mkdtemp(prefix=f"bench_pressure_{pct}_")
+    def budget_row(pool, prefix, extra=None):
+        jdir = tempfile.mkdtemp(prefix=prefix)
         try:
-            el, val, _ps, counters, totals, shards = run(pool, jdir)
+            el, val, _ps, counters, totals, shards = run(pool, jdir,
+                                                         extra)
             rep = analyze_shards(shards)
         finally:
             shutil.rmtree(jdir, ignore_errors=True)
         t = rep["totals"]
-        budgets[str(pct)] = {
+        row = {
             "pool_bytes": pool,
             "time_s": round(el, 4),
             "slowdown_vs_unconstrained": round(el / el0, 3) if el0 else None,
@@ -654,11 +657,35 @@ def pressure_microbench(write_artifact: bool = True) -> dict:
             # runtime/retry view of the same run (timed-run deltas)
             "oomSpillRetries": counters[MN.OOM_SPILL_RETRIES],
             "oomAllocFailures": counters[MN.OOM_ALLOC_FAILURES],
+            "numPolicyVictimPicks": counters[MN.NUM_POLICY_VICTIM_PICKS],
+            "numPolicyVictimOverrides":
+                counters[MN.NUM_POLICY_VICTIM_OVERRIDES],
+            "numPolicyEarlyReleases":
+                counters[MN.NUM_POLICY_EARLY_RELEASES],
+            "numProactiveUnspills": counters[MN.NUM_PROACTIVE_UNSPILLS],
             "retries": int(sum(totals.get(f"{b}Retries", 0)
                                for b in MN.RETRY_BLOCKS)),
             "splits": int(sum(totals.get(f"{b}Splits", 0)
                               for b in MN.RETRY_BLOCKS)),
         }
+        return row, val
+
+    # each budget runs twice — data-movement policy engine ON (the
+    # default) and OFF — so the artifact carries the ISSUE-18 acceptance
+    # comparison (churn/slowdown deltas, and bit-for-bit row checksums)
+    policy_off_conf = {"spark.rapids.sql.tpu.policy.enabled": "false"}
+    budgets = {}
+    for pct in (100, 75, 50, 25):
+        pool = max(1 << 16, working_set * pct // 100)
+        row, val_on = budget_row(pool, f"bench_pressure_{pct}_")
+        off, val_off = budget_row(pool, f"bench_pressure_{pct}off_",
+                                  policy_off_conf)
+        row["policy_off"] = {k: off[k] for k in (
+            "time_s", "slowdown_vs_unconstrained", "match",
+            "spill_bytes", "respill_bytes", "churn_ratio",
+            "victim_quality", "cascades", "oomSpillRetries")}
+        row["policy_bit_for_bit"] = bool(val_on == val_off)
+        budgets[str(pct)] = row
 
     # 2. ledger overhead gate (<5% on q1 at MODERATE, journal on — the
     # ISSUE-8 twin of the tracing stage's gate)

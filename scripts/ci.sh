@@ -186,6 +186,18 @@ T_CHAOS=$SECONDS
 python -m pytest tests/test_chaos.py -q -m "not slow" -p no:cacheprovider
 echo "== chaos tier took $((SECONDS - T_CHAOS))s =="
 
+echo "== policy tier =="
+# data-movement policy engine (ISSUE 18): policy ON must equal policy
+# OFF bit-for-bit across every dtype and under genuine pressure (the
+# kill switch is the contract), injected OOMs at every reserve site
+# must recover identically with the scorer live, proactive unspill must
+# stay inside the owning query's budget, flow-control stalls must stay
+# bounded (never a deadlock), and codec re-selection must round-trip
+# the PR 5 negotiation
+T_POL=$SECONDS
+python -m pytest tests/test_policy.py -q -m "not slow" -p no:cacheprovider
+echo "== policy tier took $((SECONDS - T_POL))s =="
+
 echo "== mesh exchange tier =="
 # mesh-native ICI shuffle (ISSUE 14): the generic exchange lowered into
 # jitted shard_map collectives must be bit-for-bit with the socket tier

@@ -911,6 +911,96 @@ MEM_LEDGER_SAMPLE_MS = _conf(
     "timeline).  OOM events always force a sample.  0 samples on every "
     "ledger event.", int)
 
+# --- data-movement policy engine (policy/) -----------------------------------
+POLICY_ENABLED = _conf(
+    "spark.rapids.sql.tpu.policy.enabled", True,
+    "Master switch for the data-movement policy engine (policy/): "
+    "next-use spill victim selection, proactive unspill of soon-needed "
+    "buffers, reduce-driven shuffle flow control, and roofline-driven "
+    "codec re-selection.  The engine only CONSUMES signals the ledgers "
+    "already produce (memory ledger re-touch history, shuffle read "
+    "order, roofline wire peak) and journals every decision under kind "
+    "'policy'.  false is the kill switch: victim order, fetch admission "
+    "and wire codec revert byte-identically to the pre-policy engine "
+    "(docs/tuning-guide.md, Data-movement policy).", _to_bool)
+POLICY_RETOUCH_WEIGHT = _conf(
+    "spark.rapids.sql.tpu.policy.victim.retouchWeight", 4.0,
+    "Score bonus protecting a spill victim per prior spill of the same "
+    "buffer (capped at 4 round trips).  The memory ledger's re-touch "
+    "history is the churn signal: a buffer that already paid a "
+    "spill+unspill round trip is this much LESS likely to be evicted "
+    "again than a never-spilled peer.  0 disables re-touch protection; "
+    "victims then rank purely on shuffle-partition liveness.", float)
+POLICY_EARLY_RELEASE = _conf(
+    "spark.rapids.sql.tpu.policy.earlyRelease.enabled", True,
+    "Free a shuffle partition's map-side device buffers as soon as the "
+    "declared read plan has consumed it for the LAST time (single-"
+    "consumer local exchanges only — never with a cluster attached, "
+    "where a peer or a speculative re-read may still fetch the block).  "
+    "A fully-consumed partition has next-use = never: releasing it "
+    "outright returns its bytes to the pool with no spill write, where "
+    "the baseline would re-spill it under pressure and count churn.  "
+    "Skew slices and coalesced specs that read a partition more than "
+    "once are planned for — the release fires only after the final "
+    "planned consumption.", _to_bool)
+POLICY_UNSPILL_INTERVAL = _conf(
+    "spark.rapids.sql.tpu.policy.unspill.intervalMs", 20,
+    "Wake interval of the proactive-unspill policy thread.  Each tick "
+    "re-materializes up to a few spilled buffers with the nearest "
+    "declared next use, charged to the owning query's ledger scope "
+    "(and its serve.queryBudgetBytes, so a prefetch can never cause "
+    "another query's OOM).  0 disables the thread; victim scoring and "
+    "flow control stay active.", int)
+POLICY_UNSPILL_HEADROOM = _conf(
+    "spark.rapids.sql.tpu.policy.unspill.headroomFraction", 0.5,
+    "Pool fraction that must remain free AFTER a proactive unspill for "
+    "it to be admitted — the prefetch is opportunistic and must never "
+    "push the device pool toward an eviction it would not otherwise "
+    "have performed.  Unspills additionally require the pool to be "
+    "spill-quiescent since the policy's previous tick.", float)
+POLICY_FLOW_MIN_WINDOW = _conf(
+    "spark.rapids.sql.tpu.policy.flow.minWindowBytes", 4 << 20,
+    "Floor of the reduce-driven flow-control window.  The window is "
+    "max(this, observed reduce consumption rate x flow.horizonMs): a "
+    "stalled consumer shrinks admission to this floor (progress is "
+    "always possible; one batch of any size still admits alone), a fast "
+    "consumer widens it up to the transport's static "
+    "maxReceiveInflightBytes bound.", to_bytes)
+POLICY_FLOW_HORIZON = _conf(
+    "spark.rapids.sql.tpu.policy.flow.horizonMs", 200,
+    "Flow-control horizon: the in-flight-bytes window targets this many "
+    "milliseconds of the reduce side's observed consumption rate, so a "
+    "producer holds at most ~horizon's worth of un-consumed bytes in "
+    "flight instead of ballooning host memory behind a slow consumer.",
+    int)
+POLICY_FLOW_MAX_STALL = _conf(
+    "spark.rapids.sql.tpu.policy.flow.maxServeStallMs", 50,
+    "Upper bound on one map-side serve stall when in-flight served "
+    "bytes exceed the flow-control window; past it the serve proceeds "
+    "anyway (soft backpressure — bounded stalls cannot deadlock the "
+    "exchange; counted in numBackpressureStalls).", int, internal=True)
+POLICY_CODEC = _conf(
+    "spark.rapids.sql.tpu.policy.codec.candidate", "lz4",
+    "Codec the policy engine advises for fetches of an exchange proven "
+    "wire-bound at runtime (read throughput at or above "
+    "codec.wireBoundFraction of the roofline wire peak at "
+    "codec.minExchangeBytes volume).  Rides the shuffle compression "
+    "negotiation end to end — the server may still answer raw when the "
+    "codec is unavailable there.  'none' disables re-selection; a "
+    "session with spark.rapids.shuffle.compression.codec explicitly "
+    "enabled is never second-guessed.", str)
+POLICY_CODEC_MIN_BYTES = _conf(
+    "spark.rapids.sql.tpu.policy.codec.minExchangeBytes", 32 << 20,
+    "Minimum wire bytes an exchange's read phase must have moved before "
+    "its throughput evidence can trigger codec re-selection — tiny "
+    "exchanges prove nothing and never pay codec CPU.", to_bytes)
+POLICY_CODEC_WIRE_BOUND = _conf(
+    "spark.rapids.sql.tpu.policy.codec.wireBoundFraction", 0.5,
+    "Fraction of the roofline wire peak (metrics/roofline.py "
+    "platform_peaks, overridable via ROOFLINE_PEAK_* confs) an "
+    "exchange's observed read throughput must reach to be judged "
+    "wire-bound for codec re-selection.", float)
+
 # --- serving tier (serve/: scheduler, admission, plan cache) -----------------
 SERVE_MAX_CONCURRENT = _conf(
     "spark.rapids.sql.tpu.serve.maxConcurrentQueries", 4,

@@ -121,7 +121,14 @@ class TpuSession:
                 out.update(sched.telemetry_gauges())
             return out
 
+        def policy_gauges() -> Dict[str, float]:
+            s = t.session_ref()
+            rt = s._runtime if s is not None else None
+            pol = getattr(rt, "policy", None) if rt is not None else None
+            return pol.gauges() if pol is not None else {}
+
         t.sampler.add_source("driver", driver_gauges)
+        t.sampler.add_source("policy", policy_gauges)
         t.sampler.start()
         if t.http is None \
                 and bool(self.conf.get(C.TELEMETRY_HTTP_ENABLED)):

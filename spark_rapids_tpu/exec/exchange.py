@@ -17,7 +17,8 @@ Spark 3 AQE over GpuShuffleExchangeExec).
 """
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Sequence
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
 
 from ..columnar import ColumnarBatch, concat_batches
 from ..ops import expressions as E
@@ -303,6 +304,31 @@ class TpuShuffleExchangeExec(TpuExec):
             and specs[-1].end == h.num_partitions \
             and all(specs[i].start == specs[i - 1].end
                     for i in range(1, len(specs)))
+        # data-movement policy (policy/engine.py): declare the reduce-
+        # partition read order (plan lookahead for victim scoring +
+        # proactive unspill), then advance the cursor / mark partitions
+        # dead as each spec is handed to the consumer
+        pol = getattr(ctx.runtime, "policy", None) if ctx.runtime \
+            and not is_mesh else None
+        spec_rids = [sorted({p for p, _mr in s.units()}) for s in specs]
+        if pol is not None:
+            seen = set()
+            order = [p for rids in spec_rids for p in rids
+                     if not (p in seen or seen.add(p))]
+            # planned consumptions per partition (a skew-sliced or
+            # re-read partition appears in several specs); with no
+            # cluster this process is the shuffle's only consumer, so
+            # the policy may free a partition's map buffers at its
+            # FINAL planned consumption (early release)
+            counts: Dict[int, int] = {}
+            for rids in spec_rids:
+                for p in rids:
+                    counts[p] = counts.get(p, 0) + 1
+            pol.begin_shuffle_read(h.sid, order, counts=counts,
+                                   exclusive=h.cluster is None)
+        wire_seen = [0]
+        t0 = time.perf_counter()
+
         def with_read_cost(pairs):
             # roofline: on the socket tier every coalesced partition
             # batch came OFF the shuffle wire and back over the
@@ -319,6 +345,10 @@ class TpuShuffleExchangeExec(TpuExec):
                         record_cost(self.metrics,
                                     wire=out.device_size_bytes(),
                                     h2d=out.device_size_bytes())
+                        wire_seen[0] += out.device_size_bytes()
+                if pol is not None:
+                    for rid in spec_rids[p]:
+                        pol.partition_consumed(h.sid, rid)
                 yield p, out
 
         try:
@@ -330,6 +360,11 @@ class TpuShuffleExchangeExec(TpuExec):
                     yield from with_read_cost(
                         self._read_specs_sync(ctx, h, specs))
         finally:
+            if pol is not None:
+                # runtime evidence for codec re-selection: the observed
+                # read throughput of this exchange vs the wire roofline
+                pol.observe_exchange(h.sid, wire_seen[0],
+                                     time.perf_counter() - t0)
             h.release()
 
     def _read_specs_async(self, ctx: ExecContext, h: _ShuffleHandle,
@@ -342,11 +377,14 @@ class TpuShuffleExchangeExec(TpuExec):
         n = h.num_partitions
         if h.cluster is not None:
             from ..shuffle.fetch import AsyncFetchIterator
+            pol = getattr(ctx.runtime, "policy", None) if ctx.runtime \
+                else None
             it = AsyncFetchIterator(
                 None, h.sid, range(n), None,
                 int(ctx.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
                 route=h.route,
-                oom_retries=int(ctx.conf.get(OOM_RETRY_MAX)))
+                oom_retries=int(ctx.conf.get(OOM_RETRY_MAX)),
+                flow=pol.flow_controller() if pol is not None else None)
         else:
             it = h.env.fetch_partitions_async(h.sid, range(n))
         drained = _drain_async(it, n)

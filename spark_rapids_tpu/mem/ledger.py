@@ -153,6 +153,15 @@ class MemoryLedger:
     def current_query_scope(self) -> Optional[QueryScope]:
         return getattr(self._tls, "qscope", None)
 
+    def spill_counts_for(self, buffer_ids) -> Dict[int, int]:
+        """Prior device-spill counts for a set of live buffers — the
+        re-touch history policy victim scoring weighs (a buffer that
+        already paid a spill round trip is protected from paying
+        another).  Missing ids read as never spilled."""
+        with self._lock:
+            return {bid: self._spill_counts[bid] for bid in buffer_ids
+                    if bid in self._spill_counts}
+
     def current_query(self) -> Optional[str]:
         """Owning query id for buffers registered by this thread: the
         explicit query scope when one is installed, else the distributed

@@ -186,6 +186,13 @@ class TpuRuntime:
         self.oom_spill = bool(self.conf.get(TPU_OOM_SPILL_ENABLED))
         self.semaphore = TpuSemaphore(
             int(self.conf.get(CONCURRENT_TPU_TASKS)), metrics=self.metrics)
+        # data-movement policy engine (policy/): rides the catalog like
+        # integrity/compression/ledger so the stores' victim pick can
+        # consult next-use scores without plumbing; holds only a weakref
+        # back to this runtime (a collected runtime ends its thread)
+        from ..policy import MovementPolicy
+        self.policy = MovementPolicy(self.conf, runtime=self)
+        self.catalog.policy = self.policy
         self._lock = threading.Lock()
 
     # ---- allocation boundary ----------------------------------------------
@@ -299,6 +306,7 @@ class TpuRuntime:
     def get_batch(self, buffer_id: int) -> ColumnarBatch:
         """Materialize a registered batch on device, from whatever tier it
         currently occupies (the read path of RapidsBuffer.getColumnarBatch)."""
+        self.policy.note_access(buffer_id)  # prefetch-hit accounting
         buf = self.catalog.acquire(buffer_id)
         try:
             return self._materialize(buf)

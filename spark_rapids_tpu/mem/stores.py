@@ -182,11 +182,18 @@ class BufferStore:
         # per-owning-query tracked bytes (serving-tier budgets); entries
         # die when they reach zero, so idle sessions cost nothing
         self._owner_sizes: Dict[str, int] = {}
+        # scored victim picks accumulated under the lock, journaled by
+        # synchronous_spill AFTER it releases (journal taps never run
+        # under a store lock — same discipline as _spill_one's ledger)
+        self._pending_decisions: List[dict] = []
         self._lock = threading.RLock()
 
-    def _priority_of(self, buffer_id: int) -> float:
+    def _priority_of(self, buffer_id: int):
+        # (priority, id): equal-priority victims order by id — creation
+        # order — NOT heap/dict insertion accidents, so victim sequences
+        # (and BENCH_PRESSURE churn rows) reproduce across processes
         b = self._buffers[buffer_id]
-        return b.spill_priority
+        return (b.spill_priority, buffer_id)
 
     @property
     def current_size(self) -> int:
@@ -253,6 +260,19 @@ class BufferStore:
             if buf.id in self._buffers:
                 self._queue.update_priority(buf.id)
 
+    def spill_candidates(self, owner: Optional[str] = None) -> List[int]:
+        """Spillable buffer ids (unreferenced, owner-confined when asked)
+        in the exact order synchronous_spill would consider them:
+        (spill_priority, id) ascending.  The stable ordering API policy
+        scoring and tests rank against — deterministic for a given set
+        of live buffers regardless of heap/dict insertion history."""
+        with self._lock:
+            return sorted(
+                (bid for bid, b in self._buffers.items()
+                 if b.ref_count == 0
+                 and (owner is None or b.owner == owner)),
+                key=self._priority_of)
+
     def synchronous_spill(self, target_size: int,
                           owner: Optional[str] = None) -> int:
         """Migrate lowest-priority unreferenced buffers to the next tier
@@ -261,6 +281,13 @@ class BufferStore:
         With `owner`, both the size bound and the victim pool are confined
         to that query's buffers — per-query budget enforcement spills the
         hog itself, never its neighbors (mem/ledger.py QueryScope)."""
+        try:
+            return self._synchronous_spill(target_size, owner)
+        finally:
+            self._flush_decisions()
+
+    def _synchronous_spill(self, target_size: int,
+                           owner: Optional[str]) -> int:
         spilled = 0
         while True:
             with self._lock:
@@ -292,6 +319,9 @@ class BufferStore:
 
     def _pick_victim(self, owner: Optional[str] = None
                      ) -> Optional[SpillableBuffer]:
+        policy = getattr(self.catalog, "policy", None)
+        if policy is not None and policy.wants_victim_scoring():
+            return self._pick_victim_scored(policy, owner)
         # scan from the head of the priority queue for an unreferenced
         # buffer (owned by `owner`, when confined)
         skipped: List[int] = []
@@ -310,6 +340,43 @@ class BufferStore:
         if victim is not None:
             self._queue.offer(victim.id)  # restored; caller removes
         return victim
+
+    def _pick_victim_scored(self, policy, owner: Optional[str]
+                            ) -> Optional[SpillableBuffer]:
+        """Victim by next-use score (policy/engine.py scores_for; lower
+        spills first), ties broken by the baseline (priority, id) order
+        so an engine that knows nothing picks EXACTLY the baseline
+        victim.  Records every pick (and whether it overrode the
+        baseline) for the post-lock decision flush."""
+        cands = self.spill_candidates(owner)
+        if not cands:
+            return None
+        baseline = min(cands, key=self._priority_of)
+        scores = policy.scores_for(cands)
+        victim_id = min(cands, key=lambda bid: (scores.get(bid, 1.0),)
+                        + tuple(self._priority_of(bid)))
+        self._pending_decisions.append({
+            "buffer": victim_id,
+            "baseline": baseline,
+            "overridden": victim_id != baseline,
+            "score": scores.get(victim_id, 1.0),
+            "owner": owner,
+        })
+        return self._buffers[victim_id]
+
+    def _flush_decisions(self) -> None:
+        """Journal + count the scored picks accumulated during a spill
+        sweep — OUTSIDE the store lock (journal taps may block)."""
+        with self._lock:
+            if not self._pending_decisions:
+                return
+            decisions, self._pending_decisions = \
+                self._pending_decisions, []
+        policy = getattr(self.catalog, "policy", None)
+        if policy is None:
+            return
+        for d in decisions:
+            policy.record_victim(self.tier, d)
 
     def _spill_one(self, buf: SpillableBuffer) -> None:
         assert self.spill_store is not None, \
@@ -534,6 +601,9 @@ class BufferCatalog:
     # memory-pressure ledger (mem/ledger.py), installed by TpuRuntime;
     # None = no allocation/spill event stream (bare-store unit tests)
     ledger = None
+    # data-movement policy engine (policy/engine.py), installed by
+    # TpuRuntime; None = baseline (priority, id) victim order
+    policy = None
 
     def __init__(self):
         self._buffers: Dict[int, SpillableBuffer] = {}

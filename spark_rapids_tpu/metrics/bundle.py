@@ -144,6 +144,20 @@ def dump_diagnostics(bundle_dir: str, session=None, cluster=None,
         _section(bundle_dir, sections, "samples", "samples.json",
                  lambda: _jsonable(telemetry.sampler.series_snapshot()))
 
+        def policy_tail():
+            # the last data-movement policy decisions still in the ring
+            # (victims/unspills/backpressure/codec) — what the engine
+            # chose right before the failure, without needing journal
+            # shards on disk
+            import json as _json
+            snap = telemetry.recorder.snapshot()
+            recs = [r for r in snap.get("events") or []
+                    if r.get("kind") == "policy"][-200:]
+            return "".join(_json.dumps(r, default=str) + "\n"
+                           for r in recs)
+        _section(bundle_dir, sections, "policy-tail", "policy-tail.jsonl",
+                 policy_tail)
+
     manifest = {
         "version": 1,
         "reason": reason,
@@ -330,6 +344,19 @@ def render_bundle(bundle_dir: str) -> str:
             lines.append("  timeline metrics: " + ", ".join(
                 f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in interesting.items()))
+    if "policy-tail.jsonl" in b["texts"]:
+        tail = [ln for ln in b["texts"]["policy-tail.jsonl"].splitlines()
+                if ln.strip()]
+        recs: Dict[str, int] = {}
+        for ln in tail:
+            try:
+                name = json.loads(ln).get("name", "?")
+            except ValueError:  # tpulint: disable=TPU006 rendering a post-mortem artifact: a torn tail line is display-only and skipped by design
+                continue
+            recs[name] = recs.get(name, 0) + 1
+        rec_str = ", ".join(f"{k}={n}" for k, n in sorted(recs.items()))
+        lines.append(f"  policy tail: {len(tail)} decisions"
+                     + (f" ({rec_str})" if rec_str else ""))
     if "explain.txt" in b["texts"]:
         lines.append("")
         lines.append(b["texts"]["explain.txt"].rstrip("\n"))

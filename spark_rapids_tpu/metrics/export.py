@@ -419,6 +419,25 @@ def session_observability(session) -> dict:
         out["numBufferRespills"] = int(
             pool.get(N.NUM_BUFFER_RESPILLS, 0))
         out["memLedgerEvents"] = int(pool.get(N.MEM_LEDGER_EVENTS, 0))
+        # data-movement policy decisions (ISSUE 18): how often the
+        # engine changed a victim, moved bytes ahead of use, stalled a
+        # producer, or flipped the wire codec — a bench row with these
+        # at zero ran with the policy effectively idle
+        out["numPolicyVictimPicks"] = int(
+            pool.get(N.NUM_POLICY_VICTIM_PICKS, 0))
+        out["numPolicyVictimOverrides"] = int(
+            pool.get(N.NUM_POLICY_VICTIM_OVERRIDES, 0))
+        out["numPolicyEarlyReleases"] = int(
+            pool.get(N.NUM_POLICY_EARLY_RELEASES, 0))
+        out["numProactiveUnspills"] = int(
+            pool.get(N.NUM_PROACTIVE_UNSPILLS, 0))
+        out["numPrefetchHits"] = int(pool.get(N.NUM_PREFETCH_HITS, 0))
+        out["numPrefetchWasted"] = int(
+            pool.get(N.NUM_PREFETCH_WASTED, 0))
+        out["numBackpressureStalls"] = int(
+            pool.get(N.NUM_BACKPRESSURE_STALLS, 0))
+        out["numCodecReselections"] = int(
+            pool.get(N.NUM_CODEC_RESELECTIONS, 0))
     # shuffle tier selection (ISSUE 14): how many exchanges the mesh
     # tier served as jitted ICI collectives vs de-lowered to the socket
     # tier — read from the session transport's counters (shuffle/ici.py)

@@ -88,7 +88,15 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # instant per executed stage, attrs flops/hbm_bytes/source)
                # joined offline against the operator spans by the
                # `python -m spark_rapids_tpu.metrics roofline` report
-               "cost")
+               "cost",
+               # policy = one data-movement policy decision (policy/):
+               # victim (scored spill pick, with the baseline choice it
+               # kept or overrode), unspill (proactive re-materialize,
+               # attrs buffer/bytes/owner), backpressure (a flow-control
+               # admission stall, attrs where/window), codec (a roofline-
+               # proven wire-bound exchange flipping the fetch codec) —
+               # replayed by `python -m spark_rapids_tpu.metrics --memory`
+               "policy")
 
 # --- flight-recorder taps ----------------------------------------------------
 # Process-wide observers of EVERY journal record emitted by ANY journal in

@@ -45,6 +45,67 @@ def mem_events(events: List[dict]) -> List[dict]:
             if e.get("kind") == "mem" and e.get("ev") == "I"]
 
 
+def analyze_policy(shards: List[dict]) -> dict:
+    """Replay the data-movement policy decision stream (journal kind
+    `policy`, policy/) from shard dicts alone: victims chosen vs.
+    overridden, proactive unspills and their fate (a prefetched buffer
+    re-spilled before its read was a wasted movement — derived by
+    interleaving the `mem` spill records), backpressure stalls and codec
+    re-selections."""
+    rep = {"victims": 0, "overridden": 0, "unspills": 0,
+           "releases": 0, "released_bytes": 0,
+           "prefetch_respilled": 0, "backpressure_stalls": 0,
+           "stalls_by_where": {}, "codec_reselections": [],
+           "decisions": []}
+    for shard in shards:
+        executor = shard.get("label") or shard.get("executor") or "?"
+        prefetched = set()
+        for e in shard.get("events") or []:
+            if e.get("ev") != "I":
+                continue
+            kind, name = e.get("kind"), e.get("name")
+            if kind == "mem":
+                if name == "spill" and e.get("src") == "DEVICE" \
+                        and e.get("buffer") in prefetched:
+                    rep["prefetch_respilled"] += 1
+                    prefetched.discard(e.get("buffer"))
+                continue
+            if kind != "policy":
+                continue
+            if name == "victim":
+                rep["victims"] += 1
+                if e.get("overridden"):
+                    rep["overridden"] += 1
+                if len(rep["decisions"]) < 50:
+                    rep["decisions"].append(
+                        {"executor": executor,
+                         "buffer": e.get("buffer"),
+                         "baseline": e.get("baseline"),
+                         "overridden": bool(e.get("overridden")),
+                         "score": e.get("score"),
+                         "tier": e.get("tier")})
+            elif name == "unspill":
+                rep["unspills"] += 1
+                prefetched.add(e.get("buffer"))
+            elif name == "release":
+                rep["releases"] += 1
+                rep["released_bytes"] += int(e.get("bytes") or 0)
+                prefetched.discard(e.get("buffer"))
+            elif name == "backpressure":
+                rep["backpressure_stalls"] += 1
+                w = str(e.get("where") or "?")
+                rep["stalls_by_where"][w] = \
+                    rep["stalls_by_where"].get(w, 0) + 1
+            elif name == "codec":
+                rep["codec_reselections"].append(
+                    {"executor": executor,
+                     "shuffle": e.get("shuffle"),
+                     "codec": e.get("codec"),
+                     "wire_bytes": e.get("wire_bytes"),
+                     "utilization": e.get("utilization")})
+    return rep
+
+
 def analyze_shards(shards: List[dict],
                    retouch_window: int = DEFAULT_RETOUCH_WINDOW) -> dict:
     """Full memory analysis over drained/loaded shard dicts
@@ -272,6 +333,7 @@ def analyze_shards(shards: List[dict],
         "victim_quality": dict(vq, quality=round(quality, 4)),
         "headroom": {"bytes": headroom,
                      "by_query": headroom_by_query},
+        "policy": analyze_policy(shards),
     }
 
 
@@ -337,6 +399,41 @@ def render(rep: dict) -> str:
         f"re-touched within {vq['window']} events "
         f"({_mb(vq['retouched_bytes'])} of {_mb(vq['spilled_bytes'])}; "
         f"quality {vq['quality']:.2%})")
+    pol = rep.get("policy") or {}
+    if pol.get("victims") or pol.get("unspills") \
+            or pol.get("releases") \
+            or pol.get("backpressure_stalls") \
+            or pol.get("codec_reselections"):
+        lines.append("policy decisions:")
+        lines.append(
+            f"    victims: {pol['victims']} scored picks, "
+            f"{pol['overridden']} overrode the baseline order")
+        settled = pol["unspills"] - pol["prefetch_respilled"]
+        lines.append(
+            f"    proactive unspills: {pol['unspills']} "
+            f"({pol['prefetch_respilled']} re-spilled before their "
+            f"read — wasted movement; {settled} stayed resident)")
+        if pol.get("releases"):
+            lines.append(
+                f"    early releases: {pol['releases']} fully-consumed "
+                f"partition buffers freed without a spill write "
+                f"({_mb(pol['released_bytes'])})")
+        if pol["backpressure_stalls"]:
+            by = ", ".join(f"{w}={n}" for w, n in
+                           sorted(pol["stalls_by_where"].items()))
+            lines.append(f"    backpressure stalls: "
+                         f"{pol['backpressure_stalls']} ({by})")
+        for c in pol["codec_reselections"][:10]:
+            lines.append(
+                f"    codec: shuffle {c['shuffle']} -> {c['codec']} "
+                f"({_mb(int(c['wire_bytes'] or 0))} at "
+                f"{float(c['utilization'] or 0):.0%} of wire peak)")
+        for d in pol["decisions"][:10]:
+            if d["overridden"]:
+                lines.append(
+                    f"    victim override: buffer {d['buffer']} over "
+                    f"baseline {d['baseline']} (score {d['score']}, "
+                    f"{d['tier']})")
     hr = rep["headroom"]
     if hr["bytes"] > 0:
         lines.append(

@@ -431,6 +431,50 @@ REPLAN_TIME = register_metric(
     "time spent applying adaptive re-planning rules between stages "
     "(excludes the map-stage writes themselves)")
 
+# --- data-movement policy decision counters (policy/) -----------------------
+# Every policy decision is also journaled under kind 'policy'; these count
+# them live so session_observability / /metrics show the engine acting.
+NUM_POLICY_VICTIM_PICKS = register_metric(
+    "numPolicyVictimPicks", COUNTER, ESSENTIAL,
+    "spill victims chosen while next-use scoring was active (every "
+    "scored pick, whether or not it changed the baseline order)")
+NUM_POLICY_VICTIM_OVERRIDES = register_metric(
+    "numPolicyVictimOverrides", COUNTER, ESSENTIAL,
+    "spill victims where the next-use score OVERRODE the baseline "
+    "(priority, id) choice — the decisions the policy engine actually "
+    "changed; zero with scoring active means it never disagreed")
+NUM_POLICY_EARLY_RELEASES = register_metric(
+    "numPolicyEarlyReleases", COUNTER, ESSENTIAL,
+    "shuffle partition buffers freed at their FINAL planned "
+    "consumption (single-consumer local reads) — bytes returned to the "
+    "pool with no spill write that the baseline would have re-spilled "
+    "under pressure")
+NUM_PROACTIVE_UNSPILLS = register_metric(
+    "numProactiveUnspills", COUNTER, ESSENTIAL,
+    "spilled buffers the policy thread re-materialized ahead of their "
+    "declared next use (charged to the owning query's ledger scope)")
+NUM_PREFETCH_HITS = register_metric(
+    "numPrefetchHits", COUNTER, ESSENTIAL,
+    "proactively unspilled buffers that were then actually read from "
+    "the device tier — the prefetch paid off")
+NUM_PREFETCH_WASTED = register_metric(
+    "numPrefetchWasted", COUNTER, ESSENTIAL,
+    "proactively unspilled buffers evicted or released before any "
+    "read — device bytes the policy thread moved for nothing; if this "
+    "rivals numPrefetchHits, raise policy.unspill.headroomFraction or "
+    "disable the thread")
+NUM_BACKPRESSURE_STALLS = register_metric(
+    "numBackpressureStalls", COUNTER, ESSENTIAL,
+    "flow-control admission stalls (map-side serve staging + reduce-"
+    "side fetch admission) where in-flight bytes exceeded the reduce-"
+    "rate-driven window — each one is host memory NOT ballooned behind "
+    "a slow consumer")
+NUM_CODEC_RESELECTIONS = register_metric(
+    "numCodecReselections", COUNTER, ESSENTIAL,
+    "exchanges whose runtime-observed read throughput proved them "
+    "wire-bound and triggered codec re-selection through the shuffle "
+    "compression negotiation path")
+
 # --- exception-hygiene counters (metrics/registry.py ENGINE_COUNTERS) -------
 # Process-wide counters for swallowed-failure sites that have no operator
 # Metrics object in scope; every TPU006 fix pairs a log line with one of
@@ -500,6 +544,11 @@ NUM_POSTMORTEM_ERRORS = register_metric(
     "post-mortem bundle sections or whole dumps that raised while being "
     "assembled (metrics/bundle.py) — the bundle (or section) is missing "
     "exactly when it was wanted most")
+NUM_POLICY_TICK_ERRORS = register_metric(
+    "numPolicyTickErrors", COUNTER, ESSENTIAL,
+    "proactive-unspill policy ticks that raised and were swallowed "
+    "(policy/engine.py) — the engine stays up but prefetch silently "
+    "stops helping while this moves")
 
 # retry-block counters: each `run_retryable(ctx, metrics, <block>)` call
 # site emits `<block>Retries` / `<block>Splits` (mem/retry.py with_retry)
@@ -600,6 +649,15 @@ TELEMETRY_GAUGES = {
                            "TpuCluster's executor pools (plugin.py)",
     "cluster_spill_bytes": "host + disk spill bytes summed over an "
                            "in-process TpuCluster's executor pools",
+    "policy_tracked_buffers": "device-resident shuffle buffers the "
+                              "data-movement policy engine is tracking "
+                              "next-use state for",
+    "policy_prefetch_pending": "proactively unspilled buffers not yet "
+                               "read back (each resolves into a "
+                               "prefetch hit or a wasted prefetch)",
+    "policy_flow_window_bytes": "current reduce-rate-driven flow-"
+                                "control admission window (floor: "
+                                "policy.flow.minWindowBytes)",
 }
 
 # --- runtime pool gauges (mem/runtime.py pool_stats()) ----------------------

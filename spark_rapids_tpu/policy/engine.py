@@ -1,0 +1,515 @@
+"""MovementPolicy: per-runtime next-use victim scoring + proactive unspill.
+
+The engine rides the BufferCatalog like integrity/compression/ledger do
+(`catalog.policy`, installed by TpuRuntime), so the stores' spill path
+can consult it without plumbing.  Its knowledge base is cheap runtime
+state the shuffle layer already produces:
+
+  * `note_shuffle_buffer` — every device-resident shuffle partition
+    write names its (shuffle, reduce partition) block;
+  * `begin_shuffle_read` — the exchange read phase declares the reduce
+    partition order it is about to consume (the AQE-planned specs);
+  * `partition_consumed` — each partition handed to the consumer
+    advances the read cursor and marks the partition's buffers DEAD;
+  * the memory ledger's spill counts — the re-touch history.
+
+Early release: a single-consumer local exchange read also declares how
+many times the planned specs will consume each reduce partition (skew
+slices and coalesced specs may read one partition more than once).
+When the FINAL planned consumption of a partition lands, its map-side
+buffers have next-use = never — the engine frees them outright
+(`runtime.free_batch`), returning the bytes to the pool with no spill
+write.  This is the decision that kills churn at the source: the
+baseline keeps consumed partitions resident until the whole shuffle is
+released, so under pressure it re-spills bytes that will never be read
+again — and every such eviction of a previously-spilled partition
+counts a re-spill.  Never applied with a cluster attached (a peer or a
+speculative re-read may still fetch the block).
+
+Victim scoring (`scores_for`, consumed by BufferStore._pick_victim):
+lower score spills first.  Dead shuffle buffers score 0 (their bytes
+will never be read again), unknown buffers score a neutral 1.0 (so with
+no shuffle knowledge the ordering degrades to the exact deterministic
+baseline, (spill_priority, id)), and buffers ahead of the read cursor
+score 1 + 1/(1+distance): an imminent read approaches 2.0 (maximally
+protected), a far-future one decays toward the neutral 1.0 — lookahead
+knowledge must never protect a cold shuffle partition over the ACTIVE
+working set it would displace.
+Buffers the ledger has seen spill before gain a protection bonus
+(retouchWeight per prior spill, capped), which is what kills churn: a
+buffer that already paid a spill+unspill round trip becomes the LAST
+candidate to evict again.
+
+Proactive unspill: a lazy-started daemon thread (one per runtime,
+holding only a weakref — a collected runtime ends it) wakes every
+unspill.intervalMs, and while device headroom stays above
+headroomFraction of the pool AND the pool has been spill-quiescent
+since the previous tick (no OOM-spill counter movement — a contended
+pool means the prefetch would race the query for the very bytes it
+frees), re-materializes the one spilled buffer with the nearest next
+use.  The unspill runs inside the owning query's
+ledger scope with the serving-tier budget, so its reservation is
+charged to (and budget-bounded by) the owner — it can never cause
+another query's OOM; any RetryOOM is caught and the prefetch simply
+skipped.  A prefetched buffer later read from device counts a hit
+(numPrefetchHits); one evicted or released untouched counts wasted
+(numPrefetchWasted).
+
+Every decision journals under kind `policy` (victim/unspill/
+backpressure/codec) — the stream `python -m spark_rapids_tpu.metrics
+--memory` replays.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..metrics import names as MN
+from ..metrics.journal import journal_event
+from ..metrics.registry import count_swallowed
+
+_RETOUCH_CAP = 4  # protection saturates: 4 round trips = maximally sticky
+
+
+class MovementPolicy:
+    """Per-runtime data-movement decision engine (see module doc)."""
+
+    def __init__(self, conf, runtime=None):
+        from .. import config as C
+        self.conf = conf
+        self.enabled = bool(conf.get(C.POLICY_ENABLED))
+        self.early_release = bool(conf.get(C.POLICY_EARLY_RELEASE))
+        self.retouch_weight = float(conf.get(C.POLICY_RETOUCH_WEIGHT))
+        self.unspill_interval_s = \
+            max(0, int(conf.get(C.POLICY_UNSPILL_INTERVAL))) / 1000.0
+        self.unspill_headroom = float(conf.get(C.POLICY_UNSPILL_HEADROOM))
+        self._serve_budget = int(conf.get(C.SERVE_QUERY_BUDGET))
+        self._flow_min_window = int(conf.get(C.POLICY_FLOW_MIN_WINDOW))
+        self._flow_horizon_s = \
+            max(0, int(conf.get(C.POLICY_FLOW_HORIZON))) / 1000.0
+        self._flow_max_stall_s = \
+            max(0, int(conf.get(C.POLICY_FLOW_MAX_STALL))) / 1000.0
+        self._rt = (weakref.ref(runtime) if runtime is not None
+                    else (lambda: None))
+        self.metrics = getattr(runtime, "metrics", None)
+        from .codec import CodecAdvisor
+        self.codec = CodecAdvisor(conf, metrics=self.metrics)
+        self._lock = threading.Lock()
+        # bid -> (shuffle_id, reduce_id) for device-resident shuffle writes
+        self._buffer_block: Dict[int, Tuple[int, int]] = {}
+        self._by_shuffle: Dict[int, Set[int]] = {}
+        # shuffle_id -> {reduce_id: position} of the declared read order
+        self._read_order: Dict[int, Dict[int, int]] = {}
+        self._read_cursor: Dict[int, int] = {}
+        self._consumed: Dict[int, Set[int]] = {}
+        # sid -> {rid: planned consumptions left} — present only for
+        # exclusive (single-consumer local) reads; drives early release
+        self._remaining: Dict[int, Dict[int, int]] = {}
+        # bid -> touched-since-proactive-unspill (False = pending hit)
+        self._prefetched: Dict[int, bool] = {}
+        self._buffer_bytes: Dict[int, int] = {}
+        self._flow = None
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._closed = False
+        # spill-activity signature at the last tick (quiescence gate)
+        self._spill_sig = None
+
+    # ---- shuffle-lifecycle feeds (shuffle/manager.py + exec/exchange.py) ----
+
+    def note_shuffle_buffer(self, buffer_id: int, shuffle_id: int,
+                            reduce_id: int, nbytes: int = 0) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._buffer_block[buffer_id] = (shuffle_id, reduce_id)
+            self._by_shuffle.setdefault(shuffle_id, set()).add(buffer_id)
+            if nbytes:
+                self._buffer_bytes[buffer_id] = int(nbytes)
+
+    def begin_shuffle_read(self, shuffle_id: int, order: List[int],
+                           counts: Optional[Dict[int, int]] = None,
+                           exclusive: bool = False) -> None:
+        """The exchange read phase declares the reduce-partition order
+        it will consume — the plan-lookahead half of the score.  With
+        `exclusive` (single local consumer, no cluster), `counts` gives
+        how many times the planned specs consume each partition; the
+        final consumption triggers early release (module doc)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._read_order[shuffle_id] = \
+                {rid: i for i, rid in enumerate(order)}
+            self._read_cursor[shuffle_id] = 0
+            self._consumed.setdefault(shuffle_id, set())
+            if exclusive and counts and self.early_release:
+                self._remaining[shuffle_id] = \
+                    {int(r): int(c) for r, c in counts.items()}
+        self._maybe_start()
+        self._wake.set()
+
+    def partition_consumed(self, shuffle_id: int, reduce_id: int) -> None:
+        if not self.enabled:
+            return
+        to_free: List[Tuple[int, int]] = []
+        with self._lock:
+            self._consumed.setdefault(shuffle_id, set()).add(reduce_id)
+            order = self._read_order.get(shuffle_id)
+            if order is not None:
+                pos = order.get(reduce_id)
+                if pos is not None and \
+                        pos >= self._read_cursor.get(shuffle_id, 0):
+                    self._read_cursor[shuffle_id] = pos + 1
+            rem = self._remaining.get(shuffle_id)
+            if rem is not None and reduce_id in rem:
+                rem[reduce_id] -= 1
+                if rem[reduce_id] <= 0:
+                    del rem[reduce_id]
+                    live = self._by_shuffle.get(shuffle_id, set())
+                    for bid in [b for b in live
+                                if self._buffer_block.get(b)
+                                == (shuffle_id, reduce_id)]:
+                        to_free.append(
+                            (bid, self._buffer_bytes.get(bid, 0)))
+                        live.discard(bid)
+                        self._buffer_block.pop(bid, None)
+                        self._buffer_bytes.pop(bid, None)
+                        self._prefetched.pop(bid, None)
+        if not to_free:
+            return
+        # frees run OUTSIDE the policy lock (free_batch takes catalog +
+        # store locks; policy._lock stays a strict leaf).  free_batch is
+        # double-free tolerant, so the shuffle's own remove_shuffle
+        # cleanup later is a no-op for these ids.
+        rt = self._rt()
+        freed = 0
+        for bid, nbytes in to_free:
+            if rt is not None:
+                try:
+                    rt.free_batch(bid)
+                    freed += 1
+                except Exception as e:  # noqa: BLE001 — a failed free
+                    # must not kill the read; remove_shuffle retries it
+                    count_swallowed("numPolicyTickErrors", __name__,
+                                    "early release of %d failed (%r)",
+                                    bid, e)
+            journal_event("policy", "release", buffer=bid,
+                          bytes=int(nbytes), shuffle=shuffle_id,
+                          partition=reduce_id)
+        if freed and self.metrics is not None:
+            self.metrics.add(MN.NUM_POLICY_EARLY_RELEASES, freed)
+
+    def shuffle_released(self, shuffle_id: int) -> None:
+        if not self.enabled:
+            return
+        wasted = 0
+        with self._lock:
+            for bid in self._by_shuffle.pop(shuffle_id, ()):
+                self._buffer_block.pop(bid, None)
+                self._buffer_bytes.pop(bid, None)
+                if self._prefetched.pop(bid, None) is False:
+                    wasted += 1
+            self._read_order.pop(shuffle_id, None)
+            self._read_cursor.pop(shuffle_id, None)
+            self._consumed.pop(shuffle_id, None)
+            self._remaining.pop(shuffle_id, None)
+        if wasted and self.metrics is not None:
+            self.metrics.add(MN.NUM_PREFETCH_WASTED, wasted)
+        self.codec.shuffle_released(shuffle_id)
+
+    def note_access(self, buffer_id: int) -> None:
+        """A buffer read through the runtime: a pending prefetch that
+        gets read before eviction is a hit."""
+        if not self.enabled or not self._prefetched:
+            return
+        hit = False
+        with self._lock:
+            if self._prefetched.get(buffer_id) is False:
+                self._prefetched[buffer_id] = True
+                hit = True
+        if hit and self.metrics is not None:
+            self.metrics.add(MN.NUM_PREFETCH_HITS, 1)
+
+    # ---- victim scoring (mem/stores.py _pick_victim) ------------------------
+
+    def wants_victim_scoring(self) -> bool:
+        return self.enabled
+
+    def scores_for(self, buffer_ids) -> Dict[int, float]:
+        """Next-use scores, lower spills first (see module doc).  Called
+        under the store lock: this takes only the ledger lock then the
+        policy lock — both leaves of the store's lock order."""
+        rt = self._rt()
+        counts: Dict[int, int] = {}
+        ledger = getattr(rt, "ledger", None) if rt is not None else None
+        if ledger is not None:
+            counts = ledger.spill_counts_for(buffer_ids)
+        out: Dict[int, float] = {}
+        with self._lock:
+            for bid in buffer_ids:
+                score = 1.0
+                info = self._buffer_block.get(bid)
+                if info is not None:
+                    sid, rid = info
+                    order = self._read_order.get(sid)
+                    if rid in self._consumed.get(sid, ()):
+                        score = 0.0  # dead: never read again, evict first
+                    elif order is not None and rid in order:
+                        d = max(0, order[rid]
+                                - self._read_cursor.get(sid, 0))
+                        score = 1.0 + 1.0 / (1.0 + d)
+                if score > 0.0:
+                    score += min(counts.get(bid, 0), _RETOUCH_CAP) \
+                        * self.retouch_weight
+                out[bid] = score
+        return out
+
+    def record_victim(self, tier, decision: dict) -> None:
+        """Journal + count one victim decision (flushed by
+        synchronous_spill OUTSIDE the store lock)."""
+        bid = decision.get("buffer")
+        wasted = False
+        with self._lock:
+            if self._prefetched.pop(bid, None) is False:
+                wasted = True  # prefetched, evicted before any read
+        if self.metrics is not None:
+            self.metrics.add(MN.NUM_POLICY_VICTIM_PICKS, 1)
+            if decision.get("overridden"):
+                self.metrics.add(MN.NUM_POLICY_VICTIM_OVERRIDES, 1)
+            if wasted:
+                self.metrics.add(MN.NUM_PREFETCH_WASTED, 1)
+        journal_event("policy", "victim", tier=tier.name, **decision)
+
+    # ---- proactive unspill --------------------------------------------------
+
+    def _maybe_start(self) -> None:
+        if not self.enabled or self.unspill_interval_s <= 0 \
+                or self._closed:
+            return
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            # the loop body installs the owner's ledger query_scope
+            # around every unspill (the thread-context discipline TPU009
+            # audits for)
+            t = threading.Thread(target=self._run,
+                                 name="movement-policy", daemon=True)
+            self._thread = t
+        t.start()
+
+    def _run(self) -> None:
+        while not self._closed:
+            self._wake.wait(timeout=self.unspill_interval_s)
+            self._wake.clear()
+            if self._closed:
+                return
+            rt = self._rt()
+            if rt is None:
+                return  # runtime collected: this engine is dead too
+            try:
+                self.tick(rt)
+            except Exception as e:  # noqa: BLE001 — a policy tick must
+                # never take the engine down; the miss is counted
+                count_swallowed("numPolicyTickErrors", __name__,
+                                "proactive-unspill tick failed (%r)", e)
+            del rt
+
+    def tick(self, runtime=None) -> int:
+        """One proactive-unspill pass (synchronous — tests drive this
+        directly; the policy thread calls it on its interval).  Returns
+        the number of buffers unspilled (at most one: the prefetch must
+        trickle into headroom, never burst into it)."""
+        rt = runtime if runtime is not None else self._rt()
+        if rt is None or not self.enabled:
+            return 0
+        if not self._pool_quiescent(rt):
+            return 0
+        # an actively-streaming reduce pipeline owns prefetch: the async
+        # fetch path is already materializing upcoming partitions, and a
+        # concurrent thread unspill would race it for the same pool
+        # bytes (measured as prefetch-then-respill churn).  The rate
+        # span decays ~1s after the last consumption, re-arming the
+        # thread for idle pools.
+        flow = self._flow
+        if flow is not None and flow.rate_bytes_per_s() > 0:
+            return 0
+        cand = self._next_unspill_candidate(rt)
+        if cand is None or not self._unspill_one(rt, *cand):
+            return 0
+        return 1
+
+    def _pool_quiescent(self, rt) -> bool:
+        """True when no spill-pressure counter moved since the last
+        tick.  A contended pool means any prefetch would race the query
+        for the bytes it is actively evicting — the measured condition
+        that turns proactive unspill into churn."""
+        try:
+            vals = rt.metrics.values
+            sig = (vals.get(MN.OOM_SPILL_RETRIES, 0),
+                   vals.get(MN.OOM_SPILL_BYTES, 0),
+                   vals.get(MN.SPILL_TIME, 0.0))
+        except Exception:  # noqa: BLE001 — no metrics: assume quiet
+            return True
+        quiet = self._spill_sig is None or sig == self._spill_sig
+        self._spill_sig = sig  # tpulint: disable=TPU009 single-owner: only the policy thread (or a test driving tick() with the thread disabled) ever reads or writes the signature
+        return quiet
+
+    def _next_unspill_candidate(self, rt):
+        """(buffer_id, size) of the spilled buffer with the nearest next
+        use that fits in present headroom, or None.  Headroom is
+        conservative: after the unspill, at least headroomFraction of
+        the pool must remain free — the prefetch is opportunistic and
+        must never push the pool toward an eviction."""
+        headroom = rt.pool_limit - rt.device_store.current_size
+        floor = int(rt.pool_limit * self.unspill_headroom)
+        best = None
+        with self._lock:
+            items = list(self._buffer_block.items())
+            cursors = dict(self._read_cursor)
+            orders = self._read_order
+            consumed = self._consumed
+            for bid, (sid, rid) in items:
+                order = orders.get(sid)
+                if order is None or rid not in order:
+                    continue
+                if rid in consumed.get(sid, ()):
+                    continue
+                pos = order[rid]
+                cur = cursors.get(sid, 0)
+                if pos < cur:
+                    continue
+                nbytes = self._buffer_bytes.get(bid, 0)
+                if nbytes <= 0 or headroom - nbytes < floor:
+                    continue
+                key = (pos - cur, bid)
+                if best is None or key < best[0]:
+                    best = (key, bid, nbytes)
+        if best is None:
+            return None
+        _, bid, nbytes = best
+        # only spilled buffers are worth a pass; a device-resident one
+        # is already where it needs to be
+        try:
+            from ..mem.buffer import StorageTier
+            if rt.catalog.lookup_tier(bid) == StorageTier.DEVICE:
+                return None if len(self._buffer_block) <= 1 \
+                    else self._next_other_candidate(rt, skip=bid)
+        except KeyError:
+            return None
+        return bid, nbytes
+
+    def _next_other_candidate(self, rt, skip: int):
+        """Fallback scan when the nearest-next-use buffer is already on
+        device: the first spilled, still-unconsumed, in-order buffer."""
+        from ..mem.buffer import StorageTier
+        headroom = rt.pool_limit - rt.device_store.current_size
+        floor = int(rt.pool_limit * self.unspill_headroom)
+        with self._lock:
+            cands = []
+            for bid, (sid, rid) in self._buffer_block.items():
+                if bid == skip:
+                    continue
+                order = self._read_order.get(sid)
+                if order is None or rid not in order \
+                        or rid in self._consumed.get(sid, ()):
+                    continue
+                cur = self._read_cursor.get(sid, 0)
+                if order[rid] < cur:
+                    continue
+                nbytes = self._buffer_bytes.get(bid, 0)
+                if nbytes <= 0 or headroom - nbytes < floor:
+                    continue
+                cands.append((order[rid] - cur, bid, nbytes))
+        for _, bid, nbytes in sorted(cands):
+            try:
+                if rt.catalog.lookup_tier(bid) != StorageTier.DEVICE:
+                    return bid, nbytes
+            except KeyError:  # tpulint: disable=TPU006 buffer freed between snapshot and lookup (early release / shuffle teardown race is benign: the candidate is simply gone)
+                continue
+        return None
+
+    def _unspill_one(self, rt, bid: int, nbytes: int) -> bool:
+        """Re-materialize one spilled buffer inside its owner's ledger
+        scope (reservation charged to, and budget-bounded by, the
+        owner); an OOM or a vanished buffer skips quietly."""
+        owner = None
+        try:
+            buf = rt.catalog.acquire(bid)
+        except KeyError:
+            return False
+        try:
+            owner = buf.owner
+            if owner is not None:
+                with rt.ledger.query_scope(owner, self._serve_budget):
+                    rt._materialize(buf)
+            else:
+                rt._materialize(buf)
+        except MemoryError:
+            return False
+        finally:
+            rt.catalog.release(buf)
+        with self._lock:
+            if bid in self._buffer_block and bid not in self._prefetched:
+                self._prefetched[bid] = False
+        if self.metrics is not None:
+            self.metrics.add(MN.NUM_PROACTIVE_UNSPILLS, 1)
+        journal_event("policy", "unspill", buffer=bid, bytes=int(nbytes),
+                      owner=owner)
+        return True
+
+    # ---- flow control / codec handles ---------------------------------------
+
+    def flow_controller(self):
+        """The runtime's shared FlowController (lazy; None when the
+        engine is disabled)."""
+        if not self.enabled:
+            return None
+        if self._flow is None:
+            from .flow import FlowController
+            rt_ref = self._rt
+
+            def headroom() -> int:
+                rt = rt_ref()
+                if rt is None:
+                    return 1 << 62  # runtime collected: no clamp
+                return rt.pool_limit - rt.device_store.current_size
+            with self._lock:
+                if self._flow is None:
+                    self._flow = FlowController(
+                        self._flow_min_window, self._flow_horizon_s,
+                        self._flow_max_stall_s, metrics=self.metrics,
+                        headroom=headroom)
+        return self._flow
+
+    def wire_codec(self, shuffle_id: int):
+        if not self.enabled:
+            return None
+        return self.codec.wire_codec(shuffle_id)
+
+    def observe_exchange(self, shuffle_id: int, wire_bytes: int,
+                         seconds: float) -> None:
+        if self.enabled:
+            self.codec.observe_exchange(shuffle_id, wire_bytes, seconds)
+
+    # ---- observability ------------------------------------------------------
+
+    def gauges(self) -> Dict[str, float]:
+        """Sampler-source snapshot (GaugeSampler 'policy' series)."""
+        with self._lock:
+            pending = sum(1 for v in self._prefetched.values()
+                          if v is False)
+            tracked = len(self._buffer_block)
+        flow = self._flow
+        return {
+            "policy_tracked_buffers": float(tracked),
+            "policy_prefetch_pending": float(pending),
+            "policy_flow_window_bytes":
+                float(flow.window_bytes()) if flow is not None else 0.0,
+        }
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake.set()

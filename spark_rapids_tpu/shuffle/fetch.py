@@ -32,7 +32,7 @@ class AsyncFetchIterator:
     def __init__(self, env, shuffle_id: int, reduce_ids: Sequence[int],
                  remote_peers: Optional[List[str]] = None,
                  max_inflight_bytes: int = 1 << 30, route=None,
-                 oom_retries: int = 2):
+                 oom_retries: int = 2, flow=None):
         self._env = env
         self._sid = shuffle_id
         self._rids = list(reduce_ids)
@@ -41,6 +41,10 @@ class AsyncFetchIterator:
         # executor per partition (exchange._execute_partitions_cluster)
         self._route = route
         self._max = max(int(max_inflight_bytes), 1)
+        # reduce-driven flow control (policy/flow.py FlowController):
+        # consumption feeds its rate, admission caps at its window —
+        # None (policy off) keeps the static _max cap exactly as before
+        self._flow = flow
         # OOM retries per partition fetch; catalog reads are idempotent,
         # so a refetch is safe as long as NOTHING of that partition was
         # handed to the consumer yet (_produce enforces that)
@@ -55,18 +59,37 @@ class AsyncFetchIterator:
 
     # ---- producer ----------------------------------------------------------
 
+    def _cap(self) -> int:
+        """Admission cap: the static max, tightened to the flow fetch
+        window when a controller rides this iterator.  The fetch window
+        floors at minWindowBytes from the rate side but may clamp BELOW
+        it on device headroom (pool-aware admission) — readahead then
+        collapses toward serial fetch; the oversized-batch-alone rule in
+        _admit still guarantees progress, so the producer is never
+        halted."""
+        if self._flow is None:
+            return self._max
+        return min(self._max, max(self._flow.fetch_window_bytes(), 1))
+
     def _admit(self, nbytes: int) -> bool:
         """Block until `nbytes` fits under the inflight cap (or the queue is
         empty — a single oversized batch must still make progress).
         Returns False when the consumer shut down."""
+        stalled = False
         with self._cv:
+            # the cap re-evaluates per wait round: consumption events
+            # widen the flow window while we sleep
             while not self._stop and self._inflight > 0 \
-                    and self._inflight + nbytes > self._max:
-                self._cv.wait(timeout=0.5)
+                    and self._inflight + nbytes > self._cap():
+                stalled = True
+                self._cv.wait(timeout=0.05 if self._flow is not None
+                              else 0.5)
             if self._stop:
                 return False
             self._inflight += nbytes
-            return True
+        if stalled and self._flow is not None:
+            self._flow.note_stall("fetch")  # counted once per admission
+        return True
 
     def _produce(self) -> None:
         try:
@@ -129,6 +152,10 @@ class AsyncFetchIterator:
                 with self._cv:
                     self._inflight -= nb
                     self._cv.notify_all()
+                if self._flow is not None:
+                    # the reduce-side consumption signal the admission
+                    # window is derived from
+                    self._flow.on_consumed(nb)
                 yield rid, batch
         finally:
             self.close()

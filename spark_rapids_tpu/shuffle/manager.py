@@ -174,11 +174,26 @@ class ShuffleServer:
             # recompute.  Copy-swap: host leaves are read-only views.
             leaves = list(leaves)
             leaves[0] = faults.flip_bit(leaves[0])
+        flow = self._flow()
+        if flow is not None:
+            # map-side serve window (policy/flow.py): bounded stall when
+            # in-flight served bytes exceed the reduce-rate-driven
+            # window — soft backpressure on the stager, never a deadlock
+            flow.serve_acquire(buffer_id,
+                               sum(int(a.nbytes) for a in leaves))
+        evicted = None
         with self._lock:
             if len(self._cache) >= 4:  # bounded serving cache
-                self._cache.pop(next(iter(self._cache)))
+                evicted = next(iter(self._cache))
+                self._cache.pop(evicted)
             self._cache[buffer_id] = (leaves, meta)
+        if evicted is not None and flow is not None:
+            flow.serve_release(evicted)
         return leaves, meta
+
+    def _flow(self):
+        pol = getattr(self.env.runtime, "policy", None)
+        return pol.flow_controller() if pol is not None else None
 
     def buffer_layout(self, buffer_id: int):
         leaves, meta = self._leaves(buffer_id)
@@ -262,6 +277,14 @@ class ShuffleServer:
         with self._lock:
             self._cache.pop(buffer_id, None)
         self._comp_cache.drop(buffer_id)
+        flow = self._flow()
+        if flow is not None:
+            # the reader's release is reduce-side consumption evidence
+            # crossing the wire: it both frees the serve window and
+            # feeds the consumption rate the window is derived from
+            nb = flow.serve_release(buffer_id)
+            if nb:
+                flow.on_consumed(nb)
 
     def invalidate(self, buffer_ids) -> None:
         """Drop serving-cache entries for removed buffers: a fetch racing
@@ -272,6 +295,10 @@ class ShuffleServer:
             for bid in buffer_ids:
                 self._cache.pop(bid, None)
         self._comp_cache.invalidate(buffer_ids)
+        flow = self._flow()
+        if flow is not None:
+            for bid in buffer_ids:
+                flow.serve_release(bid)
 
 
 class ShuffleEnv:
@@ -412,6 +439,10 @@ class ShuffleEnv:
             self.runtime.free_batch(bid)
         for bid in self.received.remove_shuffle(shuffle_id):
             self.runtime.free_batch(bid)
+        pol = getattr(self.runtime, "policy", None)
+        if pol is not None:
+            # drops next-use state AND settles wasted-prefetch accounting
+            pol.shuffle_released(shuffle_id)
 
     # ---- write path (RapidsCachingWriter.write) ----------------------------
 
@@ -437,6 +468,12 @@ class ShuffleEnv:
                     + float(seq))
             bid = self.runtime.add_batch(batch, prio)
             self.catalog.add_buffer(block, bid)
+            pol = getattr(self.runtime, "policy", None)
+            if pol is not None:
+                # feeds victim scoring + proactive unspill: the buffer is
+                # now known to be (shuffle, reduce) — dead once consumed,
+                # prefetchable once an exchange declares its read order
+                pol.note_shuffle_buffer(bid, shuffle_id, reduce_id, nbytes)
         else:
             from ..mem.buffer import fresh_buffer_id
             leaves, meta = batch_to_host(batch)
@@ -521,10 +558,12 @@ class ShuffleEnv:
         (shuffle/fetch.py; reference RapidsShuffleIterator.scala:17-258)."""
         from ..config import OOM_RETRY_MAX
         from .fetch import AsyncFetchIterator
+        pol = getattr(self.runtime, "policy", None)
         return AsyncFetchIterator(
             self, shuffle_id, reduce_ids, remote_peers,
             int(self.conf.get(SHUFFLE_MAX_RECV_INFLIGHT)),
-            oom_retries=int(self.conf.get(OOM_RETRY_MAX)))
+            oom_retries=int(self.conf.get(OOM_RETRY_MAX)),
+            flow=pol.flow_controller() if pol is not None else None)
 
     def _fetch_remote(self, peer: str, shuffle_id: int, reduce_id: int,
                       map_range: Optional[tuple] = None
@@ -571,6 +610,18 @@ class ShuffleEnv:
             try:
                 tcomp = getattr(self.transport, "compression", None)
                 client = self.transport.make_client(peer)
+                if tcomp is None or not tcomp.enabled:
+                    # roofline-driven re-selection (policy/codec.py): a
+                    # session WITHOUT configured wire compression rides
+                    # the advised codec through the same negotiation;
+                    # clients are per-fetch objects, so the override
+                    # never leaks past this read
+                    pol = getattr(self.runtime, "policy", None)
+                    if pol is not None \
+                            and pol.wire_codec(shuffle_id) is not None:
+                        client.compression_override = \
+                            pol.codec.reader_policy()
+                        tcomp = client.compression_override
                 resp = on_wire(lambda: client.fetch_metadata(
                     MetadataRequest(
                         shuffle_id=shuffle_id, reduce_id=reduce_id,

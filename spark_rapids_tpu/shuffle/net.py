@@ -767,10 +767,10 @@ class SocketClient(ShuffleTransportClient):
                 # never reaches the decompressor
                 out = decode_compressed_leaves(
                     flats, layout, resolve_codec(comp["codec"]),
-                    comp_sums, sums, policy, self.transport.compression,
+                    comp_sums, sums, policy, self._wire_compression(),
                     buffer_id, "shm")
                 self.transport.count("compressed_bytes_received", off)
-                cmetrics = self.transport.compression.metrics
+                cmetrics = self._wire_compression().metrics
                 if cmetrics is not None:
                     from ..metrics import names as MN
                     cmetrics.add(MN.COMPRESSED_SHUFFLE_BYTES_READ, off)
@@ -801,7 +801,7 @@ class SocketClient(ShuffleTransportClient):
         txn = self.transport.next_txn()
         deadline = (time.monotonic() + self.transport.txn_timeout
                     if self.transport.txn_timeout > 0 else None)
-        cpol = getattr(self.transport, "compression", None)
+        cpol = self._wire_compression()
         req_codec = (cpol.codec_name
                      if cpol is not None and cpol.enabled else None)
         # trace context of the requesting task: rides the layout + fetch

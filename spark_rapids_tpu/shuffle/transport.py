@@ -533,6 +533,17 @@ class AsyncFramedReader:
 class ShuffleTransportClient:
     """Fetch path to one peer (RapidsShuffleClient equivalent)."""
 
+    # per-client wire-compression override (policy/codec.py): clients
+    # are per-fetch objects, so the policy engine attaches its advised
+    # reader CompressionPolicy here without touching the transport's
+    # session-configured one; None = use the transport's.
+    compression_override = None
+
+    def _wire_compression(self):
+        if self.compression_override is not None:
+            return self.compression_override
+        return getattr(self.transport, "compression", None)
+
     def fetch_metadata(self, request: MetadataRequest) -> MetadataResponse:
         raise NotImplementedError
 
@@ -712,7 +723,7 @@ class LoopbackClient(ShuffleTransportClient):
         (writer rot) — the same ladder the socket stream runs."""
         from ..compress import resolve_codec
         policy = self.transport.integrity
-        cpol = self.transport.compression
+        cpol = self._wire_compression()
         codec = resolve_codec(comp["codec"])
         sizes = comp["sizes"]
         comp_sums = None
@@ -783,7 +794,7 @@ class LoopbackClient(ShuffleTransportClient):
         # configured codec; a peer without compression support (or the
         # codec library) answers None and we fall back to the raw wire
         # format, counted — never an error (typed graceful degradation)
-        cpol = getattr(self.transport, "compression", None)
+        cpol = self._wire_compression()
         if cpol is not None and cpol.enabled:
             get_comp = getattr(self.server, "compressed_layout", None)
             comp = None

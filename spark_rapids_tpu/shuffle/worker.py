@@ -147,6 +147,7 @@ class WorkerHandler:
             self.telemetry.sampler.add_source(
                 "transport", lambda: dict(self.transport.counters))
             self.telemetry.sampler.add_source("tasks", self._task_gauges)
+            self.telemetry.sampler.add_source("policy", self._policy_gauges)
             self.telemetry.sampler.start()
             if bool(self.session.conf.get(TELEMETRY_HTTP_ENABLED)):
                 from ..metrics.http import serve_telemetry
@@ -161,6 +162,10 @@ class WorkerHandler:
         out["spill_bytes"] = float(stats.get("host_used", 0)
                                    + stats.get("disk_used", 0))
         return out
+
+    def _policy_gauges(self) -> Dict[str, float]:
+        pol = getattr(self.runtime, "policy", None)
+        return pol.gauges() if pol is not None else {}
 
     def _task_gauges(self) -> Dict[str, float]:
         with self._hb_lock:

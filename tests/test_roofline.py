@@ -551,6 +551,11 @@ def test_spill_phase_attributed_to_the_spilling_query_only():
         "spark.rapids.sql.reader.batchSizeRows": "16384",
         "spark.rapids.sql.tpu.memoryScanCache.enabled": "false",
         "spark.rapids.sql.tpu.serve.maxConcurrentQueries": "1",
+        # keep the pressure scenario: the policy's early release frees
+        # consumed shuffle partitions and this workload then fits the
+        # 2MB pool without a single spill — which is the behavior under
+        # test HERE, not the attribution
+        "spark.rapids.sql.tpu.policy.earlyRelease.enabled": "false",
     })
     heavy_df = s.from_pydict({"v": [float(i % 977) for i in range(n)]})
     light_df = s.from_pydict({"x": [1.0, 2.0, 3.0]})
