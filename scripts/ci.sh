@@ -160,6 +160,24 @@ T_SRV=$SECONDS
 python -m pytest tests/test_serve.py -q -m "not slow" -p no:cacheprovider
 echo "== serve tier took $((SECONDS - T_SRV))s =="
 
+echo "== lifecycle tier =="
+# query lifecycle robustness (ISSUE 19): cooperative cancellation
+# (queued dequeues free, running stops at the next checkpoint with
+# owner-confined cleanup — zero residual owner bytes across all tiers),
+# per-query deadlines (typed QueryDeadlineExceeded into the query's own
+# failure path, queue-side shedding), SLO-aware preemption (suspended
+# victim resumes bit-for-bit across plan shapes), typed QueryTimeout on
+# result()/exception() waits, token-routed scheduler shutdown, and the
+# kill-switch no-op guarantee.  The fast half runs here; -m "lifecycle
+# and slow" adds the >=20-round mixed-priority serving chaos soak
+# (random cancels/deadlines/preemptions + injectOom, survivors
+# bit-for-bit, zero leaked owner bytes — CHAOS_ROUNDS/CHAOS_SEED
+# tunable).
+T_LC=$SECONDS
+python -m pytest tests/test_lifecycle.py -q -m "not slow" \
+    -p no:cacheprovider
+echo "== lifecycle tier took $((SECONDS - T_LC))s =="
+
 echo "== roofline tier =="
 # roofline-attribution profiler (ISSUE 13): cost-declaration coverage
 # (every plan node of the q1/q6 shapes names a bottleneck resource),

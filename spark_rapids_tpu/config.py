@@ -1052,6 +1052,45 @@ SERVE_PLAN_CACHE_ENABLED = _conf(
 SERVE_PLAN_CACHE_SIZE = _conf(
     "spark.rapids.sql.tpu.serve.planCache.maxEntries", 128,
     "LRU bound on distinct normalized plans the plan cache tracks.", int)
+SERVE_LIFECYCLE_ENABLED = _conf(
+    "spark.rapids.sql.tpu.serve.lifecycle.enabled", True,
+    "Query lifecycle layer for scheduler-submitted queries "
+    "(serve/lifecycle.py): cooperative cancellation "
+    "(QueryFuture.cancel()), per-query deadlines (submit deadline_ms=, "
+    "with admission-time shedding) and SLO-aware preemption all ride a "
+    "per-query token checked at reserve()/retry/stage/exchange "
+    "boundaries.  Kill switch: false installs no token at all, making "
+    "every checkpoint a no-op byte-identical to the pre-lifecycle "
+    "paths — cancel() then returns False and deadlines are ignored.",
+    _to_bool)
+SERVE_PREEMPTION_ENABLED = _conf(
+    "spark.rapids.sql.tpu.serve.preemption.enabled", False,
+    "SLO-aware preemption: when a higher-priority query arrives while a "
+    "lower-priority one holds the admission share/device gate, the "
+    "scheduler asks the victim to suspend at its next stage boundary — "
+    "its device buffers park as spillable state charged to its own "
+    "budget, its semaphore slots and admission share release — and "
+    "resume FIFO-within-priority once no higher-priority work remains, "
+    "bit-for-bit with the unpreempted run (numPreemptions, "
+    "numPreemptionResumes, SLO phase 'preempt').  Off by default: "
+    "preemption trades victim latency for latency-class p99, a policy "
+    "choice the operator should opt into (docs/tuning-guide.md, Query "
+    "lifecycle).  Requires serve.lifecycle.enabled.", _to_bool)
+SERVE_PREEMPTION_RESUME_TIMEOUT = _conf(
+    "spark.rapids.sql.tpu.serve.preemption.resumeTimeoutSeconds", 600.0,
+    "Hard bound on how long a preempted query stays suspended waiting "
+    "for the scheduler's resume grant; past it the victim force-resumes "
+    "(re-taking its admission share even over budget) so a scheduler "
+    "fault can never hang a suspended query forever.", float)
+SERVE_DEADLINE_SHED_FACTOR = _conf(
+    "spark.rapids.sql.tpu.serve.deadline.shedSafetyFactor", 1.0,
+    "Admission-time shedding margin: a query is shed (numDeadlineSheds, "
+    "typed QueryDeadlineExceeded on its future) when its remaining "
+    "deadline is under this factor x the scheduler's EWMA of observed "
+    "plan+compile seconds — rejecting a doomed query at admission is "
+    "cheaper than admitting it to time out mid-compile.  0 disables "
+    "estimate-based shedding (already-expired deadlines still shed).",
+    float)
 
 # --- export -----------------------------------------------------------------
 EXPORT_COLUMNAR_RDD = _conf(

@@ -323,20 +323,27 @@ class TpuSession:
         return self._scheduler
 
     def submit(self, df, priority: int = 0,
-               memory_need: Optional[int] = None):
+               memory_need: Optional[int] = None,
+               deadline_ms: Optional[float] = None):
         """Submit a DataFrame (or logical plan) for concurrent execution;
         returns a serve.QueryFuture immediately.  Queries flow through
         the priority queue, fair-share admission control, the
         parameterized plan cache and a per-query memory budget
         (docs/tuning-guide.md, Concurrent serving and plan caching);
-        the blocking collect() paths are unchanged."""
+        the blocking collect() paths are unchanged.  `deadline_ms`
+        bounds the query end to end: past it the query fails with a
+        typed QueryDeadlineExceeded at its next lifecycle checkpoint —
+        or is shed at admission when the remaining deadline cannot cover
+        the estimated plan+compile cost (docs/tuning-guide.md, Query
+        lifecycle)."""
         if self._scheduler is None:
             with self._serve_lock:
                 if self._scheduler is None:
                     from .serve.scheduler import QueryScheduler
                     self._scheduler = QueryScheduler(self)
         return self._scheduler.submit(df, priority=priority,
-                                      memory_need=memory_need)
+                                      memory_need=memory_need,
+                                      deadline_ms=deadline_ms)
 
     def shutdown_serving(self, wait: bool = True) -> None:
         """Stop the scheduler's workers (idempotent).  In-flight queries
@@ -377,11 +384,20 @@ class TpuSession:
         ctx = ExecContext(self.conf, runtime=runtime,
                           cluster=self.cluster, journal=qe.journal,
                           query_execution=qe)
+        # lifecycle token of a scheduler-run query (serve/lifecycle.py):
+        # installed on the ledger query scope so every tier's checkpoint
+        # reaches it thread-locally; None for blocking collect() paths
+        # and when the serve.lifecycle.enabled kill switch is off
+        lifecycle = getattr(future, "lifecycle", None) \
+            if future is not None else None
+        if lifecycle is not None:
+            lifecycle.journal = qe.journal
         error = None
         qscope = None
         try:
             with runtime.ledger.query_scope(f"q{qe.query_id}",
-                                            budget_bytes) as qscope:
+                                            budget_bytes,
+                                            lifecycle=lifecycle) as qscope:
                 if on_device:
                     # device semaphore: this "task" holds a device slot
                     # for the duration of its device work (reference:
@@ -401,6 +417,23 @@ class TpuSession:
             # resources operators registered (e.g. shuffle partitions
             # orphaned by a mid-write error)
             ctx.run_cleanups()
+            if error is not None:
+                # owner-confined cleanup for lifecycle kills: after the
+                # shuffle cleanups above, free whatever buffers still
+                # carry this query's owner stamp across device/host/disk
+                # — a cancelled or past-deadline query must not leak
+                # pool bytes (received shuffle buffers, parked
+                # checkpoints, partial writes the cleanups missed)
+                from .serve.lifecycle import (QueryCancelled,
+                                              QueryDeadlineExceeded)
+                if isinstance(error, (QueryCancelled,
+                                      QueryDeadlineExceeded)):
+                    freed = runtime.release_owner(f"q{qe.query_id}")
+                    if qe.journal is not None:
+                        qe.journal.instant(
+                            "lifecycle", "ownerCleanup",
+                            q=f"q{qe.query_id}", freed_bytes=freed,
+                            reason=type(error).__name__)
             self._finish_execution(qe, error)
             if future is not None:
                 # phase breakdown for the serving SLO histograms

@@ -1016,7 +1016,12 @@ class TpuHashAggregateExec(TpuExec):
         cap = 0
         byte_budget = ctx.conf.get(C.BATCH_SIZE_BYTES) // 2
         total_bytes = 0
+        from ..serve.lifecycle import ctx_checkpoint
         for b in src_iter:
+            # stage-boundary lifecycle checkpoint: the probe drain is
+            # the last per-batch loop before the fused agg becomes ONE
+            # device dispatch, so this is the agg's cancel/suspend point
+            ctx_checkpoint(ctx, allow_suspend=True)
             shapes = [tuple(x.shape) for x in
                       jax.tree_util.tree_flatten(b)[0]]
             total_bytes += b.device_size_bytes()
@@ -1320,7 +1325,13 @@ class TpuHashAggregateExec(TpuExec):
                 hot["offset"] += b.num_rows_host()
             return partial
 
+        from ..serve.lifecycle import ctx_checkpoint
         for batch in input_iter:
+            # stage-boundary lifecycle checkpoint (serve/lifecycle.py):
+            # between per-batch updates no reservation is mid-flight —
+            # partial states are spillable like any owned buffers, so a
+            # preemption suspend here parks and resumes bit-for-bit
+            ctx_checkpoint(ctx, allow_suspend=True)
             # the update kernel sorts at batch CAPACITY: a selective
             # upstream filter leaves mostly-dead batches, so shrink first
             # (capacity check is static: dense small batches skip the

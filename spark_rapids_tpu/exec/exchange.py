@@ -388,7 +388,13 @@ class TpuShuffleExchangeExec(TpuExec):
         else:
             it = h.env.fetch_partitions_async(h.sid, range(n))
         drained = _drain_async(it, n)
+        from ..serve.lifecycle import ctx_checkpoint
         for i, spec in enumerate(specs):
+            # lifecycle checkpoint on the DRAIN side (the fetch threads
+            # have no query scope): cancel/deadline only — suspending
+            # with the async pipeline mid-flight would pin its in-flight
+            # admission window for the whole park
+            ctx_checkpoint(ctx, allow_suspend=False)
             parts = []
             for _ in range(spec.start, spec.end):
                 _p, b = next(drained)
@@ -402,7 +408,12 @@ class TpuShuffleExchangeExec(TpuExec):
         reduce partition or one map-range slice), so a reserve() OOM
         during re-materialization just refetches that unit."""
         from .retryable import run_retryable
+        from ..serve.lifecycle import ctx_checkpoint
         for i, spec in enumerate(specs):
+            # read-boundary lifecycle checkpoint: each spec's fetches are
+            # idempotent units, so cancelling between them loses nothing,
+            # and a preempted reducer can park before the next fetch
+            ctx_checkpoint(ctx, allow_suspend=True)
             parts = []
             for p, map_range in spec.units():
                 def fetch_unit(pp, _mr=map_range):
@@ -551,8 +562,16 @@ class TpuShuffleExchangeExec(TpuExec):
             from ..mem import donation as _donation
             fused_donate = bool(ctx.conf.get(CC.DONATION_ENABLED)) \
                 and fused_stage.donate_inputs
+        from ..serve.lifecycle import ctx_checkpoint
         with self.metrics.timer(MN.SHUFFLE_WRITE_TIME):
             for map_id, batch in enumerate(child_batches):
+                # stage-boundary lifecycle checkpoint: between map
+                # batches no partition is mid-write (partition_one has no
+                # catalog writes inside), so a cancel/deadline raises
+                # cleanly — the registered remove_shuffle cleanup and
+                # owner-confined release free what was already written —
+                # and a preemption request may suspend here
+                ctx_checkpoint(ctx, allow_suspend=True)
 
                 def partition_one(b, map_id=map_id):
                     """Retryable partition-id + split compute (no catalog

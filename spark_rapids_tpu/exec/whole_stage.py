@@ -217,8 +217,15 @@ class TpuWholeStageExec(FusedPipelineExec):
                 donation.record_donated_dispatch(b, self.metrics)
             return fn(*args)
 
+        from ..serve.lifecycle import ctx_checkpoint
         for batch in self.children[0].execute(ctx):
             n_batches += 1
+            # stage-boundary lifecycle checkpoint (serve/lifecycle.py):
+            # between batch dispatches nothing is mid-reservation, so a
+            # cancel/deadline raises here and a preemption request may
+            # SUSPEND here (spill own buffers, release the semaphore,
+            # block for a FIFO-within-priority resume)
+            ctx_checkpoint(ctx, allow_suspend=True)
             # captured BEFORE the dispatch: a donating executable
             # consumes the batch, so no metadata read may follow it
             in_bytes = batch.device_size_bytes() if moderate else 0

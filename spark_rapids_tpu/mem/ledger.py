@@ -83,9 +83,9 @@ class QueryScope:
     query's own retry ladder.  One hog spills itself, not its
     neighbors."""
 
-    __slots__ = ("query", "budget", "spill_seconds")
+    __slots__ = ("query", "budget", "spill_seconds", "lifecycle")
 
-    def __init__(self, query: str, budget: int = 0):
+    def __init__(self, query: str, budget: int = 0, lifecycle=None):
         self.query = query
         self.budget = max(0, int(budget or 0))
         # wall seconds THIS query's reservations spent inside
@@ -94,6 +94,11 @@ class QueryScope:
         # shared runtime spillTime metric cannot attribute per query
         # under concurrency
         self.spill_seconds = 0.0
+        # serve.lifecycle.QueryLifecycle token of a scheduler-run query
+        # (None for blocking collect() paths and with the lifecycle kill
+        # switch off): reserve()/with_retry/stage boundaries consult it
+        # for pending cancel/deadline/preemption signals
+        self.lifecycle = lifecycle
 
 
 class MemoryLedger:
@@ -136,15 +141,17 @@ class MemoryLedger:
     # -- per-query scope (serving tier) --------------------------------------
 
     @contextlib.contextmanager
-    def query_scope(self, query: str, budget: int = 0):
+    def query_scope(self, query: str, budget: int = 0, lifecycle=None):
         """Install `query` as the owning query for buffers this thread
         registers (and, with budget > 0, the reserve()-enforced device
-        cap).  Nests: inner scopes shadow outer ones (a CPU-fallback
-        re-execution keeps the parent query's identity unless re-scoped).
-        Active even when the ledger is disabled — ownership accounting
-        is what budgets/admission are built on, journaling is not."""
+        cap; with a `lifecycle` token, the checkpoint state the
+        cancel/deadline/preemption machinery consults).  Nests: inner
+        scopes shadow outer ones (a CPU-fallback re-execution keeps the
+        parent query's identity unless re-scoped).  Active even when the
+        ledger is disabled — ownership accounting is what
+        budgets/admission are built on, journaling is not."""
         prev = getattr(self._tls, "qscope", None)
-        self._tls.qscope = QueryScope(query, budget)
+        self._tls.qscope = QueryScope(query, budget, lifecycle=lifecycle)
         try:
             yield self._tls.qscope
         finally:

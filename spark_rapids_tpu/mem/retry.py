@@ -189,6 +189,14 @@ def with_retry(fn: Callable, inputs: Sequence, *, runtime=None,
                                can_split=split is not None)
         try:
             while True:
+                # lifecycle checkpoint (serve/lifecycle.py): a cancelled
+                # or past-deadline query stops HERE instead of burning
+                # retries — the signal is typed non-MemoryError, so the
+                # `except MemoryError` ladder below can never swallow it
+                if runtime is not None:
+                    _scope = runtime.ledger.current_query_scope()
+                    if _scope is not None and _scope.lifecycle is not None:
+                        _scope.lifecycle.check()
                 try:
                     arg = handle.acquire() if handle is not None else x
                     try:

@@ -205,6 +205,16 @@ class TpuRuntime:
         to retry); retryable blocks (mem/retry.py with_retry) catch it,
         re-spill/split and re-enter here.  `site` labels the call for the
         fault injector and test observability."""
+        # lifecycle checkpoint (serve/lifecycle.py): reserve() guards
+        # every whole-batch device allocation, which makes it the ONE
+        # universal cancel/deadline yield point — a cancelled or
+        # past-deadline query raises (typed, non-MemoryError: the retry
+        # ladder must never retry it) BEFORE committing more memory.
+        # Suspension is not allowed here (stage boundaries only); the
+        # no-token path reads one attribute and moves on.
+        scope0 = self.ledger.current_query_scope()
+        if scope0 is not None and scope0.lifecycle is not None:
+            scope0.lifecycle.check()
         faults.INJECTOR.on_reserve(site, nbytes)
         self.event_handler.retry_count = 0  # fresh allocation attempt
         with self.ledger.reservation(site, nbytes):
@@ -364,6 +374,23 @@ class TpuRuntime:
         if self._debug_on:
             self._debug_log(f"free id={buffer_id} {buf.size_bytes}B "
                             f"pool={self.device_store.current_size}B")
+
+    def release_owner(self, owner: Optional[str]) -> int:
+        """Free every buffer stamped with `owner` across all three tiers
+        — the owner-confined cleanup a cancelled/past-deadline query runs
+        after its shuffle cleanups, so a killed query can never leak pool
+        bytes (its buffers are its own by construction: PR 10's owner
+        stamps come from the thread-local query scope).  Returns the
+        bytes freed.  Idempotent: free_batch tolerates already-removed
+        ids, and a query that leaked nothing frees nothing."""
+        if not owner:
+            return 0
+        freed = 0
+        for store in (self.device_store, self.host_store, self.disk_store):
+            for bid, nbytes in store.owner_buffers(owner):
+                freed += nbytes
+                self.free_batch(bid)
+        return freed
 
     def update_priority(self, buffer_id: int, priority: float) -> None:
         buf = self.catalog.acquire(buffer_id)

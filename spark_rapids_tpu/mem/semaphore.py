@@ -81,6 +81,33 @@ class TpuSemaphore:
             else:
                 self._holders[key] = depth - 1
 
+    def park(self, task_id=None) -> int:
+        """Preemption suspend: drop EVERY slot depth this task holds and
+        wake waiters; returns the depth to restore via `unpark()`.
+        Unlike release_if_necessary (balances one acquisition) this
+        empties the task's whole re-entrant stack — the suspended query
+        must not keep the device gate while parked (serve/lifecycle.py
+        QueryLifecycle._suspend)."""
+        key = self._key(task_id)
+        with self._cond:
+            depth = self._holders.pop(key, 0)
+            if depth > 0:
+                self._cond.notify_all()
+            return depth
+
+    def unpark(self, depth: int, task_id=None, metrics=None) -> None:
+        """Preemption resume: block until a slot frees, then restore the
+        exact re-entrant depth `park()` returned — the enclosing held()
+        contexts on the resumed thread's stack balance out as if the
+        suspend never happened.  Blocked time is attributed like any
+        acquire (semaphoreWaitTime on the resuming query's metrics)."""
+        if depth <= 0:
+            return
+        self.acquire_if_necessary(task_id, metrics=metrics)
+        key = self._key(task_id)
+        with self._cond:
+            self._holders[key] = depth
+
     def task_done(self, task_id=None) -> None:
         """Drop every reference the task holds (the task-completion listener
         path, GpuSemaphore.scala:97-120)."""

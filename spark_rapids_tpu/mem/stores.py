@@ -254,6 +254,18 @@ class BufferStore:
         with self._lock:
             return self._owner_sizes.get(owner, 0)
 
+    def owner_buffers(self, owner: Optional[str]) -> List[tuple]:
+        """(buffer id, size) of every buffer this store tracks for one
+        owning query, id-ascending — the enumeration owner-confined
+        cleanup walks (runtime.release_owner) when a cancelled or
+        past-deadline query's remaining buffers must be freed."""
+        if owner is None:
+            return []
+        with self._lock:
+            return sorted((bid, b.size_bytes)
+                          for bid, b in self._buffers.items()
+                          if b.owner == owner)
+
     def update_priority(self, buf: SpillableBuffer, priority: float) -> None:
         with self._lock:
             buf.spill_priority = priority

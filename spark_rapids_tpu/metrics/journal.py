@@ -96,7 +96,17 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # admission stall, attrs where/window), codec (a roofline-
                # proven wire-bound exchange flipping the fetch codec) —
                # replayed by `python -m spark_rapids_tpu.metrics --memory`
-               "policy")
+               "policy",
+               # lifecycle = one query-lifecycle decision
+               # (serve/lifecycle.py): cancel (a QueryFuture.cancel or
+               # token-routed shutdown observed at a checkpoint),
+               # deadline (a submit deadline_ms= enforced mid-run), shed
+               # (rejected at admission: remaining deadline under the
+               # estimated plan+compile cost), preemptSuspend /
+               # preemptResume (a victim parking at a stage boundary and
+               # continuing bit-for-bit), ownerCleanup (the freed-bytes
+               # accounting of a killed query's owner-confined release)
+               "lifecycle")
 
 # --- flight-recorder taps ----------------------------------------------------
 # Process-wide observers of EVERY journal record emitted by ANY journal in

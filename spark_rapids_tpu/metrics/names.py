@@ -365,6 +365,38 @@ NUM_BUDGET_OOMS = register_metric(
     "reservations that exceeded a query's serve.queryBudgetBytes after "
     "spilling the query's own buffers — the RetryOOM then drives that "
     "query's (and only that query's) retry/split/CPU-fallback ladder")
+NUM_CANCELLED_QUERIES = register_metric(
+    "numCancelledQueries", COUNTER, ESSENTIAL,
+    "scheduler-run queries terminated by QueryFuture.cancel() or a "
+    "token-routed shutdown — dequeued for free while queued, stopped at "
+    "the next lifecycle checkpoint while running, then owner-confined "
+    "cleanup freed their remaining device/host/disk buffers and shuffle "
+    "outputs (serve/lifecycle.py)")
+NUM_DEADLINE_SHEDS = register_metric(
+    "numDeadlineSheds", COUNTER, ESSENTIAL,
+    "queries rejected AT ADMISSION because their remaining deadline "
+    "could not cover the estimated plan+compile cost "
+    "(serve.deadline.shedSafetyFactor x the scheduler's EWMA) — shed "
+    "with a typed QueryDeadlineExceeded instead of admitted doomed")
+NUM_DEADLINE_EXCEEDED = register_metric(
+    "numDeadlineExceeded", COUNTER, ESSENTIAL,
+    "admitted queries that ran past their submit(deadline_ms=) deadline "
+    "and were terminated at a lifecycle checkpoint with "
+    "QueryDeadlineExceeded — always the late query's OWN failure path, "
+    "never a neighbor's")
+NUM_PREEMPTIONS = register_metric(
+    "numPreemptions", COUNTER, ESSENTIAL,
+    "running queries that suspended at a stage boundary to yield the "
+    "admission share/device gate to a higher-priority arrival: device "
+    "buffers parked as spillable state charged to the victim's budget, "
+    "semaphore + admission share released (serve.preemption.enabled)")
+NUM_PREEMPTION_RESUMES = register_metric(
+    "numPreemptionResumes", COUNTER, ESSENTIAL,
+    "preempted queries granted a FIFO-within-priority resume (or "
+    "force-resumed at preemption.resumeTimeoutSeconds): they re-took "
+    "their admission share and semaphore slots and continued in place, "
+    "bit-for-bit with the unpreempted run; suspend-to-resume latency "
+    "lands in the SLO 'preempt' phase histograms")
 
 # --- roofline cost declarations (metrics/roofline.py) ------------------------
 # Every device operator declares the bytes it moves per RESOURCE and an

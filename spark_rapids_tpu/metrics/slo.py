@@ -14,6 +14,11 @@ This module keeps one fixed-bucket histogram per (phase, priority class):
                      reservations paid (accumulated on its thread-local
                      memory scope; the shared runtime spillTime metric
                      cannot attribute per query under concurrency)
+           preempt — suspend -> resume latency of each preemption the
+                     query paid (serve/lifecycle.py: park own buffers,
+                     release semaphore + admission share, wait for the
+                     FIFO-within-priority resume grant) — the cost side
+                     of the latency-class p99 the preemption buys
            total   — submit -> result
 
 Buckets are log-spaced powers of two from 0.5ms to ~1000s (22 buckets +
@@ -27,7 +32,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
-PHASES = ("queue", "plan", "compile", "execute", "spill", "total")
+PHASES = ("queue", "plan", "compile", "execute", "spill", "preempt",
+          "total")
 
 #: log-spaced upper bounds in seconds: 0.5ms * 2^k, k = 0..21 (~1048s)
 BUCKET_BOUNDS: Tuple[float, ...] = tuple(

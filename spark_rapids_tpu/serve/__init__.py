@@ -10,11 +10,20 @@ physical plans so the 2nd..Nth literal-variant submission replays the
 paying warmup again (BENCH_HEADLINE: q1 spends 27.9s compiling vs 1.3s
 executing — the cache is what makes a second user cheap).
 
-Entry point: `TpuSession.submit(df, priority=..., memory_need=...)`
-returns a `QueryFuture`; the blocking `collect()` paths are untouched.
+Entry point: `TpuSession.submit(df, priority=..., memory_need=...,
+deadline_ms=...)` returns a `QueryFuture`; the blocking `collect()`
+paths are untouched.  Query lifecycle robustness lives in lifecycle.py:
+`QueryFuture.cancel()` (cooperative cancellation with owner-confined
+cleanup), per-query deadlines with admission-time shedding, and
+SLO-aware preemption that suspends a lower-priority query at a stage
+boundary and resumes it bit-for-bit.
 """
+from .lifecycle import (QueryCancelled, QueryDeadlineExceeded,
+                        QueryLifecycle, QueryTimeout)
 from .plan_cache import PlanCache, extract_parameters, plan_cache_key
 from .scheduler import AdmissionRejected, QueryFuture, QueryScheduler
 
 __all__ = ["PlanCache", "extract_parameters", "plan_cache_key",
-           "AdmissionRejected", "QueryFuture", "QueryScheduler"]
+           "AdmissionRejected", "QueryFuture", "QueryScheduler",
+           "QueryCancelled", "QueryDeadlineExceeded", "QueryLifecycle",
+           "QueryTimeout"]

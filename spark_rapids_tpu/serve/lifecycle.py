@@ -1,0 +1,235 @@
+"""Query lifecycle: cooperative cancellation, deadlines, preemption.
+
+The serving half of ROADMAP item 4's robustness story: every admitted
+query carries a `QueryLifecycle` token threaded through its memory-ledger
+`QueryScope`, and the execution tiers consult it at their natural yield
+points — `reserve()` (every whole-batch device allocation), the
+`with_retry` attempt loop, the whole-stage per-batch dispatch loop and
+the exchange write/read loops.  Three mechanisms ride the one token:
+
+  * **Cancellation** — `QueryFuture.cancel()` (or scheduler shutdown)
+    stamps a reason; the next checkpoint raises `QueryCancelled` into
+    the query's OWN failure path.  A queued query dequeues for free.
+    The engine then runs owner-confined cleanup (PR 10's `owner`
+    stamps): the cancelled query's device/host/disk buffers and shuffle
+    outputs are freed, so a cancel can never leak pool bytes
+    (numCancelledQueries; journal kind `lifecycle`).
+  * **Deadlines** — `submit(..., deadline_ms=)` sets an absolute
+    monotonic deadline enforced at the same checkpoints
+    (`QueryDeadlineExceeded`, typed, never a neighbor's failure path).
+    Queue-side shedding is the scheduler's: a query whose remaining
+    deadline cannot cover the estimated plan+compile cost is rejected
+    at admission (numDeadlineSheds) instead of admitted doomed.
+  * **Preemption** — the scheduler requests preemption of a
+    lower-priority running query when a higher-priority one needs the
+    pool/device gate; the victim suspends at its next STAGE boundary
+    (suspension is only permitted where `checkpoint(allow_suspend=True)`
+    says so — never inside a reserve()): its device-resident buffers
+    are parked as spillable state charged to its own budget, the device
+    semaphore slots and the admission share are released, and the thread
+    blocks until the scheduler grants a FIFO-within-priority resume.
+    Execution then continues in place, so the result is bit-for-bit
+    identical to the unpreempted run (numPreemptions,
+    numPreemptionResumes, SLO phase `preempt`).
+
+Exception typing is load-bearing: neither `QueryCancelled` nor
+`QueryDeadlineExceeded` subclasses MemoryError, so the retry ladder
+(`with_retry` catches `MemoryError` only) can never swallow or
+retry-loop a lifecycle signal — it propagates straight to the worker's
+failure path.  `QueryTimeout` subclasses TimeoutError so callers that
+caught the old bare `TimeoutError("query still running")` keep working.
+
+Kill switch: spark.rapids.sql.tpu.serve.lifecycle.enabled=false makes
+the scheduler install no token at all — every checkpoint then reads one
+`None` attribute and does nothing, byte-identical to the pre-lifecycle
+paths.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class QueryCancelled(RuntimeError):
+    """The query was cancelled (QueryFuture.cancel() or scheduler
+    shutdown) and stopped at its next lifecycle checkpoint.  NOT a
+    MemoryError: the retry ladder must never retry a cancellation."""
+
+
+class QueryDeadlineExceeded(RuntimeError):
+    """The query ran past its submit(..., deadline_ms=) deadline (or was
+    shed at admission because the remaining deadline could not cover the
+    estimated plan+compile cost).  Raised into the query's OWN failure
+    path at a lifecycle checkpoint — never a neighbor's."""
+
+
+class QueryTimeout(TimeoutError):
+    """QueryFuture.result()/exception() gave up waiting (the caller's
+    `timeout=` elapsed).  The QUERY keeps running — this types the
+    caller-side wait, unlike QueryCancelled/QueryDeadlineExceeded which
+    terminate the query itself.  Subclasses TimeoutError for
+    compatibility with callers of the old untyped wait."""
+
+
+#: lifecycle checkpoints that may SUSPEND (preemption) — stage/batch
+#: boundaries where no reservation is mid-flight; reserve()-level
+#: checkpoints pass allow_suspend=False and only observe cancel/deadline
+STAGE_BOUNDARY = True
+
+
+class QueryLifecycle:
+    """Per-query cancellation/deadline/preemption token (one per
+    scheduler submission; installed on the query's ledger QueryScope by
+    engine._collect_physical so every tier reaches it thread-locally)."""
+
+    __slots__ = ("label", "priority", "deadline_at", "deadline_s",
+                 "journal", "metrics", "resume_timeout_s",
+                 "_cancel_reason", "_preempt_req", "_resume_evt",
+                 "_sched", "_item", "suspended", "preemptions",
+                 "preempt_seconds")
+
+    def __init__(self, label: Optional[str] = None, priority: int = 0,
+                 deadline_ms: Optional[float] = None):
+        self.label = label
+        self.priority = int(priority)
+        self.deadline_s = (None if deadline_ms is None
+                           else max(0.0, float(deadline_ms) / 1e3))
+        self.deadline_at = (None if self.deadline_s is None
+                            else time.monotonic() + self.deadline_s)
+        self.journal = None        # query's EventJournal (engine installs)
+        self.metrics = None        # runtime Metrics (scheduler installs)
+        self.resume_timeout_s = 600.0
+        self._cancel_reason: Optional[str] = None
+        self._preempt_req = threading.Event()
+        self._resume_evt = threading.Event()
+        self._sched = None         # QueryScheduler (preemption hooks)
+        self._item = None          # scheduler _Item (admission share)
+        self.suspended = False
+        self.preemptions = 0
+        self.preempt_seconds = 0.0
+
+    # -- cancellation / deadline --------------------------------------------
+
+    def cancel(self, reason: str = "cancelled by caller") -> None:
+        """Stamp the cancel reason; the query observes it at its next
+        checkpoint (idempotent — the first reason wins)."""
+        if self._cancel_reason is None:
+            self._cancel_reason = str(reason)
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel_reason is not None
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds until the deadline (None = no deadline)."""
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - time.monotonic()
+
+    def check(self) -> None:
+        """Raise the pending lifecycle signal, if any.  Two attribute
+        reads on the fast path — cheap enough for reserve()."""
+        if self._cancel_reason is not None:
+            raise QueryCancelled(
+                f"query {self.label or '?'} cancelled: "
+                f"{self._cancel_reason}")
+        if self.deadline_at is not None \
+                and time.monotonic() > self.deadline_at:
+            raise QueryDeadlineExceeded(
+                f"query {self.label or '?'} exceeded its "
+                f"{self.deadline_s:.3f}s deadline")
+
+    # -- preemption ----------------------------------------------------------
+
+    def request_preempt(self) -> None:
+        """Scheduler-side: ask this query to suspend at its next stage
+        boundary (idempotent; a no-op once the query finished)."""
+        self._preempt_req.set()
+
+    def checkpoint(self, runtime=None, allow_suspend: bool = False) -> None:
+        """The ONE lifecycle yield point: raise a pending cancel/deadline
+        signal, and — at stage boundaries only — honor a pending
+        preemption request by suspending in place."""
+        self.check()
+        if allow_suspend and self._preempt_req.is_set():
+            self._suspend(runtime)
+
+    def _suspend(self, runtime) -> None:
+        """Park this query: spill its own device buffers (charged to its
+        budget), give back its device-semaphore slots and its admission
+        share, then block until the scheduler grants a
+        FIFO-within-priority resume.  Cancels/deadlines are still
+        observed while suspended (a parked query must stay killable),
+        and a resume-timeout forces progress so a scheduler bug can
+        never hang the victim forever."""
+        sched, item = self._sched, self._item
+        self._preempt_req.clear()
+        if sched is None or item is None:
+            return  # not a scheduler-run query: preemption cannot apply
+        self._resume_evt.clear()
+        t0 = time.perf_counter()
+        parked = 0
+        sem_depth = 0
+        if runtime is not None:
+            owner = runtime.ledger.current_query()
+            if owner:
+                # park in-flight state: everything this query has
+                # registered on-device becomes spillable checkpoints in
+                # the lower tiers (still owner-charged, so its budget —
+                # not its neighbors' — carries the parked bytes)
+                parked = runtime.device_store.synchronous_spill(
+                    0, owner=owner)
+            sem_depth = runtime.semaphore.park()
+        from ..metrics.journal import journal_event
+        journal_event("lifecycle", "preemptSuspend",
+                      q=self.label, priority=self.priority,
+                      parked_bytes=parked, sem_depth=sem_depth)
+        self.suspended = True
+        sched._on_suspend(item)
+        try:
+            forced = False
+            give_up_at = time.monotonic() + max(1.0, self.resume_timeout_s)
+            while not self._resume_evt.wait(0.02):
+                try:
+                    self.check()  # suspended queries stay killable
+                except BaseException:
+                    sched._abort_suspended(item)
+                    raise
+                if time.monotonic() >= give_up_at:
+                    sched._force_resume(item)
+                    forced = True
+                    break
+        finally:
+            self.suspended = False
+        if runtime is not None and sem_depth:
+            runtime.semaphore.unpark(sem_depth, metrics=self.metrics)
+        dt = time.perf_counter() - t0
+        self.preemptions += 1
+        self.preempt_seconds += dt
+        sched._on_resumed(item, dt)
+        journal_event("lifecycle", "preemptResume", q=self.label,
+                      priority=self.priority, seconds=round(dt, 6),
+                      forced=forced)
+
+
+def scope_checkpoint(ledger, runtime=None,
+                     allow_suspend: bool = False) -> None:
+    """Consult the calling thread's query scope for a lifecycle token
+    and run its checkpoint.  The no-token path (blocking collect(),
+    kill switch off, worker task threads) is two attribute reads."""
+    scope = ledger.current_query_scope()
+    if scope is None:
+        return
+    tok = scope.lifecycle
+    if tok is not None:
+        tok.checkpoint(runtime=runtime, allow_suspend=allow_suspend)
+
+
+def ctx_checkpoint(ctx, allow_suspend: bool = False) -> None:
+    """Exec-layer convenience: lifecycle checkpoint through an
+    ExecContext (no-op without a runtime, e.g. bare CPU contexts)."""
+    rt = getattr(ctx, "runtime", None)
+    if rt is None:
+        return
+    scope_checkpoint(rt.ledger, runtime=rt, allow_suspend=allow_suspend)

@@ -1,0 +1,611 @@
+"""Query lifecycle robustness (ISSUE 19): cooperative cancellation,
+per-query deadlines, SLO-aware preemption, overload shedding.
+
+Coverage:
+  * typed exceptions: QueryCancelled / QueryDeadlineExceeded are
+    RuntimeError (never MemoryError — the retry ladder must not swallow
+    them); QueryTimeout subclasses TimeoutError and types the WAIT, not
+    the query;
+  * cancellation: a queued query dequeues for free (never costs a
+    worker); a running one stops at its next checkpoint with
+    QueryCancelled in its OWN failure path and ZERO residual
+    owner-stamped bytes in any tier and no orphaned shuffle buffers —
+    also composed with injectOom recovery in flight;
+  * deadlines: admission-time shedding when the remaining budget cannot
+    cover the estimated plan+compile cost, and mid-run enforcement at
+    checkpoints, both typed and owner-clean;
+  * preemption: a higher-priority arrival suspends the lower-priority
+    victim at a stage boundary; the victim's result stays bit-for-bit
+    identical across >= 3 plan shapes (row-local, aggregation,
+    exchange+aggregation); resume grants are FIFO-within-priority
+    (deterministic unit on _grant_resumes_locked);
+  * scheduler shutdown routes through the tokens: an in-flight query
+    stops at its next checkpoint instead of running to completion;
+  * kill switch: serve.lifecycle.enabled=false installs no token at all
+    — cancel() reports False, results are identical, checkpoints see
+    None;
+  * slow: the >= 20-round seeded mixed-priority serving chaos soak
+    (random cancels/deadlines/preemptions + injectOom) — every survivor
+    bit-for-bit vs its oracle, zero leaked owner bytes, zero orphaned
+    shuffle buffers, hard wall-clock bound (CHAOS_ROUNDS/CHAOS_SEED
+    tunable).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.engine import TpuSession
+from spark_rapids_tpu.plan.logical import col, functions as F, lit
+from spark_rapids_tpu.serve.lifecycle import (QueryCancelled,
+                                              QueryDeadlineExceeded,
+                                              QueryLifecycle, QueryTimeout)
+
+pytestmark = pytest.mark.lifecycle
+
+N_ROWS = 40_000
+N_SLOW = 200_000
+
+
+def _table(n=N_ROWS, seed=7):
+    rng = np.random.RandomState(seed)
+    return pa.table({
+        "a": rng.uniform(0.0, 100.0, n),
+        "b": rng.randint(0, 50, n).astype(np.int64),
+        "c": rng.uniform(-1.0, 1.0, n),
+    })
+
+
+_TABLE = _table()
+_SLOW_TABLE = _table(N_SLOW, seed=11)
+
+
+def _session(extra=None):
+    conf = {"spark.rapids.sql.variableFloatAgg.enabled": "true"}
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+#: many small batches => many stage-boundary checkpoints, so a running
+#: query observes cancel/deadline/preempt signals within one batch
+_SMALL_BATCHES = {"spark.rapids.sql.reader.batchSizeRows": "2000"}
+
+
+def _q_rowlocal(df):
+    return (df.filter(col("a") > 1.0)
+            .select((col("a") * lit(2.0)).alias("x"),
+                    (col("c") * lit(-1.0)).alias("y"), col("b")))
+
+
+def _q_agg(df):
+    return (df.filter(col("a") > 5.0)
+            .select((col("a") * lit(1.5)).alias("x"), col("b"))
+            .group_by(col("b"))
+            .agg(F.sum(col("x")).alias("sx"), F.count(lit(1)).alias("n"))
+            .order_by("b"))
+
+
+def _q_exchange(df):
+    return (df.repartition(4, col("b"))
+            .group_by(col("b")).agg(F.sum(col("a")).alias("sa"))
+            .order_by("b"))
+
+
+def _q_fast(df):
+    return (df.filter((col("a") >= 40.0) & (col("a") <= 60.0))
+            .select((col("a") + lit(1.5)).alias("x"), col("b")))
+
+
+def _owner_bytes(session, query_id):
+    rt = session.runtime
+    owner = f"q{query_id}"
+    return sum(st.owner_size(owner) for st in
+               (rt.device_store, rt.host_store, rt.disk_store))
+
+
+def _shuffle_orphans(session):
+    env = getattr(session.runtime, "_shuffle_env", None)
+    if env is None:
+        return 0
+    received = sum(len(v) for v in env.received._received.values())
+    return env.catalog.num_buffers() + received
+
+
+# --------------------------------------------------------------------------
+# typed exceptions
+# --------------------------------------------------------------------------
+
+def test_exception_typing():
+    """The retry ladder catches MemoryError only — neither lifecycle
+    signal may be one; the wait timeout must stay a TimeoutError for
+    callers of the old untyped wait."""
+    assert issubclass(QueryCancelled, RuntimeError)
+    assert issubclass(QueryDeadlineExceeded, RuntimeError)
+    assert not issubclass(QueryCancelled, MemoryError)
+    assert not issubclass(QueryDeadlineExceeded, MemoryError)
+    assert issubclass(QueryTimeout, TimeoutError)
+
+
+def test_token_check_raises_typed():
+    tok = QueryLifecycle(label="t1")
+    tok.check()  # no signal: no-op
+    tok.cancel("first")
+    tok.cancel("second")  # first reason wins
+    with pytest.raises(QueryCancelled, match="first"):
+        tok.check()
+    tok2 = QueryLifecycle(label="t2", deadline_ms=0.0001)
+    time.sleep(0.01)
+    with pytest.raises(QueryDeadlineExceeded):
+        tok2.check()
+    assert tok2.remaining_s() < 0
+
+
+def test_result_and_exception_timeout_typed():
+    """A timed-out WAIT raises QueryTimeout; the query keeps running and
+    a later un-timed wait still delivers the result."""
+    s = _session(dict(_SMALL_BATCHES))
+    try:
+        df = s.from_arrow(_SLOW_TABLE)
+        expected = _q_rowlocal(df).to_arrow()
+        f = s.submit(_q_rowlocal(df))
+        with pytest.raises(QueryTimeout):
+            f.result(timeout=1e-6)
+        with pytest.raises(QueryTimeout):
+            f.exception(timeout=1e-6)
+        assert not f.cancelled
+        assert f.result(300).equals(expected)
+    finally:
+        s.shutdown_serving()
+
+
+# --------------------------------------------------------------------------
+# cancellation
+# --------------------------------------------------------------------------
+
+def test_cancel_queued_resolves_without_a_worker():
+    """A cancelled QUEUED query resolves immediately — the parked worker
+    never touches it, and it counts in numCancelledQueries."""
+    s = _session({"spark.rapids.sql.tpu.serve.maxConcurrentQueries": "1"})
+    orig = s._collect_physical
+    try:
+        df = s.from_arrow(_TABLE)
+        gate = threading.Event()
+        release = threading.Event()
+
+        def blocking(physical, out_schema, **kw):
+            gate.set()
+            assert release.wait(30)
+            return orig(physical, out_schema, **kw)
+
+        s._collect_physical = blocking
+        try:
+            f1 = s.submit(df.limit(3))
+            assert gate.wait(30)  # the only worker is parked in query 1
+            f2 = s.submit(df.limit(4))
+            t0 = time.monotonic()
+            assert f2.cancel("not needed anymore") is True
+            err = f2.exception(5)
+            assert time.monotonic() - t0 < 5  # resolved while q1 parked
+            assert isinstance(err, QueryCancelled)
+            assert "not needed anymore" in str(err)
+            assert f2.cancelled
+            assert f2.cancel() is False  # already resolved
+        finally:
+            release.set()
+        assert f1.result(300).num_rows == 3
+        st = s.scheduler.stats()["lifecycle"]
+        assert st["cancelled"] == 1
+        assert s.runtime.pool_stats().get("numCancelledQueries", 0) == 1
+    finally:
+        s._collect_physical = orig
+        s.shutdown_serving()
+
+
+def test_cancel_running_stops_and_cleans_owner():
+    """A RUNNING query stops at its next checkpoint with QueryCancelled
+    as its own error; afterwards no tier holds owner-stamped bytes and
+    no shuffle buffers are orphaned."""
+    s = _session(dict(_SMALL_BATCHES))
+    try:
+        df = s.from_arrow(_SLOW_TABLE)
+        f = s.submit(_q_rowlocal(df))
+        # wait until the worker picked it up, then let it run a little
+        deadline = time.monotonic() + 30
+        while f.admitted_ns is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.05)
+        assert f.cancel("operator abort") is True
+        err = f.exception(60)
+        if err is None:
+            pytest.skip("query finished before observing the cancel "
+                        "(cooperative cancellation keeps the result)")
+        assert isinstance(err, QueryCancelled)
+        with pytest.raises(QueryCancelled):
+            f.result(1)
+        assert f.cancelled
+        assert _owner_bytes(s, f.query_id) == 0
+        assert _shuffle_orphans(s) == 0
+        assert s.scheduler.stats()["lifecycle"]["cancelled"] == 1
+    finally:
+        s.shutdown_serving()
+
+
+def test_cancel_shuffling_query_no_orphans_with_injectoom():
+    """Cancel an exchange-bearing query mid-run while injectOom fires in
+    the same window: whether the round ends in QueryCancelled or a
+    recovered result, no owner bytes and no shuffle buffers survive."""
+    s = _session({**_SMALL_BATCHES,
+                  "spark.rapids.tpu.test.injectOom": "3x2,9x2"})
+    try:
+        df = s.from_arrow(_SLOW_TABLE)
+        f = s.submit(_q_exchange(df))
+        deadline = time.monotonic() + 30
+        while f.admitted_ns is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.05)
+        f.cancel("chaos")
+        err = f.exception(120)
+        assert err is None or isinstance(err, QueryCancelled)
+        assert _owner_bytes(s, f.query_id) == 0
+        assert _shuffle_orphans(s) == 0
+    finally:
+        s.shutdown_serving()
+
+
+# --------------------------------------------------------------------------
+# deadlines
+# --------------------------------------------------------------------------
+
+def test_deadline_shed_at_admission():
+    """An already-expired deadline is shed at the queue edge: typed
+    error, numDeadlineSheds counted, the worker never plans it."""
+    s = _session()
+    try:
+        df = s.from_arrow(_TABLE)
+        f = s.submit(_q_fast(df), deadline_ms=0.001)
+        err = f.exception(60)
+        assert isinstance(err, QueryDeadlineExceeded)
+        assert "shed at admission" in str(err)
+        st = s.scheduler.stats()["lifecycle"]
+        assert st["deadline_sheds"] == 1
+        assert s.runtime.pool_stats().get("numDeadlineSheds", 0) == 1
+        assert f.plan_seconds is None  # never planned
+    finally:
+        s.shutdown_serving()
+
+
+def test_deadline_mid_run_typed_and_owner_clean():
+    """A deadline that expires mid-execution raises
+    QueryDeadlineExceeded into the query's OWN failure path at a
+    checkpoint, then owner cleanup leaves zero residual bytes."""
+    s = _session(dict(_SMALL_BATCHES))
+    try:
+        df = s.from_arrow(_SLOW_TABLE)
+        # passes admission shedding (the plan+compile EWMA starts at 0,
+        # so the estimate is 0 and only an already-expired deadline
+        # sheds) but expires long before the batch loop finishes
+        f = s.submit(_q_rowlocal(df), deadline_ms=60)
+        err = f.exception(120)
+        if err is None:
+            pytest.skip("query beat its 60ms deadline on this host")
+        assert isinstance(err, QueryDeadlineExceeded)
+        assert _owner_bytes(s, f.query_id) == 0
+        st = s.scheduler.stats()["lifecycle"]
+        assert st["deadline_exceeded"] + st["deadline_sheds"] >= 1
+    finally:
+        s.shutdown_serving()
+
+
+def test_deadline_does_not_affect_other_queries():
+    """A past-deadline query fails ALONE: a deadline-free neighbor
+    submitted alongside returns its full result."""
+    s = _session(dict(_SMALL_BATCHES))
+    try:
+        df = s.from_arrow(_TABLE)
+        expected = _q_agg(df).to_arrow()
+        doomed = s.submit(_q_fast(df), deadline_ms=0.001)
+        healthy = s.submit(_q_agg(df))
+        assert isinstance(doomed.exception(60), QueryDeadlineExceeded)
+        assert healthy.result(300).equals(expected)
+    finally:
+        s.shutdown_serving()
+
+
+# --------------------------------------------------------------------------
+# preemption
+# --------------------------------------------------------------------------
+
+_PREEMPT_CONF = {
+    **_SMALL_BATCHES,
+    "spark.rapids.sql.tpu.serve.maxConcurrentQueries": "2",
+    "spark.rapids.sql.concurrentTpuTasks": "1",
+    "spark.rapids.sql.tpu.serve.preemption.enabled": "true",
+}
+
+
+@pytest.mark.parametrize("shape,builder,extra", [
+    ("rowlocal", _q_rowlocal, {}),
+    # whole-stage absorption off keeps the agg on its STREAMING
+    # per-batch update loop: the fused agg drains every input batch
+    # host-side and then runs ONE device dispatch, so its only
+    # suspend-capable window is too narrow for the burst to land in
+    # deterministically (cancel/deadline coverage of the fused probe
+    # drain comes from the chaos soak, which runs fused)
+    ("aggregation", _q_agg,
+     {"spark.rapids.sql.tpu.wholeStage.enabled": "false"}),
+    ("exchange_agg", _q_exchange, {}),
+])
+def test_preempted_victim_bit_for_bit(shape, builder, extra):
+    """A low-priority victim suspended by a high-priority burst resumes
+    and produces a result bit-for-bit identical to its blocking run —
+    across row-local, aggregation and exchange+aggregation shapes."""
+    s = _session({**_PREEMPT_CONF, **extra})
+    try:
+        df = s.from_arrow(_SLOW_TABLE)
+        expected = builder(df).to_arrow()
+        fast_expected = _q_fast(df).to_arrow()
+        preempted = False
+        for _attempt in range(3):
+            before = (s.scheduler.stats()["lifecycle"]["preemptions"]
+                      if s.scheduler is not None else 0)
+            victim = s.submit(builder(df), priority=0)
+            deadline = time.monotonic() + 30
+            while victim.admitted_ns is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.002)
+            burst = [s.submit(_q_fast(df), priority=10) for _ in range(2)]
+            for b in burst:
+                assert b.result(300).equals(fast_expected)
+            assert victim.result(300).equals(expected), \
+                f"{shape}: preempted victim result diverged"
+            st = s.scheduler.stats()["lifecycle"]
+            if st["preemptions"] > before:
+                assert st["preemption_resumes"] == st["preemptions"]
+                preempted = True
+                break
+            # victim finished before the burst landed — retry (results
+            # were still verified bit-for-bit above)
+        assert preempted, f"{shape}: no preemption in 3 attempts"
+        assert s.scheduler.stats()["lifecycle"]["suspended"] == 0
+        pool = s.runtime.pool_stats()
+        assert pool.get("numPreemptions", 0) >= 1
+        assert pool.get("numPreemptionResumes", 0) == \
+            pool.get("numPreemptions", 0)
+    finally:
+        s.shutdown_serving()
+
+
+def test_preempt_latency_lands_in_slo_phase():
+    """Each suspend->resume pays into the `preempt` SLO phase for the
+    victim's priority class."""
+    s = _session(dict(_PREEMPT_CONF))
+    try:
+        df = s.from_arrow(_SLOW_TABLE)
+        for _attempt in range(3):
+            victim = s.submit(_q_rowlocal(df), priority=0)
+            deadline = time.monotonic() + 30
+            while victim.admitted_ns is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.002)
+            hi = s.submit(_q_fast(df), priority=10)
+            hi.result(300)
+            victim.result(300)
+            if s.scheduler.stats()["lifecycle"]["preemptions"]:
+                break
+        rep = s.scheduler.slo.report().get("preempt", {})
+        if not rep:
+            pytest.skip("no preemption landed on this host's timing")
+        hist = rep.get("0")
+        assert hist is not None and hist["count"] >= 1
+        assert hist["p99_s"] is not None
+    finally:
+        s.shutdown_serving()
+
+
+def test_resume_grants_fifo_within_priority():
+    """Deterministic unit on _grant_resumes_locked: suspended victims
+    resume highest-priority first, FIFO within a priority, and never
+    while a strictly-higher-priority query is queued with a free worker
+    or active."""
+    import heapq
+    from spark_rapids_tpu.serve.scheduler import _Item
+
+    s = _session()
+    try:
+        s.submit(s.from_arrow(_TABLE).limit(1)).result(60)  # build sched
+        sched = s.scheduler
+
+        def suspended_item(priority, seq):
+            tok = QueryLifecycle(label=f"p{priority}s{seq}",
+                                 priority=priority)
+            from spark_rapids_tpu.serve.scheduler import QueryFuture
+            fut = QueryFuture(priority, 10)
+            fut.lifecycle = tok
+            item = _Item(None, priority, 10, fut, seq=seq)
+            tok._item = item
+            tok._sched = sched
+            return item
+
+        with sched._lock:
+            saved = (sched._suspended, list(sched._queue), sched._running,
+                     sched._inflight_need, sched.preemption_resumes)
+            items = [suspended_item(0, 5), suspended_item(5, 3),
+                     suspended_item(5, 2), suspended_item(9, 7)]
+            sched._suspended = [(-it.priority, it.seq, it)
+                                for it in items]
+            heapq.heapify(sched._suspended)
+            sched._queue = []
+            sched._running = 0
+            sched._inflight_need = 0
+            sched._grant_resumes_locked()
+            order = [it.future.lifecycle._resume_evt.is_set()
+                     for it in items]
+            assert order == [True, True, True, True]
+            # grant ORDER: pop sequence is priority desc, seq asc —
+            # verify by re-running with a queue barrier in the middle
+            sched._suspended = [(-it.priority, it.seq, it)
+                                for it in items]
+            heapq.heapify(sched._suspended)
+            for it in items:
+                it.future.lifecycle._resume_evt.clear()
+                it.need_released = True
+            # a queued priority-7 query (with a free worker available)
+            # blocks the p5/p0 victims but NOT the p9 one
+            barrier = _Item(None, 7, 1, None, seq=10)
+            sched._queue = [(-7, 10, barrier)]
+            sched._grant_resumes_locked()
+            granted = [it.future.lifecycle._resume_evt.is_set()
+                       for it in items]
+            assert granted == [False, False, False, True]
+            (sched._suspended, sched._queue, sched._running,
+             sched._inflight_need, sched.preemption_resumes) = saved
+    finally:
+        s.shutdown_serving()
+
+
+# --------------------------------------------------------------------------
+# shutdown through the token path
+# --------------------------------------------------------------------------
+
+def test_shutdown_cancels_in_flight_at_checkpoint():
+    """shutdown() routes through the lifecycle tokens: a long in-flight
+    query stops at its next checkpoint instead of running to completion,
+    so the workers join promptly."""
+    s = _session(dict(_SMALL_BATCHES))
+    df = s.from_arrow(_SLOW_TABLE)
+    f = s.submit(_q_rowlocal(df))
+    deadline = time.monotonic() + 30
+    while f.admitted_ns is None and time.monotonic() < deadline:
+        time.sleep(0.005)
+    t0 = time.monotonic()
+    s.shutdown_serving()
+    joined_s = time.monotonic() - t0
+    err = f.exception(5)
+    # either the query beat the shutdown to completion, or it was
+    # token-cancelled at a checkpoint — never a hang
+    assert err is None or isinstance(err, QueryCancelled)
+    assert joined_s < 60
+    if isinstance(err, QueryCancelled):
+        assert "shutdown" in str(err)
+
+
+# --------------------------------------------------------------------------
+# kill switch
+# --------------------------------------------------------------------------
+
+def test_kill_switch_installs_no_token():
+    """serve.lifecycle.enabled=false: no token anywhere — cancel() is a
+    False no-op, deadlines are ignored, results identical."""
+    s = _session({"spark.rapids.sql.tpu.serve.lifecycle.enabled":
+                  "false"})
+    try:
+        df = s.from_arrow(_TABLE)
+        expected = _q_agg(df).to_arrow()
+        f = s.submit(_q_agg(df), deadline_ms=0.001)
+        assert f.lifecycle is None
+        assert f.cancel("ignored") is False
+        assert f.result(300).equals(expected)
+        st = s.scheduler.stats()["lifecycle"]
+        assert not st["enabled"]
+        assert st["cancelled"] == st["deadline_sheds"] == \
+            st["preemptions"] == 0
+        # the ledger scope carries no token either: every checkpoint in
+        # the exec tiers read None and did nothing
+        assert s.runtime.ledger.current_query_scope() is None
+    finally:
+        s.shutdown_serving()
+
+
+def test_preemption_off_by_default():
+    s = _session()
+    try:
+        s.submit(s.from_arrow(_TABLE).limit(1)).result(60)
+        assert s.scheduler.lifecycle_enabled
+        assert not s.scheduler.preemption_enabled
+    finally:
+        s.shutdown_serving()
+
+
+# --------------------------------------------------------------------------
+# serving chaos soak (slow)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serving_chaos_soak():
+    """>= 20 seeded rounds of mixed-priority queries under random
+    cancels, deadlines, preemption bursts and injectOom sweeps.  Every
+    survivor must be bit-for-bit identical to its oracle, every
+    cancelled/expired query must end in its typed error, and after every
+    round: zero owner-stamped bytes for finished queries, zero orphaned
+    shuffle buffers.  A hard wall-clock bound guards against hangs."""
+    from spark_rapids_tpu.utils import faults
+
+    rounds = int(os.environ.get("CHAOS_ROUNDS", "20"))
+    seed = int(os.environ.get("CHAOS_SEED", "19"))
+    rng = random.Random(seed)
+    # anti-hang bound, not a throughput target: sized for 20 rounds of
+    # cold-compile-heavy mixed shapes on a CPU-emulated device
+    wall_budget = float(os.environ.get("CHAOS_WALL_S", "2400"))
+
+    s = _session(dict(_PREEMPT_CONF))
+    try:
+        df = s.from_arrow(_SLOW_TABLE)
+        shapes = [("rowlocal", _q_rowlocal), ("agg", _q_agg),
+                  ("exchange", _q_exchange), ("fast", _q_fast)]
+        oracles = {name: b(df).to_arrow() for name, b in shapes}
+        t_start = time.monotonic()
+        survivors = cancels = sheds = expirations = 0
+        for rnd in range(rounds):
+            assert time.monotonic() - t_start < wall_budget, \
+                f"soak exceeded its {wall_budget}s wall-clock bound " \
+                f"at round {rnd}"
+            if rng.random() < 0.4:
+                faults.INJECTOR.configure(
+                    oom_spec=f"{rng.randrange(1, 12)}x2")
+            else:
+                faults.INJECTOR.reset()
+            futs = []
+            for _ in range(rng.randrange(3, 6)):
+                name, b = shapes[rng.randrange(len(shapes))]
+                deadline = (rng.uniform(50, 400)
+                            if rng.random() < 0.25 else None)
+                futs.append((name, s.submit(
+                    b(df), priority=rng.randrange(0, 11),
+                    deadline_ms=deadline)))
+            # random cancels while the round races
+            for name, f in futs:
+                if rng.random() < 0.25:
+                    f.cancel(f"chaos round {rnd}")
+            for name, f in futs:
+                err = f.exception(300)
+                if err is None:
+                    assert f.result(1).equals(oracles[name]), \
+                        f"round {rnd}: survivor {name} diverged"
+                    survivors += 1
+                elif isinstance(err, QueryCancelled):
+                    cancels += 1
+                elif isinstance(err, QueryDeadlineExceeded):
+                    if "shed at admission" in str(err):
+                        sheds += 1
+                    else:
+                        expirations += 1
+                else:
+                    raise AssertionError(
+                        f"round {rnd}: untyped failure {err!r}")
+                assert _owner_bytes(s, f.query_id or -1) == 0
+            assert _shuffle_orphans(s) == 0, \
+                f"round {rnd}: orphaned shuffle buffers"
+            assert s.scheduler.stats()["lifecycle"]["suspended"] == 0
+        faults.INJECTOR.reset()
+        st = s.scheduler.stats()["lifecycle"]
+        # the soak must have actually exercised the machinery
+        assert survivors >= rounds  # most queries survive
+        assert cancels + sheds + expirations + st["preemptions"] > 0
+    finally:
+        s.shutdown_serving()
