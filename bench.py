@@ -1068,6 +1068,154 @@ def serve_microbench(write_artifact: bool = True) -> dict:
     return out
 
 
+def streaming_microbench(write_artifact: bool = True) -> dict:
+    """Streaming micro-batch bench (ISSUE 20 acceptance artifact:
+    BENCH_STREAM.json).
+
+    For several epoch batch sizes: a grouped sum/avg/count query runs
+    incrementally over an in-memory append stream (reader batch rows
+    pinned to the epoch size — the bit-for-bit alignment contract).
+    After a 3-epoch warm-up, the sweep records epochs/s, p50/p95 epoch
+    latency, and the warm-epoch compile count, which must be ZERO (every
+    epoch after the first is a plan-cache hit replaying compiled
+    stages).  At the largest stream length it also times one full batch
+    re-query over everything seen so far: the incremental epoch must
+    beat it >= 3x (the speedup grows with stream length — that is the
+    point of keeping state resident), and the incremental result's
+    checksum must match the batch oracle's."""
+    import jax
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.engine import DataFrame, TpuSession
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.logical import col, functions as F, lit
+    from spark_rapids_tpu.streaming import MemoryStream, StreamingQuery
+    from spark_rapids_tpu.types import LongType, DoubleType, Schema, \
+        StructField
+    from spark_rapids_tpu.utils import kernel_cache as KC
+
+    xla_compiles = [0]
+    try:
+        jax.monitoring.register_event_listener(
+            lambda name, **kw: xla_compiles.__setitem__(
+                0, xla_compiles[0]
+                + (name == "/jax/compilation_cache/"
+                           "compile_requests_use_cache")))
+    except Exception:
+        pass
+
+    schema = Schema([StructField("k", LongType),
+                     StructField("v", DoubleType)])
+    rng = np.random.default_rng(42)
+
+    def make_chunk(rows):
+        return pa.table({
+            "k": pa.array(rng.integers(0, 64, rows), type=pa.int64()),
+            "v": pa.array(rng.random(rows) * 100.0, type=pa.float64())})
+
+    def build(df):
+        return df.group_by(col("k")).agg(
+            F.sum(col("v")).alias("sv"), F.avg(col("v")).alias("av"),
+            F.count(lit(1)).alias("c"))
+
+    WARMUP = 3
+    out = {"single_core": (os.cpu_count() or 1) == 1, "batch_sizes": []}
+    for batch_rows, n_epochs in ((2_000, 24), (8_000, 24), (32_000, 24)):
+        conf = {
+            "spark.rapids.sql.variableFloatAgg.enabled": "true",
+            "spark.rapids.sql.reader.batchSizeRows": str(batch_rows),
+            "spark.rapids.sql.tpu.streaming.maxBatchRows": str(batch_rows),
+        }
+        s = TpuSession(conf)
+        src = MemoryStream(schema, name=f"bench{batch_rows}")
+        q = StreamingQuery(s, src, build, name=f"bench{batch_rows}")
+        for _ in range(WARMUP):
+            src.append(make_chunk(batch_rows))
+            q.trigger_once()
+        b0, x0 = KC.stats(), xla_compiles[0]
+        times = []
+        for _ in range(n_epochs - WARMUP):
+            src.append(make_chunk(batch_rows))
+            t0 = time.time()
+            q.trigger_once()
+            times.append(time.time() - t0)
+        b1, x1 = KC.stats(), xla_compiles[0]
+        times.sort()
+
+        def pct(p):
+            return round(times[min(len(times) - 1,
+                                   int(p * len(times)))], 5)
+
+        rec = {
+            "epoch_rows": batch_rows,
+            "epochs": n_epochs,
+            "warm_epochs": len(times),
+            "epochs_per_s": round(len(times) / max(1e-9, sum(times)), 2),
+            "p50_epoch_s": pct(0.50),
+            "p95_epoch_s": pct(0.95),
+            "rows_per_s": round(batch_rows * len(times)
+                                / max(1e-9, sum(times)), 1),
+            "warm_compiles": (b1["builds"] - b0["builds"]
+                              + b1["stage_compiles"]
+                              - b0["stage_compiles"]),
+            "warm_xla_compiles": x1 - x0,
+        }
+        if batch_rows == 32_000:
+            # incremental-vs-full-requery at the longest stream: one
+            # more epoch incrementally vs the whole history from scratch
+            src.append(make_chunk(batch_rows))
+            t0 = time.time()
+            q.trigger_once()
+            t_inc = time.time() - t0
+            full_df = build(DataFrame(s, L.LogicalScan(
+                src.rows_between(0, src.latest_offset()), schema,
+                "memory")))
+            t_full = None
+            for _ in range(2):  # first run may compile the final concat
+                t1 = time.time()
+                full = full_df.to_arrow()
+                t_full = time.time() - t1
+            inc = q.result()
+            cks = {
+                "incremental": round(checksum(
+                    sorted(zip(*(inc.column(i).to_pylist()
+                                 for i in range(inc.num_columns))))), 4),
+                "batch_oracle": round(checksum(
+                    sorted(zip(*(full.column(i).to_pylist()
+                                 for i in range(full.num_columns))))), 4),
+            }
+            rec["requery"] = {
+                "stream_rows": src.latest_offset(),
+                "incremental_epoch_s": round(t_inc, 5),
+                "full_requery_s": round(t_full, 5),
+                "speedup": round(t_full / max(1e-9, t_inc), 2),
+                "checksum_match": abs(cks["incremental"]
+                                      - cks["batch_oracle"])
+                <= 1e-6 * max(1.0, abs(cks["batch_oracle"])),
+                **cks,
+            }
+        out["batch_sizes"].append(rec)
+        q.stop()
+        s.shutdown_serving()
+    out["warm_compiles_total"] = sum(r["warm_compiles"]
+                                     for r in out["batch_sizes"])
+    out["zero_warm_compiles"] = out["warm_compiles_total"] == 0
+    last = out["batch_sizes"][-1].get("requery", {})
+    out["incremental_speedup"] = last.get("speedup")
+    out["checksum_match"] = last.get("checksum_match")
+    try:
+        out["platform"] = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        out["platform"] = "unknown"
+    if write_artifact:
+        try:
+            with open(os.path.join(REPO, "BENCH_STREAM.json"), "w") as f:
+                json.dump(out, f, indent=1)
+        except OSError:
+            pass
+    return out
+
+
 def chaos_microbench(write_artifact: bool = True) -> dict:
     """Chaos/recovery bench (ISSUE 15 acceptance artifact:
     BENCH_CHAOS.json).  On a 3-worker CPU ProcCluster running the
@@ -1859,6 +2007,15 @@ def child_main(mode: str) -> None:
         emit("serve", **serve_microbench())
     except Exception as e:
         emit("serve", error=repr(e)[:200])
+    # streaming rollup (ISSUE 20): incremental micro-batch epochs/s per
+    # batch size, p50/p95 epoch latency, the zero-warm-compile gate
+    # (every epoch after the first replays compiled stages), and the
+    # incremental-vs-full-requery speedup with a batch-oracle checksum
+    # cross-check; also writes BENCH_STREAM.json
+    try:
+        emit("streaming", **streaming_microbench())
+    except Exception as e:
+        emit("streaming", error=repr(e)[:200])
     # chaos rollup (ISSUE 15): recovery latency at 0/1/2 injected
     # mid-task kills on a 3-worker ProcCluster plus a measured
     # speculation win on an injected-delay straggler, every round
@@ -2021,8 +2178,8 @@ def collect(r: "StageReader", end_at: float,
            "transfer": None, "aborted": False, "backend_error": None,
            "observability": None, "adaptive": None, "integrity": None,
            "compress": None, "fusion": None, "tracing": None,
-           "pressure": None, "serve": None, "profile": None,
-           "chaos": None, "multichip": None}
+           "pressure": None, "serve": None, "streaming": None,
+           "profile": None, "chaos": None, "multichip": None}
     first = True
     try:
         while True:
@@ -2076,6 +2233,9 @@ def collect(r: "StageReader", end_at: float,
             elif st == "serve":
                 out["serve"] = {k: v for k, v in rec.items()
                                 if k != "stage"}
+            elif st == "streaming":
+                out["streaming"] = {k: v for k, v in rec.items()
+                                    if k != "stage"}
             elif st == "profile":
                 out["profile"] = {k: v for k, v in rec.items()
                                   if k != "stage"}
@@ -2116,6 +2276,13 @@ def main():
         # (plan-cache compile reduction + concurrency 1/4/16 mixed
         # workload) without the full suite
         print(json.dumps(serve_microbench(), indent=1))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--streaming":
+        # standalone streaming micro-batch sweep: regenerate
+        # BENCH_STREAM.json (epochs/s per batch size, p50/p95 epoch
+        # latency, zero-warm-compile gate, incremental-vs-full-requery
+        # speedup + checksum) without the full suite
+        print(json.dumps(streaming_microbench(), indent=1))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--chaos":
         # standalone chaos/recovery sweep: regenerate BENCH_CHAOS.json
@@ -2283,6 +2450,7 @@ def _run():
         "tracing": dev.get("tracing"),
         "pressure": dev.get("pressure"),
         "serve": dev.get("serve"),
+        "streaming": dev.get("streaming"),
         "profile": dev.get("profile"),
         "chaos": dev.get("chaos"),
         "multichip": dev.get("multichip"),

@@ -178,6 +178,20 @@ python -m pytest tests/test_lifecycle.py -q -m "not slow" \
     -p no:cacheprovider
 echo "== lifecycle tier took $((SECONDS - T_LC))s =="
 
+echo "== streaming tier =="
+# streaming micro-batch engine (ISSUE 20): incremental results must be
+# BIT-FOR-BIT identical to a full batch re-query at every epoch (across
+# agg shapes, rollup, and every dtype as a state key — the epoch-row /
+# reader-batch alignment contract), every epoch after the first a
+# plan-cache hit with ZERO warm-epoch kernel/stage compiles, injectOom
+# forced at the stream.fold/stream.restore reserve sites, kill-and-
+# restart checkpoint recovery (partial epoch dirs ignored), and
+# stop()/deadline shutdowns leaving zero leaked owner bytes.
+T_STRM=$SECONDS
+python -m pytest tests/test_streaming.py -q -m "not slow" \
+    -p no:cacheprovider
+echo "== streaming tier took $((SECONDS - T_STRM))s =="
+
 echo "== roofline tier =="
 # roofline-attribution profiler (ISSUE 13): cost-declaration coverage
 # (every plan node of the q1/q6 shapes names a bottleneck resource),

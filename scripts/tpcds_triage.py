@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""TPC-DS triage sweep: classify every query in the 99-query tier.
+
+Runs the full `benchmarks.tpcds.QUERIES` list at one scale factor
+(default SF0.1) and classifies each query:
+
+    ok           fully on-device plan, result matches the CPU oracle
+    fallback     result matches but the physical plan contains Cpu*
+                 nodes (named in the table) — perf work, not correctness
+    wrong        device result does NOT match the CPU oracle — a
+                 correctness bug to file
+    unsupported  the query raises while planning or executing
+
+Each row also records wall time on the device path vs the CPU oracle
+(single run each, shared session + tables, so times include first-run
+compiles — the honest "what would a user see" number at this scale).
+
+Outputs: a JSON table (--json, default TPCDS_TRIAGE.json at the repo
+root, the artifact bench tooling diffs) and a markdown table (--md,
+default docs/tpcds-triage.md, the checked-in triage board).
+
+The sweep runs in chunks of --chunk queries, each in a fresh
+subprocess: XLA's JIT keeps every compiled executable mapped for the
+life of the process, and ~40 queries' worth of stages exhausts
+vm.max_map_count (LLVM reports it as "Cannot allocate memory").
+Chunking bounds the per-process map count; --chunk 0 runs in-process.
+
+    python scripts/tpcds_triage.py                # full sweep, SF0.1
+    python scripts/tpcds_triage.py --sf 0.01 --queries 3,5,96
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+DEVICE_CONF = {"spark.rapids.sql.variableFloatAgg.enabled": "true"}
+CPU_CONF = {"spark.rapids.sql.enabled": "false"}
+
+
+def _cpu_fallback_nodes(session, df) -> list:
+    """Names of Cpu*-prefixed physical nodes in the query's plan."""
+    plan = session.plan(df.plan)
+    bad = set()
+
+    def walk(n):
+        if type(n).__name__.startswith("Cpu"):
+            bad.add(type(n).__name__)
+        for c in n.children:
+            walk(c)
+    walk(plan)
+    return sorted(bad)
+
+
+def triage(sf: float, qnums=None) -> dict:
+    from benchmarks.tpcds import QUERIES, load_tables
+    from compare import assert_rows_equal
+    from spark_rapids_tpu.engine import TpuSession
+
+    qnums = sorted(QUERIES) if not qnums else sorted(qnums)
+    t0 = time.time()
+    dev_s = TpuSession(dict(DEVICE_CONF))
+    dev_tables = load_tables(dev_s, sf=sf)
+    cpu_s = TpuSession(dict(CPU_CONF))
+    cpu_tables = load_tables(cpu_s, sf=sf)
+    load_seconds = round(time.time() - t0, 2)
+
+    rows = []
+    for qnum in qnums:
+        rec = {"query": qnum, "status": None, "device_s": None,
+               "cpu_s": None, "ratio": None, "rows": None,
+               "fallback_nodes": [], "error": None}
+        try:
+            df = QUERIES[qnum](dev_tables)
+            rec["fallback_nodes"] = _cpu_fallback_nodes(dev_s, df)
+            t = time.time()
+            got = df.collect()
+            rec["device_s"] = round(time.time() - t, 3)
+            rec["rows"] = len(got)
+        except Exception as e:  # noqa: BLE001 — triage, not a test
+            rec["status"] = "unsupported"
+            rec["error"] = repr(e)[:200]
+            rows.append(rec)
+            print(f"q{qnum}: unsupported ({rec['error'][:60]})",
+                  flush=True)
+            continue
+        t = time.time()
+        want = QUERIES[qnum](cpu_tables).collect()
+        rec["cpu_s"] = round(time.time() - t, 3)
+        rec["ratio"] = round(rec["device_s"] / max(1e-9, rec["cpu_s"]), 2)
+        try:
+            assert_rows_equal(want, got, ignore_order=True,
+                              approx_float=True)
+        except AssertionError as e:
+            rec["status"] = "wrong"
+            rec["error"] = repr(e)[:200]
+        else:
+            rec["status"] = "fallback" if rec["fallback_nodes"] else "ok"
+        rows.append(rec)
+        print(f"q{qnum}: {rec['status']} dev={rec['device_s']}s "
+              f"cpu={rec['cpu_s']}s", flush=True)
+
+    counts = {}
+    for r in rows:
+        counts[r["status"]] = counts.get(r["status"], 0) + 1
+    return {"sf": sf, "queries": len(rows), "counts": counts,
+            "load_seconds": load_seconds,
+            "total_device_s": round(sum(r["device_s"] or 0.0
+                                        for r in rows), 1),
+            "total_cpu_s": round(sum(r["cpu_s"] or 0.0
+                                     for r in rows), 1),
+            "rows": rows}
+
+
+def _merge(parts: list) -> dict:
+    rows = [r for p in parts for r in p["rows"]]
+    counts = {}
+    for r in rows:
+        counts[r["status"]] = counts.get(r["status"], 0) + 1
+    return {"sf": parts[0]["sf"], "queries": len(rows), "counts": counts,
+            "load_seconds": round(sum(p["load_seconds"] for p in parts), 2),
+            "total_device_s": round(sum(p["total_device_s"]
+                                        for p in parts), 1),
+            "total_cpu_s": round(sum(p["total_cpu_s"] for p in parts), 1),
+            "rows": rows}
+
+
+def triage_chunked(sf: float, qnums, chunk: int) -> dict:
+    """Run the sweep `chunk` queries per fresh subprocess and merge."""
+    parts = []
+    with tempfile.TemporaryDirectory(prefix="tpcds_triage_") as tmp:
+        for i in range(0, len(qnums), chunk):
+            part = qnums[i:i + chunk]
+            out = os.path.join(tmp, f"part-{i}.json")
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--sf", str(sf),
+                   "--queries", ",".join(str(q) for q in part),
+                   "--json", out, "--md", os.devnull, "--chunk", "0"]
+            subprocess.run(cmd, check=True)
+            with open(out) as f:
+                parts.append(json.load(f))
+    return _merge(parts)
+
+
+def to_markdown(result: dict) -> str:
+    counts = result["counts"]
+    lines = [
+        "# TPC-DS triage",
+        "",
+        f"Generated by `scripts/tpcds_triage.py` at SF{result['sf']} — "
+        "the full 99-query tier, each query classified "
+        "ok / fallback / wrong / unsupported with single-run wall time "
+        "vs the CPU oracle (shared session and tables; device times "
+        "include first-run compiles).",
+        "",
+        "| status | queries |",
+        "|---|---|",
+    ]
+    for st in ("ok", "fallback", "wrong", "unsupported"):
+        if counts.get(st):
+            lines.append(f"| {st} | {counts[st]} |")
+    lines += [
+        "",
+        f"Table load: {result['load_seconds']}s.  Total device time: "
+        f"{result['total_device_s']}s; total CPU-oracle time: "
+        f"{result['total_cpu_s']}s.",
+        "",
+        "| query | status | device s | cpu s | dev/cpu | rows | notes |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in result["rows"]:
+        notes = ""
+        if r["fallback_nodes"]:
+            notes = ", ".join(r["fallback_nodes"])
+        elif r["error"]:
+            notes = r["error"][:80].replace("|", "\\|")
+        lines.append(
+            f"| q{r['query']} | {r['status']} "
+            f"| {r['device_s'] if r['device_s'] is not None else '—'} "
+            f"| {r['cpu_s'] if r['cpu_s'] is not None else '—'} "
+            f"| {r['ratio'] if r['ratio'] is not None else '—'} "
+            f"| {r['rows'] if r['rows'] is not None else '—'} "
+            f"| {notes} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sf", type=float, default=0.1)
+    ap.add_argument("--queries", type=str, default="",
+                    help="comma-separated query numbers (default: all)")
+    ap.add_argument("--json", type=str,
+                    default=os.path.join(REPO, "TPCDS_TRIAGE.json"))
+    ap.add_argument("--md", type=str,
+                    default=os.path.join(REPO, "docs", "tpcds-triage.md"))
+    ap.add_argument("--chunk", type=int, default=20,
+                    help="queries per fresh subprocess (0 = in-process)")
+    args = ap.parse_args()
+    qnums = ([int(x) for x in args.queries.split(",") if x.strip()]
+             if args.queries else None)
+    if args.chunk > 0:
+        from benchmarks.tpcds import QUERIES
+        qnums = sorted(QUERIES) if not qnums else sorted(qnums)
+        if len(qnums) > args.chunk:
+            result = triage_chunked(args.sf, qnums, args.chunk)
+        else:
+            result = triage(args.sf, qnums)
+    else:
+        result = triage(args.sf, qnums)
+    with open(args.json, "w") as f:
+        json.dump(result, f, indent=1)
+    with open(args.md, "w") as f:
+        f.write(to_markdown(result))
+    print(json.dumps({"counts": result["counts"],
+                      "json": args.json, "md": args.md}, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1092,6 +1092,33 @@ SERVE_DEADLINE_SHED_FACTOR = _conf(
     "estimate-based shedding (already-expired deadlines still shed).",
     float)
 
+# --- streaming micro-batch engine (streaming/) ------------------------------
+STREAM_MAX_BATCH_ROWS = _conf(
+    "spark.rapids.sql.tpu.streaming.maxBatchRows", 65536,
+    "Upper bound on rows one streaming epoch reads from an append-only "
+    "source (streaming/source.py epoch planner).  Keeping it CONSTANT "
+    "for a query's lifetime keeps micro-batch capacities in one bucket, "
+    "so warm epochs replay compiled stages instead of re-tracing "
+    "(docs/tuning-guide.md, Streaming micro-batch execution).", int)
+STREAM_MAX_FILES_PER_EPOCH = _conf(
+    "spark.rapids.sql.tpu.streaming.maxFilesPerEpoch", 1,
+    "Upper bound on newly-arrived files one epoch of a directory-tail "
+    "streaming source decodes through the io/ device readers.", int)
+STREAM_CHECKPOINT_KEEP = _conf(
+    "spark.rapids.sql.tpu.streaming.checkpoint.keepEpochs", 2,
+    "Committed epoch snapshots retained in a streaming checkpoint "
+    "directory; older epoch dirs are pruned after each atomic commit "
+    "(the commit marker always lands last, so a kill mid-commit "
+    "resumes from the previous epoch bit-for-bit).", int)
+STREAM_EPOCH_DEADLINE_MS = _conf(
+    "spark.rapids.sql.tpu.streaming.epochDeadlineMs", 0.0,
+    "Default per-epoch deadline for streaming queries: each epoch is a "
+    "scheduler query carrying a lifecycle token, so past the deadline "
+    "it stops at its next checkpoint with QueryDeadlineExceeded and "
+    "owner-confined cleanup — the stream's device-resident state is "
+    "untouched and the next trigger retries the epoch.  0 disables.",
+    float)
+
 # --- export -----------------------------------------------------------------
 EXPORT_COLUMNAR_RDD = _conf(
     "spark.rapids.sql.exportColumnarRdd", False,

@@ -106,7 +106,15 @@ EVENT_KINDS = ("query", "stage", "operator", "retry", "spill", "fetch",
                # preemptResume (a victim parking at a stage boundary and
                # continuing bit-for-bit), ownerCleanup (the freed-bytes
                # accounting of a killed query's owner-confined release)
-               "lifecycle")
+               "lifecycle",
+               # epoch = one streaming micro-batch epoch
+               # (streaming/query.py): slice (unread offsets planned
+               # into a micro-batch, attrs source/start/end/rows),
+               # commit (offsets + state snapshot atomically durable,
+               # attrs epoch/state_bytes/rows), recover (a restarted
+               # query resuming from the last committed checkpoint
+               # instead of a cold recompute, attrs epoch/offsets)
+               "epoch")
 
 # --- flight-recorder taps ----------------------------------------------------
 # Process-wide observers of EVERY journal record emitted by ANY journal in

@@ -398,6 +398,31 @@ NUM_PREEMPTION_RESUMES = register_metric(
     "bit-for-bit with the unpreempted run; suspend-to-resume latency "
     "lands in the SLO 'preempt' phase histograms")
 
+# --- streaming micro-batch engine (streaming/, ISSUE 20) ---------------------
+NUM_EPOCHS = register_metric(
+    "numEpochs", COUNTER, ESSENTIAL,
+    "streaming micro-batch epochs committed: each epoch sliced unread "
+    "source rows, ran the partial-aggregate delta query through the "
+    "scheduler (replaying compiled stages via the plan cache), folded "
+    "the delta into the device-resident state with the aggregate merge "
+    "kernel, and atomically committed offsets + state snapshot")
+EPOCH_TIME = register_metric(
+    "epochTime", TIMER, ESSENTIAL,
+    "wall seconds per committed streaming epoch (delta query + state "
+    "fold + checkpoint commit); the per-priority distribution lands in "
+    "the SLO 'epoch' phase histograms")
+STREAM_STATE_BYTES = register_metric(
+    "streamStateBytes", GAUGE, ESSENTIAL,
+    "device bytes of streaming aggregation state resident in HBM "
+    "between epochs — owner-stamped spillable buffers, so per-query "
+    "budgets, policy victim selection and the memory ledger all see "
+    "them; released by StreamingQuery.stop()")
+NUM_STATE_RECOVERIES = register_metric(
+    "numStateRecoveries", COUNTER, ESSENTIAL,
+    "streaming queries that restored state + source offsets from the "
+    "last committed checkpoint epoch instead of a cold full recompute "
+    "(streaming/checkpoint.py recovery path)")
+
 # --- roofline cost declarations (metrics/roofline.py) ------------------------
 # Every device operator declares the bytes it moves per RESOURCE and an
 # estimated FLOP count; the roofline ledger joins these declarations
@@ -587,7 +612,7 @@ NUM_POLICY_TICK_ERRORS = register_metric(
 RETRY_BLOCKS = ("sort", "aggUpdate", "aggMerge", "joinBuild", "joinProbe",
                 "exchangePartition", "exchangeWrite", "exchangeFetch",
                 "exchangeCollective", "wholeStage", "wholeStageOp",
-                "retryBlock")
+                "streamFold", "streamRestore", "retryBlock")
 for _b in RETRY_BLOCKS:
     register_metric(f"{_b}Retries", COUNTER, ESSENTIAL,
                     f"same-size OOM retries of the {_b} retryable block")

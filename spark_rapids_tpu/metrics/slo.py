@@ -20,6 +20,10 @@ This module keeps one fixed-bucket histogram per (phase, priority class):
                      FIFO-within-priority resume grant) — the cost side
                      of the latency-class p99 the preemption buys
            total   — submit -> result
+           epoch   — one committed streaming micro-batch epoch
+                     (streaming/query.py: delta query + state fold +
+                     checkpoint commit) — the trigger-loop analogue of
+                     total for an incremental query
 
 Buckets are log-spaced powers of two from 0.5ms to ~1000s (22 buckets +
 +Inf), so p50/p95/p99 come from bucket interpolation with bounded error
@@ -33,7 +37,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 PHASES = ("queue", "plan", "compile", "execute", "spill", "preempt",
-          "total")
+          "total", "epoch")
 
 #: log-spaced upper bounds in seconds: 0.5ms * 2^k, k = 0..21 (~1048s)
 BUCKET_BOUNDS: Tuple[float, ...] = tuple(

@@ -266,8 +266,20 @@ def _val_fp(v, seen: set):
 
 def _plan_fp(node: LogicalPlan, seen: set) -> tuple:
     attrs = []
+    skip = ("children", "_cached_schema")
+    if getattr(node, "source_identity", None) is not None:
+        # Streaming scans (streaming/source.py) stamp a stable
+        # source_identity on the scan node: the source PAYLOAD changes
+        # every epoch (an appended table object, a longer file list, a
+        # bigger num_rows) while the plan is the same dashboard query —
+        # baking the table fingerprint (id/rows) into the key would miss
+        # the cache on every epoch and re-compile the stages incremental
+        # execution exists to replay.  The identity string (which IS one
+        # of the fingerprinted attrs below) plus the scan schema keys the
+        # plan instead; offsets/row counts stay out of the key.
+        skip = skip + ("source",)
     for k, v in sorted(vars(node).items()):
-        if k in ("children", "_cached_schema"):
+        if k in skip:
             continue
         attrs.append((k, _val_fp(v, seen)))
     return (type(node).__name__, tuple(attrs),

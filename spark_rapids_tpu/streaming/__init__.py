@@ -1,0 +1,28 @@
+"""Streaming micro-batch engine: incremental execution of grouped
+aggregations over append-only sources, with device-resident partial
+state, atomic epoch checkpoints, and compiled-stage replay.
+
+The module map mirrors the epoch's life:
+
+  source.py      append-only sources + the epoch planner (monotonic
+                 offsets, micro-batch slicing, identity-stamped scans)
+  query.py       StreamingQuery: the trigger loop, each epoch a
+                 scheduler query with a lifecycle token
+  state.py       device-resident partial-aggregate state (owner-stamped
+                 spillable buffers, folded via the aggregate's own
+                 merge kernel)
+  checkpoint.py  atomic epoch commit + restart recovery
+
+See docs/tuning-guide.md, "Streaming micro-batch execution".
+"""
+from .checkpoint import EpochCheckpoint
+from .query import StreamingQuery, StreamingUnsupported, stream_query
+from .source import DirectoryTailSource, EpochSlice, MemoryStream, \
+    StreamingSource
+from .state import StreamState
+
+__all__ = [
+    "DirectoryTailSource", "EpochCheckpoint", "EpochSlice", "MemoryStream",
+    "StreamState", "StreamingQuery", "StreamingSource",
+    "StreamingUnsupported", "stream_query",
+]

@@ -1,0 +1,211 @@
+"""Append-only streaming sources and the epoch planner.
+
+A streaming source is an append-only dataset with a MONOTONIC offset: an
+integer that only grows as data arrives (rows appended for the in-memory
+table, files landed for the directory tail).  The epoch planner slices
+the unread range [committed_offset, latest_offset) into one micro-batch
+per epoch, bounded by `spark.rapids.sql.tpu.streaming.maxBatchRows` /
+`.maxFilesPerEpoch`, and hands back an ordinary LogicalScan over just
+that slice — the rest of the engine never learns it is streaming.
+
+Every epoch scan is stamped with `source_identity`, the stable string
+that names this source across epochs AND process restarts.  The plan
+cache fingerprints a stamped scan by that identity + schema instead of
+the source payload (serve/plan_cache.py _plan_fp): the payload changes
+every epoch (an appended table object, a longer file list) while the
+query is the same dashboard aggregation, so keying on the payload would
+miss the cache — and re-compile the stages — every epoch.  The identity
+is also the checkpoint key for this source's committed offset
+(streaming/checkpoint.py), which is why restart recovery requires the
+caller to pick a name that survives the restart.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Tuple
+
+from ..plan import logical as L
+from ..types import Schema
+
+
+class EpochSlice:
+    """One planned micro-batch: the scan to run plus the offset range it
+    covers.  `end` becomes the committed offset once the epoch commits."""
+
+    __slots__ = ("scan", "start", "end", "rows")
+
+    def __init__(self, scan: L.LogicalScan, start: int, end: int,
+                 rows: Optional[int]):
+        self.scan = scan
+        self.start = start
+        self.end = end
+        self.rows = rows  # None when unknown before decode (file sources)
+
+
+class StreamingSource:
+    """Base: a named, append-only source with monotonic integer offsets."""
+
+    identity: str
+    schema: Schema
+
+    def latest_offset(self) -> int:
+        raise NotImplementedError
+
+    def plan_epoch(self, start: int, conf) -> Optional[EpochSlice]:
+        """Slice [start, latest) into the next micro-batch, or None when
+        no unread data exists.  Implementations stamp `source_identity`
+        on the returned scan."""
+        raise NotImplementedError
+
+    def placeholder_scan(self) -> L.LogicalScan:
+        """An empty scan of this source's schema — the node the user's
+        query is built over.  StreamingQuery swaps the per-epoch slice in
+        at this position (located by `source_identity`)."""
+        raise NotImplementedError
+
+    def _stamp(self, scan: L.LogicalScan) -> L.LogicalScan:
+        scan.source_identity = self.identity
+        return scan
+
+
+class MemoryStream(StreamingSource):
+    """In-memory append-only table (the MemoryStream of Spark Structured
+    Streaming, and the unit-test workhorse).  Offsets are ROW counts;
+    append() is thread-safe; epoch slices are zero-copy pyarrow slices of
+    the appended chunks."""
+
+    def __init__(self, schema_or_table, name: str = "mem"):
+        self.identity = f"mem:{name}"
+        self._lock = threading.Lock()
+        self._chunks: List = []       # appended pa.Table chunks, in order
+        self._offsets: List[int] = [0]  # cumulative row counts
+        if isinstance(schema_or_table, Schema):
+            self.schema = schema_or_table
+            self._empty = _empty_table(self.schema)
+        else:
+            table = schema_or_table
+            from ..types import StructField, from_arrow
+            self.schema = Schema([
+                StructField(n, from_arrow(t))
+                for n, t in zip(table.column_names, table.schema.types)])
+            self._empty = table.slice(0, 0)
+            if table.num_rows:
+                self.append(table)
+
+    def append(self, table) -> int:
+        """Append a pyarrow Table; returns the new latest offset."""
+        if table.column_names != [f.name for f in self.schema]:
+            raise ValueError(
+                f"appended columns {table.column_names} do not match "
+                f"source schema {[f.name for f in self.schema]}")
+        with self._lock:
+            self._chunks.append(table)
+            self._offsets.append(self._offsets[-1] + table.num_rows)
+            return self._offsets[-1]
+
+    def latest_offset(self) -> int:
+        with self._lock:
+            return self._offsets[-1]
+
+    def rows_between(self, start: int, end: int):
+        """pyarrow Table of rows [start, end) — zero-copy slices of the
+        appended chunks, concatenated in append order (the order every
+        bit-for-bit argument in docs/tuning-guide.md leans on)."""
+        import pyarrow as pa
+        with self._lock:
+            chunks, offsets = list(self._chunks), list(self._offsets)
+        parts = []
+        for i, chunk in enumerate(chunks):
+            lo, hi = offsets[i], offsets[i + 1]
+            s, e = max(start, lo), min(end, hi)
+            if s < e:
+                parts.append(chunk.slice(s - lo, e - s))
+        if not parts:
+            return self._empty
+        return pa.concat_tables(parts)
+
+    def plan_epoch(self, start: int, conf) -> Optional[EpochSlice]:
+        from .. import config as C
+        latest = self.latest_offset()
+        if latest <= start:
+            return None
+        end = min(latest, start + int(conf.get(C.STREAM_MAX_BATCH_ROWS)))
+        table = self.rows_between(start, end)
+        scan = self._stamp(L.LogicalScan(table, self.schema, "memory"))
+        return EpochSlice(scan, start, end, table.num_rows)
+
+    def placeholder_scan(self) -> L.LogicalScan:
+        return self._stamp(L.LogicalScan(self._empty, self.schema,
+                                         "memory"))
+
+
+class DirectoryTailSource(StreamingSource):
+    """Directory-tail file source: new files landing in a (flat)
+    directory are the append log; the offset is the index into the
+    SORTED file listing.  Epoch scans are ordinary file LogicalScans, so
+    decode rides the existing io/ device decode path (parquet/csv/orc).
+
+    Files must be immutable once visible (write-to-temp + rename, the
+    same discipline the checkpoint commit uses) and the directory flat:
+    Hive-partitioned layouts would make the scan options vary with the
+    file list and break the epoch-stable plan fingerprint."""
+
+    def __init__(self, directory: str, fmt: str = "parquet",
+                 schema: Optional[Schema] = None,
+                 options: Optional[dict] = None, name: str = ""):
+        self.directory = os.path.abspath(directory)
+        self.fmt = fmt
+        self.identity = f"dir:{name or self.directory}|{fmt}"
+        self._options = dict(options or {})
+        self._schema: Optional[Schema] = schema
+        self._exts = {"parquet": (".parquet", ".pq"),
+                      "csv": (".csv",), "orc": (".orc",)}[fmt]
+
+    def _listing(self) -> List[str]:
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        return sorted(os.path.join(self.directory, n) for n in names
+                      if n.lower().endswith(self._exts)
+                      and not n.startswith((".", "_")))
+
+    @property
+    def schema(self) -> Schema:  # type: ignore[override]
+        if self._schema is None:
+            files = self._listing()
+            if not files:
+                raise ValueError(
+                    f"cannot infer schema: no {self.fmt} files in "
+                    f"{self.directory} yet — pass schema= explicitly")
+            from ..io.scan import scan_info
+            _files, schema, _opts = scan_info([files[0]], self.fmt,
+                                              dict(self._options))
+            self._schema = schema
+        return self._schema
+
+    def latest_offset(self) -> int:
+        return len(self._listing())
+
+    def plan_epoch(self, start: int, conf) -> Optional[EpochSlice]:
+        from .. import config as C
+        files = self._listing()
+        if len(files) <= start:
+            return None
+        end = min(len(files),
+                  start + max(1, int(conf.get(C.STREAM_MAX_FILES_PER_EPOCH))))
+        scan = self._stamp(L.LogicalScan(files[start:end], self.schema,
+                                         self.fmt, dict(self._options)))
+        return EpochSlice(scan, start, end, None)
+
+    def placeholder_scan(self) -> L.LogicalScan:
+        return self._stamp(L.LogicalScan([], self.schema, self.fmt,
+                                         dict(self._options)))
+
+
+def _empty_table(schema: Schema):
+    import pyarrow as pa
+    from ..types import to_arrow
+    return pa.table({f.name: pa.array([], type=to_arrow(f.dtype))
+                     for f in schema})

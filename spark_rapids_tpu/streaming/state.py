@@ -1,0 +1,182 @@
+"""Device-resident streaming aggregation state.
+
+The state is ONE ColumnarBatch in the aggregate's partial-state layout
+(`TpuHashAggregateExec._state_schema`: the grouping keys as `_k{i}`
+columns plus each aggregate's partial columns), registered with the
+memory runtime as an owner-stamped SPILLABLE buffer.  That registration
+is the whole point: between epochs the state is first-class managed
+memory — per-query budgets count it, the policy engine can pick it as a
+spill victim under pressure, the ledger journals its movements, and
+`StreamingQuery.stop()` releases it with the same owner-confined cleanup
+a cancelled query uses.  A state batch that was spilled to host/disk
+between epochs unspills transparently on the next fold (get_batch's
+`materialize` path).
+
+fold() is the incremental heart: the epoch's delta — the SAME
+aggregation run over just the new rows, rewritten so its output IS a
+partial state (query.py `_delta_aggregates`) — is concatenated BEHIND
+the resident state and pushed through the aggregate's own merge kernel,
+borrowed via the exec's exact kernel-cache key so warm streaming folds
+share the compiled program with the batch path.  State-first concat
+order is a correctness load-bearing detail: the merge's stable key sort
+keeps state rows ahead of delta rows within each group, so float partial
+sums accumulate in chronological left-deep order — the same order the
+batch oracle's prefix-fold merge uses — which is what makes incremental
+results bit-for-bit equal to a full re-query (docs/tuning-guide.md,
+Streaming micro-batch execution).
+
+Both allocation paths are retry blocks with their own reserve sites
+(`stream.fold` / `stream.restore`, swept by the injectOom tests): an OOM
+mid-fold spills, retries, and never corrupts the state — the old buffer
+is freed only after the new one is registered.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..columnar import ColumnarBatch
+from ..columnar.batch import concat_batches
+from ..metrics import names as MN
+
+
+class StreamState:
+    """One streaming query's device-resident partial-aggregate state."""
+
+    def __init__(self, session, agg_exec, owner: str,
+                 budget_bytes: int = 0):
+        self.runtime = session.runtime
+        self.agg = agg_exec
+        self.owner = owner
+        self.budget = int(budget_bytes)
+        self._bid: Optional[int] = None
+        self._size_bytes = 0
+        self._rows = 0
+
+    # -- kernels (shared with the batch aggregate via its cache key) --------
+
+    def _kernel(self, suffix: str, builder):
+        from ..utils.kernel_cache import cached_kernel
+        return cached_kernel(self.agg.kernel_key() + (suffix,), builder)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def state_schema(self):
+        return self.agg._state_schema
+
+    def device_bytes(self) -> int:
+        return self._size_bytes if self._bid is not None else 0
+
+    def num_groups(self) -> int:
+        return self._rows
+
+    # -- fold ----------------------------------------------------------------
+
+    def fold(self, delta_table) -> int:
+        """Fold one epoch's delta (a pyarrow table already renamed to the
+        state schema) into the resident state; returns resident group
+        count.  Retryable: `stream.fold` reserves the H2D + concat +
+        merge working set up front so the spill cascade (and the fault
+        injector) see the allocation boundary."""
+        from ..mem.retry import with_retry
+        from ..utils.kernel_cache import record_dispatch
+
+        names = [f.name for f in self.state_schema]
+        if delta_table.column_names != names:
+            delta_table = delta_table.rename_columns(names)
+
+        def attempt(table) -> ColumnarBatch:
+            # working set: the delta lands on device, concat copies
+            # state + delta once, the merge writes one output of the
+            # same footprint
+            est = max(1, int(table.nbytes)) * 2 + self._size_bytes * 3
+            self.runtime.reserve(est, site="stream.fold")
+            delta = ColumnarBatch.from_arrow(table)
+            parts = [delta]
+            if self._bid is not None:
+                # unspills transparently if the policy engine evicted
+                # the state between epochs
+                parts = [self.runtime.get_batch(self._bid), delta]
+            merged_in = parts[0] if len(parts) == 1 \
+                else concat_batches(parts)
+            merge = self._kernel("merge", lambda: self.agg._merge_kernel)
+            record_dispatch()
+            return merge(merged_in)
+
+        with self.runtime.ledger.query_scope(self.owner, self.budget):
+            merged = with_retry(attempt, [delta_table],
+                                runtime=self.runtime,
+                                metrics=self.runtime.metrics,
+                                name="streamFold")[0]
+            n = merged.num_rows_host()
+            merged = merged.maybe_shrink(n)
+            new_bid = self.runtime.add_batch(merged)
+        old_bid, self._bid = self._bid, new_bid
+        self._rows = n
+        self._size_bytes = merged.device_size_bytes()
+        if old_bid is not None:
+            self.runtime.free_batch(old_bid)
+        self.runtime.metrics.set_max(MN.STREAM_STATE_BYTES,
+                                     self._size_bytes)
+        return n
+
+    # -- finalize ------------------------------------------------------------
+
+    def finalize_table(self):
+        """Finalized result of the resident state as a pyarrow table
+        (group columns + aggregate outputs), through the aggregate's own
+        finalize kernel.  None before the first fold."""
+        if self._bid is None:
+            return None
+        from ..utils.kernel_cache import record_dispatch
+        state = self.runtime.get_batch(self._bid)
+        finalize = self._kernel("finalize",
+                                lambda: self.agg._finalize_kernel)
+        record_dispatch()
+        return finalize(state).to_arrow()
+
+    # -- checkpoint + recovery ----------------------------------------------
+
+    def snapshot(self) -> Optional[Tuple[list, object]]:
+        """(host leaves, BatchMeta) of the resident state — the exact
+        device bits pulled down through the spill serde, so a restore
+        reproduces the state bit-for-bit."""
+        if self._bid is None:
+            return None
+        from ..mem.buffer import batch_to_host
+        return batch_to_host(self.runtime.get_batch(self._bid))
+
+    def restore(self, leaves, meta) -> None:
+        """Re-admit a checkpointed state snapshot onto the device
+        (restart recovery).  Retryable at `stream.restore`."""
+        from ..mem.buffer import host_to_batch
+        from ..mem.retry import with_retry
+
+        def attempt(_):
+            self.runtime.reserve(max(1, int(meta.size_bytes)),
+                                 site="stream.restore")
+            return host_to_batch(leaves, meta)
+
+        with self.runtime.ledger.query_scope(self.owner, self.budget):
+            batch = with_retry(attempt, [None], runtime=self.runtime,
+                               metrics=self.runtime.metrics,
+                               name="streamRestore")[0]
+            new_bid = self.runtime.add_batch(batch)
+        old_bid, self._bid = self._bid, new_bid
+        self._rows = batch.num_rows_host()
+        self._size_bytes = batch.device_size_bytes()
+        if old_bid is not None:
+            self.runtime.free_batch(old_bid)
+        self.runtime.metrics.set_max(MN.STREAM_STATE_BYTES,
+                                     self._size_bytes)
+
+    # -- release -------------------------------------------------------------
+
+    def release(self) -> int:
+        """Owner-confined cleanup (the stop() path): free every buffer
+        stamped with this stream's owner across all tiers.  Returns
+        bytes freed; idempotent."""
+        self._bid = None
+        self._rows = 0
+        self._size_bytes = 0
+        return self.runtime.release_owner(self.owner)

@@ -52,6 +52,8 @@ OOM_SWEEP_SITES = (
     "join.probe",              # exec/join.py — probe output
     "materialize",             # mem/runtime.py — unspill re-admit
     "sort",                    # exec/sort.py — device sort staging
+    "stream.fold",             # streaming/state.py — epoch delta fold
+    "stream.restore",          # streaming/state.py — checkpoint re-admit
     "wholeStage",              # exec/whole_stage.py — fused stage
     "wholeStage.op",           # exec/whole_stage.py — per-op fallback
 )

@@ -1,0 +1,658 @@
+"""Streaming micro-batch engine tier (ISSUE 20 acceptance).
+
+Four pillars, all on CPU:
+
+  * correctness: incremental results are BIT-FOR-BIT identical to a full
+    batch re-query over all data seen so far — across agg shapes
+    (sum/avg/min/max/count, multi-agg, rollup) and every supported dtype
+    as a grouping key.  The alignment contract: the epoch row size must
+    equal `spark.rapids.sql.reader.batchSizeRows`, so the batch oracle's
+    prefix-fold merges partials in the same left-deep order the
+    incremental fold does (docs/tuning-guide.md, Streaming micro-batch
+    execution);
+  * replay: every epoch after the first is a plan-cache HIT (the
+    fingerprint keys the stamped scan by source identity + schema, not
+    the per-epoch payload — the PR 20 bugfix), and warm epochs compile
+    ZERO new kernels or stages;
+  * robustness: injectOom forced at every `stream.fold` /
+    `stream.restore` reserve ordinal leaves results identical;
+    kill-and-restart resumes from the last committed epoch bit-for-bit
+    (including with a partial epoch directory from a killed commit);
+    stop() and a blown epoch deadline leave zero leaked owner bytes;
+  * observability: epoch journal events validate, numEpochs /
+    streamStateBytes / numStateRecoveries move.
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.engine import TpuSession
+from spark_rapids_tpu.metrics import names as MN
+from spark_rapids_tpu.metrics.journal import validate_events
+from spark_rapids_tpu.plan.logical import col, functions as F, lit
+from spark_rapids_tpu.streaming import (DirectoryTailSource, MemoryStream,
+                                        StreamingQuery, StreamingUnsupported,
+                                        stream_query)
+from spark_rapids_tpu.utils import faults
+from spark_rapids_tpu.utils import kernel_cache as KC
+
+from data_gen import gen_table
+
+pytestmark = pytest.mark.streaming
+
+EPOCH_ROWS = 200
+
+
+def _conf(extra=None):
+    """Streaming session conf: device float agg on (streaming state
+    requires the device aggregate) and the reader batch size pinned to
+    the epoch row size — the alignment that makes incremental float
+    folds bit-for-bit equal to the batch oracle's prefix-fold."""
+    conf = {
+        "spark.rapids.sql.variableFloatAgg.enabled": "true",
+        "spark.rapids.sql.reader.batchSizeRows": str(EPOCH_ROWS),
+        "spark.rapids.sql.tpu.streaming.maxBatchRows": str(EPOCH_ROWS),
+    }
+    conf.update(extra or {})
+    return conf
+
+
+def _canon(table):
+    """Canonical row list with floats replaced by their BIT PATTERNS
+    (NaN payloads and signed zeros distinguish) — sorted, so unordered
+    aggregate output compares exactly."""
+    cols = []
+    for i in range(table.num_columns):
+        c = table.column(i).combine_chunks()
+        vals = c.to_pylist()
+        if pa.types.is_float64(c.type):
+            vals = [None if v is None else struct.pack("<d", v)
+                    for v in vals]
+        elif pa.types.is_float32(c.type):
+            vals = [None if v is None else struct.pack("<f", v)
+                    for v in vals]
+        cols.append(vals)
+    return sorted(zip(*cols), key=repr) if cols else []
+
+
+def _assert_tables_bit_equal(a, b, label=""):
+    assert a is not None and b is not None, label
+    assert a.column_names == b.column_names, label
+    assert _canon(a) == _canon(b), label
+
+
+def _mem_source(schema_fields, name="s"):
+    return MemoryStream(T.Schema([T.StructField(n, d)
+                                  for n, d in schema_fields]), name=name)
+
+
+def _batch_oracle(session, source, build):
+    """Full re-query over everything appended so far, through the BATCH
+    path of the same session (same kernel caches, same batch slicing)."""
+    from spark_rapids_tpu.engine import DataFrame
+    from spark_rapids_tpu.plan import logical as L
+    table = source.rows_between(0, source.latest_offset())
+    df = DataFrame(session, L.LogicalScan(table, source.schema, "memory"))
+    return build(df).to_arrow()
+
+
+def _chunks(seed, n_epochs, key_mod=11, **cols):
+    """n_epochs pyarrow chunks of EPOCH_ROWS rows each, typed per cols.
+    `key_mod` narrows an integer "k" column so groups repeat across
+    epochs and the fold actually MERGES state (unique keys would only
+    ever append); None keeps the raw generated values."""
+    data, schema = gen_table(seed, n_epochs * EPOCH_ROWS, **cols)
+    if key_mod is not None and "k" in data:
+        data["k"] = [None if x is None else x % key_mod
+                     for x in data["k"]]
+    from spark_rapids_tpu.types import to_arrow
+    table = pa.table({k: pa.array(v, type=to_arrow(schema.field(k).dtype))
+                      for k, v in data.items()})
+    return [table.slice(i * EPOCH_ROWS, EPOCH_ROWS)
+            for i in range(n_epochs)], schema
+
+
+# --------------------------------------------------------------------------
+# correctness: incremental == batch oracle, bit for bit
+# --------------------------------------------------------------------------
+
+AGG_SHAPES = {
+    "sum": lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv")),
+    "avg": lambda df: df.group_by(col("k")).agg(
+        F.avg(col("v")).alias("av")),
+    "min": lambda df: df.group_by(col("k")).agg(
+        F.min(col("v")).alias("mn")),
+    "max": lambda df: df.group_by(col("k")).agg(
+        F.max(col("v")).alias("mx")),
+    "count": lambda df: df.group_by(col("k")).agg(
+        F.count(col("v")).alias("c")),
+    "multi": lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv"), F.avg(col("v")).alias("av"),
+        F.min(col("v")).alias("mn"), F.max(col("v")).alias("mx"),
+        F.count(lit(1)).alias("c")),
+}
+
+
+@pytest.mark.parametrize("shape", sorted(AGG_SHAPES), ids=str)
+def test_incremental_equals_batch_oracle_every_epoch(shape):
+    """Every epoch's complete-mode output equals a full batch re-query
+    over all rows appended so far — bit for bit, doubles included."""
+    s = TpuSession(_conf())
+    src = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    build = AGG_SHAPES[shape]
+    q = StreamingQuery(s, src, build, name=f"agg-{shape}")
+    chunks, _ = _chunks(101, 4, k=(T.LongType, False), v=T.DoubleType)
+    for chunk in chunks:
+        src.append(chunk)
+        assert q.trigger_once()
+        _assert_tables_bit_equal(q.result(),
+                                 _batch_oracle(s, src, build),
+                                 f"{shape} epoch {q.epochs_committed}")
+    q.stop()
+
+
+ALL_DTYPES = [T.IntegerType, T.LongType, T.ShortType, T.ByteType,
+              T.DoubleType, T.FloatType, T.BooleanType, T.StringType,
+              T.DateType, T.TimestampType]
+
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES, ids=lambda d: d.name)
+def test_incremental_bit_for_bit_every_key_dtype(dtype):
+    """Every supported dtype flows through the state store as a nullable
+    grouping key (keys ARE state columns) — incremental output stays bit
+    identical to the oracle."""
+    s = TpuSession(_conf())
+    src = _mem_source([("k", dtype), ("v", T.LongType)])
+
+    def build(df):
+        return df.group_by(col("k")).agg(
+            F.count(lit(1)).alias("c"), F.sum(col("v")).alias("sv"))
+
+    q = StreamingQuery(s, src, build, name=f"dt-{dtype.name}")
+    key_mod = 11 if dtype in (T.IntegerType, T.LongType, T.ShortType,
+                              T.ByteType) else None
+    chunks, _ = _chunks(7, 3, key_mod=key_mod, k=dtype,
+                        v=(T.LongType, False))
+    for chunk in chunks:
+        src.append(chunk)
+        assert q.trigger_once()
+    _assert_tables_bit_equal(q.result(), _batch_oracle(s, src, build),
+                             dtype.name)
+    q.stop()
+
+
+def test_incremental_rollup_bit_for_bit():
+    """ROLLUP is incremental-safe: the grouping-id is just another state
+    key, and the result projection (dropping it) is a pure column
+    select."""
+    s = TpuSession(_conf())
+    src = _mem_source([("a", T.LongType), ("b", T.LongType),
+                       ("v", T.DoubleType)])
+
+    def build(df):
+        return df.rollup(col("a"), col("b")).agg(
+            F.sum(col("v")).alias("sv"), F.count(col("v")).alias("c"))
+
+    q = StreamingQuery(s, src, build, name="rollup")
+    chunks, _ = _chunks(13, 3, a=(T.LongType, False), b=(T.LongType, False),
+                        v=T.DoubleType)
+    for chunk in chunks:
+        # narrow the key space so subtotal groups actually merge
+        chunk = chunk.set_column(
+            0, "a", pa.array(
+                [x % 5 if x is not None else None
+                 for x in chunk.column(0).to_pylist()], type=pa.int64()))
+        chunk = chunk.set_column(
+            1, "b", pa.array(
+                [x % 3 if x is not None else None
+                 for x in chunk.column(1).to_pylist()], type=pa.int64()))
+        src.append(chunk)
+        assert q.trigger_once()
+        _assert_tables_bit_equal(q.result(),
+                                 _batch_oracle(s, src, build),
+                                 f"rollup epoch {q.epochs_committed}")
+    q.stop()
+
+
+def test_update_mode_returns_touched_groups_only():
+    s = TpuSession(_conf())
+    src = _mem_source([("k", T.LongType), ("v", T.LongType)])
+    build = lambda df: df.group_by(col("k")).agg(F.sum(col("v")).alias("sv"))
+    q = StreamingQuery(s, src, build, name="upd", output_mode="update")
+    src.append(pa.table({"k": pa.array([1, 2, 3], type=pa.int64()),
+                         "v": pa.array([10, 20, 30], type=pa.int64())}))
+    assert q.trigger_once()
+    assert sorted(q.result().column("k").to_pylist()) == [1, 2, 3]
+    # epoch 2 touches only k=2: update emits just that group, with the
+    # FOLDED (not delta) value
+    src.append(pa.table({"k": pa.array([2], type=pa.int64()),
+                         "v": pa.array([5], type=pa.int64())}))
+    assert q.trigger_once()
+    out = q.result()
+    assert out.column("k").to_pylist() == [2]
+    assert out.column("sv").to_pylist() == [25]
+    q.stop()
+
+
+def test_directory_tail_source_incremental():
+    """New files landing in a directory are epochs; incremental result
+    equals a batch read of all files (integer aggs: exact regardless of
+    decode batching)."""
+    import pyarrow.parquet as pq
+    import tempfile
+    s = TpuSession(_conf())
+    with tempfile.TemporaryDirectory() as d:
+        rng = np.random.default_rng(3)
+        tables = [pa.table({
+            "k": pa.array(rng.integers(0, 6, 150), type=pa.int64()),
+            "v": pa.array(rng.integers(0, 1000, 150), type=pa.int64())})
+            for _ in range(3)]
+        # first file lands before the query starts (schema inference)
+        pq.write_table(tables[0], os.path.join(d, "part-000.parquet"))
+        src = DirectoryTailSource(d, fmt="parquet", name="tail")
+        build = lambda df: df.group_by(col("k")).agg(
+            F.sum(col("v")).alias("sv"), F.count(lit(1)).alias("c"))
+        q = StreamingQuery(s, src, build, name="dir")
+        assert q.process_available() == 1
+        for i, t in enumerate(tables[1:], start=1):
+            # write-to-temp + rename: files must be immutable once seen
+            tmp = os.path.join(d, f"_part-{i:03d}.tmp")
+            pq.write_table(t, tmp)
+            os.replace(tmp, os.path.join(d, f"part-{i:03d}.parquet"))
+        assert q.process_available() == 2
+        oracle = build(s.read.parquet(*sorted(
+            os.path.join(d, f) for f in os.listdir(d)
+            if f.endswith(".parquet")))).to_arrow()
+        _assert_tables_bit_equal(q.result(), oracle, "dir tail")
+        q.stop()
+
+
+# --------------------------------------------------------------------------
+# replay: plan-cache hits + zero warm compiles (the PR 20 bugfix)
+# --------------------------------------------------------------------------
+
+def test_plan_cache_hits_across_epochs():
+    """The fingerprint keys a stamped streaming scan by source identity
+    + schema, NOT the per-epoch payload: every epoch after the first is
+    a plan-cache hit on ONE cache entry."""
+    s = TpuSession(_conf())
+    src = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    q = StreamingQuery(s, src, lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv")), name="pc")
+    chunks, _ = _chunks(23, 4, k=(T.LongType, False), v=T.DoubleType)
+    for chunk in chunks:
+        src.append(chunk)
+        q.trigger_once()
+    stats = s.scheduler.stats()["plan_cache"]
+    assert stats["entries"] == 1, stats
+    assert stats["misses"] == 1, stats
+    assert stats["hits"] == 3, stats
+    commits = [e for e in q.journal.events()
+               if e.get("kind") == "epoch" and e.get("name") == "commit"]
+    assert [c["plan_cache"] for c in commits] == \
+        ["miss", "hit", "hit", "hit"]
+    q.stop()
+
+
+def test_plan_fingerprint_ignores_stream_scan_payload():
+    """Regression (the bug this PR fixes): two epochs' delta plans carry
+    different scan payloads (different tables, offsets, row counts) but
+    the same source identity — their fingerprints must be EQUAL, and a
+    different identity must change the fingerprint."""
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.serve.plan_cache import _plan_fp as _fp_impl
+    schema = T.Schema([T.StructField("k", T.LongType),
+                       T.StructField("v", T.DoubleType)])
+
+    def _plan_fp(node):
+        return _fp_impl(node, set())
+    t1 = pa.table({"k": pa.array([1], type=pa.int64()),
+                   "v": pa.array([1.0], type=pa.float64())})
+    t2 = pa.table({"k": pa.array([2, 3], type=pa.int64()),
+                   "v": pa.array([2.0, 3.0], type=pa.float64())})
+
+    def plan_for(table, identity):
+        scan = L.LogicalScan(table, schema, "memory")
+        scan.source_identity = identity
+        return L.LogicalAggregate(
+            [col("k")], [F.sum(col("v")).alias("sv")], scan)
+
+    assert _plan_fp(plan_for(t1, "mem:a")) == _plan_fp(plan_for(t2, "mem:a"))
+    assert _plan_fp(plan_for(t1, "mem:a")) != _plan_fp(plan_for(t1, "mem:b"))
+    # unstamped scans still key by payload (batch behavior unchanged)
+    assert _plan_fp(plan_for(t1, None)) != _plan_fp(plan_for(t2, None))
+
+
+def test_warm_epochs_compile_nothing():
+    """After 3 warm-up epochs (batch bucket shapes stabilize), further
+    epochs perform ZERO kernel builds and ZERO stage compiles — they
+    replay compiled stages end to end."""
+    s = TpuSession(_conf())
+    src = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    q = StreamingQuery(s, src, lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv"), F.avg(col("v")).alias("av")),
+        name="warm")
+    chunks, _ = _chunks(31, 7, k=(T.LongType, False), v=T.DoubleType)
+    for chunk in chunks[:3]:
+        src.append(chunk)
+        q.trigger_once()
+    st0 = KC.stats()
+    for chunk in chunks[3:]:
+        src.append(chunk)
+        q.trigger_once()
+    st1 = KC.stats()
+    assert st1["builds"] - st0["builds"] == 0, (st0, st1)
+    assert st1.get("stage_compiles", 0) - st0.get("stage_compiles", 0) \
+        == 0, (st0, st1)
+    q.stop()
+
+
+# --------------------------------------------------------------------------
+# robustness: injectOom sweep, kill/restart recovery, clean shutdown
+# --------------------------------------------------------------------------
+
+def _fold_run(extra_conf=None, n_epochs=2):
+    """One small streaming run; returns the final complete-mode table.
+    Fresh session per call so the injectOom spec arms from conf."""
+    faults.INJECTOR.reset()
+    s = TpuSession(_conf(extra_conf))
+    src = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    q = StreamingQuery(s, src, lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv"), F.count(lit(1)).alias("c")),
+        name="oom")
+    chunks, _ = _chunks(47, n_epochs, k=(T.LongType, False), v=T.DoubleType)
+    for chunk in chunks:
+        src.append(chunk)
+        assert q.trigger_once()
+    out = q.result()
+    q.stop()
+    return out
+
+
+def test_oom_injection_at_every_stream_fold_ordinal():
+    """Force an OOM at EVERY `stream.fold` reserve ordinal, one at a
+    time: the retry block spills + retries and the final result stays
+    bit-for-bit identical (the old state buffer is freed only after the
+    new one is registered)."""
+    order = []
+    orig = faults.INJECTOR.on_reserve
+
+    def spy(site, nbytes):
+        order.append(site)
+        return orig(site, nbytes)
+
+    faults.INJECTOR.on_reserve = spy
+    try:
+        baseline = _fold_run()
+    finally:
+        faults.INJECTOR.on_reserve = orig
+    fold_ordinals = [i + 1 for i, site in enumerate(order)
+                     if site == "stream.fold"]
+    assert len(fold_ordinals) == 2, order  # one fold per epoch
+    for ordinal in fold_ordinals:
+        out = _fold_run({"spark.rapids.tpu.test.injectOom": str(ordinal)})
+        assert any(rec[2] == "stream.fold"
+                   for rec in faults.INJECTOR.injected_log), \
+            f"ordinal {ordinal} never fired at stream.fold"
+        _assert_tables_bit_equal(out, baseline, f"ordinal {ordinal}")
+    faults.INJECTOR.reset()
+
+
+def test_oom_injection_at_stream_restore(tmp_path):
+    """Recovery's state re-admit retries through an injected OOM and the
+    recovered query continues bit-for-bit."""
+    ckpt = str(tmp_path / "ckpt")
+
+    def run(extra=None, epochs=(0, 1, 2)):
+        faults.INJECTOR.reset()
+        s = TpuSession(_conf(extra))
+        src = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+        chunks, _ = _chunks(59, 3, k=(T.LongType, False), v=T.DoubleType)
+        build = lambda df: df.group_by(col("k")).agg(
+            F.sum(col("v")).alias("sv"))
+        q = StreamingQuery(s, src, build, name="rec",
+                           checkpoint_dir=ckpt)
+        for i in epochs:
+            src.append(chunks[i])
+        q.process_available()
+        out = q.result()
+        q.stop()
+        return out, q
+
+    # seed the checkpoint with 2 committed epochs, then snapshot it so
+    # the baseline and the injected run both recover from the SAME point
+    # (each recovery run advances the live checkpoint)
+    import shutil
+    shutil.rmtree(ckpt, ignore_errors=True)
+    run(epochs=(0, 1))
+    seed_dir = str(tmp_path / "ckpt-seed")
+    shutil.copytree(ckpt, seed_dir)
+    # discover the restore ordinal
+    order = []
+    orig = faults.INJECTOR.on_reserve
+
+    def spy(site, nbytes):
+        order.append(site)
+        return orig(site, nbytes)
+
+    faults.INJECTOR.on_reserve = spy
+    try:
+        baseline, q1 = run(epochs=(0, 1, 2))
+    finally:
+        faults.INJECTOR.on_reserve = orig
+    assert q1.recovered
+    restore_ordinals = [i + 1 for i, site in enumerate(order)
+                        if site == "stream.restore"]
+    assert restore_ordinals, order
+    shutil.rmtree(ckpt)
+    shutil.copytree(seed_dir, ckpt)
+    out, q2 = run({"spark.rapids.tpu.test.injectOom":
+                   str(restore_ordinals[0])}, epochs=(0, 1, 2))
+    assert any(rec[2] == "stream.restore"
+               for rec in faults.INJECTOR.injected_log)
+    _assert_tables_bit_equal(out, baseline, "restore ordinal")
+    faults.INJECTOR.reset()
+
+
+def test_kill_and_restart_resumes_bit_for_bit(tmp_path):
+    """A query abandoned mid-stream (no stop(), like a process kill)
+    restarts from its checkpoint and the resumed run's final result is
+    bit-for-bit identical to an uninterrupted run — including when the
+    kill left a PARTIAL epoch directory behind (commit marker moves
+    last, so recovery never reads it)."""
+    conf = _conf()
+    chunks, _ = _chunks(71, 6, k=(T.LongType, False), v=T.DoubleType)
+    build = lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv"), F.avg(col("v")).alias("av"))
+
+    # uninterrupted oracle run
+    s0 = TpuSession(conf)
+    src0 = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    q0 = StreamingQuery(s0, src0, build, name="uninterrupted")
+    for c in chunks:
+        src0.append(c)
+    assert q0.process_available() == 6
+    oracle = q0.result()
+    q0.stop()
+
+    # killed run: 3 epochs commit, then the instance is abandoned
+    ckpt = str(tmp_path / "ckpt")
+    s1 = TpuSession(conf)
+    src1 = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    q1 = StreamingQuery(s1, src1, build, name="victim",
+                        checkpoint_dir=ckpt)
+    for c in chunks[:3]:
+        src1.append(c)
+    assert q1.process_available() == 3
+    q1._state.release()  # the kill reclaims device memory
+
+    # a killed commit of epoch 4 left a partial directory (no marker)
+    partial = os.path.join(ckpt, "epoch-4")
+    os.makedirs(partial)
+    with open(os.path.join(partial, "state.bin"), "wb") as f:
+        f.write(b"\x00garbage")
+
+    # restart: a NEW source instance replays the same append log (the
+    # committed offset skips what epochs 1-3 already folded)
+    s2 = TpuSession(conf)
+    src2 = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    for c in chunks:
+        src2.append(c)
+    before = s2.runtime.metrics.snapshot().get("numStateRecoveries", 0)
+    q2 = StreamingQuery(s2, src2, build, name="victim",
+                        checkpoint_dir=ckpt)
+    assert q2.recovered
+    assert q2.epochs_committed == 3
+    assert s2.runtime.metrics.snapshot()["numStateRecoveries"] == before + 1
+    assert q2.process_available() == 3  # only the unread epochs
+    _assert_tables_bit_equal(q2.result(), oracle, "restart")
+    q2.stop()
+
+
+def test_checkpoint_prunes_old_epochs(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    s = TpuSession(_conf({
+        "spark.rapids.sql.tpu.streaming.checkpoint.keepEpochs": "2"}))
+    src = _mem_source([("k", T.LongType), ("v", T.LongType)])
+    q = StreamingQuery(s, src, lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv")), name="prune", checkpoint_dir=ckpt)
+    chunks, _ = _chunks(83, 5, k=(T.LongType, False), v=(T.LongType, False))
+    for c in chunks:
+        src.append(c)
+        q.trigger_once()
+    dirs = sorted(d for d in os.listdir(ckpt) if d.startswith("epoch-"))
+    assert dirs == ["epoch-4", "epoch-5"], dirs
+    q.stop()
+
+
+def _owner_bytes(session, owner):
+    rt = session.runtime
+    return sum(st.owner_size(owner) for st in
+               (rt.device_store, rt.host_store, rt.disk_store))
+
+
+def test_stop_releases_every_owner_byte():
+    s = TpuSession(_conf())
+    src = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    q = StreamingQuery(s, src, lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv")), name="release")
+    chunks, _ = _chunks(97, 3, k=(T.LongType, False), v=T.DoubleType)
+    for c in chunks:
+        src.append(c)
+        q.trigger_once()
+    assert _owner_bytes(s, q.owner) > 0
+    freed = q.stop()
+    assert freed > 0
+    assert _owner_bytes(s, q.owner) == 0
+    # idempotent; a stopped query refuses further triggers
+    assert q.stop() == 0
+    with pytest.raises(RuntimeError):
+        q.trigger_once()
+
+
+def test_blown_epoch_deadline_leaves_zero_owner_bytes():
+    """An epoch whose delta query dies on its deadline (shed at
+    admission or cancelled mid-flight) surfaces the error from
+    trigger_once; stop() still leaves zero owner bytes and the session
+    stays usable."""
+    from spark_rapids_tpu.serve.lifecycle import (QueryCancelled,
+                                                  QueryDeadlineExceeded)
+    s = TpuSession(_conf())
+    src = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    q = StreamingQuery(s, src, lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv")), name="deadline",
+        epoch_deadline_ms=0.000001)
+    chunks, _ = _chunks(103, 1, k=(T.LongType, False), v=T.DoubleType)
+    src.append(chunks[0])
+    with pytest.raises((QueryCancelled, QueryDeadlineExceeded,
+                        TimeoutError)):
+        q.trigger_once()
+    assert q.epochs_committed == 0
+    q.stop()
+    assert _owner_bytes(s, q.owner) == 0
+    # the session still serves batch queries
+    assert s.from_pydict({"x": [1, 2, 3]}).count() == 3
+
+
+def test_interval_trigger_and_stop_midstream():
+    s = TpuSession(_conf())
+    src = _mem_source([("k", T.LongType), ("v", T.LongType)])
+    q = StreamingQuery(s, src, lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv")), name="interval")
+    chunks, _ = _chunks(109, 3, k=(T.LongType, False), v=(T.LongType, False))
+    q.start(interval_s=0.01)
+    import time
+    for c in chunks:
+        src.append(c)
+    deadline = time.time() + 30
+    while q.epochs_committed < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert q.epochs_committed == 3
+    assert q.error is None
+    q.stop()
+    assert _owner_bytes(s, q.owner) == 0
+
+
+# --------------------------------------------------------------------------
+# gates: what cannot fold incrementally fails FAST, not mid-stream
+# --------------------------------------------------------------------------
+
+def test_unsupported_shapes_raise_up_front():
+    s = TpuSession(_conf())
+    src = _mem_source([("k", T.LongType), ("v", T.LongType)])
+
+    def expect(build):
+        with pytest.raises(StreamingUnsupported):
+            StreamingQuery(s, src, build, name="gate")
+
+    # distinct aggregates: partial states not mergeable across epochs
+    expect(lambda df: df.group_by(col("k")).agg(
+        F.count_distinct(col("v")).alias("cd")))
+    # global aggregation: no grouping keys
+    expect(lambda df: df.agg(F.sum(col("v")).alias("sv")))
+    # compound result projection needs re-finalization arithmetic
+    expect(lambda df: df.group_by(col("k")).agg(
+        (F.sum(col("v")) / F.count(col("v"))).alias("m")))
+    # not an aggregation at all
+    expect(lambda df: df.filter(col("v") > 0))
+
+
+# --------------------------------------------------------------------------
+# observability: journal + metrics
+# --------------------------------------------------------------------------
+
+def test_epoch_journal_events_and_metrics(tmp_path):
+    s = TpuSession(_conf())
+    src = _mem_source([("k", T.LongType), ("v", T.DoubleType)])
+    q = StreamingQuery(s, src, lambda df: df.group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv")), name="obs",
+        checkpoint_dir=str(tmp_path / "ck"))
+    chunks, _ = _chunks(127, 2, k=(T.LongType, False), v=T.DoubleType)
+    for c in chunks:
+        src.append(c)
+        q.trigger_once()
+    events = q.journal.events()
+    assert validate_events(events) == []
+    slices = [e for e in events
+              if e.get("kind") == "epoch" and e.get("name") == "slice"]
+    commits = [e for e in events
+               if e.get("kind") == "epoch" and e.get("name") == "commit"]
+    assert len(slices) == 2 and len(commits) == 2
+    assert [c["epoch"] for c in commits] == [1, 2]
+    assert all(c["state_bytes"] > 0 for c in commits)
+    assert slices[0]["start"] == 0 and slices[0]["end"] == EPOCH_ROWS
+    snap = s.runtime.metrics.snapshot()
+    assert snap["numEpochs"] == 2
+    assert snap["streamStateBytes"] > 0
+    assert "epochTime" in snap
+    # the epoch SLO phase sees one observation per committed epoch
+    report = s.scheduler.slo.report()
+    assert report["epoch"]["0"]["count"] == 2, report.get("epoch")
+    q.stop()
